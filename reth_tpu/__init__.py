@@ -1,0 +1,28 @@
+"""reth-tpu: a TPU-native Ethereum execution-layer framework.
+
+A brand-new framework with the capabilities of the reference client
+(paradigmxyz/reth): staged historical sync, block execution, MDBX-class
+storage, Merkle-Patricia-Trie state commitment, Engine API, and JSON-RPC —
+with the state-commitment data plane (batched Keccak-256 node hashing)
+expressed as shape-stable JAX/XLA/Pallas kernels that run on TPU.
+
+Layer map (mirrors the reference's layering, see SURVEY.md §1):
+
+- ``reth_tpu.primitives``  — B256/Address/RLP/nibbles/keccak CPU reference
+  (reference layer 0: alloy-primitives, alloy-rlp, alloy-trie).
+- ``reth_tpu.ops``         — device kernels: batched keccak-f[1600] in JAX
+  and Pallas (replaces the reference's `asm-keccak` sha3-asm fast path).
+- ``reth_tpu.storage``     — typed tables, Database/Tx/Cursor traits, memdb
+  (reference: crates/storage/db-api, crates/storage/db).
+- ``reth_tpu.trie``        — StateRoot/StorageRoot walkers, HashBuilder,
+  prefix sets, sparse trie, proofs (reference: crates/trie/*).
+- ``reth_tpu.evm``         — block execution on CPU (reference: revm glue).
+- ``reth_tpu.consensus``   — header/body/post-execution validation.
+- ``reth_tpu.stages``      — staged-sync pipeline (reference: crates/stages).
+- ``reth_tpu.engine``      — live-tip tree, state-root strategies.
+- ``reth_tpu.parallel``    — device meshes, sharded hashing, host↔device
+  batching (the reference's rayon/crossbeam analogue).
+- ``reth_tpu.utils``       — ETL collector, misc.
+"""
+
+__version__ = "0.1.0"

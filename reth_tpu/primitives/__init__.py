@@ -1,0 +1,41 @@
+"""Layer-0 primitives: hashing, RLP, nibbles, core chain types.
+
+Reference analogue: the external alloy-primitives / alloy-rlp / alloy-trie /
+reth-primitives-traits crates (reference Cargo.toml:324-448).
+"""
+
+from .keccak import keccak256, keccak256_batch_np
+from .rlp import rlp_encode, rlp_decode, rlp_encode_list
+from .nibbles import Nibbles, pack_nibbles, unpack_nibbles, encode_path
+from .types import (
+    Account,
+    Header,
+    Transaction,
+    Receipt,
+    Block,
+    Withdrawal,
+    EMPTY_ROOT_HASH,
+    EMPTY_CODE_HASH,
+    KECCAK_EMPTY,
+)
+
+__all__ = [
+    "keccak256",
+    "keccak256_batch_np",
+    "rlp_encode",
+    "rlp_decode",
+    "rlp_encode_list",
+    "Nibbles",
+    "pack_nibbles",
+    "unpack_nibbles",
+    "encode_path",
+    "Account",
+    "Header",
+    "Transaction",
+    "Receipt",
+    "Block",
+    "Withdrawal",
+    "EMPTY_ROOT_HASH",
+    "EMPTY_CODE_HASH",
+    "KECCAK_EMPTY",
+]

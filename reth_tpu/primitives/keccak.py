@@ -1,0 +1,181 @@
+"""Keccak-256 — CPU reference implementations.
+
+Two host-side implementations of Ethereum's Keccak-256 (original Keccak
+padding 0x01, NOT NIST SHA3's 0x06):
+
+- ``keccak256``          — pure-Python, bit-exact reference used by tests and
+  by cold host paths. Reference analogue: `alloy_primitives::keccak256`
+  (the reference enables the `asm-keccak` sha3-asm fast path by default,
+  reference bin/reth/Cargo.toml:94).
+- ``keccak256_batch_np`` — numpy-vectorised batch version over uint64 lanes;
+  this is the *CPU baseline* that the TPU kernel in
+  ``reth_tpu.ops.keccak_jax`` is benchmarked against, standing in for the
+  reference's 32-core rayon keccak (reference
+  crates/stages/stages/src/stages/hashing_account.rs:29-32).
+
+The permutation layout follows FIPS-202: 25 lanes of 64 bits, flat index
+``idx = x + 5*y``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+RATE = 136  # bytes: keccak-256 rate (1088 bits), capacity 512
+
+# Round constants for keccak-f[1600] (24 rounds).
+RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+# Rotation offsets r[x][y].
+ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+_MASK = (1 << 64) - 1
+
+
+def _rotl(v: int, r: int) -> int:
+    return ((v << r) | (v >> (64 - r))) & _MASK
+
+
+def keccak_f1600(state: list[int]) -> list[int]:
+    """One keccak-f[1600] permutation over 25 python-int lanes (pure ref)."""
+    a = list(state)
+    for rc in RC:
+        # theta
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] ^= d[x]
+        # rho + pi
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl(a[x + 5 * y], ROT[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] = b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y] & _MASK)
+        # iota
+        a[0] ^= rc
+    return a
+
+
+def _pad(data: bytes) -> bytes:
+    """Multi-rate keccak padding: 0x01 … 0x80 (0x81 if a single pad byte)."""
+    q = RATE - (len(data) % RATE)
+    if q == 1:
+        return data + b"\x81"
+    return data + b"\x01" + b"\x00" * (q - 2) + b"\x80"
+
+
+def keccak256(data: bytes) -> bytes:
+    """Ethereum Keccak-256 of ``data`` (pure-Python reference)."""
+    padded = _pad(bytes(data))
+    state = [0] * 25
+    for off in range(0, len(padded), RATE):
+        block = padded[off : off + RATE]
+        for i in range(RATE // 8):
+            state[i] ^= int.from_bytes(block[8 * i : 8 * i + 8], "little")
+        state = keccak_f1600(state)
+    out = b"".join(state[i].to_bytes(8, "little") for i in range(4))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# numpy-vectorised batch implementation (CPU baseline for the TPU kernel)
+# ---------------------------------------------------------------------------
+
+_RC_NP = np.array(RC, dtype=np.uint64)
+
+
+def _rotl_np(v: np.ndarray, r: int) -> np.ndarray:
+    if r == 0:
+        return v
+    return (v << np.uint64(r)) | (v >> np.uint64(64 - r))
+
+
+def keccak_f1600_np(lanes: np.ndarray) -> np.ndarray:
+    """Vectorised keccak-f[1600]: ``lanes`` is (N, 25) uint64."""
+    a = [lanes[:, i].copy() for i in range(25)]
+    for rnd in range(24):
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl_np(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] = a[x + 5 * y] ^ d[x]
+        b = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl_np(a[x + 5 * y], ROT[x][y])
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] = b[x + 5 * y] ^ (~b[(x + 1) % 5 + 5 * y] & b[(x + 2) % 5 + 5 * y])
+        a[0] = a[0] ^ _RC_NP[rnd]
+    return np.stack(a, axis=1)
+
+
+def pad_batch(msgs: list[bytes], num_blocks: int) -> np.ndarray:
+    """Pad each message to ``num_blocks*RATE`` bytes, return (N, blocks*17) uint64.
+
+    All messages must fit: ``len(m) < num_blocks*RATE`` with room for at least
+    one pad byte (i.e. ``len(m) <= num_blocks*RATE - 1``).
+    """
+    n = len(msgs)
+    total = num_blocks * RATE
+    buf = np.zeros((n, total), dtype=np.uint8)
+    for i, m in enumerate(msgs):
+        lm = len(m)
+        if lm > total - 1:
+            raise ValueError(f"message {i} too long for {num_blocks} blocks: {lm}")
+        buf[i, :lm] = np.frombuffer(m, dtype=np.uint8)
+        buf[i, lm] ^= 0x01
+        buf[i, total - 1] ^= 0x80
+    return buf.view("<u8").reshape(n, total // 8)
+
+
+def keccak256_batch_np(msgs: list[bytes]) -> list[bytes]:
+    """Batched keccak-256 over same-or-mixed-length messages (numpy, CPU).
+
+    Buckets messages by block count internally; order preserved.
+    """
+    if not msgs:
+        return []
+    out: list[bytes | None] = [None] * len(msgs)
+    buckets: dict[int, list[int]] = {}
+    for i, m in enumerate(msgs):
+        nb = len(m) // RATE + 1
+        buckets.setdefault(nb, []).append(i)
+    for nb, idxs in buckets.items():
+        words = pad_batch([msgs[i] for i in idxs], nb)
+        digests = keccak256_words_np(words, nb)
+        for row, i in enumerate(idxs):
+            out[i] = digests[row].tobytes()
+    return out  # type: ignore[return-value]
+
+
+def keccak256_words_np(words: np.ndarray, num_blocks: int) -> np.ndarray:
+    """Absorb ``num_blocks`` rate-blocks of pre-padded words, return (N, 4) u64.
+
+    ``words`` is (N, num_blocks*17) uint64 little-endian as from ``pad_batch``.
+    """
+    n = words.shape[0]
+    state = np.zeros((n, 25), dtype=np.uint64)
+    for blk in range(num_blocks):
+        state[:, :17] ^= words[:, blk * 17 : (blk + 1) * 17]
+        state = keccak_f1600_np(state)
+    return np.ascontiguousarray(state[:, :4])

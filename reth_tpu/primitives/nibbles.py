@@ -1,0 +1,62 @@
+"""Nibble paths and hex-prefix encoding for the Merkle-Patricia-Trie.
+
+Reference analogue: `Nibbles` in crates/trie/common/src/nibbles.rs and the
+hex-prefix ("compact") path encoding from the Ethereum yellow paper.
+
+A nibble path is represented as an immutable ``bytes`` where every byte is
+0..15 — simple, hashable (usable as dict key), and cheap to slice. This is
+the host-side structural representation; device kernels never see nibbles.
+"""
+
+from __future__ import annotations
+
+Nibbles = bytes  # each byte 0..15
+
+
+def unpack_nibbles(key: bytes) -> Nibbles:
+    """Byte key → nibble path (hi nibble first)."""
+    out = bytearray(2 * len(key))
+    for i, b in enumerate(key):
+        out[2 * i] = b >> 4
+        out[2 * i + 1] = b & 0x0F
+    return bytes(out)
+
+
+def pack_nibbles(nibbles: Nibbles) -> bytes:
+    """Even-length nibble path → byte key."""
+    if len(nibbles) % 2:
+        raise ValueError("odd nibble path cannot pack to bytes")
+    return bytes((nibbles[i] << 4) | nibbles[i + 1] for i in range(0, len(nibbles), 2))
+
+
+def encode_path(nibbles: Nibbles, is_leaf: bool) -> bytes:
+    """Hex-prefix encode a path for a leaf/extension node."""
+    odd = len(nibbles) % 2
+    flag = (2 if is_leaf else 0) + odd
+    if odd:
+        first = bytes([(flag << 4) | nibbles[0]])
+        rest = nibbles[1:]
+    else:
+        first = bytes([flag << 4])
+        rest = nibbles
+    return first + pack_nibbles(rest)
+
+
+def decode_path(encoded: bytes) -> tuple[Nibbles, bool]:
+    """Hex-prefix decode → (nibbles, is_leaf)."""
+    if not encoded:
+        raise ValueError("empty hex-prefix path")
+    flag = encoded[0] >> 4
+    is_leaf = bool(flag & 2)
+    nibs = unpack_nibbles(encoded)
+    if flag & 1:  # odd: keep low nibble of first byte
+        return nibs[1:], is_leaf
+    return nibs[2:], is_leaf
+
+
+def common_prefix_len(a: Nibbles, b: Nibbles) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n

@@ -1,0 +1,110 @@
+"""RLP (recursive length prefix) encode/decode.
+
+Reference analogue: the external alloy-rlp crate (reference Cargo.toml:336).
+Items are ``bytes`` or (possibly nested) lists of items. Integers are
+encoded via ``encode_int`` — big-endian minimal, 0 ↦ empty string — matching
+Ethereum consensus encoding.
+"""
+
+from __future__ import annotations
+
+Item = bytes | list  # recursive: list[Item]
+
+
+def encode_int(v: int) -> bytes:
+    """Minimal big-endian integer payload (0 encodes as empty string)."""
+    if v == 0:
+        return b""
+    return v.to_bytes((v.bit_length() + 7) // 8, "big")
+
+
+def decode_int(b: bytes) -> int:
+    if b and b[0] == 0:
+        raise ValueError("leading zero in RLP integer")
+    return int.from_bytes(b, "big")
+
+
+def _encode_length(length: int, offset: int) -> bytes:
+    if length < 56:
+        return bytes([offset + length])
+    lb = encode_int(length)
+    return bytes([offset + 55 + len(lb)]) + lb
+
+
+def rlp_encode(item: Item) -> bytes:
+    if isinstance(item, (bytes, bytearray, memoryview)):
+        b = bytes(item)
+        if len(b) == 1 and b[0] < 0x80:
+            return b
+        return _encode_length(len(b), 0x80) + b
+    if isinstance(item, (list, tuple)):
+        payload = b"".join(rlp_encode(x) for x in item)
+        return _encode_length(len(payload), 0xC0) + payload
+    if isinstance(item, int):
+        return rlp_encode(encode_int(item))
+    raise TypeError(f"cannot RLP-encode {type(item)}")
+
+
+def rlp_encode_list(items: list[Item]) -> bytes:
+    return rlp_encode(list(items))
+
+
+def _decode_at(data: bytes, pos: int) -> tuple[Item, int]:
+    if pos >= len(data):
+        raise ValueError("RLP: out of bounds")
+    b0 = data[pos]
+    if b0 < 0x80:
+        return bytes([b0]), pos + 1
+    if b0 < 0xB8:  # short string
+        ln = b0 - 0x80
+        end = pos + 1 + ln
+        s = data[pos + 1 : end]
+        if len(s) != ln:
+            raise ValueError("RLP: truncated string")
+        if ln == 1 and s[0] < 0x80:
+            raise ValueError("RLP: non-canonical single byte")
+        return s, end
+    if b0 < 0xC0:  # long string
+        lln = b0 - 0xB7
+        ln = decode_int(data[pos + 1 : pos + 1 + lln])
+        if ln < 56:
+            raise ValueError("RLP: non-canonical long string")
+        start = pos + 1 + lln
+        end = start + ln
+        if end > len(data):
+            raise ValueError("RLP: truncated string")
+        return data[start:end], end
+    if b0 < 0xF8:  # short list
+        ln = b0 - 0xC0
+        end = pos + 1 + ln
+        if end > len(data):
+            raise ValueError("RLP: truncated list")
+        return _decode_list_payload(data, pos + 1, end), end
+    # long list
+    lln = b0 - 0xF7
+    ln = decode_int(data[pos + 1 : pos + 1 + lln])
+    if ln < 56:
+        raise ValueError("RLP: non-canonical long list")
+    start = pos + 1 + lln
+    end = start + ln
+    if end > len(data):
+        raise ValueError("RLP: truncated list")
+    return _decode_list_payload(data, start, end), end
+
+
+def _decode_list_payload(data: bytes, start: int, end: int) -> list:
+    out = []
+    pos = start
+    while pos < end:
+        item, pos = _decode_at(data, pos)
+        out.append(item)
+    if pos != end:
+        raise ValueError("RLP: list payload overrun")
+    return out
+
+
+def rlp_decode(data: bytes) -> Item:
+    item, end = _decode_at(bytes(data), 0)
+    if end != len(data):
+        raise ValueError("RLP: trailing bytes")
+    return item

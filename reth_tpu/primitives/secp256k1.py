@@ -1,0 +1,165 @@
+"""secp256k1 ECDSA: sign + public-key recovery (sender recovery).
+
+Reference analogue: the C secp256k1 library (reference Cargo.toml:592), used
+for `SenderRecoveryStage` and ECIES. This is a portable pure-Python
+implementation (Jacobian point arithmetic, RFC-6979 deterministic nonces);
+the batched/NATIVE fast path belongs to the C++ runtime layer in a later
+milestone — interfaces here are the stable seam.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+from .keccak import keccak256
+
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+_JPoint = tuple[int, int, int]  # Jacobian (X, Y, Z); Z=0 → infinity
+_INF: _JPoint = (1, 1, 0)
+
+
+def _jdouble(p: _JPoint) -> _JPoint:
+    x, y, z = p
+    if z == 0 or y == 0:
+        return _INF
+    s = (4 * x * y * y) % P
+    m = (3 * x * x) % P  # a = 0 for secp256k1
+    x3 = (m * m - 2 * s) % P
+    y3 = (m * (s - x3) - 8 * y * y * y * y) % P
+    z3 = (2 * y * z) % P
+    return (x3, y3, z3)
+
+
+def _jadd(p: _JPoint, q: _JPoint) -> _JPoint:
+    if p[2] == 0:
+        return q
+    if q[2] == 0:
+        return p
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    z1z1 = z1 * z1 % P
+    z2z2 = z2 * z2 % P
+    u1 = x1 * z2z2 % P
+    u2 = x2 * z1z1 % P
+    s1 = y1 * z2 * z2z2 % P
+    s2 = y2 * z1 * z1z1 % P
+    if u1 == u2:
+        if s1 != s2:
+            return _INF
+        return _jdouble(p)
+    h = (u2 - u1) % P
+    r = (s2 - s1) % P
+    h2 = h * h % P
+    h3 = h2 * h % P
+    x3 = (r * r - h3 - 2 * u1 * h2) % P
+    y3 = (r * (u1 * h2 - x3) - s1 * h3) % P
+    z3 = h * z1 * z2 % P
+    return (x3, y3, z3)
+
+
+def _jmul(p: _JPoint, k: int) -> _JPoint:
+    k %= N
+    result = _INF
+    addend = p
+    while k:
+        if k & 1:
+            result = _jadd(result, addend)
+        addend = _jdouble(addend)
+        k >>= 1
+    return result
+
+
+def _to_affine(p: _JPoint) -> tuple[int, int]:
+    x, y, z = p
+    if z == 0:
+        raise ValueError("point at infinity")
+    zinv = pow(z, P - 2, P)
+    zinv2 = zinv * zinv % P
+    return (x * zinv2 % P, y * zinv2 * zinv % P)
+
+
+_G: _JPoint = (GX, GY, 1)
+
+
+def pubkey_from_priv(priv: int) -> tuple[int, int]:
+    return _to_affine(_jmul(_G, priv))
+
+
+def address_from_pubkey(pub: tuple[int, int]) -> bytes:
+    raw = pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
+    return keccak256(raw)[12:]
+
+
+def address_from_priv(priv: int) -> bytes:
+    return address_from_pubkey(pubkey_from_priv(priv))
+
+
+def _rfc6979_k(msg_hash: bytes, priv: int) -> int:
+    """Deterministic nonce per RFC 6979 with HMAC-SHA256."""
+    x = priv.to_bytes(32, "big")
+    h1 = msg_hash
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < N:
+            return cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def sign(msg_hash: bytes, priv: int) -> tuple[int, int, int]:
+    """ECDSA sign → (y_parity, r, s) with low-s normalisation (EIP-2)."""
+    z = int.from_bytes(msg_hash, "big")
+    while True:
+        k = _rfc6979_k(msg_hash, priv)
+        rx, ry = _to_affine(_jmul(_G, k))
+        r = rx % N
+        if r == 0:
+            msg_hash = hashlib.sha256(msg_hash).digest()
+            continue
+        s = pow(k, N - 2, N) * (z + r * priv) % N
+        if s == 0:
+            msg_hash = hashlib.sha256(msg_hash).digest()
+            continue
+        parity = ry & 1
+        if s > N // 2:
+            s = N - s
+            parity ^= 1
+        return (parity, r, s)
+
+
+def ecrecover(msg_hash: bytes, y_parity: int, r: int, s: int) -> bytes:
+    """Recover the signer's address from a signature.
+
+    Raises ValueError on invalid signatures (reference rejects these during
+    sender recovery and tx validation).
+    """
+    if not (1 <= r < N and 1 <= s < N):
+        raise ValueError("signature out of range")
+    # EIP-2 (homestead): high-s signatures are invalid for tx senders.
+    if s > N // 2:
+        raise ValueError("high-s signature")
+    x = r
+    y_sq = (pow(x, 3, P) + 7) % P
+    y = pow(y_sq, (P + 1) // 4, P)
+    if y * y % P != y_sq:
+        raise ValueError("invalid r: not on curve")
+    if y & 1 != y_parity:
+        y = P - y
+    z = int.from_bytes(msg_hash, "big")
+    r_inv = pow(r, N - 2, N)
+    # Q = r^-1 (s*R - z*G)
+    point = _jadd(_jmul((x, y, 1), s), _jmul(_G, (-z) % N))
+    q = _to_affine(_jmul(point, r_inv))
+    return address_from_pubkey(q)

@@ -1,0 +1,394 @@
+"""Core chain types: Account, Header, Transaction, Receipt, Block.
+
+Reference analogue: alloy-consensus types + `EthPrimitives`
+(reference crates/ethereum/primitives, external reth-primitives-traits).
+Encodings follow Ethereum consensus RLP, post-merge through Cancun/Prague
+(trailing-optional header fields included only when set).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from .keccak import keccak256
+from .rlp import rlp_encode, rlp_decode, encode_int, decode_int
+
+# keccak256(rlp(b"")) — root of the empty trie.
+EMPTY_ROOT_HASH = keccak256(rlp_encode(b""))
+# keccak256(b"") — code hash of an EOA / empty code.
+KECCAK_EMPTY = keccak256(b"")
+EMPTY_CODE_HASH = KECCAK_EMPTY
+# keccak256(rlp([])) — ommers hash of an empty ommer list.
+EMPTY_OMMER_ROOT_HASH = keccak256(rlp_encode([]))
+
+B256_ZERO = b"\x00" * 32
+ADDRESS_ZERO = b"\x00" * 20
+
+
+@dataclass(frozen=True)
+class Account:
+    """An Ethereum account (reference: alloy `TrieAccount` / reth `Account`)."""
+
+    nonce: int = 0
+    balance: int = 0
+    storage_root: bytes = EMPTY_ROOT_HASH
+    code_hash: bytes = KECCAK_EMPTY
+
+    def trie_encode(self) -> bytes:
+        """RLP leaf value as stored in the state trie."""
+        return rlp_encode([
+            encode_int(self.nonce),
+            encode_int(self.balance),
+            self.storage_root,
+            self.code_hash,
+        ])
+
+    @classmethod
+    def trie_decode(cls, data: bytes) -> "Account":
+        nonce, balance, storage_root, code_hash = rlp_decode(data)
+        return cls(decode_int(nonce), decode_int(balance), storage_root, code_hash)
+
+    @property
+    def is_empty(self) -> bool:
+        """EIP-161 emptiness: nonce==0, balance==0, no code."""
+        return self.nonce == 0 and self.balance == 0 and self.code_hash == KECCAK_EMPTY
+
+    def with_(self, **kw) -> "Account":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class Withdrawal:
+    index: int
+    validator_index: int
+    address: bytes
+    amount: int  # gwei
+
+    def rlp_fields(self) -> list:
+        return [
+            encode_int(self.index),
+            encode_int(self.validator_index),
+            self.address,
+            encode_int(self.amount),
+        ]
+
+
+@dataclass(frozen=True)
+class Header:
+    """Block header (reference: alloy-consensus `Header`)."""
+
+    parent_hash: bytes = B256_ZERO
+    ommers_hash: bytes = EMPTY_OMMER_ROOT_HASH
+    beneficiary: bytes = ADDRESS_ZERO
+    state_root: bytes = EMPTY_ROOT_HASH
+    transactions_root: bytes = EMPTY_ROOT_HASH
+    receipts_root: bytes = EMPTY_ROOT_HASH
+    logs_bloom: bytes = b"\x00" * 256
+    difficulty: int = 0
+    number: int = 0
+    gas_limit: int = 30_000_000
+    gas_used: int = 0
+    timestamp: int = 0
+    extra_data: bytes = b""
+    mix_hash: bytes = B256_ZERO
+    nonce: bytes = b"\x00" * 8
+    base_fee_per_gas: int | None = None
+    withdrawals_root: bytes | None = None
+    blob_gas_used: int | None = None
+    excess_blob_gas: int | None = None
+    parent_beacon_block_root: bytes | None = None
+    requests_hash: bytes | None = None
+
+    def rlp_fields(self) -> list:
+        fields: list = [
+            self.parent_hash,
+            self.ommers_hash,
+            self.beneficiary,
+            self.state_root,
+            self.transactions_root,
+            self.receipts_root,
+            self.logs_bloom,
+            encode_int(self.difficulty),
+            encode_int(self.number),
+            encode_int(self.gas_limit),
+            encode_int(self.gas_used),
+            encode_int(self.timestamp),
+            self.extra_data,
+            self.mix_hash,
+            self.nonce,
+        ]
+        # Trailing optionals: include a field iff it or any later field is set.
+        opts = [
+            None if self.base_fee_per_gas is None else encode_int(self.base_fee_per_gas),
+            self.withdrawals_root,
+            None if self.blob_gas_used is None else encode_int(self.blob_gas_used),
+            None if self.excess_blob_gas is None else encode_int(self.excess_blob_gas),
+            self.parent_beacon_block_root,
+            self.requests_hash,
+        ]
+        last_set = -1
+        for i, v in enumerate(opts):
+            if v is not None:
+                last_set = i
+        for i in range(last_set + 1):
+            v = opts[i]
+            if v is None:
+                raise ValueError("non-contiguous optional header fields")
+            fields.append(v)
+        return fields
+
+    def encode(self) -> bytes:
+        return rlp_encode(self.rlp_fields())
+
+    @classmethod
+    def decode_fields(cls, f: list) -> "Header":
+        h = cls(
+            parent_hash=f[0], ommers_hash=f[1], beneficiary=f[2], state_root=f[3],
+            transactions_root=f[4], receipts_root=f[5], logs_bloom=f[6],
+            difficulty=decode_int(f[7]), number=decode_int(f[8]),
+            gas_limit=decode_int(f[9]), gas_used=decode_int(f[10]),
+            timestamp=decode_int(f[11]), extra_data=f[12], mix_hash=f[13], nonce=f[14],
+        )
+        extra = f[15:]
+        kw: dict = {}
+        if len(extra) > 0:
+            kw["base_fee_per_gas"] = decode_int(extra[0])
+        if len(extra) > 1:
+            kw["withdrawals_root"] = extra[1]
+        if len(extra) > 2:
+            kw["blob_gas_used"] = decode_int(extra[2])
+        if len(extra) > 3:
+            kw["excess_blob_gas"] = decode_int(extra[3])
+        if len(extra) > 4:
+            kw["parent_beacon_block_root"] = extra[4]
+        if len(extra) > 5:
+            kw["requests_hash"] = extra[5]
+        return replace(h, **kw)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Header":
+        return cls.decode_fields(rlp_decode(data))
+
+    @property
+    def hash(self) -> bytes:
+        return keccak256(self.encode())
+
+
+LEGACY_TX_TYPE = 0
+EIP1559_TX_TYPE = 2
+
+
+@dataclass(frozen=True)
+class Transaction:
+    """Signed transaction: legacy (type 0) or EIP-1559 (type 2).
+
+    Reference: alloy-consensus `TxEnvelope`; reth recovers senders in
+    `SenderRecoveryStage` (crates/stages/stages/src/stages/sender_recovery.rs).
+    """
+
+    tx_type: int = LEGACY_TX_TYPE
+    chain_id: int | None = None
+    nonce: int = 0
+    gas_price: int = 0                # legacy; for 1559 use max_fee fields
+    max_priority_fee_per_gas: int = 0
+    max_fee_per_gas: int = 0
+    gas_limit: int = 21_000
+    to: bytes | None = None           # None = contract creation
+    value: int = 0
+    data: bytes = b""
+    access_list: tuple = ()            # ((address, (slot32, ...)), ...)
+    # signature
+    y_parity: int = 0
+    r: int = 0
+    s: int = 0
+
+    def _to_field(self) -> bytes:
+        return self.to if self.to is not None else b""
+
+    def _access_list_fields(self) -> list:
+        return [[addr, list(slots)] for addr, slots in self.access_list]
+
+    def signing_hash(self) -> bytes:
+        if self.tx_type == LEGACY_TX_TYPE:
+            fields = [
+                encode_int(self.nonce), encode_int(self.gas_price),
+                encode_int(self.gas_limit), self._to_field(),
+                encode_int(self.value), self.data,
+            ]
+            if self.chain_id is not None:  # EIP-155
+                fields += [encode_int(self.chain_id), b"", b""]
+            return keccak256(rlp_encode(fields))
+        if self.tx_type == EIP1559_TX_TYPE:
+            fields = [
+                encode_int(self.chain_id or 0), encode_int(self.nonce),
+                encode_int(self.max_priority_fee_per_gas), encode_int(self.max_fee_per_gas),
+                encode_int(self.gas_limit), self._to_field(),
+                encode_int(self.value), self.data, self._access_list_fields(),
+            ]
+            return keccak256(b"\x02" + rlp_encode(fields))
+        raise ValueError(f"unsupported tx type {self.tx_type}")
+
+    def encode(self) -> bytes:
+        """Network/consensus encoding (typed txs prefixed with their type byte)."""
+        if self.tx_type == LEGACY_TX_TYPE:
+            if self.chain_id is not None:
+                v = self.chain_id * 2 + 35 + self.y_parity
+            else:
+                v = 27 + self.y_parity
+            return rlp_encode([
+                encode_int(self.nonce), encode_int(self.gas_price),
+                encode_int(self.gas_limit), self._to_field(),
+                encode_int(self.value), self.data,
+                encode_int(v), encode_int(self.r), encode_int(self.s),
+            ])
+        if self.tx_type == EIP1559_TX_TYPE:
+            return b"\x02" + rlp_encode([
+                encode_int(self.chain_id or 0), encode_int(self.nonce),
+                encode_int(self.max_priority_fee_per_gas), encode_int(self.max_fee_per_gas),
+                encode_int(self.gas_limit), self._to_field(),
+                encode_int(self.value), self.data, self._access_list_fields(),
+                encode_int(self.y_parity), encode_int(self.r), encode_int(self.s),
+            ])
+        raise ValueError(f"unsupported tx type {self.tx_type}")
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Transaction":
+        data = bytes(data)
+        if data and data[0] == EIP1559_TX_TYPE:
+            f = rlp_decode(data[1:])
+            al = tuple((a, tuple(slots)) for a, slots in f[8])
+            return cls(
+                tx_type=EIP1559_TX_TYPE, chain_id=decode_int(f[0]),
+                nonce=decode_int(f[1]), max_priority_fee_per_gas=decode_int(f[2]),
+                max_fee_per_gas=decode_int(f[3]), gas_limit=decode_int(f[4]),
+                to=f[5] or None, value=decode_int(f[6]), data=f[7], access_list=al,
+                y_parity=decode_int(f[9]), r=decode_int(f[10]), s=decode_int(f[11]),
+            )
+        f = rlp_decode(data)
+        v = decode_int(f[6])
+        if v in (27, 28):
+            chain_id, y_parity = None, v - 27
+        else:
+            chain_id = (v - 35) // 2
+            y_parity = (v - 35) % 2
+        return cls(
+            tx_type=LEGACY_TX_TYPE, chain_id=chain_id, nonce=decode_int(f[0]),
+            gas_price=decode_int(f[1]), gas_limit=decode_int(f[2]), to=f[3] or None,
+            value=decode_int(f[4]), data=f[5], y_parity=y_parity,
+            r=decode_int(f[7]), s=decode_int(f[8]),
+        )
+
+    @property
+    def hash(self) -> bytes:
+        return keccak256(self.encode())
+
+    def effective_gas_price(self, base_fee: int | None) -> int:
+        if self.tx_type == LEGACY_TX_TYPE:
+            return self.gas_price
+        if base_fee is None:
+            return self.max_fee_per_gas
+        return min(self.max_fee_per_gas, base_fee + self.max_priority_fee_per_gas)
+
+    def recover_sender(self) -> bytes:
+        from .secp256k1 import ecrecover
+        return ecrecover(self.signing_hash(), self.y_parity, self.r, self.s)
+
+
+@dataclass(frozen=True)
+class Log:
+    address: bytes
+    topics: tuple[bytes, ...]
+    data: bytes
+
+    def rlp_fields(self) -> list:
+        return [self.address, list(self.topics), self.data]
+
+
+def logs_bloom(logs: list[Log]) -> bytes:
+    """2048-bit bloom over log addresses and topics (yellow paper M3:2048)."""
+    bloom = bytearray(256)
+    items: list[bytes] = []
+    for log in logs:
+        items.append(log.address)
+        items.extend(log.topics)
+    for item in items:
+        h = keccak256(item)
+        for i in (0, 2, 4):
+            bit = ((h[i] << 8) | h[i + 1]) & 0x7FF
+            bloom[256 - 1 - bit // 8] |= 1 << (bit % 8)
+    return bytes(bloom)
+
+
+@dataclass(frozen=True)
+class Receipt:
+    """Transaction receipt (reference: reth `Receipt`)."""
+
+    tx_type: int = LEGACY_TX_TYPE
+    success: bool = True
+    cumulative_gas_used: int = 0
+    logs: tuple[Log, ...] = ()
+
+    def bloom(self) -> bytes:
+        return logs_bloom(list(self.logs))
+
+    def encode_2718(self) -> bytes:
+        """EIP-2718 encoding as placed in the receipts trie."""
+        payload = rlp_encode([
+            encode_int(1 if self.success else 0),
+            encode_int(self.cumulative_gas_used),
+            self.bloom(),
+            [log.rlp_fields() for log in self.logs],
+        ])
+        if self.tx_type == LEGACY_TX_TYPE:
+            return payload
+        return bytes([self.tx_type]) + payload
+
+
+@dataclass(frozen=True)
+class Block:
+    header: Header
+    transactions: tuple[Transaction, ...] = ()
+    ommers: tuple[Header, ...] = ()
+    withdrawals: tuple[Withdrawal, ...] | None = None
+
+    def encode(self) -> bytes:
+        fields: list = [
+            self.header.rlp_fields(),
+            [_tx_block_item(tx) for tx in self.transactions],
+            [o.rlp_fields() for o in self.ommers],
+        ]
+        if self.withdrawals is not None:
+            fields.append([w.rlp_fields() for w in self.withdrawals])
+        return rlp_encode(fields)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Block":
+        f = rlp_decode(data)
+        header = Header.decode_fields(f[0])
+        txs = tuple(_tx_from_block_item(t) for t in f[1])
+        ommers = tuple(Header.decode_fields(o) for o in f[2])
+        withdrawals = None
+        if len(f) > 3:
+            withdrawals = tuple(
+                Withdrawal(decode_int(w[0]), decode_int(w[1]), w[2], decode_int(w[3]))
+                for w in f[3]
+            )
+        return cls(header, txs, ommers, withdrawals)
+
+    @property
+    def hash(self) -> bytes:
+        return self.header.hash
+
+
+def _tx_block_item(tx: Transaction):
+    """In a block body, typed txs appear as RLP strings, legacy as lists."""
+    enc = tx.encode()
+    if tx.tx_type == LEGACY_TX_TYPE:
+        return rlp_decode(enc)  # as a list structure
+    return enc
+
+
+def _tx_from_block_item(item) -> Transaction:
+    if isinstance(item, bytes):
+        return Transaction.decode(item)
+    return Transaction.decode(rlp_encode(item))

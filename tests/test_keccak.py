@@ -1,0 +1,50 @@
+"""Bit-exactness tests for the CPU keccak reference implementations."""
+
+import os
+
+import numpy as np
+import pytest
+
+from reth_tpu.primitives.keccak import (
+    keccak256,
+    keccak256_batch_np,
+    RATE,
+)
+
+# Known Keccak-256 vectors (Ethereum keccak, NOT NIST SHA3).
+VECTORS = [
+    (b"", "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"),
+    (b"abc", "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"),
+    (b"hello", "1c8aff950685c2ed4bc3174f3472287b56d9517b9c948127319a09a7a36deac8"),
+    (
+        b"The quick brown fox jumps over the lazy dog",
+        "4d741b6f1eb29cb2a9b9911c82f56fa8d73b04959d3d9d222895df6c0b28aa15",
+    ),
+]
+
+
+@pytest.mark.parametrize("msg,expect", VECTORS)
+def test_known_vectors(msg, expect):
+    assert keccak256(msg).hex() == expect
+
+
+def test_boundary_lengths():
+    """Exercise padding at rate boundaries (135/136/137 bytes etc.)."""
+    rng = np.random.default_rng(0)
+    for ln in [0, 1, 55, 56, RATE - 2, RATE - 1, RATE, RATE + 1, 2 * RATE - 1, 2 * RATE, 300, 1000]:
+        msg = bytes(rng.integers(0, 256, size=ln, dtype=np.uint8))
+        # batch impl must agree with the pure reference
+        assert keccak256_batch_np([msg])[0] == keccak256(msg), f"len={ln}"
+
+
+def test_batch_mixed_lengths_order_preserved():
+    rng = np.random.default_rng(1)
+    msgs = [bytes(rng.integers(0, 256, size=int(l), dtype=np.uint8))
+            for l in rng.integers(0, 500, size=64)]
+    got = keccak256_batch_np(msgs)
+    want = [keccak256(m) for m in msgs]
+    assert got == want
+
+
+def test_empty_batch():
+    assert keccak256_batch_np([]) == []

@@ -1,0 +1,107 @@
+"""Tests for nibbles, types, and secp256k1 sender recovery."""
+
+import numpy as np
+
+from reth_tpu.primitives import (
+    Account,
+    Header,
+    Transaction,
+    EMPTY_ROOT_HASH,
+    KECCAK_EMPTY,
+)
+from reth_tpu.primitives.nibbles import (
+    unpack_nibbles,
+    pack_nibbles,
+    encode_path,
+    decode_path,
+    common_prefix_len,
+)
+from reth_tpu.primitives.types import Receipt, Log, Block, Withdrawal
+from reth_tpu.primitives import secp256k1
+
+
+def test_constants():
+    assert EMPTY_ROOT_HASH.hex() == "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+    assert KECCAK_EMPTY.hex() == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+
+
+def test_nibbles_roundtrip():
+    key = bytes(range(32))
+    nibs = unpack_nibbles(key)
+    assert len(nibs) == 64
+    assert pack_nibbles(nibs) == key
+
+
+def test_hex_prefix():
+    # yellow paper examples
+    assert encode_path(bytes([1, 2, 3, 4, 5]), False).hex() == "112345"
+    assert encode_path(bytes([0, 1, 2, 3, 4, 5]), False).hex() == "00012345"
+    assert encode_path(bytes([0, 15, 1, 12, 11, 8]), True).hex() == "200f1cb8"
+    assert encode_path(bytes([15, 1, 12, 11, 8]), True).hex() == "3f1cb8"
+    for nibs in [b"", bytes([5]), bytes([1, 2, 3]), bytes(range(10))]:
+        for leaf in (False, True):
+            assert decode_path(encode_path(nibs, leaf)) == (nibs, leaf)
+
+
+def test_common_prefix():
+    assert common_prefix_len(bytes([1, 2, 3]), bytes([1, 2, 4])) == 2
+    assert common_prefix_len(b"", bytes([1])) == 0
+
+
+def test_account_roundtrip():
+    acc = Account(nonce=3, balance=10**18)
+    assert Account.trie_decode(acc.trie_encode()) == acc
+    assert Account().is_empty
+    assert not Account(balance=1).is_empty
+
+
+def test_header_roundtrip():
+    h = Header(number=100, base_fee_per_gas=7, withdrawals_root=EMPTY_ROOT_HASH,
+               blob_gas_used=0, excess_blob_gas=0, parent_beacon_block_root=b"\x11" * 32)
+    assert Header.decode(h.encode()) == h
+    assert len(h.hash) == 32
+    # pre-london header (no optionals)
+    h0 = Header(number=1)
+    assert Header.decode(h0.encode()) == h0
+
+
+def test_sign_and_recover():
+    priv = 0xA11CE
+    addr = secp256k1.address_from_priv(priv)
+    tx = Transaction(tx_type=2, chain_id=1, nonce=0, max_fee_per_gas=10**9,
+                     max_priority_fee_per_gas=10**8, gas_limit=21000,
+                     to=b"\x22" * 20, value=10**17)
+    parity, r, s = secp256k1.sign(tx.signing_hash(), priv)
+    signed = Transaction(**{**tx.__dict__, "y_parity": parity, "r": r, "s": s})
+    assert signed.recover_sender() == addr
+    # encode/decode round trip preserves sender
+    assert Transaction.decode(signed.encode()) == signed
+
+
+def test_legacy_tx_roundtrip():
+    priv = 0xB0B
+    tx = Transaction(tx_type=0, chain_id=1, nonce=5, gas_price=2 * 10**9,
+                     gas_limit=21000, to=b"\x33" * 20, value=123)
+    parity, r, s = secp256k1.sign(tx.signing_hash(), priv)
+    signed = Transaction(**{**tx.__dict__, "y_parity": parity, "r": r, "s": s})
+    assert Transaction.decode(signed.encode()) == signed
+    assert signed.recover_sender() == secp256k1.address_from_priv(priv)
+
+
+def test_receipt_and_bloom():
+    log = Log(address=b"\x01" * 20, topics=(b"\x02" * 32,), data=b"xyz")
+    r = Receipt(tx_type=2, success=True, cumulative_gas_used=21000, logs=(log,))
+    enc = r.encode_2718()
+    assert enc[0] == 2
+    bloom = r.bloom()
+    assert len(bloom) == 256
+    assert any(bloom)  # some bits set
+    assert Receipt().bloom() == b"\x00" * 256
+
+
+def test_block_roundtrip():
+    h = Header(number=7, base_fee_per_gas=10, withdrawals_root=EMPTY_ROOT_HASH)
+    tx = Transaction(tx_type=2, chain_id=1, to=b"\x01" * 20, r=1, s=1)
+    blk = Block(header=h, transactions=(tx,),
+                withdrawals=(Withdrawal(0, 1, b"\x02" * 20, 10),))
+    assert Block.decode(blk.encode()) == blk

@@ -1,0 +1,50 @@
+"""RLP codec tests — canonical encodings and round-trips."""
+
+import pytest
+
+from reth_tpu.primitives.rlp import rlp_encode, rlp_decode, encode_int
+
+
+CASES = [
+    (b"", "80"),
+    (b"\x00", "00"),
+    (b"\x0f", "0f"),
+    (b"\x7f", "7f"),
+    (b"\x80", "8180"),
+    (b"dog", "83646f67"),
+    ([], "c0"),
+    ([b"cat", b"dog"], "c88363617483646f67"),
+    # nested: [ [], [[]], [ [], [[]] ] ]
+    ([[], [[]], [[], [[]]]], "c7c0c1c0c3c0c1c0"),
+    (b"a" * 55, "b7" + "61" * 55),
+    (b"a" * 56, "b838" + "61" * 56),
+]
+
+
+@pytest.mark.parametrize("item,expect", CASES)
+def test_canonical(item, expect):
+    assert rlp_encode(item).hex() == expect
+
+
+@pytest.mark.parametrize("item,_", CASES)
+def test_roundtrip(item, _):
+    assert rlp_decode(rlp_encode(item)) == item
+
+
+def test_encode_int():
+    assert encode_int(0) == b""
+    assert encode_int(15) == b"\x0f"
+    assert encode_int(1024) == b"\x04\x00"
+    assert rlp_encode(encode_int(0)).hex() == "80"
+
+
+def test_reject_noncanonical():
+    with pytest.raises(ValueError):
+        rlp_decode(bytes.fromhex("8100"))  # single byte <0x80 must be bare
+    with pytest.raises(ValueError):
+        rlp_decode(bytes.fromhex("8180") + b"x")  # trailing bytes
+
+
+def test_long_list_roundtrip():
+    item = [b"x" * 30, [b"y" * 40, b"z"], b""] * 5
+    assert rlp_decode(rlp_encode(item)) == item
