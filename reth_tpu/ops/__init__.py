@@ -1,0 +1,22 @@
+"""Device kernels (JAX/XLA/Pallas) — the TPU data plane.
+
+Reference analogue: the `asm-keccak` native fast path and rayon-parallel
+keccak loops of the reference (bin/reth/Cargo.toml:94,
+crates/stages/stages/src/stages/hashing_account.rs:29-32,
+crates/trie/sparse/src/arena/mod.rs:2500-2548). Here those become batched,
+shape-stable XLA programs.
+"""
+
+from .keccak_jax import (
+    keccak_f1600_jax,
+    keccak256_jax_words,
+    keccak256_batch_jax,
+    KeccakDevice,
+)
+
+__all__ = [
+    "keccak_f1600_jax",
+    "keccak256_jax_words",
+    "keccak256_batch_jax",
+    "KeccakDevice",
+]

@@ -129,43 +129,78 @@ def keccak_f1600_np(lanes: np.ndarray) -> np.ndarray:
     return np.stack(a, axis=1)
 
 
-def pad_batch(msgs: list[bytes], num_blocks: int) -> np.ndarray:
-    """Pad each message to ``num_blocks*RATE`` bytes, return (N, blocks*17) uint64.
+def pad_batch(
+    msgs: list[bytes],
+    num_blocks: int | np.ndarray,
+    pad_to_blocks: int | None = None,
+) -> np.ndarray:
+    """Pad each message at ITS OWN final rate block, zero-extend the buffer to
+    ``pad_to_blocks`` blocks; return (N, pad_to_blocks*17) uint64.
 
-    All messages must fit: ``len(m) < num_blocks*RATE`` with room for at least
-    one pad byte (i.e. ``len(m) <= num_blocks*RATE - 1``).
+    ``num_blocks`` is each message's real block count (``num_blocks_for``) —
+    a scalar for uniform buckets or a per-message array. ``pad_to_blocks``
+    defaults to the max block count; blocks at index >= a message's count are
+    all-zero and must NOT be absorbed (masked-absorb kernels only).
     """
     n = len(msgs)
-    total = num_blocks * RATE
-    buf = np.zeros((n, total), dtype=np.uint8)
-    for i, m in enumerate(msgs):
-        lm = len(m)
-        if lm > total - 1:
-            raise ValueError(f"message {i} too long for {num_blocks} blocks: {lm}")
-        buf[i, :lm] = np.frombuffer(m, dtype=np.uint8)
-        buf[i, lm] ^= 0x01
-        buf[i, total - 1] ^= 0x80
+    nb = np.broadcast_to(np.asarray(num_blocks, dtype=np.int64), (n,))
+    total = (pad_to_blocks if pad_to_blocks is not None else int(nb.max() if n else 1)) * RATE
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=n)
+    if lens.size and (lens > nb * RATE - 1).any():
+        bad = int(np.argmax(lens > nb * RATE - 1))
+        raise ValueError(f"message {bad} too long for {nb[bad]} blocks: {lens[bad]}")
+    # Vectorised scatter: this runs on the host hot path feeding the device,
+    # so no per-message Python work is allowed.
+    flat = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+    starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+    cols = np.arange(total, dtype=np.int64)
+    gather = starts[:, None] + cols[None, :]
+    valid = cols[None, :] < lens[:, None]
+    np.minimum(gather, max(flat.size - 1, 0), out=gather)
+    buf = np.where(valid, flat[gather] if flat.size else 0, 0).astype(np.uint8)
+    rows = np.arange(n)
+    buf[rows, lens] ^= 0x01
+    buf[rows, nb * RATE - 1] ^= 0x80
     return buf.view("<u8").reshape(n, total // 8)
 
 
-def keccak256_batch_np(msgs: list[bytes]) -> list[bytes]:
-    """Batched keccak-256 over same-or-mixed-length messages (numpy, CPU).
+def num_blocks_for(msg: bytes) -> int:
+    """Rate-block count of ``msg`` after keccak padding."""
+    return len(msg) // RATE + 1
 
-    Buckets messages by block count internally; order preserved.
+
+def bucketed_hash(msgs: list[bytes], bucket_hasher, bucket_key=None) -> list[bytes]:
+    """Shared bucketing scaffolding for batch hashers.
+
+    Messages are grouped by ``bucket_key(num_blocks)`` (default: the exact
+    block count). ``bucket_hasher(sub_msgs, key, counts)`` — where ``counts``
+    is the per-message real block-count array — must return an array whose
+    rows view as the 32-byte digests (``row.tobytes()`` == digest). Order of
+    ``msgs`` is preserved. Both the numpy CPU baseline and the JAX device
+    front-end route through this, so bucketing semantics cannot diverge.
     """
     if not msgs:
         return []
     out: list[bytes | None] = [None] * len(msgs)
     buckets: dict[int, list[int]] = {}
     for i, m in enumerate(msgs):
-        nb = len(m) // RATE + 1
-        buckets.setdefault(nb, []).append(i)
-    for nb, idxs in buckets.items():
-        words = pad_batch([msgs[i] for i in idxs], nb)
-        digests = keccak256_words_np(words, nb)
+        nb = num_blocks_for(m)
+        buckets.setdefault(bucket_key(nb) if bucket_key else nb, []).append(i)
+    for key, idxs in sorted(buckets.items()):
+        counts = np.fromiter(
+            (num_blocks_for(msgs[i]) for i in idxs), dtype=np.int64, count=len(idxs)
+        )
+        digests = bucket_hasher([msgs[i] for i in idxs], key, counts)
         for row, i in enumerate(idxs):
             out[i] = digests[row].tobytes()
     return out  # type: ignore[return-value]
+
+
+def keccak256_batch_np(msgs: list[bytes]) -> list[bytes]:
+    """Batched keccak-256 over same-or-mixed-length messages (numpy, CPU)."""
+    return bucketed_hash(
+        msgs, lambda sub, nb, _counts: keccak256_words_np(pad_batch(sub, nb), nb)
+    )
 
 
 def keccak256_words_np(words: np.ndarray, num_blocks: int) -> np.ndarray:

@@ -47,10 +47,14 @@ def decode_path(encoded: bytes) -> tuple[Nibbles, bool]:
     if not encoded:
         raise ValueError("empty hex-prefix path")
     flag = encoded[0] >> 4
+    if flag > 3:
+        raise ValueError(f"invalid hex-prefix flag nibble: {flag}")
     is_leaf = bool(flag & 2)
     nibs = unpack_nibbles(encoded)
     if flag & 1:  # odd: keep low nibble of first byte
         return nibs[1:], is_leaf
+    if encoded[0] & 0x0F:
+        raise ValueError("non-canonical hex-prefix: even path with nonzero pad nibble")
     return nibs[2:], is_leaf
 
 

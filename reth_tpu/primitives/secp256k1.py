@@ -99,8 +99,12 @@ def address_from_priv(priv: int) -> bytes:
     return address_from_pubkey(pubkey_from_priv(priv))
 
 
-def _rfc6979_k(msg_hash: bytes, priv: int) -> int:
-    """Deterministic nonce per RFC 6979 with HMAC-SHA256."""
+def _rfc6979_k(msg_hash: bytes, priv: int):
+    """Deterministic nonce candidates per RFC 6979 with HMAC-SHA256.
+
+    Yields successive candidates (sec 3.2 step h retry) so callers can pull
+    another nonce if r or s comes out zero, without touching the message.
+    """
     x = priv.to_bytes(32, "big")
     h1 = msg_hash
     v = b"\x01" * 32
@@ -113,7 +117,7 @@ def _rfc6979_k(msg_hash: bytes, priv: int) -> int:
         v = hmac.new(k, v, hashlib.sha256).digest()
         cand = int.from_bytes(v, "big")
         if 1 <= cand < N:
-            return cand
+            yield cand
         k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
         v = hmac.new(k, v, hashlib.sha256).digest()
 
@@ -121,22 +125,20 @@ def _rfc6979_k(msg_hash: bytes, priv: int) -> int:
 def sign(msg_hash: bytes, priv: int) -> tuple[int, int, int]:
     """ECDSA sign → (y_parity, r, s) with low-s normalisation (EIP-2)."""
     z = int.from_bytes(msg_hash, "big")
-    while True:
-        k = _rfc6979_k(msg_hash, priv)
+    for k in _rfc6979_k(msg_hash, priv):
         rx, ry = _to_affine(_jmul(_G, k))
         r = rx % N
         if r == 0:
-            msg_hash = hashlib.sha256(msg_hash).digest()
-            continue
+            continue  # next RFC-6979 candidate
         s = pow(k, N - 2, N) * (z + r * priv) % N
         if s == 0:
-            msg_hash = hashlib.sha256(msg_hash).digest()
             continue
         parity = ry & 1
         if s > N // 2:
             s = N - s
             parity ^= 1
         return (parity, r, s)
+    raise AssertionError("unreachable: RFC-6979 generator is infinite")
 
 
 def ecrecover(msg_hash: bytes, y_parity: int, r: int, s: int) -> bytes:

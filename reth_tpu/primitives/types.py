@@ -268,9 +268,11 @@ class Transaction:
         v = decode_int(f[6])
         if v in (27, 28):
             chain_id, y_parity = None, v - 27
-        else:
+        elif v >= 35:
             chain_id = (v - 35) // 2
             y_parity = (v - 35) % 2
+        else:
+            raise ValueError(f"invalid legacy signature v: {v}")
         return cls(
             tx_type=LEGACY_TX_TYPE, chain_id=chain_id, nonce=decode_int(f[0]),
             gas_price=decode_int(f[1]), gas_limit=decode_int(f[2]), to=f[3] or None,

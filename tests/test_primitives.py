@@ -88,6 +88,23 @@ def test_legacy_tx_roundtrip():
     assert signed.recover_sender() == secp256k1.address_from_priv(priv)
 
 
+def test_invalid_legacy_v_rejected():
+    import pytest
+    from reth_tpu.primitives.rlp import rlp_encode
+    # v=1 is not a valid legacy signature v (must be 27/28 or >=35)
+    raw = rlp_encode([b"", b"", b"", b"", b"", b"", b"\x01", b"\x01", b"\x01"])
+    with pytest.raises(ValueError, match="invalid legacy signature v"):
+        Transaction.decode(raw)
+
+
+def test_noncanonical_hex_prefix_rejected():
+    import pytest
+    with pytest.raises(ValueError):
+        decode_path(bytes.fromhex("45"))  # flag nibble 4 invalid
+    with pytest.raises(ValueError):
+        decode_path(bytes.fromhex("0f12"))  # even path with nonzero pad nibble
+
+
 def test_receipt_and_bloom():
     log = Log(address=b"\x01" * 20, topics=(b"\x02" * 32,), data=b"xyz")
     r = Receipt(tx_type=2, success=True, cumulative_gas_used=21000, logs=(log,))
