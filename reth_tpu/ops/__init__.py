@@ -7,6 +7,12 @@ crates/trie/sparse/src/arena/mod.rs:2500-2548). Here those become batched,
 shape-stable XLA programs.
 """
 
+# NOTE: do NOT enable jax's persistent compilation cache here — setting
+# jax_compilation_cache_dir (or the jax_persistent_cache_min_* knobs)
+# deadlocks the first jit in this jax build (0.9.0/axon). Compile cost is
+# managed by minimising distinct program shapes instead (see KeccakDevice
+# block_tier / batch tiers).
+
 from .keccak_jax import (
     keccak_f1600_jax,
     keccak256_jax_words,

@@ -187,14 +187,24 @@ class KeccakDevice:
     # power-of-two block tiers so compilation count stays bounded.
     MAX_EXACT_BLOCKS = 8
 
-    def __init__(self, min_tier: int = 8):
+    def __init__(self, min_tier: int = 8, block_tier: int | None = None):
+        """``block_tier``: if set, ALL messages up to that many rate blocks
+        share one masked program per batch tier (compile-count-minimal mode
+        for workloads with a known size ceiling, e.g. trie nodes <= 4
+        blocks); larger messages still fall back to pow2 tiers above it.
+        """
         self.min_tier = min_tier
+        self.block_tier = block_tier
 
     def hash_batch(self, msgs: list[bytes]) -> list[bytes]:
         return bucketed_hash(msgs, self._hash_bucket, bucket_key=self._bucket_key)
 
     def _bucket_key(self, nb: int) -> int:
         """Exact program for small block counts; shared pow2 tier above."""
+        if self.block_tier is not None:
+            if nb <= self.block_tier:
+                return self.block_tier
+            return _next_tier(nb, 2 * self.block_tier)
         if nb <= self.MAX_EXACT_BLOCKS:
             return nb
         return _next_tier(nb, 2 * self.MAX_EXACT_BLOCKS)
@@ -203,7 +213,7 @@ class KeccakDevice:
         """Hash one bucket; returns (n, 8) uint32 digests."""
         n = len(sub)
         batch_tier = _next_tier(n, self.min_tier)
-        if key <= self.MAX_EXACT_BLOCKS:
+        if self.block_tier is None and key <= self.MAX_EXACT_BLOCKS:
             w32 = _to_u32(pad_batch(sub, key), batch_tier)
             digests = keccak256_jax_words(jnp.asarray(w32), key)
         else:

@@ -1,0 +1,35 @@
+"""Merkle-Patricia-Trie state commitment — the north-star subsystem.
+
+Reference analogue: crates/trie/{common,trie,db,parallel,sparse}. The
+reference computes roots with a streaming `HashBuilder` stack fed by a
+cursor walk (`StateRoot`, crates/trie/trie/src/trie.rs:32) and hashes every
+node's RLP with CPU keccak. Here the design is TPU-first: structure is
+resolved on host (cheap, pointer-chasing), and ALL node hashing is batched
+level-by-level through the device keccak kernel — replacing the sequential
+stack with a device-friendly bottom-up reduction (SURVEY.md §7).
+"""
+
+from .node import (
+    leaf_node_rlp,
+    extension_node_rlp,
+    branch_node_rlp,
+    node_ref,
+)
+from .naive import naive_trie_root, naive_secure_root
+from .committer import TrieCommitter, TrieBuildResult, BranchNode
+from .state_root import state_root, storage_root, account_trie_leaves
+
+__all__ = [
+    "leaf_node_rlp",
+    "extension_node_rlp",
+    "branch_node_rlp",
+    "node_ref",
+    "naive_trie_root",
+    "naive_secure_root",
+    "TrieCommitter",
+    "TrieBuildResult",
+    "BranchNode",
+    "state_root",
+    "storage_root",
+    "account_trie_leaves",
+]

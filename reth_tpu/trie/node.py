@@ -1,0 +1,58 @@
+"""MPT node RLP encodings and the node-reference rule.
+
+Reference analogue: alloy-trie's node types + `TrieNodeV2`
+(reference crates/trie/common/src/trie_node_v2.rs). Yellow-paper rules:
+
+- leaf:      RLP([hex_prefix(path, leaf=True), value])
+- extension: RLP([hex_prefix(path, leaf=False), child_ref])
+- branch:    RLP([c0, ..., c15, value]) — 17 items
+- ref(node): the node RLP itself if len < 32, else keccak256(rlp) as a
+  32-byte string. Inline refs are embedded as raw RLP (already encoded),
+  hashes as RLP strings.
+- root hash: always keccak256(rlp(root_node)).
+"""
+
+from __future__ import annotations
+
+from ..primitives.keccak import keccak256
+from ..primitives.nibbles import Nibbles, encode_path
+from ..primitives.rlp import rlp_encode, _encode_length
+
+EMPTY_STRING_RLP = b"\x80"
+
+
+def encode_hash_ref(h: bytes) -> bytes:
+    """A 32-byte hash child reference as RLP (0xa0 + hash)."""
+    return b"\xa0" + h
+
+
+def leaf_node_rlp(path: Nibbles, value: bytes) -> bytes:
+    return rlp_encode([encode_path(path, True), value])
+
+
+def extension_node_rlp(path: Nibbles, child_ref_rlp: bytes) -> bytes:
+    """``child_ref_rlp`` is the already-RLP-encoded child reference."""
+    payload = rlp_encode(encode_path(path, False)) + child_ref_rlp
+    return _encode_length(len(payload), 0xC0) + payload
+
+
+def branch_node_rlp(child_refs_rlp: list[bytes], value: bytes = b"") -> bytes:
+    """``child_refs_rlp``: 16 already-encoded refs (EMPTY_STRING_RLP if absent)."""
+    payload = b"".join(child_refs_rlp) + rlp_encode(value)
+    return _encode_length(len(payload), 0xC0) + payload
+
+
+def node_ref(node_rlp: bytes) -> bytes:
+    """Reference to a node as embedded in its parent (already RLP-encoded)."""
+    if len(node_rlp) < 32:
+        return node_rlp
+    return encode_hash_ref(keccak256(node_rlp))
+
+
+def ref_is_hash(ref_rlp: bytes) -> bool:
+    return len(ref_rlp) == 33 and ref_rlp[0] == 0xA0
+
+
+def ref_hash(ref_rlp: bytes) -> bytes:
+    assert ref_is_hash(ref_rlp)
+    return ref_rlp[1:]

@@ -1,0 +1,109 @@
+"""State-root assembly: hashed keys → storage tries → account trie.
+
+Reference analogue: `StateRoot`/`StorageRoot`
+(crates/trie/trie/src/trie.rs:32,488) and the hashing stages
+(crates/stages/stages/src/stages/hashing_{account,storage}.rs). TPU-first
+shape: key hashing (keccak of addresses/slots) is one batched dispatch,
+all storage tries commit together with shared level batching, then the
+account trie commits — O(depth) total device dispatches for the whole
+state, instead of per-account sequential walks.
+"""
+
+from __future__ import annotations
+
+from ..primitives.keccak import keccak256
+from ..primitives.nibbles import Nibbles, unpack_nibbles
+from ..primitives.rlp import rlp_encode, encode_int
+from ..primitives.types import Account, EMPTY_ROOT_HASH
+from .committer import TrieCommitter, TrieBuildResult
+
+
+def storage_root(slots: dict[bytes, int], committer: TrieCommitter | None = None) -> bytes:
+    """Root of one account's storage trie. ``slots``: 32-byte slot → value."""
+    committer = committer or TrieCommitter()
+    hashed_keys = committer.hasher([s for s, v in slots.items() if v])
+    leaves = [
+        (unpack_nibbles(hk), rlp_encode(encode_int(v)))
+        for hk, v in zip(hashed_keys, [v for v in slots.values() if v])
+    ]
+    if not leaves:
+        return EMPTY_ROOT_HASH
+    return committer.commit(leaves, collect_branches=False).root
+
+
+def account_leaf(hashed_addr: bytes, acc: Account) -> tuple[Nibbles, bytes] | None:
+    """Account-trie leaf for a hashed address, or None if excluded (EIP-161).
+
+    The single home of the emptiness-exclusion rule — every caller (full
+    rebuild, incremental, tests) must route through this.
+    """
+    if acc.is_empty and acc.storage_root == EMPTY_ROOT_HASH:
+        return None
+    return (unpack_nibbles(hashed_addr), acc.trie_encode())
+
+
+def account_trie_leaves(
+    accounts: dict[bytes, Account],
+) -> list[tuple[Nibbles, bytes]]:
+    """Hashed-address account leaves (storage roots must already be set)."""
+    out = []
+    for addr, acc in accounts.items():
+        leaf = account_leaf(keccak256(addr), acc)
+        if leaf is not None:
+            out.append(leaf)
+    return out
+
+
+def state_root(
+    accounts: dict[bytes, Account],
+    storages: dict[bytes, dict[bytes, int]] | None = None,
+    committer: TrieCommitter | None = None,
+) -> tuple[bytes, dict]:
+    """Full state root from plain state.
+
+    ``accounts``: address → Account (storage_root fields are recomputed
+    here when ``storages`` has an entry for the address).
+    ``storages``: address → {32-byte slot → int value}.
+
+    Returns ``(root, details)`` where details carries the account-trie
+    branch nodes (TrieUpdates analogue) and per-account storage roots.
+    """
+    committer = committer or TrieCommitter()
+    storages = storages or {}
+
+    # 1. one batched dispatch for ALL key hashing: addresses + every slot
+    addr_list = list(accounts.keys())
+    slot_jobs: list[tuple[bytes, bytes, int]] = []  # (addr, slot, value)
+    for addr, slots in storages.items():
+        for slot, val in slots.items():
+            if val:
+                slot_jobs.append((addr, slot, val))
+    digests = committer.hasher(addr_list + [s for _, s, _ in slot_jobs])
+    hashed_addrs = dict(zip(addr_list, digests[: len(addr_list)]))
+    hashed_slots = digests[len(addr_list) :]
+
+    # 2. all storage tries in one shared-level commit. Every address with a
+    # storages entry gets a recomputed root — including all-zero-slot
+    # entries, which must land on EMPTY_ROOT_HASH, not the stale field.
+    per_addr: dict[bytes, list[tuple[Nibbles, bytes]]] = {a: [] for a in storages}
+    for (addr, _slot, val), hslot in zip(slot_jobs, hashed_slots):
+        per_addr[addr].append((unpack_nibbles(hslot), rlp_encode(encode_int(val))))
+    storage_addrs = list(per_addr.keys())
+    storage_results = committer.commit_many(
+        [(per_addr[a], None) for a in storage_addrs], collect_branches=False
+    )
+    storage_roots = {a: r.root for a, r in zip(storage_addrs, storage_results)}
+
+    # 3. account trie
+    leaves: list[tuple[Nibbles, bytes]] = []
+    for addr, acc in accounts.items():
+        sroot = storage_roots.get(addr, acc.storage_root)
+        leaf = account_leaf(hashed_addrs[addr], acc.with_(storage_root=sroot))
+        if leaf is not None:
+            leaves.append(leaf)
+    result: TrieBuildResult = committer.commit(leaves)
+    return result.root, {
+        "branch_nodes": result.branch_nodes,
+        "storage_roots": storage_roots,
+        "hashed_addresses": hashed_addrs,
+    }

@@ -8,7 +8,14 @@ Must run before the first `import jax` anywhere in the test session.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu — the driver environment exports JAX_PLATFORMS=axon (the real
+# TPU tunnel), so a setdefault would silently run every test over the
+# tunnel. Tests must be hermetic on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Skipping the axon plugin registration needs PALLAS_AXON_POOL_IPS unset
+# BEFORE interpreter start (sitecustomize) — prefer running pytest via
+#   env -u PALLAS_AXON_POOL_IPS python -m pytest tests/
+# when the tunnel is flaky; with a healthy tunnel this conftest suffices.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
