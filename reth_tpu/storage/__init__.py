@@ -1,0 +1,25 @@
+"""Storage layer: typed tables, KV transactions, cursors, providers.
+
+Reference analogue: crates/storage/{db-api,db,provider} — the
+`Database`/`DbTx`/`DbCursorRO` GAT traits (db-api/src/database.rs),
+the ~31-table typed schema (db-api/src/tables/mod.rs:310-536), and the
+`ProviderFactory`/`DatabaseProvider` facade (provider/src/). The MDBX
+C engine is replaced for now by a bytes-faithful in-memory/file store
+behind the same interfaces; a native C++ B+tree backend slots in behind
+``Database`` without touching callers.
+"""
+
+from .kv import Database, Tx, Cursor, MemDb
+from .tables import Tables, TableDef
+from .provider import ProviderFactory, DatabaseProvider
+
+__all__ = [
+    "Database",
+    "Tx",
+    "Cursor",
+    "MemDb",
+    "Tables",
+    "TableDef",
+    "ProviderFactory",
+    "DatabaseProvider",
+]

@@ -1,0 +1,380 @@
+"""KV store abstraction: Database → Tx → Cursor, with DUPSORT tables.
+
+Reference analogue: the `Database`/`DbTx`/`DbTxMut`/`DbCursorRO/RW` traits
+(crates/storage/db-api/src/{database,transaction,cursor}.rs) over libmdbx.
+Semantics kept from MDBX where they matter to callers:
+
+- keys and values are raw ``bytes``; tables are sorted by key
+- DUPSORT tables hold multiple values per key, sorted by value; a
+  (key, subkey-prefixed value) model identical to the reference's use
+- single-writer model (as MDBX enforces in the reference): writes apply
+  live with an undo log, ``commit`` is O(1), ``abort`` replays the log.
+  Readers in the same process see live data — there is NO cross-tx
+  snapshot isolation in this backend; don't interleave a reader with a
+  writer and expect MDBX's MVCC.
+
+The in-memory ``MemDb`` keeps each table as ``dict[key -> value | sorted
+value list]`` plus a cached sorted key index (invalidated on key
+add/remove), giving O(log n) seeks and ordered iteration — a correct,
+adequately fast stand-in for the native backend.
+"""
+
+from __future__ import annotations
+
+import bisect
+import pickle
+from pathlib import Path
+
+
+class Cursor:
+    """Sorted cursor over one table (reference `DbCursorRO`/`DbDupCursorRO`).
+
+    Positions on (key, value) pairs; for DUPSORT tables each duplicate is a
+    separate position, ordered by (key, value).
+    """
+
+    def __init__(self, tx: "Tx", table: str):
+        self._tx = tx
+        self._table = table
+        self._keys = tx._sorted_keys(table)
+        self._ki = -1  # key index
+        self._di = 0   # dup index within key
+
+    # -- helpers ------------------------------------------------------------
+
+    def _data(self):
+        return self._tx._table(self._table)
+
+    def _dups(self, key: bytes) -> list[bytes]:
+        v = self._data().get(key)
+        if v is None:
+            return []
+        return v if isinstance(v, list) else [v]
+
+    def _current(self):
+        if 0 <= self._ki < len(self._keys):
+            key = self._keys[self._ki]
+            dups = self._dups(key)
+            if 0 <= self._di < len(dups):
+                return (key, dups[self._di])
+        return None
+
+    # -- positioning --------------------------------------------------------
+
+    def first(self):
+        self._ki, self._di = (0, 0) if self._keys else (-1, 0)
+        return self._current()
+
+    def last(self):
+        if not self._keys:
+            self._ki = -1
+            return None
+        self._ki = len(self._keys) - 1
+        self._di = len(self._dups(self._keys[self._ki])) - 1
+        return self._current()
+
+    def seek(self, key: bytes):
+        """Position at the first entry with key >= ``key``."""
+        self._ki = bisect.bisect_left(self._keys, key)
+        self._di = 0
+        return self._current()
+
+    def seek_exact(self, key: bytes):
+        i = bisect.bisect_left(self._keys, key)
+        if i < len(self._keys) and self._keys[i] == key:
+            self._ki, self._di = i, 0
+            return self._current()
+        self._ki = len(self._keys)  # past end
+        self._di = 0
+        return None
+
+    def next(self):
+        if self._ki < 0:
+            return self.first()
+        if self._ki >= len(self._keys):
+            return None
+        dups = self._dups(self._keys[self._ki])
+        if self._di + 1 < len(dups):
+            self._di += 1
+        else:
+            self._ki += 1
+            self._di = 0
+        return self._current()
+
+    def prev(self):
+        if self._ki < 0:
+            return None
+        if self._di > 0:
+            self._di -= 1
+            return self._current()
+        if self._ki == 0:
+            self._ki = -1
+            return None
+        self._ki -= 1
+        if self._ki < len(self._keys):
+            self._di = len(self._dups(self._keys[self._ki])) - 1
+        return self._current()
+
+    # -- DUPSORT ------------------------------------------------------------
+
+    def seek_by_key_subkey(self, key: bytes, subkey: bytes):
+        """First duplicate of ``key`` whose value >= ``subkey`` (prefix seek)."""
+        i = bisect.bisect_left(self._keys, key)
+        if i >= len(self._keys) or self._keys[i] != key:
+            self._ki = len(self._keys)
+            return None
+        dups = self._dups(key)
+        j = bisect.bisect_left(dups, subkey)
+        if j >= len(dups):
+            return None
+        self._ki, self._di = i, j
+        return (key, dups[j])
+
+    def next_dup(self):
+        cur = self._current()
+        if cur is None:
+            return None
+        dups = self._dups(self._keys[self._ki])
+        if self._di + 1 < len(dups):
+            self._di += 1
+            return self._current()
+        return None
+
+    def next_no_dup(self):
+        if self._ki < 0:
+            return self.first()
+        self._ki += 1
+        self._di = 0
+        return self._current()
+
+    def walk(self, start: bytes | None = None):
+        """Iterate (key, value) from ``start`` (or beginning) to the end."""
+        entry = self.seek(start) if start is not None else self.first()
+        while entry is not None:
+            yield entry
+            entry = self.next()
+
+    def walk_dup(self, key: bytes, subkey: bytes = b""):
+        entry = self.seek_by_key_subkey(key, subkey)
+        while entry is not None:
+            yield entry
+            entry = self.next_dup()
+
+    def walk_range(self, start: bytes, end: bytes):
+        """Iterate entries with start <= key < end."""
+        for key, value in self.walk(start):
+            if key >= end:
+                return
+            yield (key, value)
+
+
+_ABSENT = object()
+
+
+class Tx:
+    """A transaction over the store.
+
+    Writes apply directly to the base tables with an undo log per touched
+    key, so ``commit`` is O(1) and ``abort`` is O(writes) — the model is
+    single-writer (as MDBX enforces in the reference), readers in the same
+    process see live data.
+    """
+
+    def __init__(self, db: "MemDb", write: bool):
+        self._db = db
+        self._write = write
+        # undo log: (table, key, previous value-or-_ABSENT), newest last
+        self._undo: list[tuple[str, bytes, object]] = []
+        self._undo_seen: set[tuple[str, bytes]] = set()
+        self._undo_clear: list[tuple[str, dict]] = []
+        self._done = False
+
+    # -- table access --------------------------------------------------------
+
+    def _table(self, table: str) -> dict:
+        return self._db._tables.setdefault(table, {})
+
+    def _sorted_keys(self, table: str) -> list[bytes]:
+        return self._db._sorted_keys(table)
+
+    def _record_undo(self, table: str, key: bytes):
+        mark = (table, key)
+        if mark in self._undo_seen:
+            return
+        self._undo_seen.add(mark)
+        t = self._table(table)
+        prev = t.get(key, _ABSENT)
+        if isinstance(prev, list):
+            prev = list(prev)
+        self._undo.append((table, key, prev))
+
+    # -- reads --------------------------------------------------------------
+
+    def get(self, table: str, key: bytes):
+        v = self._table(table).get(key)
+        if isinstance(v, list):
+            return v[0] if v else None
+        return v
+
+    def get_dups(self, table: str, key: bytes) -> list[bytes]:
+        v = self._table(table).get(key)
+        if v is None:
+            return []
+        return list(v) if isinstance(v, list) else [v]
+
+    def cursor(self, table: str) -> Cursor:
+        return Cursor(self, table)
+
+    def entry_count(self, table: str) -> int:
+        n = 0
+        for v in self._table(table).values():
+            n += len(v) if isinstance(v, list) else 1
+        return n
+
+    # -- writes -------------------------------------------------------------
+
+    def put(self, table: str, key: bytes, value: bytes, dupsort: bool = False):
+        assert self._write, "read-only transaction"
+        self._record_undo(table, key)
+        t = self._table(table)
+        if key not in t:
+            self._db._invalidate_keys(table)
+        if dupsort:
+            dups = t.get(key)
+            if dups is None:
+                t[key] = [value]
+            else:
+                if not isinstance(dups, list):
+                    dups = [dups]
+                    t[key] = dups
+                j = bisect.bisect_left(dups, value)
+                if j >= len(dups) or dups[j] != value:
+                    dups.insert(j, value)
+        else:
+            t[key] = value
+
+    def delete(self, table: str, key: bytes, value: bytes | None = None):
+        """Delete a key (or one duplicate when ``value`` given)."""
+        assert self._write, "read-only transaction"
+        self._record_undo(table, key)
+        t = self._table(table)
+        if key not in t:
+            return False
+        if value is None or not isinstance(t.get(key), list):
+            del t[key]
+            self._db._invalidate_keys(table)
+            return True
+        dups = t[key]
+        j = bisect.bisect_left(dups, value)
+        if j < len(dups) and dups[j] == value:
+            dups.pop(j)
+            if not dups:
+                del t[key]
+                self._db._invalidate_keys(table)
+            return True
+        return False
+
+    def clear(self, table: str):
+        assert self._write
+        # Fold this table's per-key undo into a reconstructed tx-start image,
+        # so abort() restores pre-transaction state even after put-then-clear
+        # (puts mutate the live dict, so the current dict is NOT tx-start).
+        start = dict(self._table(table))
+        for tb, k, prev in self._undo:
+            if tb == table:
+                if prev is _ABSENT:
+                    start.pop(k, None)
+                else:
+                    start[k] = prev
+        self._undo = [e for e in self._undo if e[0] != table]
+        self._undo_seen = {m for m in self._undo_seen if m[0] != table}
+        self._undo_clear.append((table, start))
+        self._db._tables[table] = {}
+        self._db._invalidate_keys(table)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def commit(self):
+        assert not self._done
+        if self._write:
+            self._db._dirty = True
+        self._undo.clear()
+        self._undo_seen.clear()
+        self._undo_clear.clear()
+        self._done = True
+
+    def abort(self):
+        if self._write:
+            for table, key, prev in reversed(self._undo):
+                t = self._table(table)
+                if prev is _ABSENT:
+                    t.pop(key, None)
+                else:
+                    t[key] = prev
+                self._db._invalidate_keys(table)
+            for table, data in reversed(self._undo_clear):
+                self._db._tables[table] = data
+                self._db._invalidate_keys(table)
+        self._done = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *a):
+        if not self._done:
+            if exc_type is None and self._write:
+                self.commit()
+            else:
+                self.abort()
+
+
+class Database:
+    """Factory of transactions (reference `Database` trait)."""
+
+    def tx(self) -> Tx:
+        raise NotImplementedError
+
+    def tx_mut(self) -> Tx:
+        raise NotImplementedError
+
+
+class MemDb(Database):
+    """In-memory store, optionally persisted to a file (test/dev backend).
+
+    Reference analogue: `create_test_rw_db` temp MDBX environments
+    (crates/storage/db/src/test_utils). Persistence is whole-image
+    pickle save/load — a stand-in until the native backend lands.
+    """
+
+    def __init__(self, path: str | Path | None = None):
+        self._tables: dict[str, dict[bytes, object]] = {}
+        self._key_cache: dict[str, list[bytes]] = {}
+        self._path = Path(path) if path else None
+        self._dirty = False
+        if self._path and self._path.exists():
+            with open(self._path, "rb") as f:
+                self._tables = pickle.load(f)
+
+    def _sorted_keys(self, table: str) -> list[bytes]:
+        cached = self._key_cache.get(table)
+        if cached is None:
+            cached = sorted(self._tables.get(table, {}).keys())
+            self._key_cache[table] = cached
+        return cached
+
+    def _invalidate_keys(self, table: str):
+        self._key_cache.pop(table, None)
+
+    def tx(self) -> Tx:
+        return Tx(self, write=False)
+
+    def tx_mut(self) -> Tx:
+        return Tx(self, write=True)
+
+    def flush(self):
+        if self._path and self._dirty:
+            tmp = self._path.with_suffix(".tmp")
+            with open(tmp, "wb") as f:
+                pickle.dump(self._tables, f, protocol=pickle.HIGHEST_PROTOCOL)
+            tmp.replace(self._path)
+            self._dirty = False

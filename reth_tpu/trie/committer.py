@@ -42,6 +42,22 @@ BRANCH = 2
 OPAQUE = 3  # unchanged subtree boundary: ref is a known 32-byte hash
 
 
+class BoundaryCollapse(Exception):
+    """Structure change would merge a path INTO an opaque boundary node.
+
+    Raised when the rebuilt trie needs an extension pointing at a boundary
+    — e.g. deletions left a branch with a single unchanged child. The
+    boundary node's kind (leaf/ext/branch) is unknown from its hash alone,
+    so the caller must "reveal" the subtree (drop the boundary, supply its
+    leaves) and retry — the analogue of the reference's sparse-trie node
+    reveal on branch collapse (crates/trie/sparse/src/state.rs).
+    """
+
+    def __init__(self, path: Nibbles):
+        self.path = path
+        super().__init__(f"boundary collapse at {path.hex()}")
+
+
 @dataclass
 class _Node:
     kind: int
@@ -177,11 +193,10 @@ class TrieCommitter:
             if len(path) == depth:
                 arena.append(_Node(OPAQUE, at, ref=encode_hash_ref(payload)))
                 return len(arena) - 1
-            # lone opaque subtree below: extension down to it
-            child = len(arena)
-            arena.append(_Node(OPAQUE, path, ref=encode_hash_ref(payload)))
-            arena.append(_Node(EXT, at, ext_path=path[depth:], child=child))
-            return len(arena) - 1
+            # A lone opaque subtree strictly below this point means the
+            # surrounding structure collapsed into it — its node kind is
+            # unknown, so the boundary must be revealed by the caller.
+            raise BoundaryCollapse(path)
         # common prefix of all items below depth
         first = items[lo][0]
         last = items[hi - 1][0]  # sorted ⇒ min/max share the group prefix

@@ -1,0 +1,185 @@
+"""Incremental state-root tests: full-vs-incremental-vs-naive equality.
+
+Mirrors the reference's merkle-stage tests (random state + incremental
+parity, crates/stages/stages/src/stages/merkle.rs tests) with direct
+control of the hashed tables (keys need not be real keccak images).
+"""
+
+import numpy as np
+
+from reth_tpu.primitives import Account, EMPTY_ROOT_HASH
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.primitives.nibbles import unpack_nibbles
+from reth_tpu.primitives.rlp import rlp_encode, encode_int
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.tables import encode_account
+from reth_tpu.trie import TrieCommitter, naive_trie_root
+from reth_tpu.trie.incremental import IncrementalStateRoot, full_state_root, nibbles_range
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def naive_state_root(accounts: dict[bytes, Account], storages: dict[bytes, dict[bytes, int]]):
+    """Oracle over hashed keys directly."""
+    enc = {}
+    for hk, acc in accounts.items():
+        sroot = EMPTY_ROOT_HASH
+        slots = {s: v for s, v in storages.get(hk, {}).items() if v}
+        if slots:
+            sroot = naive_trie_root(
+                {s: rlp_encode(encode_int(v)) for s, v in slots.items()}
+            )
+        if acc.is_empty and sroot == EMPTY_ROOT_HASH:
+            continue
+        enc[hk] = encode_account(acc.with_(storage_root=sroot))
+    return naive_trie_root(enc)
+
+
+def write_hashed_state(p, accounts, storages):
+    for hk, acc in accounts.items():
+        p.put_hashed_account(hk, acc)
+    for hk, slots in storages.items():
+        for s, v in slots.items():
+            p.put_hashed_storage(hk, s, v)
+
+
+def test_nibbles_range():
+    start, end = nibbles_range(b"\x01\x02")
+    assert start == bytes.fromhex("12" + "00" * 31)
+    assert end == bytes.fromhex("13" + "00" * 31)
+    start, end = nibbles_range(b"")
+    assert start == b"\x00" * 32 and end is None
+    start, end = nibbles_range(b"\x0f" * 64)
+    assert end is None
+
+
+def test_full_then_incremental_simple():
+    factory = ProviderFactory(MemDb())
+    accounts = {
+        bytes.fromhex("11" + "00" * 30 + "01"): Account(balance=1),
+        bytes.fromhex("12" + "00" * 30 + "02"): Account(balance=2),
+        bytes.fromhex("22" + "00" * 30 + "03"): Account(balance=3),
+    }
+    with factory.provider_rw() as p:
+        write_hashed_state(p, accounts, {})
+        root = full_state_root(p, CPU)
+        assert root == naive_state_root(accounts, {})
+
+    # update one account incrementally
+    k = list(accounts)[0]
+    accounts[k] = Account(balance=100)
+    with factory.provider_rw() as p:
+        p.put_hashed_account(k, accounts[k])
+        inc = IncrementalStateRoot(p, CPU)
+        root = inc.compute({k})
+        assert root == naive_state_root(accounts, {})
+
+
+def test_incremental_deletion_collapse():
+    """Deleting a sibling collapses a branch into an unchanged boundary."""
+    factory = ProviderFactory(MemDb())
+    k1 = bytes.fromhex("11" + "aa" * 31)
+    k2 = bytes.fromhex("12" + "bb" * 31)
+    k3 = bytes.fromhex("22" + "cc" * 31)
+    accounts = {k1: Account(balance=1), k2: Account(balance=2), k3: Account(balance=3)}
+    with factory.provider_rw() as p:
+        write_hashed_state(p, accounts, {})
+        assert full_state_root(p, CPU) == naive_state_root(accounts, {})
+
+    del accounts[k2]
+    with factory.provider_rw() as p:
+        p.put_hashed_account(k2, None)
+        root = IncrementalStateRoot(p, CPU).compute({k2})
+        assert root == naive_state_root(accounts, {})
+        # stored branch at path [1] must be gone (collapsed)
+        assert p.account_branch(b"\x01") is None
+        # and a no-change recompute from stored structure still agrees
+        assert IncrementalStateRoot(p, CPU).compute(set()) == root
+
+
+def test_incremental_randomised_churn():
+    rng = np.random.default_rng(77)
+    factory = ProviderFactory(MemDb())
+    accounts: dict[bytes, Account] = {}
+    storages: dict[bytes, dict[bytes, int]] = {}
+
+    def rand_key():
+        return bytes(rng.integers(0, 256, size=32, dtype=np.uint8))
+
+    # initial population
+    for _ in range(120):
+        accounts[rand_key()] = Account(
+            nonce=int(rng.integers(0, 9)), balance=int(rng.integers(1, 10**12))
+        )
+    keys = list(accounts)
+    for hk in keys[:20]:
+        storages[hk] = {
+            rand_key(): int(rng.integers(1, 2**60)) for _ in range(int(rng.integers(1, 6)))
+        }
+    with factory.provider_rw() as p:
+        write_hashed_state(p, accounts, storages)
+        assert full_state_root(p, CPU) == naive_state_root(accounts, storages)
+
+    for round_i in range(6):
+        changed_accounts: set[bytes] = set()
+        changed_storages: dict[bytes, set[bytes]] = {}
+        wiped: set[bytes] = set()
+        with factory.provider_rw() as p:
+            # mutate accounts: update / insert / delete
+            for _ in range(12):
+                op = rng.integers(0, 3)
+                if op == 0 and accounts:  # update
+                    hk = list(accounts)[int(rng.integers(0, len(accounts)))]
+                    accounts[hk] = accounts[hk].with_(balance=int(rng.integers(1, 10**12)))
+                    p.put_hashed_account(hk, accounts[hk])
+                    changed_accounts.add(hk)
+                elif op == 1:  # insert
+                    hk = rand_key()
+                    accounts[hk] = Account(balance=int(rng.integers(1, 10**12)))
+                    p.put_hashed_account(hk, accounts[hk])
+                    changed_accounts.add(hk)
+                elif accounts:  # delete
+                    hk = list(accounts)[int(rng.integers(0, len(accounts)))]
+                    del accounts[hk]
+                    p.put_hashed_account(hk, None)
+                    changed_accounts.add(hk)
+                    if hk in storages:
+                        for s in storages.pop(hk):
+                            p.put_hashed_storage(hk, s, 0)
+                        wiped.add(hk)
+            # mutate storage slots
+            for _ in range(6):
+                cands = [a for a in accounts if a in storages]
+                if cands:
+                    hk = cands[int(rng.integers(0, len(cands)))]
+                    slot = rand_key() if rng.integers(0, 2) else list(storages[hk])[0]
+                    val = int(rng.integers(0, 2**60))
+                    if val:
+                        storages[hk][slot] = val
+                    else:
+                        storages[hk].pop(slot, None)
+                    p.put_hashed_storage(hk, slot, val)
+                    changed_storages.setdefault(hk, set()).add(slot)
+            root = IncrementalStateRoot(p, CPU).compute(
+                changed_accounts, changed_storages, wiped
+            )
+            want = naive_state_root(accounts, storages)
+            assert root == want, f"round {round_i} diverged"
+            # stored-structure consistency
+            assert IncrementalStateRoot(p, CPU).compute(set()) == want
+
+
+def test_wiped_storage():
+    factory = ProviderFactory(MemDb())
+    hk = b"\x33" * 32
+    slots = {b"\x01" * 32: 5, b"\x02" * 32: 6}
+    accounts = {hk: Account(balance=9)}
+    with factory.provider_rw() as p:
+        write_hashed_state(p, accounts, {hk: slots})
+        assert full_state_root(p, CPU) == naive_state_root(accounts, {hk: slots})
+    with factory.provider_rw() as p:
+        for s in slots:
+            p.put_hashed_storage(hk, s, 0)
+        root = IncrementalStateRoot(p, CPU).compute(set(), {}, {hk})
+        assert root == naive_state_root(accounts, {})
+        assert p.hashed_account(hk).storage_root == EMPTY_ROOT_HASH

@@ -1,0 +1,184 @@
+"""Storage layer tests: KV semantics, dupsort cursors, provider round-trips."""
+
+import numpy as np
+import pytest
+
+from reth_tpu.primitives.types import (
+    Account,
+    Block,
+    Header,
+    Receipt,
+    Log,
+    Transaction,
+    Withdrawal,
+)
+from reth_tpu.storage import MemDb, ProviderFactory, Tables
+from reth_tpu.storage.tables import be64
+from reth_tpu.trie.committer import BranchNode
+
+
+def test_kv_basic_and_cursor_order():
+    db = MemDb()
+    with db.tx_mut() as tx:
+        for k in (b"b", b"a", b"c"):
+            tx.put("t", k, b"v" + k)
+    tx = db.tx()
+    cur = tx.cursor("t")
+    assert [k for k, _ in cur.walk()] == [b"a", b"b", b"c"]
+    assert cur.seek(b"aa") == (b"b", b"vb")
+    assert cur.seek_exact(b"aa") is None
+    assert cur.seek_exact(b"c") == (b"c", b"vc")
+    assert cur.prev() == (b"b", b"vb")
+    assert cur.last() == (b"c", b"vc")
+
+
+def test_abort_rolls_back():
+    db = MemDb()
+    with db.tx_mut() as tx:
+        tx.put("t", b"k", b"v1")
+    tx = db.tx_mut()
+    tx.put("t", b"k", b"v2")
+    tx.put("t", b"k2", b"x")
+    tx.delete("t", b"k")
+    tx.abort()
+    assert db.tx().get("t", b"k") == b"v1"
+    assert db.tx().get("t", b"k2") is None
+
+
+def test_clear_rolls_back():
+    db = MemDb()
+    with db.tx_mut() as tx:
+        tx.put("t", b"k", b"v1")
+    tx = db.tx_mut()
+    tx.clear("t")
+    tx.put("t", b"k3", b"z")
+    tx.abort()
+    assert db.tx().get("t", b"k") == b"v1"
+    assert db.tx().get("t", b"k3") is None
+
+
+def test_put_then_clear_abort_restores_tx_start():
+    """abort after put-then-clear must restore PRE-transaction state."""
+    db = MemDb()
+    with db.tx_mut() as tx:
+        tx.put("t", b"k", b"v1")
+    tx = db.tx_mut()
+    tx.put("t", b"k", b"v2")
+    tx.clear("t")
+    tx.put("t", b"k", b"v3")
+    tx.abort()
+    assert db.tx().get("t", b"k") == b"v1"
+
+
+def test_dupsort_cursor():
+    db = MemDb()
+    with db.tx_mut() as tx:
+        tx.put("d", b"k1", b"bbb", dupsort=True)
+        tx.put("d", b"k1", b"aaa", dupsort=True)
+        tx.put("d", b"k1", b"ccc", dupsort=True)
+        tx.put("d", b"k2", b"zzz", dupsort=True)
+    cur = db.tx().cursor("d")
+    assert list(cur.walk_dup(b"k1")) == [(b"k1", b"aaa"), (b"k1", b"bbb"), (b"k1", b"ccc")]
+    assert cur.seek_by_key_subkey(b"k1", b"bb") == (b"k1", b"bbb")
+    assert cur.seek_by_key_subkey(b"k1", b"zzz") is None
+    # full walk visits each dup
+    assert [v for _, v in db.tx().cursor("d").walk()] == [b"aaa", b"bbb", b"ccc", b"zzz"]
+    # delete one dup
+    with db.tx_mut() as tx:
+        assert tx.delete("d", b"k1", b"bbb")
+    assert list(db.tx().cursor("d").walk_dup(b"k1")) == [(b"k1", b"aaa"), (b"k1", b"ccc")]
+
+
+def test_walk_range():
+    db = MemDb()
+    with db.tx_mut() as tx:
+        for i in range(10):
+            tx.put("t", be64(i), bytes([i]))
+    got = [k for k, _ in db.tx().cursor("t").walk_range(be64(3), be64(7))]
+    assert got == [be64(i) for i in range(3, 7)]
+
+
+def test_persistence_roundtrip(tmp_path):
+    path = tmp_path / "db.bin"
+    db = MemDb(path)
+    with db.tx_mut() as tx:
+        tx.put("t", b"k", b"v")
+    db.flush()
+    db2 = MemDb(path)
+    assert db2.tx().get("t", b"k") == b"v"
+
+
+def test_provider_blocks_and_state():
+    factory = ProviderFactory(MemDb())
+    header = Header(number=1, base_fee_per_gas=7)
+    tx0 = Transaction(tx_type=2, chain_id=1, to=b"\x01" * 20, value=5, r=1, s=1)
+    block = Block(header, (tx0,), (), (Withdrawal(0, 0, b"\x02" * 20, 1),))
+    with factory.provider_rw() as p:
+        p.insert_header(header)
+        p.insert_block_body(block)
+        p.put_sender(0, b"\x0a" * 20)
+        p.put_receipt(0, Receipt(tx_type=2, success=True, cumulative_gas_used=21000,
+                                 logs=(Log(b"\x01" * 20, (b"\x02" * 32,), b"d"),)))
+        p.put_account(b"\x0a" * 20, Account(nonce=1, balance=100))
+        p.put_storage(b"\x0a" * 20, b"\x01" * 32, 42)
+
+    p = factory.provider()
+    assert p.header_by_number(1) == header
+    assert p.canonical_hash(1) == header.hash
+    assert p.block_number(header.hash) == 1
+    got = p.block_by_number(1)
+    assert got == block
+    assert p.sender(0) == b"\x0a" * 20
+    assert p.receipt(0).cumulative_gas_used == 21000
+    assert p.account(b"\x0a" * 20) == Account(nonce=1, balance=100)
+    assert p.storage(b"\x0a" * 20, b"\x01" * 32) == 42
+    assert p.storage(b"\x0a" * 20, b"\x02" * 32) == 0
+    idx = p.block_body_indices(1)
+    assert (idx.first_tx_num, idx.tx_count) == (0, 1)
+
+
+def test_provider_storage_overwrite_and_zero():
+    factory = ProviderFactory(MemDb())
+    addr = b"\x0b" * 20
+    with factory.provider_rw() as p:
+        p.put_storage(addr, b"\x01" * 32, 1)
+        p.put_storage(addr, b"\x01" * 32, 2)  # overwrite, not duplicate
+        p.put_storage(addr, b"\x02" * 32, 3)
+        p.put_storage(addr, b"\x02" * 32, 0)  # delete
+    p = factory.provider()
+    assert p.account_storage(addr) == {b"\x01" * 32: 2}
+
+
+def test_changesets_first_seen_wins():
+    factory = ProviderFactory(MemDb())
+    addr = b"\x0c" * 20
+    with factory.provider_rw() as p:
+        p.record_account_change(5, addr, Account(balance=1))
+        p.record_account_change(6, addr, Account(balance=2))
+        p.record_storage_change(5, addr, b"\x01" * 32, 10)
+        p.record_storage_change(6, addr, b"\x01" * 32, 20)
+    p = factory.provider()
+    assert p.account_changes_in_range(5, 6)[addr] == Account(balance=1)
+    assert p.account_changes_in_range(6, 6)[addr] == Account(balance=2)
+    assert p.storage_changes_in_range(5, 6)[addr][b"\x01" * 32] == 10
+
+
+def test_trie_branch_storage():
+    factory = ProviderFactory(MemDb())
+    node = BranchNode(0b11, 0b01, 0b10, (b"\xaa" * 32,))
+    with factory.provider_rw() as p:
+        p.put_account_branch(b"\x01\x02", node)
+        p.put_storage_branch(b"\xbb" * 32, b"\x03", node)
+        p.put_storage_branch(b"\xbb" * 32, b"\x03", BranchNode(0b1, 0, 0, ()))  # overwrite
+    p = factory.provider()
+    assert p.account_branch(b"\x01\x02") == node
+    assert p.storage_branch(b"\xbb" * 32, b"\x03") == BranchNode(0b1, 0, 0, ())
+    assert p.storage_branch(b"\xbb" * 32, b"\x04") is None
+
+
+def test_stage_checkpoints():
+    factory = ProviderFactory(MemDb())
+    with factory.provider_rw() as p:
+        assert p.stage_checkpoint("Headers") == 0
+        p.save_stage_checkpoint("Headers", 100)
+    assert factory.provider().stage_checkpoint("Headers") == 100
