@@ -1,0 +1,122 @@
+"""Header/body/post-execution validation, post-merge rule set.
+
+Reference analogue: `EthBeaconConsensus` — header-vs-parent checks,
+pre-execution body checks (tx/withdrawal roots), post-execution checks
+(gas used, receipts root, logs bloom)
+(crates/ethereum/consensus/src/lib.rs, crates/consensus/common).
+"""
+
+from __future__ import annotations
+
+from ..primitives.types import (
+    Block,
+    EMPTY_OMMER_ROOT_HASH,
+    Header,
+    Receipt,
+    logs_bloom,
+)
+from ..primitives.rlp import rlp_encode
+from ..trie.state_root import ordered_trie_root
+
+GAS_LIMIT_BOUND_DIVISOR = 1024
+MIN_GAS_LIMIT = 5000
+BASE_FEE_MAX_CHANGE_DENOMINATOR = 8
+ELASTICITY_MULTIPLIER = 2
+MAX_EXTRA_DATA = 32
+
+
+class ConsensusError(Exception):
+    pass
+
+
+def calc_next_base_fee(parent: Header) -> int:
+    """EIP-1559 base fee for the child of ``parent``."""
+    base = parent.base_fee_per_gas
+    if base is None:
+        return 10**9  # activation default (EIP-1559 INITIAL_BASE_FEE)
+    target = parent.gas_limit // ELASTICITY_MULTIPLIER
+    if parent.gas_used == target:
+        return base
+    if parent.gas_used > target:
+        delta = max(1, base * (parent.gas_used - target) // target // BASE_FEE_MAX_CHANGE_DENOMINATOR)
+        return base + delta
+    delta = base * (target - parent.gas_used) // target // BASE_FEE_MAX_CHANGE_DENOMINATOR
+    return base - delta
+
+
+def validate_header_against_parent(header: Header, parent: Header) -> None:
+    if header.number != parent.number + 1:
+        raise ConsensusError(f"block number {header.number} not parent+1")
+    if header.parent_hash != parent.hash:
+        raise ConsensusError("parent hash mismatch")
+    if header.timestamp <= parent.timestamp:
+        raise ConsensusError("timestamp not after parent")
+    # gas limit bounds
+    diff = abs(header.gas_limit - parent.gas_limit)
+    if diff >= parent.gas_limit // GAS_LIMIT_BOUND_DIVISOR:
+        raise ConsensusError("gas limit changed too much")
+    if header.gas_limit < MIN_GAS_LIMIT:
+        raise ConsensusError("gas limit below minimum")
+    # EIP-1559
+    if header.base_fee_per_gas is None:
+        raise ConsensusError("missing base fee")
+    expected = calc_next_base_fee(parent)
+    if header.base_fee_per_gas != expected:
+        raise ConsensusError(f"base fee {header.base_fee_per_gas} != expected {expected}")
+    # post-merge constants
+    if header.difficulty != 0:
+        raise ConsensusError("non-zero difficulty post-merge")
+    if header.nonce != b"\x00" * 8:
+        raise ConsensusError("non-zero nonce post-merge")
+    if header.ommers_hash != EMPTY_OMMER_ROOT_HASH:
+        raise ConsensusError("ommers not allowed post-merge")
+    if len(header.extra_data) > MAX_EXTRA_DATA:
+        raise ConsensusError("extra data too long")
+
+
+def validate_block_pre_execution(block: Block, committer=None) -> None:
+    """Structural checks before execution: body roots match the header."""
+    header = block.header
+    tx_encodings = [tx.encode() for tx in block.transactions]
+    if ordered_trie_root(tx_encodings, committer) != header.transactions_root:
+        raise ConsensusError("transactions root mismatch")
+    if block.withdrawals is not None:
+        want = ordered_trie_root(
+            [rlp_encode(w.rlp_fields()) for w in block.withdrawals], committer
+        )
+        if header.withdrawals_root != want:
+            raise ConsensusError("withdrawals root mismatch")
+    elif header.withdrawals_root is not None:
+        raise ConsensusError("header has withdrawals root but body has none")
+    if block.ommers:
+        raise ConsensusError("ommers not allowed post-merge")
+
+
+def validate_block_post_execution(
+    block: Block, receipts: list[Receipt], gas_used: int, committer=None
+) -> None:
+    header = block.header
+    if gas_used != header.gas_used:
+        raise ConsensusError(f"gas used {gas_used} != header {header.gas_used}")
+    receipts_root = ordered_trie_root([r.encode_2718() for r in receipts], committer)
+    if receipts_root != header.receipts_root:
+        raise ConsensusError("receipts root mismatch")
+    bloom = logs_bloom([log for r in receipts for log in r.logs])
+    if bloom != header.logs_bloom:
+        raise ConsensusError("logs bloom mismatch")
+
+
+class EthBeaconConsensus:
+    """Bundles the rule set behind one object (reference `FullConsensus`)."""
+
+    def __init__(self, committer=None):
+        self.committer = committer
+
+    def validate_header_against_parent(self, header: Header, parent: Header):
+        validate_header_against_parent(header, parent)
+
+    def validate_block_pre_execution(self, block: Block):
+        validate_block_pre_execution(block, self.committer)
+
+    def validate_block_post_execution(self, block: Block, receipts, gas_used):
+        validate_block_post_execution(block, receipts, gas_used, self.committer)

@@ -1,0 +1,19 @@
+"""Block execution on CPU — interpreter, journaled state, block executor.
+
+Reference analogue: the revm v41 interpreter (external crate) plus reth's
+glue (crates/revm, crates/evm/evm, crates/ethereum/evm). Execution stays
+on the host by design (SURVEY.md north star): the TPU accelerates the
+state-commitment path, not the EVM; this package produces the state
+changes and receipts that feed the hashing/merkle stages.
+"""
+
+from .state import EvmState, BlockChanges
+from .executor import BlockExecutor, BlockExecutionOutput, EvmConfig
+
+__all__ = [
+    "EvmState",
+    "BlockChanges",
+    "BlockExecutor",
+    "BlockExecutionOutput",
+    "EvmConfig",
+]

@@ -1,0 +1,247 @@
+"""Block executor: transaction validation, execution, receipts, changesets.
+
+Reference analogue: `ConfigureEvm`/`Executor`/`BlockExecutionOutput`
+(crates/evm/evm/src/lib.rs:181, crates/evm/execution-types) with
+`EthEvmConfig`'s mainnet wiring (crates/ethereum/evm). Post-merge rules:
+no block rewards, withdrawals credited in gwei, EIP-1559 fee handling
+(priority fee to coinbase, base fee burned), EIP-3529 refund cap of 1/5.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..primitives.keccak import keccak256
+from ..primitives.types import Account, Block, Log, Receipt, Transaction
+from .interpreter import (
+    BlockEnv,
+    CallFrame,
+    G_ACCESS_LIST_ADDR,
+    G_ACCESS_LIST_SLOT,
+    G_INITCODE_WORD,
+    G_NONZERO_BYTE,
+    G_TX,
+    G_TX_CREATE,
+    G_ZERO_BYTE,
+    Halt,
+    Interpreter,
+    MAX_INITCODE_SIZE,
+    Revert,
+    TxEnv,
+)
+from .state import BlockChanges, EvmState, StateSource
+
+MAX_REFUND_QUOTIENT = 5  # EIP-3529
+
+
+class InvalidTransaction(Exception):
+    pass
+
+
+@dataclass
+class EvmConfig:
+    """Chain-level execution config (reference `EthEvmConfig`)."""
+
+    chain_id: int = 1
+
+
+@dataclass
+class TxResult:
+    receipt: Receipt
+    gas_used: int
+    success: bool
+    output: bytes = b""
+
+
+@dataclass
+class BlockExecutionOutput:
+    """Everything downstream stages need (reference `BlockExecutionOutput`)."""
+
+    receipts: list[Receipt] = field(default_factory=list)
+    gas_used: int = 0
+    changes: BlockChanges | None = None
+    post_accounts: dict[bytes, Account | None] = field(default_factory=dict)
+    post_storage: dict[bytes, dict[bytes, int]] = field(default_factory=dict)
+    senders: list[bytes] = field(default_factory=list)
+
+
+def intrinsic_gas(tx: Transaction) -> int:
+    gas = G_TX
+    for b in tx.data:
+        gas += G_ZERO_BYTE if b == 0 else G_NONZERO_BYTE
+    if tx.to is None:
+        gas += G_TX_CREATE
+        gas += G_INITCODE_WORD * ((len(tx.data) + 31) // 32)  # EIP-3860
+    for _addr, slots in tx.access_list:
+        gas += G_ACCESS_LIST_ADDR + G_ACCESS_LIST_SLOT * len(slots)
+    return gas
+
+
+class BlockExecutor:
+    """Executes one block against a state source."""
+
+    def __init__(self, source: StateSource, config: EvmConfig | None = None):
+        self.source = source
+        self.config = config or EvmConfig()
+
+    def execute(
+        self, block: Block, senders: list[bytes] | None = None,
+        block_hashes: dict[int, bytes] | None = None,
+    ) -> BlockExecutionOutput:
+        header = block.header
+        env = BlockEnv(
+            number=header.number,
+            timestamp=header.timestamp,
+            coinbase=header.beneficiary,
+            gas_limit=header.gas_limit,
+            base_fee=header.base_fee_per_gas or 0,
+            prev_randao=header.mix_hash,
+            chain_id=self.config.chain_id,
+            block_hashes=block_hashes or {},
+        )
+        state = EvmState(self.source)
+        out = BlockExecutionOutput()
+        if senders is None:
+            senders = [tx.recover_sender() for tx in block.transactions]
+        out.senders = senders
+        cumulative_gas = 0
+        for tx, sender in zip(block.transactions, senders):
+            result = self._execute_tx(state, env, tx, sender, header.gas_limit - cumulative_gas)
+            cumulative_gas += result.gas_used
+            receipt = Receipt(
+                tx_type=tx.tx_type,
+                success=result.success,
+                cumulative_gas_used=cumulative_gas,
+                logs=tuple(result.receipt.logs),
+            )
+            out.receipts.append(receipt)
+        # withdrawals (gwei → wei), post-merge; zero-amount does not touch
+        for w in block.withdrawals or ():
+            if w.amount:
+                state._capture_account_change(w.address)
+                state.add_balance(w.address, w.amount * 10**9)
+        out.gas_used = cumulative_gas
+        out.changes = state.changes
+        out.post_accounts, out.post_storage = state.final_state()
+        return out
+
+    def _execute_tx(
+        self, state: EvmState, env: BlockEnv, tx: Transaction, sender: bytes,
+        gas_available: int,
+    ) -> TxResult:
+        base_fee = env.base_fee
+        # -- validation (reference: EthTransactionValidator + pre-exec checks)
+        if tx.gas_limit > gas_available:
+            raise InvalidTransaction("block gas limit exceeded")
+        if tx.chain_id is not None and tx.chain_id != env.chain_id:
+            raise InvalidTransaction("wrong chain id")
+        gas_price = tx.effective_gas_price(base_fee)
+        if tx.tx_type >= 2 and tx.max_fee_per_gas < base_fee:
+            raise InvalidTransaction("max fee below base fee")
+        if tx.tx_type < 2 and gas_price < base_fee:  # legacy + EIP-2930
+            raise InvalidTransaction("gas price below base fee")
+        acct = state.account_or_empty(sender)
+        if acct.nonce != tx.nonce:
+            raise InvalidTransaction(f"nonce mismatch: acct {acct.nonce} vs tx {tx.nonce}")
+        max_cost = tx.gas_limit * (tx.max_fee_per_gas if tx.tx_type >= 2 else tx.gas_price)
+        if acct.balance < max_cost + tx.value:
+            raise InvalidTransaction("insufficient funds")
+        ig = intrinsic_gas(tx)
+        if tx.gas_limit < ig:
+            raise InvalidTransaction("intrinsic gas too high")
+        if tx.to is None and len(tx.data) > MAX_INITCODE_SIZE:
+            raise InvalidTransaction("initcode too large")
+
+        # -- setup
+        state.begin_tx()
+        state.delete_empty_touched()
+        interp = Interpreter(state, env, TxEnv(origin=sender, gas_price=gas_price))
+        # buy gas
+        state.sub_balance(sender, tx.gas_limit * gas_price)
+        state.bump_nonce(sender)
+        # warm: sender, coinbase (EIP-3651), target, precompiles (EIP-2929
+        # initialises accessed_addresses with them), access list
+        state.warm_account(sender)
+        state.warm_account(env.coinbase)
+        for i in range(1, 11):
+            state.warm_account(b"\x00" * 19 + bytes([i]))
+        if tx.to is not None:
+            state.warm_account(tx.to)
+        for addr, slots in tx.access_list:
+            state.warm_account(addr)
+            for s in slots:
+                state.warm_slot(addr, s)
+
+        gas = tx.gas_limit - ig
+        success, output = True, b""
+        if tx.to is None:
+            ok, gas_left, _addr, output = interp.create(
+                sender, tx.value, tx.data, gas, 0, tx_nonce=tx.nonce
+            )
+            success = ok
+        else:
+            frame = CallFrame(
+                caller=sender, address=tx.to, code=state.code(tx.to),
+                data=tx.data, value=tx.value, gas=gas,
+            )
+            try:
+                ok, gas_left, output = interp.call(frame)
+                success = ok
+            except Revert as r:
+                success, gas_left, output = False, getattr(r, "gas_left", 0), r.output
+            except Halt:
+                success, gas_left, output = False, 0, b""
+
+        gas_used = tx.gas_limit - gas_left
+        if success:
+            refund = min(state.refund, gas_used // MAX_REFUND_QUOTIENT)
+            gas_used -= refund
+        # refund unused gas, pay coinbase the priority fee, burn base fee
+        state.add_balance(sender, (tx.gas_limit - gas_used) * gas_price)
+        priority = gas_price - base_fee
+        if priority > 0:
+            state._capture_account_change(env.coinbase)
+            state.add_balance(env.coinbase, gas_used * priority)
+        # failed frames already popped their logs via journal revert
+        logs = state.take_logs()
+        state.delete_empty_touched()
+        return TxResult(
+            receipt=Receipt(tx_type=tx.tx_type, success=success, logs=tuple(logs)),
+            gas_used=gas_used,
+            success=success,
+            output=output,
+        )
+
+
+class ProviderStateSource(StateSource):
+    """StateSource over a DatabaseProvider's plain state."""
+
+    def __init__(self, provider):
+        self.provider = provider
+
+    def account(self, address: bytes) -> Account | None:
+        return self.provider.account(address)
+
+    def storage(self, address: bytes, slot: bytes) -> int:
+        return self.provider.storage(address, slot)
+
+    def bytecode(self, code_hash: bytes) -> bytes:
+        return self.provider.bytecode(code_hash) or b""
+
+
+class InMemoryStateSource(StateSource):
+    """Dict-backed source for tests and genesis building."""
+
+    def __init__(self, accounts=None, storages=None, codes=None):
+        self.accounts = dict(accounts or {})
+        self.storages = {a: dict(s) for a, s in (storages or {}).items()}
+        self.codes = dict(codes or {})
+
+    def account(self, address: bytes) -> Account | None:
+        return self.accounts.get(address)
+
+    def storage(self, address: bytes, slot: bytes) -> int:
+        return self.storages.get(address, {}).get(slot, 0)
+
+    def bytecode(self, code_hash: bytes) -> bytes:
+        return self.codes.get(code_hash, b"")

@@ -1,0 +1,707 @@
+"""EVM bytecode interpreter (CPU) — Shanghai/Cancun rule set.
+
+Reference analogue: the revm v41 interpreter (external crate; reth wires
+it via `ConfigureEvm`, crates/evm/evm/src/lib.rs:181). A from-scratch
+stack machine: 256-bit words as Python ints, memory as bytearray,
+EIP-2929 warm/cold access, EIP-3529 refunds, EIP-3860 initcode metering,
+EIP-1153 transient storage, EIP-5656 MCOPY, EIP-6780 selfdestruct.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from ..primitives.keccak import keccak256
+from ..primitives.rlp import rlp_encode, encode_int
+from .state import EvmState
+
+U256 = 1 << 256
+MASK = U256 - 1
+SIGN_BIT = 1 << 255
+
+MAX_CALL_DEPTH = 1024
+MAX_CODE_SIZE = 24576
+MAX_INITCODE_SIZE = 2 * MAX_CODE_SIZE
+
+# gas constants
+G_ZERO_BYTE = 4
+G_NONZERO_BYTE = 16
+G_COLD_SLOAD = 2100
+G_WARM_ACCESS = 100
+G_COLD_ACCOUNT = 2600
+G_SSTORE_SET = 20000
+G_SSTORE_RESET = 2900
+R_SSTORE_CLEAR = 4800
+G_KECCAK = 30
+G_KECCAK_WORD = 6
+G_COPY_WORD = 3
+G_LOG = 375
+G_LOG_TOPIC = 375
+G_LOG_BYTE = 8
+G_CREATE = 32000
+G_CODE_DEPOSIT = 200
+G_CALL_VALUE = 9000
+G_CALL_STIPEND = 2300
+G_NEW_ACCOUNT = 25000
+G_SELFDESTRUCT = 5000
+G_INITCODE_WORD = 2
+G_EXP_BYTE = 50
+G_MEM = 3
+G_TX = 21000
+G_TX_CREATE = 32000
+G_ACCESS_LIST_ADDR = 2400
+G_ACCESS_LIST_SLOT = 1900
+
+
+class Halt(Exception):
+    """Exceptional halt: consumes all frame gas."""
+
+
+class Revert(Exception):
+    def __init__(self, output: bytes):
+        self.output = output
+
+
+@dataclass
+class BlockEnv:
+    number: int = 0
+    timestamp: int = 0
+    coinbase: bytes = b"\x00" * 20
+    gas_limit: int = 30_000_000
+    base_fee: int = 0
+    prev_randao: bytes = b"\x00" * 32
+    blob_base_fee: int = 1
+    chain_id: int = 1
+    block_hashes: dict[int, bytes] = field(default_factory=dict)
+
+
+@dataclass
+class TxEnv:
+    origin: bytes = b"\x00" * 20
+    gas_price: int = 0
+    blob_hashes: tuple[bytes, ...] = ()
+
+
+@dataclass
+class CallFrame:
+    caller: bytes
+    address: bytes          # storage/context address
+    code: bytes
+    data: bytes
+    value: int              # CALLVALUE the frame observes
+    gas: int
+    static: bool = False
+    depth: int = 0
+    transfer_value: bool = True  # False for DELEGATECALL: value is context-only
+
+
+class Interpreter:
+    def __init__(self, state: EvmState, block: BlockEnv, tx: TxEnv):
+        self.state = state
+        self.block = block
+        self.tx = tx
+        self.transient: dict[tuple[bytes, bytes], int] = {}
+
+    # -- entry points ---------------------------------------------------------
+
+    def call(self, frame: CallFrame) -> tuple[bool, int, bytes]:
+        """Execute a message call; returns (success, gas_left, output)."""
+        if frame.depth > MAX_CALL_DEPTH:
+            return False, frame.gas, b""
+        state = self.state
+        snap = state.snapshot()
+        if frame.value and frame.transfer_value:
+            if state.balance(frame.caller) < frame.value:
+                return False, frame.gas, b""
+            state.sub_balance(frame.caller, frame.value)
+            state.add_balance(frame.address, frame.value)
+        pre = _precompile(frame.address)
+        if pre is not None:
+            ok, gas_left, out = pre(frame.data, frame.gas)
+            if not ok:
+                state.revert(snap)
+            return ok, gas_left, out
+        if not frame.code:
+            return True, frame.gas, b""
+        try:
+            gas_left, out = self._run(frame)
+            return True, gas_left, out
+        except Revert as r:
+            state.revert(snap)
+            raise
+        except Halt:
+            state.revert(snap)
+            return False, 0, b""
+
+    def create(
+        self, caller: bytes, value: int, initcode: bytes, gas: int,
+        depth: int, salt: bytes | None = None, tx_nonce: int | None = None,
+    ) -> tuple[bool, int, bytes, bytes]:
+        """CREATE/CREATE2; returns (success, gas_left, address, output).
+
+        ``tx_nonce`` marks a top-level create transaction: the address
+        derives from the tx nonce and the sender's nonce is NOT bumped here
+        (the transaction itself already did).
+        """
+        state = self.state
+        if depth > MAX_CALL_DEPTH or state.balance(caller) < value:
+            return False, gas, b"", b""
+        if state.nonce(caller) >= (1 << 64) - 1:
+            return False, gas, b"", b""
+        if tx_nonce is not None:
+            addr = keccak256(rlp_encode([caller, encode_int(tx_nonce)]))[12:]
+        elif salt is None:
+            addr = keccak256(rlp_encode([caller, encode_int(state.nonce(caller))]))[12:]
+        else:
+            addr = keccak256(b"\xff" + caller + salt + keccak256(initcode))[12:]
+        if tx_nonce is None:
+            state.bump_nonce(caller)
+        state.warm_account(addr)
+        existing = state.account(addr)
+        if existing is not None and (existing.nonce > 0 or existing.code_hash != keccak256(b"")):
+            return False, 0, b"", b""  # address collision burns gas
+        snap = state.snapshot()
+        state.create_account(addr)
+        state.sub_balance(caller, value)
+        state.add_balance(addr, value)
+        frame = CallFrame(caller=caller, address=addr, code=initcode,
+                          data=b"", value=value, gas=gas, depth=depth)
+        try:
+            gas_left, out = self._run(frame)
+        except Revert as r:
+            state.revert(snap)
+            return False, getattr(r, "gas_left", 0), b"", r.output
+        except Halt:
+            state.revert(snap)
+            return False, 0, b"", b""
+        # code deposit
+        if len(out) > MAX_CODE_SIZE or (out and out[0] == 0xEF):
+            state.revert(snap)
+            return False, 0, b"", b""
+        deposit = G_CODE_DEPOSIT * len(out)
+        if gas_left < deposit:
+            state.revert(snap)
+            return False, 0, b"", b""
+        gas_left -= deposit
+        state.set_code(addr, out)
+        return True, gas_left, addr, b""
+
+    # -- main loop ------------------------------------------------------------
+
+    def _run(self, fr: CallFrame) -> tuple[int, bytes]:
+        state = self.state
+        code = fr.code
+        stack: list[int] = []
+        mem = bytearray()
+        pc = 0
+        gas = fr.gas
+        returndata = b""
+        jumpdests = _jumpdests(code)
+        push = stack.append
+
+        def use(n):
+            nonlocal gas
+            if gas < n:
+                raise Halt()
+            gas -= n
+
+        def pop():
+            if not stack:
+                raise Halt()
+            return stack.pop()
+
+        def mem_expand(offset, size):
+            nonlocal gas
+            if size == 0:
+                return
+            end = offset + size
+            if end > len(mem):
+                new_words = (end + 31) // 32
+                old_words = (len(mem) + 31) // 32
+                cost = (G_MEM * new_words + new_words * new_words // 512) - (
+                    G_MEM * old_words + old_words * old_words // 512
+                )
+                use(cost)
+                mem.extend(b"\x00" * (new_words * 32 - len(mem)))
+
+        def mem_read(offset, size):
+            if size == 0:
+                return b""
+            if offset > 2**32 or size > 2**32:
+                raise Halt()
+            mem_expand(offset, size)
+            return bytes(mem[offset : offset + size])
+
+        def mem_write(offset, data):
+            if not data:
+                return
+            if offset > 2**32:
+                raise Halt()
+            mem_expand(offset, len(data))
+            mem[offset : offset + len(data)] = data
+
+        while pc < len(code):
+            op = code[pc]
+            pc += 1
+            # PUSH0..PUSH32
+            if 0x5F <= op <= 0x7F:
+                n = op - 0x5F
+                use(2 if n == 0 else 3)
+                if len(stack) >= 1024:
+                    raise Halt()
+                push(int.from_bytes(code[pc : pc + n], "big"))
+                pc += n
+                continue
+            # DUP1..DUP16
+            if 0x80 <= op <= 0x8F:
+                use(3)
+                i = op - 0x7F
+                if len(stack) < i or len(stack) >= 1024:
+                    raise Halt()
+                push(stack[-i])
+                continue
+            # SWAP1..SWAP16
+            if 0x90 <= op <= 0x9F:
+                use(3)
+                i = op - 0x8F
+                if len(stack) < i + 1:
+                    raise Halt()
+                stack[-1], stack[-i - 1] = stack[-i - 1], stack[-1]
+                continue
+
+            if op == 0x00:  # STOP
+                return gas, b""
+            elif op == 0x01:  # ADD
+                use(3); a, b = pop(), pop(); push((a + b) & MASK)
+            elif op == 0x02:  # MUL
+                use(5); a, b = pop(), pop(); push((a * b) & MASK)
+            elif op == 0x03:  # SUB
+                use(3); a, b = pop(), pop(); push((a - b) & MASK)
+            elif op == 0x04:  # DIV
+                use(5); a, b = pop(), pop(); push(a // b if b else 0)
+            elif op == 0x05:  # SDIV
+                use(5); a, b = _sgn(pop()), _sgn(pop())
+                if b == 0:
+                    push(0)
+                else:
+                    q = abs(a) // abs(b)
+                    push((q if (a < 0) == (b < 0) else -q) & MASK)
+            elif op == 0x06:  # MOD
+                use(5); a, b = pop(), pop(); push(a % b if b else 0)
+            elif op == 0x07:  # SMOD
+                use(5); a, b = _sgn(pop()), _sgn(pop())
+                if b == 0:
+                    push(0)
+                else:
+                    r = abs(a) % abs(b)
+                    push((-r if a < 0 else r) & MASK)
+            elif op == 0x08:  # ADDMOD
+                use(8); a, b, n = pop(), pop(), pop(); push((a + b) % n if n else 0)
+            elif op == 0x09:  # MULMOD
+                use(8); a, b, n = pop(), pop(), pop(); push((a * b) % n if n else 0)
+            elif op == 0x0A:  # EXP
+                a, e = pop(), pop()
+                use(10 + G_EXP_BYTE * ((e.bit_length() + 7) // 8))
+                push(pow(a, e, U256))
+            elif op == 0x0B:  # SIGNEXTEND
+                use(5); b, x = pop(), pop()
+                if b < 31:
+                    bit = 8 * (b + 1) - 1
+                    if x & (1 << bit):
+                        x |= MASK ^ ((1 << (bit + 1)) - 1)
+                    else:
+                        x &= (1 << (bit + 1)) - 1
+                push(x & MASK)
+            elif op == 0x10:  # LT
+                use(3); push(1 if pop() < pop() else 0)
+            elif op == 0x11:  # GT
+                use(3); push(1 if pop() > pop() else 0)
+            elif op == 0x12:  # SLT
+                use(3); push(1 if _sgn(pop()) < _sgn(pop()) else 0)
+            elif op == 0x13:  # SGT
+                use(3); push(1 if _sgn(pop()) > _sgn(pop()) else 0)
+            elif op == 0x14:  # EQ
+                use(3); push(1 if pop() == pop() else 0)
+            elif op == 0x15:  # ISZERO
+                use(3); push(1 if pop() == 0 else 0)
+            elif op == 0x16:  # AND
+                use(3); push(pop() & pop())
+            elif op == 0x17:  # OR
+                use(3); push(pop() | pop())
+            elif op == 0x18:  # XOR
+                use(3); push(pop() ^ pop())
+            elif op == 0x19:  # NOT
+                use(3); push(pop() ^ MASK)
+            elif op == 0x1A:  # BYTE
+                use(3); i, x = pop(), pop()
+                push((x >> (8 * (31 - i))) & 0xFF if i < 32 else 0)
+            elif op == 0x1B:  # SHL
+                use(3); s, x = pop(), pop(); push((x << s) & MASK if s < 256 else 0)
+            elif op == 0x1C:  # SHR
+                use(3); s, x = pop(), pop(); push(x >> s if s < 256 else 0)
+            elif op == 0x1D:  # SAR
+                use(3); s, x = pop(), _sgn(pop())
+                push((x >> s if s < 256 else (0 if x >= 0 else MASK)) & MASK)
+            elif op == 0x20:  # KECCAK256
+                off, size = pop(), pop()
+                use(G_KECCAK + G_KECCAK_WORD * ((size + 31) // 32))
+                push(int.from_bytes(keccak256(mem_read(off, size)), "big"))
+            elif op == 0x30:  # ADDRESS
+                use(2); push(int.from_bytes(fr.address, "big"))
+            elif op == 0x31:  # BALANCE
+                addr = pop().to_bytes(32, "big")[12:]
+                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                push(state.balance(addr))
+            elif op == 0x32:  # ORIGIN
+                use(2); push(int.from_bytes(self.tx.origin, "big"))
+            elif op == 0x33:  # CALLER
+                use(2); push(int.from_bytes(fr.caller, "big"))
+            elif op == 0x34:  # CALLVALUE
+                use(2); push(fr.value)
+            elif op == 0x35:  # CALLDATALOAD
+                use(3); i = pop()
+                push(int.from_bytes(fr.data[i : i + 32].ljust(32, b"\x00"), "big") if i < len(fr.data) else 0)
+            elif op == 0x36:  # CALLDATASIZE
+                use(2); push(len(fr.data))
+            elif op == 0x37:  # CALLDATACOPY
+                d, s, size = pop(), pop(), pop()
+                use(3 + G_COPY_WORD * ((size + 31) // 32))
+                mem_write(d, fr.data[s : s + size].ljust(size, b"\x00") if s < len(fr.data) else b"\x00" * size)
+            elif op == 0x38:  # CODESIZE
+                use(2); push(len(code))
+            elif op == 0x39:  # CODECOPY
+                d, s, size = pop(), pop(), pop()
+                use(3 + G_COPY_WORD * ((size + 31) // 32))
+                mem_write(d, code[s : s + size].ljust(size, b"\x00") if s < len(code) else b"\x00" * size)
+            elif op == 0x3A:  # GASPRICE
+                use(2); push(self.tx.gas_price)
+            elif op == 0x3B:  # EXTCODESIZE
+                addr = pop().to_bytes(32, "big")[12:]
+                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                push(len(state.code(addr)))
+            elif op == 0x3C:  # EXTCODECOPY
+                addr = pop().to_bytes(32, "big")[12:]
+                d, s, size = pop(), pop(), pop()
+                use((G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                    + G_COPY_WORD * ((size + 31) // 32))
+                ext = state.code(addr)
+                mem_write(d, ext[s : s + size].ljust(size, b"\x00") if s < len(ext) else b"\x00" * size)
+            elif op == 0x3D:  # RETURNDATASIZE
+                use(2); push(len(returndata))
+            elif op == 0x3E:  # RETURNDATACOPY
+                d, s, size = pop(), pop(), pop()
+                use(3 + G_COPY_WORD * ((size + 31) // 32))
+                if s + size > len(returndata):
+                    raise Halt()
+                mem_write(d, returndata[s : s + size])
+            elif op == 0x3F:  # EXTCODEHASH
+                addr = pop().to_bytes(32, "big")[12:]
+                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                acc = state.account(addr)
+                push(0 if acc is None or acc.is_empty else int.from_bytes(acc.code_hash, "big"))
+            elif op == 0x40:  # BLOCKHASH
+                use(20); n = pop()
+                h = self.block.block_hashes.get(n, b"")
+                push(int.from_bytes(h, "big") if h else 0)
+            elif op == 0x41:  # COINBASE
+                use(2); push(int.from_bytes(self.block.coinbase, "big"))
+            elif op == 0x42:  # TIMESTAMP
+                use(2); push(self.block.timestamp)
+            elif op == 0x43:  # NUMBER
+                use(2); push(self.block.number)
+            elif op == 0x44:  # PREVRANDAO
+                use(2); push(int.from_bytes(self.block.prev_randao, "big"))
+            elif op == 0x45:  # GASLIMIT
+                use(2); push(self.block.gas_limit)
+            elif op == 0x46:  # CHAINID
+                use(2); push(self.block.chain_id)
+            elif op == 0x47:  # SELFBALANCE
+                use(5); push(state.balance(fr.address))
+            elif op == 0x48:  # BASEFEE
+                use(2); push(self.block.base_fee)
+            elif op == 0x49:  # BLOBHASH
+                use(3); i = pop()
+                push(int.from_bytes(self.tx.blob_hashes[i], "big") if i < len(self.tx.blob_hashes) else 0)
+            elif op == 0x4A:  # BLOBBASEFEE
+                use(2); push(self.block.blob_base_fee)
+            elif op == 0x50:  # POP
+                use(2); pop()
+            elif op == 0x51:  # MLOAD
+                use(3); off = pop(); push(int.from_bytes(mem_read(off, 32), "big"))
+            elif op == 0x52:  # MSTORE
+                use(3); off, v = pop(), pop(); mem_write(off, v.to_bytes(32, "big"))
+            elif op == 0x53:  # MSTORE8
+                use(3); off, v = pop(), pop(); mem_write(off, bytes([v & 0xFF]))
+            elif op == 0x54:  # SLOAD
+                slot = pop().to_bytes(32, "big")
+                use(G_WARM_ACCESS if state.warm_slot(fr.address, slot) else G_COLD_SLOAD)
+                push(state.sload(fr.address, slot))
+            elif op == 0x55:  # SSTORE
+                if fr.static:
+                    raise Halt()
+                if gas <= G_CALL_STIPEND:
+                    raise Halt()
+                slot, value = pop().to_bytes(32, "big"), pop()
+                cold = not state.warm_slot(fr.address, slot)
+                current = state.sload(fr.address, slot)
+                original = state.original_storage(fr.address, slot)
+                cost = G_COLD_SLOAD if cold else 0
+                if value == current:
+                    cost += G_WARM_ACCESS
+                elif current == original:
+                    cost += G_SSTORE_SET if original == 0 else G_SSTORE_RESET
+                else:
+                    cost += G_WARM_ACCESS
+                use(cost)
+                # EIP-3529 refunds
+                if value != current:
+                    if current == original:
+                        if original != 0 and value == 0:
+                            state.add_refund(R_SSTORE_CLEAR)
+                    else:
+                        if original != 0:
+                            if current == 0:
+                                state.add_refund(-R_SSTORE_CLEAR)
+                            elif value == 0:
+                                state.add_refund(R_SSTORE_CLEAR)
+                        if value == original:
+                            if original == 0:
+                                state.add_refund(G_SSTORE_SET - G_WARM_ACCESS)
+                            else:
+                                state.add_refund(G_SSTORE_RESET - G_WARM_ACCESS)
+                    state.sstore(fr.address, slot, value)
+            elif op == 0x56:  # JUMP
+                use(8); dest = pop()
+                if dest not in jumpdests:
+                    raise Halt()
+                pc = dest
+            elif op == 0x57:  # JUMPI
+                use(10); dest, cond = pop(), pop()
+                if cond:
+                    if dest not in jumpdests:
+                        raise Halt()
+                    pc = dest
+            elif op == 0x58:  # PC
+                use(2); push(pc - 1)
+            elif op == 0x59:  # MSIZE
+                use(2); push(len(mem))
+            elif op == 0x5A:  # GAS
+                use(2); push(gas)
+            elif op == 0x5B:  # JUMPDEST
+                use(1)
+            elif op == 0x5C:  # TLOAD
+                use(100); slot = pop().to_bytes(32, "big")
+                push(self.transient.get((fr.address, slot), 0))
+            elif op == 0x5D:  # TSTORE
+                if fr.static:
+                    raise Halt()
+                use(100); slot, v = pop().to_bytes(32, "big"), pop()
+                self.transient[(fr.address, slot)] = v
+            elif op == 0x5E:  # MCOPY
+                d, s, size = pop(), pop(), pop()
+                use(3 + G_COPY_WORD * ((size + 31) // 32))
+                data = mem_read(s, size)
+                mem_write(d, data)
+            elif 0xA0 <= op <= 0xA4:  # LOG0..LOG4
+                if fr.static:
+                    raise Halt()
+                n = op - 0xA0
+                off, size = pop(), pop()
+                topics = tuple(pop().to_bytes(32, "big") for _ in range(n))
+                use(G_LOG + G_LOG_TOPIC * n + G_LOG_BYTE * size)
+                data = mem_read(off, size)
+                from ..primitives.types import Log
+
+                state.add_log(Log(fr.address, topics, data))
+            elif op == 0xF0 or op == 0xF5:  # CREATE / CREATE2
+                if fr.static:
+                    raise Halt()
+                value = pop(); off = pop(); size = pop()
+                salt = pop().to_bytes(32, "big") if op == 0xF5 else None
+                words = (size + 31) // 32
+                use(G_CREATE + G_INITCODE_WORD * words
+                    + (G_KECCAK_WORD * words if op == 0xF5 else 0))
+                if size > MAX_INITCODE_SIZE:
+                    raise Halt()
+                initcode = mem_read(off, size)
+                child_gas = gas - gas // 64
+                use(child_gas)
+                ok, gas_left, addr, out = self.create(
+                    fr.address, value, initcode, child_gas, fr.depth + 1, salt
+                )
+                gas += gas_left
+                returndata = out
+                push(int.from_bytes(addr, "big") if ok else 0)
+            elif op in (0xF1, 0xF2, 0xF4, 0xFA):  # CALL/CALLCODE/DELEGATECALL/STATICCALL
+                g = pop()
+                addr = pop().to_bytes(32, "big")[12:]
+                value = pop() if op in (0xF1, 0xF2) else 0
+                ain, ains, aout, aouts = pop(), pop(), pop(), pop()
+                if fr.static and value and op == 0xF1:
+                    raise Halt()
+                access = G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT
+                extra = access
+                if value:
+                    extra += G_CALL_VALUE
+                    if op == 0xF1 and not state.exists(addr):
+                        extra += G_NEW_ACCOUNT
+                use(extra)
+                data = mem_read(ain, ains)
+                mem_expand(aout, aouts)
+                avail = gas - gas // 64
+                child_gas = min(g, avail)
+                use(child_gas)
+                if value:
+                    child_gas += G_CALL_STIPEND
+                if op == 0xF1:  # CALL
+                    sub = CallFrame(fr.address, addr, state.code(addr), data, value,
+                                    child_gas, fr.static, fr.depth + 1)
+                elif op == 0xF2:  # CALLCODE
+                    sub = CallFrame(fr.address, fr.address, state.code(addr), data,
+                                    value, child_gas, fr.static, fr.depth + 1)
+                elif op == 0xF4:  # DELEGATECALL: parent's value/caller, NO transfer
+                    sub = CallFrame(fr.caller, fr.address, state.code(addr), data,
+                                    fr.value, child_gas, fr.static, fr.depth + 1,
+                                    transfer_value=False)
+                else:  # STATICCALL
+                    sub = CallFrame(fr.address, addr, state.code(addr), data, 0,
+                                    child_gas, True, fr.depth + 1)
+                try:
+                    ok, gas_left, out = self.call(sub)
+                except Revert as r:
+                    # child reverted: its unused gas comes back, output exposed
+                    ok, out = False, r.output
+                    gas_left = getattr(r, "gas_left", 0)
+                gas += gas_left
+                returndata = out
+                mem[aout : aout + min(aouts, len(out))] = out[: aouts]
+                push(1 if ok else 0)
+            elif op == 0xF3:  # RETURN
+                off, size = pop(), pop()
+                return gas, mem_read(off, size)
+            elif op == 0xFD:  # REVERT
+                off, size = pop(), pop()
+                r = Revert(mem_read(off, size))
+                r.gas_left = gas
+                raise r
+            elif op == 0xFE:  # INVALID
+                raise Halt()
+            elif op == 0xFF:  # SELFDESTRUCT
+                if fr.static:
+                    raise Halt()
+                ben = pop().to_bytes(32, "big")[12:]
+                cost = G_SELFDESTRUCT
+                if not state.warm_account(ben):
+                    cost += G_COLD_ACCOUNT
+                if state.balance(fr.address) and not state.exists(ben):
+                    cost += G_NEW_ACCOUNT
+                use(cost)
+                state.selfdestruct(fr.address, ben)
+                return gas, b""
+            else:
+                raise Halt()
+        return gas, b""
+
+
+def _sgn(x: int) -> int:
+    return x - U256 if x & SIGN_BIT else x
+
+
+def _jumpdests(code: bytes) -> set[int]:
+    dests = set()
+    i = 0
+    n = len(code)
+    while i < n:
+        op = code[i]
+        if op == 0x5B:
+            dests.add(i)
+        if 0x60 <= op <= 0x7F:
+            i += op - 0x5F
+        i += 1
+    return dests
+
+
+# -- precompiles --------------------------------------------------------------
+
+
+def _pre_ecrecover(data: bytes, gas: int):
+    if gas < 3000:
+        return False, 0, b""
+    gas -= 3000
+    data = data.ljust(128, b"\x00")[:128]
+    h = data[:32]
+    v = int.from_bytes(data[32:64], "big")
+    r = int.from_bytes(data[64:96], "big")
+    s = int.from_bytes(data[96:128], "big")
+    if v not in (27, 28):
+        return True, gas, b""
+    from ..primitives.secp256k1 import ecrecover
+
+    try:
+        addr = ecrecover(h, v - 27, r, s, allow_high_s=True)
+    except ValueError:
+        return True, gas, b""
+    return True, gas, addr.rjust(32, b"\x00")
+
+
+def _pre_sha256(data: bytes, gas: int):
+    cost = 60 + 12 * ((len(data) + 31) // 32)
+    if gas < cost:
+        return False, 0, b""
+    return True, gas - cost, hashlib.sha256(data).digest()
+
+
+def _pre_ripemd160(data: bytes, gas: int):
+    cost = 600 + 120 * ((len(data) + 31) // 32)
+    if gas < cost:
+        return False, 0, b""
+    try:
+        h = hashlib.new("ripemd160", data).digest()
+    except ValueError:
+        return False, 0, b""
+    return True, gas - cost, h.rjust(32, b"\x00")
+
+
+def _pre_identity(data: bytes, gas: int):
+    cost = 15 + 3 * ((len(data) + 31) // 32)
+    if gas < cost:
+        return False, 0, b""
+    return True, gas - cost, data
+
+
+def _pre_modexp(data: bytes, gas: int):
+    data = bytes(data)
+    bl = int.from_bytes(data[0:32].ljust(32, b"\x00"), "big")
+    el = int.from_bytes(data[32:64].ljust(32, b"\x00"), "big")
+    ml = int.from_bytes(data[64:96].ljust(32, b"\x00"), "big")
+    if bl > 4096 or el > 4096 or ml > 4096:
+        return False, 0, b""
+    body = data[96:].ljust(bl + el + ml, b"\x00")
+    b_ = int.from_bytes(body[:bl], "big")
+    e_ = int.from_bytes(body[bl : bl + el], "big")
+    m_ = int.from_bytes(body[bl + el : bl + el + ml], "big")
+    # EIP-2565 pricing
+    words = (max(bl, ml) + 7) // 8
+    mult = words * words
+    iters = max(1, (el - 32) * 8 + (e_.bit_length() - 1 if el <= 32 and e_ else 0)) if el > 32 else max(1, e_.bit_length() - 1 if e_ else 0)
+    cost = max(200, mult * iters // 3)
+    if gas < cost:
+        return False, 0, b""
+    out = pow(b_, e_, m_).to_bytes(ml, "big") if m_ else b"\x00" * ml
+    return True, gas - cost, out
+
+
+_PRECOMPILES = {
+    1: _pre_ecrecover,
+    2: _pre_sha256,
+    3: _pre_ripemd160,
+    4: _pre_identity,
+    5: _pre_modexp,
+}
+
+
+def _precompile(address: bytes):
+    if address[:19] == b"\x00" * 19 and 1 <= address[19] <= 10:
+        return _PRECOMPILES.get(address[19])
+    return None

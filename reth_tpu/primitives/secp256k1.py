@@ -141,16 +141,18 @@ def sign(msg_hash: bytes, priv: int) -> tuple[int, int, int]:
     raise AssertionError("unreachable: RFC-6979 generator is infinite")
 
 
-def ecrecover(msg_hash: bytes, y_parity: int, r: int, s: int) -> bytes:
+def ecrecover(msg_hash: bytes, y_parity: int, r: int, s: int,
+              allow_high_s: bool = False) -> bytes:
     """Recover the signer's address from a signature.
 
     Raises ValueError on invalid signatures (reference rejects these during
-    sender recovery and tx validation).
+    sender recovery and tx validation). ``allow_high_s`` relaxes the EIP-2
+    low-s rule for the ecrecover PRECOMPILE, which accepts any s in range.
     """
     if not (1 <= r < N and 1 <= s < N):
         raise ValueError("signature out of range")
     # EIP-2 (homestead): high-s signatures are invalid for tx senders.
-    if s > N // 2:
+    if s > N // 2 and not allow_high_s:
         raise ValueError("high-s signature")
     x = r
     y_sq = (pow(x, 3, P) + 7) % P

@@ -1,0 +1,50 @@
+"""Staged sync: the pipeline and its stages.
+
+Reference analogue: crates/stages — `Stage` trait
+(api/src/stage.rs:241), `Pipeline` (api/src/pipeline/mod.rs:69), stage
+implementations (stages/src/stages/), `DefaultStages` ordering
+(stages/src/sets.rs:85; id ordering types/src/id.rs:46-58).
+"""
+
+from .api import ExecInput, ExecOutput, Pipeline, Stage, StageError, UnwindInput
+from .execution import ExecutionStage
+from .sender_recovery import SenderRecoveryStage
+from .hashing import AccountHashingStage, StorageHashingStage
+from .merkle import MerkleStage, MerkleUnwindStage
+from .tx_lookup import TransactionLookupStage
+from .finish import FinishStage
+
+
+def default_stages(committer=None, consensus=None) -> list[Stage]:
+    """Offline stage set (headers/bodies come from import; reference
+    `OfflineStages`, stages/src/sets.rs:302; MerkleUnwind placement per
+    id.rs:46-58 so unwind order is correct)."""
+    return [
+        SenderRecoveryStage(),
+        ExecutionStage(consensus=consensus),
+        MerkleUnwindStage(committer=committer),
+        AccountHashingStage(committer=committer),
+        StorageHashingStage(committer=committer),
+        MerkleStage(committer=committer),
+        TransactionLookupStage(),
+        FinishStage(),
+    ]
+
+
+__all__ = [
+    "ExecInput",
+    "ExecOutput",
+    "Pipeline",
+    "Stage",
+    "StageError",
+    "UnwindInput",
+    "ExecutionStage",
+    "SenderRecoveryStage",
+    "AccountHashingStage",
+    "StorageHashingStage",
+    "MerkleStage",
+    "MerkleUnwindStage",
+    "TransactionLookupStage",
+    "FinishStage",
+    "default_stages",
+]

@@ -1,0 +1,16 @@
+"""FinishStage (reference crates/stages/stages/src/stages/finish.rs)."""
+
+from __future__ import annotations
+
+from ..storage.provider import DatabaseProvider
+from .api import ExecInput, ExecOutput, Stage, UnwindInput
+
+
+class FinishStage(Stage):
+    id = "Finish"
+
+    def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
+        return ExecOutput(checkpoint=inp.target)
+
+    def unwind(self, provider: DatabaseProvider, inp: UnwindInput) -> None:
+        return None

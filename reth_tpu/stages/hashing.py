@@ -1,0 +1,92 @@
+"""Account/Storage hashing stages: plain state → hashed tables.
+
+Reference analogue: `AccountHashingStage` (keccak256(address), rayon
+chunks + ETL — crates/stages/stages/src/stages/hashing_account.rs:37) and
+`StorageHashingStage` (hashing_storage.rs:133-137). TPU-first: the keccak
+work is ONE batched device dispatch per commit chunk instead of CPU worker
+chunks — this is benchmark config #3 (BASELINE.md).
+
+Clean path (first sync): scan the whole plain table, batch-hash every
+key. Incremental path: only keys in the range's changesets.
+"""
+
+from __future__ import annotations
+
+from ..storage.provider import DatabaseProvider
+from ..storage.tables import Tables, decode_account, decode_storage_entry
+from ..trie.committer import TrieCommitter
+from .api import ExecInput, ExecOutput, Stage, UnwindInput
+
+
+class AccountHashingStage(Stage):
+    id = "AccountHashing"
+
+    def __init__(self, committer: TrieCommitter | None = None, clean_threshold: int = 100_000):
+        self.hasher = (committer or TrieCommitter()).hasher
+        self.clean_threshold = clean_threshold
+
+    def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
+        if inp.checkpoint == 0 or inp.target - inp.checkpoint > self.clean_threshold:
+            # clean rebuild: hash every plain account key in one batch
+            provider.tx.clear(Tables.HashedAccounts.name)
+            entries = list(provider.tx.cursor(Tables.PlainAccountState.name).walk())
+            hashed = self.hasher([k for k, _ in entries])
+            for (addr, value), haddr in zip(entries, hashed):
+                provider.tx.put(Tables.HashedAccounts.name, haddr, value)
+        else:
+            changed = provider.account_changes_in_range(inp.next_block, inp.target)
+            addrs = sorted(changed.keys())
+            hashed = self.hasher(addrs)
+            for addr, haddr in zip(addrs, hashed):
+                acc = provider.account(addr)
+                provider.put_hashed_account(haddr, acc)
+        return ExecOutput(checkpoint=inp.target)
+
+    def unwind(self, provider: DatabaseProvider, inp: UnwindInput) -> None:
+        # Restore hashed accounts from changeset PREV-IMAGES directly: plain
+        # state is unwound later (ExecutionStage is after us in unwind order).
+        changed = provider.account_changes_in_range(inp.unwind_to + 1, inp.checkpoint)
+        addrs = sorted(changed.keys())
+        hashed = self.hasher(addrs)
+        for addr, haddr in zip(addrs, hashed):
+            provider.put_hashed_account(haddr, changed[addr])
+
+
+class StorageHashingStage(Stage):
+    id = "StorageHashing"
+
+    def __init__(self, committer: TrieCommitter | None = None, clean_threshold: int = 100_000):
+        self.hasher = (committer or TrieCommitter()).hasher
+        self.clean_threshold = clean_threshold
+
+    def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
+        if inp.checkpoint == 0 or inp.target - inp.checkpoint > self.clean_threshold:
+            provider.tx.clear(Tables.HashedStorages.name)
+            jobs: list[tuple[bytes, bytes, int]] = []  # (addr, slot, value)
+            for addr, dup in provider.tx.cursor(Tables.PlainStorageState.name).walk():
+                slot, value = decode_storage_entry(dup)
+                jobs.append((addr, slot, value))
+            digests = self.hasher([a for a, _, _ in jobs] + [s for _, s, _ in jobs])
+            n = len(jobs)
+            for (addr, slot, value), haddr, hslot in zip(jobs, digests[:n], digests[n:]):
+                provider.put_hashed_storage(haddr, hslot, value)
+        else:
+            changed = provider.storage_changes_in_range(inp.next_block, inp.target)
+            self._apply_changed(provider, changed, use_prev_images=False)
+        return ExecOutput(checkpoint=inp.target)
+
+    def _apply_changed(self, provider: DatabaseProvider, changed, use_prev_images: bool) -> None:
+        pairs: list[tuple[bytes, bytes]] = [
+            (addr, slot) for addr, slots in changed.items() for slot in slots
+        ]
+        addrs = sorted({a for a, _ in pairs})
+        digests = self.hasher(addrs + [s for _, s in pairs])
+        haddr_of = dict(zip(addrs, digests[: len(addrs)]))
+        for (addr, slot), hslot in zip(pairs, digests[len(addrs) :]):
+            value = changed[addr][slot] if use_prev_images else provider.storage(addr, slot)
+            provider.put_hashed_storage(haddr_of[addr], hslot, value)
+
+    def unwind(self, provider: DatabaseProvider, inp: UnwindInput) -> None:
+        # prev-images ARE the post-unwind values (plain state unwinds later)
+        changed = provider.storage_changes_in_range(inp.unwind_to + 1, inp.checkpoint)
+        self._apply_changed(provider, changed, use_prev_images=True)

@@ -1,0 +1,106 @@
+"""MerkleStage: state root from hashed tables, validated against headers.
+
+Reference analogue: `MerkleStage`
+(crates/stages/stages/src/stages/merkle.rs:80): full rebuild above
+`rebuild_threshold` (clear trie tables, recompute everything — the
+PRIMARY TPU benchmark target), incremental below it via changesets +
+prefix sets. Root must match the target header's state root
+(merkle.rs:343-358, INVALID_STATE_ROOT_ERROR_MESSAGE analogue).
+"""
+
+from __future__ import annotations
+
+from ..storage.provider import DatabaseProvider
+from ..trie.committer import TrieCommitter
+from ..trie.incremental import IncrementalStateRoot, full_state_root
+from .api import ExecInput, ExecOutput, Stage, StageError, UnwindInput
+
+INVALID_STATE_ROOT = (
+    "state root mismatch — this is a bug in execution/trie code or corrupt input"
+)
+
+
+class MerkleStage(Stage):
+    id = "MerkleExecute"
+
+    def __init__(self, committer: TrieCommitter | None = None, rebuild_threshold: int = 50_000):
+        self.committer = committer or TrieCommitter()
+        self.rebuild_threshold = rebuild_threshold
+
+    def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
+        if inp.checkpoint == 0 or inp.target - inp.checkpoint > self.rebuild_threshold:
+            root = full_state_root(provider, self.committer)
+        else:
+            root = self._incremental(provider, inp.next_block, inp.target)
+        header = provider.header_by_number(inp.target)
+        if header is None:
+            raise StageError(f"missing header {inp.target}", block=inp.target)
+        if root != header.state_root:
+            raise StageError(
+                f"{INVALID_STATE_ROOT}: got {root.hex()} want "
+                f"{header.state_root.hex()} at block {inp.target}",
+                block=inp.target,
+            )
+        return ExecOutput(checkpoint=inp.target)
+
+    def _incremental(self, provider: DatabaseProvider, start: int, end: int,
+                     unwinding: bool = False) -> bytes:
+        account_changes = provider.account_changes_in_range(start, end)
+        changed_storages_plain = provider.storage_changes_in_range(start, end)
+        # hash all changed keys in one batch
+        addrs = sorted(set(account_changes) | set(changed_storages_plain.keys()))
+        slot_pairs = [
+            (a, s) for a, slots in changed_storages_plain.items() for s in slots
+        ]
+        digests = self.committer.hasher(addrs + [s for _, s in slot_pairs])
+        haddr = dict(zip(addrs, digests[: len(addrs)]))
+        changed_hashed_accounts = {haddr[a] for a in account_changes}
+        changed_hashed_storages: dict[bytes, set[bytes]] = {}
+        for (a, _s), hs in zip(slot_pairs, digests[len(addrs) :]):
+            changed_hashed_storages.setdefault(haddr[a], set()).add(hs)
+        if unwinding:
+            # post-unwind existence = changeset prev-image (plain state is
+            # reverted AFTER this stage in unwind order)
+            wiped = {
+                haddr[a]
+                for a in changed_storages_plain
+                if account_changes.get(a, provider.account(a)) is None
+            }
+        else:
+            wiped = {
+                haddr[a] for a in changed_storages_plain if provider.account(a) is None
+            }
+        inc = IncrementalStateRoot(provider, self.committer)
+        return inc.compute(changed_hashed_accounts, changed_hashed_storages, wiped)
+
+    def unwind(self, provider: DatabaseProvider, inp: UnwindInput) -> None:
+        # no-op: the recompute happens in MerkleUnwindStage, which sits
+        # BEFORE the hashing stages in forward order so that on unwind it
+        # runs AFTER they have reverted the hashed tables (the reference's
+        # MerkleUnwind/MerkleExecute placeholder split, id.rs:46-58).
+        return None
+
+
+class MerkleUnwindStage(Stage):
+    """Placeholder stage owning the unwind-side trie recompute."""
+
+    id = "MerkleUnwind"
+
+    def __init__(self, committer: TrieCommitter | None = None):
+        self.committer = committer or TrieCommitter()
+
+    def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
+        return ExecOutput(checkpoint=inp.target)  # forward no-op
+
+    def unwind(self, provider: DatabaseProvider, inp: UnwindInput) -> None:
+        if inp.unwind_to == 0:
+            provider.clear_trie_tables()
+            return
+        stage = MerkleStage(self.committer)
+        root = stage._incremental(provider, inp.unwind_to + 1, inp.checkpoint, unwinding=True)
+        header = provider.header_by_number(inp.unwind_to)
+        if header is not None and root != header.state_root:
+            raise StageError(
+                f"unwind {INVALID_STATE_ROOT}: got {root.hex()} at block {inp.unwind_to}",
+                block=inp.unwind_to,
+            )

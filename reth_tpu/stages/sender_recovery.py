@@ -1,0 +1,44 @@
+"""SenderRecoveryStage: ecrecover for every tx in the range.
+
+Reference analogue: `SenderRecoveryStage`
+(crates/stages/stages/src/stages/sender_recovery.rs) — rayon-parallel
+ecrecover into TransactionSenders. Host-side here (pure-Python secp256k1
+for now; the native C++ batch path is a later milestone — this stage is
+the seam where it plugs in).
+"""
+
+from __future__ import annotations
+
+from ..storage.provider import DatabaseProvider
+from ..storage.tables import Tables, be64
+from .api import ExecInput, ExecOutput, Stage, StageError, UnwindInput
+
+
+class SenderRecoveryStage(Stage):
+    id = "SenderRecovery"
+
+    def __init__(self, max_blocks_per_commit: int = 5000):
+        self.max_blocks = max_blocks_per_commit
+
+    def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
+        end = min(inp.target, inp.checkpoint + self.max_blocks)
+        for n in range(inp.next_block, end + 1):
+            idx = provider.block_body_indices(n)
+            if idx is None:
+                raise StageError(f"missing body indices for block {n}", block=n)
+            txs = provider.transactions_by_block(n) or []
+            for i, tx in enumerate(txs):
+                try:
+                    sender = tx.recover_sender()
+                except ValueError as e:
+                    raise StageError(f"invalid signature in block {n}: {e}", block=n)
+                provider.put_sender(idx.first_tx_num + i, sender)
+        return ExecOutput(checkpoint=end, done=end >= inp.target)
+
+    def unwind(self, provider: DatabaseProvider, inp: UnwindInput) -> None:
+        idx = provider.block_body_indices(inp.unwind_to)
+        next_tx = idx.next_tx_num if idx else 0
+        cur = provider.tx.cursor(Tables.TransactionSenders.name)
+        doomed = [k for k, _ in cur.walk(be64(next_tx))]
+        for k in doomed:
+            provider.tx.delete(Tables.TransactionSenders.name, k)

@@ -257,6 +257,26 @@ class DatabaseProvider:
             out.setdefault(addr, {}).setdefault(slot, prev)
         return out
 
+    def prune_changesets_above(self, block: int):
+        """Drop changeset rows for blocks > ``block`` (unwind cleanup)."""
+        cur = self.tx.cursor(Tables.AccountChangeSets.name)
+        doomed = [k for k, _ in cur.walk(be64(block + 1))]
+        for k in set(doomed):
+            self.tx.delete(Tables.AccountChangeSets.name, k)
+        cur = self.tx.cursor(Tables.StorageChangeSets.name)
+        doomed = [k for k, _ in cur.walk(be64(block + 1))]
+        for k in set(doomed):
+            self.tx.delete(Tables.StorageChangeSets.name, k)
+
+    def prune_receipts_above(self, block: int):
+        idx = self.block_body_indices(block)
+        if idx is None:
+            return
+        cur = self.tx.cursor(Tables.Receipts.name)
+        doomed = [k for k, _ in cur.walk(be64(idx.next_tx_num))]
+        for k in doomed:
+            self.tx.delete(Tables.Receipts.name, k)
+
     # -- hashed state ----------------------------------------------------------
 
     def put_hashed_account(

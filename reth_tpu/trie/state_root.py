@@ -18,6 +18,22 @@ from ..primitives.types import Account, EMPTY_ROOT_HASH
 from .committer import TrieCommitter, TrieBuildResult
 
 
+def ordered_trie_root(items: list[bytes], committer: TrieCommitter | None = None) -> bytes:
+    """Root of an index-keyed trie (transactions/receipts/withdrawals roots).
+
+    Keys are rlp(index) — the yellow-paper ordered trie. Reference:
+    alloy-consensus `proofs::ordered_trie_root`.
+    """
+    if not items:
+        return EMPTY_ROOT_HASH
+    committer = committer or TrieCommitter()
+    leaves = [
+        (unpack_nibbles(rlp_encode(encode_int(i))), item)
+        for i, item in enumerate(items)
+    ]
+    return committer.commit(leaves, collect_branches=False).root
+
+
 def storage_root(slots: dict[bytes, int], committer: TrieCommitter | None = None) -> bytes:
     """Root of one account's storage trie. ``slots``: 32-byte slot → value."""
     committer = committer or TrieCommitter()

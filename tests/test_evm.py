@@ -1,0 +1,298 @@
+"""EVM tests: transfers, contract lifecycle, storage, reverts, gas."""
+
+import pytest
+
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256
+from reth_tpu.primitives import secp256k1
+from reth_tpu.primitives.types import Block, Header, Transaction
+from reth_tpu.evm import BlockExecutor, EvmConfig
+from reth_tpu.evm.executor import InMemoryStateSource, InvalidTransaction, intrinsic_gas
+from reth_tpu.evm.interpreter import BlockEnv, CallFrame, Interpreter, TxEnv
+from reth_tpu.evm.state import EvmState
+
+ALICE_KEY = 0xA11CE
+ALICE = secp256k1.address_from_priv(ALICE_KEY)
+BOB = b"\x0b" * 20
+COINBASE = b"\xc0" * 20
+
+
+def signed_tx(**kw):
+    priv = kw.pop("priv", ALICE_KEY)
+    defaults = dict(tx_type=2, chain_id=1, nonce=0, max_fee_per_gas=10,
+                    max_priority_fee_per_gas=2, gas_limit=21000, to=BOB, value=1000)
+    defaults.update(kw)
+    tx = Transaction(**defaults)
+    p, r, s = secp256k1.sign(tx.signing_hash(), priv)
+    return Transaction(**{**tx.__dict__, "y_parity": p, "r": r, "s": s})
+
+
+def make_block(txs, **hdr):
+    defaults = dict(number=1, base_fee_per_gas=7, gas_limit=30_000_000, timestamp=1000)
+    defaults.update(hdr)
+    return Block(Header(beneficiary=COINBASE, **defaults), tuple(txs))
+
+
+def rich_source(balance=10**18):
+    return InMemoryStateSource({ALICE: Account(balance=balance)})
+
+
+def test_simple_transfer():
+    src = rich_source()
+    tx = signed_tx()
+    out = BlockExecutor(src).execute(make_block([tx]))
+    assert out.receipts[0].success
+    assert out.gas_used == 21000
+    assert out.post_accounts[BOB].balance == 1000
+    # alice: -value -gas*effective_price (base 7 + prio 2 = 9)
+    assert out.post_accounts[ALICE].balance == 10**18 - 1000 - 21000 * 9
+    # coinbase gets priority fee only
+    assert out.post_accounts[COINBASE].balance == 21000 * 2
+    assert out.senders == [ALICE]
+
+
+def test_nonce_and_funds_validation():
+    src = rich_source(balance=1)
+    with pytest.raises(InvalidTransaction, match="insufficient"):
+        BlockExecutor(src).execute(make_block([signed_tx()]))
+    src = rich_source()
+    with pytest.raises(InvalidTransaction, match="nonce"):
+        BlockExecutor(src).execute(make_block([signed_tx(nonce=5)]))
+
+
+def test_two_txs_sequential_nonces():
+    src = rich_source()
+    b = make_block([signed_tx(nonce=0), signed_tx(nonce=1, value=500)])
+    out = BlockExecutor(src).execute(b)
+    assert out.gas_used == 42000
+    assert out.post_accounts[BOB].balance == 1500
+    assert out.post_accounts[ALICE].nonce == 2
+
+
+# A contract that stores calldata word0 at slot0:
+# PUSH0 CALLDATALOAD PUSH0 SSTORE STOP
+STORE_CODE = bytes.fromhex("5f355f5500")
+# Runtime-returning initcode for STORE_CODE:
+#   PUSH5 <code> PUSH0 MSTORE ... simpler: CODECOPY pattern
+# initcode: PUSH1 len PUSH1 off PUSH0 CODECOPY PUSH1 len PUSH0 RETURN <code>
+def initcode_for(runtime: bytes) -> bytes:
+    n = len(runtime)
+    return bytes([0x60, n, 0x60, 0x0B, 0x5F, 0x39, 0x60, n, 0x5F, 0xF3]) + b"\x00" + runtime
+
+
+def test_create_and_call_contract():
+    src = rich_source()
+    deploy = signed_tx(to=None, data=initcode_for(STORE_CODE), gas_limit=200_000)
+    out = BlockExecutor(src).execute(make_block([deploy]))
+    assert out.receipts[0].success
+    # locate the created contract account
+    created = [a for a, acc in out.post_accounts.items()
+               if acc and acc.code_hash != keccak256(b"") and a != ALICE]
+    assert len(created) == 1
+    contract = created[0]
+    assert out.changes.new_bytecodes[keccak256(STORE_CODE)] == STORE_CODE
+    # now call it: store 0xdead at slot 0
+    src2 = InMemoryStateSource(
+        {ALICE: Account(balance=10**18), contract: out.post_accounts[contract]},
+        codes={keccak256(STORE_CODE): STORE_CODE},
+    )
+    call = signed_tx(to=contract, value=0, gas_limit=100_000,
+                     data=(0xDEAD).to_bytes(32, "big"))
+    out2 = BlockExecutor(src2).execute(make_block([call]))
+    assert out2.receipts[0].success
+    assert out2.post_storage[contract][b"\x00" * 32] == 0xDEAD
+    assert out2.changes.storage[contract][b"\x00" * 32] == 0  # prev value
+
+
+def test_revert_rolls_back_state():
+    # contract: store 1 at slot0 then revert: PUSH1 1 PUSH0 SSTORE PUSH0 PUSH0 REVERT
+    code = bytes.fromhex("60015f555f5ffd")
+    caddr = b"\x11" * 20
+    src = InMemoryStateSource(
+        {ALICE: Account(balance=10**18), caddr: Account(code_hash=keccak256(code))},
+        codes={keccak256(code): code},
+    )
+    tx = signed_tx(to=caddr, value=0, gas_limit=100_000)
+    out = BlockExecutor(src).execute(make_block([tx]))
+    assert not out.receipts[0].success
+    assert caddr not in out.post_storage or out.post_storage[caddr].get(b"\x00" * 32, 0) == 0
+    # gas was still charged
+    assert out.gas_used > 21000
+
+
+def test_sstore_refund():
+    # clear an existing slot: PUSH0 PUSH0 SSTORE (set slot0 = 0)
+    code = bytes.fromhex("5f5f5500")
+    caddr = b"\x12" * 20
+    src = InMemoryStateSource(
+        {ALICE: Account(balance=10**18), caddr: Account(code_hash=keccak256(code))},
+        storages={caddr: {b"\x00" * 32: 99}},
+        codes={keccak256(code): code},
+    )
+    tx = signed_tx(to=caddr, value=0, gas_limit=100_000)
+    out = BlockExecutor(src).execute(make_block([tx]))
+    assert out.receipts[0].success
+    assert out.post_storage[caddr][b"\x00" * 32] == 0
+    # refund (4800) capped at gas_used/5 applied: without refund it'd be
+    # 21000 + 2100(cold) + 2900(reset) + 4 = 26004; refund = min(4800, 5200)
+    no_refund = 21000 + 2100 + 2900 + 2 + 2
+    assert out.gas_used == no_refund - min(4800, no_refund // 5)
+
+
+def test_log_emission():
+    # LOG1 with topic 0x42: PUSH1 0x42 PUSH0 PUSH0 LOG1 STOP
+    code = bytes.fromhex("60425f5fa100")
+    caddr = b"\x13" * 20
+    src = InMemoryStateSource(
+        {ALICE: Account(balance=10**18), caddr: Account(code_hash=keccak256(code))},
+        codes={keccak256(code): code},
+    )
+    out = BlockExecutor(src).execute(
+        make_block([signed_tx(to=caddr, value=0, gas_limit=100_000)])
+    )
+    r = out.receipts[0]
+    assert r.success and len(r.logs) == 1
+    assert r.logs[0].address == caddr
+    assert r.logs[0].topics == ((0x42).to_bytes(32, "big"),)
+
+
+def test_withdrawals_credit():
+    from reth_tpu.primitives.types import Withdrawal
+
+    src = InMemoryStateSource({})
+    blk = Block(
+        Header(number=1, base_fee_per_gas=7, withdrawals_root=b"\x00" * 32),
+        (), (), (Withdrawal(0, 1, BOB, 3), Withdrawal(1, 1, BOB, 2)),
+    )
+    out = BlockExecutor(src).execute(blk)
+    assert out.post_accounts[BOB].balance == 5 * 10**9
+
+
+def test_intrinsic_gas():
+    tx = Transaction(tx_type=2, chain_id=1, to=BOB, data=b"\x00\x01\x02")
+    assert intrinsic_gas(tx) == 21000 + 4 + 16 + 16
+    create = Transaction(tx_type=2, chain_id=1, to=None, data=b"\xff" * 33)
+    assert intrinsic_gas(create) == 21000 + 32000 + 33 * 16 + 2 * 2
+
+
+def test_interpreter_arithmetic_direct():
+    """Drive raw opcodes: (3+4)*5 stored to slot0."""
+    # PUSH1 3 PUSH1 4 ADD PUSH1 5 MUL PUSH0 SSTORE STOP
+    code = bytes.fromhex("60036004016005025f5500")
+    state = EvmState(InMemoryStateSource({}))
+    interp = Interpreter(state, BlockEnv(), TxEnv())
+    ok, gas_left, out = interp.call(
+        CallFrame(caller=ALICE, address=b"\x14" * 20, code=code, data=b"", value=0, gas=100_000)
+    )
+    assert ok
+    assert state.sload(b"\x14" * 20, b"\x00" * 32) == 35
+
+
+def test_precompile_sha256_and_identity():
+    state = EvmState(InMemoryStateSource({}))
+    interp = Interpreter(state, BlockEnv(), TxEnv())
+    import hashlib
+
+    ok, _, out = interp.call(CallFrame(
+        caller=ALICE, address=b"\x00" * 19 + b"\x02", code=b"", data=b"abc", value=0, gas=10_000
+    ))
+    assert ok and out == hashlib.sha256(b"abc").digest()
+    ok, _, out = interp.call(CallFrame(
+        caller=ALICE, address=b"\x00" * 19 + b"\x04", code=b"", data=b"xyz", value=0, gas=10_000
+    ))
+    assert ok and out == b"xyz"
+
+
+def test_delegatecall_does_not_retransfer_value():
+    """DELEGATECALL must not move the parent frame's value again."""
+    # impl B: STOP. proxy A: DELEGATECALL B then STOP
+    impl = bytes.fromhex("00")
+    # PUSH0 x4, PUSH20 <B>, GAS, DELEGATECALL, STOP
+    b_addr = b"\x1b" * 20
+    proxy_code = bytes.fromhex("5f5f5f5f73") + b_addr + bytes.fromhex("5af400")
+    a_addr = b"\x1a" * 20
+    src = InMemoryStateSource(
+        {ALICE: Account(balance=10**18),
+         a_addr: Account(code_hash=keccak256(proxy_code)),
+         b_addr: Account(code_hash=keccak256(impl))},
+        codes={keccak256(proxy_code): proxy_code, keccak256(impl): impl},
+    )
+    value = 10**17
+    tx = signed_tx(to=a_addr, value=value, gas_limit=200_000)
+    out = BlockExecutor(src).execute(make_block([tx]))
+    assert out.receipts[0].success
+    # alice debited exactly once for the value
+    fees = out.gas_used * 9
+    assert out.post_accounts[ALICE].balance == 10**18 - value - fees
+    assert out.post_accounts[a_addr].balance == value
+
+
+def test_sstore_original_is_tx_start_not_block_start():
+    """EIP-2200: 'original' is the value at TX start; two txs hitting the
+    same slot in one block must charge reset gas in the second tx."""
+    # contract: sstore(0, calldata[0])
+    caddr = b"\x21" * 20
+    src = InMemoryStateSource(
+        {ALICE: Account(balance=10**18), caddr: Account(code_hash=keccak256(STORE_CODE))},
+        codes={keccak256(STORE_CODE): STORE_CODE},
+    )
+    tx1 = signed_tx(to=caddr, value=0, gas_limit=100_000, nonce=0,
+                    data=(1).to_bytes(32, "big"))
+    tx2 = signed_tx(to=caddr, value=0, gas_limit=100_000, nonce=1,
+                    data=(2).to_bytes(32, "big"))
+    out = BlockExecutor(src).execute(make_block([tx1, tx2]))
+    base = 21000 + 31 * 4 + 16  # intrinsic incl. calldata (31 zero, 1 nonzero)
+    g1 = out.receipts[0].cumulative_gas_used
+    g2 = out.receipts[1].cumulative_gas_used - g1
+    # tx1: cold slot, 0->1 set: 2100 + 20000 (+ code overhead 2+3+2)
+    assert g1 == base + 2100 + 20000 + 7
+    # tx2: cold again (per-tx warm reset), original=1 -> reset 2900
+    assert g2 == base + 2100 + 2900 + 7
+    assert out.post_storage[caddr][b"\x00" * 32] == 2
+
+
+def test_precompiles_are_warm():
+    """EIP-2929: precompile CALL costs warm access, not cold."""
+    # PUSH0 x5, PUSH1 4 (identity), GAS, STATICCALL, STOP
+    code = bytes.fromhex("5f5f5f5f5f60045afa00")
+    caddr = b"\x22" * 20
+    src = InMemoryStateSource(
+        {ALICE: Account(balance=10**18), caddr: Account(code_hash=keccak256(code))},
+        codes={keccak256(code): code},
+    )
+    out = BlockExecutor(src).execute(
+        make_block([signed_tx(to=caddr, value=0, gas_limit=100_000)])
+    )
+    assert out.receipts[0].success
+    # 5*PUSH0(2) + PUSH1(3) + GAS(2) + warm access(100) + identity(15)
+    assert out.gas_used == 21000 + 5 * 2 + 3 + 2 + 100 + 15
+
+
+def test_selfdestruct_to_self_keeps_balance():
+    """Post-EIP-6780: pre-existing contract SELFDESTRUCT(self) keeps funds."""
+    # PUSH20 <self> SELFDESTRUCT
+    caddr = b"\x23" * 20
+    code = b"\x73" + caddr + b"\xff"
+    src = InMemoryStateSource(
+        {ALICE: Account(balance=10**18),
+         caddr: Account(balance=555, code_hash=keccak256(code))},
+        codes={keccak256(code): code},
+    )
+    out = BlockExecutor(src).execute(
+        make_block([signed_tx(to=caddr, value=0, gas_limit=100_000)])
+    )
+    assert out.receipts[0].success
+    acc = out.post_accounts.get(caddr)
+    assert acc is not None and acc.balance == 555  # not destroyed, not burned
+
+
+def test_precompile_ecrecover():
+    state = EvmState(InMemoryStateSource({}))
+    interp = Interpreter(state, BlockEnv(), TxEnv())
+    h = keccak256(b"message")
+    parity, r, s = secp256k1.sign(h, ALICE_KEY)
+    data = h + (27 + parity).to_bytes(32, "big") + r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    ok, _, out = interp.call(CallFrame(
+        caller=ALICE, address=b"\x00" * 19 + b"\x01", code=b"", data=data, value=0, gas=10_000
+    ))
+    assert ok and out[12:] == ALICE
