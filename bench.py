@@ -1,0 +1,100 @@
+"""Benchmark: MerkleStage-style full state-root rebuild on the device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload = benchmark config #2/#3 in miniature (BASELINE.md): a synthetic
+hashed state (accounts + storage slots) is committed bottom-up with the
+level-batched trie committer; every node hash runs through the batched
+device keccak kernel. ``vs_baseline`` is the wall-clock speedup of the
+device hasher over the numpy CPU baseline on the identical workload
+(the stand-in for the reference's parallel CPU keccak path).
+
+Env knobs: RETH_TPU_BENCH_ACCOUNTS (default 50000),
+RETH_TPU_BENCH_SLOTS (default 20000 across accounts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_state(n_accounts: int, n_slots: int):
+    from reth_tpu.primitives.rlp import encode_int, rlp_encode
+    from reth_tpu.primitives.nibbles import unpack_nibbles
+    from reth_tpu.primitives.types import Account
+    from reth_tpu.storage.tables import encode_account
+
+    rng = np.random.default_rng(42)
+    akeys = rng.integers(0, 256, size=(n_accounts, 32), dtype=np.uint8)
+    balances = rng.integers(1, 1 << 60, size=n_accounts)
+    account_leaves = [
+        (
+            unpack_nibbles(akeys[i].tobytes()),
+            encode_account(Account(nonce=int(i % 300), balance=int(balances[i]))),
+        )
+        for i in range(n_accounts)
+    ]
+    # storage tries: n_slots spread over n_accounts//10 accounts
+    n_storage_accts = max(1, n_accounts // 10)
+    skeys = rng.integers(0, 256, size=(n_slots, 32), dtype=np.uint8)
+    svals = rng.integers(1, 1 << 60, size=n_slots)
+    storage_jobs: dict[int, list] = {}
+    for j in range(n_slots):
+        owner = j % n_storage_accts
+        storage_jobs.setdefault(owner, []).append(
+            (unpack_nibbles(skeys[j].tobytes()), rlp_encode(encode_int(int(svals[j]))))
+        )
+    return account_leaves, list(storage_jobs.values())
+
+
+def run_commit(committer, account_leaves, storage_jobs):
+    jobs = [(leaves, None) for leaves in storage_jobs] + [(account_leaves, None)]
+    t0 = time.time()
+    results = committer.commit_many(jobs, collect_branches=False)
+    dt = time.time() - t0
+    hashed = sum(r.hashed_nodes for r in results)
+    return results[-1].root, hashed, dt
+
+
+def main():
+    n_accounts = int(os.environ.get("RETH_TPU_BENCH_ACCOUNTS", "50000"))
+    n_slots = int(os.environ.get("RETH_TPU_BENCH_SLOTS", "20000"))
+
+    from reth_tpu.ops import KeccakDevice
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.trie.committer import TrieCommitter
+
+    account_leaves, storage_jobs = build_state(n_accounts, n_slots)
+
+    dev_committer = TrieCommitter()  # device hasher (TPU when attached)
+    cpu_committer = TrieCommitter(hasher=keccak256_batch_np)
+
+    # warm-up = one full untimed run, so every batch tier the measured run
+    # dispatches is already compiled (XLA caches by shape in-process)
+    run_commit(dev_committer, account_leaves, storage_jobs)
+
+    root_dev, hashed_dev, dt_dev = run_commit(dev_committer, account_leaves, storage_jobs)
+    root_cpu, _hashed_cpu, dt_cpu = run_commit(cpu_committer, account_leaves, storage_jobs)
+    if root_dev != root_cpu:
+        print(
+            json.dumps({"metric": "merkle_rebuild_keccak_per_sec", "value": 0,
+                        "unit": "hashes/s", "vs_baseline": 0,
+                        "error": "device/cpu root mismatch"}),
+        )
+        sys.exit(1)
+
+    print(json.dumps({
+        "metric": "merkle_rebuild_keccak_per_sec",
+        "value": round(hashed_dev / dt_dev, 1),
+        "unit": "hashes/s",
+        "vs_baseline": round(dt_cpu / dt_dev, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

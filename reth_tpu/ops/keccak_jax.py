@@ -101,6 +101,20 @@ def _squeeze256(lo, hi):
     return jnp.stack([lo[0], hi[0], lo[1], hi[1], lo[2], hi[2], lo[3], hi[3]], axis=1)
 
 
+def absorb_single_block(words):
+    """Single-rate-block keccak-256: (N, 34) uint32 words → (N, 8) digests.
+
+    The canonical one-block absorb — the mesh/sharding layer and the graft
+    entry build on this exact function so the lane layout lives in one place.
+    """
+    n = words.shape[0]
+    w = words.reshape(n, 17, 2).transpose(1, 2, 0)  # (17, 2, N)
+    lo = jnp.zeros((25, n), dtype=jnp.uint32).at[:17].set(w[:, 0, :])
+    hi = jnp.zeros((25, n), dtype=jnp.uint32).at[:17].set(w[:, 1, :])
+    lo, hi = keccak_f1600_jax(lo, hi)
+    return _squeeze256(lo, hi)
+
+
 @partial(jax.jit, static_argnums=1)
 def keccak256_jax_words(words, num_blocks: int):
     """Keccak-256 over pre-padded messages, all with the same block count.

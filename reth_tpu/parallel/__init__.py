@@ -1,0 +1,14 @@
+"""Multi-chip parallelism: device meshes, sharded hashing, collectives.
+
+Reference analogue: reth's process-level parallelism (rayon worker pools,
+crossbeam channels — SURVEY.md §2.9) and its cross-node backbone. Here
+the scale-out axis is a ``jax.sharding.Mesh``: hash batches shard over
+the ``data`` axis (hash-lane parallelism is embarrassingly parallel, the
+exact analogue of the reference's rayon chunking), and trie level
+reduction uses XLA collectives (all_gather) over ICI — no NCCL/MPI
+translation, the compiler inserts the transfers.
+"""
+
+from .mesh import HashMesh, multichip_commit_step, sharded_keccak
+
+__all__ = ["HashMesh", "multichip_commit_step", "sharded_keccak"]

@@ -1,0 +1,90 @@
+"""Device-mesh sharded keccak + the multi-chip trie-commit step.
+
+Design (scaling-book recipe): pick a mesh, annotate shardings, let XLA
+insert collectives. The hash workload is batch-parallel, so the mesh has
+one ``data`` axis; a trie level of N nodes shards N/devices per chip.
+Parent levels need children's digests — a cross-device dependency —
+expressed as an ``all_gather`` of the level's digest shard (rides ICI on
+real hardware). This is the whole communication pattern of the
+state-commitment data plane: hash (sharded) → gather digests → hash the
+next level.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.keccak_jax import absorb_single_block
+
+
+def _commit_step(w):
+    """Two-level trie commit: sharded leaf hash → gather → parent hash.
+
+    Level 0: hash N leaf messages (batch-sharded, pure data parallel).
+    Level 1: every device needs the whole level's digests to build parent
+    nodes → the replication constraint makes XLA insert an all_gather,
+    then the N/4 parent nodes (each the 128-byte concatenation of 4 child
+    digests, single rate block after padding) are hashed — a miniature
+    4-ary trie level reduce.
+    """
+    digests = absorb_single_block(w)  # (N, 8) sharded over batch
+    # reshaping groups of 4 children into parent rows crosses shard
+    # boundaries — XLA inserts the all_gather/collective from the sharding
+    # propagation (leaf level sharded, parent level replicated)
+    n = digests.shape[0]
+    groups = digests.reshape(n // 4, 32)  # 4 children of 8 words per parent
+    pad = jnp.zeros((n // 4, 2), dtype=jnp.uint32)
+    # keccak padding for a 128-byte message in the 136-byte rate block:
+    # byte 128 = 0x01 → word 32; byte 135 = 0x80 → word 33 high byte
+    pad = pad.at[:, 0].set(jnp.uint32(0x01)).at[:, 1].set(jnp.uint32(0x80000000))
+    parents = jnp.concatenate([groups, pad], axis=1)  # (n/4, 34)
+    return absorb_single_block(parents)
+
+
+class HashMesh:
+    """A 1-axis device mesh for batch-parallel hashing.
+
+    Jitted programs are cached per mesh instance — callers reuse one
+    HashMesh for the life of the device topology.
+    """
+
+    def __init__(self, devices=None, axis: str = "data"):
+        devices = devices if devices is not None else jax.devices()
+        self.axis = axis
+        self.mesh = Mesh(np.array(devices), (axis,))
+        sharded = self.batch_sharding()
+        self._keccak = jax.jit(absorb_single_block, out_shardings=sharded)
+        # parent level reads ALL child digests → reshape over the full batch
+        # forces the all_gather; output is small, leave it replicated
+        self._commit = jax.jit(_commit_step, out_shardings=self.replicated())
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def sharded_keccak(hash_mesh: HashMesh, words: np.ndarray) -> jax.Array:
+    """Hash a padded single-block batch sharded across the mesh.
+
+    ``words``: (N, 34) uint32, N divisible by the device count. Each device
+    hashes its batch shard; no communication.
+    """
+    arr = jax.device_put(jnp.asarray(words), hash_mesh.batch_sharding())
+    return hash_mesh._keccak(arr)
+
+
+def multichip_commit_step(hash_mesh: HashMesh, words: np.ndarray) -> jax.Array:
+    """One two-level 4-ary trie-commit step across the mesh (see
+    ``_commit_step``): N sharded leaves → all_gather → N/4 parent digests."""
+    arr = jax.device_put(jnp.asarray(words), hash_mesh.batch_sharding())
+    return hash_mesh._commit(arr)
