@@ -1,0 +1,14 @@
+"""Engine (live tip): in-memory block tree, payload validation, forkchoice.
+
+Reference analogue: crates/engine/tree — `EngineApiTreeHandler`
+(src/tree/mod.rs), `TreeState` (src/tree/state.rs), the state-root
+strategies (src/tree/state_root_strategy/), and the persistence service
+(src/persistence.rs). Here each pending block's entire effect (plain +
+hashed state, trie nodes, receipts, changesets) is one overlay layer;
+the incremental-root committer runs unchanged against the overlay, and
+persistence applies layers in canonical order.
+"""
+
+from .tree import EngineTree, ExecutedBlock, PayloadStatus
+
+__all__ = ["EngineTree", "ExecutedBlock", "PayloadStatus"]

@@ -1,0 +1,388 @@
+"""The engine tree: newPayload / forkchoiceUpdated / persistence.
+
+Reference analogue: `EngineApiTreeHandler::on_new_payload` (insert +
+validate + state root, crates/engine/tree/src/tree/mod.rs:762),
+`on_forkchoice_updated` (:1175), `TreeState`, `advance_persistence`
+(:1449) + `PersistenceHandle`. The per-block state-root job — the
+reference's SparseTrieCacheTask pipeline — is the batched incremental
+committer over the block's overlay.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+from ..consensus import ConsensusError, EthBeaconConsensus
+from ..evm import BlockExecutor, EvmConfig
+from ..evm.executor import InvalidTransaction, ProviderStateSource
+from ..primitives.types import Block
+from ..stages.execution import write_execution_output
+from ..storage.overlay import Layer, OverlayTx, apply_layer
+from ..storage.provider import DatabaseProvider, ProviderFactory
+from ..storage.tables import Tables
+from ..trie.committer import TrieCommitter
+from ..trie.incremental import IncrementalStateRoot
+
+
+class PayloadStatusKind(Enum):
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+
+
+@dataclass
+class PayloadStatus:
+    status: PayloadStatusKind
+    latest_valid_hash: bytes | None = None
+    validation_error: str | None = None
+
+
+@dataclass
+class ExecutedBlock:
+    """A validated pending block: its full effect as one overlay layer."""
+
+    block: Block
+    senders: list[bytes]
+    receipts: list
+    layer: Layer
+    parent_hash: bytes
+
+    @property
+    def hash(self) -> bytes:
+        return self.block.hash
+
+    @property
+    def number(self) -> int:
+        return self.block.header.number
+
+
+class EngineTree:
+    """In-memory tree of pending blocks above the persisted chain."""
+
+    def __init__(
+        self,
+        factory: ProviderFactory,
+        committer: TrieCommitter | None = None,
+        consensus: EthBeaconConsensus | None = None,
+        config: EvmConfig | None = None,
+        persistence_threshold: int = 2,
+        unwinder=None,
+    ):
+        self.factory = factory
+        self.committer = committer or TrieCommitter()
+        self.consensus = consensus or EthBeaconConsensus(self.committer)
+        self.config = config or EvmConfig()
+        self.persistence_threshold = persistence_threshold
+        if unwinder is None:
+            def unwinder(fac, target):
+                from ..stages import Pipeline, default_stages
+
+                Pipeline(fac, default_stages(committer=self.committer)).unwind(target)
+        self.unwinder = unwinder
+        self.blocks: dict[bytes, ExecutedBlock] = {}
+        self.invalid: dict[bytes, str] = {}
+        # blocks whose parent is unknown yet (reference BlockBuffer,
+        # crates/engine/tree/src/tree/block_buffer.rs)
+        self.buffered: dict[bytes, Block] = {}
+        with factory.provider() as p:
+            n = p.last_block_number()
+            h = p.canonical_hash(n)
+        self.persisted_number = n
+        self.persisted_hash = h
+        self.head_hash: bytes = h  # canonical in-memory head
+        self.canon_listeners: list = []  # CanonStateNotification sinks
+
+    # -- helpers --------------------------------------------------------------
+
+    def _chain_layers(self, parent_hash: bytes) -> list[Layer] | None:
+        """Overlay layers from the persisted root up to ``parent_hash``.
+
+        Returns None when the parent is unknown (not persisted tip chain).
+        """
+        layers: list[Layer] = []
+        h = parent_hash
+        while h != self.persisted_hash:
+            eb = self.blocks.get(h)
+            if eb is None:
+                return None
+            layers.append(eb.layer)
+            h = eb.parent_hash
+        layers.reverse()
+        return layers
+
+    def block_by_hash(self, block_hash: bytes) -> Block | None:
+        eb = self.blocks.get(block_hash)
+        if eb is not None:
+            return eb.block
+        with self.factory.provider() as p:
+            n = p.block_number(block_hash)
+            return p.block_by_number(n) if n is not None else None
+
+    def canonical_chain(self) -> list[bytes]:
+        """In-memory canonical hashes, oldest first (persisted root excl.)."""
+        out = []
+        h = self.head_hash
+        while h != self.persisted_hash:
+            eb = self.blocks.get(h)
+            if eb is None:
+                break
+            out.append(h)
+            h = eb.parent_hash
+        out.reverse()
+        return out
+
+    def overlay_provider(self, head: bytes | None = None) -> DatabaseProvider:
+        """Read provider over the canonical in-memory state at ``head``.
+
+        Raises KeyError when ``head`` is not a known tree block or the
+        persisted root — never silently serves the wrong state.
+        """
+        target = head if head is not None else self.head_hash
+        layers = self._chain_layers(target)
+        if layers is None:
+            raise KeyError(f"unknown head {target.hex()}")
+        base = self.factory.db.tx()
+        return DatabaseProvider(OverlayTx(base, layers))
+
+    # -- newPayload ------------------------------------------------------------
+
+    def on_new_payload(self, block: Block) -> PayloadStatus:
+        h = block.hash
+        if h in self.blocks:
+            return PayloadStatus(PayloadStatusKind.VALID, h)
+        if h in self.invalid:
+            return PayloadStatus(PayloadStatusKind.INVALID, None, self.invalid[h])
+        if block.header.parent_hash in self.invalid:
+            self.invalid[h] = "invalid ancestor"
+            return PayloadStatus(PayloadStatusKind.INVALID, None, "invalid ancestor")
+        # replay of an already-persisted canonical block → VALID
+        with self.factory.provider() as p:
+            if p.canonical_hash(block.header.number) == h:
+                return PayloadStatus(PayloadStatusKind.VALID, h)
+        parent_layers = self._chain_layers(block.header.parent_hash)
+        if parent_layers is None:
+            # parent unknown or below the persisted tip: buffer; a later FCU
+            # to this branch unwinds and replays (reference BlockBuffer)
+            self.buffered[h] = block
+            return PayloadStatus(PayloadStatusKind.SYNCING)
+        return self._validate_and_insert(block, parent_layers)
+
+    def _validate_and_insert(self, block: Block, parent_layers: list[Layer]) -> PayloadStatus:
+        h = block.hash
+        base = self.factory.db.tx()
+        layer: Layer = {}
+        overlay = DatabaseProvider(OverlayTx(base, parent_layers, layer))
+        try:
+            parent = self._header_of(block.header.parent_hash, overlay)
+            self.consensus.validate_header_against_parent(block.header, parent)
+            self.consensus.validate_block_pre_execution(block)
+            status, senders, receipts = self._execute_into_overlay(block, overlay)
+        except (ConsensusError, InvalidTransaction) as e:
+            self.invalid[h] = str(e)
+            return PayloadStatus(PayloadStatusKind.INVALID, None, str(e))
+        if status.status is PayloadStatusKind.VALID:
+            self.blocks[h] = ExecutedBlock(
+                block=block, senders=senders, receipts=receipts,
+                layer=layer, parent_hash=block.header.parent_hash,
+            )
+            self.buffered.pop(h, None)
+        return status
+
+    def _header_of(self, block_hash: bytes, overlay: DatabaseProvider):
+        eb = self.blocks.get(block_hash)
+        if eb is not None:
+            return eb.block.header
+        n = overlay.block_number(block_hash)
+        if n is None:
+            raise ConsensusError("unknown parent")
+        return overlay.header_by_number(n)
+
+    def _execute_into_overlay(
+        self, block: Block, overlay: DatabaseProvider
+    ) -> tuple[PayloadStatus, list[bytes], list]:
+        """Execute + hash + root-check ``block``, writing into the overlay.
+
+        Returns (status, senders, receipts); senders/receipts are empty on
+        invalid payloads.
+        """
+        header = block.header
+        n = header.number
+        # execute (senders recovered here = SenderRecovery equivalent)
+        executor = BlockExecutor(ProviderStateSource(overlay), self.config)
+        hashes = {}
+        for k in range(max(0, n - 256), n):
+            bh = overlay.canonical_hash(k)
+            if bh:
+                hashes[k] = bh
+        try:
+            senders = [tx.recover_sender() for tx in block.transactions]
+        except ValueError as e:
+            self.invalid[block.hash] = f"bad signature: {e}"
+            return PayloadStatus(PayloadStatusKind.INVALID, None, str(e)), [], []
+        out = executor.execute(block, senders, hashes)
+        try:
+            self.consensus.validate_block_post_execution(block, out.receipts, out.gas_used)
+        except ConsensusError as e:
+            self.invalid[block.hash] = str(e)
+            return PayloadStatus(PayloadStatusKind.INVALID, None, str(e)), [], []
+        # body + execution output into the overlay layer
+        overlay.insert_header(header)
+        overlay.insert_block_body(block)
+        idx = overlay.block_body_indices(n)
+        for i, s in enumerate(senders):
+            overlay.put_sender(idx.first_tx_num + i, s)
+        write_execution_output(overlay, n, idx.first_tx_num, out)
+        # hashed-state delta + incremental root (the state-root job)
+        root = self._state_root_job(overlay, out)
+        if root != header.state_root:
+            msg = (
+                f"state root mismatch: computed {root.hex()} header "
+                f"{header.state_root.hex()}"
+            )
+            self.invalid[block.hash] = msg
+            return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
+        return PayloadStatus(PayloadStatusKind.VALID, block.hash), senders, out.receipts
+
+    def _state_root_job(self, overlay: DatabaseProvider, out) -> bytes:
+        """Hash the block's state delta and commit the trie incrementally.
+
+        Reference analogue: the SparseTrieCacheTask pipeline
+        (state updates → proof targets → sparse trie → root,
+        crates/engine/tree/src/tree/state_root_strategy/sparse_trie.rs) —
+        here one batched keccak dispatch for the changed keys plus the
+        level-batched incremental commit over the overlay.
+        """
+        changes = out.changes
+        addrs = sorted(set(changes.accounts) | set(changes.storage) | set(changes.wiped_storage))
+        slot_pairs = [(a, s) for a, slots in out.post_storage.items() for s in slots]
+        digests = self.committer.hasher(addrs + [s for _, s in slot_pairs])
+        haddr = dict(zip(addrs, digests[: len(addrs)]))
+        hslots = digests[len(addrs) :]
+        # write hashed tables (live-tip equivalent of the hashing stages)
+        for a in addrs:
+            if a in out.post_accounts:
+                overlay.put_hashed_account(haddr[a], out.post_accounts[a])
+        wiped_hashed = set()
+        for a in changes.wiped_storage:
+            wiped_hashed.add(haddr[a])
+            overlay.clear_hashed_storage(haddr[a])
+        changed_hashed_storages: dict[bytes, set[bytes]] = {}
+        for (a, s), hs in zip(slot_pairs, hslots):
+            overlay.put_hashed_storage(haddr[a], hs, out.post_storage[a][s])
+            changed_hashed_storages.setdefault(haddr[a], set()).add(hs)
+        changed_hashed_accounts = {haddr[a] for a in changes.accounts}
+        inc = IncrementalStateRoot(overlay, self.committer)
+        return inc.compute(changed_hashed_accounts, changed_hashed_storages, wiped_hashed)
+
+    # -- forkchoice ------------------------------------------------------------
+
+    def on_forkchoice_updated(
+        self, head: bytes, safe: bytes | None = None, finalized: bytes | None = None
+    ) -> PayloadStatus:
+        if head in self.invalid:
+            return PayloadStatus(PayloadStatusKind.INVALID, None, self.invalid[head])
+        if head == self.persisted_hash:
+            return self._set_head(head)
+        if head in self.blocks and self._chain_layers(head) is not None:
+            return self._set_head(head)
+        # head may be an old persisted canonical block (CL rewind) or reach
+        # the canonical chain below the persisted tip via buffered blocks —
+        # both need the persisted chain unwound to the branch point.
+        branch = self._find_persisted_branch_point(head)
+        if branch is None:
+            return PayloadStatus(PayloadStatusKind.SYNCING)
+        branch_number, replay = branch
+        if self.unwinder is None:
+            return PayloadStatus(PayloadStatusKind.SYNCING)
+        self._unwind_persisted_to(branch_number)
+        for blk in replay:
+            st = self.on_new_payload(blk)
+            if st.status is not PayloadStatusKind.VALID:
+                return st
+        if head in self.blocks or head == self.persisted_hash:
+            return self._set_head(head)
+        return PayloadStatus(PayloadStatusKind.SYNCING)
+
+    def _set_head(self, head: bytes) -> PayloadStatus:
+        old_head = self.head_hash
+        self.head_hash = head
+        if old_head != head:
+            self._notify_canon_change()
+        self._advance_persistence()
+        return PayloadStatus(PayloadStatusKind.VALID, head)
+
+    def _find_persisted_branch_point(self, head: bytes):
+        """If ``head`` connects to a persisted canonical block below the tip
+        (directly or via buffered blocks), return (branch_number, replay
+        chain oldest-first); else None."""
+        replay: list[Block] = []
+        h = head
+        with self.factory.provider() as p:
+            while True:
+                n = p.block_number(h)
+                if n is not None and p.canonical_hash(n) == h:
+                    return (n, list(reversed(replay)))
+                blk = self.buffered.get(h)
+                if blk is None:
+                    eb = self.blocks.get(h)
+                    if eb is None:
+                        return None
+                    blk = eb.block
+                replay.append(blk)
+                h = blk.header.parent_hash
+
+    def _unwind_persisted_to(self, number: int) -> None:
+        """Unwind the persisted chain to ``number`` (reference: engine →
+        backfill pipeline unwind on deep reorgs, pipeline/mod.rs:303)."""
+        self.unwinder(self.factory, number)
+        # drop unwound canonical blocks' header index
+        with self.factory.provider_rw() as p:
+            old_tip = p.last_block_number()
+            for n in range(number + 1, old_tip + 1):
+                bh = p.canonical_hash(n)
+                if bh:
+                    p.tx.delete(Tables.CanonicalHeaders.name, (n).to_bytes(8, "big"))
+                    p.tx.delete(Tables.Headers.name, (n).to_bytes(8, "big"))
+                    p.tx.delete(Tables.HeaderNumbers.name, bh)
+        with self.factory.provider() as p:
+            self.persisted_number = number
+            self.persisted_hash = p.canonical_hash(number)
+        self.head_hash = self.persisted_hash
+        # in-memory tree entries built on the old chain are now stale
+        self.blocks.clear()
+
+    def _notify_canon_change(self):
+        chain = [self.blocks[h] for h in self.canonical_chain()]
+        for listener in self.canon_listeners:
+            listener(chain)
+
+    # -- persistence -----------------------------------------------------------
+
+    def _advance_persistence(self):
+        """Persist canonical blocks deeper than the threshold, prune tree.
+
+        Reference analogue: `advance_persistence` + the persistence thread
+        (crates/engine/tree/src/persistence.rs): apply layers to the DB,
+        move stage checkpoints, drop persisted/abandoned tree nodes.
+        """
+        chain = self.canonical_chain()
+        if len(chain) <= self.persistence_threshold:
+            return
+        to_persist = chain[: len(chain) - self.persistence_threshold]
+        with self.factory.provider_rw() as p:
+            for h in to_persist:
+                apply_layer(p.tx, self.blocks[h].layer)
+            top = self.blocks[to_persist[-1]].number
+            for stage in ("SenderRecovery", "Execution", "MerkleUnwind",
+                          "AccountHashing", "StorageHashing", "MerkleExecute",
+                          "TransactionLookup", "Finish"):
+                p.save_stage_checkpoint(stage, top)
+        last = self.blocks[to_persist[-1]]
+        self.persisted_number = last.number
+        self.persisted_hash = last.hash
+        # prune: drop persisted blocks and stale forks below the new root
+        for h in to_persist:
+            self.blocks.pop(h, None)
+        for h in [h for h, eb in self.blocks.items() if eb.number <= self.persisted_number]:
+            self.blocks.pop(h, None)

@@ -57,33 +57,7 @@ class ExecutionStage(Stage):
 
     def _write_output(self, provider: DatabaseProvider, block_num: int,
                       first_tx_num: int, out) -> None:
-        changes = out.changes
-        # changesets: previous images (wiped storage records its whole map)
-        for addr, prev in changes.accounts.items():
-            provider.record_account_change(block_num, addr, prev)
-        wiped_prev: dict[bytes, dict[bytes, int]] = {}
-        for addr in changes.wiped_storage:
-            wiped_prev[addr] = provider.account_storage(addr)
-            for slot, prev_val in wiped_prev[addr].items():
-                provider.record_storage_change(block_num, addr, slot, prev_val)
-        for addr, slots in changes.storage.items():
-            already = wiped_prev.get(addr, {})
-            for slot, prev_val in slots.items():
-                if slot not in already:
-                    provider.record_storage_change(block_num, addr, slot, prev_val)
-        # plain state
-        for addr in changes.wiped_storage:
-            provider.clear_account_storage(addr)
-        for addr, acc in out.post_accounts.items():
-            provider.put_account(addr, acc)
-        for addr, slots in out.post_storage.items():
-            for slot, val in slots.items():
-                provider.put_storage(addr, slot, val)
-        for code_hash, code in changes.new_bytecodes.items():
-            provider.put_bytecode(code_hash, code)
-        # receipts
-        for i, receipt in enumerate(out.receipts):
-            provider.put_receipt(first_tx_num + i, receipt)
+        write_execution_output(provider, block_num, first_tx_num, out)
 
     def unwind(self, provider: DatabaseProvider, inp: UnwindInput) -> None:
         """Restore plain state from changesets for blocks > unwind_to."""
@@ -96,3 +70,38 @@ class ExecutionStage(Stage):
                 provider.put_storage(addr, slot, prev_val)
         provider.prune_changesets_above(inp.unwind_to)
         provider.prune_receipts_above(inp.unwind_to)
+
+
+def write_execution_output(provider: DatabaseProvider, block_num: int,
+                           first_tx_num: int, out) -> None:
+    """Write a `BlockExecutionOutput`: plain state, changesets, receipts.
+
+    Shared by the staged-sync ExecutionStage and the engine live-tip path
+    (which targets an overlay transaction instead of the real DB)."""
+    changes = out.changes
+    # changesets: previous images (wiped storage records its whole map)
+    for addr, prev in changes.accounts.items():
+        provider.record_account_change(block_num, addr, prev)
+    wiped_prev: dict[bytes, dict[bytes, int]] = {}
+    for addr in changes.wiped_storage:
+        wiped_prev[addr] = provider.account_storage(addr)
+        for slot, prev_val in wiped_prev[addr].items():
+            provider.record_storage_change(block_num, addr, slot, prev_val)
+    for addr, slots in changes.storage.items():
+        already = wiped_prev.get(addr, {})
+        for slot, prev_val in slots.items():
+            if slot not in already:
+                provider.record_storage_change(block_num, addr, slot, prev_val)
+    # plain state
+    for addr in changes.wiped_storage:
+        provider.clear_account_storage(addr)
+    for addr, acc in out.post_accounts.items():
+        provider.put_account(addr, acc)
+    for addr, slots in out.post_storage.items():
+        for slot, val in slots.items():
+            provider.put_storage(addr, slot, val)
+    for code_hash, code in changes.new_bytecodes.items():
+        provider.put_bytecode(code_hash, code)
+    # receipts
+    for i, receipt in enumerate(out.receipts):
+        provider.put_receipt(first_tx_num + i, receipt)

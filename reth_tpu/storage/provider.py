@@ -305,6 +305,10 @@ class DatabaseProvider:
         raw = self.tx.get(Tables.HashedAccounts.name, hashed_addr)
         return T.decode_account(raw) if raw else None
 
+    def clear_hashed_storage(self, hashed_addr: bytes):
+        """Drop every hashed-storage entry of an account (selfdestruct wipe)."""
+        self.tx.delete(Tables.HashedStorages.name, hashed_addr)
+
     def put_hashed_storage(self, hashed_addr: bytes, hashed_slot: bytes, value: int):
         self._replace_dup(
             Tables.HashedStorages.name, hashed_addr, hashed_slot,

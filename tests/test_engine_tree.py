@@ -1,0 +1,168 @@
+"""Engine tree tests: newPayload/FCU flow, reorgs, persistence.
+
+Reference analogue: the engine-tree integration tests
+(crates/engine/tree/src/tree/tests.rs) — synthetic payloads driven
+through the handler, tree state asserted.
+"""
+
+import pytest
+
+from reth_tpu.engine import EngineTree
+from reth_tpu.engine.tree import PayloadStatusKind
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.primitives.types import Block, Header
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def make_env(n_blocks=5):
+    alice = Wallet(0xA11CE)
+    bob = Wallet(0xB0B)
+    builder = ChainBuilder(
+        {alice.address: Account(balance=10**21), bob.address: Account(balance=10**20)},
+        committer=CPU,
+    )
+    for i in range(n_blocks):
+        builder.build_block([alice.transfer(bob.address, 10**15 + i)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=2)
+    return builder, factory, tree, alice, bob
+
+
+def test_new_payload_chain_valid():
+    builder, factory, tree, *_ = make_env()
+    for blk in builder.blocks[1:]:
+        st = tree.on_new_payload(blk)
+        assert st.status is PayloadStatusKind.VALID, st.validation_error
+    assert len(tree.blocks) == 5
+
+
+def test_fcu_advances_and_persists():
+    builder, factory, tree, *_ = make_env()
+    for blk in builder.blocks[1:]:
+        assert tree.on_new_payload(blk).status is PayloadStatusKind.VALID
+        st = tree.on_forkchoice_updated(blk.hash)
+        assert st.status is PayloadStatusKind.VALID
+    # threshold 2: blocks 1..3 persisted, 4..5 in memory
+    assert tree.persisted_number == 3
+    p = factory.provider()
+    assert p.last_block_number() == 3
+    assert p.header_by_number(3).state_root == builder.blocks[3].header.state_root
+    assert p.stage_checkpoint("Finish") == 3
+    # overlay view still sees the in-memory tip
+    ov = tree.overlay_provider()
+    assert ov.last_block_number() == 5
+    assert ov.header_by_number(5).hash == builder.blocks[5].hash
+
+
+def test_unknown_parent_is_syncing():
+    builder, factory, tree, *_ = make_env(2)
+    st = tree.on_new_payload(builder.blocks[2])  # parent (block 1) not sent
+    assert st.status is PayloadStatusKind.SYNCING
+
+
+def test_invalid_state_root_rejected_and_descendants():
+    builder, factory, tree, *_ = make_env(2)
+    blk1 = builder.blocks[1]
+    bad_header = Header(**{**blk1.header.__dict__, "state_root": b"\x13" * 32})
+    bad = Block(bad_header, blk1.transactions, (), blk1.withdrawals)
+    st = tree.on_new_payload(bad)
+    assert st.status is PayloadStatusKind.INVALID
+    assert "state root mismatch" in st.validation_error
+    # a child of the invalid block is rejected as invalid ancestor
+    child_header = Header(**{**builder.blocks[2].header.__dict__, "parent_hash": bad.hash})
+    child = Block(child_header, builder.blocks[2].transactions, (), builder.blocks[2].withdrawals)
+    st2 = tree.on_new_payload(child)
+    assert st2.status is PayloadStatusKind.INVALID
+    # FCU to the invalid head also reports invalid
+    assert tree.on_forkchoice_updated(bad.hash).status is PayloadStatusKind.INVALID
+
+
+def test_reorg_between_forks():
+    """Two competing blocks at the same height; FCU flips between them."""
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=10)
+
+    # fork A: transfer 111; fork B (different timestamp): transfer 222
+    fork_a = builder.build_block([alice.transfer(b"\xaa" * 20, 111)])
+    # rebuild from genesis for fork B
+    alice_b = Wallet(0xA11CE)
+    builder_b = ChainBuilder({alice_b.address: Account(balance=10**21)}, committer=CPU)
+    fork_b = builder_b.build_block([alice_b.transfer(b"\xbb" * 20, 222)], timestamp=24)
+
+    assert tree.on_new_payload(fork_a).status is PayloadStatusKind.VALID
+    assert tree.on_new_payload(fork_b).status is PayloadStatusKind.VALID
+    assert tree.on_forkchoice_updated(fork_a.hash).status is PayloadStatusKind.VALID
+    assert tree.overlay_provider().account(b"\xaa" * 20).balance == 111
+    assert tree.overlay_provider().account(b"\xbb" * 20) is None
+    # reorg to fork B
+    assert tree.on_forkchoice_updated(fork_b.hash).status is PayloadStatusKind.VALID
+    assert tree.overlay_provider().account(b"\xbb" * 20).balance == 222
+    assert tree.overlay_provider().account(b"\xaa" * 20) is None
+
+
+def test_replay_persisted_block_is_valid():
+    builder, factory, tree, *_ = make_env()
+    for blk in builder.blocks[1:]:
+        tree.on_new_payload(blk)
+        tree.on_forkchoice_updated(blk.hash)
+    assert tree.persisted_number == 3
+    # CL re-sends an already-persisted payload after a restart
+    st = tree.on_new_payload(builder.blocks[2])
+    assert st.status is PayloadStatusKind.VALID
+
+
+def test_overlay_provider_unknown_head_raises():
+    builder, factory, tree, *_ = make_env(1)
+    with pytest.raises(KeyError):
+        tree.overlay_provider(b"\x77" * 32)
+
+
+def test_deep_reorg_unwinds_persisted_chain():
+    """A fork branching below the persisted tip triggers a pipeline unwind."""
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    for i in range(4):
+        builder.build_block([alice.transfer(b"\xaa" * 20, 100 + i)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=1)
+    for blk in builder.blocks[1:]:
+        assert tree.on_new_payload(blk).status is PayloadStatusKind.VALID
+        tree.on_forkchoice_updated(blk.hash)
+    assert tree.persisted_number == 3  # blocks 1..3 persisted, 4 in memory
+
+    # competing fork branching at block 2 (persisted, below the tip)
+    alice_b = Wallet(0xA11CE)
+    builder_b = ChainBuilder({alice_b.address: Account(balance=10**21)}, committer=CPU)
+    for i in range(2):
+        builder_b.build_block([alice_b.transfer(b"\xaa" * 20, 100 + i)])
+    fork3 = builder_b.build_block([alice_b.transfer(b"\xbb" * 20, 999)], timestamp=100)
+    assert fork3.header.parent_hash == builder.blocks[2].hash  # same prefix
+    st = tree.on_new_payload(fork3)
+    assert st.status is PayloadStatusKind.SYNCING  # buffered: parent below tip
+    st = tree.on_forkchoice_updated(fork3.hash)
+    assert st.status is PayloadStatusKind.VALID, st.validation_error
+    p = tree.overlay_provider()
+    assert p.account(b"\xbb" * 20).balance == 999
+    assert p.account(b"\xaa" * 20).balance == 100 + 101  # only blocks 1-2
+
+
+def test_canon_notifications():
+    builder, factory, tree, *_ = make_env(2)
+    seen = []
+    tree.canon_listeners.append(lambda chain: seen.append([b.number for b in chain]))
+    tree.on_new_payload(builder.blocks[1])
+    tree.on_forkchoice_updated(builder.blocks[1].hash)
+    tree.on_new_payload(builder.blocks[2])
+    tree.on_forkchoice_updated(builder.blocks[2].hash)
+    assert seen == [[1], [1, 2]]

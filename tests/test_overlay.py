@@ -1,0 +1,86 @@
+"""OverlayTx semantics: merged reads, tombstones, layer application."""
+
+from reth_tpu.storage import MemDb
+from reth_tpu.storage.overlay import OverlayTx, apply_layer
+
+
+def base_db():
+    db = MemDb()
+    with db.tx_mut() as tx:
+        tx.put("t", b"a", b"1")
+        tx.put("t", b"b", b"2")
+        tx.put("d", b"k", b"aaa", dupsort=True)
+        tx.put("d", b"k", b"bbb", dupsort=True)
+    return db
+
+
+def test_read_through_and_shadow():
+    db = base_db()
+    ov = OverlayTx(db.tx())
+    assert ov.get("t", b"a") == b"1"
+    ov.put("t", b"a", b"9")
+    ov.put("t", b"c", b"3")
+    assert ov.get("t", b"a") == b"9"
+    assert ov.get("t", b"c") == b"3"
+    assert db.tx().get("t", b"a") == b"1"  # base untouched
+    assert [k for k, _ in ov.cursor("t").walk()] == [b"a", b"b", b"c"]
+
+
+def test_tombstone_delete():
+    db = base_db()
+    ov = OverlayTx(db.tx())
+    assert ov.delete("t", b"a")
+    assert ov.get("t", b"a") is None
+    assert [k for k, _ in ov.cursor("t").walk()] == [b"b"]
+    assert db.tx().get("t", b"a") == b"1"
+
+
+def test_dupsort_copy_on_write():
+    db = base_db()
+    ov = OverlayTx(db.tx())
+    ov.put("d", b"k", b"ccc", dupsort=True)
+    assert ov.get_dups("d", b"k") == [b"aaa", b"bbb", b"ccc"]
+    assert ov.delete("d", b"k", b"aaa")
+    assert ov.get_dups("d", b"k") == [b"bbb", b"ccc"]
+    assert db.tx().get_dups("d", b"k") == [b"aaa", b"bbb"]
+    assert list(ov.cursor("d").walk_dup(b"k")) == [(b"k", b"bbb"), (b"k", b"ccc")]
+
+
+def test_layer_stack():
+    db = base_db()
+    l1 = {}
+    ov1 = OverlayTx(db.tx(), [], l1)
+    ov1.put("t", b"a", b"L1")
+    ov1.delete("t", b"b")
+    ov2 = OverlayTx(db.tx(), [l1])
+    assert ov2.get("t", b"a") == b"L1"
+    assert ov2.get("t", b"b") is None
+    ov2.put("t", b"b", b"L2")  # resurrect in upper layer
+    assert ov2.get("t", b"b") == b"L2"
+    assert [k for k, _ in ov2.cursor("t").walk()] == [b"a", b"b"]
+
+
+def test_apply_layer_roundtrip():
+    db = base_db()
+    layer = {}
+    ov = OverlayTx(db.tx(), [], layer)
+    ov.put("t", b"a", b"new")
+    ov.delete("t", b"b")
+    ov.put("d", b"k", b"zzz", dupsort=True)
+    ov.clear("x")  # clearing a non-existent table is fine
+    with db.tx_mut() as tx:
+        apply_layer(tx, layer)
+    t = db.tx()
+    assert t.get("t", b"a") == b"new"
+    assert t.get("t", b"b") is None
+    assert t.get_dups("d", b"k") == [b"aaa", b"bbb", b"zzz"]
+
+
+def test_clear_table():
+    db = base_db()
+    ov = OverlayTx(db.tx())
+    ov.clear("t")
+    assert ov.get("t", b"a") is None
+    assert list(ov.cursor("t").walk()) == []
+    ov.put("t", b"z", b"9")
+    assert [k for k, _ in ov.cursor("t").walk()] == [b"z"]
