@@ -1,0 +1,35 @@
+"""Dev-mode local miner: drives the FCU/payload loop without a CL.
+
+Reference analogue: `LocalMiner` (crates/engine/local/src/lib.rs) — in
+dev mode the node mines its own blocks from the pool on an interval or
+on demand.
+"""
+
+from __future__ import annotations
+
+from ..consensus.validation import calc_next_base_fee
+from ..payload import PayloadAttributes, build_payload
+from .tree import EngineTree, PayloadStatusKind
+
+
+class LocalMiner:
+    def __init__(self, tree: EngineTree, pool, block_time: int = 12):
+        self.tree = tree
+        self.pool = pool
+        self.block_time = block_time
+
+    def mine_block(self, timestamp: int | None = None):
+        """Build one block from the pool, submit it, make it canonical."""
+        head = self.tree.head_hash
+        overlay = self.tree.overlay_provider(head)
+        parent = overlay.header_by_number(overlay.block_number(head))
+        attrs = PayloadAttributes(
+            timestamp=timestamp if timestamp is not None else parent.timestamp + self.block_time,
+        )
+        block = build_payload(self.tree, self.pool, head, attrs)
+        st = self.tree.on_new_payload(block)
+        if st.status is not PayloadStatusKind.VALID:
+            raise RuntimeError(f"self-mined block invalid: {st.validation_error}")
+        self.tree.on_forkchoice_updated(block.hash)
+        self.pool.on_canonical_state_change(calc_next_base_fee(block.header))
+        return block

@@ -1,0 +1,105 @@
+"""The launched node: components + RPC servers + dev miner.
+
+Reference analogue: `EngineNodeLauncher::launch_node`
+(crates/node/builder/src/launch/engine.rs:70-419): provider factory →
+genesis → components (pool, payload, consensus, executor) → add-ons
+(RPC modules, engine API) → launched handle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..consensus import EthBeaconConsensus
+from ..engine import EngineTree
+from ..engine.local import LocalMiner
+from ..evm import EvmConfig
+from ..payload import PayloadBuilderService
+from ..pool import TransactionPool
+from ..primitives.types import Account, Header
+from ..rpc import EngineApi, EthApi, RpcServer
+from ..rpc.net import NetApi, TxpoolApi, Web3Api
+from ..storage import MemDb, ProviderFactory
+from ..storage.genesis import init_genesis
+from ..trie.committer import TrieCommitter
+
+
+@dataclass
+class NodeConfig:
+    chain_id: int = 1
+    datadir: str | Path | None = None
+    dev: bool = False                 # dev mode: local miner enabled
+    http_port: int = 0                # 0 = ephemeral
+    authrpc_port: int = 0
+    persistence_threshold: int = 2
+    genesis_header: Header | None = None
+    genesis_alloc: dict[bytes, Account] = field(default_factory=dict)
+    genesis_storage: dict | None = None
+    genesis_codes: dict | None = None
+
+
+class Node:
+    """A launched node (in-process; networking arrives as its own layer)."""
+
+    def __init__(self, config: NodeConfig, committer: TrieCommitter | None = None):
+        self.config = config
+        self.committer = committer or TrieCommitter()
+        db_path = Path(config.datadir) / "db.bin" if config.datadir else None
+        self.factory = ProviderFactory(MemDb(db_path))
+        if config.genesis_header is not None:
+            init_genesis(
+                self.factory, config.genesis_header, config.genesis_alloc,
+                config.genesis_storage, config.genesis_codes, self.committer,
+            )
+        self.consensus = EthBeaconConsensus(self.committer)
+        self.tree = EngineTree(
+            self.factory, self.committer, self.consensus,
+            EvmConfig(chain_id=config.chain_id),
+            persistence_threshold=config.persistence_threshold,
+        )
+        self.pool = TransactionPool(lambda: self.tree.overlay_provider())
+        with self.factory.provider() as p:
+            tip = p.header_by_number(p.last_block_number())
+        if tip is not None and tip.base_fee_per_gas is not None:
+            self.pool.base_fee = tip.base_fee_per_gas
+        self.payload_service = PayloadBuilderService(self.tree, self.pool)
+        self.miner = LocalMiner(self.tree, self.pool) if config.dev else None
+
+        # pool maintenance rides canonical-state notifications, so the pool
+        # stays correct in CL-driven mode too (reference src/maintain.rs)
+        def _maintain_pool(chain):
+            if chain:
+                from ..consensus.validation import calc_next_base_fee
+
+                self.pool.on_canonical_state_change(
+                    calc_next_base_fee(chain[-1].block.header)
+                )
+
+        self.tree.canon_listeners.append(_maintain_pool)
+
+        # RPC servers: public + auth (engine) — reference serves the engine
+        # API on a separate JWT-authed port (rpc-builder auth server)
+        import threading
+
+        shared_lock = threading.RLock()
+        self.eth_api = EthApi(self.tree, self.pool, config.chain_id)
+        self.rpc = RpcServer(port=config.http_port, lock=shared_lock)
+        self.rpc.register(self.eth_api)
+        self.rpc.register(NetApi(config.chain_id))
+        self.rpc.register(Web3Api())
+        self.rpc.register(TxpoolApi(self.pool))
+        self.engine_api = EngineApi(self.tree, self.payload_service)
+        self.authrpc = RpcServer(port=config.authrpc_port, lock=shared_lock)
+        self.authrpc.register(self.engine_api)
+        self.authrpc.register(self.eth_api)  # CLs also query eth_ on authrpc
+
+    def start_rpc(self) -> tuple[int, int]:
+        """Start both HTTP servers; returns (http_port, authrpc_port)."""
+        return self.rpc.start(), self.authrpc.start()
+
+    def stop(self):
+        self.rpc.stop()
+        self.authrpc.stop()
+        if self.factory.db is not None and hasattr(self.factory.db, "flush"):
+            self.factory.db.flush()

@@ -1,0 +1,10 @@
+"""Payload building: assemble blocks from the pool for the Engine API.
+
+Reference analogue: crates/payload — `PayloadBuilderService`/`PayloadJob`
+(builder/src/service.rs), `BasicPayloadJobGenerator`
+(basic/src/lib.rs:57), `EthereumPayloadBuilder` (crates/ethereum/payload).
+"""
+
+from .builder import PayloadAttributes, PayloadBuilderService, build_payload
+
+__all__ = ["PayloadAttributes", "PayloadBuilderService", "build_payload"]

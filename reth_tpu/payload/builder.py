@@ -1,0 +1,145 @@
+"""Block assembly from pool transactions.
+
+Reference analogue: `EthereumPayloadBuilder::try_build`
+(crates/ethereum/payload/src/lib.rs) — pull `best_transactions`, execute
+greedily under the gas limit, skip invalid txs, seal with real roots.
+The built block is re-validated when the CL returns it via newPayload
+(same trust model as the reference).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from ..consensus.validation import calc_next_base_fee
+from ..engine.tree import EngineTree
+from ..evm import BlockExecutor, EvmConfig
+from ..evm.executor import InvalidTransaction, ProviderStateSource
+from ..evm.interpreter import BlockEnv
+from ..evm.state import EvmState
+from ..primitives.rlp import rlp_encode
+from ..primitives.types import Block, Header, Receipt, Transaction, Withdrawal, logs_bloom
+from ..storage.overlay import OverlayTx
+from ..storage.provider import DatabaseProvider
+from ..trie.state_root import ordered_trie_root
+
+
+@dataclass
+class PayloadAttributes:
+    """engine_forkchoiceUpdated payload attributes (V2/V3 shape)."""
+
+    timestamp: int
+    prev_randao: bytes = b"\x00" * 32
+    suggested_fee_recipient: bytes = b"\x00" * 20
+    withdrawals: tuple[Withdrawal, ...] = ()
+    parent_beacon_block_root: bytes | None = None
+
+
+def build_payload(
+    tree: EngineTree,
+    pool,
+    parent_hash: bytes,
+    attrs: PayloadAttributes,
+) -> Block:
+    """Assemble a sealed block on top of ``parent_hash``."""
+    overlay = tree.overlay_provider(parent_hash)
+    parent_num = overlay.block_number(parent_hash)
+    parent = overlay.header_by_number(parent_num)
+    base_fee = calc_next_base_fee(parent)
+    env = BlockEnv(
+        number=parent.number + 1,
+        timestamp=attrs.timestamp,
+        coinbase=attrs.suggested_fee_recipient,
+        gas_limit=parent.gas_limit,
+        base_fee=base_fee,
+        prev_randao=attrs.prev_randao,
+        chain_id=tree.config.chain_id,
+    )
+    executor = BlockExecutor(ProviderStateSource(overlay), tree.config)
+    state = EvmState(executor.source)
+    selected: list[Transaction] = []
+    receipts: list[Receipt] = []
+    cumulative_gas = 0
+    for tx in pool.best_transactions(base_fee):
+        if cumulative_gas + tx.gas_limit > env.gas_limit:
+            continue
+        try:
+            sender = tx.recover_sender()
+            result = executor._execute_tx(
+                state, env, tx, sender, env.gas_limit - cumulative_gas
+            )
+        except (InvalidTransaction, ValueError):
+            continue  # skip; pool maintenance will evict later
+        cumulative_gas += result.gas_used
+        selected.append(tx)
+        receipts.append(Receipt(
+            tx_type=tx.tx_type, success=result.success,
+            cumulative_gas_used=cumulative_gas, logs=result.receipt.logs,
+        ))
+    # withdrawals
+    for w in attrs.withdrawals:
+        if w.amount:
+            state._capture_account_change(w.address)
+            state.add_balance(w.address, w.amount * 10**9)
+
+    # state root over a scratch overlay (not retained; newPayload re-derives)
+    post_accounts, post_storage = state.final_state()
+    out = _MiniOutput(state.changes, post_accounts, post_storage, receipts)
+    scratch = DatabaseProvider(OverlayTx(tree.factory.db.tx(),
+                                         tree._chain_layers(parent_hash), {}))
+    root = tree._state_root_job(scratch, out)
+
+    header = Header(
+        parent_hash=parent_hash,
+        beneficiary=attrs.suggested_fee_recipient,
+        state_root=root,
+        transactions_root=ordered_trie_root([t.encode() for t in selected], tree.committer),
+        receipts_root=ordered_trie_root([r.encode_2718() for r in receipts], tree.committer),
+        logs_bloom=logs_bloom([l for r in receipts for l in r.logs]),
+        number=parent.number + 1,
+        gas_limit=env.gas_limit,
+        gas_used=cumulative_gas,
+        timestamp=attrs.timestamp,
+        mix_hash=attrs.prev_randao,
+        base_fee_per_gas=base_fee,
+        withdrawals_root=ordered_trie_root(
+            [rlp_encode(w.rlp_fields()) for w in attrs.withdrawals], tree.committer
+        ),
+        blob_gas_used=None,
+        excess_blob_gas=None,
+        parent_beacon_block_root=attrs.parent_beacon_block_root,
+    )
+    return Block(header, tuple(selected), (), tuple(attrs.withdrawals))
+
+
+@dataclass
+class _MiniOutput:
+    changes: object
+    post_accounts: dict
+    post_storage: dict
+    receipts: list
+
+
+class PayloadBuilderService:
+    """payload_id → built block store (reference PayloadBuilderService).
+
+    Bounded: only the newest ``MAX_JOBS`` payloads are retained (reference
+    jobs resolve/expire; a CL issues one per slot)."""
+
+    MAX_JOBS = 16
+
+    def __init__(self, tree: EngineTree, pool):
+        self.tree = tree
+        self.pool = pool
+        self.jobs: dict[bytes, Block] = {}
+
+    def new_payload_job(self, parent_hash: bytes, attrs: PayloadAttributes) -> bytes:
+        payload_id = os.urandom(8)
+        self.jobs[payload_id] = build_payload(self.tree, self.pool, parent_hash, attrs)
+        while len(self.jobs) > self.MAX_JOBS:
+            self.jobs.pop(next(iter(self.jobs)))
+        return payload_id
+
+    def get_payload(self, payload_id: bytes) -> Block | None:
+        return self.jobs.get(payload_id)

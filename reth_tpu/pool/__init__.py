@@ -1,0 +1,12 @@
+"""Transaction pool: validation, subpools, best-transaction ordering.
+
+Reference analogue: crates/transaction-pool — the `TransactionPool` trait
+(src/traits.rs:114), the pending/queued/basefee subpool state machine
+(src/pool/), `BestTransactions` (src/pool/best.rs), validation
+(src/validate/), and the canonical-state maintenance loop
+(src/maintain.rs).
+"""
+
+from .pool import PoolConfig, PoolError, TransactionPool
+
+__all__ = ["PoolConfig", "PoolError", "TransactionPool"]

@@ -1,0 +1,211 @@
+"""The transaction pool.
+
+Subpool model (reference src/pool/mod.rs state machine):
+
+- **pending**: executable now — contiguous nonces from the account's
+  on-chain nonce, fee cap >= current base fee.
+- **basefee**: nonce-contiguous but priced below the current base fee;
+  promoted when the base fee falls.
+- **queued**: nonce gap; promoted when the gap fills.
+
+``best_transactions`` yields pending txs ordered by effective tip (then
+insertion order), never yielding a later nonce before an earlier one per
+sender. ``on_canonical_state_change`` is the maintenance loop: drops
+mined/stale txs and re-buckets everything against the new state.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+from ..primitives.types import Transaction
+
+MIN_PRICE_BUMP_PERCENT = 10  # replacement bump (reference: 10%)
+
+
+class PoolError(Exception):
+    pass
+
+
+@dataclass
+class PoolConfig:
+    max_account_slots: int = 16      # txs per sender
+    max_pool_size: int = 10_000
+    minimal_protocol_fee: int = 0
+
+
+@dataclass
+class PooledTx:
+    tx: Transaction
+    sender: bytes
+    submission_id: int
+    cost: int  # max gas cost + value
+
+    @property
+    def nonce(self) -> int:
+        return self.tx.nonce
+
+    def effective_tip(self, base_fee: int) -> int:
+        if self.tx.tx_type >= 2:
+            if self.tx.max_fee_per_gas < base_fee:
+                return -1
+            return min(self.tx.max_priority_fee_per_gas,
+                       self.tx.max_fee_per_gas - base_fee)
+        return self.tx.gas_price - base_fee
+
+    def max_fee(self) -> int:
+        return self.tx.max_fee_per_gas if self.tx.tx_type >= 2 else self.tx.gas_price
+
+
+class TransactionPool:
+    """State-aware pool over a read-provider factory."""
+
+    def __init__(self, state_reader, config: PoolConfig | None = None):
+        """``state_reader()`` → object with .account(addr) and the current
+        base fee via ``state_reader.base_fee`` callable/attribute."""
+        self.state_reader = state_reader
+        self.config = config or PoolConfig()
+        self.by_sender: dict[bytes, dict[int, PooledTx]] = {}
+        self.by_hash: dict[bytes, PooledTx] = {}
+        self._submission_counter = itertools.count()
+        self.base_fee: int = 0
+
+    # -- submission -----------------------------------------------------------
+
+    def add_transaction(self, tx: Transaction) -> bytes:
+        """Validate + insert; returns the tx hash. Raises PoolError."""
+        h = tx.hash
+        if h in self.by_hash:
+            raise PoolError("already known")
+        try:
+            sender = tx.recover_sender()
+        except ValueError as e:
+            raise PoolError(f"invalid signature: {e}")
+        if tx.tx_type >= 2 and tx.max_priority_fee_per_gas > tx.max_fee_per_gas:
+            raise PoolError("priority fee exceeds max fee")
+        if tx.gas_limit > 30_000_000:
+            raise PoolError("gas limit too high")
+        state = self.state_reader()
+        acct = state.account(sender)
+        nonce_on_chain = acct.nonce if acct else 0
+        balance = acct.balance if acct else 0
+        if tx.nonce < nonce_on_chain:
+            raise PoolError("nonce too low")
+        cost = tx.gas_limit * (tx.max_fee_per_gas if tx.tx_type >= 2 else tx.gas_price) + tx.value
+        if cost > balance:
+            raise PoolError("insufficient funds")
+        sender_txs = self.by_sender.setdefault(sender, {})
+        existing = sender_txs.get(tx.nonce)
+        if existing is not None:
+            bump = existing.max_fee() * (100 + MIN_PRICE_BUMP_PERCENT) // 100
+            if self._fee_of(tx) < bump:
+                raise PoolError("replacement underpriced")
+            self.by_hash.pop(existing.tx.hash, None)
+        if len(sender_txs) >= self.config.max_account_slots and existing is None:
+            raise PoolError("sender slot limit")
+        if len(self.by_hash) >= self.config.max_pool_size:
+            raise PoolError("pool full")
+        ptx = PooledTx(tx, sender, next(self._submission_counter), cost)
+        sender_txs[tx.nonce] = ptx
+        self.by_hash[h] = ptx
+        return h
+
+    def _fee_of(self, tx: Transaction) -> int:
+        return tx.max_fee_per_gas if tx.tx_type >= 2 else tx.gas_price
+
+    # -- queries ---------------------------------------------------------------
+
+    def get(self, tx_hash: bytes) -> Transaction | None:
+        ptx = self.by_hash.get(tx_hash)
+        return ptx.tx if ptx else None
+
+    def contains(self, tx_hash: bytes) -> bool:
+        return tx_hash in self.by_hash
+
+    def __len__(self) -> int:
+        return len(self.by_hash)
+
+    def pooled_nonce(self, sender: bytes) -> int | None:
+        """Highest contiguous pooled nonce + 1 for a sender (for RPC
+        'pending' transaction count)."""
+        state = self.state_reader()
+        acct = state.account(sender)
+        nonce = acct.nonce if acct else 0
+        txs = self.by_sender.get(sender, {})
+        while nonce in txs:
+            nonce += 1
+        return nonce
+
+    def _bucket(self, ptx: PooledTx, nonce_on_chain: int, pending_gap: bool) -> str:
+        if pending_gap:
+            return "queued"
+        if ptx.effective_tip(self.base_fee) < 0:
+            return "basefee"
+        return "pending"
+
+    def content(self) -> dict[str, dict[bytes, dict[int, Transaction]]]:
+        """txpool_content-shaped view: {pending|queued: {sender: {nonce: tx}}}."""
+        out = {"pending": {}, "queued": {}}
+        state = self.state_reader()
+        for sender, txs in self.by_sender.items():
+            acct = state.account(sender)
+            next_nonce = acct.nonce if acct else 0
+            for nonce in sorted(txs):
+                ptx = txs[nonce]
+                gap = nonce > next_nonce
+                bucket = self._bucket(ptx, next_nonce, gap)
+                key = "pending" if bucket == "pending" else "queued"
+                out[key].setdefault(sender, {})[nonce] = ptx.tx
+                if not gap:
+                    next_nonce = nonce + 1
+        return out
+
+    # -- best transactions ------------------------------------------------------
+
+    def best_transactions(self, base_fee: int | None = None):
+        """Yield executable txs, highest effective tip first, nonce-ordered
+        per sender (reference BestTransactions)."""
+        base_fee = self.base_fee if base_fee is None else base_fee
+        state = self.state_reader()
+        heads: dict[bytes, int] = {}  # sender -> next yieldable nonce
+        for sender in self.by_sender:
+            acct = state.account(sender)
+            heads[sender] = acct.nonce if acct else 0
+        candidates: list[PooledTx] = []
+        for sender, txs in self.by_sender.items():
+            ptx = txs.get(heads[sender])
+            if ptx is not None and ptx.effective_tip(base_fee) >= 0:
+                candidates.append(ptx)
+        while candidates:
+            candidates.sort(key=lambda p: (-p.effective_tip(base_fee), p.submission_id))
+            best = candidates.pop(0)
+            yield best.tx
+            heads[best.sender] += 1
+            nxt = self.by_sender[best.sender].get(heads[best.sender])
+            if nxt is not None and nxt.effective_tip(base_fee) >= 0:
+                candidates.append(nxt)
+
+    # -- maintenance -------------------------------------------------------------
+
+    def on_canonical_state_change(self, base_fee: int) -> None:
+        """New head: drop mined/underfunded txs, update the base fee.
+
+        Reference: the maintenance task (src/maintain.rs) driven by
+        CanonStateNotifications.
+        """
+        self.base_fee = base_fee
+        state = self.state_reader()
+        for sender in list(self.by_sender):
+            acct = state.account(sender)
+            nonce = acct.nonce if acct else 0
+            balance = acct.balance if acct else 0
+            txs = self.by_sender[sender]
+            for n in [n for n in txs if n < nonce]:
+                self.by_hash.pop(txs[n].tx.hash, None)
+                del txs[n]
+            for n in [n for n in txs if txs[n].cost > balance]:
+                self.by_hash.pop(txs[n].tx.hash, None)
+                del txs[n]
+            if not txs:
+                del self.by_sender[sender]

@@ -1,0 +1,14 @@
+"""JSON-RPC: eth/net/web3/txpool namespaces + the Engine API.
+
+Reference analogue: crates/rpc — the jsonrpsee module registry
+(rpc-builder), the eth API trait stack (rpc-eth-api), and the Engine API
+server (rpc-engine-api/src/engine_api.rs). Transport here is a stdlib
+threaded HTTP server (no external deps); module selection mirrors
+`RethRpcModule` names.
+"""
+
+from .server import RpcServer, RpcError
+from .eth import EthApi
+from .engine_api import EngineApi
+
+__all__ = ["RpcServer", "RpcError", "EthApi", "EngineApi"]

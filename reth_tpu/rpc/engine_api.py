@@ -1,0 +1,213 @@
+"""The engine_* namespace: the CL ↔ EL boundary.
+
+Reference analogue: crates/rpc/rpc-engine-api/src/engine_api.rs —
+newPayloadV1-V3, forkchoiceUpdatedV1-V3, getPayloadV1-V3, capabilities.
+Payload JSON ↔ Block conversion follows the ExecutionPayload schema.
+"""
+
+from __future__ import annotations
+
+from ..engine.tree import EngineTree, PayloadStatusKind
+from ..payload import PayloadAttributes, PayloadBuilderService
+from ..primitives.types import Block, Header, Transaction, Withdrawal, EMPTY_OMMER_ROOT_HASH
+from .convert import data, parse_data, parse_qty, qty
+from .server import RpcError
+
+CAPABILITIES = [
+    "engine_newPayloadV1", "engine_newPayloadV2", "engine_newPayloadV3",
+    "engine_forkchoiceUpdatedV1", "engine_forkchoiceUpdatedV2",
+    "engine_forkchoiceUpdatedV3",
+    "engine_getPayloadV1", "engine_getPayloadV2", "engine_getPayloadV3",
+    "engine_exchangeCapabilities",
+]
+
+
+def payload_to_block(payload: dict, committer=None) -> Block:
+    """ExecutionPayloadV1/V2/V3 JSON → sealed Block.
+
+    ``committer`` must be the node's TrieCommitter — constructing a default
+    one here would spin up (and compile) a fresh device hasher per request.
+    """
+    withdrawals = None
+    if "withdrawals" in payload and payload["withdrawals"] is not None:
+        withdrawals = tuple(
+            Withdrawal(
+                parse_qty(w["index"]), parse_qty(w["validatorIndex"]),
+                parse_data(w["address"]), parse_qty(w["amount"]),
+            )
+            for w in payload["withdrawals"]
+        )
+    txs = tuple(Transaction.decode(parse_data(t)) for t in payload["transactions"])
+    from ..trie.state_root import ordered_trie_root
+    from ..primitives.rlp import rlp_encode
+
+    header = Header(
+        parent_hash=parse_data(payload["parentHash"]),
+        ommers_hash=EMPTY_OMMER_ROOT_HASH,
+        beneficiary=parse_data(payload["feeRecipient"]),
+        state_root=parse_data(payload["stateRoot"]),
+        transactions_root=ordered_trie_root(
+            [parse_data(t) for t in payload["transactions"]], committer
+        ),
+        receipts_root=parse_data(payload["receiptsRoot"]),
+        logs_bloom=parse_data(payload["logsBloom"]),
+        difficulty=0,
+        number=parse_qty(payload["blockNumber"]),
+        gas_limit=parse_qty(payload["gasLimit"]),
+        gas_used=parse_qty(payload["gasUsed"]),
+        timestamp=parse_qty(payload["timestamp"]),
+        extra_data=parse_data(payload["extraData"]),
+        mix_hash=parse_data(payload["prevRandao"]),
+        nonce=b"\x00" * 8,
+        base_fee_per_gas=parse_qty(payload["baseFeePerGas"]),
+        withdrawals_root=(
+            ordered_trie_root([rlp_encode(w.rlp_fields()) for w in withdrawals], committer)
+            if withdrawals is not None else None
+        ),
+        blob_gas_used=parse_qty(payload["blobGasUsed"]) if "blobGasUsed" in payload else None,
+        excess_blob_gas=parse_qty(payload["excessBlobGas"]) if "excessBlobGas" in payload else None,
+        parent_beacon_block_root=None,
+    )
+    return Block(header, txs, (), withdrawals)
+
+
+def block_to_payload(block: Block) -> dict:
+    h = block.header
+    out = {
+        "parentHash": data(h.parent_hash),
+        "feeRecipient": data(h.beneficiary),
+        "stateRoot": data(h.state_root),
+        "receiptsRoot": data(h.receipts_root),
+        "logsBloom": data(h.logs_bloom),
+        "prevRandao": data(h.mix_hash),
+        "blockNumber": qty(h.number),
+        "gasLimit": qty(h.gas_limit),
+        "gasUsed": qty(h.gas_used),
+        "timestamp": qty(h.timestamp),
+        "extraData": data(h.extra_data),
+        "baseFeePerGas": qty(h.base_fee_per_gas or 0),
+        "blockHash": data(h.hash),
+        "transactions": [data(tx.encode()) for tx in block.transactions],
+    }
+    if block.withdrawals is not None:
+        out["withdrawals"] = [
+            {
+                "index": qty(w.index), "validatorIndex": qty(w.validator_index),
+                "address": data(w.address), "amount": qty(w.amount),
+            }
+            for w in block.withdrawals
+        ]
+    if h.blob_gas_used is not None:
+        out["blobGasUsed"] = qty(h.blob_gas_used)
+        out["excessBlobGas"] = qty(h.excess_blob_gas)
+    return out
+
+
+class EngineApi:
+    def __init__(self, tree: EngineTree, payload_service: PayloadBuilderService | None = None):
+        self.tree = tree
+        self.payloads = payload_service
+
+    def _status_json(self, st) -> dict:
+        return {
+            "status": st.status.value,
+            "latestValidHash": data(st.latest_valid_hash) if st.latest_valid_hash else None,
+            "validationError": st.validation_error,
+        }
+
+    def engine_exchangeCapabilities(self, caps=None):
+        return CAPABILITIES
+
+    def engine_newPayloadV1(self, payload):
+        return self._new_payload(payload)
+
+    def engine_newPayloadV2(self, payload):
+        return self._new_payload(payload)
+
+    def engine_newPayloadV3(self, payload, blob_hashes=None, parent_beacon_root=None):
+        block = payload_to_block(payload, self.tree.committer)
+        if parent_beacon_root is not None:
+            header = Header(**{
+                **block.header.__dict__,
+                "parent_beacon_block_root": parse_data(parent_beacon_root),
+            })
+            block = Block(header, block.transactions, (), block.withdrawals)
+        return self._check_hash_and_insert(block, payload)
+
+    def _new_payload(self, payload):
+        return self._check_hash_and_insert(
+            payload_to_block(payload, self.tree.committer), payload
+        )
+
+    def _check_hash_and_insert(self, block: Block, payload: dict):
+        want = parse_data(payload["blockHash"])
+        if block.hash != want:
+            return {
+                "status": "INVALID",
+                "latestValidHash": None,
+                "validationError": "block hash mismatch",
+            }
+        return self._status_json(self.tree.on_new_payload(block))
+
+    def engine_forkchoiceUpdatedV1(self, state, attrs=None):
+        return self._fcu(state, attrs)
+
+    def engine_forkchoiceUpdatedV2(self, state, attrs=None):
+        return self._fcu(state, attrs)
+
+    def engine_forkchoiceUpdatedV3(self, state, attrs=None):
+        return self._fcu(state, attrs)
+
+    def _fcu(self, state: dict, attrs):
+        head = parse_data(state["headBlockHash"])
+        safe = parse_data(state["safeBlockHash"]) if state.get("safeBlockHash") else None
+        fin = parse_data(state["finalizedBlockHash"]) if state.get("finalizedBlockHash") else None
+        st = self.tree.on_forkchoice_updated(head, safe, fin)
+        resp = {"payloadStatus": self._status_json(st), "payloadId": None}
+        if attrs is not None and st.status is PayloadStatusKind.VALID:
+            if self.payloads is None:
+                raise RpcError(-38003, "payload building not configured")
+            withdrawals = tuple(
+                Withdrawal(
+                    parse_qty(w["index"]), parse_qty(w["validatorIndex"]),
+                    parse_data(w["address"]), parse_qty(w["amount"]),
+                )
+                for w in attrs.get("withdrawals") or ()
+            )
+            pa = PayloadAttributes(
+                timestamp=parse_qty(attrs["timestamp"]),
+                prev_randao=parse_data(attrs["prevRandao"]),
+                suggested_fee_recipient=parse_data(attrs["suggestedFeeRecipient"]),
+                withdrawals=withdrawals,
+                parent_beacon_block_root=(
+                    parse_data(attrs["parentBeaconBlockRoot"])
+                    if attrs.get("parentBeaconBlockRoot") else None
+                ),
+            )
+            pid = self.payloads.new_payload_job(head, pa)
+            resp["payloadId"] = data(pid)
+        return resp
+
+    def engine_getPayloadV1(self, payload_id):
+        return self._get_payload(payload_id)["executionPayload"]
+
+    def engine_getPayloadV2(self, payload_id):
+        return self._get_payload(payload_id)
+
+    def engine_getPayloadV3(self, payload_id):
+        out = self._get_payload(payload_id)
+        out["blobsBundle"] = {"commitments": [], "proofs": [], "blobs": []}
+        out["shouldOverrideBuilder"] = False
+        return out
+
+    def _get_payload(self, payload_id):
+        if self.payloads is None:
+            raise RpcError(-38003, "payload building not configured")
+        block = self.payloads.get_payload(parse_data(payload_id))
+        if block is None:
+            raise RpcError(-38001, "unknown payload")
+        fees = 0
+        return {
+            "executionPayload": block_to_payload(block),
+            "blockValue": qty(fees),
+        }

@@ -1,0 +1,309 @@
+"""The eth_* namespace.
+
+Reference analogue: crates/rpc/rpc-eth-api trait stack + crates/rpc/rpc
+eth module. Serves state from the engine tree's canonical overlay
+(pending blocks included), the pool, and the DB.
+"""
+
+from __future__ import annotations
+
+from ..engine.tree import EngineTree
+from ..evm import BlockExecutor
+from ..evm.executor import ProviderStateSource
+from ..evm.interpreter import BlockEnv, CallFrame, Interpreter, Revert, TxEnv
+from ..evm.state import EvmState
+from ..primitives.types import Transaction
+from .convert import (
+    block_to_rpc,
+    data,
+    parse_data,
+    parse_qty,
+    qty,
+    receipt_to_rpc,
+    tx_to_rpc,
+)
+from .server import RpcError
+
+
+class EthApi:
+    def __init__(self, tree: EngineTree, pool=None, chain_id: int = 1):
+        self.tree = tree
+        self.pool = pool
+        self.chain_id = chain_id
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _provider(self):
+        return self.tree.overlay_provider()
+
+    def _resolve_number(self, tag, p) -> int:
+        if tag in (None, "latest", "pending", "safe", "finalized"):
+            return p.last_block_number()
+        if tag == "earliest":
+            return 0
+        return parse_qty(tag)
+
+    def _state_at(self, tag):
+        p = self._provider()
+        n = self._resolve_number(tag, p)
+        if n != p.last_block_number():
+            raise RpcError(-32000, "historical state not yet served")
+        return p
+
+    # -- chain meta ------------------------------------------------------------
+
+    def eth_chainId(self):
+        return qty(self.chain_id)
+
+    def eth_blockNumber(self):
+        return qty(self._provider().last_block_number())
+
+    def eth_syncing(self):
+        return False
+
+    def eth_gasPrice(self):
+        p = self._provider()
+        header = p.header_by_number(p.last_block_number())
+        base = header.base_fee_per_gas or 0
+        return qty(base + 10**9)
+
+    def eth_maxPriorityFeePerGas(self):
+        return qty(10**9)
+
+    # -- state -----------------------------------------------------------------
+
+    def eth_getBalance(self, address, tag="latest"):
+        p = self._state_at(tag)
+        acc = p.account(parse_data(address))
+        return qty(acc.balance if acc else 0)
+
+    def eth_getTransactionCount(self, address, tag="latest"):
+        addr = parse_data(address)
+        if tag == "pending" and self.pool is not None:
+            return qty(self.pool.pooled_nonce(addr))
+        p = self._state_at(tag)
+        acc = p.account(addr)
+        return qty(acc.nonce if acc else 0)
+
+    def eth_getCode(self, address, tag="latest"):
+        p = self._state_at(tag)
+        acc = p.account(parse_data(address))
+        if acc is None:
+            return "0x"
+        return data(p.bytecode(acc.code_hash) or b"")
+
+    def eth_getStorageAt(self, address, slot, tag="latest"):
+        p = self._state_at(tag)
+        v = p.storage(parse_data(address), parse_qty(slot).to_bytes(32, "big"))
+        return data(v.to_bytes(32, "big"))
+
+    # -- blocks ----------------------------------------------------------------
+
+    def eth_getBlockByNumber(self, tag, full=False):
+        p = self._provider()
+        n = self._resolve_number(tag, p)
+        block = p.block_by_number(n)
+        if block is None:
+            return None
+        idx = p.block_body_indices(n)
+        senders = None
+        if full and idx:
+            senders = [p.sender(t) for t in range(idx.first_tx_num, idx.next_tx_num)]
+        return block_to_rpc(block, full, senders)
+
+    def eth_getBlockByHash(self, block_hash, full=False):
+        p = self._provider()
+        n = p.block_number(parse_data(block_hash))
+        if n is None:
+            return None
+        return self.eth_getBlockByNumber(qty(n), full)
+
+    def eth_getBlockTransactionCountByNumber(self, tag):
+        p = self._provider()
+        idx = p.block_body_indices(self._resolve_number(tag, p))
+        return qty(idx.tx_count if idx else 0)
+
+    # -- transactions ----------------------------------------------------------
+
+    def eth_getTransactionByHash(self, tx_hash):
+        h = parse_data(tx_hash)
+        if self.pool is not None:
+            tx = self.pool.get(h)
+            if tx is not None:
+                return tx_to_rpc(tx)
+        p = self._provider()
+        from ..storage.tables import Tables, from_be64
+
+        raw = p.tx.get(Tables.TransactionHashNumbers.name, h)
+        if raw is None:
+            return None
+        tx_num = from_be64(raw)
+        block_num = self._block_of_tx(p, tx_num)
+        if block_num is None:
+            return None
+        header = p.header_by_number(block_num)
+        idx = p.block_body_indices(block_num)
+        txs = p.transactions_by_block(block_num)
+        i = tx_num - idx.first_tx_num
+        return tx_to_rpc(txs[i], header, i, p.sender(tx_num))
+
+    def _block_of_tx(self, p, tx_num: int) -> int | None:
+        # scan back from the tip (fine at test scale; index later)
+        n = p.last_block_number()
+        while n >= 0:
+            idx = p.block_body_indices(n)
+            if idx and idx.first_tx_num <= tx_num < idx.next_tx_num:
+                return n
+            n -= 1
+        return None
+
+    def eth_getTransactionReceipt(self, tx_hash):
+        h = parse_data(tx_hash)
+        p = self._provider()
+        from ..storage.tables import Tables, from_be64
+
+        raw = p.tx.get(Tables.TransactionHashNumbers.name, h)
+        if raw is None:
+            return None
+        tx_num = from_be64(raw)
+        block_num = self._block_of_tx(p, tx_num)
+        if block_num is None:
+            return None
+        header = p.header_by_number(block_num)
+        idx = p.block_body_indices(block_num)
+        i = tx_num - idx.first_tx_num
+        receipt = p.receipt(tx_num)
+        if receipt is None:
+            return None
+        prev = p.receipt(tx_num - 1).cumulative_gas_used if i > 0 else 0
+        log_base = 0
+        for t in range(idx.first_tx_num, tx_num):
+            log_base += len(p.receipt(t).logs)
+        txs = p.transactions_by_block(block_num)
+        return receipt_to_rpc(receipt, txs[i], header, i, prev, p.sender(tx_num), log_base)
+
+    def eth_sendRawTransaction(self, raw):
+        if self.pool is None:
+            raise RpcError(-32000, "no transaction pool")
+        tx = Transaction.decode(parse_data(raw))
+        from ..pool import PoolError
+
+        try:
+            h = self.pool.add_transaction(tx)
+        except PoolError as e:
+            raise RpcError(-32000, str(e))
+        return data(h)
+
+    # -- execution (read-only) ---------------------------------------------------
+
+    def _call_env(self, p):
+        header = p.header_by_number(p.last_block_number())
+        return BlockEnv(
+            number=header.number + 1,
+            timestamp=header.timestamp + 12,
+            gas_limit=header.gas_limit,
+            base_fee=header.base_fee_per_gas or 0,
+            chain_id=self.chain_id,
+        )
+
+    def eth_call(self, call, tag="latest"):
+        p = self._state_at(tag)
+        env = self._call_env(p)
+        state = EvmState(ProviderStateSource(p))
+        interp = Interpreter(state, env, TxEnv(origin=parse_data(call.get("from", "0x" + "00" * 20))))
+        to = parse_data(call["to"]) if call.get("to") else None
+        frame = CallFrame(
+            caller=parse_data(call.get("from", "0x" + "00" * 20)),
+            address=to or b"\x00" * 20,
+            code=state.code(to) if to else b"",
+            data=parse_data(call.get("data", call.get("input", "0x"))),
+            value=parse_qty(call.get("value", "0x0")),
+            gas=parse_qty(call.get("gas", hex(env.gas_limit))),
+        )
+        try:
+            ok, _gas_left, out = interp.call(frame)
+        except Revert as r:
+            raise RpcError(3, "execution reverted: 0x" + r.output.hex())
+        if not ok:
+            raise RpcError(-32000, "execution failed")
+        return data(out)
+
+    def eth_estimateGas(self, call, tag="latest"):
+        p = self._state_at(tag)
+        env = self._call_env(p)
+        sender = parse_data(call.get("from", "0x" + "00" * 20))
+        state = EvmState(ProviderStateSource(p))
+        interp = Interpreter(state, env, TxEnv(origin=sender))
+        to = parse_data(call["to"]) if call.get("to") else None
+        gas = parse_qty(call.get("gas", hex(env.gas_limit)))
+        frame = CallFrame(
+            caller=sender, address=to or b"\x00" * 20,
+            code=state.code(to) if to else b"",
+            data=parse_data(call.get("data", call.get("input", "0x"))),
+            value=parse_qty(call.get("value", "0x0")), gas=gas,
+        )
+        try:
+            ok, gas_left, _ = interp.call(frame)
+        except Revert:
+            raise RpcError(3, "execution reverted")
+        if not ok:
+            raise RpcError(-32000, "execution failed")
+        from ..evm.executor import intrinsic_gas
+
+        used = gas - gas_left
+        fake_tx = Transaction(to=to, data=parse_data(call.get("data", call.get("input", "0x"))))
+        return qty(used + intrinsic_gas(fake_tx) + used // 16)
+
+    # -- logs --------------------------------------------------------------------
+
+    def eth_getLogs(self, filt):
+        p = self._provider()
+        start = self._resolve_number(filt.get("fromBlock", "earliest"), p)
+        end = self._resolve_number(filt.get("toBlock", "latest"), p)
+        want_addr = None
+        if filt.get("address"):
+            a = filt["address"]
+            want_addr = {parse_data(x) for x in (a if isinstance(a, list) else [a])}
+        topics = filt.get("topics") or []
+        out = []
+        for n in range(start, end + 1):
+            idx = p.block_body_indices(n)
+            if idx is None or idx.tx_count == 0:
+                continue
+            header = p.header_by_number(n)
+            txs = p.transactions_by_block(n)
+            log_base = 0
+            for i, t in enumerate(range(idx.first_tx_num, idx.next_tx_num)):
+                receipt = p.receipt(t)
+                if receipt is None:
+                    continue
+                for j, log in enumerate(receipt.logs):
+                    if want_addr and log.address not in want_addr:
+                        continue
+                    if not _topics_match(log.topics, topics):
+                        continue
+                    out.append({
+                        "address": data(log.address),
+                        "topics": [data(x) for x in log.topics],
+                        "data": data(log.data),
+                        "blockNumber": qty(n),
+                        "blockHash": data(header.hash),
+                        "transactionHash": data(txs[i].hash),
+                        "transactionIndex": qty(i),
+                        "logIndex": qty(log_base + j),
+                        "removed": False,
+                    })
+                log_base += len(receipt.logs)
+        return out
+
+
+def _topics_match(log_topics, want) -> bool:
+    for i, t in enumerate(want):
+        if t is None:
+            continue
+        if i >= len(log_topics):
+            return False
+        opts = t if isinstance(t, list) else [t]
+        if data(log_topics[i]) not in [o.lower() for o in opts]:
+            return False
+    return True

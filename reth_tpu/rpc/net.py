@@ -1,0 +1,57 @@
+"""net_* / web3_* / txpool_* namespaces (reference crates/rpc/rpc)."""
+
+from __future__ import annotations
+
+from .convert import data, qty
+
+
+class NetApi:
+    def __init__(self, chain_id: int = 1, peer_count: int = 0):
+        self.chain_id = chain_id
+        self.peer_count = peer_count
+
+    def net_version(self):
+        return str(self.chain_id)
+
+    def net_listening(self):
+        return False
+
+    def net_peerCount(self):
+        return qty(self.peer_count)
+
+
+class Web3Api:
+    def web3_clientVersion(self):
+        from .. import __version__
+
+        return f"reth-tpu/v{__version__}"
+
+    def web3_sha3(self, payload):
+        from ..primitives.keccak import keccak256
+        from .convert import parse_data
+
+        return data(keccak256(parse_data(payload)))
+
+
+class TxpoolApi:
+    def __init__(self, pool):
+        self.pool = pool
+
+    def txpool_status(self):
+        content = self.pool.content()
+        return {
+            "pending": qty(sum(len(v) for v in content["pending"].values())),
+            "queued": qty(sum(len(v) for v in content["queued"].values())),
+        }
+
+    def txpool_content(self):
+        from .convert import tx_to_rpc
+
+        content = self.pool.content()
+        return {
+            bucket: {
+                data(sender): {str(n): tx_to_rpc(tx) for n, tx in txs.items()}
+                for sender, txs in senders.items()
+            }
+            for bucket, senders in content.items()
+        }

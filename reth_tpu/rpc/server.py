@@ -1,0 +1,121 @@
+"""Threaded JSON-RPC 2.0 HTTP server with a method registry.
+
+Reference analogue: the rpc-builder server assembly + transport layers
+(crates/rpc/rpc-builder/src/lib.rs) — trimmed to HTTP; the method
+registry takes `namespace_method` callables from API objects.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL_ERROR = -32603
+
+
+class RpcServer:
+    """Registry + HTTP transport. ``register(api)`` scans an API object for
+    ``namespace_method``-named callables (e.g. ``eth_blockNumber``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 lock: threading.RLock | None = None):
+        self.methods: dict[str, callable] = {}
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        # one coarse lock serialises handlers: pool/tree state has no
+        # internal synchronisation (share the lock across servers that
+        # share state, e.g. the public and auth servers of one node)
+        self.lock = lock or threading.RLock()
+
+    def register(self, api: object, prefix: str | None = None):
+        for name in dir(api):
+            if name.startswith("_"):
+                continue
+            fn = getattr(api, name)
+            if callable(fn) and "_" in name:
+                self.methods[name] = fn
+
+    def register_method(self, name: str, fn):
+        self.methods[name] = fn
+
+    # -- dispatch --------------------------------------------------------------
+
+    def handle(self, body: bytes) -> bytes:
+        try:
+            req = json.loads(body)
+        except json.JSONDecodeError:
+            return self._error(None, PARSE_ERROR, "parse error")
+        if isinstance(req, list):
+            return json.dumps([json.loads(self._handle_one(r)) for r in req]).encode()
+        return self._handle_one(req)
+
+    def _handle_one(self, req) -> bytes:
+        rid = req.get("id") if isinstance(req, dict) else None
+        if not isinstance(req, dict) or "method" not in req:
+            return self._error(rid, INVALID_REQUEST, "invalid request")
+        method = req["method"]
+        fn = self.methods.get(method)
+        if fn is None:
+            return self._error(rid, METHOD_NOT_FOUND, f"method {method} not found")
+        params = req.get("params", [])
+        try:
+            with self.lock:
+                result = fn(*params) if isinstance(params, list) else fn(**params)
+        except RpcError as e:
+            return self._error(rid, e.code, e.message)
+        except TypeError as e:
+            return self._error(rid, INVALID_PARAMS, str(e))
+        except Exception as e:  # noqa: BLE001 — every fault maps to an RPC error
+            return self._error(rid, INTERNAL_ERROR, f"{type(e).__name__}: {e}")
+        return json.dumps({"jsonrpc": "2.0", "id": rid, "result": result}).encode()
+
+    def _error(self, rid, code, message) -> bytes:
+        return json.dumps({
+            "jsonrpc": "2.0", "id": rid,
+            "error": {"code": code, "message": message},
+        }).encode()
+
+    # -- transport -------------------------------------------------------------
+
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                resp = server.handle(body)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(resp)))
+                self.end_headers()
+                self.wfile.write(resp)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()

@@ -1,0 +1,129 @@
+"""Txpool + payload builder + local miner tests."""
+
+import pytest
+
+from reth_tpu.engine import EngineTree
+from reth_tpu.engine.local import LocalMiner
+from reth_tpu.payload import PayloadAttributes, PayloadBuilderService, build_payload
+from reth_tpu.pool import PoolError, TransactionPool
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+ALICE = 0xA11CE
+BOB = 0xB0B
+
+
+def make_node():
+    alice, bob = Wallet(ALICE), Wallet(BOB)
+    builder = ChainBuilder(
+        {alice.address: Account(balance=10**21), bob.address: Account(balance=10**20)},
+        committer=CPU,
+    )
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=2)
+    pool = TransactionPool(lambda: tree.overlay_provider())
+    pool.base_fee = 10**9
+    return tree, pool, alice, bob
+
+
+def test_pool_validation():
+    tree, pool, alice, bob = make_node()
+    tx = alice.transfer(bob.address, 100)
+    h = pool.add_transaction(tx)
+    assert pool.contains(h) and len(pool) == 1
+    with pytest.raises(PoolError, match="already known"):
+        pool.add_transaction(tx)
+    # insufficient funds
+    poor = Wallet(0xDEAD)
+    with pytest.raises(PoolError, match="insufficient funds"):
+        pool.add_transaction(poor.transfer(bob.address, 10**18))
+
+
+def test_pool_nonce_too_low_after_mining():
+    tree, pool, alice, bob = make_node()
+    pool.add_transaction(alice.transfer(bob.address, 1))
+    LocalMiner(tree, pool).mine_block()
+    stale = Wallet(ALICE)  # nonce 0 again
+    with pytest.raises(PoolError, match="nonce too low"):
+        pool.add_transaction(stale.transfer(bob.address, 2))
+
+
+def test_pool_nonce_gap_and_ordering():
+    tree, pool, alice, bob = make_node()
+    t0 = alice.transfer(bob.address, 1)          # nonce 0
+    t1 = alice.transfer(bob.address, 2)          # nonce 1
+    alice.nonce = 5
+    t5 = alice.transfer(bob.address, 3)          # nonce 5 (gap)
+    b0 = bob.transfer(alice.address, 1, max_priority_fee_per_gas=5 * 10**9)
+    for t in (t1, t5, b0, t0):  # shuffled insertion
+        pool.add_transaction(t)
+    best = list(pool.best_transactions(10**9))
+    # bob pays a higher tip -> first; alice nonce-ordered; gap tx excluded
+    assert [t.hash for t in best] == [b0.hash, t0.hash, t1.hash]
+    content = pool.content()
+    assert t5.hash in [t.hash for t in content["queued"].get(alice.address, {}).values()]
+
+
+def test_pool_replacement_rules():
+    tree, pool, alice, bob = make_node()
+    t0 = alice.transfer(bob.address, 1)
+    pool.add_transaction(t0)
+    alice.nonce = 0
+    cheap = alice.transfer(bob.address, 2)  # same nonce, same fee
+    with pytest.raises(PoolError, match="underpriced"):
+        pool.add_transaction(cheap)
+    alice.nonce = 0
+    bumped = alice.transfer(bob.address, 2, max_fee_per_gas=200 * 10**9)
+    pool.add_transaction(bumped)
+    assert not pool.contains(t0.hash)
+    assert pool.contains(bumped.hash)
+
+
+def test_payload_builder_and_miner():
+    tree, pool, alice, bob = make_node()
+    for i in range(3):
+        pool.add_transaction(alice.transfer(bob.address, 1000 + i))
+    miner = LocalMiner(tree, pool)
+    block = miner.mine_block()
+    assert block.header.number == 1
+    assert len(block.transactions) == 3
+    assert tree.head_hash == block.hash
+    # mined txs evicted from the pool
+    assert len(pool) == 0
+    # balances visible at the new head
+    p = tree.overlay_provider()
+    assert p.account(bob.address).balance == 10**20 + 3000 + 3
+    # mine an empty follow-up block
+    b2 = miner.mine_block()
+    assert b2.header.number == 2 and len(b2.transactions) == 0
+
+
+def test_payload_service_ids():
+    tree, pool, alice, bob = make_node()
+    pool.add_transaction(alice.transfer(bob.address, 5))
+    svc = PayloadBuilderService(tree, pool)
+    pid = svc.new_payload_job(tree.head_hash, PayloadAttributes(timestamp=12))
+    block = svc.get_payload(pid)
+    assert block is not None and len(block.transactions) == 1
+    # the built payload is accepted by the engine
+    from reth_tpu.engine.tree import PayloadStatusKind
+
+    assert tree.on_new_payload(block).status is PayloadStatusKind.VALID
+
+
+def test_gas_limit_respected():
+    tree, pool, alice, bob = make_node()
+    # many txs; cap block gas artificially small via parent gas limit is
+    # fixed, so instead check cumulative gas never exceeds the limit
+    for i in range(5):
+        pool.add_transaction(alice.transfer(bob.address, i + 1))
+    block = build_payload(tree, pool, tree.head_hash, PayloadAttributes(timestamp=12))
+    assert block.header.gas_used == 5 * 21000
+    assert block.header.gas_used <= block.header.gas_limit

@@ -1,0 +1,192 @@
+"""RPC end-to-end: a live node over real HTTP, driven like a user + CL.
+
+Reference analogue: crates/e2e-test-utils node tests + rpc-e2e-tests —
+launch a node, submit txs over eth_, drive blocks over engine_.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from reth_tpu.node import Node, NodeConfig
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.rpc.convert import data, parse_data, parse_qty
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def rpc(port, method, *params):
+    req = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method, "params": list(params)})
+    resp = urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://127.0.0.1:{port}/", req.encode(),
+            {"Content-Type": "application/json"},
+        ),
+        timeout=30,
+    )
+    out = json.loads(resp.read())
+    if "error" in out:
+        raise RuntimeError(f"{method}: {out['error']}")
+    return out["result"]
+
+
+@pytest.fixture()
+def node():
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    cfg = NodeConfig(
+        dev=True,
+        genesis_header=builder.genesis,
+        genesis_alloc=builder.accounts_at_genesis,
+    )
+    n = Node(cfg, committer=CPU)
+    n.start_rpc()
+    yield n, alice
+    n.stop()
+
+
+def test_eth_basics_over_http(node):
+    n, alice = node
+    port = n.rpc.port
+    assert rpc(port, "eth_chainId") == "0x1"
+    assert rpc(port, "eth_blockNumber") == "0x0"
+    assert parse_qty(rpc(port, "eth_getBalance", data(alice.address), "latest")) == 10**21
+    assert rpc(port, "web3_clientVersion").startswith("reth-tpu/")
+    assert rpc(port, "net_version") == "1"
+    blk = rpc(port, "eth_getBlockByNumber", "0x0", False)
+    assert parse_qty(blk["number"]) == 0
+
+
+def test_send_tx_mine_and_receipt(node):
+    n, alice = node
+    port = n.rpc.port
+    bob = b"\x0b" * 20
+    tx = alice.transfer(bob, 12345)
+    h = rpc(port, "eth_sendRawTransaction", data(tx.encode()))
+    assert parse_data(h) == tx.hash
+    assert rpc(port, "txpool_status")["pending"] == "0x1"
+    # pending nonce reflects the pool
+    assert rpc(port, "eth_getTransactionCount", data(alice.address), "pending") == "0x1"
+    n.miner.mine_block()
+    assert rpc(port, "eth_blockNumber") == "0x1"
+    assert parse_qty(rpc(port, "eth_getBalance", data(bob), "latest")) == 12345
+    rec = rpc(port, "eth_getTransactionReceipt", data(tx.hash))
+    assert rec["status"] == "0x1" and parse_qty(rec["gasUsed"]) == 21000
+    got = rpc(port, "eth_getTransactionByHash", data(tx.hash))
+    assert got["blockNumber"] == "0x1" and got["from"] == data(alice.address)
+    full = rpc(port, "eth_getBlockByNumber", "0x1", True)
+    assert len(full["transactions"]) == 1
+
+
+def test_engine_api_drives_chain(node):
+    """Act as a consensus client: FCU+attrs → getPayload → newPayload → FCU."""
+    n, alice = node
+    auth = n.authrpc.port
+    genesis_hash = rpc(auth, "eth_getBlockByNumber", "0x0", False)["hash"]
+    # send a tx through the public port
+    rpc(n.rpc.port, "eth_sendRawTransaction", data(alice.transfer(b"\x0c" * 20, 777).encode()))
+    fcu = rpc(auth, "engine_forkchoiceUpdatedV2",
+              {"headBlockHash": genesis_hash, "safeBlockHash": genesis_hash,
+               "finalizedBlockHash": genesis_hash},
+              {"timestamp": "0xc", "prevRandao": "0x" + "00" * 32,
+               "suggestedFeeRecipient": "0x" + "aa" * 20, "withdrawals": []})
+    assert fcu["payloadStatus"]["status"] == "VALID"
+    pid = fcu["payloadId"]
+    payload = rpc(auth, "engine_getPayloadV2", pid)["executionPayload"]
+    assert len(payload["transactions"]) == 1
+    st = rpc(auth, "engine_newPayloadV2", payload)
+    assert st["status"] == "VALID", st
+    fcu2 = rpc(auth, "engine_forkchoiceUpdatedV2",
+               {"headBlockHash": payload["blockHash"], "safeBlockHash": genesis_hash,
+                "finalizedBlockHash": genesis_hash})
+    assert fcu2["payloadStatus"]["status"] == "VALID"
+    assert parse_qty(rpc(n.rpc.port, "eth_getBalance", "0x" + "0c" * 20, "latest")) == 777
+    caps = rpc(auth, "engine_exchangeCapabilities", [])
+    assert "engine_newPayloadV3" in caps
+
+
+def test_eth_call_and_logs(node):
+    n, alice = node
+    port = n.rpc.port
+    # deploy the storage contract, then eth_call reads calldata echo? The
+    # STORE contract writes; use eth_call for a balance-transfer frame (no
+    # code): returns empty data with success
+    out = rpc(port, "eth_call", {"from": data(alice.address), "to": "0x" + "0d" * 20,
+                                 "value": "0x1"}, "latest")
+    assert out == "0x"
+    # deploy a LOG1-emitting contract, then call it
+    from reth_tpu.primitives.keccak import keccak256
+    from reth_tpu.primitives.rlp import encode_int, rlp_encode
+
+    code = bytes.fromhex("60425f5fa100")
+    deploy_initcode = bytes([0x60, len(code), 0x60, 0x0B, 0x5F, 0x39, 0x60, len(code), 0x5F, 0xF3, 0x00]) + code
+    rpc(port, "eth_sendRawTransaction", data(alice.deploy(deploy_initcode).encode()))
+    n.miner.mine_block()
+    contract = keccak256(rlp_encode([alice.address, encode_int(0)]))[12:]
+    assert n.tree.overlay_provider().account(contract) is not None
+    rpc(port, "eth_sendRawTransaction", data(alice.call(contract, b"").encode()))
+    n.miner.mine_block()
+    logs = rpc(port, "eth_getLogs", {"fromBlock": "0x0", "toBlock": "latest",
+                                     "address": data(contract)})
+    assert len(logs) == 1
+    assert logs[0]["topics"] == ["0x" + "00" * 31 + "42"]
+
+
+def test_contract_address_in_receipt_and_legacy_v(node):
+    n, alice = node
+    port = n.rpc.port
+    code = bytes.fromhex("00")
+    initcode = bytes([0x60, 1, 0x60, 0x0B, 0x5F, 0x39, 0x60, 1, 0x5F, 0xF3, 0x00]) + code
+    deploy = alice.deploy(initcode)
+    rpc(port, "eth_sendRawTransaction", data(deploy.encode()))
+    n.miner.mine_block()
+    rec = rpc(port, "eth_getTransactionReceipt", data(deploy.hash))
+    from reth_tpu.primitives.keccak import keccak256
+    from reth_tpu.primitives.rlp import encode_int, rlp_encode
+
+    want = keccak256(rlp_encode([alice.address, encode_int(0)]))[12:]
+    assert rec["contractAddress"] == data(want)
+
+
+def test_pending_tx_shape(node):
+    n, alice = node
+    port = n.rpc.port
+    tx = alice.transfer(b"\x0e" * 20, 5)
+    rpc(port, "eth_sendRawTransaction", data(tx.encode()))
+    got = rpc(port, "eth_getTransactionByHash", data(tx.hash))
+    assert got["blockHash"] is None and got["blockNumber"] is None
+    assert got["from"] == data(alice.address)
+
+
+def test_pool_maintained_in_cl_driven_mode(node):
+    """Txs must be evicted when blocks arrive via the engine API (no miner)."""
+    n, alice = node
+    auth, port = n.authrpc.port, n.rpc.port
+    genesis_hash = rpc(auth, "eth_getBlockByNumber", "0x0", False)["hash"]
+    tx = alice.transfer(b"\x0f" * 20, 9)
+    rpc(port, "eth_sendRawTransaction", data(tx.encode()))
+    fcu = rpc(auth, "engine_forkchoiceUpdatedV2",
+              {"headBlockHash": genesis_hash, "safeBlockHash": genesis_hash,
+               "finalizedBlockHash": genesis_hash},
+              {"timestamp": "0xc", "prevRandao": "0x" + "00" * 32,
+               "suggestedFeeRecipient": "0x" + "aa" * 20, "withdrawals": []})
+    payload = rpc(auth, "engine_getPayloadV2", fcu["payloadId"])["executionPayload"]
+    rpc(auth, "engine_newPayloadV2", payload)
+    rpc(auth, "engine_forkchoiceUpdatedV2",
+        {"headBlockHash": payload["blockHash"], "safeBlockHash": genesis_hash,
+         "finalizedBlockHash": genesis_hash})
+    assert rpc(port, "txpool_status")["pending"] == "0x0"  # evicted
+
+
+def test_error_shapes(node):
+    n, _ = node
+    port = n.rpc.port
+    with pytest.raises(RuntimeError, match="not found"):
+        rpc(port, "eth_notAMethod")
+    with pytest.raises(RuntimeError, match="insufficient funds"):
+        poor = Wallet(0x9999)
+        rpc(port, "eth_sendRawTransaction", data(poor.transfer(b"\x01" * 20, 10**18).encode()))
