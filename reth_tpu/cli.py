@@ -1,0 +1,279 @@
+"""Command-line interface: init / import / node / db / stage commands.
+
+Reference analogue: bin/reth (`Cli::run`, Commands enum —
+crates/ethereum/cli/src/interface.rs:284) and crates/cli/commands
+(init, import, db stats, stage run…). Genesis files use the geth-style
+JSON schema (chainId + alloc).
+
+Run as ``python -m reth_tpu <command> ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def _num(v, default=0) -> int:
+    """Genesis numeric field: hex string, decimal string, or JSON number
+    (geth's math.HexOrDecimal256 accepts all three)."""
+    if v is None:
+        return default
+    if isinstance(v, int):
+        return v
+    s = str(v)
+    if s.startswith(("0x", "0X")):
+        return int(s, 16)
+    return int(s)
+
+
+def _make_committer(args):
+    from .trie.committer import TrieCommitter
+
+    if getattr(args, "hasher", "device") == "cpu":
+        from .primitives.keccak import keccak256_batch_np
+
+        return TrieCommitter(hasher=keccak256_batch_np)
+    return TrieCommitter()
+
+
+# Built-in dev-mode genesis (reference --dev auto-installs a dev chainspec).
+# Funded key: the standard dev mnemonic's first account.
+DEV_PRIVATE_KEY = 0xAC0974BEC39A17E36BA4A6B4D238FF944BACB478CBED5EFCAE784D7BF4F2FF80
+
+
+def _dev_genesis_spec() -> dict:
+    from .primitives import secp256k1
+
+    addr = secp256k1.address_from_priv(DEV_PRIVATE_KEY)
+    return {
+        "config": {"chainId": 1337},
+        "gasLimit": hex(30_000_000),
+        "alloc": {"0x" + addr.hex(): {"balance": hex(10**24)}},
+    }
+
+
+def _load_genesis(path: str | None, committer, spec: dict | None = None):
+    from .primitives.types import Account, Header, EMPTY_ROOT_HASH
+    from .primitives.keccak import keccak256
+
+    if spec is None:
+        spec = json.loads(Path(path).read_text())
+    alloc = {}
+    storage = {}
+    codes = {}
+    for addr_hex, entry in spec.get("alloc", {}).items():
+        addr = bytes.fromhex(addr_hex.removeprefix("0x"))
+        code = bytes.fromhex(entry.get("code", "0x")[2:]) if entry.get("code") else b""
+        code_hash = keccak256(code) if code else keccak256(b"")
+        alloc[addr] = Account(
+            nonce=_num(entry.get("nonce")),
+            balance=_num(entry.get("balance")),
+            code_hash=code_hash,
+        )
+        if code:
+            codes[code_hash] = code
+        if entry.get("storage"):
+            storage[addr] = {
+                _num(k).to_bytes(32, "big"): _num(v)
+                for k, v in entry["storage"].items()
+            }
+    chain_id = _num(spec.get("config", {}).get("chainId"), 1)
+    from .trie.state_root import state_root
+
+    root, _ = state_root(alloc, storage, committer=committer)
+    header = Header(
+        number=0,
+        state_root=root,
+        gas_limit=_num(spec.get("gasLimit"), 30_000_000),
+        timestamp=_num(spec.get("timestamp")),
+        extra_data=bytes.fromhex(spec.get("extraData", "0x")[2:]),
+        base_fee_per_gas=_num(spec.get("baseFeePerGas"), 10**9),
+        withdrawals_root=None if spec.get("preMerge") else EMPTY_ROOT_HASH,
+    )
+    return header, alloc, storage, codes, chain_id
+
+
+def cmd_init(args):
+    from .node import Node, NodeConfig
+
+    committer = _make_committer(args)
+    header, alloc, storage, codes, chain_id = _load_genesis(args.genesis, committer)
+    cfg = NodeConfig(
+        chain_id=chain_id, datadir=args.datadir, genesis_header=header,
+        genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes,
+    )
+    node = Node(cfg, committer=committer)
+    node.factory.db.flush()
+    print(f"genesis initialised: hash=0x{header.hash.hex()} chain_id={chain_id}")
+    return 0
+
+
+def cmd_import(args):
+    from .consensus import EthBeaconConsensus
+    from .node import Node, NodeConfig
+    from .primitives.types import Block
+    from .stages import Pipeline, default_stages
+    from .storage.genesis import import_chain
+
+    committer = _make_committer(args)
+    header, alloc, storage, codes, chain_id = _load_genesis(args.genesis, committer)
+    cfg = NodeConfig(chain_id=chain_id, datadir=args.datadir, genesis_header=header,
+                     genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes)
+    node = Node(cfg, committer=committer)
+    raw = Path(args.file).read_bytes()
+    blocks = []
+    pos = 0
+    from .primitives.rlp import _decode_at
+
+    while pos < len(raw):
+        _item, end = _decode_at(raw, pos)
+        blocks.append(Block.decode(raw[pos:end]))
+        pos = end
+    tip = import_chain(node.factory, blocks, EthBeaconConsensus(node.committer))
+    print(f"imported {len(blocks)} blocks, tip={tip}")
+    t0 = time.time()
+    pipeline = Pipeline(node.factory, default_stages(committer=node.committer))
+    pipeline.run(tip)
+    node.factory.db.flush()
+    print(f"pipeline synced to {tip} in {time.time()-t0:.2f}s")
+    return 0
+
+
+def cmd_node(args):
+    from .node import Node, NodeConfig
+
+    committer = _make_committer(args)
+    kw = {}
+    if args.genesis:
+        header, alloc, storage, codes, chain_id = _load_genesis(args.genesis, committer)
+        kw = dict(genesis_header=header, genesis_alloc=alloc,
+                  genesis_storage=storage, genesis_codes=codes, chain_id=chain_id)
+    elif args.dev:
+        # reference --dev auto-installs a dev chainspec with a funded key
+        header, alloc, storage, codes, chain_id = _load_genesis(
+            None, committer, spec=_dev_genesis_spec()
+        )
+        kw = dict(genesis_header=header, genesis_alloc=alloc,
+                  genesis_storage=storage, genesis_codes=codes, chain_id=chain_id)
+        print(f"dev genesis: funded key 0x{DEV_PRIVATE_KEY:064x}")
+    else:
+        from .storage import MemDb
+
+        db_probe = MemDb(Path(args.datadir) / "db.bin") if args.datadir else None
+        if db_probe is None or not db_probe._tables:
+            print("error: no genesis — pass --genesis or run `init`, or use --dev",
+                  file=sys.stderr)
+            return 1
+    cfg = NodeConfig(datadir=args.datadir, dev=args.dev,
+                     http_port=args.http_port, authrpc_port=args.authrpc_port, **kw)
+    node = Node(cfg, committer=committer)
+    http_port, auth_port = node.start_rpc()
+    print(f"RPC listening on 127.0.0.1:{http_port}, engine API on 127.0.0.1:{auth_port}")
+    if args.dev and args.block_time > 0:
+        print(f"dev mode: mining every {args.block_time}s")
+        try:
+            while True:
+                time.sleep(args.block_time)
+                block = node.miner.mine_block(timestamp=int(time.time()))
+                print(f"mined block {block.header.number} "
+                      f"({len(block.transactions)} txs) 0x{block.hash.hex()[:16]}")
+        except KeyboardInterrupt:
+            pass
+    else:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    node.stop()
+    return 0
+
+
+def cmd_db_stats(args):
+    from .storage import MemDb
+
+    db = MemDb(Path(args.datadir) / "db.bin")
+    tx = db.tx()
+    print(f"{'table':<28}{'entries':>12}")
+    for name in sorted(db._tables):
+        print(f"{name:<28}{tx.entry_count(name):>12}")
+    return 0
+
+
+def cmd_stage_run(args):
+    from .stages import Pipeline, default_stages
+    from .storage import MemDb, ProviderFactory
+
+    factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
+    committer = _make_committer(args)
+    stages = [s for s in default_stages(committer=committer)
+              if args.stage in ("all", s.id)]
+    if not stages:
+        print(f"unknown stage {args.stage}", file=sys.stderr)
+        return 1
+    with factory.provider() as p:
+        target = args.to if args.to is not None else p.last_block_number()
+    t0 = time.time()
+    Pipeline(factory, stages).run(target)
+    factory.db.flush()
+    print(f"stage(s) {[s.id for s in stages]} ran to {target} in {time.time()-t0:.2f}s")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="reth-tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    def add_hasher(p):
+        p.add_argument("--hasher", choices=["device", "cpu"], default="device",
+                       help="keccak backend: device (TPU/XLA, the "
+                            "--state-root.backend analogue) or cpu (numpy)")
+
+    p = sub.add_parser("init", help="initialise the database from a genesis file")
+    p.add_argument("--datadir", required=True)
+    p.add_argument("--genesis", required=True)
+    add_hasher(p)
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("import", help="import an RLP chain file and sync")
+    p.add_argument("--datadir", required=True)
+    p.add_argument("--genesis", required=True)
+    p.add_argument("file")
+    add_hasher(p)
+    p.set_defaults(fn=cmd_import)
+
+    p = sub.add_parser("node", help="run the node (RPC + engine API)")
+    p.add_argument("--datadir", default=None)
+    p.add_argument("--genesis", default=None)
+    p.add_argument("--dev", action="store_true")
+    p.add_argument("--block-time", type=int, default=2)
+    p.add_argument("--http-port", type=int, default=8545)
+    p.add_argument("--authrpc-port", type=int, default=8551)
+    add_hasher(p)
+    p.set_defaults(fn=cmd_node)
+
+    p = sub.add_parser("db", help="database tools")
+    dbsub = p.add_subparsers(dest="db_command", required=True)
+    ps = dbsub.add_parser("stats")
+    ps.add_argument("--datadir", required=True)
+    ps.set_defaults(fn=cmd_db_stats)
+
+    p = sub.add_parser("stage", help="run a single stage")
+    stsub = p.add_subparsers(dest="stage_command", required=True)
+    pr = stsub.add_parser("run")
+    pr.add_argument("--datadir", required=True)
+    pr.add_argument("--stage", default="all")
+    pr.add_argument("--to", type=int, default=None)
+    add_hasher(pr)
+    pr.set_defaults(fn=cmd_stage_run)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,7 +12,7 @@ from ..evm import BlockExecutor
 from ..evm.executor import ProviderStateSource
 from ..evm.interpreter import BlockEnv, CallFrame, Interpreter, Revert, TxEnv
 from ..evm.state import EvmState
-from ..primitives.types import Transaction
+from ..primitives.types import KECCAK_EMPTY, Transaction
 from .convert import (
     block_to_rpc,
     data,
@@ -96,6 +96,31 @@ class EthApi:
         p = self._state_at(tag)
         v = p.storage(parse_data(address), parse_qty(slot).to_bytes(32, "big"))
         return data(v.to_bytes(32, "big"))
+
+    def eth_getProof(self, address, slots, tag="latest"):
+        from ..trie.proof import ProofCalculator
+
+        p = self._state_at(tag)
+        addr = parse_data(address)
+        keys = [parse_qty(s).to_bytes(32, "big") for s in slots]
+        proof = ProofCalculator(p, self.tree.committer).account_proof(addr, keys)
+        acc = proof.account
+        return {
+            "address": address,
+            "accountProof": [data(n) for n in proof.proof],
+            "balance": qty(acc.balance if acc else 0),
+            "nonce": qty(acc.nonce if acc else 0),
+            "codeHash": data(acc.code_hash if acc else KECCAK_EMPTY),
+            "storageHash": data(proof.storage_root),
+            "storageProof": [
+                {
+                    "key": data(sp.key),
+                    "value": qty(sp.value),
+                    "proof": [data(n) for n in sp.proof],
+                }
+                for sp in proof.storage_proofs
+            ],
+        }
 
     # -- blocks ----------------------------------------------------------------
 

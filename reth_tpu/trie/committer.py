@@ -92,6 +92,8 @@ class TrieBuildResult:
     branch_nodes: dict[Nibbles, BranchNode] = field(default_factory=dict)
     hashed_nodes: int = 0
     levels: int = 0
+    # node RLPs along requested proof spines: trie path -> node RLP
+    proof_nodes: dict[Nibbles, bytes] = field(default_factory=dict)
 
 
 class TrieCommitter:
@@ -132,6 +134,7 @@ class TrieCommitter:
         self,
         jobs: list[tuple[list[tuple[Nibbles, bytes]], dict[Nibbles, bytes] | None]],
         collect_branches: bool = True,
+        proof_targets: list[list[Nibbles]] | None = None,
     ) -> list[TrieBuildResult]:
         """Commit MANY independent tries with shared level batching.
 
@@ -164,7 +167,7 @@ class TrieCommitter:
             roots_idx.append(self._build(arena, items, 0, 0, len(items), b""))
             arenas.append(arena)
 
-        self._hash_levels(arenas, results)
+        self._hash_levels(arenas, results, proof_targets)
 
         for arena, root_idx, result in zip(arenas, roots_idx, results):
             if arena is None:
@@ -226,9 +229,21 @@ class TrieCommitter:
     # -- hash phase ---------------------------------------------------------
 
     def _hash_levels(
-        self, arenas: list[list[_Node] | None], results: list[TrieBuildResult]
+        self,
+        arenas: list[list[_Node] | None],
+        results: list[TrieBuildResult],
+        proof_targets: list[list[Nibbles]] | None = None,
     ) -> None:
-        """Hash all arenas bottom-up, one device dispatch per depth level."""
+        """Hash all arenas bottom-up, one device dispatch per depth level.
+
+        ``proof_targets[aid]``: full key paths whose spines' node RLPs are
+        recorded into ``results[aid].proof_nodes`` (a node is on a spine if
+        its path is a prefix of a target)."""
+
+        def on_spine(aid: int, at: Nibbles) -> bool:
+            if not proof_targets or not proof_targets[aid]:
+                return False
+            return any(t[: len(at)] == at for t in proof_targets[aid])
         by_depth: dict[int, list[tuple[int, int]]] = {}
         for aid, arena in enumerate(arenas):
             if arena is None:
@@ -262,6 +277,8 @@ class TrieCommitter:
             for (aid, idx), rlp in zip(level, rlps):
                 if not arenas[aid][idx].node_hash:
                     arenas[aid][idx].ref = rlp  # inline
+                if on_spine(aid, arenas[aid][idx].at):
+                    results[aid].proof_nodes[arenas[aid][idx].at] = rlp
         total_levels = len(by_depth)
         for r, arena in zip(results, arenas):
             if arena is not None:

@@ -1,0 +1,206 @@
+"""EIP-1186 proofs and multiproofs over the database.
+
+Reference analogue: `ProofCalculator` (crates/trie/trie/src/proof_v2/
+mod.rs:47), `StateProofProvider::proof/multiproof`
+(crates/storage/storage-api/src/trie.rs:147-159), serving `eth_getProof`
+(crates/rpc/rpc-eth-api/src/helpers/state.rs:155).
+
+TPU-first shape: proof generation IS an incremental commit with the
+targets as the prefix set — the planner turns everything off-spine into
+opaque boundaries, the committer rebuilds only the spines (batched
+hashing), and the spine nodes' RLPs are the proof. Multiproof = many
+targets in one commit, storage tries batched alongside.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..primitives.keccak import keccak256
+from ..primitives.nibbles import Nibbles, unpack_nibbles
+from ..primitives.rlp import rlp_decode
+from ..primitives.types import Account, EMPTY_ROOT_HASH
+from ..storage.provider import DatabaseProvider
+from .committer import TrieCommitter
+from .incremental import IncrementalStateRoot, PrefixSet, plan_subtrie
+
+
+@dataclass
+class StorageProof:
+    key: bytes
+    value: int
+    proof: list[bytes]
+
+
+@dataclass
+class AccountProof:
+    address: bytes
+    account: Account | None
+    proof: list[bytes]
+    storage_root: bytes = EMPTY_ROOT_HASH
+    storage_proofs: list[StorageProof] = field(default_factory=list)
+
+
+class ProofCalculator:
+    def __init__(self, provider: DatabaseProvider, committer: TrieCommitter | None = None):
+        self.provider = provider
+        self.committer = committer or TrieCommitter()
+        self._inc = IncrementalStateRoot(provider, self.committer)
+
+    def account_proof(self, address: bytes, slots: list[bytes] = ()) -> AccountProof:
+        return self.multiproof({address: list(slots)})[address]
+
+    def multiproof(self, targets: dict[bytes, list[bytes]]) -> dict[bytes, AccountProof]:
+        """Batched proofs for many accounts (+ their storage slots)."""
+        addresses = list(targets.keys())
+        all_slots = [s for slots in targets.values() for s in slots]
+        digests = self.committer.hasher(addresses + all_slots)
+        haddr = dict(zip(addresses, digests[: len(addresses)]))
+        hslot_iter = iter(digests[len(addresses) :])
+        hslots = {a: [next(hslot_iter) for _ in targets[a]] for a in addresses}
+
+        # plan + commit: account trie spine first
+        acct_paths = {a: unpack_nibbles(haddr[a]) for a in addresses}
+        plan = plan_subtrie(
+            self.provider.account_branch, PrefixSet(list(acct_paths.values()))
+        )
+        jobs = [(self._inc._scan_account_leaves(plan.dirty_ranges), dict(plan.boundaries))]
+        proof_target_lists = [list(acct_paths.values())]
+        # storage tries for accounts that exist and have storage
+        storage_jobs_meta = []  # (address, [slot nibble paths])
+        for a in addresses:
+            if not targets[a]:
+                continue
+            splan = plan_subtrie(
+                lambda p, _a=haddr[a]: self.provider.storage_branch(_a, p),
+                PrefixSet([unpack_nibbles(hs) for hs in hslots[a]]),
+            )
+            jobs.append((
+                self._inc._scan_storage_leaves(haddr[a], splan.dirty_ranges),
+                dict(splan.boundaries),
+            ))
+            proof_target_lists.append([unpack_nibbles(hs) for hs in hslots[a]])
+            storage_jobs_meta.append(a)
+        results = self.committer.commit_many(
+            jobs, collect_branches=False, proof_targets=proof_target_lists
+        )
+
+        acct_result = results[0]
+        out: dict[bytes, AccountProof] = {}
+        for a in addresses:
+            spine = _spine_nodes(acct_result.proof_nodes, acct_paths[a])
+            acc = self.provider.hashed_account(haddr[a])
+            out[a] = AccountProof(
+                address=a,
+                account=acc,
+                proof=spine,
+                storage_root=acc.storage_root if acc else EMPTY_ROOT_HASH,
+            )
+        for a, res in zip(storage_jobs_meta, results[1:]):
+            ap = out[a]
+            for slot, hs in zip(targets[a], hslots[a]):
+                value = self._storage_value(haddr[a], hs)
+                ap.storage_proofs.append(StorageProof(
+                    key=slot, value=value,
+                    proof=_spine_nodes(res.proof_nodes, unpack_nibbles(hs)),
+                ))
+        return out
+
+    def _storage_value(self, hashed_addr: bytes, hashed_slot: bytes) -> int:
+        from ..storage import tables as T
+
+        cur = self.provider.tx.cursor(T.Tables.HashedStorages.name)
+        entry = cur.seek_by_key_subkey(hashed_addr, hashed_slot)
+        if entry is not None and entry[1][:32] == hashed_slot:
+            return T.decode_storage_entry(entry[1])[1]
+        return 0
+
+
+def _spine_nodes(proof_nodes: dict[Nibbles, bytes], target: Nibbles) -> list[bytes]:
+    """Root→leaf node RLPs whose paths prefix ``target`` (inline nodes are
+    embedded in their parents per EIP-1186, so only hashed nodes appear —
+    plus the root which is always included)."""
+    spine = sorted(
+        (p for p in proof_nodes if target[: len(p)] == p), key=len
+    )
+    out = []
+    for p in spine:
+        rlp = proof_nodes[p]
+        if len(p) == 0 or len(rlp) >= 32:
+            out.append(rlp)
+    return out
+
+
+# -- verification (tests + light-client style checks) -------------------------
+
+
+def verify_account_proof(root: bytes, address: bytes, proof: AccountProof) -> bool:
+    """Verify an EIP-1186 account proof against a state root."""
+    value = proof.account.trie_encode() if proof.account else None
+    ok, leaf = _verify_path(root, unpack_nibbles(keccak256(address)), proof.proof)
+    if not ok:
+        return False
+    if value is None:
+        return leaf is None
+    return leaf == value
+
+
+def verify_storage_proof(storage_root: bytes, sp: StorageProof) -> bool:
+    from ..primitives.rlp import rlp_encode, encode_int
+
+    hashed = keccak256(sp.key)
+    ok, leaf = _verify_path(storage_root, unpack_nibbles(hashed), sp.proof)
+    if not ok:
+        return False
+    if sp.value == 0:
+        return leaf is None
+    return leaf == rlp_encode(encode_int(sp.value))
+
+
+def _verify_path(root: bytes, path: Nibbles, nodes: list[bytes]):
+    """Walk ``nodes`` from the root following ``path``; returns
+    (valid, leaf_value|None)."""
+    from ..primitives.nibbles import decode_path
+
+    if not nodes:
+        return root == EMPTY_ROOT_HASH, None
+    if keccak256(nodes[0]) != root:
+        return False, None
+    node_bytes = nodes[0]
+    depth = 0
+    idx = 0
+    while True:
+        node = rlp_decode(node_bytes)
+        if len(node) == 17:  # branch
+            if depth == len(path):
+                return True, node[16] or None
+            child = node[path[depth]]
+            depth += 1
+            if child == b"" or child == []:
+                return True, None
+            nxt = child
+        elif len(node) == 2:
+            nibs, is_leaf = decode_path(node[0])
+            if is_leaf:
+                if path[depth:] == nibs:
+                    return True, node[1]
+                return True, None
+            if path[depth : depth + len(nibs)] != nibs:
+                return True, None
+            depth += len(nibs)
+            nxt = node[1]
+        else:
+            return False, None
+        # resolve the next node: hash ref → next proof element; inline → walk
+        if isinstance(nxt, bytes) and len(nxt) == 32:
+            idx += 1
+            if idx >= len(nodes):
+                return False, None
+            if keccak256(nodes[idx]) != nxt:
+                return False, None
+            node_bytes = nodes[idx]
+        else:
+            # inline node embedded in the parent
+            from ..primitives.rlp import rlp_encode as enc
+
+            node_bytes = enc(nxt)

@@ -1,0 +1,75 @@
+"""CLI tests: init / import / db stats / stage run via the real argv entry."""
+
+import json
+
+import pytest
+
+from reth_tpu.cli import main
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+@pytest.fixture()
+def chain_files(tmp_path):
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    for i in range(3):
+        builder.build_block([alice.transfer(b"\x0b" * 20, 1000 + i)])
+    genesis = {
+        "config": {"chainId": 1},
+        "gasLimit": hex(builder.genesis.gas_limit),
+        "baseFeePerGas": hex(builder.genesis.base_fee_per_gas),
+        "alloc": {
+            "0x" + alice.address.hex(): {"balance": hex(10**21)},
+        },
+    }
+    gpath = tmp_path / "genesis.json"
+    gpath.write_text(json.dumps(genesis))
+    cpath = tmp_path / "chain.rlp"
+    cpath.write_bytes(builder.export_rlp())
+    return tmp_path, gpath, cpath, builder
+
+
+def test_init_and_db_stats(chain_files, capsys):
+    tmp, gpath, cpath, builder = chain_files
+    datadir = tmp / "data1"
+    datadir.mkdir()
+    assert main(["init", "--datadir", str(datadir), "--genesis", str(gpath), "--hasher", "cpu"]) == 0
+    out = capsys.readouterr().out
+    assert builder.genesis.hash.hex() in out
+    assert main(["db", "stats", "--datadir", str(datadir)]) == 0
+    out = capsys.readouterr().out
+    assert "PlainAccountState" in out
+
+
+def test_import_pipeline_and_stage_rerun(chain_files, capsys):
+    tmp, gpath, cpath, builder = chain_files
+    datadir = tmp / "data2"
+    datadir.mkdir()
+    assert main(["import", "--datadir", str(datadir), "--genesis", str(gpath),
+                 "--hasher", "cpu", str(cpath)]) == 0
+    out = capsys.readouterr().out
+    assert "imported 3 blocks" in out and "pipeline synced to 3" in out
+    # stage run is a no-op now but must succeed against the same datadir
+    assert main(["stage", "run", "--datadir", str(datadir), "--stage", "all",
+                 "--hasher", "cpu"]) == 0
+
+
+def test_genesis_mismatch_cli(chain_files, tmp_path):
+    tmp, gpath, cpath, builder = chain_files
+    datadir = tmp / "data3"
+    datadir.mkdir()
+    main(["init", "--datadir", str(datadir), "--genesis", str(gpath), "--hasher", "cpu"])
+    # re-init with a different genesis must fail loudly
+    other = json.loads(gpath.read_text())
+    other["alloc"] = {}
+    g2 = tmp_path / "g2.json"
+    g2.write_text(json.dumps(other))
+    from reth_tpu.storage.genesis import GenesisMismatch
+
+    with pytest.raises(GenesisMismatch):
+        main(["init", "--datadir", str(datadir), "--genesis", str(g2), "--hasher", "cpu"])

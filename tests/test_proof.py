@@ -1,0 +1,95 @@
+"""EIP-1186 proof tests: generation + independent verification."""
+
+import numpy as np
+
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256, keccak256_batch_np
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.trie import TrieCommitter
+from reth_tpu.trie.incremental import full_state_root
+from reth_tpu.trie.proof import (
+    ProofCalculator,
+    verify_account_proof,
+    verify_storage_proof,
+)
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def setup_state(n_accounts=50, with_storage=True):
+    rng = np.random.default_rng(3)
+    factory = ProviderFactory(MemDb())
+    addresses = [bytes(rng.integers(0, 256, 20, dtype=np.uint8)) for _ in range(n_accounts)]
+    storages = {}
+    with factory.provider_rw() as p:
+        for i, a in enumerate(addresses):
+            p.put_hashed_account(keccak256(a), Account(nonce=i, balance=1000 + i))
+        if with_storage:
+            for a in addresses[:5]:
+                slots = {
+                    bytes(rng.integers(0, 256, 32, dtype=np.uint8)): int(rng.integers(1, 2**60))
+                    for _ in range(6)
+                }
+                storages[a] = slots
+                for s, v in slots.items():
+                    p.put_hashed_storage(keccak256(a), keccak256(s), v)
+        root = full_state_root(p, CPU)
+    return factory, addresses, storages, root
+
+
+def test_account_proof_existing():
+    factory, addrs, storages, root = setup_state()
+    with factory.provider() as p:
+        calc = ProofCalculator(p, CPU)
+        proof = calc.account_proof(addrs[7])
+    assert proof.account is not None and proof.account.balance == 1007
+    assert verify_account_proof(root, addrs[7], proof)
+    # tampered proof fails
+    proof.account = proof.account.with_(balance=1)
+    assert not verify_account_proof(root, addrs[7], proof)
+
+
+def test_account_proof_absent():
+    factory, addrs, storages, root = setup_state()
+    missing = b"\x77" * 20
+    with factory.provider() as p:
+        proof = ProofCalculator(p, CPU).account_proof(missing)
+    assert proof.account is None
+    assert verify_account_proof(root, missing, proof)
+
+
+def test_storage_proofs():
+    factory, addrs, storages, root = setup_state()
+    target = addrs[0]
+    slots = list(storages[target].keys())[:3] + [b"\x55" * 32]  # 3 present + 1 absent
+    with factory.provider() as p:
+        proof = ProofCalculator(p, CPU).account_proof(target, slots)
+    assert verify_account_proof(root, target, proof)
+    assert len(proof.storage_proofs) == 4
+    for sp in proof.storage_proofs[:3]:
+        assert sp.value == storages[target][sp.key]
+        assert verify_storage_proof(proof.storage_root, sp)
+    absent = proof.storage_proofs[3]
+    assert absent.value == 0
+    assert verify_storage_proof(proof.storage_root, absent)
+
+
+def test_multiproof_batched():
+    """config #5 shape: many accounts in one batched proof computation."""
+    factory, addrs, storages, root = setup_state(n_accounts=200)
+    targets = {a: [] for a in addrs[:50]}
+    with factory.provider() as p:
+        proofs = ProofCalculator(p, CPU).multiproof(targets)
+    assert len(proofs) == 50
+    for a, proof in proofs.items():
+        assert verify_account_proof(root, a, proof), a.hex()
+
+
+def test_proof_empty_state():
+    factory = ProviderFactory(MemDb())
+    with factory.provider_rw() as p:
+        root = full_state_root(p, CPU)
+    with factory.provider() as p:
+        proof = ProofCalculator(p, CPU).account_proof(b"\x01" * 20)
+    assert proof.account is None
+    assert verify_account_proof(root, b"\x01" * 20, proof)

@@ -182,6 +182,29 @@ def test_pool_maintained_in_cl_driven_mode(node):
     assert rpc(port, "txpool_status")["pending"] == "0x0"  # evicted
 
 
+def test_eth_get_proof(node):
+    n, alice = node
+    port = n.rpc.port
+    proof = rpc(port, "eth_getProof", data(alice.address), [], "latest")
+    assert parse_qty(proof["balance"]) == 10**21
+    # verify against the canonical state root
+    from reth_tpu.trie.proof import AccountProof, verify_account_proof
+    from reth_tpu.primitives import Account
+    from reth_tpu.primitives.types import KECCAK_EMPTY, EMPTY_ROOT_HASH
+
+    blk = rpc(port, "eth_getBlockByNumber", "latest", False)
+    ap = AccountProof(
+        address=alice.address,
+        account=Account(
+            nonce=parse_qty(proof["nonce"]), balance=parse_qty(proof["balance"]),
+            storage_root=parse_data(proof["storageHash"]),
+            code_hash=parse_data(proof["codeHash"]),
+        ),
+        proof=[parse_data(x) for x in proof["accountProof"]],
+    )
+    assert verify_account_proof(parse_data(blk["stateRoot"]), alice.address, ap)
+
+
 def test_error_shapes(node):
     n, _ = node
     port = n.rpc.port
