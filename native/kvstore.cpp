@@ -1,0 +1,592 @@
+// reth-tpu native KV storage engine.
+//
+// Reference analogue: libmdbx (crates/storage/libmdbx-rs/mdbx-sys/libmdbx,
+// 37.7k LoC C) — the reference's embedded B+tree store. This engine keeps
+// the same contract surface the framework's Database/Tx/Cursor interface
+// needs: named tables sorted by key, DUPSORT duplicate lists sorted by
+// value, single-writer transactions with O(writes) abort, ordered cursors,
+// and a write-ahead log + snapshot compaction. Durability scope: commits
+// fflush (process-crash-safe; recovery = snapshot + WAL replay of complete
+// committed batches); call rtkv_sync for power-loss durability (fsync).
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC kvstore.cpp -o libkvstore.so
+
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace {
+
+using Key = std::string;
+using Dups = std::vector<std::string>;  // sorted; non-dup tables: size()==1
+using Table = std::map<Key, Dups>;
+
+struct Env;
+
+// -- WAL record layout --------------------------------------------------------
+// u8 op | u32 table_len | table | u32 key_len | key | u32 val_len | val
+// ops: 1=put 2=put_dup 3=del_key 4=del_dup 5=clear_table 6=commit_mark
+enum WalOp : uint8_t {
+  WAL_PUT = 1,
+  WAL_PUT_DUP = 2,
+  WAL_DEL_KEY = 3,
+  WAL_DEL_DUP = 4,
+  WAL_CLEAR = 5,
+  WAL_COMMIT = 6,
+};
+
+struct Env {
+  std::map<std::string, Table> tables;
+  std::string dir;       // empty = in-memory only
+  FILE* wal = nullptr;
+  uint64_t wal_records = 0;
+
+  ~Env() {
+    if (wal) fclose(wal);
+  }
+};
+
+struct UndoEntry {
+  std::string table;
+  Key key;
+  bool existed;
+  Dups prev;
+};
+
+struct ClearUndo {
+  std::string table;
+  Table prev;
+};
+
+struct Txn {
+  Env* env;
+  bool write;
+  std::vector<UndoEntry> undo;
+  std::vector<ClearUndo> clear_undo;
+  std::map<std::pair<std::string, Key>, bool> seen;
+  // WAL records buffered until commit (atomicity: records + commit mark)
+  std::string wal_buf;
+};
+
+struct Cursor {
+  Txn* txn;
+  std::string table;
+  Table::iterator it;
+  size_t dup = 0;
+  // tri-state mirrors the python MemDb cursor: UNPOS (fresh; next()=first),
+  // POS (on an entry), EXHAUSTED (failed seek / ran off the end;
+  // next()=None but prev()=last — MemDb _ki==len semantics)
+  enum State : uint8_t { UNPOS, POS, EXHAUSTED } state = UNPOS;
+};
+
+void wal_append(std::string& buf, uint8_t op, const std::string& table,
+                const std::string& key, const std::string& val) {
+  auto put32 = [&buf](uint32_t v) { buf.append(reinterpret_cast<char*>(&v), 4); };
+  buf.push_back(static_cast<char>(op));
+  put32(static_cast<uint32_t>(table.size()));
+  buf.append(table);
+  put32(static_cast<uint32_t>(key.size()));
+  buf.append(key);
+  put32(static_cast<uint32_t>(val.size()));
+  buf.append(val);
+}
+
+void apply_put(Env* env, const std::string& table, const std::string& key,
+               const std::string& val, bool dupsort) {
+  Table& t = env->tables[table];
+  Dups& d = t[key];
+  if (!dupsort) {
+    d.assign(1, val);
+    return;
+  }
+  auto pos = std::lower_bound(d.begin(), d.end(), val);
+  if (pos == d.end() || *pos != val) d.insert(pos, val);
+}
+
+bool apply_del(Env* env, const std::string& table, const std::string& key,
+               const std::string* val) {
+  auto ti = env->tables.find(table);
+  if (ti == env->tables.end()) return false;
+  auto ki = ti->second.find(key);
+  if (ki == ti->second.end()) return false;
+  if (val == nullptr) {
+    ti->second.erase(ki);
+    return true;
+  }
+  Dups& d = ki->second;
+  auto pos = std::lower_bound(d.begin(), d.end(), *val);
+  if (pos != d.end() && *pos == *val) {
+    d.erase(pos);
+    if (d.empty()) ti->second.erase(ki);
+    return true;
+  }
+  return false;
+}
+
+// -- snapshot format ----------------------------------------------------------
+// magic "RTKV1\n" | per table: u32 name_len name u64 nkeys
+//   per key: u32 key_len key u32 ndups { u32 len bytes }
+// terminated by u32 name_len == 0xFFFFFFFF
+
+bool save_snapshot(Env* env) {
+  if (env->dir.empty()) return true;
+  std::string tmp = env->dir + "/snapshot.tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return false;
+  bool ok = true;
+  auto wr = [f, &ok](const void* p, size_t n) {
+    if (n && fwrite(p, 1, n, f) != n) ok = false;
+  };
+  auto w32 = [&wr](uint32_t v) { wr(&v, 4); };
+  auto w64 = [&wr](uint64_t v) { wr(&v, 8); };
+  wr("RTKV1\n", 6);
+  for (auto& [name, table] : env->tables) {
+    w32(static_cast<uint32_t>(name.size()));
+    wr(name.data(), name.size());
+    w64(table.size());
+    for (auto& [key, dups] : table) {
+      w32(static_cast<uint32_t>(key.size()));
+      wr(key.data(), key.size());
+      w32(static_cast<uint32_t>(dups.size()));
+      for (auto& v : dups) {
+        w32(static_cast<uint32_t>(v.size()));
+        wr(v.data(), v.size());
+      }
+    }
+  }
+  w32(0xFFFFFFFFu);
+  if (fflush(f) != 0) ok = false;
+  if (ok && fsync(fileno(f)) != 0) ok = false;
+  fclose(f);
+  if (!ok) {
+    remove(tmp.c_str());
+    return false;  // keep the old snapshot + WAL intact
+  }
+  std::string final = env->dir + "/snapshot.rtkv";
+  if (rename(tmp.c_str(), final.c_str()) != 0) return false;
+  // snapshot now authoritative: truncate the WAL
+  if (env->wal) fclose(env->wal);
+  std::string walpath = env->dir + "/wal.rtkv";
+  env->wal = fopen(walpath.c_str(), "wb");
+  env->wal_records = 0;
+  return env->wal != nullptr;
+}
+
+bool read_exact(FILE* f, void* out, size_t n) { return fread(out, 1, n, f) == n; }
+
+bool load_snapshot(Env* env) {
+  std::string path = env->dir + "/snapshot.rtkv";
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return true;  // fresh env
+  char magic[6];
+  if (!read_exact(f, magic, 6) || memcmp(magic, "RTKV1\n", 6) != 0) {
+    fclose(f);
+    return false;
+  }
+  while (true) {
+    uint32_t name_len;
+    if (!read_exact(f, &name_len, 4)) break;
+    if (name_len == 0xFFFFFFFFu) break;
+    std::string name(name_len, '\0');
+    if (!read_exact(f, name.data(), name_len)) break;
+    uint64_t nkeys;
+    if (!read_exact(f, &nkeys, 8)) break;
+    Table& t = env->tables[name];
+    for (uint64_t i = 0; i < nkeys; i++) {
+      uint32_t klen;
+      if (!read_exact(f, &klen, 4)) goto done;
+      std::string key(klen, '\0');
+      if (!read_exact(f, key.data(), klen)) goto done;
+      uint32_t ndups;
+      if (!read_exact(f, &ndups, 4)) goto done;
+      Dups d;
+      d.reserve(ndups);
+      for (uint32_t j = 0; j < ndups; j++) {
+        uint32_t vlen;
+        if (!read_exact(f, &vlen, 4)) goto done;
+        std::string v(vlen, '\0');
+        if (!read_exact(f, v.data(), vlen)) goto done;
+        d.push_back(std::move(v));
+      }
+      t.emplace(std::move(key), std::move(d));
+    }
+  }
+done:
+  fclose(f);
+  return true;
+}
+
+bool replay_wal(Env* env) {
+  std::string path = env->dir + "/wal.rtkv";
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return true;
+  // collect one committed batch at a time; uncommitted tails are dropped
+  struct Rec {
+    uint8_t op;
+    std::string table, key, val;
+  };
+  std::vector<Rec> batch;
+  while (true) {
+    uint8_t op;
+    if (!read_exact(f, &op, 1)) break;
+    uint32_t tlen, klen, vlen;
+    std::string table, key, val;
+    if (!read_exact(f, &tlen, 4)) break;
+    table.resize(tlen);
+    if (tlen && !read_exact(f, table.data(), tlen)) break;
+    if (!read_exact(f, &klen, 4)) break;
+    key.resize(klen);
+    if (klen && !read_exact(f, key.data(), klen)) break;
+    if (!read_exact(f, &vlen, 4)) break;
+    val.resize(vlen);
+    if (vlen && !read_exact(f, val.data(), vlen)) break;
+    if (op == WAL_COMMIT) {
+      for (auto& r : batch) {
+        switch (r.op) {
+          case WAL_PUT: apply_put(env, r.table, r.key, r.val, false); break;
+          case WAL_PUT_DUP: apply_put(env, r.table, r.key, r.val, true); break;
+          case WAL_DEL_KEY: apply_del(env, r.table, r.key, nullptr); break;
+          case WAL_DEL_DUP: apply_del(env, r.table, r.key, &r.val); break;
+          case WAL_CLEAR: env->tables[r.table].clear(); break;
+        }
+      }
+      batch.clear();
+    } else {
+      batch.push_back({op, std::move(table), std::move(key), std::move(val)});
+    }
+  }
+  fclose(f);
+  return true;
+}
+
+void record_undo(Txn* txn, const std::string& table, const Key& key) {
+  auto mark = std::make_pair(table, key);
+  if (txn->seen.count(mark)) return;
+  txn->seen.emplace(mark, true);
+  UndoEntry e;
+  e.table = table;
+  e.key = key;
+  auto ti = txn->env->tables.find(table);
+  if (ti != txn->env->tables.end()) {
+    auto ki = ti->second.find(key);
+    if (ki != ti->second.end()) {
+      e.existed = true;
+      e.prev = ki->second;
+      txn->undo.push_back(std::move(e));
+      return;
+    }
+  }
+  e.existed = false;
+  txn->undo.push_back(std::move(e));
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rtkv_open(const char* dir) {
+  auto env = std::make_unique<Env>();
+  if (dir && dir[0]) {
+    env->dir = dir;
+    if (!load_snapshot(env.get())) return nullptr;
+    if (!replay_wal(env.get())) return nullptr;
+    std::string walpath = env->dir + "/wal.rtkv";
+    env->wal = fopen(walpath.c_str(), "ab");
+    if (!env->wal) return nullptr;
+  }
+  return env.release();
+}
+
+void rtkv_close(void* envp) { delete static_cast<Env*>(envp); }
+
+int rtkv_snapshot(void* envp) {
+  return save_snapshot(static_cast<Env*>(envp)) ? 0 : -1;
+}
+
+// Power-loss durability point: fsync the WAL.
+int rtkv_sync(void* envp) {
+  auto env = static_cast<Env*>(envp);
+  if (!env->wal) return 0;
+  if (fflush(env->wal) != 0) return -1;
+  return fsync(fileno(env->wal)) == 0 ? 0 : -1;
+}
+
+void* rtkv_txn_begin(void* envp, int write) {
+  auto txn = new Txn();
+  txn->env = static_cast<Env*>(envp);
+  txn->write = write != 0;
+  return txn;
+}
+
+int rtkv_put(void* txnp, const char* table, const uint8_t* key, uint32_t klen,
+             const uint8_t* val, uint32_t vlen, int dupsort) {
+  auto txn = static_cast<Txn*>(txnp);
+  if (!txn->write) return -1;
+  std::string t(table), k(reinterpret_cast<const char*>(key), klen),
+      v(reinterpret_cast<const char*>(val), vlen);
+  record_undo(txn, t, k);
+  apply_put(txn->env, t, k, v, dupsort != 0);
+  wal_append(txn->wal_buf, dupsort ? WAL_PUT_DUP : WAL_PUT, t, k, v);
+  return 0;
+}
+
+int rtkv_del(void* txnp, const char* table, const uint8_t* key, uint32_t klen,
+             const uint8_t* val, uint32_t vlen, int have_val) {
+  auto txn = static_cast<Txn*>(txnp);
+  if (!txn->write) return -1;
+  std::string t(table), k(reinterpret_cast<const char*>(key), klen);
+  record_undo(txn, t, k);
+  bool ok;
+  if (have_val) {
+    std::string v(reinterpret_cast<const char*>(val), vlen);
+    ok = apply_del(txn->env, t, k, &v);
+    if (ok) wal_append(txn->wal_buf, WAL_DEL_DUP, t, k, v);
+  } else {
+    ok = apply_del(txn->env, t, k, nullptr);
+    if (ok) wal_append(txn->wal_buf, WAL_DEL_KEY, t, k, "");
+  }
+  return ok ? 1 : 0;
+}
+
+int rtkv_clear(void* txnp, const char* table) {
+  auto txn = static_cast<Txn*>(txnp);
+  if (!txn->write) return -1;
+  std::string t(table);
+  ClearUndo cu;
+  cu.table = t;
+  auto ti = txn->env->tables.find(t);
+  if (ti != txn->env->tables.end()) cu.prev = std::move(ti->second);
+  // fold per-key undo of this table into the clear image (matches the
+  // python MemDb semantics: abort after put-then-clear restores tx start)
+  for (auto it = txn->undo.begin(); it != txn->undo.end();) {
+    if (it->table == t) {
+      if (it->existed) cu.prev[it->key] = it->prev;
+      else cu.prev.erase(it->key);
+      it = txn->undo.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  for (auto it = txn->seen.begin(); it != txn->seen.end();) {
+    if (it->first.first == t) it = txn->seen.erase(it);
+    else ++it;
+  }
+  txn->clear_undo.push_back(std::move(cu));
+  txn->env->tables[t].clear();
+  wal_append(txn->wal_buf, WAL_CLEAR, t, "", "");
+  return 0;
+}
+
+// get: first duplicate; returns 1 found / 0 missing. Pointer valid until the
+// next mutation of the env (caller copies immediately).
+int rtkv_get(void* txnp, const char* table, const uint8_t* key, uint32_t klen,
+             const uint8_t** out, uint32_t* out_len) {
+  auto txn = static_cast<Txn*>(txnp);
+  auto ti = txn->env->tables.find(table);
+  if (ti == txn->env->tables.end()) return 0;
+  auto ki = ti->second.find(std::string(reinterpret_cast<const char*>(key), klen));
+  if (ki == ti->second.end() || ki->second.empty()) return 0;
+  *out = reinterpret_cast<const uint8_t*>(ki->second[0].data());
+  *out_len = static_cast<uint32_t>(ki->second[0].size());
+  return 1;
+}
+
+uint64_t rtkv_entry_count(void* txnp, const char* table) {
+  auto txn = static_cast<Txn*>(txnp);
+  auto ti = txn->env->tables.find(table);
+  if (ti == txn->env->tables.end()) return 0;
+  uint64_t n = 0;
+  for (auto& [k, d] : ti->second) n += d.size();
+  return n;
+}
+
+int rtkv_commit(void* txnp) {
+  auto txn = static_cast<Txn*>(txnp);
+  int rc = 0;
+  if (txn->write && txn->env->wal && !txn->wal_buf.empty()) {
+    wal_append(txn->wal_buf, WAL_COMMIT, "", "", "");
+    if (fwrite(txn->wal_buf.data(), 1, txn->wal_buf.size(), txn->env->wal) !=
+        txn->wal_buf.size())
+      rc = -1;
+    fflush(txn->env->wal);
+    txn->env->wal_records += 1;
+  }
+  delete txn;
+  return rc;
+}
+
+void rtkv_abort(void* txnp) {
+  auto txn = static_cast<Txn*>(txnp);
+  if (txn->write) {
+    for (auto it = txn->undo.rbegin(); it != txn->undo.rend(); ++it) {
+      Table& t = txn->env->tables[it->table];
+      if (it->existed) t[it->key] = it->prev;
+      else t.erase(it->key);
+    }
+    for (auto it = txn->clear_undo.rbegin(); it != txn->clear_undo.rend(); ++it) {
+      txn->env->tables[it->table] = std::move(it->prev);
+    }
+  }
+  delete txn;
+}
+
+// -- cursors ------------------------------------------------------------------
+
+void* rtkv_cursor(void* txnp, const char* table) {
+  auto txn = static_cast<Txn*>(txnp);
+  auto cur = new Cursor();
+  cur->txn = txn;
+  cur->table = table;
+  cur->state = Cursor::UNPOS;
+  return cur;
+}
+
+void rtkv_cursor_close(void* curp) { delete static_cast<Cursor*>(curp); }
+
+namespace {
+
+Table* cursor_table(Cursor* c) {
+  auto ti = c->txn->env->tables.find(c->table);
+  return ti == c->txn->env->tables.end() ? nullptr : &ti->second;
+}
+
+int emit(Cursor* c, const uint8_t** k, uint32_t* klen, const uint8_t** v,
+         uint32_t* vlen) {
+  if (c->state != Cursor::POS) return 0;
+  const Key& key = c->it->first;
+  const Dups& d = c->it->second;
+  if (c->dup >= d.size()) return 0;
+  *k = reinterpret_cast<const uint8_t*>(key.data());
+  *klen = static_cast<uint32_t>(key.size());
+  *v = reinterpret_cast<const uint8_t*>(d[c->dup].data());
+  *vlen = static_cast<uint32_t>(d[c->dup].size());
+  return 1;
+}
+
+}  // namespace
+
+int rtkv_cursor_first(void* curp, const uint8_t** k, uint32_t* klen,
+                      const uint8_t** v, uint32_t* vlen) {
+  auto c = static_cast<Cursor*>(curp);
+  Table* t = cursor_table(c);
+  if (!t || t->empty()) {
+    c->state = Cursor::EXHAUSTED;
+    return 0;
+  }
+  c->it = t->begin();
+  c->dup = 0;
+  c->state = Cursor::POS;
+  return emit(c, k, klen, v, vlen);
+}
+
+int rtkv_cursor_last(void* curp, const uint8_t** k, uint32_t* klen,
+                     const uint8_t** v, uint32_t* vlen) {
+  auto c = static_cast<Cursor*>(curp);
+  Table* t = cursor_table(c);
+  if (!t || t->empty()) {
+    c->state = Cursor::EXHAUSTED;
+    return 0;
+  }
+  c->it = std::prev(t->end());
+  c->dup = c->it->second.size() ? c->it->second.size() - 1 : 0;
+  c->state = Cursor::POS;
+  return emit(c, k, klen, v, vlen);
+}
+
+int rtkv_cursor_seek(void* curp, const uint8_t* key, uint32_t klen, int exact,
+                     const uint8_t** k, uint32_t* kl, const uint8_t** v,
+                     uint32_t* vl) {
+  auto c = static_cast<Cursor*>(curp);
+  Table* t = cursor_table(c);
+  c->state = Cursor::EXHAUSTED;
+  if (!t) return 0;
+  std::string target(reinterpret_cast<const char*>(key), klen);
+  auto it = t->lower_bound(target);
+  if (it == t->end()) return 0;
+  if (exact && it->first != target) return 0;
+  c->it = it;
+  c->dup = 0;
+  c->state = Cursor::POS;
+  return emit(c, k, kl, v, vl);
+}
+
+int rtkv_cursor_next(void* curp, int skip_dups, const uint8_t** k, uint32_t* kl,
+                     const uint8_t** v, uint32_t* vl) {
+  auto c = static_cast<Cursor*>(curp);
+  Table* t = cursor_table(c);
+  if (!t) {
+    c->state = Cursor::EXHAUSTED;
+    return 0;
+  }
+  if (c->state == Cursor::EXHAUSTED) return 0;  // MemDb: past-the-end stays put
+  if (c->state == Cursor::UNPOS) return rtkv_cursor_first(curp, k, kl, v, vl);
+  if (!skip_dups && c->dup + 1 < c->it->second.size()) {
+    c->dup += 1;
+    return emit(c, k, kl, v, vl);
+  }
+  ++c->it;
+  c->dup = 0;
+  if (c->it == t->end()) {
+    c->state = Cursor::EXHAUSTED;
+    return 0;
+  }
+  return emit(c, k, kl, v, vl);
+}
+
+int rtkv_cursor_prev(void* curp, const uint8_t** k, uint32_t* kl,
+                     const uint8_t** v, uint32_t* vl) {
+  auto c = static_cast<Cursor*>(curp);
+  Table* t = cursor_table(c);
+  if (!t || c->state == Cursor::UNPOS) return 0;
+  if (c->state == Cursor::EXHAUSTED)  // MemDb: prev from past-the-end = last
+    return rtkv_cursor_last(curp, k, kl, v, vl);
+  if (c->dup > 0) {
+    c->dup -= 1;
+    return emit(c, k, kl, v, vl);
+  }
+  if (c->it == t->begin()) {
+    c->state = Cursor::UNPOS;
+    return 0;
+  }
+  --c->it;
+  c->dup = c->it->second.size() ? c->it->second.size() - 1 : 0;
+  return emit(c, k, kl, v, vl);
+}
+
+// next duplicate of the CURRENT key only; 0 when exhausted
+int rtkv_cursor_next_dup(void* curp, const uint8_t** k, uint32_t* kl,
+                         const uint8_t** v, uint32_t* vl) {
+  auto c = static_cast<Cursor*>(curp);
+  if (c->state != Cursor::POS) return 0;
+  if (c->dup + 1 >= c->it->second.size()) return 0;
+  c->dup += 1;
+  return emit(c, k, kl, v, vl);
+}
+
+// first duplicate of `key` with value >= subkey prefix
+int rtkv_cursor_seek_dup(void* curp, const uint8_t* key, uint32_t klen,
+                         const uint8_t* sub, uint32_t slen, const uint8_t** k,
+                         uint32_t* kl, const uint8_t** v, uint32_t* vl) {
+  auto c = static_cast<Cursor*>(curp);
+  Table* t = cursor_table(c);
+  c->state = Cursor::EXHAUSTED;
+  if (!t) return 0;
+  auto it = t->find(std::string(reinterpret_cast<const char*>(key), klen));
+  if (it == t->end()) return 0;
+  std::string target(reinterpret_cast<const char*>(sub), slen);
+  const Dups& d = it->second;
+  auto pos = std::lower_bound(d.begin(), d.end(), target);
+  if (pos == d.end()) return 0;
+  c->it = it;
+  c->dup = static_cast<size_t>(pos - d.begin());
+  c->state = Cursor::POS;
+  return emit(c, k, kl, v, vl);
+}
+
+}  // extern "C"

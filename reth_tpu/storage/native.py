@@ -1,0 +1,289 @@
+"""ctypes binding for the native C++ KV engine (native/kvstore.cpp).
+
+Reference analogue: libmdbx-rs — the Rust binding over the C engine
+(crates/storage/libmdbx-rs). Exposes the same Database/Tx/Cursor duck
+interface as ``MemDb``; the shared library is built on demand with g++
+and cached next to the source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "kvstore.cpp"
+_SO = _SRC.parent / "build" / "libkvstore.so"
+_build_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            _SO.parent.mkdir(parents=True, exist_ok=True)
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   str(_SRC), "-o", str(_SO)]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(f"g++ failed:\n{proc.stderr}")
+        lib = ctypes.CDLL(str(_SO))
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.rtkv_open.restype = ctypes.c_void_p
+        lib.rtkv_open.argtypes = [ctypes.c_char_p]
+        lib.rtkv_close.argtypes = [ctypes.c_void_p]
+        lib.rtkv_snapshot.argtypes = [ctypes.c_void_p]
+        lib.rtkv_txn_begin.restype = ctypes.c_void_p
+        lib.rtkv_txn_begin.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rtkv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
+                                 ctypes.c_uint32, u8p, ctypes.c_uint32, ctypes.c_int]
+        lib.rtkv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
+                                 ctypes.c_uint32, u8p, ctypes.c_uint32, ctypes.c_int]
+        lib.rtkv_clear.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtkv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
+                                 ctypes.c_uint32, ctypes.POINTER(u8p),
+                                 ctypes.POINTER(ctypes.c_uint32)]
+        lib.rtkv_entry_count.restype = ctypes.c_uint64
+        lib.rtkv_entry_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtkv_commit.argtypes = [ctypes.c_void_p]
+        lib.rtkv_abort.argtypes = [ctypes.c_void_p]
+        lib.rtkv_sync.argtypes = [ctypes.c_void_p]
+        lib.rtkv_cursor.restype = ctypes.c_void_p
+        lib.rtkv_cursor.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtkv_cursor_close.argtypes = [ctypes.c_void_p]
+        out4 = [ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint32)]
+        lib.rtkv_cursor_first.argtypes = [ctypes.c_void_p] + out4
+        lib.rtkv_cursor_last.argtypes = [ctypes.c_void_p] + out4
+        lib.rtkv_cursor_seek.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint32,
+                                         ctypes.c_int] + out4
+        lib.rtkv_cursor_next.argtypes = [ctypes.c_void_p, ctypes.c_int] + out4
+        lib.rtkv_cursor_prev.argtypes = [ctypes.c_void_p] + out4
+        lib.rtkv_cursor_next_dup.argtypes = [ctypes.c_void_p] + out4
+        lib.rtkv_cursor_seek_dup.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_uint32, u8p, ctypes.c_uint32] + out4
+        _lib = lib
+        return _lib
+
+
+def _buf(b: bytes):
+    return (ctypes.c_uint8 * len(b)).from_buffer_copy(b) if b else None
+
+
+class NativeCursor:
+    """Cursor over one table; same surface as storage.kv.Cursor."""
+
+    def __init__(self, tx: "NativeTx", table: str):
+        self._lib = tx._lib
+        self._tx = tx  # keep the txn alive for the cursor's lifetime
+        self._cur = self._lib.rtkv_cursor(tx._txn, table.encode())
+        self._out = (
+            ctypes.POINTER(ctypes.c_uint8)(), ctypes.c_uint32(),
+            ctypes.POINTER(ctypes.c_uint8)(), ctypes.c_uint32(),
+        )
+
+    def __del__(self):
+        try:
+            self._lib.rtkv_cursor_close(self._cur)
+        except Exception:
+            pass
+
+    def _ret(self, rc: int):
+        if not rc:
+            return None
+        kp, kl, vp, vl = self._out
+        key = ctypes.string_at(kp, kl.value) if kl.value else b""
+        val = ctypes.string_at(vp, vl.value) if vl.value else b""
+        return (key, val)
+
+    def _refs(self):
+        kp, kl, vp, vl = self._out
+        return (ctypes.byref(kp), ctypes.byref(kl), ctypes.byref(vp), ctypes.byref(vl))
+
+    def first(self):
+        return self._ret(self._lib.rtkv_cursor_first(self._cur, *self._refs()))
+
+    def last(self):
+        return self._ret(self._lib.rtkv_cursor_last(self._cur, *self._refs()))
+
+    def seek(self, key: bytes):
+        return self._ret(self._lib.rtkv_cursor_seek(
+            self._cur, _buf(key), len(key), 0, *self._refs()))
+
+    def seek_exact(self, key: bytes):
+        return self._ret(self._lib.rtkv_cursor_seek(
+            self._cur, _buf(key), len(key), 1, *self._refs()))
+
+    def next(self):
+        return self._ret(self._lib.rtkv_cursor_next(self._cur, 0, *self._refs()))
+
+    def prev(self):
+        return self._ret(self._lib.rtkv_cursor_prev(self._cur, *self._refs()))
+
+    def next_dup(self):
+        return self._ret(self._lib.rtkv_cursor_next_dup(self._cur, *self._refs()))
+
+    def next_no_dup(self):
+        return self._ret(self._lib.rtkv_cursor_next(self._cur, 1, *self._refs()))
+
+    def seek_by_key_subkey(self, key: bytes, subkey: bytes):
+        return self._ret(self._lib.rtkv_cursor_seek_dup(
+            self._cur, _buf(key), len(key), _buf(subkey), len(subkey), *self._refs()))
+
+    def walk(self, start: bytes | None = None):
+        entry = self.seek(start) if start is not None else self.first()
+        while entry is not None:
+            yield entry
+            entry = self.next()
+
+    def walk_dup(self, key: bytes, subkey: bytes = b""):
+        entry = self.seek_by_key_subkey(key, subkey)
+        while entry is not None:
+            yield entry
+            entry = self.next_dup()
+
+    def walk_range(self, start: bytes, end: bytes):
+        for key, value in self.walk(start):
+            if key >= end:
+                return
+            yield (key, value)
+
+
+class NativeTx:
+    def __init__(self, db: "NativeDb", write: bool):
+        self._db = db
+        self._lib = db._lib
+        self._txn = self._lib.rtkv_txn_begin(db._env, 1 if write else 0)
+        self._write = write
+        self._done = False
+
+    def get(self, table: str, key: bytes):
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint32()
+        rc = self._lib.rtkv_get(self._txn, table.encode(), _buf(key), len(key),
+                                ctypes.byref(out), ctypes.byref(out_len))
+        if not rc:
+            return None
+        return ctypes.string_at(out, out_len.value) if out_len.value else b""
+
+
+    def get_dups(self, table: str, key: bytes) -> list[bytes]:
+        return [v for _, v in self.cursor(table).walk_dup(key)]
+
+    def cursor(self, table: str) -> NativeCursor:
+        return NativeCursor(self, table)
+
+    def entry_count(self, table: str) -> int:
+        return int(self._lib.rtkv_entry_count(self._txn, table.encode()))
+
+    def _sorted_keys(self, table: str) -> list[bytes]:
+        # cached at DB level (single-writer model, like MemDb's key cache)
+        cached = self._db._key_cache.get(table)
+        if cached is not None:
+            return cached
+        keys = []
+        cur = self.cursor(table)
+        entry = cur.first()
+        while entry is not None:
+            keys.append(entry[0])
+            entry = cur.next_no_dup()
+        self._db._key_cache[table] = keys
+        return keys
+
+    def put(self, table: str, key: bytes, value: bytes, dupsort: bool = False):
+        assert self._write, "read-only transaction"
+        self._db._key_cache.pop(table, None)
+        self._lib.rtkv_put(self._txn, table.encode(), _buf(key), len(key),
+                           _buf(value), len(value), 1 if dupsort else 0)
+
+    def delete(self, table: str, key: bytes, value: bytes | None = None) -> bool:
+        assert self._write, "read-only transaction"
+        self._db._key_cache.pop(table, None)
+        if value is None:
+            return bool(self._lib.rtkv_del(self._txn, table.encode(), _buf(key),
+                                           len(key), None, 0, 0))
+        return bool(self._lib.rtkv_del(self._txn, table.encode(), _buf(key),
+                                       len(key), _buf(value), len(value), 1))
+
+    def clear(self, table: str):
+        assert self._write
+        self._db._key_cache.pop(table, None)
+        self._lib.rtkv_clear(self._txn, table.encode())
+
+    def commit(self):
+        assert not self._done
+        rc = self._lib.rtkv_commit(self._txn)
+        self._done = True
+        if rc != 0:
+            raise OSError("native KV commit failed (WAL write error)")
+
+    def abort(self):
+        if not self._done:
+            if self._write:
+                # writes mutated live tables; caches may be stale after undo
+                self._db._key_cache.clear()
+            self._lib.rtkv_abort(self._txn)
+            self._done = True
+
+    def __del__(self):
+        # read txns are routinely dropped without commit (provider reads);
+        # abort frees the C++ Txn (no-op rollback for read-only)
+        try:
+            self.abort()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *a):
+        if not self._done:
+            if exc_type is None and self._write:
+                self.commit()
+            else:
+                self.abort()
+
+
+class NativeDb:
+    """Database over the C++ engine (persistent when ``path`` given)."""
+
+    def __init__(self, path: str | Path | None = None):
+        self._lib = load_library()
+        self._dir = str(path) if path else ""
+        self._key_cache: dict[str, list[bytes]] = {}
+        if path:
+            Path(path).mkdir(parents=True, exist_ok=True)
+        self._env = self._lib.rtkv_open(self._dir.encode())
+        if not self._env:
+            raise NativeBuildError(f"rtkv_open failed for {self._dir!r}")
+
+    def tx(self) -> NativeTx:
+        return NativeTx(self, write=False)
+
+    def tx_mut(self) -> NativeTx:
+        return NativeTx(self, write=True)
+
+    def flush(self):
+        """Compact the WAL into a snapshot (fsynced)."""
+        if self._lib.rtkv_snapshot(self._env) != 0:
+            raise OSError("native KV snapshot failed")
+
+    def sync(self):
+        """Power-loss durability point: fsync the WAL."""
+        if self._lib.rtkv_sync(self._env) != 0:
+            raise OSError("native KV sync failed")
+
+    def close(self):
+        if self._env:
+            self._lib.rtkv_close(self._env)
+            self._env = None

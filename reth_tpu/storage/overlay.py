@@ -35,7 +35,8 @@ class _MergedTable:
             if t is not None and key in t:
                 v = t[key]
                 return default if v is _TOMBSTONE else v
-        return self._overlay._base_table().get(self._table_name, {}).get(key, default)
+        v = self._overlay._base_get(self._table_name, key)
+        return default if v is None else v
 
     def __contains__(self, key: bytes) -> bool:
         return self.get(key) is not None
@@ -64,8 +65,20 @@ class OverlayTx:
         for l in reversed(self.parent_layers):
             yield l
 
-    def _base_table(self):
-        return self.base._db._tables if hasattr(self.base, "_db") else {}
+    def _base_get(self, table: str, key: bytes):
+        """Backend-agnostic base read: value bytes, dup list, or None.
+
+        Fast path for MemDb (direct table dict); generic path goes through
+        the Tx duck interface (works over the native C++ engine too).
+        """
+        if hasattr(self.base, "_db") and hasattr(self.base._db, "_tables"):
+            return self.base._db._tables.get(table, {}).get(key)
+        dups = self.base.get_dups(table, key)
+        if not dups:
+            return None
+        # always a list: keeps dup-delete semantics identical across
+        # backends (a single-dup entry must NOT collapse to plain bytes)
+        return list(dups)
 
     def _table(self, table: str) -> _MergedTable:
         return _MergedTable(self, table)
@@ -126,7 +139,7 @@ class OverlayTx:
                     prev = lt[key]
                     break
             else:
-                prev = self._base_table().get(table, {}).get(key)
+                prev = self._base_get(table, key)
             if prev is _TOMBSTONE:
                 prev = None
             t[key] = list(prev) if isinstance(prev, list) else prev

@@ -17,6 +17,105 @@ from reth_tpu.storage.tables import be64
 from reth_tpu.trie.committer import BranchNode
 
 
+def _native_db():
+    from reth_tpu.storage.native import NativeDb
+
+    return NativeDb()
+
+
+@pytest.fixture(params=["mem", "native"])
+def make_db(request):
+    """Both storage backends must satisfy the same KV contract."""
+    if request.param == "mem":
+        return MemDb
+    try:
+        _native_db()
+    except Exception as e:  # toolchain missing
+        pytest.skip(f"native backend unavailable: {e}")
+    return _native_db
+
+
+def test_kv_basic_and_cursor_order_backends(make_db):
+    db = make_db()
+    with db.tx_mut() as tx:
+        for k in (b"b", b"a", b"c"):
+            tx.put("t", k, b"v" + k)
+    tx = db.tx()
+    cur = tx.cursor("t")
+    assert [k for k, _ in cur.walk()] == [b"a", b"b", b"c"]
+    assert cur.seek(b"aa") == (b"b", b"vb")
+    assert cur.seek_exact(b"aa") is None
+    assert cur.seek_exact(b"c") == (b"c", b"vc")
+    assert cur.prev() == (b"b", b"vb")
+    assert cur.last() == (b"c", b"vc")
+
+
+def test_dupsort_backends(make_db):
+    db = make_db()
+    with db.tx_mut() as tx:
+        tx.put("d", b"k1", b"bbb", dupsort=True)
+        tx.put("d", b"k1", b"aaa", dupsort=True)
+        tx.put("d", b"k1", b"ccc", dupsort=True)
+        tx.put("d", b"k2", b"zzz", dupsort=True)
+    cur = db.tx().cursor("d")
+    assert list(cur.walk_dup(b"k1")) == [(b"k1", b"aaa"), (b"k1", b"bbb"), (b"k1", b"ccc")]
+    assert cur.seek_by_key_subkey(b"k1", b"bb") == (b"k1", b"bbb")
+    assert cur.seek_by_key_subkey(b"k1", b"zzz") is None
+    assert [v for _, v in db.tx().cursor("d").walk()] == [b"aaa", b"bbb", b"ccc", b"zzz"]
+    with db.tx_mut() as tx:
+        assert tx.delete("d", b"k1", b"bbb")
+    assert list(db.tx().cursor("d").walk_dup(b"k1")) == [(b"k1", b"aaa"), (b"k1", b"ccc")]
+
+
+def test_cursor_failed_seek_semantics_backends(make_db):
+    """Failed seeks leave the cursor past-the-end on BOTH backends:
+    next() -> None, prev() -> last entry (MemDb _ki==len semantics)."""
+    db = make_db()
+    with db.tx_mut() as tx:
+        for k in (b"a", b"b", b"c"):
+            tx.put("t", k, b"v" + k)
+    cur = db.tx().cursor("t")
+    assert cur.seek(b"zzz") is None
+    assert cur.next() is None
+    assert cur.prev() == (b"c", b"vc")
+    cur2 = db.tx().cursor("t")
+    assert cur2.seek_exact(b"nope") is None
+    assert cur2.next() is None
+    # fresh cursor: next() == first()
+    cur3 = db.tx().cursor("t")
+    assert cur3.next() == (b"a", b"va")
+
+
+def test_abort_backends(make_db):
+    db = make_db()
+    with db.tx_mut() as tx:
+        tx.put("t", b"k", b"v1")
+    tx = db.tx_mut()
+    tx.put("t", b"k", b"v2")
+    tx.put("t", b"k2", b"x")
+    tx.delete("t", b"k")
+    tx.clear("t")
+    tx.put("t", b"k3", b"z")
+    tx.abort()
+    assert db.tx().get("t", b"k") == b"v1"
+    assert db.tx().get("t", b"k2") is None
+    assert db.tx().get("t", b"k3") is None
+
+
+def test_provider_over_both_backends(make_db):
+    factory = ProviderFactory(make_db())
+    addr = b"\x0a" * 20
+    with factory.provider_rw() as p:
+        p.put_account(addr, Account(nonce=1, balance=100))
+        p.put_storage(addr, b"\x01" * 32, 42)
+        p.put_storage(addr, b"\x01" * 32, 43)  # overwrite
+        p.record_account_change(5, addr, None)
+    p = factory.provider()
+    assert p.account(addr) == Account(nonce=1, balance=100)
+    assert p.account_storage(addr) == {b"\x01" * 32: 43}
+    assert p.account_changes_in_range(5, 5) == {addr: None}
+
+
 def test_kv_basic_and_cursor_order():
     db = MemDb()
     with db.tx_mut() as tx:
