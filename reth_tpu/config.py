@@ -1,0 +1,76 @@
+"""TOML node configuration (reth.toml analogue).
+
+Reference analogue: crates/config — `reth.toml` with per-stage
+thresholds (`StageConfig`/`MerkleConfig`, src/config.rs:22-537) and
+prune settings. Read with stdlib tomllib; flags override file values.
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .prune import PruneMode, PruneModes
+
+
+@dataclass
+class MerkleConfig:
+    # reference: rebuild_threshold=100_000, incremental_threshold=7_000
+    rebuild_threshold: int = 50_000
+    incremental_threshold: int = 7_000
+
+
+@dataclass
+class HashingConfig:
+    clean_threshold: int = 100_000
+
+
+@dataclass
+class ExecutionConfig:
+    max_blocks_per_commit: int = 1000
+
+
+@dataclass
+class StageConfig:
+    merkle: MerkleConfig = field(default_factory=MerkleConfig)
+    account_hashing: HashingConfig = field(default_factory=HashingConfig)
+    storage_hashing: HashingConfig = field(default_factory=HashingConfig)
+    execution: ExecutionConfig = field(default_factory=ExecutionConfig)
+
+
+@dataclass
+class RethTpuConfig:
+    stages: StageConfig = field(default_factory=StageConfig)
+    prune: PruneModes = field(default_factory=PruneModes)
+    persistence_threshold: int = 2
+    hasher: str = "device"  # device | cpu
+
+
+def _prune_mode(d: dict) -> PruneMode:
+    return PruneMode(distance=d.get("distance"), before=d.get("before"))
+
+
+def load_config(path: str | Path | None) -> RethTpuConfig:
+    cfg = RethTpuConfig()
+    if path is None or not Path(path).exists():
+        return cfg
+    raw = tomllib.loads(Path(path).read_text())
+    stages = raw.get("stages", {})
+    if "merkle" in stages:
+        cfg.stages.merkle = MerkleConfig(**stages["merkle"])
+    if "account_hashing" in stages:
+        cfg.stages.account_hashing = HashingConfig(**stages["account_hashing"])
+    if "storage_hashing" in stages:
+        cfg.stages.storage_hashing = HashingConfig(**stages["storage_hashing"])
+    if "execution" in stages:
+        cfg.stages.execution = ExecutionConfig(**stages["execution"])
+    prune = raw.get("prune", {})
+    for seg in ("sender_recovery", "receipts", "transaction_lookup",
+                "account_history", "storage_history"):
+        if seg in prune:
+            setattr(cfg.prune, seg, _prune_mode(prune[seg]))
+    node = raw.get("node", {})
+    cfg.persistence_threshold = node.get("persistence_threshold", cfg.persistence_threshold)
+    cfg.hasher = node.get("hasher", cfg.hasher)
+    return cfg

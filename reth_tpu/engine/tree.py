@@ -10,8 +10,11 @@ committer over the block's overlay.
 
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass, field
 from enum import Enum
+
+from ..metrics import REGISTRY
 
 from ..consensus import ConsensusError, EthBeaconConsensus
 from ..evm import BlockExecutor, EvmConfig
@@ -92,6 +95,11 @@ class EngineTree:
         self.persisted_hash = h
         self.head_hash: bytes = h  # canonical in-memory head
         self.canon_listeners: list = []  # CanonStateNotification sinks
+        self._root_histogram = REGISTRY.histogram(
+            "engine_state_root_duration_seconds",
+            "per-block incremental state-root wall clock",
+        )
+        self._blocks_counter = REGISTRY.counter("engine_blocks_executed_total")
 
     # -- helpers --------------------------------------------------------------
 
@@ -234,7 +242,10 @@ class EngineTree:
             overlay.put_sender(idx.first_tx_num + i, s)
         write_execution_output(overlay, n, idx.first_tx_num, out)
         # hashed-state delta + incremental root (the state-root job)
+        t0 = _time.time()
         root = self._state_root_job(overlay, out)
+        self._root_histogram.record(_time.time() - t0)
+        self._blocks_counter.increment()
         if root != header.state_root:
             msg = (
                 f"state root mismatch: computed {root.hex()} header "

@@ -1,0 +1,113 @@
+"""ExEx (execution extensions): durable canonical-state notifications.
+
+Reference analogue: crates/exex — `ExExManager` fanning out
+`CanonStateNotification`s with backpressure, a WAL so notifications
+survive restarts (src/wal/), and `FinishedHeight` feedback that gates
+pruning (src/lib.rs:17-24). Extensions register a handler; the manager
+journals every notification before delivery and replays unacknowledged
+ones on restart.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+
+@dataclass
+class CanonStateNotification:
+    """A committed chain segment (hashes + numbers; state via provider)."""
+
+    tip_number: int
+    tip_hash: bytes
+    blocks: list[tuple[int, bytes]]  # (number, hash) oldest first
+
+    def to_json(self) -> dict:
+        return {
+            "tip_number": self.tip_number,
+            "tip_hash": self.tip_hash.hex(),
+            "blocks": [[n, h.hex()] for n, h in self.blocks],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CanonStateNotification":
+        return cls(
+            d["tip_number"], bytes.fromhex(d["tip_hash"]),
+            [(n, bytes.fromhex(h)) for n, h in d["blocks"]],
+        )
+
+
+class ExExHandle:
+    def __init__(self, name: str, handler):
+        self.name = name
+        self.handler = handler
+        self.finished_height = 0  # highest block fully processed
+
+
+class ExExManager:
+    """Fan-out + WAL + finished-height aggregation."""
+
+    def __init__(self, wal_dir: str | Path | None = None):
+        self.handles: list[ExExHandle] = []
+        self.wal_path = Path(wal_dir) / "exex_wal.jsonl" if wal_dir else None
+        self._next_seq = 0
+        if self.wal_path and self.wal_path.exists():
+            # count existing records so sequence numbers keep increasing
+            with open(self.wal_path) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    self._next_seq = max(self._next_seq, rec["seq"] + 1)
+
+    def register(self, name: str, handler) -> ExExHandle:
+        h = ExExHandle(name, handler)
+        self.handles.append(h)
+        return h
+
+    def notify(self, notification: CanonStateNotification) -> None:
+        seq = self._next_seq
+        self._next_seq += 1
+        if self.wal_path:
+            with open(self.wal_path, "a") as f:
+                f.write(json.dumps({"seq": seq, "n": notification.to_json()}) + "\n")
+                f.flush()
+        for h in self.handles:
+            h.handler(notification)
+            h.finished_height = max(h.finished_height, notification.tip_number)
+
+    def finished_height(self) -> int:
+        """Lowest height every extension has finished — the pruning gate."""
+        if not self.handles:
+            return 1 << 62
+        return min(h.finished_height for h in self.handles)
+
+    def replay(self, from_height: int = 0) -> int:
+        """Redeliver WAL'd notifications above ``from_height`` (restart)."""
+        if not self.wal_path or not self.wal_path.exists():
+            return 0
+        count = 0
+        with open(self.wal_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                n = CanonStateNotification.from_json(rec["n"])
+                if n.tip_number > from_height:
+                    for h in self.handles:
+                        h.handler(n)
+                        h.finished_height = max(h.finished_height, n.tip_number)
+                    count += 1
+        return count
+
+    def prune_wal(self, below_height: int) -> None:
+        """Drop WAL records at or below a height every ExEx finished."""
+        if not self.wal_path or not self.wal_path.exists():
+            return
+        kept = []
+        with open(self.wal_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec["n"]["tip_number"] > below_height:
+                    kept.append(line)
+        tmp = self.wal_path.with_suffix(".tmp")
+        with open(tmp, "w") as f:
+            f.writelines(kept)
+        tmp.replace(self.wal_path)

@@ -1,0 +1,112 @@
+"""Metrics registry with a Prometheus text exposition endpoint.
+
+Reference analogue: crates/metrics (metrics-rs facade + derive) and
+crates/node/metrics (Prometheus server/recorder,
+node/metrics/src/server.rs:22). Counters/gauges/histograms register
+globally; the node serves GET /metrics from its HTTP server.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str = ""
+    value: float = 0.0
+
+    def increment(self, amount: float = 1.0):
+        self.value += amount
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str = ""
+    value: float = 0.0
+
+    def set(self, value: float):
+        self.value = value
+
+
+@dataclass
+class Histogram:
+    """Fixed-bucket histogram (Prometheus cumulative buckets)."""
+
+    name: str
+    help: str = ""
+    buckets: tuple[float, ...] = (0.001, 0.01, 0.1, 0.5, 1, 5, 30, 120)
+    counts: list[int] = field(default_factory=list)
+    total: float = 0.0
+    n: int = 0
+
+    def __post_init__(self):
+        if not self.counts:
+            self.counts = [0] * (len(self.buckets) + 1)
+
+    def record(self, value: float):
+        self.total += value
+        self.n += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _register(self, name: str, kind, factory):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is None:
+                existing = self._metrics[name] = factory()
+            elif not isinstance(existing, kind):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(existing).__name__}"
+                )
+            return existing
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._register(name, Counter, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._register(name, Gauge, lambda: Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "", **kw) -> Histogram:
+        h = self._register(name, Histogram, lambda: Histogram(name, help, **kw))
+        if kw.get("buckets") and h.buckets != kw["buckets"]:
+            raise ValueError(f"metric {name!r} registered with different buckets")
+        return h
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        with self._lock:
+            for name, m in sorted(self._metrics.items()):
+                if isinstance(m, Counter):
+                    lines.append(f"# TYPE {name} counter")
+                    lines.append(f"{name} {m.value}")
+                elif isinstance(m, Gauge):
+                    lines.append(f"# TYPE {name} gauge")
+                    lines.append(f"{name} {m.value}")
+                elif isinstance(m, Histogram):
+                    lines.append(f"# TYPE {name} histogram")
+                    cum = 0
+                    for b, c in zip(m.buckets, m.counts):
+                        cum += c
+                        lines.append(f'{name}_bucket{{le="{b}"}} {cum}')
+                    lines.append(f'{name}_bucket{{le="+Inf"}} {m.n}')
+                    lines.append(f"{name}_sum {m.total}")
+                    lines.append(f"{name}_count {m.n}")
+        return "\n".join(lines) + "\n"
+
+
+# the global registry (metrics-rs global recorder analogue)
+REGISTRY = MetricsRegistry()

@@ -225,8 +225,20 @@ class KeccakDevice:
 
     def _hash_bucket(self, sub: list[bytes], key: int, counts: np.ndarray) -> np.ndarray:
         """Hash one bucket; returns (n, 8) uint32 digests."""
+        import os
+
         n = len(sub)
         batch_tier = _next_tier(n, self.min_tier)
+        if key == 1 and os.environ.get("RETH_TPU_PALLAS"):
+            # hand-written fused kernel for the dominant single-block bucket;
+            # any lowering failure falls back to the XLA path below
+            try:
+                from .keccak_pallas import keccak256_pallas_words
+
+                w32 = _to_u32(pad_batch(sub, 1), batch_tier)
+                return np.asarray(keccak256_pallas_words(w32))[:n]
+            except Exception:
+                pass
         if self.block_tier is None and key <= self.MAX_EXACT_BLOCKS:
             w32 = _to_u32(pad_batch(sub, key), batch_tier)
             digests = keccak256_jax_words(jnp.asarray(w32), key)

@@ -106,6 +106,20 @@ class RpcServer:
                 self.end_headers()
                 self.wfile.write(resp)
 
+            def do_GET(self):
+                if self.path == "/metrics":
+                    from ..metrics import REGISTRY
+
+                    body = REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
             def log_message(self, *a):  # quiet
                 pass
 

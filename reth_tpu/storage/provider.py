@@ -35,10 +35,15 @@ class BlockBodyIndices:
 
 
 class DatabaseProvider:
-    """A transaction-scoped typed view of the database."""
+    """A transaction-scoped typed view of the database.
 
-    def __init__(self, tx: Tx):
+    ``static_files``: optional StaticFileProvider — reads of rows moved
+    out of the DB by the static-file producer fall back to it.
+    """
+
+    def __init__(self, tx: Tx, static_files=None):
         self.tx = tx
+        self.static_files = static_files
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -129,6 +134,8 @@ class DatabaseProvider:
         out = []
         for i in range(idx.first_tx_num, idx.next_tx_num):
             raw = self.tx.get(Tables.Transactions.name, be64(i))
+            if raw is None and self.static_files is not None:
+                raw = self.static_files.row("transactions", i, "tx")
             if raw is None:
                 raise KeyError(f"missing tx number {i}")
             out.append(T.decode_tx(raw))
@@ -165,6 +172,8 @@ class DatabaseProvider:
 
     def receipt(self, tx_num: int) -> Receipt | None:
         raw = self.tx.get(Tables.Receipts.name, be64(tx_num))
+        if raw is None and self.static_files is not None:
+            raw = self.static_files.row("receipts", tx_num, "receipt")
         return T.decode_receipt(raw) if raw else None
 
     # -- plain state -----------------------------------------------------------
@@ -391,11 +400,12 @@ class DatabaseProvider:
 class ProviderFactory:
     """Creates transaction-scoped providers (reference `ProviderFactory`)."""
 
-    def __init__(self, db: Database):
+    def __init__(self, db: Database, static_files=None):
         self.db = db
+        self.static_files = static_files
 
     def provider(self) -> DatabaseProvider:
-        return DatabaseProvider(self.db.tx())
+        return DatabaseProvider(self.db.tx(), self.static_files)
 
     def provider_rw(self) -> DatabaseProvider:
-        return DatabaseProvider(self.db.tx_mut())
+        return DatabaseProvider(self.db.tx_mut(), self.static_files)

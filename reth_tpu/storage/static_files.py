@@ -1,0 +1,206 @@
+"""Static files: immutable columnar segment files for finalized history.
+
+Reference analogue: crates/static-file (`StaticFileProducer` moving
+finalized headers/txs/receipts out of MDBX) + crates/storage/nippy-jar
+(the immutable mmap column format with compression). Format per file:
+
+    magic "RTSF1\\n"
+    u32 json_len | json header {segment, start, count, columns:[names]}
+    per column: u64[count+1] offsets | zlib-compressed rows back to back
+
+Readers memory-map lazily (plain file reads here); rows decompress on
+access. The provider falls back to static files for rows pruned from
+the DB, so history stays served after the producer runs.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from dataclasses import dataclass
+from pathlib import Path
+
+MAGIC = b"RTSF1\n"
+
+SEGMENT_HEADERS = "headers"          # row key: block number; cols: header, hash
+SEGMENT_TRANSACTIONS = "transactions"  # row key: tx number; cols: tx
+SEGMENT_RECEIPTS = "receipts"        # row key: tx number; cols: receipt
+
+
+def write_segment_file(
+    path: Path, segment: str, start: int, columns: dict[str, list[bytes]]
+) -> None:
+    names = list(columns.keys())
+    count = len(next(iter(columns.values())))
+    for rows in columns.values():
+        assert len(rows) == count, "ragged columns"
+    header = json.dumps(
+        {"segment": segment, "start": start, "count": count, "columns": names}
+    ).encode()
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<I", len(header)))
+        f.write(header)
+        for name in names:
+            blobs = [zlib.compress(r) for r in columns[name]]
+            offsets = [0]
+            for b in blobs:
+                offsets.append(offsets[-1] + len(b))
+            f.write(struct.pack(f"<{count + 1}Q", *offsets))
+            for b in blobs:
+                f.write(b)
+
+
+@dataclass
+class SegmentFile:
+    path: Path
+    segment: str
+    start: int
+    count: int
+    columns: list[str]
+    _col_offsets: dict[str, int]  # file offset of each column's offset table
+    _fh: object = None            # cached open handle (immutable file)
+
+    @property
+    def end(self) -> int:
+        return self.start + self.count - 1
+
+    @classmethod
+    def open(cls, path: Path) -> "SegmentFile":
+        f = open(path, "rb")
+        if f.read(6) != MAGIC:
+            f.close()
+            raise ValueError(f"{path}: bad magic")
+        (hlen,) = struct.unpack("<I", f.read(4))
+        meta = json.loads(f.read(hlen))
+        pos = 6 + 4 + hlen
+        col_offsets = {}
+        for name in meta["columns"]:
+            col_offsets[name] = pos
+            f.seek(pos)
+            offs = struct.unpack(
+                f"<{meta['count'] + 1}Q", f.read(8 * (meta["count"] + 1))
+            )
+            pos += 8 * (meta["count"] + 1) + offs[-1]
+        return cls(path, meta["segment"], meta["start"], meta["count"],
+                   meta["columns"], col_offsets, f)
+
+    def row(self, number: int, column: str) -> bytes | None:
+        if not (self.start <= number <= self.end):
+            return None
+        i = number - self.start
+        base = self._col_offsets[column]
+        f = self._fh  # immutable file: one cached handle, seek per read
+        f.seek(base + 8 * i)
+        lo, hi = struct.unpack("<2Q", f.read(16))
+        payload_base = base + 8 * (self.count + 1)
+        f.seek(payload_base + lo)
+        return zlib.decompress(f.read(hi - lo))
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+class StaticFileProvider:
+    """Read side over a directory of segment files."""
+
+    def __init__(self, directory: str | Path):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._files: dict[str, list[SegmentFile]] = {}
+        self.reload()
+
+    def reload(self):
+        for files in self._files.values():
+            for sf in files:
+                sf.close()
+        self._files = {}
+        for p in sorted(self.dir.glob("*.sf")):
+            sf = SegmentFile.open(p)
+            self._files.setdefault(sf.segment, []).append(sf)
+        for files in self._files.values():
+            files.sort(key=lambda s: s.start)
+
+    def highest(self, segment: str) -> int | None:
+        files = self._files.get(segment)
+        return files[-1].end if files else None
+
+    def row(self, segment: str, number: int, column: str) -> bytes | None:
+        for sf in self._files.get(segment, []):
+            if sf.start <= number <= sf.end:
+                return sf.row(number, column)
+        return None
+
+
+class StaticFileProducer:
+    """Moves finalized rows DB → segment files, then prunes the DB copies.
+
+    Reference: static_file_producer.rs — runs after the pipeline commits;
+    here it takes [from, to] block range per run.
+    """
+
+    def __init__(self, factory, provider_dir: str | Path):
+        self.factory = factory
+        self.static = StaticFileProvider(provider_dir)
+
+    def run(self, to_block: int) -> dict[str, int]:
+        """Copy segments up to ``to_block``; returns rows moved per segment."""
+        from . import tables as T
+        from .tables import Tables, be64
+
+        moved = {}
+        with self.factory.provider_rw() as p:
+            h = self.static.highest(SEGMENT_HEADERS)
+            start_block = (h if h is not None else -1) + 1
+            if start_block > to_block:
+                return {}
+            headers, hashes, txs, receipts = [], [], [], []
+            first_tx_num = None
+            for n in range(start_block, to_block + 1):
+                h = p.header_by_number(n)
+                if h is None:
+                    raise ValueError(f"missing header {n}")
+                headers.append(h.encode())
+                hashes.append(h.hash)
+                idx = p.block_body_indices(n)
+                if idx and idx.tx_count:
+                    if first_tx_num is None:
+                        first_tx_num = idx.first_tx_num
+                    for t in range(idx.first_tx_num, idx.next_tx_num):
+                        raw_tx = p.tx.get(Tables.Transactions.name, be64(t))
+                        if raw_tx is None:
+                            raise ValueError(f"missing tx {t} in block {n}")
+                        txs.append(raw_tx)
+                        raw_rc = p.tx.get(Tables.Receipts.name, be64(t))
+                        receipts.append(raw_rc or b"")
+            write_segment_file(
+                self.static.dir / f"headers_{start_block}_{to_block}.sf",
+                SEGMENT_HEADERS, start_block,
+                {"header": headers, "hash": hashes},
+            )
+            moved[SEGMENT_HEADERS] = len(headers)
+            if txs:
+                write_segment_file(
+                    self.static.dir / f"transactions_{first_tx_num}_{first_tx_num + len(txs) - 1}.sf",
+                    SEGMENT_TRANSACTIONS, first_tx_num, {"tx": txs},
+                )
+                write_segment_file(
+                    self.static.dir / f"receipts_{first_tx_num}_{first_tx_num + len(txs) - 1}.sf",
+                    SEGMENT_RECEIPTS, first_tx_num, {"receipt": receipts},
+                )
+                moved[SEGMENT_TRANSACTIONS] = len(txs)
+                moved[SEGMENT_RECEIPTS] = len(receipts)
+            # prune DB copies (headers stay for canonical-hash lookups of
+            # the recent window; here we drop tx/receipt rows like the
+            # reference's static-file-backed tables)
+            for n in range(start_block, to_block + 1):
+                idx = p.block_body_indices(n)
+                if idx and idx.tx_count:
+                    for t in range(idx.first_tx_num, idx.next_tx_num):
+                        p.tx.delete(Tables.Transactions.name, be64(t))
+                        p.tx.delete(Tables.Receipts.name, be64(t))
+        self.static.reload()
+        return moved

@@ -1,0 +1,160 @@
+"""Aux subsystems: pruner, ExEx WAL, metrics endpoint, TOML config."""
+
+import urllib.request
+
+from reth_tpu.config import load_config
+from reth_tpu.exex import CanonStateNotification, ExExManager
+from reth_tpu.metrics import MetricsRegistry
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.prune import PruneMode, PruneModes, Pruner
+from reth_tpu.consensus import EthBeaconConsensus
+from reth_tpu.stages import Pipeline, default_stages
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import import_chain, init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def synced_factory(n_blocks=6):
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    for i in range(n_blocks):
+        builder.build_block([alice.transfer(b"\x0b" * 20, 100 + i)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    import_chain(factory, builder.blocks[1:], EthBeaconConsensus(CPU))
+    Pipeline(factory, default_stages(committer=CPU)).run(n_blocks)
+    return factory, builder
+
+
+def test_pruner_receipts_and_senders():
+    factory, _ = synced_factory()
+    modes = PruneModes(
+        receipts=PruneMode(distance=2),
+        sender_recovery=PruneMode(distance=2),
+        transaction_lookup=PruneMode(before=3),
+    )
+    progress = Pruner(factory, modes).run(tip=6)
+    assert {p.segment for p in progress} == {"SenderRecovery", "Receipts", "TransactionLookup"}
+    p = factory.provider()
+    # blocks 1..3 pruned (tip 6, distance 2 → target 3)
+    assert p.receipt(0) is None and p.sender(0) is None
+    # blocks 4..6 retained
+    idx4 = p.block_body_indices(4)
+    assert p.receipt(idx4.first_tx_num) is not None
+    # lookup pruned only before block 3
+    tx_b1 = p.transactions_by_block(1)[0]
+    tx_b5 = p.transactions_by_block(5)[0]
+    from reth_tpu.storage.tables import Tables
+
+    assert p.tx.get(Tables.TransactionHashNumbers.name, tx_b1.hash) is None
+    assert p.tx.get(Tables.TransactionHashNumbers.name, tx_b5.hash) is not None
+    # second run is a no-op (checkpoints advanced)
+    assert Pruner(factory, modes).run(tip=6) == []
+
+
+def test_exex_wal_and_replay(tmp_path):
+    mgr = ExExManager(tmp_path)
+    seen = []
+    mgr.register("indexer", lambda n: seen.append(n.tip_number))
+    for i in range(1, 4):
+        mgr.notify(CanonStateNotification(i, bytes([i]) * 32, [(i, bytes([i]) * 32)]))
+    assert seen == [1, 2, 3]
+    assert mgr.finished_height() == 3
+
+    # restart: new manager replays the WAL above the ExEx's durable height
+    mgr2 = ExExManager(tmp_path)
+    seen2 = []
+    mgr2.register("indexer", lambda n: seen2.append(n.tip_number))
+    replayed = mgr2.replay(from_height=1)
+    assert replayed == 2 and seen2 == [2, 3]
+    # prune acknowledged records
+    mgr2.prune_wal(below_height=2)
+    mgr3 = ExExManager(tmp_path)
+    got = []
+    mgr3.register("x", lambda n: got.append(n.tip_number))
+    mgr3.replay()
+    assert got == [3]
+
+
+def test_metrics_render():
+    reg = MetricsRegistry()
+    reg.counter("blocks_total", "blocks").increment(5)
+    reg.gauge("head_number").set(42)
+    h = reg.histogram("root_seconds", buckets=(0.1, 1.0))
+    h.record(0.05)
+    h.record(0.5)
+    h.record(10)
+    text = reg.render()
+    assert "blocks_total 5.0" in text
+    assert "head_number 42" in text
+    assert 'root_seconds_bucket{le="0.1"} 1' in text
+    assert 'root_seconds_bucket{le="1.0"} 2' in text
+    assert 'root_seconds_bucket{le="+Inf"} 3' in text
+    assert "root_seconds_count 3" in text
+
+
+def test_metrics_http_endpoint():
+    from reth_tpu.metrics import REGISTRY
+    from reth_tpu.rpc import RpcServer
+
+    REGISTRY.counter("test_http_metric").increment()
+    srv = RpcServer()
+    port = srv.start()
+    try:
+        body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10).read()
+        assert b"test_http_metric" in body
+    finally:
+        srv.stop()
+
+
+def test_static_file_producer_and_fallback(tmp_path):
+    factory, builder = synced_factory()
+    producer = __import__(
+        "reth_tpu.storage.static_files", fromlist=["StaticFileProducer"]
+    ).StaticFileProducer(factory, tmp_path / "static")
+    moved = producer.run(to_block=4)
+    assert moved["headers"] == 5  # blocks 0..4
+    assert moved["transactions"] == 4  # blocks 1..4, one tx each
+    # DB rows for the moved range are gone...
+    from reth_tpu.storage.tables import Tables, be64
+
+    p = factory.provider()
+    assert p.tx.get(Tables.Transactions.name, be64(0)) is None
+    # ...but a static-file-aware factory still serves them
+    factory2 = ProviderFactory(factory.db, producer.static)
+    p2 = factory2.provider()
+    txs = p2.transactions_by_block(1)
+    assert len(txs) == 1 and txs[0].value == 100
+    assert p2.receipt(0) is not None
+    # incremental second run picks up where it left off
+    moved2 = producer.run(to_block=6)
+    assert moved2["headers"] == 2
+    assert factory2.provider().transactions_by_block(6)[0].value == 105
+
+
+def test_config_toml(tmp_path):
+    cfg_file = tmp_path / "reth.toml"
+    cfg_file.write_text("""
+[stages.merkle]
+rebuild_threshold = 123
+incremental_threshold = 45
+
+[prune.receipts]
+distance = 100
+
+[node]
+persistence_threshold = 5
+hasher = "cpu"
+""")
+    cfg = load_config(cfg_file)
+    assert cfg.stages.merkle.rebuild_threshold == 123
+    assert cfg.prune.receipts.distance == 100
+    assert cfg.prune.sender_recovery.distance is None
+    assert cfg.persistence_threshold == 5
+    assert cfg.hasher == "cpu"
+    # missing file → defaults
+    assert load_config(tmp_path / "nope.toml").stages.merkle.rebuild_threshold == 50_000
