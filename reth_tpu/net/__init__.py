@@ -1,0 +1,32 @@
+"""P2P networking: eth-wire messages, peer sessions, sync downloaders.
+
+Reference analogue: crates/net — eth-wire message types/codecs
+(eth-wire-types), the session/server machinery (network), download
+abstractions (p2p) and the reverse-headers/bodies downloaders
+(downloaders). Transport here is length-prefixed frames over TCP; the
+RLPx ECIES/AES encryption layer is a later milestone (no AES primitive
+in-image) — the message vocabulary, handshake semantics, request/
+response correlation, and sync logic are the compatible parts.
+"""
+
+from .wire import (
+    EthMessage,
+    MessageId,
+    Status,
+    decode_message,
+    encode_message,
+)
+from .p2p import PeerConnection
+from .server import NetworkManager
+from .downloader import sync_from_peer
+
+__all__ = [
+    "EthMessage",
+    "MessageId",
+    "Status",
+    "decode_message",
+    "encode_message",
+    "PeerConnection",
+    "NetworkManager",
+    "sync_from_peer",
+]

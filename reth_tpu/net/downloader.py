@@ -1,0 +1,75 @@
+"""Sync downloaders: headers + bodies from a peer into the pipeline.
+
+Reference analogue: crates/net/downloaders — `ReverseHeadersDownloader`
+(tip→local batched header download) and `BodiesDownloader`, feeding the
+staged pipeline. ``sync_from_peer`` is the full networked-sync flow:
+fetch headers to the peer's tip, validate linkage, fetch bodies, insert
+via import, run the pipeline.
+"""
+
+from __future__ import annotations
+
+from ..consensus import EthBeaconConsensus
+from ..primitives.types import Block
+from ..storage.genesis import import_chain
+from .p2p import PeerConnection, PeerError
+
+HEADER_BATCH = 192
+BODY_BATCH = 128
+
+
+def download_headers(peer: PeerConnection, from_block: int, to_block: int) -> list:
+    """Forward header download [from_block, to_block] in batches."""
+    headers = []
+    n = from_block
+    while n <= to_block:
+        limit = min(HEADER_BATCH, to_block - n + 1)
+        batch = peer.get_headers(n, limit)
+        if not batch:
+            raise PeerError(f"peer returned no headers at {n}")
+        for h in batch:
+            if h.number != n:
+                raise PeerError(f"non-contiguous header {h.number} != {n}")
+            if headers and h.parent_hash != headers[-1].hash:
+                raise PeerError(f"broken parent link at {h.number}")
+            headers.append(h)
+            n += 1
+    return headers
+
+
+def download_bodies(peer: PeerConnection, headers: list) -> list[Block]:
+    """Fetch bodies for ``headers``; returns sealed blocks, validated."""
+    blocks = []
+    for i in range(0, len(headers), BODY_BATCH):
+        chunk = headers[i : i + BODY_BATCH]
+        bodies = peer.get_bodies([h.hash for h in chunk])
+        if len(bodies) != len(chunk):
+            raise PeerError("missing bodies in response")
+        for header, body in zip(chunk, bodies):
+            blocks.append(Block(header, body.transactions, body.ommers, body.withdrawals))
+    return blocks
+
+
+def sync_from_peer(factory, peer: PeerConnection, pipeline=None,
+                   consensus: EthBeaconConsensus | None = None) -> int:
+    """Sync to the peer's head; returns the new local tip.
+
+    The networked version of `reth import`: headers (with linkage checks)
+    → bodies → import (pre-execution validation) → staged pipeline.
+    """
+    consensus = consensus or EthBeaconConsensus()
+    with factory.provider() as p:
+        local_tip = p.last_block_number()
+    # peer head number: ask for its head header by hash
+    head = peer.get_headers(peer.status.head, 1)
+    if not head:
+        return local_tip
+    target = head[0].number
+    if target <= local_tip:
+        return local_tip
+    headers = download_headers(peer, local_tip + 1, target)
+    blocks = download_bodies(peer, headers)
+    tip = import_chain(factory, blocks, consensus)
+    if pipeline is not None:
+        pipeline.run(tip)
+    return tip

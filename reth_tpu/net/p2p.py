@@ -1,0 +1,130 @@
+"""Peer sessions: framed TCP transport + status handshake + requests.
+
+Reference analogue: crates/net/network session machinery
+(src/session/mod.rs) and the p2p client traits
+(crates/net/p2p: HeadersClient/BodiesClient). Request/response
+correlation uses eth/66-style request ids.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+
+from . import wire
+from .wire import MessageId, Status, decode_message, encode_message
+
+
+class PeerError(Exception):
+    pass
+
+
+class PeerConnection:
+    """One established peer session over a socket."""
+
+    def __init__(self, sock: socket.socket, status: Status):
+        self.sock = sock
+        self.status = status  # the REMOTE peer's status
+        self._req_ids = itertools.count(1)
+        self._lock = threading.Lock()
+        # unsolicited gossip received while awaiting a response (drained by
+        # the owner; bounded so a chatty peer cannot balloon memory)
+        self.gossip: list = []
+        self.MAX_GOSSIP_BUFFER = 1024
+
+    # -- framing ---------------------------------------------------------------
+
+    @staticmethod
+    def _recv_exact(sock, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise PeerError("peer disconnected")
+            buf += chunk
+        return buf
+
+    @classmethod
+    def recv_frame(cls, sock) -> bytes:
+        (length,) = struct.unpack("<I", cls._recv_exact(sock, 4))
+        if length > 64 * 1024 * 1024:
+            raise PeerError("oversized frame")
+        return cls._recv_exact(sock, length)
+
+    def send(self, msg) -> None:
+        data = encode_message(msg)
+        with self._lock:
+            self.sock.sendall(data)
+
+    def recv(self):
+        return decode_message(self.recv_frame(self.sock))
+
+    # -- handshake -------------------------------------------------------------
+
+    @classmethod
+    def connect(cls, host: str, port: int, our_status: Status,
+                timeout: float = 10.0) -> "PeerConnection":
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.sendall(encode_message(our_status))
+        remote = decode_message(cls.recv_frame(sock))
+        if not isinstance(remote, Status):
+            raise PeerError("expected status handshake")
+        _validate_status(our_status, remote)
+        return cls(sock, remote)
+
+    @classmethod
+    def accept(cls, sock: socket.socket, our_status: Status) -> "PeerConnection":
+        remote = decode_message(cls.recv_frame(sock))
+        if not isinstance(remote, Status):
+            raise PeerError("expected status handshake")
+        _validate_status(our_status, remote)
+        sock.sendall(encode_message(our_status))
+        return cls(sock, remote)
+
+    # -- typed requests (HeadersClient / BodiesClient analogues) ---------------
+
+    def _await_response(self, kind, rid: int, max_frames: int = 256):
+        """Receive until the matching (type, request_id) response arrives;
+        interleaved gossip is buffered, not treated as a protocol error."""
+        for _ in range(max_frames):
+            msg = self.recv()
+            if isinstance(msg, kind) and msg.request_id == rid:
+                return msg
+            if isinstance(msg, (wire.TransactionsMsg, wire.NewPooledTxHashes,
+                                wire.NewBlockHashes)):
+                if len(self.gossip) < self.MAX_GOSSIP_BUFFER:
+                    self.gossip.append(msg)
+                continue
+            raise PeerError(f"unexpected {type(msg).__name__} awaiting {kind.__name__}")
+        raise PeerError("response never arrived")
+
+    def get_headers(self, start, limit: int, reverse: bool = False,
+                    skip: int = 0) -> list:
+        rid = next(self._req_ids)
+        self.send(wire.GetBlockHeaders(rid, start, limit, skip, reverse))
+        return self._await_response(wire.BlockHeaders, rid).headers
+
+    def get_bodies(self, hashes: list[bytes]) -> list:
+        rid = next(self._req_ids)
+        self.send(wire.GetBlockBodies(rid, hashes))
+        return self._await_response(wire.BlockBodies, rid).bodies
+
+    def get_receipts(self, hashes: list[bytes]) -> list[list[bytes]]:
+        rid = next(self._req_ids)
+        self.send(wire.GetReceipts(rid, hashes))
+        return self._await_response(wire.ReceiptsMsg, rid).receipts
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _validate_status(ours: Status, theirs: Status) -> None:
+    if theirs.network_id != ours.network_id:
+        raise PeerError(f"network id mismatch: {theirs.network_id}")
+    if theirs.genesis != ours.genesis:
+        raise PeerError("genesis mismatch")

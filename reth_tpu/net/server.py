@@ -1,0 +1,162 @@
+"""NetworkManager: listen, serve eth requests, track peers.
+
+Reference analogue: crates/net/network — `NetworkManager`
+(src/manager.rs:108) + `EthRequestHandler` serving headers/bodies/
+receipts from the provider (src/eth_requests.rs), and tx broadcast
+hooks (src/transactions/). Threaded accept loop; one thread per peer.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from . import wire
+from .p2p import PeerConnection, PeerError
+from .wire import Status
+
+MAX_HEADERS_SERVE = 1024
+MAX_BODIES_SERVE = 256
+
+
+class NetworkManager:
+    def __init__(self, factory, status: Status, pool=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.factory = factory
+        self.status = status
+        self.pool = pool
+        self.host = host
+        self.port = port
+        self.peers: list[PeerConnection] = []
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> int:
+        self._listener = socket.create_server((self.host, self.port))
+        self.port = self._listener.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self.port
+
+    def stop(self):
+        self._stop.set()
+        if self._listener:
+            self._listener.close()
+        for p in list(self.peers):  # serve threads mutate the live list
+            p.close()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            try:
+                peer = PeerConnection.accept(sock, self.status)
+            except PeerError:
+                sock.close()
+                continue
+            self.peers.append(peer)
+            t = threading.Thread(target=self._serve_peer, args=(peer,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- request serving (EthRequestHandler analogue) --------------------------
+
+    def _serve_peer(self, peer: PeerConnection):
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = peer.recv()
+                    self._handle(peer, msg)
+                except Exception:  # noqa: BLE001 — malformed frame/request
+                    break          # drops the peer; cleanup in finally
+        finally:
+            peer.close()
+            try:
+                self.peers.remove(peer)
+            except ValueError:
+                pass
+
+    def _handle(self, peer: PeerConnection, msg):
+        if isinstance(msg, wire.GetBlockHeaders):
+            peer.send(wire.BlockHeaders(msg.request_id, self._headers_for(msg)))
+        elif isinstance(msg, wire.GetBlockBodies):
+            peer.send(wire.BlockBodies(msg.request_id, self._bodies_for(msg.hashes)))
+        elif isinstance(msg, wire.GetReceipts):
+            peer.send(wire.ReceiptsMsg(msg.request_id, self._receipts_for(msg.hashes)))
+        elif isinstance(msg, wire.TransactionsMsg) and self.pool is not None:
+            from ..pool import PoolError
+
+            for tx in msg.transactions:
+                try:
+                    self.pool.add_transaction(tx)
+                except PoolError:
+                    pass
+        # other gossip ignored for now
+
+    def _headers_for(self, req: wire.GetBlockHeaders):
+        with self.factory.provider() as p:
+            if isinstance(req.start, bytes):
+                start = p.block_number(req.start)
+                if start is None:
+                    return []
+            else:
+                start = req.start
+            step = -(1 + req.skip) if req.reverse else (1 + req.skip)
+            out = []
+            n = start
+            for _ in range(min(req.limit, MAX_HEADERS_SERVE)):
+                h = p.header_by_number(n)
+                if h is None:
+                    break
+                out.append(h)
+                n += step
+                if n < 0:
+                    break
+            return out
+
+    def _bodies_for(self, hashes):
+        from .wire import BlockBody
+
+        out = []
+        with self.factory.provider() as p:
+            for h in hashes[:MAX_BODIES_SERVE]:
+                n = p.block_number(h)
+                if n is None:
+                    continue
+                block = p.block_by_number(n)
+                out.append(BlockBody(block.transactions, block.ommers, block.withdrawals))
+        return out
+
+    def _receipts_for(self, hashes):
+        from ..storage import tables as T
+
+        out = []
+        with self.factory.provider() as p:
+            for h in hashes[:MAX_BODIES_SERVE]:
+                n = p.block_number(h)
+                if n is None:
+                    continue
+                idx = p.block_body_indices(n)
+                rs = []
+                if idx:
+                    for t in range(idx.first_tx_num, idx.next_tx_num):
+                        r = p.receipt(t)
+                        if r is not None:
+                            rs.append(T.encode_receipt(r))
+                out.append(rs)
+        return out
+
+    # -- broadcast -------------------------------------------------------------
+
+    def broadcast_transactions(self, txs):
+        for peer in list(self.peers):
+            try:
+                peer.send(wire.TransactionsMsg(list(txs)))
+            except (PeerError, OSError):
+                pass

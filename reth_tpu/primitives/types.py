@@ -346,6 +346,36 @@ class Receipt:
         return bytes([self.tx_type]) + payload
 
 
+def body_rlp_fields(
+    transactions: tuple[Transaction, ...],
+    ommers: tuple[Header, ...],
+    withdrawals: tuple[Withdrawal, ...] | None,
+) -> list:
+    """Block-body RLP shape — the single home for it (blocks + wire bodies)."""
+    fields: list = [
+        [_tx_block_item(tx) for tx in transactions],
+        [o.rlp_fields() for o in ommers],
+    ]
+    if withdrawals is not None:
+        fields.append([w.rlp_fields() for w in withdrawals])
+    return fields
+
+
+def body_from_fields(f: list):
+    """Inverse of ``body_rlp_fields`` → (txs, ommers, withdrawals)."""
+    withdrawals = None
+    if len(f) > 2:
+        withdrawals = tuple(
+            Withdrawal(decode_int(w[0]), decode_int(w[1]), w[2], decode_int(w[3]))
+            for w in f[2]
+        )
+    return (
+        tuple(_tx_from_block_item(t) for t in f[0]),
+        tuple(Header.decode_fields(o) for o in f[1]),
+        withdrawals,
+    )
+
+
 @dataclass(frozen=True)
 class Block:
     header: Header
@@ -354,27 +384,16 @@ class Block:
     withdrawals: tuple[Withdrawal, ...] | None = None
 
     def encode(self) -> bytes:
-        fields: list = [
-            self.header.rlp_fields(),
-            [_tx_block_item(tx) for tx in self.transactions],
-            [o.rlp_fields() for o in self.ommers],
-        ]
-        if self.withdrawals is not None:
-            fields.append([w.rlp_fields() for w in self.withdrawals])
-        return rlp_encode(fields)
+        return rlp_encode(
+            [self.header.rlp_fields()]
+            + body_rlp_fields(self.transactions, self.ommers, self.withdrawals)
+        )
 
     @classmethod
     def decode(cls, data: bytes) -> "Block":
         f = rlp_decode(data)
         header = Header.decode_fields(f[0])
-        txs = tuple(_tx_from_block_item(t) for t in f[1])
-        ommers = tuple(Header.decode_fields(o) for o in f[2])
-        withdrawals = None
-        if len(f) > 3:
-            withdrawals = tuple(
-                Withdrawal(decode_int(w[0]), decode_int(w[1]), w[2], decode_int(w[3]))
-                for w in f[3]
-            )
+        txs, ommers, withdrawals = body_from_fields(f[1:])
         return cls(header, txs, ommers, withdrawals)
 
     @property
