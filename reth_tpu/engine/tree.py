@@ -385,9 +385,19 @@ class EngineTree:
             for h in to_persist:
                 apply_layer(p.tx, self.blocks[h].layer)
             top = self.blocks[to_persist[-1]].number
+            # history indices run at persistence time (the engine path skips
+            # the pipeline, but changesets are in the layers)
+            from ..stages import IndexAccountHistoryStage, IndexStorageHistoryStage
+            from ..stages.api import ExecInput
+
+            for stage_obj in (IndexStorageHistoryStage(), IndexAccountHistoryStage()):
+                cp = p.stage_checkpoint(stage_obj.id)
+                if cp < top:
+                    stage_obj.execute(p, ExecInput(top, cp))
             for stage in ("SenderRecovery", "Execution", "MerkleUnwind",
                           "AccountHashing", "StorageHashing", "MerkleExecute",
-                          "TransactionLookup", "Finish"):
+                          "TransactionLookup", "IndexStorageHistory",
+                          "IndexAccountHistory", "Finish"):
                 p.save_stage_checkpoint(stage, top)
         last = self.blocks[to_persist[-1]]
         self.persisted_number = last.number

@@ -44,11 +44,28 @@ class EthApi:
         return parse_qty(tag)
 
     def _state_at(self, tag):
+        """State view at a block tag: the live overlay for the tip, a
+        history-index-backed view for older blocks.
+
+        Rejects: unknown (future) blocks, blocks newer than the history
+        index covers (the unindexed in-memory window), and blocks below
+        the history prune horizon — never silently serves tip state."""
         p = self._provider()
         n = self._resolve_number(tag, p)
-        if n != p.last_block_number():
-            raise RpcError(-32000, "historical state not yet served")
-        return p
+        tip = p.last_block_number()
+        if n == tip:
+            return p
+        if n > tip:
+            raise RpcError(-32000, f"unknown block {n} (tip {tip})")
+        from ..storage.tables import Tables, from_be64
+
+        for seg in (b"AccountHistory", b"StorageHistory"):
+            raw = p.tx.get(Tables.PruneCheckpoints.name, seg)
+            if raw is not None and n < from_be64(raw):
+                raise RpcError(-32000, f"historical state pruned below {from_be64(raw)}")
+        from ..storage.historical import HistoricalStateProvider
+
+        return HistoricalStateProvider(p, n)
 
     # -- chain meta ------------------------------------------------------------
 
@@ -98,9 +115,12 @@ class EthApi:
         return data(v.to_bytes(32, "big"))
 
     def eth_getProof(self, address, slots, tag="latest"):
+        from ..storage.historical import HistoricalStateProvider
         from ..trie.proof import ProofCalculator
 
         p = self._state_at(tag)
+        if isinstance(p, HistoricalStateProvider):
+            raise RpcError(-32000, "proofs are served for the latest block only")
         addr = parse_data(address)
         keys = [parse_qty(s).to_bytes(32, "big") for s in slots]
         proof = ProofCalculator(p, self.tree.committer).account_proof(addr, keys)
@@ -221,19 +241,25 @@ class EthApi:
 
     # -- execution (read-only) ---------------------------------------------------
 
-    def _call_env(self, p):
-        header = p.header_by_number(p.last_block_number())
+    def _call_env(self, tag="latest"):
+        """Execution env for eth_call at ``tag``: the REQUESTED block's
+        number/timestamp/basefee, so state and env are consistent."""
+        p = self._provider()
+        n = self._resolve_number(tag, p)
+        header = p.header_by_number(min(n, p.last_block_number()))
         return BlockEnv(
-            number=header.number + 1,
-            timestamp=header.timestamp + 12,
+            number=header.number,
+            timestamp=header.timestamp,
+            coinbase=header.beneficiary,
             gas_limit=header.gas_limit,
             base_fee=header.base_fee_per_gas or 0,
+            prev_randao=header.mix_hash,
             chain_id=self.chain_id,
         )
 
     def eth_call(self, call, tag="latest"):
         p = self._state_at(tag)
-        env = self._call_env(p)
+        env = self._call_env(tag)
         state = EvmState(ProviderStateSource(p))
         interp = Interpreter(state, env, TxEnv(origin=parse_data(call.get("from", "0x" + "00" * 20))))
         to = parse_data(call["to"]) if call.get("to") else None
@@ -255,7 +281,7 @@ class EthApi:
 
     def eth_estimateGas(self, call, tag="latest"):
         p = self._state_at(tag)
-        env = self._call_env(p)
+        env = self._call_env(tag)
         sender = parse_data(call.get("from", "0x" + "00" * 20))
         state = EvmState(ProviderStateSource(p))
         interp = Interpreter(state, env, TxEnv(origin=sender))

@@ -12,6 +12,7 @@ from .sender_recovery import SenderRecoveryStage
 from .hashing import AccountHashingStage, StorageHashingStage
 from .merkle import MerkleStage, MerkleUnwindStage
 from .tx_lookup import TransactionLookupStage
+from .index_history import IndexAccountHistoryStage, IndexStorageHistoryStage
 from .finish import FinishStage
 
 
@@ -27,6 +28,8 @@ def default_stages(committer=None, consensus=None) -> list[Stage]:
         StorageHashingStage(committer=committer),
         MerkleStage(committer=committer),
         TransactionLookupStage(),
+        IndexStorageHistoryStage(),
+        IndexAccountHistoryStage(),
         FinishStage(),
     ]
 
@@ -45,6 +48,8 @@ __all__ = [
     "MerkleStage",
     "MerkleUnwindStage",
     "TransactionLookupStage",
+    "IndexAccountHistoryStage",
+    "IndexStorageHistoryStage",
     "FinishStage",
     "default_stages",
 ]

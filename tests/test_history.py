@@ -1,0 +1,144 @@
+"""History index stages + HistoricalStateProvider + historical RPC."""
+
+from reth_tpu.consensus import EthBeaconConsensus
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.stages import Pipeline, default_stages
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import import_chain, init_genesis
+from reth_tpu.storage.historical import HistoricalStateProvider
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+STORE_CODE = bytes.fromhex("5f355f5500")  # sstore(0, calldata[0])
+
+
+def initcode_for(runtime: bytes) -> bytes:
+    n = len(runtime)
+    return bytes([0x60, n, 0x60, 0x0B, 0x5F, 0x39, 0x60, n, 0x5F, 0xF3]) + b"\x00" + runtime
+
+
+def build_env():
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    from reth_tpu.primitives.keccak import keccak256
+    from reth_tpu.primitives.rlp import encode_int, rlp_encode
+
+    contract = keccak256(rlp_encode([alice.address, encode_int(0)]))[12:]
+    builder.build_block([alice.deploy(initcode_for(STORE_CODE))])          # 1
+    builder.build_block([alice.call(contract, (0x11).to_bytes(32, "big"))])  # 2
+    builder.build_block([alice.transfer(b"\x0b" * 20, 777)])               # 3
+    builder.build_block([alice.call(contract, (0x22).to_bytes(32, "big"))])  # 4
+    builder.build_block([])                                                # 5
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    import_chain(factory, builder.blocks[1:], EthBeaconConsensus(CPU))
+    pipeline = Pipeline(factory, default_stages(committer=CPU))
+    pipeline.run(5)
+    return factory, builder, alice.address, contract, pipeline
+
+
+def test_historical_account_values():
+    factory, builder, alice_addr, contract, _ = build_env()
+    p = factory.provider()
+    # nonce history: 0 at genesis, 1 after block 1, ... 4 after block 4
+    for block, want_nonce in [(0, 0), (1, 1), (2, 2), (3, 3), (4, 4), (5, 4)]:
+        hist = HistoricalStateProvider(p, block)
+        acc = hist.account(alice_addr)
+        assert (acc.nonce if acc else 0) == want_nonce, f"block {block}"
+    # bob funded at block 3
+    bob = b"\x0b" * 20
+    assert HistoricalStateProvider(p, 2).account(bob) is None
+    assert HistoricalStateProvider(p, 3).account(bob).balance == 777
+
+
+def test_historical_storage_values():
+    factory, builder, alice_addr, contract, _ = build_env()
+    p = factory.provider()
+    slot = b"\x00" * 32
+    assert HistoricalStateProvider(p, 1).storage(contract, slot) == 0
+    assert HistoricalStateProvider(p, 2).storage(contract, slot) == 0x11
+    assert HistoricalStateProvider(p, 3).storage(contract, slot) == 0x11
+    assert HistoricalStateProvider(p, 4).storage(contract, slot) == 0x22
+    assert HistoricalStateProvider(p, 5).storage(contract, slot) == 0x22
+
+
+def test_history_unwind():
+    factory, builder, alice_addr, contract, pipeline = build_env()
+    pipeline.unwind(2)
+    p = factory.provider()
+    # indices reflect only blocks <= 2 now
+    from reth_tpu.stages.index_history import first_change_after
+    from reth_tpu.storage.tables import Tables
+
+    assert first_change_after(p, Tables.AccountsHistory.name, alice_addr, 2) is None
+    # resync rebuilds them
+    pipeline.run(5)
+    p = factory.provider()
+    assert HistoricalStateProvider(p, 3).account(b"\x0b" * 20).balance == 777
+
+
+def test_shard_splitting():
+    from reth_tpu.stages.index_history import SHARD_CAP, _append_to_shards, first_change_after
+    from reth_tpu.storage.tables import Tables
+
+    factory = ProviderFactory(MemDb())
+    with factory.provider_rw() as p:
+        _append_to_shards(p, Tables.AccountsHistory.name, b"\xaa" * 20,
+                          list(range(1, SHARD_CAP * 2 + 50)))
+        # lookups cross shard boundaries correctly
+        assert first_change_after(p, Tables.AccountsHistory.name, b"\xaa" * 20, 0) == 1
+        assert first_change_after(p, Tables.AccountsHistory.name, b"\xaa" * 20,
+                                  SHARD_CAP) == SHARD_CAP + 1
+        assert first_change_after(p, Tables.AccountsHistory.name, b"\xaa" * 20,
+                                  SHARD_CAP * 2 + 49) is None
+
+
+def test_historical_via_engine_persistence():
+    """Blocks persisted by the ENGINE (not the pipeline) are indexed too,
+    and the unindexed in-memory window is served via the changeset tail."""
+    from reth_tpu.engine import EngineTree
+    from reth_tpu.rpc import EthApi
+    from reth_tpu.rpc.convert import data, parse_qty
+
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    for i in range(5):
+        builder.build_block([alice.transfer(b"\x0b" * 20, 100 + i)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=2)
+    for blk in builder.blocks[1:]:
+        assert tree.on_new_payload(blk).status.value == "VALID"
+        tree.on_forkchoice_updated(blk.hash)
+    assert tree.persisted_number == 3  # 4,5 in memory
+    api = EthApi(tree, None, 1)
+    bob = data(b"\x0b" * 20)
+    # indexed range (persisted blocks)
+    assert parse_qty(api.eth_getBalance(bob, "0x1")) == 100
+    assert parse_qty(api.eth_getBalance(bob, "0x2")) == 201
+    # unindexed in-memory window via changeset tail scan
+    assert parse_qty(api.eth_getBalance(bob, "0x4")) == 100 + 101 + 102 + 103
+    # unknown block rejected
+    import pytest as _pytest
+    from reth_tpu.rpc import RpcError
+
+    with _pytest.raises(RpcError):
+        api.eth_getBalance(bob, "0x63")
+
+
+def test_historical_rpc_balance():
+    from reth_tpu.engine import EngineTree
+    from reth_tpu.rpc import EthApi
+    from reth_tpu.rpc.convert import data, parse_qty
+
+    factory, builder, alice_addr, contract, _ = build_env()
+    tree = EngineTree(factory, committer=CPU)
+    api = EthApi(tree, None, 1)
+    bal_b2 = parse_qty(api.eth_getBalance(data(b"\x0b" * 20), "0x2"))
+    bal_b3 = parse_qty(api.eth_getBalance(data(b"\x0b" * 20), "0x3"))
+    assert (bal_b2, bal_b3) == (0, 777)
+    slot_b2 = api.eth_getStorageAt(data(contract), "0x0", "0x2")
+    assert parse_qty(slot_b2) == 0x11
