@@ -1,0 +1,66 @@
+"""Tracing/logging: layered init with per-target filters + span timing.
+
+Reference analogue: crates/tracing — stdout/file layers with per-layer
+env filters (src/lib.rs:1-35) and the `target:` discipline (e.g.
+``trie::state_root``). Built on stdlib logging; `span()` provides the
+timing-span idiom used across the reference's hot paths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import time
+from pathlib import Path
+
+
+def init_tracing(
+    stdout_level: str | None = None,
+    file_path: str | Path | None = None,
+    file_level: str = "DEBUG",
+    filters: str | None = None,
+) -> None:
+    """Install stdout (+ optional file) handlers.
+
+    ``filters``: comma-separated ``target=LEVEL`` pairs (the RUST_LOG
+    analogue), e.g. ``"reth_tpu.trie=DEBUG,reth_tpu.engine=INFO"``; also
+    read from the RETH_TPU_LOG env var.
+    """
+    root = logging.getLogger("reth_tpu")
+    root.setLevel(logging.DEBUG)
+    root.handlers.clear()
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname)-5s %(name)s: %(message)s", "%H:%M:%S"
+    )
+    out = logging.StreamHandler(sys.stdout)
+    out.setLevel((stdout_level or "INFO").upper())
+    out.setFormatter(fmt)
+    root.addHandler(out)
+    if file_path:
+        fh = logging.FileHandler(file_path)
+        fh.setLevel(file_level.upper())
+        fh.setFormatter(fmt)
+        root.addHandler(fh)
+    spec = filters if filters is not None else os.environ.get("RETH_TPU_LOG", "")
+    for pair in filter(None, spec.split(",")):
+        target, _, level = pair.partition("=")
+        logging.getLogger(target.strip()).setLevel((level or "DEBUG").upper())
+
+
+def tracer(target: str) -> logging.Logger:
+    """Logger for a target (``trie.state_root`` style)."""
+    return logging.getLogger(f"reth_tpu.{target}")
+
+
+@contextlib.contextmanager
+def span(target: str, name: str, level: int = logging.DEBUG, **fields):
+    """Timed span: logs entry fields + exit duration (tracing-span idiom)."""
+    log = tracer(target)
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        extra = " ".join(f"{k}={v}" for k, v in fields.items())
+        log.log(level, "%s %s took %.3fms", name, extra, (time.time() - t0) * 1e3)
