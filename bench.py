@@ -18,9 +18,40 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
+
+# Watchdog BEFORE any jax import: the device tunnel can wedge whole
+# processes (see .claude memory: axon-tunnel-pitfalls); a bench that hangs
+# forever is worse than one that reports failure. Phase-aware: if the
+# device run already finished, its result is reported (with vs_baseline 0
+# and a note) rather than a bogus device failure.
+_DEADLINE = int(os.environ.get("RETH_TPU_BENCH_TIMEOUT", "1500"))
+_STATE: dict = {"phase": "startup", "device_result": None}
+
+
+def _watchdog():
+    time.sleep(_DEADLINE)
+    dev = _STATE["device_result"]
+    if dev is not None:
+        print(json.dumps({
+            "metric": "merkle_rebuild_keccak_per_sec", "value": dev,
+            "unit": "hashes/s", "vs_baseline": 0,
+            "error": f"timed out during {_STATE['phase']} after the device "
+                     f"run completed (baseline unmeasured)",
+        }), flush=True)
+        os._exit(3)
+    print(json.dumps({
+        "metric": "merkle_rebuild_keccak_per_sec", "value": 0,
+        "unit": "hashes/s", "vs_baseline": 0,
+        "error": f"timed out during {_STATE['phase']} after {_DEADLINE}s",
+    }), flush=True)
+    os._exit(2)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
 
 
 def build_state(n_accounts: int, n_slots: int):
@@ -69,6 +100,7 @@ def main():
     from reth_tpu.primitives.keccak import keccak256_batch_np
     from reth_tpu.trie.committer import TrieCommitter
 
+    _STATE["phase"] = "state build"
     account_leaves, storage_jobs = build_state(n_accounts, n_slots)
 
     dev_committer = TrieCommitter()  # device hasher (TPU when attached)
@@ -76,9 +108,13 @@ def main():
 
     # warm-up = one full untimed run, so every batch tier the measured run
     # dispatches is already compiled (XLA caches by shape in-process)
+    _STATE["phase"] = "device warm-up (compiles)"
     run_commit(dev_committer, account_leaves, storage_jobs)
 
+    _STATE["phase"] = "device run"
     root_dev, hashed_dev, dt_dev = run_commit(dev_committer, account_leaves, storage_jobs)
+    _STATE["device_result"] = round(hashed_dev / dt_dev, 1)
+    _STATE["phase"] = "cpu baseline"
     root_cpu, _hashed_cpu, dt_cpu = run_commit(cpu_committer, account_leaves, storage_jobs)
     if root_dev != root_cpu:
         print(

@@ -193,13 +193,17 @@ class EthApi:
         return tx_to_rpc(txs[i], header, i, p.sender(tx_num))
 
     def _block_of_tx(self, p, tx_num: int) -> int | None:
-        # scan back from the tip (fine at test scale; index later)
-        n = p.last_block_number()
-        while n >= 0:
+        # TransactionBlocks: be64(last_tx_num_of_block) -> be64(block);
+        # seek gives the first block whose last tx >= tx_num (O(log n))
+        from ..storage.tables import Tables, be64, from_be64
+
+        cur = p.tx.cursor(Tables.TransactionBlocks.name)
+        entry = cur.seek(be64(tx_num))
+        if entry is not None:
+            n = from_be64(entry[1])
             idx = p.block_body_indices(n)
             if idx and idx.first_tx_num <= tx_num < idx.next_tx_num:
                 return n
-            n -= 1
         return None
 
     def eth_getTransactionReceipt(self, tx_hash):
