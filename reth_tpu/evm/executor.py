@@ -127,7 +127,7 @@ class BlockExecutor:
 
     def _execute_tx(
         self, state: EvmState, env: BlockEnv, tx: Transaction, sender: bytes,
-        gas_available: int,
+        gas_available: int, tracer=None,
     ) -> TxResult:
         base_fee = env.base_fee
         # -- validation (reference: EthTransactionValidator + pre-exec checks)
@@ -155,7 +155,8 @@ class BlockExecutor:
         # -- setup
         state.begin_tx()
         state.delete_empty_touched()
-        interp = Interpreter(state, env, TxEnv(origin=sender, gas_price=gas_price))
+        interp = Interpreter(state, env, TxEnv(origin=sender, gas_price=gas_price),
+                             tracer=tracer)
         # buy gas
         state.sub_balance(sender, tx.gas_limit * gas_price)
         state.bump_nonce(sender)

@@ -97,11 +97,15 @@ class CallFrame:
 
 
 class Interpreter:
-    def __init__(self, state: EvmState, block: BlockEnv, tx: TxEnv):
+    def __init__(self, state: EvmState, block: BlockEnv, tx: TxEnv, tracer=None):
         self.state = state
         self.block = block
         self.tx = tx
         self.transient: dict[tuple[bytes, bytes], int] = {}
+        # optional per-step hook(pc, op, gas, stack, mem, depth) — the
+        # struct-logger seam for debug_traceTransaction (revm Inspector
+        # analogue); None costs one branch per opcode
+        self.tracer = tracer
 
     # -- entry points ---------------------------------------------------------
 
@@ -241,8 +245,11 @@ class Interpreter:
             mem_expand(offset, len(data))
             mem[offset : offset + len(data)] = data
 
+        tracer = self.tracer
         while pc < len(code):
             op = code[pc]
+            if tracer is not None:
+                tracer(pc, op, gas, stack, mem, fr.depth)
             pc += 1
             # PUSH0..PUSH32
             if 0x5F <= op <= 0x7F:

@@ -89,6 +89,9 @@ class Node:
         self.rpc.register(NetApi(config.chain_id))
         self.rpc.register(Web3Api())
         self.rpc.register(TxpoolApi(self.pool))
+        from ..rpc.debug import DebugApi
+
+        self.rpc.register(DebugApi(self.eth_api))
         self.engine_api = EngineApi(self.tree, self.payload_service)
         self.authrpc = RpcServer(port=config.authrpc_port, lock=shared_lock)
         self.authrpc.register(self.engine_api)

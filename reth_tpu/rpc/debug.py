@@ -1,0 +1,163 @@
+"""debug_* and trace-adjacent namespaces.
+
+Reference analogue: crates/rpc/rpc debug module (Geth-style tracers,
+src/debug.rs). `debug_traceTransaction` re-executes the block up to the
+target transaction against the parent state, then runs the target with
+the opcode struct logger attached (the default Geth tracer shape).
+"""
+
+from __future__ import annotations
+
+OPNAMES = {
+    0x00: "STOP", 0x01: "ADD", 0x02: "MUL", 0x03: "SUB", 0x04: "DIV",
+    0x05: "SDIV", 0x06: "MOD", 0x07: "SMOD", 0x08: "ADDMOD", 0x09: "MULMOD",
+    0x0A: "EXP", 0x0B: "SIGNEXTEND", 0x10: "LT", 0x11: "GT", 0x12: "SLT",
+    0x13: "SGT", 0x14: "EQ", 0x15: "ISZERO", 0x16: "AND", 0x17: "OR",
+    0x18: "XOR", 0x19: "NOT", 0x1A: "BYTE", 0x1B: "SHL", 0x1C: "SHR",
+    0x1D: "SAR", 0x20: "KECCAK256", 0x30: "ADDRESS", 0x31: "BALANCE",
+    0x32: "ORIGIN", 0x33: "CALLER", 0x34: "CALLVALUE", 0x35: "CALLDATALOAD",
+    0x36: "CALLDATASIZE", 0x37: "CALLDATACOPY", 0x38: "CODESIZE",
+    0x39: "CODECOPY", 0x3A: "GASPRICE", 0x3B: "EXTCODESIZE",
+    0x3C: "EXTCODECOPY", 0x3D: "RETURNDATASIZE", 0x3E: "RETURNDATACOPY",
+    0x3F: "EXTCODEHASH", 0x40: "BLOCKHASH", 0x41: "COINBASE",
+    0x42: "TIMESTAMP", 0x43: "NUMBER", 0x44: "PREVRANDAO", 0x45: "GASLIMIT",
+    0x46: "CHAINID", 0x47: "SELFBALANCE", 0x48: "BASEFEE", 0x49: "BLOBHASH",
+    0x4A: "BLOBBASEFEE", 0x50: "POP", 0x51: "MLOAD", 0x52: "MSTORE",
+    0x53: "MSTORE8", 0x54: "SLOAD", 0x55: "SSTORE", 0x56: "JUMP",
+    0x57: "JUMPI", 0x58: "PC", 0x59: "MSIZE", 0x5A: "GAS", 0x5B: "JUMPDEST",
+    0x5C: "TLOAD", 0x5D: "TSTORE", 0x5E: "MCOPY",
+    0xF0: "CREATE", 0xF1: "CALL", 0xF2: "CALLCODE", 0xF3: "RETURN",
+    0xF4: "DELEGATECALL", 0xF5: "CREATE2", 0xFA: "STATICCALL",
+    0xFD: "REVERT", 0xFE: "INVALID", 0xFF: "SELFDESTRUCT",
+}
+for _n in range(33):
+    OPNAMES[0x5F + _n] = f"PUSH{_n}"
+for _n in range(16):
+    OPNAMES[0x80 + _n] = f"DUP{_n + 1}"
+    OPNAMES[0x90 + _n] = f"SWAP{_n + 1}"
+for _n in range(5):
+    OPNAMES[0xA0 + _n] = f"LOG{_n}"
+
+
+class StructLogger:
+    """Geth default-tracer struct logs (pc/op/gas/depth/stack)."""
+
+    def __init__(self, with_memory: bool = False, limit: int = 100_000):
+        self.logs: list[dict] = []
+        self.with_memory = with_memory
+        self.limit = limit
+
+    def __call__(self, pc, op, gas, stack, mem, depth):
+        if len(self.logs) >= self.limit:
+            return
+        entry = {
+            "pc": pc,
+            "op": OPNAMES.get(op, f"opcode 0x{op:x}"),
+            "gas": gas,
+            "depth": depth + 1,
+            "stack": [hex(v) for v in stack],
+        }
+        if self.with_memory:
+            entry["memory"] = ["0x" + bytes(mem[i : i + 32]).hex()
+                               for i in range(0, len(mem), 32)]
+        self.logs.append(entry)
+
+
+class DebugApi:
+    def __init__(self, eth_api):
+        self.eth = eth_api
+
+    def debug_traceTransaction(self, tx_hash, opts=None):
+        from ..evm import BlockExecutor, EvmConfig
+        from ..evm.state import EvmState
+        from ..storage.tables import Tables, from_be64
+        from .convert import parse_data, qty
+        from .server import RpcError
+
+        opts = opts or {}
+        h = parse_data(tx_hash)
+        p = self.eth._provider()
+        raw = p.tx.get(Tables.TransactionHashNumbers.name, h)
+        if raw is None:
+            raise RpcError(-32000, "transaction not found")
+        tx_num = from_be64(raw)
+        block_num = self.eth._block_of_tx(p, tx_num)
+        if block_num is None:
+            raise RpcError(-32000, "transaction not found in any block")
+        block = p.block_by_number(block_num)
+        idx = p.block_body_indices(block_num)
+        target_i = tx_num - idx.first_tx_num
+
+        # parent state through the SAME guards as eth state queries (prune
+        # horizon, unknown blocks) — never trace against silently-wrong state
+        parent_state = self.eth._state_at(qty(block_num - 1)) if block_num > 0 else p
+        executor = BlockExecutor(parent_state, EvmConfig(chain_id=self.eth.chain_id))
+        from ..evm.interpreter import BlockEnv
+
+        header = block.header
+        block_hashes = {}
+        for k in range(max(0, block_num - 256), block_num):
+            bh = p.canonical_hash(k)
+            if bh:
+                block_hashes[k] = bh
+        env = BlockEnv(
+            number=header.number, timestamp=header.timestamp,
+            coinbase=header.beneficiary, gas_limit=header.gas_limit,
+            base_fee=header.base_fee_per_gas or 0, prev_randao=header.mix_hash,
+            chain_id=self.eth.chain_id, block_hashes=block_hashes,
+        )
+        state = EvmState(parent_state)
+        senders = [p.sender(idx.first_tx_num + i) or block.transactions[i].recover_sender()
+                   for i in range(target_i + 1)]
+        gas_left_in_block = header.gas_limit
+        for i in range(target_i):
+            r = executor._execute_tx(state, env, block.transactions[i], senders[i],
+                                     gas_left_in_block)
+            gas_left_in_block -= r.gas_used
+
+        logger = StructLogger(with_memory=bool(opts.get("enableMemory")))
+        result = executor._execute_tx(
+            state, env, block.transactions[target_i], senders[target_i],
+            gas_left_in_block, tracer=logger,
+        )
+        return {
+            "gas": qty(result.gas_used),
+            "failed": not result.success,
+            "returnValue": result.output.hex(),
+            "structLogs": logger.logs,
+        }
+
+    def debug_getRawHeader(self, tag):
+        from .convert import data
+
+        p = self.eth._provider()
+        n = self.eth._resolve_number(tag, p)
+        h = p.header_by_number(n)
+        from .server import RpcError
+
+        if h is None:
+            raise RpcError(-32000, "unknown block")
+        return data(h.encode())
+
+    def debug_getRawBlock(self, tag):
+        from .convert import data
+
+        p = self.eth._provider()
+        n = self.eth._resolve_number(tag, p)
+        b = p.block_by_number(n)
+        from .server import RpcError
+
+        if b is None:
+            raise RpcError(-32000, "unknown block")
+        return data(b.encode())
+
+    def debug_getRawTransaction(self, tx_hash):
+        from .convert import data, parse_data
+        from ..storage.tables import Tables
+
+        p = self.eth._provider()
+        raw = p.tx.get(Tables.TransactionHashNumbers.name, parse_data(tx_hash))
+        if raw is None:
+            return None
+        tx_raw = p.tx.get(Tables.Transactions.name, raw)
+        return data(tx_raw) if tx_raw else None

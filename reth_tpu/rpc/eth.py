@@ -87,6 +87,42 @@ class EthApi:
     def eth_maxPriorityFeePerGas(self):
         return qty(10**9)
 
+    def eth_feeHistory(self, block_count, newest_tag="latest", reward_percentiles=None):
+        p = self._provider()
+        newest = self._resolve_number(newest_tag, p)
+        tip = p.last_block_number()
+        if newest > tip:
+            raise RpcError(-32000, f"unknown block {newest} (tip {tip})")
+        count = min(parse_qty(block_count), newest + 1, 1024)
+        if count < 1:
+            raise RpcError(-32602, "block count must be >= 1")
+        oldest = newest - count + 1
+        base_fees, ratios, rewards = [], [], []
+        for n in range(oldest, newest + 1):
+            h = p.header_by_number(n)
+            base_fees.append(qty(h.base_fee_per_gas or 0))
+            ratios.append(h.gas_used / h.gas_limit if h.gas_limit else 0.0)
+            if reward_percentiles:
+                tips = sorted(
+                    tx.effective_gas_price(h.base_fee_per_gas) - (h.base_fee_per_gas or 0)
+                    for tx in (p.transactions_by_block(n) or [])
+                ) or [0]
+                rewards.append([
+                    qty(tips[min(len(tips) - 1, int(pc / 100 * len(tips)))])
+                    for pc in reward_percentiles
+                ])
+        from ..consensus.validation import calc_next_base_fee
+
+        base_fees.append(qty(calc_next_base_fee(p.header_by_number(newest))))
+        out = {
+            "oldestBlock": qty(oldest),
+            "baseFeePerGas": base_fees,
+            "gasUsedRatio": ratios,
+        }
+        if reward_percentiles:
+            out["reward"] = rewards
+        return out
+
     # -- state -----------------------------------------------------------------
 
     def eth_getBalance(self, address, tag="latest"):

@@ -205,6 +205,47 @@ def test_eth_get_proof(node):
     assert verify_account_proof(parse_data(blk["stateRoot"]), alice.address, ap)
 
 
+def test_debug_trace_transaction(node):
+    n, alice = node
+    port = n.rpc.port
+    # deploy + call the storage contract, then trace the call
+    code = bytes.fromhex("5f355f5500")  # sstore(0, calldata[0])
+    initcode = bytes([0x60, len(code), 0x60, 0x0B, 0x5F, 0x39, 0x60, len(code), 0x5F, 0xF3, 0x00]) + code
+    rpc(port, "eth_sendRawTransaction", data(alice.deploy(initcode).encode()))
+    n.miner.mine_block()
+    from reth_tpu.primitives.keccak import keccak256
+    from reth_tpu.primitives.rlp import encode_int, rlp_encode
+
+    contract = keccak256(rlp_encode([alice.address, encode_int(0)]))[12:]
+    call_tx = alice.call(contract, (0x77).to_bytes(32, "big"))
+    rpc(port, "eth_sendRawTransaction", data(call_tx.encode()))
+    n.miner.mine_block()
+    trace = rpc(port, "debug_traceTransaction", data(call_tx.hash))
+    assert trace["failed"] is False
+    ops = [l["op"] for l in trace["structLogs"]]
+    assert ops == ["PUSH0", "CALLDATALOAD", "PUSH0", "SSTORE", "STOP"]
+    assert trace["structLogs"][3]["stack"][-2:] == ["0x77", "0x0"]
+    assert parse_qty(trace["gas"]) > 21000
+    # raw accessors
+    raw_h = rpc(port, "debug_getRawHeader", "0x1")
+    assert raw_h.startswith("0x")
+    raw_tx = rpc(port, "debug_getRawTransaction", data(call_tx.hash))
+    assert parse_data(raw_tx) == call_tx.encode()
+
+
+def test_fee_history(node):
+    n, alice = node
+    port = n.rpc.port
+    rpc(port, "eth_sendRawTransaction", data(alice.transfer(b"\x0b" * 20, 1).encode()))
+    n.miner.mine_block()
+    n.miner.mine_block()
+    fh = rpc(port, "eth_feeHistory", "0x2", "latest", [50])
+    assert fh["oldestBlock"] == "0x1"
+    assert len(fh["baseFeePerGas"]) == 3  # 2 blocks + next
+    assert len(fh["gasUsedRatio"]) == 2
+    assert len(fh["reward"]) == 2
+
+
 def test_error_shapes(node):
     n, _ = node
     port = n.rpc.port
