@@ -193,6 +193,30 @@ def cmd_node(args):
     return 0
 
 
+def cmd_db_verify_trie(args):
+    """Recompute the state root from hashed tables; compare with the tip
+    header (reference `reth db repair-trie` / trie verify iterator)."""
+    from .storage import MemDb, ProviderFactory
+    from .trie.incremental import verify_state_root
+
+    factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
+    committer = _make_committer(args)
+    with factory.provider() as p:
+        tip = p.last_block_number()
+        header = p.header_by_number(tip)
+        if header is None:
+            print("empty database", file=sys.stderr)
+            return 1
+        # READ-ONLY full rebuild from the hashed leaf tables
+        root = verify_state_root(p, committer)
+        if root == header.state_root:
+            print(f"trie OK at block {tip}: 0x{root.hex()}")
+            return 0
+        print(f"TRIE MISMATCH at block {tip}: computed 0x{root.hex()} "
+              f"header 0x{header.state_root.hex()}", file=sys.stderr)
+        return 1
+
+
 def cmd_db_stats(args):
     from .storage import MemDb
 
@@ -261,6 +285,10 @@ def main(argv=None) -> int:
     ps = dbsub.add_parser("stats")
     ps.add_argument("--datadir", required=True)
     ps.set_defaults(fn=cmd_db_stats)
+    pv = dbsub.add_parser("verify-trie")
+    pv.add_argument("--datadir", required=True)
+    add_hasher(pv)
+    pv.set_defaults(fn=cmd_db_verify_trie)
 
     p = sub.add_parser("stage", help="run a single stage")
     stsub = p.add_subparsers(dest="stage_command", required=True)

@@ -353,6 +353,43 @@ def full_state_root(
     return result.root
 
 
+def verify_state_root(
+    provider: DatabaseProvider, committer: TrieCommitter | None = None
+) -> bytes:
+    """READ-ONLY full recompute from the hashed leaf tables.
+
+    Reference analogue: the trie `verify` iterator behind
+    `reth db repair-trie` — unlike reconstruction from stored branch
+    nodes (self-consistent by construction), this rebuilds every storage
+    trie and the account trie from leaves, so divergence between the
+    hashed tables and the committed root IS detected. Writes nothing.
+    """
+    committer = committer or TrieCommitter()
+    p = provider
+    cur = p.tx.cursor(Tables.HashedStorages.name)
+    addrs: list[bytes] = []
+    entry = cur.first()
+    while entry is not None:
+        addrs.append(entry[0])
+        entry = cur.next_no_dup()
+    jobs = []
+    for addr in addrs:
+        leaves = []
+        for _, dup in p.tx.cursor(Tables.HashedStorages.name).walk_dup(addr):
+            slot, value = T.decode_storage_entry(dup)
+            leaves.append((unpack_nibbles(slot), rlp_encode(encode_int(value))))
+        jobs.append((leaves, None))
+    results = committer.commit_many(jobs, collect_branches=False)
+    storage_roots = dict(zip(addrs, (r.root for r in results)))
+
+    account_leaves = []
+    for k, v in p.tx.cursor(Tables.HashedAccounts.name).walk():
+        acct = T.decode_account(v)
+        acct = acct.with_(storage_root=storage_roots.get(k, EMPTY_ROOT_HASH))
+        account_leaves.append((unpack_nibbles(k), T.encode_account(acct)))
+    return committer.commit(account_leaves, collect_branches=False).root
+
+
 def _dedup_ranges(ranges: list[Nibbles]) -> list[Nibbles]:
     """Drop ranges fully covered by a shorter range in the list."""
     out: list[Nibbles] = []

@@ -59,6 +59,29 @@ def test_import_pipeline_and_stage_rerun(chain_files, capsys):
                  "--hasher", "cpu"]) == 0
 
 
+def test_db_verify_trie(chain_files, capsys):
+    tmp, gpath, cpath, builder = chain_files
+    datadir = tmp / "data_verify"
+    datadir.mkdir()
+    main(["import", "--datadir", str(datadir), "--genesis", str(gpath),
+          "--hasher", "cpu", str(cpath)])
+    capsys.readouterr()
+    assert main(["db", "verify-trie", "--datadir", str(datadir),
+                 "--hasher", "cpu"]) == 0
+    assert "trie OK at block 3" in capsys.readouterr().out
+    # corrupt a hashed account -> mismatch detected
+    from reth_tpu.storage import MemDb, ProviderFactory
+    from reth_tpu.primitives import Account
+
+    factory = ProviderFactory(MemDb(datadir / "db.bin"))
+    with factory.provider_rw() as p:
+        p.put_hashed_account(b"\x42" * 32, Account(balance=1))
+    factory.db.flush()
+    assert main(["db", "verify-trie", "--datadir", str(datadir),
+                 "--hasher", "cpu"]) == 1
+    assert "TRIE MISMATCH" in capsys.readouterr().err
+
+
 def test_genesis_mismatch_cli(chain_files, tmp_path):
     tmp, gpath, cpath, builder = chain_files
     datadir = tmp / "data3"
