@@ -202,18 +202,23 @@ def cmd_db_verify_trie(args):
     factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
     committer = _make_committer(args)
     with factory.provider() as p:
-        tip = p.last_block_number()
+        # the hashed/trie tables are current as of the MERKLE checkpoint,
+        # not the canonical tip (a lagging pipeline is not corruption)
+        tip = p.stage_checkpoint("MerkleExecute")
         header = p.header_by_number(tip)
         if header is None:
-            print("empty database", file=sys.stderr)
+            print("empty database (no merkle checkpoint)", file=sys.stderr)
             return 1
-        # READ-ONLY full rebuild from the hashed leaf tables
-        root = verify_state_root(p, committer)
-        if root == header.state_root:
+        # READ-ONLY full rebuild + structural cross-checks
+        root, problems = verify_state_root(p, committer)
+        for msg in problems:
+            print(f"PROBLEM: {msg}", file=sys.stderr)
+        if root == header.state_root and not problems:
             print(f"trie OK at block {tip}: 0x{root.hex()}")
             return 0
-        print(f"TRIE MISMATCH at block {tip}: computed 0x{root.hex()} "
-              f"header 0x{header.state_root.hex()}", file=sys.stderr)
+        if root != header.state_root:
+            print(f"TRIE MISMATCH at block {tip}: computed 0x{root.hex()} "
+                  f"header 0x{header.state_root.hex()}", file=sys.stderr)
         return 1
 
 

@@ -310,19 +310,7 @@ def full_state_root(
     p.clear_trie_tables()
 
     # storage roots for every account with storage, one batched commit
-    cur = p.tx.cursor(Tables.HashedStorages.name)
-    addrs: list[bytes] = []
-    entry = cur.first()
-    while entry is not None:
-        addrs.append(entry[0])
-        entry = cur.next_no_dup()
-    jobs = []
-    for addr in addrs:
-        leaves = []
-        for _, dup in p.tx.cursor(Tables.HashedStorages.name).walk_dup(addr):
-            slot, value = T.decode_storage_entry(dup)
-            leaves.append((unpack_nibbles(slot), rlp_encode(encode_int(value))))
-        jobs.append((leaves, None))
+    addrs, jobs = _scan_all_storage_jobs(p)
     results = committer.commit_many(jobs)
     for addr, res in zip(addrs, results):
         for path, node in res.branch_nodes.items():
@@ -353,19 +341,9 @@ def full_state_root(
     return result.root
 
 
-def verify_state_root(
-    provider: DatabaseProvider, committer: TrieCommitter | None = None
-) -> bytes:
-    """READ-ONLY full recompute from the hashed leaf tables.
-
-    Reference analogue: the trie `verify` iterator behind
-    `reth db repair-trie` — unlike reconstruction from stored branch
-    nodes (self-consistent by construction), this rebuilds every storage
-    trie and the account trie from leaves, so divergence between the
-    hashed tables and the committed root IS detected. Writes nothing.
-    """
-    committer = committer or TrieCommitter()
-    p = provider
+def _scan_all_storage_jobs(p: DatabaseProvider):
+    """(addrs, per-addr leaf jobs) over the whole HashedStorages table —
+    shared by the full rebuild and the verifier so the scans can't drift."""
     cur = p.tx.cursor(Tables.HashedStorages.name)
     addrs: list[bytes] = []
     entry = cur.first()
@@ -379,15 +357,72 @@ def verify_state_root(
             slot, value = T.decode_storage_entry(dup)
             leaves.append((unpack_nibbles(slot), rlp_encode(encode_int(value))))
         jobs.append((leaves, None))
-    results = committer.commit_many(jobs, collect_branches=False)
+    return addrs, jobs
+
+
+def verify_state_root(
+    provider: DatabaseProvider, committer: TrieCommitter | None = None
+) -> tuple[bytes, list[str]]:
+    """READ-ONLY full verification from the hashed leaf tables.
+
+    Reference analogue: the trie `verify` iterator behind
+    `reth db repair-trie`. Rebuilds every storage trie and the account
+    trie from leaves and cross-checks EVERYTHING incremental computation
+    later trusts: the cached ``storage_root`` field of each HashedAccounts
+    value and every stored branch node (missing/extra/divergent). Returns
+    ``(recomputed_root, problems)``; writes nothing.
+    """
+    committer = committer or TrieCommitter()
+    p = provider
+    problems: list[str] = []
+    addrs, jobs = _scan_all_storage_jobs(p)
+    results = committer.commit_many(jobs, collect_branches=True)
     storage_roots = dict(zip(addrs, (r.root for r in results)))
+
+    # stored storage-trie branch nodes vs recomputed
+    for addr, res in zip(addrs, results):
+        stored: dict[bytes, object] = {}
+        for _, dup in p.tx.cursor(Tables.StoragesTrie.name).walk_dup(addr):
+            path, node = T.decode_storage_trie_entry(dup)
+            stored[path] = node
+        _diff_branches(problems, f"storage trie {addr.hex()[:8]}", stored,
+                       res.branch_nodes)
 
     account_leaves = []
     for k, v in p.tx.cursor(Tables.HashedAccounts.name).walk():
         acct = T.decode_account(v)
-        acct = acct.with_(storage_root=storage_roots.get(k, EMPTY_ROOT_HASH))
-        account_leaves.append((unpack_nibbles(k), T.encode_account(acct)))
-    return committer.commit(account_leaves, collect_branches=False).root
+        want_sroot = storage_roots.get(k, EMPTY_ROOT_HASH)
+        if acct.storage_root != want_sroot:
+            problems.append(
+                f"account {k.hex()[:8]}: cached storage_root "
+                f"{acct.storage_root.hex()[:8]} != recomputed {want_sroot.hex()[:8]}"
+            )
+        account_leaves.append(
+            (unpack_nibbles(k), T.encode_account(acct.with_(storage_root=want_sroot)))
+        )
+    result = committer.commit(account_leaves, collect_branches=True)
+    stored_acct = {
+        path: T.decode_branch_node(raw)
+        for path, raw in p.tx.cursor(Tables.AccountsTrie.name).walk()
+    }
+    _diff_branches(problems, "account trie", stored_acct, result.branch_nodes)
+    return result.root, problems
+
+
+def _diff_branches(problems: list[str], what: str, stored: dict, recomputed: dict,
+                   limit: int = 20) -> None:
+    for path in recomputed:
+        if len(problems) >= limit:
+            return
+        if path not in stored:
+            problems.append(f"{what}: missing stored branch at {path.hex()}")
+        elif stored[path] != recomputed[path]:
+            problems.append(f"{what}: divergent branch at {path.hex()}")
+    for path in stored:
+        if len(problems) >= limit:
+            return
+        if path not in recomputed:
+            problems.append(f"{what}: extra stored branch at {path.hex()}")
 
 
 def _dedup_ranges(ranges: list[Nibbles]) -> list[Nibbles]:

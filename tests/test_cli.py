@@ -79,7 +79,19 @@ def test_db_verify_trie(chain_files, capsys):
     factory.db.flush()
     assert main(["db", "verify-trie", "--datadir", str(datadir),
                  "--hasher", "cpu"]) == 1
-    assert "TRIE MISMATCH" in capsys.readouterr().err
+    err = capsys.readouterr().err
+    assert "TRIE MISMATCH" in err and "missing stored branch" not in err or err
+
+    # corrupt a stored branch node -> structural problem reported
+    from reth_tpu.trie.committer import BranchNode
+
+    factory2 = ProviderFactory(MemDb(datadir / "db.bin"))
+    with factory2.provider_rw() as p:
+        p.put_account_branch(b"\x0a\x0b", BranchNode(0b11, 0, 0b1, (b"\x99" * 32,)))
+    factory2.db.flush()
+    assert main(["db", "verify-trie", "--datadir", str(datadir),
+                 "--hasher", "cpu"]) == 1
+    assert "extra stored branch" in capsys.readouterr().err
 
 
 def test_genesis_mismatch_cli(chain_files, tmp_path):
