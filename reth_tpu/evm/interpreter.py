@@ -96,6 +96,14 @@ class CallFrame:
     transfer_value: bool = True  # False for DELEGATECALL: value is context-only
 
 
+import sys as _sys
+
+# The interpreter recurses natively per call frame (~5 python frames per EVM
+# frame); EVM's depth limit is 1024, far above CPython's default 1000.
+if _sys.getrecursionlimit() < 20_000:
+    _sys.setrecursionlimit(20_000)
+
+
 class Interpreter:
     def __init__(self, state: EvmState, block: BlockEnv, tx: TxEnv, tracer=None):
         self.state = state
@@ -179,6 +187,10 @@ class Interpreter:
         except Halt:
             state.revert(snap)
             return False, 0, b"", b""
+        # initcode selfdestructed its own account (EIP-6780 same-tx): the
+        # creation succeeds but deposits nothing — the account stays dead
+        if addr in state._selfdestructs:
+            return True, gas_left, addr, b""
         # code deposit
         if len(out) > MAX_CODE_SIZE or (out and out[0] == 0xEF):
             state.revert(snap)

@@ -1,0 +1,165 @@
+"""EVM edge cases: call semantics, create collisions, static violations.
+
+Reference analogue: the slice of ethereum/tests GeneralStateTests
+behaviors most likely to diverge in a from-scratch interpreter.
+"""
+
+from reth_tpu.evm.interpreter import BlockEnv, CallFrame, Interpreter, Revert, TxEnv
+from reth_tpu.evm.state import EvmState
+from reth_tpu.evm.executor import InMemoryStateSource
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256
+
+A = b"\x0a" * 20
+
+
+def run_code(code, value=0, gas=1_000_000, accounts=None, storages=None, codes=None,
+             caller=A, addr=b"\x10" * 20, data=b""):
+    src = InMemoryStateSource(accounts or {caller: Account(balance=10**18)},
+                              storages, codes)
+    state = EvmState(src)
+    interp = Interpreter(state, BlockEnv(), TxEnv(origin=caller))
+    ok, gas_left, out = interp.call(CallFrame(
+        caller=caller, address=addr, code=code, data=data, value=value, gas=gas))
+    return ok, gas_left, out, state
+
+
+def test_staticcall_blocks_sstore():
+    # target: PUSH1 1 PUSH0 SSTORE STOP
+    target = bytes.fromhex("60015f5500")
+    taddr = b"\x11" * 20
+    # caller: STATICCALL target, push result, sstore result to slot1, STOP
+    code = (bytes.fromhex("5f5f5f5f73") + taddr + bytes.fromhex("5afa")
+            + bytes.fromhex("600155 00".replace(" ", "")))
+    ok, _, _, state = run_code(
+        code,
+        accounts={A: Account(balance=1), b"\x10" * 20: Account(),
+                  taddr: Account(code_hash=keccak256(target))},
+        codes={keccak256(target): target},
+    )
+    assert ok
+    # STATICCALL returned 0 (inner halted on SSTORE); nothing written there
+    assert state.sload(b"\x10" * 20, (1).to_bytes(32, "big")) == 0
+    assert state.sload(taddr, b"\x00" * 32) == 0
+
+
+def test_nested_revert_isolated():
+    """Inner call's storage write reverts; outer's survives."""
+    inner = bytes.fromhex("60015f555f5ffd")  # sstore(0,1); revert
+    iaddr = b"\x12" * 20
+    # outer: sstore(1, 0xAA); CALL inner; STOP
+    outer = (bytes.fromhex("60aa600155")
+             + bytes.fromhex("5f5f5f5f5f73") + iaddr + bytes.fromhex("5af1")
+             + bytes.fromhex("00"))
+    ok, _, _, state = run_code(
+        outer,
+        accounts={A: Account(balance=1), b"\x10" * 20: Account(),
+                  iaddr: Account(code_hash=keccak256(inner))},
+        codes={keccak256(inner): inner},
+    )
+    assert ok
+    assert state.sload(b"\x10" * 20, (1).to_bytes(32, "big")) == 0xAA
+    assert state.sload(iaddr, b"\x00" * 32) == 0
+
+
+def test_create2_collision_fails():
+    src = InMemoryStateSource({A: Account(balance=10**18, nonce=1)})
+    state = EvmState(src)
+    interp = Interpreter(state, BlockEnv(), TxEnv(origin=A))
+    initcode = bytes.fromhex("5f5ff3")  # returns empty code
+    ok1, _, addr1, _ = interp.create(A, 0, initcode, 1_000_000, 0, salt=b"\x01" * 32)
+    assert ok1
+    # same salt + initcode -> same address, now occupied (nonce=1) -> fail
+    ok2, gas_left, addr2, _ = interp.create(A, 0, initcode, 1_000_000, 0, salt=b"\x01" * 32)
+    assert not ok2 and gas_left == 0
+
+
+def test_call_depth_limit():
+    """Self-recursive CALL bottoms out at depth 1024 without crashing."""
+    myaddr = b"\x13" * 20
+    # code: CALL self with all gas; STOP
+    code = bytes.fromhex("5f5f5f5f5f73") + myaddr + bytes.fromhex("5af100")
+    ok, _, _, state = run_code(
+        code,
+        accounts={A: Account(balance=1), myaddr: Account(code_hash=keccak256(code))},
+        codes={keccak256(code): code},
+        addr=myaddr, gas=20_000_000,
+    )
+    assert ok  # outer frame completes; inner failures absorbed
+
+
+def test_extcodehash_semantics():
+    code = bytes.fromhex("73") + b"\x77" * 20 + bytes.fromhex("3f5f5200 00".replace(" ", ""))
+    # EXTCODEHASH of nonexistent account -> 0
+    ok, _, _, state = run_code(bytes.fromhex("73") + b"\x77" * 20 + bytes.fromhex("3f5f55"))
+    assert ok
+    assert state.sload(b"\x10" * 20, b"\x00" * 32) == 0
+    # of an existing EOA with balance -> keccak(empty)
+    eoa = b"\x78" * 20
+    ok, _, _, state = run_code(
+        bytes.fromhex("73") + eoa + bytes.fromhex("3f5f55"),
+        accounts={A: Account(balance=1), eoa: Account(balance=5)},
+    )
+    assert ok
+    assert state.sload(b"\x10" * 20, b"\x00" * 32) == int.from_bytes(keccak256(b""), "big")
+
+
+def test_returndata_copy_oob_halts():
+    # RETURNDATACOPY with no prior call and size>0 must halt
+    code = bytes.fromhex("60205f5f3e00")  # returndatacopy(0,0,32)
+    ok, gas_left, _, _ = run_code(code)
+    assert not ok and gas_left == 0
+
+
+def test_memory_expansion_gas_quadratic():
+    # MSTORE at a huge offset must exhaust gas (halt), not allocate
+    code = bytes.fromhex("600163ffffffff52")  # mstore(0xffffffff, 1)
+    ok, gas_left, _, _ = run_code(code, gas=100_000)
+    assert not ok and gas_left == 0
+
+
+def test_value_transfer_in_call_and_revert():
+    """CALL with value; callee reverts -> value returns."""
+    inner = bytes.fromhex("5f5ffd")  # revert
+    iaddr = b"\x14" * 20
+    outer = (bytes.fromhex("5f5f5f5f600a73") + iaddr + bytes.fromhex("5af100"))
+    ok, _, _, state = run_code(
+        outer,
+        accounts={A: Account(balance=1),
+                  b"\x10" * 20: Account(balance=100),
+                  iaddr: Account(code_hash=keccak256(inner))},
+        codes={keccak256(inner): inner},
+    )
+    assert ok
+    assert state.balance(b"\x10" * 20) == 100  # transfer rolled back
+    assert state.balance(iaddr) == 0
+
+
+def test_selfdestruct_same_tx_created():
+    """EIP-6780: a contract created and destroyed in one tx disappears."""
+    src = InMemoryStateSource({A: Account(balance=10**18)})
+    state = EvmState(src)
+    interp = Interpreter(state, BlockEnv(), TxEnv(origin=A))
+    # initcode: selfdestruct(caller) — runs during creation
+    initcode = bytes.fromhex("33ff")
+    ok, _, addr, _ = interp.create(A, 5, initcode, 1_000_000, 0)
+    assert ok
+    assert state.account(addr) is None
+    assert state.balance(A) == 10**18  # value came back via beneficiary
+
+
+def test_gas_opcode_63_64_rule():
+    """CALL forwards at most 63/64 of remaining gas."""
+    # inner: burn everything (invalid opcode)
+    inner = bytes.fromhex("fe")
+    iaddr = b"\x15" * 20
+    outer = bytes.fromhex("5f5f5f5f5f73") + iaddr + bytes.fromhex("5af100")
+    ok, gas_left, _, _ = run_code(
+        outer,
+        accounts={A: Account(balance=1), iaddr: Account(code_hash=keccak256(inner))},
+        codes={keccak256(inner): inner},
+        gas=640_000,
+    )
+    assert ok
+    # outer keeps >= 1/64 of the gas at the call site
+    assert gas_left > 640_000 // 64 - 1000
