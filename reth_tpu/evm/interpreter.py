@@ -96,16 +96,15 @@ class CallFrame:
     transfer_value: bool = True  # False for DELEGATECALL: value is context-only
 
 
-import sys as _sys
-
-# The interpreter recurses natively per call frame (~5 python frames per EVM
-# frame); EVM's depth limit is 1024, far above CPython's default 1000.
-if _sys.getrecursionlimit() < 20_000:
-    _sys.setrecursionlimit(20_000)
-
-
 class Interpreter:
     def __init__(self, state: EvmState, block: BlockEnv, tx: TxEnv, tracer=None):
+        # the interpreter recurses natively per call frame (~5 python frames
+        # per EVM frame); EVM's depth limit is 1024, far above CPython's
+        # default 1000 — raise lazily, only when an interpreter exists
+        import sys
+
+        if sys.getrecursionlimit() < 20_000:
+            sys.setrecursionlimit(20_000)
         self.state = state
         self.block = block
         self.tx = tx
@@ -187,11 +186,8 @@ class Interpreter:
         except Halt:
             state.revert(snap)
             return False, 0, b"", b""
-        # initcode selfdestructed its own account (EIP-6780 same-tx): the
-        # creation succeeds but deposits nothing — the account stays dead
-        if addr in state._selfdestructs:
-            return True, gas_left, addr, b""
-        # code deposit
+        # code validation + deposit gas apply even if the initcode
+        # selfdestructed the account (execution-specs generic_create order)
         if len(out) > MAX_CODE_SIZE or (out and out[0] == 0xEF):
             state.revert(snap)
             return False, 0, b"", b""
@@ -200,6 +196,12 @@ class Interpreter:
             state.revert(snap)
             return False, 0, b"", b""
         gas_left -= deposit
+        # EIP-6780: if the initcode selfdestructed the account it is None
+        # now (create_account made it live; only a fresh destruct kills it)
+        # → creation succeeds but the account stays dead, no code deposit.
+        # Stale _selfdestructs membership from earlier txs cannot trip this.
+        if state.account(addr) is None:
+            return True, gas_left, addr, b""
         state.set_code(addr, out)
         return True, gas_left, addr, b""
 

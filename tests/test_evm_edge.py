@@ -89,7 +89,6 @@ def test_call_depth_limit():
 
 
 def test_extcodehash_semantics():
-    code = bytes.fromhex("73") + b"\x77" * 20 + bytes.fromhex("3f5f5200 00".replace(" ", ""))
     # EXTCODEHASH of nonexistent account -> 0
     ok, _, _, state = run_code(bytes.fromhex("73") + b"\x77" * 20 + bytes.fromhex("3f5f55"))
     assert ok
@@ -146,6 +145,46 @@ def test_selfdestruct_same_tx_created():
     assert ok
     assert state.account(addr) is None
     assert state.balance(A) == 10**18  # value came back via beneficiary
+
+
+def test_create2_redeploy_after_same_block_selfdestruct():
+    """EIP-6780 scoping: a selfdestruct in tx1 must not suppress the code
+    deposit of a CREATE2 redeploy at the same address in tx2."""
+    src = InMemoryStateSource({A: Account(balance=10**18)})
+    state = EvmState(src)
+    interp = Interpreter(state, BlockEnv(), TxEnv(origin=A))
+    # tx1: create a contract whose initcode selfdestructs -> dead
+    ok, _, addr, _ = interp.create(A, 0, bytes.fromhex("33ff"), 1_000_000, 0,
+                                   salt=b"\x02" * 32)
+    assert ok and state.account(addr) is None
+    # tx2 boundary: stale _selfdestructs membership persists (block scope)
+    state.begin_tx()
+    assert addr in state._selfdestructs
+    interp2 = Interpreter(state, BlockEnv(), TxEnv(origin=A))
+    # redeploy with the SAME initcode (same CREATE2 address): it dies again
+    # (created-this-tx) and must stay dead, not resurrect as empty
+    ok2, _, addr2, _ = interp2.create(A, 0, bytes.fromhex("33ff"), 1_000_000, 0,
+                                      salt=b"\x02" * 32)
+    assert ok2 and addr2 == addr
+    assert state.account(addr) is None
+    # and an initcode that survives deposits real code despite the stale
+    # membership: PUSH1 1 PUSH0 MSTORE8 PUSH1 1 PUSH0 RETURN → runtime 0x01
+    state.begin_tx()
+    interp3 = Interpreter(state, BlockEnv(), TxEnv(origin=A))
+    live_init = bytes.fromhex("60015f5360015ff3")
+    ok3, _, addr3, _ = interp3.create(A, 0, live_init, 1_000_000, 0,
+                                      salt=b"\x03" * 32)
+    assert ok3
+    # now selfdestruct it (same tx -> dead), then in a LATER tx redeploy the
+    # exact same (initcode, salt): guard must allow the code deposit
+    state.selfdestruct(addr3, A)
+    assert state.account(addr3) is None
+    state.begin_tx()
+    interp4 = Interpreter(state, BlockEnv(), TxEnv(origin=A))
+    ok4, _, addr4, _ = interp4.create(A, 0, live_init, 1_000_000, 0,
+                                      salt=b"\x03" * 32)
+    assert ok4 and addr4 == addr3
+    assert state.code(addr4) == b"\x01"  # deposited despite stale membership
 
 
 def test_gas_opcode_63_64_rule():
