@@ -94,6 +94,7 @@ class CallFrame:
     static: bool = False
     depth: int = 0
     transfer_value: bool = True  # False for DELEGATECALL: value is context-only
+    kind: str = "CALL"           # CALL/CALLCODE/DELEGATECALL/STATICCALL (tracers)
 
 
 class Interpreter:
@@ -120,6 +121,26 @@ class Interpreter:
         """Execute a message call; returns (success, gas_left, output)."""
         if frame.depth > MAX_CALL_DEPTH:
             return False, frame.gas, b""
+        on_enter = getattr(self.tracer, "on_enter", None)
+        on_exit = getattr(self.tracer, "on_exit", None)
+        if on_enter is not None:
+            ok, gas_left, out = self._call_traced(frame, on_enter, on_exit)
+            return ok, gas_left, out
+        return self._call_inner(frame)
+
+    def _call_traced(self, frame, on_enter, on_exit):
+        on_enter(frame.kind, frame)
+        try:
+            ok, gas_left, out = self._call_inner(frame)
+        except Revert as r:
+            if on_exit is not None:
+                on_exit(frame, False, getattr(r, "gas_left", 0), r.output, "reverted")
+            raise
+        if on_exit is not None:
+            on_exit(frame, ok, gas_left, out, None if ok else "halted")
+        return ok, gas_left, out
+
+    def _call_inner(self, frame: CallFrame) -> tuple[bool, int, bytes]:
         state = self.state
         snap = state.snapshot()
         if frame.value and frame.transfer_value:
@@ -577,17 +598,18 @@ class Interpreter:
                     child_gas += G_CALL_STIPEND
                 if op == 0xF1:  # CALL
                     sub = CallFrame(fr.address, addr, state.code(addr), data, value,
-                                    child_gas, fr.static, fr.depth + 1)
+                                    child_gas, fr.static, fr.depth + 1, kind="CALL")
                 elif op == 0xF2:  # CALLCODE
                     sub = CallFrame(fr.address, fr.address, state.code(addr), data,
-                                    value, child_gas, fr.static, fr.depth + 1)
+                                    value, child_gas, fr.static, fr.depth + 1,
+                                    kind="CALLCODE")
                 elif op == 0xF4:  # DELEGATECALL: parent's value/caller, NO transfer
                     sub = CallFrame(fr.caller, fr.address, state.code(addr), data,
                                     fr.value, child_gas, fr.static, fr.depth + 1,
-                                    transfer_value=False)
+                                    transfer_value=False, kind="DELEGATECALL")
                 else:  # STATICCALL
                     sub = CallFrame(fr.address, addr, state.code(addr), data, 0,
-                                    child_gas, True, fr.depth + 1)
+                                    child_gas, True, fr.depth + 1, kind="STATICCALL")
                 try:
                     ok, gas_left, out = self.call(sub)
                 except Revert as r:

@@ -63,18 +63,120 @@ class StructLogger:
         self.logs.append(entry)
 
 
+class CallTracer:
+    """Geth callTracer: nested call frames (from/to/value/gas/input/output).
+
+    Uses the interpreter's frame enter/exit hooks; opcode steps ignored.
+    """
+
+    def __init__(self):
+        self.root: dict | None = None
+        self._stack: list[dict] = []
+
+    def __call__(self, pc, op, gas, stack, mem, depth):
+        pass  # frame-level tracer: per-opcode events unused
+
+    def on_enter(self, kind, frame):
+        node = {
+            "type": kind,
+            "from": "0x" + frame.caller.hex(),
+            "to": "0x" + frame.address.hex(),
+            "value": hex(frame.value),
+            "gas": hex(frame.gas),
+            "input": "0x" + frame.data.hex(),
+            "calls": [],
+        }
+        if self._stack:
+            self._stack[-1]["calls"].append(node)
+        else:
+            self.root = node
+        self._stack.append(node)
+
+    def on_exit(self, frame, ok, gas_left, output, error):
+        node = self._stack.pop()
+        node["gasUsed"] = hex(max(0, int(node["gas"], 16) - gas_left))
+        node["output"] = "0x" + output.hex()
+        if error:
+            node["error"] = error
+
+    def result(self) -> dict:
+        node = self.root or {}
+        _strip_empty_calls(node)
+        return node
+
+
+def _strip_empty_calls(node: dict):
+    if not node.get("calls"):
+        node.pop("calls", None)
+    else:
+        for c in node["calls"]:
+            _strip_empty_calls(c)
+
+
+def _flatten_parity(node: dict, trace_address: list, out: list):
+    """callTracer tree → Parity trace_transaction flat frames."""
+    action = {
+        "callType": node["type"].lower(),
+        "from": node["from"],
+        "to": node["to"],
+        "value": node["value"],
+        "gas": node["gas"],
+        "input": node["input"],
+    }
+    entry = {
+        "action": action,
+        "type": "call",
+        "traceAddress": list(trace_address),
+        "subtraces": len(node.get("calls", [])),
+    }
+    if "error" in node:
+        entry["error"] = node["error"]
+    else:
+        entry["result"] = {"gasUsed": node.get("gasUsed", "0x0"),
+                           "output": node.get("output", "0x")}
+    out.append(entry)
+    for i, child in enumerate(node.get("calls", [])):
+        _flatten_parity(child, trace_address + [i], out)
+
+
 class DebugApi:
     def __init__(self, eth_api):
         self.eth = eth_api
 
+    def trace_transaction(self, tx_hash):
+        """Parity trace_transaction: flat call frames."""
+        tracer = CallTracer()
+        self._replay(tx_hash, tracer)
+        frames: list = []
+        if tracer.root is not None:
+            _flatten_parity(tracer.result(), [], frames)
+        return frames
+
     def debug_traceTransaction(self, tx_hash, opts=None):
+        opts = opts or {}
+        from .convert import qty
+
+        if opts.get("tracer") == "callTracer":
+            tracer = CallTracer()
+            self._replay(tx_hash, tracer)
+            return tracer.result()
+        logger = StructLogger(with_memory=bool(opts.get("enableMemory")))
+        result = self._replay(tx_hash, logger)
+        return {
+            "gas": qty(result.gas_used),
+            "failed": not result.success,
+            "returnValue": result.output.hex(),
+            "structLogs": logger.logs,
+        }
+
+    def _replay(self, tx_hash, tracer):
+        """Re-execute the block prefix, then the target tx with ``tracer``."""
         from ..evm import BlockExecutor, EvmConfig
         from ..evm.state import EvmState
         from ..storage.tables import Tables, from_be64
         from .convert import parse_data, qty
         from .server import RpcError
 
-        opts = opts or {}
         h = parse_data(tx_hash)
         p = self.eth._provider()
         raw = p.tx.get(Tables.TransactionHashNumbers.name, h)
@@ -115,17 +217,10 @@ class DebugApi:
                                      gas_left_in_block)
             gas_left_in_block -= r.gas_used
 
-        logger = StructLogger(with_memory=bool(opts.get("enableMemory")))
-        result = executor._execute_tx(
+        return executor._execute_tx(
             state, env, block.transactions[target_i], senders[target_i],
-            gas_left_in_block, tracer=logger,
+            gas_left_in_block, tracer=tracer,
         )
-        return {
-            "gas": qty(result.gas_used),
-            "failed": not result.success,
-            "returnValue": result.output.hex(),
-            "structLogs": logger.logs,
-        }
 
     def debug_getRawHeader(self, tag):
         from .convert import data

@@ -233,6 +233,44 @@ def test_debug_trace_transaction(node):
     assert parse_data(raw_tx) == call_tx.encode()
 
 
+def test_call_tracer_and_parity_trace(node):
+    n, alice = node
+    port = n.rpc.port
+    # inner: sstore(0, 7); outer: CALL inner then STOP
+    inner = bytes.fromhex("60075f5500")
+    from reth_tpu.primitives.keccak import keccak256
+    from reth_tpu.primitives.rlp import encode_int, rlp_encode
+
+    def deploy(code, nonce):
+        init = bytes([0x60, len(code), 0x60, 0x0B, 0x5F, 0x39, 0x60, len(code), 0x5F, 0xF3, 0x00]) + code
+        rpc(port, "eth_sendRawTransaction", data(alice.deploy(init).encode()))
+        return keccak256(rlp_encode([alice.address, encode_int(nonce)]))[12:]
+
+    inner_addr = deploy(inner, 0)
+    outer = bytes.fromhex("5f5f5f5f5f73") + inner_addr + bytes.fromhex("5af100")
+    outer_addr = deploy(outer, 1)
+    n.miner.mine_block()
+    call_tx = alice.call(outer_addr, b"")
+    rpc(port, "eth_sendRawTransaction", data(call_tx.encode()))
+    n.miner.mine_block()
+
+    tree = rpc(port, "debug_traceTransaction", data(call_tx.hash), {"tracer": "callTracer"})
+    assert tree["from"] == data(alice.address)
+    assert tree["to"] == data(outer_addr)
+    assert len(tree["calls"]) == 1
+    assert tree["calls"][0]["to"] == data(inner_addr)
+    assert tree["calls"][0]["type"] == "CALL"
+    assert "error" not in tree
+
+    flat = rpc(port, "trace_transaction", data(call_tx.hash))
+    assert len(flat) == 2
+    assert flat[0]["traceAddress"] == [] and flat[0]["subtraces"] == 1
+    assert flat[1]["traceAddress"] == [0]
+    assert flat[1]["action"]["to"] == data(inner_addr)
+    # the inner store actually happened
+    assert parse_qty(rpc(port, "eth_getStorageAt", data(inner_addr), "0x0", "latest")) == 7
+
+
 def test_fee_history(node):
     n, alice = node
     port = n.rpc.port
