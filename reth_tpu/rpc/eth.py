@@ -267,6 +267,42 @@ class EthApi:
         txs = p.transactions_by_block(block_num)
         return receipt_to_rpc(receipt, txs[i], header, i, prev, p.sender(tx_num), log_base)
 
+    def eth_getBlockReceipts(self, tag):
+        p = self._provider()
+        n = self._resolve_number(tag, p)
+        if p.header_by_number(n) is None:
+            return None
+        header = p.header_by_number(n)
+        idx = p.block_body_indices(n)
+        if idx is None or idx.tx_count == 0:
+            return []
+        txs = p.transactions_by_block(n)
+        out = []
+        log_base = 0
+        prev_cum = 0
+        for i, t in enumerate(range(idx.first_tx_num, idx.next_tx_num)):
+            receipt = p.receipt(t)
+            if receipt is None:
+                return None
+            out.append(receipt_to_rpc(receipt, txs[i], header, i, prev_cum,
+                                      p.sender(t), log_base))
+            prev_cum = receipt.cumulative_gas_used
+            log_base += len(receipt.logs)
+        return out
+
+    def eth_getTransactionByBlockNumberAndIndex(self, tag, index):
+        p = self._provider()
+        n = self._resolve_number(tag, p)
+        idx = p.block_body_indices(n)
+        i = parse_qty(index)
+        if idx is None or i >= idx.tx_count:
+            return None
+        txs = p.transactions_by_block(n)
+        return tx_to_rpc(txs[i], p.header_by_number(n), i, p.sender(idx.first_tx_num + i))
+
+    def eth_accounts(self):
+        return []
+
     def eth_sendRawTransaction(self, raw):
         if self.pool is None:
             raise RpcError(-32000, "no transaction pool")

@@ -233,6 +233,26 @@ def test_debug_trace_transaction(node):
     assert parse_data(raw_tx) == call_tx.encode()
 
 
+def test_block_receipts_and_tx_by_index(node):
+    n, alice = node
+    port = n.rpc.port
+    t1 = alice.transfer(b"\x0b" * 20, 1)
+    t2 = alice.transfer(b"\x0b" * 20, 2)
+    rpc(port, "eth_sendRawTransaction", data(t1.encode()))
+    rpc(port, "eth_sendRawTransaction", data(t2.encode()))
+    n.miner.mine_block()
+    receipts = rpc(port, "eth_getBlockReceipts", "0x1")
+    assert len(receipts) == 2
+    assert receipts[0]["transactionHash"] == data(t1.hash)
+    assert parse_qty(receipts[1]["gasUsed"]) == 21000
+    assert parse_qty(receipts[1]["cumulativeGasUsed"]) == 42000
+    got = rpc(port, "eth_getTransactionByBlockNumberAndIndex", "0x1", "0x1")
+    assert got["hash"] == data(t2.hash)
+    assert rpc(port, "eth_getTransactionByBlockNumberAndIndex", "0x1", "0x5") is None
+    assert rpc(port, "eth_getBlockReceipts", "0x0") == []
+    assert rpc(port, "eth_accounts") == []
+
+
 def test_call_tracer_and_parity_trace(node):
     n, alice = node
     port = n.rpc.port
