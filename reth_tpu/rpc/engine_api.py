@@ -18,6 +18,7 @@ CAPABILITIES = [
     "engine_forkchoiceUpdatedV1", "engine_forkchoiceUpdatedV2",
     "engine_forkchoiceUpdatedV3",
     "engine_getPayloadV1", "engine_getPayloadV2", "engine_getPayloadV3",
+    "engine_getPayloadBodiesByHashV1", "engine_getPayloadBodiesByRangeV1",
     "engine_exchangeCapabilities",
 ]
 
@@ -187,6 +188,38 @@ class EngineApi:
             pid = self.payloads.new_payload_job(head, pa)
             resp["payloadId"] = data(pid)
         return resp
+
+    def _body_json(self, block: Block | None):
+        if block is None:
+            return None
+        out = {"transactions": [data(tx.encode()) for tx in block.transactions]}
+        if block.withdrawals is not None:
+            out["withdrawals"] = [
+                {
+                    "index": qty(w.index), "validatorIndex": qty(w.validator_index),
+                    "address": data(w.address), "amount": qty(w.amount),
+                }
+                for w in block.withdrawals
+            ]
+        else:
+            out["withdrawals"] = None
+        return out
+
+    def engine_getPayloadBodiesByHashV1(self, hashes):
+        out = []
+        for h in hashes:
+            out.append(self._body_json(self.tree.block_by_hash(parse_data(h))))
+        return out
+
+    def engine_getPayloadBodiesByRangeV1(self, start, count):
+        s, c = parse_qty(start), parse_qty(count)
+        if s < 1 or c < 1:
+            raise RpcError(-38004, "invalid params: start and count must be >= 1")
+        out = []
+        p = self.tree.overlay_provider()
+        for n in range(s, s + min(c, 1024)):
+            out.append(self._body_json(p.block_by_number(n)))
+        return out
 
     def engine_getPayloadV1(self, payload_id):
         return self._get_payload(payload_id)["executionPayload"]

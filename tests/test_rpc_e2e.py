@@ -233,6 +233,25 @@ def test_debug_trace_transaction(node):
     assert parse_data(raw_tx) == call_tx.encode()
 
 
+def test_engine_payload_bodies(node):
+    n, alice = node
+    port, auth = n.rpc.port, n.authrpc.port
+    tx = alice.transfer(b"\x0b" * 20, 3)
+    rpc(port, "eth_sendRawTransaction", data(tx.encode()))
+    blk = n.miner.mine_block()
+    bodies = rpc(auth, "engine_getPayloadBodiesByHashV1",
+                 [data(blk.hash), "0x" + "77" * 32])
+    assert len(bodies) == 2
+    assert bodies[0]["transactions"] == [data(tx.encode())]
+    assert bodies[1] is None  # unknown hash
+    by_range = rpc(auth, "engine_getPayloadBodiesByRangeV1", "0x1", "0x2")
+    assert len(by_range) == 2
+    assert by_range[0]["transactions"] == [data(tx.encode())]
+    assert by_range[1] is None  # beyond tip
+    with pytest.raises(RuntimeError, match="must be >= 1"):
+        rpc(auth, "engine_getPayloadBodiesByRangeV1", "0x0", "0x1")
+
+
 def test_block_receipts_and_tx_by_index(node):
     n, alice = node
     port = n.rpc.port
