@@ -151,7 +151,7 @@ class EngineTree:
         if layers is None:
             raise KeyError(f"unknown head {target.hex()}")
         base = self.factory.db.tx()
-        return DatabaseProvider(OverlayTx(base, layers))
+        return DatabaseProvider(OverlayTx(base, layers), self.factory.static_files)
 
     # -- newPayload ------------------------------------------------------------
 

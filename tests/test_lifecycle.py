@@ -1,0 +1,72 @@
+"""Full data-lifecycle integration: one node, every storage subsystem.
+
+Chain grows via the engine; finalized history moves to static files;
+changesets are pruned under PruneModes; the trie still verifies and the
+RPC still serves everything it should (and refuses what it can't).
+"""
+
+from reth_tpu.engine import EngineTree
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.prune import PruneMode, PruneModes, Pruner
+from reth_tpu.rpc import EthApi, RpcError
+from reth_tpu.rpc.convert import data, parse_qty
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import init_genesis
+from reth_tpu.storage.static_files import StaticFileProducer
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+from reth_tpu.trie.incremental import verify_state_root
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def test_full_lifecycle(tmp_path):
+    import pytest
+
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    for i in range(10):
+        builder.build_block([alice.transfer(b"\x0b" * 20, 100 + i)])
+
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=2)
+    for blk in builder.blocks[1:]:
+        assert tree.on_new_payload(blk).status.value == "VALID"
+        tree.on_forkchoice_updated(blk.hash)
+    assert tree.persisted_number == 8  # 9,10 in memory
+
+    # 1. move finalized history (blocks <= 6) into static files
+    producer = StaticFileProducer(factory, tmp_path / "static")
+    moved = producer.run(to_block=6)
+    assert moved["transactions"] == 6
+    factory.static_files = producer.static  # wire the read fallback
+
+    # 2. prune receipts + senders deeper than 4 blocks from the tip
+    pruner = Pruner(factory, PruneModes(
+        receipts=PruneMode(distance=4), sender_recovery=PruneMode(distance=4),
+    ))
+    progress = pruner.run(tip=8)
+    assert {p.segment for p in progress} == {"SenderRecovery", "Receipts"}
+
+    # 3. the trie still verifies cleanly over the persisted tables
+    with factory.provider() as p:
+        root, problems = verify_state_root(p, CPU)
+        assert problems == []
+        assert root == builder.blocks[8].header.state_root
+
+    # 4. RPC serves: tip state, static-file history, receipts via fallback
+    api = EthApi(tree, None, 1)
+    bob = data(b"\x0b" * 20)
+    assert parse_qty(api.eth_getBalance(bob, "latest")) == sum(100 + i for i in range(10))
+    blk3 = api.eth_getBlockByNumber("0x3", True)  # txs come from static files
+    assert len(blk3["transactions"]) == 1
+    # receipts for the un-pruned window still resolve (block 5 via static)
+    receipts5 = api.eth_getBlockReceipts("0x5")
+    assert receipts5 is not None and len(receipts5) == 1
+    # historical balance mid-chain
+    assert parse_qty(api.eth_getBalance(bob, "0x4")) == sum(100 + i for i in range(4))
+    # unknown block still refused
+    with pytest.raises(RpcError):
+        api.eth_getBalance(bob, "0x63")
