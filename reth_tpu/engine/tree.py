@@ -318,9 +318,11 @@ class EngineTree:
     def _set_head(self, head: bytes) -> PayloadStatus:
         old_head = self.head_hash
         self.head_hash = head
+        # persist first so listeners (pool maintenance, static-file
+        # producer, pruner) observe the advanced persisted state
+        self._advance_persistence()
         if old_head != head:
             self._notify_canon_change()
-        self._advance_persistence()
         return PayloadStatus(PayloadStatusKind.VALID, head)
 
     def _find_persisted_branch_point(self, head: bytes):

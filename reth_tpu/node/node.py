@@ -37,6 +37,10 @@ class NodeConfig:
     genesis_alloc: dict[bytes, Account] = field(default_factory=dict)
     genesis_storage: dict | None = None
     genesis_codes: dict | None = None
+    # data lifecycle: move finalized history to static files once the chain
+    # is this many blocks past it (None disables), and prune per modes
+    static_file_distance: int | None = None
+    prune_modes: object | None = None  # PruneModes | None
 
 
 class Node:
@@ -77,6 +81,34 @@ class Node:
                 )
 
         self.tree.canon_listeners.append(_maintain_pool)
+
+        # data lifecycle: static-file producer + pruner run after
+        # persistence advances (reference: launched after pipeline commits)
+        self.static_producer = None
+        self.pruner = None
+        if config.static_file_distance is not None and config.datadir:
+            from ..storage.static_files import StaticFileProducer
+
+            self.static_producer = StaticFileProducer(
+                self.factory, Path(config.datadir) / "static_files"
+            )
+            self.factory.static_files = self.static_producer.static
+        if config.prune_modes is not None:
+            from ..prune import Pruner
+
+            self.pruner = Pruner(self.factory, config.prune_modes)
+
+        def _lifecycle(chain):
+            tip = self.tree.persisted_number
+            if self.static_producer is not None:
+                target = tip - config.static_file_distance
+                if target >= 0:
+                    self.static_producer.run(target)
+            if self.pruner is not None:
+                self.pruner.run(tip)
+
+        if self.static_producer is not None or self.pruner is not None:
+            self.tree.canon_listeners.append(_lifecycle)
 
         # RPC servers: public + auth (engine) — reference serves the engine
         # API on a separate JWT-authed port (rpc-builder auth server)

@@ -21,6 +21,37 @@ from reth_tpu.trie.incremental import verify_state_root
 CPU = TrieCommitter(hasher=keccak256_batch_np)
 
 
+def test_node_runs_lifecycle_automatically(tmp_path):
+    """A launched Node with lifecycle config produces static files and
+    prunes as the dev miner advances the chain."""
+    from reth_tpu.node import Node, NodeConfig
+
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    cfg = NodeConfig(
+        dev=True,
+        datadir=tmp_path,
+        genesis_header=builder.genesis,
+        genesis_alloc=builder.accounts_at_genesis,
+        persistence_threshold=1,
+        static_file_distance=3,
+        prune_modes=PruneModes(receipts=PruneMode(distance=6)),
+    )
+    node = Node(cfg, committer=CPU)
+    for i in range(10):
+        node.pool.add_transaction(alice.transfer(b"\x0b" * 20, 50 + i))
+        node.miner.mine_block()
+    # persisted to 9; static files should cover to 9-3=6
+    assert node.tree.persisted_number == 9
+    assert node.static_producer.static.highest("headers") == 6
+    # receipts older than 6 blocks pruned, but still served via static files
+    p = node.factory.provider()
+    assert p.tx.get("Receipts", (0).to_bytes(8, "big")) is None
+    assert parse_qty(node.eth_api.eth_getBlockReceipts("0x1")[0]["gasUsed"]) == 21000
+    assert parse_qty(node.eth_api.eth_getBalance(data(b"\x0b" * 20), "latest")) == \
+        sum(50 + i for i in range(10))
+
+
 def test_full_lifecycle(tmp_path):
     import pytest
 
