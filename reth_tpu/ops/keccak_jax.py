@@ -143,16 +143,12 @@ def keccak256_jax_words(words, num_blocks: int):
     return _squeeze256(lo, hi)
 
 
-@partial(jax.jit, static_argnums=1)
-def keccak256_jax_words_masked(words, max_blocks: int, counts=None):
-    """Masked-absorb variant: messages of differing block counts in one batch.
-
-    ``words``: (N, max_blocks*34) uint32, each message padded at its OWN
-    final rate block and zero-extended (``pad_batch(..., pad_to_blocks=...)``).
-    ``counts``: (N,) int32 — real block count per message. Blocks at index
-    >= count leave that message's state untouched, so one compiled program
-    serves a whole power-of-two tier of block counts.
-    """
+def masked_absorb_words(words, max_blocks: int, counts):
+    """Non-jitted masked-absorb core shared by the batch front-end and the
+    fused level committer (``ops.fused_commit``): messages of differing block
+    counts in one batch, each padded at its OWN final rate block and
+    zero-extended to ``max_blocks``. Blocks at index >= ``counts[i]`` leave
+    message i's state untouched. Returns (N, 8) uint32 digests."""
     n = words.shape[0]
     w = words.reshape(n, max_blocks, 17, 2).transpose(1, 2, 3, 0)
 
@@ -168,6 +164,13 @@ def keccak256_jax_words_masked(words, max_blocks: int, counts=None):
     zero = jnp.zeros((25, n), dtype=jnp.uint32)
     lo, hi = lax.fori_loop(0, max_blocks, absorb, (zero, zero))
     return _squeeze256(lo, hi)
+
+
+@partial(jax.jit, static_argnums=1)
+def keccak256_jax_words_masked(words, max_blocks: int, counts=None):
+    """Jitted wrapper over :func:`masked_absorb_words` (one program per
+    (max_blocks, N) shape tier — the batching front-end bounds both)."""
+    return masked_absorb_words(words, max_blocks, counts)
 
 
 def _next_tier(n: int, min_tier: int = 8) -> int:

@@ -25,10 +25,12 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ..primitives.keccak import keccak256
-from ..primitives.nibbles import Nibbles, common_prefix_len
+from ..primitives.keccak import RATE, keccak256
+from ..primitives.nibbles import Nibbles, common_prefix_len, encode_path
+from ..primitives.rlp import _encode_length, rlp_encode
 from .node import (
     EMPTY_STRING_RLP,
+    HASH_REF_HOLE,
     branch_node_rlp,
     encode_hash_ref,
     extension_node_rlp,
@@ -68,6 +70,7 @@ class _Node:
     child: int = -1                 # ext: child index
     ref: bytes = b""                # resolved RLP-encoded reference
     node_hash: bytes = b""          # keccak of rlp, when hashed
+    slot: int = 0                   # fused path: digest-buffer slot (0 = not hashed)
 
 
 @dataclass(frozen=True)
@@ -103,15 +106,31 @@ class TrieCommitter:
     backend (device kernel, numpy baseline, or pure reference).
     """
 
-    def __init__(self, hasher=None):
-        if hasher is None:
+    def __init__(self, hasher=None, fused: bool = False, min_tier: int = 1024, mesh=None):
+        """``fused=True`` switches the hash phase to the fused multi-level
+        device commit (``ops.fused_commit``): child digests stay resident in
+        HBM between levels, eliminating the per-level D2H round trip; one
+        fetch at the end resolves every node hash. ``mesh`` (a
+        ``jax.sharding.Mesh``) shards the fused level loop SPMD across
+        devices. ``hasher`` is ignored when fused."""
+        self.fused = fused
+        self._engine = None
+        if fused:
+            from ..ops.fused_commit import FusedLevelEngine, FusedMeshEngine
+
+            self._engine = (
+                FusedMeshEngine(mesh, min_tier=min_tier)
+                if mesh is not None
+                else FusedLevelEngine(min_tier=min_tier)
+            )
+        elif hasher is None:
             from ..ops import KeccakDevice
 
             # Trie nodes are <= 4 rate blocks (branch max ~533 B); one masked
             # program per batch tier keeps XLA compile count minimal, and
             # min_tier=1024 collapses the small near-root levels into one
             # shape (padding waste is far cheaper than a compile).
-            hasher = KeccakDevice(min_tier=1024, block_tier=4).hash_batch
+            hasher = KeccakDevice(min_tier=min_tier, block_tier=4).hash_batch
         self.hasher = hasher
 
     def commit(
@@ -167,7 +186,10 @@ class TrieCommitter:
             roots_idx.append(self._build(arena, items, 0, 0, len(items), b""))
             arenas.append(arena)
 
-        self._hash_levels(arenas, results, proof_targets)
+        if self.fused:
+            self._hash_levels_fused(arenas, results, proof_targets)
+        else:
+            self._hash_levels(arenas, results, proof_targets)
 
         for arena, root_idx, result in zip(arenas, roots_idx, results):
             if arena is None:
@@ -228,6 +250,36 @@ class TrieCommitter:
 
     # -- hash phase ---------------------------------------------------------
 
+    @staticmethod
+    def _make_on_spine(proof_targets):
+        """Spine test shared by both hash phases: a node is on a proof spine
+        if its trie path is a prefix of any target key."""
+
+        def on_spine(aid: int, at: Nibbles) -> bool:
+            if not proof_targets or not proof_targets[aid]:
+                return False
+            return any(t[: len(at)] == at for t in proof_targets[aid])
+
+        return on_spine
+
+    @staticmethod
+    def _group_by_depth(arenas) -> dict[int, list[tuple[int, int]]]:
+        """(aid, node idx) per nibble depth — the level batching order."""
+        by_depth: dict[int, list[tuple[int, int]]] = {}
+        for aid, arena in enumerate(arenas):
+            if arena is None:
+                continue
+            for idx, node in enumerate(arena):
+                if node.kind != OPAQUE:
+                    by_depth.setdefault(len(node.at), []).append((aid, idx))
+        return by_depth
+
+    @staticmethod
+    def _set_levels(results, arenas, total_levels: int) -> None:
+        for r, arena in zip(results, arenas):
+            if arena is not None:
+                r.levels = total_levels
+
     def _hash_levels(
         self,
         arenas: list[list[_Node] | None],
@@ -239,18 +291,8 @@ class TrieCommitter:
         ``proof_targets[aid]``: full key paths whose spines' node RLPs are
         recorded into ``results[aid].proof_nodes`` (a node is on a spine if
         its path is a prefix of a target)."""
-
-        def on_spine(aid: int, at: Nibbles) -> bool:
-            if not proof_targets or not proof_targets[aid]:
-                return False
-            return any(t[: len(at)] == at for t in proof_targets[aid])
-        by_depth: dict[int, list[tuple[int, int]]] = {}
-        for aid, arena in enumerate(arenas):
-            if arena is None:
-                continue
-            for idx, node in enumerate(arena):
-                if node.kind != OPAQUE:
-                    by_depth.setdefault(len(node.at), []).append((aid, idx))
+        on_spine = self._make_on_spine(proof_targets)
+        by_depth = self._group_by_depth(arenas)
         for depth in sorted(by_depth, reverse=True):
             level = by_depth[depth]
             rlps: list[bytes] = []
@@ -279,10 +321,101 @@ class TrieCommitter:
                     arenas[aid][idx].ref = rlp  # inline
                 if on_spine(aid, arenas[aid][idx].at):
                     results[aid].proof_nodes[arenas[aid][idx].at] = rlp
-        total_levels = len(by_depth)
-        for r, arena in zip(results, arenas):
-            if arena is not None:
-                r.levels = total_levels
+        self._set_levels(results, arenas, len(by_depth))
+
+    # -- fused hash phase (device-resident digests) -------------------------
+
+    def _child_ref_template(self, arena, c: int) -> tuple[bytes, int]:
+        """Child reference as template bytes + digest source slot (0 = none).
+
+        A hashed child contributes a 33-byte placeholder whose digest the
+        device splices from the resident buffer; inline and opaque children
+        contribute literal host-known bytes. The inline-vs-hashed decision
+        needs only RLP *lengths*, never digest values — the invariant the
+        whole fused path rests on (an inline node, <32 B, can never contain
+        a 33-byte hash ref, so inline RLP is always hole-free)."""
+        node = arena[c]
+        if node.slot:
+            return HASH_REF_HOLE, node.slot
+        return node.ref, 0
+
+    def _node_template(self, arena, node) -> tuple[bytes, list[tuple[int, int]]]:
+        """(RLP template with zero-filled holes, [(byte_off, src_slot)])."""
+        if node.kind == LEAF:
+            return leaf_node_rlp(node.ext_path, node.value), []
+        holes: list[tuple[int, int]] = []
+        if node.kind == EXT:
+            prefix = rlp_encode(encode_path(node.ext_path, False))
+            ref, src = self._child_ref_template(arena, node.child)
+            payload = prefix + ref
+            if src:
+                holes.append((len(prefix) + 1, src))  # +1 skips the 0xa0
+        else:
+            parts: list[bytes] = []
+            off = 0
+            for c in node.children:
+                if c < 0:
+                    ref = EMPTY_STRING_RLP
+                else:
+                    ref, src = self._child_ref_template(arena, c)
+                    if src:
+                        holes.append((off + 1, src))
+                parts.append(ref)
+                off += len(ref)
+            parts.append(rlp_encode(node.value))
+            payload = b"".join(parts)
+        header = _encode_length(len(payload), 0xC0)
+        return header + payload, [(len(header) + o, s) for o, s in holes]
+
+    def _hash_levels_fused(
+        self,
+        arenas: list[list[_Node] | None],
+        results: list[TrieBuildResult],
+        proof_targets: list[list[Nibbles]] | None = None,
+    ) -> None:
+        """Fused hash phase: every level queues on the device without any
+        D2H; digests resolve from ONE buffer fetch at the end. Template
+        building for the next level overlaps device hashing of the previous
+        one (async dispatch). See ``ops.fused_commit``."""
+        from ..ops.fused_commit import _Bucket
+
+        on_spine = self._make_on_spine(proof_targets)
+        engine = self._engine
+        by_depth = self._group_by_depth(arenas)
+        total_nodes = sum(len(a) for a in arenas if a is not None)
+        engine.begin(total_nodes)
+        hashed: list[tuple[int, int]] = []  # (aid, idx) with slots to resolve
+        spines: list[tuple[int, Nibbles, bytes, list[tuple[int, int]]]] = []
+        for depth in sorted(by_depth, reverse=True):
+            plain, splice = _Bucket(), _Bucket()
+            for aid, idx in by_depth[depth]:
+                arena = arenas[aid]
+                node = arena[idx]
+                template, holes = self._node_template(arena, node)
+                if len(template) >= 32:
+                    node.slot = engine.alloc_slot()
+                    nb = len(template) // RATE + 1
+                    (splice if holes else plain).add(template, nb, node.slot, holes)
+                    hashed.append((aid, idx))
+                else:
+                    node.ref = template  # inline: complete, hole-free
+                if on_spine(aid, node.at):
+                    spines.append((aid, node.at, template, holes))
+            engine.dispatch_level(plain)
+            engine.dispatch_level(splice)
+        digests = engine.finish()  # the single D2H of the whole commit
+        for aid, idx in hashed:
+            node = arenas[aid][idx]
+            h = digests[node.slot].tobytes()
+            node.node_hash = h
+            node.ref = encode_hash_ref(h)
+            results[aid].hashed_nodes += 1
+        for aid, at, template, holes in spines:
+            rlp = bytearray(template)
+            for off, src in holes:
+                rlp[off : off + 32] = digests[src].tobytes()
+            results[aid].proof_nodes[at] = bytes(rlp)
+        self._set_levels(results, arenas, len(by_depth))
 
     # -- TrieUpdates --------------------------------------------------------
 

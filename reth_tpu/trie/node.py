@@ -20,6 +20,10 @@ from ..primitives.rlp import rlp_encode, _encode_length
 
 EMPTY_STRING_RLP = b"\x80"
 
+# Zero-filled placeholder for a hashed-child ref in a fused-commit RLP
+# template (the device splices the real digest over the 32 zero bytes).
+HASH_REF_HOLE = b"\xa0" + b"\x00" * 32
+
 
 def encode_hash_ref(h: bytes) -> bytes:
     """A 32-byte hash child reference as RLP (0xa0 + hash)."""
