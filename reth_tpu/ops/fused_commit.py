@@ -74,13 +74,92 @@ def _splice_level(
     return digest_buf.at[slots].set(_digests_to_bytes(d))
 
 
+def _packed_level(
+    flat, row_off, row_len, counts, hole_node, hole_byte, hole_src, slots,
+    digest_buf, *, b_tier: int
+):
+    """Unpack tightly-concatenated RLP rows by gather, apply keccak padding,
+    splice child digests, hash, scatter digests. The packed form is what
+    crosses the host->device wire — no per-row padding is transferred
+    (tunnel H2D is the single-chip bottleneck, see memory axon-tunnel-pitfalls)."""
+    L = b_tier * RATE
+    n = row_off.shape[0]
+    col = jnp.arange(L, dtype=jnp.uint32)[None, :]
+    idx = jnp.minimum(row_off[:, None] + col, flat.shape[0] - 1)
+    rows = jnp.where(col < row_len[:, None], flat[idx], 0)
+    # multi-rate padding: 0x01 at the message end, 0x80 at the block end
+    rows = rows ^ jnp.where(col == row_len[:, None], 0x01, 0).astype(jnp.uint8)
+    last = (counts.astype(jnp.uint32) * RATE - 1)[:, None]
+    rows = rows ^ jnp.where(col == last, 0x80, 0).astype(jnp.uint8)
+    if hole_node is not None:
+        dig = digest_buf[hole_src]
+        fr = rows.reshape(-1)
+        sidx = (hole_node * L + hole_byte)[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
+        rows = fr.at[sidx.reshape(-1)].set(dig.reshape(-1)).reshape(n, L)
+    d = masked_absorb_words(_bytes_to_words(rows), b_tier, counts)
+    return digest_buf.at[slots].set(_digests_to_bytes(d))
+
+
+def _branch_level(masks, slots, ch_row, ch_nib, ch_src, digest_buf, *, b_tier: int):
+    """Construct whole branch-node RLPs ON DEVICE from 2-byte state masks.
+
+    A secure-trie branch whose 16 children are all hashed has a fully
+    determined byte layout: list header (f8 <len> for <=7 children, f9
+    <len:2> above), then per nibble (a0 + 32-byte ref) or 80, then 80
+    (empty value). Only the mask and the child (row, nibble, digest-slot)
+    triples cross the wire — ~250x less H2D than the 532-byte template."""
+    L = b_tier * RATE
+    n = masks.shape[0]
+    nibs = jnp.arange(16, dtype=jnp.int32)[None, :]
+    present = ((masks[:, None].astype(jnp.int32) >> nibs) & 1).astype(jnp.int32)  # (n,16)
+    sizes = 1 + 32 * present
+    csum = jnp.cumsum(sizes, axis=1) - sizes          # exclusive prefix
+    payload = jnp.sum(sizes, axis=1) + 1              # + empty value byte
+    hl = jnp.where(payload > 0xFF, 3, 2)              # header length
+    total = hl + payload
+    col = jnp.arange(L, dtype=jnp.int32)[None, :]
+    rows = jnp.zeros((n, L), dtype=jnp.uint8)
+    rows = rows.at[:, 0].set(jnp.where(hl == 3, 0xF9, 0xF8).astype(jnp.uint8))
+    rows = rows.at[:, 1].set(
+        jnp.where(hl == 3, payload >> 8, payload & 0xFF).astype(jnp.uint8)
+    )
+    # byte 2 = low len byte for f9 rows; f8 rows overwrite it with their
+    # first child marker below (csum[:, 0] == 0 puts it exactly at hl == 2)
+    rows = rows.at[:, 2].set((payload & 0xFF).astype(jnp.uint8))
+    # child markers: 0xa0 when present else 0x80, at hl + csum
+    marker = jnp.where(present == 1, 0xA0, 0x80).astype(jnp.uint8)
+    flat = rows.reshape(-1)
+    midx = (jnp.arange(n, dtype=jnp.int32)[:, None] * L + hl[:, None] + csum).reshape(-1)
+    flat = flat.at[midx].set(marker.reshape(-1))
+    # empty branch value right after the children
+    vidx = jnp.arange(n, dtype=jnp.int32) * L + (total - 1)
+    flat = flat.at[vidx].set(jnp.uint8(0x80))
+    # splice child digests at marker+1
+    dig = digest_buf[ch_src]
+    off = hl[ch_row] + csum[ch_row, ch_nib] + 1
+    sidx = (ch_row * L + off)[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
+    flat = flat.at[sidx.reshape(-1)].set(dig.reshape(-1))
+    rows = flat.reshape(n, L)
+    # keccak padding from the computed total length
+    counts = total // RATE + 1
+    rows = rows ^ jnp.where(col == total[:, None], 0x01, 0).astype(jnp.uint8)
+    rows = rows ^ jnp.where(col == (counts * RATE - 1)[:, None], 0x80, 0).astype(jnp.uint8)
+    d = masked_absorb_words(_bytes_to_words(rows), b_tier, counts.astype(jnp.int32))
+    return digest_buf.at[slots].set(_digests_to_bytes(d))
+
+
 @lru_cache(maxsize=None)
 def _jitted(kind: str, b_tier: int, sharding_key=None):
     """One compiled program per (kind, block tier); shapes add tiers via the
     caller's padding. ``sharding_key`` is an opaque hashable handle the mesh
     layer uses to get distinctly-sharded variants (see ``FusedMeshEngine``)."""
-    fn = {"plain": _plain_level, "splice": _splice_level}[kind]
-    donate = {"plain": 3, "splice": 6}[kind]
+    fn = {
+        "plain": _plain_level,
+        "splice": _splice_level,
+        "packed": _packed_level,
+        "branch": _branch_level,
+    }[kind]
+    donate = {"plain": 3, "splice": 6, "packed": 8, "branch": 5}[kind]
     return jax.jit(partial(fn, b_tier=b_tier), donate_argnums=donate)
 
 
@@ -157,6 +236,15 @@ class FusedLevelEngine:
     def finish(self) -> np.ndarray:
         buf, self._buf = self._buf, None
         return np.asarray(buf)
+
+    def fetch_slots(self, slots: np.ndarray) -> np.ndarray:
+        """Small D2H: gather specific digest slots (e.g. per-job roots)
+        without pulling the whole buffer; ends the commit."""
+        ids = np.zeros((_pow2(max(len(slots), 1), floor=8),), dtype=np.int32)
+        ids[: len(slots)] = slots
+        out = np.asarray(jnp.take(self._buf, self._device_put(ids), axis=0))
+        self._buf = None
+        return out[: len(slots)]
 
     # -- mesh seam (overridden by FusedMeshEngine) -------------------------
 
@@ -246,6 +334,88 @@ class FusedLevelEngine:
             self._put_batch(templates), self._put_batch(counts),
             self._put_batch(hole_node), self._put_batch(hole_byte),
             self._put_batch(hole_src), self._put_batch(slots), self._buf,
+        )
+
+    # -- raw turbo dispatch (arrays straight from native/triebuild.cpp) ----
+
+    def _pad_rows(self, n: int, *arrays):
+        """Pad row-indexed arrays to the batch tier; returns (n_tier, padded)."""
+        mult = self._batch_multiple()
+        n_tier = _tier(max(n + 1, mult), max(self.min_tier, mult), growth=4)
+        out = []
+        for arr, fill in arrays:
+            p = np.full((n_tier,), fill, dtype=arr.dtype)
+            p[:n] = arr
+            out.append(p)
+        return n_tier, out
+
+    def _pad_holes(self, holes, n: int, floor: int, growth_mult):
+        """Pad (row, off/nib, src) triples; padding rows target row ``n``
+        (always a padding row since n_tier >= n+1) and dummy slot 0."""
+        h = holes.shape[1] if holes is not None else 0
+        mult = self._batch_multiple()
+        h_tier = -(-floor // mult) * mult  # hole arrays shard over the mesh too
+        while h_tier < h:
+            h_tier *= growth_mult
+        rows = np.full((h_tier,), n, dtype=np.int32)
+        offs = np.zeros((h_tier,), dtype=np.int32)
+        srcs = np.zeros((h_tier,), dtype=np.int32)
+        if h:
+            rows[:h], offs[:h], srcs[:h] = holes[0], holes[1], holes[2]
+        return rows, offs, srcs
+
+    def dispatch_packed(
+        self,
+        flat: np.ndarray,
+        row_off: np.ndarray,
+        row_len: np.ndarray,
+        slots: np.ndarray,
+        holes: np.ndarray | None,
+        b_tier: int,
+    ) -> None:
+        """One level of tightly-packed RLP rows from the native builder.
+
+        ``flat``: concatenated row bytes (the only bulk H2D of the level);
+        ``holes``: (3, H) int32 [row, byte_off, src_slot] or None."""
+        n = len(row_off)
+        if n == 0:
+            return
+        counts = (row_len // RATE + 1).astype(np.int32)
+        n_tier, (row_off_p, row_len_p, counts_p, slots_p) = self._pad_rows(
+            n, (row_off.astype(np.uint32), 0), (row_len.astype(np.uint32), 0),
+            (counts, 1), (slots.astype(np.int32), 0),
+        )
+        flat_tier = _pow2(max(len(flat), 1), floor=4096)
+        flat_p = np.zeros((flat_tier,), dtype=np.uint8)
+        flat_p[: len(flat)] = flat
+        hr, ho, hs = self._pad_holes(holes, n, floor=256, growth_mult=4)
+        fn = _jitted("packed", b_tier, self._sharding_key())
+        self._buf = fn(
+            self._device_put(flat_p), self._put_batch(row_off_p),
+            self._put_batch(row_len_p), self._put_batch(counts_p),
+            self._put_batch(hr), self._put_batch(ho), self._put_batch(hs),
+            self._put_batch(slots_p), self._buf,
+        )
+
+    def dispatch_branch(
+        self, masks: np.ndarray, slots: np.ndarray, children: np.ndarray
+    ) -> None:
+        """One level of all-hashed-children branches: 2-byte masks + child
+        (row, nibble, src-slot) triples; the RLP bytes are constructed on
+        device (``_branch_level``)."""
+        n = len(masks)
+        if n == 0:
+            return
+        n_tier, (masks_p, slots_p) = self._pad_rows(
+            n, (masks.astype(np.int32), 0), (slots.astype(np.int32), 0)
+        )
+        # children <= 16n; tier as a multiple of the batch tier to bound the
+        # number of compiled (n_tier, h_tier) combinations
+        cr, cn, cs = self._pad_holes(children, n, floor=2 * n_tier, growth_mult=2)
+        fn = _jitted("branch", 4, self._sharding_key())
+        self._buf = fn(
+            self._put_batch(masks_p), self._put_batch(slots_p),
+            self._put_batch(cr), self._put_batch(cn), self._put_batch(cs), self._buf,
         )
 
 

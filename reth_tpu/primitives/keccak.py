@@ -203,6 +203,24 @@ def keccak256_batch_np(msgs: list[bytes]) -> list[bytes]:
     )
 
 
+def keccak256_words_masked_np(
+    words: np.ndarray, max_blocks: int, counts: np.ndarray
+) -> np.ndarray:
+    """Masked absorb (numpy twin of the device kernel): each message padded
+    at its OWN final rate block and zero-extended to ``max_blocks``; blocks
+    at index >= counts[i] leave message i's state untouched. Returns
+    (N, 4) uint64 digest lanes."""
+    n = words.shape[0]
+    state = np.zeros((n, 25), dtype=np.uint64)
+    for blk in range(max_blocks):
+        nxt = state.copy()
+        nxt[:, :17] ^= words[:, blk * 17 : (blk + 1) * 17]
+        nxt = keccak_f1600_np(nxt)
+        live = (blk < counts)[:, None]
+        state = np.where(live, nxt, state)
+    return np.ascontiguousarray(state[:, :4])
+
+
 def keccak256_words_np(words: np.ndarray, num_blocks: int) -> np.ndarray:
     """Absorb ``num_blocks`` rate-blocks of pre-padded words, return (N, 4) u64.
 

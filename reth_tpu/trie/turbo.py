@@ -1,0 +1,360 @@
+"""Turbo commit path: native structure sweep + array-level hashing backends.
+
+The end-to-end MerkleStage rebuild pipeline with NO per-node Python:
+
+  sorted 32-byte hashed keys + RLP values
+    └─ native/triebuild.cpp  (C++ sweep: structure + RLP templates/masks,
+       flat per-level arrays — replaces trie/committer.py's per-node
+       recursion for the secure-trie full-rebuild shape)
+        └─ per level, deepest first:
+           PACKED rows  → FusedLevelEngine.dispatch_packed   (device)
+           BITMAP rows  → FusedLevelEngine.dispatch_branch   (device)
+           ... or the numpy twin (`_NumpyBackend`) — the measured CPU
+           baseline and the no-jax fallback
+            └─ ONE digest fetch: roots (+ branch-node hashes when
+               TrieUpdates collection is requested)
+
+Reference analogue: StateRoot's cursor walk + HashBuilder + asm-keccak
+(reference crates/trie/trie/src/trie.rs:32, crates/stages/stages/src/
+stages/hashing_account.rs:29-32), re-partitioned so the host does memcpy
+work and the device does all hashing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+from ..primitives.keccak import (
+    RATE,
+    keccak256,
+    keccak256_words_masked_np,
+)
+from ..primitives.types import EMPTY_ROOT_HASH
+from .committer import BranchNode, TrieBuildResult
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "triebuild.cpp"
+_SO = _SRC.parent / "build" / "libtriebuild.so"
+_build_lock = threading.Lock()
+_lib = None
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_u16p = ctypes.POINTER(ctypes.c_uint16)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            _SO.parent.mkdir(parents=True, exist_ok=True)
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", str(_SRC), "-o", str(_SO)]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(f"g++ failed building triebuild:\n{proc.stderr}")
+        lib = ctypes.CDLL(str(_SO))
+        lib.rtb_build.restype = ctypes.c_void_p
+        lib.rtb_build.argtypes = [_u8p, ctypes.c_uint64, _u64p, ctypes.c_uint32,
+                                  _u8p, _u64p, ctypes.c_int, _i32p]
+        lib.rtb_free.argtypes = [ctypes.c_void_p]
+        for name, res in [("rtb_num_levels", ctypes.c_int32),
+                          ("rtb_max_slot", ctypes.c_int32)]:
+            getattr(lib, name).restype = res
+            getattr(lib, name).argtypes = [ctypes.c_void_p]
+        lib.rtb_level_depth.restype = ctypes.c_uint32
+        lib.rtb_level_depth.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.rtb_packed_bytes.restype = ctypes.c_uint64
+        lib.rtb_packed_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        for name in ["rtb_packed_rows", "rtb_packed_holes", "rtb_bmp_rows",
+                     "rtb_bmp_children"]:
+            getattr(lib, name).restype = ctypes.c_uint32
+            getattr(lib, name).argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.rtb_packed_get.argtypes = [ctypes.c_void_p, ctypes.c_int32, _u8p, _u32p, _i32p]
+        lib.rtb_packed_get_holes.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                             _i32p, _i32p, _i32p]
+        lib.rtb_bmp_get.argtypes = [ctypes.c_void_p, ctypes.c_int32, _u16p, _i32p]
+        lib.rtb_bmp_get_children.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                             _i32p, _i32p, _i32p]
+        lib.rtb_roots.argtypes = [ctypes.c_void_p, _i32p]
+        lib.rtb_root_inline_len.restype = ctypes.c_uint32
+        lib.rtb_root_inline_len.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.rtb_root_inline.argtypes = [ctypes.c_void_p, ctypes.c_uint32, _u8p]
+        lib.rtb_meta_count.restype = ctypes.c_uint64
+        lib.rtb_meta_count.argtypes = [ctypes.c_void_p]
+        lib.rtb_meta_get.argtypes = [ctypes.c_void_p, _u8p]
+        _lib = lib
+        return lib
+
+
+def _ptr(arr: np.ndarray, ty):
+    return arr.ctypes.data_as(ty)
+
+
+class _Level:
+    """One depth level as flat numpy arrays, straight from the native sweep."""
+
+    __slots__ = ("depth", "flat", "row_off", "row_len", "row_slot", "holes",
+                 "masks", "bmp_slot", "children", "b_tier")
+
+    def __init__(self, lib, h, i):
+        self.depth = lib.rtb_level_depth(h, i)
+        nb = int(lib.rtb_packed_bytes(h, i))
+        nr = int(lib.rtb_packed_rows(h, i))
+        self.flat = np.zeros((nb,), dtype=np.uint8)
+        row_off_full = np.zeros((nr + 1,), dtype=np.uint32)
+        self.row_slot = np.zeros((nr,), dtype=np.int32)
+        if nr:
+            lib.rtb_packed_get(h, i, _ptr(self.flat, _u8p),
+                               _ptr(row_off_full, _u32p), _ptr(self.row_slot, _i32p))
+        self.row_off = row_off_full[:-1]
+        self.row_len = np.diff(row_off_full).astype(np.uint32)
+        nh = int(lib.rtb_packed_holes(h, i))
+        if nh:
+            self.holes = np.zeros((3, nh), dtype=np.int32)
+            lib.rtb_packed_get_holes(h, i, _ptr(self.holes[0], _i32p),
+                                     _ptr(self.holes[1], _i32p), _ptr(self.holes[2], _i32p))
+        else:
+            self.holes = None
+        nbm = int(lib.rtb_bmp_rows(h, i))
+        self.masks = np.zeros((nbm,), dtype=np.uint16)
+        self.bmp_slot = np.zeros((nbm,), dtype=np.int32)
+        nch = int(lib.rtb_bmp_children(h, i))
+        self.children = np.zeros((3, max(nch, 0)), dtype=np.int32)
+        if nbm:
+            lib.rtb_bmp_get(h, i, _ptr(self.masks, _u16p), _ptr(self.bmp_slot, _i32p))
+        if nch:
+            lib.rtb_bmp_get_children(h, i, _ptr(self.children[0], _i32p),
+                                     _ptr(self.children[1], _i32p),
+                                     _ptr(self.children[2], _i32p))
+        maxlen = int(self.row_len.max()) if nr else 0
+        bt = 1
+        while bt * RATE <= maxlen:
+            bt *= 2
+        self.b_tier = bt
+
+
+class _NumpyBackend:
+    """CPU twin of the device engine — the measured baseline and the no-jax
+    fallback. Same array protocol, digests live in a host buffer."""
+
+    def __init__(self):
+        self._buf = None
+
+    def begin(self, max_slots: int) -> None:
+        self._buf = np.zeros((max_slots + 1, 32), dtype=np.uint8)
+
+    def _hash_rows(self, rows: np.ndarray, counts: np.ndarray, slots: np.ndarray,
+                   b_tier: int) -> None:
+        lanes = keccak256_words_masked_np(
+            np.ascontiguousarray(rows).view("<u8"), b_tier, counts
+        )
+        self._buf[slots] = np.ascontiguousarray(lanes).view(np.uint8).reshape(-1, 32)
+
+    def dispatch_packed(self, flat, row_off, row_len, slots, holes, b_tier) -> None:
+        n = len(row_off)
+        if n == 0:
+            return
+        L = b_tier * RATE
+        col = np.arange(L, dtype=np.uint32)[None, :]
+        idx = np.minimum(row_off[:, None] + col, max(len(flat) - 1, 0))
+        rows = np.where(col < row_len[:, None], flat[idx] if len(flat) else 0, 0).astype(np.uint8)
+        r = np.arange(n)
+        counts = (row_len // RATE + 1).astype(np.int64)
+        rows[r, row_len] ^= 0x01
+        rows[r, counts * RATE - 1] ^= 0x80
+        if holes is not None:
+            hr, ho, hs = holes
+            rows[hr[:, None], ho[:, None] + np.arange(32)] = self._buf[hs]
+        self._hash_rows(rows, counts, slots, b_tier)
+
+    def dispatch_branch(self, masks, slots, children) -> None:
+        n = len(masks)
+        if n == 0:
+            return
+        L = 4 * RATE
+        nibs = np.arange(16, dtype=np.int32)[None, :]
+        present = ((masks[:, None].astype(np.int32) >> nibs) & 1).astype(np.int64)
+        sizes = 1 + 32 * present
+        csum = np.cumsum(sizes, axis=1) - sizes
+        payload = sizes.sum(axis=1) + 1
+        hl = np.where(payload > 0xFF, 3, 2)
+        total = hl + payload
+        rows = np.zeros((n, L), dtype=np.uint8)
+        rows[:, 0] = np.where(hl == 3, 0xF9, 0xF8)
+        rows[:, 1] = np.where(hl == 3, payload >> 8, payload & 0xFF)
+        rows[:, 2] = payload & 0xFF  # f8 rows: overwritten by first marker
+        r16 = np.repeat(np.arange(n), 16)
+        rows[r16, (hl[:, None] + csum).reshape(-1)] = np.where(
+            present == 1, 0xA0, 0x80
+        ).reshape(-1)
+        rows[np.arange(n), total - 1] = 0x80
+        cr, cn, cs = children
+        off = hl[cr] + csum[cr, cn] + 1
+        rows[cr[:, None], off[:, None] + np.arange(32)] = self._buf[cs]
+        counts = total // RATE + 1
+        rows[np.arange(n), total] ^= 0x01
+        rows[np.arange(n), counts * RATE - 1] ^= 0x80
+        self._hash_rows(rows, counts, slots, 4)
+
+    def fetch_slots(self, slots: np.ndarray) -> np.ndarray:
+        out = self._buf[slots]
+        self._buf = None
+        return out
+
+    def finish(self) -> np.ndarray:
+        buf, self._buf = self._buf, None
+        return buf
+
+
+class TurboCommitter:
+    """Full-rebuild state committer over 32-byte hashed keys.
+
+    ``backend``: "device" (fused HBM-resident engine, optionally SPMD over
+    ``mesh``) or "numpy" (CPU twin — the measured baseline)."""
+
+    def __init__(self, backend: str = "device", min_tier: int = 1024, mesh=None):
+        self.backend_kind = backend
+        self.min_tier = min_tier
+        self.mesh = mesh
+        self._lib = load_library()
+
+    def _make_backend(self):
+        if self.backend_kind == "numpy":
+            return _NumpyBackend()
+        from ..ops.fused_commit import FusedLevelEngine, FusedMeshEngine
+
+        if self.mesh is not None:
+            return FusedMeshEngine(self.mesh, min_tier=self.min_tier)
+        return FusedLevelEngine(min_tier=self.min_tier)
+
+    def commit_hashed_many(
+        self,
+        jobs: list[tuple[np.ndarray, list[bytes]]],
+        collect_branches: bool = False,
+    ) -> list[TrieBuildResult]:
+        """Commit many independent secure tries with shared level batching.
+
+        ``jobs``: (keys (n, 32) uint8 — need not be sorted, values aligned
+        RLP-encoded bytes) per trie. Returns one TrieBuildResult per job
+        (root + optional BranchNode TrieUpdates)."""
+        lib = self._lib
+        n_jobs = len(jobs)
+        key_arrays, val_chunks, job_off = [], [], [0]
+        for keys, values in jobs:
+            keys = np.ascontiguousarray(keys, dtype=np.uint8).reshape(-1, 32)
+            if len(keys) != len(values):
+                raise ValueError("keys/values length mismatch")
+            order = np.argsort(keys.view("S32").ravel(), kind="stable")
+            key_arrays.append(keys[order])
+            val_chunks.extend(values[i] for i in order)
+            job_off.append(job_off[-1] + len(keys))
+        all_keys = (
+            np.concatenate(key_arrays) if key_arrays else np.zeros((0, 32), np.uint8)
+        )
+        flat_vals = b"".join(val_chunks)
+        val_off = np.zeros((len(val_chunks) + 1,), dtype=np.uint64)
+        if val_chunks:
+            val_off[1:] = np.cumsum(
+                np.fromiter((len(v) for v in val_chunks), dtype=np.uint64,
+                            count=len(val_chunks))
+            )
+        vals_np = np.frombuffer(flat_vals, dtype=np.uint8) if flat_vals else np.zeros(1, np.uint8)
+        job_off_np = np.asarray(job_off, dtype=np.uint64)
+        err = ctypes.c_int32(0)
+        h = lib.rtb_build(
+            _ptr(np.ascontiguousarray(all_keys), _u8p), len(all_keys),
+            _ptr(job_off_np, _u64p), n_jobs,
+            _ptr(vals_np, _u8p), _ptr(val_off, _u64p),
+            1 if collect_branches else 0, ctypes.byref(err),
+        )
+        if not h:
+            raise ValueError(f"triebuild failed (err={err.value}: "
+                             f"{'unsorted' if err.value == 1 else 'duplicate keys' if err.value == 2 else 'bad input'})")
+        try:
+            return self._run(lib, h, n_jobs, key_arrays, collect_branches)
+        finally:
+            lib.rtb_free(h)
+
+    def _run(self, lib, h, n_jobs, key_arrays, collect_branches):
+        backend = self._make_backend()
+        max_slot = lib.rtb_max_slot(h)
+        backend.begin(max_slot)
+        n_levels = lib.rtb_num_levels(h)
+        hashed_per_level = []
+        for i in range(n_levels):
+            lv = _Level(lib, h, i)
+            backend.dispatch_packed(lv.flat, lv.row_off, lv.row_len, lv.row_slot,
+                                    lv.holes, lv.b_tier)
+            backend.dispatch_branch(lv.masks, lv.bmp_slot, lv.children)
+            hashed_per_level.append(len(lv.row_slot) + len(lv.masks))
+        root_slots = np.zeros((n_jobs,), dtype=np.int32)
+        lib.rtb_roots(h, _ptr(root_slots, _i32p))
+        meta_rec = None
+        if collect_branches:
+            nmeta = int(lib.rtb_meta_count(h))
+            meta_rec = np.zeros((nmeta, 80), dtype=np.uint8)
+            if nmeta:
+                lib.rtb_meta_get(h, _ptr(meta_rec, _u8p))
+            digests = backend.finish()
+        else:
+            digests = None
+            roots_raw = backend.fetch_slots(np.maximum(root_slots, 0))
+        results = []
+        total_hashed = sum(hashed_per_level)
+        for j in range(n_jobs):
+            slot = int(root_slots[j])
+            if slot > 0:
+                root = (digests[slot] if digests is not None else roots_raw[j]).tobytes()
+            else:
+                ln = lib.rtb_root_inline_len(h, j)
+                if ln == 0:
+                    root = EMPTY_ROOT_HASH
+                else:
+                    buf = np.zeros((ln,), dtype=np.uint8)
+                    lib.rtb_root_inline(h, j, _ptr(buf, _u8p))
+                    root = keccak256(buf.tobytes())
+            results.append(TrieBuildResult(root=root, levels=n_levels))
+        if results:
+            # attribute the shared hash count to the batch (job-level split
+            # is not tracked in turbo mode; totals are what the stage reports)
+            results[-1].hashed_nodes = total_hashed
+        if collect_branches and meta_rec is not None and len(meta_rec):
+            job_starts = np.cumsum([0] + [len(k) for k in key_arrays])
+            self._collect_meta(meta_rec, key_arrays, job_starts, digests, results)
+        return results
+
+    def _collect_meta(self, meta_rec, key_arrays, job_starts, digests, results):
+        jobs_f = meta_rec[:, 0:4].copy().view("<u4").ravel()
+        reps = meta_rec[:, 4:8].copy().view("<u4").ravel()
+        depths = meta_rec[:, 8:10].copy().view("<u2").ravel()
+        smasks = meta_rec[:, 10:12].copy().view("<u2").ravel()
+        tmasks = meta_rec[:, 12:14].copy().view("<u2").ravel()
+        hmasks = meta_rec[:, 14:16].copy().view("<u2").ravel()
+        cslots = meta_rec[:, 16:80].copy().view("<i4").reshape(-1, 16)
+        for k in range(len(meta_rec)):
+            j = int(jobs_f[k])
+            keys = key_arrays[j]
+            d = int(depths[k])
+            key = keys[int(reps[k]) - int(job_starts[j])]  # rep_key is global
+            nibs = np.empty((64,), dtype=np.uint8)
+            nibs[0::2] = key >> 4
+            nibs[1::2] = key & 0xF
+            path = bytes(nibs[:d])
+            hm = int(hmasks[k])
+            hashes = tuple(
+                digests[cslots[k, nb]].tobytes() for nb in range(16) if (hm >> nb) & 1
+            )
+            results[j].branch_nodes[path] = BranchNode(
+                int(smasks[k]), int(tmasks[k]), hm, hashes
+            )
+        return results

@@ -1,0 +1,138 @@
+"""Turbo commit path (native sweep + array backends): parity tests.
+
+Pins native/triebuild.cpp + TurboCommitter (numpy and device backends)
+against the Python TrieCommitter, which is itself pinned to the naive
+oracle (tests/test_trie.py). Covers inline leaves (deep shared prefixes
+with tiny values — the <32-byte RLP case), branch-with-inline-child rows,
+TrieUpdates branch metadata, and the SPMD mesh backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.primitives.nibbles import unpack_nibbles
+from reth_tpu.primitives.rlp import rlp_encode
+from reth_tpu.trie.committer import TrieCommitter
+from reth_tpu.trie.turbo import TurboCommitter
+
+
+def _job(n, seed, val_len=(1, 100)):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 256, size=(n, 32), dtype=np.uint8)
+    keys = np.unique(keys.view("S32").ravel()).view(np.uint8).reshape(-1, 32)
+    rng.shuffle(keys)
+    values = [
+        rlp_encode(bytes(rng.integers(0, 256, size=int(rng.integers(*val_len)), dtype=np.uint8)))
+        for _ in range(len(keys))
+    ]
+    return keys, values
+
+
+def _baseline_result(jobs, collect=False):
+    base = TrieCommitter(hasher=keccak256_batch_np)
+    py_jobs = [
+        ([(unpack_nibbles(k.tobytes()), v) for k, v in zip(keys, values)], None)
+        for keys, values in jobs
+    ]
+    return base.commit_many(py_jobs, collect_branches=collect)
+
+
+@pytest.fixture(scope="module")
+def turbo_np():
+    return TurboCommitter(backend="numpy")
+
+
+@pytest.mark.parametrize("n", [1, 2, 30, 500, 3000])
+def test_turbo_numpy_root_parity(turbo_np, n):
+    jobs = [_job(n, seed=n)]
+    got = turbo_np.commit_hashed_many(jobs)
+    want = _baseline_result(jobs)
+    assert got[0].root == want[0].root
+
+
+def test_turbo_many_jobs(turbo_np):
+    jobs = [_job(40, seed=10 + i, val_len=(1, 32)) for i in range(8)] + [_job(900, seed=99)]
+    got = turbo_np.commit_hashed_many(jobs)
+    want = _baseline_result(jobs)
+    assert [r.root for r in got] == [r.root for r in want]
+
+
+def test_turbo_empty_job(turbo_np):
+    from reth_tpu.primitives.types import EMPTY_ROOT_HASH
+
+    keys = np.zeros((0, 32), dtype=np.uint8)
+    got = turbo_np.commit_hashed_many([(keys, []), _job(5, seed=1)])
+    assert got[0].root == EMPTY_ROOT_HASH
+    assert got[1].root == _baseline_result([_job(5, seed=1)])[0].root
+
+
+def test_turbo_inline_leaves(turbo_np):
+    """Keys sharing 60 nibbles with 1-byte values produce <32-byte leaf RLPs
+    (inline) and a branch row with literal inline-child bytes."""
+    prefix = bytes(range(30))
+    keys = np.array(
+        [list(prefix + bytes([i, 7])) for i in range(6)]
+        + [list(bytes(31) + bytes([9]))],
+        dtype=np.uint8,
+    )
+    values = [rlp_encode(b"\x01")] * len(keys)
+    got = turbo_np.commit_hashed_many([(keys, values)])
+    want = _baseline_result([(keys, values)])
+    assert got[0].root == want[0].root
+
+
+def test_turbo_branch_meta(turbo_np):
+    jobs = [_job(400, seed=4)]
+    got = turbo_np.commit_hashed_many(jobs, collect_branches=True)
+    want = _baseline_result(jobs, collect=True)
+    assert got[0].root == want[0].root
+    assert got[0].branch_nodes == want[0].branch_nodes
+
+
+def test_turbo_duplicate_keys_rejected(turbo_np):
+    keys = np.zeros((2, 32), dtype=np.uint8)
+    with pytest.raises(ValueError, match="duplicate"):
+        turbo_np.commit_hashed_many([(keys, [b"\x01", b"\x02"])])
+
+
+def test_turbo_device_backend_parity(turbo_np):
+    dev = TurboCommitter(backend="device", min_tier=64)
+    jobs = [_job(60, seed=21, val_len=(1, 40)) for _ in range(3)] + [_job(800, seed=22)]
+    got = dev.commit_hashed_many(jobs, collect_branches=True)
+    want = turbo_np.commit_hashed_many(jobs, collect_branches=True)
+    assert [r.root for r in got] == [r.root for r in want]
+    assert got[-1].branch_nodes == want[-1].branch_nodes
+
+
+def test_turbo_device_inline_leaves():
+    dev = TurboCommitter(backend="device", min_tier=16)
+    prefix = bytes(range(30))
+    keys = np.array([list(prefix + bytes([i, 7])) for i in range(6)], dtype=np.uint8)
+    values = [rlp_encode(b"\x01")] * len(keys)
+    got = dev.commit_hashed_many([(keys, values)])
+    want = _baseline_result([(keys, values)])
+    assert got[0].root == want[0].root
+
+
+@pytest.mark.parametrize("n_dev", [8, 6])
+def test_turbo_mesh_backend_parity(turbo_np, n_dev):
+    """Mesh sharding incl. a non-power-of-two device count (6): every tier
+    (batch, holes, children) must round to a device-count multiple."""
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
+    dev = TurboCommitter(backend="device", min_tier=64, mesh=mesh)
+    jobs = [_job(600, seed=31)]
+    got = dev.commit_hashed_many(jobs)
+    want = turbo_np.commit_hashed_many(jobs)
+    assert got[0].root == want[0].root
+
+
+def test_turbo_oversized_value_rejected(turbo_np):
+    keys = np.arange(32, dtype=np.uint8).reshape(1, 32)
+    with pytest.raises(ValueError, match="triebuild failed"):
+        turbo_np.commit_hashed_many([(keys, [b"\x01" * 70000])])
