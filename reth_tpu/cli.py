@@ -36,8 +36,12 @@ def _make_committer(args):
     if getattr(args, "hasher", "device") == "cpu":
         from .primitives.keccak import keccak256_batch_np
 
-        return TrieCommitter(hasher=keccak256_batch_np)
-    return TrieCommitter()
+        committer = TrieCommitter(hasher=keccak256_batch_np)
+        committer.turbo_backend = "numpy"  # MerkleStage clean-path backend
+    else:
+        committer = TrieCommitter()
+        committer.turbo_backend = "device"
+    return committer
 
 
 # Built-in dev-mode genesis (reference --dev auto-installs a dev chainspec).

@@ -12,7 +12,11 @@ from __future__ import annotations
 
 from ..storage.provider import DatabaseProvider
 from ..trie.committer import TrieCommitter
-from ..trie.incremental import IncrementalStateRoot, full_state_root
+from ..trie.incremental import (
+    IncrementalStateRoot,
+    full_state_root,
+    full_state_root_turbo,
+)
 from .api import ExecInput, ExecOutput, Stage, StageError, UnwindInput
 
 INVALID_STATE_ROOT = (
@@ -27,9 +31,19 @@ class MerkleStage(Stage):
         self.committer = committer or TrieCommitter()
         self.rebuild_threshold = rebuild_threshold
 
+    def _full_rebuild(self, provider: DatabaseProvider) -> bytes:
+        """Clean path: turbo (C++ sweep + device levels) with fallback to
+        the general committer when the fast path rejects the input (e.g.
+        oversized values) or the native build is unavailable."""
+        backend = getattr(self.committer, "turbo_backend", "numpy")
+        try:
+            return full_state_root_turbo(provider, backend=backend)
+        except (ValueError, RuntimeError):
+            return full_state_root(provider, self.committer)
+
     def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
         if inp.checkpoint == 0 or inp.target - inp.checkpoint > self.rebuild_threshold:
-            root = full_state_root(provider, self.committer)
+            root = self._full_rebuild(provider)
         else:
             root = self._incremental(provider, inp.next_block, inp.target)
         header = provider.header_by_number(inp.target)

@@ -311,7 +311,7 @@ def full_state_root(
 
     # storage roots for every account with storage, one batched commit
     addrs, jobs = _scan_all_storage_jobs(p)
-    results = committer.commit_many(jobs)
+    results = committer.commit_many(_nibble_jobs(jobs))
     for addr, res in zip(addrs, results):
         for path, node in res.branch_nodes.items():
             p.put_storage_branch(addr, path, node)
@@ -341,9 +341,66 @@ def full_state_root(
     return result.root
 
 
+def full_state_root_turbo(provider: DatabaseProvider, backend: str = "device") -> bytes:
+    """Full rebuild on the turbo path: C++ structure sweep + packed/bitmap
+    device levels (trie/turbo.py) — zero per-node Python. Same semantics as
+    :func:`full_state_root`; raises ``ValueError`` for inputs outside the
+    secure-trie fast path (the MerkleStage falls back to the general
+    committer). Reference analogue: the clean MerkleStage path
+    (crates/stages/stages/src/stages/merkle.rs:184-330)."""
+    from .turbo import TurboCommitter
+    import numpy as np
+
+    committer = TurboCommitter(backend=backend)
+    p = provider
+    p.clear_trie_tables()
+
+    addrs, jobs = _scan_all_storage_jobs(p)
+    turbo_jobs = []
+    for pairs in jobs:
+        keys = (
+            np.frombuffer(b"".join(s for s, _ in pairs), dtype=np.uint8).reshape(-1, 32)
+            if pairs else np.zeros((0, 32), dtype=np.uint8)
+        )
+        turbo_jobs.append((keys, [v for _, v in pairs]))
+    results = committer.commit_hashed_many(turbo_jobs, collect_branches=True)
+    for addr, res in zip(addrs, results):
+        for path, node in res.branch_nodes.items():
+            p.put_storage_branch(addr, path, node)
+        acct = p.hashed_account(addr)
+        if acct is not None and acct.storage_root != res.root:
+            p.put_hashed_account(addr, acct.with_(storage_root=res.root),
+                                 preserve_storage_root=False)
+
+    with_storage = set(addrs)
+    stale = []
+    for k, v in p.tx.cursor(Tables.HashedAccounts.name).walk():
+        if k not in with_storage:
+            acct = T.decode_account(v)
+            if acct.storage_root != EMPTY_ROOT_HASH:
+                stale.append((k, acct))
+    for k, acct in stale:
+        p.put_hashed_account(k, acct.with_(storage_root=EMPTY_ROOT_HASH),
+                             preserve_storage_root=False)
+
+    akeys, avals = [], []
+    for k, v in p.tx.cursor(Tables.HashedAccounts.name).walk():
+        akeys.append(k)
+        avals.append(v)
+    keys_np = (
+        np.frombuffer(b"".join(akeys), dtype=np.uint8).reshape(-1, 32)
+        if akeys else np.zeros((0, 32), dtype=np.uint8)
+    )
+    result = committer.commit_hashed_many([(keys_np, avals)], collect_branches=True)[0]
+    for path, node in result.branch_nodes.items():
+        p.put_account_branch(path, node)
+    return result.root
+
+
 def _scan_all_storage_jobs(p: DatabaseProvider):
-    """(addrs, per-addr leaf jobs) over the whole HashedStorages table —
-    shared by the full rebuild and the verifier so the scans can't drift."""
+    """(addrs, per-addr raw (hashed-slot, value-RLP) lists) over the whole
+    HashedStorages table — shared by the full rebuild (both committers) and
+    the verifier so the scans can't drift."""
     cur = p.tx.cursor(Tables.HashedStorages.name)
     addrs: list[bytes] = []
     entry = cur.first()
@@ -352,12 +409,19 @@ def _scan_all_storage_jobs(p: DatabaseProvider):
         entry = cur.next_no_dup()
     jobs = []
     for addr in addrs:
-        leaves = []
+        pairs = []
         for _, dup in p.tx.cursor(Tables.HashedStorages.name).walk_dup(addr):
             slot, value = T.decode_storage_entry(dup)
-            leaves.append((unpack_nibbles(slot), rlp_encode(encode_int(value))))
-        jobs.append((leaves, None))
+            pairs.append((slot, rlp_encode(encode_int(value))))
+        jobs.append(pairs)
     return addrs, jobs
+
+
+def _nibble_jobs(jobs):
+    """Raw (slot, value) scan output -> the general committer's leaf jobs."""
+    return [
+        ([(unpack_nibbles(slot), v) for slot, v in pairs], None) for pairs in jobs
+    ]
 
 
 def verify_state_root(
@@ -376,7 +440,7 @@ def verify_state_root(
     p = provider
     problems: list[str] = []
     addrs, jobs = _scan_all_storage_jobs(p)
-    results = committer.commit_many(jobs, collect_branches=True)
+    results = committer.commit_many(_nibble_jobs(jobs), collect_branches=True)
     storage_roots = dict(zip(addrs, (r.root for r in results)))
 
     # stored storage-trie branch nodes vs recomputed

@@ -136,3 +136,38 @@ def test_turbo_oversized_value_rejected(turbo_np):
     keys = np.arange(32, dtype=np.uint8).reshape(1, 32)
     with pytest.raises(ValueError, match="triebuild failed"):
         turbo_np.commit_hashed_many([(keys, [b"\x01" * 70000])])
+
+
+def test_full_state_root_turbo_matches_general(tmp_path):
+    """End-to-end: a synced provider's turbo full rebuild equals the general
+    committer's root AND the header root (storage tries + account trie,
+    with storage roots flowing into the account values)."""
+    from reth_tpu.consensus.validation import EthBeaconConsensus
+    from reth_tpu.primitives.types import Account
+    from reth_tpu.stages import default_stages
+    from reth_tpu.stages.api import Pipeline
+    from reth_tpu.storage.genesis import import_chain, init_genesis
+    from reth_tpu.storage.kv import MemDb
+    from reth_tpu.storage.provider import ProviderFactory
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie.incremental import full_state_root, full_state_root_turbo
+
+    cpu = TrieCommitter(hasher=keccak256_batch_np)
+    alice = Wallet(0xA11CE)
+    store = bytes.fromhex("5f355f5500")  # sstore(0, calldata[0])
+    init = bytes([0x60, len(store), 0x60, 0x0B, 0x5F, 0x39, 0x60, len(store),
+                  0x5F, 0xF3]) + b"\x00" + store
+    b = ChainBuilder({alice.address: Account(balance=10**21)}, committer=cpu)
+    b.build_block([alice.deploy(init)])
+    contract = next(iter(a for a, acc in b.accounts.items() if acc.code_hash != Account().code_hash and a != alice.address))
+    b.build_block([alice.call(contract, (0xBEEF).to_bytes(32, "big")),
+                   alice.transfer(b"\x42" * 20, 777)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, b.genesis, dict(b.accounts_at_genesis), committer=cpu)
+    import_chain(factory, b.blocks[1:], EthBeaconConsensus(cpu))
+    Pipeline(factory, default_stages(committer=cpu)).run(b.tip.number)
+    with factory.provider_rw() as p:
+        want = full_state_root(p, cpu)
+    with factory.provider_rw() as p:
+        got = full_state_root_turbo(p, backend="numpy")
+    assert got == want == b.tip.state_root
