@@ -1,0 +1,180 @@
+"""BlockchainTests runner: JSON fixture -> fresh node -> replay -> verify.
+
+Mirrors the reference's flow (testing/ef-tests/src/cases/blockchain_test.rs):
+init a throwaway provider from ``pre`` + genesis header, decode each
+block's RLP, run the real pipeline (execution + hashing + Merkle stages,
+so the state root in every header is recomputed from the trie, not
+trusted), then check ``lastblockhash`` and the ``postState`` account
+values. ``expectException`` blocks must fail import/validation.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..consensus.validation import ConsensusError, EthBeaconConsensus
+from ..primitives.keccak import keccak256
+from ..primitives.types import Account, Block, Header
+from ..stages import default_stages
+from ..stages.api import Pipeline, StageError
+from ..storage.genesis import GenesisMismatch, import_chain, init_genesis
+from ..storage.kv import MemDb
+from ..storage.provider import ProviderFactory
+
+
+class ConformanceFailure(AssertionError):
+    pass
+
+
+def _int(v) -> int:
+    if isinstance(v, int):
+        return v
+    return int(v, 16) if v.startswith("0x") else int(v)
+
+
+def _bytes(v: str) -> bytes:
+    return bytes.fromhex(v[2:] if v.startswith("0x") else v)
+
+
+def _b32(v) -> bytes:
+    return _int(v).to_bytes(32, "big")
+
+
+def header_from_json(h: dict) -> Header:
+    """ef-tests header field names -> Header."""
+    kw = dict(
+        parent_hash=_bytes(h["parentHash"]),
+        ommers_hash=_bytes(h["uncleHash"]),
+        beneficiary=_bytes(h["coinbase"]),
+        state_root=_bytes(h["stateRoot"]),
+        transactions_root=_bytes(h["transactionsTrie"]),
+        receipts_root=_bytes(h["receiptTrie"]),
+        logs_bloom=_bytes(h["bloom"]),
+        difficulty=_int(h["difficulty"]),
+        number=_int(h["number"]),
+        gas_limit=_int(h["gasLimit"]),
+        gas_used=_int(h["gasUsed"]),
+        timestamp=_int(h["timestamp"]),
+        extra_data=_bytes(h["extraData"]),
+        mix_hash=_bytes(h["mixHash"]),
+        nonce=_bytes(h["nonce"]),
+    )
+    if "baseFeePerGas" in h:
+        kw["base_fee_per_gas"] = _int(h["baseFeePerGas"])
+    if "withdrawalsRoot" in h:
+        kw["withdrawals_root"] = _bytes(h["withdrawalsRoot"])
+    if "blobGasUsed" in h:
+        kw["blob_gas_used"] = _int(h["blobGasUsed"])
+    if "excessBlobGas" in h:
+        kw["excess_blob_gas"] = _int(h["excessBlobGas"])
+    if "parentBeaconBlockRoot" in h:
+        kw["parent_beacon_block_root"] = _bytes(h["parentBeaconBlockRoot"])
+    if "requestsHash" in h:
+        kw["requests_hash"] = _bytes(h["requestsHash"])
+    return Header(**kw)
+
+
+def _parse_pre(pre: dict):
+    alloc: dict[bytes, Account] = {}
+    storage: dict[bytes, dict[bytes, int]] = {}
+    codes: dict[bytes, bytes] = {}
+    for addr_hex, acct in pre.items():
+        addr = _bytes(addr_hex)
+        code = _bytes(acct.get("code", "0x") or "0x")
+        code_hash = keccak256(code)
+        alloc[addr] = Account(
+            nonce=_int(acct.get("nonce", "0x0")),
+            balance=_int(acct.get("balance", "0x0")),
+            code_hash=code_hash,
+        )
+        if code:
+            codes[code_hash] = code
+        slots = {
+            _b32(k): _int(v)
+            for k, v in acct.get("storage", {}).items()
+            if _int(v) != 0
+        }
+        if slots:
+            storage[addr] = slots
+    return alloc, storage, codes
+
+
+def run_blockchain_test(name: str, case: dict, committer=None) -> None:
+    """Run one BlockchainTests case; raises ConformanceFailure on mismatch."""
+    if committer is None:
+        from ..primitives.keccak import keccak256_batch_np
+        from ..trie.committer import TrieCommitter
+
+        committer = TrieCommitter(hasher=keccak256_batch_np)
+    alloc, storage, codes = _parse_pre(case["pre"])
+    genesis = header_from_json(case["genesisBlockHeader"])
+    factory = ProviderFactory(MemDb())
+    try:
+        ghash = init_genesis(factory, genesis, alloc, storage, codes,
+                             committer=committer)
+    except GenesisMismatch as e:
+        raise ConformanceFailure(f"{name}: genesis init failed: {e}") from e
+    declared = case["genesisBlockHeader"].get("hash")
+    if declared and ghash != _bytes(declared):
+        raise ConformanceFailure(
+            f"{name}: genesis hash {ghash.hex()} != declared {declared}"
+        )
+
+    consensus = EthBeaconConsensus(committer)
+    pipeline = Pipeline(factory, default_stages(committer=committer))
+    for i, blk in enumerate(case.get("blocks", ())):
+        expect_fail = "expectException" in blk
+        try:
+            block = Block.decode(_bytes(blk["rlp"]))
+            import_chain(factory, [block], consensus)
+            pipeline.run(block.header.number)
+        except (ConsensusError, StageError, ValueError, KeyError, TypeError,
+                IndexError) as e:  # malformed RLP surfaces as Type/IndexError
+            if expect_fail:
+                continue
+            raise ConformanceFailure(f"{name}: block {i} rejected: {e}") from e
+        if expect_fail:
+            raise ConformanceFailure(
+                f"{name}: block {i} accepted but expected {blk['expectException']}"
+            )
+
+    with factory.provider() as p:
+        tip = p.last_block_number()
+        tip_hash = p.canonical_hash(tip)
+        if "lastblockhash" in case and tip_hash != _bytes(case["lastblockhash"]):
+            raise ConformanceFailure(
+                f"{name}: lastblockhash {tip_hash.hex()} != "
+                f"{case['lastblockhash']}"
+            )
+        for addr_hex, want in case.get("postState", {}).items():
+            addr = _bytes(addr_hex)
+            acct = p.account(addr)
+            if acct is None:
+                if _int(want.get("balance", "0x0")) or _int(want.get("nonce", "0x0")):
+                    raise ConformanceFailure(f"{name}: missing account {addr_hex}")
+                continue
+            if acct.balance != _int(want.get("balance", "0x0")):
+                raise ConformanceFailure(
+                    f"{name}: {addr_hex} balance {acct.balance} != "
+                    f"{_int(want.get('balance', '0x0'))}"
+                )
+            if acct.nonce != _int(want.get("nonce", "0x0")):
+                raise ConformanceFailure(f"{name}: {addr_hex} nonce mismatch")
+            code = _bytes(want.get("code", "0x") or "0x")
+            if keccak256(code) != acct.code_hash:
+                raise ConformanceFailure(f"{name}: {addr_hex} code mismatch")
+            for slot_hex, val in want.get("storage", {}).items():
+                got = p.storage(addr, _b32(slot_hex))
+                if got != _int(val):
+                    raise ConformanceFailure(
+                        f"{name}: {addr_hex} slot {slot_hex}: {got} != {_int(val)}"
+                    )
+
+
+def run_fixture_file(path: str, committer=None) -> list[str]:
+    """Run every case in a fixture file; returns the list of case names."""
+    with open(path) as f:
+        cases = json.load(f)
+    for name, case in cases.items():
+        run_blockchain_test(name, case, committer=committer)
+    return list(cases)

@@ -72,6 +72,23 @@ def validate_header_against_parent(header: Header, parent: Header) -> None:
         raise ConsensusError("ommers not allowed post-merge")
     if len(header.extra_data) > MAX_EXTRA_DATA:
         raise ConsensusError("extra data too long")
+    # EIP-4844 blob gas accounting (Cancun). Activation is parent-driven:
+    # once the chain carries blob fields they can never be dropped — a
+    # child that omits them must be rejected, or a peer could sidestep the
+    # whole blob fee market with a field-less header.
+    if parent.excess_blob_gas is not None or header.excess_blob_gas is not None:
+        from ..evm.executor import MAX_BLOB_GAS_PER_BLOCK, next_excess_blob_gas
+
+        if header.excess_blob_gas is None or header.blob_gas_used is None:
+            raise ConsensusError("missing blob gas fields post-Cancun")
+        want = next_excess_blob_gas(parent.excess_blob_gas or 0,
+                                    parent.blob_gas_used or 0)
+        if header.excess_blob_gas != want:
+            raise ConsensusError(
+                f"excess blob gas {header.excess_blob_gas} != expected {want}"
+            )
+        if header.blob_gas_used > MAX_BLOB_GAS_PER_BLOCK:
+            raise ConsensusError("blob gas used above block maximum")
 
 
 def validate_block_pre_execution(block: Block, committer=None) -> None:
@@ -80,6 +97,14 @@ def validate_block_pre_execution(block: Block, committer=None) -> None:
     tx_encodings = [tx.encode() for tx in block.transactions]
     if ordered_trie_root(tx_encodings, committer) != header.transactions_root:
         raise ConsensusError("transactions root mismatch")
+    total_blob_gas = sum(tx.blob_gas() for tx in block.transactions)
+    if header.blob_gas_used is not None:
+        if total_blob_gas != header.blob_gas_used:
+            raise ConsensusError(
+                f"blob gas used {total_blob_gas} != header {header.blob_gas_used}"
+            )
+    elif total_blob_gas:
+        raise ConsensusError("blob transactions in a block without blob fields")
     if block.withdrawals is not None:
         want = ordered_trie_root(
             [rlp_encode(w.rlp_fields()) for w in block.withdrawals], committer

@@ -12,7 +12,17 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..primitives.keccak import keccak256
-from ..primitives.types import Account, Block, Log, Receipt, Transaction
+from ..primitives.types import (
+    Account,
+    Block,
+    DELEGATION_PREFIX,
+    EIP4844_TX_TYPE,
+    EIP7702_TX_TYPE,
+    GAS_PER_BLOB,
+    Log,
+    Receipt,
+    Transaction,
+)
 from .interpreter import (
     BlockEnv,
     CallFrame,
@@ -29,9 +39,42 @@ from .interpreter import (
     Revert,
     TxEnv,
 )
-from .state import BlockChanges, EvmState, StateSource
+from .state import BlockChanges, EvmState, StateSource, resolve_delegation
 
 MAX_REFUND_QUOTIENT = 5  # EIP-3529
+
+# EIP-4844 blob fee market (Cancun parameters)
+MIN_BLOB_BASE_FEE = 1
+BLOB_BASE_FEE_UPDATE_FRACTION = 3_338_477
+TARGET_BLOB_GAS_PER_BLOCK = 3 * GAS_PER_BLOB
+MAX_BLOB_GAS_PER_BLOCK = 6 * GAS_PER_BLOB
+
+# EIP-7702
+PER_EMPTY_ACCOUNT_COST = 25_000
+PER_AUTH_BASE_COST = 12_500
+SECP256K1_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+def fake_exponential(factor: int, numerator: int, denominator: int) -> int:
+    """EIP-4844 blob base fee approximation of factor * e^(num/denom)."""
+    i = 1
+    output = 0
+    acc = factor * denominator
+    while acc > 0:
+        output += acc
+        acc = acc * numerator // (denominator * i)
+        i += 1
+    return output // denominator
+
+
+def blob_base_fee(excess_blob_gas: int) -> int:
+    return fake_exponential(MIN_BLOB_BASE_FEE, excess_blob_gas,
+                            BLOB_BASE_FEE_UPDATE_FRACTION)
+
+
+def next_excess_blob_gas(parent_excess: int, parent_blob_gas_used: int) -> int:
+    total = parent_excess + parent_blob_gas_used
+    return max(0, total - TARGET_BLOB_GAS_PER_BLOCK)
 
 
 class InvalidTransaction(Exception):
@@ -74,6 +117,7 @@ def intrinsic_gas(tx: Transaction) -> int:
         gas += G_INITCODE_WORD * ((len(tx.data) + 31) // 32)  # EIP-3860
     for _addr, slots in tx.access_list:
         gas += G_ACCESS_LIST_ADDR + G_ACCESS_LIST_SLOT * len(slots)
+    gas += PER_EMPTY_ACCOUNT_COST * len(tx.authorization_list)  # EIP-7702
     return gas
 
 
@@ -98,6 +142,7 @@ class BlockExecutor:
             prev_randao=header.mix_hash,
             chain_id=self.config.chain_id,
             block_hashes=block_hashes or {},
+            blob_base_fee=blob_base_fee(header.excess_blob_gas or 0),
         )
         state = EvmState(self.source)
         out = BlockExecutionOutput()
@@ -140,10 +185,28 @@ class BlockExecutor:
             raise InvalidTransaction("max fee below base fee")
         if tx.tx_type < 2 and gas_price < base_fee:  # legacy + EIP-2930
             raise InvalidTransaction("gas price below base fee")
+        blob_fee = 0
+        if tx.tx_type == EIP4844_TX_TYPE:
+            # EIP-4844: blob txs must target a contract and carry blobs
+            if tx.to is None:
+                raise InvalidTransaction("blob tx cannot create")
+            if not tx.blob_versioned_hashes:
+                raise InvalidTransaction("blob tx without blobs")
+            if any(len(h) != 32 or h[0] != 0x01 for h in tx.blob_versioned_hashes):
+                raise InvalidTransaction("malformed blob versioned hash")
+            if tx.max_fee_per_blob_gas < env.blob_base_fee:
+                raise InvalidTransaction("max blob fee below blob base fee")
+            blob_fee = tx.blob_gas() * env.blob_base_fee
+        if tx.tx_type == EIP7702_TX_TYPE:
+            if tx.to is None:
+                raise InvalidTransaction("set-code tx cannot create")
+            if not tx.authorization_list:
+                raise InvalidTransaction("set-code tx without authorizations")
         acct = state.account_or_empty(sender)
         if acct.nonce != tx.nonce:
             raise InvalidTransaction(f"nonce mismatch: acct {acct.nonce} vs tx {tx.nonce}")
         max_cost = tx.gas_limit * (tx.max_fee_per_gas if tx.tx_type >= 2 else tx.gas_price)
+        max_cost += tx.blob_gas() * tx.max_fee_per_blob_gas
         if acct.balance < max_cost + tx.value:
             raise InvalidTransaction("insufficient funds")
         ig = intrinsic_gas(tx)
@@ -155,10 +218,14 @@ class BlockExecutor:
         # -- setup
         state.begin_tx()
         state.delete_empty_touched()
-        interp = Interpreter(state, env, TxEnv(origin=sender, gas_price=gas_price),
-                             tracer=tracer)
-        # buy gas
-        state.sub_balance(sender, tx.gas_limit * gas_price)
+        interp = Interpreter(
+            state, env,
+            TxEnv(origin=sender, gas_price=gas_price,
+                  blob_hashes=tuple(tx.blob_versioned_hashes)),
+            tracer=tracer,
+        )
+        # buy gas (+ the blob fee, burned — EIP-4844)
+        state.sub_balance(sender, tx.gas_limit * gas_price + blob_fee)
         state.bump_nonce(sender)
         # warm: sender, coinbase (EIP-3651), target, precompiles (EIP-2929
         # initialises accessed_addresses with them), access list
@@ -172,6 +239,8 @@ class BlockExecutor:
             state.warm_account(addr)
             for s in slots:
                 state.warm_slot(addr, s)
+        if tx.tx_type == EIP7702_TX_TYPE:
+            self._apply_authorizations(state, env, tx)
 
         gas = tx.gas_limit - ig
         success, output = True, b""
@@ -181,17 +250,32 @@ class BlockExecutor:
             )
             success = ok
         else:
-            frame = CallFrame(
-                caller=sender, address=tx.to, code=state.code(tx.to),
-                data=tx.data, value=tx.value, gas=gas,
-            )
-            try:
-                ok, gas_left, output = interp.call(frame)
-                success = ok
-            except Revert as r:
-                success, gas_left, output = False, getattr(r, "gas_left", 0), r.output
-            except Halt:
-                success, gas_left, output = False, 0, b""
+            # EIP-7702: execute the delegate's code in tx.to's context,
+            # charging the delegate's account-access cost; running short of
+            # gas here is an IN-BLOCK out-of-gas failure, never a tx-
+            # validity error (state mutations above must stand)
+            code, target = resolve_delegation(state, tx.to)
+            oog = False
+            if target is not None:
+                from .interpreter import G_COLD_ACCOUNT, G_WARM_ACCESS
+
+                cost = G_WARM_ACCESS if state.warm_account(target) else G_COLD_ACCOUNT
+                if gas < cost:
+                    success, gas_left, output, oog = False, 0, b"", True
+                else:
+                    gas -= cost
+            if not oog:
+                frame = CallFrame(
+                    caller=sender, address=tx.to, code=code,
+                    data=tx.data, value=tx.value, gas=gas,
+                )
+                try:
+                    ok, gas_left, output = interp.call(frame)
+                    success = ok
+                except Revert as r:
+                    success, gas_left, output = False, getattr(r, "gas_left", 0), r.output
+                except Halt:
+                    success, gas_left, output = False, 0, b""
 
         gas_used = tx.gas_limit - gas_left
         if success:
@@ -212,6 +296,39 @@ class BlockExecutor:
             success=success,
             output=output,
         )
+
+
+    def _apply_authorizations(self, state: EvmState, env: BlockEnv, tx: Transaction):
+        """EIP-7702 set-code processing: each valid authorization installs a
+        delegation designator (0xef0100 ++ address) as the authority's code.
+        Invalid tuples are SKIPPED, never fatal (per spec)."""
+        for auth in tx.authorization_list:
+            if len(auth.address) != 20:
+                continue
+            if auth.chain_id not in (0, env.chain_id):
+                continue
+            if auth.nonce >= 2**64 - 1:
+                continue
+            if auth.s > SECP256K1_N // 2 or auth.y_parity not in (0, 1):
+                continue
+            try:
+                authority = auth.recover_authority()
+            except ValueError:
+                continue
+            state.warm_account(authority)
+            code = state.code(authority)
+            if code and not (code[:3] == DELEGATION_PREFIX and len(code) == 23):
+                continue  # real contract code cannot be overridden
+            if state.nonce(authority) != auth.nonce:
+                continue
+            if state.exists(authority) and not state.is_empty(authority):
+                state.add_refund(PER_EMPTY_ACCOUNT_COST - PER_AUTH_BASE_COST)
+            state._capture_account_change(authority)
+            if auth.address == b"\x00" * 20:
+                state.set_code(authority, b"")  # clear the delegation
+            else:
+                state.set_code(authority, DELEGATION_PREFIX + auth.address)
+            state.set_nonce(authority, auth.nonce + 1)
 
 
 class ProviderStateSource(StateSource):

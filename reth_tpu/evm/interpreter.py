@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 
 from ..primitives.keccak import keccak256
 from ..primitives.rlp import rlp_encode, encode_int
-from .state import EvmState
+from .state import EvmState, resolve_delegation
 
 U256 = 1 << 256
 MASK = U256 - 1
@@ -589,6 +589,11 @@ class Interpreter:
                     if op == 0xF1 and not state.exists(addr):
                         extra += G_NEW_ACCOUNT
                 use(extra)
+                # EIP-7702: a delegation designator executes the delegate's
+                # code (one level, with the delegate's access cost charged)
+                run_code, tgt = resolve_delegation(state, addr)
+                if tgt is not None:
+                    use(G_WARM_ACCESS if state.warm_account(tgt) else G_COLD_ACCOUNT)
                 data = mem_read(ain, ains)
                 mem_expand(aout, aouts)
                 avail = gas - gas // 64
@@ -597,18 +602,18 @@ class Interpreter:
                 if value:
                     child_gas += G_CALL_STIPEND
                 if op == 0xF1:  # CALL
-                    sub = CallFrame(fr.address, addr, state.code(addr), data, value,
+                    sub = CallFrame(fr.address, addr, run_code, data, value,
                                     child_gas, fr.static, fr.depth + 1, kind="CALL")
                 elif op == 0xF2:  # CALLCODE
-                    sub = CallFrame(fr.address, fr.address, state.code(addr), data,
+                    sub = CallFrame(fr.address, fr.address, run_code, data,
                                     value, child_gas, fr.static, fr.depth + 1,
                                     kind="CALLCODE")
                 elif op == 0xF4:  # DELEGATECALL: parent's value/caller, NO transfer
-                    sub = CallFrame(fr.caller, fr.address, state.code(addr), data,
+                    sub = CallFrame(fr.caller, fr.address, run_code, data,
                                     fr.value, child_gas, fr.static, fr.depth + 1,
                                     transfer_value=False, kind="DELEGATECALL")
                 else:  # STATICCALL
-                    sub = CallFrame(fr.address, addr, state.code(addr), data, 0,
+                    sub = CallFrame(fr.address, addr, run_code, data, 0,
                                     child_gas, True, fr.depth + 1, kind="STATICCALL")
                 try:
                     ok, gas_left, out = self.call(sub)
@@ -735,12 +740,148 @@ def _pre_modexp(data: bytes, gas: int):
     return True, gas - cost, out
 
 
+def _bn_g1_point(data: bytes):
+    """64-byte (x, y) -> validated bn254 G1 point; raises on bad input."""
+    from ..primitives.pairing import BN254, g1_group
+
+    x = int.from_bytes(data[:32], "big")
+    y = int.from_bytes(data[32:64], "big")
+    if x == 0 and y == 0:
+        return None
+    if x >= BN254.p or y >= BN254.p or not g1_group(BN254).on_curve((x, y)):
+        raise ValueError("invalid bn254 G1 point")
+    return (x, y)
+
+
+def _pre_bn_add(data: bytes, gas: int):
+    """0x06 alt_bn128 ADD (EIP-196; 150 gas since EIP-1108)."""
+    if gas < 150:
+        return False, 0, b""
+    gas -= 150
+    from ..primitives.pairing import BN254, g1_group
+
+    data = data.ljust(128, b"\x00")[:128]
+    try:
+        a = _bn_g1_point(data[0:64])
+        b = _bn_g1_point(data[64:128])
+    except ValueError:
+        return False, 0, b""
+    s = g1_group(BN254).padd(a, b)
+    if s is None:
+        return True, gas, b"\x00" * 64
+    return True, gas, s[0].to_bytes(32, "big") + s[1].to_bytes(32, "big")
+
+
+def _pre_bn_mul(data: bytes, gas: int):
+    """0x07 alt_bn128 MUL (EIP-196; 6000 gas since EIP-1108)."""
+    if gas < 6000:
+        return False, 0, b""
+    gas -= 6000
+    from ..primitives.pairing import BN254, g1_group
+
+    data = data.ljust(96, b"\x00")[:96]
+    try:
+        a = _bn_g1_point(data[0:64])
+    except ValueError:
+        return False, 0, b""
+    k = int.from_bytes(data[64:96], "big")
+    s = g1_group(BN254).mul_scalar(a, k) if a is not None else None
+    if s is None:
+        return True, gas, b"\x00" * 64
+    return True, gas, s[0].to_bytes(32, "big") + s[1].to_bytes(32, "big")
+
+
+def _pre_bn_pairing(data: bytes, gas: int):
+    """0x08 alt_bn128 pairing check (EIP-197; EIP-1108 gas). G2 Fp2
+    coordinates arrive imaginary-part first: [x_c1, x_c0, y_c1, y_c0]."""
+    if len(data) % 192 != 0:
+        return False, 0, b""
+    k = len(data) // 192
+    cost = 45000 + 34000 * k
+    if gas < cost:
+        return False, 0, b""
+    gas -= cost
+    from ..primitives.pairing import BN254, g2_group, g2_valid, pairing_product_is_one
+
+    pairs = []
+    for i in range(k):
+        chunk = data[i * 192 : (i + 1) * 192]
+        try:
+            p1 = _bn_g1_point(chunk[0:64])
+        except ValueError:
+            return False, 0, b""
+        x = (int.from_bytes(chunk[96:128], "big"), int.from_bytes(chunk[64:96], "big"))
+        y = (int.from_bytes(chunk[160:192], "big"), int.from_bytes(chunk[128:160], "big"))
+        q2 = None if x == (0, 0) and y == (0, 0) else (x, y)
+        if q2 is not None and not g2_valid(q2, BN254):
+            return False, 0, b""
+        if p1 is not None and q2 is not None:
+            pairs.append((p1, q2))
+    ok = pairing_product_is_one(pairs, BN254) if pairs else True
+    return True, gas, (1 if ok else 0).to_bytes(32, "big")
+
+
+def _pre_blake2f(data: bytes, gas: int):
+    """0x09 blake2b F compression (EIP-152)."""
+    if len(data) != 213:
+        return False, 0, b""
+    rounds = int.from_bytes(data[0:4], "big")
+    final = data[212]
+    if final not in (0, 1):
+        return False, 0, b""
+    if gas < rounds:
+        return False, 0, b""
+    from ..primitives.blake2 import blake2f
+
+    h = [int.from_bytes(data[4 + 8 * i : 12 + 8 * i], "little") for i in range(8)]
+    m = [int.from_bytes(data[68 + 8 * i : 76 + 8 * i], "little") for i in range(16)]
+    t0 = int.from_bytes(data[196:204], "little")
+    t1 = int.from_bytes(data[204:212], "little")
+    out = blake2f(rounds, h, m, t0, t1, final == 1)
+    return True, gas - rounds, b"".join(v.to_bytes(8, "little") for v in out)
+
+
+def _pre_point_eval(data: bytes, gas: int):
+    """0x0a KZG point evaluation (EIP-4844): verify p(z) == y against a
+    versioned-hash-bound commitment."""
+    if gas < 50000:
+        return False, 0, b""
+    gas -= 50000
+    if len(data) != 192:
+        return False, 0, b""
+    from ..primitives import kzg
+
+    versioned_hash = data[0:32]
+    z = int.from_bytes(data[32:64], "big")
+    y = int.from_bytes(data[64:96], "big")
+    commitment_b = data[96:144]
+    proof_b = data[144:192]
+    if z >= kzg.BLS_MODULUS or y >= kzg.BLS_MODULUS:
+        return False, 0, b""
+    if kzg.kzg_to_versioned_hash(commitment_b) != versioned_hash:
+        return False, 0, b""
+    try:
+        commitment = kzg.g1_from_bytes(commitment_b)
+        proof = kzg.g1_from_bytes(proof_b)
+    except kzg.KzgError:
+        return False, 0, b""
+    if not kzg.verify_kzg_proof(commitment, z, y, proof):
+        return False, 0, b""
+    out = kzg.FIELD_ELEMENTS_PER_BLOB.to_bytes(32, "big") + kzg.BLS_MODULUS.to_bytes(32, "big")
+    return True, gas, out
+
+
 _PRECOMPILES = {
     1: _pre_ecrecover,
     2: _pre_sha256,
     3: _pre_ripemd160,
     4: _pre_identity,
     5: _pre_modexp,
+    6: _pre_bn_add,
+    7: _pre_bn_mul,
+    8: _pre_bn_pairing,
+    9: _pre_blake2f,
+    10: _pre_point_eval,
 }
 
 

@@ -13,7 +13,21 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..primitives.keccak import keccak256
-from ..primitives.types import Account, KECCAK_EMPTY, Log
+from ..primitives.types import Account, DELEGATION_PREFIX, KECCAK_EMPTY, Log
+
+
+def resolve_delegation(state, address: bytes) -> tuple[bytes, bytes | None]:
+    """Code to EXECUTE for a call to ``address`` (EIP-7702, one level).
+
+    Returns (code, delegate) — ``delegate`` is the designated target whose
+    account-access cost the caller must charge, or None when the account's
+    code is not a delegation designator. EXTCODE* opcodes must NOT use
+    this: they observe the designator itself."""
+    code = state.code(address)
+    if code[:3] == DELEGATION_PREFIX and len(code) == 23:
+        target = code[3:]
+        return state.code(target), target
+    return code, None
 
 
 class StateSource:

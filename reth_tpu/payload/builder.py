@@ -43,10 +43,18 @@ def build_payload(
     attrs: PayloadAttributes,
 ) -> Block:
     """Assemble a sealed block on top of ``parent_hash``."""
+    from ..evm.executor import MAX_BLOB_GAS_PER_BLOCK, blob_base_fee, next_excess_blob_gas
+
     overlay = tree.overlay_provider(parent_hash)
     parent_num = overlay.block_number(parent_hash)
     parent = overlay.header_by_number(parent_num)
     base_fee = calc_next_base_fee(parent)
+    # EIP-4844: blob fields continue once the parent carries them
+    cancun = parent.excess_blob_gas is not None
+    excess_blob = (
+        next_excess_blob_gas(parent.excess_blob_gas, parent.blob_gas_used or 0)
+        if cancun else 0
+    )
     env = BlockEnv(
         number=parent.number + 1,
         timestamp=attrs.timestamp,
@@ -55,14 +63,20 @@ def build_payload(
         base_fee=base_fee,
         prev_randao=attrs.prev_randao,
         chain_id=tree.config.chain_id,
+        blob_base_fee=blob_base_fee(excess_blob),
     )
     executor = BlockExecutor(ProviderStateSource(overlay), tree.config)
     state = EvmState(executor.source)
     selected: list[Transaction] = []
     receipts: list[Receipt] = []
     cumulative_gas = 0
+    blob_gas_used = 0
     for tx in pool.best_transactions(base_fee):
         if cumulative_gas + tx.gas_limit > env.gas_limit:
+            continue
+        if tx.blob_gas() and (
+            not cancun or blob_gas_used + tx.blob_gas() > MAX_BLOB_GAS_PER_BLOCK
+        ):
             continue
         try:
             sender = tx.recover_sender()
@@ -72,6 +86,7 @@ def build_payload(
         except (InvalidTransaction, ValueError):
             continue  # skip; pool maintenance will evict later
         cumulative_gas += result.gas_used
+        blob_gas_used += tx.blob_gas()
         selected.append(tx)
         receipts.append(Receipt(
             tx_type=tx.tx_type, success=result.success,
@@ -106,8 +121,8 @@ def build_payload(
         withdrawals_root=ordered_trie_root(
             [rlp_encode(w.rlp_fields()) for w in attrs.withdrawals], tree.committer
         ),
-        blob_gas_used=None,
-        excess_blob_gas=None,
+        blob_gas_used=blob_gas_used if cancun else None,
+        excess_blob_gas=excess_blob if cancun else None,
         parent_beacon_block_root=attrs.parent_beacon_block_root,
     )
     return Block(header, tuple(selected), (), tuple(attrs.withdrawals))

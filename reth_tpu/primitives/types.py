@@ -175,12 +175,51 @@ class Header:
 
 
 LEGACY_TX_TYPE = 0
+EIP2930_TX_TYPE = 1
 EIP1559_TX_TYPE = 2
+EIP4844_TX_TYPE = 3
+EIP7702_TX_TYPE = 4
+
+SETCODE_MAGIC = b"\x05"              # EIP-7702 authorization signing domain
+DELEGATION_PREFIX = b"\xef\x01\x00"  # EIP-7702 delegation designator
+
+
+@dataclass(frozen=True)
+class Authorization:
+    """EIP-7702 set-code authorization tuple (signed by the authority)."""
+
+    chain_id: int
+    address: bytes
+    nonce: int
+    y_parity: int = 0
+    r: int = 0
+    s: int = 0
+
+    def signing_hash(self) -> bytes:
+        return keccak256(SETCODE_MAGIC + rlp_encode([
+            encode_int(self.chain_id), self.address, encode_int(self.nonce),
+        ]))
+
+    def recover_authority(self) -> bytes:
+        from .secp256k1 import ecrecover
+        return ecrecover(self.signing_hash(), self.y_parity, self.r, self.s)
+
+    def rlp_fields(self) -> list:
+        return [encode_int(self.chain_id), self.address, encode_int(self.nonce),
+                encode_int(self.y_parity), encode_int(self.r), encode_int(self.s)]
+
+    @classmethod
+    def from_fields(cls, f) -> "Authorization":
+        if len(f[1]) != 20:
+            raise ValueError("authorization address must be 20 bytes")
+        return cls(chain_id=decode_int(f[0]), address=f[1], nonce=decode_int(f[2]),
+                   y_parity=decode_int(f[3]), r=decode_int(f[4]), s=decode_int(f[5]))
 
 
 @dataclass(frozen=True)
 class Transaction:
-    """Signed transaction: legacy (type 0) or EIP-1559 (type 2).
+    """Signed transaction envelope: legacy (0), EIP-2930 (1), EIP-1559 (2),
+    EIP-4844 blob (3), EIP-7702 set-code (4).
 
     Reference: alloy-consensus `TxEnvelope`; reth recovers senders in
     `SenderRecoveryStage` (crates/stages/stages/src/stages/sender_recovery.rs).
@@ -189,7 +228,7 @@ class Transaction:
     tx_type: int = LEGACY_TX_TYPE
     chain_id: int | None = None
     nonce: int = 0
-    gas_price: int = 0                # legacy; for 1559 use max_fee fields
+    gas_price: int = 0                # legacy/2930; for 1559+ use max_fee fields
     max_priority_fee_per_gas: int = 0
     max_fee_per_gas: int = 0
     gas_limit: int = 21_000
@@ -197,6 +236,9 @@ class Transaction:
     value: int = 0
     data: bytes = b""
     access_list: tuple = ()            # ((address, (slot32, ...)), ...)
+    max_fee_per_blob_gas: int = 0      # type 3
+    blob_versioned_hashes: tuple = ()  # type 3
+    authorization_list: tuple = ()     # type 4: (Authorization, ...)
     # signature
     y_parity: int = 0
     r: int = 0
@@ -208,6 +250,31 @@ class Transaction:
     def _access_list_fields(self) -> list:
         return [[addr, list(slots)] for addr, slots in self.access_list]
 
+    def _auth_fields(self) -> list:
+        return [a.rlp_fields() for a in self.authorization_list]
+
+    def _typed_payload_fields(self) -> list:
+        """Unsigned field list for typed txs (1/2/3/4)."""
+        if self.tx_type == EIP2930_TX_TYPE:
+            return [
+                encode_int(self.chain_id or 0), encode_int(self.nonce),
+                encode_int(self.gas_price), encode_int(self.gas_limit),
+                self._to_field(), encode_int(self.value), self.data,
+                self._access_list_fields(),
+            ]
+        fields = [
+            encode_int(self.chain_id or 0), encode_int(self.nonce),
+            encode_int(self.max_priority_fee_per_gas), encode_int(self.max_fee_per_gas),
+            encode_int(self.gas_limit), self._to_field(),
+            encode_int(self.value), self.data, self._access_list_fields(),
+        ]
+        if self.tx_type == EIP4844_TX_TYPE:
+            fields += [encode_int(self.max_fee_per_blob_gas),
+                       list(self.blob_versioned_hashes)]
+        elif self.tx_type == EIP7702_TX_TYPE:
+            fields += [self._auth_fields()]
+        return fields
+
     def signing_hash(self) -> bytes:
         if self.tx_type == LEGACY_TX_TYPE:
             fields = [
@@ -218,14 +285,10 @@ class Transaction:
             if self.chain_id is not None:  # EIP-155
                 fields += [encode_int(self.chain_id), b"", b""]
             return keccak256(rlp_encode(fields))
-        if self.tx_type == EIP1559_TX_TYPE:
-            fields = [
-                encode_int(self.chain_id or 0), encode_int(self.nonce),
-                encode_int(self.max_priority_fee_per_gas), encode_int(self.max_fee_per_gas),
-                encode_int(self.gas_limit), self._to_field(),
-                encode_int(self.value), self.data, self._access_list_fields(),
-            ]
-            return keccak256(b"\x02" + rlp_encode(fields))
+        if self.tx_type in (EIP2930_TX_TYPE, EIP1559_TX_TYPE, EIP4844_TX_TYPE,
+                            EIP7702_TX_TYPE):
+            return keccak256(bytes([self.tx_type])
+                             + rlp_encode(self._typed_payload_fields()))
         raise ValueError(f"unsupported tx type {self.tx_type}")
 
     def encode(self) -> bytes:
@@ -241,28 +304,53 @@ class Transaction:
                 encode_int(self.value), self.data,
                 encode_int(v), encode_int(self.r), encode_int(self.s),
             ])
-        if self.tx_type == EIP1559_TX_TYPE:
-            return b"\x02" + rlp_encode([
-                encode_int(self.chain_id or 0), encode_int(self.nonce),
-                encode_int(self.max_priority_fee_per_gas), encode_int(self.max_fee_per_gas),
-                encode_int(self.gas_limit), self._to_field(),
-                encode_int(self.value), self.data, self._access_list_fields(),
+        if self.tx_type in (EIP2930_TX_TYPE, EIP1559_TX_TYPE, EIP4844_TX_TYPE,
+                            EIP7702_TX_TYPE):
+            fields = self._typed_payload_fields() + [
                 encode_int(self.y_parity), encode_int(self.r), encode_int(self.s),
-            ])
+            ]
+            return bytes([self.tx_type]) + rlp_encode(fields)
         raise ValueError(f"unsupported tx type {self.tx_type}")
 
     @classmethod
     def decode(cls, data: bytes) -> "Transaction":
         data = bytes(data)
-        if data and data[0] == EIP1559_TX_TYPE:
+        if data and data[0] == EIP2930_TX_TYPE:
             f = rlp_decode(data[1:])
-            al = tuple((a, tuple(slots)) for a, slots in f[8])
             return cls(
-                tx_type=EIP1559_TX_TYPE, chain_id=decode_int(f[0]),
+                tx_type=EIP2930_TX_TYPE, chain_id=decode_int(f[0]),
+                nonce=decode_int(f[1]), gas_price=decode_int(f[2]),
+                gas_limit=decode_int(f[3]), to=f[4] or None,
+                value=decode_int(f[5]), data=f[6],
+                access_list=tuple((a, tuple(slots)) for a, slots in f[7]),
+                y_parity=decode_int(f[8]), r=decode_int(f[9]), s=decode_int(f[10]),
+            )
+        if data and data[0] in (EIP1559_TX_TYPE, EIP4844_TX_TYPE, EIP7702_TX_TYPE):
+            tx_type = data[0]
+            f = rlp_decode(data[1:])
+            kw = dict(
+                tx_type=tx_type, chain_id=decode_int(f[0]),
                 nonce=decode_int(f[1]), max_priority_fee_per_gas=decode_int(f[2]),
                 max_fee_per_gas=decode_int(f[3]), gas_limit=decode_int(f[4]),
-                to=f[5] or None, value=decode_int(f[6]), data=f[7], access_list=al,
-                y_parity=decode_int(f[9]), r=decode_int(f[10]), s=decode_int(f[11]),
+                to=f[5] or None, value=decode_int(f[6]), data=f[7],
+                access_list=tuple((a, tuple(slots)) for a, slots in f[8]),
+            )
+            i = 9
+            if tx_type == EIP4844_TX_TYPE:
+                kw["max_fee_per_blob_gas"] = decode_int(f[9])
+                hashes = tuple(f[10])
+                if any(len(h) != 32 for h in hashes):
+                    raise ValueError("blob versioned hash must be 32 bytes")
+                kw["blob_versioned_hashes"] = hashes
+                i = 11
+            elif tx_type == EIP7702_TX_TYPE:
+                kw["authorization_list"] = tuple(
+                    Authorization.from_fields(a) for a in f[9]
+                )
+                i = 10
+            return cls(
+                y_parity=decode_int(f[i]), r=decode_int(f[i + 1]),
+                s=decode_int(f[i + 2]), **kw,
             )
         f = rlp_decode(data)
         v = decode_int(f[6])
@@ -285,15 +373,21 @@ class Transaction:
         return keccak256(self.encode())
 
     def effective_gas_price(self, base_fee: int | None) -> int:
-        if self.tx_type == LEGACY_TX_TYPE:
+        if self.tx_type in (LEGACY_TX_TYPE, EIP2930_TX_TYPE):
             return self.gas_price
         if base_fee is None:
             return self.max_fee_per_gas
         return min(self.max_fee_per_gas, base_fee + self.max_priority_fee_per_gas)
 
+    def blob_gas(self) -> int:
+        return GAS_PER_BLOB * len(self.blob_versioned_hashes)
+
     def recover_sender(self) -> bytes:
         from .secp256k1 import ecrecover
         return ecrecover(self.signing_hash(), self.y_parity, self.r, self.s)
+
+
+GAS_PER_BLOB = 1 << 17  # EIP-4844
 
 
 @dataclass(frozen=True)

@@ -41,36 +41,47 @@ class Wallet:
         return secp256k1.address_from_priv(self.priv)
 
     def transfer(self, to: bytes, value: int, chain_id: int = 1, **kw) -> Transaction:
-        tx = Transaction(
+        return self.sign_tx(Transaction(
             tx_type=2, chain_id=chain_id, nonce=self.nonce,
             max_fee_per_gas=kw.pop("max_fee_per_gas", 100 * 10**9),
             max_priority_fee_per_gas=kw.pop("max_priority_fee_per_gas", 10**9),
             gas_limit=kw.pop("gas_limit", 21_000), to=to, value=value, **kw,
-        )
-        p, r, s = secp256k1.sign(tx.signing_hash(), self.priv)
-        self.nonce += 1
-        return Transaction(**{**tx.__dict__, "y_parity": p, "r": r, "s": s})
+        ))
 
     def deploy(self, initcode: bytes, chain_id: int = 1, gas_limit: int = 1_000_000) -> Transaction:
-        tx = Transaction(
+        return self.sign_tx(Transaction(
             tx_type=2, chain_id=chain_id, nonce=self.nonce,
             max_fee_per_gas=100 * 10**9, max_priority_fee_per_gas=10**9,
             gas_limit=gas_limit, to=None, data=initcode,
-        )
-        p, r, s = secp256k1.sign(tx.signing_hash(), self.priv)
-        self.nonce += 1
-        return Transaction(**{**tx.__dict__, "y_parity": p, "r": r, "s": s})
+        ))
 
     def call(self, to: bytes, data: bytes, chain_id: int = 1, gas_limit: int = 200_000,
              value: int = 0) -> Transaction:
-        tx = Transaction(
+        return self.sign_tx(Transaction(
             tx_type=2, chain_id=chain_id, nonce=self.nonce,
             max_fee_per_gas=100 * 10**9, max_priority_fee_per_gas=10**9,
             gas_limit=gas_limit, to=to, value=value, data=data,
-        )
+        ))
+
+    def sign_tx(self, tx: Transaction, bump_nonce: bool = True) -> Transaction:
+        """Sign an arbitrary unsigned tx (any envelope type) with this key."""
         p, r, s = secp256k1.sign(tx.signing_hash(), self.priv)
-        self.nonce += 1
+        if bump_nonce:
+            self.nonce += 1
         return Transaction(**{**tx.__dict__, "y_parity": p, "r": r, "s": s})
+
+    def authorize(self, delegate: bytes, nonce: int, chain_id: int = 1):
+        """Sign an EIP-7702 authorization delegating this account's code.
+
+        ``nonce`` is explicit on purpose: the authority's ACCOUNT nonce at
+        authorization-processing time must match, and when the authority
+        also sends the tx its nonce is bumped before processing — a default
+        would silently sign stale tuples."""
+        from .primitives.types import Authorization
+
+        auth = Authorization(chain_id=chain_id, address=delegate, nonce=nonce)
+        p, r, s = secp256k1.sign(auth.signing_hash(), self.priv)
+        return Authorization(**{**auth.__dict__, "y_parity": p, "r": r, "s": s})
 
 
 class ChainBuilder:
@@ -84,8 +95,10 @@ class ChainBuilder:
         chain_id: int = 1,
         committer: TrieCommitter | None = None,
         genesis_gas_limit: int = 30_000_000,
+        cancun: bool = False,
     ):
         self.chain_id = chain_id
+        self.cancun = cancun  # blob-gas header fields (EIP-4844)
         self.committer = committer or TrieCommitter()
         self.accounts: dict[bytes, Account] = dict(genesis_alloc or {})
         self.storages: dict[bytes, dict[bytes, int]] = {
@@ -104,6 +117,8 @@ class ChainBuilder:
             timestamp=0,
             base_fee_per_gas=10**9,
             withdrawals_root=EMPTY_ROOT_HASH,
+            blob_gas_used=0 if cancun else None,
+            excess_blob_gas=0 if cancun else None,
         )
         self.blocks: list[Block] = [Block(self.genesis, (), (), ())]
         self.block_hashes: dict[int, bytes] = {0: self.genesis.hash}
@@ -124,6 +139,16 @@ class ChainBuilder:
     ) -> Block:
         parent = self.tip
         base_fee = calc_next_base_fee(parent)
+        blob_kw = {}
+        if self.cancun:
+            from .evm.executor import next_excess_blob_gas
+
+            blob_kw = dict(
+                blob_gas_used=sum(tx.blob_gas() for tx in txs),
+                excess_blob_gas=next_excess_blob_gas(
+                    parent.excess_blob_gas or 0, parent.blob_gas_used or 0
+                ),
+            )
         draft = Header(
             parent_hash=parent.hash,
             beneficiary=coinbase,
@@ -131,6 +156,7 @@ class ChainBuilder:
             gas_limit=parent.gas_limit,
             timestamp=timestamp if timestamp is not None else parent.timestamp + 12,
             base_fee_per_gas=base_fee,
+            **blob_kw,
         )
         block = Block(draft, tuple(txs), (), tuple(withdrawals))
         executor = BlockExecutor(self.state_source(), EvmConfig(chain_id=self.chain_id))

@@ -1,0 +1,89 @@
+"""Conformance suite: 110 generated BlockchainTests cases through the
+runner (full pipeline replay: decode RLP -> execute -> rebuild roots).
+
+Reference analogue: testing/ef-tests/tests/tests.rs per-suite macros.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from reth_tpu.conformance import ConformanceFailure, run_blockchain_test
+from reth_tpu.conformance.generate import SCENARIOS, builder_to_fixture, generate_suite
+from reth_tpu.conformance.runner import run_fixture_file
+
+_PER_SCENARIO = 10
+
+
+@pytest.fixture(scope="module")
+def suite():
+    return generate_suite(_PER_SCENARIO)
+
+
+def test_suite_size(suite):
+    assert len(suite) >= 100
+
+
+@pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+def test_scenario_cases_pass(suite, scenario):
+    ran = 0
+    for name, case in suite.items():
+        if name.startswith(f"{scenario}_"):
+            run_blockchain_test(name, case)
+            ran += 1
+    assert ran == _PER_SCENARIO
+
+
+def test_corrupted_post_state_fails(suite):
+    case = json.loads(json.dumps(suite["transfers_0"]))  # deep copy
+    addr = next(iter(case["postState"]))
+    case["postState"][addr]["balance"] = "0xdeadbeef"
+    with pytest.raises(ConformanceFailure, match="balance"):
+        run_blockchain_test("corrupted", case)
+
+
+def test_corrupted_block_rlp_fails(suite):
+    case = json.loads(json.dumps(suite["storage_0"]))
+    blk = bytearray(bytes.fromhex(case["blocks"][0]["rlp"][2:]))
+    blk[-1] ^= 0xFF  # flip a byte in the last tx
+    case["blocks"][0]["rlp"] = "0x" + blk.hex()
+    with pytest.raises(ConformanceFailure):
+        run_blockchain_test("corrupted-rlp", case)
+
+
+def test_expect_exception_honored(suite):
+    """A block marked expectException must be rejected, and acceptance is a
+    failure: reuse a valid block at the wrong height."""
+    case = json.loads(json.dumps(suite["transfers_0"]))
+    good = case["blocks"][0]
+    # re-importing the same height must be rejected -> expectException OK
+    case["blocks"] = [good, {**good, "expectException": "InvalidBlock"}]
+    run_blockchain_test("expect-exc", case)
+
+    case2 = json.loads(json.dumps(suite["transfers_0"]))
+    case2["blocks"] = [{**case2["blocks"][0], "expectException": "InvalidBlock"}]
+    del case2["postState"]
+    with pytest.raises(ConformanceFailure, match="accepted"):
+        run_blockchain_test("expect-exc-bad", case2)
+
+
+def test_fixture_file_roundtrip(tmp_path, suite):
+    path = tmp_path / "suite.json"
+    small = {k: suite[k] for k in list(suite)[:3]}
+    path.write_text(json.dumps(small))
+    assert len(run_fixture_file(str(path))) == 3
+
+
+def test_fixture_shape_is_ef_compatible(suite):
+    """The JSON shape matches what the official corpus uses, so real
+    ethereum/tests fixtures drop into the same runner."""
+    case = suite["storage_0"]
+    assert {"pre", "genesisBlockHeader", "blocks", "postState",
+            "lastblockhash", "network"} <= set(case)
+    gh = case["genesisBlockHeader"]
+    for k in ("parentHash", "stateRoot", "transactionsTrie", "receiptTrie",
+              "bloom", "gasLimit", "coinbase", "baseFeePerGas"):
+        assert k in gh
+    assert all("rlp" in b for b in case["blocks"])

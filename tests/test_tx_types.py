@@ -1,0 +1,255 @@
+"""Tx envelope types 1 (EIP-2930), 3 (EIP-4844), 4 (EIP-7702): codec
+round-trips, sender recovery, and executor semantics (blob fee market,
+authorization processing, delegated execution).
+
+Reference analogue: alloy-consensus TxEnvelope variants + revm's Cancun/
+Prague tx handling, exercised in the reference via ef-tests.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from reth_tpu.evm import BlockExecutor, EvmConfig
+from reth_tpu.evm.executor import (
+    InMemoryStateSource,
+    InvalidTransaction,
+    blob_base_fee,
+    next_excess_blob_gas,
+)
+from reth_tpu.primitives.types import (
+    Account,
+    Block,
+    DELEGATION_PREFIX,
+    GAS_PER_BLOB,
+    Header,
+    Transaction,
+)
+from reth_tpu.testing import Wallet
+
+CHAIN_ID = 1
+
+
+def make_block(txs, excess_blob_gas=0):
+    return Block(
+        header=Header(number=1, gas_limit=30_000_000, base_fee_per_gas=7,
+                      timestamp=1000, excess_blob_gas=excess_blob_gas,
+                      blob_gas_used=sum(tx.blob_gas() for tx in txs)),
+        transactions=tuple(txs),
+    )
+
+
+@pytest.fixture
+def alice():
+    return Wallet(0xA11CE)
+
+
+@pytest.fixture
+def src(alice):
+    return InMemoryStateSource({alice.address: Account(balance=10**21)})
+
+
+# -- codecs ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tx", [
+    Transaction(tx_type=1, chain_id=1, nonce=3, gas_price=10**9, gas_limit=50_000,
+                to=b"\x11" * 20, value=5,
+                access_list=((b"\x22" * 20, (b"\x01" * 32, b"\x02" * 32)),),
+                y_parity=1, r=123, s=456),
+    Transaction(tx_type=3, chain_id=1, nonce=0, max_fee_per_gas=10**10,
+                max_priority_fee_per_gas=10**9, gas_limit=100_000,
+                to=b"\x33" * 20, max_fee_per_blob_gas=7,
+                blob_versioned_hashes=(b"\x01" + b"\xaa" * 31,),
+                y_parity=0, r=9, s=8),
+], ids=["eip2930", "eip4844"])
+def test_typed_tx_roundtrip(tx):
+    assert Transaction.decode(tx.encode()) == tx
+    assert tx.encode()[0] == tx.tx_type
+
+
+def test_eip7702_roundtrip(alice):
+    auth = alice.authorize(b"\x44" * 20, nonce=9)
+    tx = Transaction(tx_type=4, chain_id=1, nonce=0, max_fee_per_gas=10**10,
+                     gas_limit=100_000, to=b"\x55" * 20,
+                     authorization_list=(auth,), y_parity=1, r=1, s=2)
+    assert Transaction.decode(tx.encode()) == tx
+    assert auth.recover_authority() == alice.address
+
+
+def test_typed_sender_recovery(alice):
+    tx = alice.sign_tx(Transaction(
+        tx_type=1, chain_id=CHAIN_ID, nonce=0, gas_price=10**9,
+        gas_limit=30_000, to=b"\x66" * 20, value=1,
+        access_list=((b"\x66" * 20, ()),),
+    ))
+    assert tx.recover_sender() == alice.address
+
+
+# -- type 1 execution --------------------------------------------------------
+
+
+def test_eip2930_executes_and_prewarms(alice, src):
+    bob = b"\x77" * 20
+    tx = alice.sign_tx(Transaction(
+        tx_type=1, chain_id=CHAIN_ID, nonce=0, gas_price=10**9,
+        gas_limit=50_000, to=bob, value=1234,
+        access_list=((bob, (b"\x00" * 32,)),),
+    ))
+    out = BlockExecutor(src).execute(make_block([tx]))
+    assert out.receipts[0].success
+    assert out.receipts[0].tx_type == 1
+    assert out.post_accounts[bob].balance == 1234
+    # intrinsic: 21000 + 2400 (addr) + 1900 (slot)
+    assert out.gas_used == 21_000 + 2400 + 1900
+
+
+# -- type 3 (blob) execution --------------------------------------------------
+
+
+def _blob_tx(alice, n_blobs=1, max_blob_fee=100, nonce=0, version=0x01):
+    return alice.sign_tx(Transaction(
+        tx_type=3, chain_id=CHAIN_ID, nonce=nonce, max_fee_per_gas=10**9,
+        max_priority_fee_per_gas=1, gas_limit=21_000, to=b"\x88" * 20,
+        value=0, max_fee_per_blob_gas=max_blob_fee,
+        blob_versioned_hashes=tuple(
+            bytes([version]) + bytes([i]) * 31 for i in range(n_blobs)
+        ),
+    ), bump_nonce=False)
+
+
+def test_blob_tx_burns_blob_fee(alice, src):
+    tx = _blob_tx(alice, n_blobs=2)
+    start = src.accounts[alice.address].balance
+    out = BlockExecutor(src).execute(make_block([tx]))
+    assert out.receipts[0].success
+    sender_after = out.post_accounts[alice.address]
+    fee = blob_base_fee(0)  # excess 0 -> 1 wei/blob-gas
+    exec_cost = out.gas_used * tx.effective_gas_price(7)
+    assert start - sender_after.balance == exec_cost + 2 * GAS_PER_BLOB * fee
+
+
+def test_blob_tx_validation_errors(alice, src):
+    with pytest.raises(InvalidTransaction, match="without blobs"):
+        BlockExecutor(src).execute(make_block([
+            alice.sign_tx(Transaction(tx_type=3, chain_id=CHAIN_ID, nonce=0,
+                                      max_fee_per_gas=10**9, gas_limit=21_000,
+                                      to=b"\x88" * 20), bump_nonce=False)]))
+    with pytest.raises(InvalidTransaction, match="version"):
+        BlockExecutor(src).execute(make_block([_blob_tx(alice, version=0x02)]))
+    with pytest.raises(InvalidTransaction, match="cannot create"):
+        bad = alice.sign_tx(Transaction(
+            tx_type=3, chain_id=CHAIN_ID, nonce=0, max_fee_per_gas=10**9,
+            gas_limit=60_000, to=None, max_fee_per_blob_gas=100,
+            blob_versioned_hashes=(b"\x01" + b"\x00" * 31,),
+        ), bump_nonce=False)
+        BlockExecutor(src).execute(make_block([bad]))
+
+
+def test_blob_fee_market_math():
+    assert blob_base_fee(0) == 1
+    assert next_excess_blob_gas(0, 6 * GAS_PER_BLOB) == 3 * GAS_PER_BLOB
+    assert next_excess_blob_gas(0, 2 * GAS_PER_BLOB) == 0
+    # monotone growth
+    assert blob_base_fee(10 * 3 * GAS_PER_BLOB) > blob_base_fee(3 * GAS_PER_BLOB)
+
+
+def test_blob_tx_insufficient_blob_fee(alice, src):
+    # excess blob gas high enough that base fee > tx max
+    blk = make_block([_blob_tx(alice, max_blob_fee=1)],
+                     excess_blob_gas=40_000_000)
+    with pytest.raises(InvalidTransaction, match="blob base fee"):
+        BlockExecutor(src).execute(blk)
+
+
+# -- type 4 (set-code) execution ---------------------------------------------
+
+# runtime: sstore(0, 0x42) — proves the DELEGATE's code ran in authority ctx
+SSTORE42 = bytes.fromhex("60425f55" + "00")
+
+
+def test_setcode_tx_installs_delegation_and_executes(alice, src):
+    from reth_tpu.primitives.keccak import keccak256
+
+    delegate = b"\x99" * 20
+    src.accounts[delegate] = Account(code_hash=keccak256(SSTORE42))
+    src.codes[src.accounts[delegate].code_hash] = SSTORE42
+    bob = Wallet(0xB0B)
+    src.accounts[bob.address] = Account(balance=10**18)
+    auth = bob.authorize(delegate, nonce=0)
+    tx = alice.sign_tx(Transaction(
+        tx_type=4, chain_id=CHAIN_ID, nonce=0, max_fee_per_gas=10**9,
+        max_priority_fee_per_gas=1, gas_limit=200_000,
+        to=bob.address, authorization_list=(auth,),
+    ), bump_nonce=False)
+    out = BlockExecutor(src).execute(make_block([tx]))
+    assert out.receipts[0].success
+    # the authority's code is now the delegation designator
+    post_bob = out.post_accounts[bob.address]
+    assert post_bob.nonce == 1  # authorization bumped it
+    # and the delegate's code executed in bob's storage context
+    assert out.post_storage[bob.address][b"\x00" * 32] == 0x42
+
+
+def test_setcode_invalid_auths_are_skipped(alice, src):
+    bob = Wallet(0xB0B)
+    src.accounts[bob.address] = Account(balance=10**18, nonce=5)
+    wrong_nonce = bob.authorize(b"\x99" * 20, nonce=3)      # stale nonce
+    wrong_chain = bob.authorize(b"\x99" * 20, nonce=5, chain_id=999)
+    tx = alice.sign_tx(Transaction(
+        tx_type=4, chain_id=CHAIN_ID, nonce=0, max_fee_per_gas=10**9,
+        gas_limit=200_000, to=b"\x11" * 20,
+        authorization_list=(wrong_nonce, wrong_chain),
+    ), bump_nonce=False)
+    out = BlockExecutor(src).execute(make_block([tx]))
+    assert out.receipts[0].success
+    post_bob = out.post_accounts.get(bob.address)
+    # untouched: nonce unchanged, no delegation installed
+    assert post_bob is None or post_bob.nonce == 5
+
+
+def test_setcode_requires_auth_list(alice, src):
+    tx = alice.sign_tx(Transaction(
+        tx_type=4, chain_id=CHAIN_ID, nonce=0, max_fee_per_gas=10**9,
+        gas_limit=100_000, to=b"\x11" * 20,
+    ), bump_nonce=False)
+    with pytest.raises(InvalidTransaction, match="without authorizations"):
+        BlockExecutor(src).execute(make_block([tx]))
+
+
+def test_plain_transfer_to_delegated_account_oogs_in_block(alice, src):
+    """A 21000-gas transfer to a delegated EOA can't afford the delegate
+    access cost: that is an IN-BLOCK failed tx (gas consumed, nonce bumped,
+    block valid) — never a tx-validity error (review round-2 finding)."""
+    from reth_tpu.primitives.keccak import keccak256
+
+    carol = Wallet(0xCA01)
+    designator = DELEGATION_PREFIX + b"\x99" * 20
+    src.accounts[carol.address] = Account(balance=10**18,
+                                          code_hash=keccak256(designator))
+    src.codes[keccak256(designator)] = designator
+    tx = alice.transfer(carol.address, 5)  # gas_limit 21000
+    out = BlockExecutor(src).execute(make_block([tx]))
+    assert not out.receipts[0].success
+    assert out.gas_used == 21_000  # all gas consumed
+    assert out.post_accounts[alice.address].nonce == 1
+    # the transfer did not happen (carol untouched => absent from changes)
+    post_carol = out.post_accounts.get(carol.address)
+    assert post_carol is None or post_carol.balance == 10**18
+
+
+def test_call_into_delegated_account_runs_delegate_code(alice, src):
+    from reth_tpu.primitives.keccak import keccak256
+
+    delegate = b"\x99" * 20
+    src.accounts[delegate] = Account(code_hash=keccak256(SSTORE42))
+    src.codes[keccak256(SSTORE42)] = SSTORE42
+    carol = Wallet(0xCA01)
+    # pre-install the delegation designator as carol's code
+    designator = DELEGATION_PREFIX + delegate
+    src.accounts[carol.address] = Account(balance=10**18, code_hash=keccak256(designator))
+    src.codes[keccak256(designator)] = designator
+    tx = alice.call(carol.address, b"")
+    out = BlockExecutor(src).execute(make_block([tx]))
+    assert out.receipts[0].success
+    assert out.post_storage[carol.address][b"\x00" * 32] == 0x42
