@@ -312,12 +312,19 @@ struct Handle {
 
 extern "C" {
 
-// err: 0 ok, 1 unsorted/duplicate keys, 2 duplicate keys, 3 bad input
+// err: 0 ok, 1 unsorted keys, 2 duplicate keys, 3 bad input, 4 oversized
+// value. start_depth: build each job's trie from nibble `start_depth` of
+// its keys — the job's result is the SUBTRIE as it sits at that depth in
+// the enclosing trie (leaf/ext paths are position-relative, so keys
+// sharing a start_depth-nibble prefix yield exactly the embedded node).
+// Chunked MerkleStage rebuilds commit per-prefix account subtries this
+// way and stitch them as opaque boundaries (reth_tpu/stages/merkle.py).
 void* rtb_build(const uint8_t* keys, uint64_t n_keys, const uint64_t* job_off,
                 uint32_t n_jobs, const uint8_t* values, const uint64_t* val_off,
-                int collect_meta, int* err) {
+                int collect_meta, int start_depth, int* err) {
     *err = 0;
-    if (!keys || !job_off || !values || !val_off || n_jobs == 0) {
+    if (!keys || !job_off || !values || !val_off || n_jobs == 0 ||
+        start_depth < 0 || start_depth >= NIBS) {
         *err = 3;
         return nullptr;
     }
@@ -348,7 +355,7 @@ void* rtb_build(const uint8_t* keys, uint64_t n_keys, const uint64_t* job_off,
             h->root_inline.emplace_back();  // empty trie
             continue;
         }
-        Ref r = b.build(lo, hi, 0, 0);
+        Ref r = b.build(lo, hi, start_depth, 0);
         if (b.err) {
             *err = b.err;
             delete h;

@@ -3,19 +3,24 @@
 Reference analogue: `AccountHashingStage` (keccak256(address), rayon
 chunks + ETL — crates/stages/stages/src/stages/hashing_account.rs:37) and
 `StorageHashingStage` (hashing_storage.rs:133-137). TPU-first: the keccak
-work is ONE batched device dispatch per commit chunk instead of CPU worker
+work is a batched device dispatch per scan chunk instead of CPU worker
 chunks — this is benchmark config #3 (BASELINE.md).
 
-Clean path (first sync): scan the whole plain table, batch-hash every
-key. Incremental path: only keys in the range's changesets.
+Clean path (first sync): scan the plain table in bounded chunks, batch-
+hash each, collect through the ETL external-sort collector (reth_tpu/etl
+— memory stays bounded for >RAM inputs) and bulk-load the hashed table
+in sorted order. Incremental path: only keys in the range's changesets.
 """
 
 from __future__ import annotations
 
+from ..etl import Collector
 from ..storage.provider import DatabaseProvider
 from ..storage.tables import Tables, decode_account, decode_storage_entry
 from ..trie.committer import TrieCommitter
 from .api import ExecInput, ExecOutput, Stage, UnwindInput
+
+_SCAN_CHUNK = 200_000  # keys hashed per device dispatch during clean scans
 
 
 class AccountHashingStage(Stage):
@@ -27,12 +32,25 @@ class AccountHashingStage(Stage):
 
     def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
         if inp.checkpoint == 0 or inp.target - inp.checkpoint > self.clean_threshold:
-            # clean rebuild: hash every plain account key in one batch
+            # clean rebuild: chunked scan -> batch hash -> ETL -> sorted load
             provider.tx.clear(Tables.HashedAccounts.name)
-            entries = list(provider.tx.cursor(Tables.PlainAccountState.name).walk())
-            hashed = self.hasher([k for k, _ in entries])
-            for (addr, value), haddr in zip(entries, hashed):
-                provider.tx.put(Tables.HashedAccounts.name, haddr, value)
+            with Collector() as col:
+                batch: list[tuple[bytes, bytes]] = []
+
+                def flush():
+                    hashed = self.hasher([k for k, _ in batch])
+                    for (_, value), haddr in zip(batch, hashed):
+                        col.insert(haddr, value)
+                    batch.clear()
+
+                for entry in provider.tx.cursor(Tables.PlainAccountState.name).walk():
+                    batch.append(entry)
+                    if len(batch) >= _SCAN_CHUNK:
+                        flush()
+                if batch:
+                    flush()
+                for haddr, value in col:
+                    provider.tx.put(Tables.HashedAccounts.name, haddr, value)
         else:
             changed = provider.account_changes_in_range(inp.next_block, inp.target)
             addrs = sorted(changed.keys())
@@ -62,14 +80,29 @@ class StorageHashingStage(Stage):
     def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
         if inp.checkpoint == 0 or inp.target - inp.checkpoint > self.clean_threshold:
             provider.tx.clear(Tables.HashedStorages.name)
-            jobs: list[tuple[bytes, bytes, int]] = []  # (addr, slot, value)
-            for addr, dup in provider.tx.cursor(Tables.PlainStorageState.name).walk():
-                slot, value = decode_storage_entry(dup)
-                jobs.append((addr, slot, value))
-            digests = self.hasher([a for a, _, _ in jobs] + [s for _, s, _ in jobs])
-            n = len(jobs)
-            for (addr, slot, value), haddr, hslot in zip(jobs, digests[:n], digests[n:]):
-                provider.put_hashed_storage(haddr, hslot, value)
+            with Collector() as col:
+                batch: list[tuple[bytes, bytes, int]] = []  # (addr, slot, value)
+
+                def flush():
+                    n = len(batch)
+                    digests = self.hasher(
+                        [a for a, _, _ in batch] + [s for _, s, _ in batch]
+                    )
+                    for (_, _, value), haddr, hslot in zip(batch, digests[:n], digests[n:]):
+                        col.insert(haddr + hslot, value.to_bytes(32, "big"))
+                    batch.clear()
+
+                for addr, dup in provider.tx.cursor(Tables.PlainStorageState.name).walk():
+                    slot, value = decode_storage_entry(dup)
+                    batch.append((addr, slot, value))
+                    if len(batch) >= _SCAN_CHUNK:
+                        flush()
+                if batch:
+                    flush()
+                for key, value32 in col:
+                    provider.put_hashed_storage(
+                        key[:32], key[32:], int.from_bytes(value32, "big")
+                    )
         else:
             changed = provider.storage_changes_in_range(inp.next_block, inp.target)
             self._apply_changed(provider, changed, use_prev_images=False)

@@ -71,6 +71,7 @@ class _Node:
     ref: bytes = b""                # resolved RLP-encoded reference
     node_hash: bytes = b""          # keccak of rlp, when hashed
     slot: int = 0                   # fused path: digest-buffer slot (0 = not hashed)
+    opaque_branch: bool = True      # OPAQUE: subtree contains stored branches
 
 
 @dataclass(frozen=True)
@@ -144,7 +145,10 @@ class TrieCommitter:
         ``leaves``: (full nibble path, RLP-encoded value) pairs, need not be
         sorted; empty values are disallowed (deletion = omit the leaf).
         ``boundaries``: path → 32-byte subtree hash for unchanged subtrees
-        (the node at ``path`` is referenced, not rebuilt). No leaf path may
+        (the node at ``path`` is referenced, not rebuilt), or
+        (hash, has_branch) to state whether the subtree contains stored
+        branch nodes (drives the parent's ``tree_mask``; bare hashes are
+        conservatively treated as branch-containing). No leaf path may
         pass through a boundary path.
         """
         return self.commit_many([(leaves, boundaries)], collect_branches)[0]
@@ -168,9 +172,9 @@ class TrieCommitter:
         roots_idx: list[int] = []
         results = [TrieBuildResult(root=EMPTY_ROOT_HASH) for _ in jobs]
         for leaves, boundaries in jobs:
-            items: list[tuple[Nibbles, int, bytes]] = [(p, LEAF, v) for p, v in leaves]
+            items: list[tuple[Nibbles, int, object]] = [(p, LEAF, v) for p, v in leaves]
             for p, h in (boundaries or {}).items():
-                items.append((p, OPAQUE, h))
+                items.append((p, OPAQUE, h if isinstance(h, tuple) else (h, True)))
             items.sort(key=lambda t: t[0])
             for i in range(1, len(items)):
                 a, b = items[i - 1][0], items[i][0]
@@ -216,7 +220,8 @@ class TrieCommitter:
                 arena.append(_Node(LEAF, at, ext_path=path[depth:], value=payload))
                 return len(arena) - 1
             if len(path) == depth:
-                arena.append(_Node(OPAQUE, at, ref=encode_hash_ref(payload)))
+                arena.append(_Node(OPAQUE, at, ref=encode_hash_ref(payload[0]),
+                                   opaque_branch=payload[1]))
                 return len(arena) - 1
             # A lone opaque subtree strictly below this point means the
             # surrounding structure collapsed into it — its node kind is
@@ -420,11 +425,13 @@ class TrieCommitter:
     # -- TrieUpdates --------------------------------------------------------
 
     def _collect_branches(self, arena: list[_Node], result: TrieBuildResult) -> None:
-        # tree_mask: child subtree contains stored (branch) nodes or is opaque
+        # tree_mask: child subtree contains stored (branch) nodes
         def subtree_has_branch(idx: int) -> bool:
             node = arena[idx]
-            if node.kind == BRANCH or node.kind == OPAQUE:
+            if node.kind == BRANCH:
                 return True
+            if node.kind == OPAQUE:
+                return node.opaque_branch
             if node.kind == EXT:
                 return subtree_has_branch(node.child)
             return False

@@ -65,7 +65,7 @@ def load_library() -> ctypes.CDLL:
         lib = ctypes.CDLL(str(_SO))
         lib.rtb_build.restype = ctypes.c_void_p
         lib.rtb_build.argtypes = [_u8p, ctypes.c_uint64, _u64p, ctypes.c_uint32,
-                                  _u8p, _u64p, ctypes.c_int, _i32p]
+                                  _u8p, _u64p, ctypes.c_int, ctypes.c_int, _i32p]
         lib.rtb_free.argtypes = [ctypes.c_void_p]
         for name, res in [("rtb_num_levels", ctypes.c_int32),
                           ("rtb_max_slot", ctypes.c_int32)]:
@@ -241,12 +241,16 @@ class TurboCommitter:
         self,
         jobs: list[tuple[np.ndarray, list[bytes]]],
         collect_branches: bool = False,
+        start_depth: int = 0,
     ) -> list[TrieBuildResult]:
         """Commit many independent secure tries with shared level batching.
 
         ``jobs``: (keys (n, 32) uint8 — need not be sorted, values aligned
-        RLP-encoded bytes) per trie. Returns one TrieBuildResult per job
-        (root + optional BranchNode TrieUpdates)."""
+        RLP-encoded bytes) per trie. ``start_depth`` builds each job as the
+        SUBTRIE below that nibble depth (keys must share the prefix); the
+        root is then the embedded subtree node's hash — the chunked-rebuild
+        boundary stitch uses this. Returns one TrieBuildResult per job
+        (root + optional BranchNode TrieUpdates, paths subtrie-relative)."""
         lib = self._lib
         n_jobs = len(jobs)
         key_arrays, val_chunks, job_off = [], [], [0]
@@ -275,17 +279,18 @@ class TurboCommitter:
             _ptr(np.ascontiguousarray(all_keys), _u8p), len(all_keys),
             _ptr(job_off_np, _u64p), n_jobs,
             _ptr(vals_np, _u8p), _ptr(val_off, _u64p),
-            1 if collect_branches else 0, ctypes.byref(err),
+            1 if collect_branches else 0, start_depth, ctypes.byref(err),
         )
         if not h:
-            raise ValueError(f"triebuild failed (err={err.value}: "
-                             f"{'unsorted' if err.value == 1 else 'duplicate keys' if err.value == 2 else 'bad input'})")
+            reason = {1: "unsorted", 2: "duplicate keys", 3: "bad input",
+                      4: "oversized leaf value"}.get(err.value, "unknown")
+            raise ValueError(f"triebuild failed (err={err.value}: {reason})")
         try:
-            return self._run(lib, h, n_jobs, key_arrays, collect_branches)
+            return self._run(lib, h, n_jobs, key_arrays, collect_branches, start_depth)
         finally:
             lib.rtb_free(h)
 
-    def _run(self, lib, h, n_jobs, key_arrays, collect_branches):
+    def _run(self, lib, h, n_jobs, key_arrays, collect_branches, start_depth=0):
         backend = self._make_backend()
         max_slot = lib.rtb_max_slot(h)
         backend.begin(max_slot)
@@ -330,10 +335,12 @@ class TurboCommitter:
             results[-1].hashed_nodes = total_hashed
         if collect_branches and meta_rec is not None and len(meta_rec):
             job_starts = np.cumsum([0] + [len(k) for k in key_arrays])
-            self._collect_meta(meta_rec, key_arrays, job_starts, digests, results)
+            self._collect_meta(meta_rec, key_arrays, job_starts, digests, results,
+                               start_depth)
         return results
 
-    def _collect_meta(self, meta_rec, key_arrays, job_starts, digests, results):
+    def _collect_meta(self, meta_rec, key_arrays, job_starts, digests, results,
+                      start_depth=0):
         jobs_f = meta_rec[:, 0:4].copy().view("<u4").ravel()
         reps = meta_rec[:, 4:8].copy().view("<u4").ravel()
         depths = meta_rec[:, 8:10].copy().view("<u2").ravel()
@@ -349,7 +356,9 @@ class TurboCommitter:
             nibs = np.empty((64,), dtype=np.uint8)
             nibs[0::2] = key >> 4
             nibs[1::2] = key & 0xF
-            path = bytes(nibs[:d])
+            # BranchMeta depths are SUBTRIE-relative; the stored path must
+            # skip the start_depth prefix nibbles of the full key
+            path = bytes(nibs[start_depth : start_depth + d])
             hm = int(hmasks[k])
             hashes = tuple(
                 digests[cslots[k, nb]].tobytes() for nb in range(16) if (hm >> nb) & 1

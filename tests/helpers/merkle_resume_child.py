@@ -1,0 +1,78 @@
+"""Child process for the kill -9 Merkle-resume test.
+
+Modes:
+  init     — build a deterministic chain into a durable native-KV datadir
+             and run the pre-Merkle stages.
+  rebuild  — run the chunked MerkleStage to completion (tiny chunks;
+             MERKLE_CHILD_SLOW=1 sleeps per chunk so the parent can land
+             a SIGKILL mid-rebuild). Prints RESUMED_FROM_PROGRESS when a
+             prior run's progress blob was found, REBUILD_OK on success.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from reth_tpu.primitives.keccak import keccak256_batch_np  # noqa: E402
+from reth_tpu.primitives.types import Account  # noqa: E402
+from reth_tpu.stages import default_stages  # noqa: E402
+from reth_tpu.stages.api import ExecInput, Pipeline  # noqa: E402
+from reth_tpu.stages.merkle import MerkleStage  # noqa: E402
+from reth_tpu.storage.genesis import import_chain, init_genesis  # noqa: E402
+from reth_tpu.storage.native import NativeDb  # noqa: E402
+from reth_tpu.storage.provider import ProviderFactory  # noqa: E402
+from reth_tpu.testing import ChainBuilder, Wallet  # noqa: E402
+from reth_tpu.trie.committer import TrieCommitter  # noqa: E402
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+CPU.turbo_backend = "numpy"
+
+
+def build_chain():
+    a = Wallet(0xAAA1)
+    bld = ChainBuilder({a.address: Account(balance=10**21)}, committer=CPU)
+    for blk in range(3):
+        bld.build_block([
+            a.transfer(bytes([blk * 16 + i + 1] * 20), 10**10 + blk * 100 + i)
+            for i in range(12)
+        ])
+    return bld
+
+
+def main():
+    datadir, mode = sys.argv[1], sys.argv[2]
+    factory = ProviderFactory(NativeDb(datadir))
+    bld = build_chain()
+    if mode == "init":
+        init_genesis(factory, bld.genesis, dict(bld.accounts_at_genesis),
+                     committer=CPU)
+        import_chain(factory, bld.blocks[1:])
+        stages = default_stages(committer=CPU)
+        merkle_idx = next(
+            i for i, s in enumerate(stages) if isinstance(s, MerkleStage)
+        )
+        Pipeline(factory, stages[:merkle_idx]).run(bld.tip.number)
+        print("INIT_OK", flush=True)
+        return
+
+    with factory.provider() as p:
+        if p.stage_progress(MerkleStage.id) is not None:
+            print("RESUMED_FROM_PROGRESS", flush=True)
+    stage = MerkleStage(CPU, chunk_leaves=3)
+    target = bld.tip.number
+    slow = os.environ.get("MERKLE_CHILD_SLOW") == "1"
+    for _ in range(1000):
+        with factory.provider_rw() as p:
+            out = stage.execute(p, ExecInput(target, 0))
+        if out.done:
+            break
+        if slow:
+            time.sleep(0.5)
+    assert out.done, "rebuild did not finish"
+    print("REBUILD_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -132,6 +132,24 @@ def test_turbo_mesh_backend_parity(turbo_np, n_dev):
     assert got[0].root == want[0].root
 
 
+def test_turbo_start_depth_subtrie_parity(turbo_np):
+    """start_depth=2 must yield the embedded subtree: root AND branch-node
+    paths (subtrie-relative, skipping the prefix nibbles — review finding)
+    equal to the general committer over prefix-stripped paths."""
+    rng = np.random.default_rng(77)
+    keys = rng.integers(0, 256, size=(64, 32), dtype=np.uint8)
+    keys[:, 0] = 0x12  # shared 2-nibble prefix
+    values = [rlp_encode(bytes([i + 1])) for i in range(64)]
+    got = turbo_np.commit_hashed_many([(keys, values)], collect_branches=True,
+                                      start_depth=2)[0]
+    base = TrieCommitter(hasher=keccak256_batch_np)
+    leaves = [(unpack_nibbles(k.tobytes())[2:], v) for k, v in zip(keys, values)]
+    want = base.commit(leaves, collect_branches=True)
+    assert got.root == want.root
+    assert got.branch_nodes == want.branch_nodes
+    assert any(len(p) >= 1 for p in got.branch_nodes), "expected deep branches"
+
+
 def test_turbo_oversized_value_rejected(turbo_np):
     keys = np.arange(32, dtype=np.uint8).reshape(1, 32)
     with pytest.raises(ValueError, match="triebuild failed"):
