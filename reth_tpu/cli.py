@@ -173,8 +173,19 @@ def cmd_node(args):
                   file=sys.stderr)
             return 1
     cfg = NodeConfig(datadir=args.datadir, dev=args.dev,
-                     http_port=args.http_port, authrpc_port=args.authrpc_port, **kw)
+                     http_port=args.http_port, authrpc_port=args.authrpc_port,
+                     p2p_port=args.port if not args.disable_p2p else None,
+                     p2p_host=args.addr,
+                     discovery=not args.no_discovery,
+                     bootnodes=tuple(args.bootnodes.split(",")) if args.bootnodes else (),
+                     **kw)
     node = Node(cfg, committer=committer)
+    p2p_port = node.start_network()
+    if p2p_port is not None:
+        print(f"P2P listening on {node.network.host}:{p2p_port} "
+              f"({node.network.enode})")
+        if node.discovery is not None:
+            print(f"discv4 on udp/{node.discovery.port}")
     http_port, auth_port = node.start_rpc()
     print(f"RPC listening on 127.0.0.1:{http_port}, engine API on 127.0.0.1:{auth_port}")
     if args.dev and args.block_time > 0:
@@ -286,6 +297,12 @@ def main(argv=None) -> int:
     p.add_argument("--block-time", type=int, default=2)
     p.add_argument("--http-port", type=int, default=8545)
     p.add_argument("--authrpc-port", type=int, default=8551)
+    p.add_argument("--port", type=int, default=30303, help="RLPx TCP port")
+    p.add_argument("--addr", default="127.0.0.1",
+                   help="P2P bind/advertise address (0.0.0.0 for all)")
+    p.add_argument("--disable-p2p", action="store_true")
+    p.add_argument("--no-discovery", action="store_true")
+    p.add_argument("--bootnodes", default="", help="comma-separated enode urls")
     add_hasher(p)
     p.set_defaults(fn=cmd_node)
 

@@ -1,8 +1,9 @@
-"""Peer sessions: framed TCP transport + status handshake + requests.
+"""Peer sessions: RLPx-encrypted transport + status handshake + requests.
 
 Reference analogue: crates/net/network session machinery
-(src/session/mod.rs) and the p2p client traits
-(crates/net/p2p: HeadersClient/BodiesClient). Request/response
+(src/session/mod.rs) over crates/net/ecies + eth-wire: every session runs
+the ECIES auth/ack handshake, the p2p Hello (snappy from v5), then the
+eth/68 Status exchange before any request traffic. Request/response
 correlation uses eth/66-style request ids.
 """
 
@@ -10,11 +11,15 @@ from __future__ import annotations
 
 import itertools
 import socket
-import struct
 import threading
 
-from . import wire
-from .wire import MessageId, Status, decode_message, encode_message
+from ..primitives.secp256k1 import random_priv as random_node_key
+from . import rlpx, wire
+from .rlpx import BASE_PROTOCOL_OFFSET, DISCONNECT_ID, PING_ID, PONG_ID, RlpxSession
+from .wire import Status
+
+CLIENT_ID = "reth-tpu/0.2"
+ETH_CAPS = [("eth", 68)]
 
 
 class PeerError(Exception):
@@ -22,10 +27,10 @@ class PeerError(Exception):
 
 
 class PeerConnection:
-    """One established peer session over a socket."""
+    """One established encrypted peer session (RLPx + Hello + Status)."""
 
-    def __init__(self, sock: socket.socket, status: Status):
-        self.sock = sock
+    def __init__(self, session: RlpxSession, status: Status):
+        self.session = session
         self.status = status  # the REMOTE peer's status
         self._req_ids = itertools.count(1)
         self._lock = threading.Lock()
@@ -34,54 +39,76 @@ class PeerConnection:
         self.gossip: list = []
         self.MAX_GOSSIP_BUFFER = 1024
 
-    # -- framing ---------------------------------------------------------------
+    @property
+    def node_id(self) -> bytes:
+        return self.session.remote_node_id
 
-    @staticmethod
-    def _recv_exact(sock, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
-                raise PeerError("peer disconnected")
-            buf += chunk
-        return buf
-
-    @classmethod
-    def recv_frame(cls, sock) -> bytes:
-        (length,) = struct.unpack("<I", cls._recv_exact(sock, 4))
-        if length > 64 * 1024 * 1024:
-            raise PeerError("oversized frame")
-        return cls._recv_exact(sock, length)
+    # -- message transport ------------------------------------------------------
 
     def send(self, msg) -> None:
-        data = encode_message(msg)
+        mid, payload = wire.encode_eth(msg)
         with self._lock:
-            self.sock.sendall(data)
+            self.session.send_msg(BASE_PROTOCOL_OFFSET + mid, payload)
 
     def recv(self):
-        return decode_message(self.recv_frame(self.sock))
+        """Next eth message; p2p pings are answered inline, disconnects
+        surface as PeerError."""
+        while True:
+            mid, body = self.session.recv_msg()
+            if mid >= BASE_PROTOCOL_OFFSET:
+                return wire.decode_eth(mid - BASE_PROTOCOL_OFFSET, body)
+            if mid == PING_ID:
+                with self._lock:
+                    self.session.send_msg(PONG_ID, b"\xc0")
+                continue
+            if mid == PONG_ID:
+                continue
+            if mid == DISCONNECT_ID:
+                raise PeerError("peer disconnected")
+            raise PeerError(f"unexpected p2p message {mid:#x}")
 
     # -- handshake -------------------------------------------------------------
 
     @classmethod
-    def connect(cls, host: str, port: int, our_status: Status,
-                timeout: float = 10.0) -> "PeerConnection":
-        sock = socket.create_connection((host, port), timeout=timeout)
-        sock.sendall(encode_message(our_status))
-        remote = decode_message(cls.recv_frame(sock))
-        if not isinstance(remote, Status):
+    def _finish_handshake(cls, session: RlpxSession, node_priv: int,
+                          our_status: Status) -> "PeerConnection":
+        session.hello(node_priv, CLIENT_ID, ETH_CAPS)
+        if not any(name == "eth" and v >= 68 for name, v in session.remote_hello["caps"]):
+            session.disconnect()
+            raise PeerError("peer lacks eth/68 capability")
+        mid, payload = wire.encode_eth(our_status)
+        session.send_msg(BASE_PROTOCOL_OFFSET + mid, payload)
+        rmid, rbody = session.recv_msg()
+        if rmid != BASE_PROTOCOL_OFFSET + wire.MessageId.STATUS:
+            session.disconnect()
             raise PeerError("expected status handshake")
-        _validate_status(our_status, remote)
-        return cls(sock, remote)
+        remote = wire.decode_eth(wire.MessageId.STATUS, rbody)
+        try:
+            _validate_status(our_status, remote)
+        except PeerError:
+            session.disconnect()
+            raise
+        return cls(session, remote)
 
     @classmethod
-    def accept(cls, sock: socket.socket, our_status: Status) -> "PeerConnection":
-        remote = decode_message(cls.recv_frame(sock))
-        if not isinstance(remote, Status):
-            raise PeerError("expected status handshake")
-        _validate_status(our_status, remote)
-        sock.sendall(encode_message(our_status))
-        return cls(sock, remote)
+    def connect(cls, host: str, port: int, our_status: Status,
+                remote_pub: tuple[int, int], node_priv: int | None = None,
+                timeout: float = 10.0) -> "PeerConnection":
+        """Dial a peer (its public key comes from discovery / the enode)."""
+        key = node_priv or random_node_key()
+        sock = socket.create_connection((host, port), timeout=timeout)
+        try:
+            session = rlpx.initiate(sock, key, remote_pub)
+            return cls._finish_handshake(session, key, our_status)
+        except Exception:
+            sock.close()
+            raise
+
+    @classmethod
+    def accept(cls, sock: socket.socket, our_status: Status,
+               node_priv: int) -> "PeerConnection":
+        session = rlpx.respond(sock, node_priv)
+        return cls._finish_handshake(session, node_priv, our_status)
 
     # -- typed requests (HeadersClient / BodiesClient analogues) ---------------
 
@@ -117,10 +144,7 @@ class PeerConnection:
         return self._await_response(wire.ReceiptsMsg, rid).receipts
 
     def close(self):
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        self.session.close()
 
 
 def _validate_status(ours: Status, theirs: Status) -> None:

@@ -11,26 +11,51 @@ from __future__ import annotations
 import socket
 import threading
 
+from ..primitives.secp256k1 import pubkey_from_bytes
 from . import wire
-from .p2p import PeerConnection, PeerError
+from .p2p import PeerConnection, PeerError, random_node_key
+from .rlpx import node_id as rlpx_node_id
 from .wire import Status
 
 MAX_HEADERS_SERVE = 1024
 MAX_BODIES_SERVE = 256
 
 
+def parse_enode(url: str) -> tuple[tuple[int, int], str, int]:
+    """enode://<128-hex node id>@host:port -> (pubkey, host, port)."""
+    if not url.startswith("enode://"):
+        raise ValueError("not an enode url")
+    ident, _, addr = url[8:].partition("@")
+    host, _, port = addr.partition(":")
+    return pubkey_from_bytes(bytes.fromhex(ident)), host, int(port or "30303")
+
+
 class NetworkManager:
     def __init__(self, factory, status: Status, pool=None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, node_priv: int | None = None):
         self.factory = factory
         self.status = status
         self.pool = pool
         self.host = host
         self.port = port
+        self.node_priv = node_priv or random_node_key()
         self.peers: list[PeerConnection] = []
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+
+    @property
+    def enode(self) -> str:
+        return (f"enode://{rlpx_node_id(self.node_priv).hex()}"
+                f"@{self.host}:{self.port}")
+
+    def connect_to(self, enode_url: str, timeout: float = 10.0) -> PeerConnection:
+        """Dial a peer by enode URL (encrypted RLPx session)."""
+        pub, host, port = parse_enode(enode_url)
+        peer = PeerConnection.connect(host, port, self.status, pub,
+                                      node_priv=self.node_priv, timeout=timeout)
+        self.peers.append(peer)
+        return peer
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -56,8 +81,10 @@ class NetworkManager:
             except OSError:
                 return
             try:
-                peer = PeerConnection.accept(sock, self.status)
-            except PeerError:
+                peer = PeerConnection.accept(sock, self.status, self.node_priv)
+            except Exception:  # noqa: BLE001 — handshake parses attacker-
+                # controlled bytes; ANY failure must drop the peer, never
+                # the accept loop (a dead listener = no inbound peers ever)
                 sock.close()
                 continue
             self.peers.append(peer)

@@ -272,3 +272,16 @@ def decode_message(frame: bytes):
     if cls is None:
         raise ValueError(f"unknown message id {mid:#x}")
     return cls.decode_payload(rlp_decode(frame[1:]))
+
+
+def encode_eth(msg) -> tuple[int, bytes]:
+    """(eth/68 message id, RLP payload) — the RLPx capability framing
+    (net/rlpx.py adds the base-protocol offset and snappy)."""
+    return _TO_ID[type(msg)], rlp_encode(msg.encode_payload())
+
+
+def decode_eth(mid: int, payload: bytes):
+    cls = _BY_ID.get(mid)
+    if cls is None:
+        raise ValueError(f"unknown eth message id {mid:#x}")
+    return cls.decode_payload(rlp_decode(payload))

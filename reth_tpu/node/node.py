@@ -41,6 +41,12 @@ class NodeConfig:
     # is this many blocks past it (None disables), and prune per modes
     static_file_distance: int | None = None
     prune_modes: object | None = None  # PruneModes | None
+    # devp2p: RLPx listener + discv4 discovery (None disables networking)
+    p2p_port: int | None = None       # 0 = ephemeral
+    p2p_host: str = "127.0.0.1"       # bind + advertised address
+    discovery: bool = True
+    node_key: int | None = None       # secp256k1 priv; random when unset
+    bootnodes: tuple[str, ...] = ()   # enode:// urls
 
 
 class Node:
@@ -129,6 +135,52 @@ class Node:
         self.authrpc.register(self.engine_api)
         self.authrpc.register(self.eth_api)  # CLs also query eth_ on authrpc
 
+        # devp2p: encrypted RLPx listener + discv4 (reference: network
+        # component wiring in the node builder, launch/engine.rs:145-156)
+        self.network = None
+        self.discovery = None
+        if config.p2p_port is not None:
+            from ..net.p2p import random_node_key
+            from ..net.server import NetworkManager
+            from ..net.wire import Status
+
+            key = config.node_key or random_node_key()
+            with self.factory.provider() as p:
+                tip_num = p.last_block_number()
+                status = Status(
+                    network_id=config.chain_id,
+                    head=p.canonical_hash(tip_num),
+                    genesis=p.canonical_hash(0),
+                )
+            self.network = NetworkManager(
+                self.factory, status, pool=self.pool, host=config.p2p_host,
+                port=config.p2p_port, node_priv=key,
+            )
+
+    def start_network(self) -> int | None:
+        """Start the RLPx listener (+ discv4 when enabled); returns the
+        TCP port, or None when networking is disabled."""
+        if self.network is None:
+            return None
+        port = self.network.start()
+        if self.config.discovery:
+            from ..net.discv4 import Discv4
+
+            self.discovery = Discv4(self.network.node_priv,
+                                    host=self.network.host, tcp_port=port)
+            self.discovery.start()
+            if self.config.bootnodes:
+                self.discovery.bootstrap(list(self.config.bootnodes))
+                self.discovery.lookup()
+        elif self.config.bootnodes:
+            # static peering: without discovery, dial the bootnodes directly
+            for url in self.config.bootnodes:
+                try:
+                    self.network.connect_to(url)
+                except Exception:  # noqa: BLE001 — best-effort static dial
+                    pass
+        return port
+
     def start_rpc(self) -> tuple[int, int]:
         """Start both HTTP servers; returns (http_port, authrpc_port)."""
         return self.rpc.start(), self.authrpc.start()
@@ -136,5 +188,9 @@ class Node:
     def stop(self):
         self.rpc.stop()
         self.authrpc.stop()
+        if self.discovery is not None:
+            self.discovery.stop()
+        if self.network is not None:
+            self.network.stop()
         if self.factory.db is not None and hasattr(self.factory.db, "flush"):
             self.factory.db.flush()

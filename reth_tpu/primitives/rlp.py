@@ -103,6 +103,14 @@ def _decode_list_payload(data: bytes, start: int, end: int) -> list:
     return out
 
 
+def rlp_decode_prefix(data: bytes) -> tuple[Item, int]:
+    """Decode the FIRST RLP item, tolerating trailing bytes; returns
+    (item, consumed). EIP-8 handshake payloads carry random padding after
+    the RLP body, which strict decoding rejects."""
+    item, end = _decode_at(bytes(data), 0)
+    return item, end
+
+
 def rlp_decode(data: bytes) -> Item:
     item, end = _decode_at(bytes(data), 0)
     if end != len(data):

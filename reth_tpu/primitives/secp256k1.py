@@ -86,6 +86,16 @@ def _to_affine(p: _JPoint) -> tuple[int, int]:
 _G: _JPoint = (GX, GY, 1)
 
 
+def random_priv() -> int:
+    """Uniform nonzero scalar (rejection sampling, no mod bias)."""
+    import os
+
+    while True:
+        k = int.from_bytes(os.urandom(32), "big")
+        if 1 <= k < N:
+            return k
+
+
 def pubkey_from_priv(priv: int) -> tuple[int, int]:
     return _to_affine(_jmul(_G, priv))
 
@@ -97,6 +107,31 @@ def address_from_pubkey(pub: tuple[int, int]) -> bytes:
 
 def address_from_priv(priv: int) -> bytes:
     return address_from_pubkey(pubkey_from_priv(priv))
+
+
+def pubkey_to_bytes(pub: tuple[int, int]) -> bytes:
+    """Uncompressed 64-byte X||Y (devp2p node-id / ECIES encoding)."""
+    return pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
+
+
+def pubkey_from_bytes(raw: bytes) -> tuple[int, int]:
+    """64-byte X||Y -> validated curve point."""
+    if len(raw) != 64:
+        raise ValueError("public key must be 64 bytes")
+    x = int.from_bytes(raw[:32], "big")
+    y = int.from_bytes(raw[32:], "big")
+    if not (0 < x < P and 0 < y < P) or (y * y - (x * x * x + 7)) % P != 0:
+        raise ValueError("point not on secp256k1")
+    return (x, y)
+
+
+def ecdh_x(priv: int, pub: tuple[int, int]) -> bytes:
+    """ECDH shared secret: x-coordinate of priv * pub (32 bytes big-endian).
+
+    The devp2p/ECIES convention (reference crates/net/ecies): only the x
+    coordinate feeds the KDF."""
+    x, _y = _to_affine(_jmul((pub[0], pub[1], 1), priv))
+    return x.to_bytes(32, "big")
 
 
 def _rfc6979_k(msg_hash: bytes, priv: int):
@@ -142,12 +177,14 @@ def sign(msg_hash: bytes, priv: int) -> tuple[int, int, int]:
 
 
 def ecrecover(msg_hash: bytes, y_parity: int, r: int, s: int,
-              allow_high_s: bool = False) -> bytes:
-    """Recover the signer's address from a signature.
+              allow_high_s: bool = False, return_pubkey: bool = False) -> bytes:
+    """Recover the signer's address (or 64-byte pubkey) from a signature.
 
     Raises ValueError on invalid signatures (reference rejects these during
     sender recovery and tx validation). ``allow_high_s`` relaxes the EIP-2
     low-s rule for the ecrecover PRECOMPILE, which accepts any s in range.
+    ``return_pubkey`` yields X||Y instead of the address (the RLPx
+    handshake recovers the peer's EPHEMERAL public key this way).
     """
     if not (1 <= r < N and 1 <= s < N):
         raise ValueError("signature out of range")
@@ -166,4 +203,6 @@ def ecrecover(msg_hash: bytes, y_parity: int, r: int, s: int,
     # Q = r^-1 (s*R - z*G)
     point = _jadd(_jmul((x, y, 1), s), _jmul(_G, (-z) % N))
     q = _to_affine(_jmul(point, r_inv))
+    if return_pubkey:
+        return q[0].to_bytes(32, "big") + q[1].to_bytes(32, "big")
     return address_from_pubkey(q)

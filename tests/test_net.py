@@ -9,6 +9,8 @@ import pytest
 
 from reth_tpu.consensus import EthBeaconConsensus
 from reth_tpu.net import NetworkManager, PeerConnection, Status, sync_from_peer
+from reth_tpu.net.rlpx import node_id
+from reth_tpu.primitives.secp256k1 import pubkey_from_priv
 from reth_tpu.net import wire
 from reth_tpu.net.p2p import PeerError
 from reth_tpu.primitives import Account
@@ -59,7 +61,7 @@ def testnet():
     """A serving node + a fresh node sharing genesis, over localhost TCP."""
     factory_a, builder = make_synced_node()
     status = Status(network_id=1, head=builder.tip.hash, genesis=builder.genesis.hash)
-    server = NetworkManager(factory_a, status)
+    server = NetworkManager(factory_a, status, node_priv=0xA11CE5)
     port = server.start()
 
     factory_b = ProviderFactory(MemDb())
@@ -70,7 +72,8 @@ def testnet():
 
 def test_handshake_and_header_requests(testnet):
     server, port, status, factory_b, builder = testnet
-    peer = PeerConnection.connect("127.0.0.1", port, status)
+    peer = PeerConnection.connect("127.0.0.1", port, status,
+                                  pubkey_from_priv(server.node_priv))
     assert peer.status.head == builder.tip.hash
     headers = peer.get_headers(1, 5)
     assert [h.number for h in headers] == [1, 2, 3, 4, 5]
@@ -89,7 +92,8 @@ def test_genesis_mismatch_rejected(testnet):
     server, port, status, *_ = testnet
     bad = Status(network_id=1, genesis=b"\x66" * 32)
     with pytest.raises(PeerError):
-        PeerConnection.connect("127.0.0.1", port, bad)
+        PeerConnection.connect("127.0.0.1", port, bad,
+                               pubkey_from_priv(server.node_priv))
 
 
 def test_full_sync_from_peer(testnet):
@@ -98,7 +102,8 @@ def test_full_sync_from_peer(testnet):
     server, port, status, factory_b, builder = testnet
     our_status = Status(network_id=1, head=builder.genesis.hash,
                         genesis=builder.genesis.hash)
-    peer = PeerConnection.connect("127.0.0.1", port, our_status)
+    peer = PeerConnection.connect("127.0.0.1", port, our_status,
+                                  pubkey_from_priv(server.node_priv))
     pipeline = Pipeline(factory_b, default_stages(committer=CPU))
     tip = sync_from_peer(factory_b, peer, pipeline, EthBeaconConsensus(CPU))
     assert tip == 8
@@ -124,7 +129,8 @@ def test_tx_broadcast_into_pool(testnet):
     alice = Wallet(0xA11CE)
     alice.nonce = 8  # after 8 mined txs
     tx = alice.transfer(b"\x0c" * 20, 5)
-    peer = PeerConnection.connect("127.0.0.1", port, status)
+    peer = PeerConnection.connect("127.0.0.1", port, status,
+                                  pubkey_from_priv(server.node_priv))
     peer.send(wire.TransactionsMsg([tx]))
     import time
 
@@ -134,3 +140,42 @@ def test_tx_broadcast_into_pool(testnet):
         time.sleep(0.05)
     assert pool.contains(tx.hash)
     peer.close()
+
+
+def test_enode_dial_and_discovery_assisted_sync(testnet):
+    """Dial by enode URL (discv4-style identity) and sync over the
+    encrypted session — the discovery -> RLPx -> eth/68 pipeline."""
+    import time
+
+    from reth_tpu.net.discv4 import Discv4
+
+    server, port, status, factory_b, builder = testnet
+    # discovery: server advertises; a fresh node bootstraps off it
+    d_server = Discv4(server.node_priv, tcp_port=port)
+    d_server.start()
+    client_net = NetworkManager(factory_b, Status(
+        network_id=1, head=builder.genesis.hash, genesis=builder.genesis.hash))
+    d_client = Discv4(client_net.node_priv)
+    d_client.start()
+    try:
+        d_client.bootstrap([d_server.enode()])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            rec = d_client.table.by_id.get(d_server.node_id)
+            if rec is not None and rec.bonded:
+                break
+            time.sleep(0.05)
+        rec = d_client.table.by_id[d_server.node_id]
+        assert rec.bonded, "bonding with the bootnode failed"
+        # the discovered record's enode is directly dialable over RLPx
+        peer = client_net.connect_to(rec.enode())
+        assert peer.session.snappy_enabled
+        pipeline = Pipeline(factory_b, default_stages(committer=CPU))
+        tip = sync_from_peer(factory_b, peer, pipeline, EthBeaconConsensus(CPU))
+        assert tip == 8
+        with factory_b.provider() as p:
+            assert p.header_by_number(8).state_root == builder.tip.state_root
+        peer.close()
+    finally:
+        d_server.stop()
+        d_client.stop()

@@ -1,0 +1,190 @@
+"""ECIES + RLPx transport: crypto roundtrips, handshake secrets, frames,
+snappy codec, Hello exchange over real sockets.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+import pytest
+
+from reth_tpu.net import snappy
+from reth_tpu.net.ecies import (
+    EciesError,
+    Handshake,
+    decrypt,
+    derive_secrets,
+    encrypt,
+)
+from reth_tpu.net.rlpx import RlpxError, RlpxSession, initiate, node_id, respond
+from reth_tpu.primitives.keccak import Keccak256, keccak256
+from reth_tpu.primitives.secp256k1 import pubkey_from_priv
+
+A_PRIV = 0x1111111111111111111111111111111111111111111111111111111111111111
+B_PRIV = 0x2222222222222222222222222222222222222222222222222222222222222222
+
+
+# -- streaming keccak --------------------------------------------------------
+
+
+def test_streaming_keccak_matches_oneshot():
+    data = bytes(range(256)) * 3
+    k = Keccak256()
+    for i in range(0, len(data), 37):  # uneven chunks across block borders
+        k.update(data[i : i + 37])
+    assert k.digest() == keccak256(data)
+    # digest() must not disturb the running state
+    k2 = Keccak256(data)
+    _ = k2.digest()
+    k2.update(b"more")
+    assert k2.digest() == keccak256(data + b"more")
+
+
+# -- snappy ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("payload", [
+    b"", b"a", b"hello world", bytes(range(256)),
+    b"ab" * 5000,                      # highly compressible
+    os.urandom(3000),                  # incompressible
+    b"\x00" * 100000,
+])
+def test_snappy_roundtrip(payload):
+    c = snappy.compress(payload)
+    assert snappy.decompress(c) == payload
+
+
+def test_snappy_compresses_repetitive_data():
+    data = b"reth-tpu " * 1000
+    assert len(snappy.compress(data)) < len(data) // 4
+
+
+def test_snappy_decode_known_vector():
+    # literal-only stream: len=5, tag (5-1)<<2, bytes
+    assert snappy.decompress(bytes([5, 4 << 2]) + b"abcde") == b"abcde"
+    # copy: "aaaa..." via 1-byte literal + copy1 (len 7, offset 1)
+    enc = bytes([8, 0]) + b"a" + bytes([1 | (3 << 2) | (0 << 5), 1])
+    assert snappy.decompress(enc) == b"a" * 8
+
+
+def test_snappy_rejects_malformed():
+    with pytest.raises(snappy.SnappyError):
+        snappy.decompress(bytes([10, 4 << 2]) + b"abcde")  # length mismatch
+    with pytest.raises(snappy.SnappyError):
+        snappy.decompress(bytes([4, 2 | (3 << 2), 9, 0]))  # offset > output
+
+
+# -- ECIES -------------------------------------------------------------------
+
+
+def test_ecies_roundtrip_and_tamper():
+    pub = pubkey_from_priv(B_PRIV)
+    msg = b"secret handshake payload"
+    ct = encrypt(pub, msg, shared_mac_data=b"\x01\x02")
+    assert decrypt(B_PRIV, ct, shared_mac_data=b"\x01\x02") == msg
+    with pytest.raises(EciesError):
+        decrypt(B_PRIV, ct, shared_mac_data=b"\x01\x03")  # wrong mac data
+    bad = bytearray(ct)
+    bad[100] ^= 1
+    with pytest.raises(EciesError):
+        decrypt(B_PRIV, bytes(bad))
+    with pytest.raises(EciesError):
+        decrypt(A_PRIV, ct)  # wrong recipient
+
+
+def test_handshake_both_sides_derive_same_keys():
+    init = Handshake(A_PRIV)
+    resp = Handshake(B_PRIV)
+    auth = init.auth(pubkey_from_priv(B_PRIV))
+    ack, s_resp = resp.on_auth(auth)
+    s_init = init.finalize_initiator(ack)
+    assert s_init.aes == s_resp.aes
+    assert s_init.mac == s_resp.mac
+    # MAC states are cross-seeded: my egress == peer's ingress
+    assert s_init.egress_mac.digest() == s_resp.ingress_mac.digest()
+    assert s_init.ingress_mac.digest() == s_resp.egress_mac.digest()
+    assert resp.remote_pub == pubkey_from_priv(A_PRIV)
+
+
+def test_handshake_rejects_wrong_recipient():
+    init = Handshake(A_PRIV)
+    auth = init.auth(pubkey_from_priv(B_PRIV))
+    eve = Handshake(0x3333)
+    with pytest.raises(EciesError):
+        eve.on_auth(auth)
+
+
+# -- RLPx frames over sockets ------------------------------------------------
+
+
+def _session_pair():
+    a, b = socket.socketpair()
+    out = {}
+
+    def server():
+        out["resp"] = respond(b, B_PRIV)
+
+    t = threading.Thread(target=server)
+    t.start()
+    out["init"] = initiate(a, A_PRIV, pubkey_from_priv(B_PRIV))
+    t.join(timeout=30)
+    return out["init"], out["resp"]
+
+
+def test_rlpx_frames_bidirectional():
+    s1, s2 = _session_pair()
+    s1.send_frame(b"\x80hello over rlpx")
+    assert s2.recv_frame() == b"\x80hello over rlpx"
+    s2.send_frame(b"\x80reply")
+    assert s1.recv_frame() == b"\x80reply"
+    # many frames keep the rolling MACs in sync
+    for i in range(20):
+        payload = os.urandom(1 + i * 37)
+        s1.send_frame(payload)
+        assert s2.recv_frame() == payload
+    s1.close()
+    s2.close()
+
+
+def test_rlpx_tampered_frame_rejected():
+    s1, s2 = _session_pair()
+    raw_sock = s1.sock
+    s1.send_frame(b"\x80data")
+    # flip one ciphertext bit in flight
+    buf = s2.sock.recv(65536, socket.MSG_PEEK)
+    assert buf
+    data = bytearray(s2.sock.recv(65536))
+    data[20] ^= 1
+    r, w = socket.socketpair()
+    w.sendall(bytes(data))
+    s2.sock = r
+    with pytest.raises(RlpxError):
+        s2.recv_frame()
+    raw_sock.close()
+
+
+def test_rlpx_hello_and_snappy_messages():
+    s1, s2 = _session_pair()
+    result = {}
+
+    def peer():
+        result["hello"] = s2.hello(B_PRIV, "reth-tpu/test-b", [("eth", 68)])
+
+    t = threading.Thread(target=peer)
+    t.start()
+    remote = s1.hello(A_PRIV, "reth-tpu/test-a", [("eth", 68)], port=30303)
+    t.join(timeout=30)
+    assert remote["client_id"] == "reth-tpu/test-b"
+    assert remote["caps"] == [("eth", 68)]
+    assert result["hello"]["port"] == 30303
+    assert result["hello"]["node_id"] == node_id(A_PRIV)
+    assert s1.snappy_enabled and s2.snappy_enabled
+    # capability messages now travel snappy-compressed
+    body = b"\xaa" * 10_000
+    s1.send_msg(0x10, body)
+    msg_id, got = s2.recv_msg()
+    assert (msg_id, got) == (0x10, body)
+    s1.close()
+    s2.close()
