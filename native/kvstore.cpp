@@ -4,7 +4,9 @@
 // 37.7k LoC C) — the reference's embedded B+tree store. This engine keeps
 // the same contract surface the framework's Database/Tx/Cursor interface
 // needs: named tables sorted by key, DUPSORT duplicate lists sorted by
-// value, single-writer transactions with O(writes) abort, ordered cursors,
+// value, single-writer transactions with MVCC snapshot isolation for
+// readers (clone-on-write tables published by one atomic map swap, as
+// MDBX does via shadow paging), ordered cursors pinned to their txn view,
 // and a write-ahead log + snapshot compaction. Durability scope: commits
 // fflush (process-crash-safe; recovery = snapshot + WAL replay of complete
 // committed batches); call rtkv_sync for power-loss durability (fsync).
@@ -18,7 +20,9 @@
 #include <cstring>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -41,43 +45,79 @@ enum WalOp : uint8_t {
   WAL_COMMIT = 6,
 };
 
+// MVCC: the published table map holds IMMUTABLE tables behind shared_ptr.
+// A txn captures the map at begin (its snapshot); a writer clones a table
+// on first touch into its private `own` set and publishes all clones with
+// one map swap at commit — readers keep their captured pointers for their
+// whole lifetime, exactly the reader isolation MDBX gives the reference
+// via shadow paging. One writer at a time (writer_mu).
+using TableRef = std::shared_ptr<const Table>;
+
 struct Env {
-  std::map<std::string, Table> tables;
+  std::map<std::string, TableRef> tables;
+  std::mutex publish_mu;             // guards `tables` capture/swap
+  std::mutex writer_mu;              // single writer (+ WAL/snapshot IO)
+  std::thread::id writer_owner{};    // nested same-thread writers = error
   std::string dir;       // empty = in-memory only
   FILE* wal = nullptr;
   uint64_t wal_records = 0;
+
+  // open-time only (single-threaded load/replay): mutable access
+  Table* open_mutable(const std::string& name) {
+    auto it = tables.find(name);
+    if (it == tables.end()) {
+      auto p = std::make_shared<Table>();
+      Table* raw = p.get();
+      tables[name] = std::move(p);
+      return raw;
+    }
+    return const_cast<Table*>(it->second.get());
+  }
 
   ~Env() {
     if (wal) fclose(wal);
   }
 };
 
-struct UndoEntry {
-  std::string table;
-  Key key;
-  bool existed;
-  Dups prev;
-};
-
-struct ClearUndo {
-  std::string table;
-  Table prev;
-};
-
 struct Txn {
   Env* env;
   bool write;
-  std::vector<UndoEntry> undo;
-  std::vector<ClearUndo> clear_undo;
-  std::map<std::pair<std::string, Key>, bool> seen;
+  std::map<std::string, TableRef> snap;                 // captured at begin
+  std::map<std::string, std::shared_ptr<Table>> own;    // clone-on-write
   // WAL records buffered until commit (atomicity: records + commit mark)
   std::string wal_buf;
+
+  const Table* view(const std::string& t) const {
+    auto oi = own.find(t);
+    if (oi != own.end()) return oi->second.get();
+    auto si = snap.find(t);
+    return si != snap.end() ? si->second.get() : nullptr;
+  }
+
+  TableRef view_ref(const std::string& t) const {
+    auto oi = own.find(t);
+    if (oi != own.end()) return oi->second;
+    auto si = snap.find(t);
+    return si != snap.end() ? si->second : nullptr;
+  }
+
+  Table* wview(const std::string& t) {
+    auto oi = own.find(t);
+    if (oi != own.end()) return oi->second.get();
+    auto si = snap.find(t);
+    auto p = si != snap.end() ? std::make_shared<Table>(*si->second)
+                              : std::make_shared<Table>();
+    Table* raw = p.get();
+    own[t] = std::move(p);
+    return raw;
+  }
 };
 
 struct Cursor {
   Txn* txn;
   std::string table;
-  Table::iterator it;
+  TableRef pin;          // the table as of cursor creation (kept alive)
+  Table::const_iterator it;
   size_t dup = 0;
   // tri-state mirrors the python MemDb cursor: UNPOS (fresh; next()=first),
   // POS (on an entry), EXHAUSTED (failed seek / ran off the end;
@@ -97,9 +137,8 @@ void wal_append(std::string& buf, uint8_t op, const std::string& table,
   buf.append(val);
 }
 
-void apply_put(Env* env, const std::string& table, const std::string& key,
-               const std::string& val, bool dupsort) {
-  Table& t = env->tables[table];
+void table_put(Table& t, const std::string& key, const std::string& val,
+               bool dupsort) {
   Dups& d = t[key];
   if (!dupsort) {
     d.assign(1, val);
@@ -109,21 +148,18 @@ void apply_put(Env* env, const std::string& table, const std::string& key,
   if (pos == d.end() || *pos != val) d.insert(pos, val);
 }
 
-bool apply_del(Env* env, const std::string& table, const std::string& key,
-               const std::string* val) {
-  auto ti = env->tables.find(table);
-  if (ti == env->tables.end()) return false;
-  auto ki = ti->second.find(key);
-  if (ki == ti->second.end()) return false;
+bool table_del(Table& t, const std::string& key, const std::string* val) {
+  auto ki = t.find(key);
+  if (ki == t.end()) return false;
   if (val == nullptr) {
-    ti->second.erase(ki);
+    t.erase(ki);
     return true;
   }
   Dups& d = ki->second;
   auto pos = std::lower_bound(d.begin(), d.end(), *val);
   if (pos != d.end() && *pos == *val) {
     d.erase(pos);
-    if (d.empty()) ti->second.erase(ki);
+    if (d.empty()) t.erase(ki);
     return true;
   }
   return false;
@@ -146,7 +182,8 @@ bool save_snapshot(Env* env) {
   auto w32 = [&wr](uint32_t v) { wr(&v, 4); };
   auto w64 = [&wr](uint64_t v) { wr(&v, 8); };
   wr("RTKV1\n", 6);
-  for (auto& [name, table] : env->tables) {
+  for (auto& [name, table_ref] : env->tables) {
+    const Table& table = *table_ref;
     w32(static_cast<uint32_t>(name.size()));
     wr(name.data(), name.size());
     w64(table.size());
@@ -197,7 +234,7 @@ bool load_snapshot(Env* env) {
     if (!read_exact(f, name.data(), name_len)) break;
     uint64_t nkeys;
     if (!read_exact(f, &nkeys, 8)) break;
-    Table& t = env->tables[name];
+    Table& t = *env->open_mutable(name);
     for (uint64_t i = 0; i < nkeys; i++) {
       uint32_t klen;
       if (!read_exact(f, &klen, 4)) goto done;
@@ -248,12 +285,13 @@ bool replay_wal(Env* env) {
     if (vlen && !read_exact(f, val.data(), vlen)) break;
     if (op == WAL_COMMIT) {
       for (auto& r : batch) {
+        Table& t = *env->open_mutable(r.table);
         switch (r.op) {
-          case WAL_PUT: apply_put(env, r.table, r.key, r.val, false); break;
-          case WAL_PUT_DUP: apply_put(env, r.table, r.key, r.val, true); break;
-          case WAL_DEL_KEY: apply_del(env, r.table, r.key, nullptr); break;
-          case WAL_DEL_DUP: apply_del(env, r.table, r.key, &r.val); break;
-          case WAL_CLEAR: env->tables[r.table].clear(); break;
+          case WAL_PUT: table_put(t, r.key, r.val, false); break;
+          case WAL_PUT_DUP: table_put(t, r.key, r.val, true); break;
+          case WAL_DEL_KEY: table_del(t, r.key, nullptr); break;
+          case WAL_DEL_DUP: table_del(t, r.key, &r.val); break;
+          case WAL_CLEAR: t.clear(); break;
         }
       }
       batch.clear();
@@ -263,27 +301,6 @@ bool replay_wal(Env* env) {
   }
   fclose(f);
   return true;
-}
-
-void record_undo(Txn* txn, const std::string& table, const Key& key) {
-  auto mark = std::make_pair(table, key);
-  if (txn->seen.count(mark)) return;
-  txn->seen.emplace(mark, true);
-  UndoEntry e;
-  e.table = table;
-  e.key = key;
-  auto ti = txn->env->tables.find(table);
-  if (ti != txn->env->tables.end()) {
-    auto ki = ti->second.find(key);
-    if (ki != ti->second.end()) {
-      e.existed = true;
-      e.prev = ki->second;
-      txn->undo.push_back(std::move(e));
-      return;
-    }
-  }
-  e.existed = false;
-  txn->undo.push_back(std::move(e));
 }
 
 }  // namespace
@@ -306,7 +323,12 @@ void* rtkv_open(const char* dir) {
 void rtkv_close(void* envp) { delete static_cast<Env*>(envp); }
 
 int rtkv_snapshot(void* envp) {
-  return save_snapshot(static_cast<Env*>(envp)) ? 0 : -1;
+  auto env = static_cast<Env*>(envp);
+  // exclude writers for the whole snapshot+WAL-truncate window: a racing
+  // commit could otherwise mutate the map mid-iteration or write to the
+  // WAL handle being swapped out
+  std::lock_guard<std::mutex> w(env->writer_mu);
+  return save_snapshot(env) ? 0 : -1;
 }
 
 // Power-loss durability point: fsync the WAL.
@@ -318,9 +340,21 @@ int rtkv_sync(void* envp) {
 }
 
 void* rtkv_txn_begin(void* envp, int write) {
+  auto env = static_cast<Env*>(envp);
+  if (write) {
+    // a nested write txn on one thread would deadlock (or, with a
+    // recursive lock, silently clobber the outer txn's clones) — error
+    if (env->writer_owner == std::this_thread::get_id()) return nullptr;
+    env->writer_mu.lock();
+    env->writer_owner = std::this_thread::get_id();
+  }
   auto txn = new Txn();
-  txn->env = static_cast<Env*>(envp);
+  txn->env = env;
   txn->write = write != 0;
+  {
+    std::lock_guard<std::mutex> g(env->publish_mu);
+    txn->snap = env->tables;  // shared_ptr copies: the MVCC snapshot
+  }
   return txn;
 }
 
@@ -330,8 +364,7 @@ int rtkv_put(void* txnp, const char* table, const uint8_t* key, uint32_t klen,
   if (!txn->write) return -1;
   std::string t(table), k(reinterpret_cast<const char*>(key), klen),
       v(reinterpret_cast<const char*>(val), vlen);
-  record_undo(txn, t, k);
-  apply_put(txn->env, t, k, v, dupsort != 0);
+  table_put(*txn->wview(t), k, v, dupsort != 0);
   wal_append(txn->wal_buf, dupsort ? WAL_PUT_DUP : WAL_PUT, t, k, v);
   return 0;
 }
@@ -341,14 +374,13 @@ int rtkv_del(void* txnp, const char* table, const uint8_t* key, uint32_t klen,
   auto txn = static_cast<Txn*>(txnp);
   if (!txn->write) return -1;
   std::string t(table), k(reinterpret_cast<const char*>(key), klen);
-  record_undo(txn, t, k);
   bool ok;
   if (have_val) {
     std::string v(reinterpret_cast<const char*>(val), vlen);
-    ok = apply_del(txn->env, t, k, &v);
+    ok = table_del(*txn->wview(t), k, &v);
     if (ok) wal_append(txn->wal_buf, WAL_DEL_DUP, t, k, v);
   } else {
-    ok = apply_del(txn->env, t, k, nullptr);
+    ok = table_del(*txn->wview(t), k, nullptr);
     if (ok) wal_append(txn->wal_buf, WAL_DEL_KEY, t, k, "");
   }
   return ok ? 1 : 0;
@@ -358,40 +390,20 @@ int rtkv_clear(void* txnp, const char* table) {
   auto txn = static_cast<Txn*>(txnp);
   if (!txn->write) return -1;
   std::string t(table);
-  ClearUndo cu;
-  cu.table = t;
-  auto ti = txn->env->tables.find(t);
-  if (ti != txn->env->tables.end()) cu.prev = std::move(ti->second);
-  // fold per-key undo of this table into the clear image (matches the
-  // python MemDb semantics: abort after put-then-clear restores tx start)
-  for (auto it = txn->undo.begin(); it != txn->undo.end();) {
-    if (it->table == t) {
-      if (it->existed) cu.prev[it->key] = it->prev;
-      else cu.prev.erase(it->key);
-      it = txn->undo.erase(it);
-    } else {
-      ++it;
-    }
-  }
-  for (auto it = txn->seen.begin(); it != txn->seen.end();) {
-    if (it->first.first == t) it = txn->seen.erase(it);
-    else ++it;
-  }
-  txn->clear_undo.push_back(std::move(cu));
-  txn->env->tables[t].clear();
+  txn->own[t] = std::make_shared<Table>();
   wal_append(txn->wal_buf, WAL_CLEAR, t, "", "");
   return 0;
 }
 
-// get: first duplicate; returns 1 found / 0 missing. Pointer valid until the
-// next mutation of the env (caller copies immediately).
+// get: first duplicate; returns 1 found / 0 missing. Pointer valid for the
+// life of the txn's snapshot (caller copies immediately anyway).
 int rtkv_get(void* txnp, const char* table, const uint8_t* key, uint32_t klen,
              const uint8_t** out, uint32_t* out_len) {
   auto txn = static_cast<Txn*>(txnp);
-  auto ti = txn->env->tables.find(table);
-  if (ti == txn->env->tables.end()) return 0;
-  auto ki = ti->second.find(std::string(reinterpret_cast<const char*>(key), klen));
-  if (ki == ti->second.end() || ki->second.empty()) return 0;
+  const Table* t = txn->view(table);
+  if (!t) return 0;
+  auto ki = t->find(std::string(reinterpret_cast<const char*>(key), klen));
+  if (ki == t->end() || ki->second.empty()) return 0;
   *out = reinterpret_cast<const uint8_t*>(ki->second[0].data());
   *out_len = static_cast<uint32_t>(ki->second[0].size());
   return 1;
@@ -399,23 +411,31 @@ int rtkv_get(void* txnp, const char* table, const uint8_t* key, uint32_t klen,
 
 uint64_t rtkv_entry_count(void* txnp, const char* table) {
   auto txn = static_cast<Txn*>(txnp);
-  auto ti = txn->env->tables.find(table);
-  if (ti == txn->env->tables.end()) return 0;
+  const Table* t = txn->view(table);
+  if (!t) return 0;
   uint64_t n = 0;
-  for (auto& [k, d] : ti->second) n += d.size();
+  for (auto& [k, d] : *t) n += d.size();
   return n;
 }
 
 int rtkv_commit(void* txnp) {
   auto txn = static_cast<Txn*>(txnp);
   int rc = 0;
-  if (txn->write && txn->env->wal && !txn->wal_buf.empty()) {
-    wal_append(txn->wal_buf, WAL_COMMIT, "", "", "");
-    if (fwrite(txn->wal_buf.data(), 1, txn->wal_buf.size(), txn->env->wal) !=
-        txn->wal_buf.size())
-      rc = -1;
-    fflush(txn->env->wal);
-    txn->env->wal_records += 1;
+  if (txn->write) {
+    if (txn->env->wal && !txn->wal_buf.empty()) {
+      wal_append(txn->wal_buf, WAL_COMMIT, "", "", "");
+      if (fwrite(txn->wal_buf.data(), 1, txn->wal_buf.size(), txn->env->wal) !=
+          txn->wal_buf.size())
+        rc = -1;
+      fflush(txn->env->wal);
+      txn->env->wal_records += 1;
+    }
+    if (!txn->own.empty()) {
+      std::lock_guard<std::mutex> g(txn->env->publish_mu);
+      for (auto& [name, tbl] : txn->own) txn->env->tables[name] = tbl;
+    }
+    txn->env->writer_owner = std::thread::id{};
+    txn->env->writer_mu.unlock();
   }
   delete txn;
   return rc;
@@ -423,15 +443,9 @@ int rtkv_commit(void* txnp) {
 
 void rtkv_abort(void* txnp) {
   auto txn = static_cast<Txn*>(txnp);
-  if (txn->write) {
-    for (auto it = txn->undo.rbegin(); it != txn->undo.rend(); ++it) {
-      Table& t = txn->env->tables[it->table];
-      if (it->existed) t[it->key] = it->prev;
-      else t.erase(it->key);
-    }
-    for (auto it = txn->clear_undo.rbegin(); it != txn->clear_undo.rend(); ++it) {
-      txn->env->tables[it->table] = std::move(it->prev);
-    }
+  if (txn->write) {  // clones just drop
+    txn->env->writer_owner = std::thread::id{};
+    txn->env->writer_mu.unlock();
   }
   delete txn;
 }
@@ -443,6 +457,7 @@ void* rtkv_cursor(void* txnp, const char* table) {
   auto cur = new Cursor();
   cur->txn = txn;
   cur->table = table;
+  cur->pin = txn->view_ref(table);  // tx view as of cursor creation
   cur->state = Cursor::UNPOS;
   return cur;
 }
@@ -451,21 +466,28 @@ void rtkv_cursor_close(void* curp) { delete static_cast<Cursor*>(curp); }
 
 namespace {
 
-Table* cursor_table(Cursor* c) {
-  auto ti = c->txn->env->tables.find(c->table);
-  return ti == c->txn->env->tables.end() ? nullptr : &ti->second;
+const Table* cursor_table(Cursor* c) { return c->pin.get(); }
+
+// MemDb cursor semantics: the KEY order is frozen at cursor creation (the
+// pin), but VALUES are read through the txn's live view — a write txn's
+// own later puts/deletes are visible to pre-existing cursors.
+const Dups* live_dups(Cursor* c, const Key& key) {
+  const Table* t = c->txn->view(c->table);
+  if (!t) return nullptr;
+  auto ki = t->find(key);
+  return ki == t->end() ? nullptr : &ki->second;
 }
 
 int emit(Cursor* c, const uint8_t** k, uint32_t* klen, const uint8_t** v,
          uint32_t* vlen) {
   if (c->state != Cursor::POS) return 0;
   const Key& key = c->it->first;
-  const Dups& d = c->it->second;
-  if (c->dup >= d.size()) return 0;
+  const Dups* d = live_dups(c, key);
+  if (!d || c->dup >= d->size()) return 0;
   *k = reinterpret_cast<const uint8_t*>(key.data());
   *klen = static_cast<uint32_t>(key.size());
-  *v = reinterpret_cast<const uint8_t*>(d[c->dup].data());
-  *vlen = static_cast<uint32_t>(d[c->dup].size());
+  *v = reinterpret_cast<const uint8_t*>((*d)[c->dup].data());
+  *vlen = static_cast<uint32_t>((*d)[c->dup].size());
   return 1;
 }
 
@@ -474,7 +496,7 @@ int emit(Cursor* c, const uint8_t** k, uint32_t* klen, const uint8_t** v,
 int rtkv_cursor_first(void* curp, const uint8_t** k, uint32_t* klen,
                       const uint8_t** v, uint32_t* vlen) {
   auto c = static_cast<Cursor*>(curp);
-  Table* t = cursor_table(c);
+  const Table* t = cursor_table(c);
   if (!t || t->empty()) {
     c->state = Cursor::EXHAUSTED;
     return 0;
@@ -488,13 +510,14 @@ int rtkv_cursor_first(void* curp, const uint8_t** k, uint32_t* klen,
 int rtkv_cursor_last(void* curp, const uint8_t** k, uint32_t* klen,
                      const uint8_t** v, uint32_t* vlen) {
   auto c = static_cast<Cursor*>(curp);
-  Table* t = cursor_table(c);
+  const Table* t = cursor_table(c);
   if (!t || t->empty()) {
     c->state = Cursor::EXHAUSTED;
     return 0;
   }
   c->it = std::prev(t->end());
-  c->dup = c->it->second.size() ? c->it->second.size() - 1 : 0;
+  const Dups* d = live_dups(c, c->it->first);
+  c->dup = (d && d->size()) ? d->size() - 1 : 0;
   c->state = Cursor::POS;
   return emit(c, k, klen, v, vlen);
 }
@@ -503,7 +526,7 @@ int rtkv_cursor_seek(void* curp, const uint8_t* key, uint32_t klen, int exact,
                      const uint8_t** k, uint32_t* kl, const uint8_t** v,
                      uint32_t* vl) {
   auto c = static_cast<Cursor*>(curp);
-  Table* t = cursor_table(c);
+  const Table* t = cursor_table(c);
   c->state = Cursor::EXHAUSTED;
   if (!t) return 0;
   std::string target(reinterpret_cast<const char*>(key), klen);
@@ -519,14 +542,15 @@ int rtkv_cursor_seek(void* curp, const uint8_t* key, uint32_t klen, int exact,
 int rtkv_cursor_next(void* curp, int skip_dups, const uint8_t** k, uint32_t* kl,
                      const uint8_t** v, uint32_t* vl) {
   auto c = static_cast<Cursor*>(curp);
-  Table* t = cursor_table(c);
+  const Table* t = cursor_table(c);
   if (!t) {
     c->state = Cursor::EXHAUSTED;
     return 0;
   }
   if (c->state == Cursor::EXHAUSTED) return 0;  // MemDb: past-the-end stays put
   if (c->state == Cursor::UNPOS) return rtkv_cursor_first(curp, k, kl, v, vl);
-  if (!skip_dups && c->dup + 1 < c->it->second.size()) {
+  const Dups* cd = live_dups(c, c->it->first);
+  if (!skip_dups && cd && c->dup + 1 < cd->size()) {
     c->dup += 1;
     return emit(c, k, kl, v, vl);
   }
@@ -542,7 +566,7 @@ int rtkv_cursor_next(void* curp, int skip_dups, const uint8_t** k, uint32_t* kl,
 int rtkv_cursor_prev(void* curp, const uint8_t** k, uint32_t* kl,
                      const uint8_t** v, uint32_t* vl) {
   auto c = static_cast<Cursor*>(curp);
-  Table* t = cursor_table(c);
+  const Table* t = cursor_table(c);
   if (!t || c->state == Cursor::UNPOS) return 0;
   if (c->state == Cursor::EXHAUSTED)  // MemDb: prev from past-the-end = last
     return rtkv_cursor_last(curp, k, kl, v, vl);
@@ -555,7 +579,8 @@ int rtkv_cursor_prev(void* curp, const uint8_t** k, uint32_t* kl,
     return 0;
   }
   --c->it;
-  c->dup = c->it->second.size() ? c->it->second.size() - 1 : 0;
+  const Dups* pd = live_dups(c, c->it->first);
+  c->dup = (pd && pd->size()) ? pd->size() - 1 : 0;
   return emit(c, k, kl, v, vl);
 }
 
@@ -564,7 +589,8 @@ int rtkv_cursor_next_dup(void* curp, const uint8_t** k, uint32_t* kl,
                          const uint8_t** v, uint32_t* vl) {
   auto c = static_cast<Cursor*>(curp);
   if (c->state != Cursor::POS) return 0;
-  if (c->dup + 1 >= c->it->second.size()) return 0;
+  const Dups* d = live_dups(c, c->it->first);
+  if (!d || c->dup + 1 >= d->size()) return 0;
   c->dup += 1;
   return emit(c, k, kl, v, vl);
 }
@@ -574,17 +600,18 @@ int rtkv_cursor_seek_dup(void* curp, const uint8_t* key, uint32_t klen,
                          const uint8_t* sub, uint32_t slen, const uint8_t** k,
                          uint32_t* kl, const uint8_t** v, uint32_t* vl) {
   auto c = static_cast<Cursor*>(curp);
-  Table* t = cursor_table(c);
+  const Table* t = cursor_table(c);
   c->state = Cursor::EXHAUSTED;
   if (!t) return 0;
   auto it = t->find(std::string(reinterpret_cast<const char*>(key), klen));
   if (it == t->end()) return 0;
   std::string target(reinterpret_cast<const char*>(sub), slen);
-  const Dups& d = it->second;
-  auto pos = std::lower_bound(d.begin(), d.end(), target);
-  if (pos == d.end()) return 0;
   c->it = it;
-  c->dup = static_cast<size_t>(pos - d.begin());
+  const Dups* d = live_dups(c, it->first);
+  if (!d) return 0;
+  auto pos = std::lower_bound(d->begin(), d->end(), target);
+  if (pos == d->end()) return 0;
+  c->dup = static_cast<size_t>(pos - d->begin());
   c->state = Cursor::POS;
   return emit(c, k, kl, v, vl);
 }

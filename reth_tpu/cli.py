@@ -172,8 +172,14 @@ def cmd_node(args):
             print("error: no genesis — pass --genesis or run `init`, or use --dev",
                   file=sys.stderr)
             return 1
+    jwt_secret = None
+    if args.authrpc_jwtsecret:
+        from .rpc.jwt import load_or_create_secret
+
+        jwt_secret = load_or_create_secret(args.authrpc_jwtsecret)
     cfg = NodeConfig(datadir=args.datadir, dev=args.dev,
                      http_port=args.http_port, authrpc_port=args.authrpc_port,
+                     jwt_secret=jwt_secret,
                      p2p_port=args.port if not args.disable_p2p else None,
                      p2p_host=args.addr,
                      discovery=not args.no_discovery,
@@ -297,6 +303,9 @@ def main(argv=None) -> int:
     p.add_argument("--block-time", type=int, default=2)
     p.add_argument("--http-port", type=int, default=8545)
     p.add_argument("--authrpc-port", type=int, default=8551)
+    p.add_argument("--authrpc-jwtsecret", default=None,
+                   help="path to the 32-byte hex JWT secret for the engine "
+                        "port (default: <datadir>/jwt.hex, created if absent)")
     p.add_argument("--port", type=int, default=30303, help="RLPx TCP port")
     p.add_argument("--addr", default="127.0.0.1",
                    help="P2P bind/advertise address (0.0.0.0 for all)")

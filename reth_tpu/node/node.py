@@ -41,6 +41,7 @@ class NodeConfig:
     # is this many blocks past it (None disables), and prune per modes
     static_file_distance: int | None = None
     prune_modes: object | None = None  # PruneModes | None
+    jwt_secret: bytes | None = None   # engine-port JWT (auto from datadir)
     # devp2p: RLPx listener + discv4 discovery (None disables networking)
     p2p_port: int | None = None       # 0 = ephemeral
     p2p_host: str = "127.0.0.1"       # bind + advertised address
@@ -131,7 +132,16 @@ class Node:
 
         self.rpc.register(DebugApi(self.eth_api))
         self.engine_api = EngineApi(self.tree, self.payload_service)
-        self.authrpc = RpcServer(port=config.authrpc_port, lock=shared_lock)
+        # JWT on the engine port (reference auth_layer.rs): explicit secret,
+        # else auto-generated jwt.hex under the datadir; dev mode stays open
+        # (the reference's --dev also relaxes local tooling friction)
+        jwt_secret = config.jwt_secret
+        if jwt_secret is None and config.datadir and not config.dev:
+            from ..rpc.jwt import load_or_create_secret
+
+            jwt_secret = load_or_create_secret(Path(config.datadir) / "jwt.hex")
+        self.authrpc = RpcServer(port=config.authrpc_port, lock=shared_lock,
+                                 jwt_secret=jwt_secret)
         self.authrpc.register(self.engine_api)
         self.authrpc.register(self.eth_api)  # CLs also query eth_ on authrpc
 

@@ -31,16 +31,34 @@ class RpcServer:
     ``namespace_method``-named callables (e.g. ``eth_blockNumber``)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 lock: threading.RLock | None = None):
+                 lock: threading.RLock | None = None,
+                 jwt_secret: bytes | None = None):
         self.methods: dict[str, callable] = {}
         self.host = host
         self.port = port
+        # HS256 JWT required on every request when set (the engine auth
+        # port; reference crates/rpc/rpc-layer/src/auth_layer.rs)
+        self.jwt_secret = jwt_secret
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         # one coarse lock serialises handlers: pool/tree state has no
         # internal synchronisation (share the lock across servers that
         # share state, e.g. the public and auth servers of one node)
         self.lock = lock or threading.RLock()
+
+    def authorize(self, auth_header: str | None) -> str | None:
+        """None when authorized; else the rejection reason."""
+        if self.jwt_secret is None:
+            return None
+        if not auth_header or not auth_header.startswith("Bearer "):
+            return "missing JWT bearer token"
+        from .jwt import JwtError, validate_jwt
+
+        try:
+            validate_jwt(self.jwt_secret, auth_header[7:].strip())
+        except JwtError as e:
+            return str(e)
+        return None
 
     def register(self, api: object, prefix: str | None = None):
         for name in dir(api):
@@ -99,8 +117,14 @@ class RpcServer:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
-                resp = server.handle(body)
-                self.send_response(200)
+                denied = server.authorize(self.headers.get("Authorization"))
+                if denied is not None:
+                    resp = json.dumps({"jsonrpc": "2.0", "id": None, "error": {
+                        "code": -32001, "message": f"unauthorized: {denied}"}}).encode()
+                    self.send_response(401)
+                else:
+                    resp = server.handle(body)
+                    self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(resp)))
                 self.end_headers()

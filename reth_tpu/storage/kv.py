@@ -7,16 +7,17 @@ Semantics kept from MDBX where they matter to callers:
 - keys and values are raw ``bytes``; tables are sorted by key
 - DUPSORT tables hold multiple values per key, sorted by value; a
   (key, subkey-prefixed value) model identical to the reference's use
-- single-writer model (as MDBX enforces in the reference): writes apply
-  live with an undo log, ``commit`` is O(1), ``abort`` replays the log.
-  Readers in the same process see live data — there is NO cross-tx
-  snapshot isolation in this backend; don't interleave a reader with a
-  writer and expect MDBX's MVCC.
+- single-writer model (as MDBX enforces in the reference) WITH MVCC
+  snapshot isolation: a transaction captures the published table map at
+  begin; published table dicts are immutable (writers clone-on-first-
+  write and publish by one atomic map swap at commit), so readers see a
+  stable point-in-time view for their whole lifetime even while a
+  writer commits — the semantics MDBX provides via shadow paging.
 
 The in-memory ``MemDb`` keeps each table as ``dict[key -> value | sorted
-value list]`` plus a cached sorted key index (invalidated on key
-add/remove), giving O(log n) seeks and ordered iteration — a correct,
-adequately fast stand-in for the native backend.
+value list]`` plus a per-transaction sorted key index, giving O(log n)
+seeks and ordered iteration — a correct, adequately fast stand-in for
+the native backend.
 """
 
 from __future__ import annotations
@@ -168,45 +169,65 @@ class Cursor:
             yield (key, value)
 
 
-_ABSENT = object()
+_EMPTY_TABLE: dict = {}
 
 
 class Tx:
-    """A transaction over the store.
+    """A transaction with MVCC snapshot isolation.
 
-    Writes apply directly to the base tables with an undo log per touched
-    key, so ``commit`` is O(1) and ``abort`` is O(writes) — the model is
-    single-writer (as MDBX enforces in the reference), readers in the same
-    process see live data.
+    Begin captures the published name->table map; published table dicts are
+    IMMUTABLE (writers clone a table on first touch and atomically swap the
+    whole map on commit), so readers see a consistent point-in-time snapshot
+    for their entire lifetime regardless of concurrent commits — the
+    semantics MDBX gives the reference via shadow paging. One writer at a
+    time (``MemDb._writer_lock``), matching MDBX's single write txn.
     """
 
     def __init__(self, db: "MemDb", write: bool):
+        import threading
+
         self._db = db
         self._write = write
-        # undo log: (table, key, previous value-or-_ABSENT), newest last
-        self._undo: list[tuple[str, bytes, object]] = []
-        self._undo_seen: set[tuple[str, bytes]] = set()
-        self._undo_clear: list[tuple[str, dict]] = []
+        if write:
+            # nested write txns on one thread would silently clobber each
+            # other's whole-table clones at commit — fail loudly instead
+            if db._writer_thread == threading.get_ident():
+                raise RuntimeError("nested write transaction on one thread")
+            db._writer_lock.acquire()
+            db._writer_thread = threading.get_ident()
+        self._snap: dict[str, dict] = db._tables  # published map (immutable)
+        self._own: dict[str, dict] = {}           # tx-private clones
+        self._key_cache: dict[str, list[bytes]] = {}
         self._done = False
 
     # -- table access --------------------------------------------------------
 
     def _table(self, table: str) -> dict:
-        return self._db._tables.setdefault(table, {})
+        t = self._own.get(table)
+        if t is not None:
+            return t
+        return self._snap.get(table, _EMPTY_TABLE)
+
+    def _wtable(self, table: str) -> dict:
+        t = self._own.get(table)
+        if t is None:
+            # deep-enough clone: dup lists are mutated in place by put/delete
+            t = {
+                k: (list(v) if isinstance(v, list) else v)
+                for k, v in self._snap.get(table, _EMPTY_TABLE).items()
+            }
+            self._own[table] = t
+        return t
 
     def _sorted_keys(self, table: str) -> list[bytes]:
-        return self._db._sorted_keys(table)
+        cached = self._key_cache.get(table)
+        if cached is None:
+            cached = sorted(self._table(table).keys())
+            self._key_cache[table] = cached
+        return cached
 
-    def _record_undo(self, table: str, key: bytes):
-        mark = (table, key)
-        if mark in self._undo_seen:
-            return
-        self._undo_seen.add(mark)
-        t = self._table(table)
-        prev = t.get(key, _ABSENT)
-        if isinstance(prev, list):
-            prev = list(prev)
-        self._undo.append((table, key, prev))
+    def _invalidate_keys(self, table: str):
+        self._key_cache.pop(table, None)
 
     # -- reads --------------------------------------------------------------
 
@@ -235,10 +256,9 @@ class Tx:
 
     def put(self, table: str, key: bytes, value: bytes, dupsort: bool = False):
         assert self._write, "read-only transaction"
-        self._record_undo(table, key)
-        t = self._table(table)
+        t = self._wtable(table)
         if key not in t:
-            self._db._invalidate_keys(table)
+            self._invalidate_keys(table)
         if dupsort:
             dups = t.get(key)
             if dups is None:
@@ -256,13 +276,12 @@ class Tx:
     def delete(self, table: str, key: bytes, value: bytes | None = None):
         """Delete a key (or one duplicate when ``value`` given)."""
         assert self._write, "read-only transaction"
-        self._record_undo(table, key)
-        t = self._table(table)
+        t = self._wtable(table)
         if key not in t:
             return False
         if value is None or not isinstance(t.get(key), list):
             del t[key]
-            self._db._invalidate_keys(table)
+            self._invalidate_keys(table)
             return True
         dups = t[key]
         j = bisect.bisect_left(dups, value)
@@ -270,51 +289,33 @@ class Tx:
             dups.pop(j)
             if not dups:
                 del t[key]
-                self._db._invalidate_keys(table)
+                self._invalidate_keys(table)
             return True
         return False
 
     def clear(self, table: str):
         assert self._write
-        # Fold this table's per-key undo into a reconstructed tx-start image,
-        # so abort() restores pre-transaction state even after put-then-clear
-        # (puts mutate the live dict, so the current dict is NOT tx-start).
-        start = dict(self._table(table))
-        for tb, k, prev in self._undo:
-            if tb == table:
-                if prev is _ABSENT:
-                    start.pop(k, None)
-                else:
-                    start[k] = prev
-        self._undo = [e for e in self._undo if e[0] != table]
-        self._undo_seen = {m for m in self._undo_seen if m[0] != table}
-        self._undo_clear.append((table, start))
-        self._db._tables[table] = {}
-        self._db._invalidate_keys(table)
+        self._own[table] = {}
+        self._invalidate_keys(table)
 
     # -- lifecycle ----------------------------------------------------------
 
     def commit(self):
         assert not self._done
         if self._write:
-            self._db._dirty = True
-        self._undo.clear()
-        self._undo_seen.clear()
-        self._undo_clear.clear()
+            if self._own:
+                new_map = dict(self._db._tables)
+                new_map.update(self._own)
+                self._db._tables = new_map  # atomic publish (GIL reference swap)
+                self._db._dirty = True
+            self._db._writer_thread = None
+            self._db._writer_lock.release()
         self._done = True
 
     def abort(self):
-        if self._write:
-            for table, key, prev in reversed(self._undo):
-                t = self._table(table)
-                if prev is _ABSENT:
-                    t.pop(key, None)
-                else:
-                    t[key] = prev
-                self._db._invalidate_keys(table)
-            for table, data in reversed(self._undo_clear):
-                self._db._tables[table] = data
-                self._db._invalidate_keys(table)
+        if self._write and not self._done:
+            self._db._writer_thread = None
+            self._db._writer_lock.release()
         self._done = True
 
     def __enter__(self):
@@ -326,6 +327,14 @@ class Tx:
                 self.commit()
             else:
                 self.abort()
+
+    def __del__(self):
+        if not self._done and self._write:
+            try:
+                self._db._writer_thread = None
+                self._db._writer_lock.release()
+            except RuntimeError:
+                pass
 
 
 class Database:
@@ -347,23 +356,16 @@ class MemDb(Database):
     """
 
     def __init__(self, path: str | Path | None = None):
+        import threading
+
         self._tables: dict[str, dict[bytes, object]] = {}
-        self._key_cache: dict[str, list[bytes]] = {}
+        self._writer_lock = threading.Lock()
+        self._writer_thread: int | None = None
         self._path = Path(path) if path else None
         self._dirty = False
         if self._path and self._path.exists():
             with open(self._path, "rb") as f:
                 self._tables = pickle.load(f)
-
-    def _sorted_keys(self, table: str) -> list[bytes]:
-        cached = self._key_cache.get(table)
-        if cached is None:
-            cached = sorted(self._tables.get(table, {}).keys())
-            self._key_cache[table] = cached
-        return cached
-
-    def _invalidate_keys(self, table: str):
-        self._key_cache.pop(table, None)
 
     def tx(self) -> Tx:
         return Tx(self, write=False)

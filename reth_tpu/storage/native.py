@@ -164,7 +164,10 @@ class NativeTx:
         self._db = db
         self._lib = db._lib
         self._txn = self._lib.rtkv_txn_begin(db._env, 1 if write else 0)
+        if not self._txn:
+            raise RuntimeError("nested write transaction on one thread")
         self._write = write
+        self._key_cache: dict[str, list[bytes]] = {}
         self._done = False
 
     def get(self, table: str, key: bytes):
@@ -187,8 +190,9 @@ class NativeTx:
         return int(self._lib.rtkv_entry_count(self._txn, table.encode()))
 
     def _sorted_keys(self, table: str) -> list[bytes]:
-        # cached at DB level (single-writer model, like MemDb's key cache)
-        cached = self._db._key_cache.get(table)
+        # cached PER TRANSACTION: with MVCC snapshots a db-level cache
+        # would leak one snapshot's key set into another's view
+        cached = self._key_cache.get(table)
         if cached is not None:
             return cached
         keys = []
@@ -197,18 +201,18 @@ class NativeTx:
         while entry is not None:
             keys.append(entry[0])
             entry = cur.next_no_dup()
-        self._db._key_cache[table] = keys
+        self._key_cache[table] = keys
         return keys
 
     def put(self, table: str, key: bytes, value: bytes, dupsort: bool = False):
         assert self._write, "read-only transaction"
-        self._db._key_cache.pop(table, None)
+        self._key_cache.pop(table, None)
         self._lib.rtkv_put(self._txn, table.encode(), _buf(key), len(key),
                            _buf(value), len(value), 1 if dupsort else 0)
 
     def delete(self, table: str, key: bytes, value: bytes | None = None) -> bool:
         assert self._write, "read-only transaction"
-        self._db._key_cache.pop(table, None)
+        self._key_cache.pop(table, None)
         if value is None:
             return bool(self._lib.rtkv_del(self._txn, table.encode(), _buf(key),
                                            len(key), None, 0, 0))
@@ -217,7 +221,7 @@ class NativeTx:
 
     def clear(self, table: str):
         assert self._write
-        self._db._key_cache.pop(table, None)
+        self._key_cache.pop(table, None)
         self._lib.rtkv_clear(self._txn, table.encode())
 
     def commit(self):
@@ -229,10 +233,7 @@ class NativeTx:
 
     def abort(self):
         if not self._done:
-            if self._write:
-                # writes mutated live tables; caches may be stale after undo
-                self._db._key_cache.clear()
-            self._lib.rtkv_abort(self._txn)
+            self._lib.rtkv_abort(self._txn)  # MVCC: clones just drop
             self._done = True
 
     def __del__(self):
@@ -260,7 +261,6 @@ class NativeDb:
     def __init__(self, path: str | Path | None = None):
         self._lib = load_library()
         self._dir = str(path) if path else ""
-        self._key_cache: dict[str, list[bytes]] = {}
         if path:
             Path(path).mkdir(parents=True, exist_ok=True)
         self._env = self._lib.rtkv_open(self._dir.encode())

@@ -71,8 +71,8 @@ class OverlayTx:
         Fast path for MemDb (direct table dict); generic path goes through
         the Tx duck interface (works over the native C++ engine too).
         """
-        if hasattr(self.base, "_db") and hasattr(self.base._db, "_tables"):
-            return self.base._db._tables.get(table, {}).get(key)
+        if hasattr(self.base, "_table"):  # MemDb fast path (snapshot-aware)
+            return self.base._table(table).get(key)
         dups = self.base.get_dups(table, key)
         if not dups:
             return None

@@ -1,0 +1,119 @@
+"""MVCC snapshot isolation: concurrent reader/writer stress on both KV
+backends (VERDICT round-1 weak #5 — historical reads racing a writer).
+
+Invariant under test: the writer commits batches that keep `sum` ==
+sum of all `k:*` values in one atomic publish; any reader transaction
+must observe a consistent pair no matter when it starts or how long it
+iterates. Pre-MVCC this raced (readers saw live mutations mid-commit).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from reth_tpu.storage.kv import MemDb
+from reth_tpu.storage.native import NativeDb
+
+BATCHES = 60
+KEYS = 40
+
+
+def _backends(tmp_path):
+    return [MemDb(), NativeDb(str(tmp_path / "native"))]
+
+
+def _writer(db, stop):
+    for i in range(1, BATCHES + 1):
+        with db.tx_mut() as tx:
+            total = 0
+            for k in range(KEYS):
+                v = i * 1000 + k
+                total += v
+                tx.put("t", b"k%03d" % k, v.to_bytes(8, "big"))
+            tx.put("t", b"sum", total.to_bytes(8, "big"))
+    stop.set()
+
+
+def _reader(db, stop, errors):
+    while True:
+        tx = db.tx()
+        try:
+            s = tx.get("t", b"sum")
+            if s is not None:
+                declared = int.from_bytes(s, "big")
+                got = 0
+                n = 0
+                for k, v in tx.cursor("t").walk():
+                    if k.startswith(b"k"):
+                        got += int.from_bytes(v, "big")
+                        n += 1
+                if n != KEYS or got != declared:
+                    errors.append(
+                        f"inconsistent snapshot: n={n} got={got} declared={declared}"
+                    )
+                    return
+        finally:
+            tx.abort()
+        if stop.is_set():
+            return
+
+
+@pytest.mark.parametrize("backend", ["mem", "native"])
+def test_concurrent_reader_writer_snapshots(tmp_path, backend):
+    db = MemDb() if backend == "mem" else NativeDb(str(tmp_path / "native"))
+    stop = threading.Event()
+    errors: list[str] = []
+    readers = [threading.Thread(target=_reader, args=(db, stop, errors))
+               for _ in range(3)]
+    w = threading.Thread(target=_writer, args=(db, stop))
+    for t in readers:
+        t.start()
+    w.start()
+    w.join(timeout=120)
+    for t in readers:
+        t.join(timeout=30)
+        assert not t.is_alive(), "reader thread wedged"
+    assert not errors, errors[:3]
+
+
+@pytest.mark.parametrize("backend", ["mem", "native"])
+def test_reader_snapshot_stable_across_commit(tmp_path, backend):
+    """A read txn opened BEFORE a commit must keep seeing the old state."""
+    db = MemDb() if backend == "mem" else NativeDb(str(tmp_path / "native"))
+    with db.tx_mut() as tx:
+        tx.put("t", b"a", b"1")
+    reader = db.tx()
+    assert reader.get("t", b"a") == b"1"
+    with db.tx_mut() as tx:
+        tx.put("t", b"a", b"2")
+        tx.put("t", b"b", b"3")
+        tx.clear("u")
+    # the reader's view is frozen at its begin
+    assert reader.get("t", b"a") == b"1"
+    assert reader.get("t", b"b") is None
+    assert [k for k, _ in reader.cursor("t").walk()] == [b"a"]
+    reader.abort()
+    fresh = db.tx()
+    assert fresh.get("t", b"a") == b"2"
+    assert fresh.get("t", b"b") == b"3"
+    fresh.abort()
+
+
+@pytest.mark.parametrize("backend", ["mem", "native"])
+def test_abort_discards_all_writes(tmp_path, backend):
+    db = MemDb() if backend == "mem" else NativeDb(str(tmp_path / "native"))
+    with db.tx_mut() as tx:
+        tx.put("t", b"x", b"keep")
+    tx = db.tx_mut()
+    tx.put("t", b"x", b"changed")
+    tx.put("t", b"y", b"new")
+    tx.clear("t")
+    tx.put("t", b"z", b"after-clear")
+    tx.abort()
+    check = db.tx()
+    assert check.get("t", b"x") == b"keep"
+    assert check.get("t", b"y") is None
+    assert check.get("t", b"z") is None
+    check.abort()
