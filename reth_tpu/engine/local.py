@@ -26,10 +26,18 @@ class LocalMiner:
         attrs = PayloadAttributes(
             timestamp=timestamp if timestamp is not None else parent.timestamp + self.block_time,
         )
-        block = build_payload(self.tree, self.pool, head, attrs)
+        block, _fees = build_payload(self.tree, self.pool, head, attrs)
         st = self.tree.on_new_payload(block)
         if st.status is not PayloadStatusKind.VALID:
             raise RuntimeError(f"self-mined block invalid: {st.validation_error}")
         self.tree.on_forkchoice_updated(block.hash)
-        self.pool.on_canonical_state_change(calc_next_base_fee(block.header))
+        next_blob_fee = None
+        if block.header.excess_blob_gas is not None:
+            from ..evm.executor import blob_base_fee, next_excess_blob_gas
+
+            next_blob_fee = blob_base_fee(next_excess_blob_gas(
+                block.header.excess_blob_gas, block.header.blob_gas_used or 0
+            ))
+        self.pool.on_canonical_state_change(calc_next_base_fee(block.header),
+                                            blob_base_fee=next_blob_fee)
         return block

@@ -228,10 +228,22 @@ class EngineTree:
         except ValueError as e:
             self.invalid[block.hash] = f"bad signature: {e}"
             return PayloadStatus(PayloadStatusKind.INVALID, None, str(e)), [], []
-        out = executor.execute(block, senders, hashes)
+        # pipelined root: a worker batch-hashes dirty keys on the device
+        # WHILE execution runs (reference state_root_task / sparse_trie
+        # strategy overlap; see engine/pipelined_root.py)
+        from .pipelined_root import PipelinedStateRoot
+
+        root_job = PipelinedStateRoot(self.committer.hasher)
+        try:
+            out = executor.execute(block, senders, hashes,
+                                   state_hook=root_job.on_state_update)
+        except BaseException:
+            root_job.finish([])  # never leak the worker thread
+            raise
         try:
             self.consensus.validate_block_post_execution(block, out.receipts, out.gas_used)
         except ConsensusError as e:
+            root_job.finish([])
             self.invalid[block.hash] = str(e)
             return PayloadStatus(PayloadStatusKind.INVALID, None, str(e)), [], []
         # body + execution output into the overlay layer
@@ -243,7 +255,7 @@ class EngineTree:
         write_execution_output(overlay, n, idx.first_tx_num, out)
         # hashed-state delta + incremental root (the state-root job)
         t0 = _time.time()
-        root = self._state_root_job(overlay, out)
+        root = self._state_root_job(overlay, out, root_job)
         self._root_histogram.record(_time.time() - t0)
         self._blocks_counter.increment()
         if root != header.state_root:
@@ -255,21 +267,28 @@ class EngineTree:
             return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
         return PayloadStatus(PayloadStatusKind.VALID, block.hash), senders, out.receipts
 
-    def _state_root_job(self, overlay: DatabaseProvider, out) -> bytes:
+    def _state_root_job(self, overlay: DatabaseProvider, out, root_job=None) -> bytes:
         """Hash the block's state delta and commit the trie incrementally.
 
         Reference analogue: the SparseTrieCacheTask pipeline
         (state updates → proof targets → sparse trie → root,
-        crates/engine/tree/src/tree/state_root_strategy/sparse_trie.rs) —
-        here one batched keccak dispatch for the changed keys plus the
-        level-batched incremental commit over the overlay.
+        crates/engine/tree/src/tree/state_root_strategy/sparse_trie.rs).
+        With a ``root_job`` (PipelinedStateRoot) most key digests were
+        already computed concurrently with execution; only stragglers
+        (e.g. withdrawal targets) hash here.
         """
         changes = out.changes
         addrs = sorted(set(changes.accounts) | set(changes.storage) | set(changes.wiped_storage))
         slot_pairs = [(a, s) for a, slots in out.post_storage.items() for s in slots]
-        digests = self.committer.hasher(addrs + [s for _, s in slot_pairs])
-        haddr = dict(zip(addrs, digests[: len(addrs)]))
-        hslots = digests[len(addrs) :]
+        if root_job is not None:
+            slot_keys = [s for _, s in slot_pairs]
+            digest_map = root_job.finish(addrs + slot_keys)
+            haddr = {a: digest_map[a] for a in addrs}
+            hslots = [digest_map[s] for s in slot_keys]
+        else:
+            digests = self.committer.hasher(addrs + [s for _, s in slot_pairs])
+            haddr = dict(zip(addrs, digests[: len(addrs)]))
+            hslots = digests[len(addrs) :]
         # write hashed tables (live-tip equivalent of the hashing stages)
         for a in addrs:
             if a in out.post_accounts:

@@ -131,7 +131,12 @@ class BlockExecutor:
     def execute(
         self, block: Block, senders: list[bytes] | None = None,
         block_hashes: dict[int, bytes] | None = None,
+        state_hook=None,
     ) -> BlockExecutionOutput:
+        """``state_hook(keys)`` is called after every transaction with the
+        plain keys (addresses + storage slots) it newly touched — the
+        OnStateHook seam feeding the pipelined state-root job (reference
+        crates/evm/evm/src/lib.rs OnStateHook -> state_root_task)."""
         header = block.header
         env = BlockEnv(
             number=header.number,
@@ -150,6 +155,8 @@ class BlockExecutor:
             senders = [tx.recover_sender() for tx in block.transactions]
         out.senders = senders
         cumulative_gas = 0
+        sent_accounts = 0
+        sent_slots: dict[bytes, int] = {}
         for tx, sender in zip(block.transactions, senders):
             result = self._execute_tx(state, env, tx, sender, header.gas_limit - cumulative_gas)
             cumulative_gas += result.gas_used
@@ -160,6 +167,20 @@ class BlockExecutor:
                 logs=tuple(result.receipt.logs),
             )
             out.receipts.append(receipt)
+            if state_hook is not None:
+                # stream only this tx's NEWLY touched keys: the changes maps
+                # are append-only per block (prev-images capture once), so
+                # watermarks over insertion order give exact per-tx deltas
+                accts = list(state.changes.accounts)
+                new = accts[sent_accounts:]
+                sent_accounts = len(accts)
+                for addr, per in state.changes.storage.items():
+                    seen = sent_slots.get(addr, 0)
+                    if len(per) > seen:
+                        new += list(per)[seen:]
+                        sent_slots[addr] = len(per)
+                if new:
+                    state_hook(new)
         # withdrawals (gwei → wei), post-merge; zero-amount does not touch
         for w in block.withdrawals or ():
             if w.amount:

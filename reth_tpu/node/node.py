@@ -82,9 +82,16 @@ class Node:
         def _maintain_pool(chain):
             if chain:
                 from ..consensus.validation import calc_next_base_fee
+                from ..evm.executor import blob_base_fee, next_excess_blob_gas
 
+                tip = chain[-1].block.header
+                next_blob_fee = None
+                if tip.excess_blob_gas is not None:
+                    next_blob_fee = blob_base_fee(next_excess_blob_gas(
+                        tip.excess_blob_gas, tip.blob_gas_used or 0
+                    ))
                 self.pool.on_canonical_state_change(
-                    calc_next_base_fee(chain[-1].block.header)
+                    calc_next_base_fee(tip), blob_base_fee=next_blob_fee
                 )
 
         self.tree.canon_listeners.append(_maintain_pool)
@@ -122,6 +129,8 @@ class Node:
         import threading
 
         shared_lock = threading.RLock()
+        # payload improvement loops must serialise with engine/RPC handlers
+        self.payload_service.lock = shared_lock
         self.eth_api = EthApi(self.tree, self.pool, config.chain_id)
         self.rpc = RpcServer(port=config.http_port, lock=shared_lock)
         self.rpc.register(self.eth_api)
@@ -131,7 +140,7 @@ class Node:
         from ..rpc.debug import DebugApi
 
         self.rpc.register(DebugApi(self.eth_api))
-        self.engine_api = EngineApi(self.tree, self.payload_service)
+        self.engine_api = EngineApi(self.tree, self.payload_service, pool=self.pool)
         # JWT on the engine port (reference auth_layer.rs): explicit secret,
         # else auto-generated jwt.hex under the datadir; dev mode stays open
         # (the reference's --dev also relaxes local tooling friction)

@@ -10,6 +10,8 @@ The built block is re-validated when the CL returns it via newPayload
 from __future__ import annotations
 
 import os
+import threading
+import time
 from dataclasses import dataclass, field
 
 from ..consensus.validation import calc_next_base_fee
@@ -42,7 +44,9 @@ def build_payload(
     parent_hash: bytes,
     attrs: PayloadAttributes,
 ) -> Block:
-    """Assemble a sealed block on top of ``parent_hash``."""
+    """Assemble a sealed block on top of ``parent_hash``; returns
+    (block, total priority fees). ``pool=None`` builds the empty-payload
+    fallback (reference BasicPayloadJob's pre-built empty payload)."""
     from ..evm.executor import MAX_BLOB_GAS_PER_BLOCK, blob_base_fee, next_excess_blob_gas
 
     overlay = tree.overlay_provider(parent_hash)
@@ -71,7 +75,9 @@ def build_payload(
     receipts: list[Receipt] = []
     cumulative_gas = 0
     blob_gas_used = 0
-    for tx in pool.best_transactions(base_fee):
+    total_fees = 0
+    txs_iter = pool.best_transactions(base_fee) if pool is not None else ()
+    for tx in txs_iter:
         if cumulative_gas + tx.gas_limit > env.gas_limit:
             continue
         if tx.blob_gas() and (
@@ -87,6 +93,7 @@ def build_payload(
             continue  # skip; pool maintenance will evict later
         cumulative_gas += result.gas_used
         blob_gas_used += tx.blob_gas()
+        total_fees += result.gas_used * max(0, tx.effective_gas_price(base_fee) - base_fee)
         selected.append(tx)
         receipts.append(Receipt(
             tx_type=tx.tx_type, success=result.success,
@@ -125,7 +132,7 @@ def build_payload(
         excess_blob_gas=excess_blob if cancun else None,
         parent_beacon_block_root=attrs.parent_beacon_block_root,
     )
-    return Block(header, tuple(selected), (), tuple(attrs.withdrawals))
+    return Block(header, tuple(selected), (), tuple(attrs.withdrawals)), total_fees
 
 
 @dataclass
@@ -136,25 +143,104 @@ class _MiniOutput:
     receipts: list
 
 
-class PayloadBuilderService:
-    """payload_id → built block store (reference PayloadBuilderService).
+class PayloadJob:
+    """One deadline-driven payload build (reference BasicPayloadJob,
+    crates/payload/basic/src/lib.rs:366).
 
-    Bounded: only the newest ``MAX_JOBS`` payloads are retained (reference
+    The first FULL build happens synchronously (so an immediate
+    getPayload already carries transactions); an improvement loop then
+    re-builds until the deadline and swaps in a payload ONLY when it
+    pays more fees. If the full build fails, the empty-payload fallback
+    keeps the job resolvable (a slot must never go blockless)."""
+
+    def __init__(self, tree, pool, parent_hash, attrs, lock, deadline: float,
+                 interval: float):
+        self.tree = tree
+        self.pool = pool
+        self.parent_hash = parent_hash
+        self.attrs = attrs
+        self.lock = lock
+        self.deadline = time.monotonic() + deadline
+        self.interval = interval
+        self.best: Block | None = None
+        self.best_fees: int = -1
+        self.rebuilds = 0
+        self._resolved = threading.Event()
+        with self.lock:
+            try:
+                self.best, self.best_fees = build_payload(
+                    tree, pool, parent_hash, attrs
+                )
+            except Exception:  # noqa: BLE001 — fall back to an empty payload
+                self.best, self.best_fees = build_payload(
+                    tree, None, parent_hash, attrs
+                )
+        self._thread = threading.Thread(target=self._improve_loop, daemon=True)
+        self._thread.start()
+
+    def rebuild(self) -> bool:
+        """One re-build; swaps only a strictly better payload. Returns
+        whether the swap happened."""
+        with self.lock:
+            if self._resolved.is_set():
+                return False
+            try:
+                block, fees = build_payload(self.tree, self.pool,
+                                            self.parent_hash, self.attrs)
+            except Exception:  # noqa: BLE001 — keep the current best
+                return False
+            self.rebuilds += 1
+            if fees > self.best_fees:
+                self.best, self.best_fees = block, fees
+                return True
+            return False
+
+    def _improve_loop(self) -> None:
+        while not self._resolved.is_set() and time.monotonic() < self.deadline:
+            if self._resolved.wait(self.interval):
+                return
+            self.rebuild()
+
+    def resolve(self) -> Block | None:
+        self._resolved.set()
+        return self.best
+
+
+class PayloadBuilderService:
+    """payload_id → deadline-driven job (reference PayloadBuilderService).
+
+    Bounded: only the newest ``MAX_JOBS`` jobs are retained (reference
     jobs resolve/expire; a CL issues one per slot)."""
 
     MAX_JOBS = 16
 
-    def __init__(self, tree: EngineTree, pool):
+    def __init__(self, tree: EngineTree, pool, lock=None,
+                 deadline: float = 2.0, interval: float = 0.25):
         self.tree = tree
         self.pool = pool
-        self.jobs: dict[bytes, Block] = {}
+        self.lock = lock or threading.RLock()
+        self.deadline = deadline
+        self.interval = interval
+        self.jobs: dict[bytes, PayloadJob] = {}
 
     def new_payload_job(self, parent_hash: bytes, attrs: PayloadAttributes) -> bytes:
         payload_id = os.urandom(8)
-        self.jobs[payload_id] = build_payload(self.tree, self.pool, parent_hash, attrs)
+        self.jobs[payload_id] = PayloadJob(
+            self.tree, self.pool, parent_hash, attrs, self.lock,
+            self.deadline, self.interval,
+        )
         while len(self.jobs) > self.MAX_JOBS:
-            self.jobs.pop(next(iter(self.jobs)))
+            self.jobs.pop(next(iter(self.jobs))).resolve()
         return payload_id
 
     def get_payload(self, payload_id: bytes) -> Block | None:
-        return self.jobs.get(payload_id)
+        block, _fees = self.get_payload_with_fees(payload_id)
+        return block
+
+    def get_payload_with_fees(self, payload_id: bytes) -> tuple[Block | None, int]:
+        """Resolve the job: (best block, its total priority fees) — the
+        fees become the engine response's blockValue."""
+        job = self.jobs.get(payload_id)
+        if job is None:
+            return None, 0
+        return job.resolve(), max(job.best_fees, 0)

@@ -61,23 +61,56 @@ class PooledTx:
 class TransactionPool:
     """State-aware pool over a read-provider factory."""
 
-    def __init__(self, state_reader, config: PoolConfig | None = None):
+    def __init__(self, state_reader, config: PoolConfig | None = None,
+                 blob_store=None):
         """``state_reader()`` → object with .account(addr) and the current
         base fee via ``state_reader.base_fee`` callable/attribute."""
+        from .blobstore import InMemoryBlobStore
+
         self.state_reader = state_reader
         self.config = config or PoolConfig()
         self.by_sender: dict[bytes, dict[int, PooledTx]] = {}
         self.by_hash: dict[bytes, PooledTx] = {}
         self._submission_counter = itertools.count()
         self.base_fee: int = 0
+        self.blob_base_fee: int = 1
+        self.blob_store = blob_store if blob_store is not None else InMemoryBlobStore()
+        # mined blob sidecars are RETAINED for a while (reorg re-broadcast +
+        # engine_getBlobs after canonicalization; reference keeps them until
+        # finalization) — bounded FIFO
+        self._mined_sidecars: list[bytes] = []
+        self.mined_sidecar_retention = 128
 
     # -- submission -----------------------------------------------------------
 
-    def add_transaction(self, tx: Transaction) -> bytes:
+    def add_blob_transaction(self, tx: Transaction, sidecar) -> bytes:
+        """Admit a type-3 tx WITH its sidecar: versioned hashes must bind
+        the commitments and every KZG blob proof must verify (reference
+        EthTransactionValidator + blobstore insert)."""
+        from .blobstore import BlobStoreError
+
+        if tx.tx_type != 3:
+            raise PoolError("not a blob transaction")
+        try:
+            sidecar.validate(tx.blob_versioned_hashes)
+        except BlobStoreError as e:
+            raise PoolError(f"invalid blob sidecar: {e}")
+        h = self.add_transaction(tx, _with_sidecar=True)
+        self.blob_store.insert(h, sidecar)
+        return h
+
+    def add_transaction(self, tx: Transaction, _with_sidecar: bool = False) -> bytes:
         """Validate + insert; returns the tx hash. Raises PoolError."""
         h = tx.hash
         if h in self.by_hash:
             raise PoolError("already known")
+        if tx.tx_type == 3:
+            if not _with_sidecar:
+                raise PoolError("blob tx requires a sidecar (add_blob_transaction)")
+            if not tx.blob_versioned_hashes:
+                raise PoolError("blob tx without blobs")
+            if tx.max_fee_per_blob_gas < self.blob_base_fee:
+                raise PoolError("max blob fee below current blob base fee")
         try:
             sender = tx.recover_sender()
         except ValueError as e:
@@ -93,6 +126,7 @@ class TransactionPool:
         if tx.nonce < nonce_on_chain:
             raise PoolError("nonce too low")
         cost = tx.gas_limit * (tx.max_fee_per_gas if tx.tx_type >= 2 else tx.gas_price) + tx.value
+        cost += tx.blob_gas() * tx.max_fee_per_blob_gas  # type-3 blob budget
         if cost > balance:
             raise PoolError("insufficient funds")
         sender_txs = self.by_sender.setdefault(sender, {})
@@ -101,7 +135,7 @@ class TransactionPool:
             bump = existing.max_fee() * (100 + MIN_PRICE_BUMP_PERCENT) // 100
             if self._fee_of(tx) < bump:
                 raise PoolError("replacement underpriced")
-            self.by_hash.pop(existing.tx.hash, None)
+            self._drop(existing.tx.hash)
         if len(sender_txs) >= self.config.max_account_slots and existing is None:
             raise PoolError("sender slot limit")
         if len(self.by_hash) >= self.config.max_pool_size:
@@ -113,6 +147,19 @@ class TransactionPool:
 
     def _fee_of(self, tx: Transaction) -> int:
         return tx.max_fee_per_gas if tx.tx_type >= 2 else tx.gas_price
+
+    def _drop(self, tx_hash: bytes, mined: bool = False) -> None:
+        self.by_hash.pop(tx_hash, None)
+        if mined and self.blob_store.get(tx_hash) is not None:
+            # keep the sidecar until the retention window evicts it
+            self._mined_sidecars.append(tx_hash)
+            while len(self._mined_sidecars) > self.mined_sidecar_retention:
+                self.blob_store.delete(self._mined_sidecars.pop(0))
+            return
+        self.blob_store.delete(tx_hash)
+
+    def get_blob_sidecar(self, tx_hash: bytes):
+        return self.blob_store.get(tx_hash)
 
     # -- queries ---------------------------------------------------------------
 
@@ -175,7 +222,7 @@ class TransactionPool:
         candidates: list[PooledTx] = []
         for sender, txs in self.by_sender.items():
             ptx = txs.get(heads[sender])
-            if ptx is not None and ptx.effective_tip(base_fee) >= 0:
+            if ptx is not None and self._executable(ptx, base_fee):
                 candidates.append(ptx)
         while candidates:
             candidates.sort(key=lambda p: (-p.effective_tip(base_fee), p.submission_id))
@@ -183,18 +230,29 @@ class TransactionPool:
             yield best.tx
             heads[best.sender] += 1
             nxt = self.by_sender[best.sender].get(heads[best.sender])
-            if nxt is not None and nxt.effective_tip(base_fee) >= 0:
+            if nxt is not None and self._executable(nxt, base_fee):
                 candidates.append(nxt)
+
+    def _executable(self, ptx: PooledTx, base_fee: int) -> bool:
+        if ptx.effective_tip(base_fee) < 0:
+            return False
+        # blob subpool gate: blob txs wait until the blob fee market allows
+        if ptx.tx.tx_type == 3 and ptx.tx.max_fee_per_blob_gas < self.blob_base_fee:
+            return False
+        return True
 
     # -- maintenance -------------------------------------------------------------
 
-    def on_canonical_state_change(self, base_fee: int) -> None:
-        """New head: drop mined/underfunded txs, update the base fee.
+    def on_canonical_state_change(self, base_fee: int,
+                                  blob_base_fee: int | None = None) -> None:
+        """New head: drop mined/underfunded txs, update the fee markets.
 
         Reference: the maintenance task (src/maintain.rs) driven by
         CanonStateNotifications.
         """
         self.base_fee = base_fee
+        if blob_base_fee is not None:
+            self.blob_base_fee = blob_base_fee
         state = self.state_reader()
         for sender in list(self.by_sender):
             acct = state.account(sender)
@@ -202,10 +260,10 @@ class TransactionPool:
             balance = acct.balance if acct else 0
             txs = self.by_sender[sender]
             for n in [n for n in txs if n < nonce]:
-                self.by_hash.pop(txs[n].tx.hash, None)
+                self._drop(txs[n].tx.hash, mined=True)
                 del txs[n]
             for n in [n for n in txs if txs[n].cost > balance]:
-                self.by_hash.pop(txs[n].tx.hash, None)
+                self._drop(txs[n].tx.hash)
                 del txs[n]
             if not txs:
                 del self.by_sender[sender]

@@ -255,3 +255,123 @@ def prove_monomial(coeffs: list[int], z: int) -> tuple[int, tuple]:
 
 def kzg_to_versioned_hash(commitment_bytes: bytes) -> bytes:
     return bytes([VERSIONED_HASH_VERSION_KZG]) + hashlib.sha256(commitment_bytes).digest()[1:]
+
+
+# ---------------------------------------------------------------------------
+# blob-level operations (EIP-4844 polynomial-in-evaluation-form)
+# ---------------------------------------------------------------------------
+#
+# A blob is FIELD_ELEMENTS evaluations of a polynomial at the roots of
+# unity in bit-reversal permutation order. The blob size tracks the active
+# setup: the mainnet ceremony file gives 4096; the insecure dev setup
+# commits to dev_blob_size()-element mini-blobs so tests can run the full
+# commit/prove/verify cycle in pure Python.
+
+_PRIMITIVE_ROOT = 7  # generator of the BLS scalar field's 2^32 subgroup
+
+
+def _bit_reverse(n: int, bits: int) -> int:
+    return int(bin(n)[2:].zfill(bits)[::-1], 2)
+
+
+@lru_cache(maxsize=4)
+def _roots_of_unity(n: int) -> tuple[int, ...]:
+    """n-th roots of unity in BIT-REVERSAL order (the 4844 blob layout)."""
+    root = pow(_PRIMITIVE_ROOT, (BLS_MODULUS - 1) // n, BLS_MODULUS)
+    seq = []
+    acc = 1
+    for _ in range(n):
+        seq.append(acc)
+        acc = acc * root % BLS_MODULUS
+    bits = n.bit_length() - 1
+    return tuple(seq[_bit_reverse(i, bits)] for i in range(n))
+
+
+def active_blob_size() -> int:
+    """Field elements per blob for the ACTIVE setup (4096 on mainnet)."""
+    n = len(active_setup().g1_monomial)
+    # largest power of two the monomial setup can commit to
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def blob_to_fields(blob: bytes) -> list[int]:
+    if len(blob) % 32:
+        raise KzgError("blob length not a multiple of 32")
+    fields = [int.from_bytes(blob[i : i + 32], "big") for i in range(0, len(blob), 32)]
+    if any(f >= BLS_MODULUS for f in fields):
+        raise KzgError("blob field element out of range")
+    return fields
+
+
+def _evals_to_coeffs(evals: list[int]) -> list[int]:
+    """Inverse DFT over the bit-reversed roots (O(n^2): dev-sized blobs)."""
+    n = len(evals)
+    roots = _roots_of_unity(n)
+    inv_n = pow(n, BLS_MODULUS - 2, BLS_MODULUS)
+    coeffs = []
+    for j in range(n):
+        s = 0
+        for i, e in enumerate(evals):
+            s += e * pow(roots[i], (BLS_MODULUS - 1 - j) % (BLS_MODULUS - 1), BLS_MODULUS)
+        coeffs.append(s % BLS_MODULUS * inv_n % BLS_MODULUS)
+    return coeffs
+
+
+def blob_to_kzg_commitment(blob: bytes) -> bytes:
+    fields = blob_to_fields(blob)
+    if len(fields) != active_blob_size():
+        raise KzgError(
+            f"blob must hold {active_blob_size()} field elements for this setup"
+        )
+    return g1_to_bytes(commit_monomial(_evals_to_coeffs(fields)))
+
+
+def _evaluate_in_evaluation_form(fields: list[int], z: int) -> int:
+    """p(z) via the barycentric formula (no coefficient conversion)."""
+    n = len(fields)
+    roots = _roots_of_unity(n)
+    for i, w in enumerate(roots):
+        if w == z % BLS_MODULUS:
+            return fields[i]
+    total = 0
+    for i, w in enumerate(roots):
+        total += fields[i] * w % BLS_MODULUS * pow(z - w, BLS_MODULUS - 2, BLS_MODULUS)
+    zn = (pow(z, n, BLS_MODULUS) - 1) % BLS_MODULUS
+    inv_n = pow(n, BLS_MODULUS - 2, BLS_MODULUS)
+    return total % BLS_MODULUS * zn % BLS_MODULUS * inv_n % BLS_MODULUS
+
+
+def compute_blob_kzg_proof(blob: bytes, commitment_bytes: bytes) -> bytes:
+    """Proof of evaluation at the Fiat-Shamir challenge (spec scheme)."""
+    fields = blob_to_fields(blob)
+    z = _blob_challenge(blob, commitment_bytes)
+    coeffs = _evals_to_coeffs(fields)
+    _y, proof = prove_monomial(coeffs, z)
+    return g1_to_bytes(proof)
+
+
+def verify_blob_kzg_proof(blob: bytes, commitment_bytes: bytes,
+                          proof_bytes: bytes) -> bool:
+    try:
+        fields = blob_to_fields(blob)
+        commitment = g1_from_bytes(commitment_bytes)
+        proof = g1_from_bytes(proof_bytes)
+    except KzgError:
+        return False
+    if len(fields) != active_blob_size():
+        return False
+    z = _blob_challenge(blob, commitment_bytes)
+    y = _evaluate_in_evaluation_form(fields, z)
+    return verify_kzg_proof(commitment, z, y, proof)
+
+
+def _blob_challenge(blob: bytes, commitment_bytes: bytes) -> int:
+    """Fiat-Shamir evaluation point binding blob + commitment
+    (consensus-specs compute_challenge: domain || degree as 16-byte
+    BIG-endian || blob || commitment, hashed to a field element)."""
+    n = len(blob) // 32
+    data = b"FSBLOBVERIFY_V1_" + n.to_bytes(16, "big") + blob + commitment_bytes
+    return int.from_bytes(hashlib.sha256(data).digest(), "big") % BLS_MODULUS

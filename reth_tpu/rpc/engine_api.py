@@ -15,6 +15,9 @@ from .server import RpcError
 
 CAPABILITIES = [
     "engine_newPayloadV1", "engine_newPayloadV2", "engine_newPayloadV3",
+    "engine_newPayloadV4", "engine_newPayloadV5",
+    "engine_getPayloadV4", "engine_getPayloadV5",
+    "engine_getBlobsV1", "engine_getBlobsV2",
     "engine_forkchoiceUpdatedV1", "engine_forkchoiceUpdatedV2",
     "engine_forkchoiceUpdatedV3",
     "engine_getPayloadV1", "engine_getPayloadV2", "engine_getPayloadV3",
@@ -104,10 +107,22 @@ def block_to_payload(block: Block) -> dict:
     return out
 
 
+def compute_requests_hash(requests: list[bytes]) -> bytes:
+    """EIP-7685: sha256 over the sha256 of each non-empty request item."""
+    import hashlib
+
+    acc = b"".join(
+        hashlib.sha256(r).digest() for r in requests if len(r) > 1
+    )
+    return hashlib.sha256(acc).digest()
+
+
 class EngineApi:
-    def __init__(self, tree: EngineTree, payload_service: PayloadBuilderService | None = None):
+    def __init__(self, tree: EngineTree, payload_service: PayloadBuilderService | None = None,
+                 pool=None):
         self.tree = tree
         self.payloads = payload_service
+        self.pool = pool  # blob sidecars for getPayload bundles + getBlobs
 
     def _status_json(self, st) -> dict:
         return {
@@ -133,7 +148,44 @@ class EngineApi:
                 "parent_beacon_block_root": parse_data(parent_beacon_root),
             })
             block = Block(header, block.transactions, (), block.withdrawals)
+        bad = self._check_blob_hashes(block, blob_hashes)
+        if bad is not None:
+            return bad
         return self._check_hash_and_insert(block, payload)
+
+    def engine_newPayloadV4(self, payload, blob_hashes=None, parent_beacon_root=None,
+                            execution_requests=None):
+        """Prague: V3 + EIP-7685 execution requests (requests_hash header)."""
+        block = payload_to_block(payload, self.tree.committer)
+        extra = {}
+        if parent_beacon_root is not None:
+            extra["parent_beacon_block_root"] = parse_data(parent_beacon_root)
+        requests = [parse_data(r) for r in (execution_requests or [])]
+        extra["requests_hash"] = compute_requests_hash(requests)
+        header = Header(**{**block.header.__dict__, **extra})
+        block = Block(header, block.transactions, (), block.withdrawals)
+        bad = self._check_blob_hashes(block, blob_hashes)
+        if bad is not None:
+            return bad
+        return self._check_hash_and_insert(block, payload)
+
+    def engine_newPayloadV5(self, payload, blob_hashes=None, parent_beacon_root=None,
+                            execution_requests=None):
+        return self.engine_newPayloadV4(payload, blob_hashes, parent_beacon_root,
+                                        execution_requests)
+
+    def _check_blob_hashes(self, block: Block, blob_hashes):
+        """Cancun rule: the CL-provided versioned hashes must equal the
+        concatenated blob hashes of the payload's type-3 txs, in order."""
+        want = [h for tx in block.transactions for h in tx.blob_versioned_hashes]
+        got = [parse_data(h) for h in (blob_hashes or [])]
+        if want != got:
+            return {
+                "status": "INVALID",
+                "latestValidHash": None,
+                "validationError": "blob versioned hashes mismatch",
+            }
+        return None
 
     def _new_payload(self, payload):
         return self._check_hash_and_insert(
@@ -225,22 +277,72 @@ class EngineApi:
         return self._get_payload(payload_id)["executionPayload"]
 
     def engine_getPayloadV2(self, payload_id):
-        return self._get_payload(payload_id)
+        out = self._get_payload(payload_id)
+        out.pop("_block", None)
+        return out
 
     def engine_getPayloadV3(self, payload_id):
         out = self._get_payload(payload_id)
-        out["blobsBundle"] = {"commitments": [], "proofs": [], "blobs": []}
+        out["blobsBundle"] = self._blobs_bundle(out.pop("_block"))
         out["shouldOverrideBuilder"] = False
         return out
+
+    def engine_getPayloadV4(self, payload_id):
+        out = self.engine_getPayloadV3(payload_id)
+        out["executionRequests"] = []
+        return out
+
+    def engine_getPayloadV5(self, payload_id):
+        return self.engine_getPayloadV4(payload_id)
+
+    def _blobs_bundle(self, block) -> dict:
+        """Sidecars of every included blob tx, concatenated in tx order.
+
+        A payload whose blob tx lost its sidecar is unshippable — the CL
+        would propose a block with mismatched blob counts and lose the
+        slot — so that is an ERROR, never a silently short bundle."""
+        blobs, commitments, proofs = [], [], []
+        if block is not None:
+            for tx in block.transactions:
+                if tx.tx_type != 3:
+                    continue
+                sc = self.pool.get_blob_sidecar(tx.hash) if self.pool else None
+                if sc is None:
+                    raise RpcError(
+                        -38001, f"blob sidecar unavailable for tx {tx.hash.hex()}"
+                    )
+                blobs += [data(b) for b in sc.blobs]
+                commitments += [data(c) for c in sc.commitments]
+                proofs += [data(p) for p in sc.proofs]
+        return {"commitments": commitments, "proofs": proofs, "blobs": blobs}
+
+    def engine_getBlobsV1(self, versioned_hashes):
+        """BlobAndProofV1 (or null) per requested hash, from the pool store."""
+        if self.pool is None:
+            return [None] * len(versioned_hashes)
+        found = self.pool.blob_store.by_versioned_hashes(
+            [parse_data(h) for h in versioned_hashes]
+        )
+        return [
+            None if f is None else {"blob": data(f[0]), "proof": data(f[1])}
+            for f in found
+        ]
+
+    def engine_getBlobsV2(self, versioned_hashes):
+        """Fulu shape: ALL requested blobs or null (no partial responses)."""
+        out = self.engine_getBlobsV1(versioned_hashes)
+        if any(f is None for f in out):
+            return None
+        return [{"blob": f["blob"], "proofs": [f["proof"]]} for f in out]
 
     def _get_payload(self, payload_id):
         if self.payloads is None:
             raise RpcError(-38003, "payload building not configured")
-        block = self.payloads.get_payload(parse_data(payload_id))
+        block, fees = self.payloads.get_payload_with_fees(parse_data(payload_id))
         if block is None:
             raise RpcError(-38001, "unknown payload")
-        fees = 0
         return {
             "executionPayload": block_to_payload(block),
             "blockValue": qty(fees),
+            "_block": block,  # internal: V3+ pop it for the blobs bundle
         }

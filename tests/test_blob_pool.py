@@ -1,0 +1,210 @@
+"""EIP-4844 blob pool + engine V4/V5 + getBlobs.
+
+Blob math runs on the insecure dev KZG setup (mini-blobs sized to the
+setup) — the same commit/prove/verify cycle as mainnet 4096-element
+blobs, at test scale.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from reth_tpu.pool.blobstore import (
+    BlobSidecar,
+    BlobStoreError,
+    DiskBlobStore,
+    InMemoryBlobStore,
+)
+from reth_tpu.pool.pool import PoolError, TransactionPool
+from reth_tpu.primitives import kzg
+from reth_tpu.primitives.types import Account, Transaction
+from reth_tpu.testing import Wallet
+
+
+def _mini_blob(seed: int) -> bytes:
+    n = kzg.active_blob_size()
+    return b"".join(
+        ((seed * 1000 + i) % kzg.BLS_MODULUS).to_bytes(32, "big") for i in range(n)
+    )
+
+
+def make_sidecar(n_blobs=1, seed=1) -> BlobSidecar:
+    blobs, commitments, proofs = [], [], []
+    for i in range(n_blobs):
+        blob = _mini_blob(seed + i)
+        c = kzg.blob_to_kzg_commitment(blob)
+        p = kzg.compute_blob_kzg_proof(blob, c)
+        blobs.append(blob)
+        commitments.append(c)
+        proofs.append(p)
+    return BlobSidecar(tuple(blobs), tuple(commitments), tuple(proofs))
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    return make_sidecar(n_blobs=2)
+
+
+# -- KZG blob math -----------------------------------------------------------
+
+
+def test_blob_proof_verifies_and_tamper_fails(sidecar):
+    blob, c, p = sidecar.blobs[0], sidecar.commitments[0], sidecar.proofs[0]
+    assert kzg.verify_blob_kzg_proof(blob, c, p)
+    bad = bytearray(blob)
+    bad[40] ^= 1
+    assert not kzg.verify_blob_kzg_proof(bytes(bad), c, p)
+    assert not kzg.verify_blob_kzg_proof(blob, sidecar.commitments[1], p)
+
+
+def test_sidecar_validate_binds_versioned_hashes(sidecar):
+    sidecar.validate(sidecar.versioned_hashes())
+    with pytest.raises(BlobStoreError, match="versioned hashes"):
+        sidecar.validate(tuple(reversed(sidecar.versioned_hashes())))
+
+
+def test_sidecar_codec_roundtrip(sidecar):
+    assert BlobSidecar.decode(sidecar.encode()) == sidecar
+
+
+# -- stores ------------------------------------------------------------------
+
+
+def test_disk_store_roundtrip(tmp_path, sidecar):
+    store = DiskBlobStore(tmp_path)
+    store.insert(b"\x01" * 32, sidecar)
+    # cold read (fresh instance = no cache)
+    cold = DiskBlobStore(tmp_path)
+    assert cold.get(b"\x01" * 32) == sidecar
+    assert cold.get(b"\x02" * 32) is None
+    cold.delete(b"\x01" * 32)
+    assert DiskBlobStore(tmp_path).get(b"\x01" * 32) is None
+
+
+def test_by_versioned_hashes(sidecar):
+    store = InMemoryBlobStore()
+    store.insert(b"\x01" * 32, sidecar)
+    vh = sidecar.versioned_hashes()
+    got = store.by_versioned_hashes([vh[1], b"\x01" + b"\x00" * 31, vh[0]])
+    assert got[0] == (sidecar.blobs[1], sidecar.proofs[1])
+    assert got[1] is None
+    assert got[2] == (sidecar.blobs[0], sidecar.proofs[0])
+
+
+# -- pool --------------------------------------------------------------------
+
+
+class _State:
+    def __init__(self, accounts):
+        self._a = accounts
+
+    def account(self, addr):
+        return self._a.get(addr)
+
+
+def _blob_tx(wallet, sidecar, nonce=0, max_blob_fee=100):
+    return wallet.sign_tx(Transaction(
+        tx_type=3, chain_id=1, nonce=nonce, max_fee_per_gas=10**10,
+        max_priority_fee_per_gas=10**9, gas_limit=21_000, to=b"\x20" * 20,
+        max_fee_per_blob_gas=max_blob_fee,
+        blob_versioned_hashes=sidecar.versioned_hashes(),
+    ), bump_nonce=False)
+
+
+@pytest.fixture
+def pool_and_wallet():
+    w = Wallet(0xB10B)
+    pool = TransactionPool(lambda: _State({w.address: Account(balance=10**21)}))
+    pool.base_fee = 10**9
+    return pool, w
+
+
+def test_pool_admits_valid_blob_tx(pool_and_wallet, sidecar):
+    pool, w = pool_and_wallet
+    tx = _blob_tx(w, sidecar)
+    h = pool.add_blob_transaction(tx, sidecar)
+    assert pool.contains(h)
+    assert pool.get_blob_sidecar(h) == sidecar
+    assert [t.hash for t in pool.best_transactions()] == [h]
+
+
+def test_pool_rejects_blob_tx_without_sidecar(pool_and_wallet, sidecar):
+    pool, w = pool_and_wallet
+    with pytest.raises(PoolError, match="sidecar"):
+        pool.add_transaction(_blob_tx(w, sidecar))
+
+
+def test_pool_rejects_bad_sidecar(pool_and_wallet, sidecar):
+    pool, w = pool_and_wallet
+    bad = BlobSidecar(sidecar.blobs, tuple(reversed(sidecar.commitments)),
+                      sidecar.proofs)
+    with pytest.raises(PoolError, match="sidecar"):
+        pool.add_blob_transaction(_blob_tx(w, sidecar), bad)
+
+
+def test_blob_fee_market_gates_execution(pool_and_wallet, sidecar):
+    pool, w = pool_and_wallet
+    tx = _blob_tx(w, sidecar, max_blob_fee=5)
+    h = pool.add_blob_transaction(tx, sidecar)
+    pool.on_canonical_state_change(10**9, blob_base_fee=50)
+    assert list(pool.best_transactions()) == []  # blob-fee gated
+    pool.on_canonical_state_change(10**9, blob_base_fee=3)
+    assert [t.hash for t in pool.best_transactions()] == [h]
+
+
+def test_mined_sidecar_retained_then_evicted(sidecar):
+    """Mined blob txs leave the pool but their sidecars stay for a
+    retention window (reorg re-broadcast + engine_getBlobs after
+    canonicalization — reference keeps them until finalization); the
+    bounded FIFO evicts the oldest beyond the window."""
+    w1, w2 = Wallet(0xB10B), Wallet(0xB20B)
+    accounts = {w1.address: Account(balance=10**21),
+                w2.address: Account(balance=10**21)}
+    pool = TransactionPool(lambda: _State(accounts))
+    pool.base_fee = 10**9
+    pool.mined_sidecar_retention = 1
+    h1 = pool.add_blob_transaction(_blob_tx(w1, sidecar), sidecar)
+    h2 = pool.add_blob_transaction(_blob_tx(w2, sidecar), sidecar)
+    # both mined: nonces advance
+    accounts[w1.address] = Account(nonce=1, balance=10**21)
+    accounts[w2.address] = Account(nonce=1, balance=10**21)
+    pool.on_canonical_state_change(10**9)
+    assert not pool.contains(h1) and not pool.contains(h2)
+    retained = [h for h in (h1, h2) if pool.get_blob_sidecar(h) is not None]
+    assert len(retained) == 1  # window of 1: newest kept, oldest evicted
+
+
+# -- engine API ---------------------------------------------------------------
+
+
+def test_engine_get_blobs(pool_and_wallet, sidecar):
+    from reth_tpu.rpc.engine_api import EngineApi
+
+    pool, w = pool_and_wallet
+    pool.add_blob_transaction(_blob_tx(w, sidecar), sidecar)
+    api = EngineApi(tree=None, payload_service=None, pool=pool)
+    vh = sidecar.versioned_hashes()
+    got = api.engine_getBlobsV1(["0x" + vh[0].hex(), "0x" + b"\x01".ljust(32, b"\x00").hex()])
+    assert got[0] == {"blob": "0x" + sidecar.blobs[0].hex(),
+                      "proof": "0x" + sidecar.proofs[0].hex()}
+    assert got[1] is None
+    # V2: all-or-nothing
+    assert api.engine_getBlobsV2(["0x" + vh[0].hex(), "0x" + b"\x02".ljust(32, b"\x00").hex()]) is None
+    v2 = api.engine_getBlobsV2(["0x" + vh[0].hex(), "0x" + vh[1].hex()])
+    assert v2 is not None and v2[1]["proofs"] == ["0x" + sidecar.proofs[1].hex()]
+
+
+def test_requests_hash():
+    import hashlib
+
+    from reth_tpu.rpc.engine_api import compute_requests_hash
+
+    r0, r1 = b"\x00" + b"dep", b"\x01" + b"wd"
+    want = hashlib.sha256(
+        hashlib.sha256(r0).digest() + hashlib.sha256(r1).digest()
+    ).digest()
+    assert compute_requests_hash([r0, r1]) == want
+    # empty/one-byte requests are skipped per EIP-7685
+    assert compute_requests_hash([r0, b"\x02"]) == hashlib.sha256(
+        hashlib.sha256(r0).digest()
+    ).digest()

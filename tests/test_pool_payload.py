@@ -124,6 +124,44 @@ def test_gas_limit_respected():
     # fixed, so instead check cumulative gas never exceeds the limit
     for i in range(5):
         pool.add_transaction(alice.transfer(bob.address, i + 1))
-    block = build_payload(tree, pool, tree.head_hash, PayloadAttributes(timestamp=12))
+    block, _fees = build_payload(tree, pool, tree.head_hash, PayloadAttributes(timestamp=12))
     assert block.header.gas_used == 5 * 21000
     assert block.header.gas_used <= block.header.gas_limit
+
+def test_payload_job_better_payload_swap():
+    """Deadline-driven job: first build is synchronous; later rebuilds swap
+    only strictly-better payloads (reference BasicPayloadJob semantics)."""
+    tree, pool, alice, _bob = make_node()
+    svc = PayloadBuilderService(tree, pool, deadline=5.0, interval=10.0)
+    pool.add_transaction(alice.transfer(b"\x01" * 20, 100))
+    pid = svc.new_payload_job(tree.head_hash, PayloadAttributes(timestamp=12))
+    job = svc.jobs[pid]
+    assert len(job.best.transactions) == 1  # synchronous first build
+    fees_before = job.best_fees
+    # a juicier tx arrives: an explicit rebuild must swap
+    pool.add_transaction(alice.transfer(b"\x02" * 20, 100,
+                                        max_priority_fee_per_gas=5 * 10**9))
+    assert job.rebuild() is True
+    assert job.best_fees > fees_before
+    assert len(job.best.transactions) == 2
+    best_fees = job.best_fees
+    # nothing new: rebuild must NOT swap (equal fees is not better)
+    assert job.rebuild() is False
+    assert job.best_fees == best_fees
+    block = svc.get_payload(pid)  # resolve stops the job
+    assert len(block.transactions) == 2
+    assert job.rebuild() is False  # resolved jobs are frozen
+
+
+def test_payload_job_empty_fallback():
+    """A failing full build must still yield an (empty) payload."""
+    tree, pool, _alice, _bob = make_node()
+
+    class ExplodingPool:
+        def best_transactions(self, base_fee=None):
+            raise RuntimeError("pool exploded")
+
+    svc = PayloadBuilderService(tree, ExplodingPool(), deadline=0.1)
+    pid = svc.new_payload_job(tree.head_hash, PayloadAttributes(timestamp=12))
+    block = svc.get_payload(pid)
+    assert block is not None and len(block.transactions) == 0
