@@ -147,6 +147,35 @@ def cmd_import(args):
     return 0
 
 
+def cmd_import_era(args):
+    from .consensus import EthBeaconConsensus
+    from .era import import_era
+    from .node import Node, NodeConfig
+    from .stages import Pipeline, default_stages
+
+    committer = _make_committer(args)
+    header, alloc, storage, codes, chain_id = _load_genesis(args.genesis, committer)
+    cfg = NodeConfig(chain_id=chain_id, datadir=args.datadir, genesis_header=header,
+                     genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes)
+    node = Node(cfg, committer=committer)
+    tip = import_era(node.factory, args.file, EthBeaconConsensus(node.committer))
+    print(f"imported era1 file, tip={tip}")
+    Pipeline(node.factory, default_stages(committer=node.committer)).run(tip)
+    node.factory.db.flush()
+    print(f"pipeline synced to {tip}")
+    return 0
+
+
+def cmd_export_era(args):
+    from .era import export_era
+    from .storage import MemDb, ProviderFactory
+
+    factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
+    n = export_era(factory, args.first, args.last, args.file)
+    print(f"exported {n} blocks to {args.file}")
+    return 0
+
+
 def cmd_node(args):
     from .node import Node, NodeConfig
 
@@ -295,6 +324,19 @@ def main(argv=None) -> int:
     p.add_argument("file")
     add_hasher(p)
     p.set_defaults(fn=cmd_import)
+
+    p = sub.add_parser("import-era", help="import an era1 history archive")
+    p.add_argument("--datadir", required=True)
+    p.add_argument("--genesis", required=True)
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_import_era)
+
+    p = sub.add_parser("export-era", help="export canonical blocks to era1")
+    p.add_argument("--datadir", required=True)
+    p.add_argument("--first", type=int, required=True)
+    p.add_argument("--last", type=int, required=True)
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_export_era)
 
     p = sub.add_parser("node", help="run the node (RPC + engine API)")
     p.add_argument("--datadir", default=None)

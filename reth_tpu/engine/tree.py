@@ -71,12 +71,16 @@ class EngineTree:
         config: EvmConfig | None = None,
         persistence_threshold: int = 2,
         unwinder=None,
+        invalid_block_hooks: list | None = None,
     ):
         self.factory = factory
         self.committer = committer or TrieCommitter()
         self.consensus = consensus or EthBeaconConsensus(self.committer)
         self.config = config or EvmConfig()
         self.persistence_threshold = persistence_threshold
+        # called with (block, reason, out=None, computed_root=None) whenever
+        # a payload is rejected (reference InvalidBlockHook, witness.rs)
+        self.invalid_block_hooks = list(invalid_block_hooks or [])
         if unwinder is None:
             def unwinder(fac, target):
                 from ..stages import Pipeline, default_stages
@@ -188,6 +192,7 @@ class EngineTree:
             status, senders, receipts = self._execute_into_overlay(block, overlay)
         except (ConsensusError, InvalidTransaction) as e:
             self.invalid[h] = str(e)
+            self._run_invalid_hooks(block, str(e))
             return PayloadStatus(PayloadStatusKind.INVALID, None, str(e))
         if status.status is PayloadStatusKind.VALID:
             self.blocks[h] = ExecutedBlock(
@@ -227,6 +232,7 @@ class EngineTree:
             senders = [tx.recover_sender() for tx in block.transactions]
         except ValueError as e:
             self.invalid[block.hash] = f"bad signature: {e}"
+            self._run_invalid_hooks(block, f"bad signature: {e}")
             return PayloadStatus(PayloadStatusKind.INVALID, None, str(e)), [], []
         # pipelined root: a worker batch-hashes dirty keys on the device
         # WHILE execution runs (reference state_root_task / sparse_trie
@@ -245,6 +251,7 @@ class EngineTree:
         except ConsensusError as e:
             root_job.finish([])
             self.invalid[block.hash] = str(e)
+            self._run_invalid_hooks(block, str(e), out)
             return PayloadStatus(PayloadStatusKind.INVALID, None, str(e)), [], []
         # body + execution output into the overlay layer
         overlay.insert_header(header)
@@ -264,8 +271,16 @@ class EngineTree:
                 f"{header.state_root.hex()}"
             )
             self.invalid[block.hash] = msg
+            self._run_invalid_hooks(block, msg, out, computed_root=root)
             return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
         return PayloadStatus(PayloadStatusKind.VALID, block.hash), senders, out.receipts
+
+    def _run_invalid_hooks(self, block, reason, out=None, computed_root=None):
+        for hook in self.invalid_block_hooks:
+            try:
+                hook(block, reason, out=out, computed_root=computed_root)
+            except Exception:  # noqa: BLE001 — diagnostics must never kill consensus
+                pass
 
     def _state_root_job(self, overlay: DatabaseProvider, out, root_job=None) -> bytes:
         """Hash the block's state delta and commit the trie incrementally.

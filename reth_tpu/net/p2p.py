@@ -26,6 +26,10 @@ class PeerError(Exception):
     pass
 
 
+class PeerDisconnected(PeerError):
+    """Graceful devp2p Disconnect — not a protocol violation."""
+
+
 class PeerConnection:
     """One established encrypted peer session (RLPx + Hello + Status)."""
 
@@ -64,7 +68,7 @@ class PeerConnection:
             if mid == PONG_ID:
                 continue
             if mid == DISCONNECT_ID:
-                raise PeerError("peer disconnected")
+                raise PeerDisconnected("peer disconnected")
             raise PeerError(f"unexpected p2p message {mid:#x}")
 
     # -- handshake -------------------------------------------------------------

@@ -13,7 +13,7 @@ import threading
 
 from ..primitives.secp256k1 import pubkey_from_bytes
 from . import wire
-from .p2p import PeerConnection, PeerError, random_node_key
+from .p2p import PeerConnection, PeerDisconnected, PeerError, random_node_key
 from .rlpx import node_id as rlpx_node_id
 from .wire import Status
 
@@ -40,6 +40,9 @@ class NetworkManager:
         self.port = port
         self.node_priv = node_priv or random_node_key()
         self.peers: list[PeerConnection] = []
+        from .reputation import PeersManager
+
+        self.peers_manager = PeersManager()
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -52,6 +55,10 @@ class NetworkManager:
     def connect_to(self, enode_url: str, timeout: float = 10.0) -> PeerConnection:
         """Dial a peer by enode URL (encrypted RLPx session)."""
         pub, host, port = parse_enode(enode_url)
+        from ..primitives.secp256k1 import pubkey_to_bytes
+
+        if self.peers_manager.is_banned(pubkey_to_bytes(pub)):
+            raise PeerError("peer is banned")
         peer = PeerConnection.connect(host, port, self.status, pub,
                                       node_priv=self.node_priv, timeout=timeout)
         self.peers.append(peer)
@@ -87,6 +94,10 @@ class NetworkManager:
                 # the accept loop (a dead listener = no inbound peers ever)
                 sock.close()
                 continue
+            if self.peers_manager.is_banned(peer.node_id):
+                peer.session.disconnect(0x05)  # banned: refuse the session
+                peer.close()
+                continue
             self.peers.append(peer)
             t = threading.Thread(target=self._serve_peer, args=(peer,), daemon=True)
             t.start()
@@ -100,6 +111,12 @@ class NetworkManager:
                 try:
                     msg = peer.recv()
                     self._handle(peer, msg)
+                except PeerDisconnected:
+                    break  # graceful goodbye: no penalty
+                except PeerError:
+                    # protocol violation: penalize (bans past the threshold)
+                    self.peers_manager.reputation_change(peer.node_id, "bad_message")
+                    break
                 except Exception:  # noqa: BLE001 — malformed frame/request
                     break          # drops the peer; cleanup in finally
         finally:

@@ -1,0 +1,99 @@
+"""Era1 archives: e2store records, framed snappy, export -> import -> sync."""
+
+from __future__ import annotations
+
+import io
+
+import pytest
+
+from reth_tpu.consensus import EthBeaconConsensus
+from reth_tpu.era import (
+    Era1Group,
+    EraError,
+    crc32c,
+    export_era,
+    import_era,
+    read_era1,
+    read_records,
+    snappy_frame_compress,
+    snappy_frame_decompress,
+    write_era1,
+    write_record,
+)
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.stages import Pipeline, default_stages
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import import_chain, init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def test_crc32c_check_value():
+    # the standard CRC-32C check vector
+    assert crc32c(b"123456789") == 0xE3069283
+
+
+@pytest.mark.parametrize("payload", [b"", b"x", b"hello " * 1000, bytes(range(256)) * 300])
+def test_snappy_framed_roundtrip(payload):
+    assert snappy_frame_decompress(snappy_frame_compress(payload)) == payload
+
+
+def test_snappy_framed_rejects_corruption():
+    framed = bytearray(snappy_frame_compress(b"data" * 100))
+    framed[-1] ^= 0xFF
+    with pytest.raises(EraError):
+        snappy_frame_decompress(bytes(framed))
+
+
+def test_e2store_records_roundtrip():
+    buf = io.BytesIO()
+    write_record(buf, 0x03, b"abc")
+    write_record(buf, 0x3265, b"")
+    got = list(read_records(buf.getvalue()))
+    assert got == [(0x03, b"abc"), (0x3265, b"")]
+
+
+def _synced_chain(n_blocks=4):
+    alice = Wallet(0xE5A)
+    bld = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    for i in range(n_blocks):
+        bld.build_block([alice.transfer(bytes([i + 1] * 20), 1000 + i)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, bld.genesis, bld.accounts_at_genesis, committer=CPU)
+    import_chain(factory, bld.blocks[1:], EthBeaconConsensus(CPU))
+    Pipeline(factory, default_stages(committer=CPU)).run(n_blocks)
+    return factory, bld
+
+
+def test_era1_file_roundtrip(tmp_path):
+    factory, bld = _synced_chain()
+    path = tmp_path / "chain-0.era1"
+    n = export_era(factory, 1, 4, path)
+    assert n == 4
+    group = read_era1(path)
+    assert group.start_block == 1
+    assert [b.hash for b in group.blocks] == [b.hash for b in bld.blocks[1:]]
+    assert all(len(r) == 1 for r in group.receipts)  # one tx per block
+
+
+def test_era1_import_syncs_fresh_node(tmp_path):
+    factory, bld = _synced_chain()
+    path = tmp_path / "chain-0.era1"
+    export_era(factory, 1, 4, path)
+
+    fresh = ProviderFactory(MemDb())
+    init_genesis(fresh, bld.genesis, bld.accounts_at_genesis, committer=CPU)
+    tip = import_era(fresh, path, EthBeaconConsensus(CPU))
+    assert tip == 4
+    Pipeline(fresh, default_stages(committer=CPU)).run(tip)
+    with fresh.provider() as p:
+        assert p.header_by_number(4).state_root == bld.tip.state_root
+
+
+def test_era1_write_rejects_oversize(tmp_path):
+    with pytest.raises(EraError, match="at most"):
+        write_era1(tmp_path / "x.era1",
+                   Era1Group(0, [None] * 8193, [None] * 8193, [0] * 8193))

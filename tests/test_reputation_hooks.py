@@ -1,0 +1,70 @@
+"""Peer reputation/banlist + invalid-block witness hooks."""
+
+from __future__ import annotations
+
+import json
+
+from reth_tpu.engine import EngineTree
+from reth_tpu.engine.invalid_hooks import InvalidBlockWitnessHook
+from reth_tpu.net.reputation import BANNED_REPUTATION, PeersManager
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.primitives.types import Block, Header
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def test_reputation_penalties_and_ban():
+    pm = PeersManager(ban_seconds=9999)
+    nid = b"\x01" * 64
+    assert not pm.is_banned(nid)
+    for _ in range(3):
+        pm.reputation_change(nid, "bad_block")
+    assert pm.reputation(nid) <= BANNED_REPUTATION
+    assert pm.is_banned(nid)
+    pm.unban(nid)
+    assert not pm.is_banned(nid)
+    assert pm.reputation(nid) == 0
+
+
+def test_ban_expires():
+    pm = PeersManager(ban_seconds=0.0)  # instant expiry
+    nid = b"\x02" * 64
+    pm.ban(nid)
+    assert not pm.is_banned(nid)  # already served
+    assert pm.reputation(nid) == 0
+
+
+def test_good_behavior_offsets_penalties():
+    pm = PeersManager()
+    nid = b"\x03" * 64
+    pm.reputation_change(nid, "timeout")
+    pm.reputation_change(nid, "good")
+    assert pm.reputation(nid) > -4_00
+
+
+def test_invalid_block_hook_writes_witness(tmp_path):
+    alice = Wallet(0xA11CE)
+    bld = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    good = bld.build_block([alice.transfer(b"\x22" * 20, 5)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, bld.genesis, bld.accounts_at_genesis, committer=CPU)
+    hook = InvalidBlockWitnessHook(tmp_path / "invalid")
+    tree = EngineTree(factory, committer=CPU, invalid_block_hooks=[hook])
+    # corrupt the state root: executes fine, roots diverge
+    bad_header = Header(**{**good.header.__dict__, "state_root": b"\x66" * 32})
+    bad = Block(bad_header, good.transactions, (), good.withdrawals)
+    status = tree.on_new_payload(bad)
+    assert status.status.name == "INVALID"
+    files = list((tmp_path / "invalid").glob("*.json"))
+    assert len(files) == 1
+    witness = json.loads(files[0].read_text())
+    assert witness["blockHash"] == "0x" + bad.hash.hex()
+    assert "state root mismatch" in witness["reason"]
+    assert witness["computedStateRoot"] != witness["headerStateRoot"]
+    assert witness["blockRlp"].startswith("0x")
+    assert witness["postAccounts"], "expected the execution delta"
