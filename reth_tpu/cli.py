@@ -225,22 +225,24 @@ def cmd_node(args):
     print(f"RPC listening on 127.0.0.1:{http_port}, engine API on 127.0.0.1:{auth_port}")
     if args.dev and args.block_time > 0:
         print(f"dev mode: mining every {args.block_time}s")
-        try:
-            while True:
-                time.sleep(args.block_time)
+
+        def mine_loop(shutdown):
+            while not shutdown.wait(args.block_time):
                 block = node.miner.mine_block(timestamp=int(time.time()))
                 print(f"mined block {block.header.number} "
                       f"({len(block.transactions)} txs) 0x{block.hash.hex()[:16]}")
-        except KeyboardInterrupt:
+
+        node.tasks.spawn_critical("dev-miner", mine_loop)
+    try:
+        while not node.tasks.shutdown.wait(1.0):
             pass
-    else:
-        try:
-            while True:
-                time.sleep(3600)
-        except KeyboardInterrupt:
-            pass
+    except KeyboardInterrupt:
+        pass
     node.stop()
-    return 0
+    errors = node.tasks.critical_errors()
+    for name, err in errors:
+        print(f"critical task {name} failed: {err}", file=sys.stderr)
+    return 1 if errors else 0
 
 
 def cmd_db_verify_trie(args):

@@ -81,6 +81,14 @@ class EngineTree:
         # called with (block, reason, out=None, computed_root=None) whenever
         # a payload is rejected (reference InvalidBlockHook, witness.rs)
         self.invalid_block_hooks = list(invalid_block_hooks or [])
+        # cross-block execution cache, anchored to the chain tip it was
+        # warmed on (reference crates/engine/execution-cache SavedCache);
+        # a payload extending a different parent resets it — stale reads
+        # would be a consensus bug, so precision beats warmth
+        from .execution_cache import ExecutionCache
+
+        self.execution_cache = ExecutionCache()
+        self._cache_anchor: bytes | None = None
         if unwinder is None:
             def unwinder(fac, target):
                 from ..stages import Pipeline, default_stages
@@ -222,7 +230,15 @@ class EngineTree:
         header = block.header
         n = header.number
         # execute (senders recovered here = SenderRecovery equivalent)
-        executor = BlockExecutor(ProviderStateSource(overlay), self.config)
+        from .execution_cache import CachedStateSource
+
+        if self._cache_anchor != header.parent_hash:
+            self.execution_cache = type(self.execution_cache)()  # reset
+            # the fresh cache is warmed with THIS parent's state: anchor it
+            # now, or a failed sibling would leave cache/anchor divergent
+            self._cache_anchor = header.parent_hash
+        source = CachedStateSource(ProviderStateSource(overlay), self.execution_cache)
+        executor = BlockExecutor(source, self.config)
         hashes = {}
         for k in range(max(0, n - 256), n):
             bh = overlay.canonical_hash(k)
@@ -273,6 +289,10 @@ class EngineTree:
             self.invalid[block.hash] = msg
             self._run_invalid_hooks(block, msg, out, computed_root=root)
             return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
+        # advance the execution cache: invalidate this block's writes and
+        # anchor the warm cache on the new tip
+        self.execution_cache.on_block_applied(out.changes)
+        self._cache_anchor = block.hash
         return PayloadStatus(PayloadStatusKind.VALID, block.hash), senders, out.receipts
 
     def _run_invalid_hooks(self, block, reason, out=None, computed_root=None):

@@ -104,6 +104,8 @@ def snappy_frame_decompress(data: bytes) -> bytes:
             raise EraError("truncated frame body")
         i += ln
         if kind in (0x00, 0x01):
+            if len(body) < 4:
+                raise EraError("chunk shorter than its checksum")
             want_crc = struct.unpack("<I", body[:4])[0]
             payload = body[4:]
             try:

@@ -38,10 +38,12 @@ class PeerRecord:
 
     def _decay(self) -> None:
         now = time.monotonic()
-        dt = now - self._last
-        self._last = now
+        points = int((now - self._last) * _DECAY_PER_SECOND)
+        if points <= 0:
+            return  # keep _last: fractional credit accumulates across calls
+        self._last += points / _DECAY_PER_SECOND
         if self.reputation < 0:
-            self.reputation = min(0, self.reputation + int(dt * _DECAY_PER_SECOND))
+            self.reputation = min(0, self.reputation + points)
 
 
 class PeersManager:

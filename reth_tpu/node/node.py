@@ -54,8 +54,15 @@ class Node:
     """A launched node (in-process; networking arrives as its own layer)."""
 
     def __init__(self, config: NodeConfig, committer: TrieCommitter | None = None):
+        from ..tasks import TaskExecutor
+
         self.config = config
         self.committer = committer or TrieCommitter()
+        # task runtime (reference crates/tasks): components register their
+        # loops here; a critical failure begins shutdown
+        self.tasks = TaskExecutor(
+            on_critical_failure=lambda name, e, tb: self.tasks.shutdown.signal()
+        )
         db_path = Path(config.datadir) / "db.bin" if config.datadir else None
         self.factory = ProviderFactory(MemDb(db_path))
         if config.genesis_header is not None:
@@ -205,6 +212,7 @@ class Node:
         return self.rpc.start(), self.authrpc.start()
 
     def stop(self):
+        self.tasks.graceful_shutdown()
         self.rpc.stop()
         self.authrpc.stop()
         if self.discovery is not None:

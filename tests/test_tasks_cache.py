@@ -1,0 +1,110 @@
+"""Task runtime (graceful shutdown, critical failures) + execution cache."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from reth_tpu.engine import EngineTree
+from reth_tpu.engine.execution_cache import CachedStateSource, ExecutionCache
+from reth_tpu.evm.executor import InMemoryStateSource
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import init_genesis
+from reth_tpu.tasks import TaskExecutor
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+# -- task runtime ------------------------------------------------------------
+
+
+def test_graceful_shutdown_joins_tasks():
+    ex = TaskExecutor()
+    ran = threading.Event()
+
+    def loop(shutdown):
+        ran.set()
+        while not shutdown.wait(0.01):
+            pass
+
+    h = ex.spawn("loop", loop)
+    assert ran.wait(5) and h.alive
+    stuck = ex.graceful_shutdown(timeout=5)
+    assert stuck == [] and not h.alive
+
+
+def test_critical_failure_surfaces():
+    failures = []
+    ex = TaskExecutor(on_critical_failure=lambda name, e, tb: failures.append((name, e)))
+
+    def boom(shutdown):
+        raise RuntimeError("kaboom")
+
+    h = ex.spawn_critical("boom", boom)
+    h.thread.join(5)
+    assert isinstance(h.error, RuntimeError)
+    assert failures and failures[0][0] == "boom"
+    assert ex.critical_errors() and ex.critical_errors()[0][0] == "boom"
+
+
+def test_noncritical_failure_is_captured_quietly():
+    called = []
+    ex = TaskExecutor(on_critical_failure=lambda *a: called.append(a))
+    h = ex.spawn("oops", lambda sd: (_ for _ in ()).throw(ValueError("x")))
+    h.thread.join(5)
+    assert isinstance(h.error, ValueError)
+    assert not called  # only CRITICAL failures fire the callback
+
+
+# -- execution cache ---------------------------------------------------------
+
+
+def test_cached_source_hits_and_invalidation():
+    inner = InMemoryStateSource({b"\x01" * 20: Account(balance=7)},
+                                {b"\x01" * 20: {b"\x02" * 32: 42}})
+    cache = ExecutionCache()
+    src = CachedStateSource(inner, cache)
+    assert src.account(b"\x01" * 20).balance == 7
+    assert src.account(b"\x01" * 20).balance == 7
+    assert cache.accounts.hits == 1
+    assert src.storage(b"\x01" * 20, b"\x02" * 32) == 42
+    # mutate underneath + invalidate: the cache must refetch
+    inner.accounts[b"\x01" * 20] = Account(balance=9)
+    inner.storages[b"\x01" * 20][b"\x02" * 32] = 43
+
+    class _Changes:
+        accounts = {b"\x01" * 20: None}
+        storage = {b"\x01" * 20: {b"\x02" * 32: 0}}
+        wiped_storage = set()
+
+    cache.on_block_applied(_Changes())
+    assert src.account(b"\x01" * 20).balance == 9
+    assert src.storage(b"\x01" * 20, b"\x02" * 32) == 43
+
+
+def test_tree_cache_stays_correct_across_blocks_and_reorgs():
+    """Chain of blocks re-touching the same accounts: the warm cache must
+    never produce a stale balance (roots are checked per block, so any
+    staleness fails validation)."""
+    alice = Wallet(0xA11CE)
+    bob = b"\x0b" * 20
+    bld = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    for i in range(5):
+        bld.build_block([alice.transfer(bob, 1000 + i)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, bld.genesis, bld.accounts_at_genesis, committer=CPU)
+    tree = EngineTree(factory, committer=CPU)
+    for blk in bld.blocks[1:]:
+        assert tree.on_new_payload(blk).status.name == "VALID"
+    assert tree.execution_cache.stats()["account_hits"] > 0
+    # side branch off block 2: anchor mismatch resets the cache, and the
+    # branch still validates (no stale reads from the canonical warmth)
+    fork = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    a2 = Wallet(0xA11CE)
+    fork.build_block([a2.transfer(bob, 1000)])
+    fork.build_block([a2.transfer(b"\x0c" * 20, 77)])
+    assert tree.on_new_payload(fork.blocks[2]).status.name == "VALID"
