@@ -244,12 +244,20 @@ class EngineTree:
             bh = overlay.canonical_hash(k)
             if bh:
                 hashes[k] = bh
-        try:
-            senders = [tx.recover_sender() for tx in block.transactions]
-        except ValueError as e:
-            self.invalid[block.hash] = f"bad signature: {e}"
-            self._run_invalid_hooks(block, f"bad signature: {e}")
-            return PayloadStatus(PayloadStatusKind.INVALID, None, str(e)), [], []
+        from ..primitives.types import recover_senders
+
+        senders = recover_senders(block.transactions)
+        if any(s is None for s in senders):
+            bad = next(i for i, s in enumerate(senders) if s is None)
+            try:
+                block.transactions[bad].recover_sender()
+                reason = "recovery failed"
+            except ValueError as e:
+                reason = str(e)
+            msg = f"bad signature: tx {bad}: {reason}"
+            self.invalid[block.hash] = msg
+            self._run_invalid_hooks(block, msg)
+            return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
         # pipelined root: a worker batch-hashes dirty keys on the device
         # WHILE execution runs (reference state_root_task / sparse_trie
         # strategy overlap; see engine/pipelined_root.py)

@@ -58,6 +58,11 @@ class Node:
 
         self.config = config
         self.committer = committer or TrieCommitter()
+        # warm the native secp build now: a lazy first-use g++ compile
+        # inside newPayload would stall a consensus response for seconds
+        from ..primitives.secp256k1 import _native_lib
+
+        _native_lib()
         # task runtime (reference crates/tasks): components register their
         # loops here; a critical failure begins shutdown
         self.tasks = TaskExecutor(

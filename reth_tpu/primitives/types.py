@@ -390,6 +390,18 @@ class Transaction:
 GAS_PER_BLOB = 1 << 17  # EIP-4844
 
 
+def recover_senders(txs, allow_high_s: bool = False) -> list[bytes | None]:
+    """Batched sender recovery for a transaction sequence (one threaded
+    native dispatch; see primitives.secp256k1.ecrecover_batch). The single
+    place that maps signature fields to recovery inputs."""
+    from .secp256k1 import ecrecover_batch
+
+    return ecrecover_batch(
+        [(tx.signing_hash(), tx.y_parity, tx.r, tx.s) for tx in txs],
+        allow_high_s=allow_high_s,
+    )
+
+
 @dataclass(frozen=True)
 class Log:
     address: bytes

@@ -2,13 +2,14 @@
 
 Reference analogue: `SenderRecoveryStage`
 (crates/stages/stages/src/stages/sender_recovery.rs) — rayon-parallel
-ecrecover into TransactionSenders. Host-side here (pure-Python secp256k1
-for now; the native C++ batch path is a later milestone — this stage is
-the seam where it plugs in).
+ecrecover into TransactionSenders. The hot path is the native threaded
+C++ batch engine (native/secp256k1.cpp via
+primitives.secp256k1.ecrecover_batch); pure Python is the fallback.
 """
 
 from __future__ import annotations
 
+from ..primitives.types import recover_senders
 from ..storage.provider import DatabaseProvider
 from ..storage.tables import Tables, be64
 from .api import ExecInput, ExecOutput, Stage, StageError, UnwindInput
@@ -22,17 +23,28 @@ class SenderRecoveryStage(Stage):
 
     def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
         end = min(inp.target, inp.checkpoint + self.max_blocks)
+        # gather the whole commit range, recover in ONE threaded batch
+        txs = []
+        slots = []  # (tx_num, block, index-in-block) aligned with txs
         for n in range(inp.next_block, end + 1):
             idx = provider.block_body_indices(n)
             if idx is None:
                 raise StageError(f"missing body indices for block {n}", block=n)
-            txs = provider.transactions_by_block(n) or []
-            for i, tx in enumerate(txs):
+            for i, tx in enumerate(provider.transactions_by_block(n) or []):
+                txs.append(tx)
+                slots.append((idx.first_tx_num + i, n, i))
+        for tx, (tx_num, n, i), sender in zip(txs, slots, recover_senders(txs)):
+            if sender is None:
+                # re-run the single python path for the precise reason
                 try:
-                    sender = tx.recover_sender()
+                    tx.recover_sender()
+                    reason = "recovery failed"
                 except ValueError as e:
-                    raise StageError(f"invalid signature in block {n}: {e}", block=n)
-                provider.put_sender(idx.first_tx_num + i, sender)
+                    reason = str(e)
+                raise StageError(
+                    f"invalid signature in block {n} tx {i}: {reason}", block=n
+                )
+            provider.put_sender(tx_num, sender)
         return ExecOutput(checkpoint=end, done=end >= inp.target)
 
     def unwind(self, provider: DatabaseProvider, inp: UnwindInput) -> None:
