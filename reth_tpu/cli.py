@@ -208,7 +208,8 @@ def cmd_node(args):
         jwt_secret = load_or_create_secret(args.authrpc_jwtsecret)
     cfg = NodeConfig(datadir=args.datadir, dev=args.dev,
                      http_port=args.http_port, authrpc_port=args.authrpc_port,
-                     jwt_secret=jwt_secret,
+                     jwt_secret=jwt_secret, ws_port=args.ws_port,
+                     enable_admin=args.enable_admin,
                      p2p_port=args.port if not args.disable_p2p else None,
                      p2p_host=args.addr,
                      discovery=not args.no_discovery,
@@ -223,6 +224,8 @@ def cmd_node(args):
             print(f"discv4 on udp/{node.discovery.port}")
     http_port, auth_port = node.start_rpc()
     print(f"RPC listening on 127.0.0.1:{http_port}, engine API on 127.0.0.1:{auth_port}")
+    if node.ws is not None:
+        print(f"WebSocket RPC on 127.0.0.1:{node.ws.port}")
     if args.dev and args.block_time > 0:
         print(f"dev mode: mining every {args.block_time}s")
 
@@ -347,6 +350,10 @@ def main(argv=None) -> int:
     p.add_argument("--block-time", type=int, default=2)
     p.add_argument("--http-port", type=int, default=8545)
     p.add_argument("--authrpc-port", type=int, default=8551)
+    p.add_argument("--ws-port", type=int, default=None,
+                   help="WebSocket RPC port (omit to disable)")
+    p.add_argument("--enable-admin", action="store_true",
+                   help="expose the admin_ namespace (node control)")
     p.add_argument("--authrpc-jwtsecret", default=None,
                    help="path to the 32-byte hex JWT secret for the engine "
                         "port (default: <datadir>/jwt.hex, created if absent)")

@@ -42,6 +42,8 @@ class NodeConfig:
     static_file_distance: int | None = None
     prune_modes: object | None = None  # PruneModes | None
     jwt_secret: bytes | None = None   # engine-port JWT (auto from datadir)
+    ws_port: int | None = None        # WebSocket RPC (None disables; 0 = any)
+    enable_admin: bool = False        # admin_ is node control: explicit opt-in
     # devp2p: RLPx listener + discv4 discovery (None disables networking)
     p2p_port: int | None = None       # 0 = ephemeral
     p2p_host: str = "127.0.0.1"       # bind + advertised address
@@ -166,6 +168,13 @@ class Node:
         self.authrpc.register(self.engine_api)
         self.authrpc.register(self.eth_api)  # CLs also query eth_ on authrpc
 
+        # WebSocket transport over the same public method registry
+        self.ws = None
+        if config.ws_port is not None:
+            from ..rpc.ws import WsRpcServer
+
+            self.ws = WsRpcServer(self.rpc, port=config.ws_port)
+
         # devp2p: encrypted RLPx listener + discv4 (reference: network
         # component wiring in the node builder, launch/engine.rs:145-156)
         self.network = None
@@ -187,6 +196,13 @@ class Node:
                 self.factory, status, pool=self.pool, host=config.p2p_host,
                 port=config.p2p_port, node_priv=key,
             )
+        from ..rpc.admin import AdminApi
+
+        self.admin_api = AdminApi(self.network, None, config.chain_id)
+        if config.enable_admin:
+            # node-control surface: only on explicit opt-in (reference
+            # gates admin behind --http.api, never on by default)
+            self.rpc.register(self.admin_api)
 
     def start_network(self) -> int | None:
         """Start the RLPx listener (+ discv4 when enabled); returns the
@@ -200,6 +216,7 @@ class Node:
             self.discovery = Discv4(self.network.node_priv,
                                     host=self.network.host, tcp_port=port)
             self.discovery.start()
+            self.admin_api.discovery = self.discovery
             if self.config.bootnodes:
                 self.discovery.bootstrap(list(self.config.bootnodes))
                 self.discovery.lookup()
@@ -213,13 +230,19 @@ class Node:
         return port
 
     def start_rpc(self) -> tuple[int, int]:
-        """Start both HTTP servers; returns (http_port, authrpc_port)."""
-        return self.rpc.start(), self.authrpc.start()
+        """Start the RPC transports; returns (http_port, authrpc_port).
+        The WS port (when enabled) is at ``self.ws.port`` after this."""
+        ports = self.rpc.start(), self.authrpc.start()
+        if self.ws is not None:
+            self.ws.start()
+        return ports
 
     def stop(self):
         self.tasks.graceful_shutdown()
         self.rpc.stop()
         self.authrpc.stop()
+        if self.ws is not None:
+            self.ws.stop()
         if self.discovery is not None:
             self.discovery.stop()
         if self.network is not None:

@@ -1,0 +1,108 @@
+"""WebSocket RPC transport + admin_ namespace."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+
+from reth_tpu.rpc.server import RpcServer
+from reth_tpu.rpc.ws import OP_PING, OP_TEXT, WsRpcServer, _WS_GUID
+
+
+def _ws_client(port):
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    key = base64.b64encode(os.urandom(16))
+    sock.sendall(
+        b"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+        b"Connection: Upgrade\r\nSec-WebSocket-Key: " + key +
+        b"\r\nSec-WebSocket-Version: 13\r\n\r\n"
+    )
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        resp += sock.recv(4096)
+    assert b"101" in resp.split(b"\r\n")[0]
+    want = base64.b64encode(hashlib.sha1(key + _WS_GUID).digest())
+    assert want in resp
+    return sock
+
+
+def _send_text(sock, payload: bytes, opcode=OP_TEXT):
+    mask = os.urandom(4)
+    header = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        header += bytes([0x80 | n])
+    else:
+        header += bytes([0x80 | 126]) + struct.pack(">H", n)
+    body = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+    sock.sendall(header + mask + body)
+
+
+def _recv_msg(sock):
+    b0, b1 = sock.recv(1)[0], sock.recv(1)[0]
+    ln = b1 & 0x7F
+    if ln == 126:
+        (ln,) = struct.unpack(">H", sock.recv(2))
+    elif ln == 127:
+        (ln,) = struct.unpack(">Q", sock.recv(8))
+    buf = b""
+    while len(buf) < ln:
+        buf += sock.recv(ln - len(buf))
+    return b0 & 0x0F, buf
+
+
+def test_ws_rpc_roundtrip():
+    rpc = RpcServer()
+    rpc.register_method("test_echo", lambda x: x * 2)
+    ws = WsRpcServer(rpc)
+    port = ws.start()
+    try:
+        sock = _ws_client(port)
+        _send_text(sock, json.dumps({"jsonrpc": "2.0", "id": 7,
+                                     "method": "test_echo", "params": [21]}).encode())
+        op, body = _recv_msg(sock)
+        assert op == OP_TEXT
+        assert json.loads(body) == {"jsonrpc": "2.0", "id": 7, "result": 42}
+        # ping -> pong
+        _send_text(sock, b"hi", opcode=OP_PING)
+        op, body = _recv_msg(sock)
+        assert op == 10 and body == b"hi"
+        # a second request on the same connection
+        _send_text(sock, json.dumps({"jsonrpc": "2.0", "id": 8,
+                                     "method": "test_echo", "params": [5]}).encode())
+        assert json.loads(_recv_msg(sock)[1])["result"] == 10
+        sock.close()
+    finally:
+        ws.stop()
+
+
+def test_admin_namespace_over_live_node():
+    from reth_tpu.net import NetworkManager, Status
+    from reth_tpu.rpc.admin import AdminApi
+    from reth_tpu.storage import MemDb, ProviderFactory
+
+    factory = ProviderFactory(MemDb())
+    status = Status(network_id=1, genesis=b"\x11" * 32)
+    a = NetworkManager(factory, status, node_priv=0xAA1)
+    b = NetworkManager(ProviderFactory(MemDb()), status, node_priv=0xBB2)
+    a.start()
+    b.start()
+    try:
+        api_a = AdminApi(a, None, chain_id=1)
+        info = api_a.admin_nodeInfo()
+        assert info["enode"] == a.enode
+        assert info["ports"]["listener"] == a.port
+        assert api_a.admin_peers() == []
+        assert api_a.admin_addPeer(b.enode)
+        peers = api_a.admin_peers()
+        assert len(peers) == 1
+        assert peers[0]["caps"] == ["eth/68"]
+        assert api_a.admin_removePeer(b.enode)
+        assert not api_a.admin_addPeer("enode://zz@nope")  # malformed -> False
+    finally:
+        a.stop()
+        b.stop()
