@@ -24,7 +24,7 @@ def download_headers(peer: PeerConnection, from_block: int, to_block: int) -> li
     n = from_block
     while n <= to_block:
         limit = min(HEADER_BATCH, to_block - n + 1)
-        batch = peer.get_headers(n, limit)
+        batch = peer.get_headers(n, limit)[:limit]  # cap over-long responses
         if not batch:
             raise PeerError(f"peer returned no headers at {n}")
         for h in batch:
@@ -51,25 +51,45 @@ def download_bodies(peer: PeerConnection, headers: list) -> list[Block]:
 
 
 def sync_from_peer(factory, peer: PeerConnection, pipeline=None,
-                   consensus: EthBeaconConsensus | None = None) -> int:
+                   consensus: EthBeaconConsensus | None = None,
+                   committer=None) -> int:
     """Sync to the peer's head; returns the new local tip.
 
-    The networked version of `reth import`: headers (with linkage checks)
-    → bodies → import (pre-execution validation) → staged pipeline.
+    With no ``pipeline`` given, the ONLINE stage set drives the whole
+    sync — the pipeline's Headers/Bodies stages pull from the peer with
+    checkpointed, per-chunk commits (reference OnlineStages). A supplied
+    pipeline keeps the legacy flow: bulk download → import → run.
     """
     consensus = consensus or EthBeaconConsensus()
     with factory.provider() as p:
         local_tip = p.last_block_number()
+        finish_cp = p.stage_checkpoint("Finish")
     # peer head number: ask for its head header by hash
     head = peer.get_headers(peer.status.head, 1)
     if not head:
         return local_tip
     target = head[0].number
+    if pipeline is None:
+        # online path: progress is measured by the PIPELINE (a crash after
+        # a Headers chunk leaves last_block_number ahead of the real sync)
+        if target <= finish_cp:
+            return local_tip
+        from ..stages import Pipeline, online_stages
+
+        with factory.provider_rw() as p:
+            # a legacy-imported DB holds headers/bodies without download
+            # checkpoints: baseline them to the fully-synced height or the
+            # Bodies stage would re-insert every historical body
+            for stage_id in ("Headers", "Bodies"):
+                if p.stage_checkpoint(stage_id) < finish_cp:
+                    p.save_stage_checkpoint(stage_id, finish_cp)
+        Pipeline(factory, online_stages(peer, committer=committer,
+                                        consensus=consensus)).run(target)
+        return target
     if target <= local_tip:
         return local_tip
     headers = download_headers(peer, local_tip + 1, target)
     blocks = download_bodies(peer, headers)
     tip = import_chain(factory, blocks, consensus)
-    if pipeline is not None:
-        pipeline.run(tip)
+    pipeline.run(tip)
     return tip

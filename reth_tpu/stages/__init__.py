@@ -14,6 +14,7 @@ from .merkle import MerkleStage, MerkleUnwindStage
 from .tx_lookup import TransactionLookupStage
 from .index_history import IndexAccountHistoryStage, IndexStorageHistoryStage
 from .finish import FinishStage
+from .headers_bodies import BodiesStage, HeadersStage, online_stages
 
 
 def default_stages(committer=None, consensus=None) -> list[Stage]:
@@ -43,6 +44,9 @@ __all__ = [
     "UnwindInput",
     "ExecutionStage",
     "SenderRecoveryStage",
+    "HeadersStage",
+    "BodiesStage",
+    "online_stages",
     "AccountHashingStage",
     "StorageHashingStage",
     "MerkleStage",

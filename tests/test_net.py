@@ -179,3 +179,34 @@ def test_enode_dial_and_discovery_assisted_sync(testnet):
     finally:
         d_server.stop()
         d_client.stop()
+
+
+def test_online_pipeline_sync(testnet):
+    """Headers/Bodies as PIPELINE stages (reference OnlineStages): a fresh
+    node syncs purely through the staged pipeline pulling from the peer."""
+    server, port, status, factory_b, builder = testnet
+    our_status = Status(network_id=1, head=builder.genesis.hash,
+                        genesis=builder.genesis.hash)
+    peer = PeerConnection.connect("127.0.0.1", port, our_status,
+                                  pubkey_from_priv(server.node_priv))
+    tip = sync_from_peer(factory_b, peer, committer=CPU)  # no pipeline arg
+    assert tip == 8
+    with factory_b.provider() as p:
+        assert p.stage_checkpoint("Headers") == 8
+        assert p.stage_checkpoint("Bodies") == 8
+        assert p.stage_checkpoint("Finish") == 8
+        assert p.header_by_number(8).state_root == builder.tip.state_root
+    # unwind through the online set (reverse order incl. Bodies/Headers),
+    # then resync from the same peer
+    from reth_tpu.stages import Pipeline, online_stages
+
+    pipeline = Pipeline(factory_b, online_stages(peer, committer=CPU))
+    pipeline.unwind(6)
+    with factory_b.provider() as p:
+        assert p.stage_checkpoint("Headers") == 6
+        assert p.header_by_number(8) is None
+        assert p.canonical_hash(7) is None
+    pipeline.run(8)
+    with factory_b.provider() as p:
+        assert p.header_by_number(8).state_root == builder.tip.state_root
+    peer.close()
