@@ -209,7 +209,7 @@ def cmd_node(args):
     cfg = NodeConfig(datadir=args.datadir, dev=args.dev,
                      http_port=args.http_port, authrpc_port=args.authrpc_port,
                      jwt_secret=jwt_secret, ws_port=args.ws_port,
-                     enable_admin=args.enable_admin,
+                     ipc_path=args.ipc_path, enable_admin=args.enable_admin,
                      p2p_port=args.port if not args.disable_p2p else None,
                      p2p_host=args.addr,
                      discovery=not args.no_discovery,
@@ -226,6 +226,8 @@ def cmd_node(args):
     print(f"RPC listening on 127.0.0.1:{http_port}, engine API on 127.0.0.1:{auth_port}")
     if node.ws is not None:
         print(f"WebSocket RPC on 127.0.0.1:{node.ws.port}")
+    if node.ipc is not None:
+        print(f"IPC RPC at {node.ipc.path}")
     if args.dev and args.block_time > 0:
         print(f"dev mode: mining every {args.block_time}s")
 
@@ -354,6 +356,8 @@ def main(argv=None) -> int:
                    help="WebSocket RPC port (omit to disable)")
     p.add_argument("--enable-admin", action="store_true",
                    help="expose the admin_ namespace (node control)")
+    p.add_argument("--ipc-path", default=None,
+                   help="Unix-socket RPC path (omit to disable)")
     p.add_argument("--authrpc-jwtsecret", default=None,
                    help="path to the 32-byte hex JWT secret for the engine "
                         "port (default: <datadir>/jwt.hex, created if absent)")

@@ -60,7 +60,7 @@ def sync_from_peer(factory, peer: PeerConnection, pipeline=None,
     checkpointed, per-chunk commits (reference OnlineStages). A supplied
     pipeline keeps the legacy flow: bulk download → import → run.
     """
-    consensus = consensus or EthBeaconConsensus()
+    consensus = consensus or EthBeaconConsensus(committer)
     with factory.provider() as p:
         local_tip = p.last_block_number()
         finish_cp = p.stage_checkpoint("Finish")
@@ -78,11 +78,18 @@ def sync_from_peer(factory, peer: PeerConnection, pipeline=None,
 
         with factory.provider_rw() as p:
             # a legacy-imported DB holds headers/bodies without download
-            # checkpoints: baseline them to the fully-synced height or the
-            # Bodies stage would re-insert every historical body
-            for stage_id in ("Headers", "Bodies"):
-                if p.stage_checkpoint(stage_id) < finish_cp:
-                    p.save_stage_checkpoint(stage_id, finish_cp)
+            # checkpoints: baseline them to what is ACTUALLY present (not
+            # the Finish checkpoint — a crash between import and pipeline
+            # completion leaves bodies above it, and re-inserting a body
+            # renumbers its transactions and corrupts the tx tables)
+            if p.stage_checkpoint("Headers") < local_tip:
+                p.save_stage_checkpoint("Headers", local_tip)
+            b_cp = p.stage_checkpoint("Bodies")
+            n = b_cp + 1
+            while n <= local_tip and p.block_body_indices(n) is not None:
+                n += 1
+            if n - 1 > b_cp:
+                p.save_stage_checkpoint("Bodies", n - 1)
         Pipeline(factory, online_stages(peer, committer=committer,
                                         consensus=consensus)).run(target)
         return target

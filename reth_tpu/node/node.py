@@ -43,6 +43,7 @@ class NodeConfig:
     prune_modes: object | None = None  # PruneModes | None
     jwt_secret: bytes | None = None   # engine-port JWT (auto from datadir)
     ws_port: int | None = None        # WebSocket RPC (None disables; 0 = any)
+    ipc_path: str | None = None       # Unix-socket RPC (None disables)
     enable_admin: bool = False        # admin_ is node control: explicit opt-in
     # devp2p: RLPx listener + discv4 discovery (None disables networking)
     p2p_port: int | None = None       # 0 = ephemeral
@@ -168,12 +169,17 @@ class Node:
         self.authrpc.register(self.engine_api)
         self.authrpc.register(self.eth_api)  # CLs also query eth_ on authrpc
 
-        # WebSocket transport over the same public method registry
+        # WebSocket + IPC transports over the same public method registry
         self.ws = None
         if config.ws_port is not None:
             from ..rpc.ws import WsRpcServer
 
             self.ws = WsRpcServer(self.rpc, port=config.ws_port)
+        self.ipc = None
+        if config.ipc_path:
+            from ..rpc.ipc import IpcRpcServer
+
+            self.ipc = IpcRpcServer(self.rpc, config.ipc_path)
 
         # devp2p: encrypted RLPx listener + discv4 (reference: network
         # component wiring in the node builder, launch/engine.rs:145-156)
@@ -235,6 +241,8 @@ class Node:
         ports = self.rpc.start(), self.authrpc.start()
         if self.ws is not None:
             self.ws.start()
+        if self.ipc is not None:
+            self.ipc.start()
         return ports
 
     def stop(self):
@@ -243,6 +251,8 @@ class Node:
         self.authrpc.stop()
         if self.ws is not None:
             self.ws.stop()
+        if self.ipc is not None:
+            self.ipc.stop()
         if self.discovery is not None:
             self.discovery.stop()
         if self.network is not None:

@@ -82,6 +82,9 @@ class BodiesStage(Stage):
         except PeerError as e:
             raise StageError(str(e), block=inp.next_block)
         for block in blocks:
+            if provider.block_body_indices(block.header.number) is not None:
+                continue  # already stored (e.g. legacy import): re-inserting
+                # would renumber its transactions
             try:
                 self.consensus.validate_block_pre_execution(block)
             except ConsensusError as e:
@@ -95,15 +98,15 @@ class BodiesStage(Stage):
 
         idx = provider.block_body_indices(inp.unwind_to)
         next_tx = idx.next_tx_num if idx else 0
-        # drop every table insert_block_body wrote for the doomed txs: the
-        # hash->num and last-tx->block rows would otherwise serve stale or
-        # WRONG lookups after tx numbers are reassigned on a reorged chain
+        # drop the tx rows insert_block_body wrote: hash->num and
+        # last-tx->block would otherwise serve WRONG lookups after tx
+        # numbers are reassigned on a reorged chain (senders are removed
+        # by SenderRecoveryStage.unwind, which runs before us)
         doomed = list(provider.tx.cursor(Tables.Transactions.name).walk(be64(next_tx)))
         for k, raw in doomed:
             tx = T.decode_tx(raw)
             provider.tx.delete(Tables.TransactionHashNumbers.name, tx.hash)
             provider.tx.delete(Tables.Transactions.name, k)
-            provider.tx.delete(Tables.TransactionSenders.name, k)
         for k, _ in list(provider.tx.cursor(Tables.TransactionBlocks.name)
                          .walk(be64(next_tx))):
             provider.tx.delete(Tables.TransactionBlocks.name, k)

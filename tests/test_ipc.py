@@ -1,0 +1,33 @@
+"""IPC (Unix socket) JSON-RPC transport."""
+
+from __future__ import annotations
+
+import json
+import socket
+
+from reth_tpu.rpc.ipc import IpcRpcServer
+from reth_tpu.rpc.server import RpcServer
+
+
+def test_ipc_roundtrip(tmp_path):
+    rpc = RpcServer()
+    rpc.register_method("test_echo", lambda x: x + 1)
+    server = IpcRpcServer(rpc, tmp_path / "node.ipc")
+    path = server.start()
+    try:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(path)
+        for i in (1, 41):
+            sock.sendall(json.dumps({"jsonrpc": "2.0", "id": i,
+                                     "method": "test_echo",
+                                     "params": [i]}).encode() + b"\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                buf += sock.recv(4096)
+            assert json.loads(buf) == {"jsonrpc": "2.0", "id": i, "result": i + 1}
+        sock.close()
+    finally:
+        server.stop()
+    import os
+
+    assert not os.path.exists(path)  # socket file cleaned up
