@@ -419,6 +419,225 @@ class FusedLevelEngine:
         )
 
 
+def _u16_slice(u8, off: int, n: int):
+    """Read n little-endian u16s staged inside the u8 buffer."""
+    raw = u8[off:off + 2 * n].reshape(n, 2).astype(jnp.uint32)
+    return raw[:, 0] | (raw[:, 1] << 8)
+
+
+@lru_cache(maxsize=16)
+# bounded: the signature concatenates every level's tiers, so distinct
+# workload shapes multiply — eviction caps retained executables and the
+# number of multi-second compiles a shape-thrashing caller can accumulate
+def _mega_jitted(sig: tuple, s_tier: int):
+    """ONE program for a whole commit: every level's hashing unrolled over
+    two staged input buffers (u8 bytes + i32 indices), digest buffer chained
+    through the stages in HBM. ``sig`` is the static plan — per stage the
+    kind, tiers, and static slice offsets into the staging buffers — so one
+    compiled program exists per distinct level-shape signature (tiering
+    collapses similar workloads onto the same signature).
+
+    Wire-size discipline (the tunnel moves ~25 MB/s when a program consumes
+    its inputs — bytes/hash IS the perf model): row lengths ship as u16
+    inside the byte buffer, row offsets and block counts are DERIVED here
+    (exclusive cumsum / div), and hole/child coordinates ship as packed
+    (row * L + byte) single i32s."""
+
+    def run(u8, i32, digest_buf):
+        for entry in sig:
+            kind = entry[0]
+            if kind == "packed":
+                (_, b_tier, n_tier, flat_off, flat_len, len_o, slot_o,
+                 hidx_o, hsrc_o, h_len) = entry
+                flat = u8[flat_off:flat_off + flat_len]
+                row_len = _u16_slice(u8, len_o, n_tier)
+                row_off = jnp.cumsum(row_len) - row_len  # exclusive prefix
+                counts = (row_len // RATE + 1).astype(jnp.int32)
+                slots = i32[slot_o:slot_o + n_tier]
+                hidx = i32[hidx_o:hidx_o + h_len]
+                hs = i32[hsrc_o:hsrc_o + h_len]
+                digest_buf = _packed_level_fused(
+                    flat, row_off, row_len, counts, hidx, hs, slots,
+                    digest_buf, b_tier=b_tier)
+            else:  # branch
+                _, n_tier, mask_o, slot_o, chidx_o, chsrc_o, ch_len = entry
+                masks = _u16_slice(u8, mask_o, n_tier).astype(jnp.int32)
+                slots = i32[slot_o:slot_o + n_tier]
+                crn = i32[chidx_o:chidx_o + ch_len]
+                cs = i32[chsrc_o:chsrc_o + ch_len]
+                digest_buf = _branch_level(masks, slots, crn // 16, crn % 16,
+                                           cs, digest_buf, b_tier=4)
+        return digest_buf
+
+    return jax.jit(run, donate_argnums=2)
+
+
+def _packed_level_fused(flat, row_off, row_len, counts, hidx, hsrc, slots,
+                        digest_buf, *, b_tier: int):
+    """_packed_level with pre-packed hole coordinates (hidx = row * L +
+    byte_off within the padded row grid)."""
+    L = b_tier * RATE
+    n = row_off.shape[0]
+    col = jnp.arange(L, dtype=jnp.uint32)[None, :]
+    idx = jnp.minimum(row_off[:, None] + col, flat.shape[0] - 1)
+    rows = jnp.where(col < row_len[:, None], flat[idx], 0)
+    rows = rows ^ jnp.where(col == row_len[:, None], 0x01, 0).astype(jnp.uint8)
+    last = (counts.astype(jnp.uint32) * RATE - 1)[:, None]
+    rows = rows ^ jnp.where(col == last, 0x80, 0).astype(jnp.uint8)
+    if hidx is not None:
+        dig = digest_buf[hsrc]
+        fr = rows.reshape(-1)
+        sidx = hidx[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
+        rows = fr.at[sidx.reshape(-1)].set(dig.reshape(-1)).reshape(n, L)
+    d = masked_absorb_words(_bytes_to_words(rows), b_tier, counts)
+    return digest_buf.at[slots].set(_digests_to_bytes(d))
+
+
+class MegaFusedEngine(FusedLevelEngine):
+    """Whole-commit staging variant of the fused engine.
+
+    The axon tunnel's H2D cost is dominated by a ~40-70 ms fixed latency
+    PER TRANSFER (bandwidth only ramps past ~4 MB) — so the per-level
+    engine's ~18 dispatches x ~5 small arrays each pay seconds in transfer
+    latency alone. This engine records every level dispatch, concatenates
+    all inputs into TWO staging buffers (u8 bytes, i32 indices), uploads
+    them in ONE device_put each, and runs the whole commit as ONE XLA
+    program (`_mega_jitted`). D2H stays a single digest/root fetch.
+
+    Reference analogue: the same per-level batching seam
+    (crates/stages/stages/src/stages/hashing_account.rs:29-32), collapsed
+    to one device round trip per MerkleStage chunk.
+    """
+
+    def __init__(self, min_tier: int = 1024):
+        super().__init__(min_tier=min_tier)
+        self._plan: list[tuple] = []
+        self._u8_parts: list[np.ndarray] = []
+        self._i32_parts: list[np.ndarray] = []
+        self._u8_off = 0
+        self._i32_off = 0
+
+    def begin(self, max_slots: int) -> None:
+        self._s_tier = _pow2(max_slots + 1, floor=max(self.min_tier, 2))
+        self._n_slots = 1
+        self._plan, self._u8_parts, self._i32_parts = [], [], []
+        self._u8_off = self._i32_off = 0
+        self._buf = None
+
+    # wire-size tiers: quantized to 4 steps per octave (2^e x {1, 1.25,
+    # 1.5, 1.75}) — ≤12.5% padding waste on the wire while keeping the
+    # signature variety (and so the XLA program count) logarithmic: chunks
+    # of a chunked MerkleStage rebuild that differ by <12.5% per level
+    # share one compiled program
+    _ROW_FLOOR = 2048
+    _FLAT_FLOOR = 1 << 16
+    _HOLE_FLOOR = 2048
+
+    @staticmethod
+    def _step(n: int, floor: int) -> int:
+        if n <= floor:
+            return floor
+        e = (n - 1).bit_length() - 1  # n in (2^e, 2^(e+1)]
+        base = 1 << e
+        for frac in (5, 6, 7, 8):
+            v = base * frac // 4
+            if v >= n:
+                return v
+        return base * 2
+
+    def _stage_u8(self, arr: np.ndarray) -> int:
+        arr = np.ascontiguousarray(arr, dtype=np.uint8).ravel()
+        off = self._u8_off
+        self._u8_parts.append(arr)
+        self._u8_off += arr.size
+        return off
+
+    def _stage_i32(self, *arrays: np.ndarray) -> int:
+        off = self._i32_off
+        for a in arrays:
+            a = np.ascontiguousarray(a).astype(np.int32, copy=False).ravel()
+            self._i32_parts.append(a)
+            self._i32_off += a.size
+        return off
+
+    def dispatch_packed(self, flat, row_off, row_len, slots, holes, b_tier) -> None:
+        n = len(row_off)
+        if n == 0:
+            return
+        n_tier = self._step(n + 1, self._ROW_FLOOR)
+        L = b_tier * RATE
+        # u16 row lengths in the byte buffer; offsets/counts derived on device
+        row_len_p = np.zeros((n_tier,), dtype="<u2")
+        row_len_p[:n] = row_len
+        slots_p = np.zeros((n_tier,), dtype=np.int32)
+        slots_p[:n] = slots
+        flat_tier = self._step(len(flat), self._FLAT_FLOOR)
+        flat_p = np.zeros((flat_tier,), dtype=np.uint8)
+        flat_p[: len(flat)] = flat
+        h = holes.shape[1] if holes is not None else 0
+        h_tier = self._step(h, self._HOLE_FLOOR)
+        # packed hole coordinate: row * L + byte_off; padding rows target the
+        # always-padding row n (row_len 0 ⇒ its bytes never feed a real hash)
+        hidx = np.full((h_tier,), n * L, dtype=np.int32)
+        hsrc = np.zeros((h_tier,), dtype=np.int32)
+        if h:
+            hidx[:h] = holes[0] * L + holes[1]
+            hsrc[:h] = holes[2]
+        flat_off = self._stage_u8(flat_p)
+        len_o = self._stage_u8(row_len_p.view(np.uint8))
+        slot_o = self._stage_i32(slots_p)
+        hidx_o = self._stage_i32(hidx)
+        hsrc_o = self._stage_i32(hsrc)
+        self._plan.append(("packed", b_tier, n_tier, flat_off, flat_tier,
+                           len_o, slot_o, hidx_o, hsrc_o, h_tier))
+
+    def dispatch_branch(self, masks, slots, children) -> None:
+        n = len(masks)
+        if n == 0:
+            return
+        n_tier = self._step(n + 1, self._ROW_FLOOR)
+        masks_p = np.zeros((n_tier,), dtype="<u2")
+        masks_p[:n] = masks
+        slots_p = np.zeros((n_tier,), dtype=np.int32)
+        slots_p[:n] = slots
+        c = children.shape[1] if children is not None else 0
+        ch_tier = self._step(c, self._HOLE_FLOOR)
+        # packed child coordinate: row * 16 + nibble; padding targets row n
+        chidx = np.full((ch_tier,), n * 16, dtype=np.int32)
+        chsrc = np.zeros((ch_tier,), dtype=np.int32)
+        if c:
+            chidx[:c] = children[0] * 16 + children[1]
+            chsrc[:c] = children[2]
+        mask_o = self._stage_u8(masks_p.view(np.uint8))
+        slot_o = self._stage_i32(slots_p)
+        chidx_o = self._stage_i32(chidx)
+        chsrc_o = self._stage_i32(chsrc)
+        self._plan.append(("branch", n_tier, mask_o, slot_o, chidx_o,
+                           chsrc_o, ch_tier))
+
+    def _execute(self) -> None:
+        if self._buf is not None:
+            return
+        u8 = (np.concatenate(self._u8_parts) if self._u8_parts
+              else np.zeros(1, np.uint8))
+        i32 = (np.concatenate(self._i32_parts) if self._i32_parts
+               else np.zeros(1, np.int32))
+        fn = _mega_jitted(tuple(self._plan), self._s_tier)
+        self._buf = fn(
+            jnp.asarray(u8), jnp.asarray(i32),
+            self._device_put(np.zeros((self._s_tier, 32), dtype=np.uint8)),
+        )
+        self._plan, self._u8_parts, self._i32_parts = [], [], []
+
+    def finish(self) -> np.ndarray:
+        self._execute()
+        return super().finish()
+
+    def fetch_slots(self, slots: np.ndarray) -> np.ndarray:
+        self._execute()
+        return super().fetch_slots(slots)
+
+
 class FusedMeshEngine(FusedLevelEngine):
     """Fused level commit SPMD-sharded over a 1-axis device mesh.
 

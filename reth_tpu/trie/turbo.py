@@ -231,11 +231,13 @@ class TurboCommitter:
     def _make_backend(self):
         if self.backend_kind == "numpy":
             return _NumpyBackend()
-        from ..ops.fused_commit import FusedLevelEngine, FusedMeshEngine
+        from ..ops.fused_commit import MegaFusedEngine, FusedMeshEngine
 
         if self.mesh is not None:
             return FusedMeshEngine(self.mesh, min_tier=self.min_tier)
-        return FusedLevelEngine(min_tier=self.min_tier)
+        # single-chip: whole-commit staging — one H2D, one program, one D2H
+        # (the axon tunnel charges ~40-70 ms latency PER transfer)
+        return MegaFusedEngine(min_tier=self.min_tier)
 
     def commit_hashed_many(
         self,
