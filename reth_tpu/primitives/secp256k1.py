@@ -125,6 +125,29 @@ def pubkey_from_bytes(raw: bytes) -> tuple[int, int]:
     return (x, y)
 
 
+def compress_pubkey(pub: tuple[int, int]) -> bytes:
+    """SEC1 compressed form: 02/03 parity prefix + 32-byte X (the ENR
+    "secp256k1" value and discv5 ephemeral-key encoding)."""
+    return bytes([2 + (pub[1] & 1)]) + pub[0].to_bytes(32, "big")
+
+
+def decompress_pubkey(raw: bytes) -> tuple[int, int]:
+    """SEC1 compressed (33 B) or uncompressed 04-prefixed (65 B) -> point."""
+    if len(raw) == 65 and raw[0] == 4:
+        return pubkey_from_bytes(raw[1:])
+    if len(raw) != 33 or raw[0] not in (2, 3):
+        raise ValueError("bad compressed public key")
+    x = int.from_bytes(raw[1:], "big")
+    if not 0 < x < P:
+        raise ValueError("x out of range")
+    y = pow((x * x * x + 7) % P, (P + 1) // 4, P)
+    if (y * y) % P != (x * x * x + 7) % P:
+        raise ValueError("point not on secp256k1")
+    if (y & 1) != (raw[0] & 1):
+        y = P - y
+    return (x, y)
+
+
 def ecdh_x(priv: int, pub: tuple[int, int]) -> bytes:
     """ECDH shared secret: x-coordinate of priv * pub (32 bytes big-endian).
 

@@ -1,0 +1,162 @@
+"""discv5 + ENR: record codec/signing, packet crypto, live handshakes.
+
+Reference analogue: the reference delegates to sigp/discv5 + enr crates
+(crates/net/discv5/src/lib.rs, src/enr.rs); these tests cover the same
+surface in-process over localhost UDP.
+"""
+
+import time
+
+import pytest
+
+from reth_tpu.net.discv5 import (
+    FLAG_ORDINARY,
+    FLAG_WHOAREYOU,
+    Discv5,
+    RoutingTable,
+    derive_session_keys,
+    id_sign,
+    id_verify,
+    mask_packet,
+    unmask_packet,
+    _header,
+)
+from reth_tpu.net.enr import Enr, EnrError, make_enr, node_id_from_pubkey
+from reth_tpu.primitives.secp256k1 import (
+    compress_pubkey,
+    decompress_pubkey,
+    pubkey_from_priv,
+    random_priv,
+)
+
+PRIV_A = 0xEEF77ACB6C6A6EEBC5B363A475AC583EC7ECCDB42B6481424C60F59AA326547F
+PRIV_B = 0x66FB62BFBD66B9177A138C1E5CDDBE4F7C30C343E94E68DF8769459CB1CDE628
+
+
+def test_compress_roundtrip():
+    for priv in (PRIV_A, PRIV_B, 1, 2, random_priv()):
+        pub = pubkey_from_priv(priv)
+        c = compress_pubkey(pub)
+        assert len(c) == 33 and c[0] in (2, 3)
+        assert decompress_pubkey(c) == pub
+
+
+def test_enr_roundtrip_and_verify():
+    rec = make_enr(PRIV_A, ip="127.0.0.1", udp=30303, tcp=30303, seq=7)
+    raw = rec.encode()
+    back = Enr.decode(raw)
+    assert back.seq == 7
+    assert back.ip == "127.0.0.1"
+    assert back.udp_port == 30303
+    assert back.node_id == node_id_from_pubkey(pubkey_from_priv(PRIV_A))
+    # base64 text form round-trips
+    assert Enr.from_base64(rec.to_base64()).encode() == raw
+    # tampering breaks the signature
+    bad = make_enr(PRIV_A, ip="127.0.0.1", udp=30303)
+    bad.pairs["udp"] = b"\x01\x02"
+    with pytest.raises(EnrError):
+        Enr.decode(bad.encode())
+
+
+def test_packet_mask_roundtrip():
+    dest_id = node_id_from_pubkey(pubkey_from_priv(PRIV_B))
+    header = _header(FLAG_ORDINARY, b"\x01" * 12, b"\xaa" * 32)
+    pkt = mask_packet(dest_id, header, b"payload")
+    iv, flag, nonce, authdata, message = unmask_packet(dest_id, pkt)
+    assert flag == FLAG_ORDINARY
+    assert nonce == b"\x01" * 12
+    assert authdata == b"\xaa" * 32
+    assert message == b"payload"
+    # wrong recipient cannot parse (masking key is dest-id prefix)
+    other = node_id_from_pubkey(pubkey_from_priv(PRIV_A))
+    with pytest.raises(Exception):
+        unmask_packet(other, pkt)
+
+
+def test_session_key_agreement_both_sides():
+    a_pub, b_pub = pubkey_from_priv(PRIV_A), pubkey_from_priv(PRIV_B)
+    a_id, b_id = node_id_from_pubkey(a_pub), node_id_from_pubkey(b_pub)
+    challenge = b"\x05" * 63
+    eph_priv = random_priv()
+    eph_pub = pubkey_from_priv(eph_priv)
+    # initiator (A, answering B's WHOAREYOU) vs recipient (B)
+    ia, ra = derive_session_keys(challenge, eph_priv, None, None, b_pub, a_id, b_id)
+    ib, rb = derive_session_keys(challenge, None, eph_pub, PRIV_B, None, a_id, b_id)
+    assert (ia, ra) == (ib, rb)
+    sig = id_sign(PRIV_A, challenge, compress_pubkey(eph_pub), b_id)
+    assert id_verify(a_pub, sig, challenge, compress_pubkey(eph_pub), b_id)
+    assert not id_verify(b_pub, sig, challenge, compress_pubkey(eph_pub), b_id)
+    assert not id_verify(a_pub, sig, b"\x06" * 63, compress_pubkey(eph_pub), b_id)
+
+
+@pytest.fixture()
+def pair():
+    a = Discv5(PRIV_A)
+    b = Discv5(PRIV_B)
+    a.start()
+    b.start()
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_handshake_establishes_sessions(pair):
+    a, b = pair
+    a.table.add(b.enr)
+    a.ping(b.enr)  # random packet -> WHOAREYOU -> handshake(PING) -> PONG
+    assert _wait(lambda: b.node_id in a.sessions and a.node_id in b.sessions)
+    # B learned A's record from the handshake
+    assert _wait(lambda: a.node_id in b.table.by_id)
+    assert b.table.by_id[a.node_id].udp_port == a.port
+
+
+def test_findnode_by_distance(pair):
+    a, b = pair
+    # C is known to B only
+    priv_c = random_priv()
+    c_enr = make_enr(priv_c, ip="127.0.0.1", udp=9, tcp=9)
+    b.table.add(c_enr)
+    a.table.add(b.enr)
+    a.ping(b.enr)
+    assert _wait(lambda: b.node_id in a.sessions)
+    d = RoutingTable.distance(b.node_id, c_enr.node_id)
+    got = a.find_node(b.enr, [d], wait=5.0)
+    assert any(e.node_id == c_enr.node_id for e in got)
+    # distance 0 returns B's own record
+    got0 = a.find_node(b.enr, [0], wait=5.0)
+    assert any(e.node_id == b.node_id for e in got0)
+
+
+def test_lookup_discovers_via_bootstrap():
+    nodes = [Discv5(random_priv()) for _ in range(4)]
+    for n in nodes:
+        n.start()
+    try:
+        boot = nodes[0]
+        # everyone bonds with the bootstrap node
+        for n in nodes[1:]:
+            n.bootstrap([boot.enr.to_base64()])
+        assert _wait(lambda: all(boot.node_id in n.sessions for n in nodes[1:]))
+        assert _wait(lambda: len(boot.table) >= 3)
+        # querying the exact buckets discovers every other node
+        newcomer = nodes[1]
+        others = [n for n in nodes[2:]]
+        dists = sorted({RoutingTable.distance(boot.node_id, n.node_id)
+                        for n in others})
+        got = newcomer.find_node(boot.enr, dists, wait=5.0)
+        assert {n.node_id for n in others} <= {e.node_id for e in got}
+        # and the iterative lookup at least keeps the table populated
+        newcomer.lookup(rounds=1, wait=0.5)
+        assert boot.node_id in newcomer.table.by_id
+    finally:
+        for n in nodes:
+            n.stop()

@@ -1,0 +1,75 @@
+"""DNS discovery (EIP-1459 ENR trees) over a dict-backed resolver.
+
+Reference analogue: crates/net/dns tree-walk + root verification tests
+(src/tree.rs); no real DNS is involved — the resolver seam is the point.
+"""
+
+import pytest
+
+from reth_tpu.net.dnsdisc import (
+    DnsDiscError,
+    DnsResolver,
+    EnrTree,
+    link_url,
+    parse_link,
+)
+from reth_tpu.net.enr import make_enr
+from reth_tpu.primitives.secp256k1 import pubkey_from_priv, random_priv
+
+TREE_KEY = 0x58D23B55BC9CDCE1F18C2500F40FF4AB411BF7437BEDBC55AF4E6289B29244AA
+
+
+def _make_enrs(n, base_port=30000):
+    return [make_enr(random_priv(), ip="127.0.0.1", udp=base_port + i,
+                     tcp=base_port + i) for i in range(n)]
+
+
+def test_tree_build_and_resolve():
+    enrs = _make_enrs(30)  # forces multi-level branch records
+    records = EnrTree(TREE_KEY, seq=3).build("nodes.example.org", enrs)
+    resolver = DnsResolver(records.get)
+    got = resolver.resolve_tree(
+        link_url(pubkey_from_priv(TREE_KEY), "nodes.example.org"))
+    assert {e.node_id for e in got} == {e.node_id for e in enrs}
+
+
+def test_root_signature_verified():
+    enrs = _make_enrs(2)
+    records = EnrTree(TREE_KEY).build("nodes.example.org", enrs)
+    wrong_key = pubkey_from_priv(0xBEEF)
+    resolver = DnsResolver(records.get)
+    with pytest.raises(DnsDiscError):
+        resolver.resolve_tree(link_url(wrong_key, "nodes.example.org"))
+
+
+def test_poisoned_record_skipped():
+    enrs = _make_enrs(3)
+    records = EnrTree(TREE_KEY).build("nodes.example.org", enrs)
+    # corrupt one leaf: content no longer matches its subdomain hash
+    leaf_fqdn = next(k for k, v in records.items()
+                     if v.startswith("enr:") and "." in k)
+    records[leaf_fqdn] = enrs[0].to_base64() + "x"
+    resolver = DnsResolver(records.get)
+    got = resolver.resolve_tree(
+        link_url(pubkey_from_priv(TREE_KEY), "nodes.example.org"))
+    assert len(got) == 2  # the poisoned leaf is dropped, others survive
+
+
+def test_linked_trees_followed():
+    enrs_a, enrs_b = _make_enrs(2), _make_enrs(2, 31000)
+    key_b = random_priv()
+    rec_b = EnrTree(key_b).build("b.example.org", enrs_b)
+    rec_a = EnrTree(TREE_KEY).build(
+        "a.example.org", enrs_a,
+        links=[link_url(pubkey_from_priv(key_b), "b.example.org")])
+    table = {**rec_a, **rec_b}
+    got = DnsResolver(table.get).resolve_tree(
+        link_url(pubkey_from_priv(TREE_KEY), "a.example.org"))
+    assert {e.node_id for e in got} == {e.node_id for e in enrs_a + enrs_b}
+
+
+def test_link_roundtrip():
+    pub = pubkey_from_priv(TREE_KEY)
+    url = link_url(pub, "nodes.example.org")
+    back_pub, domain = parse_link(url)
+    assert back_pub == pub and domain == "nodes.example.org"
