@@ -98,17 +98,22 @@ def _load_genesis(path: str | None, committer, spec: dict | None = None):
         base_fee_per_gas=_num(spec.get("baseFeePerGas"), 10**9),
         withdrawals_root=None if spec.get("preMerge") else EMPTY_ROOT_HASH,
     )
-    return header, alloc, storage, codes, chain_id
+    from .chainspec import ChainSpec
+
+    chain_spec = ChainSpec.from_genesis_config(
+        spec.get("config", {}), genesis_hash=header.hash, chain_id=chain_id)
+    return header, alloc, storage, codes, chain_id, chain_spec
 
 
 def cmd_init(args):
     from .node import Node, NodeConfig
 
     committer = _make_committer(args)
-    header, alloc, storage, codes, chain_id = _load_genesis(args.genesis, committer)
+    header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(args.genesis, committer)
     cfg = NodeConfig(
         chain_id=chain_id, datadir=args.datadir, genesis_header=header,
         genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes,
+        chain_spec=chain_spec,
     )
     node = Node(cfg, committer=committer)
     node.factory.db.flush()
@@ -124,9 +129,10 @@ def cmd_import(args):
     from .storage.genesis import import_chain
 
     committer = _make_committer(args)
-    header, alloc, storage, codes, chain_id = _load_genesis(args.genesis, committer)
+    header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(args.genesis, committer)
     cfg = NodeConfig(chain_id=chain_id, datadir=args.datadir, genesis_header=header,
-                     genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes)
+                     genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes,
+                     chain_spec=chain_spec)
     node = Node(cfg, committer=committer)
     raw = Path(args.file).read_bytes()
     blocks = []
@@ -154,9 +160,10 @@ def cmd_import_era(args):
     from .stages import Pipeline, default_stages
 
     committer = _make_committer(args)
-    header, alloc, storage, codes, chain_id = _load_genesis(args.genesis, committer)
+    header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(args.genesis, committer)
     cfg = NodeConfig(chain_id=chain_id, datadir=args.datadir, genesis_header=header,
-                     genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes)
+                     genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes,
+                     chain_spec=chain_spec)
     node = Node(cfg, committer=committer)
     tip = import_era(node.factory, args.file, EthBeaconConsensus(node.committer))
     print(f"imported era1 file, tip={tip}")
@@ -182,16 +189,18 @@ def cmd_node(args):
     committer = _make_committer(args)
     kw = {}
     if args.genesis:
-        header, alloc, storage, codes, chain_id = _load_genesis(args.genesis, committer)
+        header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(args.genesis, committer)
         kw = dict(genesis_header=header, genesis_alloc=alloc,
-                  genesis_storage=storage, genesis_codes=codes, chain_id=chain_id)
+                  genesis_storage=storage, genesis_codes=codes, chain_id=chain_id,
+                  chain_spec=chain_spec)
     elif args.dev:
         # reference --dev auto-installs a dev chainspec with a funded key
-        header, alloc, storage, codes, chain_id = _load_genesis(
+        header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(
             None, committer, spec=_dev_genesis_spec()
         )
         kw = dict(genesis_header=header, genesis_alloc=alloc,
-                  genesis_storage=storage, genesis_codes=codes, chain_id=chain_id)
+                  genesis_storage=storage, genesis_codes=codes, chain_id=chain_id,
+                  chain_spec=chain_spec)
         print(f"dev genesis: funded key 0x{DEV_PRIVATE_KEY:064x}")
     else:
         from .storage import MemDb
@@ -214,6 +223,7 @@ def cmd_node(args):
                      p2p_host=args.addr,
                      discovery=not args.no_discovery,
                      bootnodes=tuple(args.bootnodes.split(",")) if args.bootnodes else (),
+                     bootnodes_v5=tuple(args.bootnodes_v5.split(",")) if args.bootnodes_v5 else (),
                      **kw)
     node = Node(cfg, committer=committer)
     p2p_port = node.start_network()
@@ -238,6 +248,25 @@ def cmd_node(args):
                       f"({len(block.transactions)} txs) 0x{block.hash.hex()[:16]}")
 
         node.tasks.spawn_critical("dev-miner", mine_loop)
+    elif args.dev:
+        # --block-time 0: geth-dev style instant sealing — mine the moment
+        # the pool holds an executable transaction
+        print("dev mode: instant sealing (mine on transaction)")
+
+        def mine_on_tx(shutdown):
+            while not shutdown.wait(0.05):
+                if not node.pool.updated.is_set():
+                    continue  # no pool activity since last look: no reads
+                node.pool.updated.clear()
+                # only seal when something is executable — queued-only
+                # (nonce-gapped) pools must not grind out empty blocks
+                if next(node.pool.best_transactions(), None) is None:
+                    continue
+                block = node.miner.mine_block(timestamp=int(time.time()))
+                print(f"mined block {block.header.number} "
+                      f"({len(block.transactions)} txs) 0x{block.hash.hex()[:16]}")
+
+        node.tasks.spawn_critical("dev-miner", mine_on_tx)
     try:
         while not node.tasks.shutdown.wait(1.0):
             pass
@@ -367,6 +396,8 @@ def main(argv=None) -> int:
     p.add_argument("--disable-p2p", action="store_true")
     p.add_argument("--no-discovery", action="store_true")
     p.add_argument("--bootnodes", default="", help="comma-separated enode urls")
+    p.add_argument("--bootnodes-v5", default="", dest="bootnodes_v5",
+                   help="comma-separated enr:... records (discv5)")
     add_hasher(p)
     p.set_defaults(fn=cmd_node)
 

@@ -19,7 +19,11 @@ from .rlpx import BASE_PROTOCOL_OFFSET, DISCONNECT_ID, PING_ID, PONG_ID, RlpxSes
 from .wire import Status
 
 CLIENT_ID = "reth-tpu/0.2"
-ETH_CAPS = [("eth", 68)]
+ETH_CAPS = [("eth", 68), ("snap", 1)]
+# capability message-id spaces are assigned alphabetically after the base
+# protocol: eth/68 spans 17 ids, snap/1 follows (devp2p multiplexing rule)
+ETH_MSG_COUNT = 17
+SNAP_OFFSET = BASE_PROTOCOL_OFFSET + ETH_MSG_COUNT
 
 
 class PeerError(Exception):
@@ -36,6 +40,9 @@ class PeerConnection:
     def __init__(self, session: RlpxSession, status: Status):
         self.session = session
         self.status = status  # the REMOTE peer's status
+        self.snap_enabled = any(
+            name == "snap" and v >= 1
+            for name, v in (session.remote_hello or {}).get("caps", []))
         self._req_ids = itertools.count(1)
         self._lock = threading.Lock()
         # unsolicited gossip received while awaiting a response (drained by
@@ -54,11 +61,22 @@ class PeerConnection:
         with self._lock:
             self.session.send_msg(BASE_PROTOCOL_OFFSET + mid, payload)
 
+    def send_snap(self, msg) -> None:
+        from . import snap as snap_mod
+
+        mid, payload = snap_mod.encode_snap(msg)
+        with self._lock:
+            self.session.send_msg(SNAP_OFFSET + mid, payload)
+
     def recv(self):
-        """Next eth message; p2p pings are answered inline, disconnects
+        """Next eth/snap message; p2p pings are answered inline, disconnects
         surface as PeerError."""
         while True:
             mid, body = self.session.recv_msg()
+            if self.snap_enabled and mid >= SNAP_OFFSET:
+                from . import snap as snap_mod
+
+                return snap_mod.decode_snap(mid - SNAP_OFFSET, body)
             if mid >= BASE_PROTOCOL_OFFSET:
                 return wire.decode_eth(mid - BASE_PROTOCOL_OFFSET, body)
             if mid == PING_ID:
@@ -75,7 +93,7 @@ class PeerConnection:
 
     @classmethod
     def _finish_handshake(cls, session: RlpxSession, node_priv: int,
-                          our_status: Status) -> "PeerConnection":
+                          our_status: Status, fork_filter=None) -> "PeerConnection":
         session.hello(node_priv, CLIENT_ID, ETH_CAPS)
         if not any(name == "eth" and v >= 68 for name, v in session.remote_hello["caps"]):
             session.disconnect()
@@ -88,7 +106,7 @@ class PeerConnection:
             raise PeerError("expected status handshake")
         remote = wire.decode_eth(wire.MessageId.STATUS, rbody)
         try:
-            _validate_status(our_status, remote)
+            _validate_status(our_status, remote, fork_filter)
         except PeerError:
             session.disconnect()
             raise
@@ -97,22 +115,22 @@ class PeerConnection:
     @classmethod
     def connect(cls, host: str, port: int, our_status: Status,
                 remote_pub: tuple[int, int], node_priv: int | None = None,
-                timeout: float = 10.0) -> "PeerConnection":
+                timeout: float = 10.0, fork_filter=None) -> "PeerConnection":
         """Dial a peer (its public key comes from discovery / the enode)."""
         key = node_priv or random_node_key()
         sock = socket.create_connection((host, port), timeout=timeout)
         try:
             session = rlpx.initiate(sock, key, remote_pub)
-            return cls._finish_handshake(session, key, our_status)
+            return cls._finish_handshake(session, key, our_status, fork_filter)
         except Exception:
             sock.close()
             raise
 
     @classmethod
     def accept(cls, sock: socket.socket, our_status: Status,
-               node_priv: int) -> "PeerConnection":
+               node_priv: int, fork_filter=None) -> "PeerConnection":
         session = rlpx.respond(sock, node_priv)
-        return cls._finish_handshake(session, node_priv, our_status)
+        return cls._finish_handshake(session, node_priv, our_status, fork_filter)
 
     # -- typed requests (HeadersClient / BodiesClient analogues) ---------------
 
@@ -147,12 +165,54 @@ class PeerConnection:
         self.send(wire.GetReceipts(rid, hashes))
         return self._await_response(wire.ReceiptsMsg, rid).receipts
 
+    # -- snap/1 requests (state-range client) ----------------------------------
+
+    def _snap_request(self, req, resp_cls):
+        if not self.snap_enabled:
+            raise PeerError("peer does not support snap/1")
+        self.send_snap(req)
+        return self._await_response(resp_cls, req.request_id)
+
+    def get_account_range(self, root: bytes, origin: bytes, limit: bytes,
+                          response_bytes: int | None = None):
+        from . import snap as s
+
+        req = s.GetAccountRange(next(self._req_ids), root, origin, limit,
+                                response_bytes or s.SOFT_RESPONSE_LIMIT)
+        return self._snap_request(req, s.AccountRange)
+
+    def get_storage_ranges(self, root: bytes, account_hashes: list[bytes],
+                           origin: bytes = b"", limit: bytes = b""):
+        from . import snap as s
+
+        req = s.GetStorageRanges(next(self._req_ids), root, account_hashes,
+                                 origin, limit)
+        return self._snap_request(req, s.StorageRanges)
+
+    def get_byte_codes(self, hashes: list[bytes]):
+        from . import snap as s
+
+        return self._snap_request(
+            s.GetByteCodes(next(self._req_ids), hashes), s.ByteCodes)
+
+    def get_trie_nodes(self, root: bytes, paths: list[list[bytes]]):
+        from . import snap as s
+
+        return self._snap_request(
+            s.GetTrieNodes(next(self._req_ids), root, paths), s.TrieNodes)
+
     def close(self):
         self.session.close()
 
 
-def _validate_status(ours: Status, theirs: Status) -> None:
+def _validate_status(ours: Status, theirs: Status, fork_filter=None) -> None:
     if theirs.network_id != ours.network_id:
         raise PeerError(f"network id mismatch: {theirs.network_id}")
     if theirs.genesis != ours.genesis:
         raise PeerError("genesis mismatch")
+    if fork_filter is not None:
+        # EIP-2124: reject peers whose fork history is incompatible
+        try:
+            fork_filter(theirs.fork_id)
+        except ValueError as e:
+            raise PeerError(f"incompatible fork id: {e}") from None

@@ -32,13 +32,19 @@ def parse_enode(url: str) -> tuple[tuple[int, int], str, int]:
 
 class NetworkManager:
     def __init__(self, factory, status: Status, pool=None, host: str = "127.0.0.1",
-                 port: int = 0, node_priv: int | None = None):
+                 port: int = 0, node_priv: int | None = None,
+                 chain_spec=None, head_position: tuple[int, int] = (0, 0)):
         self.factory = factory
         self.status = status
         self.pool = pool
         self.host = host
         self.port = port
         self.node_priv = node_priv or random_node_key()
+        # EIP-2124 ForkFilter: reject peers on an incompatible fork during
+        # the Status handshake (reference: alloy ForkFilter used by
+        # crates/net/network session setup)
+        self.chain_spec = chain_spec
+        self.head_position = head_position
         self.peers: list[PeerConnection] = []
         from .reputation import PeersManager
 
@@ -46,6 +52,17 @@ class NetworkManager:
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+
+    def _snap_server(self):
+        if getattr(self, "_snap", None) is None:
+            from .snap import SnapServer
+
+            self._snap = SnapServer(self.factory)
+        return self._snap
+
+    def _fork_filter(self, remote_fork_id: tuple[bytes, int]) -> None:
+        if self.chain_spec is not None:
+            self.chain_spec.validate_fork_id(remote_fork_id, *self.head_position)
 
     @property
     def enode(self) -> str:
@@ -60,7 +77,8 @@ class NetworkManager:
         if self.peers_manager.is_banned(pubkey_to_bytes(pub)):
             raise PeerError("peer is banned")
         peer = PeerConnection.connect(host, port, self.status, pub,
-                                      node_priv=self.node_priv, timeout=timeout)
+                                      node_priv=self.node_priv, timeout=timeout,
+                                      fork_filter=self._fork_filter)
         self.peers.append(peer)
         return peer
 
@@ -88,7 +106,8 @@ class NetworkManager:
             except OSError:
                 return
             try:
-                peer = PeerConnection.accept(sock, self.status, self.node_priv)
+                peer = PeerConnection.accept(sock, self.status, self.node_priv,
+                                             fork_filter=self._fork_filter)
             except Exception:  # noqa: BLE001 — handshake parses attacker-
                 # controlled bytes; ANY failure must drop the peer, never
                 # the accept loop (a dead listener = no inbound peers ever)
@@ -127,6 +146,20 @@ class NetworkManager:
                 pass
 
     def _handle(self, peer: PeerConnection, msg):
+        from . import snap as snap_mod
+
+        if isinstance(msg, snap_mod.GetAccountRange):
+            peer.send_snap(self._snap_server().account_range(msg))
+            return
+        if isinstance(msg, snap_mod.GetStorageRanges):
+            peer.send_snap(self._snap_server().storage_ranges(msg))
+            return
+        if isinstance(msg, snap_mod.GetByteCodes):
+            peer.send_snap(self._snap_server().byte_codes(msg))
+            return
+        if isinstance(msg, snap_mod.GetTrieNodes):
+            peer.send_snap(self._snap_server().trie_nodes(msg))
+            return
         if isinstance(msg, wire.GetBlockHeaders):
             peer.send(wire.BlockHeaders(msg.request_id, self._headers_for(msg)))
         elif isinstance(msg, wire.GetBlockBodies):

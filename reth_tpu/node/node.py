@@ -42,6 +42,7 @@ class NodeConfig:
     static_file_distance: int | None = None
     prune_modes: object | None = None  # PruneModes | None
     jwt_secret: bytes | None = None   # engine-port JWT (auto from datadir)
+    chain_spec: object | None = None  # ChainSpec: hardfork schedule + fork ids
     ws_port: int | None = None        # WebSocket RPC (None disables; 0 = any)
     ipc_path: str | None = None       # Unix-socket RPC (None disables)
     enable_admin: bool = False        # admin_ is node control: explicit opt-in
@@ -51,6 +52,7 @@ class NodeConfig:
     discovery: bool = True
     node_key: int | None = None       # secp256k1 priv; random when unset
     bootnodes: tuple[str, ...] = ()   # enode:// urls
+    bootnodes_v5: tuple[str, ...] = ()  # enr:... text records (discv5/DNS)
 
 
 class Node:
@@ -78,6 +80,23 @@ class Node:
                 self.factory, config.genesis_header, config.genesis_alloc,
                 config.genesis_storage, config.genesis_codes, self.committer,
             )
+        # chain spec: persist on first launch, rebuild on restart (a node
+        # relaunched from a datadir without --genesis must keep advertising
+        # the right EIP-2124 fork id)
+        from ..storage.tables import Tables
+
+        _SPEC_KEY = b"chain_spec"
+        if config.chain_spec is not None:
+            with self.factory.provider_rw() as p:
+                p.tx.put(Tables.Metadata.name, _SPEC_KEY,
+                         config.chain_spec.to_json().encode())
+        else:
+            with self.factory.provider() as p:
+                raw = p.tx.get(Tables.Metadata.name, _SPEC_KEY)
+            if raw is not None:
+                from ..chainspec import ChainSpec
+
+                config.chain_spec = ChainSpec.from_json(raw.decode())
         self.consensus = EthBeaconConsensus(self.committer)
         self.tree = EngineTree(
             self.factory, self.committer, self.consensus,
@@ -185,6 +204,7 @@ class Node:
         # component wiring in the node builder, launch/engine.rs:145-156)
         self.network = None
         self.discovery = None
+        self.discovery_v5 = None
         if config.p2p_port is not None:
             from ..net.p2p import random_node_key
             from ..net.server import NetworkManager
@@ -193,15 +213,37 @@ class Node:
             key = config.node_key or random_node_key()
             with self.factory.provider() as p:
                 tip_num = p.last_block_number()
+                tip_header = p.header_by_number(tip_num)
+                fork_id = (b"\x00" * 4, 0)
+                if config.chain_spec is not None:
+                    fork_id = config.chain_spec.fork_id(
+                        tip_num, tip_header.timestamp if tip_header else 0)
                 status = Status(
                     network_id=config.chain_id,
                     head=p.canonical_hash(tip_num),
                     genesis=p.canonical_hash(0),
+                    fork_id=fork_id,
                 )
             self.network = NetworkManager(
                 self.factory, status, pool=self.pool, host=config.p2p_host,
                 port=config.p2p_port, node_priv=key,
+                chain_spec=config.chain_spec,
+                head_position=(tip_num, tip_header.timestamp if tip_header else 0),
             )
+
+            # keep the advertised Status + ForkFilter anchored to the LIVE
+            # head: a node that syncs across a fork boundary must start
+            # advertising (and enforcing) the post-fork id
+            def _track_head(chain, _net=self.network, _spec=config.chain_spec):
+                if not chain:
+                    return
+                tip = chain[-1].block.header
+                _net.head_position = (tip.number, tip.timestamp)
+                _net.status.head = tip.hash
+                if _spec is not None:
+                    _net.status.fork_id = _spec.fork_id(tip.number, tip.timestamp)
+
+            self.tree.canon_listeners.append(_track_head)
         from ..rpc.admin import AdminApi
 
         self.admin_api = AdminApi(self.network, None, config.chain_id)
@@ -218,14 +260,49 @@ class Node:
         port = self.network.start()
         if self.config.discovery:
             from ..net.discv4 import Discv4
+            from ..net.discv5 import Discv5
 
             self.discovery = Discv4(self.network.node_priv,
                                     host=self.network.host, tcp_port=port)
             self.discovery.start()
             self.admin_api.discovery = self.discovery
+            # discv5 runs alongside discv4 (reference: both services feed
+            # the same peer set, crates/net/discv5/src/lib.rs)
+            self.discovery_v5 = Discv5(self.network.node_priv,
+                                       host=self.network.host, tcp_port=port)
+            self.discovery_v5.start()
             if self.config.bootnodes:
                 self.discovery.bootstrap(list(self.config.bootnodes))
                 self.discovery.lookup()
+            if self.config.bootnodes_v5:
+                self.discovery_v5.bootstrap(list(self.config.bootnodes_v5))
+
+                def _v5_lookup(shutdown, d5=self.discovery_v5, net=self.network):
+                    # sessions form asynchronously (1+ UDP round trips) —
+                    # a lookup fired synchronously after bootstrap would
+                    # find zero session peers and degrade to static peering
+                    for _ in range(100):
+                        if shutdown.wait(0.1):
+                            return
+                        if d5.sessions:
+                            break
+                    known = {p.node_id for p in net.peers}
+                    for enr in d5.lookup(rounds=2):
+                        # discovered records are dialable RLPx peers
+                        if not (enr.ip and enr.tcp_port):
+                            continue
+                        from ..primitives.secp256k1 import pubkey_to_bytes
+
+                        nid = pubkey_to_bytes(enr.pubkey)
+                        if nid in known:
+                            continue
+                        try:
+                            net.connect_to(
+                                f"enode://{nid.hex()}@{enr.ip}:{enr.tcp_port}")
+                        except Exception:  # noqa: BLE001 — best-effort dial
+                            pass
+
+                self.tasks.spawn("discv5-lookup", _v5_lookup)
         elif self.config.bootnodes:
             # static peering: without discovery, dial the bootnodes directly
             for url in self.config.bootnodes:
@@ -255,6 +332,8 @@ class Node:
             self.ipc.stop()
         if self.discovery is not None:
             self.discovery.stop()
+        if self.discovery_v5 is not None:
+            self.discovery_v5.stop()
         if self.network is not None:
             self.network.stop()
         if self.factory.db is not None and hasattr(self.factory.db, "flush"):

@@ -80,6 +80,12 @@ class TransactionPool:
         # finalization) — bounded FIFO
         self._mined_sidecars: list[bytes] = []
         self.mined_sidecar_retention = 128
+        # set on every successful insert / canonical update; consumers
+        # (instant-seal dev miner, payload jobs) wait on this instead of
+        # polling executability (which costs a state read per sender)
+        import threading
+
+        self.updated = threading.Event()
 
     # -- submission -----------------------------------------------------------
 
@@ -143,6 +149,7 @@ class TransactionPool:
         ptx = PooledTx(tx, sender, next(self._submission_counter), cost)
         sender_txs[tx.nonce] = ptx
         self.by_hash[h] = ptx
+        self.updated.set()
         return h
 
     def _fee_of(self, tx: Transaction) -> int:
@@ -267,3 +274,5 @@ class TransactionPool:
                 del txs[n]
             if not txs:
                 del self.by_sender[sender]
+        if self.by_hash:
+            self.updated.set()  # remaining txs may have become executable

@@ -1,0 +1,154 @@
+"""ChainSpec: activation schedule, EIP-2124 fork ids, ForkFilter rules.
+
+Fork-id vectors are the published EIP-2124 mainnet test vectors (the
+same ones the reference's alloy ForkId tests use), so a match here means
+we interoperate with real clients' Status handshakes.
+"""
+
+import pytest
+
+from reth_tpu.chainspec import (
+    BERLIN, CANCUN, HOMESTEAD, LONDON, MAINNET, PARIS, PETERSBURG, SHANGHAI,
+    SPURIOUS_DRAGON, ChainSpec, ForkCondition, dev_spec,
+)
+
+
+def fid(h):
+    return bytes.fromhex(h)
+
+
+# (head_number, expected FORK_HASH, expected FORK_NEXT) — EIP-2124 appendix
+MAINNET_VECTORS = [
+    (0, "fc64ec04", 1_150_000),
+    (1_149_999, "fc64ec04", 1_150_000),
+    (1_150_000, "97c2c34c", 1_920_000),
+    (1_919_999, "97c2c34c", 1_920_000),
+    (1_920_000, "91d1f948", 2_463_000),
+    (2_462_999, "91d1f948", 2_463_000),
+    (2_463_000, "7a64da13", 2_675_000),
+    (2_674_999, "7a64da13", 2_675_000),
+    (2_675_000, "3edd5b10", 4_370_000),
+    (4_369_999, "3edd5b10", 4_370_000),
+    (4_370_000, "a00bc324", 7_280_000),
+    (7_279_999, "a00bc324", 7_280_000),
+    (7_280_000, "668db0af", 9_069_000),
+    (9_068_999, "668db0af", 9_069_000),
+    (9_069_000, "879d6e30", 9_200_000),
+    (9_199_999, "879d6e30", 9_200_000),
+]
+
+# (head_number, head_timestamp, hash, next) — post-merge era: the organic
+# merge block must NOT fold into the hash (these are the fork ids real
+# clients advertise today)
+MAINNET_VECTORS_POSTMERGE = [
+    (15_537_394, 1_668_000_000, "f0afd0e3", 1_681_338_455),  # paris
+    (17_034_870, 1_681_338_455, "dce96c2d", 1_710_338_135),  # shanghai
+    (19_426_587, 1_710_338_135, "9f3d2254", 1_746_612_311),  # cancun
+    (22_431_084, 1_746_612_311, "c376cf8b", 0),              # prague
+]
+
+
+@pytest.mark.parametrize("head,ts,want_hash,want_next", MAINNET_VECTORS_POSTMERGE)
+def test_mainnet_fork_id_postmerge(head, ts, want_hash, want_next):
+    assert MAINNET.fork_id(head, ts) == (fid(want_hash), want_next)
+
+
+@pytest.mark.parametrize("head,want_hash,want_next", MAINNET_VECTORS)
+def test_mainnet_fork_id_vectors(head, want_hash, want_next):
+    assert MAINNET.fork_id(head) == (fid(want_hash), want_next)
+
+
+def test_fork_id_after_timestamp_forks():
+    # past every scheduled fork: FORK_NEXT must be 0 and the hash stable
+    h, nxt = MAINNET.fork_id(25_000_000, 1_800_000_000)
+    assert nxt == 0
+    assert MAINNET.fork_id(30_000_000, 1_900_000_000) == (h, nxt)
+
+
+def test_spec_at_ordering():
+    assert MAINNET.spec_at(0) == "frontier"
+    assert MAINNET.spec_at(1_150_000) == "homestead"
+    # Constantinople and Petersburg activate together; Petersburg wins
+    assert MAINNET.spec_at(7_280_000) == PETERSBURG
+    assert MAINNET.spec_at(20_000_000, 1_681_338_455) == SHANGHAI
+    assert MAINNET.spec_at(20_000_000, 1_746_612_311) == "prague"
+    assert MAINNET.is_at_least(LONDON, 12_965_000)
+    assert not MAINNET.is_at_least(LONDON, 12_964_999)
+    assert MAINNET.is_at_least(HOMESTEAD, 12_965_000)
+
+
+def test_fork_filter_accepts_same_and_syncing_peers():
+    # same fork, nothing announced
+    MAINNET.validate_fork_id((fid("668db0af"), 0), 7_987_396)
+    # same fork, remote announces a future fork we'll learn about
+    MAINNET.validate_fork_id((fid("668db0af"), 99_999_999_999), 7_987_396)
+    # we're on Byzantium pre-fork, remote already announces Petersburg
+    MAINNET.validate_fork_id((fid("a00bc324"), 7_280_000), 7_279_999)
+    # remote behind us but announcing the upgrade it will apply
+    MAINNET.validate_fork_id((fid("a00bc324"), 7_280_000), 7_987_396)
+    # remote ahead of us (we are the stale one): accept
+    MAINNET.validate_fork_id((fid("668db0af"), 9_069_000), 7_279_999)
+    # fully-synced remote (FORK_NEXT=0) while we're still syncing: accept —
+    # this is every healthy peer during initial sync
+    MAINNET.validate_fork_id((fid("c376cf8b"), 0), 7_279_999)
+
+
+def test_fork_filter_rejects():
+    # remote behind and NOT announcing the fork it must apply
+    with pytest.raises(ValueError):
+        MAINNET.validate_fork_id((fid("a00bc324"), 0), 7_987_396)
+    # different chain entirely
+    with pytest.raises(ValueError):
+        MAINNET.validate_fork_id((fid("5cddc0e1"), 0), 7_987_396)
+
+
+def test_from_genesis_config():
+    spec = ChainSpec.from_genesis_config({
+        "chainId": 7777, "homesteadBlock": 0, "berlinBlock": 5,
+        "londonBlock": 10, "terminalTotalDifficulty": 0,
+        "shanghaiTime": 100, "cancunTime": 200,
+    }, genesis_hash=b"\x11" * 32)
+    assert spec.chain_id == 7777
+    assert spec.hardforks[BERLIN] == ForkCondition(block=5)
+    assert spec.hardforks[PARIS] == ForkCondition(ttd=0)
+    # ttd=0 => Paris is active from genesis, outranking London
+    assert spec.spec_at(10, 99) == PARIS
+    assert spec.spec_at(10, 100) == SHANGHAI
+    assert spec.spec_at(10, 200) == CANCUN
+    # eip155/eip158 both map onto spurious dragon without duplication
+    spec2 = ChainSpec.from_genesis_config({"eip155Block": 3, "eip158Block": 3})
+    assert spec2.hardforks[SPURIOUS_DRAGON] == ForkCondition(block=3)
+
+
+def test_dev_spec_everything_active():
+    spec = dev_spec()
+    assert spec.spec_at(0, 0) == "prague"
+    assert spec.fork_id(0, 0) == (spec.fork_id(100, 100)[0], 0)
+
+
+def test_chain_spec_persists_across_restart(tmp_path):
+    """A node relaunched from a datadir without --genesis rebuilds the same
+    spec (and so keeps advertising the right fork id)."""
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.trie import TrieCommitter
+    from reth_tpu.primitives.types import Header, EMPTY_ROOT_HASH
+    from reth_tpu.trie.state_root import state_root
+
+    cpu = TrieCommitter(hasher=keccak256_batch_np)
+    root, _ = state_root({}, {}, committer=cpu)
+    genesis = Header(number=0, state_root=root, base_fee_per_gas=10**9,
+                     withdrawals_root=EMPTY_ROOT_HASH)
+    spec = ChainSpec.from_genesis_config(
+        {"chainId": 777, "londonBlock": 5, "shanghaiTime": 99},
+        genesis_hash=genesis.hash, chain_id=777)
+    cfg = NodeConfig(chain_id=777, datadir=str(tmp_path),
+                     genesis_header=genesis, chain_spec=spec)
+    node = Node(cfg, committer=cpu)
+    node.factory.db.flush()
+
+    cfg2 = NodeConfig(chain_id=777, datadir=str(tmp_path))
+    node2 = Node(cfg2, committer=cpu)
+    assert cfg2.chain_spec is not None
+    assert cfg2.chain_spec.fork_id(10, 100) == spec.fork_id(10, 100)
+    assert cfg2.chain_spec.hardforks == spec.hardforks

@@ -210,3 +210,37 @@ def test_online_pipeline_sync(testnet):
     with factory_b.provider() as p:
         assert p.header_by_number(8).state_root == builder.tip.state_root
     peer.close()
+
+
+def test_fork_id_filter_rejects_incompatible_peer(testnet):
+    """EIP-2124: a peer whose fork history diverges is dropped during the
+    Status handshake, even with matching genesis + network id."""
+    from reth_tpu.chainspec import MAINNET, dev_spec
+
+    server, port, status, factory_b, builder = testnet
+    server.chain_spec = dev_spec(chain_id=1, genesis_hash=builder.genesis.hash)
+    server.head_position = (8, builder.tip.timestamp)
+    ok_fid = server.chain_spec.fork_id(8, builder.tip.timestamp)
+
+    good = Status(network_id=1, head=builder.genesis.hash,
+                  genesis=builder.genesis.hash, fork_id=ok_fid)
+    peer = PeerConnection.connect("127.0.0.1", port, good,
+                                  pubkey_from_priv(server.node_priv))
+    peer.close()
+
+    # a mainnet-history fork hash against a dev-spec server: incompatible.
+    # The server sends its Status before validating ours, so the dial
+    # itself may succeed — the session is dead by the first request.
+    bad = Status(network_id=1, head=builder.genesis.hash,
+                 genesis=builder.genesis.hash,
+                 fork_id=(bytes.fromhex("668db0af"), 0))
+    with pytest.raises((PeerError, OSError)):
+        p = PeerConnection.connect("127.0.0.1", port, bad,
+                                   pubkey_from_priv(server.node_priv))
+        p.get_headers(1, 1)
+
+    # client-side filter: dialing a peer with an incompatible fork id fails
+    with pytest.raises(PeerError):
+        PeerConnection.connect(
+            "127.0.0.1", port, bad, pubkey_from_priv(server.node_priv),
+            fork_filter=lambda fid: MAINNET.validate_fork_id(fid, 7_987_396))

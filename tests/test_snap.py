@@ -1,0 +1,125 @@
+"""snap/1 state-range serving over the encrypted testnet.
+
+Reference analogue: the `StateRangeProvider` serving surface
+(crates/storage/storage-api/src/trie.rs:73) + devp2p snap vocabulary,
+multiplexed next to eth/68 the way reth's RLPx sub-protocol registry
+does (crates/net/network/src/protocol.rs).
+"""
+
+import pytest
+
+from reth_tpu.consensus import EthBeaconConsensus
+from reth_tpu.net import NetworkManager, PeerConnection, Status
+from reth_tpu.net import snap
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256, keccak256_batch_np
+from reth_tpu.primitives.secp256k1 import pubkey_from_priv
+from reth_tpu.stages import Pipeline, default_stages
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import import_chain, init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+@pytest.fixture(scope="module")
+def snap_net():
+    alice = Wallet(0xA11CE)
+    code = bytes.fromhex("6001600155")  # writes storage on every call
+    contract = b"\x0c" * 20
+    genesis_accounts = {
+        alice.address: Account(balance=10**21),
+        contract: Account(balance=1, code_hash=keccak256(code)),
+    }
+    builder = ChainBuilder(genesis_accounts, committer=CPU, codes={keccak256(code): code})
+    for i in range(4):
+        builder.build_block([alice.transfer(b"\x0b" * 20, 100 + i)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 codes={keccak256(code): code}, committer=CPU)
+    import_chain(factory, builder.blocks[1:], EthBeaconConsensus(CPU))
+    Pipeline(factory, default_stages(committer=CPU)).run(4)
+    status = Status(network_id=1, head=builder.tip.hash, genesis=builder.genesis.hash)
+    server = NetworkManager(factory, status, node_priv=0x51A9)
+    port = server.start()
+    peer = PeerConnection.connect("127.0.0.1", port, status,
+                                  pubkey_from_priv(server.node_priv))
+    root = builder.tip.state_root
+    yield server, peer, factory, root
+    peer.close()
+    server.stop()
+
+
+def test_slim_account_roundtrip():
+    acc = Account(nonce=3, balance=10**18)
+    slim = snap.slim_account(acc)
+    back = snap.unslim_account(slim)
+    assert back.nonce == 3 and back.balance == 10**18
+    assert back.storage_root == acc.storage_root
+    assert back.code_hash == acc.code_hash
+
+
+def test_snap_codec_roundtrips():
+    msgs = [
+        snap.GetAccountRange(1, b"\x01" * 32, b"\x00" * 32, b"\xff" * 32, 1000),
+        snap.AccountRange(1, [(b"\x02" * 32, b"\x80")], [b"proofnode"]),
+        snap.GetStorageRanges(2, b"\x01" * 32, [b"\x03" * 32], b"", b"", 500),
+        snap.StorageRanges(2, [[(b"\x04" * 32, b"\x05")]], []),
+        snap.GetByteCodes(3, [b"\x06" * 32], 100),
+        snap.ByteCodes(3, [b"\x60\x01"]),
+        snap.GetTrieNodes(4, b"\x01" * 32, [[b"\x07"], [b"\x08", b"\x09"]], 50),
+        snap.TrieNodes(4, [b"node"]),
+    ]
+    for m in msgs:
+        mid, payload = snap.encode_snap(m)
+        assert snap.decode_snap(mid, payload) == m, type(m).__name__
+
+
+def test_account_range_with_proofs(snap_net):
+    server, peer, factory, root = snap_net
+    assert peer.snap_enabled
+    rng = peer.get_account_range(root, b"\x00" * 32, b"\xff" * 32)
+    assert len(rng.accounts) >= 3  # alice, recipient, contract at least
+    keys = [h for h, _ in rng.accounts]
+    assert keys == sorted(keys)
+    assert rng.proof, "range must carry boundary proofs"
+    assert snap.verify_account_range(root, b"\x00" * 32, rng)
+    # stale root -> empty (unavailable)
+    stale = peer.get_account_range(b"\x77" * 32, b"\x00" * 32, b"\xff" * 32)
+    assert stale.accounts == [] and stale.proof == []
+
+
+def test_account_range_pagination(snap_net):
+    server, peer, factory, root = snap_net
+    # tiny byte budget: server truncates; resume from last key returns more
+    first = peer.get_account_range(root, b"\x00" * 32, b"\xff" * 32,
+                                   response_bytes=1)
+    assert len(first.accounts) == 1
+    last = first.accounts[-1][0]
+    nxt = peer.get_account_range(
+        root, (int.from_bytes(last, "big") + 1).to_bytes(32, "big"),
+        b"\xff" * 32)
+    assert nxt.accounts and nxt.accounts[0][0] > last
+
+
+def test_storage_ranges_and_bytecodes(snap_net):
+    server, peer, factory, root = snap_net
+    contract = b"\x0c" * 20
+    ha = keccak256(contract)
+    with factory.provider() as p:
+        acc = p.account(contract)
+    rng = peer.get_storage_ranges(root, [ha])
+    assert len(rng.slots) == 1
+    # the contract wrote slot 1 = 1 on genesis-time... (no calls made:
+    # storage may be empty — shape is what matters)
+    codes = peer.get_byte_codes([acc.code_hash])
+    assert codes.codes and keccak256(codes.codes[0]) == acc.code_hash
+
+
+def test_trie_nodes_healing(snap_net):
+    server, peer, factory, root = snap_net
+    # ask for the root node by empty path: server returns the root's spine
+    nodes = peer.get_trie_nodes(root, [[b""]])
+    assert nodes.nodes
+    assert keccak256(nodes.nodes[0]) == root
