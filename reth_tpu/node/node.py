@@ -43,6 +43,7 @@ class NodeConfig:
     prune_modes: object | None = None  # PruneModes | None
     jwt_secret: bytes | None = None   # engine-port JWT (auto from datadir)
     chain_spec: object | None = None  # ChainSpec: hardfork schedule + fork ids
+    db_backend: str = "memdb"         # memdb | native (C++ WAL engine)
     ws_port: int | None = None        # WebSocket RPC (None disables; 0 = any)
     ipc_path: str | None = None       # Unix-socket RPC (None disables)
     enable_admin: bool = False        # admin_ is node control: explicit opt-in
@@ -70,11 +71,24 @@ class Node:
         _native_lib()
         # task runtime (reference crates/tasks): components register their
         # loops here; a critical failure begins shutdown
-        self.tasks = TaskExecutor(
-            on_critical_failure=lambda name, e, tb: self.tasks.shutdown.signal()
-        )
+        def _critical_failed(name, e, tb):
+            import sys
+
+            print(f"critical task {name!r} failed: {e}\n{tb}", file=sys.stderr)
+            self.tasks.shutdown.signal()
+
+        self.tasks = TaskExecutor(on_critical_failure=_critical_failed)
         db_path = Path(config.datadir) / "db.bin" if config.datadir else None
-        self.factory = ProviderFactory(MemDb(db_path))
+        # storage-settings switch (reference: the database args picking the
+        # backing store): "memdb" = in-process store with snapshot file,
+        # "native" = the C++ WAL engine (native/kvstore.cpp)
+        if config.db_backend == "native":
+            from ..storage.native import NativeDb
+
+            native_dir = Path(config.datadir) / "nativedb" if config.datadir else None
+            self.factory = ProviderFactory(NativeDb(native_dir))
+        else:
+            self.factory = ProviderFactory(MemDb(db_path))
         if config.genesis_header is not None:
             init_genesis(
                 self.factory, config.genesis_header, config.genesis_alloc,
@@ -172,8 +186,15 @@ class Node:
         self.rpc.register(Web3Api())
         self.rpc.register(TxpoolApi(self.pool))
         from ..rpc.debug import DebugApi
+        from ..rpc.flashbots import BundleApi
+        from ..rpc.miner import MinerApi
+        from ..rpc.otterscan import OtterscanApi
 
-        self.rpc.register(DebugApi(self.eth_api))
+        debug_api = DebugApi(self.eth_api)
+        self.rpc.register(debug_api)
+        self.rpc.register(OtterscanApi(self.eth_api, debug_api))
+        self.rpc.register(BundleApi(self.eth_api))
+        self.rpc.register(MinerApi(self.payload_service, self.pool))
         self.engine_api = EngineApi(self.tree, self.payload_service, pool=self.pool)
         # JWT on the engine port (reference auth_layer.rs): explicit secret,
         # else auto-generated jwt.hex under the datadir; dev mode stays open
@@ -223,6 +244,8 @@ class Node:
                     head=p.canonical_hash(tip_num),
                     genesis=p.canonical_hash(0),
                     fork_id=fork_id,
+                    earliest=0,  # full node: whole history served
+                    latest=tip_num,
                 )
             self.network = NetworkManager(
                 self.factory, status, pool=self.pool, host=config.p2p_host,
@@ -240,8 +263,12 @@ class Node:
                 tip = chain[-1].block.header
                 _net.head_position = (tip.number, tip.timestamp)
                 _net.status.head = tip.hash
+                _net.status.latest = tip.number
                 if _spec is not None:
                     _net.status.fork_id = _spec.fork_id(tip.number, tip.timestamp)
+                # eth/69 range gossip replaces TD announcements
+                _net.announce_block_range(_net.status.earliest, tip.number,
+                                          tip.hash)
 
             self.tree.canon_listeners.append(_track_head)
         from ..rpc.admin import AdminApi

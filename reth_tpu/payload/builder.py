@@ -43,6 +43,8 @@ def build_payload(
     pool,
     parent_hash: bytes,
     attrs: PayloadAttributes,
+    extra_data: bytes = b"",
+    gas_ceiling: int | None = None,
 ) -> Block:
     """Assemble a sealed block on top of ``parent_hash``; returns
     (block, total priority fees). ``pool=None`` builds the empty-payload
@@ -59,11 +61,18 @@ def build_payload(
         next_excess_blob_gas(parent.excess_blob_gas, parent.blob_gas_used or 0)
         if cancun else 0
     )
+    # gas target moves toward the miner's ceiling by at most 1/1024 per
+    # block (protocol rule the reference's gas-limit knob follows)
+    gas_limit = parent.gas_limit
+    if gas_ceiling is not None and gas_ceiling != gas_limit:
+        step = max(1, parent.gas_limit // 1024 - 1)
+        gas_limit = (min(gas_limit + step, gas_ceiling) if gas_ceiling > gas_limit
+                     else max(gas_limit - step, gas_ceiling, 5000))
     env = BlockEnv(
         number=parent.number + 1,
         timestamp=attrs.timestamp,
         coinbase=attrs.suggested_fee_recipient,
-        gas_limit=parent.gas_limit,
+        gas_limit=gas_limit,
         base_fee=base_fee,
         prev_randao=attrs.prev_randao,
         chain_id=tree.config.chain_id,
@@ -123,6 +132,7 @@ def build_payload(
         gas_limit=env.gas_limit,
         gas_used=cumulative_gas,
         timestamp=attrs.timestamp,
+        extra_data=extra_data,
         mix_hash=attrs.prev_randao,
         base_fee_per_gas=base_fee,
         withdrawals_root=ordered_trie_root(
@@ -154,7 +164,8 @@ class PayloadJob:
     keeps the job resolvable (a slot must never go blockless)."""
 
     def __init__(self, tree, pool, parent_hash, attrs, lock, deadline: float,
-                 interval: float):
+                 interval: float, extra_data: bytes = b"",
+                 gas_ceiling: int | None = None):
         self.tree = tree
         self.pool = pool
         self.parent_hash = parent_hash
@@ -162,6 +173,8 @@ class PayloadJob:
         self.lock = lock
         self.deadline = time.monotonic() + deadline
         self.interval = interval
+        self.extra_data = extra_data
+        self.gas_ceiling = gas_ceiling
         self.best: Block | None = None
         self.best_fees: int = -1
         self.rebuilds = 0
@@ -169,11 +182,13 @@ class PayloadJob:
         with self.lock:
             try:
                 self.best, self.best_fees = build_payload(
-                    tree, pool, parent_hash, attrs
+                    tree, pool, parent_hash, attrs,
+                    extra_data=extra_data, gas_ceiling=gas_ceiling,
                 )
             except Exception:  # noqa: BLE001 — fall back to an empty payload
                 self.best, self.best_fees = build_payload(
-                    tree, None, parent_hash, attrs
+                    tree, None, parent_hash, attrs,
+                    extra_data=extra_data, gas_ceiling=gas_ceiling,
                 )
         self._thread = threading.Thread(target=self._improve_loop, daemon=True)
         self._thread.start()
@@ -186,7 +201,9 @@ class PayloadJob:
                 return False
             try:
                 block, fees = build_payload(self.tree, self.pool,
-                                            self.parent_hash, self.attrs)
+                                            self.parent_hash, self.attrs,
+                                            extra_data=self.extra_data,
+                                            gas_ceiling=self.gas_ceiling)
             except Exception:  # noqa: BLE001 — keep the current best
                 return False
             self.rebuilds += 1
@@ -221,6 +238,9 @@ class PayloadBuilderService:
         self.lock = lock or threading.RLock()
         self.deadline = deadline
         self.interval = interval
+        # miner_ knobs (rpc/miner.py): stamped into every subsequent job
+        self.extra_data: bytes = b""
+        self.gas_ceiling: int | None = None
         self.jobs: dict[bytes, PayloadJob] = {}
 
     def new_payload_job(self, parent_hash: bytes, attrs: PayloadAttributes) -> bytes:
@@ -228,6 +248,7 @@ class PayloadBuilderService:
         self.jobs[payload_id] = PayloadJob(
             self.tree, self.pool, parent_hash, attrs, self.lock,
             self.deadline, self.interval,
+            extra_data=self.extra_data, gas_ceiling=self.gas_ceiling,
         )
         while len(self.jobs) > self.MAX_JOBS:
             self.jobs.pop(next(iter(self.jobs))).resolve()

@@ -123,6 +123,14 @@ class TransactionPool:
             raise PoolError(f"invalid signature: {e}")
         if tx.tx_type >= 2 and tx.max_priority_fee_per_gas > tx.max_fee_per_gas:
             raise PoolError("priority fee exceeds max fee")
+        # operator price floor (miner_setGasPrice): tip for 1559 txs,
+        # gas price for legacy
+        floor = self.config.minimal_protocol_fee
+        if floor:
+            offered = (tx.max_priority_fee_per_gas if tx.tx_type >= 2
+                       else tx.gas_price)
+            if offered < floor:
+                raise PoolError("transaction underpriced (below pool floor)")
         if tx.gas_limit > 30_000_000:
             raise PoolError("gas limit too high")
         state = self.state_reader()

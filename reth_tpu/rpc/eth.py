@@ -27,9 +27,12 @@ from .server import RpcError
 
 class EthApi:
     def __init__(self, tree: EngineTree, pool=None, chain_id: int = 1):
+        from .gas_oracle import GasPriceOracle
+
         self.tree = tree
         self.pool = pool
         self.chain_id = chain_id
+        self.gas_oracle = GasPriceOracle()
 
     # -- helpers ---------------------------------------------------------------
 
@@ -79,13 +82,10 @@ class EthApi:
         return False
 
     def eth_gasPrice(self):
-        p = self._provider()
-        header = p.header_by_number(p.last_block_number())
-        base = header.base_fee_per_gas or 0
-        return qty(base + 10**9)
+        return qty(self.gas_oracle.suggest_gas_price(self._provider()))
 
     def eth_maxPriorityFeePerGas(self):
-        return qty(10**9)
+        return qty(self.gas_oracle.suggest_tip_cap(self._provider()))
 
     def eth_feeHistory(self, block_count, newest_tag="latest", reward_percentiles=None):
         p = self._provider()

@@ -1,0 +1,92 @@
+"""eth_callBundle: simulate a bundle of signed transactions atop a block.
+
+Reference analogue: `EthBundle` / `EthCallBundle` (crates/rpc/rpc/src/
+eth/bundle.rs) — searcher tooling: execute raw txs sequentially against
+the parent state (without touching the canonical chain), report per-tx
+results, gas, and the coinbase payment summary.
+"""
+
+from __future__ import annotations
+
+from ..evm import BlockExecutor, EvmConfig
+from ..evm.state import EvmState
+from ..primitives.keccak import keccak256
+from ..primitives.types import Transaction
+from .convert import data, parse_data, parse_qty, qty
+from .server import RpcError
+
+MAX_BUNDLE_TXS = 100
+
+
+class BundleApi:
+    def __init__(self, eth_api):
+        self.eth = eth_api
+
+    def eth_callBundle(self, bundle):
+        txs_raw = bundle.get("txs") or []
+        if not txs_raw:
+            raise RpcError(-32602, "bundle missing txs")
+        if len(txs_raw) > MAX_BUNDLE_TXS:
+            raise RpcError(-32602, "bundle too large")
+        state_tag = bundle.get("stateBlockNumber", "latest")
+        p = self.eth._state_at(state_tag)
+        env = self.eth._call_env(state_tag)
+        # simulate as the NEXT block unless pinned
+        if "blockNumber" in bundle:
+            env.number = parse_qty(bundle["blockNumber"])
+        else:
+            env.number += 1
+        if "timestamp" in bundle:
+            env.timestamp = parse_qty(bundle["timestamp"])
+
+        from ..evm.executor import ProviderStateSource
+
+        executor = BlockExecutor(ProviderStateSource(p),
+                                 EvmConfig(chain_id=self.eth.chain_id))
+        state = EvmState(executor.source)
+        coinbase_before = state.balance(env.coinbase)
+        results = []
+        total_gas = 0
+        total_fees = 0
+        gas_available = env.gas_limit
+        for raw in txs_raw:
+            tx = Transaction.decode(parse_data(raw))
+            sender = tx.recover_sender()
+            try:
+                res = executor._execute_tx(state, env, tx, sender, gas_available)
+            except Exception as e:  # noqa: BLE001 — invalid tx in bundle
+                results.append({"txHash": data(tx.hash), "error": str(e)})
+                continue
+            gas_available -= res.gas_used
+            gas_price = tx.effective_gas_price(env.base_fee)
+            total_gas += res.gas_used
+            tip = (gas_price - env.base_fee) * res.gas_used
+            total_fees += tip
+            entry = {
+                "txHash": data(tx.hash),
+                "gasUsed": res.gas_used,
+                "gasPrice": qty(gas_price),
+                "fromAddress": data(sender),
+                "toAddress": data(tx.to) if tx.to else None,
+                "gasFees": qty(tip),
+                "coinbaseDiff": qty(tip),
+                "value": data(res.output),
+            }
+            if not res.success:
+                entry["revert"] = data(res.output)
+            results.append(entry)
+        # the executor already credits priority fees to the coinbase, so the
+        # balance delta IS the full diff (tips + direct transfers)
+        coinbase_diff = state.balance(env.coinbase) - coinbase_before
+        bundle_hash = keccak256(b"".join(
+            Transaction.decode(parse_data(r)).hash for r in txs_raw))
+        return {
+            "bundleHash": data(bundle_hash),
+            "bundleGasPrice": qty(total_fees // total_gas if total_gas else 0),
+            "coinbaseDiff": qty(coinbase_diff),
+            "ethSentToCoinbase": qty(max(0, coinbase_diff - total_fees)),
+            "gasFees": qty(total_fees),
+            "totalGasUsed": total_gas,
+            "stateBlockNumber": env.number - 1,
+            "results": results,
+        }

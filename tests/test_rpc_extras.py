@@ -1,0 +1,160 @@
+"""otterscan / miner / bundle / gas-oracle namespaces over a live node.
+
+Reference analogue: crates/rpc/rpc/src/otterscan.rs, miner.rs,
+eth/bundle.rs, rpc-eth-types gas_oracle.rs.
+"""
+
+import pytest
+
+from reth_tpu.node import Node, NodeConfig
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256, keccak256_batch_np
+from reth_tpu.rpc.convert import data, parse_qty
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+from test_rpc_e2e import rpc
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+@pytest.fixture()
+def node():
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    cfg = NodeConfig(dev=True, genesis_header=builder.genesis,
+                     genesis_alloc=builder.accounts_at_genesis)
+    n = Node(cfg, committer=CPU)
+    n.start_rpc()
+    yield n, alice
+    n.stop()
+
+
+def _mine_transfers(n, alice, count=3):
+    bob = b"\x0b" * 20
+    hashes = []
+    for i in range(count):
+        tx = alice.transfer(bob, 1000 + i)
+        rpc(n.rpc.port, "eth_sendRawTransaction", data(tx.encode()))
+        hashes.append(tx.hash)
+        n.miner.mine_block()
+    return bob, hashes
+
+
+def test_ots_block_details_and_txs(node):
+    n, alice = node
+    port = n.rpc.port
+    assert rpc(port, "ots_getApiLevel") == 8
+    bob, hashes = _mine_transfers(n, alice)
+    details = rpc(port, "ots_getBlockDetails", "0x1")
+    assert details["block"]["transactionCount"] == 1
+    assert parse_qty(details["totalFees"]) > 0
+    h = rpc(port, "eth_getBlockByNumber", "0x2", False)["hash"]
+    by_hash = rpc(port, "ots_getBlockDetailsByHash", h)
+    assert by_hash["block"]["transactionCount"] == 1
+    page = rpc(port, "ots_getBlockTransactions", 1, 0, 10)
+    assert len(page["fullblock"]["transactions"]) == 1
+    assert len(page["receipts"]) == 1
+
+
+def test_ots_search_and_sender_nonce(node):
+    n, alice = node
+    port = n.rpc.port
+    bob, hashes = _mine_transfers(n, alice)
+    res = rpc(port, "ots_searchTransactionsBefore", data(bob), "0x0", 10)
+    assert len(res["txs"]) == 3
+    res2 = rpc(port, "ots_searchTransactionsAfter", data(alice.address), "0x1", 10)
+    assert len(res2["txs"]) == 2  # blocks 2 and 3
+    got = rpc(port, "ots_getTransactionBySenderAndNonce", data(alice.address), "0x1")
+    assert got == data(hashes[1])
+    assert rpc(port, "ots_hasCode", data(bob), "latest") is False
+
+
+def test_ots_contract_creator_and_trace(node):
+    n, alice = node
+    port = n.rpc.port
+    # deploy: initcode returning empty runtime is fine for creator lookup
+    deploy = alice.deploy(bytes.fromhex("600060005500"))
+    rpc(port, "eth_sendRawTransaction", data(deploy.encode()))
+    n.miner.mine_block()
+    from reth_tpu.primitives.rlp import encode_int, rlp_encode
+
+    created = keccak256(rlp_encode([alice.address, encode_int(0)]))[12:]
+    info = rpc(port, "ots_getContractCreator", data(created))
+    assert info is not None
+    assert info["creator"] == data(alice.address)
+    assert info["hash"] == data(deploy.hash)
+    trace = rpc(port, "ots_traceTransaction", data(deploy.hash))
+    assert trace and trace[0]["depth"] == 0
+    assert rpc(port, "ots_getTransactionError", data(deploy.hash)) == "0x"
+
+
+def test_gas_oracle_tracks_tips(node):
+    n, alice = node
+    port = n.rpc.port
+    _mine_transfers(n, alice)
+    price = parse_qty(rpc(port, "eth_gasPrice"))
+    tip = parse_qty(rpc(port, "eth_maxPriorityFeePerGas"))
+    assert tip > 0
+    assert price >= tip  # price = base fee + tip
+    # cached per head: same answer without recompute
+    assert parse_qty(rpc(port, "eth_gasPrice")) == price
+
+
+def test_miner_namespace(node):
+    n, alice = node
+    port = n.rpc.port
+    assert rpc(port, "miner_setExtra", "0x" + b"reth-tpu".hex()) is True
+    assert rpc(port, "miner_setGasLimit", "0x1c9c380") is True
+    assert rpc(port, "miner_setGasPrice", "0x3b9aca00") is True
+
+
+def test_eth_call_bundle(node):
+    n, alice = node
+    port = n.rpc.port
+    bob = b"\x0b" * 20
+    tx1 = alice.transfer(bob, 500)
+    alice.nonce += 0  # transfer() advanced it
+    tx2 = alice.transfer(bob, 600)
+    out = rpc(port, "eth_callBundle", {
+        "txs": [data(tx1.encode()), data(tx2.encode())],
+    })
+    assert out["totalGasUsed"] == 42000
+    assert len(out["results"]) == 2
+    assert all("error" not in r for r in out["results"])
+    # bundle simulation must NOT touch the chain
+    assert rpc(port, "eth_blockNumber") == "0x0"
+    assert parse_qty(rpc(port, "eth_getBalance", data(bob), "latest")) == 0
+
+
+def test_miner_knobs_have_effect(node):
+    n, alice = node
+    port = n.rpc.port
+    # extra data lands in subsequently built payloads
+    rpc(port, "miner_setExtra", "0x" + b"tpu!".hex())
+    from reth_tpu.payload.builder import PayloadAttributes, build_payload
+
+    parent = n.tree.head_hash
+    block, _fees = build_payload(
+        n.tree, n.pool, parent,
+        PayloadAttributes(timestamp=1_700_000_000),
+        extra_data=n.payload_service.extra_data,
+        gas_ceiling=n.payload_service.gas_ceiling)
+    assert block.header.extra_data == b"tpu!"
+    # price floor rejects underpriced txs at admission
+    rpc(port, "miner_setGasPrice", hex(2 * 10**9))
+    cheap = alice.transfer(b"\x0b" * 20, 1, max_priority_fee_per_gas=10**9)
+    import urllib.error
+
+    try:
+        rpc(port, "eth_sendRawTransaction", data(cheap.encode()))
+        raised = False
+    except RuntimeError as e:
+        raised = "underpriced" in str(e)
+    assert raised
+    # gas ceiling steers the next payload's gas limit downward
+    rpc(port, "miner_setGasLimit", hex(20_000_000))
+    block2, _ = build_payload(
+        n.tree, None, parent, PayloadAttributes(timestamp=1_700_000_001),
+        gas_ceiling=n.payload_service.gas_ceiling)
+    assert block2.header.gas_limit < 30_000_000
