@@ -23,9 +23,11 @@ class LocalMiner:
         head = self.tree.head_hash
         overlay = self.tree.overlay_provider(head)
         parent = overlay.header_by_number(overlay.block_number(head))
-        attrs = PayloadAttributes(
-            timestamp=timestamp if timestamp is not None else parent.timestamp + self.block_time,
-        )
+        ts = timestamp if timestamp is not None else parent.timestamp + self.block_time
+        # instant sealing can produce several blocks per wall-clock second;
+        # consensus requires strictly increasing timestamps (geth dev mode
+        # applies the same clamp)
+        attrs = PayloadAttributes(timestamp=max(ts, parent.timestamp + 1))
         block, _fees = build_payload(self.tree, self.pool, head, attrs)
         st = self.tree.on_new_payload(block)
         if st.status is not PayloadStatusKind.VALID:

@@ -1,0 +1,107 @@
+"""Engine fault-injection middleware + message store + debug CL client.
+
+Reference analogue: crates/engine/util (EngineReorg/EngineSkip/
+engine-store) and crates/consensus/debug-client.
+"""
+
+import pytest
+
+from reth_tpu.consensus import EthBeaconConsensus
+from reth_tpu.consensus.debug_client import DebugConsensusClient, RpcBlockSource
+from reth_tpu.engine import EngineTree
+from reth_tpu.engine.tree import PayloadStatusKind
+from reth_tpu.engine.util import EngineFaultInjector, EngineMessageStore
+from reth_tpu.node import Node, NodeConfig
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def make_chain(n_blocks=6):
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    for i in range(n_blocks):
+        builder.build_block([alice.transfer(b"\x0b" * 20, 100 + i)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    return builder, factory
+
+
+def test_skip_new_payload_and_fcu():
+    builder, factory = make_chain(4)
+    tree = EngineTree(factory, committer=CPU)
+    inj = EngineFaultInjector(tree, skip_new_payload=2, skip_fcu=3)
+    statuses = []
+    for b in builder.blocks[1:]:
+        st = inj.on_new_payload(b)
+        statuses.append(st.status)
+        inj.on_forkchoice_updated(b.hash)
+    # every 2nd payload dropped as SYNCING, every 3rd FCU swallowed
+    assert statuses[0] is PayloadStatusKind.VALID
+    assert statuses[1] is PayloadStatusKind.SYNCING
+    assert inj.skipped_payloads == 2
+    assert inj.skipped_fcu == 1
+
+
+def test_reorg_injection_exercises_tree_reorg_path():
+    builder, factory = make_chain(5)
+    tree = EngineTree(factory, committer=CPU)
+    inj = EngineFaultInjector(tree, reorg_frequency=2)
+    for b in builder.blocks[1:]:
+        assert inj.on_new_payload(b).status is PayloadStatusKind.VALID
+        inj.on_forkchoice_updated(b.hash)
+    assert inj.injected_reorgs >= 1
+    # the tree still lands on the right head
+    assert tree.head_hash == builder.tip.hash
+
+
+def test_message_store_records_and_replays(tmp_path):
+    builder, factory = make_chain(3)
+    tree = EngineTree(factory, committer=CPU)
+    store = EngineMessageStore(tree, tmp_path / "engine.jsonl")
+    for b in builder.blocks[1:]:
+        store.on_new_payload(b)
+        store.on_forkchoice_updated(b.hash)
+    # replay the captured stream into a FRESH tree
+    _, factory2 = make_chain(0)
+    tree2 = EngineTree(factory2, committer=CPU)
+    n = EngineMessageStore.replay(tmp_path / "engine.jsonl", tree2)
+    assert n == 6
+    assert tree2.head_hash == builder.tip.hash
+
+
+def test_debug_client_follows_rpc_source():
+    """One node mines; a second follows it through the debug CL client."""
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    cfg = NodeConfig(dev=True, genesis_header=builder.genesis,
+                     genesis_alloc=builder.accounts_at_genesis)
+    source_node = Node(cfg, committer=CPU)
+    source_node.start_rpc()
+    try:
+        from reth_tpu.rpc.convert import data
+
+        from test_rpc_e2e import rpc
+
+        for i in range(3):
+            tx = alice.transfer(b"\x0b" * 20, 100 + i)
+            rpc(source_node.rpc.port, "eth_sendRawTransaction", data(tx.encode()))
+            source_node.miner.mine_block()
+
+        follower_factory = ProviderFactory(MemDb())
+        init_genesis(follower_factory, builder.genesis,
+                     builder.accounts_at_genesis, committer=CPU)
+        follower = EngineTree(follower_factory, committer=CPU)
+        client = DebugConsensusClient(
+            follower,
+            RpcBlockSource(f"http://127.0.0.1:{source_node.rpc.port}/"))
+        assert client.run_once() == 3
+        assert client.run_once() == 0  # caught up, idempotent
+        assert follower.head_hash == source_node.tree.head_hash
+    finally:
+        source_node.stop()
