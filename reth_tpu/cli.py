@@ -224,6 +224,7 @@ def cmd_node(args):
                      discovery=not args.no_discovery,
                      bootnodes=tuple(args.bootnodes.split(",")) if args.bootnodes else (),
                      bootnodes_v5=tuple(args.bootnodes_v5.split(",")) if args.bootnodes_v5 else (),
+                     db_backend=args.db_backend,
                      **kw)
     node = Node(cfg, committer=committer)
     p2p_port = node.start_network()
@@ -234,6 +235,16 @@ def cmd_node(args):
             print(f"discv4 on udp/{node.discovery.port}")
     http_port, auth_port = node.start_rpc()
     print(f"RPC listening on 127.0.0.1:{http_port}, engine API on 127.0.0.1:{auth_port}")
+    if getattr(args, "ethstats", None):
+        from .ethstats import EthStatsService
+
+        try:
+            stats = EthStatsService(args.ethstats, node)
+            stats.start()
+            node.ethstats = stats
+            print(f"ethstats reporting to {stats.host}:{stats.port} as {stats.node_name}")
+        except OSError as e:
+            print(f"ethstats connection failed: {e}", file=sys.stderr)
     if node.ws is not None:
         print(f"WebSocket RPC on 127.0.0.1:{node.ws.port}")
     if node.ipc is not None:
@@ -339,6 +350,110 @@ def cmd_stage_run(args):
     return 0
 
 
+def cmd_dump_genesis(args):
+    """Print the built-in dev genesis JSON (reference `reth dump-genesis`)."""
+    print(json.dumps(_dev_genesis_spec(), indent=2))
+    return 0
+
+
+def cmd_prune(args):
+    """Run the pruner once to the configured targets (reference `reth prune`)."""
+    from .config import load_config
+    from .prune import Pruner
+    from .storage import MemDb, ProviderFactory
+
+    cfg = load_config(args.config)
+    factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
+    pruner = Pruner(factory, cfg.prune)
+    with factory.provider() as p:
+        tip = p.last_block_number()
+    out = pruner.run(tip)
+    factory.db.flush()
+    for prog in out:
+        print(f"{prog.segment:<24}{prog.pruned:>10} entries pruned"
+              + ("" if prog.done else " (more remain)"))
+    return 0
+
+
+def cmd_re_execute(args):
+    """Re-execute a block range against historical state and compare
+    receipts/gas with what is stored (reference `reth re-execute`)."""
+    from .consensus import EthBeaconConsensus
+    from .evm import BlockExecutor, EvmConfig
+    from .evm.executor import ProviderStateSource
+    from .storage import MemDb, ProviderFactory
+    from .storage.historical import HistoricalStateProvider
+
+    factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
+    mismatches = 0
+    with factory.provider() as p:
+        tip = p.last_block_number()
+        first = max(args.from_block if args.from_block is not None else 1, 1)
+        last = min(args.to_block if args.to_block is not None else tip, tip)
+        if last < first:
+            print(f"nothing to re-execute (range [{first}, {last}], tip {tip})")
+            return 0
+        for n in range(first, last + 1):
+            block = p.block_by_number(n)
+            parent_state = HistoricalStateProvider(p, n - 1)
+            executor = BlockExecutor(ProviderStateSource(parent_state),
+                                     EvmConfig())
+            out = executor.execute(block)
+            if out.gas_used != block.header.gas_used:
+                mismatches += 1
+                print(f"block {n}: gas {out.gas_used} != header "
+                      f"{block.header.gas_used}", file=sys.stderr)
+            idx = p.block_body_indices(n)
+            for i, r in enumerate(out.receipts):
+                stored = p.receipt(idx.first_tx_num + i)
+                if stored is not None and (
+                        stored.success != r.success
+                        or stored.cumulative_gas_used != r.cumulative_gas_used):
+                    mismatches += 1
+                    print(f"block {n} tx {i}: receipt mismatch", file=sys.stderr)
+    span = last - first + 1
+    print(f"re-executed {span} blocks: "
+          + ("all match" if not mismatches else f"{mismatches} MISMATCHES"))
+    return 1 if mismatches else 0
+
+
+def cmd_p2p(args):
+    """Fetch a header/body from a peer over RLPx (reference `reth p2p`)."""
+    from .net.p2p import PeerConnection, random_node_key
+    from .net.server import parse_enode
+    from .net.wire import Status
+
+    pub, host, port = parse_enode(args.enode)
+    status = Status(network_id=args.chain_id)
+    if args.genesis_hash:
+        status.genesis = bytes.fromhex(args.genesis_hash.removeprefix("0x"))
+        status.head = status.genesis
+    peer = PeerConnection.connect(host, port, status, pub,
+                                  node_priv=random_node_key())
+    try:
+        if args.what == "header":
+            start = (bytes.fromhex(args.id.removeprefix("0x"))
+                     if args.id.startswith("0x") else int(args.id))
+            headers = peer.get_headers(start, 1)
+            if not headers:
+                print("no header returned", file=sys.stderr)
+                return 1
+            h = headers[0]
+            print(f"number={h.number} hash=0x{h.hash.hex()} "
+                  f"state_root=0x{h.state_root.hex()} gas_used={h.gas_used}")
+        else:  # body
+            bodies = peer.get_bodies([bytes.fromhex(args.id.removeprefix("0x"))])
+            if not bodies:
+                print("no body returned", file=sys.stderr)
+                return 1
+            b = bodies[0]
+            print(f"transactions={len(b.transactions)} "
+                  f"withdrawals={len(b.withdrawals or ())}")
+        return 0
+    finally:
+        peer.close()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="reth-tpu", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -398,8 +513,36 @@ def main(argv=None) -> int:
     p.add_argument("--bootnodes", default="", help="comma-separated enode urls")
     p.add_argument("--bootnodes-v5", default="", dest="bootnodes_v5",
                    help="comma-separated enr:... records (discv5)")
+    p.add_argument("--db", dest="db_backend", choices=["memdb", "native"],
+                   default="memdb", help="storage backend (native = C++ WAL engine)")
+    p.add_argument("--ethstats", default=None,
+                   help="report to an ethstats server (node:secret@host:port)")
     add_hasher(p)
     p.set_defaults(fn=cmd_node)
+
+    p = sub.add_parser("dump-genesis", help="print the dev genesis JSON")
+    p.set_defaults(fn=cmd_dump_genesis)
+
+    p = sub.add_parser("prune", help="prune history per the config's targets")
+    p.add_argument("--datadir", required=True)
+    p.add_argument("--config", default=None, help="reth.toml path")
+    p.set_defaults(fn=cmd_prune)
+
+    p = sub.add_parser("re-execute",
+                       help="re-run blocks against historical state and "
+                            "compare receipts/gas")
+    p.add_argument("--datadir", required=True)
+    p.add_argument("--from", dest="from_block", type=int, default=None)
+    p.add_argument("--to", dest="to_block", type=int, default=None)
+    p.set_defaults(fn=cmd_re_execute)
+
+    p = sub.add_parser("p2p", help="fetch a header/body from a peer")
+    p.add_argument("what", choices=["header", "body"])
+    p.add_argument("id", help="block number, or 0x hash")
+    p.add_argument("--enode", required=True)
+    p.add_argument("--chain-id", dest="chain_id", type=int, default=1)
+    p.add_argument("--genesis-hash", dest="genesis_hash", default=None)
+    p.set_defaults(fn=cmd_p2p)
 
     p = sub.add_parser("db", help="database tools")
     dbsub = p.add_subparsers(dest="db_command", required=True)

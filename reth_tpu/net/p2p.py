@@ -19,11 +19,19 @@ from .rlpx import BASE_PROTOCOL_OFFSET, DISCONNECT_ID, PING_ID, PONG_ID, RlpxSes
 from .wire import Status
 
 CLIENT_ID = "reth-tpu/0.2"
-ETH_CAPS = [("eth", 68), ("snap", 1)]
+ETH_CAPS = [("eth", 68), ("eth", 69), ("snap", 1)]
 # capability message-id spaces are assigned alphabetically after the base
-# protocol: eth/68 spans 17 ids, snap/1 follows (devp2p multiplexing rule)
-ETH_MSG_COUNT = 17
-SNAP_OFFSET = BASE_PROTOCOL_OFFSET + ETH_MSG_COUNT
+# protocol; the NEGOTIATED eth version sets the span (eth/68: 17 ids,
+# eth/69 adds BlockRangeUpdate: 18), snap/1 follows (devp2p rule)
+ETH_MSG_COUNT = {68: 17, 69: 18}
+SNAP_OFFSET = BASE_PROTOCOL_OFFSET + ETH_MSG_COUNT[68]  # legacy alias
+
+
+def _negotiate_eth(caps) -> int | None:
+    """Highest shared eth version (devp2p: advertise all, shared max wins)."""
+    ours = {v for name, v in ETH_CAPS if name == "eth"}
+    shared = [v for name, v in caps if name == "eth" and v in ours]
+    return max(shared) if shared else None
 
 
 class PeerError(Exception):
@@ -40,9 +48,12 @@ class PeerConnection:
     def __init__(self, session: RlpxSession, status: Status):
         self.session = session
         self.status = status  # the REMOTE peer's status
-        self.snap_enabled = any(
-            name == "snap" and v >= 1
-            for name, v in (session.remote_hello or {}).get("caps", []))
+        caps = (session.remote_hello or {}).get("caps", [])
+        self.eth_version = _negotiate_eth(caps)
+        self.snap_enabled = any(name == "snap" and v >= 1 for name, v in caps)
+        self.snap_offset = (BASE_PROTOCOL_OFFSET
+                            + ETH_MSG_COUNT.get(self.eth_version, 17))
+        self.block_range: tuple[int, int, bytes] | None = None  # eth/69
         self._req_ids = itertools.count(1)
         self._lock = threading.Lock()
         # unsolicited gossip received while awaiting a response (drained by
@@ -66,17 +77,17 @@ class PeerConnection:
 
         mid, payload = snap_mod.encode_snap(msg)
         with self._lock:
-            self.session.send_msg(SNAP_OFFSET + mid, payload)
+            self.session.send_msg(self.snap_offset + mid, payload)
 
     def recv(self):
         """Next eth/snap message; p2p pings are answered inline, disconnects
         surface as PeerError."""
         while True:
             mid, body = self.session.recv_msg()
-            if self.snap_enabled and mid >= SNAP_OFFSET:
+            if self.snap_enabled and mid >= self.snap_offset:
                 from . import snap as snap_mod
 
-                return snap_mod.decode_snap(mid - SNAP_OFFSET, body)
+                return snap_mod.decode_snap(mid - self.snap_offset, body)
             if mid >= BASE_PROTOCOL_OFFSET:
                 return wire.decode_eth(mid - BASE_PROTOCOL_OFFSET, body)
             if mid == PING_ID:
@@ -95,9 +106,13 @@ class PeerConnection:
     def _finish_handshake(cls, session: RlpxSession, node_priv: int,
                           our_status: Status, fork_filter=None) -> "PeerConnection":
         session.hello(node_priv, CLIENT_ID, ETH_CAPS)
-        if not any(name == "eth" and v >= 68 for name, v in session.remote_hello["caps"]):
+        version = _negotiate_eth(session.remote_hello["caps"])
+        if version is None:
             session.disconnect()
             raise PeerError("peer lacks eth/68 capability")
+        import dataclasses
+
+        our_status = dataclasses.replace(our_status, version=version)
         mid, payload = wire.encode_eth(our_status)
         session.send_msg(BASE_PROTOCOL_OFFSET + mid, payload)
         rmid, rbody = session.recv_msg()
@@ -141,6 +156,9 @@ class PeerConnection:
             msg = self.recv()
             if isinstance(msg, kind) and msg.request_id == rid:
                 return msg
+            if isinstance(msg, wire.BlockRangeUpdate):
+                self.block_range = (msg.earliest, msg.latest, msg.latest_hash)
+                continue
             if isinstance(msg, (wire.TransactionsMsg, wire.NewPooledTxHashes,
                                 wire.NewBlockHashes)):
                 if len(self.gossip) < self.MAX_GOSSIP_BUFFER:

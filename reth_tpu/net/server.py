@@ -166,6 +166,8 @@ class NetworkManager:
             peer.send(wire.BlockBodies(msg.request_id, self._bodies_for(msg.hashes)))
         elif isinstance(msg, wire.GetReceipts):
             peer.send(wire.ReceiptsMsg(msg.request_id, self._receipts_for(msg.hashes)))
+        elif isinstance(msg, wire.BlockRangeUpdate):
+            peer.block_range = (msg.earliest, msg.latest, msg.latest_hash)
         elif isinstance(msg, wire.TransactionsMsg) and self.pool is not None:
             from ..pool import PoolError
 
@@ -235,5 +237,16 @@ class NetworkManager:
         for peer in list(self.peers):
             try:
                 peer.send(wire.TransactionsMsg(list(txs)))
+            except (PeerError, OSError):
+                pass
+
+    def announce_block_range(self, earliest: int, latest: int,
+                             latest_hash: bytes):
+        """eth/69 BlockRangeUpdate to every v69 peer (replaces TD gossip)."""
+        for peer in list(self.peers):
+            if peer.eth_version < 69:
+                continue
+            try:
+                peer.send(wire.BlockRangeUpdate(earliest, latest, latest_hash))
             except (PeerError, OSError):
                 pass

@@ -29,11 +29,14 @@ class MessageId:
     POOLED_TRANSACTIONS = 0x0A
     GET_RECEIPTS = 0x0F
     RECEIPTS = 0x10
+    BLOCK_RANGE_UPDATE = 0x11  # eth/69
 
 
 @dataclass
 class Status:
-    """eth status handshake (version, networkid, td, head, genesis, fork)."""
+    """eth status handshake. eth/68 carries total difficulty + head hash;
+    eth/69 replaces TD with the served block range (earliest, latest,
+    latest hash) — `version` selects the wire shape."""
 
     version: int = 68
     network_id: int = 1
@@ -41,20 +44,52 @@ class Status:
     head: bytes = b"\x00" * 32
     genesis: bytes = b"\x00" * 32
     fork_id: tuple[bytes, int] = (b"\x00" * 4, 0)
+    earliest: int = 0  # eth/69: first block this node can serve
+    latest: int = 0    # eth/69: tip number (head keeps the tip hash)
 
     def encode_payload(self):
+        fid = [self.fork_id[0], encode_int(self.fork_id[1])]
+        if self.version >= 69:
+            return [
+                encode_int(self.version), encode_int(self.network_id),
+                self.genesis, fid, encode_int(self.earliest),
+                encode_int(self.latest), self.head,
+            ]
         return [
             encode_int(self.version), encode_int(self.network_id),
-            encode_int(self.total_difficulty), self.head, self.genesis,
-            [self.fork_id[0], encode_int(self.fork_id[1])],
+            encode_int(self.total_difficulty), self.head, self.genesis, fid,
         ]
 
     @classmethod
     def decode_payload(cls, f):
+        version = decode_int(f[0])
+        if version >= 69:
+            return cls(
+                version, decode_int(f[1]), 0, bytes(f[6]), bytes(f[2]),
+                (bytes(f[3][0]), decode_int(f[3][1])),
+                decode_int(f[4]), decode_int(f[5]),
+            )
         return cls(
-            decode_int(f[0]), decode_int(f[1]), decode_int(f[2]), f[3], f[4],
+            version, decode_int(f[1]), decode_int(f[2]), f[3], f[4],
             (f[5][0], decode_int(f[5][1])),
         )
+
+
+@dataclass
+class BlockRangeUpdate:
+    """eth/69: the served block range changed (replaces TD gossip)."""
+
+    earliest: int
+    latest: int
+    latest_hash: bytes
+
+    def encode_payload(self):
+        return [encode_int(self.earliest), encode_int(self.latest),
+                self.latest_hash]
+
+    @classmethod
+    def decode_payload(cls, f):
+        return cls(decode_int(f[0]), decode_int(f[1]), bytes(f[2]))
 
 
 @dataclass
@@ -256,6 +291,7 @@ _BY_ID = {
     MessageId.POOLED_TRANSACTIONS: PooledTransactions,
     MessageId.GET_RECEIPTS: GetReceipts,
     MessageId.RECEIPTS: ReceiptsMsg,
+    MessageId.BLOCK_RANGE_UPDATE: BlockRangeUpdate,
 }
 _TO_ID = {v: k for k, v in _BY_ID.items()}
 

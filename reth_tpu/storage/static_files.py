@@ -2,20 +2,28 @@
 
 Reference analogue: crates/static-file (`StaticFileProducer` moving
 finalized headers/txs/receipts out of MDBX) + crates/storage/nippy-jar
-(the immutable mmap column format with compression). Format per file:
+(the immutable mmap column format with per-column compression tiers —
+the reference offers zstd/lz4/uncompressed per jar). Format per file:
 
     magic "RTSF1\\n"
-    u32 json_len | json header {segment, start, count, columns:[names]}
-    per column: u64[count+1] offsets | zlib-compressed rows back to back
+    u32 json_len | json header {segment, start, count, columns:[names],
+                                compression:{col: none|zlib|lzma}}
+    per column: u64[count+1] offsets | compressed rows back to back
 
-Readers memory-map lazily (plain file reads here); rows decompress on
-access. The provider falls back to static files for rows pruned from
-the DB, so history stays served after the producer runs.
+Readers MEMORY-MAP the file (one mmap per immutable segment; row reads
+are zero-copy slices + decompress). The compression tier is chosen per
+column by sampling (like NippyJar's per-jar compressor selection):
+incompressible rows (hashes) store raw, big repetitive rows take lzma,
+the rest zlib. Files written before tiers existed (no "compression"
+key) read back as all-zlib. The provider falls back to static files for
+rows pruned from the DB, so history stays served after the producer runs.
 """
 
 from __future__ import annotations
 
 import json
+import lzma
+import mmap
 import struct
 import zlib
 from dataclasses import dataclass
@@ -23,27 +31,60 @@ from pathlib import Path
 
 MAGIC = b"RTSF1\n"
 
+_CODECS = {
+    "none": (lambda b: b, lambda b: b),
+    "zlib": (zlib.compress, zlib.decompress),
+    "lzma": (lambda b: lzma.compress(b, preset=6), lzma.decompress),
+}
+
+
+def _pick_codec(rows: list[bytes]) -> str:
+    """Sample-driven tier choice (NippyJar-style): smallest total wins,
+    with 'none' preferred unless compression actually pays >10%."""
+    sample = [r for r in rows[:16] if r]
+    if not sample:
+        return "none"
+    raw = sum(len(r) for r in sample)
+    z = sum(len(zlib.compress(r)) for r in sample)
+    best, best_size = "none", raw
+    if z < raw * 0.9:
+        best, best_size = "zlib", z
+    # lzma only worth trying on bigger rows (its header alone is ~60 B)
+    if raw / len(sample) >= 256:
+        xz = sum(len(lzma.compress(r, preset=6)) for r in sample)
+        if xz < best_size * 0.9:
+            best = "lzma"
+    return best
+
 SEGMENT_HEADERS = "headers"          # row key: block number; cols: header, hash
 SEGMENT_TRANSACTIONS = "transactions"  # row key: tx number; cols: tx
 SEGMENT_RECEIPTS = "receipts"        # row key: tx number; cols: receipt
 
 
 def write_segment_file(
-    path: Path, segment: str, start: int, columns: dict[str, list[bytes]]
+    path: Path, segment: str, start: int, columns: dict[str, list[bytes]],
+    compression: str = "auto",
 ) -> None:
     names = list(columns.keys())
     count = len(next(iter(columns.values())))
     for rows in columns.values():
         assert len(rows) == count, "ragged columns"
+    codecs = {
+        name: (_pick_codec(columns[name]) if compression == "auto"
+               else compression)
+        for name in names
+    }
     header = json.dumps(
-        {"segment": segment, "start": start, "count": count, "columns": names}
+        {"segment": segment, "start": start, "count": count, "columns": names,
+         "compression": codecs}
     ).encode()
     with open(path, "wb") as f:
         f.write(MAGIC)
         f.write(struct.pack("<I", len(header)))
         f.write(header)
         for name in names:
-            blobs = [zlib.compress(r) for r in columns[name]]
+            enc = _CODECS[codecs[name]][0]
+            blobs = [enc(r) for r in columns[name]]
             offsets = [0]
             for b in blobs:
                 offsets.append(offsets[-1] + len(b))
@@ -60,7 +101,9 @@ class SegmentFile:
     count: int
     columns: list[str]
     _col_offsets: dict[str, int]  # file offset of each column's offset table
+    _codecs: dict[str, str]
     _fh: object = None            # cached open handle (immutable file)
+    _map: object = None           # mmap over the whole immutable file
 
     @property
     def end(self) -> int:
@@ -74,31 +117,33 @@ class SegmentFile:
             raise ValueError(f"{path}: bad magic")
         (hlen,) = struct.unpack("<I", f.read(4))
         meta = json.loads(f.read(hlen))
+        m = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         pos = 6 + 4 + hlen
         col_offsets = {}
         for name in meta["columns"]:
             col_offsets[name] = pos
-            f.seek(pos)
-            offs = struct.unpack(
-                f"<{meta['count'] + 1}Q", f.read(8 * (meta["count"] + 1))
-            )
-            pos += 8 * (meta["count"] + 1) + offs[-1]
+            (last,) = struct.unpack_from("<Q", m, pos + 8 * meta["count"])
+            pos += 8 * (meta["count"] + 1) + last
+        # pre-tier files carry no "compression" key: they are all-zlib
+        codecs = meta.get("compression") or {n: "zlib" for n in meta["columns"]}
         return cls(path, meta["segment"], meta["start"], meta["count"],
-                   meta["columns"], col_offsets, f)
+                   meta["columns"], col_offsets, codecs, f, m)
 
     def row(self, number: int, column: str) -> bytes | None:
         if not (self.start <= number <= self.end):
             return None
         i = number - self.start
         base = self._col_offsets[column]
-        f = self._fh  # immutable file: one cached handle, seek per read
-        f.seek(base + 8 * i)
-        lo, hi = struct.unpack("<2Q", f.read(16))
+        m = self._map  # immutable file: zero-copy mmap slices
+        lo, hi = struct.unpack_from("<2Q", m, base + 8 * i)
         payload_base = base + 8 * (self.count + 1)
-        f.seek(payload_base + lo)
-        return zlib.decompress(f.read(hi - lo))
+        raw = m[payload_base + lo:payload_base + hi]
+        return _CODECS[self._codecs[column]][1](raw)
 
     def close(self):
+        if self._map is not None:
+            self._map.close()
+            self._map = None
         if self._fh:
             self._fh.close()
             self._fh = None

@@ -158,3 +158,46 @@ hasher = "cpu"
     assert cfg.hasher == "cpu"
     # missing file → defaults
     assert load_config(tmp_path / "nope.toml").stages.merkle.rebuild_threshold == 50_000
+
+
+def test_static_file_compression_tiers(tmp_path):
+    """NippyJar-style per-column tiers: incompressible columns store raw,
+    repetitive ones compress; old all-zlib files still read."""
+    import json as _json
+    import struct as _struct
+    import zlib as _zlib
+
+    from reth_tpu.storage.static_files import MAGIC, SegmentFile, write_segment_file
+
+    import os
+    hashes = [os.urandom(32) for _ in range(40)]          # incompressible
+    blobs = [b"A" * 600 + bytes([i]) for i in range(40)]  # very repetitive
+    path = tmp_path / "seg_0_39.sf"
+    write_segment_file(path, "headers", 0, {"hash": hashes, "header": blobs})
+    sf = SegmentFile.open(path)
+    assert sf._codecs["hash"] == "none"
+    assert sf._codecs["header"] in ("zlib", "lzma")
+    for i in (0, 17, 39):
+        assert sf.row(i, "hash") == hashes[i]
+        assert sf.row(i, "header") == blobs[i]
+    sf.close()
+
+    # legacy format (no compression key, all zlib) still reads
+    header = _json.dumps({"segment": "headers", "start": 0, "count": 2,
+                          "columns": ["header"]}).encode()
+    rows = [b"old-one", b"old-two"]
+    with open(tmp_path / "legacy_0_1.sf", "wb") as f:
+        f.write(MAGIC)
+        f.write(_struct.pack("<I", len(header)))
+        f.write(header)
+        payload = [_zlib.compress(r) for r in rows]
+        offs = [0]
+        for b in payload:
+            offs.append(offs[-1] + len(b))
+        f.write(_struct.pack("<3Q", *offs))
+        for b in payload:
+            f.write(b)
+    old = SegmentFile.open(tmp_path / "legacy_0_1.sf")
+    assert old.row(0, "header") == b"old-one"
+    assert old.row(1, "header") == b"old-two"
+    old.close()

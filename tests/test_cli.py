@@ -108,3 +108,89 @@ def test_genesis_mismatch_cli(chain_files, tmp_path):
 
     with pytest.raises(GenesisMismatch):
         main(["init", "--datadir", str(datadir), "--genesis", str(g2), "--hasher", "cpu"])
+
+
+def test_dump_genesis(capsys):
+    assert main(["dump-genesis"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["config"]["chainId"] == 1337
+    assert out["alloc"]
+
+
+def test_re_execute_matches(chain_files, capsys):
+    tmp, gpath, cpath, builder = chain_files
+    datadir = tmp / "data3"
+    datadir.mkdir()
+    assert main(["import", "--datadir", str(datadir), "--genesis", str(gpath),
+                 "--hasher", "cpu", str(cpath)]) == 0
+    capsys.readouterr()
+    assert main(["re-execute", "--datadir", str(datadir)]) == 0
+    out = capsys.readouterr().out
+    assert "re-executed 3 blocks: all match" in out
+
+
+def test_prune_command(chain_files, tmp_path, capsys):
+    tmp, gpath, cpath, builder = chain_files
+    datadir = tmp / "data4"
+    datadir.mkdir()
+    assert main(["import", "--datadir", str(datadir), "--genesis", str(gpath),
+                 "--hasher", "cpu", str(cpath)]) == 0
+    cfg = tmp_path / "reth.toml"
+    cfg.write_text("[prune.sender_recovery]\ndistance = 0\n")
+    capsys.readouterr()
+    assert main(["prune", "--datadir", str(datadir), "--config", str(cfg)]) == 0
+    out = capsys.readouterr().out
+    assert "SenderRecovery" in out and "2 entries pruned" in out
+
+
+def test_p2p_command(chain_files, capsys):
+    from reth_tpu.consensus import EthBeaconConsensus
+    from reth_tpu.net import NetworkManager, Status
+    from reth_tpu.stages import Pipeline, default_stages
+    from reth_tpu.storage import MemDb, ProviderFactory
+    from reth_tpu.storage.genesis import import_chain, init_genesis
+
+    tmp, gpath, cpath, builder = chain_files
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    import_chain(factory, builder.blocks[1:], EthBeaconConsensus(CPU))
+    Pipeline(factory, default_stages(committer=CPU)).run(3)
+    status = Status(network_id=1, head=builder.tip.hash,
+                    genesis=builder.genesis.hash)
+    server = NetworkManager(factory, status, node_priv=0xBEEF)
+    server.start()
+    try:
+        assert main(["p2p", "header", "2", "--enode", server.enode,
+                     "--genesis-hash", "0x" + builder.genesis.hash.hex()]) == 0
+        out = capsys.readouterr().out
+        assert f"hash=0x{builder.blocks[2].hash.hex()}" in out
+        assert main(["p2p", "body", "0x" + builder.blocks[2].hash.hex(),
+                     "--enode", server.enode,
+                     "--genesis-hash", "0x" + builder.genesis.hash.hex()]) == 0
+        out = capsys.readouterr().out
+        assert "transactions=1" in out
+    finally:
+        server.stop()
+
+
+def test_node_native_db_backend(chain_files, tmp_path):
+    """--db native runs the node on the C++ WAL engine end to end."""
+    from reth_tpu.node import Node, NodeConfig
+
+    tmp, gpath, cpath, builder = chain_files
+    datadir = tmp_path / "native_data"
+    datadir.mkdir()
+    alice = Wallet(0xA11CE)
+    cfg = NodeConfig(dev=True, datadir=str(datadir), db_backend="native",
+                     genesis_header=builder.genesis,
+                     genesis_alloc=builder.accounts_at_genesis)
+    n = Node(cfg, committer=CPU)
+    try:
+        tx = alice.transfer(b"\x0b" * 20, 42)
+        n.pool.add_transaction(tx)
+        n.miner.mine_block()
+        with n.factory.provider() as p:
+            assert p.last_block_number() >= 0
+        assert type(n.factory.db).__name__ == "NativeDb"
+    finally:
+        n.stop()

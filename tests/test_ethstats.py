@@ -1,0 +1,107 @@
+"""ethstats reporting against an in-process dashboard server.
+
+Reference analogue: crates/node/ethstats service tests — hello login,
+node-ping/node-pong, block + stats emits over WebSocket.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from reth_tpu.ethstats import EthStatsService, parse_ethstats_url, _send_masked
+from reth_tpu.node import Node, NodeConfig
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.rpc.ws import accept_handshake, read_frame, write_frame
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+class FakeDashboard:
+    """Minimal ethstats server: records emits, can ping the node."""
+
+    def __init__(self):
+        self.listener = socket.create_server(("127.0.0.1", 0))
+        self.port = self.listener.getsockname()[1]
+        self.received = []
+        self.conn = None
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        try:
+            sock, _ = self.listener.accept()
+        except OSError:
+            return
+        accept_handshake(sock)
+        self.conn = sock
+        while True:
+            try:
+                op, fin, payload = read_frame(sock)
+            except Exception:
+                return
+            if op == 0x1:
+                self.received.append(json.loads(payload))
+
+    def ping(self):
+        write_frame(self.conn, 0x1, json.dumps(
+            {"emit": ["node-ping", {}]}).encode())
+
+    def topics(self):
+        return [m["emit"][0] for m in self.received]
+
+    def close(self):
+        self.listener.close()
+        if self.conn:
+            self.conn.close()
+
+
+def test_parse_url():
+    assert parse_ethstats_url("mynode:s3cret@stats.example.org:3000") == (
+        "mynode", "s3cret", "stats.example.org", 3000)
+    assert parse_ethstats_url("n:@host")[3] == 3000
+    with pytest.raises(ValueError):
+        parse_ethstats_url("nohost")
+
+
+def test_hello_stats_block_and_pong():
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    node = Node(NodeConfig(dev=True, genesis_header=builder.genesis,
+                           genesis_alloc=builder.accounts_at_genesis),
+                committer=CPU)
+    dash = FakeDashboard()
+    svc = EthStatsService(f"test:sec@127.0.0.1:{dash.port}", node, interval=0.2)
+    try:
+        svc.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not (
+                "stats" in dash.topics() and "pending" in dash.topics()):
+            time.sleep(0.05)
+        assert dash.topics()[0] == "hello"
+        hello = dash.received[0]["emit"][1]
+        assert hello["id"] == "test" and hello["secret"] == "sec"
+        assert "stats" in dash.topics() and "pending" in dash.topics()
+        # mining a block triggers a block report via the canon listener
+        node.pool.add_transaction(alice.transfer(b"\x0b" * 20, 5))
+        node.miner.mine_block()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and "block" not in dash.topics():
+            time.sleep(0.05)
+        blocks = [m["emit"][1] for m in dash.received if m["emit"][0] == "block"]
+        assert blocks and blocks[-1]["block"]["number"] >= 0
+        # ping -> pong
+        dash.ping()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and "node-pong" not in dash.topics():
+            time.sleep(0.05)
+        assert "node-pong" in dash.topics()
+    finally:
+        svc.stop()
+        dash.close()
+        node.stop()

@@ -244,3 +244,46 @@ def test_fork_id_filter_rejects_incompatible_peer(testnet):
         PeerConnection.connect(
             "127.0.0.1", port, bad, pubkey_from_priv(server.node_priv),
             fork_filter=lambda fid: MAINNET.validate_fork_id(fid, 7_987_396))
+
+
+def test_eth69_negotiation_and_block_range(testnet):
+    """Both sides advertise eth/68+69: the session negotiates 69, the
+    Status travels in the TD-less v69 shape, and BlockRangeUpdate gossip
+    lands on the peer object."""
+    server, port, status, factory_b, builder = testnet
+    import dataclasses
+
+    st69 = dataclasses.replace(status, earliest=0, latest=8)
+    peer = PeerConnection.connect("127.0.0.1", port, st69,
+                                  pubkey_from_priv(server.node_priv))
+    assert peer.eth_version == 69
+    assert peer.status.version == 69
+    assert peer.snap_enabled and peer.snap_offset == 0x10 + 18
+    # requests still work over the renumbered snap space
+    assert [h.number for h in peer.get_headers(1, 3)] == [1, 2, 3]
+    # range gossip: server records it on its side of the session
+    import time as _t
+
+    peer.send(wire.BlockRangeUpdate(0, 8, builder.tip.hash))
+    deadline = _t.monotonic() + 5
+    server_peer = None
+    while _t.monotonic() < deadline:
+        if server.peers and server.peers[-1].block_range:
+            server_peer = server.peers[-1]
+            break
+        _t.sleep(0.05)
+    assert server_peer is not None
+    assert server_peer.block_range == (0, 8, builder.tip.hash)
+    peer.close()
+
+
+def test_status_v69_codec_roundtrip():
+    st = Status(version=69, network_id=7, genesis=b"\x09" * 32,
+                head=b"\x08" * 32, fork_id=(b"\xaa\xbb\xcc\xdd", 123),
+                earliest=4, latest=99)
+    frame = wire.encode_message(st)
+    got = wire.decode_message(frame[4:])
+    assert got == st
+    bru = wire.BlockRangeUpdate(1, 2, b"\x03" * 32)
+    frame = wire.encode_message(bru)
+    assert wire.decode_message(frame[4:]) == bru

@@ -100,7 +100,7 @@ def test_admin_namespace_over_live_node():
         assert api_a.admin_addPeer(b.enode)
         peers = api_a.admin_peers()
         assert len(peers) == 1
-        assert peers[0]["caps"] == ["eth/68", "snap/1"]
+        assert peers[0]["caps"] == ["eth/68", "eth/69", "snap/1"]
         assert api_a.admin_removePeer(b.enode)
         assert not api_a.admin_addPeer("enode://zz@nope")  # malformed -> False
     finally:
