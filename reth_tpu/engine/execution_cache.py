@@ -15,31 +15,42 @@ _MISS = object()
 
 
 class _Lru:
-    __slots__ = ("cap", "data", "hits", "misses")
+    """Thread-safe LRU: the prewarm workers (engine/prewarm.py) populate
+    these caches from several threads while nothing else runs, and the
+    sequential executor reads them after — a mutex keeps the OrderedDict
+    reorders from interleaving."""
+
+    __slots__ = ("cap", "data", "hits", "misses", "_mu")
 
     def __init__(self, cap: int):
+        import threading
+
         self.cap = cap
         self.data: OrderedDict = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self._mu = threading.Lock()
 
     def get(self, key):
-        v = self.data.get(key, _MISS)
-        if v is _MISS:
-            self.misses += 1
-            return _MISS
-        self.data.move_to_end(key)
-        self.hits += 1
-        return v
+        with self._mu:
+            v = self.data.get(key, _MISS)
+            if v is _MISS:
+                self.misses += 1
+                return _MISS
+            self.data.move_to_end(key)
+            self.hits += 1
+            return v
 
     def put(self, key, value) -> None:
-        self.data[key] = value
-        self.data.move_to_end(key)
-        while len(self.data) > self.cap:
-            self.data.popitem(last=False)
+        with self._mu:
+            self.data[key] = value
+            self.data.move_to_end(key)
+            while len(self.data) > self.cap:
+                self.data.popitem(last=False)
 
     def drop(self, key) -> None:
-        self.data.pop(key, None)
+        with self._mu:
+            self.data.pop(key, None)
 
 
 class ExecutionCache:

@@ -1,0 +1,63 @@
+"""Prewarm: parallel optimistic execution to warm the state caches.
+
+Reference analogue: crates/engine/tree/src/tree/payload_processor/
+prewarm.rs — before the sequential (canonical) execution of a new
+payload, worker tasks execute every transaction INDEPENDENTLY against
+the parent state. The results are discarded; the point is the side
+effect: every account/storage/bytecode read lands in the shared
+execution cache, so the sequential pass hits warm caches instead of
+cold storage. Transactions that depend on earlier in-block writes
+simply read parent-state values — still the right keys to warm (the
+reference accepts the same approximation; its BAL-driven variant warms
+the exact access list).
+
+Workers execute against thread-local EvmStates over the SHARED
+CachedStateSource; reads flow through the (mutex-guarded) cache,
+speculative writes stay in the worker's journal and die with it.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+from ..evm.state import EvmState
+
+
+class PrewarmTask:
+    """One prewarm pass for one payload."""
+
+    def __init__(self, executor, env, max_workers: int = 4):
+        """``executor``: the BlockExecutor whose (cached) source the
+        sequential pass will use; ``env``: the block's BlockEnv."""
+        self.executor = executor
+        self.env = env
+        self.max_workers = max_workers
+        self.warmed = 0
+        self.failed = 0
+
+    def _one(self, tx, sender) -> bool:
+        state = EvmState(self.executor.source)  # thread-local journal
+        try:
+            # independent execution: later in-block txs see the PARENT
+            # nonce, so align the journal's copy (the reference's prewarm
+            # relaxes the same sequential-only checks); reads still flow
+            # through (and warm) the shared cache
+            if state.nonce(sender) != tx.nonce:
+                state.set_nonce(sender, tx.nonce)
+            self.executor._execute_tx(state, self.env, tx, sender,
+                                      self.env.gas_limit)
+            return True
+        except Exception:  # noqa: BLE001 — speculative: any failure is fine
+            return False
+
+    def run(self, transactions, senders) -> int:
+        """Execute all txs concurrently; returns how many completed.
+        Counters come from the map results — workers share no mutable
+        state, so nothing needs a lock."""
+        if not transactions:
+            return 0
+        with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+            results = list(pool.map(self._one, transactions, senders))
+        self.warmed = sum(results)
+        self.failed = len(results) - self.warmed
+        return self.warmed

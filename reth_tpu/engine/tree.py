@@ -89,6 +89,10 @@ class EngineTree:
 
         self.execution_cache = ExecutionCache()
         self._cache_anchor: bytes | None = None
+        # parallel cache-warming pass before sequential execution (set
+        # high to disable; reference gates prewarm similarly)
+        self.prewarm_threshold = 4
+        self.last_prewarm = None
         if unwinder is None:
             def unwinder(fac, target):
                 from ..stages import Pipeline, default_stages
@@ -258,6 +262,24 @@ class EngineTree:
             self.invalid[block.hash] = msg
             self._run_invalid_hooks(block, msg)
             return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
+        # prewarm: execute txs in parallel against PARENT state first,
+        # purely to populate the execution cache (reference
+        # payload_processor/prewarm.rs); canonical execution below then
+        # runs against warm caches
+        if len(block.transactions) >= self.prewarm_threshold:
+            from ..evm.executor import blob_base_fee
+            from ..evm.interpreter import BlockEnv
+            from .prewarm import PrewarmTask
+
+            env = BlockEnv(
+                number=header.number, timestamp=header.timestamp,
+                coinbase=header.beneficiary, gas_limit=header.gas_limit,
+                base_fee=header.base_fee_per_gas or 0,
+                prev_randao=header.mix_hash, chain_id=self.config.chain_id,
+                blob_base_fee=blob_base_fee(header.excess_blob_gas or 0),
+            )
+            self.last_prewarm = PrewarmTask(executor, env)
+            self.last_prewarm.run(block.transactions, senders)
         # pipelined root: a worker batch-hashes dirty keys on the device
         # WHILE execution runs (reference state_root_task / sparse_trie
         # strategy overlap; see engine/pipelined_root.py)
@@ -430,7 +452,13 @@ class EngineTree:
     def _notify_canon_change(self):
         chain = [self.blocks[h] for h in self.canonical_chain()]
         for listener in self.canon_listeners:
-            listener(chain)
+            try:
+                listener(chain)
+            except Exception:  # noqa: BLE001 — a telemetry/maintenance
+                # listener must never fail consensus-critical
+                # canonicalization (reference notifications are decoupled
+                # channels for the same reason)
+                continue
 
     # -- persistence -----------------------------------------------------------
 

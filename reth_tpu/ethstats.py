@@ -128,8 +128,22 @@ class EthStatsService:
                 _send_masked(self.sock, msg)
 
     def connect(self) -> None:
-        self.sock = socket.create_connection((self.host, self.port), timeout=10)
-        _client_handshake(self.sock, f"{self.host}:{self.port}")
+        # handshake on a local socket; publish under the lock only once
+        # upgraded, so a concurrent _emit can never write a frame into the
+        # raw HTTP upgrade stream
+        sock = socket.create_connection((self.host, self.port), timeout=10)
+        try:
+            _client_handshake(sock, f"{self.host}:{self.port}")
+        except Exception:
+            sock.close()
+            raise
+        with self._lock:
+            old, self.sock = self.sock, sock
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
         self._emit("hello", {
             "id": self.node_name,
             "secret": self.secret,
@@ -179,7 +193,11 @@ class EthStatsService:
         }
 
     def report_block(self) -> None:
-        self._emit("block", self._block_payload())
+        # called from the engine's canon listener: never raise into it
+        try:
+            self._emit("block", self._block_payload())
+        except Exception:  # noqa: BLE001 — the loop's reconnect recovers
+            pass
 
     def report_stats(self) -> None:
         self._emit("stats", self._stats_payload())
@@ -207,8 +225,11 @@ class EthStatsService:
                 got = _recv_unmasked(self.sock, idle_timeout=0.5)
                 op, payload = got if got is not None else (None, None)
                 if op == 0x1 and payload:
-                    msg = json.loads(payload)
-                    topic = (msg.get("emit") or [None])[0]
+                    try:
+                        msg = json.loads(payload)
+                        topic = (msg.get("emit") or [None])[0]
+                    except Exception:  # noqa: BLE001 — a malformed frame
+                        topic = None   # must not kill the telemetry thread
                     if topic == "node-ping":
                         self._emit("node-pong", {"id": self.node_name,
                                                  "clientTime": time.time()})

@@ -110,3 +110,36 @@ class MetricsRegistry:
 
 # the global registry (metrics-rs global recorder analogue)
 REGISTRY = MetricsRegistry()
+
+
+class TrieMetrics:
+    """TrieTracker analogue (reference crates/trie metrics): per-commit
+    stats for the state-commitment hot path — node/leaf counts, level
+    depth, host→device wire bytes, wall time, split by backend."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._nodes = {k: reg.counter(f"trie_commit_nodes_total_{k}")
+                       for k in ("device", "numpy")}
+        self._leaves = reg.counter("trie_commit_leaves_total")
+        self._wire = reg.counter("trie_commit_wire_bytes_total")
+        self._commits = reg.counter("trie_commits_total")
+        self._seconds = reg.histogram("trie_commit_duration_seconds")
+        self._levels = reg.histogram(
+            "trie_commit_levels", buckets=(2, 4, 6, 8, 10, 12, 16))
+        self.last: dict | None = None  # most recent commit, for bench triage
+
+    def record_commit(self, backend: str, nodes: int, levels: int,
+                      leaves: int, wire_bytes: int, seconds: float) -> None:
+        self._nodes.get(backend, self._nodes["numpy"]).increment(nodes)
+        self._leaves.increment(leaves)
+        self._wire.increment(wire_bytes)
+        self._commits.increment()
+        self._seconds.record(seconds)
+        self._levels.record(levels)
+        self.last = {"backend": backend, "nodes": nodes, "levels": levels,
+                     "leaves": leaves, "wire_bytes": wire_bytes,
+                     "seconds": round(seconds, 4)}
+
+
+trie_metrics = TrieMetrics()

@@ -22,9 +22,9 @@ CLIENT_ID = "reth-tpu/0.2"
 ETH_CAPS = [("eth", 68), ("eth", 69), ("snap", 1)]
 # capability message-id spaces are assigned alphabetically after the base
 # protocol; the NEGOTIATED eth version sets the span (eth/68: 17 ids,
-# eth/69 adds BlockRangeUpdate: 18), snap/1 follows (devp2p rule)
+# eth/69 adds BlockRangeUpdate: 18), snap/1 follows (devp2p rule) —
+# always use the per-session `PeerConnection.snap_offset`
 ETH_MSG_COUNT = {68: 17, 69: 18}
-SNAP_OFFSET = BASE_PROTOCOL_OFFSET + ETH_MSG_COUNT[68]  # legacy alias
 
 
 def _negotiate_eth(caps) -> int | None:
@@ -121,6 +121,11 @@ class PeerConnection:
             raise PeerError("expected status handshake")
         remote = wire.decode_eth(wire.MessageId.STATUS, rbody)
         try:
+            if remote.version != version:
+                # message-id spaces derive from the negotiated version: a
+                # mismatched Status would silently desync the multiplexing
+                raise PeerError(
+                    f"status version {remote.version} != negotiated {version}")
             _validate_status(our_status, remote, fork_filter)
         except PeerError:
             session.disconnect()

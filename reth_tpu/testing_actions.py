@@ -1,0 +1,163 @@
+"""Declarative e2e test actions: drive a live node as a scripted scenario.
+
+Reference analogue: crates/e2e-test-utils' `Action` trait + testsuite
+(setup → ordered actions, each acting on the node and asserting on the
+result): ProduceBlocks, ReorgTo, SubmitTransaction, expect-status
+combinators. Actions here run against a live in-process `Node` (RPC +
+engine + dev miner), so a scenario reads as the user/CL behavior it
+encodes.
+
+    TestSuite(node).run(
+        SubmitTransaction(wallet, to=bob, value=100),
+        ProduceBlocks(1),
+        AssertChainTip(1),
+        AssertBalance(bob, 100),
+        ReorgTo(0),
+        AssertChainTip(0),
+    )
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class ActionError(AssertionError):
+    pass
+
+
+class TestSuite:
+    """Ordered action runner over a live Node."""
+
+    def __init__(self, node):
+        self.node = node
+
+    def run(self, *actions) -> "TestSuite":
+        for i, action in enumerate(actions):
+            try:
+                action(self.node)
+            except ActionError as e:
+                raise ActionError(
+                    f"action #{i} {type(action).__name__}: {e}") from None
+        return self
+
+
+class SubmitTransaction:
+    def __init__(self, wallet, to: bytes, value: int, chain_id: int = 1):
+        self.tx = wallet.transfer(to, value, chain_id=chain_id)
+
+    def __call__(self, node):
+        node.pool.add_transaction(self.tx)
+
+
+class SubmitRawTransaction:
+    def __init__(self, tx):
+        self.tx = tx
+
+    def __call__(self, node):
+        node.pool.add_transaction(self.tx)
+
+
+class ProduceBlocks:
+    """Mine n blocks through the dev miner (the CL-loop stand-in)."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def __call__(self, node):
+        for _ in range(self.n):
+            node.miner.mine_block()
+
+
+class ProduceInvalidPayload:
+    """Submit a tampered payload; expects the engine to reject it."""
+
+    def __init__(self, tamper):
+        self.tamper = tamper  # fn(Block) -> Block
+
+    def __call__(self, node):
+        from reth_tpu.engine.tree import PayloadStatusKind
+        from reth_tpu.payload.builder import PayloadAttributes, build_payload
+
+        with node.factory.provider() as p:
+            ts = p.header_by_number(p.last_block_number()).timestamp
+        block, _ = build_payload(node.tree, None, node.tree.head_hash,
+                                 PayloadAttributes(timestamp=ts + 1))
+        st = node.tree.on_new_payload(self.tamper(block))
+        if st.status is not PayloadStatusKind.INVALID:
+            raise ActionError(f"expected INVALID, got {st.status.name}")
+
+
+class ReorgTo:
+    """Forkchoice back to an earlier canonical block."""
+
+    def __init__(self, number: int):
+        self.number = number
+
+    def __call__(self, node):
+        target = None
+        with node.factory.provider() as p:
+            target = p.canonical_hash(self.number)
+        if target is None:
+            # unpersisted tip blocks live in the tree
+            for h, eb in node.tree.blocks.items():
+                if eb.block.header.number == self.number:
+                    target = h
+                    break
+        if target is None:
+            raise ActionError(f"no canonical block {self.number}")
+        node.tree.on_forkchoice_updated(target)
+
+
+class WaitFor:
+    """Poll a predicate(node) -> bool until true or timeout."""
+
+    def __init__(self, predicate, timeout: float = 5.0):
+        self.predicate = predicate
+        self.timeout = timeout
+
+    def __call__(self, node):
+        deadline = time.monotonic() + self.timeout
+        while time.monotonic() < deadline:
+            if self.predicate(node):
+                return
+            time.sleep(0.02)
+        raise ActionError("predicate never became true")
+
+
+class AssertChainTip:
+    def __init__(self, number: int):
+        self.number = number
+
+    def __call__(self, node):
+        eb = node.tree.blocks.get(node.tree.head_hash)
+        if eb is not None:
+            tip = eb.block.header.number
+        else:
+            with node.factory.provider() as p:
+                tip = p.block_number(node.tree.head_hash)
+        if tip != self.number:
+            raise ActionError(f"tip is {tip}, expected {self.number}")
+
+
+class AssertBalance:
+    def __init__(self, address: bytes, value: int):
+        self.address = address
+        self.value = value
+
+    def __call__(self, node):
+        got = node.tree.overlay_provider().account(self.address)
+        bal = got.balance if got else 0
+        if bal != self.value:
+            raise ActionError(
+                f"balance of 0x{self.address.hex()} is {bal}, "
+                f"expected {self.value}")
+
+
+class AssertPoolSize:
+    def __init__(self, n: int):
+        self.n = n
+
+    def __call__(self, node):
+        if len(node.pool) != self.n:
+            raise ActionError(f"pool has {len(node.pool)}, expected {self.n}")

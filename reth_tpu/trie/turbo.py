@@ -293,17 +293,25 @@ class TurboCommitter:
             lib.rtb_free(h)
 
     def _run(self, lib, h, n_jobs, key_arrays, collect_branches, start_depth=0):
+        import time as _time
+
+        from ..metrics import trie_metrics
+
+        t_start = _time.time()
         backend = self._make_backend()
         max_slot = lib.rtb_max_slot(h)
         backend.begin(max_slot)
         n_levels = lib.rtb_num_levels(h)
         hashed_per_level = []
+        wire_bytes = 0
         for i in range(n_levels):
             lv = _Level(lib, h, i)
             backend.dispatch_packed(lv.flat, lv.row_off, lv.row_len, lv.row_slot,
                                     lv.holes, lv.b_tier)
             backend.dispatch_branch(lv.masks, lv.bmp_slot, lv.children)
             hashed_per_level.append(len(lv.row_slot) + len(lv.masks))
+            wire_bytes += (lv.flat.nbytes + lv.row_off.nbytes + lv.row_len.nbytes
+                           + lv.masks.nbytes + lv.children.nbytes)
         root_slots = np.zeros((n_jobs,), dtype=np.int32)
         lib.rtb_roots(h, _ptr(root_slots, _i32p))
         meta_rec = None
@@ -335,6 +343,12 @@ class TurboCommitter:
             # attribute the shared hash count to the batch (job-level split
             # is not tracked in turbo mode; totals are what the stage reports)
             results[-1].hashed_nodes = total_hashed
+        # TrieTracker-style commit stats (reference trie metrics/tracker):
+        # what the hot path actually did, on /metrics and in bench triage
+        trie_metrics.record_commit(
+            backend=self.backend_kind, nodes=total_hashed, levels=n_levels,
+            leaves=sum(len(k) for k in key_arrays), wire_bytes=wire_bytes,
+            seconds=_time.time() - t_start)
         if collect_branches and meta_rec is not None and len(meta_rec):
             job_starts = np.cumsum([0] + [len(k) for k in key_arrays])
             self._collect_meta(meta_rec, key_arrays, job_starts, digests, results,

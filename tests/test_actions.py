@@ -1,0 +1,98 @@
+"""Declarative e2e scenarios over a live node (Action testsuite).
+
+Reference analogue: crates/e2e-test-utils tests — ordered actions
+driving a node: produce blocks, reorg, tamper payloads, assert state.
+"""
+
+import pytest
+
+from reth_tpu.node import Node, NodeConfig
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.testing_actions import TestSuite as Suite
+from reth_tpu.testing_actions import (
+    ActionError,
+    AssertBalance,
+    AssertChainTip,
+    AssertPoolSize,
+    ProduceBlocks,
+    ProduceInvalidPayload,
+    ReorgTo,
+    SubmitTransaction,
+    WaitFor,
+)
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+BOB = b"\x0b" * 20
+
+
+@pytest.fixture()
+def node():
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    n = Node(NodeConfig(dev=True, genesis_header=builder.genesis,
+                        genesis_alloc=builder.accounts_at_genesis),
+             committer=CPU)
+    yield n, alice
+    n.stop()
+
+
+def test_produce_and_assert_scenario(node):
+    n, alice = node
+    Suite(n).run(
+        SubmitTransaction(alice, to=BOB, value=100),
+        AssertPoolSize(1),
+        ProduceBlocks(1),
+        AssertChainTip(1),
+        AssertBalance(BOB, 100),
+        AssertPoolSize(0),
+        SubmitTransaction(alice, to=BOB, value=50),
+        ProduceBlocks(2),
+        AssertChainTip(3),
+        AssertBalance(BOB, 150),
+    )
+
+
+def test_reorg_scenario(node):
+    n, alice = node
+    Suite(n).run(
+        SubmitTransaction(alice, to=BOB, value=100),
+        ProduceBlocks(3),
+        AssertChainTip(3),
+        ReorgTo(1),
+        AssertChainTip(1),
+        AssertBalance(BOB, 100),  # tx was in block 1: survives the reorg
+    )
+
+
+def test_invalid_payload_scenario(node):
+    n, alice = node
+
+    def break_root(block):
+        from dataclasses import replace
+
+        bad_header = replace(block.header, state_root=b"\x13" * 32)
+        return type(block)(bad_header, block.transactions, block.ommers,
+                           block.withdrawals)
+
+    Suite(n).run(
+        ProduceBlocks(1),
+        ProduceInvalidPayload(break_root),
+        AssertChainTip(1),  # the bad payload never became canonical
+    )
+
+
+def test_failed_assertion_reports_action(node):
+    n, alice = node
+    with pytest.raises(ActionError, match="action #1 AssertChainTip"):
+        Suite(n).run(ProduceBlocks(1), AssertChainTip(5))
+
+
+def test_waitfor_polls(node):
+    n, alice = node
+    Suite(n).run(
+        SubmitTransaction(alice, to=BOB, value=1),
+        WaitFor(lambda nd: len(nd.pool) == 1),
+    )

@@ -201,3 +201,22 @@ def test_static_file_compression_tiers(tmp_path):
     assert old.row(0, "header") == b"old-one"
     assert old.row(1, "header") == b"old-two"
     old.close()
+
+
+def test_trie_metrics_record_on_turbo_commit():
+    import numpy as np
+
+    from reth_tpu.metrics import trie_metrics
+    from reth_tpu.primitives.rlp import rlp_encode
+    from reth_tpu.trie.turbo import TurboCommitter
+
+    rng = np.random.default_rng(1)
+    keys = rng.integers(0, 256, (64, 32), dtype=np.uint8)
+    vals = [rlp_encode(bytes([i])) for i in range(64)]
+    before = trie_metrics._commits.value
+    TurboCommitter(backend="numpy").commit_hashed_many([(keys, vals)])
+    assert trie_metrics._commits.value == before + 1
+    assert trie_metrics.last["backend"] == "numpy"
+    assert trie_metrics.last["leaves"] == 64
+    assert trie_metrics.last["nodes"] > 0
+    assert trie_metrics.last["wire_bytes"] > 0

@@ -108,3 +108,36 @@ def test_tree_cache_stays_correct_across_blocks_and_reorgs():
     fork.build_block([a2.transfer(bob, 1000)])
     fork.build_block([a2.transfer(b"\x0c" * 20, 77)])
     assert tree.on_new_payload(fork.blocks[2]).status.name == "VALID"
+
+
+def test_prewarm_populates_cache_and_execution_agrees():
+    """A multi-tx payload triggers the prewarm pass; the canonical
+    execution result (and root) is unchanged and the cache is warm."""
+    from reth_tpu.engine import EngineTree
+    from reth_tpu.engine.tree import PayloadStatusKind
+    from reth_tpu.primitives import Account
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.storage import MemDb, ProviderFactory
+    from reth_tpu.storage.genesis import init_genesis
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    cpu = TrieCommitter(hasher=keccak256_batch_np)
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=cpu)
+    # one block with enough txs to cross the prewarm threshold
+    txs = [alice.transfer(bytes([0x10 + i]) * 20, 1000 + i) for i in range(6)]
+    builder.build_block(txs)
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=cpu)
+    tree = EngineTree(factory, committer=cpu)
+    assert tree.prewarm_threshold <= 6
+    st = tree.on_new_payload(builder.blocks[1])
+    assert st.status is PayloadStatusKind.VALID
+    assert tree.last_prewarm is not None
+    assert tree.last_prewarm.warmed == 6
+    # the warm pass populated the shared cache and the sequential pass hit
+    # it (sizes go back down when on_block_applied invalidates the block's
+    # own writes — hits are the proof of warmth)
+    stats = tree.execution_cache.stats()
+    assert stats["account_hits"] > 0
