@@ -513,8 +513,10 @@ def main(argv=None) -> int:
     p.add_argument("--bootnodes", default="", help="comma-separated enode urls")
     p.add_argument("--bootnodes-v5", default="", dest="bootnodes_v5",
                    help="comma-separated enr:... records (discv5)")
-    p.add_argument("--db", dest="db_backend", choices=["memdb", "native"],
-                   default="memdb", help="storage backend (native = C++ WAL engine)")
+    p.add_argument("--db", dest="db_backend", choices=["memdb", "native", "paged"],
+                   default="memdb",
+                   help="storage backend (native = C++ WAL engine, "
+                        "paged = mmap COW B+tree engine)")
     p.add_argument("--ethstats", default=None,
                    help="report to an ethstats server (node:secret@host:port)")
     add_hasher(p)

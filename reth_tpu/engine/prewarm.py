@@ -54,10 +54,30 @@ class PrewarmTask:
         """Execute all txs concurrently; returns how many completed.
         Counters come from the map results — workers share no mutable
         state, so nothing needs a lock."""
+        self.start(transactions, senders)
+        return self.join()
+
+    def start(self, transactions, senders) -> None:
+        """Kick the workers off WITHOUT waiting: the canonical sequential
+        pass runs concurrently and benefits from whatever has already been
+        warmed when it reaches each transaction (the reference's prewarm
+        overlaps execution the same way — blocking first would serialize
+        two full passes)."""
+        self._pool = None
+        self._futures = []
         if not transactions:
+            return
+        self._pool = ThreadPoolExecutor(max_workers=self.max_workers)
+        self._futures = [self._pool.submit(self._one, tx, s)
+                         for tx, s in zip(transactions, senders)]
+
+    def join(self) -> int:
+        """Collect results and release the workers."""
+        if self._pool is None:
             return 0
-        with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
-            results = list(pool.map(self._one, transactions, senders))
+        results = [f.result() for f in self._futures]
+        self._pool.shutdown(wait=True)
+        self._pool = None
         self.warmed = sum(results)
         self.failed = len(results) - self.warmed
         return self.warmed

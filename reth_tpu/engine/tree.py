@@ -279,7 +279,10 @@ class EngineTree:
                 blob_base_fee=blob_base_fee(header.excess_blob_gas or 0),
             )
             self.last_prewarm = PrewarmTask(executor, env)
-            self.last_prewarm.run(block.transactions, senders)
+            # started, NOT joined: the canonical pass below overlaps the
+            # warming workers (speculative reads only touch the shared
+            # mutex-guarded cache; canonical writes stay in its journal)
+            self.last_prewarm.start(block.transactions, senders)
         # pipelined root: a worker batch-hashes dirty keys on the device
         # WHILE execution runs (reference state_root_task / sparse_trie
         # strategy overlap; see engine/pipelined_root.py)
@@ -291,7 +294,11 @@ class EngineTree:
                                    state_hook=root_job.on_state_update)
         except BaseException:
             root_job.finish([])  # never leak the worker thread
+            if self.last_prewarm is not None:
+                self.last_prewarm.join()
             raise
+        if self.last_prewarm is not None:
+            self.last_prewarm.join()
         try:
             self.consensus.validate_block_post_execution(block, out.receipts, out.gas_used)
         except ConsensusError as e:

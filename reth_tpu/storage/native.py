@@ -1,9 +1,16 @@
-"""ctypes binding for the native C++ KV engine (native/kvstore.cpp).
+"""ctypes bindings for the native C++ KV engines.
 
-Reference analogue: libmdbx-rs — the Rust binding over the C engine
-(crates/storage/libmdbx-rs). Exposes the same Database/Tx/Cursor duck
-interface as ``MemDb``; the shared library is built on demand with g++
-and cached next to the source.
+Two engines share one Database/Tx/Cursor duck interface (same as ``MemDb``):
+
+* ``NativeDb`` — native/kvstore.cpp: in-RAM sorted tables + WAL/snapshot
+  durability. Reference analogue: the in-memory half of libmdbx-rs usage.
+* ``PagedDb`` — native/pagedkv.cpp: mmap-read copy-on-write paged B+tree
+  with dual-meta commits, the real MDBX architecture analogue (shadow
+  paging, O(1) crash recovery, nothing resident in process RAM).
+
+Shared libraries are built on demand with g++ and cached next to the
+source. Each engine exports the same C ABI under its own prefix
+(``rtkv_`` / ``rtpg_``); ``_Api`` normalizes them for the Python classes.
 """
 
 from __future__ import annotations
@@ -13,67 +20,92 @@ import subprocess
 import threading
 from pathlib import Path
 
-_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "kvstore.cpp"
-_SO = _SRC.parent / "build" / "libkvstore.so"
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
 _build_lock = threading.Lock()
-_lib = None
+_apis: dict = {}
 
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def load_library() -> ctypes.CDLL:
-    global _lib
-    if _lib is not None:
-        return _lib
+class _Api:
+    """Prefix-normalized function table for one engine's shared library."""
+
+    _FUNCS = [
+        "open", "close", "snapshot", "sync", "txn_begin", "put", "del",
+        "clear", "get", "entry_count", "commit", "abort", "cursor",
+        "cursor_close", "cursor_first", "cursor_last", "cursor_seek",
+        "cursor_next", "cursor_prev", "cursor_next_dup", "cursor_seek_dup",
+    ]
+
+    def __init__(self, lib: ctypes.CDLL, prefix: str):
+        for name in self._FUNCS:
+            # "del" is a Python keyword: expose as del_
+            setattr(self, name if name != "del" else "del_",
+                    getattr(lib, f"{prefix}_{name}"))
+
+
+def _load_api(src_name: str, prefix: str) -> _Api:
+    if prefix in _apis:
+        return _apis[prefix]
     with _build_lock:
-        if _lib is not None:
-            return _lib
-        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
-            _SO.parent.mkdir(parents=True, exist_ok=True)
+        if prefix in _apis:
+            return _apis[prefix]
+        src = _NATIVE_DIR / src_name
+        so = _NATIVE_DIR / "build" / f"lib{src.stem}.so"
+        if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+            so.parent.mkdir(parents=True, exist_ok=True)
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   str(_SRC), "-o", str(_SO)]
+                   str(src), "-o", str(so)]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise NativeBuildError(f"g++ failed:\n{proc.stderr}")
-        lib = ctypes.CDLL(str(_SO))
+        lib = ctypes.CDLL(str(so))
         u8p = ctypes.POINTER(ctypes.c_uint8)
-        lib.rtkv_open.restype = ctypes.c_void_p
-        lib.rtkv_open.argtypes = [ctypes.c_char_p]
-        lib.rtkv_close.argtypes = [ctypes.c_void_p]
-        lib.rtkv_snapshot.argtypes = [ctypes.c_void_p]
-        lib.rtkv_txn_begin.restype = ctypes.c_void_p
-        lib.rtkv_txn_begin.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.rtkv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
-                                 ctypes.c_uint32, u8p, ctypes.c_uint32, ctypes.c_int]
-        lib.rtkv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
-                                 ctypes.c_uint32, u8p, ctypes.c_uint32, ctypes.c_int]
-        lib.rtkv_clear.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.rtkv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
-                                 ctypes.c_uint32, ctypes.POINTER(u8p),
-                                 ctypes.POINTER(ctypes.c_uint32)]
-        lib.rtkv_entry_count.restype = ctypes.c_uint64
-        lib.rtkv_entry_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.rtkv_commit.argtypes = [ctypes.c_void_p]
-        lib.rtkv_abort.argtypes = [ctypes.c_void_p]
-        lib.rtkv_sync.argtypes = [ctypes.c_void_p]
-        lib.rtkv_cursor.restype = ctypes.c_void_p
-        lib.rtkv_cursor.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.rtkv_cursor_close.argtypes = [ctypes.c_void_p]
+        p = prefix
+        f = lambda n: getattr(lib, f"{p}_{n}")  # noqa: E731
+        f("open").restype = ctypes.c_void_p
+        f("open").argtypes = [ctypes.c_char_p]
+        f("close").argtypes = [ctypes.c_void_p]
+        f("snapshot").argtypes = [ctypes.c_void_p]
+        f("txn_begin").restype = ctypes.c_void_p
+        f("txn_begin").argtypes = [ctypes.c_void_p, ctypes.c_int]
+        f("put").argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
+                             ctypes.c_uint32, u8p, ctypes.c_uint32, ctypes.c_int]
+        f("del").argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
+                             ctypes.c_uint32, u8p, ctypes.c_uint32, ctypes.c_int]
+        f("clear").argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        f("get").argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
+                             ctypes.c_uint32, ctypes.POINTER(u8p),
+                             ctypes.POINTER(ctypes.c_uint32)]
+        f("entry_count").restype = ctypes.c_uint64
+        f("entry_count").argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        f("commit").argtypes = [ctypes.c_void_p]
+        f("abort").argtypes = [ctypes.c_void_p]
+        f("sync").argtypes = [ctypes.c_void_p]
+        f("cursor").restype = ctypes.c_void_p
+        f("cursor").argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        f("cursor_close").argtypes = [ctypes.c_void_p]
         out4 = [ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint32),
                 ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint32)]
-        lib.rtkv_cursor_first.argtypes = [ctypes.c_void_p] + out4
-        lib.rtkv_cursor_last.argtypes = [ctypes.c_void_p] + out4
-        lib.rtkv_cursor_seek.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint32,
-                                         ctypes.c_int] + out4
-        lib.rtkv_cursor_next.argtypes = [ctypes.c_void_p, ctypes.c_int] + out4
-        lib.rtkv_cursor_prev.argtypes = [ctypes.c_void_p] + out4
-        lib.rtkv_cursor_next_dup.argtypes = [ctypes.c_void_p] + out4
-        lib.rtkv_cursor_seek_dup.argtypes = [
+        f("cursor_first").argtypes = [ctypes.c_void_p] + out4
+        f("cursor_last").argtypes = [ctypes.c_void_p] + out4
+        f("cursor_seek").argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint32,
+                                     ctypes.c_int] + out4
+        f("cursor_next").argtypes = [ctypes.c_void_p, ctypes.c_int] + out4
+        f("cursor_prev").argtypes = [ctypes.c_void_p] + out4
+        f("cursor_next_dup").argtypes = [ctypes.c_void_p] + out4
+        f("cursor_seek_dup").argtypes = [
             ctypes.c_void_p, u8p, ctypes.c_uint32, u8p, ctypes.c_uint32] + out4
-        _lib = lib
-        return _lib
+        api = _Api(lib, prefix)
+        _apis[prefix] = api
+        return api
+
+
+def load_library():
+    """Backwards-compatible loader for the WAL engine's API table."""
+    return _load_api("kvstore.cpp", "rtkv")
 
 
 def _buf(b: bytes):
@@ -84,9 +116,9 @@ class NativeCursor:
     """Cursor over one table; same surface as storage.kv.Cursor."""
 
     def __init__(self, tx: "NativeTx", table: str):
-        self._lib = tx._lib
+        self._api = tx._api
         self._tx = tx  # keep the txn alive for the cursor's lifetime
-        self._cur = self._lib.rtkv_cursor(tx._txn, table.encode())
+        self._cur = self._api.cursor(tx._txn, table.encode())
         self._out = (
             ctypes.POINTER(ctypes.c_uint8)(), ctypes.c_uint32(),
             ctypes.POINTER(ctypes.c_uint8)(), ctypes.c_uint32(),
@@ -94,7 +126,7 @@ class NativeCursor:
 
     def __del__(self):
         try:
-            self._lib.rtkv_cursor_close(self._cur)
+            self._api.cursor_close(self._cur)
         except Exception:
             pass
 
@@ -111,33 +143,33 @@ class NativeCursor:
         return (ctypes.byref(kp), ctypes.byref(kl), ctypes.byref(vp), ctypes.byref(vl))
 
     def first(self):
-        return self._ret(self._lib.rtkv_cursor_first(self._cur, *self._refs()))
+        return self._ret(self._api.cursor_first(self._cur, *self._refs()))
 
     def last(self):
-        return self._ret(self._lib.rtkv_cursor_last(self._cur, *self._refs()))
+        return self._ret(self._api.cursor_last(self._cur, *self._refs()))
 
     def seek(self, key: bytes):
-        return self._ret(self._lib.rtkv_cursor_seek(
+        return self._ret(self._api.cursor_seek(
             self._cur, _buf(key), len(key), 0, *self._refs()))
 
     def seek_exact(self, key: bytes):
-        return self._ret(self._lib.rtkv_cursor_seek(
+        return self._ret(self._api.cursor_seek(
             self._cur, _buf(key), len(key), 1, *self._refs()))
 
     def next(self):
-        return self._ret(self._lib.rtkv_cursor_next(self._cur, 0, *self._refs()))
+        return self._ret(self._api.cursor_next(self._cur, 0, *self._refs()))
 
     def prev(self):
-        return self._ret(self._lib.rtkv_cursor_prev(self._cur, *self._refs()))
+        return self._ret(self._api.cursor_prev(self._cur, *self._refs()))
 
     def next_dup(self):
-        return self._ret(self._lib.rtkv_cursor_next_dup(self._cur, *self._refs()))
+        return self._ret(self._api.cursor_next_dup(self._cur, *self._refs()))
 
     def next_no_dup(self):
-        return self._ret(self._lib.rtkv_cursor_next(self._cur, 1, *self._refs()))
+        return self._ret(self._api.cursor_next(self._cur, 1, *self._refs()))
 
     def seek_by_key_subkey(self, key: bytes, subkey: bytes):
-        return self._ret(self._lib.rtkv_cursor_seek_dup(
+        return self._ret(self._api.cursor_seek_dup(
             self._cur, _buf(key), len(key), _buf(subkey), len(subkey), *self._refs()))
 
     def walk(self, start: bytes | None = None):
@@ -162,8 +194,8 @@ class NativeCursor:
 class NativeTx:
     def __init__(self, db: "NativeDb", write: bool):
         self._db = db
-        self._lib = db._lib
-        self._txn = self._lib.rtkv_txn_begin(db._env, 1 if write else 0)
+        self._api = db._api
+        self._txn = self._api.txn_begin(db._env, 1 if write else 0)
         if not self._txn:
             raise RuntimeError("nested write transaction on one thread")
         self._write = write
@@ -173,7 +205,7 @@ class NativeTx:
     def get(self, table: str, key: bytes):
         out = ctypes.POINTER(ctypes.c_uint8)()
         out_len = ctypes.c_uint32()
-        rc = self._lib.rtkv_get(self._txn, table.encode(), _buf(key), len(key),
+        rc = self._api.get(self._txn, table.encode(), _buf(key), len(key),
                                 ctypes.byref(out), ctypes.byref(out_len))
         if not rc:
             return None
@@ -187,7 +219,7 @@ class NativeTx:
         return NativeCursor(self, table)
 
     def entry_count(self, table: str) -> int:
-        return int(self._lib.rtkv_entry_count(self._txn, table.encode()))
+        return int(self._api.entry_count(self._txn, table.encode()))
 
     def _sorted_keys(self, table: str) -> list[bytes]:
         # cached PER TRANSACTION: with MVCC snapshots a db-level cache
@@ -207,33 +239,33 @@ class NativeTx:
     def put(self, table: str, key: bytes, value: bytes, dupsort: bool = False):
         assert self._write, "read-only transaction"
         self._key_cache.pop(table, None)
-        self._lib.rtkv_put(self._txn, table.encode(), _buf(key), len(key),
+        self._api.put(self._txn, table.encode(), _buf(key), len(key),
                            _buf(value), len(value), 1 if dupsort else 0)
 
     def delete(self, table: str, key: bytes, value: bytes | None = None) -> bool:
         assert self._write, "read-only transaction"
         self._key_cache.pop(table, None)
         if value is None:
-            return bool(self._lib.rtkv_del(self._txn, table.encode(), _buf(key),
-                                           len(key), None, 0, 0))
-        return bool(self._lib.rtkv_del(self._txn, table.encode(), _buf(key),
-                                       len(key), _buf(value), len(value), 1))
+            return bool(self._api.del_(self._txn, table.encode(), _buf(key),
+                                       len(key), None, 0, 0))
+        return bool(self._api.del_(self._txn, table.encode(), _buf(key),
+                                   len(key), _buf(value), len(value), 1))
 
     def clear(self, table: str):
         assert self._write
         self._key_cache.pop(table, None)
-        self._lib.rtkv_clear(self._txn, table.encode())
+        self._api.clear(self._txn, table.encode())
 
     def commit(self):
         assert not self._done
-        rc = self._lib.rtkv_commit(self._txn)
+        rc = self._api.commit(self._txn)
         self._done = True
         if rc != 0:
             raise OSError("native KV commit failed (WAL write error)")
 
     def abort(self):
         if not self._done:
-            self._lib.rtkv_abort(self._txn)  # MVCC: clones just drop
+            self._api.abort(self._txn)  # MVCC: clones just drop
             self._done = True
 
     def __del__(self):
@@ -259,11 +291,11 @@ class NativeDb:
     """Database over the C++ engine (persistent when ``path`` given)."""
 
     def __init__(self, path: str | Path | None = None):
-        self._lib = load_library()
+        self._api = load_library()
         self._dir = str(path) if path else ""
         if path:
             Path(path).mkdir(parents=True, exist_ok=True)
-        self._env = self._lib.rtkv_open(self._dir.encode())
+        self._env = self._api.open(self._dir.encode())
         if not self._env:
             raise NativeBuildError(f"rtkv_open failed for {self._dir!r}")
 
@@ -275,15 +307,38 @@ class NativeDb:
 
     def flush(self):
         """Compact the WAL into a snapshot (fsynced)."""
-        if self._lib.rtkv_snapshot(self._env) != 0:
+        if self._api.snapshot(self._env) != 0:
             raise OSError("native KV snapshot failed")
 
     def sync(self):
         """Power-loss durability point: fsync the WAL."""
-        if self._lib.rtkv_sync(self._env) != 0:
+        if self._api.sync(self._env) != 0:
             raise OSError("native KV sync failed")
 
     def close(self):
         if self._env:
-            self._lib.rtkv_close(self._env)
+            self._api.close(self._env)
             self._env = None
+
+
+class PagedDb(NativeDb):
+    """Database over the paged copy-on-write B+tree engine (pagedkv.cpp).
+
+    The MDBX architecture analogue: reads go through one shared mmap (the
+    OS page cache is the read cache), commits are shadow-paged with a dual
+    meta-page flip, and crash recovery is O(1) — the previous meta is
+    always intact. Persistent-only: a directory path is required.
+    """
+
+    def __init__(self, path: str | Path):
+        self._api = _load_api("pagedkv.cpp", "rtpg")
+        self._dir = str(path)
+        Path(path).mkdir(parents=True, exist_ok=True)
+        self._env = self._api.open(self._dir.encode())
+        if not self._env:
+            raise NativeBuildError(f"rtpg_open failed for {self._dir!r}")
+
+    def flush(self):
+        """Durability point (every commit already fsyncs the meta flip)."""
+        if self._api.snapshot(self._env) != 0:
+            raise OSError("paged KV sync failed")

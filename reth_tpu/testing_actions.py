@@ -99,11 +99,17 @@ class ReorgTo:
         with node.factory.provider() as p:
             target = p.canonical_hash(self.number)
         if target is None:
-            # unpersisted tip blocks live in the tree
-            for h, eb in node.tree.blocks.items():
-                if eb.block.header.number == self.number:
-                    target = h
+            # unpersisted tip blocks live in the tree; walk the CANONICAL
+            # chain (a fork sibling at the same height must not win)
+            head = node.tree.head_hash
+            while head is not None:
+                eb = node.tree.blocks.get(head)
+                if eb is None:
                     break
+                if eb.block.header.number == self.number:
+                    target = head
+                    break
+                head = eb.block.header.parent_hash
         if target is None:
             raise ActionError(f"no canonical block {self.number}")
         node.tree.on_forkchoice_updated(target)

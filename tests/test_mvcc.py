@@ -1,4 +1,4 @@
-"""MVCC snapshot isolation: concurrent reader/writer stress on both KV
+"""MVCC snapshot isolation: concurrent reader/writer stress on all KV
 backends (VERDICT round-1 weak #5 — historical reads racing a writer).
 
 Invariant under test: the writer commits batches that keep `sum` ==
@@ -14,14 +14,18 @@ import threading
 import pytest
 
 from reth_tpu.storage.kv import MemDb
-from reth_tpu.storage.native import NativeDb
+from reth_tpu.storage.native import NativeDb, PagedDb
 
 BATCHES = 60
 KEYS = 40
 
 
-def _backends(tmp_path):
-    return [MemDb(), NativeDb(str(tmp_path / "native"))]
+def _make(backend, tmp_path):
+    if backend == "mem":
+        return MemDb()
+    if backend == "paged":
+        return PagedDb(str(tmp_path / "paged"))
+    return NativeDb(str(tmp_path / "native"))
 
 
 def _writer(db, stop):
@@ -60,9 +64,9 @@ def _reader(db, stop, errors):
             return
 
 
-@pytest.mark.parametrize("backend", ["mem", "native"])
+@pytest.mark.parametrize("backend", ["mem", "native", "paged"])
 def test_concurrent_reader_writer_snapshots(tmp_path, backend):
-    db = MemDb() if backend == "mem" else NativeDb(str(tmp_path / "native"))
+    db = _make(backend, tmp_path)
     stop = threading.Event()
     errors: list[str] = []
     readers = [threading.Thread(target=_reader, args=(db, stop, errors))
@@ -78,10 +82,10 @@ def test_concurrent_reader_writer_snapshots(tmp_path, backend):
     assert not errors, errors[:3]
 
 
-@pytest.mark.parametrize("backend", ["mem", "native"])
+@pytest.mark.parametrize("backend", ["mem", "native", "paged"])
 def test_reader_snapshot_stable_across_commit(tmp_path, backend):
     """A read txn opened BEFORE a commit must keep seeing the old state."""
-    db = MemDb() if backend == "mem" else NativeDb(str(tmp_path / "native"))
+    db = _make(backend, tmp_path)
     with db.tx_mut() as tx:
         tx.put("t", b"a", b"1")
     reader = db.tx()
@@ -101,9 +105,9 @@ def test_reader_snapshot_stable_across_commit(tmp_path, backend):
     fresh.abort()
 
 
-@pytest.mark.parametrize("backend", ["mem", "native"])
+@pytest.mark.parametrize("backend", ["mem", "native", "paged"])
 def test_abort_discards_all_writes(tmp_path, backend):
-    db = MemDb() if backend == "mem" else NativeDb(str(tmp_path / "native"))
+    db = _make(backend, tmp_path)
     with db.tx_mut() as tx:
         tx.put("t", b"x", b"keep")
     tx = db.tx_mut()

@@ -23,11 +23,22 @@ def _native_db():
     return NativeDb()
 
 
-@pytest.fixture(params=["mem", "native"])
-def make_db(request):
-    """Both storage backends must satisfy the same KV contract."""
+@pytest.fixture(params=["mem", "native", "paged"])
+def make_db(request, tmp_path):
+    """All storage backends must satisfy the same KV contract."""
     if request.param == "mem":
         return MemDb
+    if request.param == "paged":
+        from reth_tpu.storage.native import PagedDb
+
+        try:
+            PagedDb(tmp_path / "probe").close()
+        except Exception as e:  # toolchain missing
+            pytest.skip(f"paged backend unavailable: {e}")
+        import itertools
+
+        seq = itertools.count()
+        return lambda: PagedDb(tmp_path / f"paged{next(seq)}")
     try:
         _native_db()
     except Exception as e:  # toolchain missing
