@@ -419,78 +419,85 @@ class FusedLevelEngine:
         )
 
 
-def _u16_slice(u8, off: int, n: int):
-    """Read n little-endian u16s staged inside the u8 buffer."""
-    raw = u8[off:off + 2 * n].reshape(n, 2).astype(jnp.uint32)
-    return raw[:, 0] | (raw[:, 1] << 8)
+@lru_cache(maxsize=64)
+def _staged_packed(b_tier: int, n_pow: int, h_pow: int, u8_len: int,
+                   i32_len: int, s_tier: int):
+    """One compiled per-LEVEL program over the staged whole-commit buffers.
 
+    Round-2 postmortem: the first mega variant unrolled EVERY level into one
+    XLA graph; it compiled for ~19 s on the CPU backend and never finished
+    over the axon tunnel's serialized remote compile — wedging the tunnel
+    exactly like round 1's compile storm (VERDICT weak #1). This variant
+    keeps the mega engine's wire win (two H2D uploads per commit, zero
+    mid-commit D2H — dispatches of device-resident buffers are cheap) but
+    compiles SMALL per-level programs shared across levels: static shapes
+    are pow2 row/hole tiers, while the level's location in the staging
+    buffers (offsets) and its live row/hole counts arrive as traced scalars.
+    Program count is O(log levels), each one a single masked-absorb graph.
+    """
 
-@lru_cache(maxsize=16)
-# bounded: the signature concatenates every level's tiers, so distinct
-# workload shapes multiply — eviction caps retained executables and the
-# number of multi-second compiles a shape-thrashing caller can accumulate
-def _mega_jitted(sig: tuple, s_tier: int):
-    """ONE program for a whole commit: every level's hashing unrolled over
-    two staged input buffers (u8 bytes + i32 indices), digest buffer chained
-    through the stages in HBM. ``sig`` is the static plan — per stage the
-    kind, tiers, and static slice offsets into the staging buffers — so one
-    compiled program exists per distinct level-shape signature (tiering
-    collapses similar workloads onto the same signature).
-
-    Wire-size discipline (the tunnel moves ~25 MB/s when a program consumes
-    its inputs — bytes/hash IS the perf model): row lengths ship as u16
-    inside the byte buffer, row offsets and block counts are DERIVED here
-    (exclusive cumsum / div), and hole/child coordinates ship as packed
-    (row * L + byte) single i32s."""
-
-    def run(u8, i32, digest_buf):
-        for entry in sig:
-            kind = entry[0]
-            if kind == "packed":
-                (_, b_tier, n_tier, flat_off, flat_len, len_o, slot_o,
-                 hidx_o, hsrc_o, h_len) = entry
-                flat = u8[flat_off:flat_off + flat_len]
-                row_len = _u16_slice(u8, len_o, n_tier)
-                row_off = jnp.cumsum(row_len) - row_len  # exclusive prefix
-                counts = (row_len // RATE + 1).astype(jnp.int32)
-                slots = i32[slot_o:slot_o + n_tier]
-                hidx = i32[hidx_o:hidx_o + h_len]
-                hs = i32[hsrc_o:hsrc_o + h_len]
-                digest_buf = _packed_level_fused(
-                    flat, row_off, row_len, counts, hidx, hs, slots,
-                    digest_buf, b_tier=b_tier)
-            else:  # branch
-                _, n_tier, mask_o, slot_o, chidx_o, chsrc_o, ch_len = entry
-                masks = _u16_slice(u8, mask_o, n_tier).astype(jnp.int32)
-                slots = i32[slot_o:slot_o + n_tier]
-                crn = i32[chidx_o:chidx_o + ch_len]
-                cs = i32[chsrc_o:chsrc_o + ch_len]
-                digest_buf = _branch_level(masks, slots, crn // 16, crn % 16,
-                                           cs, digest_buf, b_tier=4)
-        return digest_buf
+    def run(u8, i32, digest_buf, flat_off, len_o, slot_o, hidx_o, hsrc_o,
+            n_valid, h_valid):
+        L = b_tier * RATE
+        raw = jax.lax.dynamic_slice(u8, (len_o,), (2 * n_pow,))
+        raw = raw.reshape(n_pow, 2).astype(jnp.uint32)
+        ridx = jnp.arange(n_pow, dtype=jnp.int32)
+        vrow = ridx < n_valid
+        row_len = jnp.where(vrow, raw[:, 0] | (raw[:, 1] << 8), 0)
+        row_off = (jnp.cumsum(row_len) - row_len).astype(jnp.int32)
+        counts = (row_len // RATE + 1).astype(jnp.int32)
+        slots = jnp.where(
+            vrow, jax.lax.dynamic_slice(i32, (slot_o,), (n_pow,)), 0)
+        # rows gather straight from the staging buffer (no slice
+        # materialization, no padding of the staged bytes)
+        col = jnp.arange(L, dtype=jnp.int32)[None, :]
+        idx = jnp.minimum(flat_off + row_off[:, None] + col, u8.shape[0] - 1)
+        rows = jnp.where(col < row_len[:, None].astype(jnp.int32), u8[idx], 0)
+        rl = row_len[:, None].astype(jnp.int32)
+        rows = rows ^ jnp.where(col == rl, 0x01, 0).astype(jnp.uint8)
+        last = (counts * RATE - 1)[:, None]
+        rows = rows ^ jnp.where(col == last, 0x80, 0).astype(jnp.uint8)
+        # splice child digests; junk hole entries retarget the level's
+        # always-padding row (row n_valid-1 has row_len 0)
+        hidxr = jax.lax.dynamic_slice(i32, (hidx_o,), (h_pow,))
+        hsrcr = jax.lax.dynamic_slice(i32, (hsrc_o,), (h_pow,))
+        hv = jnp.arange(h_pow, dtype=jnp.int32) < h_valid
+        dump = (n_valid - 1) * L
+        hidx = jnp.where(hv, hidxr, dump)
+        hsrc = jnp.where(hv, hsrcr, 0)
+        dig = digest_buf[hsrc]
+        fr = rows.reshape(-1)
+        sidx = hidx[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
+        rows = fr.at[sidx.reshape(-1)].set(dig.reshape(-1)).reshape(n_pow, L)
+        d = masked_absorb_words(_bytes_to_words(rows), b_tier, counts)
+        return digest_buf.at[slots].set(_digests_to_bytes(d))
 
     return jax.jit(run, donate_argnums=2)
 
 
-def _packed_level_fused(flat, row_off, row_len, counts, hidx, hsrc, slots,
-                        digest_buf, *, b_tier: int):
-    """_packed_level with pre-packed hole coordinates (hidx = row * L +
-    byte_off within the padded row grid)."""
-    L = b_tier * RATE
-    n = row_off.shape[0]
-    col = jnp.arange(L, dtype=jnp.uint32)[None, :]
-    idx = jnp.minimum(row_off[:, None] + col, flat.shape[0] - 1)
-    rows = jnp.where(col < row_len[:, None], flat[idx], 0)
-    rows = rows ^ jnp.where(col == row_len[:, None], 0x01, 0).astype(jnp.uint8)
-    last = (counts.astype(jnp.uint32) * RATE - 1)[:, None]
-    rows = rows ^ jnp.where(col == last, 0x80, 0).astype(jnp.uint8)
-    if hidx is not None:
-        dig = digest_buf[hsrc]
-        fr = rows.reshape(-1)
-        sidx = hidx[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
-        rows = fr.at[sidx.reshape(-1)].set(dig.reshape(-1)).reshape(n, L)
-    d = masked_absorb_words(_bytes_to_words(rows), b_tier, counts)
-    return digest_buf.at[slots].set(_digests_to_bytes(d))
+@lru_cache(maxsize=64)
+def _staged_branch(n_pow: int, ch_pow: int, u8_len: int, i32_len: int,
+                   s_tier: int):
+    """Per-level staged branch program (see `_staged_packed`)."""
+
+    def run(u8, i32, digest_buf, mask_o, slot_o, chidx_o, chsrc_o,
+            n_valid, ch_valid):
+        raw = jax.lax.dynamic_slice(u8, (mask_o,), (2 * n_pow,))
+        raw = raw.reshape(n_pow, 2).astype(jnp.uint32)
+        vrow = jnp.arange(n_pow, dtype=jnp.int32) < n_valid
+        masks = jnp.where(vrow, (raw[:, 0] | (raw[:, 1] << 8)), 0)
+        slots = jnp.where(
+            vrow, jax.lax.dynamic_slice(i32, (slot_o,), (n_pow,)), 0)
+        crn_r = jax.lax.dynamic_slice(i32, (chidx_o,), (ch_pow,))
+        cs_r = jax.lax.dynamic_slice(i32, (chsrc_o,), (ch_pow,))
+        cv = jnp.arange(ch_pow, dtype=jnp.int32) < ch_valid
+        dump = (n_valid - 1) * 16
+        crn = jnp.where(cv, crn_r, dump)
+        cs = jnp.where(cv, cs_r, 0)
+        return _branch_level(masks.astype(jnp.int32), slots, crn // 16,
+                             crn % 16, cs, digest_buf, b_tier=4)
+
+    return jax.jit(run, donate_argnums=2)
 
 
 class MegaFusedEngine(FusedLevelEngine):
@@ -501,8 +508,10 @@ class MegaFusedEngine(FusedLevelEngine):
     engine's ~18 dispatches x ~5 small arrays each pay seconds in transfer
     latency alone. This engine records every level dispatch, concatenates
     all inputs into TWO staging buffers (u8 bytes, i32 indices), uploads
-    them in ONE device_put each, and runs the whole commit as ONE XLA
-    program (`_mega_jitted`). D2H stays a single digest/root fetch.
+    them in ONE device_put each, then runs one SMALL compiled program per
+    level over the resident buffers (`_staged_packed`/`_staged_branch`),
+    digest buffer donated through the chain. D2H stays a single
+    digest/root fetch.
 
     Reference analogue: the same per-level batching seam
     (crates/stages/stages/src/stages/hashing_account.rs:29-32), collapsed
@@ -524,17 +533,17 @@ class MegaFusedEngine(FusedLevelEngine):
         self._u8_off = self._i32_off = 0
         self._buf = None
 
-    # wire-size tiers: quantized to 4 steps per octave (2^e x {1, 1.25,
-    # 1.5, 1.75}) — ≤12.5% padding waste on the wire while keeping the
-    # signature variety (and so the XLA program count) logarithmic: chunks
-    # of a chunked MerkleStage rebuild that differ by <12.5% per level
-    # share one compiled program
+    # program-shape tiers are pow2 from these floors: compile count stays
+    # O(log workload) while the STAGED bytes remain tight (padding never
+    # crosses the wire; the programs mask junk rows/holes via n_valid)
     _ROW_FLOOR = 2048
-    _FLAT_FLOOR = 1 << 16
     _HOLE_FLOOR = 2048
 
     @staticmethod
     def _step(n: int, floor: int) -> int:
+        """Quantize the final staging-buffer length: 4 steps per octave —
+        ≤12.5% wire waste, logarithmic buffer-shape variety (the buffer
+        length is part of every level program's signature)."""
         if n <= floor:
             return floor
         e = (n - 1).bit_length() - 1  # n in (2^e, 2^(e+1)]
@@ -564,47 +573,70 @@ class MegaFusedEngine(FusedLevelEngine):
         n = len(row_off)
         if n == 0:
             return
-        n_tier = self._step(n + 1, self._ROW_FLOOR)
         L = b_tier * RATE
-        # u16 row lengths in the byte buffer; offsets/counts derived on device
-        row_len_p = np.zeros((n_tier,), dtype="<u2")
+        if n + 1 > self._MAX_ROWS:
+            # int32 scatter indices (row * L + byte) wrap past 2^31 — split
+            # the level by row ranges (within-level order is free)
+            cap = self._MAX_ROWS - 1
+            for lo in range(0, n, cap):
+                hi = min(lo + cap, n)
+                sub_holes = None
+                if holes is not None:
+                    m = (holes[0] >= lo) & (holes[0] < hi)
+                    if m.any():
+                        sub_holes = np.stack(
+                            (holes[0][m] - lo, holes[1][m], holes[2][m]))
+                base = int(row_off[lo])
+                end = int(row_off[hi - 1] + row_len[hi - 1])
+                self.dispatch_packed(
+                    flat[base:end], row_off[lo:hi] - base, row_len[lo:hi],
+                    slots[lo:hi], sub_holes, b_tier)
+            return
+        # tight staging + one explicit padding row (the hole dump target)
+        row_len_p = np.zeros((n + 1,), dtype="<u2")
         row_len_p[:n] = row_len
-        slots_p = np.zeros((n_tier,), dtype=np.int32)
+        slots_p = np.zeros((n + 1,), dtype=np.int32)
         slots_p[:n] = slots
-        flat_tier = self._step(len(flat), self._FLAT_FLOOR)
-        flat_p = np.zeros((flat_tier,), dtype=np.uint8)
-        flat_p[: len(flat)] = flat
         h = holes.shape[1] if holes is not None else 0
-        h_tier = self._step(h, self._HOLE_FLOOR)
-        # packed hole coordinate: row * L + byte_off; padding rows target the
-        # always-padding row n (row_len 0 ⇒ its bytes never feed a real hash)
-        hidx = np.full((h_tier,), n * L, dtype=np.int32)
-        hsrc = np.zeros((h_tier,), dtype=np.int32)
+        hidx = np.full((h + 1,), n * L, dtype=np.int32)
+        hsrc = np.zeros((h + 1,), dtype=np.int32)
         if h:
             hidx[:h] = holes[0] * L + holes[1]
             hsrc[:h] = holes[2]
-        flat_off = self._stage_u8(flat_p)
+        flat_off = self._stage_u8(np.asarray(flat, dtype=np.uint8))
         len_o = self._stage_u8(row_len_p.view(np.uint8))
         slot_o = self._stage_i32(slots_p)
         hidx_o = self._stage_i32(hidx)
         hsrc_o = self._stage_i32(hsrc)
-        self._plan.append(("packed", b_tier, n_tier, flat_off, flat_tier,
-                           len_o, slot_o, hidx_o, hsrc_o, h_tier))
+        self._plan.append(("packed", b_tier,
+                           _pow2(n + 1, floor=self._ROW_FLOOR),
+                           _pow2(h + 1, floor=self._HOLE_FLOOR),
+                           flat_off, len_o, slot_o, hidx_o, hsrc_o,
+                           n + 1, h + 1))
 
     def dispatch_branch(self, masks, slots, children) -> None:
         n = len(masks)
         if n == 0:
             return
-        n_tier = self._step(n + 1, self._ROW_FLOOR)
-        masks_p = np.zeros((n_tier,), dtype="<u2")
+        if n + 1 > self._MAX_ROWS:
+            cap = self._MAX_ROWS - 1
+            for lo in range(0, n, cap):
+                hi = min(lo + cap, n)
+                sub = None
+                if children is not None:
+                    m = (children[0] >= lo) & (children[0] < hi)
+                    if m.any():
+                        sub = np.stack(
+                            (children[0][m] - lo, children[1][m], children[2][m]))
+                self.dispatch_branch(masks[lo:hi], slots[lo:hi], sub)
+            return
+        masks_p = np.zeros((n + 1,), dtype="<u2")
         masks_p[:n] = masks
-        slots_p = np.zeros((n_tier,), dtype=np.int32)
+        slots_p = np.zeros((n + 1,), dtype=np.int32)
         slots_p[:n] = slots
         c = children.shape[1] if children is not None else 0
-        ch_tier = self._step(c, self._HOLE_FLOOR)
-        # packed child coordinate: row * 16 + nibble; padding targets row n
-        chidx = np.full((ch_tier,), n * 16, dtype=np.int32)
-        chsrc = np.zeros((ch_tier,), dtype=np.int32)
+        chidx = np.full((c + 1,), n * 16, dtype=np.int32)
+        chsrc = np.zeros((c + 1,), dtype=np.int32)
         if c:
             chidx[:c] = children[0] * 16 + children[1]
             chsrc[:c] = children[2]
@@ -612,21 +644,67 @@ class MegaFusedEngine(FusedLevelEngine):
         slot_o = self._stage_i32(slots_p)
         chidx_o = self._stage_i32(chidx)
         chsrc_o = self._stage_i32(chsrc)
-        self._plan.append(("branch", n_tier, mask_o, slot_o, chidx_o,
-                           chsrc_o, ch_tier))
+        self._plan.append(("branch",
+                           _pow2(n + 1, floor=self._ROW_FLOOR),
+                           _pow2(c + 1, floor=self._HOLE_FLOOR),
+                           mask_o, slot_o, chidx_o, chsrc_o, n + 1, c + 1))
+
+    def _buffer_lens(self) -> tuple[int, int]:
+        """Final staged lengths: every program's dynamic_slice must fit
+        in-bounds (a clamped slice start would silently misalign the level),
+        then quantized so buffer-shape variety stays logarithmic."""
+        u8_need = self._u8_off
+        i32_need = self._i32_off
+        for e in self._plan:
+            if e[0] == "packed":
+                (_, _b, n_pow, h_pow, _f, len_o, slot_o, hidx_o, hsrc_o,
+                 _n, _h) = e
+                u8_need = max(u8_need, len_o + 2 * n_pow)
+                i32_need = max(i32_need, slot_o + n_pow,
+                               hidx_o + h_pow, hsrc_o + h_pow)
+            else:
+                _, n_pow, ch_pow, mask_o, slot_o, chidx_o, chsrc_o, _n, _c = e
+                u8_need = max(u8_need, mask_o + 2 * n_pow)
+                i32_need = max(i32_need, slot_o + n_pow,
+                               chidx_o + ch_pow, chsrc_o + ch_pow)
+        return (self._step(u8_need, 1 << 16), self._step(i32_need, 1 << 12))
 
     def _execute(self) -> None:
         if self._buf is not None:
             return
-        u8 = (np.concatenate(self._u8_parts) if self._u8_parts
-              else np.zeros(1, np.uint8))
-        i32 = (np.concatenate(self._i32_parts) if self._i32_parts
-               else np.zeros(1, np.int32))
-        fn = _mega_jitted(tuple(self._plan), self._s_tier)
-        self._buf = fn(
-            jnp.asarray(u8), jnp.asarray(i32),
-            self._device_put(np.zeros((self._s_tier, 32), dtype=np.uint8)),
-        )
+        u8_len, i32_len = self._buffer_lens()
+        u8 = np.zeros((u8_len,), dtype=np.uint8)
+        off = 0
+        for part in self._u8_parts:
+            u8[off:off + part.size] = part
+            off += part.size
+        i32 = np.zeros((i32_len,), dtype=np.int32)
+        off = 0
+        for part in self._i32_parts:
+            i32[off:off + part.size] = part
+            off += part.size
+        u8d = self._device_put(u8)
+        i32d = self._device_put(i32)
+        buf = self._device_put(np.zeros((self._s_tier, 32), dtype=np.uint8))
+        s32 = np.int32
+        for e in self._plan:
+            if e[0] == "packed":
+                (_, b_tier, n_pow, h_pow, flat_off, len_o, slot_o, hidx_o,
+                 hsrc_o, n_valid, h_valid) = e
+                fn = _staged_packed(b_tier, n_pow, h_pow, u8_len, i32_len,
+                                    self._s_tier)
+                buf = fn(u8d, i32d, buf, s32(flat_off), s32(len_o),
+                         s32(slot_o), s32(hidx_o), s32(hsrc_o),
+                         s32(n_valid), s32(h_valid))
+            else:
+                (_, n_pow, ch_pow, mask_o, slot_o, chidx_o, chsrc_o,
+                 n_valid, c_valid) = e
+                fn = _staged_branch(n_pow, ch_pow, u8_len, i32_len,
+                                    self._s_tier)
+                buf = fn(u8d, i32d, buf, s32(mask_o), s32(slot_o),
+                         s32(chidx_o), s32(chsrc_o), s32(n_valid),
+                         s32(c_valid))
+        self._buf = buf
         self._plan, self._u8_parts, self._i32_parts = [], [], []
 
     def finish(self) -> np.ndarray:
