@@ -1,0 +1,168 @@
+"""Stateless block validation over a witness-backed sparse trie.
+
+Reference analogue: the stateless validation flow the reference exposes
+through `debug_executionWitness` + invalid-block witness hooks
+(crates/engine/invalid-block-hooks/src/witness.rs), and the sparse-trie
+state-root strategy's reveal→update→rehash loop
+(crates/engine/tree/src/tree/state_root_strategy/sparse_trie.rs:126-259)
+— run here with NO state source at all: every read comes from the
+witness's revealed nodes, every trie edit lands in the sparse trie, and
+the post-state root is recomputed with level-batched keccak.
+
+`StatelessChain` validates consecutive blocks reusing the preserved
+sparse trie (chain-state `PreservedSparseTrie`): block n+1 anchors on the
+trie left by block n and only reveals what it newly touches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from ..evm.executor import BlockExecutor, StateSource
+from ..primitives.keccak import keccak256, keccak256_batch_np
+from ..primitives.rlp import decode_int, encode_int, rlp_decode, rlp_encode
+from ..primitives.types import Account, Block, Header, KECCAK_EMPTY
+from ..trie.sparse import (
+    BlindedNodeError,
+    PreservedSparseTrie,
+    SparseStateTrie,
+    SparseTrie,
+)
+
+
+class StatelessValidationError(Exception):
+    pass
+
+
+def _decode_account_leaf(leaf: bytes) -> Account:
+    nonce, balance, storage_root, code_hash = rlp_decode(leaf)
+    return Account(nonce=decode_int(nonce), balance=decode_int(balance),
+                   storage_root=storage_root, code_hash=code_hash)
+
+
+class WitnessStateSource(StateSource):
+    """EVM state source answering every read from a shared sparse trie
+    revealed out of witness nodes (no database anywhere)."""
+
+    def __init__(self, trie: SparseStateTrie, witness_nodes: list[bytes],
+                 codes: list[bytes]):
+        self.trie = trie
+        self.nodes = witness_nodes
+        self.codes = {keccak256(c): c for c in codes}
+        self._storage_revealed: set[bytes] = set()
+
+    def account(self, address: bytes) -> Account | None:
+        leaf = self.trie.account_trie.get(keccak256(address))
+        return _decode_account_leaf(leaf) if leaf is not None else None
+
+    def storage(self, address: bytes, slot: bytes) -> int:
+        acct = self.account(address)
+        if acct is None:
+            return 0
+        ha = keccak256(address)
+        if ha not in self._storage_revealed:
+            self.trie.reveal_storage(ha, acct.storage_root, self.nodes)
+            self._storage_revealed.add(ha)
+        leaf = self.trie.storage_trie(ha).get(keccak256(slot))
+        return decode_int(rlp_decode(leaf)) if leaf is not None else 0
+
+    def bytecode(self, code_hash: bytes) -> bytes:
+        if code_hash == KECCAK_EMPTY:
+            return b""
+        code = self.codes.get(code_hash)
+        if code is None:
+            raise StatelessValidationError(
+                f"witness missing bytecode {code_hash.hex()}")
+        return code
+
+
+def apply_output_to_trie(st: SparseStateTrie, out,
+                         hasher=keccak256_batch_np) -> bytes:
+    """Apply a BlockExecutionOutput's state delta to the sparse state trie
+    and return the recomputed root. Raises BlindedNodeError when an edit
+    needs an unrevealed path (witness generation catches it to close the
+    witness; stateless validation treats it as an incomplete witness)."""
+    # storage wipes reset the trie (SELFDESTRUCT / re-created accounts)
+    for a in out.changes.wiped_storage:
+        st.storage_tries[keccak256(a)] = SparseTrie()
+    # storage writes
+    storage_roots: dict[bytes, bytes] = {}
+    for a, slots in out.post_storage.items():
+        ha = keccak256(a)
+        stg = st.storage_trie(ha)
+        try:
+            for slot, val in slots.items():
+                hs = keccak256(slot)
+                if val == 0:
+                    stg.delete(hs)
+                else:
+                    stg.update(hs, rlp_encode(encode_int(val)))
+            storage_roots[a] = stg.root_hash_compute(hasher)
+        except BlindedNodeError as e:
+            e.owner = ha  # which storage trie needs the reveal
+            raise
+    for a in out.changes.wiped_storage:
+        if a not in storage_roots:
+            storage_roots[a] = st.storage_tries[keccak256(a)].root_hash_compute(hasher)
+    # account writes: compose leaves with the recomputed storage roots
+    touched = set(out.post_accounts) | set(storage_roots)
+    for a in sorted(touched):
+        ha = keccak256(a)
+        if a in out.post_accounts:
+            acct = out.post_accounts[a]
+            if acct is None:
+                st.remove_account(ha)
+                continue
+        else:  # storage-only change: account fields come from the parent leaf
+            leaf = st.account_trie.get(ha)
+            if leaf is None:
+                continue  # storage of a deleted account
+            acct = _decode_account_leaf(leaf)
+        sroot = storage_roots.get(a)
+        if sroot is None:
+            prior = st.account_trie.get(ha)
+            sroot = (_decode_account_leaf(prior).storage_root
+                     if prior is not None else Account().storage_root)
+        st.update_account(ha, replace(acct, storage_root=sroot).trie_encode())
+    return st.account_trie.root_hash_compute(hasher)
+
+
+class StatelessChain:
+    """Validate consecutive blocks statelessly, preserving the sparse trie
+    across blocks (reference PreservedSparseTrie semantics)."""
+
+    def __init__(self, config=None, hasher=keccak256_batch_np):
+        self.config = config
+        self.hasher = hasher
+        self.preserved = PreservedSparseTrie()
+
+    def validate(self, block: Block, witness, parent_header: Header) -> bytes:
+        """Re-execute ``block`` purely from ``witness``; returns the
+        computed state root or raises StatelessValidationError."""
+        if block.header.parent_hash != parent_header.hash:
+            raise StatelessValidationError("witness parent mismatch")
+        st = self.preserved.take(block.header.parent_hash)
+        if st is None:
+            st = SparseStateTrie.anchored(parent_header.state_root)
+        st.reveal_account(witness.state)
+        src = WitnessStateSource(st, witness.state, witness.codes)
+        hashes = {parent_header.number: parent_header.hash}
+        for raw in witness.headers:
+            h = Header.decode(raw)
+            hashes[h.number] = h.hash
+        executor = BlockExecutor(src, self.config)
+        try:
+            senders = [tx.recover_sender() for tx in block.transactions]
+            out = executor.execute(block, senders, hashes)
+            root = apply_output_to_trie(st, out, self.hasher)
+        except BlindedNodeError as e:
+            raise StatelessValidationError(
+                f"witness incomplete: blinded path {e.path.hex()}") from e
+        if root != block.header.state_root:
+            raise StatelessValidationError(
+                f"stateless root mismatch: computed {root.hex()} header "
+                f"{block.header.state_root.hex()}")
+        if out.gas_used != block.header.gas_used:
+            raise StatelessValidationError("gas used mismatch")
+        self.preserved.preserve(block.header.hash, st)
+        return root

@@ -1,0 +1,166 @@
+"""Execution witness generation: everything needed to re-execute a block
+statelessly against its parent.
+
+Reference analogue: `debug_executionWitness`
+(crates/rpc/rpc/src/debug.rs), the invalid-block witness hook
+(crates/engine/invalid-block-hooks/src/witness.rs), and revm's witness
+recording (crates/revm/src/witness.rs). Format follows the reference's
+ExecutionWitness: `state` (parent-state trie nodes), `codes` (touched
+bytecodes), `keys` (touched preimages), `headers` (RLP ancestor headers
+for BLOCKHASH + the parent).
+
+The witness is CLOSED under trie edits: after collecting the touched-key
+multiproof, the block's state delta is applied to a sparse trie revealed
+from it; any `BlindedNodeError` (a delete collapsing into an unrevealed
+sibling) reveals that path from the parent view and adds it to the
+witness, so a stateless validator can replay the block without a state
+source (reference sparse-trie reveal-on-demand, done ahead of time here
+because the consumer has nobody to ask).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..evm.executor import BlockExecutor, StateSource
+from ..primitives.keccak import keccak256
+from ..primitives.types import Block, Header
+from ..trie.proof import ProofCalculator
+from ..trie.sparse import BlindedNodeError, SparseStateTrie
+from .stateless import apply_output_to_trie
+
+
+@dataclass
+class ExecutionWitness:
+    """Self-contained stateless re-execution input for one block."""
+
+    state: list[bytes] = field(default_factory=list)    # trie node RLPs
+    codes: list[bytes] = field(default_factory=list)    # bytecodes
+    keys: list[bytes] = field(default_factory=list)     # address/slot preimages
+    headers: list[bytes] = field(default_factory=list)  # RLP headers
+
+    def to_json(self) -> dict:
+        return {
+            "state": ["0x" + n.hex() for n in self.state],
+            "codes": ["0x" + c.hex() for c in self.codes],
+            "keys": ["0x" + k.hex() for k in self.keys],
+            "headers": ["0x" + h.hex() for h in self.headers],
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "ExecutionWitness":
+        unhex = lambda x: bytes.fromhex(x[2:] if x.startswith("0x") else x)  # noqa: E731
+        return cls(
+            state=[unhex(n) for n in obj.get("state", [])],
+            codes=[unhex(c) for c in obj.get("codes", [])],
+            keys=[unhex(k) for k in obj.get("keys", [])],
+            headers=[unhex(h) for h in obj.get("headers", [])],
+        )
+
+
+class RecordingStateSource(StateSource):
+    """Wraps a provider view, recording every read the EVM makes."""
+
+    def __init__(self, provider):
+        self.provider = provider
+        self.addresses: set[bytes] = set()
+        self.slots: dict[bytes, set[bytes]] = {}
+        self.code_hashes: set[bytes] = set()
+
+    def account(self, address: bytes):
+        self.addresses.add(address)
+        return self.provider.account(address)
+
+    def storage(self, address: bytes, slot: bytes) -> int:
+        self.addresses.add(address)
+        self.slots.setdefault(address, set()).add(slot)
+        return self.provider.storage(address, slot)
+
+    def bytecode(self, code_hash: bytes) -> bytes:
+        self.code_hashes.add(code_hash)
+        return self.provider.bytecode(code_hash) or b""
+
+
+def generate_witness(parent_provider, block: Block, committer,
+                     senders: list[bytes] | None = None,
+                     parent_header: Header | None = None,
+                     config=None,
+                     block_hashes: dict[int, bytes] | None = None) -> ExecutionWitness:
+    """Execute ``block`` against the parent view, recording reads, and
+    assemble a closed witness. ``parent_provider`` must present the state
+    AS OF the parent block (trie tables + hashed/plain state);
+    ``block_hashes`` supplies the BLOCKHASH window when the parent view
+    (e.g. a historical provider) cannot."""
+    src = RecordingStateSource(parent_provider)
+    executor = BlockExecutor(src, config)
+    if senders is None:
+        senders = [tx.recover_sender() for tx in block.transactions]
+    # BLOCKHASH window served (and recorded) from canonical headers
+    hashes: dict[int, bytes] = dict(block_hashes or {})
+    headers: list[bytes] = []
+    if parent_header is not None:
+        headers.append(parent_header.encode())
+    if not hashes and hasattr(parent_provider, "canonical_hash"):
+        lo = max(0, block.header.number - 256)
+        for n in range(lo, block.header.number):
+            h = parent_provider.canonical_hash(n)
+            if h is not None:
+                hashes[n] = h
+    out = executor.execute(block, senders, hashes)
+
+    # the executor also writes: fee recipient, withdrawals, created/deleted
+    touched = set(src.addresses) | set(out.post_accounts)
+    slots = {a: set(s) for a, s in src.slots.items()}
+    for a, ps in out.post_storage.items():
+        slots.setdefault(a, set()).update(ps)
+    targets = {a: sorted(slots.get(a, ())) for a in sorted(touched)}
+
+    calc = ProofCalculator(parent_provider, committer)
+    proofs = calc.multiproof(targets)
+    nodes: dict[bytes, bytes] = {}
+    for ap in proofs.values():
+        for n in ap.proof:
+            nodes[keccak256(n)] = n
+        for sp in ap.storage_proofs:
+            for n in sp.proof:
+                nodes[keccak256(n)] = n
+
+    # close the witness under the block's own trie edits: reveal, apply,
+    # and feed back any sibling paths a collapse needs
+    parent_root = (parent_header.state_root if parent_header is not None
+                   else parent_provider.header_by_number(
+                       block.header.number - 1).state_root)
+    for _attempt in range(64):
+        st = SparseStateTrie.anchored(parent_root)
+        all_nodes = list(nodes.values())
+        st.reveal_account(all_nodes)
+        for a in targets:
+            ap = proofs.get(a)
+            if ap is not None and ap.account is not None:
+                st.reveal_storage(keccak256(a), ap.storage_root, all_nodes)
+        try:
+            apply_output_to_trie(st, out, committer.hasher)
+            break
+        except BlindedNodeError as e:
+            extra = (calc.storage_spine_for_path(e.owner, e.path)
+                     if e.owner is not None else calc.spine_for_path(e.path))
+            new = False
+            for n in extra:
+                if keccak256(n) not in nodes:
+                    nodes[keccak256(n)] = n
+                    new = True
+            if not new:
+                raise  # witness cannot be closed; bail loudly
+    codes = []
+    seen = set()
+    for ch in src.code_hashes:
+        code = parent_provider.bytecode(ch)
+        if code and ch not in seen:
+            seen.add(ch)
+            codes.append(code)
+    keys = [a for a in targets]
+    for a in targets:
+        keys.extend(targets[a])
+    return ExecutionWitness(
+        state=list(nodes.values()), codes=codes, keys=keys, headers=headers,
+    )

@@ -569,6 +569,16 @@ class MegaFusedEngine(FusedLevelEngine):
             self._i32_off += a.size
         return off
 
+    @staticmethod
+    def _filter_triples(triples, lo: int, hi: int):
+        """Select (row, coord, src) triples with lo <= row < hi, rebased."""
+        if triples is None:
+            return None
+        m = (triples[0] >= lo) & (triples[0] < hi)
+        if not m.any():
+            return None
+        return np.stack((triples[0][m] - lo, triples[1][m], triples[2][m]))
+
     def dispatch_packed(self, flat, row_off, row_len, slots, holes, b_tier) -> None:
         n = len(row_off)
         if n == 0:
@@ -580,17 +590,11 @@ class MegaFusedEngine(FusedLevelEngine):
             cap = self._MAX_ROWS - 1
             for lo in range(0, n, cap):
                 hi = min(lo + cap, n)
-                sub_holes = None
-                if holes is not None:
-                    m = (holes[0] >= lo) & (holes[0] < hi)
-                    if m.any():
-                        sub_holes = np.stack(
-                            (holes[0][m] - lo, holes[1][m], holes[2][m]))
                 base = int(row_off[lo])
                 end = int(row_off[hi - 1] + row_len[hi - 1])
                 self.dispatch_packed(
                     flat[base:end], row_off[lo:hi] - base, row_len[lo:hi],
-                    slots[lo:hi], sub_holes, b_tier)
+                    slots[lo:hi], self._filter_triples(holes, lo, hi), b_tier)
             return
         # tight staging + one explicit padding row (the hole dump target)
         row_len_p = np.zeros((n + 1,), dtype="<u2")
@@ -622,13 +626,8 @@ class MegaFusedEngine(FusedLevelEngine):
             cap = self._MAX_ROWS - 1
             for lo in range(0, n, cap):
                 hi = min(lo + cap, n)
-                sub = None
-                if children is not None:
-                    m = (children[0] >= lo) & (children[0] < hi)
-                    if m.any():
-                        sub = np.stack(
-                            (children[0][m] - lo, children[1][m], children[2][m]))
-                self.dispatch_branch(masks[lo:hi], slots[lo:hi], sub)
+                self.dispatch_branch(masks[lo:hi], slots[lo:hi],
+                                     self._filter_triples(children, lo, hi))
             return
         masks_p = np.zeros((n + 1,), dtype="<u2")
         masks_p[:n] = masks

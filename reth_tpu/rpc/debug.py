@@ -222,6 +222,47 @@ class DebugApi:
             gas_left_in_block, tracer=tracer,
         )
 
+    def debug_executionWitness(self, tag):
+        """Everything needed to re-execute the block statelessly: parent
+        trie nodes, bytecodes, touched keys, ancestor headers (reference
+        debug_executionWitness, crates/rpc/rpc/src/debug.rs)."""
+        from ..engine.witness import generate_witness
+        from ..evm import EvmConfig
+        from .server import RpcError
+
+        p = self.eth._provider()
+        n = self.eth._resolve_number(tag, p)
+        block = p.block_by_number(n)
+        if block is None or n == 0:
+            raise RpcError(-32000, "unknown block (or genesis)")
+        parent_header = p.header_by_number(n - 1)
+        # the parent view needs TRIE tables (proof generation), so it comes
+        # from the engine tree's overlay chain, not the historical
+        # reconstruction (which only rebuilds plain state)
+        try:
+            parent_state = self.eth.tree.overlay_provider(parent_header.hash)
+        except KeyError:
+            raise RpcError(
+                -32000,
+                "witness parent below the in-memory window (trie state "
+                "for deep history is not reconstructible)") from None
+        idx = p.block_body_indices(n)
+        senders = [
+            p.sender(idx.first_tx_num + i) or block.transactions[i].recover_sender()
+            for i in range(len(block.transactions))
+        ]
+        hashes = {}
+        for k in range(max(0, n - 256), n):
+            bh = p.canonical_hash(k)
+            if bh:
+                hashes[k] = bh
+        w = generate_witness(
+            parent_state, block, self.eth.tree.committer, senders,
+            parent_header, EvmConfig(chain_id=self.eth.chain_id),
+            block_hashes=hashes,
+        )
+        return w.to_json()
+
     def debug_getRawHeader(self, tag):
         from .convert import data
 

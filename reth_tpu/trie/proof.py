@@ -106,6 +106,30 @@ class ProofCalculator:
                 ))
         return out
 
+    def spine_for_path(self, path: Nibbles) -> list[bytes]:
+        """Account-trie spine through an arbitrary nibble path (used to
+        reveal a blinded node during witness closure — the path is padded
+        to a full key so the spine passes through the blinded node)."""
+        return self._spine_for_path(
+            self.provider.account_branch, self._inc._scan_account_leaves, path)
+
+    def storage_spine_for_path(self, hashed_addr: bytes,
+                               path: Nibbles) -> list[bytes]:
+        """Storage-trie spine through an arbitrary nibble path."""
+        return self._spine_for_path(
+            lambda p: self.provider.storage_branch(hashed_addr, p),
+            lambda ranges: self._inc._scan_storage_leaves(hashed_addr, ranges),
+            path)
+
+    def _spine_for_path(self, branch_fn, leaf_scan, path: Nibbles) -> list[bytes]:
+        full = bytes(path) + b"\x00" * (64 - len(path))
+        plan = plan_subtrie(branch_fn, PrefixSet([full]))
+        res = self.committer.commit_many(
+            [(leaf_scan(plan.dirty_ranges), dict(plan.boundaries))],
+            collect_branches=False, proof_targets=[[full]],
+        )[0]
+        return _spine_nodes(res.proof_nodes, full)
+
     def _storage_value(self, hashed_addr: bytes, hashed_slot: bytes) -> int:
         from ..storage import tables as T
 

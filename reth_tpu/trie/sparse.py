@@ -1,0 +1,516 @@
+"""Proof-revealed sparse MPT + the cross-block preserved trie cache.
+
+Reference analogue: crates/trie/sparse (`SparseStateTrie`,
+`ArenaParallelSparseTrie`, `SerialSparseTrie`) and chain-state's
+`PreservedSparseTrie` (crates/chain-state/src/preserved_sparse_trie.rs:15).
+The reference reveals multiproof nodes into an in-memory partial trie at
+the live tip, applies the payload's state updates to it, re-hashes only
+dirty subtrees (rayon keccak, arena/mod.rs:2500-2548), and preserves the
+anchored trie across consecutive payloads so each block only reveals the
+paths it newly touches.
+
+TPU-first redesign: the structure walk (reveal/update/delete — pointer
+work) stays on host, but re-hashing is LEVEL-BATCHED exactly like the
+committer — dirty nodes are grouped by depth and each depth hashes in one
+batched keccak call (device-dispatchable), instead of the reference's
+per-node sequential keccak inside a rayon worker. Clean subtrees keep
+their cached refs, so cross-block reuse skips both structure and hashing
+work for untouched paths.
+
+Blinded nodes: paths the proofs never revealed. Reading through or
+collapsing into one raises ``BlindedNodeError`` carrying the nibble path,
+so a caller holding a proof source (the engine strategy, stateless
+executors) can reveal exactly that path and retry — the reference's
+reveal-on-demand loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..primitives.keccak import keccak256, keccak256_batch_np
+from ..primitives.nibbles import (
+    Nibbles,
+    common_prefix_len,
+    decode_path,
+    unpack_nibbles,
+)
+from ..primitives.rlp import rlp_decode
+from ..primitives.types import EMPTY_ROOT_HASH
+from .node import (
+    EMPTY_STRING_RLP,
+    branch_node_rlp,
+    encode_hash_ref,
+    extension_node_rlp,
+    leaf_node_rlp,
+)
+
+
+class BlindedNodeError(Exception):
+    """Traversal hit an unrevealed subtree; ``path`` names the blinded
+    node so the caller can fetch a proof for it and retry."""
+
+    def __init__(self, path: Nibbles, msg: str = ""):
+        super().__init__(msg or f"blinded node at {path.hex()}")
+        self.path = path
+        # hashed address of the storage trie the blind was hit in (set by
+        # state-level callers); None = the account trie
+        self.owner: bytes | None = None
+
+
+# -- node objects -------------------------------------------------------------
+# Kept as small Python objects (host pointer work); only hashing batches.
+
+
+class _Blind:
+    __slots__ = ("hash",)
+
+    def __init__(self, h: bytes):
+        self.hash = h
+
+
+class _Leaf:
+    __slots__ = ("path", "value", "_ref")
+
+    def __init__(self, path: Nibbles, value: bytes):
+        self.path = path
+        self.value = value
+        self._ref = None  # cached RLP ref while clean
+
+
+class _Ext:
+    __slots__ = ("path", "child", "_ref")
+
+    def __init__(self, path: Nibbles, child):
+        self.path = path
+        self.child = child
+        self._ref = None
+
+
+class _Branch:
+    __slots__ = ("children", "value", "_ref")
+
+    def __init__(self, children=None, value: bytes = b""):
+        self.children = children if children is not None else [None] * 16
+        self.value = value
+        self._ref = None
+
+
+def _decode_node(rlp: bytes, by_hash: dict[bytes, bytes]):
+    """Materialize one RLP node, descending into children found in
+    ``by_hash`` (proof set); absent hashed children stay blinded."""
+    items = rlp_decode(rlp)
+    if len(items) == 2:
+        prefix, payload = items
+        nib, is_leaf = decode_path(prefix)
+        if is_leaf:
+            return _Leaf(nib, payload)
+        # extension: payload is a child ref (raw RLP list when inline)
+        return _Ext(nib, _decode_ref(payload, by_hash))
+    assert len(items) == 17, "malformed MPT node"
+    br = _Branch(value=items[16])
+    for i in range(16):
+        if items[i] != b"":
+            br.children[i] = _decode_ref(items[i], by_hash)
+    return br
+
+
+def _decode_ref(ref, by_hash: dict[bytes, bytes]):
+    """A child as it appears inside a parent's decoded RLP: a 32-byte hash
+    string, or an inline (already decoded) list for <32-byte nodes."""
+    if isinstance(ref, list):  # inline child: re-encode to reuse _decode_node
+        from ..primitives.rlp import rlp_encode
+
+        return _decode_node(rlp_encode(ref), by_hash)
+    assert isinstance(ref, bytes)
+    if len(ref) == 32:
+        sub = by_hash.get(ref)
+        if sub is not None:
+            return _decode_node(sub, by_hash)
+        return _Blind(ref)
+    # short raw value used as a ref (shouldn't occur in secure tries)
+    raise ValueError("unexpected short child reference")
+
+
+class SparseTrie:
+    """One partially-revealed secure MPT (account trie or one storage trie)."""
+
+    def __init__(self, root_hash: bytes = EMPTY_ROOT_HASH):
+        self.root_hash = root_hash
+        self.root = None if root_hash == EMPTY_ROOT_HASH else _Blind(root_hash)
+        self.updates = 0  # mutations since last root()
+
+    # -- reveal ---------------------------------------------------------------
+
+    def reveal(self, proof_nodes: list[bytes]) -> None:
+        """Reveal the subtrees reachable from the current root through the
+        given proof nodes (spine nodes of one or more proofs)."""
+        if not proof_nodes:
+            return
+        by_hash = {keccak256(n): n for n in proof_nodes}
+        if self.root is None or isinstance(self.root, _Blind):
+            top = by_hash.get(self.root_hash)
+            if top is None:
+                return  # proof for a different root
+            self.root = _decode_node(top, by_hash)
+            return
+        self.root = self._merge(self.root, by_hash)
+
+    def _merge(self, node, by_hash):
+        if isinstance(node, _Blind):
+            rlp = by_hash.get(node.hash)
+            return _decode_node(rlp, by_hash) if rlp is not None else node
+        if isinstance(node, _Ext):
+            node.child = self._merge(node.child, by_hash)
+        elif isinstance(node, _Branch):
+            for i, c in enumerate(node.children):
+                if c is not None:
+                    node.children[i] = self._merge(c, by_hash)
+        return node
+
+    # -- read -----------------------------------------------------------------
+
+    def get(self, key: bytes):
+        """Value for a 32-byte hashed key; None when provably absent."""
+        nib = unpack_nibbles(key)
+        node, depth = self.root, 0
+        while True:
+            if node is None:
+                return None
+            if isinstance(node, _Blind):
+                raise BlindedNodeError(nib[:depth])
+            if isinstance(node, _Leaf):
+                return node.value if node.path == nib[depth:] else None
+            if isinstance(node, _Ext):
+                if nib[depth:depth + len(node.path)] != node.path:
+                    return None
+                depth += len(node.path)
+                node = node.child
+                continue
+            node = node.children[nib[depth]]
+            depth += 1
+
+    # -- write ----------------------------------------------------------------
+
+    def update(self, key: bytes, value: bytes) -> None:
+        nib = unpack_nibbles(key)
+        self.root = self._insert(self.root, nib, 0, value)
+        self.updates += 1
+
+    def delete(self, key: bytes) -> None:
+        nib = unpack_nibbles(key)
+        self.root = self._remove(self.root, nib, 0)
+        self.updates += 1
+
+    def _insert(self, node, nib: Nibbles, depth: int, value: bytes):
+        if node is None:
+            return _Leaf(nib[depth:], value)
+        if isinstance(node, _Blind):
+            raise BlindedNodeError(nib[:depth])
+        node._ref = None  # path dirties
+        if isinstance(node, _Leaf):
+            rem = nib[depth:]
+            if node.path == rem:
+                node.value = value
+                return node
+            return self._split(node.path, node, rem, _Leaf(b"", value))
+        if isinstance(node, _Ext):
+            rem = nib[depth:]
+            common = _common_len(node.path, rem)
+            if common == len(node.path):
+                node.child = self._insert(node.child, nib, depth + common, value)
+                return node
+            return self._split(node.path, node, rem, _Leaf(b"", value),
+                               common)
+        idx = nib[depth]
+        node.children[idx] = self._insert(node.children[idx], nib, depth + 1,
+                                          value)
+        return node
+
+    @staticmethod
+    def _strip(node, by: int):
+        """Drop ``by`` leading nibbles from a leaf/ext's remaining path."""
+        node.path = node.path[by:]
+        return node
+
+    def _split(self, old_path: Nibbles, old_node, new_path: Nibbles, new_leaf,
+               common: int | None = None):
+        """Diverge two paths into (optional ext →) branch."""
+        if common is None:
+            common = _common_len(old_path, new_path)
+        branch = _Branch()
+        old = self._strip(old_node, common + 1) if len(old_path) > common \
+            else old_node
+        if len(old_path) == common:
+            # old path exhausted at the branch: only valid for leaf (value
+            # in branch slot 16) — extensions always have a next nibble
+            assert isinstance(old_node, _Leaf)
+            branch.value = old_node.value
+        else:
+            child = old
+            if isinstance(child, _Ext) and len(child.path) == 0:
+                child = child.child  # ext with empty path collapses
+            branch.children[old_path[common]] = child
+        if len(new_path) == common:
+            branch.value = new_leaf.value
+        else:
+            new_leaf.path = new_path[common + 1:]
+            branch.children[new_path[common]] = new_leaf
+        if common:
+            return _Ext(old_path[:common], branch)
+        return branch
+
+    def _remove(self, node, nib: Nibbles, depth: int):
+        if node is None:
+            return None
+        if isinstance(node, _Blind):
+            raise BlindedNodeError(nib[:depth])
+        node._ref = None
+        if isinstance(node, _Leaf):
+            return None if node.path == nib[depth:] else node
+        if isinstance(node, _Ext):
+            if nib[depth:depth + len(node.path)] != node.path:
+                return node
+            node.child = self._remove(node.child, nib, depth + len(node.path))
+            if node.child is None:
+                return None
+            return self._collapse_ext(node, nib, depth)
+        idx = nib[depth]
+        node.children[idx] = self._remove(node.children[idx], nib, depth + 1)
+        return self._collapse_branch(node, nib, depth)
+
+    def _collapse_ext(self, ext: _Ext, nib: Nibbles, depth: int):
+        child = ext.child
+        if isinstance(child, _Ext):
+            child._ref = None
+            child.path = ext.path + child.path
+            return child
+        if isinstance(child, _Leaf):
+            child._ref = None
+            child.path = ext.path + child.path
+            return child
+        return ext
+
+    def _collapse_branch(self, br: _Branch, nib: Nibbles, depth: int):
+        live = [(i, c) for i, c in enumerate(br.children) if c is not None]
+        if br.value:
+            if live:
+                return br
+            return _Leaf(b"", br.value)
+        if len(live) > 1:
+            return br
+        if not live:
+            return None
+        idx, child = live[0]
+        # merging needs the child's structure: a blinded survivor must be
+        # revealed first (the engine strategy reveals and retries)
+        if isinstance(child, _Blind):
+            raise BlindedNodeError(nib[:depth] + bytes([idx]),
+                                   "collapse into blinded sibling")
+        child._ref = None
+        if isinstance(child, _Leaf):
+            child.path = bytes([idx]) + child.path
+            return child
+        if isinstance(child, _Ext):
+            child.path = bytes([idx]) + child.path
+            return child
+        return _Ext(bytes([idx]), child)
+
+    # -- hashing --------------------------------------------------------------
+
+    def root_hash_compute(self, hasher=keccak256_batch_np) -> bytes:
+        """Level-batched rehash of dirty subtrees: one batched keccak call
+        per depth level (the device dispatch seam), cached refs for clean
+        subtrees (the cross-block reuse)."""
+        if self.root is None:
+            self.root_hash = EMPTY_ROOT_HASH
+            self.updates = 0
+            return self.root_hash
+        if isinstance(self.root, _Blind):
+            self.root_hash = self.root.hash
+            return self.root_hash
+        # collect dirty nodes by depth (a node is dirty iff _ref is None)
+        levels: dict[int, list] = {}
+
+        def collect(node, depth):
+            if isinstance(node, _Blind) or node is None:
+                return
+            if getattr(node, "_ref", None) is not None:
+                return  # clean subtree: ref cached
+            levels.setdefault(depth, []).append(node)
+            if isinstance(node, _Ext):
+                collect(node.child, depth + 1)
+            elif isinstance(node, _Branch):
+                for c in node.children:
+                    collect(c, depth + 1)
+
+        collect(self.root, 0)
+        for depth in sorted(levels, reverse=True):
+            rlps, nodes = [], []
+            for node in levels[depth]:
+                rlp = self._encode(node)
+                if len(rlp) < 32:
+                    node._ref = rlp  # inline ref
+                else:
+                    rlps.append(rlp)
+                    nodes.append(node)
+            if rlps:
+                digests = hasher(rlps)
+                for node, d in zip(nodes, digests):
+                    node._ref = encode_hash_ref(bytes(d))
+        top = self._encode(self.root)
+        self.root_hash = keccak256(top)
+        self.updates = 0
+        return self.root_hash
+
+    def _encode(self, node) -> bytes:
+        if isinstance(node, _Leaf):
+            return leaf_node_rlp(node.path, node.value)
+        if isinstance(node, _Ext):
+            return extension_node_rlp(node.path, self._child_ref(node.child))
+        assert isinstance(node, _Branch)
+        refs = [self._child_ref(c) if c is not None else EMPTY_STRING_RLP
+                for c in node.children]
+        return branch_node_rlp(refs, node.value)
+
+    def _child_ref(self, child) -> bytes:
+        if isinstance(child, _Blind):
+            return encode_hash_ref(child.hash)
+        assert child._ref is not None, "child not hashed (collect order bug)"
+        return child._ref
+
+    def spine(self, key: bytes) -> list[bytes]:
+        """The RLP nodes along ``key``'s path (a single-key proof). Valid
+        after ``root_hash_compute`` (refs must be clean); used by witness
+        generation and the collapse-retry reveal loop."""
+        out = []
+        nib = unpack_nibbles(key)
+        node, depth = self.root, 0
+        while node is not None and not isinstance(node, _Blind):
+            rlp = self._encode(node)
+            if len(rlp) >= 32:
+                out.append(rlp)
+            if isinstance(node, _Leaf):
+                break
+            if isinstance(node, _Ext):
+                if nib[depth:depth + len(node.path)] != node.path:
+                    break
+                depth += len(node.path)
+                node = node.child
+            else:
+                node = node.children[nib[depth]]
+                depth += 1
+        return out
+
+    # -- introspection --------------------------------------------------------
+
+    def revealed_count(self) -> int:
+        n = 0
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            if node is None or isinstance(node, _Blind):
+                continue
+            n += 1
+            if isinstance(node, _Ext):
+                stack.append(node.child)
+            elif isinstance(node, _Branch):
+                stack.extend(node.children)
+        return n
+
+
+_common_len = common_prefix_len
+
+
+# -- state-level composition --------------------------------------------------
+
+
+@dataclass
+class SparseStateTrie:
+    """Account trie + per-account storage tries, revealed from proofs.
+
+    Reference: crates/trie/sparse/src/state.rs. Keys are HASHED (secure
+    trie); callers pass keccak(address)/keccak(slot).
+    """
+
+    account_trie: SparseTrie = field(default_factory=SparseTrie)
+    storage_tries: dict[bytes, SparseTrie] = field(default_factory=dict)
+
+    @classmethod
+    def anchored(cls, state_root: bytes) -> "SparseStateTrie":
+        return cls(account_trie=SparseTrie(state_root))
+
+    def reveal_account(self, proof_nodes: list[bytes]) -> None:
+        self.account_trie.reveal(proof_nodes)
+
+    def storage_trie(self, hashed_addr: bytes,
+                     storage_root: bytes = EMPTY_ROOT_HASH) -> SparseTrie:
+        st = self.storage_tries.get(hashed_addr)
+        if st is None:
+            st = SparseTrie(storage_root)
+            self.storage_tries[hashed_addr] = st
+        return st
+
+    def reveal_storage(self, hashed_addr: bytes, storage_root: bytes,
+                       proof_nodes: list[bytes]) -> None:
+        st = self.storage_tries.get(hashed_addr)
+        if st is None or (st.root is None and st.root_hash != storage_root):
+            st = SparseTrie(storage_root)
+            self.storage_tries[hashed_addr] = st
+        st.reveal(proof_nodes)
+
+    def update_account(self, hashed_addr: bytes, account_rlp: bytes) -> None:
+        self.account_trie.update(hashed_addr, account_rlp)
+
+    def remove_account(self, hashed_addr: bytes) -> None:
+        self.account_trie.delete(hashed_addr)
+        self.storage_tries.pop(hashed_addr, None)
+
+    def root(self, hasher=keccak256_batch_np) -> bytes:
+        """State root: storage tries hash level-batched ACROSS tries first
+        (one call per depth over every dirty storage trie — the committer's
+        commit_many batching), then the account trie."""
+        # batch across storage tries by depth
+        dirty = [t for t in self.storage_tries.values()
+                 if t.updates or (t.root is not None
+                                  and not isinstance(t.root, _Blind)
+                                  and t.root._ref is None)]
+        # simple composition: each trie's own level batching (tries are
+        # independent; a cross-trie scheduler can merge the per-depth calls)
+        for t in dirty:
+            t.root_hash_compute(hasher)
+        return self.account_trie.root_hash_compute(hasher)
+
+
+class PreservedSparseTrie:
+    """Cross-block sparse-trie cache anchored at the canonical tip.
+
+    Reference: crates/chain-state/src/preserved_sparse_trie.rs:15 — after
+    a payload's state root is computed, the revealed+updated sparse trie is
+    preserved keyed by that block's hash; the next payload building on it
+    takes the trie and only reveals the paths it newly touches. A reorg
+    (parent mismatch) drops the cache.
+    """
+
+    def __init__(self):
+        self._anchor: bytes | None = None
+        self._trie: SparseStateTrie | None = None
+        self.hits = 0
+        self.misses = 0
+
+    def take(self, parent_hash: bytes) -> SparseStateTrie | None:
+        """Claim the preserved trie if it is anchored at ``parent_hash``."""
+        if self._trie is not None and self._anchor == parent_hash:
+            t, self._trie, self._anchor = self._trie, None, None
+            self.hits += 1
+            return t
+        self.misses += 1
+        return None
+
+    def preserve(self, block_hash: bytes, trie: SparseStateTrie) -> None:
+        self._anchor = block_hash
+        self._trie = trie
+
+    def invalidate(self) -> None:
+        self._anchor = None
+        self._trie = None

@@ -331,3 +331,22 @@ def test_error_shapes(node):
     with pytest.raises(RuntimeError, match="insufficient funds"):
         poor = Wallet(0x9999)
         rpc(port, "eth_sendRawTransaction", data(poor.transfer(b"\x01" * 20, 10**18).encode()))
+
+def test_debug_execution_witness_stateless_roundtrip(node):
+    """debug_executionWitness over HTTP feeds a stateless validator that
+    reproduces the block's state root with no database."""
+    from reth_tpu.engine.stateless import StatelessChain
+    from reth_tpu.engine.witness import ExecutionWitness
+    from reth_tpu.evm import EvmConfig
+    from reth_tpu.primitives.types import Block, Header
+
+    n, alice = node
+    port = n.rpc.port
+    rpc(port, "eth_sendRawTransaction", data(alice.transfer(b"\x0b" * 20, 777).encode()))
+    n.miner.mine_block()
+    w = ExecutionWitness.from_json(rpc(port, "debug_executionWitness", "0x1"))
+    assert w.state and w.keys
+    block = Block.decode(parse_data(rpc(port, "debug_getRawBlock", "0x1")))
+    parent = Header.decode(parse_data(rpc(port, "debug_getRawHeader", "0x0")))
+    chain = StatelessChain(config=EvmConfig(chain_id=1))
+    assert chain.validate(block, w, parent) == block.header.state_root

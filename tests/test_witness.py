@@ -1,0 +1,141 @@
+"""Execution witness generation + stateless validation (reference
+debug_executionWitness / invalid-block witness hook / sparse-trie
+strategy, re-executed here with NO state source)."""
+
+import numpy as np
+import pytest
+
+from reth_tpu.consensus import EthBeaconConsensus
+from reth_tpu.engine.stateless import (
+    StatelessChain,
+    StatelessValidationError,
+)
+from reth_tpu.engine.witness import ExecutionWitness, generate_witness
+from reth_tpu.evm import EvmConfig
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256, keccak256_batch_np
+from reth_tpu.stages import Pipeline, default_stages
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import import_chain, init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+# PUSH0 CALLDATALOAD PUSH0 SSTORE STOP — stores calldata word0 at slot 0
+STORE_CODE = bytes.fromhex("5f355f5500")
+
+
+def initcode_for(runtime: bytes) -> bytes:
+    n = len(runtime)
+    return bytes([0x60, n, 0x60, 0x0B, 0x5F, 0x39, 0x60, n, 0x5F, 0xF3]) \
+        + b"\x00" + runtime
+
+
+def build_chain():
+    """Transfers, a contract deploy, storage writes AND a slot zeroing
+    (delete path), across several blocks."""
+    alice = Wallet(0xA11CE)
+    bob = Wallet(0xB0B)
+    builder = ChainBuilder({
+        alice.address: Account(balance=10**21),
+        bob.address: Account(balance=10**21),
+    }, committer=CPU)
+    builder.build_block([alice.transfer(b"\x0c" * 20, 1000)])
+    deploy = alice.deploy(initcode_for(STORE_CODE))
+    builder.build_block([deploy])
+    contract = [a for a, acc in builder.accounts.items()
+                if builder.codes.get(acc.code_hash) == STORE_CODE][0]
+    builder.build_block([
+        alice.call(contract, (0xBEEF).to_bytes(32, "big")),
+        bob.transfer(alice.address, 7),
+    ])
+    # zero the slot: storage delete path
+    builder.build_block([alice.call(contract, (0).to_bytes(32, "big"))])
+    builder.build_block([bob.transfer(b"\x0d" * 20, 55)])
+    return builder
+
+
+def test_witness_closed_and_stateless_chain_validates():
+    builder = build_chain()
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 builder.storage_at_genesis, builder.codes_at_genesis,
+                 committer=CPU)
+    chain = StatelessChain(config=EvmConfig(chain_id=builder.chain_id))
+    consensus = EthBeaconConsensus(CPU)
+    for n in range(1, len(builder.blocks)):
+        block = builder.blocks[n]
+        parent = builder.blocks[n - 1].header
+        # witness from the provider view at n-1 (current tip)
+        with factory.provider() as p:
+            w = generate_witness(p, block, CPU,
+                                 parent_header=parent,
+                                 config=EvmConfig(chain_id=builder.chain_id))
+        # round-trip through the JSON wire form
+        w2 = ExecutionWitness.from_json(w.to_json())
+        root = chain.validate(block, w2, parent)
+        assert root == block.header.state_root
+        # advance the stateful node to n for the next witness
+        import_chain(factory, [block], consensus)
+        Pipeline(factory, default_stages(committer=CPU)).run(n)
+    # the preserved trie chained across all blocks after the first
+    assert chain.preserved.hits == len(builder.blocks) - 2
+
+
+def test_stateless_rejects_tampered_block():
+    builder = build_chain()
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 builder.storage_at_genesis, builder.codes_at_genesis,
+                 committer=CPU)
+    block = builder.blocks[1]
+    parent = builder.genesis
+    with factory.provider() as p:
+        w = generate_witness(p, block, CPU, parent_header=parent,
+                             config=EvmConfig(chain_id=builder.chain_id))
+    # tamper: claim a different state root
+    import dataclasses
+    bad_header = dataclasses.replace(block.header, state_root=b"\xde" * 32)
+    bad_block = dataclasses.replace(block, header=bad_header)
+    chain = StatelessChain(config=EvmConfig(chain_id=builder.chain_id))
+    with pytest.raises(StatelessValidationError, match="root mismatch"):
+        chain.validate(bad_block, w, parent)
+
+
+def test_incomplete_witness_detected():
+    builder = build_chain()
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 builder.storage_at_genesis, builder.codes_at_genesis,
+                 committer=CPU)
+    block = builder.blocks[1]
+    with factory.provider() as p:
+        w = generate_witness(p, block, CPU, parent_header=builder.genesis,
+                             config=EvmConfig(chain_id=builder.chain_id))
+    # drop a state node: validation must fail loudly, not mis-validate
+    assert len(w.state) > 1
+    w.state = w.state[:1]
+    chain = StatelessChain(config=EvmConfig(chain_id=builder.chain_id))
+    with pytest.raises(StatelessValidationError):
+        chain.validate(block, w, builder.genesis)
+
+
+def test_witness_includes_touched_codes():
+    builder = build_chain()
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 builder.storage_at_genesis, builder.codes_at_genesis,
+                 committer=CPU)
+    consensus = EthBeaconConsensus(CPU)
+    import_chain(factory, builder.blocks[1:3], consensus)
+    Pipeline(factory, default_stages(committer=CPU)).run(2)
+    # block 3 calls the contract: its code must ship in the witness
+    block = builder.blocks[3]
+    with factory.provider() as p:
+        w = generate_witness(p, block, CPU,
+                             parent_header=builder.blocks[2].header,
+                             config=EvmConfig(chain_id=builder.chain_id))
+    assert STORE_CODE in w.codes
+    assert any(len(k) == 20 for k in w.keys)      # address preimages
+    assert any(len(k) == 32 for k in w.keys)      # slot preimages
