@@ -222,6 +222,7 @@ def cmd_node(args):
                      p2p_port=args.port if not args.disable_p2p else None,
                      p2p_host=args.addr,
                      discovery=not args.no_discovery,
+                     nat=args.nat,
                      bootnodes=tuple(args.bootnodes.split(",")) if args.bootnodes else (),
                      bootnodes_v5=tuple(args.bootnodes_v5.split(",")) if args.bootnodes_v5 else (),
                      db_backend=args.db_backend,
@@ -290,13 +291,270 @@ def cmd_node(args):
     return 1 if errors else 0
 
 
+def _open_db(args):
+    """Open the datadir's database with the selected backend (reference:
+    the database args shared by every offline command)."""
+    from .storage import MemDb
+
+    Path(args.datadir).mkdir(parents=True, exist_ok=True)
+    backend = getattr(args, "db_backend", "memdb")
+    if backend == "native":
+        from .storage.native import NativeDb
+
+        return NativeDb(Path(args.datadir) / "nativedb")
+    if backend == "paged":
+        from .storage.native import PagedDb
+
+        return PagedDb(Path(args.datadir) / "pageddb")
+    return MemDb(Path(args.datadir) / "db.bin")
+
+
+def cmd_db_get(args):
+    """Print one table entry (reference `reth db get`)."""
+    db = _open_db(args)
+    with db.tx() as tx:
+        key = bytes.fromhex(args.key.removeprefix("0x"))
+        if args.subkey:
+            sub = bytes.fromhex(args.subkey.removeprefix("0x"))
+            entry = tx.cursor(args.table).seek_by_key_subkey(key, sub)
+            val = entry[1] if entry else None
+        else:
+            val = tx.get(args.table, key)
+    if val is None:
+        print("not found", file=sys.stderr)
+        return 1
+    print("0x" + val.hex())
+    return 0
+
+
+def cmd_db_list(args):
+    """List table entries from an offset (reference `reth db list`)."""
+    db = _open_db(args)
+    with db.tx() as tx:
+        cur = tx.cursor(args.table)
+        start = bytes.fromhex(args.start.removeprefix("0x")) if args.start else None
+        shown = 0
+        for key, val in cur.walk(start):
+            print(f"0x{key.hex()}  0x{val.hex()[:2 * args.value_bytes]}"
+                  + ("…" if len(val) > args.value_bytes else ""))
+            shown += 1
+            if shown >= args.limit:
+                break
+        print(f"-- {shown} entr{'y' if shown == 1 else 'ies'} "
+              f"(of {tx.entry_count(args.table)})")
+    return 0
+
+
+def cmd_db_diff(args):
+    """Compare two databases table-by-table (reference `reth db diff`)."""
+    import argparse as _ap
+
+    db_a = _open_db(args)
+    db_b = _open_db(_ap.Namespace(datadir=args.other,
+                                  db_backend=getattr(args, "db_backend", "memdb")))
+    tables = args.table.split(",") if args.table else None
+    differences = 0
+    with db_a.tx() as ta, db_b.tx() as tb:
+        names = tables
+        if names is None:
+            from .storage.tables import TableDef, Tables
+
+            names = sorted(v.name for v in vars(Tables).values()
+                           if isinstance(v, TableDef))
+        for name in names:
+            ca, cb = ta.entry_count(name), tb.entry_count(name)
+            seen = 0
+            # keys only; values compared as whole duplicate sets (DUPSORT
+            # tables hold several values per key)
+            cur = ta.cursor(name)
+            entry = cur.first()
+            while entry is not None:
+                key = entry[0]
+                if ta.get_dups(name, key) != tb.get_dups(name, key):
+                    differences += 1
+                    seen += 1
+                    if seen <= args.limit:
+                        missing = tb.get(name, key) is None
+                        print(f"{name}: 0x{key.hex()} "
+                              f"{'missing' if missing else 'differs'}")
+                entry = cur.next_no_dup()
+            if ca != cb:
+                differences += 1
+                print(f"{name}: entry count {ca} != {cb}")
+    print(f"{differences} difference(s)")
+    return 0 if differences == 0 else 1
+
+
+def cmd_db_repair_trie(args):
+    """Rebuild the trie tables from the hashed state and fix divergences
+    (reference `reth db repair-trie`): verify first, then clear + recompute
+    stored branch nodes so the stored trie matches the leaves."""
+    from .storage import ProviderFactory
+    from .trie.incremental import full_state_root, verify_state_root
+
+    factory = ProviderFactory(_open_db(args))
+    committer = _make_committer(args)
+    with factory.provider() as p:
+        tip = p.stage_checkpoint("MerkleExecute")
+        header = p.header_by_number(tip)
+        if header is None:
+            print("empty database (no merkle checkpoint)", file=sys.stderr)
+            return 1
+        try:
+            root, problems = verify_state_root(p, committer)
+        except Exception as e:  # noqa: BLE001 — corrupt nodes may not decode
+            root, problems = None, [f"verification failed: {e}"]
+        if root == header.state_root and not problems:
+            print(f"trie OK at block {tip}: nothing to repair")
+            return 0
+    for msg in problems:
+        print(f"REPAIRING: {msg}", file=sys.stderr)
+    with factory.provider_rw() as p:
+        from .storage.tables import Tables
+
+        p.tx.clear(Tables.AccountsTrie.name)
+        p.tx.clear(Tables.StoragesTrie.name)
+        new_root = full_state_root(p, committer)
+        if new_root != header.state_root:
+            print(f"REPAIR FAILED: rebuilt 0x{new_root.hex()} != header "
+                  f"0x{header.state_root.hex()} — hashed state itself is bad",
+                  file=sys.stderr)
+            return 1
+    factory.db.flush()
+    print(f"trie repaired at block {tip}: 0x{new_root.hex()}")
+    return 0
+
+
+def cmd_init_state(args):
+    """Initialise a database from a state dump at a given block (reference
+    `reth init-state`: sync-from-state for chains with huge history)."""
+    from .storage import ProviderFactory
+    from .storage.genesis import init_genesis
+    from .primitives.types import Header
+
+    with open(args.state) as f:
+        dump = json.load(f)
+    unhex = lambda x: bytes.fromhex(x.removeprefix("0x"))  # noqa: E731
+    header = Header.decode(unhex(dump["header"]))
+    alloc, storage, codes = {}, {}, {}
+    from .primitives.types import Account
+    from .primitives.keccak import keccak256
+
+    for addr_hex, acct in dump.get("accounts", {}).items():
+        addr = unhex(addr_hex)
+        code = unhex(acct["code"]) if acct.get("code") else b""
+        if code:
+            codes[keccak256(code)] = code
+        alloc[addr] = Account(
+            nonce=int(acct.get("nonce", "0x0"), 16),
+            balance=int(acct.get("balance", "0x0"), 16),
+        )
+        slots = {unhex(k): int(v, 16)
+                 for k, v in acct.get("storage", {}).items()}
+        if slots:
+            storage[addr] = slots
+    factory = ProviderFactory(_open_db(args))
+    committer = _make_committer(args)
+    got = init_genesis(factory, header, alloc, storage, codes,
+                       committer=committer)
+    factory.db.flush()
+    print(f"state initialised at block {header.number}: 0x{got.hex()}")
+    return 0
+
+
+def cmd_test_vectors(args):
+    """Generate deterministic codec/table test vectors (reference
+    `reth test-vectors compact|tables`): random typed values round-tripped
+    through the codecs, written as JSON for cross-version compatibility
+    checks."""
+    import numpy as np
+
+    from .primitives.types import Account, Header
+    from .storage.tables import (
+        decode_account,
+        encode_account,
+        be64,
+        from_be64,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    vectors = {"accounts": [], "headers": [], "be64": []}
+    for _ in range(args.count):
+        acct = Account(
+            nonce=int(rng.integers(0, 2**40)),
+            balance=int(rng.integers(0, 2**60)) * int(rng.integers(1, 2**30)),
+            storage_root=bytes(rng.integers(0, 256, 32, dtype=np.uint8)),
+            code_hash=bytes(rng.integers(0, 256, 32, dtype=np.uint8)),
+        )
+        enc = encode_account(acct)
+        assert decode_account(enc) == acct
+        vectors["accounts"].append("0x" + enc.hex())
+        h = Header(
+            number=int(rng.integers(0, 2**32)),
+            timestamp=int(rng.integers(0, 2**32)),
+            gas_limit=int(rng.integers(0, 2**30)),
+            gas_used=int(rng.integers(0, 2**30)),
+            base_fee_per_gas=int(rng.integers(0, 2**40)),
+            state_root=bytes(rng.integers(0, 256, 32, dtype=np.uint8)),
+        )
+        enc = h.encode()
+        assert Header.decode(enc).hash == h.hash
+        vectors["headers"].append("0x" + enc.hex())
+        n = int(rng.integers(0, 2**63))
+        assert from_be64(be64(n)) == n
+        vectors["be64"].append(n)
+    out = json.dumps(vectors, indent=None)
+    if args.out:
+        Path(args.out).write_text(out)
+        print(f"{args.count} vectors x 3 codecs -> {args.out}")
+    else:
+        print(out)
+    return 0
+
+
+def cmd_config(args):
+    """Print the effective TOML-style config (reference `reth config`)."""
+    from .config import load_config
+
+    cfg = load_config(args.config)
+    lines = [
+        "[stages.merkle]",
+        f"rebuild_threshold = {cfg.stages.merkle.rebuild_threshold}",
+        f"incremental_threshold = {cfg.stages.merkle.incremental_threshold}",
+        "",
+        "[stages.account_hashing]",
+        f"clean_threshold = {cfg.stages.account_hashing.clean_threshold}",
+        "",
+        "[stages.storage_hashing]",
+        f"clean_threshold = {cfg.stages.storage_hashing.clean_threshold}",
+        "",
+        "[stages.execution]",
+        f"max_blocks_per_commit = {cfg.stages.execution.max_blocks_per_commit}",
+        "",
+        "[node]",
+        f"persistence_threshold = {cfg.persistence_threshold}",
+        f'hasher = "{cfg.hasher}"',
+        "",
+        "[prune]",
+    ]
+    for seg in ("sender_recovery", "receipts", "transaction_lookup",
+                "account_history", "storage_history"):
+        mode = getattr(cfg.prune, seg, None)
+        if mode is not None and (mode.distance is not None or mode.before is not None):
+            which = (f"distance = {mode.distance}" if mode.distance is not None
+                     else f"before = {mode.before}")
+            lines.append(f"{seg} = {{ {which} }}")
+    print("\n".join(lines))
+    return 0
+
+
 def cmd_db_verify_trie(args):
     """Recompute the state root from hashed tables; compare with the tip
     header (reference `reth db repair-trie` / trie verify iterator)."""
-    from .storage import MemDb, ProviderFactory
+    from .storage import ProviderFactory
     from .trie.incremental import verify_state_root
 
-    factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
+    factory = ProviderFactory(_open_db(args))
     committer = _make_committer(args)
     with factory.provider() as p:
         # the hashed/trie tables are current as of the MERKLE checkpoint,
@@ -320,12 +578,17 @@ def cmd_db_verify_trie(args):
 
 
 def cmd_db_stats(args):
-    from .storage import MemDb
+    from .storage.tables import Tables
 
-    db = MemDb(Path(args.datadir) / "db.bin")
+    db = _open_db(args)
     tx = db.tx()
     print(f"{'table':<28}{'entries':>12}")
-    for name in sorted(db._tables):
+    from .storage.tables import TableDef
+
+    names = (sorted(db._tables) if hasattr(db, "_tables")
+             else sorted(v.name for v in vars(Tables).values()
+                         if isinstance(v, TableDef)))
+    for name in names:
         print(f"{name:<28}{tx.entry_count(name):>12}")
     return 0
 
@@ -548,13 +811,61 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("db", help="database tools")
     dbsub = p.add_subparsers(dest="db_command", required=True)
+
+    def add_db_args(sp):
+        sp.add_argument("--datadir", required=True)
+        sp.add_argument("--db", dest="db_backend",
+                        choices=["memdb", "native", "paged"], default="memdb")
+
     ps = dbsub.add_parser("stats")
-    ps.add_argument("--datadir", required=True)
+    add_db_args(ps)
     ps.set_defaults(fn=cmd_db_stats)
     pv = dbsub.add_parser("verify-trie")
-    pv.add_argument("--datadir", required=True)
+    add_db_args(pv)
     add_hasher(pv)
     pv.set_defaults(fn=cmd_db_verify_trie)
+    pg = dbsub.add_parser("get", help="print one table entry")
+    add_db_args(pg)
+    pg.add_argument("table")
+    pg.add_argument("key")
+    pg.add_argument("--subkey", default=None)
+    pg.set_defaults(fn=cmd_db_get)
+    pl = dbsub.add_parser("list", help="list table entries")
+    add_db_args(pl)
+    pl.add_argument("table")
+    pl.add_argument("--start", default=None)
+    pl.add_argument("--limit", type=int, default=20)
+    pl.add_argument("--value-bytes", dest="value_bytes", type=int, default=32)
+    pl.set_defaults(fn=cmd_db_list)
+    pd = dbsub.add_parser("diff", help="compare two databases")
+    add_db_args(pd)
+    pd.add_argument("other", help="second datadir")
+    pd.add_argument("--table", default=None, help="comma-separated subset")
+    pd.add_argument("--limit", type=int, default=10)
+    pd.set_defaults(fn=cmd_db_diff)
+    pr2 = dbsub.add_parser("repair-trie", help="rebuild trie tables from hashed state")
+    add_db_args(pr2)
+    add_hasher(pr2)
+    pr2.set_defaults(fn=cmd_db_repair_trie)
+
+    p = sub.add_parser("init-state",
+                       help="initialise from a state dump at a block")
+    p.add_argument("state", help="state dump JSON")
+    p.add_argument("--datadir", required=True)
+    p.add_argument("--db", dest="db_backend",
+                   choices=["memdb", "native", "paged"], default="memdb")
+    add_hasher(p)
+    p.set_defaults(fn=cmd_init_state)
+
+    p = sub.add_parser("test-vectors", help="generate codec test vectors")
+    p.add_argument("--count", type=int, default=100)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None)
+    p.set_defaults(fn=cmd_test_vectors)
+
+    p = sub.add_parser("config", help="print the effective config")
+    p.add_argument("--config", default=None)
+    p.set_defaults(fn=cmd_config)
 
     p = sub.add_parser("stage", help="run a single stage")
     stsub = p.add_subparsers(dest="stage_command", required=True)

@@ -111,6 +111,37 @@ class MetricsRegistry:
 # the global registry (metrics-rs global recorder analogue)
 REGISTRY = MetricsRegistry()
 
+_PROC_START = None
+
+
+def update_process_metrics(registry: MetricsRegistry | None = None) -> None:
+    """Process-level gauges from /proc/self (reference crates/node/metrics
+    process collector: RSS, CPU time, fds, threads, uptime). Called at
+    scrape time by the /metrics endpoint; silently a no-op off-Linux."""
+    global _PROC_START
+    reg = registry or REGISTRY
+    import os
+    import time as _t
+
+    if _PROC_START is None:
+        _PROC_START = _t.time()
+    reg.gauge("process_uptime_seconds").set(round(_t.time() - _PROC_START, 1))
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        reg.gauge("process_resident_memory_bytes").set(
+            pages * os.sysconf("SC_PAGE_SIZE"))
+        with open("/proc/self/stat") as f:
+            parts = f.read().rsplit(")", 1)[1].split()
+        tck = os.sysconf("SC_CLK_TCK")
+        # fields (post-comm): utime=11 stime=12 num_threads=17 (0-based)
+        reg.gauge("process_cpu_seconds_total").set(
+            round((int(parts[11]) + int(parts[12])) / tck, 2))
+        reg.gauge("process_threads").set(int(parts[17]))
+        reg.gauge("process_open_fds").set(len(os.listdir("/proc/self/fd")))
+    except (OSError, IndexError, ValueError):
+        pass
+
 
 class TrieMetrics:
     """TrieTracker analogue (reference crates/trie metrics): per-commit

@@ -38,6 +38,7 @@ class NetworkManager:
         self.status = status
         self.pool = pool
         self.host = host
+        self.advertised_host: str | None = None  # NAT-resolved external IP
         self.port = port
         self.node_priv = node_priv or random_node_key()
         # EIP-2124 ForkFilter: reject peers on an incompatible fork during
@@ -66,8 +67,9 @@ class NetworkManager:
 
     @property
     def enode(self) -> str:
+        host = self.advertised_host or self.host
         return (f"enode://{rlpx_node_id(self.node_priv).hex()}"
-                f"@{self.host}:{self.port}")
+                f"@{host}:{self.port}")
 
     def connect_to(self, enode_url: str, timeout: float = 10.0) -> PeerConnection:
         """Dial a peer by enode URL (encrypted RLPx session)."""

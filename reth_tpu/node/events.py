@@ -1,0 +1,103 @@
+"""Human log dashboard of node progress events.
+
+Reference analogue: crates/node/events/src/node.rs — the periodic
+"Status" / "Block added" INFO lines operators actually read: canonical
+tip, throughput since the last report, txpool depth, peer count, and
+stage progress during sync. Events arrive over an `EventSender` broadcast
+(events.py); a reporter thread coalesces them into one line per interval
+instead of one per block.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..events import EventSender
+from ..tracing import tracer
+
+log = tracer("node::events")
+
+
+@dataclass
+class CanonUpdate:
+    number: int
+    hash: bytes
+    txs: int
+    gas_used: int
+
+
+class NodeEventReporter:
+    """Coalescing progress reporter over the node's event stream."""
+
+    def __init__(self, node, interval: float = 10.0):
+        self.node = node
+        self.interval = interval
+        self.sender = EventSender()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # window accumulators
+        self._lock = threading.Lock()
+        self._blocks = 0
+        self._txs = 0
+        self._gas = 0
+        self._tip: CanonUpdate | None = None
+
+    # -- event intake ---------------------------------------------------------
+
+    def on_canon_change(self, chain) -> None:
+        """Installed as an engine canon listener."""
+        if not chain:
+            return
+        tip = chain[-1].block
+        up = CanonUpdate(tip.header.number, tip.header.hash,
+                         len(tip.transactions), tip.header.gas_used)
+        with self._lock:
+            self._blocks += len(chain)
+            self._txs += sum(len(eb.block.transactions) for eb in chain)
+            self._gas += sum(eb.block.header.gas_used for eb in chain)
+            self._tip = up
+        self.sender.notify(up)
+
+    # -- reporting ------------------------------------------------------------
+
+    def _snapshot(self):
+        with self._lock:
+            out = (self._blocks, self._txs, self._gas, self._tip)
+            self._blocks = self._txs = self._gas = 0
+            self._tip = None
+            return out
+
+    def report_once(self) -> str | None:
+        blocks, txs, gas, tip = self._snapshot()
+        if tip is None:
+            return None
+        pool = getattr(self.node, "pool", None)
+        net = getattr(self.node, "network", None)
+        pool_n = len(pool) if pool is not None else 0
+        peer_n = len(net.peers) if net is not None else 0
+        mgas = gas / 1e6
+        line = (f"Canonical chain advanced  number={tip.number} "
+                f"hash=0x{tip.hash.hex()[:16]}… blocks={blocks} txs={txs} "
+                f"mgas={mgas:.2f} pool={pool_n} peers={peer_n}")
+        log.info(line)
+        return line
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.report_once()
+            except Exception:  # noqa: BLE001 — reporting must never kill the node
+                pass
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="node-events")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.sender.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -50,6 +50,7 @@ class NodeConfig:
     # devp2p: RLPx listener + discv4 discovery (None disables networking)
     p2p_port: int | None = None       # 0 = ephemeral
     p2p_host: str = "127.0.0.1"       # bind + advertised address
+    nat: str = "any"                  # any | none | extip:<ip> | upnp | natpmp
     discovery: bool = True
     node_key: int | None = None       # secp256k1 priv; random when unset
     bootnodes: tuple[str, ...] = ()   # enode:// urls
@@ -261,6 +262,12 @@ class Node:
                 chain_spec=config.chain_spec,
                 head_position=(tip_num, tip_header.timestamp if tip_header else 0),
             )
+            # NAT resolution decides the ADVERTISED address (enode/ENR);
+            # binding stays on p2p_host (reference crates/net/nat)
+            from ..net.nat import NatResolver
+
+            self.network.advertised_host = NatResolver.parse(
+                config.nat).external_ip(config.p2p_host)
 
             # keep the advertised Status + ForkFilter anchored to the LIVE
             # head: a node that syncs across a fork boundary must start
@@ -279,6 +286,12 @@ class Node:
                                           tip.hash)
 
             self.tree.canon_listeners.append(_track_head)
+        # human progress dashboard (reference crates/node/events)
+        from .events import NodeEventReporter
+
+        self.event_reporter = NodeEventReporter(self)
+        self.tree.canon_listeners.append(self.event_reporter.on_canon_change)
+
         from ..rpc.admin import AdminApi
 
         self.admin_api = AdminApi(self.network, None, config.chain_id)
@@ -350,6 +363,7 @@ class Node:
     def start_rpc(self) -> tuple[int, int]:
         """Start the RPC transports; returns (http_port, authrpc_port).
         The WS port (when enabled) is at ``self.ws.port`` after this."""
+        self.event_reporter.start()
         ports = self.rpc.start(), self.authrpc.start()
         if self.ws is not None:
             self.ws.start()
@@ -358,6 +372,7 @@ class Node:
         return ports
 
     def stop(self):
+        self.event_reporter.stop()
         self.tasks.graceful_shutdown()
         self.rpc.stop()
         self.authrpc.stop()

@@ -28,11 +28,13 @@ from .server import RpcError
 class EthApi:
     def __init__(self, tree: EngineTree, pool=None, chain_id: int = 1):
         from .gas_oracle import GasPriceOracle
+        from .state_cache import EthStateCache
 
         self.tree = tree
         self.pool = pool
         self.chain_id = chain_id
         self.gas_oracle = GasPriceOracle()
+        self.state_cache = EthStateCache()
 
     # -- helpers ---------------------------------------------------------------
 
@@ -183,14 +185,11 @@ class EthApi:
     def eth_getBlockByNumber(self, tag, full=False):
         p = self._provider()
         n = self._resolve_number(tag, p)
-        block = p.block_by_number(n)
-        if block is None:
+        cached = self.state_cache.block_with_senders(p, n)
+        if cached is None:
             return None
-        idx = p.block_body_indices(n)
-        senders = None
-        if full and idx:
-            senders = [p.sender(t) for t in range(idx.first_tx_num, idx.next_tx_num)]
-        return block_to_rpc(block, full, senders)
+        block, senders = cached
+        return block_to_rpc(block, full, senders if full else None)
 
     def eth_getBlockByHash(self, block_hash, full=False):
         p = self._provider()
@@ -270,22 +269,21 @@ class EthApi:
     def eth_getBlockReceipts(self, tag):
         p = self._provider()
         n = self._resolve_number(tag, p)
-        if p.header_by_number(n) is None:
+        cached = self.state_cache.block_with_senders(p, n)
+        if cached is None:
             return None
-        header = p.header_by_number(n)
-        idx = p.block_body_indices(n)
-        if idx is None or idx.tx_count == 0:
+        block, senders = cached
+        if not block.transactions:
             return []
-        txs = p.transactions_by_block(n)
+        receipts = self.state_cache.receipts(p, n)
+        if receipts is None:
+            return None
         out = []
         log_base = 0
         prev_cum = 0
-        for i, t in enumerate(range(idx.first_tx_num, idx.next_tx_num)):
-            receipt = p.receipt(t)
-            if receipt is None:
-                return None
-            out.append(receipt_to_rpc(receipt, txs[i], header, i, prev_cum,
-                                      p.sender(t), log_base))
+        for i, (tx, receipt) in enumerate(zip(block.transactions, receipts)):
+            out.append(receipt_to_rpc(receipt, tx, block.header, i, prev_cum,
+                                      senders[i], log_base))
             prev_cum = receipt.cumulative_gas_used
             log_base += len(receipt.logs)
         return out

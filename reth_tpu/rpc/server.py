@@ -134,6 +134,9 @@ class RpcServer:
                 if self.path == "/metrics":
                     from ..metrics import REGISTRY
 
+                    from ..metrics import update_process_metrics
+
+                    update_process_metrics()
                     body = REGISTRY.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")

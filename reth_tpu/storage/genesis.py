@@ -31,8 +31,9 @@ def init_genesis(
     """Initialise the database from genesis; returns the genesis hash."""
     committer = committer or TrieCommitter()
     storage = storage or {}
+    base = genesis_header.number  # >0 for init-state (sync-from-state) inits
     with factory.provider_rw() as p:
-        existing = p.canonical_hash(0)
+        existing = p.canonical_hash(base)
         if existing is not None:
             if existing != genesis_header.hash:
                 raise GenesisMismatch(
@@ -64,8 +65,16 @@ def init_genesis(
                 f"{genesis_header.state_root.hex()}"
             )
         p.insert_header(genesis_header)
-        p.tx.put(Tables.BlockBodyIndices.name, (0).to_bytes(8, "big"),
+        p.tx.put(Tables.BlockBodyIndices.name, base.to_bytes(8, "big"),
                  (0).to_bytes(8, "big") * 2)
+        if base > 0:
+            # init-state: the chain below `base` has no data — every stage
+            # starts AT the state block (reference `reth init-state`)
+            for stage in ("Headers", "Bodies", "SenderRecovery", "Execution",
+                          "AccountHashing", "StorageHashing", "MerkleExecute",
+                          "TransactionLookup", "IndexAccountHistory",
+                          "IndexStorageHistory", "Finish"):
+                p.save_stage_checkpoint(stage, base)
         return genesis_header.hash
 
 

@@ -1,0 +1,131 @@
+"""Auxiliary components: event streams, NAT resolution, state cache,
+process metrics, node events dashboard (reference crates/tokio-util,
+crates/net/nat, rpc-eth-types EthStateCache, node/metrics, node/events)."""
+
+import threading
+import time
+
+import pytest
+
+from reth_tpu.events import EventSender
+from reth_tpu.net.nat import NatResolver
+
+
+def test_event_stream_fanout_and_lag():
+    sender = EventSender(buffer=4)
+    a = sender.new_listener()
+    b = sender.new_listener()
+    for i in range(3):
+        sender.notify(i)
+    assert a.next(0) == 0 and a.next(0) == 1 and a.next(0) == 2
+    # b lags: overflow drops its OLDEST events, producer never blocks
+    for i in range(3, 10):
+        sender.notify(i)
+    got = [b.next(0) for _ in range(4)]
+    assert got == [6, 7, 8, 9]
+    assert b.dropped == 6
+    # close wakes blocked consumers with end-of-stream
+    done = []
+
+    def consume():
+        done.extend(list(a))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    sender.notify("last")
+    time.sleep(0.05)
+    sender.close()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert done[-1] == "last"
+
+
+def test_event_stream_unsubscribe():
+    sender = EventSender()
+    s = sender.new_listener()
+    s.unsubscribe()
+    sender.notify("x")
+    assert s.next(0) is None
+
+
+def test_nat_resolver():
+    assert NatResolver.parse("extip:1.2.3.4").external_ip() == "1.2.3.4"
+    with pytest.raises(ValueError):
+        NatResolver.parse("extip:not-an-ip")
+    with pytest.raises(ValueError):
+        NatResolver.parse("bogus")
+    none = NatResolver.parse("none")
+    assert none.external_ip("0.0.0.0") == "127.0.0.1"
+    assert none.external_ip("10.1.2.3") == "10.1.2.3"
+    anyr = NatResolver.parse("any")
+    ip = anyr.external_ip("0.0.0.0")
+    assert ip.count(".") == 3
+    # upnp needs egress: degrades with an explicit reason, never errors
+    up = NatResolver.parse("upnp")
+    assert up.fallback_reason and up.external_ip("0.0.0.0")
+
+
+def test_process_metrics_gauges():
+    from reth_tpu.metrics import MetricsRegistry, update_process_metrics
+
+    reg = MetricsRegistry()
+    update_process_metrics(reg)
+    text = reg.render()
+    assert "process_resident_memory_bytes" in text
+    assert "process_open_fds" in text
+    assert "process_uptime_seconds" in text
+
+
+def test_eth_state_cache_hits_and_reorg_safety():
+    from reth_tpu.primitives import Account
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.rpc.state_cache import EthStateCache
+    from reth_tpu.storage import MemDb, ProviderFactory
+    from reth_tpu.storage.genesis import import_chain, init_genesis
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    CPU = TrieCommitter(hasher=keccak256_batch_np)
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    builder.build_block([alice.transfer(b"\x0b" * 20, 5)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis, committer=CPU)
+    import_chain(factory, builder.blocks[1:])
+    from reth_tpu.stages import Pipeline, default_stages
+
+    Pipeline(factory, default_stages(committer=CPU)).run(1)
+    cache = EthStateCache(max_blocks=8)
+    with factory.provider() as p:
+        b1, senders = cache.block_with_senders(p, 1)
+        assert b1.header.number == 1 and len(senders) == 1
+        again, _ = cache.block_with_senders(p, 1)
+        assert again is b1  # served from cache
+        rec = cache.receipts(p, 1)
+        assert len(rec) == 1 and cache.receipts(p, 1) is rec
+        assert cache.block_with_senders(p, 99) is None
+
+
+def test_node_event_reporter_line():
+    from types import SimpleNamespace
+
+    from reth_tpu.node.events import NodeEventReporter
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.primitives import Account
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    CPU = TrieCommitter(hasher=keccak256_batch_np)
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    builder.build_block([alice.transfer(b"\x0b" * 20, 5)])
+    fake_node = SimpleNamespace(pool=None, network=None)
+    rep = NodeEventReporter(fake_node, interval=999)
+    eb = SimpleNamespace(block=builder.blocks[1])
+    stream = rep.sender.new_listener()
+    rep.on_canon_change([eb])
+    line = rep.report_once()
+    assert "number=1" in line and "txs=1" in line
+    assert rep.report_once() is None  # window drained
+    ev = stream.next(0)
+    assert ev.number == 1 and ev.txs == 1
