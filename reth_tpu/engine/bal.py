@@ -1,0 +1,374 @@
+"""Block-access-list (BAL) parallel execution.
+
+Reference analogue: EIP-7928 block access lists and the reference's
+BAL-driven parallel execution
+(crates/engine/tree/src/tree/payload_processor/bal/execute.rs): when a
+block's per-transaction access sets are known, non-conflicting
+transactions execute concurrently against the pre-state and their
+journals merge in order; transactions whose actual accesses collide with
+an earlier in-flight write are re-executed serially against the merged
+state. The result is bit-identical to serial execution — the access list
+is an OPTIMIZATION HINT, never trusted for correctness:
+
+* every wave worker re-records its actual reads/writes; the in-order
+  commit validates them against the writes already merged this wave and
+  demotes any collision to a serial re-run;
+* the coinbase priority-fee credit — which would serialize every pair of
+  transactions — is accumulated as a commutative delta through the
+  executor's `_credit_coinbase` seam and applied once per commit; any
+  OTHER coinbase access (BALANCE of the fee recipient, transfers to or
+  from it) marks the transaction coinbase-sensitive and forces it serial.
+
+Scheduling: waves are built greedily from the access list — a
+transaction joins the current wave unless an earlier wave member's write
+set intersects its read∪write set (read-after-write / write-after-write;
+write-after-read is safe because wave members all read the pre-wave
+state and journals merge in transaction order).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from ..evm.executor import BlockExecutor, blob_base_fee
+from ..evm.interpreter import BlockEnv
+from ..evm.state import EvmState, StateSource
+from ..primitives.types import Account, Block, Receipt
+
+
+@dataclass
+class TxAccess:
+    """One transaction's access sets (EIP-7928 per-tx entry)."""
+
+    index: int
+    account_reads: set[bytes] = field(default_factory=set)
+    account_writes: set[bytes] = field(default_factory=set)
+    slot_reads: set[tuple[bytes, bytes]] = field(default_factory=set)
+    slot_writes: set[tuple[bytes, bytes]] = field(default_factory=set)
+    coinbase_sensitive: bool = False
+
+    def conflicts_with_writes(self, other: "TxAccess") -> bool:
+        """True when `other`'s writes feed this tx's reads or writes."""
+        touched_accts = self.account_reads | self.account_writes
+        if other.account_writes & touched_accts:
+            return True
+        touched_slots = self.slot_reads | self.slot_writes
+        return bool(other.slot_writes & touched_slots)
+
+    def to_json(self) -> dict:
+        hx = lambda b: "0x" + b.hex()  # noqa: E731
+        return {
+            "index": self.index,
+            "accountReads": sorted(hx(a) for a in self.account_reads),
+            "accountWrites": sorted(hx(a) for a in self.account_writes),
+            "slotReads": sorted([hx(a), hx(s)] for a, s in self.slot_reads),
+            "slotWrites": sorted([hx(a), hx(s)] for a, s in self.slot_writes),
+        }
+
+
+@dataclass
+class BlockAccessList:
+    """Per-transaction access sets for one block."""
+
+    entries: list[TxAccess] = field(default_factory=list)
+
+    def to_json(self) -> list[dict]:
+        return [e.to_json() for e in self.entries]
+
+
+# -- sources ------------------------------------------------------------------
+
+
+class _RecordingSource(StateSource):
+    """Records the cold reads one transaction pulls through the source."""
+
+    def __init__(self, base: StateSource, acc: TxAccess):
+        self.base = base
+        self.acc = acc
+
+    def account(self, address: bytes):
+        self.acc.account_reads.add(address)
+        return self.base.account(address)
+
+    def storage(self, address: bytes, slot: bytes) -> int:
+        self.acc.slot_reads.add((address, slot))
+        return self.base.storage(address, slot)
+
+    def bytecode(self, code_hash: bytes) -> bytes:
+        return self.base.bytecode(code_hash)
+
+
+class _MergedView(StateSource):
+    """Parent source + committed post-state overlay."""
+
+    def __init__(self, parent: StateSource):
+        self.parent = parent
+        self.accounts: dict[bytes, Account | None] = {}
+        self.slots: dict[bytes, dict[bytes, int]] = {}
+        self.wiped: set[bytes] = set()
+        self.codes: dict[bytes, bytes] = {}
+
+    def account(self, address: bytes):
+        if address in self.accounts:
+            return self.accounts[address]
+        return self.parent.account(address)
+
+    def storage(self, address: bytes, slot: bytes) -> int:
+        per = self.slots.get(address)
+        if per is not None and slot in per:
+            return per[slot]
+        if address in self.wiped:
+            return 0
+        return self.parent.storage(address, slot)
+
+    def bytecode(self, code_hash: bytes) -> bytes:
+        code = self.codes.get(code_hash)
+        if code is not None:
+            return code
+        return self.parent.bytecode(code_hash)
+
+
+class _BalState(EvmState):
+    """EvmState flagging genuine coinbase accesses (the fee credit itself
+    bypasses state through the executor seam, so anything left is real)."""
+
+    def __init__(self, source: StateSource, coinbase: bytes, acc: TxAccess):
+        super().__init__(source)
+        self._coinbase = coinbase
+        self._acc = acc
+
+    def account(self, address: bytes):
+        if address == self._coinbase:
+            self._acc.coinbase_sensitive = True
+        return super().account(address)
+
+
+class _WaveExecutor(BlockExecutor):
+    """Worker executor: coinbase credit becomes a commutative delta."""
+
+    def __init__(self, source: StateSource, config):
+        super().__init__(source, config)
+        self.fee_delta = 0
+
+    def _credit_coinbase(self, state, env, amount):
+        self.fee_delta += amount
+
+
+def make_recording_state(source: StateSource, coinbase: bytes, index: int,
+                         config):
+    """The recording trio every speculative/recording execution needs:
+    (TxAccess, fee-delta executor, coinbase-flagging state). The fee
+    credit MUST go through the delta executor — a plain BlockExecutor
+    would write coinbase state and poison every access set with a
+    coinbase conflict."""
+    acc = TxAccess(index=index)
+    rec = _RecordingSource(source, acc)
+    ex = _WaveExecutor(rec, config)
+    state = _BalState(rec, coinbase, acc)
+    return acc, ex, state
+
+
+# -- recording (builds the exact BAL from a serial reference run) -------------
+
+
+def record_access_list(source: StateSource, block: Block,
+                       senders: list[bytes], config=None) -> BlockAccessList:
+    """Serial execution that records each transaction's exact access sets
+    (the payload builder's side of EIP-7928: the builder KNOWS the
+    accesses because it executed the block)."""
+    env = _block_env(block, config)
+    bal = BlockAccessList()
+    merged = _MergedView(source)
+    cumulative = 0
+    for i, (tx, sender) in enumerate(zip(block.transactions, senders)):
+        acc, ex, state = make_recording_state(merged, env.coinbase, i, config)
+        result = ex._execute_tx(state, env, tx, sender,
+                                env.gas_limit - cumulative)
+        cumulative += result.gas_used
+        _extract_writes(state, acc)
+        _commit_journal(merged, state, ex.fee_delta, env.coinbase)
+        bal.entries.append(acc)
+    return bal
+
+
+def _block_env(block: Block, config, block_hashes=None) -> BlockEnv:
+    h = block.header
+    return BlockEnv(
+        number=h.number, timestamp=h.timestamp, coinbase=h.beneficiary,
+        gas_limit=h.gas_limit, base_fee=h.base_fee_per_gas or 0,
+        prev_randao=h.mix_hash,
+        chain_id=config.chain_id if config is not None else 1,
+        block_hashes=block_hashes or {},
+        blob_base_fee=blob_base_fee(h.excess_blob_gas or 0),
+    )
+
+
+def _extract_writes(state: EvmState, acc: TxAccess) -> None:
+    for addr in state.changes.accounts:
+        acc.account_writes.add(addr)
+    for addr, slots in state.changes.storage.items():
+        for s in slots:
+            acc.slot_writes.add((addr, s))
+
+
+def _commit_journal(merged: _MergedView, state: EvmState, fee_delta: int,
+                    coinbase: bytes) -> None:
+    """Fold one transaction's journal into the merged post-state view."""
+    accounts, storage = state.final_state()
+    merged.accounts.update(accounts)
+    for addr in state.changes.wiped_storage:
+        merged.wiped.add(addr)
+        merged.slots[addr] = {}
+    for addr, slots in storage.items():
+        merged.slots.setdefault(addr, {}).update(slots)
+    merged.codes.update(state.changes.new_bytecodes)
+    if fee_delta:
+        prev = merged.account(coinbase) or Account()
+        merged.accounts[coinbase] = prev.with_(balance=prev.balance + fee_delta)
+
+
+# -- parallel execution -------------------------------------------------------
+
+
+def _build_waves(bal: BlockAccessList, n_txs: int) -> list[list[int]]:
+    """Greedy in-order wave partition from the (hint) access list."""
+    waves: list[list[int]] = []
+    entries = {e.index: e for e in bal.entries}
+    current: list[int] = []
+    for i in range(n_txs):
+        acc = entries.get(i)
+        joins = acc is not None and not acc.coinbase_sensitive and all(
+            not acc.conflicts_with_writes(entries[j])
+            for j in current if j in entries
+        )
+        if joins or not current:
+            current.append(i)
+        else:
+            waves.append(current)
+            current = [i]
+    if current:
+        waves.append(current)
+    return waves
+
+
+def execute_block_bal(source: StateSource, block: Block,
+                      senders: list[bytes], bal: BlockAccessList,
+                      config=None, max_workers: int = 4, state_hook=None,
+                      block_hashes=None):
+    """Execute a block wave-parallel per the access-list hint; output is
+    identical to `BlockExecutor.execute` (validated, with serial fallback
+    per conflicting transaction). Returns (output, stats)."""
+    from ..evm.executor import BlockExecutionOutput
+
+    env = _block_env(block, config, block_hashes)
+    merged = _MergedView(source)
+    changes_accounts: dict[bytes, Account | None] = {}
+    changes_storage: dict[bytes, dict[bytes, int]] = {}
+    wiped: set[bytes] = set()
+    new_codes: dict[bytes, bytes] = {}
+    receipts: list[Receipt] = []
+    cumulative = 0
+    stats = {"waves": 0, "parallel": 0, "serial": 0}
+    waves = _build_waves(bal, len(block.transactions))
+    pool = (ThreadPoolExecutor(max_workers=max_workers)
+            if any(len(w) > 1 for w in waves) else None)
+
+    def _speculate(i: int):
+        acc, ex, state = make_recording_state(merged, env.coinbase, i, config)
+        try:
+            result = ex._execute_tx(state, env, block.transactions[i],
+                                    senders[i], env.gas_limit)
+            _extract_writes(state, acc)
+            return (i, acc, state, ex.fee_delta, result, None)
+        except Exception as e:  # noqa: BLE001 — stale-state failures retry serial
+            return (i, acc, None, 0, None, e)
+
+    def _serial(i: int):
+        acc, ex, state = make_recording_state(merged, env.coinbase, i, config)
+        result = ex._execute_tx(state, env, block.transactions[i], senders[i],
+                                env.gas_limit - cumulative)
+        _extract_writes(state, acc)
+        return acc, state, ex.fee_delta, result
+
+    def _capture_changesets(state: EvmState):
+        # first-touch-wins previous images, relative to BLOCK start
+        for addr, prev in state.changes.accounts.items():
+            if addr not in changes_accounts:
+                changes_accounts[addr] = prev
+        for addr, slots in state.changes.storage.items():
+            per = changes_storage.setdefault(addr, {})
+            for s, prev in slots.items():
+                per.setdefault(s, prev)
+        for addr in state.changes.wiped_storage:
+            wiped.add(addr)
+        new_codes.update(state.changes.new_bytecodes)
+
+    for wave in waves:
+        stats["waves"] += 1
+        if len(wave) == 1:
+            results = {wave[0]: _speculate(wave[0])}
+        else:
+            results = {r[0]: r for r in pool.map(_speculate, wave)}
+        committed_writes: list[TxAccess] = []
+        for i in wave:
+            _, acc, state, fee_delta, result, err = results[i]
+            conflicted = (
+                err is not None
+                or acc.coinbase_sensitive
+                or any(acc.conflicts_with_writes(w) for w in committed_writes)
+                or block.transactions[i].gas_limit > env.gas_limit - cumulative
+            )
+            if conflicted:
+                stats["serial"] += 1
+                acc, state, fee_delta, result = _serial(i)  # may raise: invalid block
+            elif len(wave) > 1:
+                stats["parallel"] += 1  # genuinely concurrent commits only
+            else:
+                stats["serial"] += 1
+            _capture_changesets(state)
+            if state_hook is not None:
+                keys = list(state.changes.accounts) + [
+                    s for a, per in state.changes.storage.items() for s in per]
+                if fee_delta:
+                    keys.append(env.coinbase)
+                state_hook(keys)
+            _commit_journal(merged, state, fee_delta, env.coinbase)
+            if fee_delta and env.coinbase not in changes_accounts:
+                changes_accounts[env.coinbase] = source.account(env.coinbase)
+            committed_writes.append(acc)
+            cumulative += result.gas_used
+            receipts.append(Receipt(
+                tx_type=block.transactions[i].tx_type,
+                success=result.success,
+                cumulative_gas_used=cumulative,
+                logs=tuple(result.receipt.logs),
+            ))
+
+    if pool is not None:
+        pool.shutdown(wait=True)
+    # withdrawals (same post-tx application as the serial path)
+    for w in block.withdrawals or ():
+        if w.amount:
+            if w.address not in changes_accounts:
+                changes_accounts[w.address] = source.account(w.address)
+            prev = merged.account(w.address) or Account()
+            merged.accounts[w.address] = prev.with_(
+                balance=prev.balance + w.amount * 10**9)
+
+    out = BlockExecutionOutput()
+    out.senders = senders
+    out.receipts = receipts
+    out.gas_used = cumulative
+    from ..evm.state import BlockChanges
+
+    out.changes = BlockChanges(accounts=changes_accounts,
+                               storage=changes_storage,
+                               wiped_storage=wiped,
+                               new_bytecodes=new_codes)
+    out.post_accounts = {a: merged.accounts.get(a) for a in changes_accounts}
+    out.post_storage = {
+        a: {s: merged.slots.get(a, {}).get(s, 0) for s in slots}
+        for a, slots in changes_storage.items()
+    }
+    return out, stats

@@ -26,26 +26,46 @@ from ..evm.state import EvmState
 class PrewarmTask:
     """One prewarm pass for one payload."""
 
-    def __init__(self, executor, env, max_workers: int = 4):
+    def __init__(self, executor, env, max_workers: int = 4,
+                 record_accesses: bool = False):
         """``executor``: the BlockExecutor whose (cached) source the
-        sequential pass will use; ``env``: the block's BlockEnv."""
+        sequential pass will use; ``env``: the block's BlockEnv. With
+        ``record_accesses`` each worker also records its tx's access sets
+        — the BAL scheduling hint (reference: prewarm and BAL execution
+        share the speculative pass)."""
         self.executor = executor
         self.env = env
         self.max_workers = max_workers
+        self.record_accesses = record_accesses
+        self.accesses: dict[int, object] = {}  # tx index -> TxAccess
         self.warmed = 0
         self.failed = 0
 
-    def _one(self, tx, sender) -> bool:
-        state = EvmState(self.executor.source)  # thread-local journal
+    def _one(self, item) -> bool:
+        i, tx, sender = item
         try:
+            if self.record_accesses:
+                from .bal import _extract_writes, make_recording_state
+
+                # the recording executor routes the coinbase fee credit
+                # through the delta seam — a plain executor would poison
+                # every access set with a coinbase write/flag
+                acc, ex, state = make_recording_state(
+                    self.executor.source, self.env.coinbase, i,
+                    self.executor.config)
+                self.accesses[i] = acc  # dict: per-key writes race-free
+            else:
+                ex = self.executor
+                state = EvmState(self.executor.source)  # thread-local journal
             # independent execution: later in-block txs see the PARENT
             # nonce, so align the journal's copy (the reference's prewarm
             # relaxes the same sequential-only checks); reads still flow
             # through (and warm) the shared cache
             if state.nonce(sender) != tx.nonce:
                 state.set_nonce(sender, tx.nonce)
-            self.executor._execute_tx(state, self.env, tx, sender,
-                                      self.env.gas_limit)
+            ex._execute_tx(state, self.env, tx, sender, self.env.gas_limit)
+            if self.record_accesses:
+                _extract_writes(state, acc)
             return True
         except Exception:  # noqa: BLE001 — speculative: any failure is fine
             return False
@@ -68,8 +88,8 @@ class PrewarmTask:
         if not transactions:
             return
         self._pool = ThreadPoolExecutor(max_workers=self.max_workers)
-        self._futures = [self._pool.submit(self._one, tx, s)
-                         for tx, s in zip(transactions, senders)]
+        self._futures = [self._pool.submit(self._one, (i, tx, s))
+                         for i, (tx, s) in enumerate(zip(transactions, senders))]
 
     def join(self) -> int:
         """Collect results and release the workers."""

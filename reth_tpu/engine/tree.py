@@ -72,6 +72,7 @@ class EngineTree:
         persistence_threshold: int = 2,
         unwinder=None,
         invalid_block_hooks: list | None = None,
+        bal_execution: bool = False,
     ):
         self.factory = factory
         self.committer = committer or TrieCommitter()
@@ -93,6 +94,11 @@ class EngineTree:
         # high to disable; reference gates prewarm similarly)
         self.prewarm_threshold = 4
         self.last_prewarm = None
+        # BAL wave execution: the prewarm pass doubles as the speculative
+        # access recording, then execute_block_bal schedules conflict-free
+        # waves (reference payload_processor/bal/execute.rs)
+        self.bal_execution = bal_execution
+        self.last_bal_stats = None
         if unwinder is None:
             def unwinder(fac, target):
                 from ..stages import Pipeline, default_stages
@@ -262,6 +268,7 @@ class EngineTree:
             self.invalid[block.hash] = msg
             self._run_invalid_hooks(block, msg)
             return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
+        self.last_prewarm = None  # bind the pass to THIS block only
         # prewarm: execute txs in parallel against PARENT state first,
         # purely to populate the execution cache (reference
         # payload_processor/prewarm.rs); canonical execution below then
@@ -278,10 +285,13 @@ class EngineTree:
                 prev_randao=header.mix_hash, chain_id=self.config.chain_id,
                 blob_base_fee=blob_base_fee(header.excess_blob_gas or 0),
             )
-            self.last_prewarm = PrewarmTask(executor, env)
+            self.last_prewarm = PrewarmTask(
+                executor, env, record_accesses=self.bal_execution)
             # started, NOT joined: the canonical pass below overlaps the
             # warming workers (speculative reads only touch the shared
-            # mutex-guarded cache; canonical writes stay in its journal)
+            # mutex-guarded cache; canonical writes stay in its journal).
+            # In BAL mode the pass is joined first instead — its recorded
+            # access sets become the wave schedule.
             self.last_prewarm.start(block.transactions, senders)
         # pipelined root: a worker batch-hashes dirty keys on the device
         # WHILE execution runs (reference state_root_task / sparse_trie
@@ -289,9 +299,22 @@ class EngineTree:
         from .pipelined_root import PipelinedStateRoot
 
         root_job = PipelinedStateRoot(self.committer.hasher)
+        use_bal = (self.bal_execution and self.last_prewarm is not None
+                   and self.last_prewarm.record_accesses)
         try:
-            out = executor.execute(block, senders, hashes,
-                                   state_hook=root_job.on_state_update)
+            if use_bal:
+                from .bal import BlockAccessList, execute_block_bal
+
+                self.last_prewarm.join()
+                hint = BlockAccessList(entries=[
+                    self.last_prewarm.accesses[i]
+                    for i in sorted(self.last_prewarm.accesses)])
+                out, self.last_bal_stats = execute_block_bal(
+                    executor.source, block, senders, hint, self.config,
+                    state_hook=root_job.on_state_update, block_hashes=hashes)
+            else:
+                out = executor.execute(block, senders, hashes,
+                                       state_hook=root_job.on_state_update)
         except BaseException:
             root_job.finish([])  # never leak the worker thread
             if self.last_prewarm is not None:

@@ -128,6 +128,13 @@ class BlockExecutor:
         self.source = source
         self.config = config or EvmConfig()
 
+    def _credit_coinbase(self, state: EvmState, env: "BlockEnv", amount: int):
+        """Priority-fee credit seam: the BAL wave executor overrides this to
+        accumulate a commutative delta instead of writing state (coinbase
+        would otherwise conflict every pair of transactions)."""
+        state._capture_account_change(env.coinbase)
+        state.add_balance(env.coinbase, amount)
+
     def execute(
         self, block: Block, senders: list[bytes] | None = None,
         block_hashes: dict[int, bytes] | None = None,
@@ -306,8 +313,7 @@ class BlockExecutor:
         state.add_balance(sender, (tx.gas_limit - gas_used) * gas_price)
         priority = gas_price - base_fee
         if priority > 0:
-            state._capture_account_change(env.coinbase)
-            state.add_balance(env.coinbase, gas_used * priority)
+            self._credit_coinbase(state, env, gas_used * priority)
         # failed frames already popped their logs via journal revert
         logs = state.take_logs()
         state.delete_empty_touched()
