@@ -155,7 +155,7 @@ def cmd_import(args):
 
 def cmd_import_era(args):
     from .consensus import EthBeaconConsensus
-    from .era import import_era
+    from .era import import_era, read_era1
     from .node import Node, NodeConfig
     from .stages import Pipeline, default_stages
 
@@ -165,9 +165,26 @@ def cmd_import_era(args):
                      genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes,
                      chain_spec=chain_spec)
     node = Node(cfg, committer=committer)
-    tip = import_era(node.factory, args.file, EthBeaconConsensus(node.committer))
-    print(f"imported era1 file, tip={tip}")
-    Pipeline(node.factory, default_stages(committer=node.committer)).run(tip)
+    consensus = EthBeaconConsensus(node.committer)
+    if args.source:
+        # checksummed multi-archive source driven by the Era STAGE
+        # (reference era-downloader + EraStage)
+        from .era_sync import EraDownloader, EraSource, EraStage
+
+        dl = EraDownloader(EraSource(args.source),
+                           Path(args.datadir) / "era-cache")
+        paths = dl.fetch_all()
+        tip = max(
+            read_era1(p).start_block + len(read_era1(p).blocks) - 1
+            for p in paths
+        )
+        stages = [EraStage(dl, consensus)] + default_stages(committer=node.committer)
+        print(f"era source verified: {len(paths)} archives, tip={tip}")
+        Pipeline(node.factory, stages).run(tip)
+    else:
+        tip = import_era(node.factory, args.file, consensus)
+        print(f"imported era1 file, tip={tip}")
+        Pipeline(node.factory, default_stages(committer=node.committer)).run(tip)
     node.factory.db.flush()
     print(f"pipeline synced to {tip}")
     return 0
@@ -739,10 +756,14 @@ def main(argv=None) -> int:
     add_hasher(p)
     p.set_defaults(fn=cmd_import)
 
-    p = sub.add_parser("import-era", help="import an era1 history archive")
+    p = sub.add_parser("import-era", help="import era1 history archives")
     p.add_argument("--datadir", required=True)
     p.add_argument("--genesis", required=True)
-    p.add_argument("file")
+    p.add_argument("file", nargs="?", default=None,
+                   help="single era1 file (or use --source)")
+    p.add_argument("--source", default=None,
+                   help="directory of era1 archives + index.txt checksums")
+    add_hasher(p)
     p.set_defaults(fn=cmd_import_era)
 
     p = sub.add_parser("export-era", help="export canonical blocks to era1")
