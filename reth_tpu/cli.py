@@ -529,6 +529,60 @@ def cmd_test_vectors(args):
     return 0
 
 
+def cmd_bb_bench(args):
+    """Big-block execution benchmark (reference bin/reth-bb): execute one
+    synthetic maximum-size block and report Mgas/s — serial vs BAL waves."""
+    from .engine.bal import execute_block_bal, record_access_list
+    from .evm import BlockExecutor, EvmConfig
+    from .evm.executor import InMemoryStateSource
+    from .primitives import Account
+    from .primitives.keccak import keccak256
+    from .primitives.types import Block, Header
+    from .testing import Wallet
+
+    n_transfer = args.transfers
+    n_store = args.stores
+    # PUSH0 CALLDATALOAD PUSH0 SSTORE STOP — a storage write per call
+    store_code = bytes.fromhex("5f355f5500")
+    wallets = [Wallet(0x10000 + i) for i in range(n_transfer + n_store)]
+    accounts = {w.address: Account(balance=10**20) for w in wallets}
+    contracts = []
+    for i in range(max(1, n_store // 8)):  # 8 callers share a contract
+        c = bytes([0x5C]) + i.to_bytes(19, "big")
+        accounts[c] = Account(code_hash=keccak256(store_code))
+        contracts.append(c)
+    src = InMemoryStateSource(accounts, codes={keccak256(store_code): store_code})
+    txs = [w.transfer(bytes([0xD0]) + i.to_bytes(19, "big"), 1 + i)
+           for i, w in enumerate(wallets[:n_transfer])]
+    txs += [w.call(contracts[i % len(contracts)], i.to_bytes(32, "big"))
+            for i, w in enumerate(wallets[n_transfer:])]
+    header = Header(number=1, gas_limit=2_000_000_000, base_fee_per_gas=7,
+                    beneficiary=b"\xcb" * 20)
+    block = Block(header, tuple(txs), (), ())
+    senders = [w.address for w in wallets]
+
+    cfg = EvmConfig(chain_id=1)
+    t0 = time.time()
+    out = BlockExecutor(src, cfg).execute(block, senders)
+    dt_serial = time.time() - t0
+    mgas = out.gas_used / 1e6
+    print(f"serial:   {len(txs)} txs, {mgas:.2f} Mgas in {dt_serial:.3f}s "
+          f"= {mgas / dt_serial:.2f} Mgas/s")
+    bal = record_access_list(src, block, senders, cfg)
+    t0 = time.time()
+    out2, stats = execute_block_bal(src, block, senders, bal, cfg)
+    dt_bal = time.time() - t0
+    assert out2.gas_used == out.gas_used
+    print(f"bal:      {mgas:.2f} Mgas in {dt_bal:.3f}s = "
+          f"{mgas / dt_bal:.2f} Mgas/s  waves={stats['waves']} "
+          f"parallel={stats['parallel']} serial={stats['serial']}")
+    print(json.dumps({"metric": "execution_mgas_per_sec",
+                      "value": round(mgas / dt_serial, 3),
+                      "unit": "Mgas/s",
+                      "bal_mgas_per_sec": round(mgas / dt_bal, 3)}))
+    return 0
+
+
 def cmd_config(args):
     """Print the effective TOML-style config (reference `reth config`)."""
     from .config import load_config
@@ -883,6 +937,12 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default=None)
     p.set_defaults(fn=cmd_test_vectors)
+
+    p = sub.add_parser("bb-bench",
+                       help="big-block execution benchmark (reth-bb analogue)")
+    p.add_argument("--transfers", type=int, default=400)
+    p.add_argument("--stores", type=int, default=100)
+    p.set_defaults(fn=cmd_bb_bench)
 
     p = sub.add_parser("config", help="print the effective config")
     p.add_argument("--config", default=None)

@@ -195,7 +195,7 @@ class Node:
         self.rpc.register(Web3Api())
         self.rpc.register(TxpoolApi(self.pool))
         from ..rpc.debug import DebugApi
-        from ..rpc.flashbots import BundleApi
+        from ..rpc.flashbots import BundleApi, ValidationApi
         from ..rpc.miner import MinerApi
         from ..rpc.otterscan import OtterscanApi
 
@@ -203,6 +203,7 @@ class Node:
         self.rpc.register(debug_api)
         self.rpc.register(OtterscanApi(self.eth_api, debug_api))
         self.rpc.register(BundleApi(self.eth_api))
+        self.rpc.register(ValidationApi(self.eth_api))
         self.rpc.register(MinerApi(self.payload_service, self.pool))
         self.engine_api = EngineApi(self.tree, self.payload_service, pool=self.pool)
         # JWT on the engine port (reference auth_layer.rs): explicit secret,

@@ -59,8 +59,79 @@ def span(target: str, name: str, level: int = logging.DEBUG, **fields):
     """Timed span: logs entry fields + exit duration (tracing-span idiom)."""
     log = tracer(target)
     t0 = time.time()
+    err = None
     try:
         yield
+    except BaseException as e:
+        err = type(e).__name__
+        raise
     finally:
+        dt = time.time() - t0
         extra = " ".join(f"{k}={v}" for k, v in fields.items())
-        log.log(level, "%s %s took %.3fms", name, extra, (time.time() - t0) * 1e3)
+        log.log(level, "%s %s took %.3fms", name, extra, dt * 1e3)
+        if _otlp is not None:
+            _otlp.export(target, name, t0, dt, fields, err)
+
+
+# -- OTLP export (reference crates/tracing-otlp) ------------------------------
+# The reference ships spans to an OTLP collector endpoint; this environment
+# has no egress, so the exporter writes the SAME span model (resource +
+# scope + span with name/attributes/start/end/status) as OTLP/JSON lines to
+# a file a collector can tail — the transport is the only difference.
+
+_otlp = None
+
+
+class OtlpFileExporter:
+    def __init__(self, path: str | Path, service_name: str = "reth-tpu"):
+        import json as _json
+        import threading
+
+        self._json = _json
+        self._lock = threading.Lock()
+        self._f = open(path, "a", buffering=1)
+        self.service_name = service_name
+        self.exported = 0
+
+    def export(self, target: str, name: str, start: float, duration: float,
+               fields: dict, error: str | None) -> None:
+        span_rec = {
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": self.service_name}}]},
+            "scopeSpans": [{
+                "scope": {"name": f"reth_tpu.{target}"},
+                "spans": [{
+                    "name": name,
+                    "startTimeUnixNano": str(int(start * 1e9)),
+                    "endTimeUnixNano": str(int((start + duration) * 1e9)),
+                    "attributes": [
+                        {"key": k, "value": {"stringValue": str(v)}}
+                        for k, v in fields.items()
+                    ],
+                    "status": ({"code": 2, "message": error} if error
+                               else {"code": 1}),
+                }],
+            }],
+        }
+        with self._lock:
+            self._f.write(self._json.dumps(span_rec) + "\n")
+            self.exported += 1
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+def init_otlp(path: str | Path, service_name: str = "reth-tpu") -> OtlpFileExporter:
+    """Install the OTLP/JSON file exporter for every span()."""
+    global _otlp
+    _otlp = OtlpFileExporter(path, service_name)
+    return _otlp
+
+
+def shutdown_otlp() -> None:
+    global _otlp
+    if _otlp is not None:
+        _otlp.close()
+        _otlp = None

@@ -129,3 +129,41 @@ def test_node_event_reporter_line():
     assert rep.report_once() is None  # window drained
     ev = stream.next(0)
     assert ev.number == 1 and ev.txs == 1
+
+
+def test_otlp_file_exporter(tmp_path):
+    """span() exports OTLP/JSON span records once the exporter is
+    installed (reference crates/tracing-otlp; file transport here)."""
+    import json
+
+    from reth_tpu.tracing import init_otlp, shutdown_otlp, span
+
+    path = tmp_path / "spans.jsonl"
+    exp = init_otlp(path, service_name="test-node")
+    try:
+        with span("trie.state_root", "commit", leaves=42):
+            pass
+        try:
+            with span("engine", "boom"):
+                raise ValueError("x")
+        except ValueError:
+            pass
+    finally:
+        shutdown_otlp()
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(lines) == 2 and exp.exported == 2
+    first = lines[0]["scopeSpans"][0]
+    assert first["scope"]["name"] == "reth_tpu.trie.state_root"
+    sp = first["spans"][0]
+    assert sp["name"] == "commit"
+    assert {"key": "leaves", "value": {"stringValue": "42"}} in sp["attributes"]
+    assert int(sp["endTimeUnixNano"]) >= int(sp["startTimeUnixNano"])
+    assert lines[1]["scopeSpans"][0]["spans"][0]["status"]["code"] == 2
+
+
+def test_bb_bench_cli(capsys):
+    from reth_tpu.cli import main
+
+    assert main(["bb-bench", "--transfers", "20", "--stores", "8"]) == 0
+    out = capsys.readouterr().out
+    assert "Mgas/s" in out and "execution_mgas_per_sec" in out

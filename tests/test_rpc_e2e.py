@@ -350,3 +350,34 @@ def test_debug_execution_witness_stateless_roundtrip(node):
     parent = Header.decode(parse_data(rpc(port, "debug_getRawHeader", "0x0")))
     chain = StatelessChain(config=EvmConfig(chain_id=1))
     assert chain.validate(block, w, parent) == block.header.state_root
+
+
+def test_flashbots_validate_builder_submission(node):
+    """Relay-side builder-block validation: a payload built by the node's
+    own payload service validates, a tampered bid value is rejected."""
+    from reth_tpu.rpc.convert import qty as _qty
+
+    n, alice = node
+    port = n.rpc.port
+    rpc(port, "eth_sendRawTransaction", data(alice.transfer(b"\x0b" * 20, 321).encode()))
+    # build (but do NOT commit) a payload on the tip via the engine API
+    head = rpc(port, "eth_getBlockByNumber", "latest", False)["hash"]
+    fcu = n.engine_api.engine_forkchoiceUpdatedV2(
+        {"headBlockHash": head, "safeBlockHash": head,
+         "finalizedBlockHash": head},
+        {"timestamp": "0x63", "prevRandao": "0x" + "00" * 32,
+         "suggestedFeeRecipient": "0x" + "ee" * 20, "withdrawals": []})
+    payload = n.engine_api.engine_getPayloadV2(
+        fcu["payloadId"])["executionPayload"]
+    res = rpc(port, "flashbots_validateBuilderSubmissionV3", {
+        "executionPayload": payload,
+        "message": {"feeRecipient": "0x" + "ee" * 20, "value": "0x0"},
+    })
+    assert res["status"] == "Valid", res
+    # demanding more payment than the block provides: invalid
+    res = rpc(port, "flashbots_validateBuilderSubmissionV3", {
+        "executionPayload": payload,
+        "message": {"feeRecipient": "0x" + "ee" * 20,
+                    "value": _qty(10**30)},
+    })
+    assert res["status"] == "Invalid" and "payment" in res["validationError"]
