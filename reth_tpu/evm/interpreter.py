@@ -884,6 +884,45 @@ _PRECOMPILES = {
     10: _pre_point_eval,
 }
 
+# -- precompile result cache (reference engine/tree precompile_cache.rs) ------
+# Precompiles are pure: (index, input) fully determines the output and the
+# charged gas. The expensive ones (modexp, bn254 add/mul/pairing, KZG point
+# evaluation, ecrecover) cache their successful results across calls,
+# transactions, and blocks; failures are gas-dependent and never cached.
+
+from collections import OrderedDict as _OrderedDict
+
+_PRECOMPILE_CACHE: "_OrderedDict[tuple[int, bytes], tuple[int, bytes]]" = _OrderedDict()
+_PRECOMPILE_CACHE_MAX = 2048
+_CACHED_INDICES = frozenset({1, 5, 6, 7, 8, 10})
+precompile_cache_stats = {"hits": 0, "misses": 0}
+
+
+def _cached_precompile(idx: int, fn):
+    def run(data, gas: int):
+        key = (idx, bytes(data))
+        hit = _PRECOMPILE_CACHE.get(key)
+        if hit is not None:
+            _PRECOMPILE_CACHE.move_to_end(key)
+            precompile_cache_stats["hits"] += 1
+            charged, out = hit
+            if gas < charged:
+                return False, 0, b""
+            return True, gas - charged, out
+        precompile_cache_stats["misses"] += 1
+        ok, gas_left, out = fn(data, gas)
+        if ok:
+            _PRECOMPILE_CACHE[key] = (gas - gas_left, out)
+            while len(_PRECOMPILE_CACHE) > _PRECOMPILE_CACHE_MAX:
+                _PRECOMPILE_CACHE.popitem(last=False)
+        return ok, gas_left, out
+
+    return run
+
+
+for _i in _CACHED_INDICES:
+    _PRECOMPILES[_i] = _cached_precompile(_i, _PRECOMPILES[_i])
+
 
 def _precompile(address: bytes):
     if address[:19] == b"\x00" * 19 and 1 <= address[19] <= 10:

@@ -234,19 +234,25 @@ class TransactionPool:
         for sender in self.by_sender:
             acct = state.account(sender)
             heads[sender] = acct.nonce if acct else 0
-        candidates: list[PooledTx] = []
+        # heap keyed (-tip, submission_id): O(log n) per yield instead of a
+        # full re-sort per transaction (reference BestTransactions keeps the
+        # same priority order over its own BTree)
+        import heapq
+
+        heap: list[tuple[int, int, PooledTx]] = []
         for sender, txs in self.by_sender.items():
             ptx = txs.get(heads[sender])
             if ptx is not None and self._executable(ptx, base_fee):
-                candidates.append(ptx)
-        while candidates:
-            candidates.sort(key=lambda p: (-p.effective_tip(base_fee), p.submission_id))
-            best = candidates.pop(0)
+                heapq.heappush(
+                    heap, (-ptx.effective_tip(base_fee), ptx.submission_id, ptx))
+        while heap:
+            _, _, best = heapq.heappop(heap)
             yield best.tx
             heads[best.sender] += 1
             nxt = self.by_sender[best.sender].get(heads[best.sender])
             if nxt is not None and self._executable(nxt, base_fee):
-                candidates.append(nxt)
+                heapq.heappush(
+                    heap, (-nxt.effective_tip(base_fee), nxt.submission_id, nxt))
 
     def _executable(self, ptx: PooledTx, base_fee: int) -> bool:
         if ptx.effective_tip(base_fee) < 0:

@@ -258,3 +258,32 @@ def test_g2_serialization_parses_generator_compressed():
     flags = 0x80 | (0x20 if is_largest else 0)
     data = bytes([raw[0] | flags]) + raw[1:]
     assert g2_from_bytes(data) == BLS12_381.g2
+
+
+def test_precompile_cache_hits_and_correctness():
+    """Repeated identical precompile calls serve from the cache with the
+    same output and gas (reference precompile_cache.rs); low-gas calls
+    fail identically whether cached or not."""
+    from reth_tpu.evm.interpreter import (
+        _PRECOMPILE_CACHE,
+        _PRECOMPILES,
+        precompile_cache_stats,
+    )
+
+    _PRECOMPILE_CACHE.clear()
+    before = dict(precompile_cache_stats)
+    # bn254 add of two generator points, twice
+    from reth_tpu.primitives.pairing import BN254, g1_group
+
+    g = g1_group(BN254)
+    data = (g.gx.to_bytes(32, "big") + g.gy.to_bytes(32, "big")) * 2
+    ok1, gas1, out1 = _PRECOMPILES[6](data, 100_000)
+    ok2, gas2, out2 = _PRECOMPILES[6](data, 100_000)
+    assert (ok1, gas1, out1) == (ok2, gas2, out2) and ok1
+    assert precompile_cache_stats["hits"] == before["hits"] + 1
+    # cached low-gas call fails exactly like the uncached path
+    assert _PRECOMPILES[6](data, 10) == (False, 0, b"")
+    # different input = different result, not a stale hit
+    data2 = data[:-1] + bytes([data[-1] ^ 1])
+    okx, _, outx = _PRECOMPILES[6](data2, 100_000)
+    assert out1 != outx or not okx

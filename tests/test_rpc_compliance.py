@@ -1,0 +1,174 @@
+"""RPC compliance battery: response SHAPES across every namespace of a
+live node (reference crates/rpc/rpc-e2e-tests — execution-apis-style
+conformance: hex quantity/data formats, field presence, null semantics)."""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from reth_tpu.node import Node, NodeConfig
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.rpc.convert import data
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+QTY = re.compile(r"^0x(0|[1-9a-f][0-9a-f]*)$")          # no leading zeros
+DATA = re.compile(r"^0x(?:[0-9a-f][0-9a-f])*$")          # even-length hex
+HASH32 = re.compile(r"^0x[0-9a-f]{64}$")
+ADDR = re.compile(r"^0x[0-9a-f]{40}$")
+BLOOM = re.compile(r"^0x[0-9a-f]{512}$")
+
+
+def rpc_raw(port, method, *params):
+    req = json.dumps({"jsonrpc": "2.0", "id": 7, "method": method,
+                      "params": list(params)})
+    resp = urllib.request.urlopen(
+        urllib.request.Request(f"http://127.0.0.1:{port}/", req.encode(),
+                               {"Content-Type": "application/json"}),
+        timeout=30)
+    return json.loads(resp.read())
+
+
+def rpc(port, method, *params):
+    out = rpc_raw(port, method, *params)
+    assert out.get("jsonrpc") == "2.0" and out.get("id") == 7
+    assert "error" not in out, f"{method}: {out.get('error')}"
+    return out["result"]
+
+
+@pytest.fixture(scope="module")
+def live():
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)}, committer=CPU)
+    cfg = NodeConfig(dev=True, genesis_header=builder.genesis,
+                     genesis_alloc=builder.accounts_at_genesis)
+    n = Node(cfg, committer=CPU)
+    n.start_rpc()
+    # mine two blocks with activity
+    port = n.rpc.port
+    tx = alice.transfer(b"\x0b" * 20, 4242)
+    rpc(port, "eth_sendRawTransaction", data(tx.encode()))
+    n.miner.mine_block()
+    tx2 = alice.transfer(b"\x0c" * 20, 11)
+    rpc(port, "eth_sendRawTransaction", data(tx2.encode()))
+    n.miner.mine_block()
+    yield n, alice, tx
+    n.stop()
+
+
+def test_quantity_formats(live):
+    n, alice, _ = live
+    port = n.rpc.port
+    for method, params in [
+        ("eth_blockNumber", []),
+        ("eth_chainId", []),
+        ("eth_gasPrice", []),
+        ("eth_getBalance", [data(alice.address), "latest"]),
+        ("eth_getTransactionCount", [data(alice.address), "latest"]),
+        ("eth_getBlockTransactionCountByNumber", ["latest"]),
+        ("eth_maxPriorityFeePerGas", []),
+    ]:
+        got = rpc(port, method, *params)
+        assert isinstance(got, str) and QTY.match(got), (method, got)
+
+
+def test_block_object_shape(live):
+    n, _, _ = live
+    blk = rpc(n.rpc.port, "eth_getBlockByNumber", "0x1", True)
+    for field, pat in [("hash", HASH32), ("parentHash", HASH32),
+                       ("stateRoot", HASH32), ("transactionsRoot", HASH32),
+                       ("receiptsRoot", HASH32), ("miner", ADDR),
+                       ("logsBloom", BLOOM), ("number", QTY),
+                       ("gasLimit", QTY), ("gasUsed", QTY),
+                       ("timestamp", QTY), ("baseFeePerGas", QTY),
+                       ("extraData", DATA)]:
+        assert field in blk, field
+        assert pat.match(blk[field]), (field, blk[field])
+    assert isinstance(blk["transactions"], list) and blk["transactions"]
+    tx = blk["transactions"][0]
+    for field, pat in [("hash", HASH32), ("from", ADDR), ("nonce", QTY),
+                       ("blockNumber", QTY), ("transactionIndex", QTY),
+                       ("value", QTY), ("gas", QTY), ("input", DATA),
+                       ("type", QTY)]:
+        assert pat.match(tx[field]), (field, tx[field])
+    # hydrated=false returns hashes only
+    blk2 = rpc(n.rpc.port, "eth_getBlockByNumber", "0x1", False)
+    assert all(HASH32.match(t) for t in blk2["transactions"])
+
+
+def test_receipt_and_logs_shape(live):
+    n, _, tx = live
+    rec = rpc(n.rpc.port, "eth_getTransactionReceipt", data(tx.hash))
+    for field, pat in [("transactionHash", HASH32), ("blockHash", HASH32),
+                       ("blockNumber", QTY), ("transactionIndex", QTY),
+                       ("from", ADDR), ("cumulativeGasUsed", QTY),
+                       ("gasUsed", QTY), ("status", QTY),
+                       ("effectiveGasPrice", QTY), ("type", QTY),
+                       ("logsBloom", BLOOM)]:
+        assert field in rec and pat.match(rec[field]), (field, rec.get(field))
+    assert isinstance(rec["logs"], list)
+    assert rec["contractAddress"] is None  # transfer: no deploy
+
+
+def test_null_semantics(live):
+    n, _, _ = live
+    port = n.rpc.port
+    assert rpc(port, "eth_getBlockByNumber", "0xdeadbeef", False) is None
+    assert rpc(port, "eth_getTransactionReceipt", "0x" + "ab" * 32) is None
+    assert rpc(port, "eth_getTransactionByHash", "0x" + "ab" * 32) is None
+    assert rpc(port, "eth_getBlockByHash", "0x" + "cd" * 32, False) is None
+
+
+def test_error_codes(live):
+    n, _, _ = live
+    port = n.rpc.port
+    out = rpc_raw(port, "eth_nonexistentMethod")
+    assert out["error"]["code"] == -32601
+    out = rpc_raw(port, "eth_getBalance")  # missing params
+    assert out["error"]["code"] in (-32602, -32603)
+    out = rpc_raw(port, "eth_sendRawTransaction", "0xzz")
+    assert out["error"]["code"] in (-32602, -32000, -32603)
+
+
+def test_namespace_coverage(live):
+    """Every advertised namespace answers its flagship method."""
+    n, alice, _ = live
+    port = n.rpc.port
+    assert rpc(port, "web3_clientVersion").startswith("reth-tpu/")
+    assert HASH32.match(rpc(port, "web3_sha3", "0x68656c6c6f20776f726c64"))
+    assert rpc(port, "net_version") == "1"
+    assert rpc(port, "net_listening") in (True, False)
+    assert QTY.match(rpc(port, "net_peerCount"))
+    pool = rpc(port, "txpool_status")
+    assert QTY.match(pool["pending"]) and QTY.match(pool["queued"])
+    fee = rpc(port, "eth_feeHistory", "0x2", "latest", [25, 75])
+    assert QTY.match(fee["oldestBlock"])
+    assert all(QTY.match(x) for x in fee["baseFeePerGas"])
+    sync = rpc(port, "eth_syncing")
+    assert sync is False or isinstance(sync, dict)
+    proof = rpc(port, "eth_getProof", data(alice.address), [], "latest")
+    assert ADDR.match(proof["address"]) and proof["accountProof"]
+    assert all(DATA.match(x) for x in proof["accountProof"])
+    trace = rpc(port, "debug_getRawHeader", "0x1")
+    assert DATA.match(trace)
+    ots = rpc(port, "ots_getApiLevel")
+    assert isinstance(ots, int)
+
+
+def test_eth_call_and_estimate_shapes(live):
+    n, alice, _ = live
+    port = n.rpc.port
+    call = {"to": data(b"\x0b" * 20), "from": data(alice.address),
+            "value": "0x0"}
+    assert DATA.match(rpc(port, "eth_call", call, "latest"))
+    assert QTY.match(rpc(port, "eth_estimateGas", call))
+    code = rpc(port, "eth_getCode", data(b"\x0b" * 20), "latest")
+    assert code == "0x"
+    slot = rpc(port, "eth_getStorageAt", data(b"\x0b" * 20),
+               "0x0", "latest")
+    assert HASH32.match(slot)
