@@ -12,10 +12,13 @@ hashing backend — an honest strong baseline standing in for the
 reference's rayon keccak path (reference
 crates/stages/stages/src/stages/hashing_account.rs:29-32).
 
-Hardening (round-1 postmortem, VERDICT.md "What's weak" #1):
+Hardening (round-1/2 postmortems, VERDICT.md "What's weak" #1):
 - A fail-fast tunnel health probe runs FIRST in a subprocess with a hard
-  budget; a wedged axon tunnel yields a diagnostic JSON in ~2 min instead
-  of burning the whole 1500 s watchdog.
+  per-attempt budget, RETRIED (default 4 attempts x 120 s, 45 s apart —
+  worst case ~10 min of the watchdog window) so one wedged minute doesn't
+  kill the round's headline; a persistently wedged tunnel still yields a
+  diagnostic JSON well inside the watchdog. If the probe only succeeds
+  late, the workload shrinks so the measured run still fits.
 - The fused committer at a forced single batch tier keeps the XLA program
   count <= ~4 (one compile storm wedged the round-1 tunnel for good).
 - The phase-aware watchdog still guarantees one JSON line no matter what.
@@ -31,7 +34,8 @@ wire-bound asymptote while still finishing well under the watchdog.
 Env knobs: RETH_TPU_BENCH_ACCOUNTS (default 150000), RETH_TPU_BENCH_SLOTS
 (default 60000), RETH_TPU_BENCH_TIER (fused batch tier, default 16384),
 RETH_TPU_BENCH_TIMEOUT (watchdog, default 1200), RETH_TPU_PROBE_TIMEOUT
-(health probe budget, default 150).
+(per-attempt probe budget, default 120), RETH_TPU_PROBE_ATTEMPTS
+(default 4), RETH_TPU_PROBE_GAP (seconds between attempts, default 45).
 """
 
 from __future__ import annotations
@@ -78,10 +82,17 @@ threading.Thread(target=_watchdog, daemon=True).start()
 
 def probe_tunnel() -> str | None:
     """Fail-fast health check: a tiny jit in a subprocess under a hard
-    budget. Returns None when healthy, else a diagnostic string. The round-1
-    bench burned its whole 1500 s inside a wedged `jax.devices()`; this
-    bounds that failure mode to ~2 min (VERDICT round 1, next-round #1)."""
-    budget = int(os.environ.get("RETH_TPU_PROBE_TIMEOUT", "150"))
+    budget, RETRIED a few times spread over the first half of the watchdog
+    window (round-2 postmortem: one wedged minute killed the whole round's
+    headline — VERDICT round 2, next-round #1a). Returns None when healthy,
+    else a diagnostic string after the last attempt.
+
+    NOTE: no `jax_compilation_cache_dir` here on purpose — the persistent
+    compile cache deadlocks the first jit over the axon tunnel (measured
+    round 2)."""
+    budget = int(os.environ.get("RETH_TPU_PROBE_TIMEOUT", "120"))
+    attempts = int(os.environ.get("RETH_TPU_PROBE_ATTEMPTS", "4"))
+    gap = int(os.environ.get("RETH_TPU_PROBE_GAP", "45"))
     code = (
         "import jax, jax.numpy as jnp\n"
         "d = jax.devices()\n"
@@ -89,17 +100,27 @@ def probe_tunnel() -> str | None:
         "y.block_until_ready()\n"
         "print('PROBE_OK', d[0].platform, flush=True)\n"
     )
-    try:
-        r = subprocess.run(
-            [sys.executable, "-u", "-c", code],
-            capture_output=True, text=True, timeout=budget,
-        )
-    except subprocess.TimeoutExpired:
-        return f"device tunnel probe exceeded {budget}s (wedged tunnel?)"
-    if r.returncode != 0 or "PROBE_OK" not in r.stdout:
+    diag = "no probe attempts ran"
+    for i in range(1, attempts + 1):
+        _STATE["phase"] = f"tunnel health probe (attempt {i}/{attempts})"
+        try:
+            r = subprocess.run(
+                [sys.executable, "-u", "-c", code],
+                capture_output=True, text=True, timeout=budget,
+            )
+        except subprocess.TimeoutExpired:
+            diag = (f"device tunnel probe exceeded {budget}s on "
+                    f"{i}/{attempts} attempts (wedged tunnel?)")
+            if i < attempts:
+                time.sleep(gap)
+            continue
+        if r.returncode == 0 and "PROBE_OK" in r.stdout:
+            return None
         tail = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["no output"]
-        return f"device probe failed rc={r.returncode}: {tail[0][:300]}"
-    return None
+        diag = f"device probe failed rc={r.returncode}: {tail[0][:300]}"
+        if i < attempts:
+            time.sleep(gap)
+    return diag
 
 
 def build_state(n_accounts: int, n_slots: int):
@@ -142,10 +163,16 @@ def main():
     n_slots = int(os.environ.get("RETH_TPU_BENCH_SLOTS", "60000"))
     tier = int(os.environ.get("RETH_TPU_BENCH_TIER", "16384"))
 
-    _STATE["phase"] = "tunnel health probe"
+    t_start = time.time()
     diag = probe_tunnel()
     if diag is not None:
         _emit(0, 0, error=f"device unavailable, bench skipped: {diag}", exit_code=2)
+    # a late probe success means a recovering tunnel AND less watchdog
+    # budget left — shrink the workload so the round still lands a number
+    remaining = _DEADLINE - (time.time() - t_start)
+    if (remaining < 600 and "RETH_TPU_BENCH_ACCOUNTS" not in os.environ
+            and "RETH_TPU_BENCH_SLOTS" not in os.environ):
+        n_accounts, n_slots = n_accounts // 3, n_slots // 3
 
     from reth_tpu.trie.turbo import TurboCommitter
 

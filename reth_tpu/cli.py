@@ -851,6 +851,8 @@ def main(argv=None) -> int:
     p.add_argument("--bootnodes", default="", help="comma-separated enode urls")
     p.add_argument("--bootnodes-v5", default="", dest="bootnodes_v5",
                    help="comma-separated enr:... records (discv5)")
+    p.add_argument("--nat", default="any",
+                   help="NAT resolution: any | none | extip:<ip> | upnp | natpmp")
     p.add_argument("--db", dest="db_backend", choices=["memdb", "native", "paged"],
                    default="memdb",
                    help="storage backend (native = C++ WAL engine, "

@@ -329,7 +329,8 @@ def execute_block_bal(source: StateSource, block: Block,
             _capture_changesets(state)
             if state_hook is not None:
                 keys = list(state.changes.accounts) + [
-                    s for a, per in state.changes.storage.items() for s in per]
+                    (a, s) for a, per in state.changes.storage.items()
+                    for s in per]
                 if fee_delta:
                     keys.append(env.coinbase)
                 state_hook(keys)

@@ -37,8 +37,11 @@ class PipelinedStateRoot:
     # -- execution-side hook (called after every transaction) ---------------
 
     def on_state_update(self, keys) -> None:
-        """Queue newly touched plain keys (addresses and storage slots)."""
-        fresh = [k for k in keys if k not in self._sent]
+        """Queue newly touched plain keys — 20-byte addresses and
+        ``(address, slot)`` pairs (slots are hashed standalone; the pair
+        form exists for the sparse strategy, which needs the owner)."""
+        flat = [k if isinstance(k, bytes) else k[1] for k in keys]
+        fresh = [k for k in flat if k not in self._sent]
         if not fresh:
             return
         self._sent.update(fresh)

@@ -1,0 +1,235 @@
+"""Sparse-trie live-tip state-root strategy: the WHOLE trie job overlaps
+execution, not just key prehashing.
+
+Reference analogue: `SparseTrieCacheTask` + the proof-worker pools
+(crates/engine/tree/src/tree/state_root_strategy/sparse_trie.rs:126-259,
+crates/trie/parallel/src/state_root_task.rs:20-100,
+crates/trie/parallel/src/proof_task.rs:136) and chain-state's
+`PreservedSparseTrie` (crates/chain-state/src/preserved_sparse_trie.rs:15).
+There, execution streams per-tx state into a background task that fetches
+multiproofs with dedicated workers and reveals them into an in-memory
+sparse trie; when execution finishes only the final leaf updates + dirty
+subtree rehash remain.
+
+TPU-first shape here: one worker thread per block consumes the streamed
+key batches and, while the EVM interprets on the main thread,
+(a) batch-hashes the plain keys (device dispatchable — the digests later
+feed the hashed-table writes), and (b) computes multiproofs from the
+PARENT view and reveals them into the (possibly cross-block preserved)
+sparse trie. ``finish`` then applies the block's final state delta and
+level-batch-rehashes only dirty subtrees — the commit that remains on the
+latency path is proportional to the block's touch set, not the trie.
+
+Any failure mode (unresolvable blind, proof mismatch) raises; the engine
+falls back to the incremental committer (`state_root_fallback`,
+reference crates/engine/primitives/src/config.rs:140).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from ..primitives.keccak import keccak256
+from ..trie.proof import ProofCalculator
+from ..trie.sparse import (
+    BlindedNodeError,
+    SparseStateTrie,
+    SparseTrie,
+    export_branch_updates,
+)
+from .stateless import apply_output_to_trie
+
+
+class SparseRootError(Exception):
+    """The sparse path could not produce a root; use the fallback."""
+
+
+class SparseRootTask:
+    """One block's background sparse-trie state-root job."""
+
+    MAX_REVEAL_RETRIES = 64
+
+    def __init__(self, parent_provider, parent_root: bytes, preserved,
+                 committer, parent_hash: bytes | None = None):
+        self.hasher = committer.hasher
+        self.calc = ProofCalculator(parent_provider, committer)
+        self.preserved = preserved
+        self.reused = False
+        st = preserved.take(parent_hash) if parent_hash is not None else None
+        if st is not None and st.account_trie.root_hash == parent_root:
+            self.trie = st
+            self.reused = True
+        else:
+            self.trie = SparseStateTrie.anchored(parent_root)
+        self._queue: queue.Queue = queue.Queue()
+        self._digests: dict[bytes, bytes] = {}
+        self._sent: set = set()
+        self._failed: Exception | None = None
+        self.proof_batches = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- execution-side hook (OnStateHook seam) -----------------------------
+
+    def on_state_update(self, keys) -> None:
+        """Queue newly touched keys: 20-byte addresses and
+        ``(address, slot)`` pairs."""
+        fresh = [k for k in keys if k not in self._sent]
+        if not fresh:
+            return
+        self._sent.update(fresh)
+        self._queue.put(fresh)
+
+    # -- worker -------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            batch = self._queue.get()
+            if batch is None:
+                return
+            if self._failed is not None:
+                continue  # drain only; finish() will fall back
+            try:
+                self._process(batch)
+            except Exception as e:  # noqa: BLE001 — reported at finish()
+                self._failed = e
+
+    def _process(self, batch) -> None:
+        addrs = [k for k in batch if isinstance(k, bytes)]
+        pairs = [k for k in batch if not isinstance(k, bytes)]
+        plain = addrs + [s for _, s in pairs]
+        if plain:
+            digests = self.hasher(list(dict.fromkeys(plain)))
+            for k, d in zip(dict.fromkeys(plain), digests):
+                self._digests[k] = bytes(d)
+        # reveal only what the trie can't already read (a preserved trie
+        # usually has last block's hot paths — the cross-block reuse)
+        targets: dict[bytes, list[bytes]] = {}
+        for a in addrs:
+            if self._needs_account_reveal(self._digests[a]):
+                targets.setdefault(a, [])
+        for a, s in pairs:
+            ha = self._digests.get(a) or bytes(self.hasher([a])[0])
+            self._digests[a] = ha
+            if self._needs_storage_reveal(ha, self._digests[s]):
+                targets.setdefault(a, []).append(s)
+        if not targets:
+            return
+        self.proof_batches += 1
+        proofs = self.calc.multiproof(targets)
+        nodes = []
+        for ap in proofs.values():
+            nodes.extend(ap.proof)
+        self.trie.reveal_account(nodes)
+        for a, ap in proofs.items():
+            snodes = [n for sp in ap.storage_proofs for n in sp.proof]
+            if snodes or targets.get(a):
+                self.trie.reveal_storage(self._digests[a], ap.storage_root,
+                                         nodes + snodes)
+
+    def _needs_account_reveal(self, hashed_addr: bytes) -> bool:
+        try:
+            self.trie.account_trie.get(hashed_addr)
+            return False
+        except BlindedNodeError:
+            return True
+
+    def _needs_storage_reveal(self, hashed_addr: bytes,
+                              hashed_slot: bytes) -> bool:
+        st = self.trie.storage_tries.get(hashed_addr)
+        if st is None:
+            return True  # storage root unknown until the account is read
+        try:
+            st.get(hashed_slot)
+            return False
+        except BlindedNodeError:
+            return True
+
+    # -- finalization --------------------------------------------------------
+
+    def finish(self, out):
+        """Apply the block's state delta and rehash dirty levels.
+        Returns ``(root, digest_map, storage_roots)`` where ``digest_map``
+        maps plain keys (addresses, slots) to keccak digests and
+        ``storage_roots`` maps plain addresses to recomputed storage
+        roots. Raises SparseRootError when the sparse path cannot close.
+        Call :meth:`preserve` only after the root matched the header —
+        preserving a trie mutated by an invalid block would poison the
+        next block's anchor."""
+        self._queue.put(None)
+        self._thread.join()
+        if self._failed is not None:
+            raise SparseRootError(f"worker failed: {self._failed}") \
+                from self._failed
+        # straggler digests (withdrawal targets, wiped accounts, ...)
+        want = sorted(set(out.changes.accounts) | set(out.changes.storage)
+                      | set(out.changes.wiped_storage))
+        slot_keys = [s for _, slots in out.post_storage.items()
+                     for s in slots]
+        missing = [k for k in want + slot_keys if k not in self._digests]
+        if missing:
+            missing = list(dict.fromkeys(missing))
+            for k, d in zip(missing, self.hasher(missing)):
+                self._digests[k] = bytes(d)
+        storage_roots: dict[bytes, bytes] = {}
+        for _attempt in range(self.MAX_REVEAL_RETRIES):
+            try:
+                root = apply_output_to_trie(self.trie, out, self.hasher,
+                                            storage_roots_out=storage_roots)
+                break
+            except BlindedNodeError as e:
+                extra = (self.calc.storage_spine_for_path(e.owner, e.path)
+                         if e.owner is not None
+                         else self.calc.spine_for_path(e.path))
+                if e.owner is not None:
+                    st = self.trie.storage_tries.get(e.owner)
+                    if st is None:
+                        raise SparseRootError("blind in unknown storage trie")
+                    st.reveal(extra)
+                else:
+                    self.trie.reveal_account(extra)
+        else:
+            raise SparseRootError("blinded-node reveal did not converge")
+        return root, self._digests, storage_roots
+
+    def preserve(self, block_hash: bytes) -> None:
+        """Anchor the updated trie for the next payload (call after the
+        computed root matched the block header)."""
+        self.preserved.preserve(block_hash, self.trie)
+
+    def export_updates(self, out, digest_map):
+        """Stored-format branch updates for the overlay, straight from the
+        sparse trie (reference: sparse trie TrieUpdates — no DB re-walk).
+        Returns (account_updates, storage_updates) where each maps
+        path -> BranchNode | None (None = delete)."""
+        changed = sorted(set(out.changes.accounts) | set(out.changes.storage)
+                         | set(out.changes.wiped_storage))
+        acct_keys = [digest_map[a] for a in changed]
+        account_updates = export_branch_updates(
+            self.trie.account_trie, acct_keys, self.calc.provider.account_branch)
+        storage_updates: dict[bytes, dict] = {}
+        for a, slots in out.post_storage.items():
+            ha = digest_map[a]
+            st = self.trie.storage_tries.get(ha)
+            if st is None:
+                continue
+            skeys = [digest_map[s] for s in slots]
+            storage_updates[ha] = export_branch_updates(
+                st, skeys,
+                lambda p, _ha=ha: self.calc.provider.storage_branch(_ha, p))
+        for a in out.changes.wiped_storage:
+            ha = digest_map[a]
+            if ha in storage_updates:
+                continue  # wiped + recreated: already exported above
+            st = self.trie.storage_tries.get(ha, SparseTrie())
+            post = out.post_storage.get(a, {})
+            skeys = [digest_map[s] for s in post]
+            storage_updates[ha] = export_branch_updates(
+                st, skeys, lambda p: None)
+        return account_updates, storage_updates
+
+    def abort(self) -> None:
+        """Stop the worker without producing a root (execution failed)."""
+        self._queue.put(None)
+        self._thread.join()

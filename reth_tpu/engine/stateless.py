@@ -77,11 +77,15 @@ class WitnessStateSource(StateSource):
 
 
 def apply_output_to_trie(st: SparseStateTrie, out,
-                         hasher=keccak256_batch_np) -> bytes:
+                         hasher=keccak256_batch_np,
+                         storage_roots_out: dict | None = None) -> bytes:
     """Apply a BlockExecutionOutput's state delta to the sparse state trie
     and return the recomputed root. Raises BlindedNodeError when an edit
     needs an unrevealed path (witness generation catches it to close the
-    witness; stateless validation treats it as an incomplete witness)."""
+    witness; stateless validation treats it as an incomplete witness).
+    ``storage_roots_out`` (plain address -> recomputed storage root) is
+    filled for callers that must mirror the roots into hashed tables (the
+    engine's sparse live-tip strategy)."""
     # storage wipes reset the trie (SELFDESTRUCT / re-created accounts)
     for a in out.changes.wiped_storage:
         st.storage_tries[keccak256(a)] = SparseTrie()
@@ -104,6 +108,8 @@ def apply_output_to_trie(st: SparseStateTrie, out,
     for a in out.changes.wiped_storage:
         if a not in storage_roots:
             storage_roots[a] = st.storage_tries[keccak256(a)].root_hash_compute(hasher)
+    if storage_roots_out is not None:
+        storage_roots_out.update(storage_roots)
     # account writes: compose leaves with the recomputed storage roots
     touched = set(out.post_accounts) | set(storage_roots)
     for a in sorted(touched):
@@ -146,10 +152,37 @@ class StatelessChain:
             st = SparseStateTrie.anchored(parent_header.state_root)
         st.reveal_account(witness.state)
         src = WitnessStateSource(st, witness.state, witness.codes)
+        # BLOCKHASH map from witness.headers — but only headers provably in
+        # the ancestor chain: walk parent_hash links down from parent_header
+        # and reject anything unlinked (a malicious witness could otherwise
+        # inject arbitrary (number, hash) pairs; reference stateless crate
+        # verifies the same linkage)
         hashes = {parent_header.number: parent_header.hash}
+        by_number: dict[int, Header] = {}
         for raw in witness.headers:
             h = Header.decode(raw)
-            hashes[h.number] = h.hash
+            if h.number == parent_header.number:
+                if h.hash != parent_header.hash:
+                    raise StatelessValidationError(
+                        "witness header forks from parent")
+                continue
+            if h.number in by_number and by_number[h.number].hash != h.hash:
+                raise StatelessValidationError(
+                    f"conflicting witness headers at {h.number}")
+            by_number[h.number] = h
+        expected = parent_header
+        n = parent_header.number - 1
+        while n in by_number:
+            h = by_number.pop(n)
+            if h.hash != expected.parent_hash:
+                raise StatelessValidationError(
+                    f"witness header {n} not hash-linked to parent chain")
+            hashes[n] = h.hash
+            expected = h
+            n -= 1
+        if by_number:
+            raise StatelessValidationError(
+                f"witness headers not in ancestor chain: {sorted(by_number)}")
         executor = BlockExecutor(src, self.config)
         try:
             senders = [tx.recover_sender() for tx in block.transactions]

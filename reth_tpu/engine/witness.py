@@ -81,6 +81,24 @@ class RecordingStateSource(StateSource):
         return self.provider.bytecode(code_hash) or b""
 
 
+class _RecordingHashes(dict):
+    """BLOCKHASH window that records which block numbers the EVM read, so
+    the witness ships exactly the ancestor headers a stateless replay needs
+    (reference ExecutionWitness `headers`)."""
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.read: set[int] = set()
+
+    def get(self, key, default=None):
+        self.read.add(key)
+        return super().get(key, default)
+
+    def __getitem__(self, key):
+        self.read.add(key)
+        return super().__getitem__(key)
+
+
 def generate_witness(parent_provider, block: Block, committer,
                      senders: list[bytes] | None = None,
                      parent_header: Header | None = None,
@@ -96,17 +114,30 @@ def generate_witness(parent_provider, block: Block, committer,
     if senders is None:
         senders = [tx.recover_sender() for tx in block.transactions]
     # BLOCKHASH window served (and recorded) from canonical headers
-    hashes: dict[int, bytes] = dict(block_hashes or {})
+    hashes = _RecordingHashes(block_hashes or {})
     headers: list[bytes] = []
+    if parent_header is None and hasattr(parent_provider, "header_by_number"):
+        parent_header = parent_provider.header_by_number(block.header.number - 1)
     if parent_header is not None:
         headers.append(parent_header.encode())
+    lo = max(0, block.header.number - 256)
     if not hashes and hasattr(parent_provider, "canonical_hash"):
-        lo = max(0, block.header.number - 256)
         for n in range(lo, block.header.number):
             h = parent_provider.canonical_hash(n)
             if h is not None:
                 hashes[n] = h
     out = executor.execute(block, senders, hashes)
+
+    # ship the ancestor headers BLOCKHASH actually read — as a contiguous
+    # hash-linked chain down from the parent, since a stateless validator
+    # can only authenticate header N-k through its child at N-k+1
+    read = {n for n in hashes.read if lo <= n < block.header.number - 1}
+    if read and hasattr(parent_provider, "header_by_number"):
+        for n in range(block.header.number - 2, min(read) - 1, -1):
+            hdr = parent_provider.header_by_number(n)
+            if hdr is None:
+                break
+            headers.append(hdr.encode())
 
     # the executor also writes: fee recipient, withdrawals, created/deleted
     touched = set(src.addresses) | set(out.post_accounts)

@@ -141,9 +141,10 @@ class BlockExecutor:
         state_hook=None,
     ) -> BlockExecutionOutput:
         """``state_hook(keys)`` is called after every transaction with the
-        plain keys (addresses + storage slots) it newly touched — the
-        OnStateHook seam feeding the pipelined state-root job (reference
-        crates/evm/evm/src/lib.rs OnStateHook -> state_root_task)."""
+        plain keys it newly touched — 20-byte addresses and
+        ``(address, slot)`` pairs — the OnStateHook seam feeding the
+        background state-root job (reference crates/evm/evm/src/lib.rs
+        OnStateHook -> state_root_task)."""
         header = block.header
         env = BlockEnv(
             number=header.number,
@@ -184,7 +185,7 @@ class BlockExecutor:
                 for addr, per in state.changes.storage.items():
                     seen = sent_slots.get(addr, 0)
                     if len(per) > seen:
-                        new += list(per)[seen:]
+                        new += [(addr, s) for s in list(per)[seen:]]
                         sent_slots[addr] = len(per)
                 if new:
                     state_hook(new)

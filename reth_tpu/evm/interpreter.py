@@ -891,30 +891,39 @@ _PRECOMPILES = {
 # transactions, and blocks; failures are gas-dependent and never cached.
 
 from collections import OrderedDict as _OrderedDict
+from threading import Lock as _Lock
 
 _PRECOMPILE_CACHE: "_OrderedDict[tuple[int, bytes], tuple[int, bytes]]" = _OrderedDict()
 _PRECOMPILE_CACHE_MAX = 2048
 _CACHED_INDICES = frozenset({1, 5, 6, 7, 8, 10})
+# prewarm workers overlap canonical execution (engine/tree.py starts
+# PrewarmTask without joining), so the LRU bookkeeping must be guarded —
+# an unguarded get()+move_to_end can race a popitem eviction
+_PRECOMPILE_CACHE_LOCK = _Lock()
 precompile_cache_stats = {"hits": 0, "misses": 0}
 
 
 def _cached_precompile(idx: int, fn):
     def run(data, gas: int):
         key = (idx, bytes(data))
-        hit = _PRECOMPILE_CACHE.get(key)
+        with _PRECOMPILE_CACHE_LOCK:
+            hit = _PRECOMPILE_CACHE.get(key)
+            if hit is not None:
+                _PRECOMPILE_CACHE.move_to_end(key)
+                precompile_cache_stats["hits"] += 1
+            else:
+                precompile_cache_stats["misses"] += 1
         if hit is not None:
-            _PRECOMPILE_CACHE.move_to_end(key)
-            precompile_cache_stats["hits"] += 1
             charged, out = hit
             if gas < charged:
                 return False, 0, b""
             return True, gas - charged, out
-        precompile_cache_stats["misses"] += 1
         ok, gas_left, out = fn(data, gas)
         if ok:
-            _PRECOMPILE_CACHE[key] = (gas - gas_left, out)
-            while len(_PRECOMPILE_CACHE) > _PRECOMPILE_CACHE_MAX:
-                _PRECOMPILE_CACHE.popitem(last=False)
+            with _PRECOMPILE_CACHE_LOCK:
+                _PRECOMPILE_CACHE[key] = (gas - gas_left, out)
+                while len(_PRECOMPILE_CACHE) > _PRECOMPILE_CACHE_MAX:
+                    _PRECOMPILE_CACHE.popitem(last=False)
         return ok, gas_left, out
 
     return run

@@ -126,7 +126,10 @@ class Node:
             EvmConfig(chain_id=config.chain_id),
             persistence_threshold=config.persistence_threshold,
         )
-        self.pool = TransactionPool(lambda: self.tree.overlay_provider())
+        from ..pool.pool import PoolConfig
+
+        self.pool = TransactionPool(lambda: self.tree.overlay_provider(),
+                                    PoolConfig(chain_id=config.chain_id))
         with self.factory.provider() as p:
             tip = p.header_by_number(p.last_block_number())
         if tip is not None and tip.base_fee_per_gas is not None:

@@ -85,6 +85,7 @@ def build_payload(
     cumulative_gas = 0
     blob_gas_used = 0
     total_fees = 0
+    failed_senders: set[bytes] = set()
     txs_iter = pool.best_transactions(base_fee) if pool is not None else ()
     for tx in txs_iter:
         if cumulative_gas + tx.gas_limit > env.gas_limit:
@@ -95,11 +96,22 @@ def build_payload(
             continue
         try:
             sender = tx.recover_sender()
+            if sender in failed_senders:
+                continue  # descendant of an evicted tx: nonce-gapped now
             result = executor._execute_tx(
                 state, env, tx, sender, env.gas_limit - cumulative_gas
             )
         except (InvalidTransaction, ValueError):
-            continue  # skip; pool maintenance will evict later
+            # provably unexecutable against this state: evict it (reference
+            # mark_invalid), or an instant-seal miner re-selects it forever;
+            # later nonces of the same sender are skipped but kept pooled
+            try:
+                failed_senders.add(tx.recover_sender())
+            except ValueError:
+                pass
+            if pool is not None:
+                pool.remove_invalid(tx.hash)
+            continue
         cumulative_gas += result.gas_used
         blob_gas_used += tx.blob_gas()
         total_fees += result.gas_used * max(0, tx.effective_gas_price(base_fee) - base_fee)

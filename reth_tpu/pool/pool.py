@@ -33,6 +33,7 @@ class PoolConfig:
     max_account_slots: int = 16      # txs per sender
     max_pool_size: int = 10_000
     minimal_protocol_fee: int = 0
+    chain_id: int | None = None      # reject foreign-chain txs at admission
 
 
 @dataclass
@@ -117,6 +118,13 @@ class TransactionPool:
                 raise PoolError("blob tx without blobs")
             if tx.max_fee_per_blob_gas < self.blob_base_fee:
                 raise PoolError("max blob fee below current blob base fee")
+        # wrong-chain txs can never execute here — reject at admission
+        # (reference EthTransactionValidator chain-id check); legacy
+        # pre-EIP-155 txs carry no chain id and pass
+        if (self.config.chain_id is not None and tx.chain_id is not None
+                and tx.chain_id != self.config.chain_id):
+            raise PoolError(
+                f"wrong chain id {tx.chain_id} (expected {self.config.chain_id})")
         try:
             sender = tx.recover_sender()
         except ValueError as e:
@@ -172,6 +180,21 @@ class TransactionPool:
                 self.blob_store.delete(self._mined_sidecars.pop(0))
             return
         self.blob_store.delete(tx_hash)
+
+    def remove_invalid(self, tx_hash: bytes) -> None:
+        """Evict a tx the payload builder proved unexecutable (reference
+        BestTransactions::mark_invalid feeding pool removal) — without this
+        an instant-seal dev miner spins forever on a 'best' tx that every
+        build skips."""
+        ptx = self.by_hash.get(tx_hash)
+        if ptx is None:
+            return
+        self._drop(tx_hash)
+        txs = self.by_sender.get(ptx.sender)
+        if txs is not None:
+            txs.pop(ptx.nonce, None)
+            if not txs:
+                del self.by_sender[ptx.sender]
 
     def get_blob_sidecar(self, tx_hash: bytes):
         return self.blob_store.get(tx_hash)
@@ -249,7 +272,8 @@ class TransactionPool:
             _, _, best = heapq.heappop(heap)
             yield best.tx
             heads[best.sender] += 1
-            nxt = self.by_sender[best.sender].get(heads[best.sender])
+            # .get twice: a consumer may remove_invalid() mid-iteration
+            nxt = self.by_sender.get(best.sender, {}).get(heads[best.sender])
             if nxt is not None and self._executable(nxt, base_fee):
                 heapq.heappush(
                     heap, (-nxt.effective_tip(base_fee), nxt.submission_id, nxt))

@@ -114,6 +114,10 @@ class ValidationApi:
         if payload is None:
             raise RpcError(-32602, "missing executionPayload")
         block = payload_to_block(payload, self.eth.tree.committer)
+        claimed_hash = payload.get("blockHash") or payload.get("block_hash")
+        if claimed_hash is not None and parse_data(claimed_hash) != block.header.hash:
+            return {"status": "Invalid",
+                    "validationError": "block hash mismatch"}
         registered = message.get("gasLimit")
         if registered is not None and parse_qty(registered) != block.header.gas_limit:
             # reference enforces the registered gas limit is honored when
@@ -137,13 +141,31 @@ class ValidationApi:
         balance_before = balance_before.balance if balance_before else 0
         src = ProviderStateSource(parent_provider)
         executor = BlockExecutor(src, tree.config)
+        # BLOCKHASH window, same as the engine newPayload path — without it
+        # a valid block reading BLOCKHASH(n-k) would execute differently
+        # here and false-fail the state-root check below
+        hashes = {}
+        for k in range(max(0, block.header.number - 256), block.header.number):
+            bh = parent_provider.canonical_hash(k)
+            if bh:
+                hashes[k] = bh
         try:
             senders = [tx.recover_sender() for tx in block.transactions]
-            out = executor.execute(block, senders)
+            out = executor.execute(block, senders, hashes)
             tree.consensus.validate_block_post_execution(
                 block, out.receipts, out.gas_used)
         except Exception as e:  # noqa: BLE001 — any failure = invalid submission
             return {"status": "Invalid", "validationError": str(e)}
+        # post-state root: a builder block with a bogus state_root must be
+        # rejected exactly like the engine newPayload path (tree.py) — the
+        # scratch overlay is discarded, so validation stays side-effect-free
+        scratch = tree.overlay_provider(block.header.parent_hash)
+        computed_root = tree._state_root_job(scratch, out)
+        if computed_root != block.header.state_root:
+            return {"status": "Invalid",
+                    "validationError":
+                        f"state root mismatch: computed {computed_root.hex()} "
+                        f"header {block.header.state_root.hex()}"}
         # proposer payment: balance delta of the fee recipient, or the
         # last transaction paying them directly (reference accepts both)
         after = out.post_accounts.get(fee_recipient)

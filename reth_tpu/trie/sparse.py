@@ -482,6 +482,90 @@ class SparseStateTrie:
         return self.account_trie.root_hash_compute(hasher)
 
 
+def export_branch_updates(trie: SparseTrie, changed_keys: list[bytes],
+                          old_branch=None):
+    """Stored-format trie updates from an updated+hashed sparse trie.
+
+    Reference analogue: the sparse trie producing ``TrieUpdates`` for the
+    engine (crates/trie/sparse — updated_nodes/removed_nodes feeding
+    `TrieUpdates`), so the live-tip path never re-walks the database.
+
+    For every prefix of every changed key path, returns
+    ``{path: BranchNode}`` where the trie holds a branch, and
+    ``{path: None}`` (a delete marker) where it no longer does. Only
+    prefixes of changed keys can have changed stored nodes — a branch's
+    content changes only when a descendant leaf does. MUST be called after
+    ``root_hash_compute`` (child refs must be clean).
+
+    ``old_branch(path)`` resolves the pre-state stored branch — used only
+    to carry over ``tree_mask`` bits for blinded children (their subtrees
+    are untouched by definition, so the old bit is still exact).
+    """
+    from .committer import BranchNode
+
+    out: dict[bytes, BranchNode | None] = {}
+    seen_prefixes: set[bytes] = set()
+    branches: dict[bytes, _Branch] = {}
+    for key in changed_keys:
+        nib = unpack_nibbles(key) if len(key) == 32 else key
+        # walk the path, recording branches at their trie paths
+        node, depth = trie.root, 0
+        while node is not None and not isinstance(node, (_Blind, _Leaf)):
+            if isinstance(node, _Ext):
+                if nib[depth:depth + len(node.path)] != node.path:
+                    break
+                depth += len(node.path)
+                node = node.child
+                continue
+            branches[nib[:depth]] = node
+            node = node.children[nib[depth]]
+            depth += 1
+        for plen in range(0, 64):
+            seen_prefixes.add(nib[:plen])
+
+    def subtree_has_branch(child) -> bool | None:
+        if isinstance(child, _Branch):
+            return True
+        if isinstance(child, _Ext):
+            return True  # an extension's child is always a branch (MPT)
+        if isinstance(child, _Leaf):
+            return False
+        return None  # blinded: unknown from the sparse view
+
+    for path in seen_prefixes:
+        br = branches.get(path)
+        if br is None:
+            out[path] = None  # delete marker (collapsed / never a branch)
+            continue
+        state_mask = tree_mask = hash_mask = 0
+        hashes: list[bytes] = []
+        old = None
+        old_resolved = False
+        for nibble in range(16):
+            c = br.children[nibble]
+            if c is None:
+                continue
+            state_mask |= 1 << nibble
+            has_branch = subtree_has_branch(c)
+            if has_branch is None:
+                # blinded child: its subtree is unchanged, so the old
+                # stored node's bit is still exact
+                if not old_resolved:
+                    old = old_branch(path) if old_branch is not None else None
+                    old_resolved = True
+                has_branch = bool(old is not None
+                                  and (old.tree_mask >> nibble) & 1)
+            if has_branch:
+                tree_mask |= 1 << nibble
+            ref = (encode_hash_ref(c.hash) if isinstance(c, _Blind)
+                   else c._ref)
+            if ref is not None and len(ref) == 33:
+                hash_mask |= 1 << nibble
+                hashes.append(ref[1:])
+        out[path] = BranchNode(state_mask, tree_mask, hash_mask, tuple(hashes))
+    return out
+
+
 class PreservedSparseTrie:
     """Cross-block sparse-trie cache anchored at the canonical tip.
 

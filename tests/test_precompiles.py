@@ -273,10 +273,10 @@ def test_precompile_cache_hits_and_correctness():
     _PRECOMPILE_CACHE.clear()
     before = dict(precompile_cache_stats)
     # bn254 add of two generator points, twice
-    from reth_tpu.primitives.pairing import BN254, g1_group
+    from reth_tpu.primitives.pairing import BN254
 
-    g = g1_group(BN254)
-    data = (g.gx.to_bytes(32, "big") + g.gy.to_bytes(32, "big")) * 2
+    gx, gy = BN254.g1
+    data = (gx.to_bytes(32, "big") + gy.to_bytes(32, "big")) * 2
     ok1, gas1, out1 = _PRECOMPILES[6](data, 100_000)
     ok2, gas2, out2 = _PRECOMPILES[6](data, 100_000)
     assert (ok1, gas1, out1) == (ok2, gas2, out2) and ok1

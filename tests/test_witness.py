@@ -13,6 +13,7 @@ from reth_tpu.engine.stateless import (
 from reth_tpu.engine.witness import ExecutionWitness, generate_witness
 from reth_tpu.evm import EvmConfig
 from reth_tpu.primitives import Account
+from reth_tpu.primitives.types import Header
 from reth_tpu.primitives.keccak import keccak256, keccak256_batch_np
 from reth_tpu.stages import Pipeline, default_stages
 from reth_tpu.storage import MemDb, ProviderFactory
@@ -119,6 +120,74 @@ def test_incomplete_witness_detected():
     chain = StatelessChain(config=EvmConfig(chain_id=builder.chain_id))
     with pytest.raises(StatelessValidationError):
         chain.validate(block, w, builder.genesis)
+
+
+# PUSH0 CALLDATALOAD BLOCKHASH PUSH0 SSTORE STOP — stores BLOCKHASH(word0)
+BLOCKHASH_CODE = bytes.fromhex("5f35405f5500")
+
+
+def _blockhash_chain():
+    """Chain whose last block SSTOREs BLOCKHASH(n-3) — the witness must ship
+    the ancestor headers down to that depth or stateless replay computes 0."""
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)},
+                           committer=CPU)
+    builder.build_block([alice.deploy(initcode_for(BLOCKHASH_CODE))])
+    contract = [a for a, acc in builder.accounts.items()
+                if builder.codes.get(acc.code_hash) == BLOCKHASH_CODE][0]
+    builder.build_block([alice.transfer(b"\x0c" * 20, 1)])
+    builder.build_block([alice.transfer(b"\x0c" * 20, 2)])
+    # block 4 reads BLOCKHASH(1): depth 3 — beyond just the parent header
+    builder.build_block([alice.call(contract, (1).to_bytes(32, "big"))])
+    return builder
+
+
+def _blockhash_witness():
+    """(builder, block-4, its witness) with the chain synced to block 3."""
+    builder = _blockhash_chain()
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 builder.storage_at_genesis, builder.codes_at_genesis,
+                 committer=CPU)
+    import_chain(factory, builder.blocks[1:4], EthBeaconConsensus(CPU))
+    Pipeline(factory, default_stages(committer=CPU)).run(3)
+    block = builder.blocks[4]
+    with factory.provider() as p:
+        w = generate_witness(p, block, CPU,
+                             parent_header=builder.blocks[3].header,
+                             config=EvmConfig(chain_id=builder.chain_id))
+    return builder, block, w
+
+
+def test_witness_ships_blockhash_ancestor_headers():
+    builder, block, w = _blockhash_witness()
+    # parent (3) + ancestors 2 and 1: the chain down to the read number
+    assert len(w.headers) == 3
+    chain = StatelessChain(config=EvmConfig(chain_id=builder.chain_id))
+    root = chain.validate(block, w, builder.blocks[3].header)
+    assert root == block.header.state_root
+
+
+def test_stateless_rejects_unlinked_witness_headers():
+    builder, block, w = _blockhash_witness()
+    import dataclasses
+    cfg = EvmConfig(chain_id=builder.chain_id)
+    # (a) ancestor header replaced by a forged one: linkage check trips
+    forged = dataclasses.replace(
+        Header.decode(w.headers[1]), state_root=b"\xfe" * 32)
+    w_forged = ExecutionWitness(state=w.state, codes=w.codes, keys=w.keys,
+                                headers=[w.headers[0], forged.encode(),
+                                         w.headers[2]])
+    with pytest.raises(StatelessValidationError, match="hash-linked"):
+        StatelessChain(config=cfg).validate(
+            block, w_forged, builder.blocks[3].header)
+    # (b) an extra header outside the ancestor chain: rejected outright
+    stray = dataclasses.replace(builder.blocks[2].header, number=9999)
+    w_stray = ExecutionWitness(state=w.state, codes=w.codes, keys=w.keys,
+                               headers=list(w.headers) + [stray.encode()])
+    with pytest.raises(StatelessValidationError, match="not in ancestor"):
+        StatelessChain(config=cfg).validate(
+            block, w_stray, builder.blocks[3].header)
 
 
 def test_witness_includes_touched_codes():
