@@ -113,7 +113,7 @@ def cmd_init(args):
     cfg = NodeConfig(
         chain_id=chain_id, datadir=args.datadir, genesis_header=header,
         genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes,
-        chain_spec=chain_spec,
+        chain_spec=chain_spec, db_backend=_resolve_backend(args),
     )
     node = Node(cfg, committer=committer)
     node.factory.db.flush()
@@ -132,7 +132,7 @@ def cmd_import(args):
     header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(args.genesis, committer)
     cfg = NodeConfig(chain_id=chain_id, datadir=args.datadir, genesis_header=header,
                      genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes,
-                     chain_spec=chain_spec)
+                     chain_spec=chain_spec, db_backend=_resolve_backend(args))
     node = Node(cfg, committer=committer)
     raw = Path(args.file).read_bytes()
     blocks = []
@@ -163,7 +163,7 @@ def cmd_import_era(args):
     header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(args.genesis, committer)
     cfg = NodeConfig(chain_id=chain_id, datadir=args.datadir, genesis_header=header,
                      genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes,
-                     chain_spec=chain_spec)
+                     chain_spec=chain_spec, db_backend=_resolve_backend(args))
     node = Node(cfg, committer=committer)
     consensus = EthBeaconConsensus(node.committer)
     if args.source:
@@ -192,9 +192,9 @@ def cmd_import_era(args):
 
 def cmd_export_era(args):
     from .era import export_era
-    from .storage import MemDb, ProviderFactory
+    from .storage import ProviderFactory
 
-    factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
+    factory = ProviderFactory(_open_db(args))
     n = export_era(factory, args.first, args.last, args.file)
     print(f"exported {n} blocks to {args.file}")
     return 0
@@ -204,6 +204,13 @@ def cmd_node(args):
     from .node import Node, NodeConfig
 
     committer = _make_committer(args)
+    backend = _resolve_backend(args)
+    if args.db_backend in ("paged", "native") and not args.datadir:
+        print(f"error: --db {args.db_backend} is a persistent engine and "
+              "needs --datadir", file=sys.stderr)
+        return 1
+    if not args.datadir:
+        backend = "memdb"  # ephemeral node: in-process store
     kw = {}
     if args.genesis:
         header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(args.genesis, committer)
@@ -220,10 +227,15 @@ def cmd_node(args):
                   chain_spec=chain_spec)
         print(f"dev genesis: funded key 0x{DEV_PRIVATE_KEY:064x}")
     else:
-        from .storage import MemDb
+        # no genesis given: the datadir must already be initialised. The
+        # persistent engines are probed by their on-disk artifacts (opening
+        # them here would double-open the store the Node is about to own).
+        initialised = False
+        if args.datadir:
+            from .storage import store_initialised
 
-        db_probe = MemDb(Path(args.datadir) / "db.bin") if args.datadir else None
-        if db_probe is None or not db_probe._tables:
+            initialised = store_initialised(backend, args.datadir)
+        if not initialised:
             print("error: no genesis — pass --genesis or run `init`, or use --dev",
                   file=sys.stderr)
             return 1
@@ -242,7 +254,7 @@ def cmd_node(args):
                      nat=args.nat,
                      bootnodes=tuple(args.bootnodes.split(",")) if args.bootnodes else (),
                      bootnodes_v5=tuple(args.bootnodes_v5.split(",")) if args.bootnodes_v5 else (),
-                     db_backend=args.db_backend,
+                     db_backend=backend,
                      **kw)
     node = Node(cfg, committer=committer)
     p2p_port = node.start_network()
@@ -308,22 +320,31 @@ def cmd_node(args):
     return 1 if errors else 0
 
 
+def _resolve_backend(args) -> str:
+    """Pick the storage backend: an explicit --db always wins; otherwise a
+    datadir that already holds a store keeps its engine (legacy datadirs
+    must never silently open a brand-new empty default store); otherwise
+    the paged default."""
+    from .storage import store_initialised
+
+    explicit = getattr(args, "db_backend", None)
+    if explicit:
+        return explicit
+    datadir = getattr(args, "datadir", None)
+    if datadir:
+        for b in ("paged", "native", "memdb"):
+            if store_initialised(b, datadir):
+                return b
+    return "paged"
+
+
 def _open_db(args):
     """Open the datadir's database with the selected backend (reference:
     the database args shared by every offline command)."""
-    from .storage import MemDb
+    from .storage import open_database
 
     Path(args.datadir).mkdir(parents=True, exist_ok=True)
-    backend = getattr(args, "db_backend", "memdb")
-    if backend == "native":
-        from .storage.native import NativeDb
-
-        return NativeDb(Path(args.datadir) / "nativedb")
-    if backend == "paged":
-        from .storage.native import PagedDb
-
-        return PagedDb(Path(args.datadir) / "pageddb")
-    return MemDb(Path(args.datadir) / "db.bin")
+    return open_database(_resolve_backend(args), args.datadir)
 
 
 def cmd_db_get(args):
@@ -368,7 +389,7 @@ def cmd_db_diff(args):
 
     db_a = _open_db(args)
     db_b = _open_db(_ap.Namespace(datadir=args.other,
-                                  db_backend=getattr(args, "db_backend", "memdb")))
+                                  db_backend=getattr(args, "db_backend", None)))
     tables = args.table.split(",") if args.table else None
     differences = 0
     with db_a.tx() as ta, db_b.tx() as tb:
@@ -666,9 +687,9 @@ def cmd_db_stats(args):
 
 def cmd_stage_run(args):
     from .stages import Pipeline, default_stages
-    from .storage import MemDb, ProviderFactory
+    from .storage import ProviderFactory
 
-    factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
+    factory = ProviderFactory(_open_db(args))
     committer = _make_committer(args)
     stages = [s for s in default_stages(committer=committer)
               if args.stage in ("all", s.id)]
@@ -694,10 +715,10 @@ def cmd_prune(args):
     """Run the pruner once to the configured targets (reference `reth prune`)."""
     from .config import load_config
     from .prune import Pruner
-    from .storage import MemDb, ProviderFactory
+    from .storage import ProviderFactory
 
     cfg = load_config(args.config)
-    factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
+    factory = ProviderFactory(_open_db(args))
     pruner = Pruner(factory, cfg.prune)
     with factory.provider() as p:
         tip = p.last_block_number()
@@ -715,10 +736,10 @@ def cmd_re_execute(args):
     from .consensus import EthBeaconConsensus
     from .evm import BlockExecutor, EvmConfig
     from .evm.executor import ProviderStateSource
-    from .storage import MemDb, ProviderFactory
+    from .storage import ProviderFactory
     from .storage.historical import HistoricalStateProvider
 
-    factory = ProviderFactory(MemDb(Path(args.datadir) / "db.bin"))
+    factory = ProviderFactory(_open_db(args))
     mismatches = 0
     with factory.provider() as p:
         tip = p.last_block_number()
@@ -797,10 +818,22 @@ def main(argv=None) -> int:
                        help="keccak backend: device (TPU/XLA, the "
                             "--state-root.backend analogue) or cpu (numpy)")
 
+    def add_db_arg(p):
+        # paged (the COW B+tree / MDBX analogue) is the DEFAULT everywhere
+        # a datadir exists — memdb is a test fixture (reference: libmdbx is
+        # the only production backend)
+        p.add_argument("--db", dest="db_backend",
+                       choices=["memdb", "native", "paged"], default=None,
+                       help="storage backend (paged = mmap COW B+tree "
+                            "engine, the default; native = C++ WAL engine; "
+                            "memdb = in-process test store). Unset: an "
+                            "initialised datadir keeps its engine")
+
     p = sub.add_parser("init", help="initialise the database from a genesis file")
     p.add_argument("--datadir", required=True)
     p.add_argument("--genesis", required=True)
     add_hasher(p)
+    add_db_arg(p)
     p.set_defaults(fn=cmd_init)
 
     p = sub.add_parser("import", help="import an RLP chain file and sync")
@@ -808,6 +841,7 @@ def main(argv=None) -> int:
     p.add_argument("--genesis", required=True)
     p.add_argument("file")
     add_hasher(p)
+    add_db_arg(p)
     p.set_defaults(fn=cmd_import)
 
     p = sub.add_parser("import-era", help="import era1 history archives")
@@ -818,6 +852,7 @@ def main(argv=None) -> int:
     p.add_argument("--source", default=None,
                    help="directory of era1 archives + index.txt checksums")
     add_hasher(p)
+    add_db_arg(p)
     p.set_defaults(fn=cmd_import_era)
 
     p = sub.add_parser("export-era", help="export canonical blocks to era1")
@@ -825,6 +860,7 @@ def main(argv=None) -> int:
     p.add_argument("--first", type=int, required=True)
     p.add_argument("--last", type=int, required=True)
     p.add_argument("file")
+    add_db_arg(p)
     p.set_defaults(fn=cmd_export_era)
 
     p = sub.add_parser("node", help="run the node (RPC + engine API)")
@@ -853,10 +889,7 @@ def main(argv=None) -> int:
                    help="comma-separated enr:... records (discv5)")
     p.add_argument("--nat", default="any",
                    help="NAT resolution: any | none | extip:<ip> | upnp | natpmp")
-    p.add_argument("--db", dest="db_backend", choices=["memdb", "native", "paged"],
-                   default="memdb",
-                   help="storage backend (native = C++ WAL engine, "
-                        "paged = mmap COW B+tree engine)")
+    add_db_arg(p)
     p.add_argument("--ethstats", default=None,
                    help="report to an ethstats server (node:secret@host:port)")
     add_hasher(p)
@@ -868,6 +901,7 @@ def main(argv=None) -> int:
     p = sub.add_parser("prune", help="prune history per the config's targets")
     p.add_argument("--datadir", required=True)
     p.add_argument("--config", default=None, help="reth.toml path")
+    add_db_arg(p)
     p.set_defaults(fn=cmd_prune)
 
     p = sub.add_parser("re-execute",
@@ -876,6 +910,7 @@ def main(argv=None) -> int:
     p.add_argument("--datadir", required=True)
     p.add_argument("--from", dest="from_block", type=int, default=None)
     p.add_argument("--to", dest="to_block", type=int, default=None)
+    add_db_arg(p)
     p.set_defaults(fn=cmd_re_execute)
 
     p = sub.add_parser("p2p", help="fetch a header/body from a peer")
@@ -891,8 +926,7 @@ def main(argv=None) -> int:
 
     def add_db_args(sp):
         sp.add_argument("--datadir", required=True)
-        sp.add_argument("--db", dest="db_backend",
-                        choices=["memdb", "native", "paged"], default="memdb")
+        add_db_arg(sp)
 
     ps = dbsub.add_parser("stats")
     add_db_args(ps)
@@ -929,8 +963,7 @@ def main(argv=None) -> int:
                        help="initialise from a state dump at a block")
     p.add_argument("state", help="state dump JSON")
     p.add_argument("--datadir", required=True)
-    p.add_argument("--db", dest="db_backend",
-                   choices=["memdb", "native", "paged"], default="memdb")
+    add_db_arg(p)
     add_hasher(p)
     p.set_defaults(fn=cmd_init_state)
 
@@ -957,6 +990,7 @@ def main(argv=None) -> int:
     pr.add_argument("--stage", default="all")
     pr.add_argument("--to", type=int, default=None)
     add_hasher(pr)
+    add_db_arg(pr)
     pr.set_defaults(fn=cmd_stage_run)
 
     args = parser.parse_args(argv)

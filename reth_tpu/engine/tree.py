@@ -73,6 +73,7 @@ class EngineTree:
         unwinder=None,
         invalid_block_hooks: list | None = None,
         bal_execution: bool = False,
+        state_root_strategy: str = "sparse",
     ):
         self.factory = factory
         self.committer = committer or TrieCommitter()
@@ -99,6 +100,17 @@ class EngineTree:
         # waves (reference payload_processor/bal/execute.rs)
         self.bal_execution = bal_execution
         self.last_bal_stats = None
+        # live-tip state-root strategy: "sparse" overlaps the WHOLE trie
+        # job with execution via a background proof-fetch + reveal task
+        # (reference state_root_strategy/sparse_trie.rs); anything else
+        # runs the prehash-only pipelined worker + incremental committer.
+        # The sparse path falls back to the incremental committer on any
+        # SparseRootError (reference config.rs:140 state_root_fallback).
+        self.state_root_strategy = state_root_strategy
+        from ..trie.sparse import PreservedSparseTrie
+
+        self.preserved_trie = PreservedSparseTrie()
+        self.last_sparse = None  # per-block strategy stats (tests/metrics)
         if unwinder is None:
             def unwinder(fac, target):
                 from ..stages import Pipeline, default_stages
@@ -207,7 +219,8 @@ class EngineTree:
             parent = self._header_of(block.header.parent_hash, overlay)
             self.consensus.validate_header_against_parent(block.header, parent)
             self.consensus.validate_block_pre_execution(block)
-            status, senders, receipts = self._execute_into_overlay(block, overlay)
+            status, senders, receipts = self._execute_into_overlay(
+                block, overlay, parent_layers)
         except (ConsensusError, InvalidTransaction) as e:
             self.invalid[h] = str(e)
             self._run_invalid_hooks(block, str(e))
@@ -230,7 +243,8 @@ class EngineTree:
         return overlay.header_by_number(n)
 
     def _execute_into_overlay(
-        self, block: Block, overlay: DatabaseProvider
+        self, block: Block, overlay: DatabaseProvider,
+        parent_layers: list[Layer] | None = None,
     ) -> tuple[PayloadStatus, list[bytes], list]:
         """Execute + hash + root-check ``block``, writing into the overlay.
 
@@ -293,12 +307,29 @@ class EngineTree:
             # In BAL mode the pass is joined first instead — its recorded
             # access sets become the wave schedule.
             self.last_prewarm.start(block.transactions, senders)
-        # pipelined root: a worker batch-hashes dirty keys on the device
-        # WHILE execution runs (reference state_root_task / sparse_trie
-        # strategy overlap; see engine/pipelined_root.py)
-        from .pipelined_root import PipelinedStateRoot
+        # background state-root job overlapping execution: the sparse
+        # strategy streams touched keys to a proof-fetch + reveal worker
+        # so the whole trie job (hash, walk, reveal) overlaps the EVM
+        # (reference state_root_strategy/sparse_trie.rs:126-259 +
+        # state_root_task.rs:20-100); the pipelined strategy overlaps key
+        # prehash only (engine/pipelined_root.py)
+        self.last_sparse = None
+        sparse_task = None
+        root_job = None
+        if self.state_root_strategy == "sparse":
+            sparse_task = self._start_sparse_root(block, parent_layers)
+        if sparse_task is None:
+            from .pipelined_root import PipelinedStateRoot
 
-        root_job = PipelinedStateRoot(self.committer.hasher)
+            root_job = PipelinedStateRoot(self.committer.hasher)
+        state_hook = (sparse_task or root_job).on_state_update
+
+        def _abort_root_job():
+            if sparse_task is not None:
+                sparse_task.abort()
+            else:
+                root_job.finish([])
+
         use_bal = (self.bal_execution and self.last_prewarm is not None
                    and self.last_prewarm.record_accesses)
         try:
@@ -311,12 +342,12 @@ class EngineTree:
                     for i in sorted(self.last_prewarm.accesses)])
                 out, self.last_bal_stats = execute_block_bal(
                     executor.source, block, senders, hint, self.config,
-                    state_hook=root_job.on_state_update, block_hashes=hashes)
+                    state_hook=state_hook, block_hashes=hashes)
             else:
                 out = executor.execute(block, senders, hashes,
-                                       state_hook=root_job.on_state_update)
+                                       state_hook=state_hook)
         except BaseException:
-            root_job.finish([])  # never leak the worker thread
+            _abort_root_job()  # never leak the worker thread
             if self.last_prewarm is not None:
                 self.last_prewarm.join()
             raise
@@ -325,7 +356,7 @@ class EngineTree:
         try:
             self.consensus.validate_block_post_execution(block, out.receipts, out.gas_used)
         except ConsensusError as e:
-            root_job.finish([])
+            _abort_root_job()
             self.invalid[block.hash] = str(e)
             self._run_invalid_hooks(block, str(e), out)
             return PayloadStatus(PayloadStatusKind.INVALID, None, str(e)), [], []
@@ -336,9 +367,12 @@ class EngineTree:
         for i, s in enumerate(senders):
             overlay.put_sender(idx.first_tx_num + i, s)
         write_execution_output(overlay, n, idx.first_tx_num, out)
-        # hashed-state delta + incremental root (the state-root job)
+        # hashed-state delta + state root (the state-root job)
         t0 = _time.time()
-        root = self._state_root_job(overlay, out, root_job)
+        if sparse_task is not None:
+            root = self._sparse_root_or_fallback(overlay, out, sparse_task)
+        else:
+            root = self._state_root_job(overlay, out, root_job)
         self._root_histogram.record(_time.time() - t0)
         self._blocks_counter.increment()
         if root != header.state_root:
@@ -349,6 +383,10 @@ class EngineTree:
             self.invalid[block.hash] = msg
             self._run_invalid_hooks(block, msg, out, computed_root=root)
             return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
+        if sparse_task is not None and self.last_sparse.get("strategy") == "sparse":
+            # preserve only AFTER the root matched: a trie mutated by an
+            # invalid block would poison the next payload's anchor
+            sparse_task.preserve(block.hash)
         # advance the execution cache: invalidate this block's writes and
         # anchor the warm cache on the new tip
         self.execution_cache.on_block_applied(out.changes)
@@ -384,7 +422,22 @@ class EngineTree:
             digests = self.committer.hasher(addrs + [s for _, s in slot_pairs])
             haddr = dict(zip(addrs, digests[: len(addrs)]))
             hslots = digests[len(addrs) :]
-        # write hashed tables (live-tip equivalent of the hashing stages)
+        hslot = {s: hs for (_, s), hs in zip(slot_pairs, hslots)}
+        changed_accts, changed_storages, wiped_hashed = \
+            self._write_hashed_tables(overlay, out, haddr, hslot)
+        inc = IncrementalStateRoot(overlay, self.committer)
+        return inc.compute(changed_accts, changed_storages, wiped_hashed)
+
+    def _write_hashed_tables(self, overlay: DatabaseProvider, out,
+                             haddr, hslot):
+        """Hashed-table writes shared by BOTH root strategies (the live-tip
+        equivalent of the hashing stages) — one code path so the sparse and
+        incremental strategies can never write different hashed state.
+        Returns (changed_hashed_accounts, changed_hashed_storages,
+        wiped_hashed) for the incremental committer."""
+        changes = out.changes
+        addrs = sorted(set(changes.accounts) | set(changes.storage)
+                       | set(changes.wiped_storage))
         for a in addrs:
             if a in out.post_accounts:
                 overlay.put_hashed_account(haddr[a], out.post_accounts[a])
@@ -393,12 +446,93 @@ class EngineTree:
             wiped_hashed.add(haddr[a])
             overlay.clear_hashed_storage(haddr[a])
         changed_hashed_storages: dict[bytes, set[bytes]] = {}
-        for (a, s), hs in zip(slot_pairs, hslots):
-            overlay.put_hashed_storage(haddr[a], hs, out.post_storage[a][s])
-            changed_hashed_storages.setdefault(haddr[a], set()).add(hs)
+        for a, slots in out.post_storage.items():
+            for s, v in slots.items():
+                overlay.put_hashed_storage(haddr[a], hslot[s], v)
+                changed_hashed_storages.setdefault(haddr[a], set()).add(hslot[s])
         changed_hashed_accounts = {haddr[a] for a in changes.accounts}
-        inc = IncrementalStateRoot(overlay, self.committer)
-        return inc.compute(changed_hashed_accounts, changed_hashed_storages, wiped_hashed)
+        return changed_hashed_accounts, changed_hashed_storages, wiped_hashed
+
+    def _start_sparse_root(self, block: Block, parent_layers):
+        """Launch the background sparse-trie root task over the PARENT
+        view (its proof worker reads concurrently with execution, so it
+        gets its own transaction + overlay — never the in-progress layer).
+
+        Reference analogue: spawning SparseTrieCacheTask per payload
+        (crates/engine/tree/src/tree/state_root_strategy/sparse_trie.rs:126).
+        """
+        from .sparse_root import SparseRootTask
+
+        if parent_layers is None:
+            return None
+        try:
+            parent_provider = DatabaseProvider(
+                OverlayTx(self.factory.db.tx(), parent_layers))
+            parent = self._header_of(block.header.parent_hash, parent_provider)
+            return SparseRootTask(
+                parent_provider, parent.state_root, self.preserved_trie,
+                self.committer, parent_hash=block.header.parent_hash)
+        except Exception:  # noqa: BLE001 — strategy startup must never
+            # fail the payload; the pipelined+incremental path covers it
+            return None
+
+    def _sparse_root_or_fallback(self, overlay: DatabaseProvider, out,
+                                 task) -> bytes:
+        """Close the sparse root job; on any SparseRootError rerun the
+        block's root with the incremental committer (reference
+        `state_root_fallback`, crates/engine/primitives/src/config.rs:140).
+        All overlay writes happen only after the sparse path fully
+        succeeded, so the fallback starts from a clean layer."""
+        from .sparse_root import SparseRootError
+
+        try:
+            root, digest_map, storage_roots = task.finish(out)
+            acct_updates, storage_updates = task.export_updates(out, digest_map)
+        except SparseRootError as e:
+            self.last_sparse = {"strategy": "fallback", "error": str(e)}
+            return self._state_root_job(overlay, out, None)
+        self.last_sparse = {
+            "strategy": "sparse", "reused": task.reused,
+            "proof_batches": task.proof_batches,
+        }
+        self._write_sparse_output(overlay, out, digest_map, storage_roots,
+                                  acct_updates, storage_updates)
+        return root
+
+    def _write_sparse_output(self, overlay: DatabaseProvider, out,
+                             digest_map, storage_roots,
+                             acct_updates, storage_updates) -> None:
+        """Mirror the sparse job's results into the overlay layer: hashed
+        tables (live-tip equivalent of the hashing stages) and stored
+        branch nodes straight from the sparse trie — no DB re-walk
+        (reference: sparse trie TrieUpdates application)."""
+        changes = out.changes
+        addrs = sorted(set(changes.accounts) | set(changes.storage)
+                       | set(changes.wiped_storage))
+        haddr = {a: digest_map[a] for a in addrs}
+        self._write_hashed_tables(overlay, out, haddr, digest_map)
+        # merkle-layer invariant: HashedAccounts carries the CURRENT root
+        for a, sroot in storage_roots.items():
+            acct = overlay.hashed_account(haddr[a])
+            if acct is not None and acct.storage_root != sroot:
+                overlay.put_hashed_account(
+                    haddr[a], acct.with_(storage_root=sroot),
+                    preserve_storage_root=False)
+        # wiped storage tries: drop every stale stored branch first; the
+        # recreated trie's updates (if any) follow below
+        for a in changes.wiped_storage:
+            overlay.delete_storage_branches_with_prefix(haddr[a], b"")
+        for path, node in acct_updates.items():
+            if node is None:
+                overlay.delete_account_branch(path)
+            else:
+                overlay.put_account_branch(path, node)
+        for ha, upd in storage_updates.items():
+            for path, node in upd.items():
+                if node is None:
+                    overlay.delete_storage_branch(ha, path)
+                else:
+                    overlay.put_storage_branch(ha, path, node)
 
     # -- forkchoice ------------------------------------------------------------
 
@@ -478,6 +612,7 @@ class EngineTree:
         self.head_hash = self.persisted_hash
         # in-memory tree entries built on the old chain are now stale
         self.blocks.clear()
+        self.preserved_trie.invalidate()
 
     def _notify_canon_change(self):
         chain = [self.blocks[h] for h in self.canonical_chain()]

@@ -20,7 +20,7 @@ from ..pool import TransactionPool
 from ..primitives.types import Account, Header
 from ..rpc import EngineApi, EthApi, RpcServer
 from ..rpc.net import NetApi, TxpoolApi, Web3Api
-from ..storage import MemDb, ProviderFactory
+from ..storage import ProviderFactory
 from ..storage.genesis import init_genesis
 from ..trie.committer import TrieCommitter
 
@@ -43,7 +43,10 @@ class NodeConfig:
     prune_modes: object | None = None  # PruneModes | None
     jwt_secret: bytes | None = None   # engine-port JWT (auto from datadir)
     chain_spec: object | None = None  # ChainSpec: hardfork schedule + fork ids
-    db_backend: str = "memdb"         # memdb | native (C++ WAL) | paged (COW B+tree)
+    # memdb | native (C++ WAL) | paged (COW B+tree, the default — the MDBX
+    # analogue, reference StorageSettings). An ephemeral node (datadir None)
+    # silently runs memdb: the persistent engines need a directory.
+    db_backend: str = "paged"
     ws_port: int | None = None        # WebSocket RPC (None disables; 0 = any)
     ipc_path: str | None = None       # Unix-socket RPC (None disables)
     enable_admin: bool = False        # admin_ is node control: explicit opt-in
@@ -79,25 +82,15 @@ class Node:
             self.tasks.shutdown.signal()
 
         self.tasks = TaskExecutor(on_critical_failure=_critical_failed)
-        db_path = Path(config.datadir) / "db.bin" if config.datadir else None
         # storage-settings switch (reference: the database args picking the
         # backing store): "memdb" = in-process store with snapshot file,
         # "native" = the C++ WAL engine (native/kvstore.cpp), "paged" = the
         # mmap copy-on-write B+tree engine (native/pagedkv.cpp, the MDBX
         # architecture analogue — reference StorageSettings backend choice)
-        if config.db_backend == "native":
-            from ..storage.native import NativeDb
+        from ..storage import open_database
 
-            native_dir = Path(config.datadir) / "nativedb" if config.datadir else None
-            self.factory = ProviderFactory(NativeDb(native_dir))
-        elif config.db_backend == "paged":
-            from ..storage.native import PagedDb
-
-            if not config.datadir:
-                raise ValueError("--db paged requires --datadir (persistent engine)")
-            self.factory = ProviderFactory(PagedDb(Path(config.datadir) / "pageddb"))
-        else:
-            self.factory = ProviderFactory(MemDb(db_path))
+        self.factory = ProviderFactory(
+            open_database(config.db_backend, config.datadir))
         if config.genesis_header is not None:
             init_genesis(
                 self.factory, config.genesis_header, config.genesis_alloc,

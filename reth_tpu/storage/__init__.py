@@ -13,7 +13,53 @@ from .kv import Database, Tx, Cursor, MemDb
 from .tables import Tables, TableDef
 from .provider import ProviderFactory, DatabaseProvider
 
+# backend name -> on-disk store name inside a datadir (the single source
+# of truth shared by the CLI, the node builder, and tests)
+DB_STORES = {"memdb": "db.bin", "native": "nativedb", "paged": "pageddb"}
+
+
+def db_store_path(backend: str, datadir):
+    from pathlib import Path
+
+    return Path(datadir) / DB_STORES[backend]
+
+
+def store_initialised(backend: str, datadir) -> bool:
+    """True when ``datadir`` holds a store for ``backend`` that has ever
+    been WRITTEN — mere directory existence is not enough, because every
+    engine creates its files as a side effect of an open (a stale
+    auto-created empty store must never mask an initialised one)."""
+    path = db_store_path(backend, datadir)
+    if backend == "memdb":  # snapshot file written on first flush
+        return path.is_file() and path.stat().st_size > 0
+    if backend == "paged":  # fresh store = the two 4 KiB meta pages only
+        data = path / "data.rtpg"
+        return data.is_file() and data.stat().st_size > 2 * 4096
+    if backend == "native":  # a compacted snapshot or a non-empty WAL
+        snap, wal = path / "snapshot.rtkv", path / "wal.rtkv"
+        return snap.is_file() or (wal.is_file() and wal.stat().st_size > 0)
+    return False
+
+
+def open_database(backend: str, datadir):
+    """Open (creating if absent) the store for ``backend`` in ``datadir``.
+    ``datadir`` None yields an ephemeral MemDb regardless of backend (the
+    persistent engines need a directory)."""
+    if backend == "native" and datadir is not None:
+        from .native import NativeDb
+
+        return NativeDb(db_store_path(backend, datadir))
+    if backend == "paged" and datadir is not None:
+        from .native import PagedDb
+
+        return PagedDb(db_store_path(backend, datadir))
+    return MemDb(db_store_path("memdb", datadir) if datadir else None)
+
+
 __all__ = [
+    "DB_STORES",
+    "db_store_path",
+    "open_database",
     "Database",
     "Tx",
     "Cursor",

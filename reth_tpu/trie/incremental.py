@@ -68,9 +68,15 @@ class PrefixSet:
 
 @dataclass
 class SubtriePlan:
-    """The walker's output for one trie: how to rebuild it."""
+    """The walker's output for one trie: how to rebuild it.
 
-    boundaries: dict[Nibbles, bytes] = field(default_factory=dict)
+    ``boundaries`` values are ``(subtree_hash, has_branch)`` tuples: the
+    32-byte unchanged-subtree hash plus whether that subtree contains
+    stored branch nodes (drives the rebuilt parent's exact ``tree_mask``;
+    ``commit_many`` also accepts bare hashes, conservatively treated as
+    branch-containing)."""
+
+    boundaries: dict[Nibbles, tuple[bytes, bool]] = field(default_factory=dict)
     dirty_ranges: list[Nibbles] = field(default_factory=list)
     touched_branch_paths: list[Nibbles] = field(default_factory=list)
 
@@ -95,7 +101,13 @@ def plan_subtrie(get_branch, prefix_set: PrefixSet) -> SubtriePlan:
             elif child_exists:
                 h = stored.child_hash(nib)
                 if h is not None:
-                    plan.boundaries[child] = h
+                    # carry the stored tree_mask bit so the rebuilt parent's
+                    # tree_mask stays EXACT (a bare hash would be treated as
+                    # branch-containing, conservatively over-setting bits —
+                    # the sparse-trie export computes exact bits, and the two
+                    # paths must produce byte-identical stored nodes)
+                    plan.boundaries[child] = (
+                        h, bool((stored.tree_mask >> nib) & 1))
                 else:
                     # inline child (small subtree): cheap re-scan
                     plan.dirty_ranges.append(child)

@@ -492,25 +492,52 @@ def export_branch_updates(trie: SparseTrie, changed_keys: list[bytes],
 
     For every prefix of every changed key path, returns
     ``{path: BranchNode}`` where the trie holds a branch, and
-    ``{path: None}`` (a delete marker) where it no longer does. Only
-    prefixes of changed keys can have changed stored nodes — a branch's
-    content changes only when a descendant leaf does. MUST be called after
-    ``root_hash_compute`` (child refs must be clean).
+    ``{path: None}`` (a delete marker) where it no longer does BUT the
+    pre-state did (``old_branch(path)`` resolves) — a collapsed branch may
+    sit deeper than the post-update walk reaches (a delete that merges a
+    long extension), so every prefix is checked against the pre-state
+    rather than guessing from walk depth; prefixes that never held a
+    stored branch produce nothing. Only prefixes of changed keys can have
+    changed stored nodes — a branch's content changes only when a
+    descendant leaf does. MUST be called after ``root_hash_compute``
+    (child refs must be clean).
 
-    ``old_branch(path)`` resolves the pre-state stored branch — used only
+    ``old_branch(path)`` resolves the pre-state stored branch — also used
     to carry over ``tree_mask`` bits for blinded children (their subtrees
     are untouched by definition, so the old bit is still exact).
     """
     from .committer import BranchNode
 
     out: dict[bytes, BranchNode | None] = {}
-    seen_prefixes: set[bytes] = set()
     branches: dict[bytes, _Branch] = {}
+    old_cache: dict[bytes, object] = {}
+
+    def old_at(path: bytes):
+        if path not in old_cache:
+            old_cache[path] = old_branch(path) if old_branch is not None else None
+        return old_cache[path]
+
+    # Which prefixes can hold a STALE stored branch (needing a delete
+    # marker)? Only pre-state branch paths along a changed key. Probing all
+    # 64 prefixes of every key is sound but wasteful; three sound cuts:
+    # (a) a stored branch whose tree_mask bit for the key's next nibble is
+    #     CLEAR proves no deeper stored branch exists in that subtree;
+    # (b) for a key still PRESENT post-state, pre-state branches on its
+    #     path never lie deeper than its post-state walk depth — any
+    #     deeper branch that collapsed did so because a sibling key was
+    #     DELETED this block, and the deleted key's own (uncapped) probe
+    #     walk shares that prefix and emits the marker;
+    # (c) one pre-state read per distinct prefix across all keys.
+    probe_caps: dict[bytes, int] = {}
     for key in changed_keys:
         nib = unpack_nibbles(key) if len(key) == 32 else key
         # walk the path, recording branches at their trie paths
         node, depth = trie.root, 0
-        while node is not None and not isinstance(node, (_Blind, _Leaf)):
+        present = False
+        while node is not None and not isinstance(node, _Blind):
+            if isinstance(node, _Leaf):
+                present = node.path == nib[depth:]
+                break
             if isinstance(node, _Ext):
                 if nib[depth:depth + len(node.path)] != node.path:
                     break
@@ -520,8 +547,23 @@ def export_branch_updates(trie: SparseTrie, changed_keys: list[bytes],
             branches[nib[:depth]] = node
             node = node.children[nib[depth]]
             depth += 1
-        for plen in range(0, 64):
-            seen_prefixes.add(nib[:plen])
+        probe_caps[nib] = depth + 1 if present else 64
+
+    # cut (a) prunes DELETE-MARKER probing only — every post-state branch
+    # recorded by the walks is emitted unconditionally below, so a new
+    # branch forming deeper than a collapsed (bit-clear) pre-state branch
+    # is never skipped
+    marker_candidates: set[bytes] = set()
+    for nib, cap in probe_caps.items():
+        for plen in range(0, min(cap, 64)):
+            p = nib[:plen]
+            if p in branches:
+                continue  # post-state branch: emitted below, no marker
+            ob = old_at(p)
+            if ob is not None:
+                marker_candidates.add(p)
+                if not (ob.tree_mask >> nib[plen]) & 1:
+                    break  # (a): provably nothing stored deeper pre-state
 
     def subtree_has_branch(child) -> bool | None:
         if isinstance(child, _Branch):
@@ -532,11 +574,10 @@ def export_branch_updates(trie: SparseTrie, changed_keys: list[bytes],
             return False
         return None  # blinded: unknown from the sparse view
 
-    for path in seen_prefixes:
-        br = branches.get(path)
-        if br is None:
-            out[path] = None  # delete marker (collapsed / never a branch)
-            continue
+    for path in marker_candidates:
+        if path not in branches:
+            out[path] = None  # pre-state stored a branch here; gone now
+    for path, br in branches.items():
         state_mask = tree_mask = hash_mask = 0
         hashes: list[bytes] = []
         old = None
@@ -551,7 +592,7 @@ def export_branch_updates(trie: SparseTrie, changed_keys: list[bytes],
                 # blinded child: its subtree is unchanged, so the old
                 # stored node's bit is still exact
                 if not old_resolved:
-                    old = old_branch(path) if old_branch is not None else None
+                    old = old_at(path)
                     old_resolved = True
                 has_branch = bool(old is not None
                                   and (old.tree_mask >> nibble) & 1)

@@ -69,11 +69,13 @@ def test_db_verify_trie(chain_files, capsys):
     assert main(["db", "verify-trie", "--datadir", str(datadir),
                  "--hasher", "cpu"]) == 0
     assert "trie OK at block 3" in capsys.readouterr().out
-    # corrupt a hashed account -> mismatch detected
-    from reth_tpu.storage import MemDb, ProviderFactory
+    # corrupt a hashed account -> mismatch detected (the default engine
+    # is the paged COW B+tree: open the same pageddb the import wrote)
+    from reth_tpu.storage import ProviderFactory
+    from reth_tpu.storage.native import PagedDb
     from reth_tpu.primitives import Account
 
-    factory = ProviderFactory(MemDb(datadir / "db.bin"))
+    factory = ProviderFactory(PagedDb(datadir / "pageddb"))
     with factory.provider_rw() as p:
         p.put_hashed_account(b"\x42" * 32, Account(balance=1))
     factory.db.flush()
@@ -85,7 +87,7 @@ def test_db_verify_trie(chain_files, capsys):
     # corrupt a stored branch node -> structural problem reported
     from reth_tpu.trie.committer import BranchNode
 
-    factory2 = ProviderFactory(MemDb(datadir / "db.bin"))
+    factory2 = ProviderFactory(PagedDb(datadir / "pageddb"))
     with factory2.provider_rw() as p:
         p.put_account_branch(b"\x0a\x0b", BranchNode(0b11, 0, 0b1, (b"\x99" * 32,)))
     factory2.db.flush()
@@ -220,10 +222,10 @@ def test_db_get_list_diff_repair(chain_files, capsys):
                  str(tmp_path / "d2")]) == 0
     assert "0 difference(s)" in capsys.readouterr().out
     # corrupt a trie node, repair restores the root
-    from reth_tpu.storage import MemDb
+    from reth_tpu.storage.native import PagedDb
     from reth_tpu.storage.tables import Tables
 
-    db = MemDb(datadir / "db.bin")
+    db = PagedDb(datadir / "pageddb")
     with db.tx_mut() as tx:
         entry = tx.cursor(Tables.AccountsTrie.name).first()
         tx.put(Tables.AccountsTrie.name, entry[0], b"\x00garbage")
@@ -260,3 +262,46 @@ def test_init_state_and_config_and_vectors(tmp_path, capsys):
     assert len(vecs["accounts"]) == 3
     assert main(["config"]) == 0
     assert "[stages.merkle]" in capsys.readouterr().out
+
+
+def test_legacy_memdb_datadir_keeps_its_engine(chain_files, capsys):
+    """A datadir initialised under --db memdb must keep opening memdb when
+    --db is unset — the paged default must never silently serve a fresh
+    empty store over existing data."""
+    tmp, gpath, cpath, builder = chain_files
+    datadir = tmp / "legacy"
+    datadir.mkdir()
+    assert main(["import", "--datadir", str(datadir), "--genesis", str(gpath),
+                 "--hasher", "cpu", "--db", "memdb", str(cpath)]) == 0
+    capsys.readouterr()
+    # no --db: resolution must find db.bin and read the imported chain
+    assert main(["db", "stats", "--datadir", str(datadir)]) == 0
+    out = capsys.readouterr().out
+    assert "CanonicalHeaders" in out and not (datadir / "pageddb").exists()
+
+
+def test_node_explicit_paged_requires_datadir(capsys):
+    assert main(["node", "--dev", "--db", "paged"]) == 1
+    assert "needs --datadir" in capsys.readouterr().err
+
+
+def test_stale_empty_store_does_not_mask_initialised_one(chain_files, capsys):
+    """An auto-created EMPTY pageddb (left behind by a command run before
+    init) must not win backend resolution over a later-initialised memdb
+    (round-4 review finding)."""
+    tmp, gpath, cpath, builder = chain_files
+    datadir = tmp / "stale"
+    datadir.mkdir()
+    # any offline command against the uninitialised dir creates pageddb/
+    main(["db", "stats", "--datadir", str(datadir)])
+    assert (datadir / "pageddb").exists()
+    capsys.readouterr()
+    assert main(["import", "--datadir", str(datadir), "--genesis", str(gpath),
+                 "--hasher", "cpu", "--db", "memdb", str(cpath)]) == 0
+    capsys.readouterr()
+    assert main(["db", "stats", "--datadir", str(datadir)]) == 0
+    out = capsys.readouterr().out
+    # resolution must pick the written memdb, which holds the chain
+    assert "CanonicalHeaders" in out
+    assert any(line.split() == ["Transactions", "3"]
+               for line in out.splitlines())

@@ -165,3 +165,93 @@ def test_payload_job_empty_fallback():
     pid = svc.new_payload_job(tree.head_hash, PayloadAttributes(timestamp=12))
     block = svc.get_payload(pid)
     assert block is not None and len(block.transactions) == 0
+
+
+def test_pool_rejects_wrong_chain_id():
+    """Wrong-chain txs are rejected at admission (reference
+    EthTransactionValidator chain-id check)."""
+    tree, _pool, alice, bob = make_node()
+    from reth_tpu.pool import PoolConfig
+
+    pool = TransactionPool(lambda: tree.overlay_provider(),
+                           PoolConfig(chain_id=1))
+    pool.base_fee = 10**9
+    with pytest.raises(PoolError, match="wrong chain id"):
+        pool.add_transaction(alice.transfer(bob.address, 1, chain_id=5))
+    # legacy pre-EIP-155 txs carry no chain id and must pass
+    from reth_tpu.primitives.types import Transaction
+
+    legacy = alice.sign_tx(Transaction(
+        tx_type=0, chain_id=None, nonce=alice.nonce - 1, gas_price=10**10,
+        gas_limit=21_000, to=bob.address, value=7,
+    ))
+    assert pool.add_transaction(legacy)
+
+
+def test_remove_invalid_drops_tx_and_sender_index():
+    tree, pool, alice, bob = make_node()
+    h0 = pool.add_transaction(alice.transfer(bob.address, 1))
+    h1 = pool.add_transaction(alice.transfer(bob.address, 2))
+    pool.remove_invalid(h0)
+    assert not pool.contains(h0) and pool.contains(h1)
+    # the sender index dropped the nonce entry too
+    assert 0 not in pool.by_sender[alice.address]
+    # removing an unknown hash is a no-op
+    pool.remove_invalid(b"\x99" * 32)
+    # best_transactions skips the gap: nonce 1 is not yieldable
+    assert [t for t in pool.best_transactions(10**9)] == []
+
+
+def test_remove_invalid_mid_best_transactions():
+    """A consumer may evict txs WHILE iterating best_transactions (the
+    payload builder does exactly this); iteration must not crash and must
+    not yield the evicted tx."""
+    tree, pool, alice, bob = make_node()
+    t0 = alice.transfer(bob.address, 1)
+    t1 = alice.transfer(bob.address, 2)
+    t2 = alice.transfer(bob.address, 3)
+    for t in (t0, t1, t2):
+        pool.add_transaction(t)
+    it = pool.best_transactions(10**9)
+    first = next(it)
+    assert first.hash == t0.hash
+    pool.remove_invalid(t1.hash)  # evict the NEXT nonce mid-iteration
+    rest = list(it)
+    assert [t.hash for t in rest] == []  # nonce gap: t2 not yieldable
+    assert pool.contains(t2.hash)  # but t2 stays pooled
+
+
+def test_builder_evicts_unexecutable_and_skips_failed_sender():
+    """A pooled tx that is provably unexecutable at build time is evicted
+    (reference mark_invalid), and later nonces of the same sender are
+    skipped in this build but kept pooled."""
+    tree, pool, alice, bob = make_node()
+    a0 = alice.transfer(bob.address, 1)
+    # a1 passes admission (alice holds 10**21 now) but will be
+    # underfunded at build time once an external block drains her
+    a1 = alice.transfer(bob.address, 5 * 10**20)
+    a2 = alice.transfer(bob.address, 2)
+    for t in (a0, a1, a2):
+        pool.add_transaction(t)
+    # external block: alice (nonce 0) moves 95% of her balance away —
+    # consumes a0's nonce AND defunds a1; no maintenance pass runs
+    ext = Wallet(ALICE)
+    chain = ChainBuilder(
+        {ext.address: Account(balance=10**21), bob.address: Account(balance=10**20)},
+        committer=CPU,
+    )
+    blk = chain.build_block(
+        [ext.transfer(b"\xcc" * 20, 95 * 10**19, gas_limit=21_000)])
+    from reth_tpu.engine.tree import PayloadStatusKind
+
+    assert tree.on_new_payload(blk).status is PayloadStatusKind.VALID
+    tree.on_forkchoice_updated(blk.hash)
+    assert pool.contains(a1.hash)  # stale txs still pooled
+
+    block, _fees = build_payload(
+        tree, pool, tree.head_hash, PayloadAttributes(timestamp=30))
+    # a1 (now the head nonce) is provably unexecutable -> evicted; a2 is
+    # the same sender's later nonce -> skipped this build but kept pooled
+    assert [t.hash for t in block.transactions] == []
+    assert not pool.contains(a1.hash)  # evicted by the builder
+    assert pool.contains(a2.hash)      # nonce-gapped, kept for a later build

@@ -381,3 +381,56 @@ def test_flashbots_validate_builder_submission(node):
                     "value": _qty(10**30)},
     })
     assert res["status"] == "Invalid" and "payment" in res["validationError"]
+
+
+def test_flashbots_rejects_bogus_block_hash(node):
+    """A submission whose claimed blockHash does not match the payload's
+    sealed header is Invalid (reference validation.rs block-hash check)."""
+    n, alice = node
+    port = n.rpc.port
+    head = rpc(port, "eth_getBlockByNumber", "latest", False)["hash"]
+    fcu = n.engine_api.engine_forkchoiceUpdatedV2(
+        {"headBlockHash": head, "safeBlockHash": head,
+         "finalizedBlockHash": head},
+        {"timestamp": "0x63", "prevRandao": "0x" + "00" * 32,
+         "suggestedFeeRecipient": "0x" + "ee" * 20, "withdrawals": []})
+    payload = n.engine_api.engine_getPayloadV2(
+        fcu["payloadId"])["executionPayload"]
+    payload["blockHash"] = "0x" + "13" * 32
+    res = rpc(port, "flashbots_validateBuilderSubmissionV3", {
+        "executionPayload": payload,
+        "message": {"feeRecipient": "0x" + "ee" * 20, "value": "0x0"},
+    })
+    assert res["status"] == "Invalid"
+    assert "block hash mismatch" in res["validationError"]
+
+
+def test_flashbots_rejects_bogus_state_root(node):
+    """A consistently-sealed payload carrying a WRONG post-state root is
+    Invalid — the relay must re-execute and check the root, exactly like
+    engine newPayload (reference validation.rs full validation)."""
+    from reth_tpu.rpc.engine_api import payload_to_block
+
+    n, alice = node
+    port = n.rpc.port
+    rpc(port, "eth_sendRawTransaction",
+        data(alice.transfer(b"\x0b" * 20, 444).encode()))
+    head = rpc(port, "eth_getBlockByNumber", "latest", False)["hash"]
+    fcu = n.engine_api.engine_forkchoiceUpdatedV2(
+        {"headBlockHash": head, "safeBlockHash": head,
+         "finalizedBlockHash": head},
+        {"timestamp": "0x63", "prevRandao": "0x" + "00" * 32,
+         "suggestedFeeRecipient": "0x" + "ee" * 20, "withdrawals": []})
+    payload = n.engine_api.engine_getPayloadV2(
+        fcu["payloadId"])["executionPayload"]
+    # tamper the state root, then RE-SEAL the claimed hash so the
+    # block-hash check passes and the state-root check must catch it
+    payload["stateRoot"] = "0x" + "37" * 32
+    resealed = payload_to_block(payload, n.tree.committer)
+    payload["blockHash"] = "0x" + resealed.header.hash.hex()
+    res = rpc(port, "flashbots_validateBuilderSubmissionV3", {
+        "executionPayload": payload,
+        "message": {"feeRecipient": "0x" + "ee" * 20, "value": "0x0"},
+    })
+    assert res["status"] == "Invalid"
+    assert "state root mismatch" in res["validationError"]

@@ -19,6 +19,7 @@ from reth_tpu.trie.sparse import (
     PreservedSparseTrie,
     SparseStateTrie,
     SparseTrie,
+    export_branch_updates,
 )
 
 CPU = TrieCommitter(hasher=keccak256_batch_np)
@@ -235,3 +236,91 @@ def test_randomized_churn_parity():
         if step % 10 == 9:
             assert t.root_hash_compute() == naive_trie_root(leaves), step
     assert t.root_hash_compute() == naive_trie_root(leaves)
+
+
+# -- export_branch_updates equivalence --------------------------------------
+
+
+def _committer_branches(leaves):
+    """Ground-truth stored branch nodes for a leaf set (full rebuild).
+    ``leaves`` maps 32-byte keys -> values."""
+    from reth_tpu.primitives.nibbles import unpack_nibbles
+
+    c = TrieCommitter(hasher=keccak256_batch_np)
+    res = c.commit(sorted((unpack_nibbles(k), v) for k, v in leaves.items()))
+    return res.root, dict(res.branch_nodes)
+
+
+def _apply_export(stored, updates):
+    out = dict(stored)
+    for path, node in updates.items():
+        if node is None:
+            out.pop(path, None)
+        else:
+            out[path] = node
+    return out
+
+
+def _run_export_case(pre_leaves, deletes, inserts):
+    """Build pre-state, apply a delete+insert batch through the sparse
+    trie, export updates, and require the applied stored table to equal a
+    post-state full rebuild byte-for-byte."""
+    _, stored_pre = _committer_branches(pre_leaves)
+    trie = SparseTrie()
+    for k, v in pre_leaves.items():
+        trie.update(k, v)
+    trie.root_hash_compute(keccak256_batch_np)
+    post = dict(pre_leaves)
+    for k in deletes:
+        trie.delete(k)
+        post.pop(k)
+    for k, v in inserts.items():
+        trie.update(k, v)
+        post[k] = v
+    root = trie.root_hash_compute(keccak256_batch_np)
+    updates = export_branch_updates(
+        trie, list(deletes) + list(inserts), stored_pre.get)
+    post_root, stored_post = _committer_branches(post)
+    assert root == post_root
+    assert _apply_export(stored_pre, updates) == stored_post
+
+
+def test_export_emits_new_branch_below_collapsed_one():
+    """Regression (round-4 review, CONFIRMED): deleting 3b1.. collapses
+    the pre-state branch at '03' while inserting 3a2.. creates a NEW
+    branch deeper at '03·0a'; the probe-pruning break must not suppress
+    the new branch node's emission."""
+    def k(nibs):  # 32-byte key with the given leading nibbles
+        full = list(nibs) + [0] * (64 - len(nibs))
+        return bytes((full[i] << 4) | full[i + 1] for i in range(0, 64, 2))
+    pre = {
+        k([3, 0xA, 1]): b"v1",
+        k([3, 0xB, 1]): b"v2",
+        k([5, 1]): b"v3",
+    }
+    _run_export_case(pre, deletes=[k([3, 0xB, 1])],
+                     inserts={k([3, 0xA, 2]): b"v4"})
+
+
+def test_export_equivalence_randomized():
+    """Randomized churn: exported updates applied to the pre-state stored
+    table always equal a post-state full rebuild."""
+    rng = np.random.default_rng(7)
+    for case in range(12):
+        n = int(rng.integers(3, 40))
+        keys = [bytes(rng.integers(0, 256, size=32, dtype=np.uint8).tolist())
+                for _ in range(n)]
+        keys = list(dict.fromkeys(keys))
+        # force some shared prefixes so collapses/extensions happen
+        for i in range(1, len(keys), 3):
+            j = int(rng.integers(1, 8))
+            keys[i] = keys[0][:j] + keys[i][j:]
+        keys = list(dict.fromkeys(keys))
+        pre = {kk: bytes([65 + j % 26]) * 3 for j, kk in enumerate(keys)}
+        dels = [kk for j, kk in enumerate(keys) if j % 4 == 1]
+        ins = {bytes(rng.integers(0, 256, size=32, dtype=np.uint8).tolist()): b"new"
+               for _ in range(int(rng.integers(1, 6)))}
+        ins.update({kk: b"upd" for j, kk in enumerate(keys) if j % 5 == 2})
+        for kk in dels:
+            ins.pop(kk, None)
+        _run_export_case(pre, dels, ins)

@@ -1,0 +1,225 @@
+"""Engine sparse-trie live-tip state-root strategy tests.
+
+Reference analogue: the state-root strategy + task tests
+(crates/engine/tree/src/tree/state_root_strategy/sparse_trie.rs,
+crates/trie/parallel/src/state_root_task.rs tests): root equality vs the
+committer on storage-heavy / selfdestruct / reorg chains, preserved-trie
+reuse across consecutive payloads (chain-state PreservedSparseTrie), the
+incremental fallback (config.rs:140 state_root_fallback), and stored
+trie-update equivalence with the database walk.
+"""
+
+import pytest
+
+from reth_tpu.engine import EngineTree
+from reth_tpu.engine.sparse_root import SparseRootError, SparseRootTask
+from reth_tpu.engine.tree import PayloadStatusKind
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256, keccak256_batch_np
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import init_genesis
+from reth_tpu.storage.tables import Tables
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+# store(slot=calldata[0], value=calldata[32]):
+#   PUSH1 0x20 CALLDATALOAD PUSH0 CALLDATALOAD SSTORE STOP
+STORE_CODE = bytes.fromhex("6020355f355500")
+STORE_HASH = keccak256(STORE_CODE)
+STORE_ADDR = b"\x51" * 20
+# initcode: SSTORE(1, 7) then SELFDESTRUCT(caller) — a same-tx
+# create+write+destroy populates changes.wiped_storage (EIP-6780)
+WIPE_INITCODE = bytes.fromhex("600760015533ff")
+
+
+def store_call(wallet, slot: int, value: int):
+    data = slot.to_bytes(32, "big") + value.to_bytes(32, "big")
+    return wallet.call(STORE_ADDR, data, gas_limit=200_000)
+
+
+def storage_env(n_extra: int = 48):
+    """Genesis with a storage-heavy contract + enough accounts for the
+    account trie to have real branch structure."""
+    alice = Wallet(0xA11CE)
+    alloc = {
+        alice.address: Account(balance=10**21),
+        STORE_ADDR: Account(code_hash=STORE_HASH),
+    }
+    for i in range(1, n_extra + 1):
+        alloc[i.to_bytes(20, "big")] = Account(balance=i)
+    storage = {STORE_ADDR: {j.to_bytes(32, "big"): j + 1 for j in range(1, 30)}}
+    builder = ChainBuilder(alloc, storage, codes={STORE_HASH: STORE_CODE},
+                           committer=CPU)
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 storage=builder.storage_at_genesis,
+                 codes=builder.codes_at_genesis, committer=CPU)
+    return alice, builder, factory
+
+
+def busy_blocks(alice, builder, n: int = 5):
+    """Blocks mixing storage writes, slot zeroing (trie collapses),
+    transfers (account trie churn), and a same-tx create+selfdestruct."""
+    for i in range(n):
+        txs = [
+            store_call(alice, 100 + i, 0xBEEF + i),   # fresh slot
+            store_call(alice, 1 + i, 0),              # zero an existing slot
+            alice.transfer((0xE0 + i).to_bytes(20, "big"), 10**15),
+        ]
+        if i == 2:
+            txs.append(alice.deploy(WIPE_INITCODE))   # wiped-storage path
+        builder.build_block(txs)
+    return builder.blocks[1:]
+
+
+def feed(tree, blocks):
+    stats = []
+    for blk in blocks:
+        st = tree.on_new_payload(blk)
+        assert st.status is PayloadStatusKind.VALID, st.validation_error
+        stats.append(dict(tree.last_sparse))
+        tree.on_forkchoice_updated(blk.hash)
+    return stats
+
+
+def test_sparse_strategy_computes_roots():
+    """Every busy block's root comes from the SPARSE path (not fallback)
+    and matches the committer-built header root."""
+    alice, builder, factory = storage_env()
+    blocks = busy_blocks(alice, builder)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=2)
+    stats = feed(tree, blocks)
+    assert all(s["strategy"] == "sparse" for s in stats), stats
+    assert any(s["proof_batches"] > 0 for s in stats)
+
+
+def test_preserved_trie_reuse_across_payloads():
+    """Consecutive payloads reuse the preserved sparse trie (hit on every
+    block after the first)."""
+    alice, builder, factory = storage_env()
+    blocks = busy_blocks(alice, builder)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=10)
+    stats = feed(tree, blocks)
+    assert stats[0]["reused"] is False
+    assert all(s["reused"] is True for s in stats[1:]), stats
+    assert tree.preserved_trie.hits >= len(blocks) - 1
+
+
+def test_fallback_fires_and_stays_correct(monkeypatch):
+    """A SparseRootError falls back to the incremental committer and the
+    block still validates (reference state_root_fallback)."""
+    alice, builder, factory = storage_env()
+    blocks = busy_blocks(alice, builder, n=3)
+
+    def boom(self, out):
+        self.abort()
+        raise SparseRootError("injected failure")
+
+    monkeypatch.setattr(SparseRootTask, "finish", boom)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=1)
+    stats = feed(tree, blocks)
+    assert all(s["strategy"] == "fallback" for s in stats)
+    # the fallback wrote real state: overlay view reflects the writes
+    ov = tree.overlay_provider()
+    assert ov.account((0xE0).to_bytes(20, "big")).balance == 10**15
+
+
+def _dump_tables(factory):
+    out = {}
+    with factory.provider() as p:
+        for t in (Tables.AccountsTrie, Tables.StoragesTrie,
+                  Tables.HashedAccounts, Tables.HashedStorages):
+            out[t.name] = sorted(p.tx.cursor(t.name).walk())
+    return out
+
+
+def test_stored_updates_equal_incremental_walk():
+    """The branch updates exported from the sparse trie leave the DB
+    byte-identical to the incremental committer's re-walk — the stored
+    trie, hashed tables included (settles the delete-marker question)."""
+    alice_a, builder_a, factory_a = storage_env()
+    blocks = busy_blocks(alice_a, builder_a)
+    # same chain replayed into a second, independent env
+    alice_b = Wallet(0xA11CE)
+    _, _, factory_b = storage_env()
+
+    tree_a = EngineTree(factory_a, committer=CPU, persistence_threshold=0,
+                        state_root_strategy="sparse")
+    tree_b = EngineTree(factory_b, committer=CPU, persistence_threshold=0,
+                        state_root_strategy="pipelined")
+    feed(tree_a, blocks)
+    for blk in blocks:
+        st = tree_b.on_new_payload(blk)
+        assert st.status is PayloadStatusKind.VALID, st.validation_error
+        tree_b.on_forkchoice_updated(blk.hash)
+    assert tree_a.persisted_number == tree_b.persisted_number == len(blocks)
+    assert _dump_tables(factory_a) == _dump_tables(factory_b)
+    # and the persisted stored structure supports a further incremental
+    # root: one more block replayed on top of the sparse-written DB
+    more = busy_blocks(alice_a, builder_a, n=1)
+    tree_a2 = EngineTree(factory_a, committer=CPU, persistence_threshold=0,
+                         state_root_strategy="pipelined")
+    for blk in more:
+        st = tree_a2.on_new_payload(blk)
+        assert st.status is PayloadStatusKind.VALID, st.validation_error
+        tree_a2.on_forkchoice_updated(blk.hash)
+
+
+def test_reorg_invalidates_preserved_trie():
+    """A fork flip anchors the next payload on a different parent: the
+    preserved trie must not be reused, and roots stay correct."""
+    alice, builder, factory = storage_env()
+    fork_a = builder.build_block([store_call(alice, 200, 111)])
+
+    alice_b = Wallet(0xA11CE)
+    alloc = {
+        alice_b.address: Account(balance=10**21),
+        STORE_ADDR: Account(code_hash=STORE_HASH),
+    }
+    for i in range(1, 49):
+        alloc[i.to_bytes(20, "big")] = Account(balance=i)
+    storage = {STORE_ADDR: {j.to_bytes(32, "big"): j + 1 for j in range(1, 30)}}
+    builder_b = ChainBuilder(alloc, storage, codes={STORE_HASH: STORE_CODE},
+                             committer=CPU)
+    fork_b = builder_b.build_block([store_call(alice_b, 200, 222)],
+                                   timestamp=24)
+    next_b = builder_b.build_block([store_call(alice_b, 201, 333)])
+
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=10)
+    assert tree.on_new_payload(fork_a).status is PayloadStatusKind.VALID
+    assert tree.last_sparse["strategy"] == "sparse"
+    assert tree.on_new_payload(fork_b).status is PayloadStatusKind.VALID
+    # fork_b's parent is genesis, but the preserved trie is anchored at
+    # fork_a — no reuse, fresh anchor
+    assert tree.last_sparse["reused"] is False
+    tree.on_forkchoice_updated(fork_b.hash)
+    st = tree.on_new_payload(next_b)
+    assert st.status is PayloadStatusKind.VALID, st.validation_error
+    # next_b extends fork_b, whose trie was preserved last
+    assert tree.last_sparse["reused"] is True
+    tree.on_forkchoice_updated(next_b.hash)
+    assert tree.overlay_provider().storage(
+        STORE_ADDR, (201).to_bytes(32, "big")) == 333
+
+
+def test_invalid_block_does_not_poison_preserved_trie():
+    """A payload rejected on state-root mismatch must not preserve its
+    mutated trie; the next valid payload still computes correct roots."""
+    from reth_tpu.primitives.types import Block, Header
+
+    alice, builder, factory = storage_env()
+    blocks = busy_blocks(alice, builder, n=2)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=10)
+    feed(tree, [blocks[0]])
+    good = blocks[1]
+    bad_header = Header(**{**good.header.__dict__, "state_root": b"\x13" * 32})
+    bad = Block(bad_header, good.transactions, (), good.withdrawals)
+    st = tree.on_new_payload(bad)
+    assert st.status is PayloadStatusKind.INVALID
+    assert "state root mismatch" in st.validation_error
+    # the real block still validates on the sparse path afterwards
+    st2 = tree.on_new_payload(good)
+    assert st2.status is PayloadStatusKind.VALID, st2.validation_error
+    assert tree.last_sparse["strategy"] == "sparse"
