@@ -277,6 +277,187 @@ def _scn_empty_blocks(seed: int) -> ChainBuilder:
     return bld
 
 
+def _mass_zero_runtime(n: int) -> bytes:
+    """sstore(i, 0) for i = n..1 in a loop — one tx zeroing ``n`` live
+    slots, so the total refund (n x 4800) exceeds the EIP-3529 cap of
+    gas_used/5 and the clamp must bind."""
+    return bytes([
+        0x60, n,                    # counter = n
+        0x5B,                       # 0x02: loop
+        0x80, 0x15, 0x60, 0x12, 0x57,   # if counter == 0 goto exit
+        0x5F, 0x81, 0x55,           # sstore(counter, 0)
+        0x60, 0x01, 0x90, 0x03,     # counter -= 1
+        0x60, 0x02, 0x56,           # goto loop
+        0x5B, 0x00,                 # 0x12: exit
+    ])
+
+
+def _scn_gas_edge(seed: int) -> ChainBuilder:
+    """Refund-cap adversaries (EIP-3529): one tx zeroes MANY pre-existing
+    slots so the refund exceeds gas_used/5 and the cap binds (a clamp bug
+    changes the sealed gas_used); plus an exact intrinsic-gas transfer
+    (21000) that must succeed with zero slack."""
+    a = Wallet(0x210000 + seed)
+    n = 8 + seed % 5
+    zeroer = _mass_zero_runtime(n)
+    zaddr = bytes([0x5D]) + bytes(18) + bytes([seed + 1])
+    bld = ChainBuilder(
+        {a.address: Account(balance=10**20),
+         zaddr: Account(code_hash=keccak256(zeroer))},
+        genesis_storage={zaddr: {i.to_bytes(32, "big"): i + 7
+                                 for i in range(1, n + 1)}},
+        codes={keccak256(zeroer): zeroer},
+    )
+    bld.build_block([a.call(zaddr, b"", gas_limit=500_000)])
+    # exact intrinsic gas: gas_limit == 21000, must land
+    bld.build_block([a.transfer(bytes([0x44] * 20), seed + 1, gas_limit=21_000)])
+    return bld
+
+
+_CREATE2_CHILD_INIT = _initcode(b"\x00")  # deploys a 1-byte STOP runtime
+
+
+def _create2_factory_runtime() -> bytes:
+    """sstore(salt, create2(0, mem[0:n], salt)) with the child initcode
+    embedded in the factory's own code (salt = calldata word 0)."""
+    n = len(_CREATE2_CHILD_INIT)
+    header = bytes([
+        0x60, n, 0x60, 0x11, 0x5F, 0x39,        # codecopy(0, 0x11, n)
+        0x5F, 0x35,                              # salt
+        0x60, n, 0x5F, 0x5F, 0xF5,               # create2(0, 0, n, salt)
+        0x5F, 0x35, 0x55,                        # sstore(salt, addr)
+        0x00,                                    # stop
+    ])
+    assert len(header) == 0x11
+    return header + _CREATE2_CHILD_INIT
+
+
+def _scn_create_collision(seed: int) -> ChainBuilder:
+    """CREATE2 address collision: the second deployment with the SAME salt
+    must fail (stores 0), a fresh salt succeeds — exercises the
+    created-account collision rules and address derivation."""
+    a = Wallet(0x220000 + seed)
+    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    factory = _create2_factory_runtime()
+    bld.build_block([a.deploy(_initcode(factory))])
+    f = _contract_addr(bld, factory)
+    salt = (0x5A17 + seed).to_bytes(32, "big")
+    bld.build_block([a.call(f, salt, gas_limit=300_000)])
+    # the colliding create burns its frame's 63/64 (EIP-684); 2M gas leaves
+    # the factory enough to SSTORE the returned zero, erasing the slot
+    bld.build_block([
+        a.call(f, salt, gas_limit=2_000_000),
+        a.call(f, (0xF0E0 + seed).to_bytes(32, "big"), gas_limit=300_000),
+    ])
+    return bld
+
+
+def _scn_delegation_chain(seed: int) -> ChainBuilder:
+    """EIP-7702 adversaries: re-delegation in a later block, an
+    invalid-nonce tuple that must be skipped, and delegation revocation
+    (authorize the zero address)."""
+    a = Wallet(0x230000 + seed)
+    b = Wallet(0x240000 + seed)
+    bld = ChainBuilder({a.address: Account(balance=10**20),
+                        b.address: Account(balance=10**19)})
+    bld.build_block([a.deploy(_initcode(_STORE)), a.deploy(_initcode(_ADDER))])
+    store = _contract_addr(bld, _STORE)
+    adder = _contract_addr(bld, _ADDER)
+    # delegate b -> store; include one stale-nonce tuple (skipped)
+    good = b.authorize(store, nonce=0)
+    stale = b.authorize(adder, nonce=77)  # wrong nonce: must be ignored
+    bld.build_block([a.sign_tx(Transaction(
+        tx_type=4, chain_id=1, nonce=a.nonce, max_fee_per_gas=10**10,
+        max_priority_fee_per_gas=10**9, gas_limit=200_000,
+        to=b.address, data=(seed + 1).to_bytes(32, "big"),
+        authorization_list=(stale, good),
+    ))])
+    # re-delegate b -> adder in a later block (auth nonce advanced to 1)
+    redel = b.authorize(adder, nonce=1)
+    bld.build_block([a.sign_tx(Transaction(
+        tx_type=4, chain_id=1, nonce=a.nonce, max_fee_per_gas=10**10,
+        max_priority_fee_per_gas=10**9, gas_limit=200_000,
+        to=b.address, data=(seed + 2).to_bytes(32, "big"),
+        authorization_list=(redel,),
+    ))])
+    # revoke (delegate to the zero address)
+    revoke = b.authorize(b"\x00" * 20, nonce=2)
+    bld.build_block([a.sign_tx(Transaction(
+        tx_type=4, chain_id=1, nonce=a.nonce, max_fee_per_gas=10**10,
+        max_priority_fee_per_gas=10**9, gas_limit=200_000,
+        to=b.address, data=b"", authorization_list=(revoke,),
+    ))])
+    return bld
+
+
+def _scn_blob_accounting(seed: int) -> ChainBuilder:
+    """EIP-4844 blob-gas market: blob-heavy blocks push excess_blob_gas
+    up, empty blocks decay it — every header's blobGasUsed/excessBlobGas
+    pair is sealed and replayed."""
+    a = Wallet(0x250000 + seed)
+    bld = ChainBuilder({a.address: Account(balance=10**21)}, cancun=True)
+    def blob_tx(n_blobs, tag):
+        return a.sign_tx(Transaction(
+            tx_type=3, chain_id=1, nonce=a.nonce, max_fee_per_gas=10**10,
+            max_priority_fee_per_gas=10**9, gas_limit=50_000,
+            to=bytes([0x66] * 20), value=tag,
+            max_fee_per_blob_gas=10**10,
+            blob_versioned_hashes=tuple(
+                b"\x01" + bytes([tag & 0xFF, i]) + b"\x00" * 29
+                for i in range(n_blobs)),
+        ))
+    # two full-blob blocks (6 blobs each) drive excess up
+    bld.build_block([blob_tx(3, seed), blob_tx(3, seed + 1)])
+    bld.build_block([blob_tx(6, seed + 2)])
+    # decay over empties
+    bld.build_block([])
+    bld.build_block([])
+    return bld
+
+
+def _revert_outer_runtime(inner: bytes) -> bytes:
+    """call(inner) then sstore(1, 42): the inner frame's writes must be
+    journal-unwound while the outer's survive."""
+    return (
+        bytes([0x5F, 0x5F, 0x5F, 0x5F, 0x5F, 0x73]) + inner  # push20 inner
+        + bytes([0x61, 0xFF, 0xFF, 0xF1,                     # call
+                 0x50,                                        # pop status
+                 0x60, 0x2A, 0x60, 0x01, 0x55,                # sstore(1, 42)
+                 0x00])
+    )
+
+
+def _scn_deep_revert(seed: int) -> ChainBuilder:
+    """Nested-frame journaling: the callee SSTOREs then REVERTs (its write
+    unwinds), the caller keeps executing and commits its own write; a
+    second tx reverts at the TOP level after a successful inner call (all
+    writes unwind)."""
+    a = Wallet(0x260000 + seed)
+    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    # inner: sstore(0, 1) then revert(0,0)
+    inner_rt = bytes([0x60, 0x01, 0x5F, 0x55, 0x5F, 0x5F, 0xFD])
+    bld.build_block([a.deploy(_initcode(inner_rt))])
+    inner = _contract_addr(bld, inner_rt)
+    outer_rt = _revert_outer_runtime(inner)
+    bld.build_block([a.deploy(_initcode(outer_rt))])
+    outer = _contract_addr(bld, outer_rt)
+    bld.build_block([a.call(outer, b"", gas_limit=300_000)])
+    # top-level revert wrapping a SUCCESSFUL store call: everything unwinds
+    store_rt = _STORE
+    bld.build_block([a.deploy(_initcode(store_rt))])
+    store = _contract_addr(bld, store_rt)
+    top_rt = (
+        bytes([0x5F, 0x5F, 0x60, 0x20, 0x5F, 0x5F, 0x73]) + store
+        + bytes([0x61, 0xFF, 0xFF, 0xF1, 0x50, 0x5F, 0x5F, 0xFD])  # revert
+    )
+    bld.build_block([a.deploy(_initcode(top_rt))])
+    top = _contract_addr(bld, top_rt)
+    bld.build_block([a.call(top, (seed + 7).to_bytes(32, "big"),
+                            gas_limit=300_000),
+                     a.transfer(bytes([0x77] * 20), seed + 1)])
+    return bld
+
+
 SCENARIOS = {
     "transfers": _scn_transfers,
     "storage": _scn_storage,
@@ -289,6 +470,13 @@ SCENARIOS = {
     "setCodeTx": _scn_setcode_tx,
     "deepState": _scn_deep_state,
     "emptyBlocks": _scn_empty_blocks,
+    # adversarial families (round-4: gas edges, collisions, 7702 chains,
+    # 4844 accounting, nested-revert journaling)
+    "gasEdge": _scn_gas_edge,
+    "createCollision": _scn_create_collision,
+    "delegationChain": _scn_delegation_chain,
+    "blobAccounting": _scn_blob_accounting,
+    "deepRevert": _scn_deep_revert,
 }
 
 

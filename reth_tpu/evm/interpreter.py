@@ -98,14 +98,13 @@ class CallFrame:
 
 
 class Interpreter:
-    def __init__(self, state: EvmState, block: BlockEnv, tx: TxEnv, tracer=None):
-        # the interpreter recurses natively per call frame (~5 python frames
-        # per EVM frame); EVM's depth limit is 1024, far above CPython's
-        # default 1000 — raise lazily, only when an interpreter exists
-        import sys
+    """Iterative interpreter: EVM call frames live on an EXPLICIT frame
+    stack of suspended generators (the trampoline in :meth:`_drive`), not
+    the Python call stack — depth-1024 chains run without touching the
+    recursion limit (reference: revm's iterative frame loop behind
+    crates/evm/evm/src/lib.rs:181)."""
 
-        if sys.getrecursionlimit() < 20_000:
-            sys.setrecursionlimit(20_000)
+    def __init__(self, state: EvmState, block: BlockEnv, tx: TxEnv, tracer=None):
         self.state = state
         self.block = block
         self.tx = tx
@@ -119,58 +118,102 @@ class Interpreter:
 
     def call(self, frame: CallFrame) -> tuple[bool, int, bytes]:
         """Execute a message call; returns (success, gas_left, output)."""
-        if frame.depth > MAX_CALL_DEPTH:
-            return False, frame.gas, b""
-        on_enter = getattr(self.tracer, "on_enter", None)
-        on_exit = getattr(self.tracer, "on_exit", None)
-        if on_enter is not None:
-            ok, gas_left, out = self._call_traced(frame, on_enter, on_exit)
-            return ok, gas_left, out
-        return self._call_inner(frame)
-
-    def _call_traced(self, frame, on_enter, on_exit):
-        on_enter(frame.kind, frame)
-        try:
-            ok, gas_left, out = self._call_inner(frame)
-        except Revert as r:
-            if on_exit is not None:
-                on_exit(frame, False, getattr(r, "gas_left", 0), r.output, "reverted")
-            raise
-        if on_exit is not None:
-            on_exit(frame, ok, gas_left, out, None if ok else "halted")
-        return ok, gas_left, out
-
-    def _call_inner(self, frame: CallFrame) -> tuple[bool, int, bytes]:
-        state = self.state
-        snap = state.snapshot()
-        if frame.value and frame.transfer_value:
-            if state.balance(frame.caller) < frame.value:
-                return False, frame.gas, b""
-            state.sub_balance(frame.caller, frame.value)
-            state.add_balance(frame.address, frame.value)
-        pre = _precompile(frame.address)
-        if pre is not None:
-            ok, gas_left, out = pre(frame.data, frame.gas)
-            if not ok:
-                state.revert(snap)
-            return ok, gas_left, out
-        if not frame.code:
-            return True, frame.gas, b""
-        try:
-            gas_left, out = self._run(frame)
-            return True, gas_left, out
-        except Revert as r:
-            state.revert(snap)
-            raise
-        except Halt:
-            state.revert(snap)
-            return False, 0, b""
+        return self._drive(self._call_gen(frame))
 
     def create(
         self, caller: bytes, value: int, initcode: bytes, gas: int,
         depth: int, salt: bytes | None = None, tx_nonce: int | None = None,
     ) -> tuple[bool, int, bytes, bytes]:
-        """CREATE/CREATE2; returns (success, gas_left, address, output).
+        """CREATE/CREATE2; returns (success, gas_left, address, output)."""
+        return self._drive(self._create_gen(caller, value, initcode, gas,
+                                            depth, salt, tx_nonce))
+
+    def _drive(self, root):
+        """The explicit frame stack: each entry is one EVM frame suspended
+        as a generator at its nested CALL/CREATE site. A child frame's
+        result resumes its parent via send(); a child's Revert/Halt is
+        thrown INTO the parent at the yield point, which preserves the
+        exact semantics the recursive form had (`try: self.call(sub)
+        except Revert` around the opcode)."""
+        stack = [root]
+        value = None
+        exc: BaseException | None = None
+        while stack:
+            g = stack[-1]
+            try:
+                if exc is not None:
+                    e, exc = exc, None
+                    req = g.throw(e)
+                else:
+                    req = g.send(value)
+                value = None
+            except StopIteration as s:
+                stack.pop()
+                value = s.value
+                continue
+            except (Revert, Halt) as e:
+                stack.pop()
+                exc = e
+                value = None
+                continue
+            kind, arg = req
+            stack.append(self._call_gen(arg) if kind == "call"
+                         else self._create_gen(*arg))
+            value = None
+        if exc is not None:
+            raise exc
+        return value
+
+    def _call_gen(self, frame: CallFrame):
+        """One message-call frame (prologue + run + epilogue) as a
+        generator; nested frames are yielded to the trampoline."""
+        if frame.depth > MAX_CALL_DEPTH:
+            return False, frame.gas, b""
+        on_enter = getattr(self.tracer, "on_enter", None)
+        on_exit = getattr(self.tracer, "on_exit", None)
+        if on_enter is not None:
+            on_enter(frame.kind, frame)
+        state = self.state
+        snap = state.snapshot()
+        ok = True
+        gas_left, out, err = frame.gas, b"", None
+        try:
+            if frame.value and frame.transfer_value:
+                if state.balance(frame.caller) < frame.value:
+                    ok = False
+                    err = "halted"
+                    return False, frame.gas, b""
+                state.sub_balance(frame.caller, frame.value)
+                state.add_balance(frame.address, frame.value)
+            pre = _precompile(frame.address)
+            if pre is not None:
+                ok, gas_left, out = pre(frame.data, frame.gas)
+                if not ok:
+                    state.revert(snap)
+                    err = "halted"
+            elif frame.code:
+                try:
+                    gas_left, out = yield from self._run_gen(frame)
+                except Revert as r:
+                    state.revert(snap)
+                    if on_exit is not None:
+                        on_exit(frame, False, getattr(r, "gas_left", 0),
+                                r.output, "reverted")
+                        on_exit = None
+                    raise
+                except Halt:
+                    state.revert(snap)
+                    ok, gas_left, out, err = False, 0, b"", "halted"
+        finally:
+            if on_exit is not None:
+                on_exit(frame, ok, gas_left, out, err)
+        return ok, gas_left, out
+
+    def _create_gen(
+        self, caller: bytes, value: int, initcode: bytes, gas: int,
+        depth: int, salt: bytes | None = None, tx_nonce: int | None = None,
+    ):
+        """One contract-creation frame as a generator.
 
         ``tx_nonce`` marks a top-level create transaction: the address
         derives from the tx nonce and the sender's nonce is NOT bumped here
@@ -200,7 +243,7 @@ class Interpreter:
         frame = CallFrame(caller=caller, address=addr, code=initcode,
                           data=b"", value=value, gas=gas, depth=depth)
         try:
-            gas_left, out = self._run(frame)
+            gas_left, out = yield from self._run_gen(frame)
         except Revert as r:
             state.revert(snap)
             return False, getattr(r, "gas_left", 0), b"", r.output
@@ -228,7 +271,7 @@ class Interpreter:
 
     # -- main loop ------------------------------------------------------------
 
-    def _run(self, fr: CallFrame) -> tuple[int, bytes]:
+    def _run_gen(self, fr: CallFrame):
         state = self.state
         code = fr.code
         stack: list[int] = []
@@ -281,72 +324,43 @@ class Interpreter:
             mem[offset : offset + len(data)] = data
 
         tracer = self.tracer
-        while pc < len(code):
-            op = code[pc]
-            if tracer is not None:
-                tracer(pc, op, gas, stack, mem, fr.depth)
-            pc += 1
-            # PUSH0..PUSH32
-            if 0x5F <= op <= 0x7F:
-                n = op - 0x5F
-                use(2 if n == 0 else 3)
-                if len(stack) >= 1024:
-                    raise Halt()
-                push(int.from_bytes(code[pc : pc + n], "big"))
-                pc += n
-                continue
-            # DUP1..DUP16
-            if 0x80 <= op <= 0x8F:
-                use(3)
-                i = op - 0x7F
-                if len(stack) < i or len(stack) >= 1024:
-                    raise Halt()
-                push(stack[-i])
-                continue
-            # SWAP1..SWAP16
-            if 0x90 <= op <= 0x9F:
-                use(3)
-                i = op - 0x8F
-                if len(stack) < i + 1:
-                    raise Halt()
-                stack[-1], stack[-i - 1] = stack[-i - 1], stack[-1]
-                continue
+        cold = None  # cold-op dispatch table, built on first cold op
 
-            if op == 0x00:  # STOP
-                return gas, b""
-            elif op == 0x01:  # ADD
-                use(3); a, b = pop(), pop(); push((a + b) & MASK)
-            elif op == 0x02:  # MUL
-                use(5); a, b = pop(), pop(); push((a * b) & MASK)
-            elif op == 0x03:  # SUB
-                use(3); a, b = pop(), pop(); push((a - b) & MASK)
-            elif op == 0x04:  # DIV
-                use(5); a, b = pop(), pop(); push(a // b if b else 0)
-            elif op == 0x05:  # SDIV
+        def _build_cold():
+            """Dispatch table for the cold tail: env/context reads, copies,
+            logs, transient storage, selfdestruct. Handlers are closures
+            over this frame's cell vars (gas/pc/stack/mem), built lazily so
+            small hot-only frames never pay for their construction. A
+            handler returning non-None ends the frame with that value."""
+
+            def h_sdiv():
                 use(5); a, b = _sgn(pop()), _sgn(pop())
                 if b == 0:
                     push(0)
                 else:
                     q = abs(a) // abs(b)
                     push((q if (a < 0) == (b < 0) else -q) & MASK)
-            elif op == 0x06:  # MOD
-                use(5); a, b = pop(), pop(); push(a % b if b else 0)
-            elif op == 0x07:  # SMOD
+
+            def h_smod():
                 use(5); a, b = _sgn(pop()), _sgn(pop())
                 if b == 0:
                     push(0)
                 else:
                     r = abs(a) % abs(b)
                     push((-r if a < 0 else r) & MASK)
-            elif op == 0x08:  # ADDMOD
+
+            def h_addmod():
                 use(8); a, b, n = pop(), pop(), pop(); push((a + b) % n if n else 0)
-            elif op == 0x09:  # MULMOD
+
+            def h_mulmod():
                 use(8); a, b, n = pop(), pop(), pop(); push((a * b) % n if n else 0)
-            elif op == 0x0A:  # EXP
+
+            def h_exp():
                 a, e = pop(), pop()
                 use(10 + G_EXP_BYTE * ((e.bit_length() + 7) // 8))
                 push(pow(a, e, U256))
-            elif op == 0x0B:  # SIGNEXTEND
+
+            def h_signextend():
                 use(5); b, x = pop(), pop()
                 if b < 31:
                     bit = 8 * (b + 1) - 1
@@ -355,6 +369,197 @@ class Interpreter:
                     else:
                         x &= (1 << (bit + 1)) - 1
                 push(x & MASK)
+
+            def h_byte():
+                use(3); i, x = pop(), pop()
+                push((x >> (8 * (31 - i))) & 0xFF if i < 32 else 0)
+
+            def h_sar():
+                use(3); s, x = pop(), _sgn(pop())
+                push((x >> s if s < 256 else (0 if x >= 0 else MASK)) & MASK)
+
+            def h_balance():
+                addr = pop().to_bytes(32, "big")[12:]
+                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                push(state.balance(addr))
+
+            def h_origin():
+                use(2); push(int.from_bytes(self.tx.origin, "big"))
+
+            def h_codesize():
+                use(2); push(len(code))
+
+            def h_gasprice():
+                use(2); push(self.tx.gas_price)
+
+            def h_extcodesize():
+                addr = pop().to_bytes(32, "big")[12:]
+                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                push(len(state.code(addr)))
+
+            def h_extcodecopy():
+                addr = pop().to_bytes(32, "big")[12:]
+                d, s, size = pop(), pop(), pop()
+                use((G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                    + G_COPY_WORD * ((size + 31) // 32))
+                ext = state.code(addr)
+                mem_write(d, ext[s : s + size].ljust(size, b"\x00") if s < len(ext) else b"\x00" * size)
+
+            def h_extcodehash():
+                addr = pop().to_bytes(32, "big")[12:]
+                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                acc = state.account(addr)
+                push(0 if acc is None or acc.is_empty else int.from_bytes(acc.code_hash, "big"))
+
+            def h_blockhash():
+                use(20); n = pop()
+                h = self.block.block_hashes.get(n, b"")
+                push(int.from_bytes(h, "big") if h else 0)
+
+            def h_coinbase():
+                use(2); push(int.from_bytes(self.block.coinbase, "big"))
+
+            def h_timestamp():
+                use(2); push(self.block.timestamp)
+
+            def h_number():
+                use(2); push(self.block.number)
+
+            def h_prevrandao():
+                use(2); push(int.from_bytes(self.block.prev_randao, "big"))
+
+            def h_gaslimit():
+                use(2); push(self.block.gas_limit)
+
+            def h_chainid():
+                use(2); push(self.block.chain_id)
+
+            def h_selfbalance():
+                use(5); push(state.balance(fr.address))
+
+            def h_basefee():
+                use(2); push(self.block.base_fee)
+
+            def h_blobhash():
+                use(3); i = pop()
+                push(int.from_bytes(self.tx.blob_hashes[i], "big") if i < len(self.tx.blob_hashes) else 0)
+
+            def h_blobbasefee():
+                use(2); push(self.block.blob_base_fee)
+
+            def h_mstore8():
+                use(3); off, v = pop(), pop(); mem_write(off, bytes([v & 0xFF]))
+
+            def h_pc():
+                use(2); push(pc - 1)
+
+            def h_msize():
+                use(2); push(len(mem))
+
+            def h_tload():
+                use(100); slot = pop().to_bytes(32, "big")
+                push(self.transient.get((fr.address, slot), 0))
+
+            def h_tstore():
+                if fr.static:
+                    raise Halt()
+                use(100); slot, v = pop().to_bytes(32, "big"), pop()
+                self.transient[(fr.address, slot)] = v
+
+            def h_mcopy():
+                d, s, size = pop(), pop(), pop()
+                use(3 + G_COPY_WORD * ((size + 31) // 32))
+                data = mem_read(s, size)
+                mem_write(d, data)
+
+            def h_selfdestruct():
+                if fr.static:
+                    raise Halt()
+                ben = pop().to_bytes(32, "big")[12:]
+                cost = G_SELFDESTRUCT
+                if not state.warm_account(ben):
+                    cost += G_COLD_ACCOUNT
+                if state.balance(fr.address) and not state.exists(ben):
+                    cost += G_NEW_ACCOUNT
+                use(cost)
+                state.selfdestruct(fr.address, ben)
+                return gas, b""
+
+            table = {
+                0x05: h_sdiv, 0x07: h_smod, 0x08: h_addmod, 0x09: h_mulmod,
+                0x0A: h_exp, 0x0B: h_signextend, 0x1A: h_byte, 0x1D: h_sar,
+                0x31: h_balance, 0x32: h_origin, 0x38: h_codesize,
+                0x3A: h_gasprice, 0x3B: h_extcodesize, 0x3C: h_extcodecopy,
+                0x3F: h_extcodehash, 0x40: h_blockhash, 0x41: h_coinbase,
+                0x42: h_timestamp, 0x43: h_number, 0x44: h_prevrandao,
+                0x45: h_gaslimit, 0x46: h_chainid, 0x47: h_selfbalance,
+                0x48: h_basefee, 0x49: h_blobhash, 0x4A: h_blobbasefee,
+                0x53: h_mstore8, 0x58: h_pc, 0x59: h_msize,
+                0x5C: h_tload, 0x5D: h_tstore, 0x5E: h_mcopy,
+                0xFF: h_selfdestruct,
+            }
+            return table
+
+        code_len = len(code)
+        while pc < code_len:
+            op = code[pc]
+            if tracer is not None:
+                tracer(pc, op, gas, stack, mem, fr.depth)
+            pc += 1
+            # -- hot tier 1: stack manipulation (the most frequent ops) --
+            if 0x5F <= op <= 0x7F:  # PUSH0..PUSH32
+                n = op - 0x5F
+                use(2 if n == 0 else 3)
+                if len(stack) >= 1024:
+                    raise Halt()
+                push(int.from_bytes(code[pc : pc + n], "big"))
+                pc += n
+                continue
+            if 0x80 <= op <= 0x8F:  # DUP1..DUP16
+                use(3)
+                i = op - 0x7F
+                if len(stack) < i or len(stack) >= 1024:
+                    raise Halt()
+                push(stack[-i])
+                continue
+            if 0x90 <= op <= 0x9F:  # SWAP1..SWAP16
+                use(3)
+                i = op - 0x8F
+                if len(stack) < i + 1:
+                    raise Halt()
+                stack[-1], stack[-i - 1] = stack[-i - 1], stack[-1]
+                continue
+
+            # -- hot tier 2: control flow, arithmetic, memory, storage --
+            # ordered by measured frequency, NOT opcode value; everything
+            # else dispatches through the cold table below
+            if op == 0x5B:  # JUMPDEST
+                use(1)
+            elif op == 0x57:  # JUMPI
+                use(10); dest, cond = pop(), pop()
+                if cond:
+                    if dest not in jumpdests:
+                        raise Halt()
+                    pc = dest
+            elif op == 0x56:  # JUMP
+                use(8); dest = pop()
+                if dest not in jumpdests:
+                    raise Halt()
+                pc = dest
+            elif op == 0x01:  # ADD
+                use(3); a, b = pop(), pop(); push((a + b) & MASK)
+            elif op == 0x03:  # SUB
+                use(3); a, b = pop(), pop(); push((a - b) & MASK)
+            elif op == 0x02:  # MUL
+                use(5); a, b = pop(), pop(); push((a * b) & MASK)
+            elif op == 0x04:  # DIV
+                use(5); a, b = pop(), pop(); push(a // b if b else 0)
+            elif op == 0x06:  # MOD
+                use(5); a, b = pop(), pop(); push(a % b if b else 0)
+            elif op == 0x15:  # ISZERO
+                use(3); push(1 if pop() == 0 else 0)
+            elif op == 0x14:  # EQ
+                use(3); push(1 if pop() == pop() else 0)
             elif op == 0x10:  # LT
                 use(3); push(1 if pop() < pop() else 0)
             elif op == 0x11:  # GT
@@ -363,10 +568,6 @@ class Interpreter:
                 use(3); push(1 if _sgn(pop()) < _sgn(pop()) else 0)
             elif op == 0x13:  # SGT
                 use(3); push(1 if _sgn(pop()) > _sgn(pop()) else 0)
-            elif op == 0x14:  # EQ
-                use(3); push(1 if pop() == pop() else 0)
-            elif op == 0x15:  # ISZERO
-                use(3); push(1 if pop() == 0 else 0)
             elif op == 0x16:  # AND
                 use(3); push(pop() & pop())
             elif op == 0x17:  # OR
@@ -375,106 +576,21 @@ class Interpreter:
                 use(3); push(pop() ^ pop())
             elif op == 0x19:  # NOT
                 use(3); push(pop() ^ MASK)
-            elif op == 0x1A:  # BYTE
-                use(3); i, x = pop(), pop()
-                push((x >> (8 * (31 - i))) & 0xFF if i < 32 else 0)
             elif op == 0x1B:  # SHL
                 use(3); s, x = pop(), pop(); push((x << s) & MASK if s < 256 else 0)
             elif op == 0x1C:  # SHR
                 use(3); s, x = pop(), pop(); push(x >> s if s < 256 else 0)
-            elif op == 0x1D:  # SAR
-                use(3); s, x = pop(), _sgn(pop())
-                push((x >> s if s < 256 else (0 if x >= 0 else MASK)) & MASK)
-            elif op == 0x20:  # KECCAK256
-                off, size = pop(), pop()
-                use(G_KECCAK + G_KECCAK_WORD * ((size + 31) // 32))
-                push(int.from_bytes(keccak256(mem_read(off, size)), "big"))
-            elif op == 0x30:  # ADDRESS
-                use(2); push(int.from_bytes(fr.address, "big"))
-            elif op == 0x31:  # BALANCE
-                addr = pop().to_bytes(32, "big")[12:]
-                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
-                push(state.balance(addr))
-            elif op == 0x32:  # ORIGIN
-                use(2); push(int.from_bytes(self.tx.origin, "big"))
-            elif op == 0x33:  # CALLER
-                use(2); push(int.from_bytes(fr.caller, "big"))
-            elif op == 0x34:  # CALLVALUE
-                use(2); push(fr.value)
-            elif op == 0x35:  # CALLDATALOAD
-                use(3); i = pop()
-                push(int.from_bytes(fr.data[i : i + 32].ljust(32, b"\x00"), "big") if i < len(fr.data) else 0)
-            elif op == 0x36:  # CALLDATASIZE
-                use(2); push(len(fr.data))
-            elif op == 0x37:  # CALLDATACOPY
-                d, s, size = pop(), pop(), pop()
-                use(3 + G_COPY_WORD * ((size + 31) // 32))
-                mem_write(d, fr.data[s : s + size].ljust(size, b"\x00") if s < len(fr.data) else b"\x00" * size)
-            elif op == 0x38:  # CODESIZE
-                use(2); push(len(code))
-            elif op == 0x39:  # CODECOPY
-                d, s, size = pop(), pop(), pop()
-                use(3 + G_COPY_WORD * ((size + 31) // 32))
-                mem_write(d, code[s : s + size].ljust(size, b"\x00") if s < len(code) else b"\x00" * size)
-            elif op == 0x3A:  # GASPRICE
-                use(2); push(self.tx.gas_price)
-            elif op == 0x3B:  # EXTCODESIZE
-                addr = pop().to_bytes(32, "big")[12:]
-                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
-                push(len(state.code(addr)))
-            elif op == 0x3C:  # EXTCODECOPY
-                addr = pop().to_bytes(32, "big")[12:]
-                d, s, size = pop(), pop(), pop()
-                use((G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
-                    + G_COPY_WORD * ((size + 31) // 32))
-                ext = state.code(addr)
-                mem_write(d, ext[s : s + size].ljust(size, b"\x00") if s < len(ext) else b"\x00" * size)
-            elif op == 0x3D:  # RETURNDATASIZE
-                use(2); push(len(returndata))
-            elif op == 0x3E:  # RETURNDATACOPY
-                d, s, size = pop(), pop(), pop()
-                use(3 + G_COPY_WORD * ((size + 31) // 32))
-                if s + size > len(returndata):
-                    raise Halt()
-                mem_write(d, returndata[s : s + size])
-            elif op == 0x3F:  # EXTCODEHASH
-                addr = pop().to_bytes(32, "big")[12:]
-                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
-                acc = state.account(addr)
-                push(0 if acc is None or acc.is_empty else int.from_bytes(acc.code_hash, "big"))
-            elif op == 0x40:  # BLOCKHASH
-                use(20); n = pop()
-                h = self.block.block_hashes.get(n, b"")
-                push(int.from_bytes(h, "big") if h else 0)
-            elif op == 0x41:  # COINBASE
-                use(2); push(int.from_bytes(self.block.coinbase, "big"))
-            elif op == 0x42:  # TIMESTAMP
-                use(2); push(self.block.timestamp)
-            elif op == 0x43:  # NUMBER
-                use(2); push(self.block.number)
-            elif op == 0x44:  # PREVRANDAO
-                use(2); push(int.from_bytes(self.block.prev_randao, "big"))
-            elif op == 0x45:  # GASLIMIT
-                use(2); push(self.block.gas_limit)
-            elif op == 0x46:  # CHAINID
-                use(2); push(self.block.chain_id)
-            elif op == 0x47:  # SELFBALANCE
-                use(5); push(state.balance(fr.address))
-            elif op == 0x48:  # BASEFEE
-                use(2); push(self.block.base_fee)
-            elif op == 0x49:  # BLOBHASH
-                use(3); i = pop()
-                push(int.from_bytes(self.tx.blob_hashes[i], "big") if i < len(self.tx.blob_hashes) else 0)
-            elif op == 0x4A:  # BLOBBASEFEE
-                use(2); push(self.block.blob_base_fee)
             elif op == 0x50:  # POP
                 use(2); pop()
             elif op == 0x51:  # MLOAD
                 use(3); off = pop(); push(int.from_bytes(mem_read(off, 32), "big"))
             elif op == 0x52:  # MSTORE
                 use(3); off, v = pop(), pop(); mem_write(off, v.to_bytes(32, "big"))
-            elif op == 0x53:  # MSTORE8
-                use(3); off, v = pop(), pop(); mem_write(off, bytes([v & 0xFF]))
+            elif op == 0x35:  # CALLDATALOAD
+                use(3); i = pop()
+                push(int.from_bytes(fr.data[i : i + 32].ljust(32, b"\x00"), "big") if i < len(fr.data) else 0)
+            elif op == 0x36:  # CALLDATASIZE
+                use(2); push(len(fr.data))
             elif op == 0x54:  # SLOAD
                 slot = pop().to_bytes(32, "big")
                 use(G_WARM_ACCESS if state.warm_slot(fr.address, slot) else G_COLD_SLOAD)
@@ -485,10 +601,10 @@ class Interpreter:
                 if gas <= G_CALL_STIPEND:
                     raise Halt()
                 slot, value = pop().to_bytes(32, "big"), pop()
-                cold = not state.warm_slot(fr.address, slot)
+                cold_slot = not state.warm_slot(fr.address, slot)
                 current = state.sload(fr.address, slot)
                 original = state.original_storage(fr.address, slot)
-                cost = G_COLD_SLOAD if cold else 0
+                cost = G_COLD_SLOAD if cold_slot else 0
                 if value == current:
                     cost += G_WARM_ACCESS
                 elif current == original:
@@ -513,38 +629,26 @@ class Interpreter:
                             else:
                                 state.add_refund(G_SSTORE_RESET - G_WARM_ACCESS)
                     state.sstore(fr.address, slot, value)
-            elif op == 0x56:  # JUMP
-                use(8); dest = pop()
-                if dest not in jumpdests:
-                    raise Halt()
-                pc = dest
-            elif op == 0x57:  # JUMPI
-                use(10); dest, cond = pop(), pop()
-                if cond:
-                    if dest not in jumpdests:
-                        raise Halt()
-                    pc = dest
-            elif op == 0x58:  # PC
-                use(2); push(pc - 1)
-            elif op == 0x59:  # MSIZE
-                use(2); push(len(mem))
+            elif op == 0x20:  # KECCAK256
+                off, size = pop(), pop()
+                use(G_KECCAK + G_KECCAK_WORD * ((size + 31) // 32))
+                push(int.from_bytes(keccak256(mem_read(off, size)), "big"))
             elif op == 0x5A:  # GAS
                 use(2); push(gas)
-            elif op == 0x5B:  # JUMPDEST
-                use(1)
-            elif op == 0x5C:  # TLOAD
-                use(100); slot = pop().to_bytes(32, "big")
-                push(self.transient.get((fr.address, slot), 0))
-            elif op == 0x5D:  # TSTORE
-                if fr.static:
-                    raise Halt()
-                use(100); slot, v = pop().to_bytes(32, "big"), pop()
-                self.transient[(fr.address, slot)] = v
-            elif op == 0x5E:  # MCOPY
+            elif op == 0x33:  # CALLER
+                use(2); push(int.from_bytes(fr.caller, "big"))
+            elif op == 0x34:  # CALLVALUE
+                use(2); push(fr.value)
+            elif op == 0x30:  # ADDRESS
+                use(2); push(int.from_bytes(fr.address, "big"))
+            elif op == 0x37:  # CALLDATACOPY
                 d, s, size = pop(), pop(), pop()
                 use(3 + G_COPY_WORD * ((size + 31) // 32))
-                data = mem_read(s, size)
-                mem_write(d, data)
+                mem_write(d, fr.data[s : s + size].ljust(size, b"\x00") if s < len(fr.data) else b"\x00" * size)
+            elif op == 0x39:  # CODECOPY
+                d, s, size = pop(), pop(), pop()
+                use(3 + G_COPY_WORD * ((size + 31) // 32))
+                mem_write(d, code[s : s + size].ljust(size, b"\x00") if s < len(code) else b"\x00" * size)
             elif 0xA0 <= op <= 0xA4:  # LOG0..LOG4
                 if fr.static:
                     raise Halt()
@@ -556,25 +660,24 @@ class Interpreter:
                 from ..primitives.types import Log
 
                 state.add_log(Log(fr.address, topics, data))
-            elif op == 0xF0 or op == 0xF5:  # CREATE / CREATE2
-                if fr.static:
+            elif op == 0x3D:  # RETURNDATASIZE
+                use(2); push(len(returndata))
+            elif op == 0x3E:  # RETURNDATACOPY
+                d, s, size = pop(), pop(), pop()
+                use(3 + G_COPY_WORD * ((size + 31) // 32))
+                if s + size > len(returndata):
                     raise Halt()
-                value = pop(); off = pop(); size = pop()
-                salt = pop().to_bytes(32, "big") if op == 0xF5 else None
-                words = (size + 31) // 32
-                use(G_CREATE + G_INITCODE_WORD * words
-                    + (G_KECCAK_WORD * words if op == 0xF5 else 0))
-                if size > MAX_INITCODE_SIZE:
-                    raise Halt()
-                initcode = mem_read(off, size)
-                child_gas = gas - gas // 64
-                use(child_gas)
-                ok, gas_left, addr, out = self.create(
-                    fr.address, value, initcode, child_gas, fr.depth + 1, salt
-                )
-                gas += gas_left
-                returndata = out
-                push(int.from_bytes(addr, "big") if ok else 0)
+                mem_write(d, returndata[s : s + size])
+            elif op == 0x00:  # STOP
+                return gas, b""
+            elif op == 0xF3:  # RETURN
+                off, size = pop(), pop()
+                return gas, mem_read(off, size)
+            elif op == 0xFD:  # REVERT
+                off, size = pop(), pop()
+                r = Revert(mem_read(off, size))
+                r.gas_left = gas
+                raise r
             elif op in (0xF1, 0xF2, 0xF4, 0xFA):  # CALL/CALLCODE/DELEGATECALL/STATICCALL
                 g = pop()
                 addr = pop().to_bytes(32, "big")[12:]
@@ -616,7 +719,7 @@ class Interpreter:
                     sub = CallFrame(fr.address, addr, run_code, data, 0,
                                     child_gas, True, fr.depth + 1, kind="STATICCALL")
                 try:
-                    ok, gas_left, out = self.call(sub)
+                    ok, gas_left, out = yield ("call", sub)
                 except Revert as r:
                     # child reverted: its unused gas comes back, output exposed
                     ok, out = False, r.output
@@ -625,30 +728,38 @@ class Interpreter:
                 returndata = out
                 mem[aout : aout + min(aouts, len(out))] = out[: aouts]
                 push(1 if ok else 0)
-            elif op == 0xF3:  # RETURN
-                off, size = pop(), pop()
-                return gas, mem_read(off, size)
-            elif op == 0xFD:  # REVERT
-                off, size = pop(), pop()
-                r = Revert(mem_read(off, size))
-                r.gas_left = gas
-                raise r
-            elif op == 0xFE:  # INVALID
-                raise Halt()
-            elif op == 0xFF:  # SELFDESTRUCT
+            elif op == 0xF0 or op == 0xF5:  # CREATE / CREATE2
                 if fr.static:
                     raise Halt()
-                ben = pop().to_bytes(32, "big")[12:]
-                cost = G_SELFDESTRUCT
-                if not state.warm_account(ben):
-                    cost += G_COLD_ACCOUNT
-                if state.balance(fr.address) and not state.exists(ben):
-                    cost += G_NEW_ACCOUNT
-                use(cost)
-                state.selfdestruct(fr.address, ben)
-                return gas, b""
-            else:
+                value = pop(); off = pop(); size = pop()
+                salt = pop().to_bytes(32, "big") if op == 0xF5 else None
+                words = (size + 31) // 32
+                use(G_CREATE + G_INITCODE_WORD * words
+                    + (G_KECCAK_WORD * words if op == 0xF5 else 0))
+                if size > MAX_INITCODE_SIZE:
+                    raise Halt()
+                initcode = mem_read(off, size)
+                child_gas = gas - gas // 64
+                use(child_gas)
+                ok, gas_left, addr, out = yield (
+                    "create",
+                    (fr.address, value, initcode, child_gas, fr.depth + 1, salt),
+                )
+                gas += gas_left
+                returndata = out
+                push(int.from_bytes(addr, "big") if ok else 0)
+            elif op == 0xFE:  # INVALID
                 raise Halt()
+            else:
+                # -- cold tier: table dispatch ---------------------------
+                if cold is None:
+                    cold = _build_cold()
+                h = cold.get(op)
+                if h is None:
+                    raise Halt()
+                res = h()
+                if res is not None:  # SELFDESTRUCT ends the frame
+                    return res
         return gas, b""
 
 

@@ -1,5 +1,9 @@
-"""Conformance suite: 110 generated BlockchainTests cases through the
-runner (full pipeline replay: decode RLP -> execute -> rebuild roots).
+"""Conformance suite: 320 generated BlockchainTests cases through the
+runner (full pipeline replay: decode RLP -> execute -> rebuild roots),
+including the round-4 adversarial families (gas edges, CREATE2
+collisions, 7702 delegation chains, 4844 blob accounting, nested-revert
+journaling). External ground-truth vectors live in
+tests/test_external_vectors.py.
 
 Reference analogue: testing/ef-tests/tests/tests.rs per-suite macros.
 """
@@ -14,7 +18,7 @@ from reth_tpu.conformance import ConformanceFailure, run_blockchain_test
 from reth_tpu.conformance.generate import SCENARIOS, builder_to_fixture, generate_suite
 from reth_tpu.conformance.runner import run_fixture_file
 
-_PER_SCENARIO = 10
+_PER_SCENARIO = 20
 
 
 @pytest.fixture(scope="module")
@@ -23,7 +27,7 @@ def suite():
 
 
 def test_suite_size(suite):
-    assert len(suite) >= 100
+    assert len(suite) >= 300
 
 
 @pytest.mark.parametrize("scenario", sorted(SCENARIOS))

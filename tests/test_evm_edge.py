@@ -202,3 +202,33 @@ def test_gas_opcode_63_64_rule():
     assert ok
     # outer keeps >= 1/64 of the gas at the call site
     assert gas_left > 640_000 // 64 - 1000
+
+
+def test_depth_1024_chain_without_recursion_limit():
+    """The trampoline (explicit generator frame stack) runs an EVM
+    depth-limit call chain at CPython's DEFAULT recursion limit — no
+    setrecursionlimit anywhere (round-4: de-recursed interpreter)."""
+    import sys
+
+    from reth_tpu.primitives.keccak import keccak256
+
+    assert sys.getrecursionlimit() <= 1100  # nobody raised it
+    # PUSH0 x5 ADDRESS GAS CALL STOP — calls itself until depth 1024
+    rt = bytes([0x5F] * 5 + [0x30, 0x5A, 0xF1, 0x00])
+    caller, contract = b"\x11" * 20, b"\x22" * 20
+    src = InMemoryStateSource(
+        {caller: Account(balance=10**18),
+         contract: Account(code_hash=keccak256(rt))},
+        codes={keccak256(rt): rt},
+    )
+    state = EvmState(src)
+    depths = []
+    interp = Interpreter(state, BlockEnv(), TxEnv(origin=caller),
+                         tracer=lambda pc, op, gas, st, mem, d: depths.append(d))
+    # enough gas that the 63/64 rule cannot stop the chain before the
+    # EVM depth cap: the chain MUST terminate at MAX_CALL_DEPTH
+    ok, gas_left, _ = interp.call(CallFrame(
+        caller=caller, address=contract, code=rt, data=b"", value=0,
+        gas=100_000_000_000))
+    assert ok
+    assert max(depths) == 1024  # hit the cap exactly, then unwound

@@ -1,0 +1,273 @@
+"""EXTERNAL conformance vectors — ground truth this repo did not generate.
+
+Breaks the generated-fixture circularity (round-3 verdict #3): every
+expected value here was produced by OTHER implementations — geth
+(`cast proof` / `geth init` outputs recorded in the reference's in-tree
+tests, crates/trie/db/tests/proof.rs), the EIP-8 specification's
+handshake test vectors (crates/net/ecies/src/algorithm.rs), and the
+canonical Ethereum mainnet/Holesky genesis data. A disagreement anywhere
+in keccak, RLP, secure-trie structure, proof spine extraction, ECIES, or
+signature recovery fails these tests against data we cannot have
+"agreed with ourselves" about.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256, keccak256_batch_np
+from reth_tpu.primitives.types import EMPTY_ROOT_HASH, Header
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.trie import TrieCommitter
+from reth_tpu.trie.incremental import full_state_root
+from reth_tpu.trie.proof import ProofCalculator
+
+FIXTURES = Path(__file__).parent / "fixtures"
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def _hx(s: str) -> bytes:
+    return bytes.fromhex(s.removeprefix("0x"))
+
+
+def _load_alloc(path, with_storage=False):
+    spec = json.loads((FIXTURES / path).read_text())
+    alloc = {}
+    storage = {}
+    codes = {}
+    for addr_hex, entry in spec["alloc"].items():
+        addr = _hx(addr_hex) if addr_hex.startswith("0x") else bytes.fromhex(addr_hex)
+        bal = entry.get("balance", "0")
+        bal = int(bal, 16) if bal.startswith("0x") else int(bal)
+        code = _hx(entry["code"]) if entry.get("code") else b""
+        ch = keccak256(code) if code else keccak256(b"")
+        alloc[addr] = Account(nonce=int(entry.get("nonce", "0"), 0),
+                              balance=bal, code_hash=ch)
+        if code:
+            codes[ch] = code
+        if with_storage and entry.get("storage"):
+            storage[addr] = {
+                _hx(k): int(v, 16) for k, v in entry["storage"].items()
+            }
+    return spec, alloc, storage, codes
+
+
+def _state_factory(alloc, storage):
+    factory = ProviderFactory(MemDb())
+    with factory.provider_rw() as p:
+        batch = list(alloc.items())
+        digests = CPU.hasher([a for a, _ in batch])
+        for (a, acct), ha in zip(batch, digests):
+            p.put_hashed_account(bytes(ha), acct)
+        for a, slots in storage.items():
+            ha = bytes(CPU.hasher([a])[0])
+            sk = list(slots.items())
+            sds = CPU.hasher([s for s, _ in sk])
+            for (s, v), hs in zip(sk, sds):
+                p.put_hashed_storage(ha, bytes(hs), v)
+        root = full_state_root(p, CPU)
+    return factory, root
+
+
+# -- geth-derived trie + proof vectors ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def geth_proofs():
+    return json.loads((FIXTURES / "geth_proofs.json").read_text())
+
+
+@pytest.fixture(scope="module")
+def testspec_state():
+    _, alloc, storage, _ = _load_alloc("proof-genesis.json")
+    return _state_factory(alloc, storage)
+
+
+def test_testspec_account_proofs_match_geth(geth_proofs, testspec_state):
+    """Byte-for-byte account-proof equality with geth's proof RPC over the
+    reference's 4-account test genesis (proof.rs testspec_proofs)."""
+    factory, root = testspec_state
+    with factory.provider() as p:
+        calc = ProofCalculator(p, CPU)
+        for case in geth_proofs["testspec"]:
+            ap = calc.account_proof(_hx(case["address"]))
+            assert [b"" + n for n in ap.proof] == [_hx(n) for n in case["proof"]], \
+                f"proof mismatch for {case['address']}"
+
+
+@pytest.fixture(scope="module")
+def mainnet_state():
+    spec, alloc, storage, _ = _load_alloc("mainnet-genesis.json")
+    factory, root = _state_factory(alloc, storage)
+    return spec, factory, root
+
+
+def test_mainnet_genesis_state_root_and_hash(mainnet_state):
+    """THE canonical external vector: the Ethereum mainnet genesis state
+    root and block hash, recomputed from the full 8893-account alloc."""
+    spec, factory, root = mainnet_state
+    assert root == _hx("0xd7f8974fb5ac78d9ac099b9ad5018bedc2ce0a72dad1827a1709da30580f0544")
+    assert root == _hx(spec["stateRoot"])
+    header = Header(
+        parent_hash=_hx(spec["parentHash"]),
+        beneficiary=_hx(spec["coinbase"]),
+        state_root=root,
+        difficulty=int(spec["difficulty"], 16),
+        number=int(spec["number"], 16),
+        gas_limit=int(spec["gasLimit"], 16),
+        gas_used=int(spec["gasUsed"], 16),
+        timestamp=int(spec["timestamp"], 16),
+        extra_data=_hx(spec["extraData"]),
+        mix_hash=_hx(spec["mixHash"]),
+        nonce=_hx(spec["nonce"]).rjust(8, b"\x00"),
+        base_fee_per_gas=None,
+        withdrawals_root=None,
+    )
+    assert header.hash == _hx(
+        "0xd4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3")
+
+
+def test_mainnet_genesis_account_proofs_match_geth(geth_proofs, mainnet_state):
+    """`cast proof ... --block 0` vectors over mainnet genesis: an existent
+    and a nonexistent account (proof.rs mainnet_genesis_account_proof*)."""
+    _, factory, root = mainnet_state
+    with factory.provider() as p:
+        calc = ProofCalculator(p, CPU)
+        for key in ("mainnet_existent", "mainnet_nonexistent"):
+            case = geth_proofs[key]
+            ap = calc.account_proof(_hx(case["address"]))
+            assert [b"" + n for n in ap.proof] == [_hx(n) for n in case["proof"]], key
+
+
+def test_holesky_deposit_contract_proof_matches_geth(geth_proofs):
+    """Holesky genesis deposit-contract: storage root, code hash, and the
+    `cast proof` account + storage proofs for slots 0x22/0x23/0x24 and a
+    nonexistent slot (proof.rs holesky_deposit_contract_proof)."""
+    _, alloc, storage, codes = _load_alloc("holesky-genesis.json", with_storage=True)
+    case = geth_proofs["holesky_deposit"]
+    target = _hx(case["address"])
+    assert alloc[target].code_hash == _hx(case["code_hash"])
+    factory, root = _state_factory(alloc, storage)
+    with factory.provider() as p:
+        calc = ProofCalculator(p, CPU)
+        slots = [int(sp["slot"], 16).to_bytes(32, "big")
+                 for sp in case["storage_proofs"]]
+        ap = calc.account_proof(target, slots)
+        assert ap.storage_root == _hx(case["storage_root"])
+        assert [b"" + n for n in ap.proof] == [_hx(n) for n in case["account_proof"]]
+        for sp, got in zip(case["storage_proofs"], ap.storage_proofs):
+            assert got.value == int(sp["value"], 16)
+            assert [b"" + n for n in got.proof] == [_hx(n) for n in sp["proof"]], sp["slot"]
+
+
+# -- EIP-8 RLPx handshake vectors --------------------------------------------
+
+EIP8_SERVER_KEY = 0xB71C71A67E1177AD4E901695E1B4B9EE17AE16C6668D313EAC2F96DBCDA3F291
+EIP8_SERVER_EPH = 0xE238EB8E04FEE6511AB04C6DD3C89CE097B11F25D584863AC2B6D5B35B1847E4
+EIP8_SERVER_NONCE = _hx("0x559aead08264d5795d3909718cdd05abd49572e84fe55590eef31a88a08fdffd")
+EIP8_CLIENT_KEY = 0x49A7B37AA6F6645917E7B807E9D1C00D4FA71F18343B0D4122A4D2DF64DD6FEE
+EIP8_CLIENT_EPH = 0x869D6ECF5211F1CC60418A13B9D870B22959D0C16F02BEC714C960DD2298A32D
+EIP8_CLIENT_NONCE = _hx("0x7e968bba13b6c50e2c4cd7f241cc0d64d1ac25c7f5952df231ac6a2bda8ee5d6")
+
+EIP8_AUTH_2 = _hx(
+    "0x01b304ab7578555167be8154d5cc456f567d5ba302662433674222360f08d5f1534499d3678b513b"
+    "0fca474f3a514b18e75683032eb63fccb16c156dc6eb2c0b1593f0d84ac74f6e475f1b8d56116b84"
+    "9634a8c458705bf83a626ea0384d4d7341aae591fae42ce6bd5c850bfe0b999a694a49bbbaf3ef6c"
+    "da61110601d3b4c02ab6c30437257a6e0117792631a4b47c1d52fc0f8f89caadeb7d02770bf999cc"
+    "147d2df3b62e1ffb2c9d8c125a3984865356266bca11ce7d3a688663a51d82defaa8aad69da39ab6"
+    "d5470e81ec5f2a7a47fb865ff7cca21516f9299a07b1bc63ba56c7a1a892112841ca44b6e0034dee"
+    "70c9adabc15d76a54f443593fafdc3b27af8059703f88928e199cb122362a4b35f62386da7caad09"
+    "c001edaeb5f8a06d2b26fb6cb93c52a9fca51853b68193916982358fe1e5369e249875bb8d0d0ec3"
+    "6f917bc5e1eafd5896d46bd61ff23f1a863a8a8dcd54c7b109b771c8e61ec9c8908c733c0263440e"
+    "2aa067241aaa433f0bb053c7b31a838504b148f570c0ad62837129e547678c5190341e4f1693956c"
+    "3bf7678318e2d5b5340c9e488eefea198576344afbdf66db5f51204a6961a63ce072c8926c")
+
+EIP8_AUTH_3 = _hx(
+    "0x01b8044c6c312173685d1edd268aa95e1d495474c6959bcdd10067ba4c9013df9e40ff45f5bfd6f7"
+    "2471f93a91b493f8e00abc4b80f682973de715d77ba3a005a242eb859f9a211d93a347fa64b597bf"
+    "280a6b88e26299cf263b01b8dfdb712278464fd1c25840b995e84d367d743f66c0e54a586725b7bb"
+    "f12acca27170ae3283c1073adda4b6d79f27656993aefccf16e0d0409fe07db2dc398a1b7e8ee93b"
+    "cd181485fd332f381d6a050fba4c7641a5112ac1b0b61168d20f01b479e19adf7fdbfa0905f63352"
+    "bfc7e23cf3357657455119d879c78d3cf8c8c06375f3f7d4861aa02a122467e069acaf513025ff19"
+    "6641f6d2810ce493f51bee9c966b15c5043505350392b57645385a18c78f14669cc4d960446c1757"
+    "1b7c5d725021babbcd786957f3d17089c084907bda22c2b2675b4378b114c601d858802a55345a15"
+    "116bc61da4193996187ed70d16730e9ae6b3bb8787ebcaea1871d850997ddc08b4f4ea668fbf3740"
+    "7ac044b55be0908ecb94d4ed172ece66fd31bfdadf2b97a8bc690163ee11f5b575a4b44e36e2bfb2"
+    "f0fce91676fd64c7773bac6a003f481fddd0bae0a1f31aa27504e2a533af4cef3b623f4791b2cca6"
+    "d490")
+
+EIP8_ACK_2 = _hx(
+    "0x01ea0451958701280a56482929d3b0757da8f7fbe5286784beead59d95089c217c9b917788989470"
+    "b0e330cc6e4fb383c0340ed85fab836ec9fb8a49672712aeabbdfd1e837c1ff4cace34311cd7f4de"
+    "05d59279e3524ab26ef753a0095637ac88f2b499b9914b5f64e143eae548a1066e14cd2f4bd7f814"
+    "c4652f11b254f8a2d0191e2f5546fae6055694aed14d906df79ad3b407d94692694e259191cde171"
+    "ad542fc588fa2b7333313d82a9f887332f1dfc36cea03f831cb9a23fea05b33deb999e85489e645f"
+    "6aab1872475d488d7bd6c7c120caf28dbfc5d6833888155ed69d34dbdc39c1f299be1057810f34fb"
+    "e754d021bfca14dc989753d61c413d261934e1a9c67ee060a25eefb54e81a4d14baff922180c395d"
+    "3f998d70f46f6b58306f969627ae364497e73fc27f6d17ae45a413d322cb8814276be6ddd13b885b"
+    "201b943213656cde498fa0e9ddc8e0b8f8a53824fbd82254f3e2c17e8eaea009c38b4aa0a3f306e8"
+    "797db43c25d68e86f262e564086f59a2fc60511c42abfb3057c247a8a8fe4fb3ccbadde17514b7ac"
+    "8000cdb6a912778426260c47f38919a91f25f4b5ffb455d6aaaf150f7e5529c100ce62d6d92826a7"
+    "1778d809bdf60232ae21ce8a437eca8223f45ac37f6487452ce626f549b3b5fdee26afd2072e4bc7"
+    "5833c2464c805246155289f4")
+
+EIP8_ACK_3 = _hx(
+    "0x01f004076e58aae772bb101ab1a8e64e01ee96e64857ce82b1113817c6cdd52c09d26f7b90981cd7"
+    "ae835aeac72e1573b8a0225dd56d157a010846d888dac7464baf53f2ad4e3d584531fa203658fab0"
+    "3a06c9fd5e35737e417bc28c1cbf5e5dfc666de7090f69c3b29754725f84f75382891c561040ea1d"
+    "dc0d8f381ed1b9d0d4ad2a0ec021421d847820d6fa0ba66eaf58175f1b235e851c7e2124069fbc20"
+    "2888ddb3ac4d56bcbd1b9b7eab59e78f2e2d400905050f4a92dec1c4bdf797b3fc9b2f8e84a482f3"
+    "d800386186712dae00d5c386ec9387a5e9c9a1aca5a573ca91082c7d68421f388e79127a5177d4f8"
+    "590237364fd348c9611fa39f78dcdceee3f390f07991b7b47e1daa3ebcb6ccc9607811cb17ce51f1"
+    "c8c2c5098dbdd28fca547b3f58c01a424ac05f869f49c6a34672ea2cbbc558428aa1fe48bbfd6115"
+    "8b1b735a65d99f21e70dbc020bfdface9f724a0d1fb5895db971cc81aa7608baa0920abb0a565c9c"
+    "436e2fd13323428296c86385f2384e408a31e104670df0791d93e743a3a5194ee6b076fb6323ca59"
+    "3011b7348c16cf58f66b9633906ba54a2ee803187344b394f75dd2e663a57b956cb830dd7a908d4f"
+    "39a2336a61ef9fda549180d4ccde21514d117b6c6fd07a9102b5efe710a32af4eeacae2cb3b1dec0"
+    "35b9593b48b9d3ca4c13d245d5f04169b0b1")
+
+
+def test_eip8_auth_vectors_decode():
+    """The EIP-8 spec's auth messages (versions 4 and 56, with and without
+    extra list elements) must decode against the spec's server key."""
+    from reth_tpu.net.ecies import Handshake
+
+    for raw in (EIP8_AUTH_2, EIP8_AUTH_3):
+        h = Handshake(EIP8_SERVER_KEY, eph_priv=EIP8_SERVER_EPH,
+                      nonce=EIP8_SERVER_NONCE)
+        ack, secrets = h.on_auth(raw)
+        assert secrets is not None and len(ack) > 2
+
+
+def test_eip8_ack_vectors_decode():
+    """The EIP-8 spec's ack messages must decode against the spec's client
+    key after the client sends its auth."""
+    from reth_tpu.net.ecies import Handshake, pubkey_from_priv
+
+    server_pub = pubkey_from_priv(EIP8_SERVER_KEY)
+    for raw in (EIP8_ACK_2, EIP8_ACK_3):
+        h = Handshake(EIP8_CLIENT_KEY, eph_priv=EIP8_CLIENT_EPH,
+                      nonce=EIP8_CLIENT_NONCE)
+        h.auth(server_pub)
+        secrets = h.finalize_initiator(raw)
+        assert secrets is not None
+
+
+def test_eip8_fixed_key_loopback():
+    """Full handshake with the EIP-8 fixed keys: both sides derive the
+    SAME frame secrets (MAC/AES seeds agree)."""
+    from reth_tpu.net.ecies import Handshake, pubkey_from_priv
+
+    client = Handshake(EIP8_CLIENT_KEY, eph_priv=EIP8_CLIENT_EPH,
+                       nonce=EIP8_CLIENT_NONCE)
+    server = Handshake(EIP8_SERVER_KEY, eph_priv=EIP8_SERVER_EPH,
+                       nonce=EIP8_SERVER_NONCE)
+    auth = client.auth(pubkey_from_priv(EIP8_SERVER_KEY))
+    ack, s_secrets = server.on_auth(auth)
+    c_secrets = client.finalize_initiator(ack)
+    assert c_secrets.aes == s_secrets.aes
+    assert c_secrets.mac == s_secrets.mac
