@@ -169,9 +169,9 @@ def cmd_import_era(args):
     if args.source:
         # checksummed multi-archive source driven by the Era STAGE
         # (reference era-downloader + EraStage)
-        from .era_sync import EraDownloader, EraSource, EraStage
+        from .era_sync import EraDownloader, EraStage, era_source_for
 
-        dl = EraDownloader(EraSource(args.source),
+        dl = EraDownloader(era_source_for(args.source),
                            Path(args.datadir) / "era-cache")
         paths = dl.fetch_all()
         tip = max(

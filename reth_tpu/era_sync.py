@@ -58,10 +58,76 @@ class EraSource:
         return len(lines)
 
 
+class HttpEraSource:
+    """An era archive provider over HTTP (reference
+    crates/era-downloader/src/client.rs): ``index.txt`` lives at
+    ``<base>/index.txt``; archives stream with RANGED requests so an
+    interrupted download resumes from the existing ``.part`` bytes
+    instead of restarting. Checksums still gate everything downstream —
+    a lying server can only waste bandwidth, never corrupt the import."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 chunk_size: int = 1 << 20):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self.chunk_size = chunk_size
+
+    def entries(self) -> list[tuple[str, str]]:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"{self.base_url}/index.txt", timeout=self.timeout) as r:
+                text = r.read().decode()
+        except OSError as e:
+            raise EraError(f"era index fetch failed: {e}")
+        out = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            name, checksum = line.split()
+            out.append((name, checksum))
+        return out
+
+    def fetch_into(self, name: str, tmp: Path) -> None:
+        """Stream ``name`` into ``tmp``, resuming from its current size
+        via a Range request when the server honors it (206)."""
+        import urllib.error
+        import urllib.request
+
+        offset = tmp.stat().st_size if tmp.exists() else 0
+        req = urllib.request.Request(f"{self.base_url}/{name}")
+        if offset:
+            req.add_header("Range", f"bytes={offset}-")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                mode = "ab" if offset and r.status == 206 else "wb"
+                with open(tmp, mode) as f:
+                    while True:
+                        chunk = r.read(self.chunk_size)
+                        if not chunk:
+                            break
+                        f.write(chunk)
+        except urllib.error.HTTPError as e:
+            if e.code == 416 and offset:  # range past EOF: already complete
+                return
+            raise EraError(f"era file fetch failed: {name}: {e}")
+        except OSError as e:
+            raise EraError(f"era file fetch failed: {name}: {e}")
+
+
+def era_source_for(location: str | Path):
+    """Pick the source type from the location: http(s) URL or local dir."""
+    if isinstance(location, str) and location.startswith(("http://", "https://")):
+        return HttpEraSource(location)
+    return EraSource(location)
+
+
 class EraDownloader:
     """Verified acquisition into a local cache directory."""
 
-    def __init__(self, source: EraSource, dest: str | Path):
+    def __init__(self, source, dest: str | Path):
         self.source = source
         self.dest = Path(dest)
         self.dest.mkdir(parents=True, exist_ok=True)
@@ -72,11 +138,14 @@ class EraDownloader:
         target = self.dest / name
         if target.exists() and self._ok(target, checksum):
             return target
-        src = self.source.open_path(name)
-        if not src.exists():
-            raise EraError(f"era file missing from source: {name}")
         tmp = target.with_suffix(".part")
-        shutil.copyfile(src, tmp)
+        if hasattr(self.source, "fetch_into"):  # remote: ranged + resumed
+            self.source.fetch_into(name, tmp)
+        else:
+            src = self.source.open_path(name)
+            if not src.exists():
+                raise EraError(f"era file missing from source: {name}")
+            shutil.copyfile(src, tmp)
         if not self._ok(tmp, checksum):
             tmp.unlink(missing_ok=True)
             raise EraError(f"checksum mismatch for {name}")

@@ -43,12 +43,22 @@ class ExExHandle:
         self.name = name
         self.handler = handler
         self.finished_height = 0  # highest block fully processed
+        # a backfilling ExEx pins finished_height at its backfill progress
+        # so the pruner cannot outrun it (reference FinishedHeight gate,
+        # exex/src/lib.rs:17-24)
+        self.backfilling = False
 
 
 class ExExManager:
     """Fan-out + WAL + finished-height aggregation."""
 
     def __init__(self, wal_dir: str | Path | None = None):
+        import threading
+
+        # serializes finished-height bookkeeping between live notify and
+        # a concurrent backfill (the pruning gate must never observe a
+        # torn backfilling/finished_height pair)
+        self._lock = threading.Lock()
         self.handles: list[ExExHandle] = []
         self.wal_path = Path(wal_dir) / "exex_wal.jsonl" if wal_dir else None
         self._next_seq = 0
@@ -73,7 +83,36 @@ class ExExManager:
                 f.flush()
         for h in self.handles:
             h.handler(notification)
-            h.finished_height = max(h.finished_height, notification.tip_number)
+            with self._lock:
+                if not h.backfilling:
+                    h.finished_height = max(h.finished_height,
+                                            notification.tip_number)
+
+    def backfill(self, handle: ExExHandle, factory, first: int, last: int,
+                 **job_kw) -> int:
+        """Catch a late-registered ExEx up over ``[first, last]``: the
+        historical chunks re-execute and deliver to THAT handle only,
+        while live notifications keep flowing to everyone else. The
+        handle's finished_height tracks backfill progress, holding the
+        pruning gate down until the backfill completes. Each delivered
+        notification carries the chunk's re-executed
+        ``BlockExecutionOutput``s as ``notification.outputs``."""
+        with self._lock:
+            handle.backfilling = True
+            handle.finished_height = min(handle.finished_height, first - 1)
+        delivered = 0
+        try:
+            for notification, outputs in BackfillJob(factory, first, last,
+                                                     **job_kw):
+                notification.outputs = outputs  # historical state changes
+                handle.handler(notification)
+                with self._lock:
+                    handle.finished_height = notification.tip_number
+                delivered += 1
+        finally:
+            with self._lock:
+                handle.backfilling = False
+        return delivered
 
     def finished_height(self) -> int:
         """Lowest height every extension has finished — the pruning gate."""
@@ -111,3 +150,59 @@ class ExExManager:
         with open(tmp, "w") as f:
             f.writelines(kept)
         tmp.replace(self.wal_path)
+
+
+class BackfillJob:
+    """Historical-range re-execution feeding a late-registered ExEx.
+
+    Reference analogue: `BackfillJob` (crates/exex/exex/src/backfill/job.rs)
+    — iterate a block range, re-execute each block against HISTORICAL
+    state, and yield committed chunks (here: a CanonStateNotification plus
+    the real BlockExecutionOutputs) in batches bounded by
+    ``batch_blocks``/``batch_gas`` (the ExecutionStageThresholds analogue).
+    """
+
+    def __init__(self, factory, first: int, last: int,
+                 batch_blocks: int = 64, batch_gas: int = 500_000_000,
+                 config=None):
+        self.factory = factory
+        self.first = first
+        self.last = last
+        self.batch_blocks = batch_blocks
+        self.batch_gas = batch_gas
+        self.config = config
+
+    def __iter__(self):
+        from .evm import BlockExecutor, EvmConfig
+        from .evm.executor import ProviderStateSource
+        from .storage.historical import HistoricalStateProvider
+
+        cfg = self.config or EvmConfig()
+        n = self.first
+        while n <= self.last:
+            blocks: list[tuple[int, bytes]] = []
+            outputs = []
+            gas = 0
+            with self.factory.provider() as p:
+                while n <= self.last and len(blocks) < self.batch_blocks \
+                        and gas < self.batch_gas:
+                    block = p.block_by_number(n)
+                    if block is None:
+                        raise ValueError(f"missing canonical block {n}")
+                    parent_state = HistoricalStateProvider(p, n - 1)
+                    executor = BlockExecutor(
+                        ProviderStateSource(parent_state), cfg)
+                    hashes = {}
+                    for k in range(max(0, n - 256), n):
+                        bh = p.canonical_hash(k)
+                        if bh:
+                            hashes[k] = bh
+                    out = executor.execute(block, block_hashes=hashes)
+                    blocks.append((n, block.hash))
+                    outputs.append(out)
+                    gas += out.gas_used
+                    n += 1
+            yield CanonStateNotification(
+                tip_number=blocks[-1][0], tip_hash=blocks[-1][1],
+                blocks=blocks,
+            ), outputs

@@ -18,7 +18,11 @@ from .wire import (
 )
 from .p2p import PeerConnection
 from .server import NetworkManager
-from .downloader import sync_from_peer
+from .downloader import (
+    BodiesDownloader,
+    download_headers_reverse,
+    sync_from_peer,
+)
 
 __all__ = [
     "EthMessage",
@@ -29,4 +33,6 @@ __all__ = [
     "PeerConnection",
     "NetworkManager",
     "sync_from_peer",
+    "BodiesDownloader",
+    "download_headers_reverse",
 ]

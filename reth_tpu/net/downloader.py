@@ -52,7 +52,7 @@ def download_bodies(peer: PeerConnection, headers: list) -> list[Block]:
 
 def sync_from_peer(factory, peer: PeerConnection, pipeline=None,
                    consensus: EthBeaconConsensus | None = None,
-                   committer=None) -> int:
+                   committer=None, extra_peers: tuple = ()) -> int:
     """Sync to the peer's head; returns the new local tip.
 
     With no ``pipeline`` given, the ONLINE stage set drives the whole
@@ -91,7 +91,8 @@ def sync_from_peer(factory, peer: PeerConnection, pipeline=None,
             if n - 1 > b_cp:
                 p.save_stage_checkpoint("Bodies", n - 1)
         Pipeline(factory, online_stages(peer, committer=committer,
-                                        consensus=consensus)).run(target)
+                                        consensus=consensus,
+                                        extra_peers=extra_peers)).run(target)
         return target
     if target <= local_tip:
         return local_tip
@@ -100,3 +101,140 @@ def sync_from_peer(factory, peer: PeerConnection, pipeline=None,
     tip = import_chain(factory, blocks, consensus)
     pipeline.run(tip)
     return tip
+
+
+def download_headers_reverse(peer: PeerConnection, tip_hash: bytes,
+                             stop_number: int,
+                             batch: int = HEADER_BATCH) -> list:
+    """Reverse tip→local header download (reference
+    `ReverseHeadersDownloader`, crates/net/downloaders/src/headers/
+    reverse_headers.rs): start from a TRUSTED tip HASH (forkchoice head —
+    its number is unknown up front) and walk parent links downward in
+    batches. Every header authenticates by hashing into the previously
+    verified child, so a lying peer cannot inject a header anywhere in
+    the range. Returns headers ASCENDING, first number = stop_number + 1.
+    """
+    out = []  # filled tip-first (descending)
+    want = tip_hash
+    while True:
+        hdrs = peer.get_headers(want, batch, reverse=True)
+        if not hdrs:
+            raise PeerError(f"peer returned no headers for {want.hex()[:16]}")
+        for h in hdrs[:batch]:
+            if h.hash != want:
+                raise PeerError(
+                    f"header {h.number} does not hash-link to its child")
+            if h.number <= stop_number:
+                raise PeerError(
+                    f"peer walked past the local chain at {h.number}")
+            out.append(h)
+            want = h.parent_hash
+            if h.number == stop_number + 1:
+                return list(reversed(out))
+
+
+class BodiesDownloader:
+    """Concurrent body download over MULTIPLE peers with bounded in-flight
+    windows (reference crates/net/downloaders/src/bodies/): the header
+    range splits into fixed windows, workers (one per peer) claim windows
+    from a shared queue, responses arrive out of order and re-assemble by
+    index. Each response is validated against its headers (body roots);
+    a bad or failing peer is penalized through the reputation sink, its
+    worker retires, and its window re-queues to a healthy peer.
+    """
+
+    def __init__(self, peers: list, window: int = BODY_BATCH,
+                 reporter=None, consensus=None):
+        """``peers``: PeerConnection-likes with ``get_bodies``.
+        ``reporter(peer, kind)``: reputation sink (kind is a
+        REPUTATION_CHANGE key, e.g. "bad_message" / "timeout")."""
+        self.peers = list(peers)
+        self.window = window
+        self.reporter = reporter or (lambda peer, kind: None)
+        from ..consensus import EthBeaconConsensus
+
+        self.consensus = consensus or EthBeaconConsensus()
+        self.stats: dict[int, int] = {}  # peer index -> windows served
+
+    def download(self, headers: list) -> list[Block]:
+        if not headers:
+            return []
+        import threading
+
+        windows = [headers[i:i + self.window]
+                   for i in range(0, len(headers), self.window)]
+        results: list[list[Block] | None] = [None] * len(windows)
+        # window states: "todo" | "inflight" | "done". A failed window
+        # returns to "todo"; healthy workers WAIT while anything is
+        # inflight elsewhere instead of exiting on an empty claim — a
+        # transient failure re-queues to a live peer, never to nobody.
+        state = {i: "todo" for i in range(len(windows))}
+        cond = threading.Condition()
+
+        def fetch_window(peer, idx: int) -> list[Block]:
+            chunk = windows[idx]
+            bodies = peer.get_bodies([h.hash for h in chunk])
+            if len(bodies) != len(chunk):
+                raise PeerError("missing bodies in response")
+            out = []
+            for header, body in zip(chunk, bodies):
+                blk = Block(header, body.transactions, body.ommers,
+                            body.withdrawals)
+                # roots bind the body to ITS header: a peer cannot serve
+                # the wrong (or tampered) body undetected
+                from ..consensus import ConsensusError
+
+                try:
+                    self.consensus.validate_block_pre_execution(blk)
+                except ConsensusError as e:
+                    raise PeerError(f"body {header.number} invalid: {e}")
+                out.append(blk)
+            return out
+
+        def claim() -> int | None:
+            """Next todo window; None when every window is done. Blocks
+            while windows are only in flight at OTHER workers (they may
+            fail and re-queue here)."""
+            with cond:
+                while True:
+                    todo_idx = next((i for i, s in state.items()
+                                     if s == "todo"), None)
+                    if todo_idx is not None:
+                        state[todo_idx] = "inflight"
+                        return todo_idx
+                    if all(s == "done" for s in state.values()):
+                        return None
+                    cond.wait(timeout=0.2)
+
+        def worker(pi: int, peer) -> None:
+            while True:
+                idx = claim()
+                if idx is None:
+                    return
+                try:
+                    got = fetch_window(peer, idx)
+                except Exception:  # noqa: BLE001 — ANY failure must
+                    # release the inflight window or waiters starve
+                    # penalize, re-queue the window, retire this peer
+                    self.reporter(peer, "bad_message")
+                    with cond:
+                        state[idx] = "todo"
+                        cond.notify_all()
+                    return
+                with cond:
+                    results[idx] = got
+                    state[idx] = "done"
+                    self.stats[pi] = self.stats.get(pi, 0) + 1
+                    cond.notify_all()
+
+        threads = [threading.Thread(target=worker, args=(i, p), daemon=True)
+                   for i, p in enumerate(self.peers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        missing = [i for i, r in enumerate(results) if r is None]
+        if missing:
+            raise PeerError(
+                f"{len(missing)} body windows unserved (all peers failed)")
+        return [blk for window_result in results for blk in window_result]

@@ -123,6 +123,12 @@ class Node:
 
         self.pool = TransactionPool(lambda: self.tree.overlay_provider(),
                                     PoolConfig(chain_id=config.chain_id))
+        # batched insertion + validation offload: RPC threads enqueue, one
+        # worker batch-recovers senders natively and inserts per batch
+        # (reference BatchTxProcessor + validation task)
+        from ..pool import TxBatcher
+
+        self.tx_batcher = TxBatcher(self.pool)
         with self.factory.provider() as p:
             tip = p.header_by_number(p.last_block_number())
         if tip is not None and tip.base_fee_per_gas is not None:
@@ -149,6 +155,20 @@ class Node:
 
         self.tree.canon_listeners.append(_maintain_pool)
 
+        # ExEx manager: durable canonical-state notifications + the
+        # FinishedHeight feedback that gates pruning (reference crates/exex)
+        from ..exex import CanonStateNotification, ExExManager
+
+        self.exex = ExExManager(config.datadir if config.datadir else None)
+
+        def _notify_exex(chain):
+            if chain and self.exex.handles:
+                self.exex.notify(CanonStateNotification(
+                    tip_number=chain[-1].number, tip_hash=chain[-1].hash,
+                    blocks=[(b.number, b.hash) for b in chain]))
+
+        self.tree.canon_listeners.append(_notify_exex)
+
         # data lifecycle: static-file producer + pruner run after
         # persistence advances (reference: launched after pipeline commits)
         self.static_producer = None
@@ -172,7 +192,9 @@ class Node:
                 if target >= 0:
                     self.static_producer.run(target)
             if self.pruner is not None:
-                self.pruner.run(tip)
+                # FinishedHeight gate: never prune past what every ExEx
+                # has finished (reference exex/src/lib.rs:17-24)
+                self.pruner.run(min(tip, self.exex.finished_height()))
 
         if self.static_producer is not None or self.pruner is not None:
             self.tree.canon_listeners.append(_lifecycle)
@@ -184,7 +206,8 @@ class Node:
         shared_lock = threading.RLock()
         # payload improvement loops must serialise with engine/RPC handlers
         self.payload_service.lock = shared_lock
-        self.eth_api = EthApi(self.tree, self.pool, config.chain_id)
+        self.eth_api = EthApi(self.tree, self.pool, config.chain_id,
+                              tx_batcher=self.tx_batcher)
         self.rpc = RpcServer(port=config.http_port, lock=shared_lock)
         self.rpc.register(self.eth_api)
         self.rpc.register(NetApi(config.chain_id))
@@ -369,6 +392,7 @@ class Node:
         return ports
 
     def stop(self):
+        self.tx_batcher.close()
         self.event_reporter.stop()
         self.tasks.graceful_shutdown()
         self.rpc.stop()

@@ -8,5 +8,6 @@ Reference analogue: crates/transaction-pool — the `TransactionPool` trait
 """
 
 from .pool import PoolConfig, PoolError, TransactionPool
+from .batcher import TxBatcher
 
-__all__ = ["PoolConfig", "PoolError", "TransactionPool"]
+__all__ = ["PoolConfig", "PoolError", "TransactionPool", "TxBatcher"]

@@ -87,6 +87,10 @@ class TransactionPool:
         import threading
 
         self.updated = threading.Event()
+        # one lock serializes mutation: RPC threads, the insertion batcher
+        # worker, and canonical-update maintenance all touch the indexes
+        # (reference: the pool lives behind a RwLock)
+        self._lock = threading.RLock()
 
     # -- submission -----------------------------------------------------------
 
@@ -106,8 +110,16 @@ class TransactionPool:
         self.blob_store.insert(h, sidecar)
         return h
 
-    def add_transaction(self, tx: Transaction, _with_sidecar: bool = False) -> bytes:
-        """Validate + insert; returns the tx hash. Raises PoolError."""
+    def add_transaction(self, tx: Transaction, _with_sidecar: bool = False,
+                        sender: bytes | None = None) -> bytes:
+        """Validate + insert; returns the tx hash. Raises PoolError.
+        ``sender`` skips in-line recovery when the caller already recovered
+        it (the insertion batcher's native batched secp dispatch)."""
+        with self._lock:
+            return self._add_locked(tx, _with_sidecar, sender)
+
+    def _add_locked(self, tx: Transaction, _with_sidecar: bool,
+                    sender: bytes | None) -> bytes:
         h = tx.hash
         if h in self.by_hash:
             raise PoolError("already known")
@@ -125,10 +137,11 @@ class TransactionPool:
                 and tx.chain_id != self.config.chain_id):
             raise PoolError(
                 f"wrong chain id {tx.chain_id} (expected {self.config.chain_id})")
-        try:
-            sender = tx.recover_sender()
-        except ValueError as e:
-            raise PoolError(f"invalid signature: {e}")
+        if sender is None:
+            try:
+                sender = tx.recover_sender()
+            except ValueError as e:
+                raise PoolError(f"invalid signature: {e}")
         if tx.tx_type >= 2 and tx.max_priority_fee_per_gas > tx.max_fee_per_gas:
             raise PoolError("priority fee exceeds max fee")
         # operator price floor (miner_setGasPrice): tip for 1559 txs,
@@ -161,12 +174,40 @@ class TransactionPool:
         if len(sender_txs) >= self.config.max_account_slots and existing is None:
             raise PoolError("sender slot limit")
         if len(self.by_hash) >= self.config.max_pool_size:
-            raise PoolError("pool full")
+            # saturated: evict the worst-paying tx (and its descendants)
+            # for a better one, else reject as underpriced (reference
+            # discard_worst, pool/txpool.rs:1232)
+            if tx.tx_type >= 2:
+                tip = min(tx.max_priority_fee_per_gas,
+                          max(0, tx.max_fee_per_gas - self.base_fee))
+            else:
+                tip = tx.gas_price - self.base_fee
+            self._discard_worst(tip)
+            # the discard may have evicted THIS sender's worst tx and
+            # dropped its by_sender entry — re-anchor, or the insert below
+            # would write into an orphaned dict invisible to the pool
+            sender_txs = self.by_sender.setdefault(sender, {})
         ptx = PooledTx(tx, sender, next(self._submission_counter), cost)
         sender_txs[tx.nonce] = ptx
         self.by_hash[h] = ptx
         self.updated.set()
         return h
+
+    def _discard_worst(self, incoming_tip: int) -> None:
+        """Make room in a full pool: drop the lowest-priority tx plus its
+        same-sender descendants (their nonces gap without it); raise when
+        the incoming tx does not pay more than the current worst."""
+        worst = min(self.by_hash.values(),
+                    key=lambda p: (p.effective_tip(self.base_fee),
+                                   -p.submission_id))
+        if worst.effective_tip(self.base_fee) >= incoming_tip:
+            raise PoolError("pool full: transaction underpriced")
+        txs = self.by_sender.get(worst.sender, {})
+        for n in sorted(n for n in txs if n >= worst.nonce):
+            self._drop(txs[n].tx.hash)
+            del txs[n]
+        if not txs:
+            self.by_sender.pop(worst.sender, None)
 
     def _fee_of(self, tx: Transaction) -> int:
         return tx.max_fee_per_gas if tx.tx_type >= 2 else tx.gas_price
@@ -186,6 +227,10 @@ class TransactionPool:
         BestTransactions::mark_invalid feeding pool removal) — without this
         an instant-seal dev miner spins forever on a 'best' tx that every
         build skips."""
+        with self._lock:
+            self._remove_invalid_locked(tx_hash)
+
+    def _remove_invalid_locked(self, tx_hash: bytes) -> None:
         ptx = self.by_hash.get(tx_hash)
         if ptx is None:
             return
@@ -295,6 +340,11 @@ class TransactionPool:
         Reference: the maintenance task (src/maintain.rs) driven by
         CanonStateNotifications.
         """
+        with self._lock:
+            self._on_canon_locked(base_fee, blob_base_fee)
+
+    def _on_canon_locked(self, base_fee: int,
+                         blob_base_fee: int | None) -> None:
         self.base_fee = base_fee
         if blob_base_fee is not None:
             self.blob_base_fee = blob_base_fee

@@ -26,12 +26,14 @@ from .server import RpcError
 
 
 class EthApi:
-    def __init__(self, tree: EngineTree, pool=None, chain_id: int = 1):
+    def __init__(self, tree: EngineTree, pool=None, chain_id: int = 1,
+                 tx_batcher=None):
         from .gas_oracle import GasPriceOracle
         from .state_cache import EthStateCache
 
         self.tree = tree
         self.pool = pool
+        self.tx_batcher = tx_batcher
         self.chain_id = chain_id
         self.gas_oracle = GasPriceOracle()
         self.state_cache = EthStateCache()
@@ -302,16 +304,26 @@ class EthApi:
         return []
 
     def eth_sendRawTransaction(self, raw):
+        # (marked _lockfree below: pool/batcher carry their own locks)
         if self.pool is None:
             raise RpcError(-32000, "no transaction pool")
         tx = Transaction.decode(parse_data(raw))
         from ..pool import PoolError
 
         try:
-            h = self.pool.add_transaction(tx)
+            # through the insertion batcher when the node wired one:
+            # validation (sender recovery) runs batched off this thread
+            if self.tx_batcher is not None:
+                h = self.tx_batcher.add_sync(tx)
+            else:
+                h = self.pool.add_transaction(tx)
         except PoolError as e:
             raise RpcError(-32000, str(e))
+        except TimeoutError as e:
+            raise RpcError(-32000, f"tx submission timed out: {e}")
         return data(h)
+
+    eth_sendRawTransaction._lockfree = True
 
     # -- execution (read-only) ---------------------------------------------------
 

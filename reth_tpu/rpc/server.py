@@ -92,8 +92,15 @@ class RpcServer:
             return self._error(rid, METHOD_NOT_FOUND, f"method {method} not found")
         params = req.get("params", [])
         try:
-            with self.lock:
+            if getattr(fn, "_lockfree", False):
+                # handlers that only touch self-locking components (the
+                # tx batcher/pool) skip the global lock: holding it while
+                # awaiting a batched insert would serialize the batcher
+                # down to batches of one and stall unrelated RPCs
                 result = fn(*params) if isinstance(params, list) else fn(**params)
+            else:
+                with self.lock:
+                    result = fn(*params) if isinstance(params, list) else fn(**params)
         except RpcError as e:
             return self._error(rid, e.code, e.message)
         except TypeError as e:

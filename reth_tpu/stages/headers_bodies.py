@@ -61,13 +61,13 @@ class BodiesStage(Stage):
     id = "Bodies"
 
     def __init__(self, client, consensus: EthBeaconConsensus | None = None,
-                 max_blocks_per_commit: int = 2048):
+                 max_blocks_per_commit: int = 2048, extra_peers: tuple = ()):
         self.client = client
+        self.extra_peers = tuple(extra_peers)  # concurrent body windows
         self.consensus = consensus or EthBeaconConsensus()
         self.max_blocks = max_blocks_per_commit
 
     def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
-        from ..net.downloader import download_bodies
         from ..net.p2p import PeerError
 
         end = min(inp.target, inp.checkpoint + self.max_blocks)
@@ -77,19 +77,22 @@ class BodiesStage(Stage):
             if h is None:
                 raise StageError(f"missing header {m} (HeadersStage gap)", block=m)
             headers.append(h)
-        try:  # shared fetch helper: batching + response-size validation
-            blocks = download_bodies(self.client, headers)
+        try:  # windowed multi-peer fetch (out-of-order reassembly +
+            # reputation feedback; reference net/downloaders/src/bodies/)
+            from ..net.downloader import BodiesDownloader
+
+            dl = BodiesDownloader([self.client, *self.extra_peers],
+                                  consensus=self.consensus)
+            blocks = dl.download(headers)
         except PeerError as e:
             raise StageError(str(e), block=inp.next_block)
         for block in blocks:
             if provider.block_body_indices(block.header.number) is not None:
                 continue  # already stored (e.g. legacy import): re-inserting
                 # would renumber its transactions
-            try:
-                self.consensus.validate_block_pre_execution(block)
-            except ConsensusError as e:
-                raise StageError(f"invalid body {block.header.number}: {e}",
-                                 block=block.header.number)
+            # pre-execution validation already ran inside the downloader
+            # (it binds each body to its header per window) — validating
+            # again here would hash every body twice per chunk
             provider.insert_block_body(block)
         return ExecOutput(checkpoint=end, done=end >= inp.target)
 
@@ -117,13 +120,15 @@ class BodiesStage(Stage):
                 provider.tx.delete(table, key)
 
 
-def online_stages(client, committer=None, consensus=None) -> list[Stage]:
+def online_stages(client, committer=None, consensus=None,
+                  extra_peers: tuple = ()) -> list[Stage]:
     """The full networked stage set: download stages + the offline tail
-    (reference `DefaultStages` = online + offline, sets.rs:85)."""
+    (reference `DefaultStages` = online + offline, sets.rs:85).
+    ``extra_peers`` join the windowed concurrent body download."""
     from . import default_stages
 
     return [
         HeadersStage(client, consensus=consensus),
-        BodiesStage(client, consensus=consensus),
+        BodiesStage(client, consensus=consensus, extra_peers=extra_peers),
         *default_stages(committer=committer, consensus=consensus),
     ]

@@ -1,0 +1,122 @@
+"""ExEx backfill + FinishedHeight pruning gate.
+
+Reference analogue: crates/exex/exex/src/backfill/ (BackfillJob re-executes
+historical ranges for late-registered extensions) and the FinishedHeight
+contract (src/lib.rs:17-24): pruning must never outrun the slowest ExEx.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from reth_tpu.exex import BackfillJob, CanonStateNotification, ExExManager
+from reth_tpu.node import Node, NodeConfig
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.prune import PruneMode, PruneModes
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def dev_node(tmp_path, **cfg_kw):
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)},
+                           committer=CPU)
+    cfg = NodeConfig(dev=True, datadir=tmp_path,
+                     genesis_header=builder.genesis,
+                     genesis_alloc=builder.accounts_at_genesis,
+                     persistence_threshold=cfg_kw.pop("persistence_threshold", 0),
+                     **cfg_kw)
+    return Node(cfg, committer=CPU), alice
+
+
+def test_backfill_reexecutes_history_with_outputs(tmp_path):
+    """A late ExEx backfills a historical range: every chunk arrives with
+    REAL re-executed outputs whose receipts match what the chain stored."""
+    node, alice = dev_node(tmp_path)
+    for i in range(6):
+        node.pool.add_transaction(alice.transfer(b"\x0b" * 20, 100 + i))
+        node.miner.mine_block()
+    assert node.tree.persisted_number == 6
+
+    seen = []
+    handle = node.exex.register("indexer", lambda n: seen.append(n))
+    delivered = node.exex.backfill(handle, node.factory, 1, 6,
+                                   batch_blocks=2)
+    assert delivered == 3  # 6 blocks in 2-block chunks
+    assert [n.tip_number for n in seen] == [2, 4, 6]
+    assert handle.finished_height == 6 and handle.backfilling is False
+    # outputs are the real historical execution results
+    with node.factory.provider() as p:
+        for n in seen:
+            for (num, _h), out in zip(n.blocks, n.outputs):
+                idx = p.block_body_indices(num)
+                for i, r in enumerate(out.receipts):
+                    stored = p.receipt(idx.first_tx_num + i)
+                    assert stored.cumulative_gas_used == r.cumulative_gas_used
+    node.stop()
+
+
+def test_backfill_interleaves_with_live_notifications(tmp_path):
+    """Live tip notifications keep flowing to OTHER extensions while one
+    handle backfills; the backfiller's finished_height lags at its own
+    progress (it pins the pruning gate)."""
+    # threshold 1 keeps the tip in memory so canonical notifications carry
+    # the new block (a fully persisted chain has nothing left to announce)
+    node, alice = dev_node(tmp_path, persistence_threshold=1)
+    for i in range(4):
+        node.pool.add_transaction(alice.transfer(b"\x0c" * 20, 50 + i))
+        node.miner.mine_block()
+    assert node.tree.persisted_number == 3
+
+    live_seen = []
+    node.exex.register("live", lambda n: live_seen.append(n.tip_number))
+    slow_seen = []
+    slow = node.exex.register("slow", lambda n: slow_seen.append(n.tip_number))
+
+    # deliver one backfill chunk "mid-flight", then a live block lands
+    job = iter(BackfillJob(node.factory, 1, 3, batch_blocks=2))
+    slow.backfilling = True
+    notification, outputs = next(job)
+    slow.handler(notification)
+    slow.finished_height = notification.tip_number
+    assert node.exex.finished_height() == 0  # live handle hasn't seen any
+
+    node.pool.add_transaction(alice.transfer(b"\x0c" * 20, 99))
+    node.miner.mine_block()  # live notification -> both handlers
+    assert live_seen[-1] == 5
+    # the backfilling handle received the live notification but its
+    # finished_height stays pinned at backfill progress
+    assert slow_seen == [2, 5]
+    assert slow.finished_height == 2
+    assert node.exex.finished_height() == 2  # the gate
+    node.stop()
+
+
+def test_pruner_held_by_finished_height(tmp_path):
+    """With receipts pruning configured, the pruner cannot advance past a
+    backfilling ExEx's finished height; once the backfill completes and
+    the height advances, pruning proceeds."""
+    node, alice = dev_node(
+        tmp_path, prune_modes=PruneModes(receipts=PruneMode(distance=1)))
+    # an ExEx that is still at height 0 pins the gate
+    handle = node.exex.register("holder", lambda n: None)
+    handle.backfilling = True  # simulates a long backfill in progress
+    for i in range(6):
+        node.pool.add_transaction(alice.transfer(b"\x0d" * 20, 10 + i))
+        node.miner.mine_block()
+    with node.factory.provider() as p:
+        idx = p.block_body_indices(1)
+        assert p.receipt(idx.first_tx_num) is not None  # NOT pruned
+
+    # backfill completes: the gate lifts, the next canonical change prunes
+    node.exex.backfill(handle, node.factory, 1, node.tree.persisted_number)
+    assert node.exex.finished_height() == node.tree.persisted_number
+    node.pool.add_transaction(alice.transfer(b"\x0d" * 20, 999))
+    node.miner.mine_block()
+    with node.factory.provider() as p:
+        idx = p.block_body_indices(1)
+        assert p.receipt(idx.first_tx_num) is None  # pruned now
+    node.stop()

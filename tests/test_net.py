@@ -287,3 +287,23 @@ def test_status_v69_codec_roundtrip():
     bru = wire.BlockRangeUpdate(1, 2, b"\x03" * 32)
     frame = wire.encode_message(bru)
     assert wire.decode_message(frame[4:]) == bru
+
+
+def test_online_sync_with_two_peers(testnet):
+    """Testnet sync where the body windows are served by TWO live peer
+    connections concurrently (reference concurrent bodies downloader)."""
+    server, port, status, factory_b, builder = testnet
+    our_status = Status(network_id=1, head=builder.genesis.hash,
+                        genesis=builder.genesis.hash)
+    peer1 = PeerConnection.connect("127.0.0.1", port, our_status,
+                                   pubkey_from_priv(server.node_priv))
+    peer2 = PeerConnection.connect("127.0.0.1", port, our_status,
+                                   pubkey_from_priv(server.node_priv))
+    tip = sync_from_peer(factory_b, peer1, committer=CPU,
+                         extra_peers=(peer2,))
+    assert tip == 8
+    p = factory_b.provider()
+    assert p.stage_checkpoint("Finish") == 8
+    assert p.header_by_number(8).state_root == builder.tip.state_root
+    peer1.close()
+    peer2.close()

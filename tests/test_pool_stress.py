@@ -1,0 +1,157 @@
+"""Saturated-pool + batcher stress tests.
+
+Reference analogue: benches/saturated_pool.rs (insertion behavior at max
+capacity) + batcher.rs tests (concurrent batched insertion) + the
+discard_worst semantics in pool/txpool.rs:1232.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from reth_tpu.engine import EngineTree
+from reth_tpu.pool import PoolConfig, PoolError, TransactionPool, TxBatcher
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def make_pool(n_senders: int, max_pool: int):
+    wallets = [Wallet(0x50000 + i) for i in range(n_senders)]
+    alloc = {w.address: Account(balance=10**20) for w in wallets}
+    builder = ChainBuilder(alloc, committer=CPU)
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 committer=CPU)
+    tree = EngineTree(factory, committer=CPU)
+    pool = TransactionPool(lambda: tree.overlay_provider(),
+                           PoolConfig(max_pool_size=max_pool,
+                                      max_account_slots=64))
+    pool.base_fee = 10**9
+    return wallets, pool
+
+
+def tip_tx(w, tip_gwei: int):
+    return w.transfer(b"\x99" * 20, 1, max_fee_per_gas=1000 * 10**9,
+                      max_priority_fee_per_gas=tip_gwei * 10**9)
+
+
+def test_saturated_pool_discards_worst():
+    """A full pool admits better-paying txs by evicting the worst, and
+    rejects underpriced ones — size stays bounded throughout."""
+    wallets, pool = make_pool(n_senders=300, max_pool=100)
+    # fill with tips 1..100 gwei (one tx per sender)
+    for i in range(100):
+        pool.add_transaction(tip_tx(wallets[i], 1 + i))
+    assert len(pool) == 100
+    # underpriced: tip below the current worst (1 gwei) -> rejected
+    with pytest.raises(PoolError, match="underpriced"):
+        pool.add_transaction(tip_tx(wallets[200], 0))
+    # 150 better-paying txs: each evicts the then-worst; size stays capped
+    for i in range(150):
+        pool.add_transaction(tip_tx(wallets[100 + i], 200 + i))
+        assert len(pool) <= 100
+    assert len(pool) == 100
+    tips = sorted(p.effective_tip(pool.base_fee) // 10**9
+                  for p in pool.by_hash.values())
+    # the survivors are the 100 best-paying: the 1..100 gwei originals and
+    # the weakest third of the 200-tier were all evicted in turn
+    assert tips[0] >= 250 and all(t >= 250 for t in tips)
+
+
+def test_discard_worst_drops_descendants():
+    """Evicting a sender's tx also drops their later nonces (gapped)."""
+    wallets, pool = make_pool(n_senders=10, max_pool=4)
+    victim = wallets[0]
+    pool.add_transaction(tip_tx(victim, 1))        # nonce 0, worst
+    pool.add_transaction(tip_tx(victim, 300))      # nonce 1 (descendant)
+    pool.add_transaction(tip_tx(wallets[1], 5))
+    pool.add_transaction(tip_tx(wallets[2], 5))
+    assert len(pool) == 4
+    pool.add_transaction(tip_tx(wallets[3], 50))   # evicts victim nonce 0
+    # the descendant went with it: no nonce-gapped orphan remains
+    assert victim.address not in pool.by_sender
+    assert len(pool) == 3
+
+
+def test_batcher_concurrent_submissions():
+    """Many threads submitting through the batcher: every future resolves,
+    the pool holds exactly the valid set, and batching actually occurred
+    (fewer batches than transactions)."""
+    wallets, pool = make_pool(n_senders=120, max_pool=10_000)
+    batcher = TxBatcher(pool, max_batch=64)
+    txs = []
+    for w in wallets:
+        for n in range(3):
+            txs.append(w.transfer(b"\x88" * 20, 1 + n))
+    futures = []
+    fut_lock = threading.Lock()
+
+    def submit(chunk):
+        for t in chunk:
+            f = batcher.submit(t)
+            with fut_lock:
+                futures.append(f)
+
+    threads = [threading.Thread(target=submit, args=(txs[i::8],))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = [f.result(timeout=30) for f in futures]
+    assert len(results) == 360 and all(isinstance(h, bytes) for h in results)
+    assert len(pool) == 360
+    assert batcher.processed == 360
+    assert batcher.batches < 360  # batching happened
+    batcher.close()
+
+
+def test_batcher_rejects_invalid_within_batch():
+    """A bad tx inside a batch fails ITS future only; neighbors land."""
+    wallets, pool = make_pool(n_senders=3, max_pool=100)
+    batcher = TxBatcher(pool, max_batch=16)
+    from reth_tpu.primitives.types import Transaction
+
+    good1 = tip_tx(wallets[0], 2)
+    signed = tip_tx(wallets[1], 2)
+    bad = Transaction(**{**signed.__dict__, "r": 0})  # unrecoverable sig
+    good2 = tip_tx(wallets[2], 2)
+    f1, f2, f3 = batcher.submit(good1), batcher.submit(bad), batcher.submit(good2)
+    assert isinstance(f1.result(30), bytes)
+    assert isinstance(f3.result(30), bytes)
+    with pytest.raises(PoolError, match="signature"):
+        f2.result(30)
+    assert len(pool) == 2
+    batcher.close()
+
+
+def test_discard_worst_same_sender_stays_visible():
+    """Regression (round-4 review): when the evicted worst tx belongs to
+    the INCOMING sender, the new tx must land in a live by_sender entry —
+    not an orphaned dict invisible to best_transactions."""
+    wallets, pool = make_pool(n_senders=4, max_pool=3)
+    s = wallets[0]
+    pool.add_transaction(tip_tx(s, 1))             # worst, nonce 0
+    pool.add_transaction(tip_tx(wallets[1], 5))
+    pool.add_transaction(tip_tx(wallets[2], 5))
+    assert len(pool) == 3
+    # same sender submits a much better tx at nonce 1: the discard evicts
+    # their nonce-0 worst (and thus their whole by_sender entry)
+    better = tip_tx(s, 500)
+    h = pool.add_transaction(better)
+    assert pool.contains(h)
+    assert s.address in pool.by_sender
+    assert pool.by_sender[s.address][1].tx.hash == h
+    # nonce 1 is gapped (nonce 0 evicted) so not yieldable, but VISIBLE:
+    # once the chain advances past nonce 0 it becomes minable — the ghost
+    # bug made it permanently invisible instead
+    assert h in {p.tx.hash for txs in pool.by_sender.values()
+                 for p in txs.values()}
