@@ -20,6 +20,7 @@ from .p2p import PeerConnection
 from .server import NetworkManager
 from .downloader import (
     BodiesDownloader,
+    FullBlockClient,
     download_headers_reverse,
     sync_from_peer,
 )
@@ -34,5 +35,6 @@ __all__ = [
     "NetworkManager",
     "sync_from_peer",
     "BodiesDownloader",
+    "FullBlockClient",
     "download_headers_reverse",
 ]

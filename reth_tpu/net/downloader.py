@@ -104,32 +104,39 @@ def sync_from_peer(factory, peer: PeerConnection, pipeline=None,
 
 
 def download_headers_reverse(peer: PeerConnection, tip_hash: bytes,
-                             stop_number: int,
-                             batch: int = HEADER_BATCH) -> list:
+                             stop_number: int | None = None,
+                             batch: int = HEADER_BATCH,
+                             count: int | None = None) -> list:
     """Reverse tip→local header download (reference
     `ReverseHeadersDownloader`, crates/net/downloaders/src/headers/
     reverse_headers.rs): start from a TRUSTED tip HASH (forkchoice head —
     its number is unknown up front) and walk parent links downward in
     batches. Every header authenticates by hashing into the previously
     verified child, so a lying peer cannot inject a header anywhere in
-    the range. Returns headers ASCENDING, first number = stop_number + 1.
+    the range. Returns headers ASCENDING. The walk is bounded by EITHER
+    ``stop_number`` (first returned number = stop_number + 1) OR ``count``
+    headers (the FullBlockClient mode) — exactly one must be given.
     """
+    assert (stop_number is None) != (count is None), \
+        "give exactly one of stop_number/count"
     out = []  # filled tip-first (descending)
     want = tip_hash
     while True:
-        hdrs = peer.get_headers(want, batch, reverse=True)
+        limit = batch if count is None else min(batch, count - len(out))
+        hdrs = peer.get_headers(want, limit, reverse=True)
         if not hdrs:
             raise PeerError(f"peer returned no headers for {want.hex()[:16]}")
-        for h in hdrs[:batch]:
+        for h in hdrs[:limit]:
             if h.hash != want:
                 raise PeerError(
                     f"header {h.number} does not hash-link to its child")
-            if h.number <= stop_number:
+            if stop_number is not None and h.number <= stop_number:
                 raise PeerError(
                     f"peer walked past the local chain at {h.number}")
             out.append(h)
             want = h.parent_hash
-            if h.number == stop_number + 1:
+            if (stop_number is not None and h.number == stop_number + 1) \
+                    or (count is not None and len(out) == count):
                 return list(reversed(out))
 
 
@@ -238,3 +245,80 @@ class BodiesDownloader:
             raise PeerError(
                 f"{len(missing)} body windows unserved (all peers failed)")
         return [blk for window_result in results for blk in window_result]
+
+
+class FullBlockClient:
+    """Composable full-block fetch over any header+body client.
+
+    Reference analogue: `FullBlockClient` (crates/net/p2p/src/full_block.rs)
+    — wraps a client exposing ``get_headers``/``get_bodies`` and returns
+    SEALED blocks: the header is guaranteed to match the requested hash,
+    bodies are validated against their headers (transaction/ommers/
+    withdrawals roots) with bounded retries for mismatched responses.
+    The engine uses this to fill parent gaps during live sync.
+    """
+
+    MAX_RETRIES = 3
+
+    def __init__(self, client, consensus=None):
+        from ..consensus import EthBeaconConsensus
+
+        self.client = client
+        self.consensus = consensus or EthBeaconConsensus()
+
+    def get_full_block(self, block_hash: bytes) -> Block:
+        """One sealed block by hash; raises PeerError after retries."""
+        return self.get_full_block_range(block_hash, 1)[0]
+
+    def get_full_block_range(self, start_hash: bytes,
+                             count: int) -> list[Block]:
+        """``count`` sealed blocks ending at ``start_hash``, DESCENDING by
+        number (reference semantics: walk parent links downward)."""
+        headers = list(reversed(download_headers_reverse(
+            self.client, start_hash, count=count)))
+        blocks: list[Block | None] = [None] * len(headers)
+        remaining = list(range(len(headers)))
+        from ..consensus import ConsensusError
+
+        from ..trie.state_root import ordered_trie_root
+
+        for _attempt in range(self.MAX_RETRIES):
+            want = [headers[i] for i in remaining]
+            bodies = self.client.get_bodies([h.hash for h in want])
+            # eth GetBlockBodies OMITS unknown hashes (no gaps): align by
+            # each body's TRANSACTIONS ROOT instead of position — a
+            # mid-list omission skips its header, and a corrupt body that
+            # matches no header is discarded instead of starving the scan
+            k = 0
+            still = []
+            pending = list(remaining)
+            while pending:
+                if k >= len(bodies):
+                    still.extend(pending)
+                    break
+                body = bodies[k]
+                tx_root = ordered_trie_root(
+                    [tx.encode() for tx in body.transactions])
+                j = next((idx for idx, i in enumerate(pending)
+                          if headers[i].transactions_root == tx_root), None)
+                if j is None:
+                    k += 1  # unmatchable (corrupt/foreign) body: discard
+                    continue
+                # headers skipped over were OMITTED by the peer
+                still.extend(pending[:j])
+                i = pending[j]
+                pending = pending[j + 1:]
+                blk = Block(headers[i], body.transactions, body.ommers,
+                            body.withdrawals)
+                try:
+                    self.consensus.validate_block_pre_execution(blk)
+                    blocks[i] = blk
+                except ConsensusError:
+                    still.append(i)  # tx root matched but ommers/blob
+                    # gas/withdrawals did not: refetch this one
+                k += 1
+            remaining = sorted(still)
+            if not remaining:
+                return blocks
+        raise PeerError(
+            f"{len(remaining)} bodies failed validation after retries")

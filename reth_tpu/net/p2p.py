@@ -226,6 +226,13 @@ class PeerConnection:
 
     def close(self):
         self.session.close()
+        for fn in getattr(self, "_on_close", ()):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — bookkeeping must not
+                # block socket teardown
+                pass
+        self._on_close = ()
 
 
 def _validate_status(ours: Status, theirs: Status, fork_filter=None) -> None:

@@ -33,8 +33,16 @@ def parse_enode(url: str) -> tuple[tuple[int, int], str, int]:
 class NetworkManager:
     def __init__(self, factory, status: Status, pool=None, host: str = "127.0.0.1",
                  port: int = 0, node_priv: int | None = None,
-                 chain_spec=None, head_position: tuple[int, int] = (0, 0)):
+                 chain_spec=None, head_position: tuple[int, int] = (0, 0),
+                 max_inbound: int = 30, max_outbound: int = 100,
+                 provider_fn=None):
         self.factory = factory
+        # request serving reads THIS view: a node passes its engine-tree
+        # overlay provider so peers can fetch the announced in-memory tip
+        # (blocks above the persistence threshold live in the tree, not
+        # the DB — serving only persisted state would advertise a head
+        # nobody can download)
+        self._provider_fn = provider_fn or factory.provider
         self.status = status
         self.pool = pool
         self.host = host
@@ -48,8 +56,14 @@ class NetworkManager:
         self.head_position = head_position
         self.peers: list[PeerConnection] = []
         from .reputation import PeersManager
+        from .sessions import SessionManager
 
         self.peers_manager = PeersManager()
+        # session lifecycle + caps + events (reference SessionManager in
+        # the Swarm, src/session/mod.rs): capacity reserves BEFORE the
+        # handshake, transitions fan out to listeners
+        self.sessions = SessionManager(max_inbound=max_inbound,
+                                       max_outbound=max_outbound)
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -78,9 +92,28 @@ class NetworkManager:
 
         if self.peers_manager.is_banned(pubkey_to_bytes(pub)):
             raise PeerError("peer is banned")
-        peer = PeerConnection.connect(host, port, self.status, pub,
-                                      node_priv=self.node_priv, timeout=timeout,
-                                      fork_filter=self._fork_filter)
+        session = self.sessions.reserve("outbound")
+        try:
+            peer = PeerConnection.connect(host, port, self.status, pub,
+                                          node_priv=self.node_priv,
+                                          timeout=timeout,
+                                          fork_filter=self._fork_filter)
+        except BaseException:
+            self.sessions.close(session, "handshake failed")
+            raise
+        self.sessions.activate(session, peer)
+        peer._session_slot = session
+        # outbound peers have no serve loop here: closing the connection
+        # must release the session slot AND drop the peer from the live
+        # list (discovery dedup + broadcasts iterate it)
+        def _closed(peer=peer, session=session):
+            self.sessions.close(session, "closed")
+            try:
+                self.peers.remove(peer)
+            except ValueError:
+                pass
+
+        peer._on_close = (_closed,)
         self.peers.append(peer)
         return peer
 
@@ -102,23 +135,34 @@ class NetworkManager:
             p.close()
 
     def _accept_loop(self):
+        from .sessions import SessionLimitExceeded
+
         while not self._stop.is_set():
             try:
                 sock, _addr = self._listener.accept()
             except OSError:
                 return
             try:
+                slot = self.sessions.reserve("inbound")
+            except SessionLimitExceeded:
+                sock.close()  # at capacity: refuse BEFORE any handshake
+                continue
+            try:
                 peer = PeerConnection.accept(sock, self.status, self.node_priv,
                                              fork_filter=self._fork_filter)
             except Exception:  # noqa: BLE001 — handshake parses attacker-
                 # controlled bytes; ANY failure must drop the peer, never
                 # the accept loop (a dead listener = no inbound peers ever)
+                self.sessions.close(slot, "handshake failed")
                 sock.close()
                 continue
             if self.peers_manager.is_banned(peer.node_id):
+                self.sessions.close(slot, "banned")
                 peer.session.disconnect(0x05)  # banned: refuse the session
                 peer.close()
                 continue
+            self.sessions.activate(slot, peer)
+            peer._session_slot = slot
             self.peers.append(peer)
             t = threading.Thread(target=self._serve_peer, args=(peer,), daemon=True)
             t.start()
@@ -127,20 +171,28 @@ class NetworkManager:
     # -- request serving (EthRequestHandler analogue) --------------------------
 
     def _serve_peer(self, peer: PeerConnection):
+        slot = getattr(peer, "_session_slot", None)
+        reason = "disconnected"
         try:
             while not self._stop.is_set():
                 try:
                     msg = peer.recv()
+                    if slot is not None:
+                        slot.messages_in += 1
                     self._handle(peer, msg)
                 except PeerDisconnected:
                     break  # graceful goodbye: no penalty
                 except PeerError:
                     # protocol violation: penalize (bans past the threshold)
                     self.peers_manager.reputation_change(peer.node_id, "bad_message")
+                    reason = "protocol violation"
                     break
                 except Exception:  # noqa: BLE001 — malformed frame/request
+                    reason = "stream error"
                     break          # drops the peer; cleanup in finally
         finally:
+            if slot is not None:
+                self.sessions.close(slot, reason)
             peer.close()
             try:
                 self.peers.remove(peer)
@@ -181,7 +233,7 @@ class NetworkManager:
         # other gossip ignored for now
 
     def _headers_for(self, req: wire.GetBlockHeaders):
-        with self.factory.provider() as p:
+        with self._provider_fn() as p:
             if isinstance(req.start, bytes):
                 start = p.block_number(req.start)
                 if start is None:
@@ -205,7 +257,7 @@ class NetworkManager:
         from .wire import BlockBody
 
         out = []
-        with self.factory.provider() as p:
+        with self._provider_fn() as p:
             for h in hashes[:MAX_BODIES_SERVE]:
                 n = p.block_number(h)
                 if n is None:
@@ -218,7 +270,7 @@ class NetworkManager:
         from ..storage import tables as T
 
         out = []
-        with self.factory.provider() as p:
+        with self._provider_fn() as p:
             for h in hashes[:MAX_BODIES_SERVE]:
                 n = p.block_number(h)
                 if n is None:

@@ -281,6 +281,7 @@ class Node:
                 port=config.p2p_port, node_priv=key,
                 chain_spec=config.chain_spec,
                 head_position=(tip_num, tip_header.timestamp if tip_header else 0),
+                provider_fn=lambda: self.tree.overlay_provider(),
             )
             # NAT resolution decides the ADVERTISED address (enode/ENR);
             # binding stays on p2p_host (reference crates/net/nat)
@@ -293,9 +294,17 @@ class Node:
             # head: a node that syncs across a fork boundary must start
             # advertising (and enforcing) the post-fork id
             def _track_head(chain, _net=self.network, _spec=config.chain_spec):
-                if not chain:
-                    return
-                tip = chain[-1].block.header
+                if chain:
+                    tip = chain[-1].block.header
+                else:
+                    # fully persisted head (low persistence threshold /
+                    # FCU to a persisted hash): the handshake Status must
+                    # still advertise the LIVE tip, or peers dialing in
+                    # would sync against a stale head
+                    with self.factory.provider() as p:
+                        tip = p.header_by_number(p.last_block_number())
+                    if tip is None:
+                        return
                 _net.head_position = (tip.number, tip.timestamp)
                 _net.status.head = tip.hash
                 _net.status.latest = tip.number

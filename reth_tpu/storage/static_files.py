@@ -21,40 +21,10 @@ rows pruned from the DB, so history stays served after the producer runs.
 
 from __future__ import annotations
 
-import json
-import lzma
-import mmap
-import struct
-import zlib
 from dataclasses import dataclass
 from pathlib import Path
 
-MAGIC = b"RTSF1\n"
-
-_CODECS = {
-    "none": (lambda b: b, lambda b: b),
-    "zlib": (zlib.compress, zlib.decompress),
-    "lzma": (lambda b: lzma.compress(b, preset=6), lzma.decompress),
-}
-
-
-def _pick_codec(rows: list[bytes]) -> str:
-    """Sample-driven tier choice (NippyJar-style): smallest total wins,
-    with 'none' preferred unless compression actually pays >10%."""
-    sample = [r for r in rows[:16] if r]
-    if not sample:
-        return "none"
-    raw = sum(len(r) for r in sample)
-    z = sum(len(zlib.compress(r)) for r in sample)
-    best, best_size = "none", raw
-    if z < raw * 0.9:
-        best, best_size = "zlib", z
-    # lzma only worth trying on bigger rows (its header alone is ~60 B)
-    if raw / len(sample) >= 256:
-        xz = sum(len(lzma.compress(r, preset=6)) for r in sample)
-        if xz < best_size * 0.9:
-            best = "lzma"
-    return best
+from .nippyjar import NippyJar
 
 SEGMENT_HEADERS = "headers"          # row key: block number; cols: header, hash
 SEGMENT_TRANSACTIONS = "transactions"  # row key: tx number; cols: tx
@@ -65,45 +35,24 @@ def write_segment_file(
     path: Path, segment: str, start: int, columns: dict[str, list[bytes]],
     compression: str = "auto",
 ) -> None:
-    names = list(columns.keys())
-    count = len(next(iter(columns.values())))
-    for rows in columns.values():
-        assert len(rows) == count, "ragged columns"
-    codecs = {
-        name: (_pick_codec(columns[name]) if compression == "auto"
-               else compression)
-        for name in names
-    }
-    header = json.dumps(
-        {"segment": segment, "start": start, "count": count, "columns": names,
-         "compression": codecs}
-    ).encode()
-    with open(path, "wb") as f:
-        f.write(MAGIC)
-        f.write(struct.pack("<I", len(header)))
-        f.write(header)
-        for name in names:
-            enc = _CODECS[codecs[name]][0]
-            blobs = [enc(r) for r in columns[name]]
-            offsets = [0]
-            for b in blobs:
-                offsets.append(offsets[-1] + len(b))
-            f.write(struct.pack(f"<{count + 1}Q", *offsets))
-            for b in blobs:
-                f.write(b)
+    """One segment = one NippyJar whose metadata carries the segment
+    identity (the reference's static files are NippyJar + a config
+    sidecar; here the jar's own metadata field serves that role)."""
+    NippyJar.write(path, columns, metadata={"segment": segment,
+                                            "start": start},
+                   compression=compression)
 
 
 @dataclass
 class SegmentFile:
+    """Segment view over a NippyJar: block/tx-number keyed row access."""
+
     path: Path
     segment: str
     start: int
     count: int
     columns: list[str]
-    _col_offsets: dict[str, int]  # file offset of each column's offset table
-    _codecs: dict[str, str]
-    _fh: object = None            # cached open handle (immutable file)
-    _map: object = None           # mmap over the whole immutable file
+    _jar: NippyJar
 
     @property
     def end(self) -> int:
@@ -111,42 +60,18 @@ class SegmentFile:
 
     @classmethod
     def open(cls, path: Path) -> "SegmentFile":
-        f = open(path, "rb")
-        if f.read(6) != MAGIC:
-            f.close()
-            raise ValueError(f"{path}: bad magic")
-        (hlen,) = struct.unpack("<I", f.read(4))
-        meta = json.loads(f.read(hlen))
-        m = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-        pos = 6 + 4 + hlen
-        col_offsets = {}
-        for name in meta["columns"]:
-            col_offsets[name] = pos
-            (last,) = struct.unpack_from("<Q", m, pos + 8 * meta["count"])
-            pos += 8 * (meta["count"] + 1) + last
-        # pre-tier files carry no "compression" key: they are all-zlib
-        codecs = meta.get("compression") or {n: "zlib" for n in meta["columns"]}
-        return cls(path, meta["segment"], meta["start"], meta["count"],
-                   meta["columns"], col_offsets, codecs, f, m)
+        jar = NippyJar.open(path)  # reads legacy RTSF1 files transparently
+        meta = jar.metadata
+        return cls(path, meta["segment"], meta["start"], jar.count,
+                   jar.columns, jar)
 
     def row(self, number: int, column: str) -> bytes | None:
         if not (self.start <= number <= self.end):
             return None
-        i = number - self.start
-        base = self._col_offsets[column]
-        m = self._map  # immutable file: zero-copy mmap slices
-        lo, hi = struct.unpack_from("<2Q", m, base + 8 * i)
-        payload_base = base + 8 * (self.count + 1)
-        raw = m[payload_base + lo:payload_base + hi]
-        return _CODECS[self._codecs[column]][1](raw)
+        return self._jar.row(column, number - self.start)
 
     def close(self):
-        if self._map is not None:
-            self._map.close()
-            self._map = None
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+        self._jar.close()
 
 
 class StaticFileProvider:

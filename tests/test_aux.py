@@ -167,7 +167,8 @@ def test_static_file_compression_tiers(tmp_path):
     import struct as _struct
     import zlib as _zlib
 
-    from reth_tpu.storage.static_files import MAGIC, SegmentFile, write_segment_file
+    from reth_tpu.storage.nippyjar import LEGACY_MAGIC as MAGIC
+    from reth_tpu.storage.static_files import SegmentFile, write_segment_file
 
     import os
     hashes = [os.urandom(32) for _ in range(40)]          # incompressible
@@ -175,8 +176,8 @@ def test_static_file_compression_tiers(tmp_path):
     path = tmp_path / "seg_0_39.sf"
     write_segment_file(path, "headers", 0, {"hash": hashes, "header": blobs})
     sf = SegmentFile.open(path)
-    assert sf._codecs["hash"] == "none"
-    assert sf._codecs["header"] in ("zlib", "lzma")
+    assert sf._jar._codecs["hash"] == "none"
+    assert sf._jar._codecs["header"] in ("zlib", "lzma")
     for i in (0, 17, 39):
         assert sf.row(i, "hash") == hashes[i]
         assert sf.row(i, "header") == blobs[i]

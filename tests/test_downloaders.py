@@ -148,3 +148,98 @@ def test_bodies_all_peers_bad_raises():
                           consensus=EthBeaconConsensus(CPU))
     with pytest.raises(PeerError, match="unserved"):
         dl.download(headers)
+
+
+def test_full_block_client_by_hash_and_range():
+    """FullBlockClient seals header+body pairs: header matches the
+    requested hash, bodies validate against their headers; range returns
+    blocks descending (reference full_block.rs semantics)."""
+    from reth_tpu.net.downloader import FullBlockClient
+
+    bld = build_chain(10)
+    client = FullBlockClient(MockPeer(bld), EthBeaconConsensus(CPU))
+    target = bld.blocks[7]
+    blk = client.get_full_block(target.hash)
+    assert blk.hash == target.hash and len(blk.transactions) == 1
+    rng = client.get_full_block_range(target.hash, 4)
+    assert [b.header.number for b in rng] == [7, 6, 5, 4]
+    assert all(b.hash == bld.blocks[b.header.number].hash for b in rng)
+
+
+def test_full_block_client_retries_bad_bodies():
+    """A client serving wrong bodies exhausts retries with PeerError."""
+    from reth_tpu.net.downloader import FullBlockClient
+
+    bld = build_chain(6)
+    liar = MockPeer(bld, lie_bodies=True)
+    client = FullBlockClient(liar, EthBeaconConsensus(CPU))
+    with pytest.raises(PeerError, match="failed validation"):
+        client.get_full_block(bld.blocks[4].hash)
+    assert liar.requests >= 3  # bounded retries actually happened
+
+
+def test_full_block_client_mid_list_omission():
+    """Regression (round-4 review): GetBlockBodies OMITS unknown hashes —
+    a body missing MID-list must not shift later bodies onto wrong
+    headers; the client realigns and refetches only the hole."""
+    from reth_tpu.net.downloader import FullBlockClient
+
+    bld = build_chain(8)
+
+    class HolePeer(MockPeer):
+        def __init__(self, builder, missing_number):
+            super().__init__(builder)
+            self.missing = missing_number
+
+        def get_bodies(self, hashes):
+            self.requests += 1
+            return [_Body(self.by_hash[h]) for h in hashes
+                    if self.by_hash[h].header.number != self.missing]
+
+    peer = HolePeer(bld, missing_number=4)
+    client = FullBlockClient(peer, EthBeaconConsensus(CPU))
+    # the hole never fills -> PeerError; but every OTHER block aligned
+    with pytest.raises(PeerError, match="1 bodies failed"):
+        client.get_full_block_range(bld.blocks[6].hash, 5)  # blocks 6..2
+
+    # transient hole: second request serves it -> full success
+    class FlakyPeer(HolePeer):
+        def get_bodies(self, hashes):
+            if self.requests >= 2:  # headers req counted too; heal later
+                self.missing = -1
+            return super().get_bodies(hashes)
+
+    peer2 = FlakyPeer(bld, missing_number=4)
+    client2 = FullBlockClient(peer2, EthBeaconConsensus(CPU))
+    rng = client2.get_full_block_range(bld.blocks[6].hash, 5)
+    assert [b.header.number for b in rng] == [6, 5, 4, 3, 2]
+    assert all(b.hash == bld.blocks[b.header.number].hash for b in rng)
+
+
+def test_full_block_client_corrupt_body_does_not_starve():
+    """Regression (round-4 review): one corrupt body in a response must
+    not starve the remaining valid bodies — it is discarded by tx-root
+    matching and only ITS block refetches."""
+    from reth_tpu.net.downloader import FullBlockClient
+
+    bld = build_chain(8)
+
+    class OneCorrupt(MockPeer):
+        def __init__(self, builder):
+            super().__init__(builder)
+            self.corrupted_once = False
+
+        def get_bodies(self, hashes):
+            self.requests += 1
+            out = [_Body(self.by_hash[h]) for h in hashes]
+            if not self.corrupted_once and len(out) >= 3:
+                # swap in a foreign body (different block's txs) mid-list
+                out[1] = _Body(self.by_number[1])
+                self.corrupted_once = True
+            return out
+
+    peer = OneCorrupt(bld)
+    client = FullBlockClient(peer, EthBeaconConsensus(CPU))
+    rng = client.get_full_block_range(bld.blocks[7].hash, 5)  # 7..3
+    assert [b.header.number for b in rng] == [7, 6, 5, 4, 3]
+    assert all(b.hash == bld.blocks[b.header.number].hash for b in rng)

@@ -307,3 +307,111 @@ def test_online_sync_with_two_peers(testnet):
     assert p.header_by_number(8).state_root == builder.tip.state_root
     peer1.close()
     peer2.close()
+
+
+def test_session_manager_caps_and_events(testnet):
+    """Session lifecycle over real connections: caps enforced BEFORE the
+    handshake, events published on establish/close, counters tracked
+    (reference SessionManager in the Swarm)."""
+    server, port, status, factory_b, builder = testnet
+    server.sessions.max_inbound = 2
+    events = []
+    server.sessions.listeners.append(
+        lambda ev, s: events.append((ev, s.direction)))
+    our_status = Status(network_id=1, head=builder.genesis.hash,
+                        genesis=builder.genesis.hash)
+
+    p1 = PeerConnection.connect("127.0.0.1", port, our_status,
+                                pubkey_from_priv(server.node_priv))
+    p2 = PeerConnection.connect("127.0.0.1", port, our_status,
+                                pubkey_from_priv(server.node_priv))
+    import time
+
+    deadline = time.time() + 5
+    while len(server.sessions.active("inbound")) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert len(server.sessions.active("inbound")) == 2
+    assert ("established", "inbound") in events
+    # third connection: refused at the cap, before any handshake
+    with pytest.raises((PeerError, OSError)):
+        PeerConnection.connect("127.0.0.1", port, our_status,
+                               pubkey_from_priv(server.node_priv), timeout=3)
+    assert len(server.sessions.active("inbound")) == 2
+    # activity is counted per session
+    p1.get_headers(1, 2)
+    assert sum(s.messages_in for s in server.sessions.active()) >= 1
+    # closure publishes an event and frees capacity
+    p1.close()
+    deadline = time.time() + 5
+    while len(server.sessions.active("inbound")) != 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert len(server.sessions.active("inbound")) == 1
+    assert ("closed", "inbound") in events
+    counts = server.sessions.counts()
+    assert counts["established_total"] >= 2 and counts["closed_total"] >= 1
+    p3 = PeerConnection.connect("127.0.0.1", port, our_status,
+                                pubkey_from_priv(server.node_priv))
+    p3.close()
+    p2.close()
+
+
+def test_outbound_session_released_on_close(testnet):
+    """Regression (round-4 review): closing an outbound connection must
+    release its session slot or the outbound cap leaks permanently."""
+    server, port, status, factory_b, builder = testnet
+    from reth_tpu.net.server import NetworkManager
+    from reth_tpu.storage import MemDb, ProviderFactory
+
+    dialer = NetworkManager(ProviderFactory(MemDb()),
+                            Status(network_id=1, head=builder.genesis.hash,
+                                   genesis=builder.genesis.hash),
+                            max_outbound=2)
+    for _ in range(5):  # reconnect loop: would exhaust the cap if leaked
+        p = dialer.connect_to(server.enode)
+        assert len(dialer.sessions.active("outbound")) == 1
+        p.close()
+        assert len(dialer.sessions.active("outbound")) == 0
+    assert dialer.sessions.counts()["closed_total"] >= 5
+
+
+def test_node_serves_in_memory_tip_over_p2p(tmp_path):
+    """A LAUNCHED node advertises its live head in the handshake Status
+    and serves tree blocks above the persistence threshold — a fresh peer
+    syncs to the full tip, not just the persisted chain (round-4 fix)."""
+    import time
+
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.storage.genesis import init_genesis
+
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)},
+                           committer=CPU)
+    cfg = NodeConfig(dev=True, datadir=tmp_path,
+                     genesis_header=builder.genesis,
+                     genesis_alloc=builder.accounts_at_genesis,
+                     persistence_threshold=2, p2p_port=0, discovery=False)
+    node = Node(cfg, committer=CPU)
+    node.start_network()
+    try:
+        for i in range(6):
+            node.pool.add_transaction(alice.transfer(b"\x0b" * 20, 50 + i))
+            node.miner.mine_block()
+        assert node.tree.persisted_number == 4  # 5,6 in memory only
+        assert node.network.status.head == node.tree.head_hash
+
+        factory_b = ProviderFactory(MemDb())
+        init_genesis(factory_b, builder.genesis,
+                     builder.accounts_at_genesis, committer=CPU)
+        from reth_tpu.net.server import NetworkManager as NM
+
+        dialer = NM(factory_b, Status(network_id=1,
+                                      head=builder.genesis.hash,
+                                      genesis=builder.genesis.hash))
+        peer = dialer.connect_to(node.network.enode)
+        tip = sync_from_peer(factory_b, peer, committer=CPU)
+        assert tip == 6
+        with factory_b.provider() as p:
+            assert p.header_by_number(6).hash == node.tree.head_hash
+        peer.close()
+    finally:
+        node.stop()
