@@ -271,3 +271,39 @@ def test_eip8_fixed_key_loopback():
     c_secrets = client.finalize_initiator(ack)
     assert c_secrets.aes == s_secrets.aes
     assert c_secrets.mac == s_secrets.mac
+
+
+# -- EIP-152 blake2f precompile vectors --------------------------------------
+
+
+def test_blake2f_eip152_official_vectors():
+    """The EIP-152 specification's own test vectors (4-7) against the
+    0x09 precompile: external ground truth for the blake2 compression
+    implementation (primitives/blake2.py)."""
+    from reth_tpu.evm.interpreter import _precompile
+
+    blake2f = _precompile(b"\x00" * 19 + b"\x09")
+    state = bytes.fromhex(
+        "48c9bdf267e6096a3ba7ca8485ae67bb2bf894fe72f36e3cf1361d5f3af54fa5"
+        "d182e6ad7f520e511f6c3e2b8c68059b6bbd41fbabd9831f79217e1319cde05b"
+        "6162630000000000000000000000000000000000000000000000000000000000"
+        + "00" * 96 + "0300000000000000" + "0000000000000000")
+    # vector 5: rounds=12, final=1 — blake2b("abc") state
+    ok, _, out = blake2f(bytes.fromhex("0000000c") + state + b"\x01", 10**5)
+    assert ok and out.hex() == (
+        "ba80a53f981c4d0d6a2797b69f12f6e94c212f14685ac4b74b12bb6fdbffa2d1"
+        "7d87c5392aab792dc252d5de4533cc9518d38aa8dbf1925ab92386edd4009923")
+    # vector 6: rounds=12, final=0
+    ok, _, out = blake2f(bytes.fromhex("0000000c") + state + b"\x00", 10**5)
+    assert ok and out.hex() == (
+        "75ab69d3190a562c51aef8d88f1c2775876944407270c42c9844252c26d28752"
+        "98743e7f6d5ea2f2d3e8d226039cd31b4e426ac4f2d3d666a610c2116fde4735")
+    # vector 7: rounds=1, final=1
+    ok, _, out = blake2f(bytes.fromhex("00000001") + state + b"\x01", 10**5)
+    assert ok and out.hex() == (
+        "b63a380cb2897d521994a85234ee2c181b5f844d2c624c002677e9703449d2fb"
+        "a551b3a8333bcdf5f2f7e08993d53923de3d64fcc68c034e717b9293fed7a421")
+    # vector 4: malformed final-block flag (2) must ERROR (EIP-152): a
+    # successful-but-empty return would be a consensus divergence
+    ok, _, out = blake2f(bytes.fromhex("0000000c") + state + b"\x02", 10**5)
+    assert not ok
