@@ -28,6 +28,7 @@ state and journals merge in transaction order).
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
@@ -48,13 +49,13 @@ class TxAccess:
     slot_writes: set[tuple[bytes, bytes]] = field(default_factory=set)
     coinbase_sensitive: bool = False
 
-    def conflicts_with_writes(self, other: "TxAccess") -> bool:
-        """True when `other`'s writes feed this tx's reads or writes."""
-        touched_accts = self.account_reads | self.account_writes
-        if other.account_writes & touched_accts:
+    def conflicts_with_write_sets(self, accts: set, slots: set) -> bool:
+        """Same predicate against an AGGREGATE of many txs' writes — one
+        intersection instead of a pairwise scan (O(wave) total instead of
+        O(wave^2); the hot cost in big conflict-free blocks)."""
+        if accts & (self.account_reads | self.account_writes):
             return True
-        touched_slots = self.slot_reads | self.slot_writes
-        return bool(other.slot_writes & touched_slots)
+        return bool(slots & (self.slot_reads | self.slot_writes))
 
     def to_json(self) -> dict:
         hx = lambda b: "0x" + b.hex()  # noqa: E731
@@ -236,17 +237,22 @@ def _build_waves(bal: BlockAccessList, n_txs: int) -> list[list[int]]:
     waves: list[list[int]] = []
     entries = {e.index: e for e in bal.entries}
     current: list[int] = []
+    cur_accts: set = set()
+    cur_slots: set = set()
     for i in range(n_txs):
         acc = entries.get(i)
-        joins = acc is not None and not acc.coinbase_sensitive and all(
-            not acc.conflicts_with_writes(entries[j])
-            for j in current if j in entries
-        )
+        joins = (acc is not None and not acc.coinbase_sensitive
+                 and not acc.conflicts_with_write_sets(cur_accts, cur_slots))
         if joins or not current:
             current.append(i)
+            if acc is not None:
+                cur_accts |= acc.account_writes
+                cur_slots |= acc.slot_writes
         else:
             waves.append(current)
             current = [i]
+            cur_accts = set(acc.account_writes) if acc else set()
+            cur_slots = set(acc.slot_writes) if acc else set()
     if current:
         waves.append(current)
     return waves
@@ -271,8 +277,16 @@ def execute_block_bal(source: StateSource, block: Block,
     cumulative = 0
     stats = {"waves": 0, "parallel": 0, "serial": 0}
     waves = _build_waves(bal, len(block.transactions))
+    # Wave members are GIL-bound pure-Python EVM runs: OS threads add
+    # contention without concurrency (measured: threaded waves ran ~4x
+    # SLOWER than serial). The wave schedule itself is the valuable
+    # artifact — conflict-free sets whose speculative runs commute — so
+    # execute each wave's members sequentially against the SAME
+    # wave-start snapshot (identical semantics to the concurrent form);
+    # a native/nogil executor plugs a real pool back in via use_threads.
+    use_threads = os.environ.get("RETH_TPU_BAL_THREADS") == "1"
     pool = (ThreadPoolExecutor(max_workers=max_workers)
-            if any(len(w) > 1 for w in waves) else None)
+            if use_threads and any(len(w) > 1 for w in waves) else None)
 
     def _speculate(i: int):
         acc, ex, state = make_recording_state(merged, env.coinbase, i, config)
@@ -306,24 +320,27 @@ def execute_block_bal(source: StateSource, block: Block,
 
     for wave in waves:
         stats["waves"] += 1
-        if len(wave) == 1:
-            results = {wave[0]: _speculate(wave[0])}
+        if len(wave) == 1 or pool is None:
+            results = {i: _speculate(i) for i in wave}
         else:
             results = {r[0]: r for r in pool.map(_speculate, wave)}
-        committed_writes: list[TxAccess] = []
+        committed_accts: set = set()
+        committed_slots: set = set()
         for i in wave:
             _, acc, state, fee_delta, result, err = results[i]
             conflicted = (
                 err is not None
                 or acc.coinbase_sensitive
-                or any(acc.conflicts_with_writes(w) for w in committed_writes)
+                or acc.conflicts_with_write_sets(committed_accts,
+                                                 committed_slots)
                 or block.transactions[i].gas_limit > env.gas_limit - cumulative
             )
             if conflicted:
                 stats["serial"] += 1
                 acc, state, fee_delta, result = _serial(i)  # may raise: invalid block
             elif len(wave) > 1:
-                stats["parallel"] += 1  # genuinely concurrent commits only
+                stats["parallel"] += 1  # conflict-free wave commit (the
+                # schedule-level count; threads only under RETH_TPU_BAL_THREADS)
             else:
                 stats["serial"] += 1
             _capture_changesets(state)
@@ -337,7 +354,8 @@ def execute_block_bal(source: StateSource, block: Block,
             _commit_journal(merged, state, fee_delta, env.coinbase)
             if fee_delta and env.coinbase not in changes_accounts:
                 changes_accounts[env.coinbase] = source.account(env.coinbase)
-            committed_writes.append(acc)
+            committed_accts |= acc.account_writes
+            committed_slots |= acc.slot_writes
             cumulative += result.gas_used
             receipts.append(Receipt(
                 tx_type=block.transactions[i].tx_type,

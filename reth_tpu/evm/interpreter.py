@@ -241,7 +241,8 @@ class Interpreter:
         state.sub_balance(caller, value)
         state.add_balance(addr, value)
         frame = CallFrame(caller=caller, address=addr, code=initcode,
-                          data=b"", value=value, gas=gas, depth=depth)
+                          data=b"", value=value, gas=gas, depth=depth,
+                          kind="CREATE")
         try:
             gas_left, out = yield from self._run_gen(frame)
         except Revert as r:
@@ -279,7 +280,10 @@ class Interpreter:
         pc = 0
         gas = fr.gas
         returndata = b""
-        jumpdests = _jumpdests(code)
+        # initcode is deployment-unique: caching it would churn hot
+        # contracts out of the bounded analysis cache
+        jumpdests = (_jumpdests(code) if fr.kind == "CREATE"
+                     else _jumpdests_cached(code))
         push = stack.append
 
         def use(n):
@@ -765,6 +769,23 @@ class Interpreter:
 
 def _sgn(x: int) -> int:
     return x - U256 if x & SIGN_BIT else x
+
+
+_JUMPDEST_CACHE: dict[bytes, set[int]] = {}
+
+
+def _jumpdests_cached(code: bytes) -> set[int]:
+    """Per-code jumpdest analysis, cached: the scan is O(len(code)) and a
+    hot contract is entered thousands of times per block (revm caches its
+    analysis on the bytecode object the same way). Keyed by the code
+    bytes — their hash is computed once and cached by CPython."""
+    dests = _JUMPDEST_CACHE.get(code)
+    if dests is None:
+        if len(_JUMPDEST_CACHE) >= 1024:
+            _JUMPDEST_CACHE.clear()  # bounded; rebuild is cheap
+        dests = _jumpdests(code)
+        _JUMPDEST_CACHE[code] = dests
+    return dests
 
 
 def _jumpdests(code: bytes) -> set[int]:
