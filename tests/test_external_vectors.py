@@ -307,3 +307,50 @@ def test_blake2f_eip152_official_vectors():
     # successful-but-empty return would be a consensus divergence
     ok, _, out = blake2f(bytes.fromhex("0000000c") + state + b"\x02", 10**5)
     assert not ok
+
+
+# -- secp256k1 cross-validation against the `cryptography` library -----------
+
+
+def test_secp256k1_cross_validates_with_openssl():
+    """The from-scratch secp256k1 (primitives/secp256k1.py) against the
+    in-image `cryptography` package (OpenSSL-backed): our signatures
+    verify under their ECDSA, and their signatures recover to the right
+    address under our ecrecover — 32 random messages each way."""
+    import os
+
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+
+    from reth_tpu.primitives import secp256k1
+
+    rng_priv = [0xA11CE, 0xB0B, 2, secp256k1.N - 2]
+    for priv in rng_priv:
+        pub = secp256k1.pubkey_from_priv(priv)
+        pub_c = ec.EllipticCurvePublicNumbers(
+            pub[0], pub[1], ec.SECP256K1()).public_key()
+        sk = ec.derive_private_key(priv, ec.SECP256K1())
+        addr = secp256k1.address_from_priv(priv)
+        for _ in range(8):
+            h = os.urandom(32)
+            # ours -> theirs
+            _y, r, s = secp256k1.sign(h, priv)
+            pub_c.verify(encode_dss_signature(r, s), h,
+                         ec.ECDSA(Prehashed(hashes.SHA256())))
+            # theirs -> ours (try both parities; high-s allowed: OpenSSL
+            # does not canonicalize to low-s)
+            r2, s2 = decode_dss_signature(
+                sk.sign(h, ec.ECDSA(Prehashed(hashes.SHA256()))))
+            recovered = []
+            for yp in (0, 1):
+                try:
+                    recovered.append(
+                        secp256k1.ecrecover(h, yp, r2, s2, allow_high_s=True))
+                except ValueError:
+                    continue
+            assert addr in recovered
