@@ -4,9 +4,9 @@ Reference analogue: crates/net — eth-wire message types/codecs
 (eth-wire-types), the session/server machinery (network), download
 abstractions (p2p) and the reverse-headers/bodies downloaders
 (downloaders). Transport here is length-prefixed frames over TCP; the
-RLPx ECIES/AES encryption layer is a later milestone (no AES primitive
-in-image) — the message vocabulary, handshake semantics, request/
-response correlation, and sync logic are the compatible parts.
+RLPx layer is fully encrypted: EIP-8 ECIES handshake (validated against
+the EIP's own vectors in tests/test_external_vectors.py) and AES-256-CTR
+frames with keccak ingress/egress MACs (net/rlpx.py).
 """
 
 from .wire import (
