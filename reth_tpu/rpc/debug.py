@@ -222,6 +222,81 @@ class DebugApi:
             gas_left_in_block, tracer=tracer,
         )
 
+    def debug_traceBlockByNumber(self, tag, opts=None):
+        """Trace every transaction of a block (reference
+        debug_traceBlockByNumber, crates/rpc/rpc/src/debug.rs)."""
+        p = self.eth._provider()
+        n = self.eth._resolve_number(tag, p)
+        return self._trace_block(p, n, opts)
+
+    def debug_traceBlockByHash(self, block_hash, opts=None):
+        from .convert import parse_data
+        from .server import RpcError
+
+        p = self.eth._provider()
+        n = p.block_number(parse_data(block_hash))
+        if n is None:
+            raise RpcError(-32000, "unknown block")
+        return self._trace_block(p, n, opts)
+
+    def _trace_block(self, p, block_num, opts):
+        """Execute the block ONCE, attaching a fresh tracer to each tx on
+        the shared state — not one whole-prefix replay per tx."""
+        from ..evm import BlockExecutor, EvmConfig
+        from ..evm.interpreter import BlockEnv
+        from ..evm.state import EvmState
+        from .convert import data, qty
+        from .server import RpcError
+
+        opts = opts or {}
+        block = p.block_by_number(block_num)
+        if block is None or block_num == 0:
+            raise RpcError(-32000, "unknown block (or genesis)")
+        idx = p.block_body_indices(block_num)
+        parent_state = (self.eth._state_at(qty(block_num - 1))
+                        if block_num > 0 else p)
+        executor = BlockExecutor(parent_state,
+                                 EvmConfig(chain_id=self.eth.chain_id))
+        header = block.header
+        block_hashes = {}
+        for k in range(max(0, block_num - 256), block_num):
+            bh = p.canonical_hash(k)
+            if bh:
+                block_hashes[k] = bh
+        env = BlockEnv(
+            number=header.number, timestamp=header.timestamp,
+            coinbase=header.beneficiary, gas_limit=header.gas_limit,
+            base_fee=header.base_fee_per_gas or 0,
+            prev_randao=header.mix_hash,
+            chain_id=self.eth.chain_id, block_hashes=block_hashes,
+        )
+        state = EvmState(parent_state)
+        gas_left_in_block = header.gas_limit
+        out = []
+        use_call_tracer = opts.get("tracer") == "callTracer"
+        for i, tx in enumerate(block.transactions):
+            sender = (p.sender(idx.first_tx_num + i)
+                      or tx.recover_sender())
+            if use_call_tracer:
+                tracer = CallTracer()
+            else:
+                tracer = StructLogger(
+                    with_memory=bool(opts.get("enableMemory")))
+            result = executor._execute_tx(state, env, tx, sender,
+                                          gas_left_in_block, tracer=tracer)
+            gas_left_in_block -= result.gas_used
+            if use_call_tracer:
+                out.append({"txHash": data(tx.hash),
+                            "result": tracer.result()})
+            else:
+                out.append({"txHash": data(tx.hash), "result": {
+                    "gas": qty(result.gas_used),
+                    "failed": not result.success,
+                    "returnValue": result.output.hex(),
+                    "structLogs": tracer.logs,
+                }})
+        return out
+
     def debug_executionWitness(self, tag):
         """Everything needed to re-execute the block statelessly: parent
         trie nodes, bytecodes, touched keys, ancestor headers (reference

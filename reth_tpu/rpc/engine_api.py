@@ -22,7 +22,7 @@ CAPABILITIES = [
     "engine_forkchoiceUpdatedV3",
     "engine_getPayloadV1", "engine_getPayloadV2", "engine_getPayloadV3",
     "engine_getPayloadBodiesByHashV1", "engine_getPayloadBodiesByRangeV1",
-    "engine_exchangeCapabilities",
+    "engine_exchangeCapabilities", "engine_getClientVersionV1",
 ]
 
 
@@ -130,6 +130,14 @@ class EngineApi:
             "latestValidHash": data(st.latest_valid_hash) if st.latest_valid_hash else None,
             "validationError": st.validation_error,
         }
+
+    def engine_getClientVersionV1(self, client_version=None):
+        """Client identification handshake (reference
+        engine_getClientVersionV1, rpc-api/src/engine.rs)."""
+        from .. import __version__
+
+        return [{"code": "RT", "name": "reth-tpu", "version": __version__,
+                 "commit": "00000000"}]
 
     def engine_exchangeCapabilities(self, caps=None):
         return CAPABILITIES

@@ -343,20 +343,28 @@ class EthApi:
             chain_id=self.chain_id,
         )
 
-    def eth_call(self, call, tag="latest"):
-        p = self._state_at(tag)
-        env = self._call_env(tag)
-        state = EvmState(ProviderStateSource(p))
-        interp = Interpreter(state, env, TxEnv(origin=parse_data(call.get("from", "0x" + "00" * 20))))
+    @staticmethod
+    def _build_call_frame(call, state, env) -> CallFrame:
+        """One place that maps an eth_call-style dict to a CallFrame
+        (from/to/data-or-input/value/gas) — eth_call, eth_estimateGas,
+        eth_createAccessList, and eth_simulateV1 all share it."""
+        sender = parse_data(call.get("from", "0x" + "00" * 20))
         to = parse_data(call["to"]) if call.get("to") else None
-        frame = CallFrame(
-            caller=parse_data(call.get("from", "0x" + "00" * 20)),
+        return CallFrame(
+            caller=sender,
             address=to or b"\x00" * 20,
             code=state.code(to) if to else b"",
             data=parse_data(call.get("data", call.get("input", "0x"))),
             value=parse_qty(call.get("value", "0x0")),
             gas=parse_qty(call.get("gas", hex(env.gas_limit))),
         )
+
+    def eth_call(self, call, tag="latest"):
+        p = self._state_at(tag)
+        env = self._call_env(tag)
+        state = EvmState(ProviderStateSource(p))
+        interp = Interpreter(state, env, TxEnv(origin=parse_data(call.get("from", "0x" + "00" * 20))))
+        frame = self._build_call_frame(call, state, env)
         try:
             ok, _gas_left, out = interp.call(frame)
         except Revert as r:
@@ -371,14 +379,8 @@ class EthApi:
         sender = parse_data(call.get("from", "0x" + "00" * 20))
         state = EvmState(ProviderStateSource(p))
         interp = Interpreter(state, env, TxEnv(origin=sender))
-        to = parse_data(call["to"]) if call.get("to") else None
-        gas = parse_qty(call.get("gas", hex(env.gas_limit)))
-        frame = CallFrame(
-            caller=sender, address=to or b"\x00" * 20,
-            code=state.code(to) if to else b"",
-            data=parse_data(call.get("data", call.get("input", "0x"))),
-            value=parse_qty(call.get("value", "0x0")), gas=gas,
-        )
+        frame = self._build_call_frame(call, state, env)
+        to, gas = frame.address if call.get("to") else None, frame.gas
         try:
             ok, gas_left, _ = interp.call(frame)
         except Revert:
@@ -390,6 +392,152 @@ class EthApi:
         used = gas - gas_left
         fake_tx = Transaction(to=to, data=parse_data(call.get("data", call.get("input", "0x"))))
         return qty(used + intrinsic_gas(fake_tx) + used // 16)
+
+
+    def eth_blobBaseFee(self, tag="latest"):
+        """Blob base fee at the requested block (reference eth_blobBaseFee,
+        crates/rpc/rpc-eth-api/src/core.rs)."""
+        from ..evm.executor import blob_base_fee
+
+        p = self._provider()
+        n = self._resolve_number(tag, p)
+        header = p.header_by_number(min(n, p.last_block_number()))
+        return qty(blob_base_fee(header.excess_blob_gas or 0))
+
+    def eth_createAccessList(self, call, tag="latest"):
+        """EIP-2930 access-list generation: run the call and report every
+        account/slot it warmed beyond the mandatory warm set (reference
+        eth_createAccessList, rpc-eth-api/src/helpers/call.rs)."""
+        p = self._state_at(tag)
+        env = self._call_env(tag)
+        sender = parse_data(call.get("from", "0x" + "00" * 20))
+
+        class _AccessRecorder(EvmState):
+            """Warm-set recording that SURVIVES journal rollback: a
+            reverting call is this API's main use case, and the plain
+            warm sets are wiped by the revert."""
+
+            def __init__(self, src):
+                super().__init__(src)
+                self.rec_accounts: set = set()
+                self.rec_slots: set = set()
+
+            def warm_account(self, address):
+                self.rec_accounts.add(address)
+                return super().warm_account(address)
+
+            def warm_slot(self, address, slot):
+                self.rec_slots.add((address, slot))
+                return super().warm_slot(address, slot)
+
+        state = _AccessRecorder(ProviderStateSource(p))
+        interp = Interpreter(state, env, TxEnv(origin=sender))
+        frame = self._build_call_frame(call, state, env)
+        to, gas = frame.address if call.get("to") else None, frame.gas
+        try:
+            ok, gas_left, _out = interp.call(frame)
+        except Revert as r:
+            ok, gas_left = False, getattr(r, "gas_left", 0)
+        # mandatory-warm entries (sender, target, coinbase, precompiles)
+        # never belong in the list (EIP-2930 semantics)
+        skip = {sender, to, env.coinbase} | {
+            (0).to_bytes(19, "big") + bytes([i]) for i in range(1, 11)}
+        per_addr: dict[bytes, list[bytes]] = {}
+        for a, s in sorted(state.rec_slots):
+            per_addr.setdefault(a, []).append(s)
+        access = [
+            {"address": data(a),
+             "storageKeys": [data(s) for s in per_addr.get(a, [])]}
+            for a in sorted(set(state.rec_accounts) | set(per_addr))
+            if a not in skip or a in per_addr
+        ]
+        return {"accessList": access, "gasUsed": qty(gas - gas_left),
+                "error": None if ok else "execution failed"}
+
+    def eth_simulateV1(self, payload, tag="latest"):
+        """Simulate batches of calls on top of the requested state with
+        state/block overrides (reference eth_simulateV1,
+        rpc-eth-api/src/core.rs:245 — the multi-block simulation API).
+        Supported subset: blockStateCalls[].calls with from/to/data/value/
+        gas, stateOverrides (balance/nonce/code/state), blockOverrides
+        (number/time/baseFeePerGas/coinbase/gasLimit); state carries over
+        across calls and across block entries."""
+        from ..primitives.types import Account
+
+        p = self._state_at(tag)
+        base_env = self._call_env(tag)
+        state = EvmState(ProviderStateSource(p))
+        out_blocks = []
+        prev_number = base_env.number
+        prev_time = base_env.timestamp
+        for entry in payload.get("blockStateCalls", []):
+            env = BlockEnv(
+                number=prev_number + 1, timestamp=prev_time + 12,
+                coinbase=base_env.coinbase, gas_limit=base_env.gas_limit,
+                base_fee=base_env.base_fee, prev_randao=base_env.prev_randao,
+                chain_id=self.chain_id,
+            )
+            for k, v in (entry.get("blockOverrides") or {}).items():
+                if k == "number":
+                    env.number = parse_qty(v)
+                elif k == "time":
+                    env.timestamp = parse_qty(v)
+                elif k == "baseFeePerGas":
+                    env.base_fee = parse_qty(v)
+                elif k == "feeRecipient" or k == "coinbase":
+                    env.coinbase = parse_data(v)
+                elif k == "gasLimit":
+                    env.gas_limit = parse_qty(v)
+            prev_number, prev_time = env.number, env.timestamp
+            for addr_hex, ov in (entry.get("stateOverrides") or {}).items():
+                addr = parse_data(addr_hex)
+                if "balance" in ov:
+                    state.set_balance(addr, parse_qty(ov["balance"]))
+                if "nonce" in ov:
+                    acct = state.account(addr) or Account()
+                    state._accounts[addr] = acct.with_(nonce=parse_qty(ov["nonce"]))
+                if "code" in ov:
+                    state.set_code(addr, parse_data(ov["code"]))
+                if "state" in ov or "stateDiff" in ov:
+                    for slot_hex, val in (ov.get("state") or ov.get("stateDiff")).items():
+                        state.sstore(addr, parse_data(slot_hex).rjust(32, b"\x00"),
+                                     parse_qty(val))
+            calls_out = []
+            for call in entry.get("calls", []):
+                sender = parse_data(call.get("from", "0x" + "00" * 20))
+                interp = Interpreter(state, env, TxEnv(origin=sender))
+                state.begin_tx()  # per-call warm-set/refund reset, like
+                # a real transaction boundary (EIP-2929 gas accounting)
+                frame = self._build_call_frame(call, state, env)
+                n_logs = len(state._logs)
+                try:
+                    ok, gas_left, out = interp.call(frame)
+                    err = None
+                except Revert as r:
+                    ok, gas_left, out = False, 0, r.output
+                    err = {"code": 3, "message": "execution reverted"}
+                logs = [
+                    {"address": data(lg.address),
+                     "topics": [data(t) for t in lg.topics],
+                     "data": data(lg.data)}
+                    for lg in state._logs[n_logs:]
+                ]
+                entry_out = {
+                    "status": qty(1 if ok else 0),
+                    "returnData": data(out),
+                    "gasUsed": qty(frame.gas - gas_left),
+                    "logs": logs,
+                }
+                if err is not None:
+                    entry_out["error"] = err
+                calls_out.append(entry_out)
+            out_blocks.append({
+                "number": qty(env.number),
+                "timestamp": qty(env.timestamp),
+                "baseFeePerGas": qty(env.base_fee),
+                "calls": calls_out,
+            })
+        return out_blocks
 
     # -- logs --------------------------------------------------------------------
 

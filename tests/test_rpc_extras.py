@@ -158,3 +158,152 @@ def test_miner_knobs_have_effect(node):
         n.tree, None, parent, PayloadAttributes(timestamp=1_700_000_001),
         gas_ceiling=n.payload_service.gas_ceiling)
     assert block2.header.gas_limit < 30_000_000
+
+
+def test_round4_rpc_surface(tmp_path):
+    """eth_blobBaseFee, eth_createAccessList, eth_simulateV1,
+    debug_traceBlockByNumber, engine_getClientVersionV1 (reference
+    rpc-eth-api/src/core.rs + rpc/src/debug.rs surfaces)."""
+    import json
+    import time
+    import urllib.request
+
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.primitives import Account
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    CPU = TrieCommitter(hasher=keccak256_batch_np)
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)},
+                           committer=CPU)
+    cfg = NodeConfig(dev=True, genesis_header=builder.genesis,
+                     genesis_alloc=builder.accounts_at_genesis)
+    n = Node(cfg, committer=CPU)
+    n.start_rpc()
+
+    def rpc(method, *params):
+        req = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                          "params": list(params)})
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{n.rpc.port}/", req.encode(),
+            {"Content-Type": "application/json"}), timeout=30)
+        out = json.loads(r.read())
+        assert "error" not in out, out
+        return out["result"]
+
+    try:
+        # a storage-writing contract to trace + access-list against
+        rt = bytes.fromhex("6020355f355500")
+        init = bytes([0x60, len(rt), 0x60, 0x0B, 0x5F, 0x39, 0x60, len(rt),
+                      0x5F, 0xF3]) + b"\x00" + rt
+        from reth_tpu.rpc.convert import data as _data
+
+        h = rpc("eth_sendRawTransaction", _data(alice.deploy(init).encode()))
+        n.miner.mine_block()
+        addr = rpc("eth_getTransactionReceipt", h)["contractAddress"]
+        rpc("eth_sendRawTransaction", _data(alice.call(
+            bytes.fromhex(addr[2:]),
+            (5).to_bytes(32, "big") + (9).to_bytes(32, "big")).encode()))
+        n.miner.mine_block()
+
+        assert int(rpc("eth_blobBaseFee"), 16) >= 0
+
+        al = rpc("eth_createAccessList", {
+            "from": "0x" + alice.address.hex(), "to": addr,
+            "data": "0x" + (7).to_bytes(32, "big").hex()
+                    + (1).to_bytes(32, "big").hex()}, "latest")
+        assert any(e["address"].lower() == addr.lower() and e["storageKeys"]
+                   for e in al["accessList"])
+
+        sim = rpc("eth_simulateV1", {
+            "blockStateCalls": [
+                {"stateOverrides": {
+                    "0x" + "aa" * 20: {"balance": hex(10**18)}},
+                 "calls": [
+                     {"from": "0x" + "aa" * 20, "to": "0x" + "bb" * 20,
+                      "value": "0x5"},
+                     {"from": "0x" + alice.address.hex(), "to": addr,
+                      "data": "0x" + (8).to_bytes(32, "big").hex()
+                              + (3).to_bytes(32, "big").hex()},
+                 ]},
+                {"blockOverrides": {"time": "0x77777777"},
+                 "calls": [
+                     {"from": "0x" + "aa" * 20, "to": "0x" + "bb" * 20,
+                      "value": "0x2"}]},
+            ]}, "latest")
+        assert len(sim) == 2
+        assert all(c["status"] == "0x1" for b in sim for c in b["calls"])
+        assert int(sim[1]["timestamp"], 16) == 0x77777777
+
+        traces = rpc("debug_traceBlockByNumber", "0x2",
+                     {"tracer": "callTracer"})
+        assert len(traces) == 1 and traces[0]["result"]["type"] == "CALL"
+
+        ver = n.engine_api.engine_getClientVersionV1()
+        assert ver[0]["name"] == "reth-tpu" and ver[0]["code"]
+    except Exception:
+        raise
+    finally:
+        n.stop()
+
+
+def test_create_access_list_survives_revert():
+    """Regression (round-4 review): a REVERTing call must still return
+    the accesses it made (the journal rollback may not wipe the list) —
+    reverting estimates are the API's main use case."""
+    import json
+    import urllib.request
+
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.primitives import Account
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.rpc.convert import data as _data
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    CPU = TrieCommitter(hasher=keccak256_batch_np)
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)},
+                           committer=CPU)
+    n = Node(NodeConfig(dev=True, genesis_header=builder.genesis,
+                        genesis_alloc=builder.accounts_at_genesis),
+             committer=CPU)
+    n.start_rpc()
+
+    def rpc(method, *params):
+        req = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                          "params": list(params)})
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{n.rpc.port}/", req.encode(),
+            {"Content-Type": "application/json"}), timeout=30)
+        out = json.loads(r.read())
+        assert "error" not in out, out
+        return out["result"]
+
+    try:
+        # sload(5) then revert: PUSH1 05 SLOAD POP PUSH0 PUSH0 REVERT
+        rt = bytes.fromhex("600554505f5ffd")
+        init = bytes([0x60, len(rt), 0x60, 0x0B, 0x5F, 0x39, 0x60, len(rt),
+                      0x5F, 0xF3]) + b"\x00" + rt
+        h = rpc("eth_sendRawTransaction", _data(alice.deploy(init).encode()))
+        n.miner.mine_block()
+        addr = rpc("eth_getTransactionReceipt", h)["contractAddress"]
+        al = rpc("eth_createAccessList", {
+            "from": "0x" + alice.address.hex(), "to": addr}, "latest")
+        assert al["error"] is not None  # the call did fail
+        slot5 = "0x" + (5).to_bytes(32, "big").hex()
+        assert any(e["address"].lower() == addr.lower()
+                   and slot5 in e["storageKeys"]
+                   for e in al["accessList"]), al
+        # simulateV1 charges COLD costs per call (warm sets reset)
+        sim = rpc("eth_simulateV1", {"blockStateCalls": [{"calls": [
+            {"from": "0x" + alice.address.hex(), "to": addr},
+            {"from": "0x" + alice.address.hex(), "to": addr},
+        ]}]}, "latest")
+        g0 = int(sim[0]["calls"][0]["gasUsed"], 16)
+        g1 = int(sim[0]["calls"][1]["gasUsed"], 16)
+        assert g0 == g1  # identical cold-start gas for identical calls
+    finally:
+        n.stop()
