@@ -156,18 +156,10 @@ class DebugApi:
         opts = opts or {}
         from .convert import qty
 
-        if opts.get("tracer") == "callTracer":
-            tracer = CallTracer()
-            self._replay(tx_hash, tracer)
-            return tracer.result()
-        logger = StructLogger(with_memory=bool(opts.get("enableMemory")))
-        result = self._replay(tx_hash, logger)
-        return {
-            "gas": qty(result.gas_used),
-            "failed": not result.success,
-            "returnValue": result.output.hex(),
-            "structLogs": logger.logs,
-        }
+        tracer, is_call_tracer = self._make_tracer(opts)
+        result = self._replay(tx_hash, tracer)
+        return self._shape_result(tracer, is_call_tracer, result.gas_used,
+                                  result.success, result.output)
 
     def _replay(self, tx_hash, tracer):
         """Re-execute the block prefix, then the target tx with ``tracer``."""
@@ -222,6 +214,56 @@ class DebugApi:
             gas_left_in_block, tracer=tracer,
         )
 
+    @staticmethod
+    def _make_tracer(opts):
+        """Shared tracer selection for every debug_trace* entry point."""
+        if opts.get("tracer") == "callTracer":
+            return CallTracer(), True
+        return StructLogger(with_memory=bool(opts.get("enableMemory"))), False
+
+    @staticmethod
+    def _shape_result(tracer, is_call_tracer, gas_used, ok, output):
+        from .convert import qty
+
+        if is_call_tracer:
+            return tracer.result()
+        return {
+            "gas": qty(gas_used),
+            "failed": not ok,
+            "returnValue": output.hex(),
+            "structLogs": tracer.logs,
+        }
+
+    def debug_traceCall(self, call, tag="latest", opts=None):
+        """Run an eth_call-shaped request under a tracer at the given
+        block (reference debug_traceCall, rpc-api/src/debug.rs:105)."""
+        from ..evm.executor import ProviderStateSource, intrinsic_gas
+        from ..evm.interpreter import Interpreter, Revert, TxEnv
+        from ..evm.state import EvmState
+        from ..primitives.types import Transaction
+        from .convert import parse_data
+
+        opts = opts or {}
+        p = self.eth._state_at(tag)
+        env = self.eth._call_env(tag)
+        sender = parse_data(call.get("from", "0x" + "00" * 20))
+        state = EvmState(ProviderStateSource(p))
+        tracer, is_call_tracer = self._make_tracer(opts)
+        interp = Interpreter(state, env, TxEnv(origin=sender),
+                             tracer=tracer)
+        frame = self.eth._build_call_frame(call, state, env)
+        gas = frame.gas
+        try:
+            ok, gas_left, out = interp.call(frame)
+        except Revert as r:
+            ok, gas_left, out = False, getattr(r, "gas_left", 0), r.output
+        # report tx-shaped gas (intrinsic included) so the number lines
+        # up with traceTransaction/receipts for the same action
+        fake_tx = Transaction(
+            to=frame.address if call.get("to") else None, data=frame.data)
+        gas_used = gas - gas_left + intrinsic_gas(fake_tx)
+        return self._shape_result(tracer, is_call_tracer, gas_used, ok, out)
+
     def debug_traceBlockByNumber(self, tag, opts=None):
         """Trace every transaction of a block (reference
         debug_traceBlockByNumber, crates/rpc/rpc/src/debug.rs)."""
@@ -273,28 +315,17 @@ class DebugApi:
         state = EvmState(parent_state)
         gas_left_in_block = header.gas_limit
         out = []
-        use_call_tracer = opts.get("tracer") == "callTracer"
         for i, tx in enumerate(block.transactions):
             sender = (p.sender(idx.first_tx_num + i)
                       or tx.recover_sender())
-            if use_call_tracer:
-                tracer = CallTracer()
-            else:
-                tracer = StructLogger(
-                    with_memory=bool(opts.get("enableMemory")))
+            tracer, is_call_tracer = self._make_tracer(opts)
             result = executor._execute_tx(state, env, tx, sender,
                                           gas_left_in_block, tracer=tracer)
             gas_left_in_block -= result.gas_used
-            if use_call_tracer:
-                out.append({"txHash": data(tx.hash),
-                            "result": tracer.result()})
-            else:
-                out.append({"txHash": data(tx.hash), "result": {
-                    "gas": qty(result.gas_used),
-                    "failed": not result.success,
-                    "returnValue": result.output.hex(),
-                    "structLogs": tracer.logs,
-                }})
+            out.append({"txHash": data(tx.hash),
+                        "result": self._shape_result(
+                            tracer, is_call_tracer, result.gas_used,
+                            result.success, result.output)})
         return out
 
     def debug_executionWitness(self, tag):

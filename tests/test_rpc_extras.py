@@ -307,3 +307,58 @@ def test_create_access_list_survives_revert():
         assert g0 == g1  # identical cold-start gas for identical calls
     finally:
         n.stop()
+
+
+def test_debug_trace_call():
+    """debug_traceCall: struct logs + callTracer for an un-mined call
+    (reference debug_traceCall, rpc-api/src/debug.rs:105)."""
+    import json
+    import urllib.request
+
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.primitives import Account
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.rpc.convert import data as _data
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    CPU = TrieCommitter(hasher=keccak256_batch_np)
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)},
+                           committer=CPU)
+    n = Node(NodeConfig(dev=True, genesis_header=builder.genesis,
+                        genesis_alloc=builder.accounts_at_genesis),
+             committer=CPU)
+    n.start_rpc()
+
+    def rpc(method, *params):
+        req = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                          "params": list(params)})
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{n.rpc.port}/", req.encode(),
+            {"Content-Type": "application/json"}), timeout=30)
+        out = json.loads(r.read())
+        assert "error" not in out, out
+        return out["result"]
+
+    try:
+        rt = bytes.fromhex("6020355f355500")  # sstore(cd[0], cd[32]); stop
+        init = bytes([0x60, len(rt), 0x60, 0x0B, 0x5F, 0x39, 0x60, len(rt),
+                      0x5F, 0xF3]) + b"\x00" + rt
+        h = rpc("eth_sendRawTransaction", _data(alice.deploy(init).encode()))
+        n.miner.mine_block()
+        addr = rpc("eth_getTransactionReceipt", h)["contractAddress"]
+        calldata = "0x" + (1).to_bytes(32, "big").hex() + (2).to_bytes(32, "big").hex()
+        tr = rpc("debug_traceCall",
+                 {"from": "0x" + alice.address.hex(), "to": addr,
+                  "data": calldata}, "latest", {})
+        assert not tr["failed"] and any(
+            lg["op"] == "SSTORE" for lg in tr["structLogs"])
+        ct = rpc("debug_traceCall",
+                 {"from": "0x" + alice.address.hex(), "to": addr,
+                  "data": calldata}, "latest", {"tracer": "callTracer"})
+        assert ct["type"] == "CALL" and ct["to"].lower() == addr.lower()
+        # the traced call was NOT mined: state unchanged
+        assert rpc("eth_getStorageAt", addr, "0x1", "latest") == "0x" + "00" * 32
+    finally:
+        n.stop()
