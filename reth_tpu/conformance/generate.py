@@ -458,6 +458,39 @@ def _scn_deep_revert(seed: int) -> ChainBuilder:
     return bld
 
 
+def _scn_invalid_blocks(seed: int) -> dict:
+    """Invalid-block rejection family (the official suites' InvalidBlocks
+    shape): a valid 2-block chain followed by a TAMPERED third block that
+    must be rejected — bad state root, bad gas used, bad transactions
+    root, or broken parent linkage, rotating by seed. Returns a finished
+    fixture (the tampered block cannot come from ChainBuilder, which only
+    seals valid chains)."""
+    a = Wallet(0x270000 + seed)
+    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    for i in range(2):
+        bld.build_block([a.transfer(bytes([0x41]) * 20, 100 + seed + i)])
+    fix2 = builder_to_fixture(bld)  # snapshot BEFORE block 3 exists
+    b3 = bld.build_block([a.transfer(bytes([0x41]) * 20, 102 + seed)])
+    h = b3.header
+    variant = seed % 4
+    if variant == 0:
+        patch = {"state_root": bytes([0x13]) * 32}
+        exc = "InvalidStateRoot"
+    elif variant == 1:
+        patch = {"gas_used": h.gas_used + 1}
+        exc = "InvalidGasUsed"
+    elif variant == 2:
+        patch = {"transactions_root": bytes([0x21]) * 32}
+        exc = "InvalidTransactionsRoot"
+    else:
+        patch = {"parent_hash": bytes([0x55]) * 32}
+        exc = "UnknownParent"
+    bad = Block(Header(**{**h.__dict__, **patch}), b3.transactions, (),
+                b3.withdrawals)
+    fix2["blocks"].append({"rlp": _hex(bad.encode()), "expectException": exc})
+    return fix2
+
+
 SCENARIOS = {
     "transfers": _scn_transfers,
     "storage": _scn_storage,
@@ -477,6 +510,7 @@ SCENARIOS = {
     "delegationChain": _scn_delegation_chain,
     "blobAccounting": _scn_blob_accounting,
     "deepRevert": _scn_deep_revert,
+    "invalidBlocks": _scn_invalid_blocks,
 }
 
 
@@ -485,7 +519,9 @@ def generate_suite(seeds_per_scenario: int = 10) -> dict[str, dict]:
     suite: dict[str, dict] = {}
     for name, fn in SCENARIOS.items():
         for seed in range(seeds_per_scenario):
-            suite[f"{name}_{seed}"] = builder_to_fixture(fn(seed))
+            made = fn(seed)
+            suite[f"{name}_{seed}"] = (made if isinstance(made, dict)
+                                       else builder_to_fixture(made))
     return suite
 
 

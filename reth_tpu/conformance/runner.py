@@ -122,12 +122,28 @@ def run_blockchain_test(name: str, case: dict, committer=None) -> None:
 
     consensus = EthBeaconConsensus(committer)
     pipeline = Pipeline(factory, default_stages(committer=committer))
+
+    def _fork():
+        """Throwaway copy of the chain state: an expectException block is
+        tried against the fork so a PARTIAL import (e.g. body written,
+        Merkle stage rejects the root) can never corrupt the canonical
+        progression the remaining blocks replay on (the official harness
+        rolls invalid blocks back the same way). MemDb's MVCC makes this
+        an O(#tables) fork — published table dicts are immutable; writers
+        clone on first touch — so no deep copy is needed."""
+        db = MemDb()
+        db._tables = dict(factory.db._tables)
+        return ProviderFactory(db)
+
     for i, blk in enumerate(case.get("blocks", ())):
         expect_fail = "expectException" in blk
+        run_factory = _fork() if expect_fail else factory
+        run_pipeline = (Pipeline(run_factory, default_stages(committer=committer))
+                        if expect_fail else pipeline)
         try:
             block = Block.decode(_bytes(blk["rlp"]))
-            import_chain(factory, [block], consensus)
-            pipeline.run(block.header.number)
+            import_chain(run_factory, [block], consensus)
+            run_pipeline.run(block.header.number)
         except (ConsensusError, StageError, ValueError, KeyError, TypeError,
                 IndexError) as e:  # malformed RLP surfaces as Type/IndexError
             if expect_fail:
