@@ -55,3 +55,37 @@ class TxpoolApi:
             }
             for bucket, senders in content.items()
         }
+
+    def txpool_contentFrom(self, address):
+        """One sender's pending/queued txs, keyed by nonce directly (the
+        geth/alloy TxpoolContentFrom shape — no address layer; reference
+        txpool_contentFrom, crates/rpc/rpc/src/txpool.rs)."""
+        from .convert import parse_data, tx_to_rpc
+
+        target = parse_data(address)
+        content = self.pool.content()
+        return {
+            bucket: {str(n): tx_to_rpc(tx)
+                     for n, tx in senders.get(target, {}).items()}
+            for bucket, senders in content.items()
+        }
+
+    def txpool_inspect(self):
+        """Human-readable pool summary, geth's inspect string format
+        (reference txpool_inspect, crates/rpc/rpc/src/txpool.rs)."""
+        def line(tx):
+            to = data(tx.to) if tx.to else "contract creation"
+            price = tx.max_fee_per_gas if tx.tx_type >= 2 else tx.gas_price
+            # the documented geth format uses the Unicode multiplication
+            # sign, and parsers regex on it
+            return (f"{to}: {tx.value} wei + {tx.gas_limit} gas "
+                    f"\u00d7 {price} wei")
+
+        content = self.pool.content()
+        return {
+            bucket: {
+                data(sender): {str(n): line(tx) for n, tx in txs.items()}
+                for sender, txs in senders.items()
+            }
+            for bucket, senders in content.items()
+        }

@@ -362,3 +362,52 @@ def test_debug_trace_call():
         assert rpc("eth_getStorageAt", addr, "0x1", "latest") == "0x" + "00" * 32
     finally:
         n.stop()
+
+
+def test_txpool_inspect_and_content_from():
+    """txpool_inspect summary strings + txpool_contentFrom filtering
+    (reference crates/rpc/rpc/src/txpool.rs)."""
+    import json
+    import urllib.request
+
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.primitives import Account
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.rpc.convert import data as _data
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    CPU = TrieCommitter(hasher=keccak256_batch_np)
+    alice, bob = Wallet(0xA11CE), Wallet(0xB0B)
+    builder = ChainBuilder({alice.address: Account(balance=10**21),
+                            bob.address: Account(balance=10**20)},
+                           committer=CPU)
+    n = Node(NodeConfig(dev=True, genesis_header=builder.genesis,
+                        genesis_alloc=builder.accounts_at_genesis),
+             committer=CPU)
+    n.start_rpc()
+
+    def rpc(method, *params):
+        req = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                          "params": list(params)})
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{n.rpc.port}/", req.encode(),
+            {"Content-Type": "application/json"}), timeout=30)
+        out = json.loads(r.read())
+        assert "error" not in out, out
+        return out["result"]
+
+    try:
+        rpc("eth_sendRawTransaction",
+            _data(alice.transfer(b"\x0b" * 20, 777).encode()))
+        rpc("eth_sendRawTransaction",
+            _data(bob.transfer(b"\x0c" * 20, 555).encode()))
+        insp = rpc("txpool_inspect")
+        a_key = "0x" + alice.address.hex()
+        assert a_key in insp["pending"]
+        assert "777 wei + 21000 gas \u00d7" in insp["pending"][a_key]["0"]
+        frm = rpc("txpool_contentFrom", a_key)
+        assert list(frm["pending"]) == ["0"]  # nonce-keyed, no addr layer
+        assert frm["pending"]["0"]["value"] == hex(777)
+    finally:
+        n.stop()
