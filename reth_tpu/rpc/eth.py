@@ -129,6 +129,28 @@ class EthApi:
 
     # -- state -----------------------------------------------------------------
 
+    def eth_getAccount(self, address, tag="latest"):
+        """Full account object in one call (reference eth_getAccount,
+        rpc-eth-api/src/core.rs): balance, nonce, codeHash, storageRoot."""
+        from ..primitives.keccak import keccak256
+        from ..primitives.types import Account
+
+        p = self._state_at(tag)
+        addr = parse_data(address)
+        acct = p.account(addr) or Account()
+        # the CURRENT storage root is merkle-layer-owned and lives in
+        # HashedAccounts (provider.put_hashed_account contract); the plain
+        # account's field is an execution-time placeholder
+        storage_root = acct.storage_root
+        hashed_fn = getattr(p, "hashed_account", None)
+        if hashed_fn is not None:
+            hashed = hashed_fn(keccak256(addr))
+            if hashed is not None:
+                storage_root = hashed.storage_root
+        return {"balance": qty(acct.balance), "nonce": qty(acct.nonce),
+                "codeHash": data(acct.code_hash),
+                "storageRoot": data(storage_root)}
+
     def eth_getBalance(self, address, tag="latest"):
         p = self._state_at(tag)
         acc = p.account(parse_data(address))
