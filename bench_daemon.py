@@ -1,0 +1,212 @@
+"""In-session opportunistic TPU bench capture daemon.
+
+Round-5 answer to four consecutive rounds of BENCH = 0: instead of betting
+the headline number on the driver's single end-of-round window (which has
+hit a wedged axon tunnel every round), this daemon runs for the WHOLE
+session and grabs the number at the first healthy window.
+
+Strategy (VERDICT.md round 4, "Next round" #1):
+- every ~10 min, probe the tunnel in a subprocess: ONE tiny pre-compiled
+  program, hard 75 s budget (memory: giant compiles wedge the tunnel for
+  hours; a probe timeout means wedged, not transient),
+- on the first healthy probe, run a *micro* bench (40k accounts, one
+  forced fused tier => <=~4 small XLA programs, ~2 min device time) via
+  bench.py in a subprocess, write ``BENCH_SELF_r05.json`` and git-commit
+  it immediately,
+- escalate to the bigger sizes (150k, then 400k accounts) only while the
+  tunnel stays healthy, updating the artifact with the full size curve,
+- append every probe/bench event to ``BENCH_PROBELOG_r05.jsonl`` and
+  commit the log hourly even when every probe fails, so the round records
+  the capture attempts either way.
+
+Reference analogue: the number being captured matches the reference's
+MerkleStage rebuild hot path (crates/stages/stages/src/stages/
+hashing_account.rs:29-32, crates/trie/sparse/src/arena/mod.rs:2500-2548).
+
+Run detached from the top of the session:
+    python bench_daemon.py >/tmp/bench_daemon.out 2>&1 &
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+LOG = os.path.join(REPO, "BENCH_PROBELOG_r05.jsonl")
+ARTIFACT = os.path.join(REPO, "BENCH_SELF_r05.json")
+
+PROBE_BUDGET_S = int(os.environ.get("RETH_TPU_DAEMON_PROBE_BUDGET", "75"))
+PROBE_GAP_S = int(os.environ.get("RETH_TPU_DAEMON_PROBE_GAP", "600"))
+HEALTHY_GAP_S = 60  # between escalation stages while the tunnel is up
+LOG_COMMIT_EVERY = 6  # probes (~hourly at the default gap)
+
+# (accounts, slots, fused tier, bench watchdog seconds) — smallest first so
+# the first healthy window lands SOME number before anything ambitious.
+SIZES = [
+    (40_000, 16_000, 16_384, 420),
+    (150_000, 60_000, 16_384, 900),
+    (400_000, 160_000, 32_768, 1500),
+]
+
+# Deliberately duplicates bench.py's probe snippet: importing bench.py would
+# start its module-level watchdog thread, which os._exit()s the process after
+# RETH_TPU_BENCH_TIMEOUT — fatal for a daemon meant to live all session.
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp\n"
+    "d = jax.devices()\n"
+    "y = jax.jit(lambda a: a ^ (a << 1))(jnp.arange(256, dtype=jnp.uint32))\n"
+    "y.block_until_ready()\n"
+    "print('PROBE_OK', d[0].platform, flush=True)\n"
+)
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds")
+
+
+def log_event(rec: dict) -> None:
+    rec = {"ts": _now(), **rec}
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def git_commit(paths: list[str], msg: str) -> bool:
+    """Commit ONLY the named paths (pathspec commit — ignores whatever the
+    interactive session has staged), retrying briefly on index-lock races.
+    The add is required first: a pathspec commit can't see untracked files."""
+    for attempt in range(5):
+        subprocess.run(["git", "-C", REPO, "add", "--"] + paths,
+                       capture_output=True, text=True)
+        r = subprocess.run(
+            ["git", "-C", REPO, "commit", "-m", msg, "--"] + paths,
+            capture_output=True, text=True,
+        )
+        if r.returncode == 0:
+            return True
+        out = (r.stdout + r.stderr).lower()
+        if "nothing to commit" in out or "no changes added" in out:
+            return False
+        time.sleep(3 + attempt * 3)
+    # don't leave our paths staged for the interactive session's next
+    # unrelated commit to sweep in
+    subprocess.run(["git", "-C", REPO, "restore", "--staged", "--"] + paths,
+                   capture_output=True, text=True)
+    log_event({"event": "git_commit_failed", "msg": msg, "stderr": r.stderr[-400:]})
+    return False
+
+
+def probe() -> tuple[bool, str]:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-u", "-c", _PROBE_CODE],
+            capture_output=True, text=True, timeout=PROBE_BUDGET_S,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe exceeded {PROBE_BUDGET_S}s (wedged tunnel)"
+    if r.returncode == 0 and "PROBE_OK" in r.stdout:
+        return True, r.stdout.strip().splitlines()[-1]
+    tail = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["no output"]
+    return False, f"rc={r.returncode}: {tail[0][:300]}"
+
+
+def run_bench(accounts: int, slots: int, tier: int, watchdog: int) -> dict | None:
+    env = dict(
+        os.environ,
+        RETH_TPU_BENCH_ACCOUNTS=str(accounts),
+        RETH_TPU_BENCH_SLOTS=str(slots),
+        RETH_TPU_BENCH_TIER=str(tier),
+        RETH_TPU_BENCH_TIMEOUT=str(watchdog),
+        # the daemon just probed healthy — skip bench.py's long retry ladder
+        RETH_TPU_PROBE_TIMEOUT="90",
+        RETH_TPU_PROBE_ATTEMPTS="1",
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=watchdog + 90, env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"value": 0, "error": f"bench subprocess exceeded {watchdog + 90}s"}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return {"value": 0, "error": f"no JSON line, rc={r.returncode}: "
+                                 f"{(r.stderr or '')[-300:]}"}
+
+
+def update_artifact(captures: list[dict]) -> None:
+    best = max((c for c in captures if c["result"].get("value", 0) > 0),
+               key=lambda c: c["accounts"], default=None)
+    art = {
+        "metric": "merkle_rebuild_keccak_per_sec",
+        "value": best["result"]["value"] if best else 0,
+        "unit": "hashes/s",
+        "vs_baseline": best["result"].get("vs_baseline", 0) if best else 0,
+        "accounts": best["accounts"] if best else 0,
+        "captured_at": _now(),
+        "captures": captures,
+        "note": "self-captured in-session by bench_daemon.py at the first "
+                "healthy tunnel window (round-5 directive #1)",
+    }
+    with open(ARTIFACT, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+
+
+def main() -> None:
+    log_event({"event": "daemon_start", "pid": os.getpid(),
+               "probe_gap_s": PROBE_GAP_S, "sizes": SIZES})
+    captures: list[dict] = []
+    stage = 0
+    probes = 0
+    while True:
+        probes += 1
+        ok, diag = probe()
+        log_event({"event": "probe", "n": probes, "ok": ok, "diag": diag})
+        if ok and stage < len(SIZES):
+            accounts, slots, tier, watchdog = SIZES[stage]
+            log_event({"event": "bench_start", "accounts": accounts,
+                       "slots": slots, "tier": tier})
+            result = run_bench(accounts, slots, tier, watchdog)
+            log_event({"event": "bench_done", "accounts": accounts,
+                       "result": result})
+            # a watchdog-truncated run (value>0 but "error" set, baseline
+            # unmeasured) is not a clean capture — retry, don't escalate
+            if result and result.get("value", 0) > 0 and "error" not in result:
+                captures.append({"accounts": accounts, "slots": slots,
+                                 "tier": tier, "ts": _now(), "result": result})
+                update_artifact(captures)
+                git_commit(
+                    [ARTIFACT, LOG],
+                    f"bench: self-captured TPU number at {accounts} accounts "
+                    f"({result['value']} hashes/s, {result.get('vs_baseline')}x "
+                    f"vs numpy baseline)",
+                )
+                stage += 1
+                if stage == len(SIZES):
+                    log_event({"event": "daemon_done",
+                               "captures": len(captures)})
+                    git_commit([LOG], "bench: capture-daemon finished — "
+                                      "full size curve captured")
+                    return
+                time.sleep(HEALTHY_GAP_S)
+                continue
+            # bench failed despite a healthy probe — log and retry the same
+            # stage on the next cycle rather than burning the window further
+        if probes % LOG_COMMIT_EVERY == 0:
+            git_commit([LOG], f"bench: capture-daemon probe log "
+                              f"({probes} probes, {len(captures)} captures)")
+        time.sleep(PROBE_GAP_S)
+
+
+if __name__ == "__main__":
+    main()
