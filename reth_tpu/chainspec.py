@@ -48,6 +48,24 @@ HARDFORK_ORDER = [
 
 
 @dataclass(frozen=True)
+class BlobParams:
+    """EIP-4844 fee-market parameters for one fork (reference
+    `BlobScheduleItem`, crates/chainspec — geth-genesis ``blobSchedule``)."""
+
+    target: int
+    max: int
+    update_fraction: int
+
+    @property
+    def target_gas(self) -> int:
+        return self.target * (1 << 17)
+
+    @property
+    def max_gas(self) -> int:
+        return self.max * (1 << 17)
+
+
+@dataclass(frozen=True)
 class ForkCondition:
     """When a hardfork activates (reference `ForkCondition`, one of
     Block / Timestamp / TTD / Never)."""
@@ -89,6 +107,18 @@ class ChainSpec:
     hardforks: dict[str, ForkCondition] = field(default_factory=dict)
     genesis_hash: bytes = b"\x00" * 32
     deposit_contract: bytes | None = None
+    # per-fork EIP-4844 parameter overrides (geth-genesis blobSchedule)
+    blob_schedule: dict[str, BlobParams] = field(default_factory=dict)
+    # True when the schedule was synthesized for a dev chain (bare genesis
+    # config): fork queries work, but execution/validation must NOT pin
+    # header shapes on it — dev chains keep the repo's legacy dev format
+    dev: bool = False
+
+    @property
+    def execution_spec(self) -> "ChainSpec | None":
+        """The chainspec to thread into executors/validators: None for a
+        synthesized dev schedule (legacy post-merge defaults apply)."""
+        return None if self.dev else self
 
     # -- activation queries ------------------------------------------------
     def is_active(self, fork: str, number: int, timestamp: int = 0) -> bool:
@@ -186,9 +216,17 @@ class ChainSpec:
                 ("block", c.block), ("timestamp", c.timestamp), ("ttd", c.ttd),
                 ("never", c.never or None),
                 ("merge_netsplit", c.merge_netsplit or None)) if v is not None}
-        return json.dumps({"chain_id": self.chain_id,
-                           "genesis_hash": self.genesis_hash.hex(),
-                           "hardforks": forks})
+        doc = {"chain_id": self.chain_id,
+               "genesis_hash": self.genesis_hash.hex(),
+               "hardforks": forks}
+        if self.dev:
+            doc["dev"] = True
+        if self.blob_schedule:
+            doc["blob_schedule"] = {
+                name: {"target": p.target, "max": p.max,
+                       "baseFeeUpdateFraction": p.update_fraction}
+                for name, p in self.blob_schedule.items()}
+        return json.dumps(doc)
 
     @classmethod
     def from_json(cls, text: str) -> "ChainSpec":
@@ -202,14 +240,37 @@ class ChainSpec:
             for name, f in d["hardforks"].items()}
         return cls(chain_id=d["chain_id"],
                    hardforks={n: forks[n] for n in HARDFORK_ORDER if n in forks},
-                   genesis_hash=bytes.fromhex(d["genesis_hash"]))
+                   genesis_hash=bytes.fromhex(d["genesis_hash"]),
+                   blob_schedule=_parse_blob_schedule(d.get("blob_schedule")),
+                   # round-4 datadirs persisted bare dev configs as a
+                   # frontier-only schedule: treat those as dev too
+                   dev=d.get("dev", len(d.get("hardforks", {})) <= 1))
 
     # -- construction ------------------------------------------------------
+    @staticmethod
+    def config_has_forks(config: dict) -> bool:
+        """True when the geth-genesis config stanza carries an explicit
+        hardfork schedule (any fork key or a TTD)."""
+        keys = ("terminalTotalDifficulty", "homesteadBlock", "eip150Block",
+                "eip155Block", "eip158Block", "byzantiumBlock",
+                "constantinopleBlock", "petersburgBlock", "istanbulBlock",
+                "berlinBlock", "londonBlock", "shanghaiTime", "cancunTime",
+                "pragueTime", "osakaTime")
+        return any(k in config for k in keys)
+
     @classmethod
     def from_genesis_config(cls, config: dict, genesis_hash: bytes = b"\x00" * 32,
                             chain_id: int | None = None) -> "ChainSpec":
         """Build from a geth-genesis `config` stanza (reference
-        crates/chainspec/src/spec.rs `from_genesis`)."""
+        crates/chainspec/src/spec.rs `from_genesis`). A stanza with no
+        fork schedule at all means a dev chain: everything active at
+        genesis (geth's --dev does the same)."""
+        if not cls.config_has_forks(config):
+            spec = dev_spec(chain_id=chain_id or int(config.get("chainId", 1)),
+                            genesis_hash=genesis_hash)
+            spec.blob_schedule = _parse_blob_schedule(config.get("blobSchedule"))
+            spec.dev = True
+            return spec
         keymap_block = {
             "homesteadBlock": HOMESTEAD, "daoForkBlock": DAO,
             "eip150Block": TANGERINE, "eip155Block": SPURIOUS_DRAGON,
@@ -240,7 +301,21 @@ class ChainSpec:
                 forks[name] = ForkCondition(timestamp=int(config[key]))
         ordered = {n: forks[n] for n in HARDFORK_ORDER if n in forks}
         return cls(chain_id=chain_id or int(config.get("chainId", 1)),
-                   hardforks=ordered, genesis_hash=genesis_hash)
+                   hardforks=ordered, genesis_hash=genesis_hash,
+                   blob_schedule=_parse_blob_schedule(config.get("blobSchedule")))
+
+
+def _parse_blob_schedule(raw: dict | None) -> dict[str, BlobParams]:
+    """geth-genesis ``blobSchedule`` stanza → {fork name: BlobParams}."""
+    out: dict[str, BlobParams] = {}
+    for fork, p in (raw or {}).items():
+        fork = fork.lower()
+        if fork in HARDFORK_ORDER:
+            out[fork] = BlobParams(
+                target=int(p["target"]), max=int(p["max"]),
+                update_fraction=int(p.get("baseFeeUpdateFraction")
+                                    or p.get("update_fraction")))
+    return out
 
 
 def _mainnet_forks() -> dict[str, ForkCondition]:
@@ -274,3 +349,32 @@ def dev_spec(chain_id: int = 1337, genesis_hash: bytes = b"\x00" * 32) -> ChainS
                    if n not in (PARIS, OSAKA)}
                   | {PARIS: ForkCondition(ttd=0)},
     )
+
+
+def pinned_spec(fork: str, chain_id: int = 1,
+                genesis_hash: bytes = b"\x00" * 32) -> ChainSpec:
+    """A chain frozen at ``fork``: every hardfork up to and including it
+    active at genesis, nothing after (ef-tests network names pin forks
+    this way — reference testing/ef-tests `ForkSpec`)."""
+    idx = HARDFORK_ORDER.index(fork)
+    active = HARDFORK_ORDER[: idx + 1]
+    forks = {n: ForkCondition(block=0) for n in active if n != PARIS}
+    if PARIS in active:
+        forks[PARIS] = ForkCondition(ttd=0)
+    return ChainSpec(chain_id=chain_id, hardforks=forks,
+                     genesis_hash=genesis_hash)
+
+
+# ef-tests network label -> hardfork name (reference ForkSpec parsing)
+NETWORK_TO_FORK = {
+    "Frontier": FRONTIER, "Homestead": HOMESTEAD,
+    "EIP150": TANGERINE, "Tangerine": TANGERINE,
+    "EIP158": SPURIOUS_DRAGON, "SpuriousDragon": SPURIOUS_DRAGON,
+    "Byzantium": BYZANTIUM, "Constantinople": CONSTANTINOPLE,
+    "ConstantinopleFix": PETERSBURG, "Petersburg": PETERSBURG,
+    "Istanbul": ISTANBUL, "MuirGlacier": MUIR_GLACIER, "Berlin": BERLIN,
+    "London": LONDON, "ArrowGlacier": ARROW_GLACIER,
+    "GrayGlacier": GRAY_GLACIER, "Merge": PARIS, "Paris": PARIS,
+    "Shanghai": SHANGHAI, "Cancun": CANCUN, "Prague": PRAGUE,
+    "Osaka": OSAKA,
+}

@@ -85,23 +85,56 @@ def _load_genesis(path: str | None, committer, spec: dict | None = None):
                 _num(k).to_bytes(32, "big"): _num(v)
                 for k, v in entry["storage"].items()
             }
-    chain_id = _num(spec.get("config", {}).get("chainId"), 1)
+    config = spec.get("config", {})
+    chain_id = _num(config.get("chainId"), 1)
     from .trie.state_root import state_root
 
     root, _ = state_root(alloc, storage, committer=committer)
-    header = Header(
+    from .chainspec import ChainSpec
+
+    common = dict(
         number=0,
         state_root=root,
         gas_limit=_num(spec.get("gasLimit"), 30_000_000),
         timestamp=_num(spec.get("timestamp")),
         extra_data=bytes.fromhex(spec.get("extraData", "0x")[2:]),
-        base_fee_per_gas=_num(spec.get("baseFeePerGas"), 10**9),
-        withdrawals_root=None if spec.get("preMerge") else EMPTY_ROOT_HASH,
+        difficulty=_num(spec.get("difficulty")),
+        beneficiary=bytes.fromhex(spec.get("coinbase", "0x" + "00" * 20)[2:]),
+        mix_hash=bytes.fromhex(spec.get("mixHash", "0x" + "00" * 32)[2:]),
+        nonce=_num(spec.get("nonce")).to_bytes(8, "big"),
     )
-    from .chainspec import ChainSpec
+    if ChainSpec.config_has_forks(config):
+        # explicit schedule: build the genesis header with exactly the
+        # fields its genesis-time fork carries (geth's genesis ToBlock)
+        cs_tmp = ChainSpec.from_genesis_config(config, chain_id=chain_id)
+        from .evm.spec import spec_for_block
 
+        s0 = spec_for_block(cs_tmp, 0, common["timestamp"])
+        import hashlib as _hashlib
+
+        header = Header(
+            **common,
+            base_fee_per_gas=(_num(spec.get("baseFeePerGas"), 10**9)
+                              if s0.has_basefee or spec.get("baseFeePerGas")
+                              else None),
+            withdrawals_root=EMPTY_ROOT_HASH if s0.has_withdrawals else None,
+            blob_gas_used=_num(spec.get("blobGasUsed"), 0) if s0.blob else None,
+            excess_blob_gas=(_num(spec.get("excessBlobGas"), 0)
+                             if s0.blob else None),
+            parent_beacon_block_root=(b"\x00" * 32 if s0.beacon_root_call
+                                      else None),
+            requests_hash=(_hashlib.sha256().digest() if s0.has_requests
+                           else None),
+        )
+    else:
+        # dev-style genesis (no schedule): keep the repo's legacy shape
+        header = Header(
+            **common,
+            base_fee_per_gas=_num(spec.get("baseFeePerGas"), 10**9),
+            withdrawals_root=None if spec.get("preMerge") else EMPTY_ROOT_HASH,
+        )
     chain_spec = ChainSpec.from_genesis_config(
-        spec.get("config", {}), genesis_hash=header.hash, chain_id=chain_id)
+        config, genesis_hash=header.hash, chain_id=chain_id)
     return header, alloc, storage, codes, chain_id, chain_spec
 
 
@@ -143,10 +176,16 @@ def cmd_import(args):
         _item, end = _decode_at(raw, pos)
         blocks.append(Block.decode(raw[pos:end]))
         pos = end
-    tip = import_chain(node.factory, blocks, EthBeaconConsensus(node.committer))
+    from .evm import EvmConfig as _EvmConfig
+
+    exec_spec = chain_spec.execution_spec
+    consensus = EthBeaconConsensus(node.committer, chainspec=exec_spec)
+    tip = import_chain(node.factory, blocks, consensus)
     print(f"imported {len(blocks)} blocks, tip={tip}")
     t0 = time.time()
-    pipeline = Pipeline(node.factory, default_stages(committer=node.committer))
+    pipeline = Pipeline(node.factory, default_stages(
+        committer=node.committer, consensus=consensus,
+        evm_config=_EvmConfig(chain_id=chain_id, chainspec=exec_spec)))
     pipeline.run(tip)
     node.factory.db.flush()
     print(f"pipeline synced to {tip} in {time.time()-t0:.2f}s")

@@ -97,10 +97,17 @@ def _header_json(h: Header) -> dict:
         out["blobGasUsed"] = _hex_int(h.blob_gas_used)
     if h.excess_blob_gas is not None:
         out["excessBlobGas"] = _hex_int(h.excess_blob_gas)
+    if h.parent_beacon_block_root is not None:
+        out["parentBeaconBlockRoot"] = _hex(h.parent_beacon_block_root)
+    if h.requests_hash is not None:
+        out["requestsHash"] = _hex(h.requests_hash)
     return out
 
 
-def builder_to_fixture(builder: ChainBuilder, network: str = "Cancun") -> dict:
+def builder_to_fixture(builder: ChainBuilder, network: str | None = None) -> dict:
+    """Serialize a sealed chain; the network label comes from the builder
+    (which executed under exactly that rule set) unless overridden."""
+    network = network or builder.network or "Cancun"
     pre = {
         _hex(addr): _account_json(
             acct,
@@ -136,10 +143,11 @@ def _contract_addr(builder: ChainBuilder, runtime: bytes) -> bytes:
 # -- scenarios (each returns a sealed ChainBuilder) --------------------------
 
 
-def _scn_transfers(seed: int) -> ChainBuilder:
+def _scn_transfers(seed: int, network: str | None = None) -> ChainBuilder:
     a, b = Wallet(0xA0000 + seed), Wallet(0xB0000 + seed)
     bld = ChainBuilder({a.address: Account(balance=10**20),
-                        b.address: Account(balance=10**19)})
+                        b.address: Account(balance=10**19)},
+                       network=network)
     for i in range(1 + seed % 3):
         bld.build_block([
             a.transfer(b.address, 10**15 + seed * 1000 + i),
@@ -148,9 +156,9 @@ def _scn_transfers(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_storage(seed: int) -> ChainBuilder:
+def _scn_storage(seed: int, network: str | None = None) -> ChainBuilder:
     a = Wallet(0xC0000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
     bld.build_block([a.deploy(_initcode(_STORE))])
     c = _contract_addr(bld, _STORE)
     writes = [a.call(c, (seed * 7 + i + 1).to_bytes(32, "big")) for i in range(3)]
@@ -159,9 +167,9 @@ def _scn_storage(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_create_call(seed: int) -> ChainBuilder:
+def _scn_create_call(seed: int, network: str | None = None) -> ChainBuilder:
     a = Wallet(0xD0000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
     bld.build_block([a.deploy(_initcode(_ADDER)), a.deploy(_initcode(_STORE))])
     adder = _contract_addr(bld, _ADDER)
     store = _contract_addr(bld, _STORE)
@@ -172,18 +180,18 @@ def _scn_create_call(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_revert(seed: int) -> ChainBuilder:
+def _scn_revert(seed: int, network: str | None = None) -> ChainBuilder:
     a = Wallet(0xE0000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
     bld.build_block([a.deploy(_initcode(_REVERTER))])
     rev = _contract_addr(bld, _REVERTER)
     bld.build_block([a.call(rev, b""), a.transfer(b"\x05" * 20, seed + 1)])
     return bld
 
 
-def _scn_selfdestruct(seed: int) -> ChainBuilder:
+def _scn_selfdestruct(seed: int, network: str | None = None) -> ChainBuilder:
     a = Wallet(0xF0000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
     bld.build_block([a.deploy(_initcode(_SELFDESTRUCT))])
     sd = _contract_addr(bld, _SELFDESTRUCT)
     # same-tx create+destruct vs later-call destruct (EIP-6780 split)
@@ -193,9 +201,9 @@ def _scn_selfdestruct(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_precompiles(seed: int) -> ChainBuilder:
+def _scn_precompiles(seed: int, network: str | None = None) -> ChainBuilder:
     a = Wallet(0x1A0000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
     which = (2, 3, 4, 6, 9)[seed % 5]
     data = bytes([seed & 0xFF]) * (8 + seed % 16)
     if which == 6:
@@ -209,9 +217,9 @@ def _scn_precompiles(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_access_list(seed: int) -> ChainBuilder:
+def _scn_access_list(seed: int, network: str | None = None) -> ChainBuilder:
     a = Wallet(0x1B0000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
     bld.build_block([a.deploy(_initcode(_STORE))])
     c = _contract_addr(bld, _STORE)
     tx = a.sign_tx(Transaction(
@@ -223,9 +231,9 @@ def _scn_access_list(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_blob_tx(seed: int) -> ChainBuilder:
+def _scn_blob_tx(seed: int, network: str | None = None) -> ChainBuilder:
     a = Wallet(0x1C0000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**21)}, cancun=True)
+    bld = ChainBuilder({a.address: Account(balance=10**21)}, cancun=True, network=network)
     tx = a.sign_tx(Transaction(
         tx_type=3, chain_id=1, nonce=a.nonce, max_fee_per_gas=10**10,
         max_priority_fee_per_gas=10**9, gas_limit=50_000,
@@ -241,11 +249,12 @@ def _scn_blob_tx(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_setcode_tx(seed: int) -> ChainBuilder:
+def _scn_setcode_tx(seed: int, network: str | None = None) -> ChainBuilder:
     a = Wallet(0x1D0000 + seed)
     b = Wallet(0x1E0000 + seed)
     bld = ChainBuilder({a.address: Account(balance=10**20),
-                        b.address: Account(balance=10**19)})
+                        b.address: Account(balance=10**19)},
+                       network=network)
     bld.build_block([a.deploy(_initcode(_STORE))])
     c = _contract_addr(bld, _STORE)
     auth = b.authorize(c, nonce=0)
@@ -259,9 +268,9 @@ def _scn_setcode_tx(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_deep_state(seed: int) -> ChainBuilder:
+def _scn_deep_state(seed: int, network: str | None = None) -> ChainBuilder:
     a = Wallet(0x1F0000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**21)})
+    bld = ChainBuilder({a.address: Account(balance=10**21)}, network=network)
     txs = [a.transfer(keccak256(bytes([seed, i]))[:20], 10**10 + i)
            for i in range(12)]
     bld.build_block(txs[:6])
@@ -269,9 +278,9 @@ def _scn_deep_state(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_empty_blocks(seed: int) -> ChainBuilder:
+def _scn_empty_blocks(seed: int, network: str | None = None) -> ChainBuilder:
     a = Wallet(0x200000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
     for i in range(2 + seed % 4):
         bld.build_block([] if i % 2 else [a.transfer(b"\x31" * 20, seed + i)])
     return bld
@@ -292,7 +301,7 @@ def _mass_zero_runtime(n: int) -> bytes:
     ])
 
 
-def _scn_gas_edge(seed: int) -> ChainBuilder:
+def _scn_gas_edge(seed: int, network: str | None = None) -> ChainBuilder:
     """Refund-cap adversaries (EIP-3529): one tx zeroes MANY pre-existing
     slots so the refund exceeds gas_used/5 and the cap binds (a clamp bug
     changes the sealed gas_used); plus an exact intrinsic-gas transfer
@@ -307,6 +316,7 @@ def _scn_gas_edge(seed: int) -> ChainBuilder:
         genesis_storage={zaddr: {i.to_bytes(32, "big"): i + 7
                                  for i in range(1, n + 1)}},
         codes={keccak256(zeroer): zeroer},
+        network=network,
     )
     bld.build_block([a.call(zaddr, b"", gas_limit=500_000)])
     # exact intrinsic gas: gas_limit == 21000, must land
@@ -332,12 +342,12 @@ def _create2_factory_runtime() -> bytes:
     return header + _CREATE2_CHILD_INIT
 
 
-def _scn_create_collision(seed: int) -> ChainBuilder:
+def _scn_create_collision(seed: int, network: str | None = None) -> ChainBuilder:
     """CREATE2 address collision: the second deployment with the SAME salt
     must fail (stores 0), a fresh salt succeeds — exercises the
     created-account collision rules and address derivation."""
     a = Wallet(0x220000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
     factory = _create2_factory_runtime()
     bld.build_block([a.deploy(_initcode(factory))])
     f = _contract_addr(bld, factory)
@@ -352,14 +362,15 @@ def _scn_create_collision(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_delegation_chain(seed: int) -> ChainBuilder:
+def _scn_delegation_chain(seed: int, network: str | None = None) -> ChainBuilder:
     """EIP-7702 adversaries: re-delegation in a later block, an
     invalid-nonce tuple that must be skipped, and delegation revocation
     (authorize the zero address)."""
     a = Wallet(0x230000 + seed)
     b = Wallet(0x240000 + seed)
     bld = ChainBuilder({a.address: Account(balance=10**20),
-                        b.address: Account(balance=10**19)})
+                        b.address: Account(balance=10**19)},
+                       network=network)
     bld.build_block([a.deploy(_initcode(_STORE)), a.deploy(_initcode(_ADDER))])
     store = _contract_addr(bld, _STORE)
     adder = _contract_addr(bld, _ADDER)
@@ -390,12 +401,12 @@ def _scn_delegation_chain(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_blob_accounting(seed: int) -> ChainBuilder:
+def _scn_blob_accounting(seed: int, network: str | None = None) -> ChainBuilder:
     """EIP-4844 blob-gas market: blob-heavy blocks push excess_blob_gas
     up, empty blocks decay it — every header's blobGasUsed/excessBlobGas
     pair is sealed and replayed."""
     a = Wallet(0x250000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**21)}, cancun=True)
+    bld = ChainBuilder({a.address: Account(balance=10**21)}, cancun=True, network=network)
     def blob_tx(n_blobs, tag):
         return a.sign_tx(Transaction(
             tx_type=3, chain_id=1, nonce=a.nonce, max_fee_per_gas=10**10,
@@ -427,13 +438,13 @@ def _revert_outer_runtime(inner: bytes) -> bytes:
     )
 
 
-def _scn_deep_revert(seed: int) -> ChainBuilder:
+def _scn_deep_revert(seed: int, network: str | None = None) -> ChainBuilder:
     """Nested-frame journaling: the callee SSTOREs then REVERTs (its write
     unwinds), the caller keeps executing and commits its own write; a
     second tx reverts at the TOP level after a successful inner call (all
     writes unwind)."""
     a = Wallet(0x260000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
     # inner: sstore(0, 1) then revert(0,0)
     inner_rt = bytes([0x60, 0x01, 0x5F, 0x55, 0x5F, 0x5F, 0xFD])
     bld.build_block([a.deploy(_initcode(inner_rt))])
@@ -458,7 +469,7 @@ def _scn_deep_revert(seed: int) -> ChainBuilder:
     return bld
 
 
-def _scn_invalid_blocks(seed: int) -> dict:
+def _scn_invalid_blocks(seed: int, network: str | None = None) -> dict:
     """Invalid-block rejection family (the official suites' InvalidBlocks
     shape): a valid 2-block chain followed by a TAMPERED third block that
     must be rejected — bad state root, bad gas used, bad transactions
@@ -466,7 +477,7 @@ def _scn_invalid_blocks(seed: int) -> dict:
     fixture (the tampered block cannot come from ChainBuilder, which only
     seals valid chains)."""
     a = Wallet(0x270000 + seed)
-    bld = ChainBuilder({a.address: Account(balance=10**20)})
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
     for i in range(2):
         bld.build_block([a.transfer(bytes([0x41]) * 20, 100 + seed + i)])
     fix2 = builder_to_fixture(bld)  # snapshot BEFORE block 3 exists
@@ -491,6 +502,104 @@ def _scn_invalid_blocks(seed: int) -> dict:
     return fix2
 
 
+def _scn_push0_boundary(seed: int, network: str | None = None) -> ChainBuilder:
+    """EIP-3855 fork boundary: the same contract call succeeds under
+    Shanghai and halts (invalid opcode, all gas burnt) under Paris —
+    sealed under each network's own rules so replay pins the divergence
+    in gas, receipts, and state."""
+    a = Wallet(0x300000 + seed)
+    # runtime built WITHOUT PUSH0 so deployment works pre-Shanghai:
+    # PUSH0 PUSH1 01 SSTORE STOP — storage write only where PUSH0 exists
+    runtime = bytes.fromhex("5f60015500")
+    init = (bytes([0x60, len(runtime), 0x60, 0x0C, 0x60, 0x00, 0x39,
+                   0x60, len(runtime), 0x60, 0x00, 0xF3])
+            + runtime)
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
+    bld.build_block([a.deploy(init)])
+    c = _contract_addr(bld, runtime)
+    bld.build_block([a.call(c, seed.to_bytes(32, "big"))])
+    return bld
+
+
+def _scn_cancun_ops_boundary(seed: int, network: str | None = None) -> ChainBuilder:
+    """EIP-1153/5656 boundary: TSTORE and MCOPY halt under Shanghai,
+    execute under Cancun (alternating by seed)."""
+    a = Wallet(0x310000 + seed)
+    if seed % 2 == 0:  # TSTORE(0,1); SSTORE(1, TLOAD(0)); STOP
+        runtime = bytes.fromhex("600160005d60005c60015500")
+    else:  # MSTORE8(0,7); MCOPY(0x20,0,0x20); SSTORE(2, MLOAD(0x20)); STOP
+        runtime = bytes.fromhex("60076000536020600060205e60205160025500")
+    init = (bytes([0x60, len(runtime), 0x60, 0x0C, 0x60, 0x00, 0x39,
+                   0x60, len(runtime), 0x60, 0x00, 0xF3])
+            + runtime)
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
+    bld.build_block([a.deploy(init)])
+    c = _contract_addr(bld, runtime)
+    bld.build_block([a.call(c, b"")])
+    return bld
+
+
+def _scn_selfdestruct_boundary(seed: int, network: str | None = None) -> ChainBuilder:
+    """EIP-6780 boundary: a PRE-EXISTING contract selfdestructs in a later
+    transaction — deleted under Shanghai, surviving (balance-move only)
+    under Cancun. The post-state accounts differ across the two fixtures."""
+    a = Wallet(0x320000 + seed)
+    sd = bytes.fromhex("600035ff")  # selfdestruct(calldata[0]) sans PUSH0
+    init = (bytes([0x60, len(sd), 0x60, 0x0C, 0x60, 0x00, 0x39,
+                   0x60, len(sd), 0x60, 0x00, 0xF3]) + sd)
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
+    bld.build_block([a.deploy(init)])
+    c = _contract_addr(bld, sd)
+    bld.build_block([a.transfer(c, 777 + seed)])  # fund it
+    ben = bytes([0x44] * 19 + [seed + 1])
+    bld.build_block([a.call(c, ben.rjust(32, b"\x00"), gas_limit=200_000)])
+    return bld
+
+
+def _scn_future_tx_rejected(seed: int, network: str | None = None) -> dict:
+    """Fork gating of tx envelopes: a block smuggling a next-fork tx type
+    (blob tx under Shanghai / set-code tx under Cancun) must be REJECTED,
+    not mis-executed (expectException)."""
+    from ..primitives.rlp import rlp_encode as _rlp
+    from ..testing import ordered_trie_root
+
+    a = Wallet(0x330000 + seed)
+    bld = ChainBuilder({a.address: Account(balance=10**20)}, network=network)
+    bld.build_block([a.transfer(bytes([0x42]) * 20, 1000 + seed)])
+    fix = builder_to_fixture(bld)
+    if network == "Shanghai":
+        bad_tx = a.sign_tx(Transaction(
+            tx_type=3, chain_id=1, nonce=a.nonce, max_fee_per_gas=10**10,
+            max_priority_fee_per_gas=10**9, gas_limit=50_000,
+            to=bytes([0x66] * 20), max_fee_per_blob_gas=10**10,
+            blob_versioned_hashes=(b"\x01" + bytes(31),)))
+        exc = "TxTypeNotActivated"
+    else:  # Cancun rejecting a Prague set-code tx
+        auth = a.authorize(bytes([0x55] * 20), nonce=a.nonce + 1)
+        bad_tx = a.sign_tx(Transaction(
+            tx_type=4, chain_id=1, nonce=a.nonce, max_fee_per_gas=10**10,
+            max_priority_fee_per_gas=10**9, gas_limit=100_000,
+            to=bytes([0x55] * 20), authorization_list=(auth,)))
+        exc = "TxTypeNotActivated"
+    parent = bld.tip
+    from ..consensus.validation import calc_next_base_fee
+    from ..primitives.types import EMPTY_ROOT_HASH
+
+    bad = Block(
+        Header(**{**parent.__dict__,
+                  "parent_hash": parent.hash,
+                  "number": parent.number + 1,
+                  "timestamp": parent.timestamp + 12,
+                  "base_fee_per_gas": calc_next_base_fee(parent),
+                  "transactions_root": ordered_trie_root(
+                      [bad_tx.encode()], bld.committer),
+                  "receipts_root": EMPTY_ROOT_HASH,
+                  "gas_used": 21_000}),
+        (bad_tx,), (), () if network != "Paris" else None)
+    fix["blocks"].append({"rlp": _hex(bad.encode()), "expectException": exc})
+    return fix
+
+
 SCENARIOS = {
     "transfers": _scn_transfers,
     "storage": _scn_storage,
@@ -511,17 +620,55 @@ SCENARIOS = {
     "blobAccounting": _scn_blob_accounting,
     "deepRevert": _scn_deep_revert,
     "invalidBlocks": _scn_invalid_blocks,
+    # fork-boundary families (round-5: per-network generation; the same
+    # scenario sealed under adjacent forks pins the divergence)
+    "push0Boundary": _scn_push0_boundary,
+    "cancunOpsBoundary": _scn_cancun_ops_boundary,
+    "selfdestructBoundary": _scn_selfdestruct_boundary,
+    "futureTxRejected": _scn_future_tx_rejected,
+}
+
+# Networks each family is generated under. Most bytecode scenarios use
+# PUSH0, so Shanghai is their floor; blob families span the EIP-7691
+# reschedule (Cancun 3/6 vs Prague 6/9 — the excess math differs);
+# 7702 families are Prague-only. Boundary families deliberately include
+# the fork where the feature does NOT exist.
+SCENARIO_NETWORKS: dict[str, list[str]] = {
+    "transfers": ["Paris", "Shanghai", "Cancun", "Prague"],
+    "emptyBlocks": ["Paris", "Shanghai", "Cancun", "Prague"],
+    "storage": ["Shanghai", "Cancun", "Prague"],
+    "createCall": ["Shanghai", "Cancun", "Prague"],
+    "revert": ["Shanghai", "Cancun", "Prague"],
+    "selfdestruct": ["Cancun", "Prague"],
+    "precompiles": ["Shanghai", "Cancun", "Prague"],
+    "accessList": ["Shanghai", "Cancun", "Prague"],
+    "deepState": ["Shanghai", "Cancun", "Prague"],
+    "gasEdge": ["Shanghai", "Cancun", "Prague"],
+    "createCollision": ["Shanghai", "Cancun", "Prague"],
+    "deepRevert": ["Shanghai", "Cancun", "Prague"],
+    "invalidBlocks": ["Shanghai", "Cancun", "Prague"],
+    "blobTx": ["Cancun", "Prague"],
+    "blobAccounting": ["Cancun", "Prague"],
+    "setCodeTx": ["Prague"],
+    "delegationChain": ["Prague"],
+    "push0Boundary": ["Paris", "Shanghai"],
+    "cancunOpsBoundary": ["Shanghai", "Cancun"],
+    "selfdestructBoundary": ["Shanghai", "Cancun"],
+    "futureTxRejected": ["Shanghai", "Cancun"],
 }
 
 
 def generate_suite(seeds_per_scenario: int = 10) -> dict[str, dict]:
-    """The full generated corpus: scenario x seed -> fixture case."""
+    """The full generated corpus: scenario x seed -> fixture case, cycling
+    each family through its eligible networks across seeds."""
     suite: dict[str, dict] = {}
     for name, fn in SCENARIOS.items():
+        networks = SCENARIO_NETWORKS[name]
         for seed in range(seeds_per_scenario):
-            made = fn(seed)
-            suite[f"{name}_{seed}"] = (made if isinstance(made, dict)
-                                       else builder_to_fixture(made))
+            network = networks[seed % len(networks)]
+            made = fn(seed, network=network)
+            suite[f"{name}_{network}_{seed}"] = (
+                made if isinstance(made, dict) else builder_to_fixture(made))
     return suite
 
 

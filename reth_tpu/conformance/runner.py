@@ -120,8 +120,27 @@ def run_blockchain_test(name: str, case: dict, committer=None) -> None:
             f"{name}: genesis hash {ghash.hex()} != declared {declared}"
         )
 
-    consensus = EthBeaconConsensus(committer)
-    pipeline = Pipeline(factory, default_stages(committer=committer))
+    # the network label pins the rule set (reference ForkSpec): every
+    # block executes and validates under exactly that fork
+    chainspec = None
+    network = case.get("network")
+    if network:
+        from ..chainspec import NETWORK_TO_FORK, pinned_spec
+
+        fork = NETWORK_TO_FORK.get(network)
+        if fork is None:
+            raise ConformanceFailure(f"{name}: unknown network {network!r}")
+        chainspec = pinned_spec(fork)
+    from ..evm import EvmConfig
+
+    evm_config = EvmConfig(chain_id=1, chainspec=chainspec)
+    consensus = EthBeaconConsensus(committer, chainspec=chainspec)
+
+    def _stages():
+        return default_stages(committer=committer, consensus=consensus,
+                              evm_config=evm_config)
+
+    pipeline = Pipeline(factory, _stages())
 
     def _fork():
         """Throwaway copy of the chain state: an expectException block is
@@ -138,7 +157,7 @@ def run_blockchain_test(name: str, case: dict, committer=None) -> None:
     for i, blk in enumerate(case.get("blocks", ())):
         expect_fail = "expectException" in blk
         run_factory = _fork() if expect_fail else factory
-        run_pipeline = (Pipeline(run_factory, default_stages(committer=committer))
+        run_pipeline = (Pipeline(run_factory, _stages())
                         if expect_fail else pipeline)
         try:
             block = Block.decode(_bytes(blk["rlp"]))

@@ -200,8 +200,12 @@ def _block_env(block: Block, config, block_hashes=None) -> BlockEnv:
         gas_limit=h.gas_limit, base_fee=h.base_fee_per_gas or 0,
         prev_randao=h.mix_hash,
         chain_id=config.chain_id if config is not None else 1,
+        difficulty=h.difficulty,
         block_hashes=block_hashes or {},
-        blob_base_fee=blob_base_fee(h.excess_blob_gas or 0),
+        blob_base_fee=blob_base_fee(
+            h.excess_blob_gas or 0,
+            config.blob_params_for(h.number, h.timestamp).update_fraction
+            if config is not None else 3_338_477),
     )
 
 

@@ -37,9 +37,11 @@ class LocalMiner:
         if block.header.excess_blob_gas is not None:
             from ..evm.executor import blob_base_fee, next_excess_blob_gas
 
+            params = self.tree.config.blob_params_for(
+                block.header.number + 1, block.header.timestamp)
             next_blob_fee = blob_base_fee(next_excess_blob_gas(
-                block.header.excess_blob_gas, block.header.blob_gas_used or 0
-            ))
+                block.header.excess_blob_gas, block.header.blob_gas_used or 0,
+                params.target_gas), params.update_fraction)
         self.pool.on_canonical_state_change(calc_next_base_fee(block.header),
                                             blob_base_fee=next_blob_fee)
         return block

@@ -297,7 +297,10 @@ class EngineTree:
                 coinbase=header.beneficiary, gas_limit=header.gas_limit,
                 base_fee=header.base_fee_per_gas or 0,
                 prev_randao=header.mix_hash, chain_id=self.config.chain_id,
-                blob_base_fee=blob_base_fee(header.excess_blob_gas or 0),
+                blob_base_fee=blob_base_fee(
+                    header.excess_blob_gas or 0,
+                    self.config.blob_params_for(
+                        header.number, header.timestamp).update_fraction),
             )
             self.last_prewarm = PrewarmTask(
                 executor, env, record_accesses=self.bal_execution)
@@ -354,7 +357,8 @@ class EngineTree:
         if self.last_prewarm is not None:
             self.last_prewarm.join()
         try:
-            self.consensus.validate_block_post_execution(block, out.receipts, out.gas_used)
+            self.consensus.validate_block_post_execution(
+                block, out.receipts, out.gas_used, requests=out.requests)
         except ConsensusError as e:
             _abort_root_job()
             self.invalid[block.hash] = str(e)

@@ -1,10 +1,15 @@
-"""EVM bytecode interpreter (CPU) — Shanghai/Cancun rule set.
+"""EVM bytecode interpreter (CPU), fork-parameterized Frontier→Prague.
 
 Reference analogue: the revm v41 interpreter (external crate; reth wires
-it via `ConfigureEvm`, crates/evm/evm/src/lib.rs:181). A from-scratch
-stack machine: 256-bit words as Python ints, memory as bytearray,
-EIP-2929 warm/cold access, EIP-3529 refunds, EIP-3860 initcode metering,
-EIP-1153 transient storage, EIP-5656 MCOPY, EIP-6780 selfdestruct.
+it via `ConfigureEvm`, crates/evm/evm/src/lib.rs:181, and selects a revm
+`SpecId` per block — crates/ethereum/evm/src/config.rs:2-3). A
+from-scratch stack machine: 256-bit words as Python ints, memory as
+bytearray. Everything fork-dependent — opcode availability, the
+EIP-2929 warm/cold model vs the flat pre-Berlin gas tables, the three
+SSTORE regimes (legacy / EIP-1283-2200 net / post-Berlin), EIP-150
+63/64 gas retention, EIP-161 touch semantics, EIP-3529 refunds,
+EIP-3860 initcode metering, EIP-1153/5656/6780 — is read from the
+active :class:`~reth_tpu.evm.spec.Spec`.
 """
 
 from __future__ import annotations
@@ -14,6 +19,7 @@ from dataclasses import dataclass, field
 
 from ..primitives.keccak import keccak256
 from ..primitives.rlp import rlp_encode, encode_int
+from .spec import LATEST_SPEC, Spec
 from .state import EvmState, resolve_delegation
 
 U256 = 1 << 256
@@ -73,6 +79,7 @@ class BlockEnv:
     prev_randao: bytes = b"\x00" * 32
     blob_base_fee: int = 1
     chain_id: int = 1
+    difficulty: int = 0  # pre-merge DIFFICULTY opcode value
     block_hashes: dict[int, bytes] = field(default_factory=dict)
 
 
@@ -104,10 +111,12 @@ class Interpreter:
     recursion limit (reference: revm's iterative frame loop behind
     crates/evm/evm/src/lib.rs:181)."""
 
-    def __init__(self, state: EvmState, block: BlockEnv, tx: TxEnv, tracer=None):
+    def __init__(self, state: EvmState, block: BlockEnv, tx: TxEnv, tracer=None,
+                 spec: Spec | None = None):
         self.state = state
         self.block = block
         self.tx = tx
+        self.spec = spec if spec is not None else LATEST_SPEC
         self.transient: dict[tuple[bytes, bytes], int] = {}
         # optional per-step hook(pc, op, gas, stack, mem, depth) — the
         # struct-logger seam for debug_traceTransaction (revm Inspector
@@ -185,7 +194,13 @@ class Interpreter:
                     return False, frame.gas, b""
                 state.sub_balance(frame.caller, frame.value)
                 state.add_balance(frame.address, frame.value)
-            pre = _precompile(frame.address)
+            elif (frame.transfer_value and self.spec.touch_creates_empty
+                  and state.account(frame.address) is None):
+                # pre-EIP-161: every message call materializes its target,
+                # value or not (the zero-balance precompile accounts on
+                # mainnet exist because of exactly this)
+                state.add_balance(frame.address, 0)
+            pre = _precompile(frame.address, self.spec)
             if pre is not None:
                 ok, gas_left, out = pre(frame.data, frame.gas)
                 if not ok:
@@ -230,6 +245,7 @@ class Interpreter:
             addr = keccak256(rlp_encode([caller, encode_int(state.nonce(caller))]))[12:]
         else:
             addr = keccak256(b"\xff" + caller + salt + keccak256(initcode))[12:]
+        spec = self.spec
         if tx_nonce is None:
             state.bump_nonce(caller)
         state.warm_account(addr)
@@ -237,7 +253,8 @@ class Interpreter:
         if existing is not None and (existing.nonce > 0 or existing.code_hash != keccak256(b"")):
             return False, 0, b"", b""  # address collision burns gas
         snap = state.snapshot()
-        state.create_account(addr)
+        # EIP-161 starts new contracts at nonce 1; before it, nonce 0
+        state.create_account(addr, nonce=1 if spec.state_clearing else 0)
         state.sub_balance(caller, value)
         state.add_balance(addr, value)
         frame = CallFrame(caller=caller, address=addr, code=initcode,
@@ -253,14 +270,20 @@ class Interpreter:
             return False, 0, b"", b""
         # code validation + deposit gas apply even if the initcode
         # selfdestructed the account (execution-specs generic_create order)
-        if len(out) > MAX_CODE_SIZE or (out and out[0] == 0xEF):
+        if spec.max_code_size is not None and len(out) > spec.max_code_size:
+            state.revert(snap)
+            return False, 0, b"", b""
+        if spec.reject_ef_code and out and out[0] == 0xEF:  # EIP-3541
             state.revert(snap)
             return False, 0, b"", b""
         deposit = G_CODE_DEPOSIT * len(out)
         if gas_left < deposit:
-            state.revert(snap)
-            return False, 0, b"", b""
-        gas_left -= deposit
+            if spec.create_fail_on_deposit_oog:  # EIP-2 (Homestead)
+                state.revert(snap)
+                return False, 0, b"", b""
+            out = b""  # Frontier: creation succeeds with empty code
+        else:
+            gas_left -= deposit
         # EIP-6780: if the initcode selfdestructed the account it is None
         # now (create_account made it live; only a fresh destruct kills it)
         # → creation succeeds but the account stays dead, no code deposit.
@@ -328,6 +351,15 @@ class Interpreter:
             mem[offset : offset + len(data)] = data
 
         tracer = self.tracer
+        # fork rule set, read into locals once per frame so the hot loop
+        # pays attribute access only at entry
+        spec = self.spec
+        warm_cold = spec.warm_cold
+        has_push0 = spec.has_push0
+        has_revert = spec.has_revert
+        has_shifts = spec.has_shifts
+        sstore_net = spec.sstore_net
+        sstore_sentry = spec.sstore_sentry
         cold = None  # cold-op dispatch table, built on first cold op
 
         def _build_cold():
@@ -361,7 +393,7 @@ class Interpreter:
 
             def h_exp():
                 a, e = pop(), pop()
-                use(10 + G_EXP_BYTE * ((e.bit_length() + 7) // 8))
+                use(10 + spec.g_exp_byte * ((e.bit_length() + 7) // 8))
                 push(pow(a, e, U256))
 
             def h_signextend():
@@ -382,9 +414,16 @@ class Interpreter:
                 use(3); s, x = pop(), _sgn(pop())
                 push((x >> s if s < 256 else (0 if x >= 0 else MASK)) & MASK)
 
+            def acct_access(addr, flat):
+                """Account-touch cost: EIP-2929 warm/cold after Berlin,
+                the fork's flat price before it."""
+                if warm_cold:
+                    return G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT
+                return flat
+
             def h_balance():
                 addr = pop().to_bytes(32, "big")[12:]
-                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                use(acct_access(addr, spec.g_balance))
                 push(state.balance(addr))
 
             def h_origin():
@@ -398,20 +437,20 @@ class Interpreter:
 
             def h_extcodesize():
                 addr = pop().to_bytes(32, "big")[12:]
-                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                use(acct_access(addr, spec.g_extcode))
                 push(len(state.code(addr)))
 
             def h_extcodecopy():
                 addr = pop().to_bytes(32, "big")[12:]
                 d, s, size = pop(), pop(), pop()
-                use((G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                use(acct_access(addr, spec.g_extcode)
                     + G_COPY_WORD * ((size + 31) // 32))
                 ext = state.code(addr)
                 mem_write(d, ext[s : s + size].ljust(size, b"\x00") if s < len(ext) else b"\x00" * size)
 
             def h_extcodehash():
                 addr = pop().to_bytes(32, "big")[12:]
-                use(G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT)
+                use(acct_access(addr, spec.g_extcodehash))
                 acc = state.account(addr)
                 push(0 if acc is None or acc.is_empty else int.from_bytes(acc.code_hash, "big"))
 
@@ -430,7 +469,12 @@ class Interpreter:
                 use(2); push(self.block.number)
 
             def h_prevrandao():
-                use(2); push(int.from_bytes(self.block.prev_randao, "big"))
+                # 0x44: DIFFICULTY before the merge, PREVRANDAO after
+                use(2)
+                if spec.merge:
+                    push(int.from_bytes(self.block.prev_randao, "big"))
+                else:
+                    push(self.block.difficulty)
 
             def h_gaslimit():
                 use(2); push(self.block.gas_limit)
@@ -480,28 +524,55 @@ class Interpreter:
                 if fr.static:
                     raise Halt()
                 ben = pop().to_bytes(32, "big")[12:]
-                cost = G_SELFDESTRUCT
-                if not state.warm_account(ben):
+                cost = spec.g_selfdestruct
+                if warm_cold and not state.warm_account(ben):
                     cost += G_COLD_ACCOUNT
-                if state.balance(fr.address) and not state.exists(ben):
-                    cost += G_NEW_ACCOUNT
+                if spec.selfdestruct_new_account == "absent":  # EIP-150
+                    if not state.exists(ben):
+                        cost += G_NEW_ACCOUNT
+                elif spec.selfdestruct_new_account == "dead_with_value":  # EIP-161
+                    if state.balance(fr.address) and state.is_empty(ben):
+                        cost += G_NEW_ACCOUNT
                 use(cost)
-                state.selfdestruct(fr.address, ben)
+                first = state.selfdestruct(
+                    fr.address, ben,
+                    same_tx_only=spec.selfdestruct_same_tx_only)
+                if first and spec.r_selfdestruct:  # pre-London refund
+                    state.add_refund(spec.r_selfdestruct)
                 return gas, b""
 
             table = {
                 0x05: h_sdiv, 0x07: h_smod, 0x08: h_addmod, 0x09: h_mulmod,
-                0x0A: h_exp, 0x0B: h_signextend, 0x1A: h_byte, 0x1D: h_sar,
+                0x0A: h_exp, 0x0B: h_signextend, 0x1A: h_byte,
                 0x31: h_balance, 0x32: h_origin, 0x38: h_codesize,
                 0x3A: h_gasprice, 0x3B: h_extcodesize, 0x3C: h_extcodecopy,
-                0x3F: h_extcodehash, 0x40: h_blockhash, 0x41: h_coinbase,
+                0x40: h_blockhash, 0x41: h_coinbase,
                 0x42: h_timestamp, 0x43: h_number, 0x44: h_prevrandao,
-                0x45: h_gaslimit, 0x46: h_chainid, 0x47: h_selfbalance,
-                0x48: h_basefee, 0x49: h_blobhash, 0x4A: h_blobbasefee,
+                0x45: h_gaslimit,
                 0x53: h_mstore8, 0x58: h_pc, 0x59: h_msize,
-                0x5C: h_tload, 0x5D: h_tstore, 0x5E: h_mcopy,
                 0xFF: h_selfdestruct,
             }
+            # fork-gated entries: an absent entry falls through to the
+            # invalid-opcode Halt below, which is exactly the pre-fork
+            # behavior of an unassigned opcode
+            if has_shifts:
+                table[0x1D] = h_sar
+            if spec.has_extcodehash:
+                table[0x3F] = h_extcodehash
+            if spec.has_chainid:
+                table[0x46] = h_chainid
+            if spec.has_selfbalance:
+                table[0x47] = h_selfbalance
+            if spec.has_basefee:
+                table[0x48] = h_basefee
+            if spec.has_blob_opcodes:
+                table[0x49] = h_blobhash
+                table[0x4A] = h_blobbasefee
+            if spec.has_transient:
+                table[0x5C] = h_tload
+                table[0x5D] = h_tstore
+            if spec.has_mcopy:
+                table[0x5E] = h_mcopy
             return table
 
         code_len = len(code)
@@ -513,6 +584,8 @@ class Interpreter:
             # -- hot tier 1: stack manipulation (the most frequent ops) --
             if 0x5F <= op <= 0x7F:  # PUSH0..PUSH32
                 n = op - 0x5F
+                if n == 0 and not has_push0:  # EIP-3855
+                    raise Halt()
                 use(2 if n == 0 else 3)
                 if len(stack) >= 1024:
                     raise Halt()
@@ -580,9 +653,13 @@ class Interpreter:
                 use(3); push(pop() ^ pop())
             elif op == 0x19:  # NOT
                 use(3); push(pop() ^ MASK)
-            elif op == 0x1B:  # SHL
+            elif op == 0x1B:  # SHL (Constantinople)
+                if not has_shifts:
+                    raise Halt()
                 use(3); s, x = pop(), pop(); push((x << s) & MASK if s < 256 else 0)
-            elif op == 0x1C:  # SHR
+            elif op == 0x1C:  # SHR (Constantinople)
+                if not has_shifts:
+                    raise Halt()
                 use(3); s, x = pop(), pop(); push(x >> s if s < 256 else 0)
             elif op == 0x50:  # POP
                 use(2); pop()
@@ -597,42 +674,60 @@ class Interpreter:
                 use(2); push(len(fr.data))
             elif op == 0x54:  # SLOAD
                 slot = pop().to_bytes(32, "big")
-                use(G_WARM_ACCESS if state.warm_slot(fr.address, slot) else G_COLD_SLOAD)
+                if warm_cold:
+                    use(G_WARM_ACCESS if state.warm_slot(fr.address, slot) else G_COLD_SLOAD)
+                else:
+                    use(spec.g_sload)
                 push(state.sload(fr.address, slot))
             elif op == 0x55:  # SSTORE
                 if fr.static:
                     raise Halt()
-                if gas <= G_CALL_STIPEND:
+                if sstore_sentry and gas <= sstore_sentry:  # EIP-2200
                     raise Halt()
                 slot, value = pop().to_bytes(32, "big"), pop()
-                cold_slot = not state.warm_slot(fr.address, slot)
-                current = state.sload(fr.address, slot)
-                original = state.original_storage(fr.address, slot)
-                cost = G_COLD_SLOAD if cold_slot else 0
-                if value == current:
-                    cost += G_WARM_ACCESS
-                elif current == original:
-                    cost += G_SSTORE_SET if original == 0 else G_SSTORE_RESET
+                if not sstore_net:
+                    # legacy metering (Frontier; also Petersburg, which
+                    # reverted EIP-1283): 20000 zero→nonzero, 5000 otherwise
+                    current = state.sload(fr.address, slot)
+                    use(G_SSTORE_SET if current == 0 and value != 0 else 5000)
+                    if current != 0 and value == 0:
+                        state.add_refund(spec.r_sstore_clear)
+                    if value != current:
+                        state.sstore(fr.address, slot, value)
                 else:
-                    cost += G_WARM_ACCESS
-                use(cost)
-                # EIP-3529 refunds
-                if value != current:
-                    if current == original:
-                        if original != 0 and value == 0:
-                            state.add_refund(R_SSTORE_CLEAR)
+                    # net metering: EIP-1283 (load leg 200) / EIP-2200 (800)
+                    # / post-Berlin (warm 100 + cold 2100 surcharge)
+                    g_load = spec.g_sstore_load
+                    reset_cost = G_SSTORE_RESET if warm_cold else 5000
+                    cold_extra = 0
+                    if warm_cold and not state.warm_slot(fr.address, slot):
+                        cold_extra = G_COLD_SLOAD
+                    current = state.sload(fr.address, slot)
+                    original = state.original_storage(fr.address, slot)
+                    if value == current:
+                        cost = cold_extra + g_load
+                    elif current == original:
+                        cost = cold_extra + (G_SSTORE_SET if original == 0 else reset_cost)
                     else:
-                        if original != 0:
-                            if current == 0:
-                                state.add_refund(-R_SSTORE_CLEAR)
-                            elif value == 0:
-                                state.add_refund(R_SSTORE_CLEAR)
-                        if value == original:
-                            if original == 0:
-                                state.add_refund(G_SSTORE_SET - G_WARM_ACCESS)
-                            else:
-                                state.add_refund(G_SSTORE_RESET - G_WARM_ACCESS)
-                    state.sstore(fr.address, slot, value)
+                        cost = cold_extra + g_load
+                    use(cost)
+                    r_clear = spec.r_sstore_clear
+                    if value != current:
+                        if current == original:
+                            if original != 0 and value == 0:
+                                state.add_refund(r_clear)
+                        else:
+                            if original != 0:
+                                if current == 0:
+                                    state.add_refund(-r_clear)
+                                elif value == 0:
+                                    state.add_refund(r_clear)
+                            if value == original:
+                                if original == 0:
+                                    state.add_refund(G_SSTORE_SET - g_load)
+                                else:
+                                    state.add_refund(reset_cost - g_load)
+                        state.sstore(fr.address, slot, value)
             elif op == 0x20:  # KECCAK256
                 off, size = pop(), pop()
                 use(G_KECCAK + G_KECCAK_WORD * ((size + 31) // 32))
@@ -664,9 +759,13 @@ class Interpreter:
                 from ..primitives.types import Log
 
                 state.add_log(Log(fr.address, topics, data))
-            elif op == 0x3D:  # RETURNDATASIZE
+            elif op == 0x3D:  # RETURNDATASIZE (Byzantium)
+                if not has_revert:
+                    raise Halt()
                 use(2); push(len(returndata))
-            elif op == 0x3E:  # RETURNDATACOPY
+            elif op == 0x3E:  # RETURNDATACOPY (Byzantium)
+                if not has_revert:
+                    raise Halt()
                 d, s, size = pop(), pop(), pop()
                 use(3 + G_COPY_WORD * ((size + 31) // 32))
                 if s + size > len(returndata):
@@ -677,34 +776,54 @@ class Interpreter:
             elif op == 0xF3:  # RETURN
                 off, size = pop(), pop()
                 return gas, mem_read(off, size)
-            elif op == 0xFD:  # REVERT
+            elif op == 0xFD:  # REVERT (Byzantium)
+                if not has_revert:
+                    raise Halt()
                 off, size = pop(), pop()
                 r = Revert(mem_read(off, size))
                 r.gas_left = gas
                 raise r
             elif op in (0xF1, 0xF2, 0xF4, 0xFA):  # CALL/CALLCODE/DELEGATECALL/STATICCALL
+                if op == 0xF4 and not spec.has_delegatecall:  # Homestead
+                    raise Halt()
+                if op == 0xFA and not has_revert:  # Byzantium
+                    raise Halt()
                 g = pop()
                 addr = pop().to_bytes(32, "big")[12:]
                 value = pop() if op in (0xF1, 0xF2) else 0
                 ain, ains, aout, aouts = pop(), pop(), pop(), pop()
                 if fr.static and value and op == 0xF1:
                     raise Halt()
-                access = G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT
-                extra = access
+                if warm_cold:
+                    extra = G_WARM_ACCESS if state.warm_account(addr) else G_COLD_ACCOUNT
+                else:
+                    extra = spec.g_call
                 if value:
                     extra += G_CALL_VALUE
-                    if op == 0xF1 and not state.exists(addr):
+                if op == 0xF1:
+                    # new-account surcharge: pre-EIP-161 whenever the target
+                    # is absent; after, only for a value transfer to a dead
+                    # account
+                    if spec.new_account_charge_always:
+                        if not state.exists(addr):
+                            extra += G_NEW_ACCOUNT
+                    elif value and state.is_empty(addr):
                         extra += G_NEW_ACCOUNT
                 use(extra)
-                # EIP-7702: a delegation designator executes the delegate's
-                # code (one level, with the delegate's access cost charged)
-                run_code, tgt = resolve_delegation(state, addr)
-                if tgt is not None:
-                    use(G_WARM_ACCESS if state.warm_account(tgt) else G_COLD_ACCOUNT)
+                if spec.has_setcode:
+                    # EIP-7702: a delegation designator executes the
+                    # delegate's code (one level, delegate access charged)
+                    run_code, tgt = resolve_delegation(state, addr)
+                    if tgt is not None:
+                        use(G_WARM_ACCESS if state.warm_account(tgt) else G_COLD_ACCOUNT)
+                else:
+                    run_code = state.code(addr)
                 data = mem_read(ain, ains)
                 mem_expand(aout, aouts)
-                avail = gas - gas // 64
-                child_gas = min(g, avail)
+                if spec.call_63_64:
+                    child_gas = min(g, gas - gas // 64)
+                else:  # pre-EIP-150: the requested gas, or out-of-gas
+                    child_gas = g
                 use(child_gas)
                 if value:
                     child_gas += G_CALL_STIPEND
@@ -733,17 +852,20 @@ class Interpreter:
                 mem[aout : aout + min(aouts, len(out))] = out[: aouts]
                 push(1 if ok else 0)
             elif op == 0xF0 or op == 0xF5:  # CREATE / CREATE2
+                if op == 0xF5 and not spec.has_create2:  # Constantinople
+                    raise Halt()
                 if fr.static:
                     raise Halt()
                 value = pop(); off = pop(); size = pop()
                 salt = pop().to_bytes(32, "big") if op == 0xF5 else None
                 words = (size + 31) // 32
-                use(G_CREATE + G_INITCODE_WORD * words
+                use(G_CREATE
+                    + (G_INITCODE_WORD * words if spec.initcode_limit else 0)
                     + (G_KECCAK_WORD * words if op == 0xF5 else 0))
-                if size > MAX_INITCODE_SIZE:
+                if spec.initcode_limit and size > MAX_INITCODE_SIZE:
                     raise Halt()
                 initcode = mem_read(off, size)
-                child_gas = gas - gas // 64
+                child_gas = gas - gas // 64 if spec.call_63_64 else gas
                 use(child_gas)
                 ok, gas_left, addr, out = yield (
                     "create",
@@ -850,7 +972,8 @@ def _pre_identity(data: bytes, gas: int):
     return True, gas - cost, data
 
 
-def _pre_modexp(data: bytes, gas: int):
+def _pre_modexp(data: bytes, gas: int, eip2565: bool = True):
+    """0x05 modexp: EIP-2565 pricing (Berlin) or EIP-198 (Byzantium)."""
     data = bytes(data)
     bl = int.from_bytes(data[0:32].ljust(32, b"\x00"), "big")
     el = int.from_bytes(data[32:64].ljust(32, b"\x00"), "big")
@@ -861,11 +984,25 @@ def _pre_modexp(data: bytes, gas: int):
     b_ = int.from_bytes(body[:bl], "big")
     e_ = int.from_bytes(body[bl : bl + el], "big")
     m_ = int.from_bytes(body[bl + el : bl + el + ml], "big")
-    # EIP-2565 pricing
-    words = (max(bl, ml) + 7) // 8
-    mult = words * words
-    iters = max(1, (el - 32) * 8 + (e_.bit_length() - 1 if el <= 32 and e_ else 0)) if el > 32 else max(1, e_.bit_length() - 1 if e_ else 0)
-    cost = max(200, mult * iters // 3)
+    # adjusted exponent length (shared by both pricings): full bit length
+    # for short exponents, else 8*(len-32) + bits of the leading 32 bytes
+    head = int.from_bytes(body[bl : bl + min(32, el)], "big")
+    if el <= 32:
+        adj = head.bit_length() - 1 if head else 0
+    else:
+        adj = 8 * (el - 32) + (head.bit_length() - 1 if head else 0)
+    if eip2565:
+        words = (max(bl, ml) + 7) // 8
+        cost = max(200, words * words * max(1, adj) // 3)
+    else:  # EIP-198
+        x = max(bl, ml)
+        if x <= 64:
+            mult = x * x
+        elif x <= 1024:
+            mult = x * x // 4 + 96 * x - 3072
+        else:
+            mult = x * x // 16 + 480 * x - 199_680
+        cost = mult * max(1, adj) // 20
     if gas < cost:
         return False, 0, b""
     out = pow(b_, e_, m_).to_bytes(ml, "big") if m_ else b"\x00" * ml
@@ -885,11 +1022,11 @@ def _bn_g1_point(data: bytes):
     return (x, y)
 
 
-def _pre_bn_add(data: bytes, gas: int):
-    """0x06 alt_bn128 ADD (EIP-196; 150 gas since EIP-1108)."""
-    if gas < 150:
+def _pre_bn_add(data: bytes, gas: int, price: int = 150):
+    """0x06 alt_bn128 ADD (EIP-196; 500 gas, 150 since EIP-1108)."""
+    if gas < price:
         return False, 0, b""
-    gas -= 150
+    gas -= price
     from ..primitives.pairing import BN254, g1_group
 
     data = data.ljust(128, b"\x00")[:128]
@@ -904,11 +1041,11 @@ def _pre_bn_add(data: bytes, gas: int):
     return True, gas, s[0].to_bytes(32, "big") + s[1].to_bytes(32, "big")
 
 
-def _pre_bn_mul(data: bytes, gas: int):
-    """0x07 alt_bn128 MUL (EIP-196; 6000 gas since EIP-1108)."""
-    if gas < 6000:
+def _pre_bn_mul(data: bytes, gas: int, price: int = 6000):
+    """0x07 alt_bn128 MUL (EIP-196; 40000 gas, 6000 since EIP-1108)."""
+    if gas < price:
         return False, 0, b""
-    gas -= 6000
+    gas -= price
     from ..primitives.pairing import BN254, g1_group
 
     data = data.ljust(96, b"\x00")[:96]
@@ -923,13 +1060,13 @@ def _pre_bn_mul(data: bytes, gas: int):
     return True, gas, s[0].to_bytes(32, "big") + s[1].to_bytes(32, "big")
 
 
-def _pre_bn_pairing(data: bytes, gas: int):
+def _pre_bn_pairing(data: bytes, gas: int, base: int = 45_000, per: int = 34_000):
     """0x08 alt_bn128 pairing check (EIP-197; EIP-1108 gas). G2 Fp2
     coordinates arrive imaginary-part first: [x_c1, x_c0, y_c1, y_c0]."""
     if len(data) % 192 != 0:
         return False, 0, b""
     k = len(data) // 192
-    cost = 45000 + 34000 * k
+    cost = base + per * k
     if gas < cost:
         return False, 0, b""
     gas -= cost
@@ -1003,7 +1140,7 @@ def _pre_point_eval(data: bytes, gas: int):
     return True, gas, out
 
 
-_PRECOMPILES = {
+_RAW_PRECOMPILES = {
     1: _pre_ecrecover,
     2: _pre_sha256,
     3: _pre_ripemd160,
@@ -1035,9 +1172,9 @@ _PRECOMPILE_CACHE_LOCK = _Lock()
 precompile_cache_stats = {"hits": 0, "misses": 0}
 
 
-def _cached_precompile(idx: int, fn):
+def _cached_precompile(idx: int, fn, era: str = ""):
     def run(data, gas: int):
-        key = (idx, bytes(data))
+        key = (idx, era, bytes(data))
         with _PRECOMPILE_CACHE_LOCK:
             hit = _PRECOMPILE_CACHE.get(key)
             if hit is not None:
@@ -1061,11 +1198,40 @@ def _cached_precompile(idx: int, fn):
     return run
 
 
-for _i in _CACHED_INDICES:
-    _PRECOMPILES[_i] = _cached_precompile(_i, _PRECOMPILES[_i])
+# per-era dispatch tables: precompile availability and pricing both vary
+# by fork (reference: revm builds its precompile set per SpecId)
+_ERA_TABLES: dict[tuple, dict] = {}
 
 
-def _precompile(address: bytes):
-    if address[:19] == b"\x00" * 19 and 1 <= address[19] <= 10:
-        return _PRECOMPILES.get(address[19])
+def _era_table(spec) -> dict:
+    key = (min(spec.precompiles, 10), spec.modexp_eip2565, spec.bn_add_gas)
+    table = _ERA_TABLES.get(key)
+    if table is not None:
+        return table
+    import functools
+
+    table = {i: _RAW_PRECOMPILES[i] for i in range(1, key[0] + 1)}
+    if 5 in table and not spec.modexp_eip2565:
+        table[5] = functools.partial(_pre_modexp, eip2565=False)
+    if 6 in table and spec.bn_add_gas != 150:
+        table[6] = functools.partial(_pre_bn_add, price=spec.bn_add_gas)
+        table[7] = functools.partial(_pre_bn_mul, price=spec.bn_mul_gas)
+        table[8] = functools.partial(_pre_bn_pairing, base=spec.bn_pair_base,
+                                     per=spec.bn_pair_per)
+    era = f"{int(spec.modexp_eip2565)}:{spec.bn_add_gas}"
+    for i in _CACHED_INDICES:
+        if i in table:
+            table[i] = _cached_precompile(i, table[i], era)
+    _ERA_TABLES[key] = table
+    return table
+
+
+_PRECOMPILES = _era_table(LATEST_SPEC)  # latest-rules table (tests, tools)
+
+
+def _precompile(address: bytes, spec: Spec | None = None):
+    if spec is None:
+        spec = LATEST_SPEC
+    if address[:19] == b"\x00" * 19 and 1 <= address[19] <= spec.precompiles:
+        return _era_table(spec).get(address[19])
     return None

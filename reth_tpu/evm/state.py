@@ -69,6 +69,10 @@ class EvmState:
         self.warm_slots: set[tuple[bytes, bytes]] = set()
         self._selfdestructs: set[bytes] = set()
         self._created: set[bytes] = set()
+        # destruct bookkeeping: accounts marked by SELFDESTRUCT this tx
+        # (refund-once tracking) and those scheduled for end-of-tx deletion
+        self._destruct_marks: set[bytes] = set()
+        self._pending_destructs: set[bytes] = set()
         self._tx_original: dict[tuple[bytes, bytes], int] = {}
         # block-level changeset capture
         self.changes = BlockChanges()
@@ -176,33 +180,55 @@ class EvmState:
             self.changes.new_bytecodes[code_hash] = code
         self._set_account(address, self.account_or_empty(address).with_(code_hash=code_hash))
 
-    def create_account(self, address: bytes):
-        """Mark an account created by CREATE/CREATE2 (storage resets)."""
+    def create_account(self, address: bytes, nonce: int = 1):
+        """Mark an account created by CREATE/CREATE2 (storage resets).
+        EIP-161 starts contracts at nonce 1; pre-Spurious forks pass 0."""
         self._capture_account_change(address)
         self._journal.append(("create", address, self._accounts.get(address, self.source.account(address)), address in self._created))
         self._created.add(address)
         prev = self.account(address)
         balance = prev.balance if prev else 0
-        self._accounts[address] = Account(nonce=1, balance=balance)
+        self._accounts[address] = Account(nonce=nonce, balance=balance)
         self._storage[address] = {}
 
-    def selfdestruct(self, address: bytes, beneficiary: bytes):
+    def selfdestruct(self, address: bytes, beneficiary: bytes,
+                     same_tx_only: bool = True) -> bool:
+        """SELFDESTRUCT. With ``same_tx_only`` (EIP-6780, Cancun) a
+        pre-existing account is NOT destroyed — pure balance move; before
+        Cancun every destruct deletes the account. Deletion itself happens
+        at END of transaction (``process_destructs``): until then the code
+        keeps executing if called again, exactly per spec. Returns True on
+        the first mark of ``address`` this tx (pre-London refund-once)."""
         bal = self.balance(address)
-        self._journal.append(("selfdestruct", address, self._accounts.get(address), dict(self._storage.get(address, {})), address in self._selfdestructs))
-        self._capture_account_change(address)
-        if address in self._created:
-            # EIP-6780: destroys only if created in the same tx; balance to
-            # the beneficiary, BURNED when the beneficiary is itself
+        first = address not in self._destruct_marks
+        if first:
+            self._journal.append(("destruct_mark", address))
+            self._destruct_marks.add(address)
+        destroys = (address in self._created) or not same_tx_only
+        if not destroys:
+            # EIP-6780 with a pre-existing account: balance move only
+            # (self-beneficiary is a no-op)
+            self.set_balance(address, 0)
+            self.add_balance(beneficiary, bal)
+            return first
+        if address not in self._pending_destructs:
+            self._journal.append(("destruct_pending", address))
+            self._pending_destructs.add(address)
+        if beneficiary != address:
+            self.set_balance(address, 0)
+            self.add_balance(beneficiary, bal)
+        # beneficiary == address: balance stays and burns with the deletion
+        return first
+
+    def process_destructs(self):
+        """End-of-tx deletion of selfdestructed accounts (+ storage wipe)."""
+        for address in self._pending_destructs:
+            self._capture_account_change(address)
             self._accounts[address] = None
             self._storage[address] = {}
             self._selfdestructs.add(address)
             self.changes.wiped_storage.add(address)
-            if beneficiary != address:
-                self.add_balance(beneficiary, bal)
-        else:
-            # not destroyed: pure balance move; self-beneficiary is a no-op
-            self.set_balance(address, 0)
-            self.add_balance(beneficiary, bal)
+        self._pending_destructs = set()
 
     # -- logs / journal ------------------------------------------------------
 
@@ -264,6 +290,10 @@ class EvmState:
                 self.warm_accounts.discard(entry[1])
             elif kind == "warm_slot":
                 self.warm_slots.discard(entry[1])
+            elif kind == "destruct_mark":
+                self._destruct_marks.discard(entry[1])
+            elif kind == "destruct_pending":
+                self._pending_destructs.discard(entry[1])
 
     def take_logs(self) -> list[Log]:
         logs = self._logs
@@ -271,11 +301,16 @@ class EvmState:
         return logs
 
     def begin_tx(self):
-        """Per-transaction resets (EIP-2929 warm sets, refund counter)."""
+        """Per-transaction resets (EIP-2929 warm sets, refund counter).
+        Finalizes the previous tx's pending destructs first, so a caller
+        that skips the explicit ``process_destructs`` cannot lose them."""
+        self.process_destructs()
         self.warm_accounts = set()
         self.warm_slots = set()
         self.refund = 0
         self._created = set()
+        self._destruct_marks = set()
+        self._pending_destructs = set()
         self._tx_original = {}
         self._journal.clear()
 
@@ -292,6 +327,7 @@ class EvmState:
 
     def final_state(self) -> tuple[dict[bytes, Account | None], dict[bytes, dict[bytes, int]]]:
         """Post-block accounts and storage values for everything touched."""
+        self.process_destructs()
         accounts = {a: self._accounts.get(a) for a in self.changes.accounts}
         storage: dict[bytes, dict[bytes, int]] = {}
         for addr, slots in self.changes.storage.items():

@@ -113,10 +113,13 @@ class Node:
                 from ..chainspec import ChainSpec
 
                 config.chain_spec = ChainSpec.from_json(raw.decode())
-        self.consensus = EthBeaconConsensus(self.committer)
+        exec_spec = (config.chain_spec.execution_spec
+                     if config.chain_spec is not None else None)
+        self.consensus = EthBeaconConsensus(self.committer,
+                                            chainspec=exec_spec)
         self.tree = EngineTree(
             self.factory, self.committer, self.consensus,
-            EvmConfig(chain_id=config.chain_id),
+            EvmConfig(chain_id=config.chain_id, chainspec=exec_spec),
             persistence_threshold=config.persistence_threshold,
         )
         from ..pool.pool import PoolConfig
@@ -146,9 +149,11 @@ class Node:
                 tip = chain[-1].block.header
                 next_blob_fee = None
                 if tip.excess_blob_gas is not None:
+                    params = self.tree.config.blob_params_for(
+                        tip.number + 1, tip.timestamp)
                     next_blob_fee = blob_base_fee(next_excess_blob_gas(
-                        tip.excess_blob_gas, tip.blob_gas_used or 0
-                    ))
+                        tip.excess_blob_gas, tip.blob_gas_used or 0,
+                        params.target_gas), params.update_fraction)
                 self.pool.on_canonical_state_change(
                     calc_next_base_fee(tip), blob_base_fee=next_blob_fee
                 )

@@ -49,16 +49,18 @@ def build_payload(
     """Assemble a sealed block on top of ``parent_hash``; returns
     (block, total priority fees). ``pool=None`` builds the empty-payload
     fallback (reference BasicPayloadJob's pre-built empty payload)."""
-    from ..evm.executor import MAX_BLOB_GAS_PER_BLOCK, blob_base_fee, next_excess_blob_gas
+    from ..evm.executor import blob_base_fee, next_excess_blob_gas
 
     overlay = tree.overlay_provider(parent_hash)
     parent_num = overlay.block_number(parent_hash)
     parent = overlay.header_by_number(parent_num)
     base_fee = calc_next_base_fee(parent)
+    blob_params = tree.config.blob_params_for(parent.number + 1, attrs.timestamp)
     # EIP-4844: blob fields continue once the parent carries them
     cancun = parent.excess_blob_gas is not None
     excess_blob = (
-        next_excess_blob_gas(parent.excess_blob_gas, parent.blob_gas_used or 0)
+        next_excess_blob_gas(parent.excess_blob_gas, parent.blob_gas_used or 0,
+                             blob_params.target_gas)
         if cancun else 0
     )
     # gas target moves toward the miner's ceiling by at most 1/1024 per
@@ -76,7 +78,7 @@ def build_payload(
         base_fee=base_fee,
         prev_randao=attrs.prev_randao,
         chain_id=tree.config.chain_id,
-        blob_base_fee=blob_base_fee(excess_blob),
+        blob_base_fee=blob_base_fee(excess_blob, blob_params.update_fraction),
     )
     executor = BlockExecutor(ProviderStateSource(overlay), tree.config)
     state = EvmState(executor.source)
@@ -91,7 +93,7 @@ def build_payload(
         if cumulative_gas + tx.gas_limit > env.gas_limit:
             continue
         if tx.blob_gas() and (
-            not cancun or blob_gas_used + tx.blob_gas() > MAX_BLOB_GAS_PER_BLOCK
+            not cancun or blob_gas_used + tx.blob_gas() > blob_params.max_gas
         ):
             continue
         try:

@@ -429,12 +429,17 @@ def logs_bloom(logs: list[Log]) -> bytes:
 
 @dataclass(frozen=True)
 class Receipt:
-    """Transaction receipt (reference: reth `Receipt`)."""
+    """Transaction receipt (reference: reth `Receipt`).
+
+    ``state_root`` is the pre-Byzantium form: receipts embedded the
+    post-transaction state root until EIP-658 replaced it with the
+    success status."""
 
     tx_type: int = LEGACY_TX_TYPE
     success: bool = True
     cumulative_gas_used: int = 0
     logs: tuple[Log, ...] = ()
+    state_root: bytes | None = None
 
     def bloom(self) -> bytes:
         return logs_bloom(list(self.logs))
@@ -442,7 +447,8 @@ class Receipt:
     def encode_2718(self) -> bytes:
         """EIP-2718 encoding as placed in the receipts trie."""
         payload = rlp_encode([
-            encode_int(1 if self.success else 0),
+            (self.state_root if self.state_root is not None
+             else encode_int(1 if self.success else 0)),
             encode_int(self.cumulative_gas_used),
             self.bloom(),
             [log.rlp_fields() for log in self.logs],

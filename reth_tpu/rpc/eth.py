@@ -424,7 +424,9 @@ class EthApi:
         p = self._provider()
         n = self._resolve_number(tag, p)
         header = p.header_by_number(min(n, p.last_block_number()))
-        return qty(blob_base_fee(header.excess_blob_gas or 0))
+        params = self.tree.config.blob_params_for(header.number, header.timestamp)
+        return qty(blob_base_fee(header.excess_blob_gas or 0,
+                                 params.update_fraction))
 
     def eth_createAccessList(self, call, tag="latest"):
         """EIP-2930 access-list generation: run the call and report every
@@ -477,88 +479,323 @@ class EthApi:
                 "error": None if ok else "execution failed"}
 
     def eth_simulateV1(self, payload, tag="latest"):
-        """Simulate batches of calls on top of the requested state with
-        state/block overrides (reference eth_simulateV1,
-        rpc-eth-api/src/core.rs:245 — the multi-block simulation API).
-        Supported subset: blockStateCalls[].calls with from/to/data/value/
-        gas, stateOverrides (balance/nonce/code/state), blockOverrides
-        (number/time/baseFeePerGas/coinbase/gasLimit); state carries over
-        across calls and across block entries."""
-        from ..primitives.types import Account
+        """Simulate chains of call-blocks on top of the requested state
+        (reference eth_simulateV1, rpc-eth-api/src/core.rs:245 +
+        rpc-eth-types/src/simulate.rs). Each entry seals a REAL block:
+        calls become typed transactions executed through the block
+        executor under the active fork's rules (system calls included),
+        and the result is a full RPC block — receiptsRoot/logsBloom/
+        gasUsed always, stateRoot recomputed by the trie pipeline when
+        the base is the canonical tip (elsewhere it is zero, like the
+        reference's optional root computation). ``validation`` enforces
+        nonce/fee rules; without it nonces auto-fill, base fee is zero
+        and EIP-3607 is off (reference disables the same CfgEnv checks).
+        Gaps in `blockOverrides.number` are filled with empty blocks per
+        the execution-apis spec. ``returnFullTransactions`` switches the
+        block's tx list from hashes to objects."""
+        import hashlib
+        from dataclasses import replace as _dc_replace
 
-        p = self._state_at(tag)
-        base_env = self._call_env(tag)
-        state = EvmState(ProviderStateSource(p))
+        from ..consensus.validation import calc_next_base_fee
+        from ..evm import BlockExecutor, EvmConfig
+        from ..evm.executor import InvalidTransaction
+        from ..primitives.types import (
+            Account, Block, EMPTY_ROOT_HASH, Header, Transaction, logs_bloom,
+        )
+        from ..stages.execution import write_execution_output
+        from ..trie.state_root import ordered_trie_root
+        from .convert import block_to_rpc
+
+        entries = payload.get("blockStateCalls") or []
+        if not entries:
+            raise RpcError(-32602, "calls are empty")
+        if len(entries) > 256:
+            raise RpcError(-32602, "too many blocks")
+        validation = bool(payload.get("validation"))
+        full_txs = bool(payload.get("returnFullTransactions"))
+
+        p0 = self._provider()
+        base_n = self._resolve_number(tag, p0)
+        compute_roots = base_n == p0.last_block_number()
+        # a dedicated overlay accumulates the simulated chain's writes so
+        # the incremental committer can root every simulated block
+        overlay = self._provider() if compute_roots else None
+        parent = p0.header_by_number(base_n)
+
+        from ..evm.state import StateSource
+
+        # execution state: post-state folded over the base view per block
+        class _Folded(StateSource):
+            def __init__(self, base):
+                self.base = base
+                self.accounts: dict = {}
+                self.storages: dict = {}
+                self.codes: dict = {}
+                self.wiped: set = set()
+
+            def account(self, address):
+                if address in self.accounts:
+                    return self.accounts[address]
+                return self.base.account(address)
+
+            def storage(self, address, slot):
+                per = self.storages.get(address)
+                if per is not None and slot in per:
+                    return per[slot]
+                if address in self.wiped:
+                    return 0
+                return self.base.storage(address, slot)
+
+            def bytecode(self, code_hash):
+                return self.codes.get(code_hash) or self.base.bytecode(code_hash)
+
+            def fold(self, out):
+                for addr, acc in out.post_accounts.items():
+                    self.accounts[addr] = acc
+                for addr in out.changes.wiped_storage:
+                    self.wiped.add(addr)
+                    self.storages[addr] = {}
+                for addr, slots in out.post_storage.items():
+                    self.storages.setdefault(addr, {}).update(slots)
+                self.codes.update(out.changes.new_bytecodes)
+
+        folded = _Folded(ProviderStateSource(self._state_at(tag)))
+        cfg = _dc_replace(self.tree.config, disable_eip3607=True,
+                          disable_nonce_check=not validation)
+
+        # BLOCKHASH window: canonical hashes below the base + simulated
+        # blocks as they seal
+        sim_hashes: dict[int, bytes] = {}
+        for h in range(max(0, base_n - 256), base_n + 1):
+            bh = p0.canonical_hash(h)
+            if bh:
+                sim_hashes[h] = bh
+
         out_blocks = []
-        prev_number = base_env.number
-        prev_time = base_env.timestamp
-        for entry in payload.get("blockStateCalls", []):
-            env = BlockEnv(
-                number=prev_number + 1, timestamp=prev_time + 12,
-                coinbase=base_env.coinbase, gas_limit=base_env.gas_limit,
-                base_fee=base_env.base_fee, prev_randao=base_env.prev_randao,
-                chain_id=self.chain_id,
-            )
+
+        def _simulate_block(entry):
+            nonlocal parent
+            env_number = parent.number + 1
+            env_time = parent.timestamp + 12
+            coinbase = b"\x00" * 20
+            gas_limit = parent.gas_limit
+            base_fee = None  # None = per-parent (validation) or 0
             for k, v in (entry.get("blockOverrides") or {}).items():
                 if k == "number":
-                    env.number = parse_qty(v)
+                    env_number = parse_qty(v)
                 elif k == "time":
-                    env.timestamp = parse_qty(v)
+                    env_time = parse_qty(v)
                 elif k == "baseFeePerGas":
-                    env.base_fee = parse_qty(v)
-                elif k == "feeRecipient" or k == "coinbase":
-                    env.coinbase = parse_data(v)
+                    base_fee = parse_qty(v)
+                elif k in ("feeRecipient", "coinbase"):
+                    coinbase = parse_data(v)
                 elif k == "gasLimit":
-                    env.gas_limit = parse_qty(v)
-            prev_number, prev_time = env.number, env.timestamp
+                    gas_limit = parse_qty(v)
+                elif k == "prevRandao":
+                    pass  # header mix hash stays zero (spec default)
+            if env_number <= parent.number:
+                raise RpcError(-32602, f"block number {env_number} not "
+                                       f"after parent {parent.number}")
+            if env_time <= parent.timestamp:
+                env_time = parent.timestamp + 1
+            # gap filling: empty blocks up to env_number-1 (spec note).
+            # Timestamps must stay strictly increasing THROUGH the gap.
+            gaps = env_number - parent.number - 1
+            if env_time - parent.timestamp <= gaps:
+                raise RpcError(-32602, "timestamps not strictly increasing "
+                                       "across the gap-filled blocks")
+            while parent.number + 1 < env_number:
+                _seal({}, parent.number + 1,
+                      min(parent.timestamp + 12,
+                          env_time - (env_number - parent.number - 1)),
+                      coinbase, gas_limit, None)
+            _seal(entry, env_number, env_time, coinbase, gas_limit, base_fee)
+
+        def _seal(entry, number, timestamp, coinbase, gas_limit, base_fee):
+            nonlocal parent
+            if base_fee is None:
+                base_fee = calc_next_base_fee(parent) if validation else 0
             for addr_hex, ov in (entry.get("stateOverrides") or {}).items():
                 addr = parse_data(addr_hex)
+                acc = folded.account(addr) or Account()
                 if "balance" in ov:
-                    state.set_balance(addr, parse_qty(ov["balance"]))
+                    acc = acc.with_(balance=parse_qty(ov["balance"]))
                 if "nonce" in ov:
-                    acct = state.account(addr) or Account()
-                    state._accounts[addr] = acct.with_(nonce=parse_qty(ov["nonce"]))
+                    acc = acc.with_(nonce=parse_qty(ov["nonce"]))
                 if "code" in ov:
-                    state.set_code(addr, parse_data(ov["code"]))
+                    code = parse_data(ov["code"])
+                    from ..primitives.keccak import keccak256 as _k
+
+                    ch = _k(code) if code else _k(b"")
+                    folded.codes[ch] = code
+                    acc = acc.with_(code_hash=ch)
+                folded.accounts[addr] = acc
                 if "state" in ov or "stateDiff" in ov:
+                    if "state" in ov:  # full replacement wipes the rest
+                        folded.wiped.add(addr)
+                        folded.storages[addr] = {}
+                    per = folded.storages.setdefault(addr, {})
                     for slot_hex, val in (ov.get("state") or ov.get("stateDiff")).items():
-                        state.sstore(addr, parse_data(slot_hex).rjust(32, b"\x00"),
-                                     parse_qty(val))
-            calls_out = []
-            for call in entry.get("calls", []):
+                        per[parse_data(slot_hex).rjust(32, b"\x00")] = parse_qty(val)
+            blob_kw = {}
+            if parent.excess_blob_gas is not None:
+                params = self.tree.config.blob_params_for(number, timestamp)
+                from ..evm.executor import next_excess_blob_gas
+
+                blob_kw = dict(
+                    blob_gas_used=0,
+                    excess_blob_gas=next_excess_blob_gas(
+                        parent.excess_blob_gas, parent.blob_gas_used or 0,
+                        params.target_gas))
+            if parent.parent_beacon_block_root is not None:
+                blob_kw["parent_beacon_block_root"] = b"\x00" * 32
+            draft = Header(
+                parent_hash=parent.hash, beneficiary=coinbase, number=number,
+                gas_limit=gas_limit, timestamp=timestamp,
+                base_fee_per_gas=base_fee,
+                withdrawals_root=(EMPTY_ROOT_HASH
+                                  if parent.withdrawals_root is not None
+                                  else None),
+                requests_hash=parent.requests_hash and hashlib.sha256().digest(),
+                **blob_kw,
+            )
+            # sequential per-call execution so a call without an explicit
+            # gas defaults to the block gas REMAINING after earlier calls
+            # (geth's simulate semantics); system calls run like any block
+            from ..evm.executor import (
+                BEACON_ROOTS_ADDRESS, BlockExecutionOutput,
+                HISTORY_STORAGE_ADDRESS, blob_base_fee as _bbf,
+            )
+            from ..primitives.types import Receipt
+
+            executor = BlockExecutor(folded, cfg)
+            spec = cfg.spec_for(number, timestamp)
+            env = BlockEnv(
+                number=number, timestamp=timestamp, coinbase=coinbase,
+                gas_limit=gas_limit, base_fee=base_fee,
+                chain_id=self.chain_id, block_hashes=dict(sim_hashes),
+                blob_base_fee=_bbf(blob_kw.get("excess_blob_gas") or 0,
+                                   spec.blob.update_fraction if spec.blob
+                                   else 3_338_477),
+            )
+            state = EvmState(folded)
+            if spec.beacon_root_call and draft.parent_beacon_block_root is not None:
+                executor._system_call(state, env, spec, BEACON_ROOTS_ADDRESS,
+                                      draft.parent_beacon_block_root)
+            if spec.history_contract_call and number > 0:
+                executor._system_call(state, env, spec,
+                                      HISTORY_STORAGE_ADDRESS, parent.hash)
+            txs, senders, receipts, outputs = [], [], [], []
+            cumulative = 0
+            for call in entry.get("calls", ()):
                 sender = parse_data(call.get("from", "0x" + "00" * 20))
-                interp = Interpreter(state, env, TxEnv(origin=sender))
-                state.begin_tx()  # per-call warm-set/refund reset, like
-                # a real transaction boundary (EIP-2929 gas accounting)
-                frame = self._build_call_frame(call, state, env)
-                n_logs = len(state._logs)
+                gas = (parse_qty(call["gas"]) if "gas" in call
+                       else gas_limit - cumulative)
+                max_fee = parse_qty(call.get("maxFeePerGas",
+                                             call.get("gasPrice", qty(base_fee))))
+                common = dict(
+                    nonce=(parse_qty(call["nonce"]) if "nonce" in call
+                           else state.nonce(sender)),
+                    gas_limit=gas,
+                    to=parse_data(call["to"]) if call.get("to") else None,
+                    value=parse_qty(call.get("value", "0x0")),
+                    data=parse_data(call.get("data", call.get("input", "0x"))),
+                )
+                if spec.max_tx_type >= 2:
+                    tx = Transaction(
+                        tx_type=2, chain_id=self.chain_id,
+                        max_fee_per_gas=max_fee,
+                        max_priority_fee_per_gas=parse_qty(
+                            call.get("maxPriorityFeePerGas", "0x0")),
+                        **common)
+                else:  # pre-London spec at the simulated height: legacy tx
+                    tx = Transaction(
+                        tx_type=0,
+                        chain_id=self.chain_id if spec.eip155 else None,
+                        gas_price=max_fee, **common)
                 try:
-                    ok, gas_left, out = interp.call(frame)
-                    err = None
-                except Revert as r:
-                    ok, gas_left, out = False, 0, r.output
-                    err = {"code": 3, "message": "execution reverted"}
-                logs = [
-                    {"address": data(lg.address),
-                     "topics": [data(t) for t in lg.topics],
-                     "data": data(lg.data)}
-                    for lg in state._logs[n_logs:]
-                ]
+                    result = executor._execute_tx(
+                        state, env, tx, sender, gas_limit - cumulative,
+                        spec=spec)
+                except InvalidTransaction as e:
+                    raise RpcError(-38014,
+                                   f"invalid transaction in simulation: {e}")
+                cumulative += result.gas_used
+                receipts.append(Receipt(
+                    tx_type=tx.tx_type, success=result.success,
+                    cumulative_gas_used=cumulative,
+                    logs=tuple(result.receipt.logs)))
+                outputs.append(result.output)
+                txs.append(tx)
+                senders.append(sender)
+            post_accounts, post_storage = state.final_state()
+            out = BlockExecutionOutput(
+                receipts=receipts, gas_used=cumulative, changes=state.changes,
+                post_accounts=post_accounts, post_storage=post_storage,
+                senders=senders, tx_outputs=outputs)
+            folded.fold(out)
+            header = Header(**{
+                **draft.__dict__,
+                "state_root": b"\x00" * 32,
+                "transactions_root": ordered_trie_root(
+                    [tx.encode() for tx in txs], self.tree.committer),
+                "receipts_root": ordered_trie_root(
+                    [r.encode_2718() for r in out.receipts], self.tree.committer),
+                "logs_bloom": logs_bloom(
+                    [lg for r in out.receipts for lg in r.logs]),
+                "gas_used": out.gas_used,
+            })
+            if overlay is not None:
+                # root the simulated block through the real trie pipeline
+                overlay.insert_header(header)
+                overlay.insert_block_body(Block(
+                    header, tuple(txs), (),
+                    () if header.withdrawals_root is not None else None))
+                idx = overlay.block_body_indices(number)
+                for i, s in enumerate(senders):
+                    overlay.put_sender(idx.first_tx_num + i, s)
+                write_execution_output(overlay, number, idx.first_tx_num, out)
+                root = self.tree._state_root_job(overlay, out)
+                header = Header(**{**header.__dict__, "state_root": root})
+            sealed = Block(header, tuple(txs), (),
+                           () if header.withdrawals_root is not None else None)
+            calls_out = []
+            log_index = 0
+            cumulative_prev = 0
+            for i, (receipt, ret) in enumerate(zip(out.receipts, out.tx_outputs)):
+                logs = []
+                for lg in receipt.logs:
+                    logs.append({
+                        "address": data(lg.address),
+                        "topics": [data(t) for t in lg.topics],
+                        "data": data(lg.data),
+                        "blockNumber": qty(number),
+                        "blockHash": data(sealed.hash),
+                        "transactionHash": data(txs[i].hash),
+                        "transactionIndex": qty(i),
+                        "logIndex": qty(log_index),
+                        "removed": False,
+                    })
+                    log_index += 1
                 entry_out = {
-                    "status": qty(1 if ok else 0),
-                    "returnData": data(out),
-                    "gasUsed": qty(frame.gas - gas_left),
+                    "status": qty(1 if receipt.success else 0),
+                    "returnData": data(ret),
+                    "gasUsed": qty(receipt.cumulative_gas_used - cumulative_prev),
                     "logs": logs,
                 }
-                if err is not None:
-                    entry_out["error"] = err
+                cumulative_prev = receipt.cumulative_gas_used
+                if not receipt.success:
+                    entry_out["error"] = {"code": -32000 if not ret else 3,
+                                          "message": ("execution reverted"
+                                                      if ret else "vm error")}
                 calls_out.append(entry_out)
-            out_blocks.append({
-                "number": qty(env.number),
-                "timestamp": qty(env.timestamp),
-                "baseFeePerGas": qty(env.base_fee),
-                "calls": calls_out,
-            })
+            out_blocks.append({**block_to_rpc(sealed, full_txs, senders),
+                               "calls": calls_out})
+            sim_hashes[number] = sealed.hash
+            parent = header
+
+        for entry in entries:
+            _simulate_block(entry)
         return out_blocks
 
     # -- logs --------------------------------------------------------------------
@@ -606,7 +843,7 @@ class EthApi:
 
 def _topics_match(log_topics, want) -> bool:
     for i, t in enumerate(want):
-        if t is None:
+        if t is None or t == []:  # null and [] are both wildcards
             continue
         if i >= len(log_topics):
             return False

@@ -17,13 +17,14 @@ from .finish import FinishStage
 from .headers_bodies import BodiesStage, HeadersStage, online_stages
 
 
-def default_stages(committer=None, consensus=None) -> list[Stage]:
+def default_stages(committer=None, consensus=None, evm_config=None) -> list[Stage]:
     """Offline stage set (headers/bodies come from import; reference
     `OfflineStages`, stages/src/sets.rs:302; MerkleUnwind placement per
-    id.rs:46-58 so unwind order is correct)."""
+    id.rs:46-58 so unwind order is correct). ``evm_config`` carries the
+    chainspec so historical blocks execute under their own fork rules."""
     return [
         SenderRecoveryStage(),
-        ExecutionStage(consensus=consensus),
+        ExecutionStage(config=evm_config, consensus=consensus),
         MerkleUnwindStage(committer=committer),
         AccountHashingStage(committer=committer),
         StorageHashingStage(committer=committer),

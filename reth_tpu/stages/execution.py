@@ -44,10 +44,13 @@ class ExecutionStage(Stage):
                     bh = provider.canonical_hash(h)
                     if bh:
                         block_hashes_cache[h] = bh
-            out = executor.execute(block, senders, block_hashes_cache)
+            try:
+                out = executor.execute(block, senders, block_hashes_cache)
+            except Exception as e:
+                raise StageError(f"execution failed at {n}: {e}", block=n)
             try:
                 self.consensus.validate_block_post_execution(
-                    block, out.receipts, out.gas_used
+                    block, out.receipts, out.gas_used, requests=out.requests
                 )
             except Exception as e:
                 raise StageError(f"post-execution validation failed at {n}: {e}", block=n)

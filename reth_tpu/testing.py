@@ -28,6 +28,11 @@ from .primitives.types import (
 from .trie import TrieCommitter, state_root
 from .trie.state_root import ordered_trie_root
 
+# EIP-7685: sha256 of zero request payloads (Prague empty-requests hash)
+import hashlib as _hashlib
+
+_EMPTY_REQUESTS_HASH = _hashlib.sha256().digest()
+
 
 @dataclass
 class Wallet:
@@ -96,8 +101,23 @@ class ChainBuilder:
         committer: TrieCommitter | None = None,
         genesis_gas_limit: int = 30_000_000,
         cancun: bool = False,
+        network: str | None = None,
     ):
+        """``network`` pins an ef-tests fork label (e.g. "Paris",
+        "Shanghai", "Prague"): blocks execute under exactly that rule set
+        and headers carry exactly that fork's fields. Without it, the
+        legacy dev shape applies (latest rules, Shanghai-style headers,
+        ``cancun=True`` opting into blob fields)."""
         self.chain_id = chain_id
+        self.network = network
+        if network is not None:
+            from .chainspec import NETWORK_TO_FORK
+            from .evm.spec import spec_for_fork
+
+            self.spec = spec_for_fork(NETWORK_TO_FORK[network])
+            cancun = self.spec.blob is not None
+        else:
+            self.spec = None
         self.cancun = cancun  # blob-gas header fields (EIP-4844)
         self.committer = committer or TrieCommitter()
         self.accounts: dict[bytes, Account] = dict(genesis_alloc or {})
@@ -110,15 +130,22 @@ class ChainBuilder:
         self.storage_at_genesis = {a: dict(s) for a, s in self.storages.items()}
         self.codes_at_genesis = dict(self.codes)
         root, _ = state_root(self.accounts, self.storages, committer=self.committer)
+        s = self.spec
         self.genesis = Header(
             number=0,
             state_root=root,
             gas_limit=genesis_gas_limit,
             timestamp=0,
-            base_fee_per_gas=10**9,
-            withdrawals_root=EMPTY_ROOT_HASH,
+            base_fee_per_gas=10**9 if s is None or s.has_basefee else None,
+            withdrawals_root=(EMPTY_ROOT_HASH
+                              if s is None or s.has_withdrawals else None),
             blob_gas_used=0 if cancun else None,
             excess_blob_gas=0 if cancun else None,
+            parent_beacon_block_root=(b"\x00" * 32
+                                      if s is not None and s.beacon_root_call
+                                      else None),
+            requests_hash=(_EMPTY_REQUESTS_HASH
+                           if s is not None and s.has_requests else None),
         )
         self.blocks: list[Block] = [Block(self.genesis, (), (), ())]
         self.block_hashes: dict[int, bytes] = {0: self.genesis.hash}
@@ -138,17 +165,23 @@ class ChainBuilder:
         timestamp: int | None = None,
     ) -> Block:
         parent = self.tip
-        base_fee = calc_next_base_fee(parent)
+        s = self.spec
+        base_fee = (calc_next_base_fee(parent)
+                    if s is None or s.has_basefee else None)
         blob_kw = {}
         if self.cancun:
             from .evm.executor import next_excess_blob_gas
 
+            target = s.blob.target_gas if s is not None and s.blob else None
             blob_kw = dict(
                 blob_gas_used=sum(tx.blob_gas() for tx in txs),
-                excess_blob_gas=next_excess_blob_gas(
-                    parent.excess_blob_gas or 0, parent.blob_gas_used or 0
-                ),
+                excess_blob_gas=(next_excess_blob_gas(
+                    parent.excess_blob_gas or 0, parent.blob_gas_used or 0,
+                    target) if target is not None else next_excess_blob_gas(
+                    parent.excess_blob_gas or 0, parent.blob_gas_used or 0)),
             )
+            if s is not None and s.beacon_root_call:
+                blob_kw["parent_beacon_block_root"] = b"\x00" * 32
         draft = Header(
             parent_hash=parent.hash,
             beneficiary=coinbase,
@@ -158,8 +191,13 @@ class ChainBuilder:
             base_fee_per_gas=base_fee,
             **blob_kw,
         )
-        block = Block(draft, tuple(txs), (), tuple(withdrawals))
-        executor = BlockExecutor(self.state_source(), EvmConfig(chain_id=self.chain_id))
+        body_withdrawals = (tuple(withdrawals)
+                            if s is None or s.has_withdrawals else None)
+        block = Block(draft, tuple(txs), (), body_withdrawals)
+        executor = BlockExecutor(
+            self.state_source(),
+            EvmConfig(chain_id=self.chain_id, spec=s) if s is not None
+            else EvmConfig(chain_id=self.chain_id))
         out = executor.execute(block, block_hashes=self.block_hashes)
 
         # apply post-state to the in-memory world
@@ -182,6 +220,15 @@ class ChainBuilder:
         self.codes.update(out.changes.new_bytecodes)
 
         root, _ = state_root(self.accounts, self.storages, committer=self.committer)
+        extra_kw = {}
+        if s is None or s.has_withdrawals:
+            extra_kw["withdrawals_root"] = ordered_trie_root(
+                [rlp_encode(w.rlp_fields()) for w in withdrawals], self.committer)
+        if s is not None and s.has_requests:
+            acc = _hashlib.sha256()
+            for r in out.requests:
+                acc.update(_hashlib.sha256(r).digest())
+            extra_kw["requests_hash"] = acc.digest()
         header = Header(
             **{
                 **draft.__dict__,
@@ -194,12 +241,10 @@ class ChainBuilder:
                 ),
                 "logs_bloom": logs_bloom([l for r in out.receipts for l in r.logs]),
                 "gas_used": out.gas_used,
-                "withdrawals_root": ordered_trie_root(
-                    [rlp_encode(w.rlp_fields()) for w in withdrawals], self.committer
-                ),
+                **extra_kw,
             }
         )
-        sealed = Block(header, tuple(txs), (), tuple(withdrawals))
+        sealed = Block(header, tuple(txs), (), body_withdrawals)
         self.blocks.append(sealed)
         self.block_hashes[header.number] = header.hash
         return sealed

@@ -47,13 +47,16 @@ def storage_root(slots: dict[bytes, int], committer: TrieCommitter | None = None
     return committer.commit(leaves, collect_branches=False).root
 
 
-def account_leaf(hashed_addr: bytes, acc: Account) -> tuple[Nibbles, bytes] | None:
+def account_leaf(hashed_addr: bytes, acc: Account,
+                 include_empty: bool = False) -> tuple[Nibbles, bytes] | None:
     """Account-trie leaf for a hashed address, or None if excluded (EIP-161).
 
     The single home of the emptiness-exclusion rule — every caller (full
     rebuild, incremental, tests) must route through this.
+    ``include_empty`` keeps empty accounts (pre-Spurious-Dragon tries
+    carry them; the hive chain's homestead segment proves it).
     """
-    if acc.is_empty and acc.storage_root == EMPTY_ROOT_HASH:
+    if not include_empty and acc.is_empty and acc.storage_root == EMPTY_ROOT_HASH:
         return None
     return (unpack_nibbles(hashed_addr), acc.trie_encode())
 
@@ -74,12 +77,15 @@ def state_root(
     accounts: dict[bytes, Account],
     storages: dict[bytes, dict[bytes, int]] | None = None,
     committer: TrieCommitter | None = None,
+    include_empty: bool = False,
 ) -> tuple[bytes, dict]:
     """Full state root from plain state.
 
     ``accounts``: address → Account (storage_root fields are recomputed
     here when ``storages`` has an entry for the address).
     ``storages``: address → {32-byte slot → int value}.
+    ``include_empty`` keeps empty accounts in the trie (pre-EIP-161
+    semantics — required when rebuilding pre-Spurious-Dragon state).
 
     Returns ``(root, details)`` where details carries the account-trie
     branch nodes (TrieUpdates analogue) and per-account storage roots.
@@ -114,7 +120,8 @@ def state_root(
     leaves: list[tuple[Nibbles, bytes]] = []
     for addr, acc in accounts.items():
         sroot = storage_roots.get(addr, acc.storage_root)
-        leaf = account_leaf(hashed_addrs[addr], acc.with_(storage_root=sroot))
+        leaf = account_leaf(hashed_addrs[addr], acc.with_(storage_root=sroot),
+                            include_empty=include_empty)
         if leaf is not None:
             leaves.append(leaf)
     result: TrieBuildResult = committer.commit(leaves)

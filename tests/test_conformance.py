@@ -41,7 +41,7 @@ def test_scenario_cases_pass(suite, scenario):
 
 
 def test_corrupted_post_state_fails(suite):
-    case = json.loads(json.dumps(suite["transfers_0"]))  # deep copy
+    case = json.loads(json.dumps(suite["transfers_Paris_0"]))  # deep copy
     addr = next(iter(case["postState"]))
     case["postState"][addr]["balance"] = "0xdeadbeef"
     with pytest.raises(ConformanceFailure, match="balance"):
@@ -49,7 +49,7 @@ def test_corrupted_post_state_fails(suite):
 
 
 def test_corrupted_block_rlp_fails(suite):
-    case = json.loads(json.dumps(suite["storage_0"]))
+    case = json.loads(json.dumps(suite["storage_Shanghai_0"]))
     blk = bytearray(bytes.fromhex(case["blocks"][0]["rlp"][2:]))
     blk[-1] ^= 0xFF  # flip a byte in the last tx
     case["blocks"][0]["rlp"] = "0x" + blk.hex()
@@ -60,13 +60,13 @@ def test_corrupted_block_rlp_fails(suite):
 def test_expect_exception_honored(suite):
     """A block marked expectException must be rejected, and acceptance is a
     failure: reuse a valid block at the wrong height."""
-    case = json.loads(json.dumps(suite["transfers_0"]))
+    case = json.loads(json.dumps(suite["transfers_Paris_0"]))
     good = case["blocks"][0]
     # re-importing the same height must be rejected -> expectException OK
     case["blocks"] = [good, {**good, "expectException": "InvalidBlock"}]
     run_blockchain_test("expect-exc", case)
 
-    case2 = json.loads(json.dumps(suite["transfers_0"]))
+    case2 = json.loads(json.dumps(suite["transfers_Paris_0"]))
     case2["blocks"] = [{**case2["blocks"][0], "expectException": "InvalidBlock"}]
     del case2["postState"]
     with pytest.raises(ConformanceFailure, match="accepted"):
@@ -83,7 +83,7 @@ def test_fixture_file_roundtrip(tmp_path, suite):
 def test_fixture_shape_is_ef_compatible(suite):
     """The JSON shape matches what the official corpus uses, so real
     ethereum/tests fixtures drop into the same runner."""
-    case = suite["storage_0"]
+    case = suite["storage_Shanghai_0"]
     assert {"pre", "genesisBlockHeader", "blocks", "postState",
             "lastblockhash", "network"} <= set(case)
     gh = case["genesisBlockHeader"]

@@ -143,6 +143,7 @@ def test_selfdestruct_same_tx_created():
     initcode = bytes.fromhex("33ff")
     ok, _, addr, _ = interp.create(A, 5, initcode, 1_000_000, 0)
     assert ok
+    state.process_destructs()  # deletion lands at end of transaction
     assert state.account(addr) is None
     assert state.balance(A) == 10**18  # value came back via beneficiary
 
@@ -156,7 +157,9 @@ def test_create2_redeploy_after_same_block_selfdestruct():
     # tx1: create a contract whose initcode selfdestructs -> dead
     ok, _, addr, _ = interp.create(A, 0, bytes.fromhex("33ff"), 1_000_000, 0,
                                    salt=b"\x02" * 32)
-    assert ok and state.account(addr) is None
+    assert ok
+    state.process_destructs()
+    assert state.account(addr) is None
     # tx2 boundary: stale _selfdestructs membership persists (block scope)
     state.begin_tx()
     assert addr in state._selfdestructs
@@ -166,6 +169,7 @@ def test_create2_redeploy_after_same_block_selfdestruct():
     ok2, _, addr2, _ = interp2.create(A, 0, bytes.fromhex("33ff"), 1_000_000, 0,
                                       salt=b"\x02" * 32)
     assert ok2 and addr2 == addr
+    state.process_destructs()
     assert state.account(addr) is None
     # and an initcode that survives deposits real code despite the stale
     # membership: PUSH1 1 PUSH0 MSTORE8 PUSH1 1 PUSH0 RETURN → runtime 0x01
@@ -178,6 +182,7 @@ def test_create2_redeploy_after_same_block_selfdestruct():
     # now selfdestruct it (same tx -> dead), then in a LATER tx redeploy the
     # exact same (initcode, salt): guard must allow the code deposit
     state.selfdestruct(addr3, A)
+    state.process_destructs()
     assert state.account(addr3) is None
     state.begin_tx()
     interp4 = Interpreter(state, BlockEnv(), TxEnv(origin=A))

@@ -1,0 +1,201 @@
+"""Replay the reference's hive rpc-compat chain as external ground truth.
+
+The reference ships a 45-block test chain spanning EVERY fork
+(homestead@0 ... tangerine@3 ... byzantium@9 ... london@27, the merge at
+block 36, then shanghai/cancun/prague by timestamp) plus recorded
+JSON-RPC request/response fixtures
+(/root/reference/crates/rpc/rpc-e2e-tests/testdata/rpc-compat/). Importing
+it through the real pipeline validates the fork-parameterized EVM against
+externally produced headers: per-block gas used, receipts roots
+(post-Byzantium), logs blooms, and the state root at every Merkle
+checkpoint — the first full-chain validation of EVM + trie + RPC together
+against data this repo did not generate.
+
+The chain exercises: PoW headers + ommers (rewards!), pre-Byzantium
+receipt format, EIP-1283/2200 SSTORE eras, the EIP-1559 activation
+gas-limit doubling, the merge, withdrawals, blob fields, the EIP-4788 /
+EIP-2935 system calls, and EIP-7702 set-code txs.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.primitives.rlp import _decode_at
+from reth_tpu.primitives.types import Block
+from reth_tpu.trie import TrieCommitter
+
+HIVE = Path("/root/reference/crates/rpc/rpc-e2e-tests/testdata/rpc-compat")
+
+pytestmark = pytest.mark.skipif(
+    not HIVE.exists(), reason="reference rpc-compat testdata not available")
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def _load_blocks() -> list[Block]:
+    raw = (HIVE / "chain.rlp").read_bytes()
+    blocks, pos = [], 0
+    while pos < len(raw):
+        _item, end = _decode_at(raw, pos)
+        blocks.append(Block.decode(raw[pos:end]))
+        pos = end
+    return blocks
+
+
+@pytest.fixture(scope="module")
+def hive_node():
+    from reth_tpu.cli import _load_genesis
+    from reth_tpu.consensus import EthBeaconConsensus
+    from reth_tpu.evm import EvmConfig
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.stages import Pipeline, default_stages
+    from reth_tpu.storage.genesis import import_chain
+
+    header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(
+        str(HIVE / "genesis.json"), CPU)
+    cfg = NodeConfig(chain_id=chain_id, genesis_header=header,
+                     genesis_alloc=alloc, genesis_storage=storage,
+                     genesis_codes=codes, chain_spec=chain_spec,
+                     db_backend="memdb")
+    node = Node(cfg, committer=CPU)
+    blocks = _load_blocks()
+    consensus = EthBeaconConsensus(CPU, chainspec=chain_spec)
+    tip = import_chain(node.factory, blocks, consensus)
+    pipeline = Pipeline(node.factory, default_stages(
+        committer=CPU, consensus=consensus,
+        evm_config=EvmConfig(chain_id=chain_id, chainspec=chain_spec)))
+    pipeline.run(tip)
+    node.start_rpc()
+    yield node, blocks
+    node.stop()
+
+
+def test_chain_imports_to_expected_head(hive_node):
+    node, blocks = hive_node
+    head_fcu = json.loads((HIVE / "headfcu.json").read_text())
+    want_head = bytes.fromhex(
+        head_fcu["params"][0]["headBlockHash"].removeprefix("0x"))
+    assert blocks[-1].header.number == 45
+    with node.factory.provider() as p:
+        assert p.last_block_number() == 45
+        assert p.canonical_hash(45) == want_head
+        # MerkleStage already validated the state root against header 45;
+        # assert the stored trie agrees with the header once more here
+        assert p.header_by_number(45).state_root == blocks[-1].header.state_root
+
+
+def _raw_rpc(port: int, payload: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+
+def _io_cases():
+    return sorted(HIVE.glob("*/*.io"))
+
+
+@pytest.mark.parametrize("io_path", _io_cases(), ids=lambda p: p.parent.name + "/" + p.stem)
+def test_io_fixture_replays_byte_compatible(hive_node, io_path):
+    """Each recorded hive exchange must reproduce exactly: same result
+    payload for the same request (modulo JSON key order)."""
+    node, _ = hive_node
+    port = node.rpc.port
+    request = None
+    for line in io_path.read_text().splitlines():
+        line = line.strip()
+        if line.startswith(">> "):
+            request = json.loads(line[3:])
+        elif line.startswith("<< "):
+            assert request is not None, "response before request in fixture"
+            expected = json.loads(line[3:])
+            got = _raw_rpc(port, request)
+            assert got.get("result") == expected.get("result"), (
+                f"{io_path.name}: {json.dumps(got.get('result'), indent=1)}\n"
+                f"!= expected {json.dumps(expected.get('result'), indent=1)}")
+            assert ("error" in got) == ("error" in expected)
+            request = None
+
+
+def test_pre_byzantium_receipt_roots_match_headers():
+    """Pre-Byzantium receipts embed the post-transaction STATE ROOT
+    (EIP-658 replaced it with the status flag). The pipeline skips this
+    check like the reference does, but the executor's
+    ``intermediate_root_fn`` seam makes it checkable: replay the hive
+    chain's pre-Byzantium segment (blocks 1-8) computing a full trie root
+    after every tx, and the receipts roots must equal the externally
+    produced headers'."""
+    from reth_tpu.cli import _load_genesis
+    from reth_tpu.consensus.validation import validate_block_post_execution
+    from reth_tpu.evm import BlockExecutor, EvmConfig
+    from reth_tpu.evm.executor import InMemoryStateSource
+    from reth_tpu.trie import state_root
+    from reth_tpu.trie.state_root import ordered_trie_root
+
+    header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(
+        str(HIVE / "genesis.json"), CPU)
+    blocks = _load_blocks()
+    src = InMemoryStateSource(alloc, storage, codes)
+    cfg = EvmConfig(chain_id=chain_id, chainspec=chain_spec)
+    hashes = {0: header.hash}
+
+    def root_fn(state):
+        accounts = dict(src.accounts)
+        storages = {a: dict(s) for a, s in src.storages.items()}
+        for addr, acc in state._accounts.items():
+            if acc is None:
+                accounts.pop(addr, None)
+            else:
+                accounts[addr] = acc
+        for addr in state._selfdestructs | state.changes.wiped_storage:
+            storages.pop(addr, None)
+        for addr, per in state._storage.items():
+            tgt = storages.setdefault(addr, {})
+            for slot, v in per.items():
+                if v:
+                    tgt[slot] = v
+                else:
+                    tgt.pop(slot, None)
+            if not tgt:
+                storages.pop(addr, None)
+        # pre-Spurious tries CARRY empty accounts (EIP-161 is what removes
+        # them); a full rebuild from plain state must include them
+        root, _ = state_root(accounts, storages, committer=CPU,
+                             include_empty=True)
+        return root
+
+    checked = 0
+    for b in blocks[:8]:  # byzantium activates at block 9
+        out = BlockExecutor(src, cfg).execute(
+            b, block_hashes=dict(hashes), intermediate_root_fn=root_fn)
+        hashes[b.header.number] = b.hash
+        assert all(r.state_root is not None for r in out.receipts)
+        got = ordered_trie_root([r.encode_2718() for r in out.receipts], CPU)
+        assert got == b.header.receipts_root, f"block {b.header.number}"
+        # the fork-aware post-exec validator must also accept it whole
+        validate_block_post_execution(b, out.receipts, out.gas_used, CPU,
+                                      chainspec=chain_spec)
+        checked += len(out.receipts)
+        for addr, acc in out.post_accounts.items():
+            if acc is None:
+                src.accounts.pop(addr, None)
+            else:
+                src.accounts[addr] = acc
+        for addr in out.changes.wiped_storage:
+            src.storages[addr] = {}
+        for addr, slots in out.post_storage.items():
+            per = src.storages.setdefault(addr, {})
+            for slot, v in slots.items():
+                if v:
+                    per[slot] = v
+                else:
+                    per.pop(slot, None)
+        for ch, code in out.changes.new_bytecodes.items():
+            src.codes[ch] = code
+    assert checked >= 20  # the segment is transaction-dense

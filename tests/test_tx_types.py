@@ -217,10 +217,13 @@ def test_setcode_requires_auth_list(alice, src):
         BlockExecutor(src).execute(make_block([tx]))
 
 
-def test_plain_transfer_to_delegated_account_oogs_in_block(alice, src):
-    """A 21000-gas transfer to a delegated EOA can't afford the delegate
-    access cost: that is an IN-BLOCK failed tx (gas consumed, nonce bumped,
-    block valid) — never a tx-validity error (review round-2 finding)."""
+def test_plain_transfer_to_delegated_account(alice, src):
+    """EIP-7702 top-level delegation: the tx destination's delegation
+    target joins accessed_addresses for FREE (the EIP extends EIP-2929's
+    init — validated against the reference's hive rpc-compat chain, block
+    45), so a 21000-gas transfer to a delegated EOA succeeds when the
+    delegate has no code, and fails IN-BLOCK (never tx-invalid) when the
+    delegate's code can't run on zero remaining gas."""
     from reth_tpu.primitives.keccak import keccak256
 
     carol = Wallet(0xCA01)
@@ -228,14 +231,25 @@ def test_plain_transfer_to_delegated_account_oogs_in_block(alice, src):
     src.accounts[carol.address] = Account(balance=10**18,
                                           code_hash=keccak256(designator))
     src.codes[keccak256(designator)] = designator
-    tx = alice.transfer(carol.address, 5)  # gas_limit 21000
+    # delegate has no code: plain 21000 transfer works
+    tx = alice.transfer(carol.address, 5)
     out = BlockExecutor(src).execute(make_block([tx]))
-    assert not out.receipts[0].success
-    assert out.gas_used == 21_000  # all gas consumed
-    assert out.post_accounts[alice.address].nonce == 1
-    # the transfer did not happen (carol untouched => absent from changes)
-    post_carol = out.post_accounts.get(carol.address)
-    assert post_carol is None or post_carol.balance == 10**18
+    assert out.receipts[0].success
+    assert out.gas_used == 21_000
+    assert out.post_accounts[carol.address].balance == 10**18 + 5
+
+    # delegate WITH code: zero gas left after intrinsic -> in-block OOG
+    # (gas consumed, nonce bumped, block still valid)
+    code = bytes.fromhex("6000600055")  # any non-empty code
+    src.accounts[b"\x99" * 20] = Account(code_hash=keccak256(code))
+    src.codes[keccak256(code)] = code
+    dave = Wallet(0xDA7E)
+    src.accounts[dave.address] = Account(balance=10**18)
+    tx2 = dave.transfer(carol.address, 5)
+    out2 = BlockExecutor(src).execute(make_block([tx2]))
+    assert not out2.receipts[0].success
+    assert out2.gas_used == 21_000
+    assert out2.post_accounts[dave.address].nonce == 1
 
 
 def test_call_into_delegated_account_runs_delegate_code(alice, src):
