@@ -635,7 +635,8 @@ def cmd_bb_bench(args):
     assert out2.gas_used == out.gas_used
     print(f"bal:      {mgas:.2f} Mgas in {dt_bal:.3f}s = "
           f"{mgas / dt_bal:.2f} Mgas/s  waves={stats['waves']} "
-          f"parallel={stats['parallel']} serial={stats['serial']}")
+          f"parallel={stats['parallel']} serial={stats['serial']} "
+          f"native={stats.get('native', 0)}")
     print(json.dumps({"metric": "execution_mgas_per_sec",
                       "value": round(mgas / dt_serial, 3),
                       "unit": "Mgas/s",

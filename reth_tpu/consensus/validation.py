@@ -131,7 +131,8 @@ def validate_header_against_parent(header: Header, parent: Header,
             )
         if header.blob_gas_used > max_gas:
             raise ConsensusError("blob gas used above block maximum")
-    elif spec is not None and header.excess_blob_gas is not None:
+    elif spec is not None and (header.excess_blob_gas is not None
+                               or header.blob_gas_used is not None):
         raise ConsensusError("blob gas fields before Cancun")
 
 

@@ -217,6 +217,19 @@ def _extract_writes(state: EvmState, acc: TxAccess) -> None:
             acc.slot_writes.add((addr, s))
 
 
+def _apply_fee_delta(merged: "_MergedView", coinbase: bytes,
+                     fee_delta: int) -> None:
+    """Credit the accumulated priority fees to the coinbase in the merged
+    view (the single home of this logic — python and native commits)."""
+    prev = merged.account(coinbase)
+    if prev is None:
+        merged.accounts[coinbase] = Account(balance=fee_delta)
+    else:
+        merged.accounts[coinbase] = Account(
+            nonce=prev.nonce, balance=prev.balance + fee_delta,
+            storage_root=prev.storage_root, code_hash=prev.code_hash)
+
+
 def _commit_journal(merged: _MergedView, state: EvmState, fee_delta: int,
                     coinbase: bytes) -> None:
     """Fold one transaction's journal into the merged post-state view."""
@@ -229,8 +242,7 @@ def _commit_journal(merged: _MergedView, state: EvmState, fee_delta: int,
         merged.slots.setdefault(addr, {}).update(slots)
     merged.codes.update(state.changes.new_bytecodes)
     if fee_delta:
-        prev = merged.account(coinbase) or Account()
-        merged.accounts[coinbase] = prev.with_(balance=prev.balance + fee_delta)
+        _apply_fee_delta(merged, coinbase, fee_delta)
 
 
 # -- parallel execution -------------------------------------------------------
@@ -279,15 +291,19 @@ def execute_block_bal(source: StateSource, block: Block,
     new_codes: dict[bytes, bytes] = {}
     receipts: list[Receipt] = []
     cumulative = 0
-    stats = {"waves": 0, "parallel": 0, "serial": 0}
+    stats = {"waves": 0, "parallel": 0, "serial": 0, "native": 0}
     waves = _build_waves(bal, len(block.transactions))
-    # Wave members are GIL-bound pure-Python EVM runs: OS threads add
-    # contention without concurrency (measured: threaded waves ran ~4x
-    # SLOWER than serial). The wave schedule itself is the valuable
-    # artifact — conflict-free sets whose speculative runs commute — so
-    # execute each wave's members sequentially against the SAME
-    # wave-start snapshot (identical semantics to the concurrent form);
-    # a native/nogil executor plugs a real pool back in via use_threads.
+    entries_by_index = {e.index: e for e in bal.entries}
+    # Wave execution prefers the NATIVE core (native/evmexec.cpp): the
+    # whole wave runs on real OS threads in C++ against a snapshot built
+    # from the access hint, entirely GIL-free; transactions it declines
+    # (unsupported ops, missing keys) fall back to the Python
+    # interpreter below. RETH_TPU_BAL_NATIVE=0 disables it.
+    use_native = os.environ.get("RETH_TPU_BAL_NATIVE", "1") != "0"
+    # Pure-Python wave members under threads are GIL-bound: contention
+    # without concurrency (measured ~4x SLOWER than serial) — so the
+    # Python fallback runs sequentially; RETH_TPU_BAL_THREADS=1 forces a
+    # pool anyway for experiments.
     use_threads = os.environ.get("RETH_TPU_BAL_THREADS") == "1"
     pool = (ThreadPoolExecutor(max_workers=max_workers)
             if use_threads and any(len(w) > 1 for w in waves) else None)
@@ -309,7 +325,7 @@ def execute_block_bal(source: StateSource, block: Block,
         _extract_writes(state, acc)
         return acc, state, ex.fee_delta, result
 
-    def _capture_changesets(state: EvmState):
+    def _capture_changesets(state):
         # first-touch-wins previous images, relative to BLOCK start
         for addr, prev in state.changes.accounts.items():
             if addr not in changes_accounts:
@@ -322,51 +338,134 @@ def execute_block_bal(source: StateSource, block: Block,
             wiped.add(addr)
         new_codes.update(state.changes.new_bytecodes)
 
-    for wave in waves:
-        stats["waves"] += 1
-        if len(wave) == 1 or pool is None:
-            results = {i: _speculate(i) for i in wave}
-        else:
-            results = {r[0]: r for r in pool.map(_speculate, wave)}
-        committed_accts: set = set()
-        committed_slots: set = set()
-        for i in wave:
-            _, acc, state, fee_delta, result, err = results[i]
-            conflicted = (
-                err is not None
-                or acc.coinbase_sensitive
-                or acc.conflicts_with_write_sets(committed_accts,
-                                                 committed_slots)
-                or block.transactions[i].gas_limit > env.gas_limit - cumulative
-            )
-            if conflicted:
-                stats["serial"] += 1
-                acc, state, fee_delta, result = _serial(i)  # may raise: invalid block
-            elif len(wave) > 1:
-                stats["parallel"] += 1  # conflict-free wave commit (the
-                # schedule-level count; threads only under RETH_TPU_BAL_THREADS)
+    committed_any = False
+
+    def _commit_tx(i: int, state, fee_delta: int, result) -> None:
+        """Fold one executed tx into the block output (shared by the
+        Python wave loop and the native segment flow)."""
+        nonlocal cumulative, committed_any
+        committed_any = True
+        _capture_changesets(state)
+        if state_hook is not None:
+            keys = list(state.changes.accounts) + [
+                (a, s) for a, per in state.changes.storage.items()
+                for s in per]
+            if fee_delta:
+                keys.append(env.coinbase)
+            state_hook(keys)
+        _commit_journal(merged, state, fee_delta, env.coinbase)
+        if fee_delta and env.coinbase not in changes_accounts:
+            changes_accounts[env.coinbase] = source.account(env.coinbase)
+        cumulative += result.gas_used
+        receipts.append(Receipt(
+            tx_type=block.transactions[i].tx_type,
+            success=result.success,
+            cumulative_gas_used=cumulative,
+            logs=tuple(result.receipt.logs),
+        ))
+
+    def _commit_native(tx_type: int, success: bool, gas_used: int,
+                       fee_delta: int, logs, acct_writes, slot_writes,
+                       prev_accounts, prev_slots) -> None:
+        """Single-pass fold of a natively executed tx — same effects as
+        `_commit_tx`, skipping the intermediate BlockChanges/shim objects
+        (this is on the per-tx hot path of big blocks)."""
+        nonlocal cumulative, committed_any
+        committed_any = True
+        keys = [] if state_hook is not None else None
+        for wa, deleted, nonce, balance in acct_writes:
+            prev = prev_accounts[wa]
+            if wa not in changes_accounts:
+                changes_accounts[wa] = prev
+            if deleted:
+                merged.accounts[wa] = None
+            elif prev is not None:
+                merged.accounts[wa] = Account(
+                    nonce=nonce, balance=balance,
+                    storage_root=prev.storage_root,
+                    code_hash=prev.code_hash)
             else:
-                stats["serial"] += 1
-            _capture_changesets(state)
-            if state_hook is not None:
-                keys = list(state.changes.accounts) + [
-                    (a, s) for a, per in state.changes.storage.items()
-                    for s in per]
-                if fee_delta:
-                    keys.append(env.coinbase)
-                state_hook(keys)
-            _commit_journal(merged, state, fee_delta, env.coinbase)
-            if fee_delta and env.coinbase not in changes_accounts:
+                merged.accounts[wa] = Account(nonce=nonce, balance=balance)
+            if keys is not None:
+                keys.append(wa)
+        for ka, ks, v in slot_writes:
+            per = changes_storage.get(ka)
+            if per is None:
+                per = changes_storage[ka] = {}
+            if ks not in per:
+                per[ks] = prev_slots[(ka, ks)]
+            mper = merged.slots.get(ka)
+            if mper is None:
+                mper = merged.slots[ka] = {}
+            mper[ks] = v
+            if keys is not None:
+                keys.append((ka, ks))
+        if fee_delta:
+            _apply_fee_delta(merged, env.coinbase, fee_delta)
+            if env.coinbase not in changes_accounts:
                 changes_accounts[env.coinbase] = source.account(env.coinbase)
-            committed_accts |= acc.account_writes
-            committed_slots |= acc.slot_writes
-            cumulative += result.gas_used
-            receipts.append(Receipt(
-                tx_type=block.transactions[i].tx_type,
-                success=result.success,
-                cumulative_gas_used=cumulative,
-                logs=tuple(result.receipt.logs),
-            ))
+            if keys is not None:
+                keys.append(env.coinbase)
+        if keys:
+            state_hook(keys)
+        cumulative += gas_used
+        receipts.append(Receipt(
+            tx_type=tx_type, success=success,
+            cumulative_gas_used=cumulative, logs=logs,
+        ))
+
+    native_done = False
+    if use_native:
+        # native segment flow: maximal runs of native-eligible txs execute
+        # entirely in C++ (waves, conflict validation, inter-wave merge);
+        # anything else runs serially through the interpreter in order
+        try:
+            from .native_exec import native_flow
+
+            native_done = native_flow(
+                block, senders, waves, entries_by_index, config, env,
+                merged, max_workers, stats,
+                commit_tx=_commit_tx, commit_native=_commit_native,
+                run_python=_serial,
+                remaining_gas=lambda: env.gas_limit - cumulative)
+        except Exception:  # noqa: BLE001 — native is an accelerator only;
+            native_done = False  # any failure restarts on the Python path
+            if committed_any:
+                raise  # partial commit: restarting would double-apply
+            # nothing committed: zero the failed attempt's counters so the
+            # Python loop's accounting starts clean
+            for k in stats:
+                stats[k] = 0
+
+    if not native_done:
+        for wave in waves:
+            stats["waves"] += 1
+            if len(wave) == 1 or pool is None:
+                results = {i: _speculate(i) for i in wave}
+            else:
+                results = {r[0]: r for r in pool.map(_speculate, wave)}
+            committed_accts: set = set()
+            committed_slots: set = set()
+            for i in wave:
+                _, acc, state, fee_delta, result, err = results[i]
+                conflicted = (
+                    err is not None
+                    or acc.coinbase_sensitive
+                    or acc.conflicts_with_write_sets(committed_accts,
+                                                     committed_slots)
+                    or block.transactions[i].gas_limit > env.gas_limit - cumulative
+                )
+                if conflicted:
+                    stats["serial"] += 1
+                    acc, state, fee_delta, result = _serial(i)  # may raise: invalid block
+                elif len(wave) > 1:
+                    stats["parallel"] += 1  # conflict-free wave commit (the
+                    # schedule-level count; threads only under RETH_TPU_BAL_THREADS)
+                else:
+                    stats["serial"] += 1
+                _commit_tx(i, state, fee_delta, result)
+                committed_accts |= acc.account_writes
+                committed_slots |= acc.slot_writes
 
     if pool is not None:
         pool.shutdown(wait=True)

@@ -589,7 +589,12 @@ class Interpreter:
                 use(2 if n == 0 else 3)
                 if len(stack) >= 1024:
                     raise Halt()
-                push(int.from_bytes(code[pc : pc + n], "big"))
+                if pc + n <= code_len:
+                    push(int.from_bytes(code[pc : pc + n], "big"))
+                else:
+                    # truncated PUSH zero-pads on the RIGHT
+                    # (execution-specs buffer_read semantics)
+                    push(int.from_bytes(code[pc:].ljust(n, b"\x00"), "big"))
                 pc += n
                 continue
             if 0x80 <= op <= 0x8F:  # DUP1..DUP16
