@@ -79,26 +79,43 @@ class PeerConnection:
         with self._lock:
             self.session.send_msg(self.snap_offset + mid, payload)
 
+    def _dispatch(self, mid: int, body: bytes):
+        """One (mid, body) -> decoded message, or None when it was a p2p
+        housekeeping frame handled inline (ping/pong)."""
+        if self.snap_enabled and mid >= self.snap_offset:
+            from . import snap as snap_mod
+
+            return snap_mod.decode_snap(mid - self.snap_offset, body)
+        if mid >= BASE_PROTOCOL_OFFSET:
+            return wire.decode_eth(mid - BASE_PROTOCOL_OFFSET, body)
+        if mid == PING_ID:
+            with self._lock:
+                self.session.send_msg(PONG_ID, b"\xc0")
+            return None
+        if mid == PONG_ID:
+            return None
+        if mid == DISCONNECT_ID:
+            raise PeerDisconnected("peer disconnected")
+        raise PeerError(f"unexpected p2p message {mid:#x}")
+
     def recv(self):
         """Next eth/snap message; p2p pings are answered inline, disconnects
         surface as PeerError."""
         while True:
             mid, body = self.session.recv_msg()
-            if self.snap_enabled and mid >= self.snap_offset:
-                from . import snap as snap_mod
+            msg = self._dispatch(mid, body)
+            if msg is not None:
+                return msg
 
-                return snap_mod.decode_snap(mid - self.snap_offset, body)
-            if mid >= BASE_PROTOCOL_OFFSET:
-                return wire.decode_eth(mid - BASE_PROTOCOL_OFFSET, body)
-            if mid == PING_ID:
-                with self._lock:
-                    self.session.send_msg(PONG_ID, b"\xc0")
-                continue
-            if mid == PONG_ID:
-                continue
-            if mid == DISCONNECT_ID:
-                raise PeerDisconnected("peer disconnected")
-            raise PeerError(f"unexpected p2p message {mid:#x}")
+    def feed(self, data: bytes) -> list:
+        """Swarm receive path: buffered ciphertext in, decoded messages
+        out (non-blocking; p2p housekeeping handled inline)."""
+        msgs = []
+        for frame in self.session.feed_frames(data):
+            msg = self._dispatch(*self.session.parse_frame(frame))
+            if msg is not None:
+                msgs.append(msg)
+        return msgs
 
     # -- handshake -------------------------------------------------------------
 

@@ -74,6 +74,17 @@ class RlpxSession:
         self._dec = Cipher(algorithms.AES(secrets.aes), modes.CTR(zero_iv)).decryptor()
         self.snappy_enabled = False
         self.remote_hello: dict | None = None
+        # non-blocking receive state (swarm mode): buffered ciphertext +
+        # the header/body phase of the in-flight frame. The CTR stream and
+        # rolling MACs are strictly ordered, so feed_frames consumes bytes
+        # exactly once, in order.
+        self._rx = bytearray()
+        self._rx_size: int | None = None   # None = waiting for a header
+        self._rx_padded = 0
+        # swarm mode send sink: frames are fully encrypted under the
+        # caller's lock, then handed to the sink (an outbox) instead of
+        # blocking in sendall
+        self._send_sink = None
 
     # -- MAC construction ---------------------------------------------------
 
@@ -100,7 +111,11 @@ class RlpxSession:
         padded = payload + b"\x00" * (-len(payload) % 16)
         frame_ct = self._enc.update(padded)
         frame_mac = self._frame_mac(self._egress_mac, frame_ct)
-        self.sock.sendall(header_ct + header_mac + frame_ct + frame_mac)
+        data = header_ct + header_mac + frame_ct + frame_mac
+        if self._send_sink is not None:
+            self._send_sink(data)
+        else:
+            self.sock.sendall(data)
 
     def recv_frame(self) -> bytes:
         header_ct = _recv_exact(self.sock, 16)
@@ -118,6 +133,39 @@ class RlpxSession:
             raise RlpxError("bad frame MAC")
         return self._dec.update(frame_ct)[:size]
 
+    def feed_frames(self, data: bytes) -> list[bytes]:
+        """Non-blocking counterpart of recv_frame: buffer ciphertext and
+        return every complete frame it now contains (swarm receive path)."""
+        self._rx += data
+        frames: list[bytes] = []
+        while True:
+            if self._rx_size is None:
+                if len(self._rx) < 32:
+                    break
+                header_ct = bytes(self._rx[:16])
+                header_mac = bytes(self._rx[16:32])
+                del self._rx[:32]
+                if self._mac_step(self._ingress_mac, header_ct) != header_mac:
+                    raise RlpxError("bad header MAC")
+                header = self._dec.update(header_ct)
+                size = int.from_bytes(header[:3], "big")
+                if size > MAX_FRAME:
+                    raise RlpxError("frame too large")
+                self._rx_size = size
+                self._rx_padded = size + (-size % 16)
+            else:
+                total = self._rx_padded + 16
+                if len(self._rx) < total:
+                    break
+                frame_ct = bytes(self._rx[:self._rx_padded])
+                frame_mac = bytes(self._rx[self._rx_padded:total])
+                del self._rx[:total]
+                if self._frame_mac(self._ingress_mac, frame_ct) != frame_mac:
+                    raise RlpxError("bad frame MAC")
+                frames.append(self._dec.update(frame_ct)[:self._rx_size])
+                self._rx_size = None
+        return frames
+
     # -- messages -----------------------------------------------------------
 
     def send_msg(self, msg_id: int, body: bytes) -> None:
@@ -125,8 +173,8 @@ class RlpxSession:
             body = snappy.compress(body)
         self.send_frame(rlp_encode(encode_int(msg_id)) + body)
 
-    def recv_msg(self) -> tuple[int, bytes]:
-        frame = self.recv_frame()
+    def parse_frame(self, frame: bytes) -> tuple[int, bytes]:
+        """One received frame -> (msg_id, body) with snappy handling."""
         if not frame:
             raise RlpxError("empty frame")
         # msg-id is a single RLP item (0x80 = 0)
@@ -139,6 +187,9 @@ class RlpxSession:
         if self.snappy_enabled and msg_id >= BASE_PROTOCOL_OFFSET:
             body = snappy.decompress(body)
         return msg_id, body
+
+    def recv_msg(self) -> tuple[int, bytes]:
+        return self.parse_frame(self.recv_frame())
 
     # -- p2p base protocol --------------------------------------------------
 

@@ -3,7 +3,9 @@
 Reference analogue: crates/net/network — `NetworkManager`
 (src/manager.rs:108) + `EthRequestHandler` serving headers/bodies/
 receipts from the provider (src/eth_requests.rs), and tx broadcast
-hooks (src/transactions/). Threaded accept loop; one thread per peer.
+hooks (src/transactions/). Inbound sessions are served by the ONE
+event-loop swarm thread (`net/swarm.py`, reference src/swarm.rs);
+handshakes run on transient threads only.
 """
 
 from __future__ import annotations
@@ -65,7 +67,6 @@ class NetworkManager:
         self.sessions = SessionManager(max_inbound=max_inbound,
                                        max_outbound=max_outbound)
         self._listener: socket.socket | None = None
-        self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
 
     def _snap_server(self):
@@ -120,84 +121,25 @@ class NetworkManager:
     # -- lifecycle -------------------------------------------------------------
 
     def start(self) -> int:
+        from .swarm import Swarm
+
         self._listener = socket.create_server((self.host, self.port))
         self.port = self._listener.getsockname()[1]
-        t = threading.Thread(target=self._accept_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
+        # ONE event loop owns the listener and every established inbound
+        # session (reference swarm, src/swarm.rs); handshakes run on
+        # transient threads only
+        self.swarm = Swarm(self, self._listener)
+        self.swarm.start()
         return self.port
 
     def stop(self):
         self._stop.set()
+        if getattr(self, "swarm", None) is not None:
+            self.swarm.stop()
         if self._listener:
             self._listener.close()
-        for p in list(self.peers):  # serve threads mutate the live list
+        for p in list(self.peers):  # close releases session slots
             p.close()
-
-    def _accept_loop(self):
-        from .sessions import SessionLimitExceeded
-
-        while not self._stop.is_set():
-            try:
-                sock, _addr = self._listener.accept()
-            except OSError:
-                return
-            try:
-                slot = self.sessions.reserve("inbound")
-            except SessionLimitExceeded:
-                sock.close()  # at capacity: refuse BEFORE any handshake
-                continue
-            try:
-                peer = PeerConnection.accept(sock, self.status, self.node_priv,
-                                             fork_filter=self._fork_filter)
-            except Exception:  # noqa: BLE001 — handshake parses attacker-
-                # controlled bytes; ANY failure must drop the peer, never
-                # the accept loop (a dead listener = no inbound peers ever)
-                self.sessions.close(slot, "handshake failed")
-                sock.close()
-                continue
-            if self.peers_manager.is_banned(peer.node_id):
-                self.sessions.close(slot, "banned")
-                peer.session.disconnect(0x05)  # banned: refuse the session
-                peer.close()
-                continue
-            self.sessions.activate(slot, peer)
-            peer._session_slot = slot
-            self.peers.append(peer)
-            t = threading.Thread(target=self._serve_peer, args=(peer,), daemon=True)
-            t.start()
-            self._threads.append(t)
-
-    # -- request serving (EthRequestHandler analogue) --------------------------
-
-    def _serve_peer(self, peer: PeerConnection):
-        slot = getattr(peer, "_session_slot", None)
-        reason = "disconnected"
-        try:
-            while not self._stop.is_set():
-                try:
-                    msg = peer.recv()
-                    if slot is not None:
-                        slot.messages_in += 1
-                    self._handle(peer, msg)
-                except PeerDisconnected:
-                    break  # graceful goodbye: no penalty
-                except PeerError:
-                    # protocol violation: penalize (bans past the threshold)
-                    self.peers_manager.reputation_change(peer.node_id, "bad_message")
-                    reason = "protocol violation"
-                    break
-                except Exception:  # noqa: BLE001 — malformed frame/request
-                    reason = "stream error"
-                    break          # drops the peer; cleanup in finally
-        finally:
-            if slot is not None:
-                self.sessions.close(slot, reason)
-            peer.close()
-            try:
-                self.peers.remove(peer)
-            except ValueError:
-                pass
 
     def _handle(self, peer: PeerConnection, msg):
         from . import snap as snap_mod

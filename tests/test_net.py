@@ -415,3 +415,42 @@ def test_node_serves_in_memory_tip_over_p2p(tmp_path):
         peer.close()
     finally:
         node.stop()
+
+
+def test_swarm_soak_flat_thread_count(testnet):
+    """Round-5 event-loop network core (reference src/swarm.rs): 30
+    concurrent inbound sessions are served by ONE loop thread — the
+    steady-state thread count must not grow with the peer count, and
+    every peer must still get served."""
+    import threading
+    import time
+
+    server, port, status, _factory_b, builder = testnet
+    peers = []
+    try:
+        from reth_tpu.primitives.secp256k1 import pubkey_from_priv
+
+        for i in range(30):
+            peers.append(PeerConnection.connect(
+                "127.0.0.1", port, status,
+                pubkey_from_priv(server.node_priv),
+                node_priv=0xB000 + i))
+        # wait until all handshake threads have finished and the swarm
+        # has adopted every session
+        deadline = time.time() + 10
+        while time.time() < deadline and len(server.peers) < 30:
+            time.sleep(0.05)
+        assert len(server.peers) == 30
+        baseline = threading.active_count()
+        # every peer served through the single loop
+        for p in peers:
+            headers = p.get_headers(0, 2)
+            assert headers and headers[0].hash == builder.genesis.hash
+        # more traffic must not spawn serving threads
+        for p in peers:
+            assert p.get_headers(1, 1)
+        assert threading.active_count() <= baseline
+        assert server.swarm._thread.is_alive()
+    finally:
+        for p in peers:
+            p.close()
