@@ -1,0 +1,25 @@
+# Developer entry points (reference: Makefile + nextest in CI,
+# .github/workflows/unit.yml).
+
+# Parallel test run: xdist shards by FILE (port-isolated fixtures make
+# files independent); JAX pinned to CPU so no shard can touch the axon
+# tunnel. Override workers with TEST_WORKERS=n.
+TEST_WORKERS ?= 6
+
+.PHONY: test test-serial native
+
+test:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests -q -p no:cacheprovider \
+	  -n $(TEST_WORKERS) --dist loadfile
+
+test-serial:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests -q -p no:cacheprovider
+
+native:
+	g++ -O2 -std=c++17 -shared -fPIC native/triebuild.cpp -o native/build/libtriebuild.so
+	g++ -O2 -std=c++17 -shared -fPIC native/secp256k1.cpp -o native/build/libsecp.so
+	g++ -O2 -std=c++17 -shared -fPIC native/kvstore.cpp -o native/build/libkvstore.so
+	g++ -O2 -std=c++17 -shared -fPIC native/pagedkv.cpp -o native/build/libpagedkv.so
+	g++ -O2 -std=c++17 -shared -fPIC -pthread native/evmexec.cpp -o native/build/libevmexec.so

@@ -147,6 +147,7 @@ def cmd_init(args):
         chain_id=chain_id, datadir=args.datadir, genesis_header=header,
         genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes,
         chain_spec=chain_spec, db_backend=_resolve_backend(args),
+        storage_v2=getattr(args, "storage_v2", None),
     )
     node = Node(cfg, committer=committer)
     node.factory.db.flush()
@@ -165,7 +166,8 @@ def cmd_import(args):
     header, alloc, storage, codes, chain_id, chain_spec = _load_genesis(args.genesis, committer)
     cfg = NodeConfig(chain_id=chain_id, datadir=args.datadir, genesis_header=header,
                      genesis_alloc=alloc, genesis_storage=storage, genesis_codes=codes,
-                     chain_spec=chain_spec, db_backend=_resolve_backend(args))
+                     chain_spec=chain_spec, db_backend=_resolve_backend(args),
+                     storage_v2=getattr(args, "storage_v2", None))
     node = Node(cfg, committer=committer)
     raw = Path(args.file).read_bytes()
     blocks = []
@@ -294,6 +296,7 @@ def cmd_node(args):
                      bootnodes=tuple(args.bootnodes.split(",")) if args.bootnodes else (),
                      bootnodes_v5=tuple(args.bootnodes_v5.split(",")) if args.bootnodes_v5 else (),
                      db_backend=backend,
+                     storage_v2=getattr(args, "storage_v2", None),
                      **kw)
     node = Node(cfg, committer=committer)
     p2p_port = node.start_network()
@@ -383,7 +386,8 @@ def _open_db(args):
     from .storage import open_database
 
     Path(args.datadir).mkdir(parents=True, exist_ok=True)
-    return open_database(_resolve_backend(args), args.datadir)
+    return open_database(_resolve_backend(args), args.datadir,
+                         getattr(args, "storage_v2", None))
 
 
 def cmd_db_get(args):
@@ -862,6 +866,12 @@ def main(argv=None) -> int:
         # paged (the COW B+tree / MDBX analogue) is the DEFAULT everywhere
         # a datadir exists — memdb is a test fixture (reference: libmdbx is
         # the only production backend)
+        p.add_argument("--storage.v2", dest="storage_v2",
+                       action="store_true", default=None,
+                       help="split layout: history/lookup tables on a "
+                            "dedicated second store (reference "
+                            "StorageSettings storage-v2); persisted per "
+                            "datadir on first init")
         p.add_argument("--db", dest="db_backend",
                        choices=["memdb", "native", "paged"], default=None,
                        help="storage backend (paged = mmap COW B+tree "

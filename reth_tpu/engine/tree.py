@@ -498,7 +498,20 @@ class EngineTree:
         self.last_sparse = {
             "strategy": "sparse", "reused": task.reused,
             "proof_batches": task.proof_batches,
+            **task.overlap_metrics(),
         }
+        try:
+            from ..metrics import REGISTRY
+
+            m = self.last_sparse
+            REGISTRY.counter("sparse_root_blocks_total").increment()
+            REGISTRY.histogram("sparse_root_overlap_fraction").record(
+                m["overlap_fraction"])
+            REGISTRY.histogram("sparse_root_proof_seconds").record(m["proof"])
+            REGISTRY.histogram("sparse_root_reveal_seconds").record(m["reveal"])
+            REGISTRY.histogram("sparse_root_finish_seconds").record(m["finish"])
+        except Exception:  # noqa: BLE001 — metrics must never fail consensus
+            pass
         self._write_sparse_output(overlay, out, digest_map, storage_roots,
                                   acct_updates, storage_updates)
         return root

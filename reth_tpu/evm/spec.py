@@ -185,6 +185,12 @@ _DELTAS: dict[str, dict] = {
     PRAGUE: dict(  # EIP-2537/2935/6110/7002/7251/7623/7691/7702
         has_setcode=True, calldata_floor=True, max_tx_type=4,
         history_contract_call=True, has_requests=True, blob=PRAGUE_BLOBS,
+        # EIP-2537 extends the precompile ADDRESS RANGE to 0x11 (warming
+        # per EIP-2929 init covers 1..17 — validated against the
+        # reference's hive chain). KNOWN GAP: the BLS operations
+        # themselves are not implemented (their MSM discount tables and
+        # hash-to-curve isogeny constants cannot be verified offline;
+        # a call to 0x0b..0x11 behaves as an empty account).
         precompiles=17,
     ),
     OSAKA: dict(),

@@ -47,6 +47,10 @@ class NodeConfig:
     # analogue, reference StorageSettings). An ephemeral node (datadir None)
     # silently runs memdb: the persistent engines need a directory.
     db_backend: str = "paged"
+    # storage-v2 split layout (history/lookup tables on a dedicated second
+    # store — reference StorageSettings.storage_v2). None = keep the
+    # datadir's persisted layout (default v1 for fresh datadirs)
+    storage_v2: bool | None = None
     ws_port: int | None = None        # WebSocket RPC (None disables; 0 = any)
     ipc_path: str | None = None       # Unix-socket RPC (None disables)
     enable_admin: bool = False        # admin_ is node control: explicit opt-in
@@ -90,7 +94,20 @@ class Node:
         from ..storage import open_database
 
         self.factory = ProviderFactory(
-            open_database(config.db_backend, config.datadir))
+            open_database(config.db_backend, config.datadir,
+                          storage_v2=config.storage_v2))
+        # storage-v2 startup invariants (reference rocksdb/invariants.rs):
+        # reconcile the aux store against the stage checkpoints — prune
+        # what's ahead, unwind what's behind
+        from ..storage.settings import SplitDb, check_consistency
+
+        if isinstance(self.factory.db, SplitDb):
+            target = check_consistency(self.factory)
+            if target is not None:
+                from ..stages import Pipeline, default_stages
+
+                Pipeline(self.factory,
+                         default_stages(committer=self.committer)).unwind(target)
         if config.genesis_header is not None:
             init_genesis(
                 self.factory, config.genesis_header, config.genesis_alloc,

@@ -41,19 +41,59 @@ def store_initialised(backend: str, datadir) -> bool:
     return False
 
 
-def open_database(backend: str, datadir):
-    """Open (creating if absent) the store for ``backend`` in ``datadir``.
-    ``datadir`` None yields an ephemeral MemDb regardless of backend (the
-    persistent engines need a directory)."""
+def _open_store(backend: str, datadir, suffix: str = ""):
     if backend == "native" and datadir is not None:
         from .native import NativeDb
+        from pathlib import Path
 
-        return NativeDb(db_store_path(backend, datadir))
+        return NativeDb(Path(str(db_store_path(backend, datadir)) + suffix))
     if backend == "paged" and datadir is not None:
         from .native import PagedDb
+        from pathlib import Path
 
-        return PagedDb(db_store_path(backend, datadir))
-    return MemDb(db_store_path("memdb", datadir) if datadir else None)
+        return PagedDb(Path(str(db_store_path(backend, datadir)) + suffix))
+    if datadir is not None:
+        from pathlib import Path
+
+        p = db_store_path("memdb", datadir)
+        return MemDb(p.with_name(p.stem + suffix + p.suffix) if suffix else p)
+    return MemDb(None)
+
+
+def open_database(backend: str, datadir, storage_v2: bool | None = None):
+    """Open (creating if absent) the store for ``backend`` in ``datadir``.
+    ``datadir`` None yields an ephemeral MemDb regardless of backend (the
+    persistent engines need a directory).
+
+    ``storage_v2`` requests the split layout (reference StorageSettings
+    storage-v2: history/lookup tables on a dedicated second store,
+    crates/storage/provider/src/providers/rocksdb/). The layout is
+    PERSISTED per datadir on first open; an existing datadir keeps its
+    recorded layout regardless of later flags."""
+    db = _open_store(backend, datadir)
+    from .settings import SplitDb, StorageSettings, read_settings, write_settings
+
+    persisted = read_settings(db)
+    if persisted is None:
+        want_v2 = bool(storage_v2)
+        if want_v2 and datadir is not None and store_initialised(backend, datadir):
+            # an initialised row-less datadir is a v1 layout (legacy or
+            # default): its history already lives in the main store, so a
+            # silent upgrade would make every history read miss
+            raise ValueError(
+                "datadir already initialised with the v1 layout; "
+                "--storage.v2 applies to fresh datadirs only")
+        settings = StorageSettings(storage_v2=want_v2)
+        # v1 stays IMPLICIT (absence of the row): writing on every open
+        # would mark stale auto-created stores as initialised and break
+        # backend resolution; only the v2 opt-in is persisted
+        if settings.storage_v2:
+            write_settings(db, settings)
+    else:
+        settings = persisted  # the datadir's recorded layout wins
+    if not settings.storage_v2:
+        return db
+    return SplitDb(db, _open_store(backend, datadir, suffix="-aux"))
 
 
 __all__ = [

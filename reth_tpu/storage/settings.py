@@ -1,0 +1,291 @@
+"""Storage-V2: history/lookup tables on a dedicated second store.
+
+Reference analogue: the RocksDB storage-v2 provider
+(crates/storage/provider/src/providers/rocksdb/provider.rs:28-40) —
+`StorageSettings.storage_v2` moves `TransactionHashNumbers`,
+`AccountsHistory`/`StoragesHistory` and the changesets out of MDBX into
+a column-family store tuned for their write pattern, and
+`invariants.rs` reconciles that store against the stage checkpoints on
+startup (ahead ⇒ heal by pruning, behind ⇒ unwind target).
+
+Here the second store is another instance of the SAME engine family
+(the paged COW B+tree already supports many trees; a separate FILE is
+the column-family boundary), behind a :class:`SplitDb` router that
+implements the ordinary ``Database`` interface — every provider,
+stage, and RPC path works unchanged on either layout. Commits are
+aux-first then main: a crash between the two leaves the aux store
+AHEAD of the checkpoints, exactly the direction ``check_consistency``
+heals (the reference recovers RocksDB↔MDBX divergence the same way).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from .kv import Cursor, Database, Tx
+from .tables import Tables, be64, from_be64
+
+# tables that move to the aux store under storage-v2 (reference
+# ROCKSDB_TABLES, providers/rocksdb/provider.rs)
+V2_TABLES = frozenset({
+    Tables.TransactionHashNumbers.name,
+    Tables.AccountsHistory.name,
+    Tables.StoragesHistory.name,
+    Tables.AccountChangeSets.name,
+    Tables.StorageChangeSets.name,
+})
+
+_SETTINGS_KEY = b"storage_settings"
+
+
+@dataclass(frozen=True)
+class StorageSettings:
+    """Persisted per-datadir layout switches (reference
+    `StorageSettings`, crates/storage/db-api/src/models)."""
+
+    storage_v2: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps({"storage_v2": self.storage_v2})
+
+    @classmethod
+    def from_json(cls, raw: str) -> "StorageSettings":
+        d = json.loads(raw)
+        return cls(storage_v2=bool(d.get("storage_v2", False)))
+
+
+def read_settings(db: Database) -> StorageSettings | None:
+    with db.tx() as tx:
+        raw = tx.get(Tables.Metadata.name, _SETTINGS_KEY)
+    return StorageSettings.from_json(raw.decode()) if raw is not None else None
+
+
+def write_settings(db: Database, settings: StorageSettings) -> None:
+    tx = db.tx_mut()
+    tx.put(Tables.Metadata.name, _SETTINGS_KEY, settings.to_json().encode())
+    tx.commit()
+
+
+class SplitTx:
+    """Routes table operations to the main or aux transaction."""
+
+    def __init__(self, main: Tx, aux: Tx):
+        self._main = main
+        self._aux = aux
+
+    def _t(self, table: str) -> Tx:
+        return self._aux if table in V2_TABLES else self._main
+
+    def __getattr__(self, name):
+        # engine-internal views the overlay layer probes with hasattr()
+        # (MemDb fast paths): forward them table-routed, but ONLY when the
+        # underlying engine actually has them — a plain method here would
+        # make hasattr() lie for the native C++ backends
+        if name in ("_table", "_sorted_keys"):
+            if not hasattr(self._main, name):
+                raise AttributeError(name)
+
+            def fwd(table, _name=name):
+                return getattr(self._t(table), _name)(table)
+
+            return fwd
+        raise AttributeError(name)
+
+    def get(self, table, key):
+        return self._t(table).get(table, key)
+
+    def get_dups(self, table, key):
+        return self._t(table).get_dups(table, key)
+
+    def cursor(self, table) -> Cursor:
+        return self._t(table).cursor(table)
+
+    def entry_count(self, table) -> int:
+        return self._t(table).entry_count(table)
+
+    def put(self, table, key, value, dupsort: bool = False):
+        return self._t(table).put(table, key, value, dupsort)
+
+    def delete(self, table, key, value=None):
+        return self._t(table).delete(table, key, value)
+
+    def clear(self, table):
+        return self._t(table).clear(table)
+
+    def commit(self):
+        # aux first: a crash in between leaves aux AHEAD of the
+        # checkpoints, which check_consistency() heals by pruning
+        self._aux.commit()
+        self._main.commit()
+
+    def abort(self):
+        self._aux.abort()
+        self._main.abort()
+
+    def __enter__(self):
+        self._aux.__enter__()
+        self._main.__enter__()
+        return self
+
+    def __exit__(self, exc_type, *a):
+        self._aux.__exit__(exc_type, *a)
+        self._main.__exit__(exc_type, *a)
+
+
+class SplitDb(Database):
+    """The storage-v2 layout: a main store + a history/lookup store
+    behind one ``Database`` face."""
+
+    def __init__(self, main: Database, aux: Database):
+        self.main = main
+        self.aux = aux
+
+    def tx(self) -> SplitTx:
+        return SplitTx(self.main.tx(), self.aux.tx())
+
+    def tx_mut(self) -> SplitTx:
+        return SplitTx(self.main.tx_mut(), self.aux.tx_mut())
+
+    def flush(self):
+        for db in (self.aux, self.main):
+            flush = getattr(db, "flush", None)
+            if flush is not None:
+                flush()
+
+    def close(self):
+        for db in (self.aux, self.main):
+            close = getattr(db, "close", None)
+            if close is not None:
+                close()
+
+
+# -- startup invariants (reference providers/rocksdb/invariants.rs) ----------
+
+
+def check_consistency(factory) -> int | None:
+    """Reconcile the aux store against the stage checkpoints. Returns an
+    unwind target when the aux store is BEHIND (the pipeline must rebuild
+    it); entries AHEAD of the checkpoints are pruned in place (healed) —
+    the post-crash direction our aux-first commit order produces."""
+    healed_any = False
+    with factory.provider_rw() as p:
+        exec_cp = p.stage_checkpoint("Execution") or 0
+        lookup_cp = p.stage_checkpoint("TransactionLookup") or 0
+        acct_hist_cp = p.stage_checkpoint("IndexAccountHistory") or 0
+        stor_hist_cp = p.stage_checkpoint("IndexStorageHistory") or 0
+        tip = p.last_block_number()
+
+        # TransactionHashNumbers AHEAD: excess entries belong to blocks in
+        # (lookup_cp, tip] — heal from the block bodies (O(crash window),
+        # never a full-table scan; the reference heals from changesets the
+        # same way). BEHIND: a missing checkpoint-range hash => unwind.
+        for n in range(lookup_cp + 1, tip + 1):
+            for tx in p.transactions_by_block(n) or []:
+                if p.tx.delete(Tables.TransactionHashNumbers.name, tx.hash):
+                    healed_any = True
+        unwind: int | None = None
+        idx = p.block_body_indices(lookup_cp) if lookup_cp else None
+        if lookup_cp and idx and idx.tx_count > 0:
+            txs = p.transactions_by_block(lookup_cp) or []
+            if txs and p.tx.get(Tables.TransactionHashNumbers.name,
+                                txs[-1].hash) is None:
+                unwind = _last_indexed_block(p, lookup_cp)
+
+        # history shards: only addresses touched above the checkpoint can
+        # hold excess entries — walk the crash window's changesets, then
+        # filter just those shards
+        healed_any |= _heal_history_window(
+            p, Tables.AccountsHistory.name, acct_hist_cp, tip,
+            _account_prefixes_in_window(p, acct_hist_cp, tip))
+        healed_any |= _heal_history_window(
+            p, Tables.StoragesHistory.name, stor_hist_cp, tip,
+            _storage_prefixes_in_window(p, stor_hist_cp, tip))
+
+        # changesets above the execution checkpoint are unreachable
+        # (their blocks re-execute on restart): prune by key seek
+        healed_any |= _prune_changesets_above(p, exec_cp)
+    if healed_any:
+        factory.db.flush()
+    return unwind
+
+
+def _last_indexed_block(p, checkpoint: int, max_scan: int = 4096) -> int:
+    """Highest block whose last tx hash IS present in the lookup table
+    (the unwind target when the aux store is behind)."""
+    n = checkpoint
+    scanned = 0
+    while n > 0 and scanned < max_scan:
+        txs = p.transactions_by_block(n) or []
+        if not txs:
+            n -= 1
+            scanned += 1
+            continue
+        if p.tx.get(Tables.TransactionHashNumbers.name,
+                    txs[-1].hash) is not None:
+            return n
+        n -= 1
+        scanned += 1
+    return 0
+
+
+_TAIL = be64((1 << 64) - 1)
+
+
+def _account_prefixes_in_window(p, checkpoint: int, tip: int) -> set[bytes]:
+    if tip <= checkpoint:
+        return set()
+    return set(p.account_changes_in_range(checkpoint + 1, tip))
+
+
+def _storage_prefixes_in_window(p, checkpoint: int, tip: int) -> set[bytes]:
+    if tip <= checkpoint:
+        return set()
+    out: set[bytes] = set()
+    for addr, slots in p.storage_changes_in_range(checkpoint + 1, tip).items():
+        for s in slots:
+            out.add(addr + s)
+    return out
+
+
+def _heal_history_window(p, table: str, checkpoint: int, tip: int,
+                         prefixes: set[bytes]) -> bool:
+    """Filter the affected shards' block lists down to the checkpoint —
+    only addresses touched in the crash window can hold excess entries,
+    so the heal is O(window), never a table scan. A shard's VALUE is
+    ascending be64 block numbers; the open tail shard keeps its u64::MAX
+    key, closed shards re-key under their new maximum."""
+    to_fix: list[tuple[bytes, bytes, bytes]] = []
+    for prefix in prefixes:
+        cur = p.tx.cursor(table)
+        item = cur.seek(prefix + be64(checkpoint + 1))
+        while item is not None and bytes(item[0][:len(prefix)]) == prefix:
+            to_fix.append((prefix, bytes(item[0]), bytes(item[1])))
+            item = cur.next()
+    for prefix, key, raw in to_fix:
+        keep = [from_be64(raw[i:i + 8]) for i in range(0, len(raw), 8)]
+        keep = [b for b in keep if b <= checkpoint]
+        p.tx.delete(table, key)
+        if keep:
+            new_key = (key if key[-8:] == _TAIL
+                       else prefix + be64(keep[-1]))
+            p.tx.put(table, new_key, b"".join(be64(b) for b in keep))
+    return bool(to_fix)
+
+
+def _prune_changesets_above(p, checkpoint: int) -> bool:
+    """Changeset keys are be64(block)-prefixed: one seek past the
+    checkpoint bounds the walk to the crash window."""
+    healed = False
+    for table in (Tables.AccountChangeSets.name,
+                  Tables.StorageChangeSets.name):
+        cur = p.tx.cursor(table)
+        doomed = []
+        item = cur.seek(be64(checkpoint + 1))
+        while item is not None:
+            doomed.append(bytes(item[0]))
+            item = cur.next()
+        for k in dict.fromkeys(doomed):
+            p.tx.delete(table, k)  # value None drops every duplicate
+            healed = True
+    return healed

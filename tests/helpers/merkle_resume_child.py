@@ -68,6 +68,8 @@ def main():
             out = stage.execute(p, ExecInput(target, 0))
         if out.done:
             break
+        print("CHUNK", flush=True)  # progress marker: the parent waits
+        # for this before landing its SIGKILL (timing-free under load)
         if slow:
             time.sleep(0.5)
     assert out.done, "rebuild did not finish"

@@ -196,10 +196,28 @@ def test_kill9_mid_rebuild_then_restart(tmp_path):
     assert p.returncode == 0, out
 
     p = spawn("rebuild", slow=True)
-    time.sleep(6)  # child sleeps per chunk; land the kill mid-rebuild
-    killed_mid_run = p.poll() is None
-    if killed_mid_run:
-        p.send_signal(signal.SIGKILL)
+    # wait for the CHUNK progress marker, then kill: guarantees the kill
+    # lands AFTER a committed chunk regardless of machine load (a fixed
+    # sleep killed during interpreter startup under parallel test runs)
+    killed_mid_run = False
+    # readline() blocks: a watchdog kills a wedged child so the test
+    # stays bounded no matter what
+    import threading
+
+    watchdog = threading.Timer(120, p.kill)
+    watchdog.start()
+    try:
+        while True:
+            line = p.stdout.readline()
+            if not line:  # child finished before any chunk boundary
+                break
+            if "CHUNK" in line:
+                killed_mid_run = p.poll() is None
+                if killed_mid_run:
+                    p.send_signal(signal.SIGKILL)
+                break
+    finally:
+        watchdog.cancel()
     p.wait(timeout=60)
 
     p = spawn("rebuild")

@@ -48,7 +48,10 @@ def test_streaming_keccak_matches_oneshot():
 @pytest.mark.parametrize("payload", [
     b"", b"a", b"hello world", bytes(range(256)),
     b"ab" * 5000,                      # highly compressible
-    os.urandom(3000),                  # incompressible
+    # incompressible but DETERMINISTIC (xdist workers must collect
+    # identical parametrize ids)
+    b"".join(__import__("hashlib").sha256(bytes([i])).digest()
+             for i in range(94)),
     b"\x00" * 100000,
 ])
 def test_snappy_roundtrip(payload):

@@ -223,3 +223,20 @@ def test_invalid_block_does_not_poison_preserved_trie():
     st2 = tree.on_new_payload(good)
     assert st2.status is PayloadStatusKind.VALID, st2.validation_error
     assert tree.last_sparse["strategy"] == "sparse"
+
+
+def test_sparse_overlap_metrics_recorded():
+    """Round-5 directive: every sparse block records its wall breakdown
+    (proof/reveal/finish/worker_busy) and overlap fraction — the honest
+    measurement of how much trie work ran while the EVM executed."""
+    alice, builder, factory = storage_env()
+    blocks = busy_blocks(alice, builder)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=10)
+    stats = feed(tree, blocks)
+    for m in stats:
+        assert m["strategy"] == "sparse"
+        for key in ("proof", "reveal", "finish", "worker_busy",
+                    "exec_wall", "overlap_fraction"):
+            assert key in m, key
+        assert 0.0 <= m["overlap_fraction"] <= 1.0
+        assert m["finish"] >= 0.0
