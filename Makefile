@@ -18,6 +18,7 @@ test-serial:
 	  python -m pytest tests -q -p no:cacheprovider
 
 native:
+	mkdir -p native/build
 	g++ -O2 -std=c++17 -shared -fPIC native/triebuild.cpp -o native/build/libtriebuild.so
 	g++ -O2 -std=c++17 -shared -fPIC native/secp256k1.cpp -o native/build/libsecp.so
 	g++ -O2 -std=c++17 -shared -fPIC native/kvstore.cpp -o native/build/libkvstore.so

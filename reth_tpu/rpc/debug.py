@@ -163,7 +163,7 @@ class DebugApi:
 
     def _replay(self, tx_hash, tracer):
         """Re-execute the block prefix, then the target tx with ``tracer``."""
-        from ..evm import BlockExecutor, EvmConfig
+        from ..evm import BlockExecutor
         from ..evm.state import EvmState
         from ..storage.tables import Tables, from_be64
         from .convert import parse_data, qty
@@ -185,7 +185,7 @@ class DebugApi:
         # parent state through the SAME guards as eth state queries (prune
         # horizon, unknown blocks) — never trace against silently-wrong state
         parent_state = self.eth._state_at(qty(block_num - 1)) if block_num > 0 else p
-        executor = BlockExecutor(parent_state, EvmConfig(chain_id=self.eth.chain_id))
+        executor = BlockExecutor(parent_state, self.eth.tree.config)
         from ..evm.interpreter import BlockEnv
 
         header = block.header
@@ -284,7 +284,7 @@ class DebugApi:
     def _trace_block(self, p, block_num, opts):
         """Execute the block ONCE, attaching a fresh tracer to each tx on
         the shared state — not one whole-prefix replay per tx."""
-        from ..evm import BlockExecutor, EvmConfig
+        from ..evm import BlockExecutor
         from ..evm.interpreter import BlockEnv
         from ..evm.state import EvmState
         from .convert import data, qty
@@ -298,7 +298,7 @@ class DebugApi:
         parent_state = (self.eth._state_at(qty(block_num - 1))
                         if block_num > 0 else p)
         executor = BlockExecutor(parent_state,
-                                 EvmConfig(chain_id=self.eth.chain_id))
+                                 self.eth.tree.config)
         header = block.header
         block_hashes = {}
         for k in range(max(0, block_num - 256), block_num):
@@ -333,7 +333,6 @@ class DebugApi:
         trie nodes, bytecodes, touched keys, ancestor headers (reference
         debug_executionWitness, crates/rpc/rpc/src/debug.rs)."""
         from ..engine.witness import generate_witness
-        from ..evm import EvmConfig
         from .server import RpcError
 
         p = self.eth._provider()
@@ -364,7 +363,7 @@ class DebugApi:
                 hashes[k] = bh
         w = generate_witness(
             parent_state, block, self.eth.tree.committer, senders,
-            parent_header, EvmConfig(chain_id=self.eth.chain_id),
+            parent_header, self.eth.tree.config,
             block_hashes=hashes,
         )
         return w.to_json()

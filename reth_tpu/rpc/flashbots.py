@@ -8,7 +8,7 @@ results, gas, and the coinbase payment summary.
 
 from __future__ import annotations
 
-from ..evm import BlockExecutor, EvmConfig
+from ..evm import BlockExecutor
 from ..evm.state import EvmState
 from ..primitives.keccak import keccak256
 from ..primitives.types import Transaction
@@ -42,7 +42,7 @@ class BundleApi:
         from ..evm.executor import ProviderStateSource
 
         executor = BlockExecutor(ProviderStateSource(p),
-                                 EvmConfig(chain_id=self.eth.chain_id))
+                                 self.eth.tree.config)
         state = EvmState(executor.source)
         coinbase_before = state.balance(env.coinbase)
         results = []

@@ -67,12 +67,18 @@ def write_settings(db: Database, settings: StorageSettings) -> None:
     tx.commit()
 
 
+_EPOCH_KEY = b"split_commit_epoch"
+
+
 class SplitTx:
     """Routes table operations to the main or aux transaction."""
 
-    def __init__(self, main: Tx, aux: Tx):
+    def __init__(self, main: Tx, aux: Tx, db: "SplitDb | None" = None,
+                 write: bool = False):
         self._main = main
         self._aux = aux
+        self._db = db
+        self._write = write
 
     def _t(self, table: str) -> Tx:
         return self._aux if table in V2_TABLES else self._main
@@ -114,8 +120,13 @@ class SplitTx:
         return self._t(table).clear(table)
 
     def commit(self):
-        # aux first: a crash in between leaves aux AHEAD of the
-        # checkpoints, which check_consistency() heals by pruning
+        # every write commit stamps BOTH stores with the same epoch, aux
+        # first: a crash in between leaves aux one epoch ahead — the
+        # exact signal check_consistency() keys its healing on
+        if self._db is not None and self._write:
+            epoch = self._db.next_epoch()
+            self._aux.put(Tables.Metadata.name, _EPOCH_KEY, be64(epoch))
+            self._main.put(Tables.Metadata.name, _EPOCH_KEY, be64(epoch))
         self._aux.commit()
         self._main.commit()
 
@@ -140,12 +151,18 @@ class SplitDb(Database):
     def __init__(self, main: Database, aux: Database):
         self.main = main
         self.aux = aux
+        self._epoch = max(_read_epoch(main), _read_epoch(aux))
+
+    def next_epoch(self) -> int:
+        self._epoch += 1
+        return self._epoch
 
     def tx(self) -> SplitTx:
         return SplitTx(self.main.tx(), self.aux.tx())
 
     def tx_mut(self) -> SplitTx:
-        return SplitTx(self.main.tx_mut(), self.aux.tx_mut())
+        return SplitTx(self.main.tx_mut(), self.aux.tx_mut(),
+                       db=self, write=True)
 
     def flush(self):
         for db in (self.aux, self.main):
@@ -163,27 +180,34 @@ class SplitDb(Database):
 # -- startup invariants (reference providers/rocksdb/invariants.rs) ----------
 
 
+def _read_epoch(db: Database) -> int:
+    with db.tx() as tx:
+        raw = tx.get(Tables.Metadata.name, _EPOCH_KEY)
+    return from_be64(raw) if raw else 0
+
+
 def check_consistency(factory) -> int | None:
-    """Reconcile the aux store against the stage checkpoints. Returns an
-    unwind target when the aux store is BEHIND (the pipeline must rebuild
-    it); entries AHEAD of the checkpoints are pruned in place (healed) —
-    the post-crash direction our aux-first commit order produces."""
+    """Reconcile the aux store against the main store on startup.
+
+    A CLEAN datadir (both stores stamped with the same commit epoch —
+    including every normal mid-sync restart, where stage checkpoints
+    legitimately lag the canonical tip) passes with one cheap probe. A
+    TORN commit (aux stamped one epoch ahead: the crash window of the
+    aux-first commit order) triggers healing: orphaned lookup rows whose
+    tx numbers exceed the committed tx space are pruned, history shards
+    touched by the orphaned changesets are refiltered through the index
+    stages' own shard surgery, and the orphaned changesets are dropped.
+    The post-heal commit re-stamps both stores with one epoch. An aux
+    store BEHIND the main store (lost aux data) returns an unwind target
+    for the pipeline to rebuild from."""
+    db = factory.db
+    torn = _read_epoch(db.aux) != _read_epoch(db.main)
     healed_any = False
     with factory.provider_rw() as p:
-        exec_cp = p.stage_checkpoint("Execution") or 0
         lookup_cp = p.stage_checkpoint("TransactionLookup") or 0
-        acct_hist_cp = p.stage_checkpoint("IndexAccountHistory") or 0
-        stor_hist_cp = p.stage_checkpoint("IndexStorageHistory") or 0
         tip = p.last_block_number()
-
-        # TransactionHashNumbers AHEAD: excess entries belong to blocks in
-        # (lookup_cp, tip] — heal from the block bodies (O(crash window),
-        # never a full-table scan; the reference heals from changesets the
-        # same way). BEHIND: a missing checkpoint-range hash => unwind.
-        for n in range(lookup_cp + 1, tip + 1):
-            for tx in p.transactions_by_block(n) or []:
-                if p.tx.delete(Tables.TransactionHashNumbers.name, tx.hash):
-                    healed_any = True
+        # cheap behind probe (always): the lookup rows for the checkpoint
+        # block must exist — body insertion wrote them
         unwind: int | None = None
         idx = p.block_body_indices(lookup_cp) if lookup_cp else None
         if lookup_cp and idx and idx.tx_count > 0:
@@ -192,20 +216,44 @@ def check_consistency(factory) -> int | None:
                                 txs[-1].hash) is None:
                 unwind = _last_indexed_block(p, lookup_cp)
 
-        # history shards: only addresses touched above the checkpoint can
-        # hold excess entries — walk the crash window's changesets, then
-        # filter just those shards
-        healed_any |= _heal_history_window(
-            p, Tables.AccountsHistory.name, acct_hist_cp, tip,
-            _account_prefixes_in_window(p, acct_hist_cp, tip))
-        healed_any |= _heal_history_window(
-            p, Tables.StoragesHistory.name, stor_hist_cp, tip,
-            _storage_prefixes_in_window(p, stor_hist_cp, tip))
+        if torn:
+            exec_cp = p.stage_checkpoint("Execution") or 0
+            acct_hist_cp = p.stage_checkpoint("IndexAccountHistory") or 0
+            stor_hist_cp = p.stage_checkpoint("IndexStorageHistory") or 0
+            # orphaned lookup rows: their tx numbers lie beyond the
+            # committed tx space (the bodies were never committed, so the
+            # rows are unreachable by any canonical path)
+            idx_tip = p.block_body_indices(tip)
+            max_tx = idx_tip.next_tx_num - 1 if idx_tip else -1
+            cur = p.tx.cursor(Tables.TransactionHashNumbers.name)
+            doomed = []
+            item = cur.first()
+            while item is not None:
+                if from_be64(item[1]) > max_tx:
+                    doomed.append(bytes(item[0]))
+                item = cur.next()
+            for k in doomed:
+                p.tx.delete(Tables.TransactionHashNumbers.name, k)
+                healed_any = True
+            # history shards: gather prefixes from the orphaned window's
+            # changesets FIRST (they may reference blocks above the tip),
+            # refilter through the index stages' own shard surgery, THEN
+            # drop the orphaned changesets
+            far = (1 << 48)
+            from ..stages.index_history import _unwind_shards
 
-        # changesets above the execution checkpoint are unreachable
-        # (their blocks re-execute on restart): prune by key seek
-        healed_any |= _prune_changesets_above(p, exec_cp)
-    if healed_any:
+            for addr in _account_prefixes_in_window(p, acct_hist_cp, far):
+                _unwind_shards(p, Tables.AccountsHistory.name, addr,
+                               acct_hist_cp + 1)
+                healed_any = True
+            for prefix in _storage_prefixes_in_window(p, stor_hist_cp, far):
+                _unwind_shards(p, Tables.StoragesHistory.name, prefix,
+                               stor_hist_cp + 1)
+                healed_any = True
+            healed_any |= _prune_changesets_above(p, exec_cp)
+    # the provider commit above went through SplitTx.commit, which stamps
+    # BOTH stores with a fresh shared epoch — the torn marker is cleared
+    if healed_any or torn:
         factory.db.flush()
     return unwind
 
@@ -229,9 +277,6 @@ def _last_indexed_block(p, checkpoint: int, max_scan: int = 4096) -> int:
     return 0
 
 
-_TAIL = be64((1 << 64) - 1)
-
-
 def _account_prefixes_in_window(p, checkpoint: int, tip: int) -> set[bytes]:
     if tip <= checkpoint:
         return set()
@@ -246,31 +291,6 @@ def _storage_prefixes_in_window(p, checkpoint: int, tip: int) -> set[bytes]:
         for s in slots:
             out.add(addr + s)
     return out
-
-
-def _heal_history_window(p, table: str, checkpoint: int, tip: int,
-                         prefixes: set[bytes]) -> bool:
-    """Filter the affected shards' block lists down to the checkpoint —
-    only addresses touched in the crash window can hold excess entries,
-    so the heal is O(window), never a table scan. A shard's VALUE is
-    ascending be64 block numbers; the open tail shard keeps its u64::MAX
-    key, closed shards re-key under their new maximum."""
-    to_fix: list[tuple[bytes, bytes, bytes]] = []
-    for prefix in prefixes:
-        cur = p.tx.cursor(table)
-        item = cur.seek(prefix + be64(checkpoint + 1))
-        while item is not None and bytes(item[0][:len(prefix)]) == prefix:
-            to_fix.append((prefix, bytes(item[0]), bytes(item[1])))
-            item = cur.next()
-    for prefix, key, raw in to_fix:
-        keep = [from_be64(raw[i:i + 8]) for i in range(0, len(raw), 8)]
-        keep = [b for b in keep if b <= checkpoint]
-        p.tx.delete(table, key)
-        if keep:
-            new_key = (key if key[-8:] == _TAIL
-                       else prefix + be64(keep[-1]))
-            p.tx.put(table, new_key, b"".join(be64(b) for b in keep))
-    return bool(to_fix)
 
 
 def _prune_changesets_above(p, checkpoint: int) -> bool:

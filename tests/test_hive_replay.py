@@ -199,3 +199,19 @@ def test_pre_byzantium_receipt_roots_match_headers():
         for ch, code in out.changes.new_bytecodes.items():
             src.codes[ch] = code
     assert checked >= 20  # the segment is transaction-dense
+
+
+def test_debug_trace_historical_block_uses_its_fork(hive_node):
+    """debug_traceBlockByNumber re-executes under the block's OWN rule
+    set (round-5: the trace paths take the node's chainspec-carrying
+    EvmConfig). Block 5 is homestead/tangerine-era: tracing it under
+    latest rules would reject its pre-EIP-155 transactions outright."""
+    node, blocks = hive_node
+    port = node.rpc.port
+    got = _raw_rpc(port, {"jsonrpc": "2.0", "id": 1,
+                          "method": "debug_traceBlockByNumber",
+                          "params": ["0x5", {"tracer": "callTracer"}]})
+    assert "error" not in got, got
+    traces = got["result"]
+    assert len(traces) == len(blocks[4].transactions)
+    assert all("result" in t for t in traces)

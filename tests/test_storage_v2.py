@@ -110,34 +110,67 @@ def test_settings_persist_per_datadir(tmp_path):
     assert not isinstance(open_database("memdb", other), SplitDb)
 
 
-def test_invariants_heal_aux_ahead():
-    """Aux entries beyond the checkpoints (the post-crash direction) are
-    pruned in place; nothing demands an unwind. Simulated the way the
-    crash actually presents: the aux store holds the tip block's rows but
-    the checkpoints only reached tip-1."""
+def test_clean_restart_skips_heavy_checks_and_keeps_rows():
+    """A clean (or plain mid-sync) restart must NOT touch legitimate aux
+    rows: lookup entries are written at body-insert time and may sit far
+    beyond the TransactionLookup checkpoint without any anomaly."""
+    split = SplitDb(MemDb(), MemDb())
+    factory, builder, _ = _synced_factory(split)
+    with factory.provider_rw() as p:
+        # mid-sync shape: checkpoints behind, rows present (NORMAL)
+        p.save_stage_checkpoint("TransactionLookup", 1)
+    assert check_consistency(factory) is None
+    with factory.provider() as p:
+        for blk in builder.blocks[1:]:
+            for tx in blk.transactions:
+                assert p.tx.get(Tables.TransactionHashNumbers.name,
+                                tx.hash) is not None
+
+
+def test_invariants_heal_torn_commit():
+    """A TORN commit (aux stamped one epoch ahead of main) triggers
+    healing: orphaned lookup rows beyond the committed tx space are
+    pruned, history shards touched by orphaned changesets refiltered,
+    orphaned changesets dropped — and the epochs converge again."""
+    from reth_tpu.storage.settings import _EPOCH_KEY, _read_epoch
+
     split = SplitDb(MemDb(), MemDb())
     factory, builder, _ = _synced_factory(split)
     tip = len(builder.blocks) - 1
-    tip_tx_hashes = [tx.hash for tx in builder.blocks[-1].transactions]
     with factory.provider_rw() as p:
-        for stage in ("TransactionLookup", "IndexAccountHistory",
-                      "IndexStorageHistory"):
-            p.save_stage_checkpoint(stage, tip - 1)
-        assert any(p.tx.get(Tables.TransactionHashNumbers.name, h)
-                   for h in tip_tx_hashes)
+        # the crash shape: aux committed block tip+1's rows, main didn't.
+        # Orphan lookup row (tx number beyond the committed space),
+        # orphan history via a changeset above the exec checkpoint.
+        idx = p.block_body_indices(tip)
+        p.tx.put(Tables.TransactionHashNumbers.name, b"\xfa" * 32,
+                 be64(idx.next_tx_num + 7))
+        p.tx.put(Tables.AccountChangeSets.name, be64(tip + 1),
+                 b"\x0b" * 20 + b"", dupsort=True)
+        tail = be64((1 << 64) - 1)
+        raw = p.tx.get(Tables.AccountsHistory.name, b"\x0b" * 20 + tail)
+        p.tx.put(Tables.AccountsHistory.name, b"\x0b" * 20 + tail,
+                 (raw or b"") + be64(tip + 1))
+    # stamp the torn state AFTER the setup commit (which synced epochs)
+    tx = split.aux.tx_mut()
+    tx.put(Tables.Metadata.name, _EPOCH_KEY, be64(_read_epoch(split.aux) + 1))
+    tx.commit()
+    assert _read_epoch(split.aux) != _read_epoch(split.main)
+
     assert check_consistency(factory) is None
     with factory.provider() as p:
-        # tip-block lookup rows healed away (the stage re-adds them)
-        for h in tip_tx_hashes:
-            assert p.tx.get(Tables.TransactionHashNumbers.name, h) is None
-        # history shards no longer reference the tip block
-        cur = p.tx.cursor(Tables.AccountsHistory.name)
-        item = cur.first()
-        while item is not None:
-            blocks = [int.from_bytes(item[1][i:i + 8], "big")
-                      for i in range(0, len(item[1]), 8)]
-            assert all(b <= tip - 1 for b in blocks), blocks
-            item = cur.next()
+        assert p.tx.get(Tables.TransactionHashNumbers.name,
+                        b"\xfa" * 32) is None
+        assert not p.tx.get_dups(Tables.AccountChangeSets.name, be64(tip + 1))
+        raw = p.tx.get(Tables.AccountsHistory.name,
+                       b"\x0b" * 20 + be64((1 << 64) - 1))
+        blocks = [int.from_bytes(raw[i:i + 8], "big")
+                  for i in range(0, len(raw or b""), 8)]
+        assert all(b <= tip for b in blocks), blocks
+        # legitimate rows survived the heal
+        for tx_ in builder.blocks[-1].transactions:
+            assert p.tx.get(Tables.TransactionHashNumbers.name,
+                            tx_.hash) is not None
+    assert _read_epoch(split.aux) == _read_epoch(split.main)
 
 
 def test_invariants_detect_aux_behind():
