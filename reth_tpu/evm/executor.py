@@ -25,7 +25,6 @@ from ..primitives.types import (
     EIP4844_TX_TYPE,
     EIP7702_TX_TYPE,
     GAS_PER_BLOB,
-    Log,
     Receipt,
     Transaction,
 )
@@ -35,9 +34,7 @@ from .interpreter import (
     G_ACCESS_LIST_ADDR,
     G_ACCESS_LIST_SLOT,
     G_INITCODE_WORD,
-    G_NONZERO_BYTE,
     G_TX,
-    G_TX_CREATE,
     G_ZERO_BYTE,
     Halt,
     Interpreter,

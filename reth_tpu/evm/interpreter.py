@@ -1075,7 +1075,7 @@ def _pre_bn_pairing(data: bytes, gas: int, base: int = 45_000, per: int = 34_000
     if gas < cost:
         return False, 0, b""
     gas -= cost
-    from ..primitives.pairing import BN254, g2_group, g2_valid, pairing_product_is_one
+    from ..primitives.pairing import BN254, g2_valid, pairing_product_is_one
 
     pairs = []
     for i in range(k):

@@ -497,7 +497,7 @@ class EthApi:
         from dataclasses import replace as _dc_replace
 
         from ..consensus.validation import calc_next_base_fee
-        from ..evm import BlockExecutor, EvmConfig
+        from ..evm import BlockExecutor
         from ..evm.executor import InvalidTransaction
         from ..primitives.types import (
             Account, Block, EMPTY_ROOT_HASH, Header, Transaction, logs_bloom,
