@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial native
+.PHONY: test test-serial test-faults native
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -16,6 +16,12 @@ test:
 test-serial:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests -q -p no:cacheprovider
+
+# device-supervisor failover drill: probes, breaker, watchdog, mid-commit
+# CPU failover + fault injection — CPU-only, no device required
+test-faults:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_supervisor.py -q -p no:cacheprovider
 
 native:
 	mkdir -p native/build

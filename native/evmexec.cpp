@@ -785,10 +785,13 @@ class TxMachine {
           if (sz == 0) break;
           uint64_t dd = check_off(d);
           mem_expand(dd, sz);
+          // clamp ss >= data.size() to zero-fill: `ss + i` wraps uint64
+          // for src offsets near 2^64 and would read real calldata
           uint64_t ss = s.w[1]|s.w[2]|s.w[3] ? ~0ULL : s.w[0];
+          uint64_t avail =
+              ss < tx_.data.size() ? tx_.data.size() - ss : 0;
           for (uint64_t i = 0; i < sz; i++)
-            mem[dd + i] = (ss != ~0ULL && ss + i < tx_.data.size())
-                              ? tx_.data[ss + i] : 0;
+            mem[dd + i] = i < avail ? tx_.data[ss + i] : 0;
           break;
         }
         case 0x38: { use(2); push(from_u64(n)); break; }
@@ -799,9 +802,11 @@ class TxMachine {
           if (sz == 0) break;
           uint64_t dd = check_off(d);
           mem_expand(dd, sz);
+          // same uint64 `ss + i` wrap clamp as CALLDATACOPY above
           uint64_t ss = s.w[1]|s.w[2]|s.w[3] ? ~0ULL : s.w[0];
+          uint64_t avail = ss < n ? n - ss : 0;
           for (uint64_t i = 0; i < sz; i++)
-            mem[dd + i] = (ss != ~0ULL && ss + i < n) ? code[ss + i] : 0;
+            mem[dd + i] = i < avail ? code[ss + i] : 0;
           break;
         }
         case 0x3A: { use(2); push(tx_.eff_price); break; }

@@ -33,11 +33,26 @@ def _num(v, default=0) -> int:
 def _make_committer(args):
     from .trie.committer import TrieCommitter
 
-    if getattr(args, "hasher", "device") == "cpu":
+    mode = getattr(args, "hasher", "device")
+    if mode == "cpu":
         from .primitives.keccak import keccak256_batch_np
 
         committer = TrieCommitter(hasher=keccak256_batch_np)
         committer.turbo_backend = "numpy"  # MerkleStage clean-path backend
+    elif mode == "auto":
+        # supervised device route (ops/supervisor.py): startup health
+        # probe, watchdog-bounded dispatch, circuit breaker with CPU
+        # failover — a wedged tunnel degrades the node, never hangs it
+        from .ops.supervisor import DeviceSupervisor
+
+        sup = DeviceSupervisor.shared()
+        healthy = sup.startup()
+        committer = TrieCommitter(supervisor=sup)
+        committer.turbo_backend = "auto"
+        if not healthy:
+            print(f"hasher auto: device unhealthy at startup "
+                  f"({sup.last_probe.diag}); routing to cpu until a "
+                  f"re-probe succeeds", file=sys.stderr)
     else:
         committer = TrieCommitter()
         committer.turbo_backend = "device"
@@ -858,9 +873,14 @@ def main(argv=None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
 
     def add_hasher(p):
-        p.add_argument("--hasher", choices=["device", "cpu"], default="device",
+        p.add_argument("--hasher", choices=["device", "cpu", "auto"],
+                       default="device",
                        help="keccak backend: device (TPU/XLA, the "
-                            "--state-root.backend analogue) or cpu (numpy)")
+                            "--state-root.backend analogue), cpu (numpy), "
+                            "or auto (device behind the health-probe + "
+                            "circuit-breaker supervisor; falls over to cpu "
+                            "on wedged dispatches — see RETH_TPU_FAULT_* "
+                            "env knobs for drill/testing)")
 
     def add_db_arg(p):
         # paged (the COW B+tree / MDBX analogue) is the DEFAULT everywhere

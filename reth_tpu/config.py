@@ -44,7 +44,7 @@ class RethTpuConfig:
     stages: StageConfig = field(default_factory=StageConfig)
     prune: PruneModes = field(default_factory=PruneModes)
     persistence_threshold: int = 2
-    hasher: str = "device"  # device | cpu
+    hasher: str = "device"  # device | cpu | auto (supervised device)
 
 
 def _prune_mode(d: dict) -> PruneMode:

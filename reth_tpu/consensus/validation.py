@@ -134,6 +134,28 @@ def validate_header_against_parent(header: Header, parent: Header,
     elif spec is not None and (header.excess_blob_gas is not None
                                or header.blob_gas_used is not None):
         raise ConsensusError("blob gas fields before Cancun")
+    # EIP-4788 parent beacon block root (Cancun) and EIP-7685 requests hash
+    # (Prague): fork-mandated presence, rejected pre-fork — same gating
+    # shape as the blob fields above. Without a chainspec the activation is
+    # parent-driven: once the chain carries a field it can never be
+    # dropped (a header that omits it would sidestep the beacon-root
+    # system call / requests commitment entirely).
+    beacon_active = (spec.beacon_root_call if spec is not None else
+                     (parent.parent_beacon_block_root is not None
+                      or header.parent_beacon_block_root is not None))
+    if beacon_active:
+        if header.parent_beacon_block_root is None:
+            raise ConsensusError("missing parent beacon block root post-Cancun")
+    elif spec is not None and header.parent_beacon_block_root is not None:
+        raise ConsensusError("parent beacon block root before Cancun")
+    requests_active = (spec.has_requests if spec is not None else
+                       (parent.requests_hash is not None
+                        or header.requests_hash is not None))
+    if requests_active:
+        if header.requests_hash is None:
+            raise ConsensusError("missing requests hash post-Prague")
+    elif spec is not None and header.requests_hash is not None:
+        raise ConsensusError("requests hash before Prague")
 
 
 def validate_block_pre_execution(block: Block, committer=None,

@@ -33,6 +33,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from ..evm.executor import BlockExecutor, blob_base_fee
+from ..evm.spec import LATEST_SPEC
 from ..evm.interpreter import BlockEnv
 from ..evm.state import EvmState, StateSource
 from ..primitives.types import Account, Block, Receipt
@@ -205,7 +206,7 @@ def _block_env(block: Block, config, block_hashes=None) -> BlockEnv:
         blob_base_fee=blob_base_fee(
             h.excess_blob_gas or 0,
             config.blob_params_for(h.number, h.timestamp).update_fraction
-            if config is not None else 3_338_477),
+            if config is not None else LATEST_SPEC.blob.update_fraction),
     )
 
 

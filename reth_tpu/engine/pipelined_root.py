@@ -30,6 +30,7 @@ class PipelinedStateRoot:
         self._lock = threading.Lock()
         self._sent: set[bytes] = set()
         self.batches_hashed = 0
+        self.batches_failed = 0
         self.hash_spans: list[tuple[float, float]] = []  # worker activity
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -55,7 +56,16 @@ class PipelinedStateRoot:
             if batch is None:
                 return
             t0 = time.monotonic()
-            digests = self.hasher(batch)
+            try:
+                digests = self.hasher(batch)
+            except Exception:  # noqa: BLE001 — a dying worker would silently
+                # serialize ALL hashing into finish(); with a supervised
+                # hasher (ops/supervisor.py) failures route to the CPU and
+                # never land here, but an unsupervised device hasher must
+                # not take the stream down — the keys re-hash in finish()
+                with self._lock:
+                    self.batches_failed += 1
+                continue
             with self._lock:
                 for k, d in zip(batch, digests):
                     self._digests[k] = d

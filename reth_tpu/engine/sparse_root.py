@@ -54,6 +54,11 @@ class SparseRootTask:
     def __init__(self, parent_provider, parent_root: bytes, preserved,
                  committer, parent_hash: bytes | None = None):
         self.hasher = committer.hasher
+        # committer wired through --hasher auto carries the device
+        # supervisor: its hasher already watchdogs + CPU-fails-over every
+        # device batch, so a wedged tunnel degrades this task instead of
+        # hanging the worker thread mid-block; kept for observability
+        self.supervisor = getattr(committer, "supervisor", None)
         self.calc = ProofCalculator(parent_provider, committer)
         self.preserved = preserved
         self.reused = False
@@ -240,12 +245,15 @@ class SparseRootTask:
         busy_during_exec = getattr(self, "_busy_at_finish",
                                    self.walls["worker_busy"])
         overlapped = min(busy_during_exec, exec_wall)
-        return {
+        out = {
             **{k: round(v, 6) for k, v in self.walls.items()},
             "exec_wall": round(exec_wall, 6),
             "overlap_fraction": round(overlapped / exec_wall, 4)
             if exec_wall > 0 else 0.0,
         }
+        if self.supervisor is not None:
+            out["hasher_breaker"] = self.supervisor.breaker.state
+        return out
 
     def preserve(self, block_hash: bytes) -> None:
         """Anchor the updated trie for the next payload (call after the

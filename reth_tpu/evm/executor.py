@@ -99,6 +99,55 @@ class InvalidTransaction(ValueError):
     failure. ValueError subclass so generic rejection paths catch it."""
 
 
+class BlockExecutionError(InvalidTransaction):
+    """A block-level execution failure that invalidates the whole block:
+    a mandatory system call reverted/halted (EIP-7002/7251 contracts must
+    not fail) or a deposit-contract log that does not decode (EIP-6110).
+    Subclasses InvalidTransaction so every block-rejection path (engine
+    tree, pipeline, conformance) treats it as block-invalid."""
+
+
+# EIP-6110 DepositEvent field sizes, in ABI order
+_DEPOSIT_FIELDS = (48, 32, 8, 96, 8)  # pubkey, wc, amount, signature, index
+
+
+def _decode_deposit_log(data: bytes) -> bytes:
+    """Decode one DepositEvent(bytes,bytes,bytes,bytes,bytes) log's ABI
+    data into the EIP-6110 deposit-request encoding: the five payloads
+    concatenated (48+32+8+96+8 = 192 bytes).
+
+    The ABI head is five 32-byte offsets; each tail is a 32-byte length
+    word followed by the right-padded payload. Offsets and lengths are
+    VALIDATED, not assumed (reference crates/ethereum/evm deposit
+    decoding) — the canonical deposit contract always emits the fixed
+    576-byte layout, but a spoofed log with the right topic from a chain's
+    overridden deposit-contract address must not be trusted blindly.
+    Raises :class:`BlockExecutionError` on any malformed field."""
+
+    def word(off: int) -> int:
+        if off + 32 > len(data):
+            raise BlockExecutionError(
+                f"deposit log truncated at byte {off} (len {len(data)})")
+        return int.from_bytes(data[off : off + 32], "big")
+
+    out = bytearray()
+    for i, size in enumerate(_DEPOSIT_FIELDS):
+        tail = word(32 * i)
+        if tail % 32 or tail < 32 * len(_DEPOSIT_FIELDS):
+            raise BlockExecutionError(
+                f"deposit log field {i}: bad ABI offset {tail}")
+        length = word(tail)
+        if length != size:
+            raise BlockExecutionError(
+                f"deposit log field {i}: length {length} != {size}")
+        start = tail + 32
+        if start + size > len(data):
+            raise BlockExecutionError(
+                f"deposit log field {i}: payload out of bounds")
+        out += data[start : start + size]
+    return bytes(out)
+
+
 @dataclass
 class EvmConfig:
     """Chain-level execution config (reference `EthEvmConfig`).
@@ -315,10 +364,19 @@ class BlockExecutor:
                           data=data, value=0, gas=30_000_000, kind="CALL")
         try:
             ok, _gas_left, out = interp.call(frame)
-        except (Revert, Halt):
-            return None
+        except (Revert, Halt) as e:
+            # a failed mandatory system call invalidates the BLOCK (the
+            # reference's BlockExecutionError / EIP-7002 "call must not
+            # fail") — silently returning None here would let a block with
+            # a broken system contract slip through with wrong requests
+            raise BlockExecutionError(
+                f"system call to 0x{target.hex()} "
+                f"{type(e).__name__.lower()}ed: {e}") from e
         state.process_destructs()
-        return out if ok else None
+        if not ok:
+            raise BlockExecutionError(
+                f"system call to 0x{target.hex()} failed")
+        return out
 
     def _collect_requests(self, state: EvmState, env: BlockEnv, spec: Spec,
                           receipts: list[Receipt]) -> list[bytes]:

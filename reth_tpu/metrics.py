@@ -174,3 +174,52 @@ class TrieMetrics:
 
 
 trie_metrics = TrieMetrics()
+
+
+class SupervisorMetrics:
+    """Device hasher supervisor state on /metrics (ops/supervisor.py):
+    breaker state + trips, mid-commit failovers, watchdog timeouts, and
+    health-probe outcomes/latency — what an operator needs to see that the
+    node degraded to the CPU hashing route and why."""
+
+    # breaker state encoding for the gauge (alerting-friendly ordering)
+    _STATES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._state = reg.gauge(
+            "hasher_supervisor_breaker_state",
+            "circuit breaker state: 0 closed, 1 half-open, 2 open")
+        self._trips = reg.counter(
+            "hasher_supervisor_breaker_trips_total",
+            "times the breaker opened (device route disabled)")
+        self._failovers = reg.counter(
+            "hasher_supervisor_failovers_total",
+            "mid-commit failovers replayed onto the CPU backend")
+        self._timeouts = reg.counter(
+            "hasher_supervisor_dispatch_timeouts_total",
+            "device dispatches that exceeded the watchdog budget")
+        self._probes = reg.counter("hasher_supervisor_probes_total")
+        self._probe_failures = reg.counter(
+            "hasher_supervisor_probe_failures_total")
+        self._probe_seconds = reg.histogram(
+            "hasher_supervisor_probe_duration_seconds",
+            buckets=(0.1, 0.5, 1, 2, 5, 15, 60, 120))
+
+    def set_state(self, state: str) -> None:
+        self._state.set(self._STATES.get(state, 2.0))
+
+    def record_trip(self) -> None:
+        self._trips.increment()
+
+    def record_failover(self) -> None:
+        self._failovers.increment()
+
+    def record_timeout(self) -> None:
+        self._timeouts.increment()
+
+    def record_probe(self, ok: bool, latency: float) -> None:
+        self._probes.increment()
+        if not ok:
+            self._probe_failures.increment()
+        self._probe_seconds.record(latency)

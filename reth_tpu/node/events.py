@@ -81,6 +81,16 @@ class NodeEventReporter:
         line = (f"Canonical chain advanced  number={tip.number} "
                 f"hash=0x{tip.hash.hex()[:16]}… blocks={blocks} txs={txs} "
                 f"mgas={mgas:.2f} pool={pool_n} peers={peer_n}")
+        # --hasher auto: the supervisor's breaker state belongs on the one
+        # line operators read — a degraded (CPU-routed) hasher is exactly
+        # the "node is slow, why?" answer
+        sup = getattr(self.node, "hasher_supervisor", None)
+        if sup is not None:
+            s = sup.snapshot()
+            line += (f" hasher={'cpu' if s['breaker'] != 'closed' else 'device'}"
+                     f" breaker={s['breaker']}")
+            if s["trips"] or s["failovers"]:
+                line += f" trips={s['trips']} failovers={s['failovers']}"
         log.info(line)
         return line
 

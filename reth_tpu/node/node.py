@@ -72,6 +72,10 @@ class Node:
 
         self.config = config
         self.committer = committer or TrieCommitter()
+        # device hasher supervisor (--hasher auto): present when the
+        # committer routes through ops/supervisor.py — surfaced on the
+        # events dashboard and /metrics
+        self.hasher_supervisor = getattr(self.committer, "supervisor", None)
         # warm the native secp build now: a lazy first-use g++ compile
         # inside newPayload would stall a consensus response for seconds
         from ..primitives.secp256k1 import _native_lib

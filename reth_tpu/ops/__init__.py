@@ -19,10 +19,26 @@ from .keccak_jax import (
     keccak256_batch_jax,
     KeccakDevice,
 )
+from .supervisor import (
+    CircuitBreaker,
+    DeviceSupervisor,
+    FaultInjector,
+    SupervisedBackend,
+    SupervisedHasher,
+    probe_device,
+    probe_device_retrying,
+)
 
 __all__ = [
     "keccak_f1600_jax",
     "keccak256_jax_words",
     "keccak256_batch_jax",
     "KeccakDevice",
+    "CircuitBreaker",
+    "DeviceSupervisor",
+    "FaultInjector",
+    "SupervisedBackend",
+    "SupervisedHasher",
+    "probe_device",
+    "probe_device_retrying",
 ]

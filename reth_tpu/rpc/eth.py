@@ -11,6 +11,7 @@ from ..engine.tree import EngineTree
 from ..evm import BlockExecutor
 from ..evm.executor import ProviderStateSource
 from ..evm.interpreter import BlockEnv, CallFrame, Interpreter, Revert, TxEnv
+from ..evm.spec import LATEST_SPEC
 from ..evm.state import EvmState
 from ..primitives.types import KECCAK_EMPTY, Transaction
 from .convert import (
@@ -675,8 +676,8 @@ class EthApi:
                 gas_limit=gas_limit, base_fee=base_fee,
                 chain_id=self.chain_id, block_hashes=dict(sim_hashes),
                 blob_base_fee=_bbf(blob_kw.get("excess_blob_gas") or 0,
-                                   spec.blob.update_fraction if spec.blob
-                                   else 3_338_477),
+                                   (spec.blob or LATEST_SPEC.blob)
+                                   .update_fraction),
             )
             state = EvmState(folded)
             if spec.beacon_root_call and draft.parent_beacon_block_root is not None:

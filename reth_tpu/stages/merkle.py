@@ -54,12 +54,15 @@ class MerkleStage(Stage):
     def _commit_subtries(self, jobs, start_depth: int = 0):
         """Commit (keys, values) subtrie jobs: turbo fast path, general
         committer fallback (native build unavailable / oversized values —
-        the same degradation the single-shot path documents)."""
+        the same degradation the single-shot path documents). A committer
+        carrying a supervisor ("auto" route) hands it down so every chunk's
+        device dispatches stay watchdog-bounded with CPU failover."""
         try:
             from ..trie.turbo import TurboCommitter
 
             turbo = TurboCommitter(
-                backend=getattr(self.committer, "turbo_backend", "numpy")
+                backend=getattr(self.committer, "turbo_backend", "numpy"),
+                supervisor=getattr(self.committer, "supervisor", None),
             )
             return turbo.commit_hashed_many(jobs, collect_branches=True,
                                             start_depth=start_depth)
@@ -77,7 +80,9 @@ class MerkleStage(Stage):
         input (e.g. oversized values) or the native build is unavailable."""
         backend = getattr(self.committer, "turbo_backend", "numpy")
         try:
-            return full_state_root_turbo(provider, backend=backend)
+            return full_state_root_turbo(
+                provider, backend=backend,
+                supervisor=getattr(self.committer, "supervisor", None))
         except (ValueError, RuntimeError):
             return full_state_root(provider, self.committer)
 

@@ -107,31 +107,47 @@ class TrieCommitter:
     backend (device kernel, numpy baseline, or pure reference).
     """
 
-    def __init__(self, hasher=None, fused: bool = False, min_tier: int = 1024, mesh=None):
+    def __init__(self, hasher=None, fused: bool = False, min_tier: int = 1024,
+                 mesh=None, supervisor=None):
         """``fused=True`` switches the hash phase to the fused multi-level
         device commit (``ops.fused_commit``): child digests stay resident in
         HBM between levels, eliminating the per-level D2H round trip; one
         fetch at the end resolves every node hash. ``mesh`` (a
         ``jax.sharding.Mesh``) shards the fused level loop SPMD across
-        devices. ``hasher`` is ignored when fused."""
+        devices. ``hasher`` is ignored when fused. ``supervisor`` (an
+        ``ops/supervisor.py`` DeviceSupervisor) puts every device call
+        behind the watchdog + circuit breaker with CPU failover — the
+        ``--hasher auto`` wiring."""
         self.fused = fused
+        self.supervisor = supervisor
         self._engine = None
         if fused:
             from ..ops.fused_commit import FusedLevelEngine, FusedMeshEngine
 
-            self._engine = (
-                FusedMeshEngine(mesh, min_tier=min_tier)
-                if mesh is not None
-                else FusedLevelEngine(min_tier=min_tier)
-            )
-        elif hasher is None:
-            from ..ops import KeccakDevice
+            if mesh is not None:
+                engine_factory = lambda: FusedMeshEngine(mesh, min_tier=min_tier)  # noqa: E731
+            else:
+                engine_factory = lambda: FusedLevelEngine(min_tier=min_tier)  # noqa: E731
+            if supervisor is not None:
+                from ..ops.supervisor import SupervisedBackend
 
-            # Trie nodes are <= 4 rate blocks (branch max ~533 B); one masked
-            # program per batch tier keeps XLA compile count minimal, and
-            # min_tier=1024 collapses the small near-root levels into one
-            # shape (padding waste is far cheaper than a compile).
-            hasher = KeccakDevice(min_tier=min_tier, block_tier=4).hash_batch
+                self._engine = SupervisedBackend(supervisor, engine_factory)
+            else:
+                self._engine = engine_factory()
+        elif hasher is None:
+            if supervisor is not None:
+                from ..ops.supervisor import SupervisedHasher
+
+                hasher = SupervisedHasher(supervisor, min_tier=min_tier)
+            else:
+                from ..ops import KeccakDevice
+
+                # Trie nodes are <= 4 rate blocks (branch max ~533 B); one
+                # masked program per batch tier keeps XLA compile count
+                # minimal, and min_tier=1024 collapses the small near-root
+                # levels into one shape (padding waste is far cheaper than
+                # a compile).
+                hasher = KeccakDevice(min_tier=min_tier, block_tier=4).hash_batch
         self.hasher = hasher
 
     def commit(

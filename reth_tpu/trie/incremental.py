@@ -353,17 +353,20 @@ def full_state_root(
     return result.root
 
 
-def full_state_root_turbo(provider: DatabaseProvider, backend: str = "device") -> bytes:
+def full_state_root_turbo(provider: DatabaseProvider, backend: str = "device",
+                          supervisor=None) -> bytes:
     """Full rebuild on the turbo path: C++ structure sweep + packed/bitmap
     device levels (trie/turbo.py) — zero per-node Python. Same semantics as
     :func:`full_state_root`; raises ``ValueError`` for inputs outside the
     secure-trie fast path (the MerkleStage falls back to the general
-    committer). Reference analogue: the clean MerkleStage path
+    committer). ``backend="auto"`` + ``supervisor`` route the device work
+    through the watchdog/breaker (ops/supervisor.py). Reference analogue:
+    the clean MerkleStage path
     (crates/stages/stages/src/stages/merkle.rs:184-330)."""
     from .turbo import TurboCommitter
     import numpy as np
 
-    committer = TurboCommitter(backend=backend)
+    committer = TurboCommitter(backend=backend, supervisor=supervisor)
     p = provider
     p.clear_trie_tables()
 

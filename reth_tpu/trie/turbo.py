@@ -144,14 +144,44 @@ class _Level:
 
 
 class _NumpyBackend:
-    """CPU twin of the device engine — the measured baseline and the no-jax
-    fallback. Same array protocol, digests live in a host buffer."""
+    """CPU twin of the device engine — the measured baseline, the no-jax
+    fallback, and the supervisor's mid-commit failover target
+    (ops/supervisor.py SupervisedBackend). Same array protocol as the
+    fused engines — including the committer's bucket protocol
+    (``alloc_slot``/``dispatch_level``) — with digests in a host buffer."""
 
     def __init__(self):
         self._buf = None
+        self._n_slots = 1
 
     def begin(self, max_slots: int) -> None:
         self._buf = np.zeros((max_slots + 1, 32), dtype=np.uint8)
+        self._n_slots = 1  # slot 0 = dummy (mirrors FusedLevelEngine)
+
+    def alloc_slot(self) -> int:
+        slot = self._n_slots
+        self._n_slots += 1
+        return slot
+
+    def dispatch_level(self, bucket) -> None:
+        """CPU twin of ``FusedLevelEngine.dispatch_level``: pad the bucket's
+        RLP templates, splice child digests from the host buffer, hash."""
+        n = len(bucket.templates)
+        if n == 0:
+            return
+        b_tier = 2
+        while b_tier < bucket.nb_max:
+            b_tier *= 2
+        L = b_tier * RATE
+        rows = np.zeros((n, L), dtype=np.uint8)
+        for i, t in enumerate(bucket.templates):
+            rows[i, : len(t)] = np.frombuffer(t, dtype=np.uint8)
+            rows[i, len(t)] ^= 0x01
+            rows[i, bucket.counts[i] * RATE - 1] ^= 0x80
+        for row, off, src in bucket.holes:
+            rows[row, off : off + 32] = self._buf[src]
+        self._hash_rows(rows, np.asarray(bucket.counts, dtype=np.int64),
+                        np.asarray(bucket.slots, dtype=np.int64), b_tier)
 
     def _hash_rows(self, rows: np.ndarray, counts: np.ndarray, slots: np.ndarray,
                    b_tier: int) -> None:
@@ -220,17 +250,19 @@ class TurboCommitter:
     """Full-rebuild state committer over 32-byte hashed keys.
 
     ``backend``: "device" (fused HBM-resident engine, optionally SPMD over
-    ``mesh``) or "numpy" (CPU twin — the measured baseline)."""
+    ``mesh``), "numpy" (CPU twin — the measured baseline), or "auto"
+    (device under the ``ops/supervisor.py`` watchdog+breaker, with
+    journaled mid-commit failover onto the numpy twin)."""
 
-    def __init__(self, backend: str = "device", min_tier: int = 1024, mesh=None):
+    def __init__(self, backend: str = "device", min_tier: int = 1024, mesh=None,
+                 supervisor=None):
         self.backend_kind = backend
         self.min_tier = min_tier
         self.mesh = mesh
+        self.supervisor = supervisor
         self._lib = load_library()
 
-    def _make_backend(self):
-        if self.backend_kind == "numpy":
-            return _NumpyBackend()
+    def _device_engine(self):
         from ..ops.fused_commit import MegaFusedEngine, FusedMeshEngine
 
         if self.mesh is not None:
@@ -238,6 +270,16 @@ class TurboCommitter:
         # single-chip: whole-commit staging — one H2D, one program, one D2H
         # (the axon tunnel charges ~40-70 ms latency PER transfer)
         return MegaFusedEngine(min_tier=self.min_tier)
+
+    def _make_backend(self):
+        if self.backend_kind == "numpy":
+            return _NumpyBackend()
+        if self.backend_kind == "auto":
+            from ..ops.supervisor import DeviceSupervisor, SupervisedBackend
+
+            sup = self.supervisor or DeviceSupervisor.shared()
+            return SupervisedBackend(sup, self._device_engine)
+        return self._device_engine()
 
     def commit_hashed_many(
         self,
@@ -344,9 +386,12 @@ class TurboCommitter:
             # is not tracked in turbo mode; totals are what the stage reports)
             results[-1].hashed_nodes = total_hashed
         # TrieTracker-style commit stats (reference trie metrics/tracker):
-        # what the hot path actually did, on /metrics and in bench triage
+        # what the hot path actually did, on /metrics and in bench triage —
+        # a supervised commit that failed over reports the backend that
+        # actually produced the digests, not the one that was asked for
+        effective = getattr(backend, "effective_kind", self.backend_kind)
         trie_metrics.record_commit(
-            backend=self.backend_kind, nodes=total_hashed, levels=n_levels,
+            backend=effective, nodes=total_hashed, levels=n_levels,
             leaves=sum(len(k) for k in key_arrays), wire_bytes=wire_bytes,
             seconds=_time.time() - t_start)
         if collect_branches and meta_rec is not None and len(meta_rec):

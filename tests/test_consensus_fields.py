@@ -1,0 +1,140 @@
+"""Fork-mandated header fields: parent_beacon_block_root (Cancun, EIP-4788)
+and requests_hash (Prague, EIP-7685) presence/absence gating in
+consensus/validation.py — mirroring the existing blob-field checks.
+
+With a chainspec the spec gates; without one (engine live-tip) activation
+is parent-driven: once the chain carries a field it can never be dropped.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from reth_tpu.chainspec import (
+    CANCUN,
+    HARDFORK_ORDER,
+    OSAKA,
+    PARIS,
+    PRAGUE,
+    SHANGHAI,
+    ChainSpec,
+    ForkCondition,
+)
+from reth_tpu.consensus.validation import (
+    ConsensusError,
+    calc_next_base_fee,
+    validate_header_against_parent,
+)
+from reth_tpu.primitives.types import Header
+
+_EMPTY_REQUESTS = bytes.fromhex(
+    "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855")
+
+
+def _chainspec(cancun_ts: int | None = None,
+               prague_ts: int | None = None) -> ChainSpec:
+    forks = {}
+    for name in HARDFORK_ORDER:
+        if name == PARIS:
+            forks[name] = ForkCondition(ttd=0)
+        elif name == SHANGHAI:
+            forks[name] = ForkCondition(timestamp=0)
+        elif name == CANCUN:
+            if cancun_ts is not None:
+                forks[name] = ForkCondition(timestamp=cancun_ts)
+        elif name == PRAGUE:
+            if prague_ts is not None:
+                forks[name] = ForkCondition(timestamp=prague_ts)
+        elif name == OSAKA:
+            continue
+        else:
+            forks[name] = ForkCondition(block=0)
+    return ChainSpec(chain_id=1, hardforks=forks)
+
+
+def _pair(parent_kw=None, child_kw=None):
+    parent = Header(number=1, timestamp=1000, gas_limit=30_000_000,
+                    gas_used=15_000_000, base_fee_per_gas=10**9,
+                    **(parent_kw or {}))
+    child_kw = dict(child_kw or {})
+    child_kw.setdefault("base_fee_per_gas", calc_next_base_fee(parent))
+    child = Header(number=2, parent_hash=parent.hash, timestamp=1012,
+                   gas_limit=30_000_000, **child_kw)
+    return parent, child
+
+
+_CANCUN_FIELDS = dict(blob_gas_used=0, excess_blob_gas=0,
+                      parent_beacon_block_root=b"\x00" * 32)
+
+
+def test_cancun_header_valid_with_all_fields():
+    parent, child = _pair(child_kw=dict(_CANCUN_FIELDS))
+    validate_header_against_parent(child, parent, _chainspec(cancun_ts=0))
+
+
+def test_cancun_missing_parent_beacon_root_rejected():
+    kw = dict(_CANCUN_FIELDS)
+    kw.pop("parent_beacon_block_root")
+    parent, child = _pair(child_kw=kw)
+    with pytest.raises(ConsensusError, match="missing parent beacon"):
+        validate_header_against_parent(child, parent, _chainspec(cancun_ts=0))
+
+
+def test_parent_beacon_root_before_cancun_rejected():
+    parent, child = _pair(
+        child_kw=dict(parent_beacon_block_root=b"\x00" * 32))
+    with pytest.raises(ConsensusError, match="before Cancun"):
+        validate_header_against_parent(child, parent, _chainspec())
+
+
+def test_prague_requires_requests_hash():
+    spec = _chainspec(cancun_ts=0, prague_ts=0)
+    parent, child = _pair(child_kw={**_CANCUN_FIELDS,
+                                    "requests_hash": _EMPTY_REQUESTS})
+    validate_header_against_parent(child, parent, spec)
+    parent, child = _pair(child_kw=dict(_CANCUN_FIELDS))
+    with pytest.raises(ConsensusError, match="missing requests hash"):
+        validate_header_against_parent(child, parent, spec)
+
+
+def test_requests_hash_before_prague_rejected():
+    parent, child = _pair(child_kw={**_CANCUN_FIELDS,
+                                    "requests_hash": _EMPTY_REQUESTS})
+    with pytest.raises(ConsensusError, match="before Prague"):
+        validate_header_against_parent(child, parent, _chainspec(cancun_ts=0))
+
+
+# -- chainspec-less (engine live-tip): parent-driven activation --------------
+
+
+def test_no_chainspec_plain_post_merge_headers_still_pass():
+    parent, child = _pair()
+    validate_header_against_parent(child, parent, None)
+
+
+def test_no_chainspec_beacon_root_cannot_be_dropped():
+    parent, child = _pair(
+        parent_kw=dict(withdrawals_root=_EMPTY_REQUESTS[:32],
+                       blob_gas_used=0, excess_blob_gas=0,
+                       parent_beacon_block_root=b"\x01" * 32),
+        # child keeps the (parent-mandated) blob fields but drops the root
+        child_kw=dict(blob_gas_used=0, excess_blob_gas=0))
+    with pytest.raises(ConsensusError, match="missing parent beacon"):
+        validate_header_against_parent(child, parent, None)
+
+
+def test_no_chainspec_requests_hash_cannot_be_dropped():
+    parent, child = _pair(
+        parent_kw={**_CANCUN_FIELDS, "withdrawals_root": _EMPTY_REQUESTS[:32],
+                   "requests_hash": _EMPTY_REQUESTS},
+        child_kw=dict(_CANCUN_FIELDS))
+    with pytest.raises(ConsensusError, match="missing requests hash"):
+        validate_header_against_parent(child, parent, None)
+
+
+def test_no_chainspec_activation_block_is_accepted():
+    # first header to CARRY the fields (activation boundary): fine
+    parent, child = _pair(
+        child_kw=dict(parent_beacon_block_root=b"\x02" * 32,
+                      requests_hash=_EMPTY_REQUESTS))
+    validate_header_against_parent(child, parent, None)

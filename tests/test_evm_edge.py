@@ -237,3 +237,127 @@ def test_depth_1024_chain_without_recursion_limit():
         gas=100_000_000_000))
     assert ok
     assert max(depths) == 1024  # hit the cap exactly, then unwound
+
+
+# -- EIP-6110 deposit log decoding + system-call failure propagation ---------
+
+
+def _abi_encode_deposit(pubkey: bytes, wc: bytes, amount: bytes,
+                        signature: bytes, index: bytes) -> bytes:
+    """ABI-encode DepositEvent(bytes,bytes,bytes,bytes,bytes) data exactly
+    the way the mainnet deposit contract does: 5-offset head, then per
+    field a length word + right-padded payload."""
+    fields = [pubkey, wc, amount, signature, index]
+    head, tail = b"", b""
+    offset = 32 * len(fields)
+    for f in fields:
+        head += offset.to_bytes(32, "big")
+        padded = f + b"\x00" * (-len(f) % 32)
+        tail += len(f).to_bytes(32, "big") + padded
+        offset += 32 + len(padded)
+    return head + tail
+
+
+def _real_deposit_fields():
+    """A mainnet-shaped deposit: 48-byte BLS pubkey, 32-byte withdrawal
+    credentials, 8-byte LE gwei amount (32 ETH), 96-byte signature,
+    8-byte LE index."""
+    pubkey = bytes.fromhex(
+        "b0b9d0f95f3a7a9e1c5c9c2e51f92a47f05c3f5e1a2ab4f7e6f2b8d1c4a5e6f7"
+        "08192a3b4c5d6e7f8091a2b3c4d5e6f7")
+    wc = b"\x01" + b"\x00" * 11 + b"\x42" * 20
+    amount = (32 * 10**9).to_bytes(8, "little")
+    signature = bytes(range(96))
+    index = (7).to_bytes(8, "little")
+    return pubkey, wc, amount, signature, index
+
+
+def test_decode_deposit_log_real_layout():
+    from reth_tpu.evm.executor import _decode_deposit_log
+
+    fields = _real_deposit_fields()
+    data = _abi_encode_deposit(*fields)
+    assert len(data) == 576                 # the canonical contract layout
+    request = _decode_deposit_log(data)
+    assert request == b"".join(fields)
+    assert len(request) == 192              # EIP-6110 deposit request size
+
+
+def test_decode_deposit_log_rejects_malformed():
+    import pytest
+
+    from reth_tpu.evm.executor import BlockExecutionError, _decode_deposit_log
+
+    fields = _real_deposit_fields()
+    good = _abi_encode_deposit(*fields)
+    with pytest.raises(BlockExecutionError, match="truncated"):
+        _decode_deposit_log(good[:100])
+    with pytest.raises(BlockExecutionError, match="length"):
+        bad = bytearray(good)
+        bad[160 + 31] = 49                  # pubkey length 48 -> 49
+        _decode_deposit_log(bytes(bad))
+    with pytest.raises(BlockExecutionError, match="offset"):
+        bad = bytearray(good)
+        bad[31] = 0xA1                      # unaligned first offset
+        _decode_deposit_log(bytes(bad))
+    with pytest.raises(BlockExecutionError):
+        _decode_deposit_log(b"")
+
+
+def test_collect_requests_extracts_deposits():
+    from reth_tpu.evm.executor import (
+        BlockExecutor, DEPOSIT_EVENT_TOPIC, EvmConfig,
+        MAINNET_DEPOSIT_CONTRACT)
+    from reth_tpu.evm.spec import LATEST_SPEC
+    from reth_tpu.primitives.types import Log, Receipt
+
+    fields = _real_deposit_fields()
+    log = Log(address=MAINNET_DEPOSIT_CONTRACT,
+              topics=(DEPOSIT_EVENT_TOPIC,),
+              data=_abi_encode_deposit(*fields))
+    noise = Log(address=b"\x99" * 20, topics=(DEPOSIT_EVENT_TOPIC,),
+                data=b"\x00" * 576)         # wrong address: ignored
+    receipts = [Receipt(logs=(noise, log)), Receipt(logs=(log,))]
+    executor = BlockExecutor(InMemoryStateSource({}), EvmConfig())
+    state = EvmState(InMemoryStateSource({}))
+    requests = executor._collect_requests(state, BlockEnv(), LATEST_SPEC,
+                                          receipts)
+    assert requests == [b"\x00" + b"".join(fields) * 2]
+
+
+def test_system_call_revert_and_halt_invalidate_block():
+    import pytest
+
+    from reth_tpu.evm.executor import (
+        BEACON_ROOTS_ADDRESS, BlockExecutionError, BlockExecutor, EvmConfig,
+        InvalidTransaction)
+    from reth_tpu.evm.spec import LATEST_SPEC
+
+    # PUSH1 0 PUSH1 0 REVERT — a beacon-roots contract that always reverts
+    revert_code = bytes.fromhex("60006000fd")
+    src = InMemoryStateSource(
+        {BEACON_ROOTS_ADDRESS: Account(code_hash=keccak256(revert_code))},
+        None, {keccak256(revert_code): revert_code})
+    executor = BlockExecutor(src, EvmConfig())
+    state = EvmState(src)
+    with pytest.raises(BlockExecutionError, match="reverted"):
+        executor._system_call(state, BlockEnv(), LATEST_SPEC,
+                              BEACON_ROOTS_ADDRESS, b"\x11" * 32)
+    # INVALID opcode halts: same propagation
+    halt_code = bytes.fromhex("fe")
+    src2 = InMemoryStateSource(
+        {BEACON_ROOTS_ADDRESS: Account(code_hash=keccak256(halt_code))},
+        None, {keccak256(halt_code): halt_code})
+    with pytest.raises(BlockExecutionError, match="failed|halted"):
+        BlockExecutor(src2, EvmConfig())._system_call(
+            EvmState(src2), BlockEnv(), LATEST_SPEC,
+            BEACON_ROOTS_ADDRESS, b"\x11" * 32)
+    # the error is an InvalidTransaction subclass: every block-rejection
+    # path (engine tree, pipeline) already treats it as block-invalid
+    assert issubclass(BlockExecutionError, InvalidTransaction)
+    # absent contract: still silently skipped (dev chains)
+    src3 = InMemoryStateSource({})
+    out = BlockExecutor(src3, EvmConfig())._system_call(
+        EvmState(src3), BlockEnv(), LATEST_SPEC,
+        BEACON_ROOTS_ADDRESS, b"\x11" * 32)
+    assert out is None
