@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults native
+.PHONY: test test-serial test-faults test-pipeline native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -23,6 +23,14 @@ test-faults:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_supervisor.py -q -p no:cacheprovider
 
+# overlapped rebuild pipeline: parity vs the serial committer, packing,
+# arena residency, abort/failover drills, chunked-resume — fast, CPU-only
+# (the sanitizer stress build is `-m slow`; run it via tsan-triebuild)
+test-pipeline:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_turbo_pipeline.py tests/test_merkle_resume.py \
+	  -q -p no:cacheprovider -m 'not slow'
+
 native:
 	mkdir -p native/build
 	g++ -O2 -std=c++17 -shared -fPIC native/triebuild.cpp -o native/build/libtriebuild.so
@@ -30,3 +38,14 @@ native:
 	g++ -O2 -std=c++17 -shared -fPIC native/kvstore.cpp -o native/build/libkvstore.so
 	g++ -O2 -std=c++17 -shared -fPIC native/pagedkv.cpp -o native/build/libpagedkv.so
 	g++ -O2 -std=c++17 -shared -fPIC -pthread native/evmexec.cpp -o native/build/libevmexec.so
+
+# threaded stress of the native structure sweep under TSAN (the rebuild
+# pipeline calls rtb_build from a thread pool); mirrors kvstore_tsan.cpp.
+# Where gcc's libtsan breaks on the running kernel, build with
+# -fsanitize=address,undefined instead (tests/test_turbo_pipeline.py
+# probes and picks automatically).
+tsan-triebuild:
+	mkdir -p native/build
+	g++ -std=c++17 -O1 -g -fsanitize=thread \
+	  native/triebuild.cpp native/triebuild_tsan.cpp -o native/build/triebuild_stress
+	./native/build/triebuild_stress

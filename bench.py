@@ -1,6 +1,13 @@
 """Benchmark: MerkleStage-style full state-root rebuild on the device.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "backend"}.
+``backend`` records which hashing plane actually produced the number:
+"device" (healthy tunnel) or "numpy" (CPU fallback). A wedged/absent
+tunnel no longer yields rc=2 with value 0 — it records the OVERLAPPED
+rebuild pipeline's CPU rate (trie/turbo.RebuildPipeline: pooled native
+sweeps + cross-subtrie level packing + resident digest arena) with
+``vs_baseline`` = speedup over the seed's serial per-subtrie chunked
+path, roots bit-identical, and exits 0.
 
 Workload = benchmark config #2/#3 in miniature (BASELINE.md): a synthetic
 hashed state (accounts + storage slots) is committed bottom-up with the
@@ -57,6 +64,7 @@ def _emit(value, vs_baseline, error=None, exit_code=None, **extra):
         "value": value,
         "unit": "hashes/s",
         "vs_baseline": vs_baseline,
+        "backend": _STATE.get("backend", "unknown"),
     }
     if error:
         line["error"] = error
@@ -91,48 +99,100 @@ def probe_tunnel() -> str | None:
     re-probes, so bench and runtime can't drift apart. (Still no
     `jax_compilation_cache_dir` in the child — the persistent compile cache
     deadlocks the first jit over the axon tunnel, measured round 2.)"""
-    from reth_tpu.ops.supervisor import probe_device_retrying
+    from reth_tpu.ops.supervisor import FaultInjector, probe_device_retrying
 
     def _phase(i, attempts):
         _STATE["phase"] = f"tunnel health probe (attempt {i}/{attempts})"
 
-    result = probe_device_retrying(on_attempt=_phase)
+    # RETH_TPU_FAULT_PROBE_FAIL drills the wedged-tunnel path end-to-end:
+    # injected probe failure -> CPU-fallback measurement -> rc=0
+    result = probe_device_retrying(on_attempt=_phase,
+                                   injector=FaultInjector.from_env())
     return None if result.ok else result.diag
 
 
 def build_state(n_accounts: int, n_slots: int):
-    """MerkleStage-shaped jobs: per-account storage tries + the account trie,
-    as (hashed-key array, RLP-value list) pairs for the turbo committer."""
+    """MerkleStage-chunk-shaped jobs: per-account storage tries (committed
+    at depth 0) + the account trie as 256 two-nibble-prefix subtries
+    (committed at ``start_depth=2``) — exactly what ``_account_chunk``
+    feeds the committer. Returns (storage_jobs, account_prefix_jobs)."""
     from reth_tpu.primitives.rlp import encode_int, rlp_encode
     from reth_tpu.primitives.types import Account
     from reth_tpu.storage.tables import encode_account
 
     rng = np.random.default_rng(42)
     akeys = rng.integers(0, 256, size=(n_accounts, 32), dtype=np.uint8)
+    akeys = np.unique(akeys.view("S32").ravel()).view(np.uint8).reshape(-1, 32)
+    n_accounts = len(akeys)
     balances = rng.integers(1, 1 << 60, size=n_accounts)
     avals = [
         encode_account(Account(nonce=int(i % 300), balance=int(balances[i])))
         for i in range(n_accounts)
     ]
+    account_jobs = []
+    for pfx in range(256):
+        sel = np.nonzero(akeys[:, 0] == pfx)[0]
+        if len(sel):
+            account_jobs.append((akeys[sel], [avals[i] for i in sel]))
     # storage tries: n_slots spread over n_accounts//10 accounts
     n_storage_accts = max(1, n_accounts // 10)
     skeys = rng.integers(0, 256, size=(n_slots, 32), dtype=np.uint8)
     svals = [rlp_encode(encode_int(int(v))) for v in rng.integers(1, 1 << 60, size=n_slots)]
-    jobs = []
+    storage_jobs = []
     for owner in range(n_storage_accts):
         sel = np.arange(owner, n_slots, n_storage_accts)
         if len(sel):
-            jobs.append((skeys[sel], [svals[i] for i in sel]))
-    jobs.append((akeys, avals))
-    return jobs
+            storage_jobs.append((skeys[sel], [svals[i] for i in sel]))
+    return storage_jobs, account_jobs
 
 
-def run_commit(committer, jobs):
+def run_rebuild(committer, storage_jobs, account_jobs, pipelined: bool):
+    """One full-rebuild pass. ``pipelined=False`` is the seed's SERIAL
+    chunked path: storage tries in one batched call, then one commit per
+    account prefix subtrie (sweep → hash → fetch with nothing overlapped).
+    ``pipelined=True`` routes both phases through the overlapped pipeline
+    (pooled sweeps + cross-subtrie level packing + resident arena)."""
     t0 = time.time()
-    results = committer.commit_hashed_many(jobs, collect_branches=False)
+    if pipelined:
+        res = committer.commit_hashed_pipelined(storage_jobs)
+        res += committer.commit_hashed_pipelined(account_jobs, start_depth=2)
+    else:
+        res = committer.commit_hashed_many(storage_jobs)
+        for job in account_jobs:
+            res += committer.commit_hashed_many([job], start_depth=2)
     dt = time.time() - t0
-    hashed = sum(r.hashed_nodes for r in results)
-    return results[-1].root, hashed, dt
+    hashed = sum(r.hashed_nodes for r in res)
+    return [r.root for r in res], hashed, dt
+
+
+def run_cpu_fallback(n_accounts: int, n_slots: int, diag: str) -> None:
+    """Device unavailable: record a CPU(numpy) measurement instead of the
+    old rc=2 / value=0 (five rounds of wedged-tunnel zeros made the
+    trajectory unreadable — BENCH_r05 postmortem). The headline is the
+    OVERLAPPED pipeline's rate; ``vs_baseline`` is its speedup over the
+    seed's serial chunked path on the same box, roots bit-identical."""
+    from reth_tpu.trie.turbo import TurboCommitter
+
+    _STATE["backend"] = "numpy"
+    _STATE["phase"] = "state build (cpu fallback)"
+    storage_jobs, account_jobs = build_state(n_accounts, n_slots)
+    committer = TurboCommitter(backend="numpy")
+
+    _STATE["phase"] = "cpu serial chunked rebuild"
+    roots_ser, hashed, dt_serial = run_rebuild(
+        committer, storage_jobs, account_jobs, pipelined=False)
+    _STATE["phase"] = "cpu pipelined rebuild"
+    roots_pipe, hashed_p, dt_pipe = run_rebuild(
+        committer, storage_jobs, account_jobs, pipelined=True)
+    if roots_ser != roots_pipe:
+        _emit(0, 0, error="pipelined/serial root mismatch", exit_code=1)
+    _STATE["device_result"] = round(hashed_p / dt_pipe, 1)
+    _emit(round(hashed_p / dt_pipe, 1), round(dt_serial / dt_pipe, 3),
+          device_unavailable=diag,
+          serial_wall_s=round(dt_serial, 3),
+          pipelined_wall_s=round(dt_pipe, 3),
+          serial_hashes_per_sec=round(hashed / dt_serial, 1),
+          exit_code=0)
 
 
 def main():
@@ -143,7 +203,10 @@ def main():
     t_start = time.time()
     diag = probe_tunnel()
     if diag is not None:
-        _emit(0, 0, error=f"device unavailable, bench skipped: {diag}", exit_code=2)
+        # wedged/absent tunnel: the pipeline's CPU win must still be
+        # CAPTURABLE — record the numpy-backend measurement and exit 0
+        run_cpu_fallback(n_accounts, n_slots, diag)
+        return
     # a late probe success means a recovering tunnel AND less watchdog
     # budget left — shrink the workload so the round still lands a number
     remaining = _DEADLINE - (time.time() - t_start)
@@ -153,8 +216,9 @@ def main():
 
     from reth_tpu.trie.turbo import TurboCommitter
 
+    _STATE["backend"] = "device"
     _STATE["phase"] = "state build"
-    jobs = build_state(n_accounts, n_slots)
+    storage_jobs, account_jobs = build_state(n_accounts, n_slots)
 
     # forced large min_tier => one or two batch tiers => <=~4 XLA programs
     dev_committer = TurboCommitter(backend="device", min_tier=tier)
@@ -163,14 +227,16 @@ def main():
     # warm-up = one full untimed run, so every program shape the measured
     # run dispatches is already compiled (XLA caches by shape in-process)
     _STATE["phase"] = "device warm-up (compiles)"
-    run_commit(dev_committer, jobs)
+    run_rebuild(dev_committer, storage_jobs, account_jobs, pipelined=True)
 
     _STATE["phase"] = "device run"
-    root_dev, hashed_dev, dt_dev = run_commit(dev_committer, jobs)
+    roots_dev, hashed_dev, dt_dev = run_rebuild(
+        dev_committer, storage_jobs, account_jobs, pipelined=True)
     _STATE["device_result"] = round(hashed_dev / dt_dev, 1)
     _STATE["phase"] = "cpu baseline"
-    root_cpu, _hashed_cpu, dt_cpu = run_commit(cpu_committer, jobs)
-    if root_dev != root_cpu:
+    roots_cpu, _hashed_cpu, dt_cpu = run_rebuild(
+        cpu_committer, storage_jobs, account_jobs, pipelined=True)
+    if roots_dev != roots_cpu:
         _emit(0, 0, error="device/cpu root mismatch", exit_code=1)
 
     _emit(round(hashed_dev / dt_dev, 1), round(dt_cpu / dt_dev, 3),

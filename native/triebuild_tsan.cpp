@@ -1,0 +1,117 @@
+// Sanitizer stress driver for the trie-structure builder (triebuild.cpp).
+//
+// The rebuild pipeline (reth_tpu/trie/turbo.py RebuildPipeline) calls
+// rtb_build from a THREAD POOL — concurrent sweeps over shared read-only
+// key/value arrays, each producing its own handle. triebuild.cpp holds no
+// global state, and this driver proves it the same way kvstore_tsan.cpp
+// proves the MVCC engine: run the real access pattern under TSAN (ASan+
+// UBSan fallback where gcc's libtsan breaks on the running kernel).
+//
+// Build + run (tests/test_turbo_pipeline.py::test_triebuild_threaded_stress):
+//   g++ -std=c++17 -O1 -g -fsanitize=thread triebuild.cpp \
+//       triebuild_tsan.cpp -o build/triebuild_stress && ./build/triebuild_stress
+//
+// Workload: N threads × R rounds. Odd threads sweep a PRIVATE key set;
+// even threads all sweep the SAME shared arrays concurrently (the
+// pipeline's job-list sharing). Two failure modes: (a) memory/race errors
+// under the sanitizer, (b) nondeterminism — any round whose level count,
+// max slot, or packed byte total differs from round 0 (exit 2).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rtb_build(const uint8_t* keys, uint64_t n_keys, const uint64_t* job_off,
+                uint32_t n_jobs, const uint8_t* values, const uint64_t* val_off,
+                int collect_meta, int start_depth, int* err);
+void rtb_free(void* h);
+int32_t rtb_num_levels(void* h);
+int32_t rtb_max_slot(void* h);
+uint64_t rtb_packed_bytes(void* h, int32_t i);
+uint64_t rtb_meta_count(void* h);
+}
+
+static std::atomic<bool> failed{false};
+static std::atomic<long> builds{0};
+
+struct Input {
+    std::vector<uint8_t> keys;     // n x 32, sorted unique
+    std::vector<uint64_t> job_off; // [0, n]
+    std::vector<uint8_t> values;   // 1 byte per key
+    std::vector<uint64_t> val_off;
+};
+
+static Input make_input(uint64_t seed, int n) {
+    // LCG-filled 32-byte keys, sorted + deduped (rtb_build requires both)
+    std::vector<std::vector<uint8_t>> raw(n, std::vector<uint8_t>(32));
+    uint64_t s = seed * 6364136223846793005ULL + 1442695040888963407ULL;
+    for (auto& k : raw)
+        for (int b = 0; b < 32; b++) {
+            s = s * 6364136223846793005ULL + 1442695040888963407ULL;
+            k[b] = uint8_t(s >> 33);
+        }
+    std::sort(raw.begin(), raw.end());
+    raw.erase(std::unique(raw.begin(), raw.end()), raw.end());
+    Input in;
+    for (auto& k : raw) in.keys.insert(in.keys.end(), k.begin(), k.end());
+    uint64_t cnt = raw.size();
+    in.job_off = {0, cnt};
+    in.values.resize(cnt, 0x41);  // single byte < 0x80 self-encodes
+    in.val_off.resize(cnt + 1);
+    for (uint64_t i = 0; i <= cnt; i++) in.val_off[i] = i;
+    return in;
+}
+
+static void worker(const Input* in, int rounds, int collect) {
+    int64_t want_levels = -1, want_slot = -1;
+    uint64_t want_bytes = 0;
+    for (int r = 0; r < rounds && !failed.load(); r++) {
+        int err = 0;
+        void* h = rtb_build(in->keys.data(), in->job_off[1], in->job_off.data(),
+                            1, in->values.data(), in->val_off.data(),
+                            collect, 0, &err);
+        if (!h || err) {
+            std::fprintf(stderr, "build failed err=%d\n", err);
+            failed.store(true);
+            return;
+        }
+        int32_t levels = rtb_num_levels(h);
+        int32_t slot = rtb_max_slot(h);
+        uint64_t bytes = 0;
+        for (int32_t i = 0; i < levels; i++) bytes += rtb_packed_bytes(h, i);
+        if (collect) bytes += rtb_meta_count(h);
+        rtb_free(h);
+        if (r == 0) {
+            want_levels = levels; want_slot = slot; want_bytes = bytes;
+        } else if (levels != want_levels || slot != want_slot ||
+                   bytes != want_bytes) {
+            std::fprintf(stderr, "NONDETERMINISM: round %d differs\n", r);
+            failed.store(true);
+            return;
+        }
+        builds.fetch_add(1, std::memory_order_relaxed);
+    }
+}
+
+int main() {
+    const int kThreads = 6, kRounds = 24, kKeys = 1200;
+    Input shared = make_input(7, kKeys);
+    std::vector<Input> privates;
+    for (int t = 0; t < kThreads; t += 2)
+        privates.push_back(make_input(100 + t, kKeys / 2));
+    std::vector<std::thread> ts;
+    size_t p = 0;
+    for (int t = 0; t < kThreads; t++) {
+        const Input* in = (t % 2 == 0) ? &shared : &privates[p++ % privates.size()];
+        ts.emplace_back(worker, in, kRounds, t % 2);
+    }
+    for (auto& t : ts) t.join();
+    if (failed.load()) return 2;
+    std::printf("STRESS_OK builds=%ld\n", builds.load());
+    return 0;
+}

@@ -39,6 +39,7 @@ from .interpreter import (
     Halt,
     Interpreter,
     MAX_INITCODE_SIZE,
+    PrecompileNotImplemented,
     Revert,
     TxEnv,
 )
@@ -496,32 +497,37 @@ class BlockExecutor:
 
         gas = tx.gas_limit - ig
         success, output = True, b""
-        if tx.to is None:
-            ok, gas_left, _addr, output = interp.create(
-                sender, tx.value, tx.data, gas, 0, tx_nonce=tx.nonce
-            )
-            success = ok
-        else:
-            # EIP-7702: a delegated destination executes the delegate's
-            # code in tx.to's context. At the TOP level the delegation
-            # target joins accessed_addresses for free (the EIP extends
-            # EIP-2929's initialization); only CALL-family opcodes charge
-            # the extra account access.
-            code, target = (resolve_delegation(state, tx.to)
-                            if spec.has_setcode else (state.code(tx.to), None))
-            if target is not None:
-                state.warm_account(target)
-            frame = CallFrame(
-                caller=sender, address=tx.to, code=code,
-                data=tx.data, value=tx.value, gas=gas,
-            )
-            try:
-                ok, gas_left, output = interp.call(frame)
+        try:
+            if tx.to is None:
+                ok, gas_left, _addr, output = interp.create(
+                    sender, tx.value, tx.data, gas, 0, tx_nonce=tx.nonce
+                )
                 success = ok
-            except Revert as r:
-                success, gas_left, output = False, getattr(r, "gas_left", 0), r.output
-            except Halt:
-                success, gas_left, output = False, 0, b""
+            else:
+                # EIP-7702: a delegated destination executes the delegate's
+                # code in tx.to's context. At the TOP level the delegation
+                # target joins accessed_addresses for free (the EIP extends
+                # EIP-2929's initialization); only CALL-family opcodes charge
+                # the extra account access.
+                code, target = (resolve_delegation(state, tx.to)
+                                if spec.has_setcode else (state.code(tx.to), None))
+                if target is not None:
+                    state.warm_account(target)
+                frame = CallFrame(
+                    caller=sender, address=tx.to, code=code,
+                    data=tx.data, value=tx.value, gas=gas,
+                )
+                try:
+                    ok, gas_left, output = interp.call(frame)
+                    success = ok
+                except Revert as r:
+                    success, gas_left, output = False, getattr(r, "gas_left", 0), r.output
+                except Halt:
+                    success, gas_left, output = False, 0, b""
+        except PrecompileNotImplemented as e:
+            # a silently-stubbed precompile would corrupt the state root
+            # without tripping any invariant — fail the BLOCK loudly instead
+            raise BlockExecutionError(str(e)) from e
 
         gas_used = tx.gas_limit - gas_left
         # refunds: capped at 1/2 of used gas pre-London, 1/5 after (EIP-3529).

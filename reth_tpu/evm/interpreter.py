@@ -1145,6 +1145,53 @@ def _pre_point_eval(data: bytes, gas: int):
     return True, gas, out
 
 
+class PrecompileNotImplemented(NotImplementedError):
+    """A precompile in the active fork's address range whose operation this
+    repo cannot faithfully implement. Raised INSTEAD of behaving like an
+    empty account: a silent stub would produce a wrong-but-plausible state
+    root and break the native/interpreter bit-identical invariant without
+    anyone noticing (round-5 verdict). The block executor surfaces this as
+    a BlockExecutionError — loud, block-invalidating, grep-able."""
+
+
+def _pre_bls_g1add(data: bytes, gas: int):
+    """0x0b BLS12_G1ADD (EIP-2537): 375 gas, no subgroup check."""
+    if gas < 375:
+        return False, 0, b""
+    from ..primitives import bls12381 as bls
+
+    try:
+        out = bls.g1add_precompile(bytes(data))
+    except bls.BlsError:
+        return False, 0, b""
+    return True, gas - 375, out
+
+
+def _pre_bls_g2add(data: bytes, gas: int):
+    """0x0d BLS12_G2ADD (EIP-2537): 600 gas, no subgroup check."""
+    if gas < 600:
+        return False, 0, b""
+    from ..primitives import bls12381 as bls
+
+    try:
+        out = bls.g2add_precompile(bytes(data))
+    except bls.BlsError:
+        return False, 0, b""
+    return True, gas - 600, out
+
+
+def _pre_bls_nyi(idx: int, name: str):
+    """EIP-2537 operations whose constants (MSM discount table, SWU
+    isogeny) this repo cannot verify offline: refuse loudly."""
+
+    def run(data, gas: int):
+        raise PrecompileNotImplemented(
+            f"BLS12-381 precompile 0x{idx:02x} ({name}) is not implemented; "
+            "executing it would silently diverge from consensus")
+
+    return run
+
+
 _RAW_PRECOMPILES = {
     1: _pre_ecrecover,
     2: _pre_sha256,
@@ -1156,6 +1203,15 @@ _RAW_PRECOMPILES = {
     8: _pre_bn_pairing,
     9: _pre_blake2f,
     10: _pre_point_eval,
+    # EIP-2537 (Prague): ADDs are implemented (pure affine arithmetic);
+    # MSM/pairing/map raise PrecompileNotImplemented instead of stubbing
+    11: _pre_bls_g1add,
+    12: _pre_bls_nyi(0x0C, "G1MSM"),
+    13: _pre_bls_g2add,
+    14: _pre_bls_nyi(0x0E, "G2MSM"),
+    15: _pre_bls_nyi(0x0F, "PAIRING_CHECK"),
+    16: _pre_bls_nyi(0x10, "MAP_FP_TO_G1"),
+    17: _pre_bls_nyi(0x11, "MAP_FP2_TO_G2"),
 }
 
 # -- precompile result cache (reference engine/tree precompile_cache.rs) ------
@@ -1209,7 +1265,7 @@ _ERA_TABLES: dict[tuple, dict] = {}
 
 
 def _era_table(spec) -> dict:
-    key = (min(spec.precompiles, 10), spec.modexp_eip2565, spec.bn_add_gas)
+    key = (min(spec.precompiles, 17), spec.modexp_eip2565, spec.bn_add_gas)
     table = _ERA_TABLES.get(key)
     if table is not None:
         return table

@@ -187,10 +187,13 @@ _DELTAS: dict[str, dict] = {
         history_contract_call=True, has_requests=True, blob=PRAGUE_BLOBS,
         # EIP-2537 extends the precompile ADDRESS RANGE to 0x11 (warming
         # per EIP-2929 init covers 1..17 — validated against the
-        # reference's hive chain). KNOWN GAP: the BLS operations
-        # themselves are not implemented (their MSM discount tables and
-        # hash-to-curve isogeny constants cannot be verified offline;
-        # a call to 0x0b..0x11 behaves as an empty account).
+        # reference's hive chain). G1ADD (0x0b) and G2ADD (0x0d) are
+        # implemented (primitives/bls12381.py); MSM/pairing/map (0x0c,
+        # 0x0e..0x11) raise PrecompileNotImplemented -> BlockExecutionError
+        # instead of silently acting as empty accounts, so the
+        # native/interpreter bit-identical invariant cannot be violated
+        # unnoticed (their MSM discount tables and hash-to-curve isogeny
+        # constants cannot be verified offline).
         precompiles=17,
     ),
     OSAKA: dict(),

@@ -176,6 +176,62 @@ class TrieMetrics:
 trie_metrics = TrieMetrics()
 
 
+class PipelineMetrics:
+    """Rebuild-pipeline observability (trie/turbo.py RebuildPipeline):
+    per-stage walls (sweep/pack/dispatch/fetch), bounded-queue depth, sweep
+    pool occupancy, window/packing counts, and queue drains onto the CPU
+    twin after a mid-rebuild device trip — what an operator needs to see
+    where the chunked Merkle rebuild is spending its time."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._stage_s = {
+            k: reg.counter(f"trie_pipeline_{k}_seconds_total")
+            for k in ("sweep", "pack", "dispatch", "fetch")
+        }
+        self._runs = reg.counter("trie_pipeline_runs_total")
+        self._windows = reg.counter(
+            "trie_pipeline_windows_total",
+            "cross-subtrie packed dispatch windows")
+        self._subtries = reg.counter("trie_pipeline_subtries_total")
+        self._drains = reg.counter(
+            "trie_pipeline_queue_drains_total",
+            "windows hashed on the CPU twin after a mid-rebuild failover")
+        self._qdepth = reg.gauge(
+            "trie_pipeline_queue_depth", "swept groups waiting for hashing")
+        self._busy = reg.gauge(
+            "trie_pipeline_pool_busy", "native sweeps currently running")
+        self.last: dict | None = None  # most recent run, for events/bench
+
+    def set_queue_depth(self, n: int) -> None:
+        self._qdepth.set(n)
+
+    def set_pool_busy(self, n: int) -> None:
+        self._busy.set(n)
+
+    def record_run(self, *, jobs: int, groups: int, windows: int,
+                   queue_peak: int, drained_windows: int, backend,
+                   wall_s: float, sweep: float, pack: float, dispatch: float,
+                   fetch: float) -> None:
+        self._runs.increment()
+        self._windows.increment(windows)
+        self._subtries.increment(jobs)
+        self._drains.increment(drained_windows)
+        for k, v in (("sweep", sweep), ("pack", pack),
+                     ("dispatch", dispatch), ("fetch", fetch)):
+            self._stage_s[k].increment(round(v, 6))
+        self.last = {
+            "jobs": jobs, "groups": groups, "windows": windows,
+            "queue_peak": queue_peak, "drained_windows": drained_windows,
+            "backend": backend, "wall_s": round(wall_s, 4),
+            "sweep_s": round(sweep, 4), "pack_s": round(pack, 4),
+            "dispatch_s": round(dispatch, 4), "fetch_s": round(fetch, 4),
+        }
+
+
+pipeline_metrics = PipelineMetrics()
+
+
 class SupervisorMetrics:
     """Device hasher supervisor state on /metrics (ops/supervisor.py):
     breaker state + trips, mid-commit failovers, watchdog timeouts, and

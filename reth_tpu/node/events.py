@@ -91,6 +91,17 @@ class NodeEventReporter:
                      f" breaker={s['breaker']}")
             if s["trips"] or s["failovers"]:
                 line += f" trips={s['trips']} failovers={s['failovers']}"
+        # rebuild-pipeline stage walls: during a chunked Merkle rebuild this
+        # is the line that says where the time goes (host sweep vs hashing)
+        from ..metrics import pipeline_metrics
+
+        pm = pipeline_metrics.last
+        if pm is not None:
+            line += (f" rebuild[win={pm['windows']} q^={pm['queue_peak']}"
+                     f" sweep={pm['sweep_s']}s pack={pm['pack_s']}s"
+                     f" disp={pm['dispatch_s']}s fetch={pm['fetch_s']}s]")
+            if pm["drained_windows"]:
+                line += f" drained={pm['drained_windows']}"
         log.info(line)
         return line
 

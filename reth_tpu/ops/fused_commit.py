@@ -208,6 +208,8 @@ class FusedLevelEngine:
     padding rows.
     """
 
+    effective_kind = "device"
+
     # hole budget per dispatch = _HOLE_FACTOR * batch tier; levels with more
     # holes (branch-heavy near-root levels) are split across dispatches
     _HOLE_FACTOR = 4
@@ -232,6 +234,21 @@ class FusedLevelEngine:
         slot = self._n_slots
         self._n_slots += 1
         return slot
+
+    def ensure(self, max_slots: int) -> None:
+        """Grow the resident digest buffer to ``max_slots`` slots,
+        preserving written digests (the pipelined rebuild only learns a
+        window's slot high-water mark when its sweep lands). Pow2 tiers
+        keep the copy-program count logarithmic."""
+        need = max_slots + 1
+        cur = 0 if self._buf is None else self._buf.shape[0]
+        if need <= cur:
+            return
+        new_tier = _pow2(need, floor=max(self.min_tier, 2, cur))
+        grown = self._device_put(np.zeros((new_tier, 32), dtype=np.uint8))
+        if cur:
+            grown = grown.at[:cur].set(self._buf)
+        self._buf = grown
 
     def finish(self) -> np.ndarray:
         buf, self._buf = self._buf, None
@@ -532,6 +549,15 @@ class MegaFusedEngine(FusedLevelEngine):
         self._plan, self._u8_parts, self._i32_parts = [], [], []
         self._u8_off = self._i32_off = 0
         self._buf = None
+
+    def ensure(self, max_slots: int) -> None:
+        """Staged variant: before ``_execute`` the buffer is only a planned
+        shape, so growth is free — just raise the tier."""
+        if self._buf is None:
+            self._s_tier = max(self._s_tier,
+                               _pow2(max_slots + 1, floor=max(self.min_tier, 2)))
+        else:  # already materialized (post-fetch reuse): real copy-grow
+            super().ensure(max_slots)
 
     # program-shape tiers are pow2 from these floors: compile count stays
     # O(log workload) while the STAGED bytes remain tight (padding never

@@ -69,6 +69,13 @@ class InjectedWedge(DeviceDispatchError):
     """Fault injection wedged this dispatch (RETH_TPU_FAULT_WEDGE_EVERY)."""
 
 
+class InjectedPipelineAbort(RuntimeError):
+    """Fault injection killed the rebuild pipeline at a window boundary
+    (RETH_TPU_FAULT_PIPELINE_ABORT) — the in-process analogue of a crash
+    mid-queue. Deliberately NOT a DeviceDispatchError: it must abort the
+    whole chunk (so resume-from-progress is exercised), not fail over."""
+
+
 class ProbeResult:
     __slots__ = ("ok", "latency", "diag")
 
@@ -149,20 +156,25 @@ class FaultInjector:
     the watchdog budget this exercises the REAL timeout path.
     ``probe_fail``: the first N health probes report failure (negative =
     all probes fail forever), so breaker recovery is testable.
+    ``pipeline_abort``: the Nth rebuild-pipeline window raises
+    :class:`InjectedPipelineAbort` — kills the chunk mid-queue so the
+    chunked rebuild's resume-from-progress path is testable in-process.
 
     Env form (read by :meth:`from_env`, also settable via CLI):
     ``RETH_TPU_FAULT_WEDGE_EVERY`` / ``RETH_TPU_FAULT_DELAY`` /
-    ``RETH_TPU_FAULT_PROBE_FAIL``.
+    ``RETH_TPU_FAULT_PROBE_FAIL`` / ``RETH_TPU_FAULT_PIPELINE_ABORT``.
     """
 
     def __init__(self, wedge_every: int = 0, delay: float = 0.0,
-                 probe_fail: int = 0):
+                 probe_fail: int = 0, pipeline_abort: int = 0):
         self.wedge_every = wedge_every
         self.delay = delay
         self.probe_fail = probe_fail
+        self.pipeline_abort = pipeline_abort
         self.dispatch_count = 0
         self.wedged = 0
         self.probes_failed = 0
+        self.windows = 0
         self._lock = threading.Lock()
 
     @classmethod
@@ -172,12 +184,28 @@ class FaultInjector:
         wedge = int(env.get("RETH_TPU_FAULT_WEDGE_EVERY", "0") or 0)
         delay = float(env.get("RETH_TPU_FAULT_DELAY", "0") or 0)
         probe = int(env.get("RETH_TPU_FAULT_PROBE_FAIL", "0") or 0)
-        if not (wedge or delay or probe):
+        pabort = int(env.get("RETH_TPU_FAULT_PIPELINE_ABORT", "0") or 0)
+        if not (wedge or delay or probe or pabort):
             return None
-        return cls(wedge_every=wedge, delay=delay, probe_fail=probe)
+        return cls(wedge_every=wedge, delay=delay, probe_fail=probe,
+                   pipeline_abort=pabort)
 
     def active(self) -> bool:
-        return bool(self.wedge_every or self.delay or self.probe_fail)
+        return bool(self.wedge_every or self.delay or self.probe_fail
+                    or self.pipeline_abort)
+
+    def on_pipeline_window(self) -> None:
+        """Called by the rebuild pipeline before dispatching each packed
+        window; the Nth call aborts the commit."""
+        if not self.pipeline_abort:
+            return
+        with self._lock:
+            self.windows += 1
+            n = self.windows
+        if n == self.pipeline_abort:
+            raise InjectedPipelineAbort(
+                f"injected pipeline abort at window #{n} "
+                f"(RETH_TPU_FAULT_PIPELINE_ABORT={self.pipeline_abort})")
 
     def on_dispatch(self) -> None:
         """Called before every supervised device call."""
@@ -468,9 +496,11 @@ class SupervisedBackend:
     engine often only blocks at its sync point.
     """
 
-    def __init__(self, supervisor: DeviceSupervisor, device_factory):
+    def __init__(self, supervisor: DeviceSupervisor, device_factory,
+                 arena=None):
         self.sup = supervisor
         self._factory = device_factory
+        self._arena = arena  # resident DigestArena for the CPU twin
         self._journal: list[tuple[str, tuple]] = []
         self._device = None
         self._cpu = None
@@ -484,7 +514,7 @@ class SupervisedBackend:
         from ..trie.turbo import _NumpyBackend
 
         self._device = None
-        self._cpu = _NumpyBackend()
+        self._cpu = _NumpyBackend(arena=self._arena)
         if mid_commit and not self.failed_over:
             self.failed_over = True
             self.sup.record_failover()
@@ -532,6 +562,12 @@ class SupervisedBackend:
         self._journal.append(("alloc_slot", ()))
         live = self._device if self._device is not None else self._cpu
         return live.alloc_slot()
+
+    def ensure(self, max_slots: int) -> None:
+        """Arena-growth protocol (pipelined rebuild): guarded on the device
+        and journaled, so a replayed CPU twin re-grows to the same capacity
+        before the journal's later dispatches land."""
+        self._call("ensure", max_slots)
 
     def dispatch_level(self, bucket):
         """Committer bucket protocol (TrieCommitter fused hash phase)."""

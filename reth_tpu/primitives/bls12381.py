@@ -1,0 +1,188 @@
+"""Minimal BLS12-381 arithmetic for the EIP-2537 precompiles.
+
+Only what G1ADD (0x0b) and G2ADD (0x0d) need: Fp / Fp2 field ops and
+affine point addition on y^2 = x^3 + 4 (G1) and y^2 = x^3 + 4(1+i) (G2).
+Per EIP-2537, ADD inputs must be valid field encodings on the curve but
+do NOT require a subgroup check; the point at infinity encodes as all
+zeros. Everything here is plain python ints — these precompiles are rare
+enough on mainnet that constant-factor speed is irrelevant, while the
+encode/validate rules are consensus-critical.
+
+The remaining EIP-2537 operations (MSM, pairing, map-to-curve) need the
+MSM discount table and the SWU isogeny constants, which this repo cannot
+verify offline — their precompiles raise loudly instead of silently
+misbehaving (see evm/interpreter.py PrecompileNotImplemented).
+"""
+
+from __future__ import annotations
+
+# the BLS12-381 base field prime
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+_B1 = 4            # G1 curve constant: y^2 = x^3 + 4
+_B2 = (4, 4)       # G2 curve constant: 4 * (1 + i) in Fp2
+
+
+class BlsError(ValueError):
+    """Invalid EIP-2537 input (length, padding, range, or off-curve)."""
+
+
+# -- Fp -----------------------------------------------------------------------
+
+
+def _fp_decode(b: bytes) -> int:
+    """One 64-byte padded field element: top 16 bytes zero, value < P."""
+    if len(b) != 64:
+        raise BlsError(f"field element must be 64 bytes, got {len(b)}")
+    if b[:16] != b"\x00" * 16:
+        raise BlsError("field element padding is not zero")
+    v = int.from_bytes(b[16:], "big")
+    if v >= P:
+        raise BlsError("field element not in canonical range")
+    return v
+
+
+def _fp_encode(v: int) -> bytes:
+    return b"\x00" * 16 + v.to_bytes(48, "big")
+
+
+def _fp_inv(v: int) -> int:
+    return pow(v, P - 2, P)
+
+
+# -- Fp2 (c0 + c1*i with i^2 = -1) -------------------------------------------
+
+
+def _fp2_add(a, b):
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def _fp2_sub(a, b):
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def _fp2_mul(a, b):
+    return ((a[0] * b[0] - a[1] * b[1]) % P, (a[0] * b[1] + a[1] * b[0]) % P)
+
+
+def _fp2_inv(a):
+    norm = _fp_inv((a[0] * a[0] + a[1] * a[1]) % P)
+    return (a[0] * norm % P, (-a[1]) * norm % P)
+
+
+# -- affine point addition (shared shape over both fields) -------------------
+
+
+def _add_affine(p1, p2, *, add, sub, mul, inv, zero):
+    """Affine chord-tangent addition; ``None`` is the point at infinity."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if add(y1, y2) == zero:  # P + (-P), including doubling a y=0 point
+            return None
+        # doubling: lambda = 3 x^2 / 2 y
+        x_sq = mul(x1, x1)
+        lam = mul(add(add(x_sq, x_sq), x_sq), inv(add(y1, y1)))
+    else:
+        lam = mul(sub(y2, y1), inv(sub(x2, x1)))
+    x3 = sub(sub(mul(lam, lam), x1), x2)
+    y3 = sub(mul(lam, sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def _g1_ops():
+    return dict(add=lambda a, b: (a + b) % P, sub=lambda a, b: (a - b) % P,
+                mul=lambda a, b: (a * b) % P, inv=_fp_inv, zero=0)
+
+
+def _g2_ops():
+    return dict(add=_fp2_add, sub=_fp2_sub, mul=_fp2_mul, inv=_fp2_inv,
+                zero=(0, 0))
+
+
+# -- G1 -----------------------------------------------------------------------
+
+
+def decode_g1(b: bytes):
+    """128-byte G1 point (x||y); all-zero = infinity. On-curve checked
+    (EIP-2537 ADD semantics: curve check yes, subgroup check no)."""
+    if len(b) != 128:
+        raise BlsError(f"G1 point must be 128 bytes, got {len(b)}")
+    x = _fp_decode(b[:64])
+    y = _fp_decode(b[64:])
+    if x == 0 and y == 0:
+        return None
+    if (y * y - (x * x * x + _B1)) % P != 0:
+        raise BlsError("G1 point not on curve")
+    return (x, y)
+
+
+def encode_g1(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * 128
+    return _fp_encode(pt[0]) + _fp_encode(pt[1])
+
+
+def g1_add(p1, p2):
+    return _add_affine(p1, p2, **_g1_ops())
+
+
+def g1add_precompile(data: bytes) -> bytes:
+    """EIP-2537 G1ADD: 256-byte input (two G1 points), 128-byte output."""
+    if len(data) != 256:
+        raise BlsError(f"G1ADD input must be 256 bytes, got {len(data)}")
+    return encode_g1(g1_add(decode_g1(data[:128]), decode_g1(data[128:])))
+
+
+# -- G2 -----------------------------------------------------------------------
+
+
+def decode_g2(b: bytes):
+    """256-byte G2 point (x_c0||x_c1||y_c0||y_c1); all-zero = infinity."""
+    if len(b) != 256:
+        raise BlsError(f"G2 point must be 256 bytes, got {len(b)}")
+    x = (_fp_decode(b[0:64]), _fp_decode(b[64:128]))
+    y = (_fp_decode(b[128:192]), _fp_decode(b[192:256]))
+    if x == (0, 0) and y == (0, 0):
+        return None
+    rhs = _fp2_add(_fp2_mul(_fp2_mul(x, x), x), _B2)
+    if _fp2_sub(_fp2_mul(y, y), rhs) != (0, 0):
+        raise BlsError("G2 point not on curve")
+    return (x, y)
+
+
+def encode_g2(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * 256
+    (x, y) = pt
+    return (_fp_encode(x[0]) + _fp_encode(x[1])
+            + _fp_encode(y[0]) + _fp_encode(y[1]))
+
+
+def g2_add(p1, p2):
+    return _add_affine(p1, p2, **_g2_ops())
+
+
+def g2add_precompile(data: bytes) -> bytes:
+    """EIP-2537 G2ADD: 512-byte input (two G2 points), 256-byte output."""
+    if len(data) != 512:
+        raise BlsError(f"G2ADD input must be 512 bytes, got {len(data)}")
+    return encode_g2(g2_add(decode_g2(data[:256]), decode_g2(data[256:])))
+
+
+# the standard generators (draft-irtf-cfrg-bls-signature / EIP-2537 test
+# vectors use them); exported for tests
+G1_GENERATOR = (
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+)
+G2_GENERATOR = (
+    (0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+     0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E),
+    (0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+     0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE),
+)

@@ -50,22 +50,40 @@ class MerkleStage(Stage):
         self.committer = committer or TrieCommitter()
         self.rebuild_threshold = rebuild_threshold
         self.chunk_leaves = chunk_leaves
+        self._turbo = None  # cached: keeps the digest arena resident
 
-    def _commit_subtries(self, jobs, start_depth: int = 0):
-        """Commit (keys, values) subtrie jobs: turbo fast path, general
-        committer fallback (native build unavailable / oversized values —
-        the same degradation the single-shot path documents). A committer
-        carrying a supervisor ("auto" route) hands it down so every chunk's
-        device dispatches stay watchdog-bounded with CPU failover."""
-        try:
+    def _turbo_committer(self):
+        """One TurboCommitter per stage instance, so the resident digest
+        arena (trie/turbo.DigestArena) survives across rebuild chunks
+        instead of re-allocating per prefix pass."""
+        if self._turbo is None:
             from ..trie.turbo import TurboCommitter
 
-            turbo = TurboCommitter(
+            self._turbo = TurboCommitter(
                 backend=getattr(self.committer, "turbo_backend", "numpy"),
                 supervisor=getattr(self.committer, "supervisor", None),
             )
-            return turbo.commit_hashed_many(jobs, collect_branches=True,
-                                            start_depth=start_depth)
+        return self._turbo
+
+    def _commit_subtries(self, jobs, start_depth: int = 0):
+        """Commit (keys, values) subtrie jobs through the OVERLAPPED rebuild
+        pipeline (trie/turbo.RebuildPipeline): pooled native sweeps feed a
+        bounded queue, same-depth levels from different subtries pack into
+        fused dispatches against the resident digest arena. Falls back to
+        the general committer when the fast path rejects the input (native
+        build unavailable / oversized values — the same degradation the
+        single-shot path documents). A committer carrying a supervisor
+        ("auto" route) hands it down so every chunk's device dispatches
+        stay watchdog-bounded, and a mid-rebuild device trip drains the
+        pipeline's queue onto the numpy twin without losing the chunk."""
+        from ..ops.supervisor import InjectedPipelineAbort
+
+        try:
+            turbo = self._turbo_committer()
+            return turbo.commit_hashed_pipelined(jobs, collect_branches=True,
+                                                 start_depth=start_depth)
+        except InjectedPipelineAbort:
+            raise  # fault drill: the chunk must die, not degrade
         except (ValueError, RuntimeError):
             py_jobs = [
                 ([(unpack_nibbles(k.tobytes())[start_depth:], v)
@@ -181,6 +199,11 @@ class MerkleStage(Stage):
         new_entries = bytearray()
         leaves = 0
         prefix = 0
+        # gather every prefix subtrie of this chunk FIRST, then commit them
+        # through ONE overlapped pipeline pass: pooled native sweeps overlap
+        # hashing, and same-depth levels from different prefixes share fused
+        # dispatches instead of 256 tiny per-prefix commits
+        chunk_jobs: list[tuple[int, "np.ndarray", list[bytes]]] = []
         while prefix < 256 and leaves < self.chunk_leaves:
             if prefix in done:
                 prefix += 1
@@ -205,16 +228,23 @@ class MerkleStage(Stage):
                 prefix += 1
                 continue
             keys_np = np.frombuffer(b"".join(keys), dtype=np.uint8).reshape(-1, 32)
-            res = self._commit_subtries([(keys_np, vals)], start_depth=2)[0]
-            pfx_nibbles = bytes([prefix >> 4, prefix & 0xF])
-            for path, node in res.branch_nodes.items():
-                p.put_account_branch(pfx_nibbles + path, node)
-            # progress records whether the subtrie holds branch nodes (the
-            # stitch needs it for the parents' tree_mask): flag byte + root
-            done[prefix] = (1 if res.branch_nodes else 0, res.root)
-            new_entries += bytes([prefix, 1 if res.branch_nodes else 0]) + res.root
+            chunk_jobs.append((prefix, keys_np, vals))
             leaves += len(keys)
             prefix += 1
+        if chunk_jobs:
+            results = self._commit_subtries(
+                [(keys_np, vals) for _, keys_np, vals in chunk_jobs],
+                start_depth=2)
+            for (pfx, _keys_np, _vals), res in zip(chunk_jobs, results):
+                pfx_nibbles = bytes([pfx >> 4, pfx & 0xF])
+                for path, node in res.branch_nodes.items():
+                    p.put_account_branch(pfx_nibbles + path, node)
+                # progress records whether the subtrie holds branch nodes
+                # (the stitch needs it for the parents' tree_mask):
+                # flag byte + root
+                done[pfx] = (1 if res.branch_nodes else 0, res.root)
+                new_entries += (bytes([pfx, 1 if res.branch_nodes else 0])
+                                + res.root)
         if len(done) < 256:
             p.save_stage_progress(self.id, b"A" + tb + done_blob + bytes(new_entries))
             return None

@@ -23,8 +23,12 @@ work and the device does all hashing.
 from __future__ import annotations
 
 import ctypes
+import os
+import queue as queue_mod
 import subprocess
 import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
 import numpy as np
@@ -143,20 +147,92 @@ class _Level:
         self.b_tier = bt
 
 
+class DigestArena:
+    """Resident host staging for the numpy hashing twin.
+
+    One arena lives as long as its committer and is REUSED across commits:
+    the (S, 32) digest buffer grows geometrically and is never freed
+    between rebuild chunks, and each hashing thread keeps a resident
+    row-staging scratch — replacing the per-subtrie buffer allocations the
+    chunked rebuild used to pay once per prefix per pass. Growth preserves
+    already-written digests, so a pipelined commit can extend the arena
+    mid-flight (``ensure``) without re-hashing earlier subtries."""
+
+    def __init__(self):
+        self._digests: np.ndarray | None = None
+        self._tls = threading.local()
+        self.grows = 0  # observability: how often the arena re-allocated
+
+    def digest_buf(self, n_slots: int) -> np.ndarray:
+        cur = self._digests
+        if cur is None or cur.shape[0] < n_slots:
+            cap = 1024 if cur is None else cur.shape[0]
+            while cap < n_slots:
+                cap *= 2
+            buf = np.zeros((cap, 32), dtype=np.uint8)
+            if cur is not None:
+                buf[: cur.shape[0]] = cur
+                self.grows += 1
+            self._digests = buf
+        return self._digests
+
+    def rows(self, n: int, length: int) -> np.ndarray:
+        """Per-thread resident staging for one dispatch's padded rows
+        (thread-local: hash workers never share a scratch buffer)."""
+        need = n * length
+        buf = getattr(self._tls, "buf", None)
+        if buf is None or buf.size < need:
+            buf = np.empty((max(need, 1 << 16),), dtype=np.uint8)
+            self._tls.buf = buf
+        return buf[:need].reshape(n, length)
+
+
 class _NumpyBackend:
     """CPU twin of the device engine — the measured baseline, the no-jax
     fallback, and the supervisor's mid-commit failover target
     (ops/supervisor.py SupervisedBackend). Same array protocol as the
     fused engines — including the committer's bucket protocol
-    (``alloc_slot``/``dispatch_level``) — with digests in a host buffer."""
+    (``alloc_slot``/``dispatch_level``) — with digests in a host buffer.
+    With an ``arena`` the digest buffer and row staging are resident
+    (reused across commits) instead of per-commit allocations."""
 
-    def __init__(self):
+    effective_kind = "numpy"
+
+    def __init__(self, arena: DigestArena | None = None):
+        self._arena = arena
         self._buf = None
         self._n_slots = 1
 
     def begin(self, max_slots: int) -> None:
-        self._buf = np.zeros((max_slots + 1, 32), dtype=np.uint8)
+        if self._arena is not None:
+            self._buf = self._arena.digest_buf(max_slots + 1)
+        else:
+            self._buf = np.zeros((max_slots + 1, 32), dtype=np.uint8)
         self._n_slots = 1  # slot 0 = dummy (mirrors FusedLevelEngine)
+
+    def ensure(self, max_slots: int) -> None:
+        """Grow the digest buffer to hold ``max_slots`` slots, preserving
+        written digests. The pipelined committer only learns a window's
+        slot high-water mark when its sweep lands, so capacity extends
+        mid-commit. Callers must not have dispatches in flight."""
+        need = max_slots + 1
+        if self._buf is not None and self._buf.shape[0] >= need:
+            return
+        if self._arena is not None:
+            self._buf = self._arena.digest_buf(need)
+            return
+        cap = max(1024, self._buf.shape[0] if self._buf is not None else 0)
+        while cap < need:
+            cap *= 2
+        grown = np.zeros((cap, 32), dtype=np.uint8)
+        if self._buf is not None:
+            grown[: self._buf.shape[0]] = self._buf
+        self._buf = grown
+
+    def _rows_scratch(self, n: int, length: int) -> np.ndarray:
+        if self._arena is not None:
+            return self._arena.rows(n, length)
+        return np.empty((n, length), dtype=np.uint8)
 
     def alloc_slot(self) -> int:
         slot = self._n_slots
@@ -173,7 +249,8 @@ class _NumpyBackend:
         while b_tier < bucket.nb_max:
             b_tier *= 2
         L = b_tier * RATE
-        rows = np.zeros((n, L), dtype=np.uint8)
+        rows = self._rows_scratch(n, L)
+        rows[:] = 0
         for i, t in enumerate(bucket.templates):
             rows[i, : len(t)] = np.frombuffer(t, dtype=np.uint8)
             rows[i, len(t)] ^= 0x01
@@ -197,7 +274,12 @@ class _NumpyBackend:
         L = b_tier * RATE
         col = np.arange(L, dtype=np.uint32)[None, :]
         idx = np.minimum(row_off[:, None] + col, max(len(flat) - 1, 0))
-        rows = np.where(col < row_len[:, None], flat[idx] if len(flat) else 0, 0).astype(np.uint8)
+        rows = self._rows_scratch(n, L)
+        if len(flat):
+            np.take(flat, idx.astype(np.int64, copy=False), out=rows)
+            np.multiply(rows, col < row_len[:, None], out=rows, casting="unsafe")
+        else:
+            rows[:] = 0
         r = np.arange(n)
         counts = (row_len // RATE + 1).astype(np.int64)
         rows[r, row_len] ^= 0x01
@@ -219,7 +301,8 @@ class _NumpyBackend:
         payload = sizes.sum(axis=1) + 1
         hl = np.where(payload > 0xFF, 3, 2)
         total = hl + payload
-        rows = np.zeros((n, L), dtype=np.uint8)
+        rows = self._rows_scratch(n, L)
+        rows[:] = 0
         rows[:, 0] = np.where(hl == 3, 0xF9, 0xF8)
         rows[:, 1] = np.where(hl == 3, payload >> 8, payload & 0xFF)
         rows[:, 2] = payload & 0xFF  # f8 rows: overwritten by first marker
@@ -246,6 +329,441 @@ class _NumpyBackend:
         return buf
 
 
+def _marshal_and_build(lib, jobs, collect_branches: bool, start_depth: int):
+    """Sort each job's keys, flatten values, and run the native structure
+    sweep. Returns (handle, per-job sorted key arrays); the caller owns the
+    handle (``rtb_free``). Raises ``ValueError`` on sweep rejection —
+    exactly the condition the MerkleStage uses to fall back to the general
+    committer."""
+    key_arrays, val_chunks, job_off = [], [], [0]
+    for keys, values in jobs:
+        keys = np.ascontiguousarray(keys, dtype=np.uint8).reshape(-1, 32)
+        if len(keys) != len(values):
+            raise ValueError("keys/values length mismatch")
+        order = np.argsort(keys.view("S32").ravel(), kind="stable")
+        key_arrays.append(keys[order])
+        val_chunks.extend(values[i] for i in order)
+        job_off.append(job_off[-1] + len(keys))
+    all_keys = (
+        np.concatenate(key_arrays) if key_arrays else np.zeros((0, 32), np.uint8)
+    )
+    flat_vals = b"".join(val_chunks)
+    val_off = np.zeros((len(val_chunks) + 1,), dtype=np.uint64)
+    if val_chunks:
+        val_off[1:] = np.cumsum(
+            np.fromiter((len(v) for v in val_chunks), dtype=np.uint64,
+                        count=len(val_chunks))
+        )
+    vals_np = np.frombuffer(flat_vals, dtype=np.uint8) if flat_vals else np.zeros(1, np.uint8)
+    job_off_np = np.asarray(job_off, dtype=np.uint64)
+    err = ctypes.c_int32(0)
+    h = lib.rtb_build(
+        _ptr(np.ascontiguousarray(all_keys), _u8p), len(all_keys),
+        _ptr(job_off_np, _u64p), len(jobs),
+        _ptr(vals_np, _u8p), _ptr(val_off, _u64p),
+        1 if collect_branches else 0, start_depth, ctypes.byref(err),
+    )
+    if not h:
+        reason = {1: "unsorted", 2: "duplicate keys", 3: "bad input",
+                  4: "oversized leaf value"}.get(err.value, "unknown")
+        raise ValueError(f"triebuild failed (err={err.value}: {reason})")
+    return h, key_arrays
+
+
+# -- pipelined rebuild --------------------------------------------------------
+
+
+class _SweepResult:
+    """One sweep group's host arrays, extracted from the native handle so
+    the handle can be freed inside the producer thread. Slots are the
+    group's own 1..max_slot namespace; the consumer rebases them into the
+    shared arena."""
+
+    __slots__ = ("job_ids", "key_arrays", "levels", "root_slots",
+                 "root_inlines", "meta_rec", "max_slot", "n_levels",
+                 "wire_bytes", "hashed_nodes", "leaves", "sweep_s")
+
+    def __init__(self, job_ids, key_arrays, levels, root_slots, root_inlines,
+                 meta_rec, max_slot, wire_bytes, sweep_s):
+        self.job_ids = job_ids
+        self.key_arrays = key_arrays
+        self.levels = levels
+        self.root_slots = root_slots
+        self.root_inlines = root_inlines
+        self.meta_rec = meta_rec
+        self.max_slot = max_slot
+        self.n_levels = len(levels)
+        self.wire_bytes = wire_bytes
+        self.hashed_nodes = sum(len(lv.row_slot) + len(lv.masks) for lv in levels)
+        self.leaves = sum(len(k) for k in key_arrays)
+        self.sweep_s = sweep_s
+
+
+def _sweep_group(lib, jobs, job_ids, collect_branches, start_depth) -> _SweepResult:
+    """Producer body: native sweep of one job group (the C++ build releases
+    the GIL, so groups sweep concurrently) + full array extraction."""
+    t0 = time.perf_counter()
+    h, key_arrays = _marshal_and_build(lib, jobs, collect_branches, start_depth)
+    try:
+        n_levels = lib.rtb_num_levels(h)
+        levels = [_Level(lib, h, i) for i in range(n_levels)]
+        root_slots = np.zeros((len(jobs),), dtype=np.int32)
+        lib.rtb_roots(h, _ptr(root_slots, _i32p))
+        root_inlines: list[bytes | None] = [None] * len(jobs)
+        for j in range(len(jobs)):
+            if root_slots[j] <= 0:
+                ln = lib.rtb_root_inline_len(h, j)
+                buf = np.zeros((ln,), dtype=np.uint8)
+                if ln:
+                    lib.rtb_root_inline(h, j, _ptr(buf, _u8p))
+                root_inlines[j] = buf.tobytes()
+        meta_rec = None
+        if collect_branches:
+            nmeta = int(lib.rtb_meta_count(h))
+            meta_rec = np.zeros((nmeta, 80), dtype=np.uint8)
+            if nmeta:
+                lib.rtb_meta_get(h, _ptr(meta_rec, _u8p))
+        max_slot = lib.rtb_max_slot(h)
+    finally:
+        lib.rtb_free(h)
+    wire_bytes = sum(lv.flat.nbytes + lv.row_off.nbytes + lv.row_len.nbytes
+                     + lv.masks.nbytes + lv.children.nbytes for lv in levels)
+    return _SweepResult(job_ids, key_arrays, levels, root_slots, root_inlines,
+                        meta_rec, max_slot, wire_bytes,
+                        time.perf_counter() - t0)
+
+
+class _MergedLevel:
+    """One fused dispatch worth of same-depth rows packed across subtrie
+    sweeps (slots already rebased into the shared arena)."""
+
+    __slots__ = ("depth", "flat", "row_off", "row_len", "row_slot", "holes",
+                 "b_tier", "masks", "bmp_slot", "children")
+
+
+def _rebase_level(lv: _Level, base: int) -> None:
+    """Shift a freshly-swept level's slot references into the arena's slot
+    space. In place: each _Level is consumed exactly once."""
+    if base == 0:
+        return
+    if len(lv.row_slot):
+        lv.row_slot += base
+    if lv.holes is not None:
+        lv.holes[2] += base
+    if len(lv.bmp_slot):
+        lv.bmp_slot += base
+    if lv.children.shape[1]:
+        lv.children[2] += base
+
+
+def _pack_window(parts: list[tuple[int, _SweepResult]]) -> list[_MergedLevel]:
+    """Cross-subtrie level packing: merge the window's per-sweep levels by
+    depth into one fused dispatch per (depth, kind), deepest first. Within
+    a sweep, deeper levels must hash before their parents; across sweeps
+    there is no ordering constraint, so same-depth rows from different
+    subtries share a dispatch — larger batch tiers, fewer dispatches, and
+    a bounded compiled-program count on the device backends."""
+    by_depth: dict[int, list[_Level]] = {}
+    for base, sw in parts:
+        for lv in sw.levels:
+            _rebase_level(lv, base)
+            by_depth.setdefault(int(lv.depth), []).append(lv)
+    out = []
+    for depth in sorted(by_depth, reverse=True):
+        group = by_depth[depth]
+        m = _MergedLevel()
+        m.depth = depth
+        packed = [lv for lv in group if len(lv.row_slot)]
+        if len(packed) == 1:
+            lv = packed[0]
+            m.flat, m.row_off, m.row_len = lv.flat, lv.row_off, lv.row_len
+            m.row_slot, m.holes, m.b_tier = lv.row_slot, lv.holes, lv.b_tier
+        elif packed:
+            m.flat = np.concatenate([lv.flat for lv in packed])
+            byte_off = np.cumsum([0] + [lv.flat.nbytes for lv in packed])
+            row_cnt = np.cumsum([0] + [len(lv.row_slot) for lv in packed])
+            m.row_off = np.concatenate(
+                [lv.row_off + np.uint32(byte_off[i]) for i, lv in enumerate(packed)])
+            m.row_len = np.concatenate([lv.row_len for lv in packed])
+            m.row_slot = np.concatenate([lv.row_slot for lv in packed])
+            holes = []
+            for i, lv in enumerate(packed):
+                if lv.holes is not None:
+                    hs = lv.holes
+                    hs[0] += np.int32(row_cnt[i])
+                    holes.append(hs)
+            m.holes = np.concatenate(holes, axis=1) if holes else None
+            m.b_tier = max(lv.b_tier for lv in packed)
+        else:
+            m.flat = np.zeros((0,), dtype=np.uint8)
+            m.row_off = m.row_len = np.zeros((0,), dtype=np.uint32)
+            m.row_slot = np.zeros((0,), dtype=np.int32)
+            m.holes, m.b_tier = None, 1
+        bmp = [lv for lv in group if len(lv.bmp_slot)]
+        if len(bmp) == 1:
+            m.masks, m.bmp_slot, m.children = bmp[0].masks, bmp[0].bmp_slot, bmp[0].children
+        elif bmp:
+            mask_cnt = np.cumsum([0] + [len(lv.bmp_slot) for lv in bmp])
+            m.masks = np.concatenate([lv.masks for lv in bmp])
+            m.bmp_slot = np.concatenate([lv.bmp_slot for lv in bmp])
+            kids = []
+            for i, lv in enumerate(bmp):
+                ch = lv.children
+                if ch.shape[1]:
+                    ch[0] += np.int32(mask_cnt[i])
+                    kids.append(ch)
+            m.children = (np.concatenate(kids, axis=1) if kids
+                          else np.zeros((3, 0), dtype=np.int32))
+        else:
+            m.masks = np.zeros((0,), dtype=np.uint16)
+            m.bmp_slot = np.zeros((0,), dtype=np.int32)
+            m.children = np.zeros((3, 0), dtype=np.int32)
+        out.append(m)
+    return out
+
+
+def _group_jobs(jobs, max_leaves: int, max_jobs: int):
+    """Slice the job list into sweep groups: each group is one native
+    build (shared levels within the group), bounded by leaves and job
+    count so sweeps stay small enough to overlap hashing."""
+    groups = []
+    lo = 0
+    while lo < len(jobs):
+        hi, leaves = lo, 0
+        while hi < len(jobs) and (hi - lo) < max_jobs:
+            leaves += len(jobs[hi][1])
+            hi += 1
+            if leaves >= max_leaves:
+                break
+        groups.append((lo, hi))
+        lo = hi
+    return groups
+
+
+class RebuildPipeline:
+    """Producer/consumer rebuild pipeline over the turbo commit path.
+
+    A small thread pool runs ``native/triebuild.cpp`` sweeps for groups of
+    prefix subtries concurrently (the ctypes call releases the GIL),
+    feeding swept level arrays through a bounded queue; the consumer packs
+    same-depth levels from different subtries into fused dispatches
+    (``_pack_window``) against a resident digest arena, so the host sweep
+    of subtrie group k+1..k+P overlaps hashing of group k. Optional hash
+    workers parallelize window hashing on the numpy twin (windows touch
+    disjoint arena slot ranges, so they are independent).
+
+    Fault surface: a supervised backend ("auto") fails over mid-commit to
+    the numpy twin via its journal — the pipeline keeps feeding it, which
+    is exactly the "drain the queue onto the CPU" semantics; an injected
+    ``RETH_TPU_FAULT_PIPELINE_ABORT`` kills the run at a window boundary
+    to exercise chunked-rebuild resume.
+    """
+
+    def __init__(self, backend, lib=None, *, sweep_workers=None,
+                 hash_workers=1, pack_window=None, queue_depth=None,
+                 leaves_per_sweep=None, jobs_per_sweep=None, injector=None):
+        env = os.environ
+        cpus = os.cpu_count() or 1
+        self.backend = backend
+        self.lib = lib or load_library()
+        self.sweep_workers = int(
+            sweep_workers
+            or env.get("RETH_TPU_PIPELINE_SWEEPERS", 0)
+            or max(2, min(4, cpus)))
+        self.hash_workers = max(1, int(
+            hash_workers or env.get("RETH_TPU_PIPELINE_HASHERS", 1)))
+        self.pack_window = int(
+            pack_window or env.get("RETH_TPU_PIPELINE_WINDOW", 0) or 16)
+        self.queue_depth = int(queue_depth or 2 * self.sweep_workers)
+        self.leaves_per_sweep = int(
+            leaves_per_sweep
+            or env.get("RETH_TPU_PIPELINE_SWEEP_LEAVES", 0) or 32768)
+        self.jobs_per_sweep = int(jobs_per_sweep or 64)
+        self.injector = injector
+        self.windows = 0
+        self.queue_peak = 0
+        self.wire_bytes = 0
+
+    def run(self, jobs, collect_branches: bool = False, start_depth: int = 0):
+        from ..metrics import pipeline_metrics
+
+        if not jobs:
+            return []
+        t_wall = time.perf_counter()
+        met = pipeline_metrics
+        groups = _group_jobs(jobs, self.leaves_per_sweep, self.jobs_per_sweep)
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=self.queue_depth)
+        stop = threading.Event()
+        busy = [0]
+        busy_lock = threading.Lock()
+        lib, backend = self.lib, self.backend
+
+        def task(lo: int, hi: int):
+            if stop.is_set():
+                return
+            with busy_lock:
+                busy[0] += 1
+                met.set_pool_busy(busy[0])
+            try:
+                out = _sweep_group(lib, jobs[lo:hi], range(lo, hi),
+                                   collect_branches, start_depth)
+            except BaseException as e:  # noqa: BLE001 — re-raised by consumer
+                out = e
+            finally:
+                with busy_lock:
+                    busy[0] -= 1
+                    met.set_pool_busy(busy[0])
+            while not stop.is_set():
+                try:
+                    q.put(out, timeout=0.05)
+                    met.set_queue_depth(q.qsize())
+                    return
+                except queue_mod.Full:
+                    continue
+
+        pool = ThreadPoolExecutor(max_workers=self.sweep_workers,
+                                  thread_name_prefix="trie-sweep")
+        hash_pool = (ThreadPoolExecutor(max_workers=self.hash_workers,
+                                        thread_name_prefix="trie-hash")
+                     if self.hash_workers > 1 else None)
+        stages = {"sweep": 0.0, "pack": 0.0, "dispatch": 0.0, "fetch": 0.0}
+        results: list = [None] * len(jobs)
+        swept: list[tuple[int, _SweepResult]] = []  # (slot_base, sweep)
+        pending: list = []
+        next_slot = [1]
+        ensured = [0]
+        drained = [0]
+
+        def flush(window: list[_SweepResult]) -> None:
+            t0 = time.perf_counter()
+            parts = []
+            for sw in window:
+                base = next_slot[0] - 1  # group slot s -> arena slot base+s
+                next_slot[0] += sw.max_slot
+                parts.append((base, sw))
+                swept.append((base, sw))
+            merged = _pack_window(parts)
+            stages["pack"] += time.perf_counter() - t0
+            hwm = next_slot[0] - 1
+            if hwm > ensured[0]:
+                for f in pending:
+                    f.result()
+                del pending[:]
+                want = max(hwm, 2 * ensured[0])
+                backend.ensure(want)
+                ensured[0] = want
+            if self.injector is not None:
+                self.injector.on_pipeline_window()
+            failed_over = getattr(backend, "failed_over", False)
+
+            def dispatch():
+                t1 = time.perf_counter()
+                for m in merged:
+                    backend.dispatch_packed(m.flat, m.row_off, m.row_len,
+                                            m.row_slot, m.holes, m.b_tier)
+                    backend.dispatch_branch(m.masks, m.bmp_slot, m.children)
+                stages["dispatch"] += time.perf_counter() - t1
+
+            if hash_pool is not None and not failed_over:
+                pending.append(hash_pool.submit(dispatch))
+            else:
+                dispatch()
+            if getattr(backend, "failed_over", False):
+                drained[0] += 1
+            self.windows += 1
+
+        try:
+            backend.begin(0)
+            for lo, hi in groups:
+                pool.submit(task, lo, hi)
+            remaining = len(groups)
+            while remaining:
+                sw = q.get()
+                self.queue_peak = max(self.queue_peak, q.qsize() + 1)
+                met.set_queue_depth(q.qsize())
+                if isinstance(sw, BaseException):
+                    raise sw
+                remaining -= 1
+                stages["sweep"] += sw.sweep_s
+                self.wire_bytes += sw.wire_bytes
+                window = [sw]
+                # fill the window with whatever has already been swept —
+                # never wait: overlap beats packing width
+                while len(window) < self.pack_window and remaining:
+                    try:
+                        sw2 = q.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if isinstance(sw2, BaseException):
+                        raise sw2
+                    remaining -= 1
+                    stages["sweep"] += sw2.sweep_s
+                    self.wire_bytes += sw2.wire_bytes
+                    window.append(sw2)
+                flush(window)
+            for f in pending:
+                f.result()
+            del pending[:]
+            return self._collect(swept, results, collect_branches,
+                                 start_depth, stages)
+        finally:
+            stop.set()
+            pool.shutdown(wait=True)
+            if hash_pool is not None:
+                hash_pool.shutdown(wait=True)
+            while True:  # unblock producers stuck on a full queue
+                try:
+                    q.get_nowait()
+                except queue_mod.Empty:
+                    break
+            met.set_queue_depth(0)
+            met.record_run(
+                jobs=len(jobs), groups=len(groups), windows=self.windows,
+                queue_peak=self.queue_peak, drained_windows=drained[0],
+                backend=getattr(backend, "effective_kind", None),
+                wall_s=time.perf_counter() - t_wall, **stages)
+
+    def _collect(self, swept, results, collect_branches, start_depth, stages):
+        t0 = time.perf_counter()
+        backend = self.backend
+        if collect_branches:
+            digests = backend.finish()
+            roots_raw = None
+        else:
+            digests = None
+            flat_slots = np.concatenate([
+                np.where(sw.root_slots > 0, sw.root_slots + base, 0)
+                for base, sw in swept]) if swept else np.zeros((0,), np.int32)
+            roots_raw = backend.fetch_slots(flat_slots)
+        cursor = 0
+        total_hashed = 0
+        for base, sw in swept:
+            total_hashed += sw.hashed_nodes
+            for k, j in enumerate(sw.job_ids):
+                slot = int(sw.root_slots[k])
+                if slot > 0:
+                    root = (digests[base + slot] if digests is not None
+                            else roots_raw[cursor + k]).tobytes()
+                else:
+                    inline = sw.root_inlines[k]
+                    root = keccak256(inline) if inline else EMPTY_ROOT_HASH
+                results[j] = TrieBuildResult(root=root, levels=sw.n_levels)
+            cursor += len(sw.job_ids)
+        if results:
+            results[-1].hashed_nodes = total_hashed
+        if collect_branches:
+            for base, sw in swept:
+                if sw.meta_rec is None or not len(sw.meta_rec):
+                    continue
+                job_starts = np.cumsum([0] + [len(k) for k in sw.key_arrays])
+                group_results = [results[j] for j in sw.job_ids]
+                _collect_meta_records(sw.meta_rec, sw.key_arrays, job_starts,
+                                      digests, group_results, start_depth,
+                                      slot_base=base)
+        stages["fetch"] += time.perf_counter() - t0
+        return results
+
+
 class TurboCommitter:
     """Full-rebuild state committer over 32-byte hashed keys.
 
@@ -260,6 +778,7 @@ class TurboCommitter:
         self.min_tier = min_tier
         self.mesh = mesh
         self.supervisor = supervisor
+        self.arena = DigestArena()  # resident across this committer's commits
         self._lib = load_library()
 
     def _device_engine(self):
@@ -273,12 +792,12 @@ class TurboCommitter:
 
     def _make_backend(self):
         if self.backend_kind == "numpy":
-            return _NumpyBackend()
+            return _NumpyBackend(arena=self.arena)
         if self.backend_kind == "auto":
             from ..ops.supervisor import DeviceSupervisor, SupervisedBackend
 
             sup = self.supervisor or DeviceSupervisor.shared()
-            return SupervisedBackend(sup, self._device_engine)
+            return SupervisedBackend(sup, self._device_engine, arena=self.arena)
         return self._device_engine()
 
     def commit_hashed_many(
@@ -297,42 +816,51 @@ class TurboCommitter:
         (root + optional BranchNode TrieUpdates, paths subtrie-relative)."""
         lib = self._lib
         n_jobs = len(jobs)
-        key_arrays, val_chunks, job_off = [], [], [0]
-        for keys, values in jobs:
-            keys = np.ascontiguousarray(keys, dtype=np.uint8).reshape(-1, 32)
-            if len(keys) != len(values):
-                raise ValueError("keys/values length mismatch")
-            order = np.argsort(keys.view("S32").ravel(), kind="stable")
-            key_arrays.append(keys[order])
-            val_chunks.extend(values[i] for i in order)
-            job_off.append(job_off[-1] + len(keys))
-        all_keys = (
-            np.concatenate(key_arrays) if key_arrays else np.zeros((0, 32), np.uint8)
-        )
-        flat_vals = b"".join(val_chunks)
-        val_off = np.zeros((len(val_chunks) + 1,), dtype=np.uint64)
-        if val_chunks:
-            val_off[1:] = np.cumsum(
-                np.fromiter((len(v) for v in val_chunks), dtype=np.uint64,
-                            count=len(val_chunks))
-            )
-        vals_np = np.frombuffer(flat_vals, dtype=np.uint8) if flat_vals else np.zeros(1, np.uint8)
-        job_off_np = np.asarray(job_off, dtype=np.uint64)
-        err = ctypes.c_int32(0)
-        h = lib.rtb_build(
-            _ptr(np.ascontiguousarray(all_keys), _u8p), len(all_keys),
-            _ptr(job_off_np, _u64p), n_jobs,
-            _ptr(vals_np, _u8p), _ptr(val_off, _u64p),
-            1 if collect_branches else 0, start_depth, ctypes.byref(err),
-        )
-        if not h:
-            reason = {1: "unsorted", 2: "duplicate keys", 3: "bad input",
-                      4: "oversized leaf value"}.get(err.value, "unknown")
-            raise ValueError(f"triebuild failed (err={err.value}: {reason})")
+        h, key_arrays = _marshal_and_build(lib, jobs, collect_branches, start_depth)
         try:
             return self._run(lib, h, n_jobs, key_arrays, collect_branches, start_depth)
         finally:
             lib.rtb_free(h)
+
+    def commit_hashed_pipelined(
+        self,
+        jobs: list[tuple[np.ndarray, list[bytes]]],
+        collect_branches: bool = False,
+        start_depth: int = 0,
+        **knobs,
+    ) -> list[TrieBuildResult]:
+        """Overlapped variant of :meth:`commit_hashed_many`: sweep groups of
+        subtries on a thread pool, pack same-depth levels across subtries
+        into fused dispatches, hash into the resident digest arena. Same
+        results bit-for-bit (parity pinned by tests/test_turbo_pipeline.py);
+        ``RETH_TPU_PIPELINE=0`` forces the serial path for A/B runs."""
+        if not jobs:
+            return []
+        if len(jobs) == 1 or os.environ.get("RETH_TPU_PIPELINE", "1") == "0":
+            return self.commit_hashed_many(jobs, collect_branches, start_depth)
+        import time as _time
+
+        from ..metrics import trie_metrics
+        from ..ops.supervisor import FaultInjector
+
+        t_start = _time.time()
+        backend = self._make_backend()
+        injector = getattr(self.supervisor, "injector", None)
+        if injector is None:
+            injector = FaultInjector.from_env()
+        if self.backend_kind in ("device", "auto") and "hash_workers" not in knobs:
+            knobs["hash_workers"] = 1  # one device; supervised journal is serial
+        pipe = RebuildPipeline(backend, self._lib, injector=injector, **knobs)
+        results = pipe.run(jobs, collect_branches, start_depth)
+        effective = getattr(backend, "effective_kind", self.backend_kind)
+        trie_metrics.record_commit(
+            backend=effective,
+            nodes=results[-1].hashed_nodes if results else 0,
+            levels=max((r.levels for r in results), default=0),
+            leaves=sum(len(j[1]) for j in jobs),
+            wire_bytes=pipe.wire_bytes,
+            seconds=_time.time() - t_start)
+        return results
 
     def _run(self, lib, h, n_jobs, key_arrays, collect_branches, start_depth=0):
         import time as _time
@@ -396,35 +924,40 @@ class TurboCommitter:
             seconds=_time.time() - t_start)
         if collect_branches and meta_rec is not None and len(meta_rec):
             job_starts = np.cumsum([0] + [len(k) for k in key_arrays])
-            self._collect_meta(meta_rec, key_arrays, job_starts, digests, results,
-                               start_depth)
+            _collect_meta_records(meta_rec, key_arrays, job_starts, digests,
+                                  results, start_depth)
         return results
 
-    def _collect_meta(self, meta_rec, key_arrays, job_starts, digests, results,
-                      start_depth=0):
-        jobs_f = meta_rec[:, 0:4].copy().view("<u4").ravel()
-        reps = meta_rec[:, 4:8].copy().view("<u4").ravel()
-        depths = meta_rec[:, 8:10].copy().view("<u2").ravel()
-        smasks = meta_rec[:, 10:12].copy().view("<u2").ravel()
-        tmasks = meta_rec[:, 12:14].copy().view("<u2").ravel()
-        hmasks = meta_rec[:, 14:16].copy().view("<u2").ravel()
-        cslots = meta_rec[:, 16:80].copy().view("<i4").reshape(-1, 16)
-        for k in range(len(meta_rec)):
-            j = int(jobs_f[k])
-            keys = key_arrays[j]
-            d = int(depths[k])
-            key = keys[int(reps[k]) - int(job_starts[j])]  # rep_key is global
-            nibs = np.empty((64,), dtype=np.uint8)
-            nibs[0::2] = key >> 4
-            nibs[1::2] = key & 0xF
-            # BranchMeta depths are SUBTRIE-relative; the stored path must
-            # skip the start_depth prefix nibbles of the full key
-            path = bytes(nibs[start_depth : start_depth + d])
-            hm = int(hmasks[k])
-            hashes = tuple(
-                digests[cslots[k, nb]].tobytes() for nb in range(16) if (hm >> nb) & 1
-            )
-            results[j].branch_nodes[path] = BranchNode(
-                int(smasks[k]), int(tmasks[k]), hm, hashes
-            )
-        return results
+
+def _collect_meta_records(meta_rec, key_arrays, job_starts, digests, results,
+                          start_depth=0, slot_base=0):
+    """Decode native BranchMeta records into per-job TrieUpdates.
+    ``slot_base`` rebases the records' group-local digest slots into the
+    pipeline's shared arena slot space."""
+    jobs_f = meta_rec[:, 0:4].copy().view("<u4").ravel()
+    reps = meta_rec[:, 4:8].copy().view("<u4").ravel()
+    depths = meta_rec[:, 8:10].copy().view("<u2").ravel()
+    smasks = meta_rec[:, 10:12].copy().view("<u2").ravel()
+    tmasks = meta_rec[:, 12:14].copy().view("<u2").ravel()
+    hmasks = meta_rec[:, 14:16].copy().view("<u2").ravel()
+    cslots = meta_rec[:, 16:80].copy().view("<i4").reshape(-1, 16)
+    for k in range(len(meta_rec)):
+        j = int(jobs_f[k])
+        keys = key_arrays[j]
+        d = int(depths[k])
+        key = keys[int(reps[k]) - int(job_starts[j])]  # rep_key is global
+        nibs = np.empty((64,), dtype=np.uint8)
+        nibs[0::2] = key >> 4
+        nibs[1::2] = key & 0xF
+        # BranchMeta depths are SUBTRIE-relative; the stored path must
+        # skip the start_depth prefix nibbles of the full key
+        path = bytes(nibs[start_depth : start_depth + d])
+        hm = int(hmasks[k])
+        hashes = tuple(
+            digests[cslots[k, nb] + slot_base].tobytes()
+            for nb in range(16) if (hm >> nb) & 1
+        )
+        results[j].branch_nodes[path] = BranchNode(
+            int(smasks[k]), int(tmasks[k]), hm, hashes
+        )
+    return results

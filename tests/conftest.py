@@ -19,3 +19,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def pytest_configure(config):
+    # tier-1 deselects these via `-m 'not slow'`; `make test-sanitizers`
+    # style targets opt back in with `-m slow`
+    config.addinivalue_line(
+        "markers", "slow: sanitizer builds / stress runs excluded from tier-1")

@@ -171,6 +171,71 @@ def test_stale_target_progress_restarts_rebuild():
         assert p.stage_progress(MerkleStage.id) is None
 
 
+def test_pipeline_abort_mid_queue_resumes_bit_identical(monkeypatch):
+    """Kill the OVERLAPPED rebuild pipeline mid-queue (fault injection via
+    RETH_TPU_FAULT_PIPELINE_ABORT): the aborted chunk's transaction rolls
+    back, earlier committed chunks survive, and a fresh stage instance
+    resumes from the persisted progress to the bit-identical root."""
+    from reth_tpu.ops.supervisor import InjectedPipelineAbort
+
+    bld = _build_chain()
+    factory = _synced_factory(bld)
+    stages = default_stages(committer=CPU)
+    merkle_idx = next(i for i, s in enumerate(stages) if isinstance(s, MerkleStage))
+    Pipeline(factory, stages[:merkle_idx]).run(bld.tip.number)
+
+    stage = MerkleStage(CPU, chunk_leaves=4)
+    target = bld.tip.number
+    for _ in range(2):  # committed chunks that the abort must NOT lose
+        with factory.provider_rw() as p:
+            out = stage.execute(p, ExecInput(target, 0))
+        assert not out.done
+    with factory.provider() as p:
+        before = p.stage_progress(MerkleStage.id)
+    assert before is not None, "expected mid-rebuild progress"
+
+    # every pipelined (multi-subtrie) commit now dies at its first packed
+    # window — the in-process analogue of a crash while the sweep queue is
+    # full. Single-job chunks take the serial path and still commit, so
+    # snapshot progress before each attempt: the abort must roll back to
+    # EXACTLY the last committed chunk, losing nothing else.
+    monkeypatch.setenv("RETH_TPU_FAULT_PIPELINE_ABORT", "1")
+    aborted = False
+    snap = before
+    for _ in range(300):
+        with factory.provider() as p:
+            snap = p.stage_progress(MerkleStage.id)
+        try:
+            with factory.provider_rw() as p:
+                out = stage.execute(p, ExecInput(target, 0))
+        except InjectedPipelineAbort:
+            aborted = True
+            break
+        if out.done:
+            break
+    assert aborted, "injected pipeline abort never fired"
+    with factory.provider() as p:
+        # the dying chunk rolled back; the committed prefix set is intact
+        assert p.stage_progress(MerkleStage.id) == snap
+
+    monkeypatch.delenv("RETH_TPU_FAULT_PIPELINE_ABORT")
+    resumed = MerkleStage(CPU, chunk_leaves=4)  # fresh instance: blob only
+    for _ in range(500):
+        with factory.provider_rw() as p:
+            out = resumed.execute(p, ExecInput(target, 0))
+        if out.done:
+            break
+    assert out.done and out.checkpoint == target
+    with factory.provider() as p:
+        assert p.stage_progress(MerkleStage.id) is None
+    from reth_tpu.trie.incremental import verify_state_root
+
+    with factory.provider_rw() as p:
+        root, problems = verify_state_root(p, CPU)
+    assert problems == []
+    assert root == bld.tip.state_root
+
+
 _KILL_SCRIPT = "tests/helpers/merkle_resume_child.py"
 
 

@@ -287,3 +287,104 @@ def test_precompile_cache_hits_and_correctness():
     data2 = data[:-1] + bytes([data[-1] ^ 1])
     okx, _, outx = _PRECOMPILES[6](data2, 100_000)
     assert out1 != outx or not okx
+
+
+# -- EIP-2537 BLS12-381 (Prague, 0x0b-0x11) ----------------------------------
+
+
+def _bls():
+    from reth_tpu.primitives import bls12381 as bls
+
+    return bls
+
+
+def test_bls_g1add_matches_pairing_scalar_mul():
+    """Cross-validate the G1ADD field/curve arithmetic against the repo's
+    independent pairing-module group law (primitives/pairing.py)."""
+    from reth_tpu.evm.interpreter import _pre_bls_g1add
+
+    bls = _bls()
+    grp = g1_group(BLS12_381)
+    acc = None
+    for k in range(1, 12):
+        acc = bls.g1_add(acc, bls.G1_GENERATOR)
+        assert acc == grp.mul_scalar(BLS12_381.g1, k)
+    # byte interface: G + 2G = 3G, gas charged = 375
+    g = bls.encode_g1(bls.G1_GENERATOR)
+    g2 = bls.encode_g1(bls.g1_add(bls.G1_GENERATOR, bls.G1_GENERATOR))
+    ok, gas_left, out = _pre_bls_g1add(g + g2, GAS)
+    assert ok and gas_left == GAS - 375
+    assert out == bls.encode_g1(grp.mul_scalar(BLS12_381.g1, 3))
+    # infinity identities + P + (-P)
+    inf = b"\x00" * 128
+    assert _pre_bls_g1add(inf + g, GAS)[2] == g
+    neg = bls.encode_g1((bls.G1_GENERATOR[0], bls.P - bls.G1_GENERATOR[1]))
+    assert _pre_bls_g1add(g + neg, GAS)[2] == inf
+
+
+def test_bls_g2add_matches_pairing_scalar_mul():
+    from reth_tpu.evm.interpreter import _pre_bls_g2add
+
+    bls = _bls()
+    grp = g2_group(BLS12_381)
+    acc = None
+    for k in range(1, 8):
+        acc = bls.g2_add(acc, bls.G2_GENERATOR)
+        assert acc == grp.mul_scalar(BLS12_381.g2, k)
+    g = bls.encode_g2(bls.G2_GENERATOR)
+    ok, gas_left, out = _pre_bls_g2add(g + g, GAS)
+    assert ok and gas_left == GAS - 600
+    assert out == bls.encode_g2(grp.mul_scalar(BLS12_381.g2, 2))
+
+
+def test_bls_g1add_rejects_invalid_encodings():
+    """EIP-2537 validation: bad length, nonzero padding, non-canonical
+    field element, and off-curve points all error (consume all gas)."""
+    from reth_tpu.evm.interpreter import _pre_bls_g1add
+
+    bls = _bls()
+    g = bls.encode_g1(bls.G1_GENERATOR)
+    fail = (False, 0, b"")
+    assert _pre_bls_g1add(g + g[:-1], GAS) == fail          # bad length
+    bad_pad = bytearray(g + g)
+    bad_pad[0] = 1                                          # padding byte
+    assert _pre_bls_g1add(bytes(bad_pad), GAS) == fail
+    too_big = b"\x00" * 16 + bls.P.to_bytes(48, "big") + g[64:] + g
+    assert _pre_bls_g1add(too_big, GAS) == fail             # x >= p
+    off = bytearray(g + g)
+    off[127] ^= 1                                           # y tweaked
+    assert _pre_bls_g1add(bytes(off), GAS) == fail
+    assert _pre_bls_g1add(g + g, 374) == fail               # insufficient gas
+
+
+def test_bls_unimplemented_ops_fail_block_loudly():
+    """Calls to 0x0c/0x0e-0x11 must raise a BlockExecutionError-backed
+    failure, never act as an empty account (round-5 verdict: a silent stub
+    breaks the native/interpreter bit-identical invariant unnoticed)."""
+    import pytest as _pytest
+
+    from reth_tpu.evm.executor import BlockExecutionError
+    from reth_tpu.evm.interpreter import (
+        PrecompileNotImplemented,
+        _precompile,
+    )
+    from reth_tpu.primitives.types import Account
+    from reth_tpu.testing import ChainBuilder, Wallet
+
+    pairing_addr = b"\x00" * 19 + b"\x0f"
+    fn = _precompile(pairing_addr)
+    assert fn is not None, "0x0f must be in the Prague precompile table"
+    with _pytest.raises(PrecompileNotImplemented):
+        fn(b"", 10**6)
+    # in-chain: a tx calling the pairing precompile invalidates the block
+    a = Wallet(0xB15)
+    bld = ChainBuilder({a.address: Account(balance=10**21)})
+    with _pytest.raises(BlockExecutionError, match="0x0f"):
+        bld.build_block([a.call(pairing_addr, b"", gas_limit=400_000)])
+    # ...while the implemented ADDs execute normally in-chain
+    bls = _bls()
+    g = bls.encode_g1(bls.G1_GENERATOR)
+    b = Wallet(0xB16)
+    bld2 = ChainBuilder({b.address: Account(balance=10**21)})
+    bld2.build_block([b.call(b"\x00" * 19 + b"\x0b", g + g,
+                             gas_limit=400_000)])

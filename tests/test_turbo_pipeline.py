@@ -1,0 +1,290 @@
+"""Overlapped rebuild pipeline (trie/turbo.py RebuildPipeline): parity,
+packing, arena residency, fault drills, and the threaded native sweep.
+
+The pipeline must be bit-identical to the serial turbo path it overlaps:
+pooled `native/triebuild.cpp` sweeps + cross-subtrie level packing +
+resident digest arena may change WHEN rows hash, never WHAT they hash.
+Roots and TrieUpdates branch metadata are pinned against
+``commit_hashed_many`` (itself pinned to the Python oracle by
+tests/test_turbo_commit.py).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from reth_tpu.primitives.rlp import rlp_encode
+from reth_tpu.trie.turbo import (
+    DigestArena,
+    RebuildPipeline,
+    TurboCommitter,
+    _group_jobs,
+    _NumpyBackend,
+)
+
+NATIVE = Path(__file__).resolve().parent.parent / "native"
+
+
+def _job(n, seed, val_len=(1, 100)):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 256, size=(n, 32), dtype=np.uint8)
+    keys = np.unique(keys.view("S32").ravel()).view(np.uint8).reshape(-1, 32)
+    rng.shuffle(keys)
+    values = [
+        rlp_encode(bytes(rng.integers(0, 256, size=int(rng.integers(*val_len)),
+                                      dtype=np.uint8)))
+        for _ in range(len(keys))
+    ]
+    return keys, values
+
+
+def _prefix_jobs(n, seed):
+    """Merkle-chunk-shaped jobs: the account trie split into two-nibble
+    prefix subtries, committed at start_depth=2 (_account_chunk's shape)."""
+    keys, values = _job(n, seed)
+    jobs = []
+    for pfx in np.unique(keys[:, 0]):
+        sel = np.nonzero(keys[:, 0] == pfx)[0]
+        jobs.append((keys[sel], [values[i] for i in sel]))
+    return jobs
+
+
+@pytest.fixture(scope="module")
+def turbo_np():
+    return TurboCommitter(backend="numpy")
+
+
+# -- parity ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("knobs", [
+    dict(jobs_per_sweep=1, pack_window=1),       # no packing, max overlap
+    dict(jobs_per_sweep=4, pack_window=16),      # grouped sweeps, wide packs
+    dict(jobs_per_sweep=64, leaves_per_sweep=200),  # leaf-bounded groups
+    dict(hash_workers=3),                        # parallel window hashing
+])
+def test_pipelined_root_and_branch_parity(turbo_np, knobs):
+    jobs = [_job(30 + 17 * i, seed=i) for i in range(12)]
+    want = turbo_np.commit_hashed_many(jobs, collect_branches=True)
+    got = turbo_np.commit_hashed_pipelined(jobs, collect_branches=True, **knobs)
+    assert [r.root for r in got] == [r.root for r in want]
+    for g, w in zip(got, want):
+        assert g.branch_nodes == w.branch_nodes
+
+
+def test_pipelined_subtrie_start_depth_parity(turbo_np):
+    """The chunked Merkle rebuild's exact call shape: prefix subtries at
+    start_depth=2, branch paths subtrie-relative."""
+    jobs = _prefix_jobs(600, seed=7)
+    want = [turbo_np.commit_hashed_many([j], collect_branches=True,
+                                        start_depth=2)[0] for j in jobs]
+    got = turbo_np.commit_hashed_pipelined(jobs, collect_branches=True,
+                                           start_depth=2, jobs_per_sweep=8)
+    assert [r.root for r in got] == [r.root for r in want]
+    for g, w in zip(got, want):
+        assert g.branch_nodes == w.branch_nodes
+
+
+def test_pipelined_empty_and_single(turbo_np):
+    from reth_tpu.primitives.types import EMPTY_ROOT_HASH
+
+    assert turbo_np.commit_hashed_pipelined([]) == []
+    # <=1 job short-circuits to the serial path
+    one = turbo_np.commit_hashed_pipelined([_job(40, seed=3)])
+    assert one[0].root == turbo_np.commit_hashed_many([_job(40, seed=3)])[0].root
+    mixed = turbo_np.commit_hashed_pipelined(
+        [(np.zeros((0, 32), dtype=np.uint8), []), _job(5, seed=1)])
+    assert mixed[0].root == EMPTY_ROOT_HASH
+
+
+def test_pipeline_env_kill_switch(turbo_np, monkeypatch):
+    """RETH_TPU_PIPELINE=0 forces the serial path — the A/B switch bench.py
+    uses; both must agree regardless."""
+    monkeypatch.setenv("RETH_TPU_PIPELINE", "0")
+    jobs = [_job(25, seed=i) for i in range(6)]
+    got = turbo_np.commit_hashed_pipelined(jobs)
+    want = turbo_np.commit_hashed_many(jobs)
+    assert [r.root for r in got] == [r.root for r in want]
+
+
+def test_pipelined_rejects_like_serial(turbo_np):
+    """Oversized leaf values reject in the sweep — the same ValueError the
+    MerkleStage catches to fall back to the general committer."""
+    keys, values = _job(8, seed=2)
+    values[3] = b"\xb9\xff\xff" + bytes(65535)  # > native leaf cap
+    with pytest.raises(ValueError, match="oversized"):
+        turbo_np.commit_hashed_pipelined(
+            [(keys, values), _job(10, seed=4)], jobs_per_sweep=1)
+
+
+# -- grouping / packing ------------------------------------------------------
+
+
+def test_group_jobs_bounds():
+    jobs = [(None, [b""] * n) for n in (10, 10, 10, 50, 5, 5)]
+    # leaf bound splits after the job that crosses it; job bound caps width
+    assert _group_jobs(jobs, max_leaves=20, max_jobs=64) == [
+        (0, 2), (2, 4), (4, 6)]
+    assert _group_jobs(jobs, max_leaves=10**9, max_jobs=2) == [
+        (0, 2), (2, 4), (4, 6)]
+    assert _group_jobs([], 100, 4) == []
+
+
+def test_pipeline_metrics_recorded(turbo_np):
+    from reth_tpu.metrics import pipeline_metrics
+
+    jobs = [_job(30, seed=40 + i) for i in range(8)]
+    turbo_np.commit_hashed_pipelined(jobs, jobs_per_sweep=2)
+    last = pipeline_metrics.last
+    assert last is not None
+    assert last["jobs"] == 8 and last["groups"] == 4
+    assert last["windows"] >= 1 and last["backend"] == "numpy"
+    assert last["queue_peak"] >= 1 and last["drained_windows"] == 0
+    for k in ("sweep_s", "pack_s", "dispatch_s", "fetch_s"):
+        assert last[k] >= 0.0
+
+
+# -- resident digest arena ---------------------------------------------------
+
+
+def test_arena_resident_across_commits():
+    arena = DigestArena()
+    b = _NumpyBackend(arena=arena)
+    b.begin(100)
+    first = b._buf
+    assert first is arena.digest_buf(1)      # backend writes the arena buf
+    b.ensure(50)
+    assert b._buf is first                   # within capacity: no realloc
+    b.ensure(5000)
+    grown = b._buf
+    assert grown.shape[0] >= 5001 and arena.grows == 1
+    b2 = _NumpyBackend(arena=arena)          # next commit, same arena
+    b2.begin(100)
+    assert b2._buf is grown                  # resident: reused, not realloc'd
+
+
+def test_arena_growth_preserves_digests():
+    arena = DigestArena()
+    b = _NumpyBackend(arena=arena)
+    b.begin(10)
+    s = b.alloc_slot()
+    b._buf[s] = 0xAB
+    b.ensure(100_000)
+    assert bytes(b._buf[s]) == b"\xab" * 32
+
+
+def test_arena_rows_thread_local():
+    import threading
+
+    arena = DigestArena()
+    bufs = {}
+
+    def grab(name):
+        r = arena.rows(4, 16)
+        r[:] = 1
+        bufs[name] = arena.rows(4, 16)
+
+    t = threading.Thread(target=grab, args=("worker",))
+    t.start(); t.join()
+    grab("main")
+    assert bufs["main"].base is not bufs["worker"].base  # never shared
+
+
+# -- fault drills ------------------------------------------------------------
+
+
+def test_injected_pipeline_abort(turbo_np, monkeypatch):
+    """RETH_TPU_FAULT_PIPELINE_ABORT kills the commit at a window boundary
+    — the in-process crash-mid-queue drill the resume test builds on."""
+    from reth_tpu.ops.supervisor import InjectedPipelineAbort
+
+    monkeypatch.setenv("RETH_TPU_FAULT_PIPELINE_ABORT", "2")
+    jobs = [_job(20, seed=60 + i) for i in range(8)]
+    with pytest.raises(InjectedPipelineAbort, match="window #2"):
+        turbo_np.commit_hashed_pipelined(jobs, jobs_per_sweep=1, pack_window=1)
+    # the wounded committer must still complete the next (clean) commit
+    monkeypatch.delenv("RETH_TPU_FAULT_PIPELINE_ABORT")
+    got = turbo_np.commit_hashed_pipelined(jobs, jobs_per_sweep=1)
+    want = turbo_np.commit_hashed_many(jobs)
+    assert [r.root for r in got] == [r.root for r in want]
+
+
+def test_mid_pipeline_failover_drains_onto_cpu():
+    """Wedge every device dispatch under the supervised ('auto') route: the
+    pipeline keeps feeding the failed-over backend, the queue drains onto
+    the numpy twin, and the roots still match the oracle."""
+    from reth_tpu.metrics import MetricsRegistry, pipeline_metrics
+    from reth_tpu.ops.supervisor import DeviceSupervisor, FaultInjector, ProbeResult
+
+    def probe(budget, injector=None):
+        return ProbeResult(True, 0.001, None)
+
+    sup = DeviceSupervisor(dispatch_budget=120.0, probe_fn=probe,
+                           registry=MetricsRegistry(),
+                           injector=FaultInjector(wedge_every=1))
+    auto = TurboCommitter(backend="auto", min_tier=64, supervisor=sup)
+    jobs = [_job(40, seed=80 + i) for i in range(10)]
+    want = TurboCommitter(backend="numpy").commit_hashed_many(jobs)
+    got = auto.commit_hashed_pipelined(jobs, jobs_per_sweep=2)
+    assert [r.root for r in got] == [r.root for r in want]
+    assert sup.failovers >= 1
+    last = pipeline_metrics.last
+    assert last["backend"] == "numpy"        # effective plane after the trip
+    assert last["drained_windows"] >= 1      # windows hashed post-failover
+
+
+# -- threaded native sweep under a sanitizer ---------------------------------
+
+
+def _probe_tsan(tmp: Path) -> bool:
+    """gcc-12's libtsan SEGVs on 6.18+ kernels; probe before trusting it."""
+    probe = tmp / "probe.cpp"
+    probe.write_text("#include <thread>\nint main(){std::thread t([]{});"
+                     "t.join();return 0;}\n")
+    exe = tmp / "probe"
+    r = subprocess.run(["g++", "-std=c++17", "-fsanitize=thread",
+                        str(probe), "-o", str(exe)], capture_output=True)
+    if r.returncode != 0:
+        return False
+    r = subprocess.run([str(exe)], capture_output=True, timeout=60)
+    return r.returncode == 0
+
+
+@pytest.mark.slow
+def test_triebuild_threaded_stress(tmp_path):
+    """The pipeline calls rtb_build from a thread pool: run the real access
+    pattern (shared read-only arrays, concurrent handles) under TSAN
+    (ASan+UBSan where libtsan breaks on the running kernel) and require
+    deterministic per-round results — native/triebuild_tsan.cpp."""
+    use_tsan = _probe_tsan(tmp_path)
+    san = "thread" if use_tsan else "address,undefined"
+    exe = tmp_path / "triebuild_stress"
+    r = subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-g", f"-fsanitize={san}",
+         str(NATIVE / "triebuild.cpp"), str(NATIVE / "triebuild_tsan.cpp"),
+         "-o", str(exe)],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    env = {"TSAN_OPTIONS": "halt_on_error=1",
+           "ASAN_OPTIONS": "halt_on_error=1", "PATH": "/usr/bin:/bin"}
+    r = subprocess.run([str(exe)], capture_output=True, text=True,
+                       timeout=300, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "STRESS_OK" in r.stdout
+
+
+def test_pipeline_concurrent_sweeps_deterministic(turbo_np):
+    """Python-level rerun determinism: many small groups racing through the
+    pool must always produce the same roots."""
+    jobs = [_job(15, seed=200 + i) for i in range(16)]
+    runs = [
+        [r.root for r in turbo_np.commit_hashed_pipelined(
+            jobs, jobs_per_sweep=1, pack_window=2)]
+        for _ in range(3)
+    ]
+    assert runs[0] == runs[1] == runs[2]
