@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -22,6 +22,13 @@ test-serial:
 test-faults:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_supervisor.py -q -p no:cacheprovider
+
+# shared hash service: continuous batching, priority lanes, backpressure,
+# exclusive lease, and the RETH_TPU_FAULT_SERVICE_* overload/stall/failover
+# drills — CPU-only, no device required
+test-service:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_hash_service.py -q -p no:cacheprovider
 
 # overlapped rebuild pipeline: parity vs the serial committer, packing,
 # arena residency, abort/failover drills, chunked-resume — fast, CPU-only

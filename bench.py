@@ -60,9 +60,9 @@ _STATE: dict = {"phase": "startup", "device_result": None}
 
 def _emit(value, vs_baseline, error=None, exit_code=None, **extra):
     line = {
-        "metric": "merkle_rebuild_keccak_per_sec",
+        "metric": _STATE.get("metric", "merkle_rebuild_keccak_per_sec"),
         "value": value,
-        "unit": "hashes/s",
+        "unit": _STATE.get("unit", "hashes/s"),
         "vs_baseline": vs_baseline,
         "backend": _STATE.get("backend", "unknown"),
     }
@@ -195,7 +195,94 @@ def run_cpu_fallback(n_accounts: int, n_slots: int, diag: str) -> None:
           exit_code=0)
 
 
+def run_service_mode() -> None:
+    """RETH_TPU_BENCH_MODE=service: coalesced small-batch throughput vs
+    per-call dispatch — the hash-service headline (ops/hash_service.py).
+
+    Workload: T concurrent clients each issuing many SMALL hash requests
+    (the SparseRootTask / proof shape the service exists for). Baseline =
+    every request dispatched directly on the backend (per-call overhead,
+    tiny batches); measured = the same requests through the service's
+    coalescing window (continuous batching into full-rate dispatches).
+    Runs on the device when the tunnel probes healthy, else the numpy
+    twin — either way one JSON line with the speedup and the measured
+    coalesce factor. Env: RETH_TPU_BENCH_SVC_CLIENTS (default 8),
+    RETH_TPU_BENCH_SVC_REQS (requests/client, default 300),
+    RETH_TPU_BENCH_SVC_KEYS (keys/request, default 4)."""
+    import numpy as _np
+
+    from reth_tpu.metrics import MetricsRegistry
+    from reth_tpu.ops.hash_service import HashService
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+
+    clients = int(os.environ.get("RETH_TPU_BENCH_SVC_CLIENTS", "8"))
+    reqs = int(os.environ.get("RETH_TPU_BENCH_SVC_REQS", "300"))
+    keys = int(os.environ.get("RETH_TPU_BENCH_SVC_KEYS", "4"))
+    _STATE["metric"] = "hash_service_small_batch_per_sec"
+    _STATE["phase"] = "service bench probe"
+    diag = probe_tunnel()
+    if diag is None:
+        from reth_tpu.ops.keccak_jax import KeccakDevice
+
+        _STATE["backend"] = "device"
+        backend = KeccakDevice(min_tier=1024, block_tier=4).hash_batch
+    else:
+        _STATE["backend"] = "numpy"
+        backend = keccak256_batch_np
+    rng = _np.random.default_rng(7)
+    workload = [
+        [rng.integers(0, 256, size=64, dtype=_np.uint8).tobytes()
+         for _ in range(keys)]
+        for _ in range(clients * reqs)
+    ]
+    lanes = ("live", "payload", "rebuild", "proof")
+
+    def run_clients(dispatch_fn) -> float:
+        errs: list = []
+
+        def worker(c):
+            try:
+                for i in range(reqs):
+                    dispatch_fn(lanes[c % 4], workload[c * reqs + i])
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(c,)) for c in range(clients)]
+        t0 = time.time()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        return time.time() - t0
+
+    total = clients * reqs * keys
+    _STATE["phase"] = "per-call baseline (direct dispatch)"
+    backend(workload[0])  # warm compiles out of the measured window
+    dt_direct = run_clients(lambda lane, msgs: backend(msgs))
+    _STATE["phase"] = "service run (coalesced)"
+    svc = HashService(backend=backend, registry=MetricsRegistry())
+    try:
+        dt_svc = run_clients(lambda lane, msgs: svc.hash(lane, msgs))
+        factor = round(svc.coalesce_factor(), 2)
+        dispatches = svc.dispatches
+    finally:
+        svc.stop()
+    _STATE["device_result"] = round(total / dt_svc, 1)
+    _emit(round(total / dt_svc, 1), round(dt_direct / dt_svc, 3),
+          coalesce_factor=factor, service_dispatches=dispatches,
+          requests=clients * reqs, keys_per_request=keys,
+          percall_wall_s=round(dt_direct, 3), service_wall_s=round(dt_svc, 3),
+          percall_hashes_per_sec=round(total / dt_direct, 1),
+          **({"device_unavailable": diag} if diag else {}),
+          exit_code=0)
+
+
 def main():
+    if os.environ.get("RETH_TPU_BENCH_MODE") == "service":
+        run_service_mode()
+        return
     n_accounts = int(os.environ.get("RETH_TPU_BENCH_ACCOUNTS", "150000"))
     n_slots = int(os.environ.get("RETH_TPU_BENCH_SLOTS", "60000"))
     tier = int(os.environ.get("RETH_TPU_BENCH_TIER", "16384"))

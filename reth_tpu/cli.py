@@ -56,6 +56,17 @@ def _make_committer(args):
     else:
         committer = TrieCommitter()
         committer.turbo_backend = "device"
+    if getattr(args, "hash_service", False):
+        # --hash-service: ONE background service owns the (supervised)
+        # hashing backend and multiplexes every client over priority lanes
+        # (ops/hash_service.py). The committer's own hasher becomes the
+        # live-tip lane client; call sites pick other lanes via for_lane.
+        from .ops.hash_service import HashService
+
+        committer.hash_service = HashService(
+            backend=committer.hasher,
+            supervisor=getattr(committer, "supervisor", None))
+        committer.hasher = committer.hash_service.client("live")
     return committer
 
 
@@ -685,6 +696,7 @@ def cmd_config(args):
         "[node]",
         f"persistence_threshold = {cfg.persistence_threshold}",
         f'hasher = "{cfg.hasher}"',
+        f"hash_service = {'true' if cfg.hash_service else 'false'}",
         "",
         "[prune]",
     ]
@@ -881,6 +893,16 @@ def main(argv=None) -> int:
                             "circuit-breaker supervisor; falls over to cpu "
                             "on wedged dispatches — see RETH_TPU_FAULT_* "
                             "env knobs for drill/testing)")
+        p.add_argument("--hash-service", action="store_true", default=None,
+                       help="multiplex every keccak client over ONE shared "
+                            "background hash service (ops/hash_service.py): "
+                            "priority lanes (live > payload > rebuild > "
+                            "proof), continuous batching with a coalescing "
+                            "window, bounded per-lane backpressure, and an "
+                            "exclusive lease for rebuild streaming; "
+                            "composes with --hasher auto (breaker trips / "
+                            "CPU failover apply to the shared service) — "
+                            "see RETH_TPU_FAULT_SERVICE_* drill knobs")
 
     def add_db_arg(p):
         # paged (the COW B+tree / MDBX analogue) is the DEFAULT everywhere

@@ -7,11 +7,62 @@ prune settings. Read with stdlib tomllib; flags override file values.
 
 from __future__ import annotations
 
-import tomllib
 from dataclasses import dataclass, field
 from pathlib import Path
 
 from .prune import PruneMode, PruneModes
+
+try:  # stdlib since 3.11; keep 3.10 importable (the mini parser below
+    import tomllib  # covers this file's flat table/int/str/bool schema)
+except ModuleNotFoundError:  # pragma: no cover - version-dependent
+    tomllib = None
+
+
+def _mini_toml(text: str) -> dict:
+    """Fallback parser for the subset reth.toml actually uses: ``[a.b]``
+    tables, int/float/bool/quoted-string values, ``#`` comments, and
+    single-line inline tables (``k = { distance = 100 }``)."""
+
+    def _value(raw: str):
+        raw = raw.strip()
+        if raw.startswith("{") and raw.endswith("}"):
+            out = {}
+            body = raw[1:-1].strip()
+            for part in filter(None, (p.strip() for p in body.split(","))):
+                k, _, v = part.partition("=")
+                out[k.strip()] = _value(v)
+            return out
+        if len(raw) >= 2 and raw[0] == raw[-1] and raw[0] in "\"'":
+            return raw[1:-1]
+        if raw in ("true", "false"):
+            return raw == "true"
+        try:
+            return int(raw)
+        except ValueError:
+            return float(raw)
+
+    root: dict = {}
+    table = root
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            table = root
+            for part in line[1:-1].split("."):
+                table = table.setdefault(part.strip(), {})
+            continue
+        key, sep, raw = line.partition("=")
+        if not sep:
+            raise ValueError(f"unparseable TOML line: {line!r}")
+        table[key.strip()] = _value(raw)
+    return root
+
+
+def _parse_toml(text: str) -> dict:
+    if tomllib is not None:
+        return tomllib.loads(text)
+    return _mini_toml(text)
 
 
 @dataclass
@@ -45,6 +96,9 @@ class RethTpuConfig:
     prune: PruneModes = field(default_factory=PruneModes)
     persistence_threshold: int = 2
     hasher: str = "device"  # device | cpu | auto (supervised device)
+    # multiplex every keccak client over the shared background hash
+    # service (ops/hash_service.py): priority lanes + continuous batching
+    hash_service: bool = False
 
 
 def _prune_mode(d: dict) -> PruneMode:
@@ -55,7 +109,7 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg = RethTpuConfig()
     if path is None or not Path(path).exists():
         return cfg
-    raw = tomllib.loads(Path(path).read_text())
+    raw = _parse_toml(Path(path).read_text())
     stages = raw.get("stages", {})
     if "merkle" in stages:
         cfg.stages.merkle = MerkleConfig(**stages["merkle"])
@@ -73,4 +127,5 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     node = raw.get("node", {})
     cfg.persistence_threshold = node.get("persistence_threshold", cfg.persistence_threshold)
     cfg.hasher = node.get("hasher", cfg.hasher)
+    cfg.hash_service = bool(node.get("hash_service", cfg.hash_service))
     return cfg

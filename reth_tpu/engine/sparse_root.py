@@ -53,7 +53,11 @@ class SparseRootTask:
 
     def __init__(self, parent_provider, parent_root: bytes, preserved,
                  committer, parent_hash: bytes | None = None):
-        self.hasher = committer.hasher
+        # live tip is the highest-priority hash-service lane: with
+        # --hash-service the task's batches coalesce with every other
+        # client's but dispatch first; without one this is committer.hasher
+        self.hasher = committer.for_lane("live").hasher \
+            if hasattr(committer, "for_lane") else committer.hasher
         # committer wired through --hasher auto carries the device
         # supervisor: its hasher already watchdogs + CPU-fails-over every
         # device batch, so a wedged tunnel degrades this task instead of
@@ -129,11 +133,15 @@ class SparseRootTask:
     def _process(self, batch) -> None:
         addrs = [k for k in batch if isinstance(k, bytes)]
         pairs = [k for k in batch if not isinstance(k, bytes)]
-        plain = addrs + [s for _, s in pairs]
+        # ONE coalesced hash call for everything this burst needs: the
+        # addresses, the pair-owner addresses (previously hashed one at a
+        # time inside the reveal loop), and the slots
+        plain = [k for k in addrs + [a for a, _ in pairs]
+                 + [s for _, s in pairs] if k not in self._digests]
         if plain:
             t0 = time.monotonic()
-            digests = self.hasher(list(dict.fromkeys(plain)))
-            for k, d in zip(dict.fromkeys(plain), digests):
+            plain = list(dict.fromkeys(plain))
+            for k, d in zip(plain, self.hasher(plain)):
                 self._digests[k] = bytes(d)
             self.walls["hash"] += time.monotonic() - t0
         # reveal only what the trie can't already read (a preserved trie
@@ -143,8 +151,7 @@ class SparseRootTask:
             if self._needs_account_reveal(self._digests[a]):
                 targets.setdefault(a, [])
         for a, s in pairs:
-            ha = self._digests.get(a) or bytes(self.hasher([a])[0])
-            self._digests[a] = ha
+            ha = self._digests[a]
             if self._needs_storage_reveal(ha, self._digests[s]):
                 targets.setdefault(a, []).append(s)
         if not targets:

@@ -146,6 +146,11 @@ def generate_witness(parent_provider, block: Block, committer,
         slots.setdefault(a, set()).update(ps)
     targets = {a: sorted(slots.get(a, ())) for a in sorted(touched)}
 
+    # witness generation is RPC work: ride the proof (lowest) hash-service
+    # lane — its multiproofs coalesce with other clients' batches but
+    # never delay the live tip (identity without a service)
+    if hasattr(committer, "for_lane"):
+        committer = committer.for_lane("proof")
     calc = ProofCalculator(parent_provider, committer)
     proofs = calc.multiproof(targets)
     nodes: dict[bytes, bytes] = {}

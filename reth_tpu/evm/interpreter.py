@@ -1180,9 +1180,43 @@ def _pre_bls_g2add(data: bytes, gas: int):
     return True, gas - 600, out
 
 
+def _pre_bls_g1msm(data: bytes, gas: int):
+    """0x0c BLS12_G1MSM (EIP-2537): discounted per-pair gas, curve AND
+    subgroup check on every input point."""
+    from ..primitives import bls12381 as bls
+
+    if len(data) == 0 or len(data) % 160 != 0:
+        return False, 0, b""
+    cost = bls.g1msm_gas(len(data) // 160)
+    if gas < cost:
+        return False, 0, b""
+    try:
+        out = bls.g1msm_precompile(bytes(data))
+    except bls.BlsError:
+        return False, 0, b""
+    return True, gas - cost, out
+
+
+def _pre_bls_g2msm(data: bytes, gas: int):
+    """0x0e BLS12_G2MSM (EIP-2537): discounted per-pair gas, curve AND
+    subgroup check on every input point."""
+    from ..primitives import bls12381 as bls
+
+    if len(data) == 0 or len(data) % 288 != 0:
+        return False, 0, b""
+    cost = bls.g2msm_gas(len(data) // 288)
+    if gas < cost:
+        return False, 0, b""
+    try:
+        out = bls.g2msm_precompile(bytes(data))
+    except bls.BlsError:
+        return False, 0, b""
+    return True, gas - cost, out
+
+
 def _pre_bls_nyi(idx: int, name: str):
-    """EIP-2537 operations whose constants (MSM discount table, SWU
-    isogeny) this repo cannot verify offline: refuse loudly."""
+    """EIP-2537 operations whose constants (Fp12 tower, SWU isogeny) this
+    repo cannot verify offline: refuse loudly."""
 
     def run(data, gas: int):
         raise PrecompileNotImplemented(
@@ -1203,12 +1237,13 @@ _RAW_PRECOMPILES = {
     8: _pre_bn_pairing,
     9: _pre_blake2f,
     10: _pre_point_eval,
-    # EIP-2537 (Prague): ADDs are implemented (pure affine arithmetic);
-    # MSM/pairing/map raise PrecompileNotImplemented instead of stubbing
+    # EIP-2537 (Prague): ADD + MSM are implemented (affine arithmetic +
+    # double-and-add with subgroup checks, primitives/bls12381.py);
+    # pairing/map raise PrecompileNotImplemented instead of stubbing
     11: _pre_bls_g1add,
-    12: _pre_bls_nyi(0x0C, "G1MSM"),
+    12: _pre_bls_g1msm,
     13: _pre_bls_g2add,
-    14: _pre_bls_nyi(0x0E, "G2MSM"),
+    14: _pre_bls_g2msm,
     15: _pre_bls_nyi(0x0F, "PAIRING_CHECK"),
     16: _pre_bls_nyi(0x10, "MAP_FP_TO_G1"),
     17: _pre_bls_nyi(0x11, "MAP_FP2_TO_G2"),

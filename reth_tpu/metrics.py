@@ -232,6 +232,99 @@ class PipelineMetrics:
 pipeline_metrics = PipelineMetrics()
 
 
+class HashServiceMetrics:
+    """Shared hash service observability (ops/hash_service.py): per-lane
+    queue depth and request counts, coalesce factor (requests fused per
+    dispatch), batch occupancy (messages over the padded tier), wait and
+    service-time histograms, plus the failure-path counters (numpy-twin
+    replays, backpressure rejects, lease bypasses) — what an operator
+    needs to see whether small client batches actually fuse into
+    full-rate dispatches and where requests spend their time."""
+
+    _LANES = ("live", "payload", "rebuild", "proof")
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._requests = {l: reg.counter(
+            f"hash_service_requests_total_{l}",
+            f"requests submitted on the {l} lane") for l in self._LANES}
+        self._msgs = {l: reg.counter(
+            f"hash_service_msgs_total_{l}",
+            f"messages submitted on the {l} lane") for l in self._LANES}
+        self._qdepth = {l: reg.gauge(
+            f"hash_service_queue_depth_{l}",
+            f"messages waiting on the {l} lane") for l in self._LANES}
+        self._rejects = {l: reg.counter(
+            f"hash_service_rejects_total_{l}",
+            f"backpressure rejections on the {l} lane") for l in self._LANES}
+        self._dispatches = reg.counter(
+            "hash_service_dispatches_total",
+            "coalesced backend dispatches issued")
+        self._coalesced = reg.counter(
+            "hash_service_coalesced_requests_total",
+            "requests fused into coalesced dispatches")
+        self._hashed = reg.counter(
+            "hash_service_hashed_msgs_total", "messages hashed")
+        self._coalesce_factor = reg.gauge(
+            "hash_service_coalesce_factor",
+            "requests per dispatch, lifetime average (>1 = batching works)")
+        self._occupancy = reg.gauge(
+            "hash_service_batch_occupancy",
+            "last dispatch: messages / padded batch tier")
+        self._replays = reg.counter(
+            "hash_service_replays_total",
+            "coalesced batches replayed on the numpy twin after a failure")
+        self._lease_bypasses = reg.counter(
+            "hash_service_lease_bypass_total",
+            "coalesced batches hashed on the CPU twin while leased")
+        self._leases = reg.counter("hash_service_leases_total")
+        self._lease_wait = reg.histogram(
+            "hash_service_lease_wait_seconds",
+            buckets=(0.0001, 0.001, 0.01, 0.1, 0.5, 1, 5, 30))
+        self._wait = {l: reg.histogram(
+            f"hash_service_wait_seconds_{l}",
+            f"queue wait before dispatch, {l} lane",
+            buckets=(0.0001, 0.0005, 0.001, 0.002, 0.005, 0.02, 0.1, 1))
+            for l in self._LANES}
+        self._service = reg.histogram(
+            "hash_service_service_seconds",
+            "coalesced dispatch wall time",
+            buckets=(0.0005, 0.001, 0.005, 0.02, 0.1, 0.5, 2, 10))
+
+    def record_submit(self, lane: str, n_msgs: int) -> None:
+        self._requests[lane].increment()
+        self._msgs[lane].increment(n_msgs)
+
+    def set_queue_depth(self, lane: str, n_msgs: int) -> None:
+        self._qdepth[lane].set(n_msgs)
+
+    def record_reject(self, lane: str) -> None:
+        self._rejects[lane].increment()
+
+    def record_wait(self, lane: str, seconds: float) -> None:
+        self._wait[lane].record(seconds)
+
+    def record_dispatch(self, *, requests: int, msgs: int, occupancy: float,
+                        service_s: float, replayed: bool) -> None:
+        self._dispatches.increment()
+        self._coalesced.increment(requests)
+        self._hashed.increment(msgs)
+        self._coalesce_factor.set(
+            round(self._coalesced.value / self._dispatches.value, 3))
+        self._occupancy.set(round(occupancy, 4))
+        self._service.record(service_s)
+
+    def record_replay(self) -> None:
+        self._replays.increment()
+
+    def record_lease(self, wait_s: float) -> None:
+        self._leases.increment()
+        self._lease_wait.record(wait_s)
+
+    def record_lease_bypass(self) -> None:
+        self._lease_bypasses.increment()
+
+
 class SupervisorMetrics:
     """Device hasher supervisor state on /metrics (ops/supervisor.py):
     breaker state + trips, mid-commit failovers, watchdog timeouts, and

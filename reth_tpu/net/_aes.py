@@ -1,0 +1,45 @@
+"""Optional-dependency shim over the ``cryptography`` AES primitives.
+
+RLPx framing, the ECIES handshake, and discv5 packet crypto need OpenSSL
+AES (CTR/ECB/GCM) from the third-party ``cryptography`` package — but
+nothing else in the repo does, and the package is absent in minimal
+containers. Importing the net stack (or anything that pulls it in, e.g.
+``era.py`` for its snappy codec) must therefore never require it: the
+real import is attempted here ONCE, and when it fails every AES entry
+point below raises a clear ``ModuleNotFoundError`` at FIRST USE instead
+of at import time. Tests gate on :data:`HAVE_CRYPTOGRAPHY` /
+``pytest.importorskip("cryptography")``.
+"""
+
+from __future__ import annotations
+
+try:
+    from cryptography.hazmat.primitives.ciphers import (  # noqa: F401
+        Cipher,
+        algorithms,
+        modes,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM  # noqa: F401
+
+    HAVE_CRYPTOGRAPHY = True
+except ModuleNotFoundError:  # optional dep absent: defer failure to use
+    HAVE_CRYPTOGRAPHY = False
+
+    _MSG = ("the 'cryptography' package is required for RLPx/ECIES/discv5 "
+            "AES but is not installed; encrypted networking is unavailable")
+
+    class _MissingCallable:
+        """Stands in for Cipher/AESGCM: constructing one raises."""
+
+        def __init__(self, *args, **kwargs):
+            raise ModuleNotFoundError(_MSG)
+
+    class _MissingNamespace:
+        """Stands in for algorithms/modes: any attribute access raises."""
+
+        def __getattr__(self, name):
+            raise ModuleNotFoundError(_MSG)
+
+    Cipher = AESGCM = _MissingCallable
+    algorithms = _MissingNamespace()
+    modes = _MissingNamespace()

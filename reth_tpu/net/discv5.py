@@ -34,9 +34,6 @@ import socket
 import threading
 import time
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
 from ..primitives import secp256k1
 from ..primitives.rlp import decode_int, encode_int, rlp_decode_prefix, rlp_encode
 from ..primitives.secp256k1 import (
@@ -44,6 +41,7 @@ from ..primitives.secp256k1 import (
     pubkey_from_priv,
     random_priv,
 )
+from ._aes import AESGCM, Cipher, algorithms, modes  # optional-dep shim
 from .enr import Enr, make_enr, node_id_from_pubkey
 
 PROTOCOL_ID = b"discv5"

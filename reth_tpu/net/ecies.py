@@ -26,8 +26,6 @@ import hmac as hmac_mod
 import os
 import struct
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
-
 from ..primitives import secp256k1
 from ..primitives.rlp import rlp_decode_prefix, rlp_encode
 from ..primitives.secp256k1 import (
@@ -37,6 +35,7 @@ from ..primitives.secp256k1 import (
     pubkey_to_bytes,
     random_priv,
 )
+from ._aes import Cipher, algorithms, modes  # optional-dep shim
 
 AUTH_VSN = 4
 

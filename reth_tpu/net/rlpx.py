@@ -24,11 +24,10 @@ import os
 import socket
 import struct
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
-
 from ..primitives.rlp import decode_int, encode_int, rlp_decode, rlp_encode
 from ..primitives.secp256k1 import pubkey_from_priv, pubkey_to_bytes
 from . import snappy
+from ._aes import Cipher, algorithms, modes  # optional-dep shim
 from .ecies import FrameSecrets, Handshake
 
 P2P_VERSION = 5

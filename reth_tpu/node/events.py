@@ -91,6 +91,21 @@ class NodeEventReporter:
                      f" breaker={s['breaker']}")
             if s["trips"] or s["failovers"]:
                 line += f" trips={s['trips']} failovers={s['failovers']}"
+        # --hash-service: the shared service's one-line health — queue
+        # pressure, whether small batches actually fuse (cf = coalesce
+        # factor), and the failure-path counters an operator pages on
+        svc = getattr(self.node, "hash_service", None)
+        if svc is not None:
+            s = svc.snapshot()
+            line += (f" hashsvc[q={s['queued_total']}"
+                     f" cf={s['coalesce_factor']}"
+                     f" disp={s['dispatches']}]")
+            if s["replays"] or s["rejects"] or s["lease_bypasses"]:
+                line += (f" svc_replays={s['replays']}"
+                         f" svc_rejects={s['rejects']}"
+                         f" svc_bypass={s['lease_bypasses']}")
+            if s["leased_by"]:
+                line += f" svc_leased={s['leased_by']}"
         # rebuild-pipeline stage walls: during a chunked Merkle rebuild this
         # is the line that says where the time goes (host sweep vs hashing)
         from ..metrics import pipeline_metrics

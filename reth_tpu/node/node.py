@@ -76,6 +76,10 @@ class Node:
         # committer routes through ops/supervisor.py — surfaced on the
         # events dashboard and /metrics
         self.hasher_supervisor = getattr(self.committer, "supervisor", None)
+        # shared hash service (--hash-service): present when every keccak
+        # client multiplexes over ops/hash_service.py — surfaced on the
+        # events dashboard and hash_service_* /metrics
+        self.hash_service = getattr(self.committer, "hash_service", None)
         # warm the native secp build now: a lazy first-use g++ compile
         # inside newPayload would stall a consensus response for seconds
         from ..primitives.secp256k1 import _native_lib

@@ -28,6 +28,13 @@ from .supervisor import (
     probe_device,
     probe_device_retrying,
 )
+from .hash_service import (
+    HashClient,
+    HashFuture,
+    HashService,
+    LaneOverloaded,
+    ServiceFaultInjector,
+)
 
 __all__ = [
     "keccak_f1600_jax",
@@ -41,4 +48,9 @@ __all__ = [
     "SupervisedHasher",
     "probe_device",
     "probe_device_retrying",
+    "HashClient",
+    "HashFuture",
+    "HashService",
+    "LaneOverloaded",
+    "ServiceFaultInjector",
 ]

@@ -1,0 +1,641 @@
+"""Shared device hash service: continuous batching, priority lanes, and
+backpressure for every keccak client.
+
+Until now every hashing client owned the device alone: ``RebuildPipeline``
+monopolized the backend during a rebuild, ``SparseRootTask`` dispatched
+tiny synchronous batches (single keys, even), and ``ProofCalculator`` /
+witness hashing never touched the device at all. This module is the
+missing scheduling layer between them — one background service owns one
+(supervised) backend and multiplexes every client over it, the way the
+parallel-hashing literature (Sakura tree hashing, arxiv 1608.00492) and
+the async-storage parallel-EVM work (Reddio, arxiv 2503.04595) keep an
+accelerator saturated: decouple request arrival from dispatch.
+
+Shape:
+
+- **Priority lanes** (:data:`LANES`): ``live`` (live-tip state root) >
+  ``payload`` (payload build) > ``rebuild`` (Merkle rebuild) > ``proof``
+  (proof/RPC). Clients submit async requests (:meth:`HashService.submit`
+  → :class:`HashFuture`) or call synchronously through a lane-bound
+  :class:`HashClient` that satisfies the repo-wide ``hasher`` protocol
+  (``list[bytes] -> list[bytes]``).
+- **Continuous batching**: a dispatcher thread gathers requests until a
+  fused tier fills (``fill_target`` messages) or a coalescing deadline
+  (``window_s``) expires, concatenates them into ONE backend dispatch,
+  and scatters the digests back through the futures. Many tiny client
+  batches become one full-rate device batch. A LONE request dispatches
+  immediately — the synchronous latency path never pays the window; the
+  window only gathers once a second request is pending, so under load
+  the previous dispatch's wall time is the natural gather period.
+- **Backpressure**: per-lane queues are bounded in *messages*; a full
+  lane blocks the submitter (or raises :class:`LaneOverloaded` with
+  ``block=False``) instead of growing without bound.
+- **Anti-starvation aging**: drain order is priority lanes first, but any
+  request older than ``age_promote_s`` is taken FIRST (FIFO), so a
+  saturating live-tip stream cannot starve proof/RPC traffic forever.
+- **Exclusive lease** (:meth:`HashService.lease`): ``RebuildPipeline``
+  streams pre-packed windows through the array-protocol engine without
+  per-call service overhead; the lease pauses coalesced dispatching.
+  Requests that age past ``lease_bypass_s`` while a (long) lease is held
+  are dispatched on the CPU twin, so a multi-second rebuild window never
+  blocks the live tip.
+- **Failover**: the backend is typically an ``ops/supervisor.py``
+  :class:`~reth_tpu.ops.supervisor.SupervisedHasher` — circuit-breaker
+  trips and watchdog timeouts apply to the shared service. Hashing is
+  stateless, so if a dispatch still raises (or service fault injection
+  wedges it), the WHOLE in-flight batch is replayed on the numpy twin:
+  every future completes exactly once, no request is lost.
+- **Fault injection** (:class:`ServiceFaultInjector`):
+  ``RETH_TPU_FAULT_SERVICE_WEDGE_EVERY`` / ``RETH_TPU_FAULT_SERVICE_STALL``
+  / ``RETH_TPU_FAULT_SERVICE_QUEUE_CAP`` drill the replay, overload, and
+  backpressure paths without hardware.
+- **Observability**: ``hash_service_*`` metrics (per-lane queue depth,
+  coalesce factor, batch occupancy, wait/service-time histograms) plus a
+  ``node/events.py`` dashboard fragment via :meth:`snapshot`.
+
+Wiring: ``--hash-service`` (cli.py) hangs a service off the committer;
+``TrieCommitter.for_lane`` hands lane-bound clients to ``SparseRootTask``
+("live"), the payload builder ("payload"), the hashing/Merkle stages
+("rebuild"), and ``ProofCalculator`` ("proof"); ``TurboCommitter``
+("auto"/"device") takes the exclusive lease around each rebuild commit.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+# priority order, highest first — index IS the priority
+LANES = ("live", "payload", "rebuild", "proof")
+_LANE_INDEX = {name: i for i, name in enumerate(LANES)}
+
+
+class HashServiceError(RuntimeError):
+    """Base class for service-level failures."""
+
+
+class LaneOverloaded(HashServiceError):
+    """Bounded lane queue is full and the submitter asked not to block."""
+
+
+class ServiceStopped(HashServiceError):
+    """The service was stopped while this request was queued."""
+
+
+class InjectedServiceWedge(HashServiceError):
+    """Service fault injection wedged this coalesced dispatch
+    (RETH_TPU_FAULT_SERVICE_WEDGE_EVERY) — exercises the replay path."""
+
+
+class ServiceFaultInjector:
+    """Overload/stall fault policies for the shared service, in the style
+    of ``ops/supervisor.py``'s FaultInjector.
+
+    ``wedge_every``: every Nth coalesced dispatch raises
+    :class:`InjectedServiceWedge` BEFORE touching the backend; the batch
+    must complete via the numpy-twin replay (``wedge_every=1`` = every
+    dispatch, the full-failover drill).
+    ``stall``: fixed seconds added to every coalesced dispatch — an
+    overload drill that backs requests up into the bounded lanes.
+    ``queue_cap``: overrides every lane's message capacity (small values
+    drill backpressure blocking/rejection).
+
+    Env form (:meth:`from_env`): ``RETH_TPU_FAULT_SERVICE_WEDGE_EVERY`` /
+    ``RETH_TPU_FAULT_SERVICE_STALL`` / ``RETH_TPU_FAULT_SERVICE_QUEUE_CAP``.
+    """
+
+    def __init__(self, wedge_every: int = 0, stall: float = 0.0,
+                 queue_cap: int = 0):
+        self.wedge_every = wedge_every
+        self.stall = stall
+        self.queue_cap = queue_cap
+        self.dispatches = 0
+        self.wedged = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, env=None) -> "ServiceFaultInjector | None":
+        env = os.environ if env is None else env
+        wedge = int(env.get("RETH_TPU_FAULT_SERVICE_WEDGE_EVERY", "0") or 0)
+        stall = float(env.get("RETH_TPU_FAULT_SERVICE_STALL", "0") or 0)
+        cap = int(env.get("RETH_TPU_FAULT_SERVICE_QUEUE_CAP", "0") or 0)
+        if not (wedge or stall or cap):
+            return None
+        return cls(wedge_every=wedge, stall=stall, queue_cap=cap)
+
+    def active(self) -> bool:
+        return bool(self.wedge_every or self.stall or self.queue_cap)
+
+    def on_dispatch(self) -> None:
+        """Called before every coalesced dispatch touches the backend."""
+        with self._lock:
+            self.dispatches += 1
+            n = self.dispatches
+        if self.stall:
+            time.sleep(self.stall)
+        if self.wedge_every and n % self.wedge_every == 0:
+            with self._lock:
+                self.wedged += 1
+            raise InjectedServiceWedge(
+                f"injected service wedge on dispatch #{n} "
+                f"(every {self.wedge_every})")
+
+
+class HashFuture:
+    """Completion handle for one submitted request. Completes exactly once
+    — either with the digest list or with an exception."""
+
+    __slots__ = ("_event", "_result", "_error", "completions")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result: list[bytes] | None = None
+        self._error: BaseException | None = None
+        self.completions = 0  # must end at exactly 1 (drill assertion)
+
+    def _complete(self, result=None, error=None) -> None:
+        self.completions += 1
+        if self.completions > 1:  # pragma: no cover - invariant guard
+            raise AssertionError("HashFuture completed twice")
+        self._result, self._error = result, error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> list[bytes]:
+        if not self._event.wait(timeout):
+            raise TimeoutError("hash service request timed out")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Request:
+    __slots__ = ("lane", "msgs", "future", "enqueued_at")
+
+    def __init__(self, lane: str, msgs: list[bytes]):
+        self.lane = lane
+        self.msgs = msgs
+        self.future = HashFuture()
+        self.enqueued_at = time.monotonic()
+
+
+class HashClient:
+    """Lane-bound callable satisfying the repo-wide ``hasher`` protocol
+    (``list[bytes] -> list[bytes]``) — drop-in for ``KeccakDevice
+    .hash_batch`` / ``keccak256_batch_np`` / ``SupervisedHasher``."""
+
+    __slots__ = ("service", "lane")
+
+    def __init__(self, service: "HashService", lane: str):
+        if lane not in _LANE_INDEX:
+            raise ValueError(f"unknown lane {lane!r} (have {LANES})")
+        self.service = service
+        self.lane = lane
+
+    def __call__(self, msgs: list[bytes]) -> list[bytes]:
+        return self.service.hash(self.lane, list(msgs))
+
+    def submit(self, msgs: list[bytes]) -> HashFuture:
+        return self.service.submit(self.lane, list(msgs))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"HashClient(lane={self.lane!r})"
+
+
+class LeasedTurboBackend:
+    """Array-protocol backend proxy that holds the service's exclusive
+    lease for the duration of one turbo commit (``begin`` → terminal
+    ``finish``/``fetch_slots``). The RebuildPipeline keeps streaming its
+    pre-packed windows straight at the inner engine — zero per-dispatch
+    service overhead — while coalesced lanes pause (aged requests bypass
+    onto the CPU twin, see :meth:`HashService.lease`)."""
+
+    def __init__(self, service: "HashService", inner):
+        self._service = service
+        self._inner = inner
+        self._lease = None
+
+    @property
+    def effective_kind(self) -> str:
+        return getattr(self._inner, "effective_kind", "device")
+
+    @property
+    def failed_over(self) -> bool:
+        return getattr(self._inner, "failed_over", False)
+
+    def begin(self, max_slots: int) -> None:
+        if self._lease is None:
+            self._lease = self._service.lease(what="rebuild")
+            self._lease.__enter__()
+        self._inner.begin(max_slots)
+
+    def release(self) -> None:
+        """Drop the lease. Idempotent — the terminal fetch calls this, and
+        committers also call it from a ``finally`` so an aborted commit
+        (pipeline fault drill, sweep rejection) can never wedge the
+        service's coalesced lanes."""
+        if self._lease is not None:
+            lease, self._lease = self._lease, None
+            lease.__exit__(None, None, None)
+
+    _release = release
+
+    def ensure(self, max_slots: int) -> None:
+        self._inner.ensure(max_slots)
+
+    def alloc_slot(self) -> int:
+        return self._inner.alloc_slot()
+
+    def dispatch_level(self, bucket) -> None:
+        self._inner.dispatch_level(bucket)
+
+    def dispatch_packed(self, flat, row_off, row_len, slots, holes, b_tier):
+        self._inner.dispatch_packed(flat, row_off, row_len, slots, holes,
+                                    b_tier)
+
+    def dispatch_branch(self, masks, slots, children) -> None:
+        self._inner.dispatch_branch(masks, slots, children)
+
+    def fetch_slots(self, slots):
+        try:
+            return self._inner.fetch_slots(slots)
+        finally:
+            self._release()
+
+    def finish(self):
+        try:
+            return self._inner.finish()
+        finally:
+            self._release()
+
+
+def _next_tier(n: int, min_tier: int) -> int:
+    t = max(1, min_tier)
+    while t < n:
+        t *= 2
+    return t
+
+
+class HashService:
+    """Background device hash service: one (supervised) backend, many
+    clients, continuous batching. See the module docstring for semantics.
+
+    ``backend``: the batch hasher (``list[bytes] -> list[bytes]``); when
+    None, built from ``supervisor`` (a ``SupervisedHasher``) or, with no
+    supervisor either, the plain device front-end.
+    ``cpu_hasher``: the replay twin (default ``keccak256_batch_np``).
+    """
+
+    def __init__(self, backend=None, supervisor=None, *,
+                 cpu_hasher=None,
+                 window_s: float | None = None,
+                 fill_target: int | None = None,
+                 max_batch: int | None = None,
+                 lane_capacity: int | None = None,
+                 age_promote_s: float | None = None,
+                 lease_bypass_s: float | None = None,
+                 min_tier: int = 1024,
+                 injector: ServiceFaultInjector | None = None,
+                 registry=None):
+        env = os.environ
+        self.supervisor = supervisor
+        if backend is None:
+            if supervisor is not None:
+                from .supervisor import SupervisedHasher
+
+                backend = SupervisedHasher(supervisor, min_tier=min_tier)
+            else:
+                from .keccak_jax import KeccakDevice
+
+                backend = KeccakDevice(min_tier=min_tier,
+                                       block_tier=4).hash_batch
+        self._backend = backend
+        if cpu_hasher is None:
+            from ..primitives.keccak import keccak256_batch_np
+
+            cpu_hasher = keccak256_batch_np
+        self._cpu = cpu_hasher
+        self.window_s = float(window_s if window_s is not None
+                              else env.get("RETH_TPU_SERVICE_WINDOW", "0.002"))
+        self.fill_target = int(fill_target or
+                               env.get("RETH_TPU_SERVICE_FILL", 0) or min_tier)
+        self.max_batch = int(max_batch or 8 * self.fill_target)
+        self.injector = (injector if injector is not None
+                         else ServiceFaultInjector.from_env())
+        cap = int(lane_capacity or
+                  env.get("RETH_TPU_SERVICE_LANE_CAP", 0) or 262144)
+        if self.injector is not None and self.injector.queue_cap:
+            cap = self.injector.queue_cap
+        self.lane_capacity = cap
+        self.age_promote_s = float(
+            age_promote_s if age_promote_s is not None
+            else env.get("RETH_TPU_SERVICE_AGE_PROMOTE", "0.05"))
+        self.lease_bypass_s = float(
+            lease_bypass_s if lease_bypass_s is not None
+            else env.get("RETH_TPU_SERVICE_LEASE_BYPASS", "0.02"))
+        self.min_tier = min_tier
+
+        from ..metrics import HashServiceMetrics
+
+        self.metrics = HashServiceMetrics(registry)
+        self._cond = threading.Condition()
+        self._queues: dict[str, list[_Request]] = {l: [] for l in LANES}
+        self._queued_msgs: dict[str, int] = {l: 0 for l in LANES}
+        self._stopping = False
+        self._leased = False
+        self._lease_what: str | None = None
+        self._dispatching = False
+        # counters surfaced via snapshot() (metrics hold the full detail)
+        self.dispatches = 0
+        self.coalesced_requests = 0
+        self.hashed_msgs = 0
+        self.replays = 0
+        self.rejects = 0
+        self.leases = 0
+        self.lease_bypasses = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="hash-service")
+        self._thread.start()
+
+    # -- shared instance (one service per process, like DeviceSupervisor) --
+
+    _shared: "HashService | None" = None
+    _shared_lock = threading.Lock()
+
+    @classmethod
+    def shared(cls, **kw) -> "HashService":
+        with cls._shared_lock:
+            if cls._shared is None:
+                cls._shared = cls(**kw)
+            return cls._shared
+
+    @classmethod
+    def reset_shared(cls) -> None:
+        with cls._shared_lock:
+            svc, cls._shared = cls._shared, None
+        if svc is not None:
+            svc.stop()
+
+    # -- client API --------------------------------------------------------
+
+    def client(self, lane: str) -> HashClient:
+        return HashClient(self, lane)
+
+    def submit(self, lane: str, msgs: list[bytes], *,
+               block: bool = True, timeout: float | None = None) -> HashFuture:
+        """Enqueue one request on ``lane``. A full lane blocks the caller
+        (bounded-queue backpressure) unless ``block=False``, which raises
+        :class:`LaneOverloaded` instead. Oversized single requests (more
+        messages than the lane holds) are admitted alone — they could
+        never fit otherwise."""
+        if lane not in _LANE_INDEX:
+            raise ValueError(f"unknown lane {lane!r} (have {LANES})")
+        req = _Request(lane, msgs)
+        if not msgs:
+            req.future._complete(result=[])
+            return req.future
+        n = len(msgs)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._stopping:
+                    raise ServiceStopped("hash service is stopping")
+                room = self.lane_capacity - self._queued_msgs[lane]
+                if n <= room or not self._queues[lane]:
+                    break
+                if not block:
+                    self.rejects += 1
+                    self.metrics.record_reject(lane)
+                    raise LaneOverloaded(
+                        f"lane {lane!r} is full "
+                        f"({self._queued_msgs[lane]}/{self.lane_capacity} msgs)")
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    self.rejects += 1
+                    self.metrics.record_reject(lane)
+                    raise LaneOverloaded(
+                        f"lane {lane!r} still full after {timeout}s")
+                self._cond.wait(remaining)
+            self._queues[lane].append(req)
+            self._queued_msgs[lane] += n
+            self.metrics.record_submit(lane, n)
+            self.metrics.set_queue_depth(lane, self._queued_msgs[lane])
+            self._cond.notify_all()
+        return req.future
+
+    def hash(self, lane: str, msgs: list[bytes]) -> list[bytes]:
+        """Synchronous submit-and-wait — the ``hasher``-protocol path."""
+        return self.submit(lane, msgs).result()
+
+    # -- exclusive lease ----------------------------------------------------
+
+    @contextmanager
+    def lease(self, what: str = "rebuild"):
+        """Exclusive use of the underlying device: coalesced dispatching
+        pauses until release (in-flight dispatch first drains). Queued
+        requests that age past ``lease_bypass_s`` are hashed on the CPU
+        twin meanwhile, so a long-held lease cannot stall the live tip."""
+        t0 = time.monotonic()
+        with self._cond:
+            while self._leased or self._dispatching:
+                self._cond.wait()
+            self._leased = True
+            self._lease_what = what
+            self.leases += 1
+        self.metrics.record_lease(time.monotonic() - t0)
+        try:
+            yield self
+        finally:
+            with self._cond:
+                self._leased = False
+                self._lease_what = None
+                self._cond.notify_all()
+
+    def lease_backend(self, inner) -> LeasedTurboBackend:
+        """Wrap an array-protocol turbo engine so one commit holds the
+        exclusive lease from ``begin()`` to its terminal fetch."""
+        return LeasedTurboBackend(self, inner)
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _total_queued(self) -> int:
+        return sum(self._queued_msgs.values())
+
+    def _drain_locked(self, now: float) -> list[_Request]:
+        """Pick the next coalesced batch (caller holds the lock): aged
+        requests first (FIFO — the anti-starvation rule), then lanes in
+        priority order, whole requests, up to ``max_batch`` messages
+        (always at least one request)."""
+        aged = [r for lane in LANES for r in self._queues[lane]
+                if now - r.enqueued_at >= self.age_promote_s]
+        aged.sort(key=lambda r: r.enqueued_at)
+        aged_ids = {id(r) for r in aged}
+        order = aged + [r for lane in LANES for r in self._queues[lane]
+                        if id(r) not in aged_ids]
+        batch: list[_Request] = []
+        total = 0
+        for r in order:
+            if batch and total + len(r.msgs) > self.max_batch:
+                break
+            batch.append(r)
+            total += len(r.msgs)
+        taken = {id(r) for r in batch}
+        for lane in LANES:
+            kept = [r for r in self._queues[lane] if id(r) not in taken]
+            if len(kept) != len(self._queues[lane]):
+                removed = sum(len(r.msgs) for r in self._queues[lane]
+                              if id(r) in taken)
+                self._queues[lane] = kept
+                self._queued_msgs[lane] -= removed
+                self.metrics.set_queue_depth(lane, self._queued_msgs[lane])
+        if batch:
+            self._cond.notify_all()  # wake submitters blocked on capacity
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stopping and (self._total_queued() == 0):
+                    self._cond.wait()
+                if self._stopping and self._total_queued() == 0:
+                    return
+                # coalescing window: gather until the fused tier fills or
+                # the oldest request's deadline expires
+                while not self._stopping:
+                    now = time.monotonic()
+                    oldest = min(r.enqueued_at for lane in LANES
+                                 for r in self._queues[lane])
+                    if self._leased:
+                        # lease held: the device is busy — requests that
+                        # outwait the grace window go to the CPU twin
+                        wait = (oldest + self.lease_bypass_s) - now
+                        if wait <= 0:
+                            batch = self._drain_locked(now)
+                            bypass = True
+                            break
+                        self._cond.wait(wait)
+                        continue
+                    deadline = oldest + self.window_s
+                    pending = sum(len(q) for q in self._queues.values())
+                    # a LONE request dispatches immediately — the sync
+                    # latency path pays no window; the window only gathers
+                    # once a second request is pending (under load the
+                    # previous dispatch's wall time is the gather period,
+                    # continuous-batching style)
+                    if (pending == 1
+                            or self._total_queued() >= self.fill_target
+                            or now >= deadline):
+                        batch = self._drain_locked(now)
+                        bypass = False
+                        break
+                    self._cond.wait(deadline - now)
+                else:
+                    # stopping: drain what's left (onto the twin if the
+                    # device is still leased out)
+                    batch = self._drain_locked(time.monotonic())
+                    bypass = self._leased
+                if not batch:
+                    continue
+                self._dispatching = not bypass
+            try:
+                self._dispatch(batch, bypass)
+            finally:
+                if not bypass:
+                    with self._cond:
+                        self._dispatching = False
+                        self._cond.notify_all()
+
+    def _dispatch(self, batch: list[_Request], bypass: bool) -> None:
+        """ONE backend call for the whole coalesced batch; scatter digests
+        back through the futures. Any backend failure (watchdog trip that
+        escaped the supervisor, injected service wedge, ...) replays the
+        ENTIRE batch on the numpy twin — hashing is stateless, so replay
+        is exact and every future completes exactly once."""
+        msgs: list[bytes] = []
+        for r in batch:
+            msgs.extend(r.msgs)
+        t0 = time.monotonic()
+        for r in batch:
+            self.metrics.record_wait(r.lane, t0 - r.enqueued_at)
+        replayed = False
+        try:
+            if bypass:
+                self.lease_bypasses += 1
+                self.metrics.record_lease_bypass()
+                digests = self._cpu(msgs)
+            else:
+                if self.injector is not None:
+                    self.injector.on_dispatch()
+                digests = self._backend(msgs)
+        except BaseException as first_error:  # noqa: BLE001 — replayed below
+            replayed = True
+            self.replays += 1
+            self.metrics.record_replay()
+            try:
+                digests = self._cpu(msgs)
+            except BaseException as e:  # pragma: no cover - twin failure
+                for r in batch:
+                    r.future._complete(error=e)
+                raise first_error
+        service_s = time.monotonic() - t0
+        off = 0
+        for r in batch:
+            r.future._complete(result=digests[off:off + len(r.msgs)])
+            off += len(r.msgs)
+        self.dispatches += 1
+        self.coalesced_requests += len(batch)
+        self.hashed_msgs += len(msgs)
+        occupancy = len(msgs) / _next_tier(len(msgs), self.min_tier)
+        self.metrics.record_dispatch(
+            requests=len(batch), msgs=len(msgs), occupancy=occupancy,
+            service_s=service_s, replayed=replayed)
+
+    # -- lifecycle / observability ------------------------------------------
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the dispatcher. ``drain=True`` completes everything still
+        queued first; ``drain=False`` fails pending futures with
+        :class:`ServiceStopped`."""
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                for lane in LANES:
+                    for r in self._queues[lane]:
+                        r.future._complete(
+                            error=ServiceStopped("hash service stopped"))
+                    self._queues[lane].clear()
+                    self._queued_msgs[lane] = 0
+                    self.metrics.set_queue_depth(lane, 0)
+            self._cond.notify_all()
+        self._thread.join(timeout)
+
+    def coalesce_factor(self) -> float:
+        """Requests per coalesced dispatch (lifetime average) — the
+        headline number: >1 means small client batches actually fused."""
+        return (self.coalesced_requests / self.dispatches
+                if self.dispatches else 0.0)
+
+    def snapshot(self) -> dict:
+        """State for the events dashboard line and bench/test triage."""
+        with self._cond:
+            queued = dict(self._queued_msgs)
+            leased = self._lease_what
+        return {
+            "queued": queued,
+            "queued_total": sum(queued.values()),
+            "dispatches": self.dispatches,
+            "coalesce_factor": round(self.coalesce_factor(), 2),
+            "hashed_msgs": self.hashed_msgs,
+            "replays": self.replays,
+            "rejects": self.rejects,
+            "leases": self.leases,
+            "lease_bypasses": self.lease_bypasses,
+            "leased_by": leased,
+            "fault_injection": (self.injector.active()
+                                if self.injector is not None else False),
+        }

@@ -135,12 +135,17 @@ def build_payload(
                                          tree._chain_layers(parent_hash), {}))
     root = tree._state_root_job(scratch, out)
 
+    # payload-build hashing rides its own hash-service lane (below live,
+    # above rebuild/proof): an improvement-loop rebuild coalesces with but
+    # never delays the canonical tip's root job
+    committer = (tree.committer.for_lane("payload")
+                 if hasattr(tree.committer, "for_lane") else tree.committer)
     header = Header(
         parent_hash=parent_hash,
         beneficiary=attrs.suggested_fee_recipient,
         state_root=root,
-        transactions_root=ordered_trie_root([t.encode() for t in selected], tree.committer),
-        receipts_root=ordered_trie_root([r.encode_2718() for r in receipts], tree.committer),
+        transactions_root=ordered_trie_root([t.encode() for t in selected], committer),
+        receipts_root=ordered_trie_root([r.encode_2718() for r in receipts], committer),
         logs_bloom=logs_bloom([l for r in receipts for l in r.logs]),
         number=parent.number + 1,
         gas_limit=env.gas_limit,
@@ -150,7 +155,7 @@ def build_payload(
         mix_hash=attrs.prev_randao,
         base_fee_per_gas=base_fee,
         withdrawals_root=ordered_trie_root(
-            [rlp_encode(w.rlp_fields()) for w in attrs.withdrawals], tree.committer
+            [rlp_encode(w.rlp_fields()) for w in attrs.withdrawals], committer
         ),
         blob_gas_used=blob_gas_used if cancun else None,
         excess_blob_gas=excess_blob if cancun else None,

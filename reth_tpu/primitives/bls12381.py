@@ -1,16 +1,22 @@
 """Minimal BLS12-381 arithmetic for the EIP-2537 precompiles.
 
-Only what G1ADD (0x0b) and G2ADD (0x0d) need: Fp / Fp2 field ops and
-affine point addition on y^2 = x^3 + 4 (G1) and y^2 = x^3 + 4(1+i) (G2).
-Per EIP-2537, ADD inputs must be valid field encodings on the curve but
-do NOT require a subgroup check; the point at infinity encodes as all
-zeros. Everything here is plain python ints — these precompiles are rare
+G1ADD (0x0b) / G2ADD (0x0d): Fp / Fp2 field ops and affine point addition
+on y^2 = x^3 + 4 (G1) and y^2 = x^3 + 4(1+i) (G2). Per EIP-2537, ADD
+inputs must be valid field encodings on the curve but do NOT require a
+subgroup check; the point at infinity encodes as all zeros.
+
+G1MSM (0x0c) / G2MSM (0x0e): multi-scalar multiplication built from
+double-and-add over the SAME affine addition (the chord-tangent formula
+handles doubling), so the group law lives in exactly one place. MSM
+inputs DO require the subgroup check (EIP-2537: "subgroup check is
+required" for MSM but not ADD), enforced by multiplying by the prime
+subgroup order ``R`` — slow in python, but these precompiles are rare
 enough on mainnet that constant-factor speed is irrelevant, while the
 encode/validate rules are consensus-critical.
 
-The remaining EIP-2537 operations (MSM, pairing, map-to-curve) need the
-MSM discount table and the SWU isogeny constants, which this repo cannot
-verify offline — their precompiles raise loudly instead of silently
+The remaining EIP-2537 operations (pairing check, map-to-curve) need
+the Fp12 tower / SWU isogeny constants, which this repo cannot verify
+offline — their precompiles raise loudly instead of silently
 misbehaving (see evm/interpreter.py PrecompileNotImplemented).
 """
 
@@ -18,6 +24,8 @@ from __future__ import annotations
 
 # the BLS12-381 base field prime
 P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+# the prime order of the G1/G2 subgroups (the scalar field)
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
 
 _B1 = 4            # G1 curve constant: y^2 = x^3 + 4
 _B2 = (4, 4)       # G2 curve constant: 4 * (1 + i) in Fp2
@@ -138,6 +146,55 @@ def g1add_precompile(data: bytes) -> bytes:
     return encode_g1(g1_add(decode_g1(data[:128]), decode_g1(data[128:])))
 
 
+# -- scalar multiplication / MSM (shared over both groups) --------------------
+
+
+def _mul_scalar(pt, k: int, add):
+    """Double-and-add via the affine group law (``add(p, p)`` doubles)."""
+    acc = None
+    while k:
+        if k & 1:
+            acc = add(acc, pt)
+        pt = add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def _check_subgroup(pt, add, what: str) -> None:
+    """EIP-2537 MSM semantics: every input point must lie in the prime
+    subgroup (infinity trivially does). Order-R multiplication is the
+    definitionally-correct check — no endomorphism shortcuts to get wrong."""
+    if pt is not None and _mul_scalar(pt, R, add) is not None:
+        raise BlsError(f"{what} point not in the prime subgroup")
+
+
+def _msm(data: bytes, pair_len: int, point_len: int, decode, encode, add,
+         what: str) -> bytes:
+    """Shared EIP-2537 MSM body: k (point, 32-byte scalar) pairs, every
+    point curve- AND subgroup-checked, scalars unreduced big-endian ints
+    (multiplication handles any magnitude). Empty input is invalid."""
+    if len(data) == 0 or len(data) % pair_len != 0:
+        raise BlsError(
+            f"{what} input must be a positive multiple of {pair_len} bytes, "
+            f"got {len(data)}")
+    acc = None
+    for off in range(0, len(data), pair_len):
+        pt = decode(data[off:off + point_len])
+        _check_subgroup(pt, add, what)
+        scalar = int.from_bytes(data[off + point_len:off + pair_len], "big")
+        acc = add(acc, _mul_scalar(pt, scalar, add))
+    return encode(acc)
+
+
+def g1_mul(pt, k: int):
+    return _mul_scalar(pt, k, g1_add)
+
+
+def g1msm_precompile(data: bytes) -> bytes:
+    """EIP-2537 G1MSM: k*(G1 point ++ 32-byte scalar) -> 128-byte point."""
+    return _msm(data, 160, 128, decode_g1, encode_g1, g1_add, "G1MSM")
+
+
 # -- G2 -----------------------------------------------------------------------
 
 
@@ -172,6 +229,64 @@ def g2add_precompile(data: bytes) -> bytes:
     if len(data) != 512:
         raise BlsError(f"G2ADD input must be 512 bytes, got {len(data)}")
     return encode_g2(g2_add(decode_g2(data[:256]), decode_g2(data[256:])))
+
+
+def g2_mul(pt, k: int):
+    return _mul_scalar(pt, k, g2_add)
+
+
+def g2msm_precompile(data: bytes) -> bytes:
+    """EIP-2537 G2MSM: k*(G2 point ++ 32-byte scalar) -> 256-byte point."""
+    return _msm(data, 288, 256, decode_g2, encode_g2, g2_add, "G2MSM")
+
+
+# EIP-2537 MSM pricing: cost = k * multiplication_cost * discount(k) / 1000
+# with the per-k discount table below (index k-1, capped at k=128). The
+# table is transcribed from the EIP's final (Pectra) parameter set.
+MSM_MULTIPLIER = 1000
+G1MSM_BASE_GAS = 12000   # G1 multiplication cost
+G2MSM_BASE_GAS = 22500   # G2 multiplication cost
+
+G1_MSM_DISCOUNT = (
+    1000, 949, 848, 797, 764, 750, 738, 728, 719, 712, 705, 698, 692, 687,
+    682, 677, 673, 669, 665, 661, 658, 654, 651, 648, 645, 642, 640, 637,
+    635, 632, 630, 627, 625, 623, 621, 619, 617, 615, 613, 611, 609, 608,
+    606, 604, 603, 601, 599, 598, 596, 595, 593, 592, 591, 589, 588, 586,
+    585, 584, 582, 581, 580, 579, 577, 576, 575, 574, 573, 572, 570, 569,
+    568, 567, 566, 565, 564, 563, 562, 561, 560, 559, 558, 557, 556, 555,
+    554, 553, 552, 551, 550, 549, 548, 547, 547, 546, 545, 544, 543, 542,
+    541, 540, 540, 539, 538, 537, 536, 536, 535, 534, 533, 532, 532, 531,
+    530, 529, 528, 528, 527, 526, 525, 525, 524, 523, 522, 522, 521, 520,
+    520, 519,
+)
+G2_MSM_DISCOUNT = (
+    1000, 1000, 923, 884, 855, 832, 812, 796, 782, 770, 759, 749, 740, 732,
+    724, 717, 711, 704, 699, 693, 688, 683, 679, 674, 670, 666, 663, 659,
+    655, 652, 649, 646, 643, 640, 637, 634, 632, 629, 627, 624, 622, 620,
+    618, 615, 613, 611, 609, 607, 606, 604, 602, 600, 598, 597, 595, 593,
+    592, 590, 589, 587, 586, 584, 583, 582, 580, 579, 578, 576, 575, 574,
+    573, 571, 570, 569, 568, 567, 566, 565, 563, 562, 561, 560, 559, 558,
+    557, 556, 555, 554, 553, 552, 552, 551, 550, 549, 548, 547, 546, 545,
+    545, 544, 543, 542, 541, 541, 540, 539, 538, 537, 537, 536, 535, 535,
+    534, 533, 532, 532, 531, 530, 530, 529, 528, 528, 527, 526, 526, 525,
+    524, 524,
+)
+
+
+def msm_gas(k: int, base: int, discounts: tuple[int, ...]) -> int:
+    """EIP-2537 MSM gas for k pairs (k >= 1)."""
+    if k == 0:
+        return 0
+    d = discounts[min(k, len(discounts)) - 1]
+    return (k * base * d) // MSM_MULTIPLIER
+
+
+def g1msm_gas(k: int) -> int:
+    return msm_gas(k, G1MSM_BASE_GAS, G1_MSM_DISCOUNT)
+
+
+def g2msm_gas(k: int) -> int:
+    return msm_gas(k, G2MSM_BASE_GAS, G2_MSM_DISCOUNT)
 
 
 # the standard generators (draft-irtf-cfrg-bls-signature / EIP-2537 test

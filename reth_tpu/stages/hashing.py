@@ -27,7 +27,12 @@ class AccountHashingStage(Stage):
     id = "AccountHashing"
 
     def __init__(self, committer: TrieCommitter | None = None, clean_threshold: int = 100_000):
-        self.hasher = (committer or TrieCommitter()).hasher
+        committer = committer or TrieCommitter()
+        # hashing-stage scans are rebuild work: with --hash-service their
+        # chunk batches ride the rebuild lane (identity without a service)
+        if hasattr(committer, "for_lane"):
+            committer = committer.for_lane("rebuild")
+        self.hasher = committer.hasher
         self.clean_threshold = clean_threshold
 
     def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:
@@ -74,7 +79,12 @@ class StorageHashingStage(Stage):
     id = "StorageHashing"
 
     def __init__(self, committer: TrieCommitter | None = None, clean_threshold: int = 100_000):
-        self.hasher = (committer or TrieCommitter()).hasher
+        committer = committer or TrieCommitter()
+        # hashing-stage scans are rebuild work: with --hash-service their
+        # chunk batches ride the rebuild lane (identity without a service)
+        if hasattr(committer, "for_lane"):
+            committer = committer.for_lane("rebuild")
+        self.hasher = committer.hasher
         self.clean_threshold = clean_threshold
 
     def execute(self, provider: DatabaseProvider, inp: ExecInput) -> ExecOutput:

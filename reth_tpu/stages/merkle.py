@@ -47,7 +47,11 @@ class MerkleStage(Stage):
 
     def __init__(self, committer: TrieCommitter | None = None,
                  rebuild_threshold: int = 50_000, chunk_leaves: int = 500_000):
-        self.committer = committer or TrieCommitter()
+        committer = committer or TrieCommitter()
+        # rebuild lane: below live/payload — a sync-time rebuild coalesces
+        # with but never delays the tip (no-op without a hash service)
+        self.committer = (committer.for_lane("rebuild")
+                          if hasattr(committer, "for_lane") else committer)
         self.rebuild_threshold = rebuild_threshold
         self.chunk_leaves = chunk_leaves
         self._turbo = None  # cached: keeps the digest arena resident
@@ -62,6 +66,7 @@ class MerkleStage(Stage):
             self._turbo = TurboCommitter(
                 backend=getattr(self.committer, "turbo_backend", "numpy"),
                 supervisor=getattr(self.committer, "supervisor", None),
+                hash_service=getattr(self.committer, "hash_service", None),
             )
         return self._turbo
 
@@ -100,7 +105,8 @@ class MerkleStage(Stage):
         try:
             return full_state_root_turbo(
                 provider, backend=backend,
-                supervisor=getattr(self.committer, "supervisor", None))
+                supervisor=getattr(self.committer, "supervisor", None),
+                hash_service=getattr(self.committer, "hash_service", None))
         except (ValueError, RuntimeError):
             return full_state_root(provider, self.committer)
 

@@ -149,6 +149,24 @@ class TrieCommitter:
                 # a compile).
                 hasher = KeccakDevice(min_tier=min_tier, block_tier=4).hash_batch
         self.hasher = hasher
+        # --hash-service wiring (cli.py): an ops/hash_service.py HashService
+        # multiplexing every keccak client over one supervised backend.
+        # When set, ``hasher`` is a lane-bound HashClient and ``for_lane``
+        # hands call sites their own priority lane.
+        self.hash_service = None
+
+    def for_lane(self, lane: str) -> "TrieCommitter":
+        """Shallow clone whose ``hasher`` is bound to the hash service's
+        ``lane`` (live > payload > rebuild > proof). Without a service —
+        or on the fused path, which doesn't go through ``hasher`` — this
+        is the identity, so call sites can use it unconditionally."""
+        if self.hash_service is None or self.fused:
+            return self
+        import copy
+
+        clone = copy.copy(self)
+        clone.hasher = self.hash_service.client(lane)
+        return clone
 
     def commit(
         self,

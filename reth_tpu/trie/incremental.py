@@ -354,7 +354,7 @@ def full_state_root(
 
 
 def full_state_root_turbo(provider: DatabaseProvider, backend: str = "device",
-                          supervisor=None) -> bytes:
+                          supervisor=None, hash_service=None) -> bytes:
     """Full rebuild on the turbo path: C++ structure sweep + packed/bitmap
     device levels (trie/turbo.py) — zero per-node Python. Same semantics as
     :func:`full_state_root`; raises ``ValueError`` for inputs outside the
@@ -366,7 +366,8 @@ def full_state_root_turbo(provider: DatabaseProvider, backend: str = "device",
     from .turbo import TurboCommitter
     import numpy as np
 
-    committer = TurboCommitter(backend=backend, supervisor=supervisor)
+    committer = TurboCommitter(backend=backend, supervisor=supervisor,
+                               hash_service=hash_service)
     p = provider
     p.clear_trie_tables()
 

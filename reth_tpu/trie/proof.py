@@ -44,7 +44,12 @@ class AccountProof:
 class ProofCalculator:
     def __init__(self, provider: DatabaseProvider, committer: TrieCommitter | None = None):
         self.provider = provider
-        self.committer = committer or TrieCommitter()
+        # proof/RPC work rides the LOWEST-priority hash-service lane: with
+        # --hash-service its (often tiny) batches coalesce with everyone
+        # else's but never delay the live tip; without one this is identity
+        committer = committer or TrieCommitter()
+        self.committer = (committer.for_lane("proof")
+                          if hasattr(committer, "for_lane") else committer)
         self._inc = IncrementalStateRoot(provider, self.committer)
 
     def account_proof(self, address: bytes, slots: list[bytes] = ()) -> AccountProof:

@@ -770,14 +770,22 @@ class TurboCommitter:
     ``backend``: "device" (fused HBM-resident engine, optionally SPMD over
     ``mesh``), "numpy" (CPU twin — the measured baseline), or "auto"
     (device under the ``ops/supervisor.py`` watchdog+breaker, with
-    journaled mid-commit failover onto the numpy twin)."""
+    journaled mid-commit failover onto the numpy twin).
+
+    ``hash_service``: an ``ops/hash_service.py`` HashService — the
+    device-touching backends ("device"/"auto") then hold the service's
+    EXCLUSIVE LEASE for each commit (begin → terminal fetch), so a rebuild
+    streams its pre-packed windows at full rate while the service's
+    coalesced lanes pause (aged live-tip requests bypass to the CPU twin).
+    The numpy backend never touches the device and takes no lease."""
 
     def __init__(self, backend: str = "device", min_tier: int = 1024, mesh=None,
-                 supervisor=None):
+                 supervisor=None, hash_service=None):
         self.backend_kind = backend
         self.min_tier = min_tier
         self.mesh = mesh
         self.supervisor = supervisor
+        self.hash_service = hash_service
         self.arena = DigestArena()  # resident across this committer's commits
         self._lib = load_library()
 
@@ -797,8 +805,15 @@ class TurboCommitter:
             from ..ops.supervisor import DeviceSupervisor, SupervisedBackend
 
             sup = self.supervisor or DeviceSupervisor.shared()
-            return SupervisedBackend(sup, self._device_engine, arena=self.arena)
-        return self._device_engine()
+            backend = SupervisedBackend(sup, self._device_engine,
+                                        arena=self.arena)
+        else:
+            backend = self._device_engine()
+        if self.hash_service is not None:
+            # shared-service discipline: this commit owns the device via
+            # the exclusive lease instead of grabbing it unilaterally
+            backend = self.hash_service.lease_backend(backend)
+        return backend
 
     def commit_hashed_many(
         self,
@@ -851,7 +866,12 @@ class TurboCommitter:
         if self.backend_kind in ("device", "auto") and "hash_workers" not in knobs:
             knobs["hash_workers"] = 1  # one device; supervised journal is serial
         pipe = RebuildPipeline(backend, self._lib, injector=injector, **knobs)
-        results = pipe.run(jobs, collect_branches, start_depth)
+        try:
+            results = pipe.run(jobs, collect_branches, start_depth)
+        finally:
+            release = getattr(backend, "release", None)
+            if release is not None:
+                release()  # idempotent: aborted commits must drop the lease
         effective = getattr(backend, "effective_kind", self.backend_kind)
         trie_metrics.record_commit(
             backend=effective,
@@ -869,6 +889,20 @@ class TurboCommitter:
 
         t_start = _time.time()
         backend = self._make_backend()
+        try:
+            return self._run_inner(lib, h, n_jobs, key_arrays, collect_branches,
+                                   start_depth, backend, t_start)
+        finally:
+            release = getattr(backend, "release", None)
+            if release is not None:
+                release()  # idempotent: failed commits must drop the lease
+
+    def _run_inner(self, lib, h, n_jobs, key_arrays, collect_branches,
+                   start_depth, backend, t_start):
+        import time as _time
+
+        from ..metrics import trie_metrics
+
         max_slot = lib.rtb_max_slot(h)
         backend.begin(max_slot)
         n_levels = lib.rtb_num_levels(h)

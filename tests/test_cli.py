@@ -146,6 +146,7 @@ def test_prune_command(chain_files, tmp_path, capsys):
 
 
 def test_p2p_command(chain_files, capsys):
+    pytest.importorskip("cryptography")  # live RLPx handshake needs AES
     from reth_tpu.consensus import EthBeaconConsensus
     from reth_tpu.net import NetworkManager, Status
     from reth_tpu.stages import Pipeline, default_stages
@@ -305,3 +306,37 @@ def test_stale_empty_store_does_not_mask_initialised_one(chain_files, capsys):
     assert "CanonicalHeaders" in out
     assert any(line.split() == ["Transactions", "3"]
                for line in out.splitlines())
+
+
+def test_hash_service_flag_wires_committer(chain_files, capsys):
+    """--hash-service: the committer grows a HashService whose live-lane
+    client becomes its hasher; init + verify-trie run end-to-end through
+    the service and the config dump carries the knob."""
+    from reth_tpu.cli import _make_committer
+    from reth_tpu.ops.hash_service import HashClient, HashService
+
+    class _Args:
+        hasher = "cpu"
+        hash_service = True
+
+    committer = _make_committer(_Args())
+    try:
+        assert isinstance(committer.hash_service, HashService)
+        assert isinstance(committer.hasher, HashClient)
+        assert committer.hasher.lane == "live"
+        assert committer.for_lane("proof").hasher.lane == "proof"
+        # digests are the service's, bit-identical to the direct path
+        assert committer.hasher([b"abc"]) == keccak256_batch_np([b"abc"])
+        assert committer.hash_service.dispatches >= 1
+    finally:
+        committer.hash_service.stop()
+
+    tmp, gpath, cpath, builder = chain_files
+    datadir = tmp / "svc"
+    assert main(["init", "--datadir", str(datadir), "--genesis", str(gpath),
+                 "--hasher", "cpu", "--hash-service"]) == 0
+    assert main(["db", "verify-trie", "--datadir", str(datadir),
+                 "--hasher", "cpu", "--hash-service"]) == 0
+    capsys.readouterr()
+    assert main(["config"]) == 0
+    assert "hash_service = false" in capsys.readouterr().out

@@ -59,6 +59,7 @@ def test_enr_roundtrip_and_verify():
 
 
 def test_packet_mask_roundtrip():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     dest_id = node_id_from_pubkey(pubkey_from_priv(PRIV_B))
     header = _header(FLAG_ORDINARY, b"\x01" * 12, b"\xaa" * 32)
     pkt = mask_packet(dest_id, header, b"payload")
@@ -91,6 +92,7 @@ def test_session_key_agreement_both_sides():
 
 @pytest.fixture()
 def pair():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     a = Discv5(PRIV_A)
     b = Discv5(PRIV_B)
     a.start()
@@ -137,6 +139,7 @@ def test_findnode_by_distance(pair):
 
 
 def test_lookup_discovers_via_bootstrap():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     nodes = [Discv5(random_priv()) for _ in range(4)]
     for n in nodes:
         n.start()

@@ -234,6 +234,7 @@ EIP8_ACK_3 = _hx(
 def test_eip8_auth_vectors_decode():
     """The EIP-8 spec's auth messages (versions 4 and 56, with and without
     extra list elements) must decode against the spec's server key."""
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     from reth_tpu.net.ecies import Handshake
 
     for raw in (EIP8_AUTH_2, EIP8_AUTH_3):
@@ -246,6 +247,7 @@ def test_eip8_auth_vectors_decode():
 def test_eip8_ack_vectors_decode():
     """The EIP-8 spec's ack messages must decode against the spec's client
     key after the client sends its auth."""
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     from reth_tpu.net.ecies import Handshake, pubkey_from_priv
 
     server_pub = pubkey_from_priv(EIP8_SERVER_KEY)
@@ -260,6 +262,7 @@ def test_eip8_ack_vectors_decode():
 def test_eip8_fixed_key_loopback():
     """Full handshake with the EIP-8 fixed keys: both sides derive the
     SAME frame secrets (MAC/AES seeds agree)."""
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     from reth_tpu.net.ecies import Handshake, pubkey_from_priv
 
     client = Handshake(EIP8_CLIENT_KEY, eph_priv=EIP8_CLIENT_EPH,
@@ -317,6 +320,7 @@ def test_secp256k1_cross_validates_with_openssl():
     in-image `cryptography` package (OpenSSL-backed): our signatures
     verify under their ECDSA, and their signatures recover to the right
     address under our ecrecover — 32 random messages each way."""
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     import os
 
     from cryptography.hazmat.primitives import hashes

@@ -59,6 +59,7 @@ def make_synced_node(n_blocks=8):
 @pytest.fixture()
 def testnet():
     """A serving node + a fresh node sharing genesis, over localhost TCP."""
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     factory_a, builder = make_synced_node()
     status = Status(network_id=1, head=builder.tip.hash, genesis=builder.genesis.hash)
     server = NetworkManager(factory_a, status, node_priv=0xA11CE5)
@@ -292,6 +293,7 @@ def test_status_v69_codec_roundtrip():
 def test_online_sync_with_two_peers(testnet):
     """Testnet sync where the body windows are served by TWO live peer
     connections concurrently (reference concurrent bodies downloader)."""
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     server, port, status, factory_b, builder = testnet
     our_status = Status(network_id=1, head=builder.genesis.hash,
                         genesis=builder.genesis.hash)
@@ -313,6 +315,7 @@ def test_session_manager_caps_and_events(testnet):
     """Session lifecycle over real connections: caps enforced BEFORE the
     handshake, events published on establish/close, counters tracked
     (reference SessionManager in the Swarm)."""
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     server, port, status, factory_b, builder = testnet
     server.sessions.max_inbound = 2
     events = []
@@ -358,6 +361,7 @@ def test_session_manager_caps_and_events(testnet):
 def test_outbound_session_released_on_close(testnet):
     """Regression (round-4 review): closing an outbound connection must
     release its session slot or the outbound cap leaks permanently."""
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     server, port, status, factory_b, builder = testnet
     from reth_tpu.net.server import NetworkManager
     from reth_tpu.storage import MemDb, ProviderFactory
@@ -378,6 +382,7 @@ def test_node_serves_in_memory_tip_over_p2p(tmp_path):
     """A LAUNCHED node advertises its live head in the handshake Status
     and serves tree blocks above the persistence threshold — a fresh peer
     syncs to the full tip, not just the persisted chain (round-4 fix)."""
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     import time
 
     from reth_tpu.node import Node, NodeConfig
@@ -422,6 +427,7 @@ def test_swarm_soak_flat_thread_count(testnet):
     concurrent inbound sessions are served by ONE loop thread — the
     steady-state thread count must not grow with the peer count, and
     every peer must still get served."""
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     import threading
     import time
 

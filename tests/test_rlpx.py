@@ -83,6 +83,7 @@ def test_snappy_rejects_malformed():
 
 
 def test_ecies_roundtrip_and_tamper():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     pub = pubkey_from_priv(B_PRIV)
     msg = b"secret handshake payload"
     ct = encrypt(pub, msg, shared_mac_data=b"\x01\x02")
@@ -98,6 +99,7 @@ def test_ecies_roundtrip_and_tamper():
 
 
 def test_handshake_both_sides_derive_same_keys():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     init = Handshake(A_PRIV)
     resp = Handshake(B_PRIV)
     auth = init.auth(pubkey_from_priv(B_PRIV))
@@ -112,6 +114,7 @@ def test_handshake_both_sides_derive_same_keys():
 
 
 def test_handshake_rejects_wrong_recipient():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     init = Handshake(A_PRIV)
     auth = init.auth(pubkey_from_priv(B_PRIV))
     eve = Handshake(0x3333)
@@ -137,6 +140,7 @@ def _session_pair():
 
 
 def test_rlpx_frames_bidirectional():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     s1, s2 = _session_pair()
     s1.send_frame(b"\x80hello over rlpx")
     assert s2.recv_frame() == b"\x80hello over rlpx"
@@ -152,6 +156,7 @@ def test_rlpx_frames_bidirectional():
 
 
 def test_rlpx_tampered_frame_rejected():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     s1, s2 = _session_pair()
     raw_sock = s1.sock
     s1.send_frame(b"\x80data")
@@ -169,6 +174,7 @@ def test_rlpx_tampered_frame_rejected():
 
 
 def test_rlpx_hello_and_snappy_messages():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     s1, s2 = _session_pair()
     result = {}
 

@@ -25,6 +25,7 @@ CPU = TrieCommitter(hasher=keccak256_batch_np)
 
 @pytest.fixture(scope="module")
 def snap_net():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     alice = Wallet(0xA11CE)
     code = bytes.fromhex("6001600155")  # writes storage on every call
     contract = b"\x0c" * 20

@@ -9,6 +9,8 @@ import os
 import socket
 import struct
 
+import pytest
+
 from reth_tpu.rpc.server import RpcServer
 from reth_tpu.rpc.ws import OP_PING, OP_TEXT, WsRpcServer, _WS_GUID
 
@@ -81,6 +83,7 @@ def test_ws_rpc_roundtrip():
 
 
 def test_admin_namespace_over_live_node():
+    pytest.importorskip("cryptography")  # AES for RLPx/discv5 paths
     from reth_tpu.net import NetworkManager, Status
     from reth_tpu.rpc.admin import AdminApi
     from reth_tpu.storage import MemDb, ProviderFactory
