@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -29,6 +29,16 @@ test-faults:
 test-service:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_hash_service.py -q -p no:cacheprovider
+
+# parallel sparse commit: randomized packed-vs-serial differential parity
+# (bit-identical roots across updates/deletes/wipes, blinded + preserved
+# edges), encode/proof pool-size sweeps, a threaded stress drill over a
+# shared committer, and the RETH_TPU_FAULT_SPARSE_* abort/wedge fault
+# drills (fallback to the incremental committer) — CPU-only
+test-sparse:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_sparse_parallel.py tests/test_sparse.py \
+	  tests/test_sparse_root_engine.py -q -p no:cacheprovider
 
 # overlapped rebuild pipeline: parity vs the serial committer, packing,
 # arena residency, abort/failover drills, chunked-resume — fast, CPU-only

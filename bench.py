@@ -279,9 +279,110 @@ def run_service_mode() -> None:
           exit_code=0)
 
 
+def build_sparse_state(n_tries: int, slots: int, dirty: int, seed: int = 3):
+    """One storage-heavy live-tip block in miniature: a SparseStateTrie
+    with ``n_tries`` fully-revealed storage tries x ``slots`` slots plus
+    matching account leaves, committed once (clean refs — the preserved
+    cross-block state), then ``dirty`` slot writes + a few deletes/wipes
+    per-trie and account churn: exactly the dirty set finish() sees."""
+    import numpy as _np
+
+    from reth_tpu.trie.sparse import SparseStateTrie, SparseTrie
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+
+    rng = _np.random.default_rng(seed)
+    st = SparseStateTrie()
+    owners = []
+    slot_keys: dict[bytes, list[bytes]] = {}
+    for _ in range(n_tries):
+        ha = bytes(rng.integers(0, 256, 32, dtype=_np.uint8))
+        owners.append(ha)
+        t = st.storage_trie(ha)
+        keys = [bytes(rng.integers(0, 256, 32, dtype=_np.uint8))
+                for _ in range(slots)]
+        slot_keys[ha] = keys
+        for k in keys:
+            t.update(k, bytes(rng.integers(1, 256, 8, dtype=_np.uint8)))
+        st.update_account(ha, b"account-leaf-" + ha)
+    st.root(keccak256_batch_np)  # clean baseline (serial; untimed)
+    # the block's dirty set
+    for i, ha in enumerate(owners):
+        t = st.storage_trie(ha)
+        keys = slot_keys[ha]
+        for j in range(dirty):
+            t.update(keys[j % len(keys)],
+                     bytes(rng.integers(1, 256, 8, dtype=_np.uint8)))
+        t.delete(keys[-1])
+        if i % 16 == 15:  # a few SELFDESTRUCT wipes
+            st.storage_tries[ha] = SparseTrie()
+        st.update_account(ha, b"post-leaf-" + ha)
+    return st
+
+
+def run_sparse_mode() -> None:
+    """RETH_TPU_BENCH_MODE=sparse: storage-heavy live-tip ``finish()``
+    commit latency — the PARALLEL packed path (cross-trie per-depth
+    dispatch fusion + lower-subtrie encode pool,
+    trie/sparse.py ParallelSparseCommitter) vs the serial per-trie
+    ``root_hash_compute`` loop the seed ran. Roots must be bit-identical;
+    ``vs_baseline`` = serial wall / parallel wall. Runs on the device
+    when the tunnel probes healthy, else the numpy twin (the established
+    CPU-fallback "backend" reporting). Env: RETH_TPU_BENCH_SPARSE_TRIES
+    (default 192), RETH_TPU_BENCH_SPARSE_SLOTS (slots/trie, default 64),
+    RETH_TPU_BENCH_SPARSE_DIRTY (dirty writes/trie, default 16),
+    RETH_TPU_SPARSE_WORKERS (encode-pool width, default auto)."""
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.trie.sparse import ParallelSparseCommitter
+
+    n_tries = int(os.environ.get("RETH_TPU_BENCH_SPARSE_TRIES", "192"))
+    slots = int(os.environ.get("RETH_TPU_BENCH_SPARSE_SLOTS", "64"))
+    dirty = int(os.environ.get("RETH_TPU_BENCH_SPARSE_DIRTY", "16"))
+    _STATE["metric"] = "sparse_commit_hashes_per_sec"
+    _STATE["phase"] = "sparse bench probe"
+    diag = probe_tunnel()
+    if diag is None:
+        from reth_tpu.ops.keccak_jax import KeccakDevice
+
+        _STATE["backend"] = "device"
+        hasher = KeccakDevice(min_tier=1024, block_tier=4).hash_batch
+    else:
+        _STATE["backend"] = "numpy"
+        hasher = keccak256_batch_np
+
+    _STATE["phase"] = "sparse state build (serial pass)"
+    st_serial = build_sparse_state(n_tries, slots, dirty)
+    t0 = time.time()
+    root_serial = st_serial.root(hasher)
+    dt_serial = time.time() - t0
+
+    _STATE["phase"] = "sparse state build (parallel pass)"
+    st_par = build_sparse_state(n_tries, slots, dirty)
+    committer = ParallelSparseCommitter()
+    t0 = time.time()
+    root_par = st_par.root(hasher, committer=committer)
+    dt_par = time.time() - t0
+    if root_serial != root_par:
+        _emit(0, 0, error="parallel/serial sparse root mismatch", exit_code=1)
+    stats = committer.last or {}
+    hashed = stats.get("hashed", 0)
+    _STATE["device_result"] = round(hashed / dt_par, 1)
+    _emit(round(hashed / dt_par, 1), round(dt_serial / dt_par, 3),
+          serial_wall_s=round(dt_serial, 4),
+          parallel_wall_s=round(dt_par, 4),
+          tries=stats.get("tries"), levels_packed=stats.get("levels"),
+          dispatches=stats.get("dispatches"),
+          encode_chunks=stats.get("encode_chunks"),
+          sparse_workers=committer.workers,
+          **({"device_unavailable": diag} if diag else {}),
+          exit_code=0)
+
+
 def main():
     if os.environ.get("RETH_TPU_BENCH_MODE") == "service":
         run_service_mode()
+        return
+    if os.environ.get("RETH_TPU_BENCH_MODE") == "sparse":
+        run_sparse_mode()
         return
     n_accounts = int(os.environ.get("RETH_TPU_BENCH_ACCOUNTS", "150000"))
     n_slots = int(os.environ.get("RETH_TPU_BENCH_SLOTS", "60000"))

@@ -323,6 +323,7 @@ def cmd_node(args):
                      bootnodes_v5=tuple(args.bootnodes_v5.split(",")) if args.bootnodes_v5 else (),
                      db_backend=backend,
                      storage_v2=getattr(args, "storage_v2", None),
+                     sparse_workers=getattr(args, "sparse_workers", None),
                      **kw)
     node = Node(cfg, committer=committer)
     p2p_port = node.start_network()
@@ -697,6 +698,7 @@ def cmd_config(args):
         f"persistence_threshold = {cfg.persistence_threshold}",
         f'hasher = "{cfg.hasher}"',
         f"hash_service = {'true' if cfg.hash_service else 'false'}",
+        f"sparse_workers = {cfg.sparse_workers}",
         "",
         "[prune]",
     ]
@@ -985,6 +987,16 @@ def main(argv=None) -> int:
     p.add_argument("--ethstats", default=None,
                    help="report to an ethstats server (node:secret@host:port)")
     add_hasher(p)
+    p.add_argument("--sparse-workers", dest="sparse_workers", type=int,
+                   default=None,
+                   help="parallel sparse commit: worker count for the "
+                        "live-tip finish path's RLP encode pool AND the "
+                        "multiproof proof-worker pool (trie/sparse.py + "
+                        "trie/proof.py). Default: RETH_TPU_SPARSE_WORKERS "
+                        "or a cpu-derived value; 1 disables the pools "
+                        "(the cross-trie packed hash dispatch stays on). "
+                        "Also settable as [node] sparse_workers in "
+                        "reth.toml")
     p.set_defaults(fn=cmd_node)
 
     p = sub.add_parser("dump-genesis", help="print the dev genesis JSON")

@@ -99,6 +99,11 @@ class RethTpuConfig:
     # multiplex every keccak client over the shared background hash
     # service (ops/hash_service.py): priority lanes + continuous batching
     hash_service: bool = False
+    # parallel sparse commit: width of the live-tip finish path's RLP
+    # encode pool AND the proof-worker pool (trie/sparse.py +
+    # trie/proof.py). 0 = auto (env RETH_TPU_SPARSE_WORKERS or
+    # cpu-derived); 1 = pools off, cross-trie packed dispatch stays on
+    sparse_workers: int = 0
 
 
 def _prune_mode(d: dict) -> PruneMode:
@@ -128,4 +133,5 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.persistence_threshold = node.get("persistence_threshold", cfg.persistence_threshold)
     cfg.hasher = node.get("hasher", cfg.hasher)
     cfg.hash_service = bool(node.get("hash_service", cfg.hash_service))
+    cfg.sparse_workers = int(node.get("sparse_workers", cfg.sparse_workers))
     return cfg

@@ -74,6 +74,7 @@ class EngineTree:
         invalid_block_hooks: list | None = None,
         bal_execution: bool = False,
         state_root_strategy: str = "sparse",
+        sparse_workers: int | None = None,
     ):
         self.factory = factory
         self.committer = committer or TrieCommitter()
@@ -107,6 +108,10 @@ class EngineTree:
         # The sparse path falls back to the incremental committer on any
         # SparseRootError (reference config.rs:140 state_root_fallback).
         self.state_root_strategy = state_root_strategy
+        # --sparse-workers: width of the sparse finish path's encode pool
+        # AND the proof-worker pool (None = env/auto; 1 = pools off, the
+        # cross-trie packed dispatch stays on)
+        self.sparse_workers = sparse_workers
         from ..trie.sparse import PreservedSparseTrie
 
         self.preserved_trie = PreservedSparseTrie()
@@ -470,12 +475,19 @@ class EngineTree:
         if parent_layers is None:
             return None
         try:
-            parent_provider = DatabaseProvider(
-                OverlayTx(self.factory.db.tx(), parent_layers))
+            def parent_view() -> DatabaseProvider:
+                # each proof worker gets its OWN transaction over the same
+                # frozen parent layers: cursor state is per-tx, the layer
+                # dicts are immutable once the parent validated
+                return DatabaseProvider(
+                    OverlayTx(self.factory.db.tx(), parent_layers))
+
+            parent_provider = parent_view()
             parent = self._header_of(block.header.parent_hash, parent_provider)
             return SparseRootTask(
                 parent_provider, parent.state_root, self.preserved_trie,
-                self.committer, parent_hash=block.header.parent_hash)
+                self.committer, parent_hash=block.header.parent_hash,
+                provider_factory=parent_view, workers=self.sparse_workers)
         except Exception:  # noqa: BLE001 — strategy startup must never
             # fail the payload; the pipelined+incremental path covers it
             return None
@@ -510,6 +522,13 @@ class EngineTree:
             REGISTRY.histogram("sparse_root_proof_seconds").record(m["proof"])
             REGISTRY.histogram("sparse_root_reveal_seconds").record(m["reveal"])
             REGISTRY.histogram("sparse_root_finish_seconds").record(m["finish"])
+            from ..metrics import sparse_commit_metrics
+
+            cs = m.get("commit")
+            if cs:
+                sparse_commit_metrics.record_block(
+                    dispatches=cs.get("dispatches", 0),
+                    finish_s=m["finish"])
         except Exception:  # noqa: BLE001 — metrics must never fail consensus
             pass
         self._write_sparse_output(overlay, out, digest_map, storage_roots,

@@ -103,12 +103,18 @@ def generate_witness(parent_provider, block: Block, committer,
                      senders: list[bytes] | None = None,
                      parent_header: Header | None = None,
                      config=None,
-                     block_hashes: dict[int, bytes] | None = None) -> ExecutionWitness:
+                     block_hashes: dict[int, bytes] | None = None,
+                     provider_factory=None,
+                     proof_workers: int | None = None) -> ExecutionWitness:
     """Execute ``block`` against the parent view, recording reads, and
     assemble a closed witness. ``parent_provider`` must present the state
     AS OF the parent block (trie tables + hashed/plain state);
     ``block_hashes`` supplies the BLOCKHASH window when the parent view
-    (e.g. a historical provider) cannot."""
+    (e.g. a historical provider) cannot. With ``provider_factory`` (a
+    zero-arg callable yielding fresh parent views) the touched-key
+    multiproof shards by storage trie across the proof-worker pool
+    (``trie/proof.py`` ProofWorkerPool) instead of serializing per trie
+    — big witnesses stop being a single-threaded walk."""
     src = RecordingStateSource(parent_provider)
     executor = BlockExecutor(src, config)
     if senders is None:
@@ -152,7 +158,18 @@ def generate_witness(parent_provider, block: Block, committer,
     if hasattr(committer, "for_lane"):
         committer = committer.for_lane("proof")
     calc = ProofCalculator(parent_provider, committer)
-    proofs = calc.multiproof(targets)
+    if provider_factory is not None:
+        from ..trie.proof import ProofWorkerPool
+
+        pool = ProofWorkerPool(
+            lambda: ProofCalculator(provider_factory(), committer),
+            workers=proof_workers)
+        try:
+            proofs = pool.multiproof(targets)
+        finally:
+            pool.shutdown()
+    else:
+        proofs = calc.multiproof(targets)
     nodes: dict[bytes, bytes] = {}
     for ap in proofs.values():
         for n in ap.proof:

@@ -232,6 +232,72 @@ class PipelineMetrics:
 pipeline_metrics = PipelineMetrics()
 
 
+class SparseCommitMetrics:
+    """Parallel sparse-commit observability (trie/sparse.py
+    ParallelSparseCommitter + the proof-worker pool): packed levels and
+    fused dispatches per block, encode-pool occupancy, proof-worker
+    depth, and the live-tip finish wall — what an operator needs to see
+    that the storage-heavy commit actually packed across tries instead
+    of degrading to per-trie per-depth calls."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._commits = reg.counter(
+            "sparse_commit_commits_total", "parallel packed commits run")
+        self._levels = reg.counter(
+            "sparse_commit_levels_packed_total",
+            "global depth levels packed across tries")
+        self._dispatches = reg.counter(
+            "sparse_commit_dispatches_total",
+            "fused hash dispatches issued (one per packed depth)")
+        self._hashed = reg.counter(
+            "sparse_commit_hashed_nodes_total")
+        self._chunks = reg.counter(
+            "sparse_commit_encode_chunks_total",
+            "lower-subtrie RLP encode chunks fanned across the pool")
+        self._streamed = reg.counter(
+            "sparse_commit_streamed_chunks_total",
+            "encode chunks streamed to the hash service's live lane")
+        self._encode_busy = reg.gauge(
+            "sparse_commit_encode_pool_busy",
+            "encode chunks currently in flight on the pool")
+        self._proof_depth = reg.gauge(
+            "sparse_commit_proof_worker_depth",
+            "sharded multiproof fetches currently outstanding")
+        self._disp_per_block = reg.histogram(
+            "sparse_commit_dispatches_per_block",
+            buckets=(2, 4, 6, 8, 12, 16, 24, 32))
+        self._finish = reg.histogram(
+            "sparse_commit_finish_seconds",
+            "live-tip sparse finish() wall clock",
+            buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 5))
+        self.last: dict | None = None  # most recent commit, for events/bench
+
+    def record_commit(self, stats: dict) -> None:
+        self._commits.increment()
+        self._levels.increment(stats.get("levels", 0))
+        self._dispatches.increment(stats.get("dispatches", 0))
+        self._hashed.increment(stats.get("hashed", 0))
+        self._chunks.increment(stats.get("encode_chunks", 0))
+        self._streamed.increment(stats.get("streamed", 0))
+        self.last = dict(stats)
+
+    def record_block(self, dispatches: int, finish_s: float) -> None:
+        self._disp_per_block.record(dispatches)
+        self._finish.record(finish_s)
+        if self.last is not None:
+            self.last["finish_s"] = round(finish_s, 4)
+
+    def set_encode_busy(self, n: int) -> None:
+        self._encode_busy.set(n)
+
+    def set_proof_depth(self, n: int) -> None:
+        self._proof_depth.set(n)
+
+
+sparse_commit_metrics = SparseCommitMetrics()
+
+
 class HashServiceMetrics:
     """Shared hash service observability (ops/hash_service.py): per-lane
     queue depth and request counts, coalesce factor (requests fused per

@@ -117,6 +117,22 @@ class NodeEventReporter:
                      f" disp={pm['dispatch_s']}s fetch={pm['fetch_s']}s]")
             if pm["drained_windows"]:
                 line += f" drained={pm['drained_windows']}"
+        # parallel sparse commit: the live-tip finish path's one-line
+        # health — how many depth levels packed across tries, fused
+        # dispatches per block, encode-chunk fan-out, and the finish wall
+        from ..metrics import sparse_commit_metrics
+
+        sc = sparse_commit_metrics.last
+        if sc is not None:
+            line += (f" sparse[tries={sc.get('tries', 0)}"
+                     f" lv={sc.get('levels', 0)}"
+                     f" disp={sc.get('dispatches', 0)}"
+                     f" enc={sc.get('encode_chunks', 0)}")
+            if sc.get("streamed"):
+                line += f" strm={sc['streamed']}"
+            if "finish_s" in sc:
+                line += f" fin={sc['finish_s']}s"
+            line += "]"
         log.info(line)
         return line
 

@@ -62,6 +62,9 @@ class NodeConfig:
     node_key: int | None = None       # secp256k1 priv; random when unset
     bootnodes: tuple[str, ...] = ()   # enode:// urls
     bootnodes_v5: tuple[str, ...] = ()  # enr:... text records (discv5/DNS)
+    # --sparse-workers / [node] sparse_workers: parallel sparse-commit
+    # pool width (None = env RETH_TPU_SPARSE_WORKERS or cpu-derived)
+    sparse_workers: int | None = None
 
 
 class Node:
@@ -146,6 +149,7 @@ class Node:
             self.factory, self.committer, self.consensus,
             EvmConfig(chain_id=config.chain_id, chainspec=exec_spec),
             persistence_threshold=config.persistence_threshold,
+            sparse_workers=config.sparse_workers,
         )
         from ..pool.pool import PoolConfig
 

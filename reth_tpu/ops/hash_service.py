@@ -58,6 +58,11 @@ Wiring: ``--hash-service`` (cli.py) hangs a service off the committer;
 ("live"), the payload builder ("payload"), the hashing/Merkle stages
 ("rebuild"), and ``ProofCalculator`` ("proof"); ``TurboCommitter``
 ("auto"/"device") takes the exclusive lease around each rebuild commit.
+The parallel sparse commit (``trie/sparse.py``) STREAMS its encode-pool
+chunks onto the live lane (``HashClient.submit`` / ``map_chunks``): each
+per-depth level arrives as many small requests that the coalescing
+window fuses back into one device dispatch while the host keeps
+encoding the rest of the level.
 """
 
 from __future__ import annotations
@@ -201,6 +206,18 @@ class HashClient:
 
     def submit(self, msgs: list[bytes]) -> HashFuture:
         return self.service.submit(self.lane, list(msgs))
+
+    def map_chunks(self, chunks) -> list[bytes]:
+        """Live-lane streaming: submit every chunk as its own request —
+        a producer (e.g. the parallel sparse commit's encode pool) keeps
+        encoding while earlier chunks already sit in the dispatcher,
+        whose continuous batching fuses them back into ONE full-rate
+        dispatch — then gather digests in submission order."""
+        futs = [self.submit(list(c)) for c in chunks]
+        out: list[bytes] = []
+        for f in futs:
+            out.extend(f.result())
+        return out
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"HashClient(lane={self.lane!r})"

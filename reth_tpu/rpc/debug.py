@@ -365,6 +365,10 @@ class DebugApi:
             parent_state, block, self.eth.tree.committer, senders,
             parent_header, self.eth.tree.config,
             block_hashes=hashes,
+            # large witnesses shard their multiproof across the
+            # proof-worker pool; each worker opens its own overlay view
+            provider_factory=lambda: self.eth.tree.overlay_provider(
+                parent_header.hash),
         )
         return w.to_json()
 

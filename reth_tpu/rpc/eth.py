@@ -179,14 +179,29 @@ class EthApi:
 
     def eth_getProof(self, address, slots, tag="latest"):
         from ..storage.historical import HistoricalStateProvider
-        from ..trie.proof import ProofCalculator
+        from ..trie.proof import ProofCalculator, ProofWorkerPool
 
         p = self._state_at(tag)
         if isinstance(p, HistoricalStateProvider):
             raise RpcError(-32000, "proofs are served for the latest block only")
         addr = parse_data(address)
         keys = [parse_qty(s).to_bytes(32, "big") for s in slots]
-        proof = ProofCalculator(p, self.tree.committer).account_proof(addr, keys)
+        if len(keys) > ProofWorkerPool.SLOT_SPLIT_MIN:
+            # big slot lists shard across the proof-worker pool (each
+            # worker walks its slot chunk on its own state view, pinned
+            # to the head resolved NOW so an advancing tip cannot mix
+            # states) instead of one serial plan_subtrie pass
+            head = self.tree.head_hash
+            pool = ProofWorkerPool(
+                lambda: ProofCalculator(self.tree.overlay_provider(head),
+                                        self.tree.committer))
+            try:
+                proof = pool.multiproof({addr: keys})[addr]
+            finally:
+                pool.shutdown()
+        else:
+            proof = ProofCalculator(p, self.tree.committer).account_proof(
+                addr, keys)
         acc = proof.account
         return {
             "address": address,

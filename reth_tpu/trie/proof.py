@@ -14,6 +14,9 @@ targets in one commit, storage tries batched alongside.
 
 from __future__ import annotations
 
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from ..primitives.keccak import keccak256
@@ -143,6 +146,143 @@ class ProofCalculator:
         if entry is not None and entry[1][:32] == hashed_slot:
             return T.decode_storage_entry(entry[1])[1]
         return 0
+
+
+class ProofWorkerPool:
+    """Sharded multiproof fetch — reth's ``proof_task.rs`` worker-pool
+    analogue over the batched-committer proof path.
+
+    A multiproof over many accounts serializes on ONE ``plan_subtrie``
+    walk per storage trie plus the account-trie walk; each storage
+    trie's walk is independent, so the pool shards ``targets`` by
+    storage trie (and splits very large single-trie slot lists) across
+    ``workers`` threads. Every worker thread builds its OWN
+    ``ProofCalculator`` via ``calc_factory`` — cursor state lives on the
+    provider's transaction, so workers never share one.
+
+    Used by the live-tip ``SparseRootTask`` (async ``submit``, reveals
+    overlap execution and other fetches), witness generation, and large
+    ``eth_getProof`` requests (sync :meth:`multiproof`).
+    """
+
+    SLOT_SPLIT_MIN = 64  # single-account slot lists split above this
+
+    def __init__(self, calc_factory, workers: int | None = None,
+                 injector=None):
+        from .sparse import SparseFaultInjector, sparse_worker_count
+
+        self.calc_factory = calc_factory
+        self.workers = sparse_worker_count(workers)
+        self.injector = (injector if injector is not None
+                         else SparseFaultInjector.from_env())
+        self._local = threading.local()
+        self._pool: ThreadPoolExecutor | None = None
+        self._pool_lock = threading.Lock()
+        self._depth = 0  # outstanding shard fetches (metrics gauge)
+        self.fetches = 0
+        self.shards_total = 0
+
+    def _executor(self) -> ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.workers,
+                    thread_name_prefix="proof-worker")
+            return self._pool
+
+    def shutdown(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def _calc(self) -> ProofCalculator:
+        calc = getattr(self._local, "calc", None)
+        if calc is None:
+            calc = self._local.calc = self.calc_factory()
+        return calc
+
+    # -- sharding -------------------------------------------------------------
+
+    def _shards(self, targets: dict) -> list[dict]:
+        """Split ``targets`` by storage trie: one (account, slot-chunk)
+        unit per trie, big slot lists chopped first, then LPT-balanced
+        into at most ``workers`` shards by walk cost (1 + slots)."""
+        units: list[tuple[bytes, list]] = []
+        for a, slots in targets.items():
+            slots = list(slots)
+            if len(slots) > self.SLOT_SPLIT_MIN:
+                step = -(-len(slots) // self.workers)
+                step = max(step, self.SLOT_SPLIT_MIN)
+                for off in range(0, len(slots), step):
+                    units.append((a, slots[off:off + step]))
+            else:
+                units.append((a, slots))
+        n_shards = min(self.workers, len(units))
+        if n_shards <= 1:
+            return [dict(targets)] if targets else []
+        bins: list[tuple[int, dict]] = [(0, {}) for _ in range(n_shards)]
+        for a, slots in sorted(units, key=lambda u: -len(u[1])):
+            idx = min(range(n_shards), key=lambda i: bins[i][0])
+            cost, shard = bins[idx]
+            if a in shard:
+                shard[a] = shard[a] + slots
+            else:
+                shard[a] = slots
+            bins[idx] = (cost + 1 + len(slots), shard)
+        return [shard for _, shard in bins if shard]
+
+    def _run_shard(self, shard: dict):
+        from ..metrics import sparse_commit_metrics
+
+        t0 = time.monotonic()
+        try:
+            if self.injector is not None:
+                self.injector.on_proof_fetch()
+            proofs = self._calc().multiproof(shard)
+        finally:
+            with self._pool_lock:
+                self._depth -= 1
+            sparse_commit_metrics.set_proof_depth(self._depth)
+        return proofs, time.monotonic() - t0
+
+    # -- API ------------------------------------------------------------------
+
+    def submit(self, targets: dict) -> list:
+        """Async sharded fetch: returns ``[(future, shard_targets)]``;
+        each future resolves to ``(proofs_dict, wall_s)``."""
+        from ..metrics import sparse_commit_metrics
+
+        shards = self._shards(targets)
+        self.fetches += 1
+        self.shards_total += len(shards)
+        with self._pool_lock:
+            self._depth += len(shards)
+        sparse_commit_metrics.set_proof_depth(self._depth)
+        pool = self._executor()
+        return [(pool.submit(self._run_shard, shard), shard)
+                for shard in shards]
+
+    def multiproof(self, targets: dict) -> dict[bytes, AccountProof]:
+        """Synchronous sharded multiproof, merged back into one
+        per-account result (storage proofs re-ordered to the request's
+        slot order when a big account was split across shards)."""
+        out: dict[bytes, AccountProof] = {}
+        for fut, _shard in self.submit(targets):
+            proofs, _wall = fut.result()
+            for a, ap in proofs.items():
+                have = out.get(a)
+                if have is None:
+                    out[a] = ap
+                else:
+                    have.storage_proofs.extend(ap.storage_proofs)
+        for a, slots in targets.items():
+            ap = out.get(a)
+            if ap is not None and len(ap.storage_proofs) > 1:
+                order = {s: i for i, s in enumerate(slots)}
+                ap.storage_proofs.sort(
+                    key=lambda sp: order.get(sp.key, len(order)))
+        return out
 
 
 def _spine_nodes(proof_nodes: dict[Nibbles, bytes], target: Nibbles) -> list[bytes]:

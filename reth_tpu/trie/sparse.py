@@ -26,6 +26,10 @@ reveal-on-demand loop.
 
 from __future__ import annotations
 
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from ..primitives.keccak import keccak256, keccak256_batch_np
@@ -364,20 +368,10 @@ class SparseTrie:
         return self.root_hash
 
     def _encode(self, node) -> bytes:
-        if isinstance(node, _Leaf):
-            return leaf_node_rlp(node.path, node.value)
-        if isinstance(node, _Ext):
-            return extension_node_rlp(node.path, self._child_ref(node.child))
-        assert isinstance(node, _Branch)
-        refs = [self._child_ref(c) if c is not None else EMPTY_STRING_RLP
-                for c in node.children]
-        return branch_node_rlp(refs, node.value)
+        return _encode_rlp(node)
 
     def _child_ref(self, child) -> bytes:
-        if isinstance(child, _Blind):
-            return encode_hash_ref(child.hash)
-        assert child._ref is not None, "child not hashed (collect order bug)"
-        return child._ref
+        return _child_ref_of(child)
 
     def spine(self, key: bytes) -> list[bytes]:
         """The RLP nodes along ``key``'s path (a single-key proof). Valid
@@ -420,6 +414,336 @@ class SparseTrie:
 
 
 _common_len = common_prefix_len
+
+
+def _encode_rlp(node) -> bytes:
+    """RLP-encode one node from its children's (clean) refs. Module-level
+    so the parallel commit's encode pool can fan it out without touching
+    any trie instance state."""
+    if isinstance(node, _Leaf):
+        return leaf_node_rlp(node.path, node.value)
+    if isinstance(node, _Ext):
+        return extension_node_rlp(node.path, _child_ref_of(node.child))
+    assert isinstance(node, _Branch)
+    refs = [_child_ref_of(c) if c is not None else EMPTY_STRING_RLP
+            for c in node.children]
+    return branch_node_rlp(refs, node.value)
+
+
+def _child_ref_of(child) -> bytes:
+    if isinstance(child, _Blind):
+        return encode_hash_ref(child.hash)
+    assert child._ref is not None, "child not hashed (collect order bug)"
+    return child._ref
+
+
+# -- parallel cross-trie commit ----------------------------------------------
+
+
+class InjectedSparseAbort(RuntimeError):
+    """Fault injection killed a parallel sparse commit at a dispatch
+    boundary (RETH_TPU_FAULT_SPARSE_ABORT) — drills the engine's
+    ``state_root_fallback`` path without hardware."""
+
+
+class SparseFaultInjector:
+    """Fault policies for the parallel sparse-commit path, in the style of
+    ``ops/supervisor.py``'s FaultInjector / the service injector.
+
+    ``abort_at``: the Nth packed hash dispatch of the process raises
+    :class:`InjectedSparseAbort` (one-shot) — a mid-commit abort; the
+    engine must fall back to the incremental committer.
+    ``proof_wedge_every``: every Nth sharded proof fetch raises — drills
+    the proof-worker failure path (worker error -> SparseRootError ->
+    fallback).
+
+    Env form (:meth:`from_env`): ``RETH_TPU_FAULT_SPARSE_ABORT`` /
+    ``RETH_TPU_FAULT_SPARSE_PROOF_WEDGE``.
+    """
+
+    def __init__(self, abort_at: int = 0, proof_wedge_every: int = 0):
+        self.abort_at = abort_at
+        self.proof_wedge_every = proof_wedge_every
+        self.dispatches = 0
+        self.proof_fetches = 0
+        self.aborts = 0
+        self.wedges = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, env=None) -> "SparseFaultInjector | None":
+        env = os.environ if env is None else env
+        abort_at = int(env.get("RETH_TPU_FAULT_SPARSE_ABORT", "0") or 0)
+        wedge = int(env.get("RETH_TPU_FAULT_SPARSE_PROOF_WEDGE", "0") or 0)
+        if not (abort_at or wedge):
+            return None
+        return cls(abort_at=abort_at, proof_wedge_every=wedge)
+
+    def on_dispatch(self) -> None:
+        with self._lock:
+            self.dispatches += 1
+            n = self.dispatches
+        if self.abort_at and n == self.abort_at:
+            with self._lock:
+                self.aborts += 1
+            raise InjectedSparseAbort(
+                f"injected sparse-commit abort on dispatch #{n} "
+                f"(RETH_TPU_FAULT_SPARSE_ABORT={self.abort_at})")
+
+    def on_proof_fetch(self) -> None:
+        with self._lock:
+            self.proof_fetches += 1
+            n = self.proof_fetches
+        if self.proof_wedge_every and n % self.proof_wedge_every == 0:
+            with self._lock:
+                self.wedges += 1
+            raise RuntimeError(
+                f"injected sparse proof wedge on fetch #{n} "
+                f"(RETH_TPU_FAULT_SPARSE_PROOF_WEDGE="
+                f"{self.proof_wedge_every})")
+
+
+def sparse_worker_count(workers: int | None = None) -> int:
+    """Resolve the shared ``--sparse-workers`` knob: explicit value >
+    ``RETH_TPU_SPARSE_WORKERS`` > cpu-derived default. 1 disables the
+    pools (packed dispatch stays on)."""
+    if workers is None or workers <= 0:
+        workers = int(os.environ.get("RETH_TPU_SPARSE_WORKERS", "0") or 0)
+    if workers <= 0:
+        workers = max(2, min(4, os.cpu_count() or 1))
+    return max(1, workers)
+
+
+class ParallelSparseCommitter:
+    """Parallel commit of MANY dirty sparse tries — the live-tip finish
+    path's analogue of ``turbo._pack_window``.
+
+    Two axes of parallelism over the serial per-trie
+    ``root_hash_compute`` loop:
+
+    (a) **Cross-trie level packing**: dirty nodes from EVERY trie (all
+        dirty storage tries + the account trie) are collected into one
+        global per-depth schedule and each depth issues ONE fused hasher
+        dispatch (deepest first — a parent always sits at a strictly
+        smaller depth, and across tries there is no ordering constraint,
+        exactly the ``_pack_window`` slot-rebasing argument). A
+        storage-heavy block's hundreds of tiny per-trie per-depth calls
+        become ~max_depth full-rate dispatches.
+    (b) **Upper/lower subtrie split with a host encode pool**: each trie
+        partitions at ``split_depth`` (reth's ``ParallelSparseTrie``
+        shape). RLP encoding for nodes inside independent lower subtries
+        fans out across a shared thread pool (chunks never split a
+        subtrie), while the short upper spine encodes serially on the
+        caller thread — host pointer-chasing stops serializing behind
+        the hash dispatch.
+
+    With a lane-bound ``HashClient`` hasher (--hash-service), encoded
+    chunks STREAM into the service as they finish (``submit`` futures on
+    the live lane); the service's continuous batching coalesces them
+    back into full-rate device dispatches, overlapping host encode with
+    device hashing inside one level.
+
+    Roots are bit-identical to the serial path by construction: the
+    structure walk, inline (<32 B) rule, and ref encoding are shared
+    with ``root_hash_compute``; only batching geometry changes.
+    Thread-safe: per-commit state is local; the executor is shared.
+    """
+
+    POOL_MIN_NODES = 128   # below this a level encodes serially
+    MIN_CHUNK = 32
+
+    def __init__(self, workers: int | None = None, split_depth: int | None = None,
+                 injector: SparseFaultInjector | None = None):
+        env = os.environ
+        self.workers = sparse_worker_count(workers)
+        self.split_depth = int(
+            split_depth if split_depth is not None
+            else env.get("RETH_TPU_SPARSE_SPLIT_DEPTH", "2"))
+        self.injector = (injector if injector is not None
+                         else SparseFaultInjector.from_env())
+        self._pool: ThreadPoolExecutor | None = None
+        self._pool_lock = threading.Lock()
+        self.last: dict | None = None  # most recent commit's stats
+
+    def _executor(self) -> ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.workers,
+                    thread_name_prefix="sparse-encode")
+            return self._pool
+
+    def shutdown(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    # -- collection -----------------------------------------------------------
+
+    def _collect(self, tries):
+        """Global per-depth schedule: ``levels[depth] = [(group, node)]``
+        across all tries. ``group`` identifies the lower subtrie a node
+        belongs to (nodes above ``split_depth`` get the trie's own upper
+        group) so encode chunks never split a subtrie."""
+        levels: dict[int, list] = {}
+        split = self.split_depth
+        group_counter = [0]
+
+        def collect(node, depth, group):
+            if node is None or isinstance(node, _Blind):
+                return
+            if node._ref is not None:
+                return  # clean subtree: ref cached (cross-block reuse)
+            levels.setdefault(depth, []).append((group, node))
+            nxt = depth + 1
+            if isinstance(node, _Ext):
+                cg = group
+                if nxt == split:
+                    group_counter[0] += 1
+                    cg = group_counter[0]
+                collect(node.child, nxt, cg)
+            elif isinstance(node, _Branch):
+                for c in node.children:
+                    if c is None:
+                        continue
+                    cg = group
+                    if nxt == split:
+                        group_counter[0] += 1
+                        cg = group_counter[0]
+                    collect(c, nxt, cg)
+
+        for t in tries:
+            group_counter[0] += 1
+            collect(t.root, 0, group_counter[0])
+        return levels
+
+    def _chunk(self, entries):
+        """Group-aligned contiguous chunks sized for the pool width."""
+        target = max(self.MIN_CHUNK, len(entries) // (self.workers * 2) or 1)
+        chunks: list[list] = []
+        cur: list = []
+        cur_group = None
+        for group, node in entries:
+            if cur and len(cur) >= target and group != cur_group:
+                chunks.append(cur)
+                cur = []
+            cur.append(node)
+            cur_group = group
+        if cur:
+            chunks.append(cur)
+        return chunks
+
+    # -- commit ---------------------------------------------------------------
+
+    def commit(self, tries: list["SparseTrie"], hasher=keccak256_batch_np) -> list[bytes]:
+        """Hash every dirty subtree of ``tries`` and return their roots
+        (in input order), bit-identical to calling ``root_hash_compute``
+        on each. One fused hasher dispatch per global depth."""
+        from ..metrics import sparse_commit_metrics
+
+        t_wall = time.perf_counter()
+        roots: list[bytes | None] = [None] * len(tries)
+        live: list[tuple[int, "SparseTrie"]] = []
+        for i, t in enumerate(tries):
+            if t.root is None:
+                t.root_hash = EMPTY_ROOT_HASH
+                t.updates = 0
+                roots[i] = t.root_hash
+            elif isinstance(t.root, _Blind):
+                t.root_hash = t.root.hash
+                roots[i] = t.root_hash
+            else:
+                live.append((i, t))
+        stats = {"tries": len(live), "levels": 0, "dispatches": 0,
+                 "hashed": 0, "encode_chunks": 0, "pooled_levels": 0,
+                 "streamed": 0}
+        if not live:
+            self.last = {**stats, "wall_s": 0.0}
+            return roots
+
+        levels = self._collect([t for _, t in live])
+        use_streaming = hasattr(hasher, "submit")
+        for depth in sorted(levels, reverse=True):
+            entries = levels[depth]
+            stats["levels"] += 1
+            use_pool = (self.workers > 1
+                        and len(entries) >= self.POOL_MIN_NODES)
+            if self.injector is not None:
+                self.injector.on_dispatch()
+            if not use_pool:
+                rlps = [_encode_rlp(node) for _, node in entries]
+                nodes = [node for _, node in entries]
+                self._apply_level(nodes, rlps, hasher, stats)
+                continue
+            stats["pooled_levels"] += 1
+            chunks = self._chunk(entries)
+            stats["encode_chunks"] += len(chunks)
+            pool = self._executor()
+            sparse_commit_metrics.set_encode_busy(len(chunks))
+            futs = [pool.submit(lambda c=c: [_encode_rlp(n) for n in c])
+                    for c in chunks]
+            try:
+                if use_streaming:
+                    # live-lane streaming: each encoded chunk's >=32 B rows
+                    # go straight to the hash service as their own request;
+                    # continuous batching fuses them back into one
+                    # full-rate dispatch while later chunks still encode
+                    pending = []
+                    for chunk, f in zip(chunks, futs):
+                        rlps = f.result()
+                        to_hash = [(n, r) for n, r in zip(chunk, rlps)
+                                   if len(r) >= 32]
+                        for n, r in zip(chunk, rlps):
+                            if len(r) < 32:
+                                n._ref = r
+                        if to_hash:
+                            stats["streamed"] += 1
+                            pending.append(
+                                (to_hash,
+                                 hasher.submit([r for _, r in to_hash])))
+                    for to_hash, fut in pending:
+                        for (n, _r), d in zip(to_hash, fut.result()):
+                            n._ref = encode_hash_ref(bytes(d))
+                            stats["hashed"] += 1
+                    stats["dispatches"] += 1 if pending else 0
+                else:
+                    nodes, rlps = [], []
+                    for chunk, f in zip(chunks, futs):
+                        nodes.extend(chunk)
+                        rlps.extend(f.result())
+                    self._apply_level(nodes, rlps, hasher, stats)
+            finally:
+                sparse_commit_metrics.set_encode_busy(0)
+
+        # per-trie top: the root hash is keccak of the root RLP whatever
+        # its size — batch every live trie's top in one dispatch
+        if self.injector is not None:
+            self.injector.on_dispatch()
+        tops = [_encode_rlp(t.root) for _, t in live]
+        stats["dispatches"] += 1
+        digests = hasher(tops)
+        for (i, t), d in zip(live, digests):
+            t.root_hash = bytes(d)
+            t.updates = 0
+            roots[i] = t.root_hash
+        stats["wall_s"] = round(time.perf_counter() - t_wall, 6)
+        self.last = stats
+        sparse_commit_metrics.record_commit(stats)
+        return roots
+
+    @staticmethod
+    def _apply_level(nodes, rlps, hasher, stats) -> None:
+        to_hash = [(n, r) for n, r in zip(nodes, rlps) if len(r) >= 32]
+        for n, r in zip(nodes, rlps):
+            if len(r) < 32:
+                n._ref = r  # inline ref
+        if to_hash:
+            stats["dispatches"] += 1
+            digests = hasher([r for _, r in to_hash])
+            for (n, _r), d in zip(to_hash, digests):
+                n._ref = encode_hash_ref(bytes(d))
+                stats["hashed"] += 1
 
 
 # -- state-level composition --------------------------------------------------
@@ -466,17 +790,28 @@ class SparseStateTrie:
         self.account_trie.delete(hashed_addr)
         self.storage_tries.pop(hashed_addr, None)
 
-    def root(self, hasher=keccak256_batch_np) -> bytes:
-        """State root: storage tries hash level-batched ACROSS tries first
-        (one call per depth over every dirty storage trie — the committer's
-        commit_many batching), then the account trie."""
-        # batch across storage tries by depth
-        dirty = [t for t in self.storage_tries.values()
-                 if t.updates or (t.root is not None
-                                  and not isinstance(t.root, _Blind)
-                                  and t.root._ref is None)]
-        # simple composition: each trie's own level batching (tries are
-        # independent; a cross-trie scheduler can merge the per-depth calls)
+    def dirty_storage_tries(self) -> list[SparseTrie]:
+        return [t for t in self.storage_tries.values()
+                if t.updates or (t.root is not None
+                                 and not isinstance(t.root, _Blind)
+                                 and t.root._ref is None)]
+
+    def root(self, hasher=keccak256_batch_np,
+             committer: "ParallelSparseCommitter | None" = None) -> bytes:
+        """State root over every dirty storage trie + the account trie.
+
+        With a :class:`ParallelSparseCommitter` the dirty storage tries
+        AND the account trie share ONE global per-depth schedule (one
+        fused dispatch per depth across all of them — the account trie's
+        leaf values already embed their storage roots, so there is no
+        ordering constraint between the tries). Without one, each trie
+        runs its own level batching (the serial baseline the bench and
+        differential tests compare against)."""
+        dirty = self.dirty_storage_tries()
+        if committer is not None:
+            roots = committer.commit(dirty + [self.account_trie], hasher)
+            return roots[-1]
+        # serial composition: each trie's own level batching
         for t in dirty:
             t.root_hash_compute(hasher)
         return self.account_trie.root_hash_compute(hasher)

@@ -1,0 +1,418 @@
+"""Parallel sparse commit (trie/sparse.py ParallelSparseCommitter +
+trie/proof.py ProofWorkerPool): randomized differential parity against
+the serial root_hash_compute path (bit-identical roots across interleaved
+updates/deletes/wipes, blinded-node and preserved-trie edges), encode/
+proof pool sweeps, a threaded stress drill over a shared committer, and
+the RETH_TPU_FAULT_SPARSE_* abort/wedge drills (engine must fall back to
+the incremental committer — reference state_root_fallback)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256, keccak256_batch_np
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.tables import encode_account
+from reth_tpu.trie import TrieCommitter
+from reth_tpu.trie.incremental import full_state_root
+from reth_tpu.trie.naive import naive_trie_root
+from reth_tpu.trie.proof import ProofCalculator, ProofWorkerPool
+from reth_tpu.trie.sparse import (
+    ParallelSparseCommitter,
+    SparseFaultInjector,
+    SparseStateTrie,
+    SparseTrie,
+)
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def _rand_key(rng):
+    return bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+
+
+def _rand_val(rng, lo=1, hi=40):
+    return bytes(rng.integers(0, 256, int(rng.integers(lo, hi)),
+                              dtype=np.uint8))
+
+
+def _build_twins(seed, n_tries=24, slots=12):
+    """Two identical SparseStateTries (fed the same ops) + a leaf oracle."""
+    rng = np.random.default_rng(seed)
+    twins = (SparseStateTrie(), SparseStateTrie())
+    oracle = {"acct": {}, "storage": {}}
+    owners = []
+    for _ in range(n_tries):
+        ha = _rand_key(rng)
+        owners.append(ha)
+        oracle["storage"][ha] = {}
+        for _ in range(slots):
+            k, v = _rand_key(rng), _rand_val(rng)
+            for st in twins:
+                st.storage_trie(ha).update(k, v)
+            oracle["storage"][ha][k] = v
+        av = b"acct" + ha
+        for st in twins:
+            st.update_account(ha, av)
+        oracle["acct"][ha] = av
+    return twins, oracle, owners, rng
+
+
+def _check_parity(twins, committer, oracle):
+    """Serial twin vs parallel twin: roots bit-identical, storage tries
+    match the naive oracle."""
+    serial, parallel = twins
+    r_ser = serial.root(keccak256_batch_np)
+    r_par = parallel.root(keccak256_batch_np, committer=committer)
+    assert r_ser == r_par
+    assert r_ser == naive_trie_root(oracle["acct"])
+    for ha, leaves in oracle["storage"].items():
+        want = naive_trie_root(leaves)
+        assert serial.storage_tries[ha].root_hash == want
+        assert parallel.storage_tries[ha].root_hash == want
+
+
+def test_randomized_differential_interleaved_churn():
+    """Interleaved updates/deletes/wipes across many storage tries + the
+    account trie: the packed parallel commit stays bit-identical to the
+    serial path round after round (cross-round ref reuse included)."""
+    twins, oracle, owners, rng = _build_twins(7)
+    committer = ParallelSparseCommitter(workers=4)
+    _check_parity(twins, committer, oracle)  # round 0: full build
+    for _round in range(4):
+        for _ in range(40):
+            op = int(rng.integers(0, 4))
+            ha = owners[int(rng.integers(0, len(owners)))]
+            leaves = oracle["storage"][ha]
+            if op == 0:  # update/insert a slot
+                k = (_rand_key(rng) if rng.integers(0, 2) or not leaves
+                     else list(leaves)[int(rng.integers(0, len(leaves)))])
+                v = _rand_val(rng)
+                for st in twins:
+                    st.storage_trie(ha).update(k, v)
+                leaves[k] = v
+            elif op == 1 and leaves:  # delete a slot
+                k = list(leaves)[int(rng.integers(0, len(leaves)))]
+                for st in twins:
+                    st.storage_trie(ha).delete(k)
+                del leaves[k]
+            elif op == 2:  # wipe the trie (SELFDESTRUCT shape)
+                for st in twins:
+                    st.storage_tries[ha] = SparseTrie()
+                leaves.clear()
+            else:  # account-leaf churn
+                v = _rand_val(rng, 4, 60)
+                for st in twins:
+                    st.update_account(ha, v)
+                oracle["acct"][ha] = v
+        _check_parity(twins, committer, oracle)
+
+
+def _db_state(n_accounts=48, seed=11):
+    rng = np.random.default_rng(seed)
+    factory = ProviderFactory(MemDb())
+    addresses = [bytes(rng.integers(0, 256, 20, dtype=np.uint8))
+                 for _ in range(n_accounts)]
+    with factory.provider_rw() as p:
+        for i, a in enumerate(addresses):
+            p.put_hashed_account(keccak256(a),
+                                 Account(nonce=i, balance=1000 + i))
+        root = full_state_root(p, CPU)
+    leaves = {keccak256(a): encode_account(Account(nonce=i, balance=1000 + i))
+              for i, a in enumerate(addresses)}
+    return factory, addresses, root, leaves
+
+
+def test_blinded_partial_reveal_parity():
+    """Anchored tries with most paths BLINDED: only revealed spines are
+    touched; the packed commit must hash the same dirty set and produce
+    the same root as the serial path (and the naive full oracle)."""
+    factory, addrs, root, leaves = _db_state()
+    serial, parallel = SparseTrie(root), SparseTrie(root)
+    touched = addrs[:10]
+    with factory.provider() as p:
+        calc = ProofCalculator(p, CPU)
+        for a in touched:
+            pr = calc.account_proof(a)
+            serial.reveal(pr.proof)
+            parallel.reveal(pr.proof)
+    for i, a in enumerate(touched):
+        new = encode_account(Account(nonce=500 + i, balance=1))
+        serial.update(keccak256(a), new)
+        parallel.update(keccak256(a), new)
+        leaves[keccak256(a)] = new
+    committer = ParallelSparseCommitter(workers=4)
+    r_ser = serial.root_hash_compute(keccak256_batch_np)
+    r_par = committer.commit([parallel], keccak256_batch_np)[0]
+    assert r_ser == r_par == naive_trie_root(leaves)
+
+
+def test_preserved_trie_second_commit_hashes_less():
+    """Cross-block reuse: after a packed commit, touching ONE trie must
+    re-hash only its dirty spine — and stay identical to the serial twin."""
+    twins, oracle, owners, rng = _build_twins(13)
+    committer = ParallelSparseCommitter(workers=4)
+    _check_parity(twins, committer, oracle)
+    calls = []
+
+    def counting(msgs):
+        calls.append(len(msgs))
+        return keccak256_batch_np(msgs)
+
+    ha = owners[0]
+    k, v = _rand_key(rng), b"post-commit"
+    for st in twins:
+        st.storage_trie(ha).update(k, v)
+    oracle["storage"][ha][k] = v
+    serial, parallel = twins
+    r_par = parallel.root(counting, committer=committer)
+    second_total = sum(calls)
+    first_total = sum(len(l) for l in oracle["storage"].values())
+    assert second_total < first_total  # only the dirty spine re-hashed
+    assert r_par == serial.root(keccak256_batch_np)
+
+
+@pytest.mark.parametrize("workers", [1, 2, 4, 8])
+def test_encode_pool_sweep(workers):
+    """Pool-size sweep: every width produces the identical root and
+    records commit stats."""
+    twins, oracle, _owners, _rng = _build_twins(23, n_tries=12, slots=20)
+    committer = ParallelSparseCommitter(workers=workers)
+    _check_parity(twins, committer, oracle)
+    stats = committer.last
+    assert stats["levels"] > 0 and stats["dispatches"] > 0
+    assert stats["hashed"] > 0
+    committer.shutdown()
+
+
+def test_split_depth_sweep():
+    """The upper/lower partition point must not affect the root."""
+    roots = set()
+    for split in (1, 2, 3):
+        twins, oracle, _o, _r = _build_twins(31, n_tries=8, slots=16)
+        committer = ParallelSparseCommitter(workers=4, split_depth=split)
+        serial, parallel = twins
+        r = parallel.root(keccak256_batch_np, committer=committer)
+        assert r == serial.root(keccak256_batch_np)
+        roots.add(r)
+    assert len(roots) == 1
+
+
+def test_live_lane_streaming_through_hash_service():
+    """With a lane-bound HashClient hasher the encode pool STREAMS chunks
+    into the service (submit futures); root stays bit-identical and the
+    service coalesces the streamed requests."""
+    from reth_tpu.metrics import MetricsRegistry
+    from reth_tpu.ops.hash_service import HashService
+
+    twins, oracle, _o, _r = _build_twins(41, n_tries=32, slots=24)
+    svc = HashService(backend=keccak256_batch_np,
+                      registry=MetricsRegistry())
+    try:
+        client = svc.client("live")
+        committer = ParallelSparseCommitter(workers=4)
+        serial, parallel = twins
+        r_par = parallel.root(client, committer=committer)
+        assert r_par == serial.root(keccak256_batch_np)
+        assert committer.last["streamed"] > 0
+        assert svc.dispatches > 0
+        # map_chunks is the same streaming contract, exposed directly
+        msgs = [b"chunk-%d" % i for i in range(8)]
+        got = client.map_chunks([msgs[:3], msgs[3:]])
+        assert got == keccak256_batch_np(msgs)
+    finally:
+        svc.stop()
+
+
+def test_threaded_stress_shared_committer():
+    """Many threads commit DISTINCT trie sets through ONE shared
+    committer (shared encode pool): every thread's roots must match its
+    serial twin — per-commit state is thread-local by construction."""
+    committer = ParallelSparseCommitter(workers=4)
+    errs = []
+
+    def worker(seed):
+        try:
+            for round_seed in range(3):
+                twins, oracle, _o, _r = _build_twins(
+                    seed * 100 + round_seed, n_tries=6, slots=10)
+                _check_parity(twins, committer, oracle)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    committer.shutdown()
+
+
+# -- proof-worker pool --------------------------------------------------------
+
+
+def _storage_db(n_accounts=10, slots_per=20, seed=5):
+    rng = np.random.default_rng(seed)
+    factory = ProviderFactory(MemDb())
+    targets = {}
+    with factory.provider_rw() as p:
+        for i in range(n_accounts):
+            a = bytes(rng.integers(0, 256, 20, dtype=np.uint8))
+            p.put_hashed_account(keccak256(a),
+                                 Account(nonce=i, balance=7 + i))
+            slots = [bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+                     for _ in range(slots_per)]
+            for s in slots:
+                p.put_hashed_storage(keccak256(a), keccak256(s), i + 1)
+            targets[a] = slots
+        full_state_root(p, CPU)
+    return factory, targets
+
+
+def _proof_key(ap):
+    return (ap.proof, ap.storage_root,
+            [(sp.key, sp.value, sp.proof) for sp in ap.storage_proofs])
+
+
+def test_proof_pool_matches_direct_multiproof():
+    """Sharded fetch across workers == one direct multiproof, proof for
+    proof, in request slot order."""
+    factory, targets = _storage_db()
+    with factory.provider() as p:
+        direct = ProofCalculator(p, CPU).multiproof(targets)
+    pool = ProofWorkerPool(
+        lambda: ProofCalculator(factory.provider(), CPU),
+        workers=4)
+    try:
+        sharded = pool.multiproof(targets)
+    finally:
+        pool.shutdown()
+    assert set(direct) == set(sharded)
+    for a in direct:
+        assert _proof_key(direct[a]) == _proof_key(sharded[a])
+    assert pool.shards_total > 1  # it actually sharded
+
+
+def test_proof_pool_splits_large_slot_list_in_order():
+    """A single account with a big slot list splits across shards and
+    merges back in the REQUEST's slot order (eth_getProof contract)."""
+    factory, targets = _storage_db(n_accounts=1, slots_per=150, seed=9)
+    with factory.provider() as p:
+        direct = ProofCalculator(p, CPU).multiproof(targets)
+    pool = ProofWorkerPool(
+        lambda: ProofCalculator(factory.provider(), CPU),
+        workers=4)
+    try:
+        sharded = pool.multiproof(targets)
+    finally:
+        pool.shutdown()
+    (a, slots), = targets.items()
+    assert [sp.key for sp in sharded[a].storage_proofs] == slots
+    assert _proof_key(direct[a]) == _proof_key(sharded[a])
+    assert pool.shards_total > 1
+
+
+# -- fault drills (engine falls back to the incremental committer) -----------
+
+
+def _engine_env():
+    from tests.test_sparse_root_engine import busy_blocks, storage_env
+
+    alice, builder, factory = storage_env()
+    return busy_blocks(alice, builder, n=3), factory
+
+
+def _feed(tree, blocks):
+    from reth_tpu.engine.tree import PayloadStatusKind
+
+    stats = []
+    for blk in blocks:
+        st = tree.on_new_payload(blk)
+        assert st.status is PayloadStatusKind.VALID, st.validation_error
+        stats.append(dict(tree.last_sparse))
+        tree.on_forkchoice_updated(blk.hash)
+    return stats
+
+
+def test_sparse_abort_drill_falls_back(monkeypatch):
+    """RETH_TPU_FAULT_SPARSE_ABORT kills the packed commit at a dispatch
+    boundary mid-finish; every block must still validate via the
+    incremental fallback (state_root_fallback semantics)."""
+    from reth_tpu.engine import EngineTree
+
+    monkeypatch.setenv("RETH_TPU_FAULT_SPARSE_ABORT", "1")
+    blocks, factory = _engine_env()
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=1)
+    stats = _feed(tree, blocks)
+    assert all(s["strategy"] == "fallback" for s in stats), stats
+    assert all("parallel commit failed" in s["error"] for s in stats)
+
+
+def test_proof_wedge_drill_falls_back(monkeypatch):
+    """RETH_TPU_FAULT_SPARSE_PROOF_WEDGE wedges every sharded proof
+    fetch; the worker failure surfaces as SparseRootError at finish and
+    the block validates on the fallback path."""
+    from reth_tpu.engine import EngineTree
+
+    monkeypatch.setenv("RETH_TPU_FAULT_SPARSE_PROOF_WEDGE", "1")
+    blocks, factory = _engine_env()
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=1,
+                      sparse_workers=4)
+    stats = _feed(tree, blocks)
+    # blocks whose proof fetch wedged fall back; ones with nothing to
+    # fetch may still close sparse — either way every block validated
+    assert any(s["strategy"] == "fallback" for s in stats), stats
+
+
+def test_injector_env_parsing(monkeypatch):
+    monkeypatch.delenv("RETH_TPU_FAULT_SPARSE_ABORT", raising=False)
+    monkeypatch.delenv("RETH_TPU_FAULT_SPARSE_PROOF_WEDGE", raising=False)
+    assert SparseFaultInjector.from_env() is None
+    monkeypatch.setenv("RETH_TPU_FAULT_SPARSE_ABORT", "3")
+    inj = SparseFaultInjector.from_env()
+    assert inj.abort_at == 3
+    inj.on_dispatch()
+    inj.on_dispatch()
+    with pytest.raises(Exception):
+        inj.on_dispatch()
+    inj.on_dispatch()  # one-shot: past the boundary it stays quiet
+
+
+def test_sparse_workers_config_and_env(tmp_path, monkeypatch):
+    """[node] sparse_workers TOML + RETH_TPU_SPARSE_WORKERS resolution."""
+    from reth_tpu.config import load_config
+    from reth_tpu.trie.sparse import sparse_worker_count
+
+    f = tmp_path / "reth.toml"
+    f.write_text("[node]\nsparse_workers = 6\n")
+    assert load_config(f).sparse_workers == 6
+    assert load_config(tmp_path / "absent.toml").sparse_workers == 0
+    monkeypatch.setenv("RETH_TPU_SPARSE_WORKERS", "7")
+    assert sparse_worker_count(None) == 7
+    assert sparse_worker_count(3) == 3  # explicit beats env
+    monkeypatch.delenv("RETH_TPU_SPARSE_WORKERS")
+    assert sparse_worker_count(None) >= 1
+
+
+def test_engine_records_parallel_commit_stats():
+    """Sparse blocks carry the packed-commit stats (levels, dispatches)
+    and the proof-pool shard count in last_sparse + /metrics."""
+    from reth_tpu.engine import EngineTree
+    from reth_tpu.metrics import REGISTRY
+
+    blocks, factory = _engine_env()
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=10,
+                      sparse_workers=2)
+    stats = _feed(tree, blocks)
+    assert all(s["strategy"] == "sparse" for s in stats), stats
+    for s in stats:
+        assert s["sparse_workers"] == 2
+        assert s["commit"]["dispatches"] >= 1
+        assert s["commit"]["levels"] >= 1
+    assert any(s["proof_shards"] > 0 for s in stats)
+    rendered = REGISTRY.render()
+    assert "sparse_commit_dispatches_total" in rendered
+    assert "sparse_commit_finish_seconds" in rendered
