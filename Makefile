@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-gateway native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -39,6 +39,14 @@ test-sparse:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_sparse_parallel.py tests/test_sparse.py \
 	  tests/test_sparse_root_engine.py -q -p no:cacheprovider
+
+# RPC serving gateway: threaded coalescing stress (bit-identical to the
+# ungated path), priority/shed behavior under full queues, head-change
+# cache invalidation, RETH_TPU_FAULT_GATEWAY_* drills, and HTTP/WS/IPC
+# one-gateway transport parity — CPU-only
+test-gateway:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_gateway.py -q -p no:cacheprovider
 
 # overlapped rebuild pipeline: parity vs the serial committer, packing,
 # arena residency, abort/failover drills, chunked-resume — fast, CPU-only

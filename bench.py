@@ -279,6 +279,100 @@ def run_service_mode() -> None:
           exit_code=0)
 
 
+def run_gateway_mode() -> None:
+    """RETH_TPU_BENCH_MODE=gateway: coalesced vs naive requests/s under a
+    duplicate-heavy read workload — the RPC serving gateway headline
+    (rpc/gateway.py).
+
+    Workload: T client threads each issuing many ``eth_call``-shaped
+    requests drawn from a SMALL key pool (trackers and wallets hammer the
+    same few reads), against a handler doing real CPU work (a batched
+    keccak over params-derived messages — the CPU-fallback path, so this
+    reports a real number with or without a device). Baseline = the same
+    requests through an ungated RpcServer (every duplicate recomputes
+    under the coarse handler lock); measured = one gateway coalescing
+    in-flight duplicates and serving repeats from the head-scoped
+    response cache. Responses are checked bit-identical to the naive
+    path before the number is emitted. Env: RETH_TPU_BENCH_GW_CLIENTS
+    (default 8), RETH_TPU_BENCH_GW_REQS (requests/client, default 150),
+    RETH_TPU_BENCH_GW_KEYS (distinct request keys, default 8),
+    RETH_TPU_BENCH_GW_WORK (keccak msgs per handler call, default 600)."""
+    from reth_tpu.metrics import MetricsRegistry
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.rpc.gateway import RpcGateway
+    from reth_tpu.rpc.server import RpcServer
+
+    clients = int(os.environ.get("RETH_TPU_BENCH_GW_CLIENTS", "8"))
+    reqs = int(os.environ.get("RETH_TPU_BENCH_GW_REQS", "150"))
+    n_keys = int(os.environ.get("RETH_TPU_BENCH_GW_KEYS", "8"))
+    work = int(os.environ.get("RETH_TPU_BENCH_GW_WORK", "600"))
+    _STATE["metric"] = "gateway_requests_per_sec"
+    _STATE["unit"] = "requests/s"
+    _STATE["backend"] = "cpu"
+
+    def handler(*params):
+        seed = json.dumps(params, sort_keys=True).encode()
+        msgs = [seed + i.to_bytes(4, "big") for i in range(work)]
+        return {"data": "0x" + keccak256_batch_np(msgs)[0].hex()}
+
+    def make_server(gateway):
+        srv = RpcServer(gateway=gateway)
+        srv.register_method("eth_call", handler)
+        return srv
+
+    bodies = [json.dumps({
+        "jsonrpc": "2.0", "id": 7, "method": "eth_call",
+        "params": [{"to": f"0x{k:040x}", "data": "0xdeadbeef"}, "latest"],
+    }).encode() for k in range(n_keys)]
+
+    def run_clients(srv) -> float:
+        errs: list = []
+
+        def worker(c):
+            try:
+                rng = np.random.default_rng(c)
+                for i in range(reqs):
+                    srv.handle(bodies[int(rng.integers(0, n_keys))])
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(c,))
+              for c in range(clients)]
+        t0 = time.time()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        return time.time() - t0
+
+    total = clients * reqs
+    _STATE["phase"] = "naive baseline (ungated dispatch)"
+    naive = make_server(None)
+    naive.handle(bodies[0])  # warm allocations out of the measured window
+    dt_naive = run_clients(naive)
+    _STATE["phase"] = "gateway run (coalesced + cached)"
+    gw = RpcGateway(head_supplier=lambda: b"bench-head",
+                    registry=MetricsRegistry())
+    gated = make_server(gw)
+    dt_gated = run_clients(gated)
+    _STATE["phase"] = "response parity check"
+    for body in bodies:
+        if gated.handle(body) != naive.handle(body):
+            _emit(0, 0, error="gated/naive response mismatch", exit_code=1)
+    snap = gw.snapshot()
+    _STATE["device_result"] = round(total / dt_gated, 1)
+    _emit(round(total / dt_gated, 1), round(dt_naive / dt_gated, 3),
+          coalesce_factor=snap["coalesce_factor"],
+          cache_hit_rate=snap["cache_hit_rate"],
+          executions=snap["executions"], requests=total,
+          distinct_keys=n_keys, work_msgs_per_call=work,
+          naive_wall_s=round(dt_naive, 3), gateway_wall_s=round(dt_gated, 3),
+          naive_requests_per_sec=round(total / dt_naive, 1),
+          exit_code=0)
+
+
 def build_sparse_state(n_tries: int, slots: int, dirty: int, seed: int = 3):
     """One storage-heavy live-tip block in miniature: a SparseStateTrie
     with ``n_tries`` fully-revealed storage tries x ``slots`` slots plus
@@ -383,6 +477,9 @@ def main():
         return
     if os.environ.get("RETH_TPU_BENCH_MODE") == "sparse":
         run_sparse_mode()
+        return
+    if os.environ.get("RETH_TPU_BENCH_MODE") == "gateway":
+        run_gateway_mode()
         return
     n_accounts = int(os.environ.get("RETH_TPU_BENCH_ACCOUNTS", "150000"))
     n_slots = int(os.environ.get("RETH_TPU_BENCH_SLOTS", "60000"))

@@ -324,6 +324,7 @@ def cmd_node(args):
                      db_backend=backend,
                      storage_v2=getattr(args, "storage_v2", None),
                      sparse_workers=getattr(args, "sparse_workers", None),
+                     rpc_gateway=getattr(args, "rpc_gateway", False),
                      **kw)
     node = Node(cfg, committer=committer)
     p2p_port = node.start_network()
@@ -700,6 +701,10 @@ def cmd_config(args):
         f"hash_service = {'true' if cfg.hash_service else 'false'}",
         f"sparse_workers = {cfg.sparse_workers}",
         "",
+        "[rpc]",
+        f"gateway = {'true' if cfg.rpc.gateway else 'false'}",
+        f"gateway_cache = {cfg.rpc.gateway_cache}",
+        "",
         "[prune]",
     ]
     for seg in ("sender_recovery", "receipts", "transaction_lookup",
@@ -997,6 +1002,17 @@ def main(argv=None) -> int:
                         "(the cross-trie packed hash dispatch stays on). "
                         "Also settable as [node] sparse_workers in "
                         "reth.toml")
+    p.add_argument("--rpc-gateway", dest="rpc_gateway", action="store_true",
+                   default=False,
+                   help="route every RPC transport (HTTP/WS/IPC + the "
+                        "engine port) through the serving gateway "
+                        "(rpc/gateway.py): per-class admission control "
+                        "with priority engine > eth-read > tx-submit > "
+                        "debug and bounded queues (-32005 shedding when "
+                        "full), in-flight coalescing of identical reads, "
+                        "and a head-invalidated response cache. Also "
+                        "settable as [rpc] gateway in reth.toml — see "
+                        "RETH_TPU_FAULT_GATEWAY_* drill knobs")
     p.set_defaults(fn=cmd_node)
 
     p = sub.add_parser("dump-genesis", help="print the dev genesis JSON")

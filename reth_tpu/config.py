@@ -91,9 +91,22 @@ class StageConfig:
 
 
 @dataclass
+class RpcConfig:
+    # route every transport's dispatch through the serving gateway
+    # (rpc/gateway.py): admission control with priority classes,
+    # in-flight coalescing of identical reads, and a head-invalidated
+    # response cache (--rpc-gateway CLI equivalent)
+    gateway: bool = False
+    # response-cache capacity in entries (0 disables the cache while
+    # keeping admission + coalescing on)
+    gateway_cache: int = 1024
+
+
+@dataclass
 class RethTpuConfig:
     stages: StageConfig = field(default_factory=StageConfig)
     prune: PruneModes = field(default_factory=PruneModes)
+    rpc: RpcConfig = field(default_factory=RpcConfig)
     persistence_threshold: int = 2
     hasher: str = "device"  # device | cpu | auto (supervised device)
     # multiplex every keccak client over the shared background hash
@@ -134,4 +147,7 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.hasher = node.get("hasher", cfg.hasher)
     cfg.hash_service = bool(node.get("hash_service", cfg.hash_service))
     cfg.sparse_workers = int(node.get("sparse_workers", cfg.sparse_workers))
+    rpc = raw.get("rpc", {})
+    cfg.rpc.gateway = bool(rpc.get("gateway", cfg.rpc.gateway))
+    cfg.rpc.gateway_cache = int(rpc.get("gateway_cache", cfg.rpc.gateway_cache))
     return cfg

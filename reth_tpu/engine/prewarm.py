@@ -27,19 +27,31 @@ class PrewarmTask:
     """One prewarm pass for one payload."""
 
     def __init__(self, executor, env, max_workers: int = 4,
-                 record_accesses: bool = False):
+                 record_accesses: bool = False, key_sink=None):
         """``executor``: the BlockExecutor whose (cached) source the
         sequential pass will use; ``env``: the block's BlockEnv. With
         ``record_accesses`` each worker also records its tx's access sets
         — the BAL scheduling hint (reference: prewarm and BAL execution
-        share the speculative pass)."""
+        share the speculative pass).
+
+        ``key_sink(keys)``: optional OnStateHook-shaped callable fed each
+        worker's touched plain keys (20-byte addresses and
+        ``(address, slot)`` pairs) AS WORKERS FINISH — a cheap key-only
+        recording independent of the BAL machinery. Wired to the sparse
+        state-root task's ``on_state_update`` so multiproof fetch
+        overlaps prewarm instead of waiting for canonical execution
+        (reference: the sparse strategy's prefetch off the prewarm pass).
+        Keys are speculative: extra keys only pre-reveal trie paths the
+        block may not touch, which never changes the computed root."""
         self.executor = executor
         self.env = env
         self.max_workers = max_workers
         self.record_accesses = record_accesses
+        self.key_sink = key_sink
         self.accesses: dict[int, object] = {}  # tx index -> TxAccess
         self.warmed = 0
         self.failed = 0
+        self.streamed_keys = 0  # keys handed to key_sink (tests/metrics)
 
     def _one(self, item) -> bool:
         i, tx, sender = item
@@ -66,9 +78,27 @@ class PrewarmTask:
             ex._execute_tx(state, self.env, tx, sender, self.env.gas_limit)
             if self.record_accesses:
                 _extract_writes(state, acc)
+            self._stream_keys(state)
             return True
         except Exception:  # noqa: BLE001 — speculative: any failure is fine
             return False
+
+    def _stream_keys(self, state) -> None:
+        """Hand this worker's touched keys to the sink (key-only mode):
+        every account and storage slot the journal read or wrote, in the
+        executor's OnStateHook format. Failures never fail the worker —
+        prefetch is an optimization, not a correctness seam."""
+        if self.key_sink is None:
+            return
+        try:
+            keys: list = list(getattr(state, "_accounts", {}))
+            for addr, slots in getattr(state, "_storage", {}).items():
+                keys.extend((addr, s) for s in slots)
+            if keys:
+                self.streamed_keys += len(keys)
+                self.key_sink(keys)
+        except Exception:  # noqa: BLE001 — speculative prefetch only
+            pass
 
     def run(self, transactions, senders) -> int:
         """Execute all txs concurrently; returns how many completed.

@@ -287,6 +287,23 @@ class EngineTree:
             self.invalid[block.hash] = msg
             self._run_invalid_hooks(block, msg)
             return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
+        # background state-root job overlapping execution: the sparse
+        # strategy streams touched keys to a proof-fetch + reveal worker
+        # so the whole trie job (hash, walk, reveal) overlaps the EVM
+        # (reference state_root_strategy/sparse_trie.rs:126-259 +
+        # state_root_task.rs:20-100); the pipelined strategy overlaps key
+        # prehash only (engine/pipelined_root.py). Created BEFORE prewarm
+        # so the warming workers can seed its proof prefetch below.
+        self.last_sparse = None
+        sparse_task = None
+        root_job = None
+        if self.state_root_strategy == "sparse":
+            sparse_task = self._start_sparse_root(block, parent_layers)
+        if sparse_task is None:
+            from .pipelined_root import PipelinedStateRoot
+
+            root_job = PipelinedStateRoot(self.committer.hasher)
+        state_hook = (sparse_task or root_job).on_state_update
         self.last_prewarm = None  # bind the pass to THIS block only
         # prewarm: execute txs in parallel against PARENT state first,
         # purely to populate the execution cache (reference
@@ -308,29 +325,21 @@ class EngineTree:
                         header.number, header.timestamp).update_fraction),
             )
             self.last_prewarm = PrewarmTask(
-                executor, env, record_accesses=self.bal_execution)
+                executor, env, record_accesses=self.bal_execution,
+                # seed the sparse task's multiproof prefetch from the
+                # warming workers' touched keys (key-only, independent of
+                # BAL): proof fetch overlaps PREWARM, not just canonical
+                # execution. on_state_update dedupes and the trie-reveal
+                # path tolerates speculative extras, so racy worker-side
+                # duplicates are harmless.
+                key_sink=(sparse_task.on_state_update
+                          if sparse_task is not None else None))
             # started, NOT joined: the canonical pass below overlaps the
             # warming workers (speculative reads only touch the shared
             # mutex-guarded cache; canonical writes stay in its journal).
             # In BAL mode the pass is joined first instead — its recorded
             # access sets become the wave schedule.
             self.last_prewarm.start(block.transactions, senders)
-        # background state-root job overlapping execution: the sparse
-        # strategy streams touched keys to a proof-fetch + reveal worker
-        # so the whole trie job (hash, walk, reveal) overlaps the EVM
-        # (reference state_root_strategy/sparse_trie.rs:126-259 +
-        # state_root_task.rs:20-100); the pipelined strategy overlaps key
-        # prehash only (engine/pipelined_root.py)
-        self.last_sparse = None
-        sparse_task = None
-        root_job = None
-        if self.state_root_strategy == "sparse":
-            sparse_task = self._start_sparse_root(block, parent_layers)
-        if sparse_task is None:
-            from .pipelined_root import PipelinedStateRoot
-
-            root_job = PipelinedStateRoot(self.committer.hasher)
-        state_hook = (sparse_task or root_job).on_state_update
 
         def _abort_root_job():
             if sparse_task is not None:

@@ -1214,16 +1214,49 @@ def _pre_bls_g2msm(data: bytes, gas: int):
     return True, gas - cost, out
 
 
-def _pre_bls_nyi(idx: int, name: str):
-    """EIP-2537 operations whose constants (Fp12 tower, SWU isogeny) this
-    repo cannot verify offline: refuse loudly."""
+def _pre_bls_pairing(data: bytes, gas: int):
+    """0x0f BLS12_PAIRING_CHECK (EIP-2537): per-pair gas, curve AND
+    subgroup check on every input point, 32-byte 0/1 output."""
+    from ..primitives import bls12381 as bls
 
-    def run(data, gas: int):
-        raise PrecompileNotImplemented(
-            f"BLS12-381 precompile 0x{idx:02x} ({name}) is not implemented; "
-            "executing it would silently diverge from consensus")
+    if len(data) == 0 or len(data) % 384 != 0:
+        return False, 0, b""
+    cost = bls.pairing_gas(len(data) // 384)
+    if gas < cost:
+        return False, 0, b""
+    try:
+        out = bls.pairing_precompile(bytes(data))
+    except bls.BlsError:
+        return False, 0, b""
+    return True, gas - cost, out
 
-    return run
+
+def _pre_bls_map_fp_to_g1(data: bytes, gas: int):
+    """0x10 BLS12_MAP_FP_TO_G1 (EIP-2537): 5500 gas, RFC 9380 SSWU +
+    11-isogeny + effective-cofactor clearing."""
+    from ..primitives import bls12381 as bls
+
+    if gas < bls.MAP_FP_TO_G1_GAS:
+        return False, 0, b""
+    try:
+        out = bls.map_fp_to_g1_precompile(bytes(data))
+    except bls.BlsError:
+        return False, 0, b""
+    return True, gas - bls.MAP_FP_TO_G1_GAS, out
+
+
+def _pre_bls_map_fp2_to_g2(data: bytes, gas: int):
+    """0x11 BLS12_MAP_FP2_TO_G2 (EIP-2537): 23800 gas, RFC 9380 SSWU +
+    3-isogeny + effective-cofactor clearing."""
+    from ..primitives import bls12381 as bls
+
+    if gas < bls.MAP_FP2_TO_G2_GAS:
+        return False, 0, b""
+    try:
+        out = bls.map_fp2_to_g2_precompile(bytes(data))
+    except bls.BlsError:
+        return False, 0, b""
+    return True, gas - bls.MAP_FP2_TO_G2_GAS, out
 
 
 _RAW_PRECOMPILES = {
@@ -1237,16 +1270,16 @@ _RAW_PRECOMPILES = {
     8: _pre_bn_pairing,
     9: _pre_blake2f,
     10: _pre_point_eval,
-    # EIP-2537 (Prague): ADD + MSM are implemented (affine arithmetic +
-    # double-and-add with subgroup checks, primitives/bls12381.py);
-    # pairing/map raise PrecompileNotImplemented instead of stubbing
+    # EIP-2537 (Prague): the full table — affine ADD/MSM with subgroup
+    # checks, the pairing check over primitives/pairing.py, and the RFC
+    # 9380 SSWU+isogeny maps (primitives/bls12381.py)
     11: _pre_bls_g1add,
     12: _pre_bls_g1msm,
     13: _pre_bls_g2add,
     14: _pre_bls_g2msm,
-    15: _pre_bls_nyi(0x0F, "PAIRING_CHECK"),
-    16: _pre_bls_nyi(0x10, "MAP_FP_TO_G1"),
-    17: _pre_bls_nyi(0x11, "MAP_FP2_TO_G2"),
+    15: _pre_bls_pairing,
+    16: _pre_bls_map_fp_to_g1,
+    17: _pre_bls_map_fp2_to_g2,
 }
 
 # -- precompile result cache (reference engine/tree precompile_cache.rs) ------
@@ -1260,7 +1293,7 @@ from threading import Lock as _Lock
 
 _PRECOMPILE_CACHE: "_OrderedDict[tuple[int, bytes], tuple[int, bytes]]" = _OrderedDict()
 _PRECOMPILE_CACHE_MAX = 2048
-_CACHED_INDICES = frozenset({1, 5, 6, 7, 8, 10})
+_CACHED_INDICES = frozenset({1, 5, 6, 7, 8, 10, 15, 16, 17})
 # prewarm workers overlap canonical execution (engine/tree.py starts
 # PrewarmTask without joining), so the LRU bookkeeping must be guarded —
 # an unguarded get()+move_to_end can race a popitem eviction

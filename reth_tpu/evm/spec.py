@@ -187,13 +187,11 @@ _DELTAS: dict[str, dict] = {
         history_contract_call=True, has_requests=True, blob=PRAGUE_BLOBS,
         # EIP-2537 extends the precompile ADDRESS RANGE to 0x11 (warming
         # per EIP-2929 init covers 1..17 — validated against the
-        # reference's hive chain). G1ADD (0x0b) and G2ADD (0x0d) are
-        # implemented (primitives/bls12381.py); MSM/pairing/map (0x0c,
-        # 0x0e..0x11) raise PrecompileNotImplemented -> BlockExecutionError
-        # instead of silently acting as empty accounts, so the
-        # native/interpreter bit-identical invariant cannot be violated
-        # unnoticed (their MSM discount tables and hash-to-curve isogeny
-        # constants cannot be verified offline).
+        # reference's hive chain). The whole table is implemented in
+        # primitives/bls12381.py: ADD/MSM (affine + subgroup checks),
+        # PAIRING over primitives/pairing.py, and the RFC 9380
+        # SSWU+isogeny maps whose constants are derived offline and
+        # pinned by exact polynomial identities + RFC vectors.
         precompiles=17,
     ),
     OSAKA: dict(),

@@ -438,3 +438,98 @@ class SupervisorMetrics:
         if not ok:
             self._probe_failures.increment()
         self._probe_seconds.record(latency)
+
+
+class GatewayMetrics:
+    """RPC serving gateway observability (rpc/gateway.py): per-class
+    request counts, queue depth, running handlers, shed counts, and
+    wait/service histograms, plus the coalescing/caching counters — what
+    an operator needs to see that duplicate read bursts actually share
+    work and where admission is queueing or shedding."""
+
+    _CLASSES = ("engine", "read", "tx", "debug")
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._requests = {c: reg.counter(
+            f"gateway_requests_total_{c}",
+            f"requests admitted to the {c} class") for c in self._CLASSES}
+        self._qdepth = {c: reg.gauge(
+            f"gateway_queue_depth_{c}",
+            f"requests waiting for a {c} slot") for c in self._CLASSES}
+        self._running = {c: reg.gauge(
+            f"gateway_running_{c}",
+            f"handlers currently executing in the {c} class")
+            for c in self._CLASSES}
+        self._sheds = {c: reg.counter(
+            f"gateway_sheds_total_{c}",
+            f"requests shed with -32005 from the {c} class")
+            for c in self._CLASSES}
+        self._coalesced = {c: reg.counter(
+            f"gateway_coalesced_total_{c}",
+            f"{c} requests that shared an in-flight computation")
+            for c in self._CLASSES}
+        self._executions = reg.counter(
+            "gateway_executions_total", "handler executions actually run")
+        self._coalesce_factor = reg.gauge(
+            "gateway_coalesce_factor",
+            "coalescable requests served per execution (>1 = sharing works)")
+        self._cache_hits = reg.counter("gateway_cache_hits_total")
+        self._cache_misses = reg.counter("gateway_cache_misses_total")
+        self._cache_hit_rate = reg.gauge(
+            "gateway_cache_hit_rate", "response-cache hit fraction")
+        self._invalidations = reg.counter(
+            "gateway_cache_invalidations_total",
+            "wholesale cache clears on canonical-head change")
+        self._invalidated = reg.counter(
+            "gateway_cache_invalidated_entries_total")
+        self._wait = {c: reg.histogram(
+            f"gateway_wait_seconds_{c}",
+            f"admission wait before dispatch, {c} class",
+            buckets=(0.0001, 0.001, 0.005, 0.02, 0.1, 0.5, 2, 10))
+            for c in self._CLASSES}
+        self._service = {c: reg.histogram(
+            f"gateway_service_seconds_{c}",
+            f"handler execution wall time, {c} class",
+            buckets=(0.0005, 0.002, 0.01, 0.05, 0.25, 1, 5, 30))
+            for c in self._CLASSES}
+
+    def record_request(self, cls: str) -> None:
+        self._requests[cls].increment()
+
+    def set_queue_depth(self, cls: str, n: int) -> None:
+        self._qdepth[cls].set(n)
+
+    def set_running(self, cls: str, n: int) -> None:
+        self._running[cls].set(n)
+
+    def record_shed(self, cls: str) -> None:
+        self._sheds[cls].increment()
+
+    def record_coalesced(self, cls: str) -> None:
+        self._coalesced[cls].increment()
+        self._update_factor()
+
+    def record_wait(self, cls: str, seconds: float) -> None:
+        self._wait[cls].record(seconds)
+
+    def record_service(self, cls: str, seconds: float) -> None:
+        self._service[cls].record(seconds)
+        self._executions.increment()
+        self._update_factor()
+
+    def _update_factor(self) -> None:
+        ex = self._executions.value
+        if ex:
+            served = (ex + self._cache_hits.value
+                      + sum(c.value for c in self._coalesced.values()))
+            self._coalesce_factor.set(round(served / ex, 3))
+
+    def record_cache(self, *, hit: bool) -> None:
+        (self._cache_hits if hit else self._cache_misses).increment()
+        total = self._cache_hits.value + self._cache_misses.value
+        self._cache_hit_rate.set(round(self._cache_hits.value / total, 4))
+
+    def record_invalidation(self, entries: int) -> None:
+        self._invalidations.increment()
+        self._invalidated.increment(entries)

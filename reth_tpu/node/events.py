@@ -106,6 +106,19 @@ class NodeEventReporter:
                          f" svc_bypass={s['lease_bypasses']}")
             if s["leased_by"]:
                 line += f" svc_leased={s['leased_by']}"
+        # --rpc-gateway: the serving gateway's one-line health — queue
+        # pressure per admission domain, whether duplicate reads actually
+        # share work (cf = coalesce factor), cache effectiveness, and the
+        # shed counter an operator pages on
+        gw = getattr(self.node, "gateway", None)
+        if gw is not None:
+            g = gw.snapshot()
+            line += (f" gateway[req={g['requests']}"
+                     f" q={g['waiting_total']}"
+                     f" cf={g['coalesce_factor']}"
+                     f" hit={g['cache_hit_rate']}]")
+            if g["sheds"]:
+                line += f" gw_sheds={g['sheds']}"
         # rebuild-pipeline stage walls: during a chunked Merkle rebuild this
         # is the line that says where the time goes (host sweep vs hashing)
         from ..metrics import pipeline_metrics

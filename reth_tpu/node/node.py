@@ -65,6 +65,11 @@ class NodeConfig:
     # --sparse-workers / [node] sparse_workers: parallel sparse-commit
     # pool width (None = env RETH_TPU_SPARSE_WORKERS or cpu-derived)
     sparse_workers: int | None = None
+    # --rpc-gateway / [rpc] gateway: route every transport's dispatch
+    # through the serving gateway (rpc/gateway.py): admission control
+    # with priority classes, in-flight coalescing, and a head-invalidated
+    # response cache
+    rpc_gateway: bool = False
 
 
 class Node:
@@ -240,9 +245,22 @@ class Node:
         shared_lock = threading.RLock()
         # payload improvement loops must serialise with engine/RPC handlers
         self.payload_service.lock = shared_lock
+        # serving gateway (--rpc-gateway): ONE gateway shared by the
+        # public and auth servers (one admission domain — engine traffic
+        # outranks public debug traffic) and by the WS/IPC transports
+        # that wrap the public registry. Response-cache keys embed the
+        # canonical head; the canon listener clears dead-head entries.
+        self.gateway = None
+        if config.rpc_gateway:
+            from ..rpc.gateway import RpcGateway
+
+            self.gateway = RpcGateway(
+                head_supplier=lambda: self.tree.head_hash)
+            self.tree.canon_listeners.append(self.gateway.on_head_change)
         self.eth_api = EthApi(self.tree, self.pool, config.chain_id,
                               tx_batcher=self.tx_batcher)
-        self.rpc = RpcServer(port=config.http_port, lock=shared_lock)
+        self.rpc = RpcServer(port=config.http_port, lock=shared_lock,
+                             gateway=self.gateway)
         self.rpc.register(self.eth_api)
         self.rpc.register(NetApi(config.chain_id))
         self.rpc.register(Web3Api())
@@ -268,7 +286,7 @@ class Node:
 
             jwt_secret = load_or_create_secret(Path(config.datadir) / "jwt.hex")
         self.authrpc = RpcServer(port=config.authrpc_port, lock=shared_lock,
-                                 jwt_secret=jwt_secret)
+                                 jwt_secret=jwt_secret, gateway=self.gateway)
         self.authrpc.register(self.engine_api)
         self.authrpc.register(self.eth_api)  # CLs also query eth_ on authrpc
 

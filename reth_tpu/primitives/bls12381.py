@@ -14,10 +14,22 @@ subgroup order ``R`` — slow in python, but these precompiles are rare
 enough on mainnet that constant-factor speed is irrelevant, while the
 encode/validate rules are consensus-critical.
 
-The remaining EIP-2537 operations (pairing check, map-to-curve) need
-the Fp12 tower / SWU isogeny constants, which this repo cannot verify
-offline — their precompiles raise loudly instead of silently
-misbehaving (see evm/interpreter.py PrecompileNotImplemented).
+PAIRING (0x0f): the product-of-pairings check over the repo's own
+pairing engine (primitives/pairing.py, reduced Tate pairing with one
+final exponentiation for the whole product); every input point is
+curve- AND subgroup-checked.
+
+MAP_FP_TO_G1 (0x10) / MAP_FP2_TO_G2 (0x11): the RFC 9380 simplified-SWU
+map to the isogenous curve E' followed by the 11-/3-isogeny back to the
+BLS curve and effective-cofactor clearing. The isogeny rational maps
+are NOT transcribed from the RFC appendix: they were re-derived offline
+from first principles (the normalized isogeny satisfies the ODE
+``(x^3 + A'x + B') F'^2 = F^3 + B_cod`` — solve it as a power series at
+infinity, Padé-reconstruct the degree-11/10 rational map, then solve for
+the unique codomain model admitting an exact solution) and the baked
+constants are pinned two independent ways: the exact polynomial isogeny
+identity (tests/test_precompiles.py) and end-to-end RFC 9380 J.9.1/J.10.1
+hash-to-curve vectors, both of which any single-constant typo breaks.
 """
 
 from __future__ import annotations
@@ -301,3 +313,261 @@ G2_GENERATOR = (
     (0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
      0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE),
 )
+
+
+# -- EIP-2537 PAIRING (0x0f) --------------------------------------------------
+
+PAIRING_BASE_GAS = 37700
+PAIRING_PAIR_GAS = 32600
+
+
+def pairing_gas(k: int) -> int:
+    return PAIRING_BASE_GAS + k * PAIRING_PAIR_GAS
+
+
+def pairing_precompile(data: bytes) -> bytes:
+    """EIP-2537 PAIRING: k*(G1 point ++ G2 point) -> 32-byte 0/1.
+
+    Every point must be on its curve AND in the prime subgroup (unlike
+    ADD, like MSM); the point at infinity is valid and contributes the
+    identity. Empty input is invalid per the EIP (unlike EIP-197 bn254).
+    The check itself — prod e(Pi, Qi) == 1 — runs on the repo's generic
+    pairing engine with ONE final exponentiation for the whole product.
+    """
+    if len(data) == 0 or len(data) % 384 != 0:
+        raise BlsError(
+            f"PAIRING input must be a positive multiple of 384 bytes, "
+            f"got {len(data)}")
+    pairs = []
+    for off in range(0, len(data), 384):
+        p1 = decode_g1(data[off:off + 128])
+        _check_subgroup(p1, g1_add, "PAIRING G1")
+        q2 = decode_g2(data[off + 128:off + 384])
+        _check_subgroup(q2, g2_add, "PAIRING G2")
+        if p1 is not None and q2 is not None:
+            pairs.append((p1, q2))
+    from .pairing import BLS12_381, pairing_product_is_one
+
+    ok = pairing_product_is_one(pairs, BLS12_381)
+    return (1 if ok else 0).to_bytes(32, "big")
+
+
+# -- EIP-2537 MAP_FP_TO_G1 / MAP_FP2_TO_G2 (0x10 / 0x11) ----------------------
+#
+# RFC 9380 simplified SWU onto the isogenous curve E', the 11-/3-isogeny
+# back onto the BLS curve, then effective-cofactor clearing. See the
+# module docstring for how the isogeny constants below were derived and
+# how they are pinned.
+
+MAP_FP_TO_G1_GAS = 5500
+MAP_FP2_TO_G2_GAS = 23800
+
+# G1 SSWU target curve E1': y^2 = x^3 + ISO1_A x + ISO1_B, Z = 11
+ISO1_A = 0x144698A3B8E9433D693A02C96D4982B0EA985383EE66A8D8E8981AEFD881AC98936F8DA0E0F97F5CF428082D584C1D
+ISO1_B = 0x12E2908D11688030018B12E8753EEE3B2016C1F0F24F4070A0B9C14FCEF35EF55A23215A316CEAA5D1CC48E98E172BE0
+ISO1_Z = 11
+# normalized 11-isogeny E1' -> y^2 = x^3 + ISO1_BCOD: x |-> N(x)/D(x)
+# (monic-leading N over monic D), y |-> y * (N'D - ND')/D^2; the model is
+# rescaled onto y^2 = x^3 + 4 by x *= ISO1_C (= s^2), y *= ISO1_S3 (= s^3)
+ISO1_BCOD = 0x6C20A4
+ISO1_C = 0x6E08C248E260E70BD1E962381EDEE3D31D79D7E22C837BC23C0BF1BC24C6B68C24B1B80B64D391FA9C8BA2E8BA2D229
+ISO1_S3 = 0x15E6BE4E990F03CE4EA50B3B42DF2EB5CB181D8F84965A3957ADD4FA95AF01B2B665027EFEC01C7704B456BE69C8B604
+# x-map numerator, index = degree (degree 11, monic)
+ISO1_N = (
+    0x753E5B010B5C2AED6CE5BA4AA4CF117B975DFEF6FF2C0A82E8D47835D0591EDAD4178B01E37966FBA894887C542CB9,
+    0x1413C543388686BC391125039A3D376FA96FC987A0B99952DBC05E4A373FF99C5106B174C8985431036FF03DFB54EDEA,
+    0x71D592BC054E3B8BFFC75B81AEFAFA0A97F03B9114CD1363513AECFEB7610341A16B39EC1F2DA1DF687186972AF9C6,
+    0x5B098E05C2AABF1E6143C24142C25324C6DCC53AD565D704DE934AA345920B145B4FE75D201AEF640487751FE98AB0A,
+    0x183F63E4654B1979AD4A84532F7E099D6D92B7C6EFC1D8B2FAA622E45E37EC2BFB991CE5556A9BDCA5545A728CA528D0,
+    0x69E074638EEAB73A3B7B2E2FA9FC54B33B081FDBD70EF8B8D6758948AC6D2D388A13B2B8E7FE14E18BD96CAA6F2F41E,
+    0xD20F79145EE9F35035EB4485A8940705E481DE8641F0C42165FDAD250DF0A5D84105C94491B1DF3CF4F73C93475EDFA,
+    0x990B39B1545D7F3990CA675E6C070C715AF1AC4F6F9AAB95CD52B05E28FA1B119F5FE26C973A01F3089B1C3BCF375A4,
+    0xC1A3784B0B69F918C6576E46B265C603ADC96424813AE770555D3D09DEC9EDB34FCDFD99B8024AAD8D60A58ABD6AB28,
+    0x4E191198FB0B670F56E5BB36434C322563036138E4314008ACE68587DDB0A83824A49AF4209A889CE74C108E919F68B,
+    0x95FC13AB9E92AD4476D6E3EB3A56680F682B4EE96F7D03776DF533978F31C1593174E4B4B7865002D6384D168ECDD0A,
+    1,
+)
+# x-map denominator, index = degree (degree 10, monic)
+ISO1_D = (
+    0x8CA8D548CFF19AE18B2E62F4BD3FA6F01D5EF4BA35B48BA9C9588617FC8AC62B558D681BE343DF8993CF9FA40D21B1C,
+    0x12561A5DEB559C4348B4711298E536367041E8CA0CF0800C0126C2588C48BF5713DAA8846CB026E9E5C8276EC82B3BFF,
+    0xB2962FE57A3225E8137E629BFF2991F6F89416F5A718CD1FCA64E00B11ACEACD6A3D0967C94FEDCFCC239BA5CB83E19,
+    0x3425581A58AE2FEC83AAFEF7C40EB545B08243F16B1655154CCA8ABC28D6FD04976D5243EECF5C4130DE8938DC62CD8,
+    0x13A8E162022914A80A6F1D5F43E7A07DFFDFC759A12062BB8D6B44E833B306DA9BD29BA81F35781D539D395B3532A21E,
+    0xE7355F8E4E667B955390F7F0506C6E9395735E9CE9CAD4D0A43BCEF24B8982F7400D24BC4228F11C02DF9A29F6304A5,
+    0x772CAACF16936190F3E0C63E0596721570F5799AF53A1894E2E073062AEDE9CEA73B3538F0DE06CEC2574496EE84A3A,
+    0x14A7AC2A9D64A8B230B3F5B074CF01996E7F63C21BCA68A81996E1CDF9822C580FA5B9489D11E2D311F7D99BBDCC5A5E,
+    0xA10ECF6ADA54F825E920B3DAFC7A3CCE07F8D1D7161366B74100DA67F39883503826692ABBA43704776EC3A79A1D641,
+    0x95FC13AB9E92AD4476D6E3EB3A56680F682B4EE96F7D03776DF533978F31C1593174E4B4B7865002D6384D168ECDD0A,
+    1,
+)
+# G1 effective cofactor (RFC 9380 8.8.1: h_eff = 1 - x_BLS)
+H_EFF_G1 = 0xD201000000010001
+
+# G2 SSWU target curve E2': y^2 = x^3 + ISO2_A x + ISO2_B over Fp2,
+# Z = -(2 + i)
+ISO2_A = (0, 240)
+ISO2_B = (1012, 1012)
+ISO2_Z = (P - 2, P - 1)
+# normalized 3-isogeny E2' -> y^2 = x^3 + ISO2_BCOD (= 4(1+i) * 3^6),
+# rescaled onto y^2 = x^3 + 4(1+i) by ISO2_C / ISO2_S3 (both in Fp)
+ISO2_BCOD = (0xB64, 0xB64)
+ISO2_C = (0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1, 0)
+ISO2_S3 = (0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10, 0)
+ISO2_N = (
+    (0x130, 0x130),
+    (0, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA93),
+    (0xC, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F),
+    (1, 0),
+)
+ISO2_D = (
+    (0, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63),
+    (0xC, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F),
+    (1, 0),
+)
+# G2 effective cofactor (RFC 9380 8.8.2, Budroni-Pintore)
+H_EFF_G2 = int(
+    "bc69f08f2ee75b3584c6a0ea91b352888e2a8e9145ad7689986ff031508ffe13"
+    "29c2f178731db956d82bf015d1212b02ec0ec69d7477c1ae954cbc06689f6a35"
+    "9894c0adebbf6b4e8020005aaa95551", 16)
+
+
+def _fp_sqrt(a: int) -> int | None:
+    """Principal square root in Fp (p = 3 mod 4), or None."""
+    r = pow(a, (P + 1) // 4, P)
+    return r if r * r % P == a % P else None
+
+
+def _fp2_sqrt(a) -> tuple | None:
+    """Square root in Fp2 (complex method for p = 3 mod 4), or None.
+    The final squaring check makes the algorithm self-verifying."""
+    if a == (0, 0):
+        return (0, 0)
+    a1 = _fp2_pow(a, (P - 3) // 4)
+    x0 = _fp2_mul(a1, a)
+    alpha = _fp2_mul(a1, x0)
+    if alpha == ((P - 1) % P, 0):
+        x = _fp2_mul((0, 1), x0)
+    else:
+        b = _fp2_pow(_fp2_add((1, 0), alpha), (P - 1) // 2)
+        x = _fp2_mul(b, x0)
+    return x if _fp2_mul(x, x) == a else None
+
+
+def _fp2_pow(a, e: int):
+    r = (1, 0)
+    while e:
+        if e & 1:
+            r = _fp2_mul(r, a)
+        a = _fp2_mul(a, a)
+        e >>= 1
+    return r
+
+
+def _sgn0_fp(x: int) -> int:
+    return x % 2
+
+
+def _sgn0_fp2(x) -> int:
+    """RFC 9380 sgn0 for m=2: sign of x0, falling back to x1 when x0=0."""
+    return x[0] % 2 if x[0] != 0 else x[1] % 2
+
+
+def _sswu(u, A, B, Z, *, add, sub, mul, inv, sqrt, sgn0, neg, zero, one):
+    """RFC 9380 6.6.2 simplified SWU: field element -> point on the
+    isogenous curve y^2 = x^3 + Ax + B (never infinity)."""
+    uu = mul(u, u)
+    tv1 = add(mul(mul(mul(Z, Z), uu), uu), mul(Z, uu))
+    if tv1 == zero:
+        x = mul(B, inv(mul(Z, A)))
+    else:
+        x = mul(mul(neg(B), inv(A)), add(one, inv(tv1)))
+    gx = add(add(mul(mul(x, x), x), mul(A, x)), B)
+    y = sqrt(gx)
+    if y is None:
+        x = mul(mul(Z, uu), x)
+        gx = add(add(mul(mul(x, x), x), mul(A, x)), B)
+        y = sqrt(gx)
+        # gx1 * gx2 = Z^3 u^6 gx1^2: with Z a non-square exactly one of
+        # the two candidates is square, so this sqrt cannot fail
+    if sgn0(u) != sgn0(y):
+        y = neg(y)
+    return x, y
+
+
+def _poly_eval(coeffs, x, *, add, mul, zero):
+    r = zero
+    for c in reversed(coeffs):
+        r = add(mul(r, x), c)
+    return r
+
+
+def _iso_map(pt, N, D, c, s3, *, add, sub, mul, inv, zero, int_):
+    """Apply the normalized isogeny x -> N(x)/D(x), y -> y (N'D - ND')/D^2
+    then rescale onto the BLS curve model (x *= c, y *= s3). A zero
+    denominator means the input sits over the isogeny kernel -> infinity."""
+    x, y = pt
+    dv = _poly_eval(D, x, add=add, mul=mul, zero=zero)
+    if dv == zero:
+        return None
+    nv = _poly_eval(N, x, add=add, mul=mul, zero=zero)
+    ndiff = [mul(int_(i), co) for i, co in enumerate(N)][1:]
+    ddiff = [mul(int_(i), co) for i, co in enumerate(D)][1:]
+    w = sub(mul(_poly_eval(ndiff, x, add=add, mul=mul, zero=zero), dv),
+            mul(nv, _poly_eval(ddiff, x, add=add, mul=mul, zero=zero)))
+    xe = mul(mul(c, nv), inv(dv))
+    ye = mul(mul(mul(y, s3), w), inv(mul(dv, dv)))
+    return xe, ye
+
+
+def _g1_map_ops():
+    return dict(add=lambda a, b: (a + b) % P, sub=lambda a, b: (a - b) % P,
+                mul=lambda a, b: (a * b) % P, inv=_fp_inv, sqrt=_fp_sqrt,
+                sgn0=_sgn0_fp, neg=lambda a: (-a) % P, zero=0, one=1)
+
+
+def _g2_map_ops():
+    return dict(add=_fp2_add, sub=_fp2_sub, mul=_fp2_mul, inv=_fp2_inv,
+                sqrt=_fp2_sqrt, sgn0=_sgn0_fp2,
+                neg=lambda a: ((-a[0]) % P, (-a[1]) % P),
+                zero=(0, 0), one=(1, 0))
+
+
+def map_fp_to_g1(u: int):
+    """RFC 9380 map_to_curve + clear_cofactor for G1: Fp element ->
+    point in the G1 subgroup (affine, None = infinity)."""
+    ops = _g1_map_ops()
+    pt = _sswu(u, ISO1_A, ISO1_B, ISO1_Z, **ops)
+    pt = _iso_map(pt, ISO1_N, ISO1_D, ISO1_C, ISO1_S3,
+                  add=ops["add"], sub=ops["sub"], mul=ops["mul"],
+                  inv=ops["inv"], zero=0, int_=lambda k: k % P)
+    return _mul_scalar(pt, H_EFF_G1, g1_add)
+
+
+def map_fp2_to_g2(u):
+    """RFC 9380 map_to_curve + clear_cofactor for G2: Fp2 element ->
+    point in the G2 subgroup (affine, None = infinity)."""
+    ops = _g2_map_ops()
+    pt = _sswu(u, ISO2_A, ISO2_B, ISO2_Z, **ops)
+    pt = _iso_map(pt, ISO2_N, ISO2_D, ISO2_C, ISO2_S3,
+                  add=ops["add"], sub=ops["sub"], mul=ops["mul"],
+                  inv=ops["inv"], zero=(0, 0), int_=lambda k: (k % P, 0))
+    return _mul_scalar(pt, H_EFF_G2, g2_add)
+
+
+def map_fp_to_g1_precompile(data: bytes) -> bytes:
+    """EIP-2537 MAP_FP_TO_G1: one 64-byte padded Fp element -> G1 point."""
+    if len(data) != 64:
+        raise BlsError(
+            f"MAP_FP_TO_G1 input must be 64 bytes, got {len(data)}")
+    return encode_g1(map_fp_to_g1(_fp_decode(data)))
+
+
+def map_fp2_to_g2_precompile(data: bytes) -> bytes:
+    """EIP-2537 MAP_FP2_TO_G2: one 128-byte Fp2 element (c0 || c1) ->
+    G2 point."""
+    if len(data) != 128:
+        raise BlsError(
+            f"MAP_FP2_TO_G2 input must be 128 bytes, got {len(data)}")
+    u = (_fp_decode(data[:64]), _fp_decode(data[64:]))
+    return encode_g2(map_fp2_to_g2(u))

@@ -13,10 +13,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 class RpcError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, data=None):
         super().__init__(message)
         self.code = code
         self.message = message
+        # optional structured error payload (JSON-RPC error.data), e.g.
+        # the gateway's {"retry_after": ...} on -32005 shedding
+        self.data = data
 
 
 PARSE_ERROR = -32700
@@ -32,7 +35,8 @@ class RpcServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  lock: threading.RLock | None = None,
-                 jwt_secret: bytes | None = None):
+                 jwt_secret: bytes | None = None,
+                 gateway=None):
         self.methods: dict[str, callable] = {}
         self.host = host
         self.port = port
@@ -45,6 +49,11 @@ class RpcServer:
         # internal synchronisation (share the lock across servers that
         # share state, e.g. the public and auth servers of one node)
         self.lock = lock or threading.RLock()
+        # serving gateway (rpc/gateway.py): every dispatch — HTTP here,
+        # plus the WS/IPC transports that wrap this registry — routes
+        # through it for admission control, coalescing, and the
+        # head-invalidated response cache (None = direct dispatch)
+        self.gateway = gateway
 
     def authorize(self, auth_header: str | None) -> str | None:
         """None when authorized; else the rejection reason."""
@@ -91,28 +100,36 @@ class RpcServer:
         if fn is None:
             return self._error(rid, METHOD_NOT_FOUND, f"method {method} not found")
         params = req.get("params", [])
-        try:
+
+        def invoke():
             if getattr(fn, "_lockfree", False):
                 # handlers that only touch self-locking components (the
                 # tx batcher/pool) skip the global lock: holding it while
                 # awaiting a batched insert would serialize the batcher
                 # down to batches of one and stall unrelated RPCs
-                result = fn(*params) if isinstance(params, list) else fn(**params)
+                return fn(*params) if isinstance(params, list) else fn(**params)
+            with self.lock:
+                return fn(*params) if isinstance(params, list) else fn(**params)
+
+        try:
+            if self.gateway is not None:
+                result = self.gateway.call(method, params, invoke)
             else:
-                with self.lock:
-                    result = fn(*params) if isinstance(params, list) else fn(**params)
+                result = invoke()
         except RpcError as e:
-            return self._error(rid, e.code, e.message)
+            return self._error(rid, e.code, e.message, e.data)
         except TypeError as e:
             return self._error(rid, INVALID_PARAMS, str(e))
         except Exception as e:  # noqa: BLE001 — every fault maps to an RPC error
             return self._error(rid, INTERNAL_ERROR, f"{type(e).__name__}: {e}")
         return json.dumps({"jsonrpc": "2.0", "id": rid, "result": result}).encode()
 
-    def _error(self, rid, code, message) -> bytes:
+    def _error(self, rid, code, message, data=None) -> bytes:
+        err = {"code": code, "message": message}
+        if data is not None:
+            err["data"] = data
         return json.dumps({
-            "jsonrpc": "2.0", "id": rid,
-            "error": {"code": code, "message": message},
+            "jsonrpc": "2.0", "id": rid, "error": err,
         }).encode()
 
     # -- transport -------------------------------------------------------------

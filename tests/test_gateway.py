@@ -1,0 +1,500 @@
+"""RPC serving gateway: admission control, coalescing, head-invalidated
+response caching, fault drills, and one-gateway transport parity.
+
+The acceptance bar (ISSUE 5): under >= 8 client threads issuing
+duplicate reads the coalesce factor exceeds 1 with every response
+bit-identical to the ungated path; full-queue shedding returns -32005
+without wedging other classes; HTTP, WS, and IPC all route through ONE
+gateway.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from reth_tpu.metrics import MetricsRegistry
+from reth_tpu.primitives.keccak import keccak256
+from reth_tpu.rpc.gateway import (
+    CLASSES,
+    DEFAULT_COALESCE,
+    OVERLOADED,
+    GatewayFaultInjector,
+    RpcGateway,
+    classify,
+)
+from reth_tpu.rpc.server import RpcServer
+
+# every gateway below gets its own registry: the global one would reject
+# re-registration across tests (and cross-pollute counters)
+
+
+def make_gateway(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return RpcGateway(**kw)
+
+
+def handle(server, method, params, rid=1):
+    out = json.loads(server.handle(json.dumps(
+        {"jsonrpc": "2.0", "id": rid, "method": method,
+         "params": params}).encode()))
+    return out
+
+
+# -- classification -----------------------------------------------------------
+
+
+def test_classification():
+    assert classify("engine_newPayloadV4") == "engine"
+    assert classify("engine_forkchoiceUpdatedV3") == "engine"
+    assert classify("eth_sendRawTransaction") == "tx"
+    assert classify("debug_traceTransaction") == "debug"
+    assert classify("trace_block") == "debug"
+    assert classify("ots_getApiLevel") == "debug"
+    assert classify("eth_call") == "read"
+    assert classify("eth_getLogs") == "read"
+    assert classify("net_version") == "read"
+    assert CLASSES.index("engine") < CLASSES.index("read") < \
+        CLASSES.index("tx") < CLASSES.index("debug")
+    # the cacheable set is exactly the pure head-scoped reads
+    assert "eth_call" in DEFAULT_COALESCE
+    assert "eth_sendRawTransaction" not in DEFAULT_COALESCE
+
+
+# -- coalescing stress --------------------------------------------------------
+
+
+def _deterministic_handler(executions, delay=0.003):
+    """An eth_call-shaped handler: deterministic in its params, with a
+    side execution counter NOT reflected in the result (so coalesced and
+    uncoalesced responses can be compared byte-for-byte)."""
+
+    def eth_call(*params):
+        executions.append(threading.get_ident())
+        time.sleep(delay)  # widen the in-flight window
+        return {"data": "0x" + keccak256(
+            json.dumps(params, sort_keys=True).encode()).hex()}
+
+    return eth_call
+
+
+def test_threaded_stress_coalesced_bit_identical():
+    """8 client threads x duplicate reads: every gated response is
+    bit-identical to the ungated server's, the handler runs far fewer
+    times than the request count, and gateway_* metrics show
+    coalesce factor > 1."""
+    gw = make_gateway(head_supplier=lambda: b"head-1", cache_size=0)
+    gated_execs, naive_execs = [], []
+    gated = RpcServer(gateway=gw)
+    gated.register_method("eth_call", _deterministic_handler(gated_execs))
+    naive = RpcServer()
+    naive.register_method("eth_call", _deterministic_handler(naive_execs))
+
+    threads, rounds = 8, 10
+    barrier = threading.Barrier(threads)
+    results: dict[tuple, bytes] = {}
+    errors: list = []
+
+    def client(t):
+        try:
+            for r in range(rounds):
+                barrier.wait()  # all threads fire the same key together
+                params = [{"to": f"0x{r:040x}", "data": "0xdeadbeef"}, "latest"]
+                body = json.dumps({"jsonrpc": "2.0", "id": 42,
+                                   "method": "eth_call",
+                                   "params": params}).encode()
+                results[(t, r)] = gated.handle(body)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    ts = [threading.Thread(target=client, args=(t,)) for t in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors, errors
+    # bit-identical to the ungated path, and across all coalesced clients
+    for r in range(rounds):
+        params = [{"to": f"0x{r:040x}", "data": "0xdeadbeef"}, "latest"]
+        body = json.dumps({"jsonrpc": "2.0", "id": 42, "method": "eth_call",
+                           "params": params}).encode()
+        want = naive.handle(body)
+        for t in range(threads):
+            assert results[(t, r)] == want
+    total = threads * rounds
+    assert len(gated_execs) < total, "no coalescing happened"
+    assert gw.coalesce_factor() > 1.0
+    assert gw.snapshot()["coalesced"] == total - len(gated_execs)
+    # the metrics registry agrees with the snapshot
+    text = gw.metrics._coalesce_factor.value
+    assert text > 1.0
+
+
+def test_coalesced_errors_fan_out():
+    """A leader's failure propagates to every coalesced follower — no
+    follower hangs or silently gets a default."""
+    gw = make_gateway(cache_size=0)
+    srv = RpcServer(gateway=gw)
+    gate = threading.Event()
+
+    def eth_call(*params):
+        gate.wait(5)
+        raise ValueError("boom")
+
+    srv.register_method("eth_call", eth_call)
+    outs = [None, None]
+
+    def client(i):
+        outs[i] = handle(srv, "eth_call", ["x"])
+
+    ts = [threading.Thread(target=client, args=(i,)) for i in range(2)]
+    ts[0].start()
+    time.sleep(0.05)
+    ts[1].start()
+    time.sleep(0.05)
+    gate.set()
+    for t in ts:
+        t.join()
+    for out in outs:
+        assert out["error"]["message"].endswith("boom")
+
+
+# -- response cache + head invalidation ---------------------------------------
+
+
+def test_cache_hits_and_head_invalidation():
+    """Identical reads at one head execute once; a canonical-head change
+    both re-keys and wholesale-clears the cache."""
+    head = {"h": b"h1"}
+    gw = make_gateway(head_supplier=lambda: head["h"])
+    srv = RpcServer(gateway=gw)
+    execs = []
+    srv.register_method("eth_getLogs", _deterministic_handler(execs, delay=0))
+
+    first = handle(srv, "eth_getLogs", [{"fromBlock": "0x1"}])
+    again = handle(srv, "eth_getLogs", [{"fromBlock": "0x1"}])
+    assert first["result"] == again["result"]
+    assert len(execs) == 1
+    assert gw.cache_hits == 1 and gw.cache_hit_rate() > 0
+    # different params = different key
+    handle(srv, "eth_getLogs", [{"fromBlock": "0x2"}])
+    assert len(execs) == 2
+    # head change: the canon-listener hook clears the cache wholesale
+    head["h"] = b"h2"
+    gw.on_head_change(chain=[])
+    assert gw.invalidations == 1
+    handle(srv, "eth_getLogs", [{"fromBlock": "0x1"}])
+    assert len(execs) == 3
+    # non-coalescable methods never touch the cache
+    srv.register_method("eth_sendRawTransaction", lambda *a: "0x00")
+    handle(srv, "eth_sendRawTransaction", ["0x01"])
+    handle(srv, "eth_sendRawTransaction", ["0x01"])
+    assert gw.cache_misses == 3  # unchanged by the tx submissions
+
+
+def test_cache_bounded_lru():
+    gw = make_gateway(head_supplier=lambda: b"h", cache_size=2)
+    srv = RpcServer(gateway=gw)
+    execs = []
+    srv.register_method("eth_call", _deterministic_handler(execs, delay=0))
+    for i in range(3):
+        handle(srv, "eth_call", [f"k{i}"])
+    handle(srv, "eth_call", ["k0"])  # evicted by k2 -> recompute
+    assert len(execs) == 4
+
+
+# -- admission: shedding, priority, aging -------------------------------------
+
+
+def test_full_queue_sheds_without_wedging_other_classes():
+    """One slow read + a full read queue: the next read sheds with
+    -32005 (+ retry_after data) while engine traffic keeps flowing; the
+    queued read completes once the slot frees."""
+    gw = make_gateway(class_limits={"read": 1},
+                      queue_caps={"read": 1}, cache_size=0)
+    srv = RpcServer(gateway=gw)
+    gate = threading.Event()
+    srv.register_method("eth_slow", lambda: gate.wait(10) and None or "slow")
+    srv.register_method("eth_fast", lambda: "fast")
+    srv.register_method("engine_ping", lambda: "pong")
+
+    outs = {}
+
+    def call(name, method):
+        outs[name] = handle(srv, method, [])
+
+    t_run = threading.Thread(target=call, args=("running", "eth_slow"))
+    t_run.start()
+    time.sleep(0.05)  # running occupies the read slot
+    t_q = threading.Thread(target=call, args=("queued", "eth_fast"))
+    t_q.start()
+    time.sleep(0.05)  # queued fills the read queue (cap 1)
+    shed = handle(srv, "eth_fast", [])
+    assert shed["error"]["code"] == OVERLOADED
+    assert shed["error"]["data"]["retry_after"] > 0
+    assert shed["error"]["data"]["class"] == "read"
+    # other classes are NOT wedged by the full read lane
+    assert handle(srv, "engine_ping", [])["result"] == "pong"
+    assert gw.snapshot()["sheds"] == 1
+    gate.set()
+    t_run.join(5)
+    t_q.join(5)
+    assert outs["queued"]["result"] == "fast"
+    assert not t_run.is_alive() and not t_q.is_alive()
+
+
+def test_priority_and_antistarvation_aging():
+    """With one global slot: a fresh engine request outranks a fresh
+    debug request, but a debug waiter older than age_promote_s is
+    granted FIRST (the hash-service aging rule on the serving path)."""
+    gw = make_gateway(max_concurrent=1, age_promote_s=0.08, cache_size=0)
+    srv = RpcServer(gateway=gw)
+    order = []
+    gate = threading.Event()
+    srv.register_method("eth_block", lambda: gate.wait(10) or "done")
+    srv.register_method("debug_probe", lambda: order.append("debug") or "d")
+    srv.register_method("engine_probe", lambda: order.append("engine") or "e")
+
+    t0 = threading.Thread(target=handle, args=(srv, "eth_block", []))
+    t0.start()
+    time.sleep(0.05)
+    td = threading.Thread(target=handle, args=(srv, "debug_probe", []))
+    td.start()
+    time.sleep(0.12)  # debug waiter ages past age_promote_s
+    te = threading.Thread(target=handle, args=(srv, "engine_probe", []))
+    te.start()
+    time.sleep(0.05)
+    gate.set()
+    for t in (t0, td, te):
+        t.join(5)
+    assert order == ["debug", "engine"]  # aged debug beat fresh engine
+
+
+def test_fresh_priority_order():
+    """Without aging, a waiting engine request is granted before a
+    debug request that enqueued earlier."""
+    gw = make_gateway(max_concurrent=1, age_promote_s=60.0, cache_size=0)
+    srv = RpcServer(gateway=gw)
+    order = []
+    gate = threading.Event()
+    srv.register_method("eth_block", lambda: gate.wait(10) or "done")
+    srv.register_method("debug_probe", lambda: order.append("debug") or "d")
+    srv.register_method("engine_probe", lambda: order.append("engine") or "e")
+
+    t0 = threading.Thread(target=handle, args=(srv, "eth_block", []))
+    t0.start()
+    time.sleep(0.05)
+    td = threading.Thread(target=handle, args=(srv, "debug_probe", []))
+    td.start()
+    time.sleep(0.05)
+    te = threading.Thread(target=handle, args=(srv, "engine_probe", []))
+    te.start()
+    time.sleep(0.05)
+    gate.set()
+    for t in (t0, td, te):
+        t.join(5)
+    assert order == ["engine", "debug"]
+
+
+# -- fault drills -------------------------------------------------------------
+
+
+def test_fault_drill_shed_every():
+    """RETH_TPU_FAULT_GATEWAY_SHED drills the client-visible -32005 path
+    without real overload."""
+    inj = GatewayFaultInjector(shed_every=3)
+    gw = make_gateway(injector=inj, cache_size=0)
+    srv = RpcServer(gateway=gw)
+    srv.register_method("eth_ping", lambda: "pong")
+    codes = []
+    for i in range(6):
+        out = handle(srv, "eth_ping", [])
+        codes.append(out.get("error", {}).get("code"))
+    assert codes == [None, None, OVERLOADED, None, None, OVERLOADED]
+    assert inj.forced_sheds == 2
+    assert gw.snapshot()["fault_injection"] is True
+
+
+def test_fault_drill_stall_backs_up_queue():
+    """RETH_TPU_FAULT_GATEWAY_STALL slows every execution, which backs
+    concurrent requests up into the bounded queue (visible in the wait
+    histogram and queue metrics)."""
+    inj = GatewayFaultInjector(stall=0.05)
+    gw = make_gateway(class_limits={"read": 1}, injector=inj, cache_size=0)
+    srv = RpcServer(gateway=gw)
+    srv.register_method("eth_ping", lambda: "pong")
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=handle, args=(srv, "eth_ping", []))
+          for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+    assert time.monotonic() - t0 >= 0.15  # serialized through the stall
+    # the second/third requests waited for the read slot
+    wait_hist = gw.metrics._wait["read"]
+    assert wait_hist.n == 3 and wait_hist.total > 0.05
+
+
+def test_injector_from_env():
+    env = {"RETH_TPU_FAULT_GATEWAY_STALL": "0.5",
+           "RETH_TPU_FAULT_GATEWAY_SHED": "7"}
+    inj = GatewayFaultInjector.from_env(env)
+    assert inj.stall == 0.5 and inj.shed_every == 7 and inj.active()
+    assert GatewayFaultInjector.from_env({}) is None
+
+
+# -- transport parity: HTTP, WS, IPC through ONE gateway ----------------------
+
+
+def _ws_client(port):
+    from reth_tpu.rpc.ws import _WS_GUID
+
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    key = base64.b64encode(os.urandom(16))
+    sock.sendall(
+        b"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+        b"Connection: Upgrade\r\nSec-WebSocket-Key: " + key +
+        b"\r\nSec-WebSocket-Version: 13\r\n\r\n"
+    )
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        resp += sock.recv(4096)
+    assert b"101" in resp.split(b"\r\n")[0]
+    assert base64.b64encode(hashlib.sha1(key + _WS_GUID).digest()) in resp
+    return sock
+
+
+def _ws_request(sock, payload: bytes) -> bytes:
+    mask = os.urandom(4)
+    header = bytes([0x80 | 1])
+    n = len(payload)
+    if n < 126:
+        header += bytes([0x80 | n])
+    else:
+        header += bytes([0x80 | 126]) + struct.pack(">H", n)
+    sock.sendall(header + mask
+                 + bytes(c ^ mask[i % 4] for i, c in enumerate(payload)))
+    b0, b1 = sock.recv(1)[0], sock.recv(1)[0]
+    ln = b1 & 0x7F
+    if ln == 126:
+        (ln,) = struct.unpack(">H", sock.recv(2))
+    buf = b""
+    while len(buf) < ln:
+        buf += sock.recv(ln - len(buf))
+    return buf
+
+
+def test_http_ws_ipc_route_through_one_gateway(tmp_path):
+    """All three transports wrap one RpcServer registry, so one gateway
+    observes (and caches/coalesces across) every transport: three
+    identical reads over HTTP, WS, and IPC execute the handler ONCE and
+    return identical results."""
+    from reth_tpu.rpc.ipc import IpcRpcServer
+    from reth_tpu.rpc.ws import WsRpcServer
+
+    gw = make_gateway(head_supplier=lambda: b"h")
+    srv = RpcServer(gateway=gw)
+    execs = []
+    srv.register_method("eth_call", _deterministic_handler(execs, delay=0))
+    http_port = srv.start()
+    ws = WsRpcServer(srv)
+    ws_port = ws.start()
+    ipc = IpcRpcServer(srv, tmp_path / "node.ipc")
+    ipc_path = ipc.start()
+    body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": "eth_call",
+                       "params": ["parity"]}).encode()
+    try:
+        http_out = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{http_port}/", body,
+            {"Content-Type": "application/json"}), timeout=10).read()
+        wsock = _ws_client(ws_port)
+        ws_out = _ws_request(wsock, body)
+        wsock.close()
+        isock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        isock.connect(ipc_path)
+        isock.sendall(body + b"\n")
+        ipc_out = b""
+        while not ipc_out.endswith(b"\n"):
+            ipc_out += isock.recv(4096)
+        isock.close()
+    finally:
+        srv.stop()
+        ws.stop()
+        ipc.stop()
+    assert json.loads(http_out) == json.loads(ws_out) == \
+        json.loads(ipc_out.strip())
+    assert len(execs) == 1, "transports did not share the gateway cache"
+    assert gw.requests == 3
+    assert gw.cache_hits == 2
+
+
+# -- node-level e2e -----------------------------------------------------------
+
+
+@pytest.fixture()
+def gateway_node():
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.primitives import Account
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    cpu = TrieCommitter(hasher=keccak256_batch_np)
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)},
+                           committer=cpu)
+    cfg = NodeConfig(dev=True, rpc_gateway=True,
+                     genesis_header=builder.genesis,
+                     genesis_alloc=builder.accounts_at_genesis)
+    n = Node(cfg, committer=cpu)
+    n.start_rpc()
+    yield n, alice
+    n.stop()
+
+
+def rpc(port, method, *params):
+    req = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                      "params": list(params)})
+    out = json.loads(urllib.request.urlopen(urllib.request.Request(
+        f"http://127.0.0.1:{port}/", req.encode(),
+        {"Content-Type": "application/json"}), timeout=30).read())
+    if "error" in out:
+        raise RuntimeError(f"{method}: {out['error']}")
+    return out["result"]
+
+
+def test_node_gateway_e2e(gateway_node):
+    """A live node with --rpc-gateway: duplicate reads hit the response
+    cache, mining a block invalidates it via the canon listener, and the
+    gateway_* series are on /metrics."""
+    n, alice = gateway_node
+    port = n.rpc.port
+    assert n.gateway is not None and n.rpc.gateway is n.gateway
+    assert n.authrpc.gateway is n.gateway  # one admission domain
+    blk = rpc(port, "eth_getBlockByNumber", "0x0", False)
+    blk2 = rpc(port, "eth_getBlockByNumber", "0x0", False)
+    assert blk == blk2
+    assert n.gateway.cache_hits >= 1
+    inval_before = n.gateway.invalidations
+    n.miner.mine_block(timestamp=1_900_000_000)
+    assert n.gateway.invalidations > inval_before
+    # post-head-change reads recompute against the new head
+    assert rpc(port, "eth_blockNumber") == "0x1"
+    metrics = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    assert "gateway_requests_total_read" in metrics
+    assert "gateway_cache_hits_total" in metrics
+    # the events dashboard line carries the gateway fragment
+    n.event_reporter.on_canon_change([])  # no-op intake
+    snap = n.gateway.snapshot()
+    assert snap["requests"] >= 3 and snap["cache_hits"] >= 1

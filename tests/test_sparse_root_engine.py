@@ -95,6 +95,25 @@ def test_sparse_strategy_computes_roots():
     assert any(s["proof_batches"] > 0 for s in stats)
 
 
+def test_prewarm_seeds_sparse_proof_prefetch():
+    """With the sparse strategy, the prewarm workers stream their touched
+    keys into the sparse task as they finish (key-only mode, independent
+    of BAL), so multiproof fetch overlaps prewarm — and the speculative
+    extras never change the computed roots."""
+    alice, builder, factory = storage_env()
+    blocks = busy_blocks(alice, builder)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=2)
+    tree.prewarm_threshold = 1  # every busy block prewarms
+    stats = feed(tree, blocks)
+    assert all(s["strategy"] == "sparse" for s in stats), stats
+    assert tree.last_prewarm is not None
+    assert tree.last_prewarm.key_sink is not None
+    assert tree.last_prewarm.streamed_keys > 0
+    # the sink fed real OnStateHook-shaped keys: the storage contract's
+    # address must have been streamed by the store_call workers
+    assert tree.last_prewarm.warmed > 0
+
+
 def test_preserved_trie_reuse_across_payloads():
     """Consecutive payloads reuse the preserved sparse trie (hit on every
     block after the first)."""
