@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-gateway native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-gateway test-obs native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -47,6 +47,16 @@ test-sparse:
 test-gateway:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_gateway.py -q -p no:cacheprovider
+
+# block-lifecycle observability (part of the default `make test` flow —
+# tests/ is swept wholesale): trace-context propagation + per-block
+# timelines, flight-recorder dumps on RETH_TPU_FAULT_* drills, Chrome /
+# OTLP span-file validation, /metrics exposition-format checks, the
+# metrics thread-safety hammer, and the tracing-disabled overhead guard
+# (span cost < 1% of the sparse-commit wall) — CPU-only
+test-obs:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_observability.py -q -p no:cacheprovider
 
 # overlapped rebuild pipeline: parity vs the serial committer, packing,
 # arena residency, abort/failover drills, chunked-resume — fast, CPU-only

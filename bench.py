@@ -58,6 +58,35 @@ _DEADLINE = int(os.environ.get("RETH_TPU_BENCH_TIMEOUT", "1200"))
 _STATE: dict = {"phase": "startup", "device_result": None}
 
 
+def _flight_excerpt(n: int = 24) -> list:
+    """Tail of the flight recorder (probe outcomes, fault events, recent
+    spans) — the trail the wedged-tunnel zeros never left behind."""
+    try:
+        from reth_tpu import tracing
+
+        return [{k: rec.get(k) for k in
+                 ("kind", "target", "name", "ts", "dur_ms", "fields",
+                  "error")}
+                for rec in tracing.flight_snapshot(n)]
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return []
+
+
+def _compile_split() -> dict:
+    """compile_wall_s vs steady-state: the per-shape first-call walls the
+    compile tracker collected (metrics.DeviceCompileTracker) — every mode
+    reports the split so a compile storm can't masquerade as slow
+    hashing."""
+    try:
+        from reth_tpu.metrics import compile_tracker
+
+        t = compile_tracker.totals()
+        return {"compile_wall_s": t["compile_wall_s"],
+                "compiled_shapes": t["shapes"]}
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return {"compile_wall_s": 0.0, "compiled_shapes": 0}
+
+
 def _emit(value, vs_baseline, error=None, exit_code=None, **extra):
     line = {
         "metric": _STATE.get("metric", "merkle_rebuild_keccak_per_sec"),
@@ -66,8 +95,12 @@ def _emit(value, vs_baseline, error=None, exit_code=None, **extra):
         "vs_baseline": vs_baseline,
         "backend": _STATE.get("backend", "unknown"),
     }
+    line.update(_compile_split())
     if error:
         line["error"] = error
+        line["flight_recorder"] = _flight_excerpt()
+    elif extra.get("device_unavailable"):
+        line["flight_recorder"] = _flight_excerpt()
     line.update(extra)
     print(json.dumps(line), flush=True)
     if exit_code is not None:
@@ -77,10 +110,15 @@ def _emit(value, vs_baseline, error=None, exit_code=None, **extra):
 def _watchdog():
     time.sleep(_DEADLINE)
     dev = _STATE["device_result"]
+    # rc=0 either way: a wedged device is a DIAGNOSED outcome, not a
+    # harness failure (five rounds of rc=2/value=0 taught us that an
+    # unreadable exit erases the trajectory — the error field + flight
+    # recorder excerpt carry the postmortem now)
     if dev is not None:
         _emit(dev, 0, error=f"timed out during {_STATE['phase']} after the device run "
-                            f"completed (baseline unmeasured)", exit_code=3)
-    _emit(0, 0, error=f"timed out during {_STATE['phase']} after {_DEADLINE}s", exit_code=2)
+                            f"completed (baseline unmeasured)", exit_code=0)
+    _emit(0, 0, error=f"timed out during {_STATE['phase']} after {_DEADLINE}s",
+          exit_code=0)
 
 
 threading.Thread(target=_watchdog, daemon=True).start()
@@ -472,6 +510,11 @@ def run_sparse_mode() -> None:
 
 
 def main():
+    # record spans/events from the start: the flight-recorder excerpt in
+    # any error line needs the trail (probe attempts, first compiles)
+    from reth_tpu import tracing
+
+    tracing.set_trace_enabled(True)
     if os.environ.get("RETH_TPU_BENCH_MODE") == "service":
         run_service_mode()
         return
@@ -510,9 +553,13 @@ def main():
     cpu_committer = TurboCommitter(backend="numpy")
 
     # warm-up = one full untimed run, so every program shape the measured
-    # run dispatches is already compiled (XLA caches by shape in-process)
+    # run dispatches is already compiled (XLA caches by shape in-process).
+    # Its wall is reported as the compile side of the compile/steady split
+    # (the per-shape detail rides in via the compile tracker).
     _STATE["phase"] = "device warm-up (compiles)"
+    t_warm = time.time()
     run_rebuild(dev_committer, storage_jobs, account_jobs, pipelined=True)
+    dt_warm = time.time() - t_warm
 
     _STATE["phase"] = "device run"
     roots_dev, hashed_dev, dt_dev = run_rebuild(
@@ -525,7 +572,9 @@ def main():
         _emit(0, 0, error="device/cpu root mismatch", exit_code=1)
 
     _emit(round(hashed_dev / dt_dev, 1), round(dt_cpu / dt_dev, 3),
-          device_wall_s=round(dt_dev, 3), baseline_wall_s=round(dt_cpu, 3))
+          device_wall_s=round(dt_dev, 3), baseline_wall_s=round(dt_cpu, 3),
+          warmup_wall_s=round(dt_warm, 3),
+          steady_hashes_per_sec=round(hashed_dev / dt_dev, 1))
 
 
 if __name__ == "__main__":

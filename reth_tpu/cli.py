@@ -267,6 +267,12 @@ def cmd_export_era(args):
     return 0
 
 
+def _env_trace_enabled() -> bool:
+    from .tracing import _env_enabled
+
+    return _env_enabled()
+
+
 def cmd_node(args):
     from .node import Node, NodeConfig
 
@@ -325,6 +331,12 @@ def cmd_node(args):
                      storage_v2=getattr(args, "storage_v2", None),
                      sparse_workers=getattr(args, "sparse_workers", None),
                      rpc_gateway=getattr(args, "rpc_gateway", False),
+                     # --trace-blocks; unset falls back to RETH_TPU_TRACE
+                     trace_blocks=(args.trace_blocks
+                                   if getattr(args, "trace_blocks", None)
+                                   is not None
+                                   else _env_trace_enabled()),
+                     trace_file=getattr(args, "trace_file", None),
                      **kw)
     node = Node(cfg, committer=committer)
     p2p_port = node.start_network()
@@ -700,6 +712,7 @@ def cmd_config(args):
         f'hasher = "{cfg.hasher}"',
         f"hash_service = {'true' if cfg.hash_service else 'false'}",
         f"sparse_workers = {cfg.sparse_workers}",
+        f"trace_blocks = {'true' if cfg.trace_blocks else 'false'}",
         "",
         "[rpc]",
         f"gateway = {'true' if cfg.rpc.gateway else 'false'}",
@@ -1013,6 +1026,23 @@ def main(argv=None) -> int:
                         "and a head-invalidated response cache. Also "
                         "settable as [rpc] gateway in reth.toml — see "
                         "RETH_TPU_FAULT_GATEWAY_* drill knobs")
+    p.add_argument("--trace-blocks", dest="trace_blocks", action="store_true",
+                   default=None,
+                   help="block-lifecycle tracing (tracing.py): a trace "
+                        "context (trace_id = block hash) propagated across "
+                        "every queue/pool handoff yields a per-block span "
+                        "timeline — gateway admission, prewarm, execution, "
+                        "sparse commit, hash-service queue-wait vs "
+                        "dispatch — exported as Chrome-trace JSON under "
+                        "<datadir>/traces (open in Perfetto), plus the "
+                        "debug_blockTimeline / debug_flightRecorder RPCs "
+                        "and a per-block wall-budget events line. Also "
+                        "RETH_TPU_TRACE=1 or [node] trace_blocks in "
+                        "reth.toml")
+    p.add_argument("--trace-file", dest="trace_file", default=None,
+                   help="Chrome-trace output path override for "
+                        "--trace-blocks (default <datadir>/traces/"
+                        "blocks.trace.json)")
     p.set_defaults(fn=cmd_node)
 
     p = sub.add_parser("dump-genesis", help="print the dev genesis JSON")

@@ -117,6 +117,10 @@ class RethTpuConfig:
     # trie/proof.py). 0 = auto (env RETH_TPU_SPARSE_WORKERS or
     # cpu-derived); 1 = pools off, cross-trie packed dispatch stays on
     sparse_workers: int = 0
+    # block-lifecycle tracing (--trace-blocks CLI equivalent): record
+    # per-block span timelines, export Chrome-trace JSON under the
+    # datadir, and point flight-recorder dumps there (tracing.py)
+    trace_blocks: bool = False
 
 
 def _prune_mode(d: dict) -> PruneMode:
@@ -147,6 +151,7 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.hasher = node.get("hasher", cfg.hasher)
     cfg.hash_service = bool(node.get("hash_service", cfg.hash_service))
     cfg.sparse_workers = int(node.get("sparse_workers", cfg.sparse_workers))
+    cfg.trace_blocks = bool(node.get("trace_blocks", cfg.trace_blocks))
     rpc = raw.get("rpc", {})
     cfg.rpc.gateway = bool(rpc.get("gateway", cfg.rpc.gateway))
     cfg.rpc.gateway_cache = int(rpc.get("gateway_cache", cfg.rpc.gateway_cache))

@@ -18,8 +18,10 @@ speculative writes stay in the worker's journal and die with it.
 
 from __future__ import annotations
 
+import time
 from concurrent.futures import ThreadPoolExecutor
 
+from .. import tracing
 from ..evm.state import EvmState
 
 
@@ -54,6 +56,13 @@ class PrewarmTask:
         self.streamed_keys = 0  # keys handed to key_sink (tests/metrics)
 
     def _one(self, item) -> bool:
+        # adopt the block's trace context in this pool worker (explicit
+        # handoff: captured once in start(), reused by every worker)
+        with tracing.use_context(self._ctx):
+            with tracing.span("engine::prewarm", "prewarm.tx", idx=item[0]):
+                return self._one_inner(item)
+
+    def _one_inner(self, item) -> bool:
         i, tx, sender = item
         try:
             if self.record_accesses:
@@ -115,6 +124,8 @@ class PrewarmTask:
         two full passes)."""
         self._pool = None
         self._futures = []
+        self._ctx = tracing.current_context()
+        self._t0 = time.time()
         if not transactions:
             return
         self._pool = ThreadPoolExecutor(max_workers=self.max_workers)
@@ -130,4 +141,11 @@ class PrewarmTask:
         self._pool = None
         self.warmed = sum(results)
         self.failed = len(results) - self.warmed
+        # the whole pass as one span under the block trace (start() ran on
+        # the block thread; workers overlapped canonical execution)
+        tracing.record_span("engine::prewarm", "prewarm", self._t0,
+                            time.time() - self._t0, ctx=self._ctx,
+                            fields={"warmed": self.warmed,
+                                    "failed": self.failed,
+                                    "streamed_keys": self.streamed_keys})
         return self.warmed

@@ -31,6 +31,7 @@ import queue
 import threading
 import time
 
+from .. import tracing
 from ..primitives.keccak import keccak256
 from ..trie.proof import ProofCalculator, ProofWorkerPool
 from ..trie.sparse import (
@@ -54,7 +55,8 @@ class SparseRootTask:
 
     def __init__(self, parent_provider, parent_root: bytes, preserved,
                  committer, parent_hash: bytes | None = None,
-                 provider_factory=None, workers: int | None = None):
+                 provider_factory=None, workers: int | None = None,
+                 trace_ctx=None):
         # live tip is the highest-priority hash-service lane: with
         # --hash-service the task's batches coalesce with every other
         # client's but dispatch first; without one this is committer.hasher
@@ -102,7 +104,14 @@ class SparseRootTask:
                       "finish": 0.0, "worker_busy": 0.0}
         self.started_at = time.monotonic()
         self.finish_called_at: float | None = None
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        # explicit trace handoff: the task is created on the block thread
+        # (under the block's root span); the worker adopts the context so
+        # its hash/proof/reveal spans land in the block's timeline.
+        # ``trace_ctx`` lets the engine hand the BLOCK root down (the
+        # constructor itself runs inside a short startup span).
+        self._ctx = (trace_ctx if trace_ctx is not None
+                     else tracing.current_context())
+        self._thread = threading.Thread(target=self._run_traced, daemon=True)
         self._thread.start()
 
     # -- execution-side hook (OnStateHook seam) -----------------------------
@@ -117,6 +126,10 @@ class SparseRootTask:
         self._queue.put(fresh)
 
     # -- worker -------------------------------------------------------------
+
+    def _run_traced(self) -> None:
+        with tracing.use_context(self._ctx):
+            self._run()
 
     def _run(self) -> None:
         while True:
@@ -168,8 +181,10 @@ class SparseRootTask:
         if plain:
             t0 = time.monotonic()
             plain = list(dict.fromkeys(plain))
-            for k, d in zip(plain, self.hasher(plain)):
-                self._digests[k] = bytes(d)
+            with tracing.span("engine::sparse_root", "key_hash",
+                              keys=len(plain)):
+                for k, d in zip(plain, self.hasher(plain)):
+                    self._digests[k] = bytes(d)
             self.walls["hash"] += time.monotonic() - t0
         # reveal only what the trie can't already read (a preserved trie
         # usually has last block's hot paths — the cross-block reuse),
@@ -201,7 +216,9 @@ class SparseRootTask:
             self._outstanding.extend(self.proof_pool.submit(targets))
             return
         t0 = time.monotonic()
-        proofs = self.calc.multiproof(targets)
+        with tracing.span("engine::sparse_root", "proof.fetch",
+                          targets=len(targets)):
+            proofs = self.calc.multiproof(targets)
         self.walls["proof"] += time.monotonic() - t0
         self._reveal(proofs, targets)
 
@@ -214,20 +231,27 @@ class SparseRootTask:
                 continue
             proofs, wall = fut.result()  # raises a worker's failure here
             self.walls["proof"] += wall
+            # attribute the shard's (concurrent, pool-side) proof wall to
+            # the block trace; start is reconstructed from the wall
+            tracing.record_span("engine::sparse_root", "proof.shard",
+                                time.time() - wall, wall, ctx=self._ctx,
+                                fields={"targets": len(shard)})
             self._reveal(proofs, shard)
         self._outstanding = still
 
     def _reveal(self, proofs, targets) -> None:
         t1 = time.monotonic()
-        nodes = []
-        for ap in proofs.values():
-            nodes.extend(ap.proof)
-        self.trie.reveal_account(nodes)
-        for a, ap in proofs.items():
-            snodes = [n for sp in ap.storage_proofs for n in sp.proof]
-            if snodes or targets.get(a):
-                self.trie.reveal_storage(self._digests[a], ap.storage_root,
-                                         nodes + snodes)
+        with tracing.span("engine::sparse_root", "reveal",
+                          accounts=len(proofs)):
+            nodes = []
+            for ap in proofs.values():
+                nodes.extend(ap.proof)
+            self.trie.reveal_account(nodes)
+            for a, ap in proofs.items():
+                snodes = [n for sp in ap.storage_proofs for n in sp.proof]
+                if snodes or targets.get(a):
+                    self.trie.reveal_storage(self._digests[a], ap.storage_root,
+                                             nodes + snodes)
         self.walls["reveal"] += time.monotonic() - t1
 
     def _needs_account_reveal(self, hashed_addr: bytes) -> bool:
@@ -291,10 +315,12 @@ class SparseRootTask:
                 # pool; any failure inside it (including the injected
                 # RETH_TPU_FAULT_SPARSE_ABORT drill) surfaces as
                 # SparseRootError below -> incremental fallback
-                root = apply_output_to_trie(
-                    self.trie, out, self.hasher,
-                    storage_roots_out=storage_roots,
-                    committer=self.sparse_committer)
+                with tracing.span("engine::sparse_root", "sparse.finish",
+                                  attempt=_attempt):
+                    root = apply_output_to_trie(
+                        self.trie, out, self.hasher,
+                        storage_roots_out=storage_roots,
+                        committer=self.sparse_committer)
                 break
             except BlindedNodeError as e:
                 extra = (self.calc.storage_spine_for_path(e.owner, e.path)

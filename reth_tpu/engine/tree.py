@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 
 from ..metrics import REGISTRY
+from .. import tracing
 
 from ..consensus import ConsensusError, EthBeaconConsensus
 from ..evm import BlockExecutor, EvmConfig
@@ -221,11 +222,18 @@ class EngineTree:
         layer: Layer = {}
         overlay = DatabaseProvider(OverlayTx(base, parent_layers, layer))
         try:
-            parent = self._header_of(block.header.parent_hash, overlay)
-            self.consensus.validate_header_against_parent(block.header, parent)
-            self.consensus.validate_block_pre_execution(block)
-            status, senders, receipts = self._execute_into_overlay(
-                block, overlay, parent_layers)
+            # block-lifecycle trace root: trace_id = block hash; every
+            # phase span below (and every queue/pool handoff that carries
+            # the context) lands in this block's timeline
+            with tracing.trace_block(h.hex(), number=block.header.number,
+                                     txs=len(block.transactions)):
+                with tracing.span("engine::tree", "validate"):
+                    parent = self._header_of(block.header.parent_hash, overlay)
+                    self.consensus.validate_header_against_parent(
+                        block.header, parent)
+                    self.consensus.validate_block_pre_execution(block)
+                status, senders, receipts = self._execute_into_overlay(
+                    block, overlay, parent_layers)
         except (ConsensusError, InvalidTransaction) as e:
             self.invalid[h] = str(e)
             self._run_invalid_hooks(block, str(e))
@@ -261,21 +269,31 @@ class EngineTree:
         # execute (senders recovered here = SenderRecovery equivalent)
         from .execution_cache import CachedStateSource
 
-        if self._cache_anchor != header.parent_hash:
-            self.execution_cache = type(self.execution_cache)()  # reset
-            # the fresh cache is warmed with THIS parent's state: anchor it
-            # now, or a failed sibling would leave cache/anchor divergent
-            self._cache_anchor = header.parent_hash
-        source = CachedStateSource(ProviderStateSource(overlay), self.execution_cache)
-        executor = BlockExecutor(source, self.config)
-        hashes = {}
-        for k in range(max(0, n - 256), n):
-            bh = overlay.canonical_hash(k)
-            if bh:
-                hashes[k] = bh
+        with tracing.span("engine::tree", "prepare"):
+            # one hash computation for the whole function: Block.hash
+            # re-encodes and keccaks the header on EVERY access (~ms) —
+            # the block timeline made the three redundant recomputations
+            # on this path visible
+            block_hash = block.hash
+            if self._cache_anchor != header.parent_hash:
+                self.execution_cache = type(self.execution_cache)()  # reset
+                # the fresh cache is warmed with THIS parent's state: anchor
+                # it now, or a failed sibling would leave cache/anchor
+                # divergent
+                self._cache_anchor = header.parent_hash
+            source = CachedStateSource(ProviderStateSource(overlay),
+                                       self.execution_cache)
+            executor = BlockExecutor(source, self.config)
+            hashes = {}
+            for k in range(max(0, n - 256), n):
+                bh = overlay.canonical_hash(k)
+                if bh:
+                    hashes[k] = bh
         from ..primitives.types import recover_senders
 
-        senders = recover_senders(block.transactions)
+        with tracing.span("engine::tree", "recover_senders",
+                          txs=len(block.transactions)):
+            senders = recover_senders(block.transactions)
         if any(s is None for s in senders):
             bad = next(i for i, s in enumerate(senders) if s is None)
             try:
@@ -284,7 +302,7 @@ class EngineTree:
             except ValueError as e:
                 reason = str(e)
             msg = f"bad signature: tx {bad}: {reason}"
-            self.invalid[block.hash] = msg
+            self.invalid[block_hash] = msg
             self._run_invalid_hooks(block, msg)
             return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
         # background state-root job overlapping execution: the sparse
@@ -297,12 +315,15 @@ class EngineTree:
         self.last_sparse = None
         sparse_task = None
         root_job = None
-        if self.state_root_strategy == "sparse":
-            sparse_task = self._start_sparse_root(block, parent_layers)
-        if sparse_task is None:
-            from .pipelined_root import PipelinedStateRoot
+        block_ctx = tracing.current_context()  # the block's root span
+        with tracing.span("engine::tree", "root_task_start"):
+            if self.state_root_strategy == "sparse":
+                sparse_task = self._start_sparse_root(block, parent_layers,
+                                                      trace_ctx=block_ctx)
+            if sparse_task is None:
+                from .pipelined_root import PipelinedStateRoot
 
-            root_job = PipelinedStateRoot(self.committer.hasher)
+                root_job = PipelinedStateRoot(self.committer.hasher)
         state_hook = (sparse_task or root_job).on_state_update
         self.last_prewarm = None  # bind the pass to THIS block only
         # prewarm: execute txs in parallel against PARENT state first,
@@ -350,19 +371,21 @@ class EngineTree:
         use_bal = (self.bal_execution and self.last_prewarm is not None
                    and self.last_prewarm.record_accesses)
         try:
-            if use_bal:
-                from .bal import BlockAccessList, execute_block_bal
+            with tracing.span("engine::execute", "execute",
+                              txs=len(block.transactions), bal=use_bal):
+                if use_bal:
+                    from .bal import BlockAccessList, execute_block_bal
 
-                self.last_prewarm.join()
-                hint = BlockAccessList(entries=[
-                    self.last_prewarm.accesses[i]
-                    for i in sorted(self.last_prewarm.accesses)])
-                out, self.last_bal_stats = execute_block_bal(
-                    executor.source, block, senders, hint, self.config,
-                    state_hook=state_hook, block_hashes=hashes)
-            else:
-                out = executor.execute(block, senders, hashes,
-                                       state_hook=state_hook)
+                    self.last_prewarm.join()
+                    hint = BlockAccessList(entries=[
+                        self.last_prewarm.accesses[i]
+                        for i in sorted(self.last_prewarm.accesses)])
+                    out, self.last_bal_stats = execute_block_bal(
+                        executor.source, block, senders, hint, self.config,
+                        state_hook=state_hook, block_hashes=hashes)
+                else:
+                    out = executor.execute(block, senders, hashes,
+                                           state_hook=state_hook)
         except BaseException:
             _abort_root_job()  # never leak the worker thread
             if self.last_prewarm is not None:
@@ -371,26 +394,31 @@ class EngineTree:
         if self.last_prewarm is not None:
             self.last_prewarm.join()
         try:
-            self.consensus.validate_block_post_execution(
-                block, out.receipts, out.gas_used, requests=out.requests)
+            with tracing.span("engine::tree", "post_validate"):
+                self.consensus.validate_block_post_execution(
+                    block, out.receipts, out.gas_used, requests=out.requests)
         except ConsensusError as e:
             _abort_root_job()
-            self.invalid[block.hash] = str(e)
+            self.invalid[block_hash] = str(e)
             self._run_invalid_hooks(block, str(e), out)
             return PayloadStatus(PayloadStatusKind.INVALID, None, str(e)), [], []
         # body + execution output into the overlay layer
-        overlay.insert_header(header)
-        overlay.insert_block_body(block)
-        idx = overlay.block_body_indices(n)
-        for i, s in enumerate(senders):
-            overlay.put_sender(idx.first_tx_num + i, s)
-        write_execution_output(overlay, n, idx.first_tx_num, out)
+        with tracing.span("engine::tree", "write_overlay"):
+            overlay.insert_header(header)
+            overlay.insert_block_body(block)
+            idx = overlay.block_body_indices(n)
+            for i, s in enumerate(senders):
+                overlay.put_sender(idx.first_tx_num + i, s)
+            write_execution_output(overlay, n, idx.first_tx_num, out)
         # hashed-state delta + state root (the state-root job)
         t0 = _time.time()
-        if sparse_task is not None:
-            root = self._sparse_root_or_fallback(overlay, out, sparse_task)
-        else:
-            root = self._state_root_job(overlay, out, root_job)
+        with tracing.span("engine::tree", "state_root",
+                          strategy=("sparse" if sparse_task is not None
+                                    else "pipelined")):
+            if sparse_task is not None:
+                root = self._sparse_root_or_fallback(overlay, out, sparse_task)
+            else:
+                root = self._state_root_job(overlay, out, root_job)
         self._root_histogram.record(_time.time() - t0)
         self._blocks_counter.increment()
         if root != header.state_root:
@@ -398,18 +426,20 @@ class EngineTree:
                 f"state root mismatch: computed {root.hex()} header "
                 f"{header.state_root.hex()}"
             )
-            self.invalid[block.hash] = msg
+            self.invalid[block_hash] = msg
             self._run_invalid_hooks(block, msg, out, computed_root=root)
             return PayloadStatus(PayloadStatusKind.INVALID, None, msg), [], []
-        if sparse_task is not None and self.last_sparse.get("strategy") == "sparse":
-            # preserve only AFTER the root matched: a trie mutated by an
-            # invalid block would poison the next payload's anchor
-            sparse_task.preserve(block.hash)
-        # advance the execution cache: invalidate this block's writes and
-        # anchor the warm cache on the new tip
-        self.execution_cache.on_block_applied(out.changes)
-        self._cache_anchor = block.hash
-        return PayloadStatus(PayloadStatusKind.VALID, block.hash), senders, out.receipts
+        with tracing.span("engine::tree", "finalize"):
+            if (sparse_task is not None
+                    and self.last_sparse.get("strategy") == "sparse"):
+                # preserve only AFTER the root matched: a trie mutated by
+                # an invalid block would poison the next payload's anchor
+                sparse_task.preserve(block_hash)
+            # advance the execution cache: invalidate this block's writes
+            # and anchor the warm cache on the new tip
+            self.execution_cache.on_block_applied(out.changes)
+            self._cache_anchor = block_hash
+        return PayloadStatus(PayloadStatusKind.VALID, block_hash), senders, out.receipts
 
     def _run_invalid_hooks(self, block, reason, out=None, computed_root=None):
         for hook in self.invalid_block_hooks:
@@ -471,7 +501,8 @@ class EngineTree:
         changed_hashed_accounts = {haddr[a] for a in changes.accounts}
         return changed_hashed_accounts, changed_hashed_storages, wiped_hashed
 
-    def _start_sparse_root(self, block: Block, parent_layers):
+    def _start_sparse_root(self, block: Block, parent_layers,
+                           trace_ctx=None):
         """Launch the background sparse-trie root task over the PARENT
         view (its proof worker reads concurrently with execution, so it
         gets its own transaction + overlay — never the in-progress layer).
@@ -496,7 +527,8 @@ class EngineTree:
             return SparseRootTask(
                 parent_provider, parent.state_root, self.preserved_trie,
                 self.committer, parent_hash=block.header.parent_hash,
-                provider_factory=parent_view, workers=self.sparse_workers)
+                provider_factory=parent_view, workers=self.sparse_workers,
+                trace_ctx=trace_ctx)
         except Exception:  # noqa: BLE001 — strategy startup must never
             # fail the payload; the pipelined+incremental path covers it
             return None

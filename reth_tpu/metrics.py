@@ -12,14 +12,27 @@ import threading
 from dataclasses import dataclass, field
 
 
+# Sub-millisecond decades for device-dispatch and gateway/service
+# timings: the old 1 ms floor swallowed every dispatch (a fused keccak
+# dispatch is tens of µs on a healthy device), making queue-wait vs
+# dispatch attribution invisible on /metrics.
+SUB_MS_BUCKETS = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                  0.005, 0.02, 0.1, 0.5, 2, 10)
+
+
 @dataclass
 class Counter:
     name: str
     help: str = ""
     value: float = 0.0
+    # float += is a read-modify-write: unsynchronized concurrent
+    # increments lose counts (every hot path here is multi-threaded)
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def increment(self, amount: float = 1.0):
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
 
 @dataclass
@@ -27,9 +40,12 @@ class Gauge:
     name: str
     help: str = ""
     value: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def set(self, value: float):
-        self.value = value
+        with self._lock:
+            self.value = value
 
 
 @dataclass
@@ -42,19 +58,22 @@ class Histogram:
     counts: list[int] = field(default_factory=list)
     total: float = 0.0
     n: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def __post_init__(self):
         if not self.counts:
             self.counts = [0] * (len(self.buckets) + 1)
 
     def record(self, value: float):
-        self.total += value
-        self.n += 1
-        for i, b in enumerate(self.buckets):
-            if value <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        with self._lock:
+            self.total += value
+            self.n += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
 
 
 class MetricsRegistry:
@@ -98,13 +117,15 @@ class MetricsRegistry:
                     lines.append(f"{name} {m.value}")
                 elif isinstance(m, Histogram):
                     lines.append(f"# TYPE {name} histogram")
+                    with m._lock:  # consistent bucket/count/sum snapshot
+                        counts, total, n = list(m.counts), m.total, m.n
                     cum = 0
-                    for b, c in zip(m.buckets, m.counts):
+                    for b, c in zip(m.buckets, counts):
                         cum += c
                         lines.append(f'{name}_bucket{{le="{b}"}} {cum}')
-                    lines.append(f'{name}_bucket{{le="+Inf"}} {m.n}')
-                    lines.append(f"{name}_sum {m.total}")
-                    lines.append(f"{name}_count {m.n}")
+                    lines.append(f'{name}_bucket{{le="+Inf"}} {n}')
+                    lines.append(f"{name}_sum {total}")
+                    lines.append(f"{name}_count {n}")
         return "\n".join(lines) + "\n"
 
 
@@ -155,7 +176,8 @@ class TrieMetrics:
         self._leaves = reg.counter("trie_commit_leaves_total")
         self._wire = reg.counter("trie_commit_wire_bytes_total")
         self._commits = reg.counter("trie_commits_total")
-        self._seconds = reg.histogram("trie_commit_duration_seconds")
+        self._seconds = reg.histogram("trie_commit_duration_seconds",
+                                      buckets=SUB_MS_BUCKETS)
         self._levels = reg.histogram(
             "trie_commit_levels", buckets=(2, 4, 6, 8, 10, 12, 16))
         self.last: dict | None = None  # most recent commit, for bench triage
@@ -270,7 +292,7 @@ class SparseCommitMetrics:
         self._finish = reg.histogram(
             "sparse_commit_finish_seconds",
             "live-tip sparse finish() wall clock",
-            buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 5))
+            buckets=SUB_MS_BUCKETS)
         self.last: dict | None = None  # most recent commit, for events/bench
 
     def record_commit(self, stats: dict) -> None:
@@ -350,12 +372,12 @@ class HashServiceMetrics:
         self._wait = {l: reg.histogram(
             f"hash_service_wait_seconds_{l}",
             f"queue wait before dispatch, {l} lane",
-            buckets=(0.0001, 0.0005, 0.001, 0.002, 0.005, 0.02, 0.1, 1))
+            buckets=SUB_MS_BUCKETS)
             for l in self._LANES}
         self._service = reg.histogram(
             "hash_service_service_seconds",
             "coalesced dispatch wall time",
-            buckets=(0.0005, 0.001, 0.005, 0.02, 0.1, 0.5, 2, 10))
+            buckets=SUB_MS_BUCKETS)
 
     def record_submit(self, lane: str, n_msgs: int) -> None:
         self._requests[lane].increment()
@@ -486,12 +508,12 @@ class GatewayMetrics:
         self._wait = {c: reg.histogram(
             f"gateway_wait_seconds_{c}",
             f"admission wait before dispatch, {c} class",
-            buckets=(0.0001, 0.001, 0.005, 0.02, 0.1, 0.5, 2, 10))
+            buckets=SUB_MS_BUCKETS)
             for c in self._CLASSES}
         self._service = {c: reg.histogram(
             f"gateway_service_seconds_{c}",
             f"handler execution wall time, {c} class",
-            buckets=(0.0005, 0.002, 0.01, 0.05, 0.25, 1, 5, 30))
+            buckets=SUB_MS_BUCKETS)
             for c in self._CLASSES}
 
     def record_request(self, cls: str) -> None:
@@ -533,3 +555,75 @@ class GatewayMetrics:
     def record_invalidation(self, entries: int) -> None:
         self._invalidations.increment()
         self._invalidated.increment(entries)
+
+
+class DeviceCompileTracker:
+    """Per-shape compile-vs-execute attribution for the device kernels
+    (ops/keccak_jax.py, ops/fused_commit.py): XLA compiles lazily on the
+    first call of each (kind, shape) pair, so a "slow dispatch" is often
+    a compile in disguise — the round-1 compile storm that wedged the
+    tunnel was invisible precisely because nothing split the two. Every
+    jitted call site reports here; the FIRST call of a shape counts as
+    its compile (wall includes the compile), later calls as steady-state
+    execution. Surfaced as keccak_compile_* / keccak_dispatch_* metrics,
+    a flight-recorder event per first-compile, and per-shape stats for
+    bench.py's compile_wall_s split."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._compiles = reg.counter(
+            "keccak_compile_total", "distinct device program shapes compiled")
+        self._compile_s = reg.counter(
+            "keccak_compile_seconds_total",
+            "wall spent on first-call (compiling) dispatches")
+        self._dispatches = reg.counter(
+            "keccak_dispatch_total", "steady-state device dispatches")
+        self._dispatch_s = reg.histogram(
+            "keccak_dispatch_seconds",
+            "steady-state (post-compile) dispatch wall",
+            buckets=SUB_MS_BUCKETS)
+        self._lock = threading.Lock()
+        self.shapes: dict = {}  # shape key -> {compile_s, calls, execute_s}
+
+    def record(self, kind: str, shape, seconds: float) -> bool:
+        """Report one jitted call; returns True when it was the shape's
+        first (compiling) call."""
+        key = (kind,) + tuple(shape if isinstance(shape, (tuple, list))
+                              else (shape,))
+        with self._lock:
+            st = self.shapes.get(key)
+            first = st is None
+            if first:
+                st = self.shapes[key] = {
+                    "compile_s": round(seconds, 6), "calls": 0,
+                    "execute_s": 0.0}
+            else:
+                st["calls"] += 1
+                st["execute_s"] = round(st["execute_s"] + seconds, 6)
+        if first:
+            self._compiles.increment()
+            self._compile_s.increment(round(seconds, 6))
+            from . import tracing
+
+            tracing.event("ops::compile", "first_compile", kind=kind,
+                          shape=str(shape), wall_s=round(seconds, 4))
+        else:
+            self._dispatches.increment()
+            self._dispatch_s.record(seconds)
+        return first
+
+    def totals(self) -> dict:
+        """Aggregate compile/execute walls (bench compile_wall_s split)."""
+        with self._lock:
+            return {
+                "shapes": len(self.shapes),
+                "compile_wall_s": round(
+                    sum(s["compile_s"] for s in self.shapes.values()), 6),
+                "execute_wall_s": round(
+                    sum(s["execute_s"] for s in self.shapes.values()), 6),
+                "execute_calls": sum(
+                    s["calls"] for s in self.shapes.values()),
+            }
+
+
+compile_tracker = DeviceCompileTracker()

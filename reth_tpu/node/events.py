@@ -146,6 +146,14 @@ class NodeEventReporter:
             if "finish_s" in sc:
                 line += f" fin={sc['finish_s']}s"
             line += "]"
+        # --trace-blocks: the per-block wall budget — where the last
+        # block's time actually went, split by phase and by hash-service
+        # queue-wait vs device dispatch (tracing.py block summaries)
+        from .. import tracing
+
+        budget = tracing.last_block_summary()
+        if budget is not None:
+            line += " | " + tracing.format_wall_budget(budget)
         log.info(line)
         return line
 

@@ -70,6 +70,11 @@ class NodeConfig:
     # with priority classes, in-flight coalescing, and a head-invalidated
     # response cache
     rpc_gateway: bool = False
+    # --trace-blocks / [node] trace_blocks: block-lifecycle tracing —
+    # per-block span timelines + wall-budget line, Chrome-trace export,
+    # and flight-recorder dumps under the datadir (tracing.py)
+    trace_blocks: bool = False
+    trace_file: str | Path | None = None  # Chrome-trace path override
 
 
 class Node:
@@ -79,6 +84,20 @@ class Node:
         from ..tasks import TaskExecutor
 
         self.config = config
+        # --trace-blocks: enable block-lifecycle tracing before any
+        # component runs; traces + flight dumps live under the datadir
+        # (or the cwd for ephemeral nodes)
+        self.trace_path = None
+        if config.trace_blocks:
+            from .. import tracing
+
+            base = Path(config.datadir) if config.datadir else Path(".")
+            trace_dir = base / "traces"
+            trace_dir.mkdir(parents=True, exist_ok=True)
+            self.trace_path = (Path(config.trace_file) if config.trace_file
+                               else trace_dir / "blocks.trace.json")
+            tracing.init_block_tracing(chrome_path=self.trace_path,
+                                       flight_dir=trace_dir)
         self.committer = committer or TrieCommitter()
         # device hasher supervisor (--hasher auto): present when the
         # committer routes through ops/supervisor.py — surfaced on the
@@ -470,3 +489,8 @@ class Node:
             self.network.stop()
         if self.factory.db is not None and hasattr(self.factory.db, "flush"):
             self.factory.db.flush()
+        if self.config.trace_blocks:
+            # terminate the Chrome trace into a valid JSON array
+            from .. import tracing
+
+            tracing.shutdown_chrome_trace()

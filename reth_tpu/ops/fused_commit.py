@@ -33,6 +33,7 @@ seam this replaces (crates/stages/stages/src/stages/hashing_account.rs:29-32).
 
 from __future__ import annotations
 
+import time as _time
 from functools import lru_cache, partial
 
 import numpy as np
@@ -43,6 +44,19 @@ import jax.numpy as jnp
 from ..primitives.keccak import RATE
 from ..trie.node import HASH_REF_HOLE  # noqa: F401  (re-export; defined jax-free)
 from .keccak_jax import masked_absorb_words
+
+
+def _timed_call(kind: str, shape, fn, *args):
+    """Run one jitted dispatch and report (shape, wall) to the compile
+    tracker: the first call of a shape IS its XLA compile (jit compiles
+    synchronously, then enqueues), so compile storms split out from the
+    near-zero steady-state enqueue cost."""
+    from ..metrics import compile_tracker
+
+    t0 = _time.perf_counter()
+    out = fn(*args)
+    compile_tracker.record(kind, shape, _time.perf_counter() - t0)
+    return out
 
 
 def _bytes_to_words(t):
@@ -333,7 +347,8 @@ class FusedLevelEngine:
         key = self._sharding_key()
         if not bucket.holes:
             fn = _jitted("plain", b_tier, key)
-            self._buf = fn(
+            self._buf = _timed_call(
+                "fused.plain", (b_tier, n_tier), fn,
                 self._put_batch(templates), self._put_batch(counts),
                 self._put_batch(slots), self._buf,
             )
@@ -347,7 +362,8 @@ class FusedLevelEngine:
             hole_byte[i] = off
             hole_src[i] = src
         fn = _jitted("splice", b_tier, key)
-        self._buf = fn(
+        self._buf = _timed_call(
+            "fused.splice", (b_tier, n_tier, h_tier), fn,
             self._put_batch(templates), self._put_batch(counts),
             self._put_batch(hole_node), self._put_batch(hole_byte),
             self._put_batch(hole_src), self._put_batch(slots), self._buf,
@@ -407,7 +423,8 @@ class FusedLevelEngine:
         flat_p[: len(flat)] = flat
         hr, ho, hs = self._pad_holes(holes, n, floor=256, growth_mult=4)
         fn = _jitted("packed", b_tier, self._sharding_key())
-        self._buf = fn(
+        self._buf = _timed_call(
+            "fused.packed", (b_tier, n_tier, flat_tier, len(hr)), fn,
             self._device_put(flat_p), self._put_batch(row_off_p),
             self._put_batch(row_len_p), self._put_batch(counts_p),
             self._put_batch(hr), self._put_batch(ho), self._put_batch(hs),
@@ -430,7 +447,8 @@ class FusedLevelEngine:
         # number of compiled (n_tier, h_tier) combinations
         cr, cn, cs = self._pad_holes(children, n, floor=2 * n_tier, growth_mult=2)
         fn = _jitted("branch", 4, self._sharding_key())
-        self._buf = fn(
+        self._buf = _timed_call(
+            "fused.branch", (n_tier, len(cr)), fn,
             self._put_batch(masks_p), self._put_batch(slots_p),
             self._put_batch(cr), self._put_batch(cn), self._put_batch(cs), self._buf,
         )
@@ -718,17 +736,21 @@ class MegaFusedEngine(FusedLevelEngine):
                  hsrc_o, n_valid, h_valid) = e
                 fn = _staged_packed(b_tier, n_pow, h_pow, u8_len, i32_len,
                                     self._s_tier)
-                buf = fn(u8d, i32d, buf, s32(flat_off), s32(len_o),
-                         s32(slot_o), s32(hidx_o), s32(hsrc_o),
-                         s32(n_valid), s32(h_valid))
+                buf = _timed_call(
+                    "mega.packed", (b_tier, n_pow, h_pow, u8_len, i32_len),
+                    fn, u8d, i32d, buf, s32(flat_off), s32(len_o),
+                    s32(slot_o), s32(hidx_o), s32(hsrc_o),
+                    s32(n_valid), s32(h_valid))
             else:
                 (_, n_pow, ch_pow, mask_o, slot_o, chidx_o, chsrc_o,
                  n_valid, c_valid) = e
                 fn = _staged_branch(n_pow, ch_pow, u8_len, i32_len,
                                     self._s_tier)
-                buf = fn(u8d, i32d, buf, s32(mask_o), s32(slot_o),
-                         s32(chidx_o), s32(chsrc_o), s32(n_valid),
-                         s32(c_valid))
+                buf = _timed_call(
+                    "mega.branch", (n_pow, ch_pow, u8_len, i32_len),
+                    fn, u8d, i32d, buf, s32(mask_o), s32(slot_o),
+                    s32(chidx_o), s32(chsrc_o), s32(n_valid),
+                    s32(c_valid))
         self._buf = buf
         self._plan, self._u8_parts, self._i32_parts = [], [], []
 

@@ -72,6 +72,8 @@ import threading
 import time
 from contextlib import contextmanager
 
+from .. import tracing
+
 # priority order, highest first — index IS the priority
 LANES = ("live", "payload", "rebuild", "proof")
 _LANE_INDEX = {name: i for i, name in enumerate(LANES)}
@@ -139,10 +141,15 @@ class ServiceFaultInjector:
             self.dispatches += 1
             n = self.dispatches
         if self.stall:
+            tracing.fault_event("RETH_TPU_FAULT_SERVICE_STALL",
+                                target="ops::hash_service",
+                                dispatch=n, stall_s=self.stall)
             time.sleep(self.stall)
         if self.wedge_every and n % self.wedge_every == 0:
             with self._lock:
                 self.wedged += 1
+            tracing.fault_event("RETH_TPU_FAULT_SERVICE_WEDGE_EVERY",
+                                target="ops::hash_service", dispatch=n)
             raise InjectedServiceWedge(
                 f"injected service wedge on dispatch #{n} "
                 f"(every {self.wedge_every})")
@@ -179,13 +186,18 @@ class HashFuture:
 
 
 class _Request:
-    __slots__ = ("lane", "msgs", "future", "enqueued_at")
+    __slots__ = ("lane", "msgs", "future", "enqueued_at", "ctx", "wall_at")
 
     def __init__(self, lane: str, msgs: list[bytes]):
         self.lane = lane
         self.msgs = msgs
         self.future = HashFuture()
         self.enqueued_at = time.monotonic()
+        # explicit trace handoff across the queue: the dispatcher thread
+        # serves many traces per coalesced batch, so each request carries
+        # its submitter's context and gets a per-request span on completion
+        self.ctx = tracing.current_context()
+        self.wall_at = time.time()
 
 
 class HashClient:
@@ -580,6 +592,7 @@ class HashService:
         for r in batch:
             self.metrics.record_wait(r.lane, t0 - r.enqueued_at)
         replayed = False
+        replay_err = None
         try:
             if bypass:
                 self.lease_bypasses += 1
@@ -591,6 +604,7 @@ class HashService:
                 digests = self._backend(msgs)
         except BaseException as first_error:  # noqa: BLE001 — replayed below
             replayed = True
+            replay_err = type(first_error).__name__
             self.replays += 1
             self.metrics.record_replay()
             try:
@@ -600,14 +614,39 @@ class HashService:
                     r.future._complete(error=e)
                 raise first_error
         service_s = time.monotonic() - t0
+        if replayed:
+            tracing.event("ops::hash_service", "replay",
+                          requests=len(batch), msgs=len(msgs),
+                          error=replay_err)
         off = 0
+        now_wall = time.time()
         for r in batch:
             r.future._complete(result=digests[off:off + len(r.msgs)])
             off += len(r.msgs)
+            # per-request attribution under the SUBMITTER's trace: queue
+            # wait vs coalesce vs device dispatch vs replay, the split
+            # the block wall-budget line prints
+            if r.ctx is not None:
+                wait_s = t0 - r.enqueued_at
+                tracing.record_span(
+                    "ops::hash_service", "hashsvc.request",
+                    r.wall_at, now_wall - r.wall_at, ctx=r.ctx,
+                    fields={"lane": r.lane, "msgs": len(r.msgs),
+                            "wait_ms": round(wait_s * 1e3, 3),
+                            "service_ms": round(service_s * 1e3, 3),
+                            "coalesced_with": len(batch),
+                            "replayed": replayed, "bypass": bypass})
         self.dispatches += 1
         self.coalesced_requests += len(batch)
         self.hashed_msgs += len(msgs)
         occupancy = len(msgs) / _next_tier(len(msgs), self.min_tier)
+        tracing.record_span(
+            "ops::hash_service",
+            "hashsvc.replay" if replayed
+            else ("hashsvc.bypass" if bypass else "hashsvc.dispatch"),
+            now_wall - service_s, service_s,
+            fields={"requests": len(batch), "msgs": len(msgs),
+                    "occupancy": round(occupancy, 4)})
         self.metrics.record_dispatch(
             requests=len(batch), msgs=len(msgs), occupancy=occupancy,
             service_s=service_s, replayed=replayed)

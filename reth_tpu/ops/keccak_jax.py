@@ -227,8 +227,15 @@ class KeccakDevice:
         return _next_tier(nb, 2 * self.MAX_EXACT_BLOCKS)
 
     def _hash_bucket(self, sub: list[bytes], key: int, counts: np.ndarray) -> np.ndarray:
-        """Hash one bucket; returns (n, 8) uint32 digests."""
+        """Hash one bucket; returns (n, 8) uint32 digests. Every dispatch
+        reports its (program kind, block count, batch tier) shape and wall
+        to the compile tracker: the FIRST call of a shape is its XLA
+        compile, so compile storms show up split from steady-state
+        dispatch instead of masquerading as slow hashing."""
         import os
+        import time as _time
+
+        from ..metrics import compile_tracker
 
         n = len(sub)
         batch_tier = _next_tier(n, self.min_tier)
@@ -239,19 +246,29 @@ class KeccakDevice:
                 from .keccak_pallas import keccak256_pallas_words
 
                 w32 = _to_u32(pad_batch(sub, 1), batch_tier)
-                return np.asarray(keccak256_pallas_words(w32))[:n]
+                t0 = _time.perf_counter()
+                out = np.asarray(keccak256_pallas_words(w32))[:n]
+                compile_tracker.record("keccak.pallas", (1, batch_tier),
+                                       _time.perf_counter() - t0)
+                return out
             except Exception:
                 pass
+        t0 = _time.perf_counter()
         if self.block_tier is None and key <= self.MAX_EXACT_BLOCKS:
+            kind = "keccak.exact"
             w32 = _to_u32(pad_batch(sub, key), batch_tier)
             digests = keccak256_jax_words(jnp.asarray(w32), key)
         else:
+            kind = "keccak.masked"
             words = pad_batch(sub, counts, pad_to_blocks=key)
             w32 = _to_u32(words, batch_tier)
             cnt = np.zeros((batch_tier,), dtype=np.int32)
             cnt[:n] = counts
             digests = keccak256_jax_words_masked(jnp.asarray(w32), key, counts=jnp.asarray(cnt))
-        return np.asarray(digests)[:n]
+        out = np.asarray(digests)[:n]  # D2H sync point: wall is honest here
+        compile_tracker.record(kind, (key, batch_tier),
+                               _time.perf_counter() - t0)
+        return out
 
     def hash_one(self, msg: bytes) -> bytes:
         return self.hash_batch([msg])[0]

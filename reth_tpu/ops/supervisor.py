@@ -45,6 +45,8 @@ import sys
 import threading
 import time
 
+from .. import tracing
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
@@ -103,6 +105,8 @@ def probe_device(budget: float | None = None, *, code: str = PROBE_CODE,
         budget = float(os.environ.get("RETH_TPU_PROBE_TIMEOUT", "120"))
     t0 = time.monotonic()
     if injector is not None and not injector.on_probe():
+        tracing.fault_event("RETH_TPU_FAULT_PROBE_FAIL",
+                            target="ops::supervisor")
         return ProbeResult(False, time.monotonic() - t0,
                            "injected probe failure (RETH_TPU_FAULT_PROBE_FAIL)")
     try:
@@ -111,16 +115,22 @@ def probe_device(budget: float | None = None, *, code: str = PROBE_CODE,
             capture_output=True, text=True, timeout=budget,
         )
     except subprocess.TimeoutExpired:
-        return ProbeResult(False, time.monotonic() - t0,
-                           f"device probe exceeded {budget}s (wedged tunnel?)")
+        diag = f"device probe exceeded {budget}s (wedged tunnel?)"
+        tracing.event("ops::supervisor", "probe", ok=False,
+                      latency_s=round(time.monotonic() - t0, 3), diag=diag)
+        return ProbeResult(False, time.monotonic() - t0, diag)
     except OSError as e:  # pragma: no cover - exec failure
         return ProbeResult(False, time.monotonic() - t0, f"probe spawn failed: {e}")
     latency = time.monotonic() - t0
     if r.returncode == 0 and "PROBE_OK" in r.stdout:
+        tracing.event("ops::supervisor", "probe", ok=True,
+                      latency_s=round(latency, 3))
         return ProbeResult(True, latency)
     tail = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["no output"]
-    return ProbeResult(False, latency,
-                       f"device probe failed rc={r.returncode}: {tail[0][:300]}")
+    diag = f"device probe failed rc={r.returncode}: {tail[0][:300]}"
+    tracing.event("ops::supervisor", "probe", ok=False,
+                  latency_s=round(latency, 3), diag=diag)
+    return ProbeResult(False, latency, diag)
 
 
 def probe_device_retrying(budget: float | None = None, attempts: int | None = None,
@@ -203,6 +213,8 @@ class FaultInjector:
             self.windows += 1
             n = self.windows
         if n == self.pipeline_abort:
+            tracing.fault_event("RETH_TPU_FAULT_PIPELINE_ABORT",
+                                target="trie::pipeline", window=n)
             raise InjectedPipelineAbort(
                 f"injected pipeline abort at window #{n} "
                 f"(RETH_TPU_FAULT_PIPELINE_ABORT={self.pipeline_abort})")
@@ -217,6 +229,8 @@ class FaultInjector:
         if self.wedge_every and n % self.wedge_every == 0:
             with self._lock:
                 self.wedged += 1
+            tracing.fault_event("RETH_TPU_FAULT_WEDGE_EVERY",
+                                target="ops::supervisor", dispatch=n)
             raise InjectedWedge(
                 f"injected wedge on dispatch #{n} "
                 f"(every {self.wedge_every})")
@@ -259,8 +273,18 @@ class CircuitBreaker:
 
     def _set_state(self, state: str) -> None:
         if state != self.state:
-            self.state = state
+            prev, self.state = self.state, state
             self.transitions.append(state)
+            if state == OPEN:
+                # the device route just went dark: this is exactly the
+                # moment a postmortem needs the recent span history
+                # (fault_event = event + rate-limited JSONL snapshot)
+                tracing.fault_event("breaker_open", target="ops::supervisor",
+                                    state=state, previous=prev,
+                                    trips=self.trips)
+            else:
+                tracing.event("ops::supervisor", "breaker",
+                              state=state, previous=prev, trips=self.trips)
 
     def allow(self) -> bool:
         """May a device call proceed right now? OPEN past its cooldown
@@ -441,6 +465,9 @@ class DeviceSupervisor:
             if t.is_alive():
                 self.dispatch_timeouts += 1
                 self.metrics.record_timeout()
+                tracing.fault_event("watchdog_timeout",
+                                    target="ops::supervisor",
+                                    what=what, budget_s=budget)
                 raise DeviceDispatchError(
                     f"device {what} exceeded {budget}s watchdog budget")
             if box[1] is not None:

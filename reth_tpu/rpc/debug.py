@@ -406,3 +406,60 @@ class DebugApi:
             return None
         tx_raw = p.tx.get(Tables.Transactions.name, raw)
         return data(tx_raw) if tx_raw else None
+
+    # -- block-lifecycle observability (tracing.py) -------------------------
+
+    def debug_blockTimeline(self, tag=None):
+        """One block's lifecycle timeline (requires --trace-blocks /
+        RETH_TPU_TRACE): every recorded span/event under the block's
+        trace plus the wall-budget summary. ``tag``: a 0x block hash, a
+        block number/tag resolvable to a canonical hash, or None for the
+        most recently traced block."""
+        from .. import tracing
+        from .server import RpcError
+
+        if not tracing.trace_enabled():
+            raise RpcError(-32000, "block tracing is disabled "
+                                   "(--trace-blocks / RETH_TPU_TRACE)")
+        trace_id = None
+        if tag is None:
+            traces = tracing.recent_traces()
+            if traces:
+                trace_id = traces[-1]
+        elif isinstance(tag, str) and tag.startswith("0x") and len(tag) == 66:
+            trace_id = tag[2:].lower()
+        else:
+            p = self.eth._provider()
+            n = self.eth._resolve_number(tag, p)
+            h = p.canonical_hash(n)
+            trace_id = h.hex() if h is not None else None
+        timeline = (tracing.block_timeline(trace_id)
+                    if trace_id is not None else None)
+        if not timeline:
+            raise RpcError(-32000, f"no timeline recorded for {tag!r}")
+        return {
+            "traceId": trace_id,
+            "summary": tracing.block_summary(trace_id),
+            "spans": timeline,
+        }
+
+    def debug_flightRecorder(self, action="snapshot", limit=256):
+        """The in-memory flight recorder: ``action="snapshot"`` returns
+        the most recent ``limit`` records; ``action="dump"`` snapshots
+        the ring to a JSONL file and returns its path plus every dump
+        written so far (breaker opens, watchdog timeouts, fault drills)."""
+        from .. import tracing
+        from .server import RpcError
+
+        rec = tracing.flight_recorder()
+        if action == "dump":
+            path = tracing.flight_dump("rpc_request")
+            return {"path": path, "dumps": list(rec.dumps)}
+        if action != "snapshot":
+            raise RpcError(-32602, f"unknown action {action!r} "
+                                   "(snapshot | dump)")
+        return {
+            "records": rec.snapshot(int(limit)),
+            "recorded": rec.recorded,
+            "dumps": list(rec.dumps),
+        }

@@ -66,6 +66,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 
+from .. import tracing
 from .server import RpcError
 
 # priority order, highest first — index IS the priority
@@ -138,12 +139,17 @@ class GatewayFaultInjector:
             self.admissions += 1
             if self.admissions % self.shed_every == 0:
                 self.forced_sheds += 1
+                tracing.fault_event("RETH_TPU_FAULT_GATEWAY_SHED",
+                                    target="rpc::gateway",
+                                    admission=self.admissions)
                 return True
         return False
 
     def on_execute(self) -> None:
         """Called before the handler runs (stall drill)."""
         if self.stall:
+            tracing.fault_event("RETH_TPU_FAULT_GATEWAY_STALL",
+                                target="rpc::gateway", stall_s=self.stall)
             time.sleep(self.stall)
 
 
@@ -280,13 +286,20 @@ class RpcGateway:
         if self.injector is not None and self.injector.on_admit():
             self._shed(cls, "fault injection")
         self._admit(cls)
-        self.metrics.record_wait(cls, time.monotonic() - t0)
+        wait_s = time.monotonic() - t0
+        self.metrics.record_wait(cls, wait_s)
         t1 = time.monotonic()
         try:
             if self.injector is not None:
                 self.injector.on_execute()
             self.executions += 1
-            return invoke()
+            # gateway admission + handler execution under one span: an
+            # engine_newPayload's block trace starts INSIDE invoke(), so
+            # this span is the "gateway admission" prefix of its timeline
+            with tracing.span("rpc::gateway", "gateway.execute",
+                              method=method, cls=cls,
+                              wait_ms=round(wait_s * 1e3, 3)):
+                return invoke()
         finally:
             self.metrics.record_service(cls, time.monotonic() - t1)
             self._release(cls)
@@ -294,6 +307,7 @@ class RpcGateway:
     def _shed(self, cls: str, why: str):
         self.sheds += 1
         self.metrics.record_shed(cls)
+        tracing.event("rpc::gateway", "shed", cls=cls, why=why)
         raise RpcError(
             OVERLOADED,
             f"{cls} lane overloaded ({why}); retry after "

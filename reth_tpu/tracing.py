@@ -1,18 +1,59 @@
-"""Tracing/logging: layered init with per-target filters + span timing.
+"""Tracing/logging: layered init with per-target filters, span timing,
+block-lifecycle trace propagation, a bounded flight recorder, and
+Chrome-trace/OTLP span export.
 
 Reference analogue: crates/tracing — stdout/file layers with per-layer
 env filters (src/lib.rs:1-35) and the `target:` discipline (e.g.
 ``trie::state_root``). Built on stdlib logging; `span()` provides the
 timing-span idiom used across the reference's hot paths.
+
+Block-lifecycle layer (this repo's observability tentpole):
+
+- **Trace context** (:class:`TraceContext`): ``trace_id`` (the block hash
+  for block lifecycles) + a process-unique span id. The context lives in
+  thread-local state inside ``span()`` blocks and is carried EXPLICITLY
+  across queue/pool handoffs: a producer captures
+  :func:`current_context`, the consumer adopts it with
+  :func:`use_context` (worker threads) or attributes completed work with
+  :func:`record_span` (batch dispatchers that serve many contexts at
+  once, e.g. the hash service).
+- **Per-block timelines**: every span/event recorded under a trace id
+  lands in a bounded per-trace timeline (:func:`block_timeline`), and
+  closing a :func:`trace_block` root computes the wall-budget summary
+  (:func:`block_summary` / :func:`last_block_summary`) the events
+  dashboard prints: ``block N total=Xms = prewarm a + exec b + root c
+  (wait d, dispatch e, encode f)``.
+- **Flight recorder** (:class:`FlightRecorder`): a bounded in-memory
+  ring of recent spans, events, breaker/fault transitions. Snapshots to
+  JSONL on circuit-breaker open, watchdog timeout, any
+  ``RETH_TPU_FAULT_*`` drill firing (:func:`fault_event`), or on demand
+  (:func:`flight_dump` / the ``debug_flightRecorder`` RPC) — the wedge
+  postmortem the BENCH_r01–r05 zeros never had.
+- **Exporters**: the OTLP/JSON file exporter (below) now carries
+  trace/span/parent ids; :class:`ChromeTraceExporter` writes the same
+  spans as Chrome trace-event JSON that Perfetto / chrome://tracing load
+  directly (``--trace-blocks``).
+
+Enablement: span *recording* is off unless ``RETH_TPU_TRACE`` is set
+truthy or :func:`set_trace_enabled` ran (the ``--trace-blocks`` path);
+when off, ``span()`` costs what it always did (one DEBUG log call).
+Events (:func:`event` / :func:`fault_event`) record into the flight
+recorder regardless — breaker trips and fault drills are rare and are
+exactly what a postmortem needs.
 """
 
 from __future__ import annotations
 
 import contextlib
+import itertools
+import json
 import logging
 import os
 import sys
+import tempfile
+import threading
 import time
+from collections import OrderedDict, deque
 from pathlib import Path
 
 
@@ -54,23 +95,409 @@ def tracer(target: str) -> logging.Logger:
     return logging.getLogger(f"reth_tpu.{target}")
 
 
+# -- trace context ------------------------------------------------------------
+
+_FALSY = ("", "0", "false", "off", "no")
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("RETH_TPU_TRACE", "").lower() not in _FALSY
+
+
+_TRACE_ON = _env_enabled()
+_tls = threading.local()
+_span_ids = itertools.count(1)
+
+
+class TraceContext:
+    """A propagated trace position: ``trace_id`` (block hash hex for
+    block lifecycles) + the current span id (None at the trace root)."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str | None, span_id: int | None = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"TraceContext({self.trace_id!r}, span={self.span_id})"
+
+
+def set_trace_enabled(on: bool) -> None:
+    """Master switch for span recording (``--trace-blocks`` /
+    ``RETH_TPU_TRACE``). Off = ``span()`` reverts to its log-only cost."""
+    global _TRACE_ON
+    _TRACE_ON = bool(on)
+
+
+def trace_enabled() -> bool:
+    return _TRACE_ON
+
+
+def current_context() -> TraceContext | None:
+    """The calling thread's trace position (None outside any span, or
+    with tracing disabled). Capture this BEFORE handing work to a queue
+    or pool; the consumer adopts it with :func:`use_context`."""
+    return getattr(_tls, "ctx", None)
+
+
+@contextlib.contextmanager
+def use_context(ctx: TraceContext | None):
+    """Adopt a propagated context in a worker thread for the duration of
+    the block — the consumer half of every queue/pool handoff."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
 @contextlib.contextmanager
 def span(target: str, name: str, level: int = logging.DEBUG, **fields):
-    """Timed span: logs entry fields + exit duration (tracing-span idiom)."""
+    """Timed span: logs entry fields + exit duration (tracing-span idiom).
+
+    With tracing enabled the span joins the current thread's trace
+    (parent/child ids), records into the flight recorder + per-trace
+    timeline, and exports to the installed OTLP/Chrome exporters."""
     log = tracer(target)
     t0 = time.time()
+    parent = None
+    ctx = None
+    if _TRACE_ON:
+        parent = getattr(_tls, "ctx", None)
+        ctx = TraceContext(parent.trace_id if parent is not None else None,
+                           next(_span_ids))
+        _tls.ctx = ctx
     err = None
     try:
-        yield
+        yield ctx
     except BaseException as e:
         err = type(e).__name__
         raise
     finally:
         dt = time.time() - t0
+        if ctx is not None:
+            _tls.ctx = parent
         extra = " ".join(f"{k}={v}" for k, v in fields.items())
         log.log(level, "%s %s took %.3fms", name, extra, dt * 1e3)
         if _otlp is not None:
-            _otlp.export(target, name, t0, dt, fields, err)
+            _otlp.export(target, name, t0, dt, fields, err,
+                         ctx=ctx, parent=parent)
+        if ctx is not None:
+            _record({
+                "kind": "span", "target": target, "name": name,
+                "ts": t0, "dur_ms": round(dt * 1e3, 3),
+                "trace": ctx.trace_id, "span": ctx.span_id,
+                "parent": parent.span_id if parent is not None else None,
+                "thread": threading.current_thread().name,
+                "fields": fields, "error": err,
+            })
+
+
+def record_span(target: str, name: str, start: float, duration: float, *,
+                ctx: TraceContext | None = None, fields: dict | None = None,
+                error: str | None = None) -> None:
+    """Record an already-timed span under ``ctx`` — the attribution path
+    for batch dispatchers that complete work for MANY contexts at once
+    (hash-service requests, proof shards): the producer captured the
+    context at submit time, the completion attributes the wall to it."""
+    if not _TRACE_ON:
+        return
+    rec = {
+        "kind": "span", "target": target, "name": name,
+        "ts": start, "dur_ms": round(duration * 1e3, 3),
+        "trace": ctx.trace_id if ctx is not None else None,
+        "span": next(_span_ids),
+        "parent": ctx.span_id if ctx is not None else None,
+        "thread": threading.current_thread().name,
+        "fields": fields or {}, "error": error,
+    }
+    _record(rec)
+
+
+def event(target: str, name: str, **fields) -> None:
+    """Instant event (breaker transition, probe outcome, fault firing).
+    Always lands in the flight recorder — these are the rare records a
+    postmortem is made of — and in the current trace's timeline when
+    span recording is on."""
+    ctx = getattr(_tls, "ctx", None) if _TRACE_ON else None
+    _record({
+        "kind": "event", "target": target, "name": name,
+        "ts": time.time(), "dur_ms": 0.0,
+        "trace": ctx.trace_id if ctx is not None else None,
+        "span": None,
+        "parent": ctx.span_id if ctx is not None else None,
+        "thread": threading.current_thread().name,
+        "fields": fields, "error": None,
+    }, always=True)
+
+
+# -- per-block timelines ------------------------------------------------------
+
+_TL_LOCK = threading.Lock()
+_TIMELINES: OrderedDict[str, list] = OrderedDict()
+_SUMMARIES: OrderedDict[str, dict] = OrderedDict()
+_MAX_TRACES = 64
+_MAX_TIMELINE_RECORDS = 8192
+_last_summary: dict | None = None
+
+
+@contextlib.contextmanager
+def trace_block(trace_id: str, name: str = "block",
+                target: str = "engine::block", **fields):
+    """Root span of one block lifecycle: ``trace_id`` (the block hash
+    hex) seeds every child span on this thread and every explicitly
+    propagated context; closing computes the wall-budget summary."""
+    if not _TRACE_ON:
+        yield None
+        return
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = TraceContext(trace_id, None)  # trace seed: root has no parent
+    with _TL_LOCK:
+        _TIMELINES.setdefault(trace_id, [])
+        _TIMELINES.move_to_end(trace_id)
+        while len(_TIMELINES) > _MAX_TRACES:
+            dead, _ = _TIMELINES.popitem(last=False)
+            _SUMMARIES.pop(dead, None)
+    try:
+        with span(target, name, **fields) as ctx:
+            yield ctx
+    finally:
+        _tls.ctx = prev
+        _finalize_block(trace_id)
+
+
+def _record(rec: dict, always: bool = False) -> None:
+    if _TRACE_ON or always:
+        _RECORDER.record(rec)
+    if _chrome is not None and (_TRACE_ON or always):
+        _chrome.export(rec)
+    trace = rec.get("trace")
+    if trace is None:
+        return
+    with _TL_LOCK:
+        tl = _TIMELINES.get(trace)
+        if tl is not None and len(tl) < _MAX_TIMELINE_RECORDS:
+            tl.append(rec)
+
+
+def block_timeline(trace_id: str) -> list[dict] | None:
+    """All records of one trace (block), oldest first; None if unknown."""
+    with _TL_LOCK:
+        tl = _TIMELINES.get(trace_id)
+        return list(tl) if tl is not None else None
+
+
+def recent_traces() -> list[str]:
+    """Known trace ids, oldest first."""
+    with _TL_LOCK:
+        return list(_TIMELINES)
+
+
+def _sum_field(records, names, field) -> float:
+    return sum(float(r["fields"].get(field, 0.0)) for r in records
+               if r["name"] in names)
+
+
+def _summarize(trace_id: str, records: list[dict]) -> dict | None:
+    root = next((r for r in records
+                 if r["kind"] == "span" and r["parent"] is None), None)
+    if root is None:
+        return None
+
+    def dur_of(name: str) -> float:
+        return sum(r["dur_ms"] for r in records
+                   if r["kind"] == "span" and r["name"] == name)
+
+    spans = [r for r in records if r["kind"] == "span"]
+    # accounted wall: union of direct-child intervals over the root span
+    children = sorted(((r["ts"], r["ts"] + r["dur_ms"] / 1e3)
+                       for r in spans if r["parent"] == root["span"]))
+    covered, cur_lo, cur_hi = 0.0, None, None
+    for lo, hi in children:
+        if cur_hi is None or lo > cur_hi:
+            if cur_hi is not None:
+                covered += cur_hi - cur_lo
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    if cur_hi is not None:
+        covered += cur_hi - cur_lo
+    total_ms = root["dur_ms"]
+    summary = {
+        "trace": trace_id,
+        "number": root["fields"].get("number"),
+        "total_ms": total_ms,
+        "prewarm_ms": round(dur_of("prewarm"), 3),
+        "exec_ms": round(dur_of("execute"), 3),
+        "root_ms": round(dur_of("state_root"), 3),
+        # hash-service attribution: queue-wait vs device dispatch (with no
+        # service the direct hash.dispatch spans carry the dispatch wall)
+        "wait_ms": round(_sum_field(records, ("hashsvc.request",), "wait_ms"), 3),
+        "dispatch_ms": round(
+            _sum_field(records, ("hashsvc.request",), "service_ms")
+            if any(r["name"] == "hashsvc.request" for r in records)
+            else dur_of("hash.dispatch"), 3),
+        "encode_ms": round(dur_of("sparse.encode"), 3),
+        "spans": len(spans),
+        "coverage": round(covered * 1e3 / total_ms, 4) if total_ms else 1.0,
+    }
+    return summary
+
+
+def _finalize_block(trace_id: str) -> None:
+    global _last_summary
+    records = block_timeline(trace_id)
+    if not records:
+        return
+    summary = _summarize(trace_id, records)
+    if summary is None:
+        return
+    with _TL_LOCK:
+        _SUMMARIES[trace_id] = summary
+        while len(_SUMMARIES) > _MAX_TRACES:
+            _SUMMARIES.popitem(last=False)
+    _last_summary = summary
+
+
+def block_summary(trace_id: str) -> dict | None:
+    """Wall-budget summary of one closed block trace."""
+    with _TL_LOCK:
+        s = _SUMMARIES.get(trace_id)
+    if s is not None:
+        return s
+    records = block_timeline(trace_id)
+    return _summarize(trace_id, records) if records else None
+
+
+def last_block_summary() -> dict | None:
+    """The most recently closed block's wall budget (events dashboard)."""
+    return _last_summary
+
+
+def format_wall_budget(s: dict) -> str:
+    """The one-line per-block budget operators read:
+    ``block N total=Xms = prewarm a + exec b + root c (wait d, dispatch
+    e, encode f)``."""
+    return (f"block {s.get('number', '?')} total={s['total_ms']:.1f}ms = "
+            f"prewarm {s['prewarm_ms']:.1f} + exec {s['exec_ms']:.1f} + "
+            f"root {s['root_ms']:.1f} (wait {s['wait_ms']:.1f}, "
+            f"dispatch {s['dispatch_ms']:.1f}, encode {s['encode_ms']:.1f})")
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring of recent spans/events/fault transitions, snapshotted
+    to JSONL when something goes wrong (breaker open, watchdog timeout,
+    a RETH_TPU_FAULT_* drill firing) or on demand."""
+
+    def __init__(self, capacity: int = 4096, directory: str | Path | None = None):
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=capacity)
+        self.directory = directory
+        self.dumps: list[str] = []  # paths written, oldest first
+        self.recorded = 0
+
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            self._buf.append(rec)
+            self.recorded += 1
+
+    def snapshot(self, n: int | None = None) -> list[dict]:
+        with self._lock:
+            out = list(self._buf)
+        return out[-n:] if n else out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def _dir(self) -> Path:
+        d = (self.directory or os.environ.get("RETH_TPU_FLIGHT_DIR")
+             or Path(tempfile.gettempdir()) / "reth_tpu_flight")
+        d = Path(d)
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def dump(self, reason: str, path: str | Path | None = None) -> str | None:
+        """Write the ring (oldest first) as JSONL: one header line
+        ``{"kind": "flight_snapshot", "reason", "ts", "records"}`` then
+        one line per record. Returns the path, or None on an empty ring.
+        Never raises — a diagnostics failure must not fail the caller."""
+        try:
+            records = self.snapshot()
+            if not records:
+                return None
+            if path is None:
+                safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                               for c in reason)[:60]
+                path = self._dir() / (
+                    f"flight-{safe}-{int(time.time() * 1e3)}-"
+                    f"{os.getpid()}.jsonl")
+            path = Path(path)
+            with open(path, "w") as f:
+                f.write(json.dumps({
+                    "kind": "flight_snapshot", "reason": reason,
+                    "ts": time.time(), "records": len(records)}) + "\n")
+                for rec in records:
+                    f.write(json.dumps(rec, default=str) + "\n")
+            self.dumps.append(str(path))
+            return str(path)
+        except Exception:  # noqa: BLE001 — diagnostics only
+            return None
+
+
+_RECORDER = FlightRecorder()
+
+
+def flight_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def flight_snapshot(n: int | None = None) -> list[dict]:
+    return _RECORDER.snapshot(n)
+
+
+def flight_dump(reason: str, path: str | Path | None = None) -> str | None:
+    """Snapshot the flight recorder to JSONL now (see the triggers in the
+    module docstring)."""
+    return _RECORDER.dump(reason, path)
+
+
+def load_flight_dump(path: str | Path) -> tuple[dict, list[dict]]:
+    """Parse a flight-recorder JSONL dump -> (header, records)."""
+    lines = Path(path).read_text().splitlines()
+    header = json.loads(lines[0])
+    return header, [json.loads(line) for line in lines[1:]]
+
+
+_fault_lock = threading.Lock()
+_fault_last_dump: dict[str, float] = {}
+FAULT_DUMP_INTERVAL_S = 5.0
+
+
+def reset_fault_dump_limits() -> None:
+    """Forget per-drill dump rate limits (tests / operator reset)."""
+    with _fault_lock:
+        _fault_last_dump.clear()
+
+
+def fault_event(drill: str, target: str = "fault", **fields) -> str | None:
+    """A RETH_TPU_FAULT_* drill (or real failure trigger) fired: record
+    the event and snapshot the flight recorder, rate-limited per drill
+    name so wedge-every-dispatch drills don't spray the disk. Returns
+    the dump path when one was written."""
+    event(target, drill, **fields)
+    now = time.monotonic()
+    with _fault_lock:
+        last = _fault_last_dump.get(drill, 0.0)
+        if now - last < FAULT_DUMP_INTERVAL_S:
+            return None
+        _fault_last_dump[drill] = now
+    return flight_dump(drill)
 
 
 # -- OTLP export (reference crates/tracing-otlp) ------------------------------
@@ -84,38 +511,43 @@ _otlp = None
 
 class OtlpFileExporter:
     def __init__(self, path: str | Path, service_name: str = "reth-tpu"):
-        import json as _json
-        import threading
-
-        self._json = _json
         self._lock = threading.Lock()
         self._f = open(path, "a", buffering=1)
         self.service_name = service_name
         self.exported = 0
 
     def export(self, target: str, name: str, start: float, duration: float,
-               fields: dict, error: str | None) -> None:
+               fields: dict, error: str | None,
+               ctx: TraceContext | None = None,
+               parent: TraceContext | None = None) -> None:
+        sp = {
+            "name": name,
+            "startTimeUnixNano": str(int(start * 1e9)),
+            "endTimeUnixNano": str(int((start + duration) * 1e9)),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in fields.items()
+            ],
+            "status": ({"code": 2, "message": error} if error
+                       else {"code": 1}),
+        }
+        if ctx is not None:
+            if ctx.trace_id is not None:
+                sp["traceId"] = str(ctx.trace_id)
+            sp["spanId"] = format(ctx.span_id or 0, "016x")
+            if parent is not None and parent.span_id is not None:
+                sp["parentSpanId"] = format(parent.span_id, "016x")
         span_rec = {
             "resource": {"attributes": [
                 {"key": "service.name",
                  "value": {"stringValue": self.service_name}}]},
             "scopeSpans": [{
                 "scope": {"name": f"reth_tpu.{target}"},
-                "spans": [{
-                    "name": name,
-                    "startTimeUnixNano": str(int(start * 1e9)),
-                    "endTimeUnixNano": str(int((start + duration) * 1e9)),
-                    "attributes": [
-                        {"key": k, "value": {"stringValue": str(v)}}
-                        for k, v in fields.items()
-                    ],
-                    "status": ({"code": 2, "message": error} if error
-                               else {"code": 1}),
-                }],
+                "spans": [sp],
             }],
         }
         with self._lock:
-            self._f.write(self._json.dumps(span_rec) + "\n")
+            self._f.write(json.dumps(span_rec) + "\n")
             self.exported += 1
 
     def close(self) -> None:
@@ -135,3 +567,117 @@ def shutdown_otlp() -> None:
     if _otlp is not None:
         _otlp.close()
         _otlp = None
+
+
+# -- Chrome trace-event export ------------------------------------------------
+# The format chrome://tracing and Perfetto's JSON importer load directly:
+# one "X" (complete) event per span, instant events as "i". Written one
+# event per line so the file doubles as JSON-lines for tooling; close()
+# terminates it into a fully valid JSON array.
+
+_chrome = None
+
+
+class ChromeTraceExporter:
+    """Spans/events as Chrome trace-event JSON (``--trace-blocks``)."""
+
+    def __init__(self, path: str | Path):
+        self._lock = threading.Lock()
+        self.path = str(path)
+        self._f = open(path, "w", buffering=1)
+        self._f.write("[\n")
+        self._tids: dict[str, int] = {}
+        self.exported = 0
+
+    def _tid(self, thread_name: str) -> int:
+        tid = self._tids.get(thread_name)
+        if tid is None:
+            tid = self._tids[thread_name] = len(self._tids) + 1
+        return tid
+
+    def export(self, rec: dict) -> None:
+        args = {k: str(v) for k, v in rec.get("fields", {}).items()}
+        if rec.get("trace"):
+            args["trace_id"] = rec["trace"]
+        if rec.get("span") is not None:
+            args["span_id"] = rec["span"]
+        if rec.get("parent") is not None:
+            args["parent_id"] = rec["parent"]
+        if rec.get("error"):
+            args["error"] = rec["error"]
+        ev = {
+            "name": rec["name"],
+            "cat": rec["target"],
+            "ph": "X" if rec["kind"] == "span" else "i",
+            "ts": round(rec["ts"] * 1e6, 1),
+            "pid": os.getpid(),
+            "args": args,
+        }
+        if rec["kind"] == "span":
+            ev["dur"] = round(rec["dur_ms"] * 1e3, 1)
+        else:
+            ev["s"] = "p"  # process-scoped instant
+        with self._lock:
+            ev["tid"] = self._tid(rec.get("thread", "main"))
+            self._f.write(json.dumps(ev) + ",\n")
+            self.exported += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                # terminate the array so the file is strictly valid JSON
+                self._f.write(json.dumps(
+                    {"name": "trace_end", "ph": "i", "ts": time.time() * 1e6,
+                     "pid": os.getpid(), "tid": 0, "s": "g", "args": {}})
+                    + "\n]\n")
+                self._f.close()
+
+
+def init_chrome_trace(path: str | Path) -> ChromeTraceExporter:
+    """Install the Chrome trace-event exporter for every recorded span."""
+    global _chrome
+    _chrome = ChromeTraceExporter(path)
+    return _chrome
+
+
+def shutdown_chrome_trace() -> None:
+    global _chrome
+    if _chrome is not None:
+        _chrome.close()
+        _chrome = None
+
+
+def read_chrome_trace(path: str | Path) -> list[dict]:
+    """Tolerant loader for a (possibly still-open) Chrome trace file:
+    each line holds one event object (JSON-lines view of the array)."""
+    out = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip().rstrip(",")
+        if line in ("", "[", "]"):
+            continue
+        out.append(json.loads(line))
+    return out
+
+
+def init_block_tracing(chrome_path: str | Path | None = None,
+                       otlp_path: str | Path | None = None,
+                       flight_dir: str | Path | None = None,
+                       capacity: int | None = None) -> None:
+    """The ``--trace-blocks`` bundle: enable span recording, install the
+    requested exporters, and point flight-recorder dumps at a directory."""
+    set_trace_enabled(True)
+    if chrome_path is not None:
+        init_chrome_trace(chrome_path)
+    if otlp_path is not None:
+        init_otlp(otlp_path)
+    if flight_dir is not None:
+        _RECORDER.directory = flight_dir
+    if capacity is not None and capacity != _RECORDER._buf.maxlen:
+        with _RECORDER._lock:
+            _RECORDER._buf = deque(_RECORDER._buf, maxlen=capacity)
+
+
+def shutdown_block_tracing() -> None:
+    shutdown_chrome_trace()
+    shutdown_otlp()
+    set_trace_enabled(_env_enabled())

@@ -32,6 +32,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
+from .. import tracing
 from ..primitives.keccak import keccak256, keccak256_batch_np
 from ..primitives.nibbles import (
     Nibbles,
@@ -486,6 +487,10 @@ class SparseFaultInjector:
         if self.abort_at and n == self.abort_at:
             with self._lock:
                 self.aborts += 1
+            from .. import tracing
+
+            tracing.fault_event("RETH_TPU_FAULT_SPARSE_ABORT",
+                                target="trie::sparse", dispatch=n)
             raise InjectedSparseAbort(
                 f"injected sparse-commit abort on dispatch #{n} "
                 f"(RETH_TPU_FAULT_SPARSE_ABORT={self.abort_at})")
@@ -497,6 +502,10 @@ class SparseFaultInjector:
         if self.proof_wedge_every and n % self.proof_wedge_every == 0:
             with self._lock:
                 self.wedges += 1
+            from .. import tracing
+
+            tracing.fault_event("RETH_TPU_FAULT_SPARSE_PROOF_WEDGE",
+                                target="trie::sparse", fetch=n)
             raise RuntimeError(
                 f"injected sparse proof wedge on fetch #{n} "
                 f"(RETH_TPU_FAULT_SPARSE_PROOF_WEDGE="
@@ -664,6 +673,16 @@ class ParallelSparseCommitter:
 
         levels = self._collect([t for _, t in live])
         use_streaming = hasattr(hasher, "submit")
+        encode_wall = [0.0]  # summed per-chunk encode time (pool-side)
+
+        def _encode_chunk(c):
+            t0 = time.perf_counter()
+            out = [_encode_rlp(n) for n in c]
+            dt = time.perf_counter() - t0
+            with self._pool_lock:
+                encode_wall[0] += dt
+            return out
+
         for depth in sorted(levels, reverse=True):
             entries = levels[depth]
             stats["levels"] += 1
@@ -681,8 +700,7 @@ class ParallelSparseCommitter:
             stats["encode_chunks"] += len(chunks)
             pool = self._executor()
             sparse_commit_metrics.set_encode_busy(len(chunks))
-            futs = [pool.submit(lambda c=c: [_encode_rlp(n) for n in c])
-                    for c in chunks]
+            futs = [pool.submit(_encode_chunk, c) for c in chunks]
             try:
                 if use_streaming:
                     # live-lane streaming: each encoded chunk's >=32 B rows
@@ -722,11 +740,20 @@ class ParallelSparseCommitter:
             self.injector.on_dispatch()
         tops = [_encode_rlp(t.root) for _, t in live]
         stats["dispatches"] += 1
-        digests = hasher(tops)
+        with tracing.span("trie::sparse", "hash.dispatch", msgs=len(tops),
+                          what="trie_tops"):
+            digests = hasher(tops)
         for (i, t), d in zip(live, digests):
             t.root_hash = bytes(d)
             t.updates = 0
             roots[i] = t.root_hash
+        if encode_wall[0]:
+            # encode-pool attribution: summed worker-side walls (chunks run
+            # concurrently, so this is work, not wall clock)
+            tracing.record_span("trie::sparse", "sparse.encode",
+                                time.time() - encode_wall[0], encode_wall[0],
+                                ctx=tracing.current_context(),
+                                fields={"chunks": stats["encode_chunks"]})
         stats["wall_s"] = round(time.perf_counter() - t_wall, 6)
         self.last = stats
         sparse_commit_metrics.record_commit(stats)
@@ -740,7 +767,9 @@ class ParallelSparseCommitter:
                 n._ref = r  # inline ref
         if to_hash:
             stats["dispatches"] += 1
-            digests = hasher([r for _, r in to_hash])
+            with tracing.span("trie::sparse", "hash.dispatch",
+                              msgs=len(to_hash), what="level"):
+                digests = hasher([r for _, r in to_hash])
             for (n, _r), d in zip(to_hash, digests):
                 n._ref = encode_hash_ref(bytes(d))
                 stats["hashed"] += 1

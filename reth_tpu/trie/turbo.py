@@ -33,6 +33,7 @@ from pathlib import Path
 
 import numpy as np
 
+from .. import tracing
 from ..primitives.keccak import (
     RATE,
     keccak256,
@@ -634,6 +635,8 @@ class RebuildPipeline:
         ensured = [0]
         drained = [0]
 
+        trace_ctx = tracing.current_context()
+
         def flush(window: list[_SweepResult]) -> None:
             t0 = time.perf_counter()
             parts = []
@@ -658,11 +661,20 @@ class RebuildPipeline:
 
             def dispatch():
                 t1 = time.perf_counter()
+                t1_wall = time.time()
                 for m in merged:
                     backend.dispatch_packed(m.flat, m.row_off, m.row_len,
                                             m.row_slot, m.holes, m.b_tier)
                     backend.dispatch_branch(m.masks, m.bmp_slot, m.children)
-                stages["dispatch"] += time.perf_counter() - t1
+                dt = time.perf_counter() - t1
+                stages["dispatch"] += dt
+                # window dispatch may run on the hash pool: attribute it to
+                # the rebuild's trace explicitly (queue/pool handoff)
+                tracing.record_span(
+                    "trie::pipeline", "rebuild.window", t1_wall, dt,
+                    ctx=trace_ctx,
+                    fields={"levels": len(merged),
+                            "subtries": len(window)})
 
             if hash_pool is not None and not failed_over:
                 pending.append(hash_pool.submit(dispatch))
@@ -717,11 +729,17 @@ class RebuildPipeline:
                 except queue_mod.Empty:
                     break
             met.set_queue_depth(0)
+            wall_s = time.perf_counter() - t_wall
             met.record_run(
                 jobs=len(jobs), groups=len(groups), windows=self.windows,
                 queue_peak=self.queue_peak, drained_windows=drained[0],
                 backend=getattr(backend, "effective_kind", None),
-                wall_s=time.perf_counter() - t_wall, **stages)
+                wall_s=wall_s, **stages)
+            tracing.record_span(
+                "trie::pipeline", "rebuild", time.time() - wall_s, wall_s,
+                ctx=trace_ctx,
+                fields={"jobs": len(jobs), "windows": self.windows,
+                        **{k: round(v, 4) for k, v in stages.items()}})
 
     def _collect(self, swept, results, collect_branches, start_depth, stages):
         t0 = time.perf_counter()
