@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-gateway test-obs native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -39,6 +39,18 @@ test-sparse:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_sparse_parallel.py tests/test_sparse.py \
 	  tests/test_sparse_root_engine.py -q -p no:cacheprovider
+
+# optimistic parallel execution (part of the default `make test` sweep):
+# randomized differential parity vs the serial executor across conflict
+# rates / worker counts / coinbase-sensitive ranks / mid-block reverts,
+# the BAL + native-core equivalence suites it builds on, the
+# RETH_TPU_FAULT_EXEC_* conflict-storm and rank-wedge drills (serial
+# fallback ladder), and a threaded stress run over the shared native
+# core — CPU-only
+test-parallel:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_parallel_exec.py tests/test_bal.py \
+	  tests/test_native_exec.py -q -p no:cacheprovider
 
 # RPC serving gateway: threaded coalescing stress (bit-identical to the
 # ungated path), priority/shed behavior under full queues, head-change

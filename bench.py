@@ -1,13 +1,20 @@
-"""Benchmark: MerkleStage-style full state-root rebuild on the device.
+"""Benchmark suite. DEFAULT mode (``RETH_TPU_BENCH_MODE`` unset or
+``exec``): optimistic parallel EVM execution vs the serial interpreter —
+a CPU-measurable number (engine/optimistic.py + native/evmexec.cpp), so
+the perf trajectory records a real measurement even while the device
+tunnel is wedged (five rounds of rc=2/value=0 taught us that lesson).
+``RETH_TPU_BENCH_MODE=rebuild`` selects the original device state-root
+rebuild benchmark described below; ``service``/``sparse``/``gateway``
+select the other subsystem benches.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "backend"}.
-``backend`` records which hashing plane actually produced the number:
-"device" (healthy tunnel) or "numpy" (CPU fallback). A wedged/absent
-tunnel no longer yields rc=2 with value 0 — it records the OVERLAPPED
-rebuild pipeline's CPU rate (trie/turbo.RebuildPipeline: pooled native
-sweeps + cross-subtrie level packing + resident digest arena) with
-``vs_baseline`` = speedup over the seed's serial per-subtrie chunked
-path, roots bit-identical, and exits 0.
+``backend`` records which plane actually produced the number. A
+wedged/absent tunnel no longer yields rc=2 with value 0 — the rebuild
+mode records the OVERLAPPED rebuild pipeline's CPU rate
+(trie/turbo.RebuildPipeline: pooled native sweeps + cross-subtrie level
+packing + resident digest arena) with ``vs_baseline`` = speedup over
+the seed's serial per-subtrie chunked path, roots bit-identical, and
+exits 0.
 
 Workload = benchmark config #2/#3 in miniature (BASELINE.md): a synthetic
 hashed state (accounts + storage slots) is committed bottom-up with the
@@ -509,20 +516,148 @@ def run_sparse_mode() -> None:
           exit_code=0)
 
 
+def _exec_bench_block(n_txs: int, conflict_rate: float, reps: int):
+    """One synthetic block: every tx calls a compute-heavy store contract
+    (``reps`` unrolled MUL/ADD units then SSTORE slot0 — natively
+    executable, interpreter-expensive). A ``conflict_rate`` fraction of
+    ranks call ONE shared contract (write-after-write on the same slot —
+    those ranks invalidate and re-run serially); the rest each own a
+    private contract, so their writes are fully disjoint. Senders are
+    synthetic (the executor trusts the provided sender list), so the
+    workload needs no signing."""
+    from reth_tpu.evm.executor import InMemoryStateSource
+    from reth_tpu.primitives import Account
+    from reth_tpu.primitives.keccak import keccak256
+    from reth_tpu.primitives.types import Block, Header, Transaction
+
+    # PUSH0 CALLDATALOAD; reps x (PUSH1 31 MUL PUSH1 7 ADD); DUP1 PUSH0
+    # SSTORE; STOP — seed-dependent compute chain ending in one store
+    code = (b"\x5f\x35" + bytes.fromhex("601f02600701") * reps
+            + bytes.fromhex("805f5500"))
+    ch = keccak256(code)
+    senders = [bytes([0xA0]) + i.to_bytes(19, "big") for i in range(n_txs)]
+    accounts = {s: Account(balance=10**20) for s in senders}
+    shared = b"\x5e" * 20
+    accounts[shared] = Account(code_hash=ch)
+    txs = []
+    stride = int(1 / conflict_rate) if conflict_rate else 0
+    for i in range(n_txs):
+        if stride and i % stride == 0:
+            to = shared  # conflicting rank: same contract, same slot
+        else:
+            to = bytes([0x5C]) + i.to_bytes(19, "big")
+            accounts[to] = Account(code_hash=ch)
+        txs.append(Transaction(
+            tx_type=2, chain_id=1, nonce=0, max_fee_per_gas=100 * 10**9,
+            max_priority_fee_per_gas=10**9, gas_limit=500_000, to=to,
+            value=0, data=(0xBEEF00 + i).to_bytes(32, "big")))
+    header = Header(number=1, gas_limit=10**9, base_fee_per_gas=7,
+                    beneficiary=b"\xc0" * 20)
+    block = Block(header, tuple(txs), (), ())
+
+    def mk_source():
+        return InMemoryStateSource(dict(accounts), codes={ch: code})
+
+    return block, senders, mk_source
+
+
+def run_exec_mode() -> None:
+    """RETH_TPU_BENCH_MODE=exec (the DEFAULT): optimistic parallel block
+    execution (engine/optimistic.py — Block-STM-style native speculation
+    + read-set validation + async storage prefetch) vs the serial
+    ``BlockExecutor`` interpreter, parameterized by conflict rate.
+    Receipts and post state are verified bit-identical before any number
+    is emitted. Headline = txs/s at 0% conflicts; ``vs_baseline`` = the
+    serial wall over the optimistic wall on that workload. Extras carry
+    the 10%/50%-conflict points and a workers=1 run (scheduler overhead
+    floor / thread-scaling reference). Env: RETH_TPU_BENCH_EXEC_TXS
+    (default 384), RETH_TPU_BENCH_EXEC_WORKERS (default 8),
+    RETH_TPU_BENCH_EXEC_REPS (compute units per tx, default 400)."""
+    from reth_tpu.engine.optimistic import execute_block_optimistic
+    from reth_tpu.evm import BlockExecutor, EvmConfig
+
+    n_txs = int(os.environ.get("RETH_TPU_BENCH_EXEC_TXS", "384"))
+    workers = int(os.environ.get("RETH_TPU_BENCH_EXEC_WORKERS", "8"))
+    reps = int(os.environ.get("RETH_TPU_BENCH_EXEC_REPS", "400"))
+    cfg = EvmConfig(chain_id=1)
+    _STATE["metric"] = "exec_parallel_txs_per_sec"
+    _STATE["unit"] = "txs/s"
+    _STATE["backend"] = "cpu"
+    per_rate = {}
+    headline = None
+    for rate in (0.0, 0.1, 0.5):
+        _STATE["phase"] = f"exec bench: build block ({rate:.0%} conflicts)"
+        block, senders, mk_source = _exec_bench_block(n_txs, rate, reps)
+        # warm: native library build + first-call allocations stay out of
+        # the measured walls
+        execute_block_optimistic(mk_source(), block, senders, cfg,
+                                 max_workers=workers)
+        _STATE["phase"] = f"exec bench: serial pass ({rate:.0%} conflicts)"
+        t0 = time.time()
+        serial = BlockExecutor(mk_source(), cfg).execute(block, senders)
+        dt_serial = time.time() - t0
+        _STATE["phase"] = f"exec bench: optimistic pass ({rate:.0%})"
+        t0 = time.time()
+        out, stats = execute_block_optimistic(mk_source(), block, senders,
+                                              cfg, max_workers=workers)
+        dt_opt = time.time() - t0
+        _STATE["phase"] = f"exec bench: verify receipts ({rate:.0%})"
+        if [r.encode_2718() for r in serial.receipts] != \
+                [r.encode_2718() for r in out.receipts] or \
+                serial.post_accounts != out.post_accounts or \
+                serial.post_storage != out.post_storage or \
+                serial.gas_used != out.gas_used:
+            _emit(0, 0, error=f"optimistic/serial output mismatch at "
+                              f"{rate:.0%} conflicts", exit_code=1)
+        if stats.get("native"):
+            _STATE["backend"] = "native-cpu"
+        per_rate[f"{rate:.0%}"] = {
+            "serial_wall_s": round(dt_serial, 4),
+            "optimistic_wall_s": round(dt_opt, 4),
+            "speedup": round(dt_serial / dt_opt, 3),
+            "txs_per_sec": round(n_txs / dt_opt, 1),
+            "serial_txs_per_sec": round(n_txs / dt_serial, 1),
+            "rounds": stats.get("rounds"), "native": stats.get("native"),
+            "conflicts": stats.get("conflicts"),
+            "serial_reruns": stats.get("serial_rerun"),
+            "prefetched": stats.get("prefetched"),
+            "fallback": stats.get("fallback"),
+        }
+        if rate == 0.0:
+            headline = (round(n_txs / dt_opt, 1),
+                        round(dt_serial / dt_opt, 3))
+    # scheduler overhead floor: same 0%-conflict block at ONE worker
+    _STATE["phase"] = "exec bench: workers=1 reference"
+    block, senders, mk_source = _exec_bench_block(n_txs, 0.0, reps)
+    t0 = time.time()
+    execute_block_optimistic(mk_source(), block, senders, cfg, max_workers=1)
+    per_rate["0%"]["workers1_wall_s"] = round(time.time() - t0, 4)
+    _STATE["device_result"] = headline[0]
+    _emit(headline[0], headline[1], txs=n_txs, workers=workers,
+          compute_reps=reps, conflict_rates=per_rate,
+          receipts_identical=True, exit_code=0)
+
+
 def main():
     # record spans/events from the start: the flight-recorder excerpt in
     # any error line needs the trail (probe attempts, first compiles)
     from reth_tpu import tracing
 
     tracing.set_trace_enabled(True)
-    if os.environ.get("RETH_TPU_BENCH_MODE") == "service":
+    mode = os.environ.get("RETH_TPU_BENCH_MODE", "exec")
+    if mode == "service":
         run_service_mode()
         return
-    if os.environ.get("RETH_TPU_BENCH_MODE") == "sparse":
+    if mode == "sparse":
         run_sparse_mode()
         return
-    if os.environ.get("RETH_TPU_BENCH_MODE") == "gateway":
+    if mode == "gateway":
         run_gateway_mode()
+        return
+    if mode == "exec":
+        # the DEFAULT: CPU-measurable optimistic parallel execution — the
+        # perf trajectory records a real number with or without a device
+        run_exec_mode()
         return
     n_accounts = int(os.environ.get("RETH_TPU_BENCH_ACCOUNTS", "150000"))
     n_slots = int(os.environ.get("RETH_TPU_BENCH_SLOTS", "60000"))

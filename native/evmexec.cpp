@@ -25,8 +25,9 @@
 //        u64 gas_limit, 32B eff_gas_price BE, 32B balance_fee_cap BE,
 //        u64 intrinsic, u64 floor, u8 tx_type, u32 data_len, data,
 //        u32 n_acl {20B, u32 n {32B}}}
-//   result per tx: u32 index, u8 status(0 fail,1 ok,2 miss),
-//        u8 coinbase_sensitive, u64 gas_used, 32B fee_delta BE,
+//   result per tx: u32 index, u8 status(0 fail,1 ok,2 miss,3 not-run),
+//        u8 mode(0 parallel,1 serial), u8 coinbase_sensitive,
+//        u64 gas_used, 32B fee_delta BE,
 //        u32 out_len, out, u32 n_logs {20B, u8 n_topics {32B}, u32 dlen,
 //        data}, u32 n_acct_reads {20B}, u32 n_acct_writes {20B,
 //        u8 deleted, u64 nonce, 32B balance BE},
@@ -1161,6 +1162,19 @@ uint8_t *evm_execute_block(const uint8_t *snap_buf, uint64_t snap_len,
   uint64_t cumulative = 0;
   bool stopped = false;
 
+  // hand a tx back to Python keeping the reads it managed before failing:
+  // the optimistic scheduler diffs them against its snapshot to decide
+  // which keys the async storage layer must prefetch before the retry
+  auto demote = [&](size_t i, uint8_t status) {
+    TxResult keep;
+    keep.index = txs[i].index;
+    keep.status = status;
+    keep.coinbase_sensitive = results[i].coinbase_sensitive;
+    keep.acct_reads = std::move(results[i].acct_reads);
+    keep.slot_reads = std::move(results[i].slot_reads);
+    results[i] = std::move(keep);
+  };
+
   auto speculate = [&](size_t i, TxResult &res) {
     res = TxResult{};
     res.index = txs[i].index;
@@ -1252,12 +1266,10 @@ uint8_t *evm_execute_block(const uint8_t *snap_buf, uint64_t snap_len,
     std::set<Addr> committed_accts;
     std::set<SlotKey> committed_slots;
     for (size_t i = lo; i < hi; i++) {
-      if (stopped) { results[i] = TxResult{}; results[i].index = txs[i].index;
-                     results[i].status = 3; continue; }
+      if (stopped) { demote(i, 3); continue; }
       if (txs[i].gas_limit > remaining_gas - cumulative) {
         // python raises invalid-block here; hand over
-        results[i] = TxResult{}; results[i].index = txs[i].index;
-        results[i].status = 2; stopped = true; continue;
+        demote(i, 2); stopped = true; continue;
       }
       bool conflicted = results[i].status == 2 ||
                         results[i].coinbase_sensitive ||
@@ -1267,8 +1279,7 @@ uint8_t *evm_execute_block(const uint8_t *snap_buf, uint64_t snap_len,
         speculate(i, results[i]);  // serial re-run against the merged view
         exec_mode[i] = 1;
         if (results[i].status == 2 || results[i].coinbase_sensitive) {
-          results[i] = TxResult{}; results[i].index = txs[i].index;
-          results[i].status = 2; stopped = true; continue;
+          demote(i, 2); stopped = true; continue;
         }
       }
       // commit writes into the view
@@ -1306,6 +1317,7 @@ uint8_t *evm_execute_block(const uint8_t *snap_buf, uint64_t snap_len,
     w.u32(res.index);
     w.u8(res.status);
     w.u8(exec_mode[i]);
+    w.u8(res.coinbase_sensitive ? 1 : 0);
     w.u64(res.gas_used);
     to_be(res.fee_delta, be); w.append(be, 32);
     w.u32((uint32_t)res.output.size());
@@ -1318,12 +1330,18 @@ uint8_t *evm_execute_block(const uint8_t *snap_buf, uint64_t snap_len,
       w.u32((uint32_t)lg.data.size());
       w.append(lg.data.data(), lg.data.size());
     }
+    w.u32((uint32_t)res.acct_reads.size());
+    for (const Addr &a : res.acct_reads) w.append(a.b, 20);
     w.u32((uint32_t)res.acct_writes.size());
     for (const auto &kv : res.acct_writes) {
       w.append(kv.first.b, 20);
       w.u8(kv.second.deleted);
       w.u64(kv.second.nonce);
       to_be(kv.second.balance, be); w.append(be, 32);
+    }
+    w.u32((uint32_t)res.slot_reads.size());
+    for (const SlotKey &k : res.slot_reads) {
+      w.append(k.a.b, 20); w.append(k.k, 32);
     }
     w.u32((uint32_t)res.slot_writes.size());
     for (const auto &kv : res.slot_writes) {

@@ -330,6 +330,7 @@ def cmd_node(args):
                      db_backend=backend,
                      storage_v2=getattr(args, "storage_v2", None),
                      sparse_workers=getattr(args, "sparse_workers", None),
+                     parallel_exec=getattr(args, "parallel_exec", False),
                      rpc_gateway=getattr(args, "rpc_gateway", False),
                      # --trace-blocks; unset falls back to RETH_TPU_TRACE
                      trace_blocks=(args.trace_blocks
@@ -712,6 +713,7 @@ def cmd_config(args):
         f'hasher = "{cfg.hasher}"',
         f"hash_service = {'true' if cfg.hash_service else 'false'}",
         f"sparse_workers = {cfg.sparse_workers}",
+        f"parallel_exec = {'true' if cfg.parallel_exec else 'false'}",
         f"trace_blocks = {'true' if cfg.trace_blocks else 'false'}",
         "",
         "[rpc]",
@@ -1015,6 +1017,19 @@ def main(argv=None) -> int:
                         "(the cross-trie packed hash dispatch stays on). "
                         "Also settable as [node] sparse_workers in "
                         "reth.toml")
+    p.add_argument("--parallel-exec", dest="parallel_exec",
+                   action="store_true", default=False,
+                   help="optimistic parallel EVM execution on the no-BAL "
+                        "newPayload path (engine/optimistic.py): "
+                        "Block-STM-style speculation through the native "
+                        "wave core with read/write-set validation, "
+                        "deterministic serial re-execution of invalidated "
+                        "ranks, and async storage prefetch; receipts stay "
+                        "bit-identical to the serial executor, any "
+                        "scheduler error falls back to it. Speculation "
+                        "width: RETH_TPU_EXEC_WORKERS (default "
+                        "cpu-derived). Also settable as [node] "
+                        "parallel_exec in reth.toml")
     p.add_argument("--rpc-gateway", dest="rpc_gateway", action="store_true",
                    default=False,
                    help="route every RPC transport (HTTP/WS/IPC + the "

@@ -117,6 +117,12 @@ class RethTpuConfig:
     # trie/proof.py). 0 = auto (env RETH_TPU_SPARSE_WORKERS or
     # cpu-derived); 1 = pools off, cross-trie packed dispatch stays on
     sparse_workers: int = 0
+    # optimistic parallel EVM execution on the no-BAL newPayload path
+    # (--parallel-exec CLI equivalent): Block-STM-style speculation with
+    # read/write-set validation, async storage prefetch, and serial
+    # fallback (engine/optimistic.py). Speculation width comes from
+    # RETH_TPU_EXEC_WORKERS (default cpu-derived).
+    parallel_exec: bool = False
     # block-lifecycle tracing (--trace-blocks CLI equivalent): record
     # per-block span timelines, export Chrome-trace JSON under the
     # datadir, and point flight-recorder dumps there (tracing.py)
@@ -151,6 +157,7 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.hasher = node.get("hasher", cfg.hasher)
     cfg.hash_service = bool(node.get("hash_service", cfg.hash_service))
     cfg.sparse_workers = int(node.get("sparse_workers", cfg.sparse_workers))
+    cfg.parallel_exec = bool(node.get("parallel_exec", cfg.parallel_exec))
     cfg.trace_blocks = bool(node.get("trace_blocks", cfg.trace_blocks))
     rpc = raw.get("rpc", {})
     cfg.rpc.gateway = bool(rpc.get("gateway", cfg.rpc.gateway))

@@ -53,10 +53,20 @@ class TxAccess:
     def conflicts_with_write_sets(self, accts: set, slots: set) -> bool:
         """Same predicate against an AGGREGATE of many txs' writes — one
         intersection instead of a pairwise scan (O(wave) total instead of
-        O(wave^2); the hot cost in big conflict-free blocks)."""
-        if accts & (self.account_reads | self.account_writes):
+        O(wave^2); the hot cost in big conflict-free blocks).
+
+        ``isdisjoint`` instead of ``&`` over materialized unions: CPython
+        iterates the smaller operand and early-exits on the first hit, so
+        a conflict-free check costs O(per-tx keys) with zero temporary
+        sets no matter how large the accumulated wave writes grow
+        (tests/test_parallel_exec.py carries the micro-benchmark)."""
+        if not accts.isdisjoint(self.account_reads):
             return True
-        return bool(slots & (self.slot_reads | self.slot_writes))
+        if not accts.isdisjoint(self.account_writes):
+            return True
+        if not slots.isdisjoint(self.slot_reads):
+            return True
+        return not slots.isdisjoint(self.slot_writes)
 
     def to_json(self) -> dict:
         hx = lambda b: "0x" + b.hex()  # noqa: E731
@@ -246,6 +256,193 @@ def _commit_journal(merged: _MergedView, state: EvmState, fee_delta: int,
         _apply_fee_delta(merged, coinbase, fee_delta)
 
 
+# -- the shared commit fold ---------------------------------------------------
+
+
+class BlockCommitter:
+    """In-order fold of executed transactions into one block's output:
+    the merged post-state view, first-touch changesets (previous images
+    relative to BLOCK start), receipts, per-tx outputs, and the
+    ``state_hook`` key streaming that feeds the background state-root
+    task. ONE home for this logic, shared by the BAL wave loop
+    (:func:`execute_block_bal`) and the optimistic scheduler
+    (engine/optimistic.py) — the two parallel execution paths cannot
+    drift in how they merge state.
+
+    ``written_accts`` / ``written_slots`` accumulate every committed
+    write key since construction: the optimistic scheduler validates
+    block-start speculation against them (Block-STM's read-set check)."""
+
+    def __init__(self, source: StateSource, env: BlockEnv, transactions,
+                 state_hook=None):
+        self.source = source
+        self.env = env
+        self.transactions = transactions
+        self.state_hook = state_hook
+        self.merged = _MergedView(source)
+        self.changes_accounts: dict[bytes, Account | None] = {}
+        self.changes_storage: dict[bytes, dict[bytes, int]] = {}
+        self.wiped: set[bytes] = set()
+        self.new_codes: dict[bytes, bytes] = {}
+        self.receipts: list[Receipt] = []
+        self.tx_outputs: list[bytes] = []
+        self.cumulative = 0
+        self.committed_any = False
+        self.written_accts: set[bytes] = set()
+        self.written_slots: set[tuple[bytes, bytes]] = set()
+
+    def capture_changesets(self, state) -> None:
+        # first-touch-wins previous images, relative to BLOCK start
+        for addr, prev in state.changes.accounts.items():
+            if addr not in self.changes_accounts:
+                self.changes_accounts[addr] = prev
+        for addr, slots in state.changes.storage.items():
+            per = self.changes_storage.setdefault(addr, {})
+            for s, prev in slots.items():
+                per.setdefault(s, prev)
+        for addr in state.changes.wiped_storage:
+            self.wiped.add(addr)
+        self.new_codes.update(state.changes.new_bytecodes)
+
+    def commit_tx(self, i: int, state, fee_delta: int, result) -> None:
+        """Fold one interpreter-executed tx (journal in ``state``) into
+        the block output."""
+        self.committed_any = True
+        self.capture_changesets(state)
+        if self.state_hook is not None:
+            keys = list(state.changes.accounts) + [
+                (a, s) for a, per in state.changes.storage.items()
+                for s in per]
+            if fee_delta:
+                keys.append(self.env.coinbase)
+            self.state_hook(keys)
+        self.written_accts.update(state.changes.accounts)
+        for a, per in state.changes.storage.items():
+            self.written_slots.update((a, s) for s in per)
+        _commit_journal(self.merged, state, fee_delta, self.env.coinbase)
+        if fee_delta and self.env.coinbase not in self.changes_accounts:
+            self.changes_accounts[self.env.coinbase] = \
+                self.source.account(self.env.coinbase)
+        self.cumulative += result.gas_used
+        self.receipts.append(Receipt(
+            tx_type=self.transactions[i].tx_type,
+            success=result.success,
+            cumulative_gas_used=self.cumulative,
+            logs=tuple(result.receipt.logs),
+        ))
+        self.tx_outputs.append(result.output)
+
+    def commit_native(self, tx_type: int, success: bool, gas_used: int,
+                      fee_delta: int, logs, acct_writes, slot_writes,
+                      prev_accounts, prev_slots, output: bytes = b"") -> None:
+        """Single-pass fold of a natively executed tx — same effects as
+        :meth:`commit_tx`, skipping the intermediate BlockChanges/shim
+        objects (this is on the per-tx hot path of big blocks)."""
+        self.committed_any = True
+        merged = self.merged
+        keys = [] if self.state_hook is not None else None
+        for wa, deleted, nonce, balance in acct_writes:
+            prev = prev_accounts[wa]
+            if wa not in self.changes_accounts:
+                self.changes_accounts[wa] = prev
+            if deleted:
+                merged.accounts[wa] = None
+            elif prev is not None:
+                merged.accounts[wa] = Account(
+                    nonce=nonce, balance=balance,
+                    storage_root=prev.storage_root,
+                    code_hash=prev.code_hash)
+            else:
+                merged.accounts[wa] = Account(nonce=nonce, balance=balance)
+            self.written_accts.add(wa)
+            if keys is not None:
+                keys.append(wa)
+        for ka, ks, v in slot_writes:
+            per = self.changes_storage.get(ka)
+            if per is None:
+                per = self.changes_storage[ka] = {}
+            if ks not in per:
+                per[ks] = prev_slots[(ka, ks)]
+            mper = merged.slots.get(ka)
+            if mper is None:
+                mper = merged.slots[ka] = {}
+            mper[ks] = v
+            self.written_slots.add((ka, ks))
+            if keys is not None:
+                keys.append((ka, ks))
+        if fee_delta:
+            _apply_fee_delta(merged, self.env.coinbase, fee_delta)
+            if self.env.coinbase not in self.changes_accounts:
+                self.changes_accounts[self.env.coinbase] = \
+                    self.source.account(self.env.coinbase)
+            if keys is not None:
+                keys.append(self.env.coinbase)
+        if keys:
+            self.state_hook(keys)
+        self.cumulative += gas_used
+        self.receipts.append(Receipt(
+            tx_type=tx_type, success=success,
+            cumulative_gas_used=self.cumulative, logs=logs,
+        ))
+        self.tx_outputs.append(output)
+
+    def commit_system_state(self, state) -> None:
+        """Fold a system-call phase's journal (an EvmState OVER the merged
+        view) into the block: changesets, merged view, key stream — no
+        receipt, no gas (system calls are unmetered in the block)."""
+        self.capture_changesets(state)
+        if self.state_hook is not None:
+            keys = list(state.changes.accounts) + [
+                (a, s) for a, per in state.changes.storage.items()
+                for s in per]
+            if keys:
+                self.state_hook(keys)
+        self.written_accts.update(state.changes.accounts)
+        for a, per in state.changes.storage.items():
+            self.written_slots.update((a, s) for s in per)
+        _commit_journal(self.merged, state, 0, self.env.coinbase)
+
+    def apply_withdrawals(self, withdrawals) -> None:
+        """Post-tx withdrawal credits (gwei → wei), as the serial path."""
+        keys = []
+        for w in withdrawals or ():
+            if w.amount:
+                if w.address not in self.changes_accounts:
+                    self.changes_accounts[w.address] = \
+                        self.source.account(w.address)
+                prev = self.merged.account(w.address) or Account()
+                self.merged.accounts[w.address] = prev.with_(
+                    balance=prev.balance + w.amount * 10**9)
+                self.written_accts.add(w.address)
+                keys.append(w.address)
+        if keys and self.state_hook is not None:
+            self.state_hook(keys)
+
+    def build_output(self, senders):
+        """Assemble the BlockExecutionOutput (identical in shape to the
+        serial executor's)."""
+        from ..evm.executor import BlockExecutionOutput
+
+        out = BlockExecutionOutput()
+        out.senders = senders
+        out.receipts = self.receipts
+        out.tx_outputs = self.tx_outputs
+        out.gas_used = self.cumulative
+        from ..evm.state import BlockChanges
+
+        out.changes = BlockChanges(accounts=self.changes_accounts,
+                                   storage=self.changes_storage,
+                                   wiped_storage=self.wiped,
+                                   new_bytecodes=self.new_codes)
+        out.post_accounts = {a: self.merged.accounts.get(a)
+                             for a in self.changes_accounts}
+        out.post_storage = {
+            a: {s: self.merged.slots.get(a, {}).get(s, 0) for s in slots}
+            for a, slots in self.changes_storage.items()
+        }
+        return out
+
+
 # -- parallel execution -------------------------------------------------------
 
 
@@ -282,16 +479,10 @@ def execute_block_bal(source: StateSource, block: Block,
     """Execute a block wave-parallel per the access-list hint; output is
     identical to `BlockExecutor.execute` (validated, with serial fallback
     per conflicting transaction). Returns (output, stats)."""
-    from ..evm.executor import BlockExecutionOutput
-
     env = _block_env(block, config, block_hashes)
-    merged = _MergedView(source)
-    changes_accounts: dict[bytes, Account | None] = {}
-    changes_storage: dict[bytes, dict[bytes, int]] = {}
-    wiped: set[bytes] = set()
-    new_codes: dict[bytes, bytes] = {}
-    receipts: list[Receipt] = []
-    cumulative = 0
+    com = BlockCommitter(source, env, block.transactions,
+                         state_hook=state_hook)
+    merged = com.merged
     stats = {"waves": 0, "parallel": 0, "serial": 0, "native": 0}
     waves = _build_waves(bal, len(block.transactions))
     entries_by_index = {e.index: e for e in bal.entries}
@@ -322,98 +513,9 @@ def execute_block_bal(source: StateSource, block: Block,
     def _serial(i: int):
         acc, ex, state = make_recording_state(merged, env.coinbase, i, config)
         result = ex._execute_tx(state, env, block.transactions[i], senders[i],
-                                env.gas_limit - cumulative)
+                                env.gas_limit - com.cumulative)
         _extract_writes(state, acc)
         return acc, state, ex.fee_delta, result
-
-    def _capture_changesets(state):
-        # first-touch-wins previous images, relative to BLOCK start
-        for addr, prev in state.changes.accounts.items():
-            if addr not in changes_accounts:
-                changes_accounts[addr] = prev
-        for addr, slots in state.changes.storage.items():
-            per = changes_storage.setdefault(addr, {})
-            for s, prev in slots.items():
-                per.setdefault(s, prev)
-        for addr in state.changes.wiped_storage:
-            wiped.add(addr)
-        new_codes.update(state.changes.new_bytecodes)
-
-    committed_any = False
-
-    def _commit_tx(i: int, state, fee_delta: int, result) -> None:
-        """Fold one executed tx into the block output (shared by the
-        Python wave loop and the native segment flow)."""
-        nonlocal cumulative, committed_any
-        committed_any = True
-        _capture_changesets(state)
-        if state_hook is not None:
-            keys = list(state.changes.accounts) + [
-                (a, s) for a, per in state.changes.storage.items()
-                for s in per]
-            if fee_delta:
-                keys.append(env.coinbase)
-            state_hook(keys)
-        _commit_journal(merged, state, fee_delta, env.coinbase)
-        if fee_delta and env.coinbase not in changes_accounts:
-            changes_accounts[env.coinbase] = source.account(env.coinbase)
-        cumulative += result.gas_used
-        receipts.append(Receipt(
-            tx_type=block.transactions[i].tx_type,
-            success=result.success,
-            cumulative_gas_used=cumulative,
-            logs=tuple(result.receipt.logs),
-        ))
-
-    def _commit_native(tx_type: int, success: bool, gas_used: int,
-                       fee_delta: int, logs, acct_writes, slot_writes,
-                       prev_accounts, prev_slots) -> None:
-        """Single-pass fold of a natively executed tx — same effects as
-        `_commit_tx`, skipping the intermediate BlockChanges/shim objects
-        (this is on the per-tx hot path of big blocks)."""
-        nonlocal cumulative, committed_any
-        committed_any = True
-        keys = [] if state_hook is not None else None
-        for wa, deleted, nonce, balance in acct_writes:
-            prev = prev_accounts[wa]
-            if wa not in changes_accounts:
-                changes_accounts[wa] = prev
-            if deleted:
-                merged.accounts[wa] = None
-            elif prev is not None:
-                merged.accounts[wa] = Account(
-                    nonce=nonce, balance=balance,
-                    storage_root=prev.storage_root,
-                    code_hash=prev.code_hash)
-            else:
-                merged.accounts[wa] = Account(nonce=nonce, balance=balance)
-            if keys is not None:
-                keys.append(wa)
-        for ka, ks, v in slot_writes:
-            per = changes_storage.get(ka)
-            if per is None:
-                per = changes_storage[ka] = {}
-            if ks not in per:
-                per[ks] = prev_slots[(ka, ks)]
-            mper = merged.slots.get(ka)
-            if mper is None:
-                mper = merged.slots[ka] = {}
-            mper[ks] = v
-            if keys is not None:
-                keys.append((ka, ks))
-        if fee_delta:
-            _apply_fee_delta(merged, env.coinbase, fee_delta)
-            if env.coinbase not in changes_accounts:
-                changes_accounts[env.coinbase] = source.account(env.coinbase)
-            if keys is not None:
-                keys.append(env.coinbase)
-        if keys:
-            state_hook(keys)
-        cumulative += gas_used
-        receipts.append(Receipt(
-            tx_type=tx_type, success=success,
-            cumulative_gas_used=cumulative, logs=logs,
-        ))
 
     native_done = False
     if use_native:
@@ -426,12 +528,12 @@ def execute_block_bal(source: StateSource, block: Block,
             native_done = native_flow(
                 block, senders, waves, entries_by_index, config, env,
                 merged, max_workers, stats,
-                commit_tx=_commit_tx, commit_native=_commit_native,
+                commit_tx=com.commit_tx, commit_native=com.commit_native,
                 run_python=_serial,
-                remaining_gas=lambda: env.gas_limit - cumulative)
+                remaining_gas=lambda: env.gas_limit - com.cumulative)
         except Exception:  # noqa: BLE001 — native is an accelerator only;
             native_done = False  # any failure restarts on the Python path
-            if committed_any:
+            if com.committed_any:
                 raise  # partial commit: restarting would double-apply
             # nothing committed: zero the failed attempt's counters so the
             # Python loop's accounting starts clean
@@ -454,7 +556,7 @@ def execute_block_bal(source: StateSource, block: Block,
                     or acc.coinbase_sensitive
                     or acc.conflicts_with_write_sets(committed_accts,
                                                      committed_slots)
-                    or block.transactions[i].gas_limit > env.gas_limit - cumulative
+                    or block.transactions[i].gas_limit > env.gas_limit - com.cumulative
                 )
                 if conflicted:
                     stats["serial"] += 1
@@ -464,34 +566,11 @@ def execute_block_bal(source: StateSource, block: Block,
                     # schedule-level count; threads only under RETH_TPU_BAL_THREADS)
                 else:
                     stats["serial"] += 1
-                _commit_tx(i, state, fee_delta, result)
+                com.commit_tx(i, state, fee_delta, result)
                 committed_accts |= acc.account_writes
                 committed_slots |= acc.slot_writes
 
     if pool is not None:
         pool.shutdown(wait=True)
-    # withdrawals (same post-tx application as the serial path)
-    for w in block.withdrawals or ():
-        if w.amount:
-            if w.address not in changes_accounts:
-                changes_accounts[w.address] = source.account(w.address)
-            prev = merged.account(w.address) or Account()
-            merged.accounts[w.address] = prev.with_(
-                balance=prev.balance + w.amount * 10**9)
-
-    out = BlockExecutionOutput()
-    out.senders = senders
-    out.receipts = receipts
-    out.gas_used = cumulative
-    from ..evm.state import BlockChanges
-
-    out.changes = BlockChanges(accounts=changes_accounts,
-                               storage=changes_storage,
-                               wiped_storage=wiped,
-                               new_bytecodes=new_codes)
-    out.post_accounts = {a: merged.accounts.get(a) for a in changes_accounts}
-    out.post_storage = {
-        a: {s: merged.slots.get(a, {}).get(s, 0) for s in slots}
-        for a, slots in changes_storage.items()
-    }
-    return out, stats
+    com.apply_withdrawals(block.withdrawals)
+    return com.build_output(senders), stats

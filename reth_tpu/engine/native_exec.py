@@ -12,6 +12,16 @@ through the Python interpreter instead: the native path either
 reproduces the interpreter bit-for-bit (asserted by
 tests/test_native_exec.py differential runs and test_bal.py's
 serial-equality suite) or it declines.
+
+Two drivers share the marshaling here:
+
+* :func:`native_flow` — the BAL segment flow (engine/bal.py): access
+  hints are known up front, segments are clipped to hint-eligible runs;
+* the optimistic scheduler (engine/optimistic.py) — no hints: it calls
+  :func:`snapshot_buffer` / :func:`txs_buffer` / :func:`call_segment`
+  directly with a snapshot grown round-by-round from the read sets the
+  results report back (misses keep their partial reads exactly so the
+  async storage layer knows what to prefetch before the retry).
 """
 
 from __future__ import annotations
@@ -63,6 +73,164 @@ def _b32(v: int) -> bytes:
     return v.to_bytes(32, "big")
 
 
+# -- marshaling (shared by the BAL flow and the optimistic scheduler) --------
+
+
+def env_buffer(env) -> bytes:
+    """Serialize a BlockEnv for the native core."""
+    return (env.coinbase
+            + struct.pack("<QQQ", env.number, env.timestamp, env.gas_limit)
+            + _b32(env.base_fee) + env.prev_randao.rjust(32, b"\x00")
+            + struct.pack("<Q", env.chain_id) + _b32(env.blob_base_fee))
+
+
+def snapshot_buffer(merged, acct_keys, slot_keys):
+    """Serialize a state snapshot read through ``merged`` (any StateSource
+    with account/storage/bytecode). Returns ``(buf, prev_accounts,
+    prev_slots)`` — the previous images the commit fold needs for
+    first-touch changesets."""
+    prev_accounts: dict[bytes, Account | None] = {}
+    code_ids: dict[bytes, int] = {}
+    codes: list[bytes] = []
+    sparts = [struct.pack("<I", len(acct_keys))]
+    for a in acct_keys:
+        acc = merged.account(a)
+        prev_accounts[a] = acc
+        code_id = -1
+        if acc is not None and acc.code_hash != KECCAK_EMPTY:
+            cid = code_ids.get(acc.code_hash)
+            if cid is None:
+                cid = len(codes)
+                codes.append(merged.bytecode(acc.code_hash))
+                code_ids[acc.code_hash] = cid
+            code_id = cid
+        sparts.append(a + struct.pack("<Q", acc.nonce if acc else 0)
+                      + _b32(acc.balance if acc else 0)
+                      + struct.pack("<iB", code_id, 1 if acc else 0))
+    prev_slots: dict[tuple[bytes, bytes], int] = {}
+    sparts.append(struct.pack("<I", len(slot_keys)))
+    for a, s in slot_keys:
+        v = merged.storage(a, s)
+        prev_slots[(a, s)] = v
+        sparts.append(a + s + _b32(v))
+    sparts.append(struct.pack("<I", len(codes)))
+    for c in codes:
+        sparts.append(struct.pack("<I", len(c)) + c)
+    return b"".join(sparts), prev_accounts, prev_slots
+
+
+_TX_HEAD = struct.Struct("<I20sB20s32sQQ32s32sQQBI")
+
+
+def txs_buffer(txs, senders, indices, spec, env) -> bytes:
+    """Serialize the transactions at ``indices`` (absolute block ranks)."""
+    tparts = [struct.pack("<I", len(indices))]
+    floorable = spec.calldata_floor
+    for i in indices:
+        tx = txs[i]
+        eff = tx.effective_gas_price(env.base_fee)
+        cap = tx.max_fee_per_gas if tx.tx_type >= 2 else tx.gas_price
+        floor = calldata_floor_gas(tx) if floorable else 0
+        tparts.append(_TX_HEAD.pack(
+            i, senders[i], 1, tx.to, tx.value.to_bytes(32, "big"),
+            tx.nonce, tx.gas_limit, eff.to_bytes(32, "big"),
+            cap.to_bytes(32, "big"), intrinsic_gas(tx, spec), floor,
+            tx.tx_type, len(tx.data)))
+        tparts.append(tx.data)
+        tparts.append(struct.pack("<I", len(tx.access_list)))
+        for addr, slots in tx.access_list:
+            tparts.append(addr + struct.pack("<I", len(slots)))
+            for s in slots:
+                tparts.append(s)
+    return b"".join(tparts)
+
+
+def call_segment(lib, snap_buf: bytes, env_buf: bytes, txs_buf: bytes,
+                 wave_sizes, remaining_gas: int, n_threads: int) -> bytes:
+    """One evm_execute_block round trip; the call releases the GIL for its
+    whole duration (ctypes), so speculation threads AND the async storage
+    prefetchers run concurrently with the C++ crunch."""
+    waves_buf = struct.pack("<I", len(wave_sizes)) + b"".join(
+        struct.pack("<I", s) for s in wave_sizes)
+    out_len = ctypes.c_uint64()
+    sb = (ctypes.c_uint8 * len(snap_buf)).from_buffer_copy(snap_buf)
+    eb = (ctypes.c_uint8 * len(env_buf)).from_buffer_copy(env_buf)
+    tb = (ctypes.c_uint8 * len(txs_buf)).from_buffer_copy(txs_buf)
+    wb = (ctypes.c_uint8 * len(waves_buf)).from_buffer_copy(waves_buf)
+    ptr = lib.evm_execute_block(sb, len(snap_buf), eb, len(env_buf),
+                                tb, len(txs_buf), wb, len(waves_buf),
+                                remaining_gas, n_threads,
+                                ctypes.byref(out_len))
+    try:
+        return ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.evm_free(ptr)
+
+
+def parse_results(raw: bytes) -> list[dict]:
+    """Decode the result buffer: one dict per tx, in submission order.
+    Statuses: 0 fail, 1 ok, 2 miss (native declined), 3 not run. Missed /
+    not-run txs still carry the partial read sets their speculation
+    managed — the optimistic scheduler's prefetch hints."""
+    (n_results,) = struct.unpack_from("<I", raw, 0)
+    off = 4
+    out = []
+    for _ in range(n_results):
+        idx, status, mode, cb_sens, gas_used = struct.unpack_from(
+            "<IBBBQ", raw, off)
+        off += 15
+        fee_delta = int.from_bytes(raw[off:off + 32], "big"); off += 32
+        (olen,) = struct.unpack_from("<I", raw, off); off += 4
+        output = raw[off:off + olen]; off += olen
+        (nlogs,) = struct.unpack_from("<I", raw, off); off += 4
+        logs = []
+        for _l in range(nlogs):
+            laddr = raw[off:off + 20]; off += 20
+            nt = raw[off]; off += 1
+            topics = []
+            for _t in range(nt):
+                topics.append(raw[off:off + 32]); off += 32
+            (dlen,) = struct.unpack_from("<I", raw, off); off += 4
+            logs.append(Log(laddr, tuple(topics), raw[off:off + dlen]))
+            off += dlen
+        (nar,) = struct.unpack_from("<I", raw, off); off += 4
+        acct_reads = set()
+        for _a in range(nar):
+            acct_reads.add(raw[off:off + 20]); off += 20
+        (naw,) = struct.unpack_from("<I", raw, off); off += 4
+        acct_writes = []
+        for _a in range(naw):
+            wa = raw[off:off + 20]; off += 20
+            deleted = raw[off]; off += 1
+            (nonce,) = struct.unpack_from("<Q", raw, off); off += 8
+            balance = int.from_bytes(raw[off:off + 32], "big"); off += 32
+            acct_writes.append((wa, deleted, nonce, balance))
+        (nsr,) = struct.unpack_from("<I", raw, off); off += 4
+        slot_reads = set()
+        for _s in range(nsr):
+            ra = raw[off:off + 20]; off += 20
+            rs = raw[off:off + 32]; off += 32
+            slot_reads.add((ra, rs))
+        (nsw,) = struct.unpack_from("<I", raw, off); off += 4
+        slot_writes = []
+        for _s in range(nsw):
+            ka = raw[off:off + 20]; off += 20
+            ks = raw[off:off + 32]; off += 32
+            v = int.from_bytes(raw[off:off + 32], "big"); off += 32
+            slot_writes.append((ka, ks, v))
+        out.append({
+            "index": idx, "status": status, "mode": mode,
+            "coinbase_sensitive": bool(cb_sens), "gas_used": gas_used,
+            "fee_delta": fee_delta, "output": output, "logs": tuple(logs),
+            "acct_reads": acct_reads, "acct_writes": acct_writes,
+            "slot_reads": slot_reads, "slot_writes": slot_writes,
+        })
+    return out
+
+
+# -- the BAL segment flow ----------------------------------------------------
+
+
 def native_flow(block, senders, waves, entries, config, env, merged,
                 n_threads, stats, commit_tx, commit_native, run_python,
                 remaining_gas) -> bool:
@@ -106,10 +274,7 @@ def native_flow(block, senders, waves, entries, config, env, merged,
     # accounting (segment re-clipping must not double-count)
     stats["waves"] += len(waves)
 
-    env_buf = (env.coinbase
-               + struct.pack("<QQQ", env.number, env.timestamp, env.gas_limit)
-               + _b32(env.base_fee) + env.prev_randao.rjust(32, b"\x00")
-               + struct.pack("<Q", env.chain_id) + _b32(env.blob_base_fee))
+    env_buf = env_buffer(env)
 
     def run_segment(lo: int, hi: int) -> int:
         """Execute txs [lo, hi) natively; returns the next tx index to
@@ -123,55 +288,9 @@ def native_flow(block, senders, waves, entries, config, env, merged,
             acct_keys.add(senders[i])
             acct_keys.add(txs[i].to)
             slot_keys |= e.slot_reads | e.slot_writes
-        prev_accounts: dict[bytes, Account | None] = {}
-        code_ids: dict[bytes, int] = {}
-        codes: list[bytes] = []
-        sparts = [struct.pack("<I", len(acct_keys))]
-        for a in acct_keys:
-            acc = merged.account(a)
-            prev_accounts[a] = acc
-            code_id = -1
-            if acc is not None and acc.code_hash != KECCAK_EMPTY:
-                cid = code_ids.get(acc.code_hash)
-                if cid is None:
-                    cid = len(codes)
-                    codes.append(merged.bytecode(acc.code_hash))
-                    code_ids[acc.code_hash] = cid
-                code_id = cid
-            sparts.append(a + struct.pack("<Q", acc.nonce if acc else 0)
-                          + _b32(acc.balance if acc else 0)
-                          + struct.pack("<iB", code_id, 1 if acc else 0))
-        prev_slots: dict[tuple[bytes, bytes], int] = {}
-        sparts.append(struct.pack("<I", len(slot_keys)))
-        for a, s in slot_keys:
-            v = merged.storage(a, s)
-            prev_slots[(a, s)] = v
-            sparts.append(a + s + _b32(v))
-        sparts.append(struct.pack("<I", len(codes)))
-        for c in codes:
-            sparts.append(struct.pack("<I", len(c)) + c)
-        snap_buf = b"".join(sparts)
-
-        tx_head = struct.Struct("<I20sB20s32sQQ32s32sQQBI")
-        tparts = [struct.pack("<I", hi - lo)]
-        floorable = spec.calldata_floor
-        for i in range(lo, hi):
-            tx = txs[i]
-            eff = tx.effective_gas_price(env.base_fee)
-            cap = tx.max_fee_per_gas if tx.tx_type >= 2 else tx.gas_price
-            floor = calldata_floor_gas(tx) if floorable else 0
-            tparts.append(tx_head.pack(
-                i, senders[i], 1, tx.to, tx.value.to_bytes(32, "big"),
-                tx.nonce, tx.gas_limit, eff.to_bytes(32, "big"),
-                cap.to_bytes(32, "big"), intrinsic_gas(tx, spec), floor,
-                tx.tx_type, len(tx.data)))
-            tparts.append(tx.data)
-            tparts.append(struct.pack("<I", len(tx.access_list)))
-            for addr, slots in tx.access_list:
-                tparts.append(addr + struct.pack("<I", len(slots)))
-                for s in slots:
-                    tparts.append(s)
-        txs_buf = b"".join(tparts)
+        snap_buf, prev_accounts, prev_slots = snapshot_buffer(
+            merged, acct_keys, slot_keys)
+        txs_buf = txs_buffer(txs, senders, range(lo, hi), spec, env)
 
         # clip the global wave partition to [lo, hi)
         sizes = []
@@ -179,67 +298,22 @@ def native_flow(block, senders, waves, entries, config, env, merged,
             a, b = max(w[0], lo), min(w[-1] + 1, hi)
             if b > a:
                 sizes.append(b - a)
-        waves_buf = struct.pack("<I", len(sizes)) + b"".join(
-            struct.pack("<I", s) for s in sizes)
 
-        out_len = ctypes.c_uint64()
-        sb = (ctypes.c_uint8 * len(snap_buf)).from_buffer_copy(snap_buf)
-        eb = (ctypes.c_uint8 * len(env_buf)).from_buffer_copy(env_buf)
-        tb = (ctypes.c_uint8 * len(txs_buf)).from_buffer_copy(txs_buf)
-        wb = (ctypes.c_uint8 * len(waves_buf)).from_buffer_copy(waves_buf)
-        ptr = lib.evm_execute_block(sb, len(snap_buf), eb, len(env_buf),
-                                    tb, len(txs_buf), wb, len(waves_buf),
-                                    remaining_gas(), n_threads,
-                                    ctypes.byref(out_len))
-        try:
-            raw = ctypes.string_at(ptr, out_len.value)
-        finally:
-            lib.evm_free(ptr)
-
-        off = 4  # n_results
+        raw = call_segment(lib, snap_buf, env_buf, txs_buf, sizes,
+                           remaining_gas(), n_threads)
         upto = hi
-        for _ in range(hi - lo):
-            idx, status, mode, gas_used = struct.unpack_from("<IBBQ", raw, off)
-            off += 14
-            fee_delta = int.from_bytes(raw[off:off + 32], "big"); off += 32
-            (olen,) = struct.unpack_from("<I", raw, off); off += 4
-            output = raw[off:off + olen]; off += olen
-            (nlogs,) = struct.unpack_from("<I", raw, off); off += 4
-            logs = []
-            for _l in range(nlogs):
-                laddr = raw[off:off + 20]; off += 20
-                nt = raw[off]; off += 1
-                topics = []
-                for _t in range(nt):
-                    topics.append(raw[off:off + 32]); off += 32
-                (dlen,) = struct.unpack_from("<I", raw, off); off += 4
-                logs.append(Log(laddr, tuple(topics), raw[off:off + dlen]))
-                off += dlen
-            (naw,) = struct.unpack_from("<I", raw, off); off += 4
-            acct_writes = []
-            for _a in range(naw):
-                wa = raw[off:off + 20]; off += 20
-                deleted = raw[off]; off += 1
-                (nonce,) = struct.unpack_from("<Q", raw, off); off += 8
-                balance = int.from_bytes(raw[off:off + 32], "big"); off += 32
-                acct_writes.append((wa, deleted, nonce, balance))
-            (nsw,) = struct.unpack_from("<I", raw, off); off += 4
-            slot_writes = []
-            for _s in range(nsw):
-                ka = raw[off:off + 20]; off += 20
-                ks = raw[off:off + 32]; off += 32
-                v = int.from_bytes(raw[off:off + 32], "big"); off += 32
-                slot_writes.append((ka, ks, v))
-            if status >= 2:  # miss (2) or not-run (3)
+        for res in parse_results(raw):
+            idx = res["index"]
+            if res["status"] >= 2:  # miss (2) or not-run (3)
                 if idx < upto:
                     upto = idx
                 continue
-            success = status == 1
             stats["native"] += 1
-            stats["parallel" if mode == 0 else "serial"] += 1
-            commit_native(txs[idx].tx_type, success, gas_used, fee_delta,
-                          tuple(logs), acct_writes, slot_writes,
-                          prev_accounts, prev_slots)
+            stats["parallel" if res["mode"] == 0 else "serial"] += 1
+            commit_native(txs[idx].tx_type, res["status"] == 1,
+                          res["gas_used"], res["fee_delta"], res["logs"],
+                          res["acct_writes"], res["slot_writes"],
+                          prev_accounts, prev_slots, output=res["output"])
         return upto
 
     pos = 0

@@ -14,6 +14,12 @@ the exact access list).
 Workers execute against thread-local EvmStates over the SHARED
 CachedStateSource; reads flow through the (mutex-guarded) cache,
 speculative writes stay in the worker's journal and die with it.
+
+With ``--parallel-exec`` this task does not run at all: the optimistic
+scheduler (engine/optimistic.py) FOLDS the prewarm pass into its
+speculative first attempts — the same recording execution warms the
+cache and streams keys, but a validation-clean result commits directly
+instead of being discarded and re-executed.
 """
 
 from __future__ import annotations

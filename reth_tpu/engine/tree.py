@@ -76,6 +76,8 @@ class EngineTree:
         bal_execution: bool = False,
         state_root_strategy: str = "sparse",
         sparse_workers: int | None = None,
+        parallel_exec: bool = False,
+        exec_workers: int | None = None,
     ):
         self.factory = factory
         self.committer = committer or TrieCommitter()
@@ -97,11 +99,23 @@ class EngineTree:
         # high to disable; reference gates prewarm similarly)
         self.prewarm_threshold = 4
         self.last_prewarm = None
-        # BAL wave execution: the prewarm pass doubles as the speculative
+        # Parallel execution has two schedulers. With a BAL hint
+        # (bal_execution): the prewarm pass doubles as the speculative
         # access recording, then execute_block_bal schedules conflict-free
-        # waves (reference payload_processor/bal/execute.rs)
+        # waves (reference payload_processor/bal/execute.rs). WITHOUT a
+        # hint — every real newPayload — --parallel-exec routes through
+        # the optimistic Block-STM-style scheduler (engine/optimistic.py):
+        # single-wave native speculation + in-order read-set validation +
+        # serial re-execution of invalidated ranks, with async storage
+        # prefetch; it folds the prewarm pass into its speculative first
+        # attempt. Fallback ladder: optimistic -> BAL wave -> serial.
         self.bal_execution = bal_execution
         self.last_bal_stats = None
+        # --parallel-exec: optimistic scheduler on the no-BAL path
+        self.parallel_exec = parallel_exec
+        # scheduler speculation width (None = RETH_TPU_EXEC_WORKERS / auto)
+        self.exec_workers = exec_workers
+        self.last_exec = None  # per-block optimistic stats (tests/metrics)
         # live-tip state-root strategy: "sparse" overlaps the WHOLE trie
         # job with execution via a background proof-fetch + reveal task
         # (reference state_root_strategy/sparse_trie.rs); anything else
@@ -326,11 +340,20 @@ class EngineTree:
                 root_job = PipelinedStateRoot(self.committer.hasher)
         state_hook = (sparse_task or root_job).on_state_update
         self.last_prewarm = None  # bind the pass to THIS block only
+        self.last_exec = None
+        # --parallel-exec without a BAL hint: the optimistic scheduler
+        # (engine/optimistic.py) replaces BOTH the prewarm pass and the
+        # serial canonical execution — its speculative first attempt IS
+        # the prewarm run (reads warm the shared cache and stream to the
+        # sparse task), and validation-clean speculation commits instead
+        # of being discarded and re-executed.
+        use_opt = (self.parallel_exec and not self.bal_execution
+                   and len(block.transactions) >= self.prewarm_threshold)
         # prewarm: execute txs in parallel against PARENT state first,
         # purely to populate the execution cache (reference
         # payload_processor/prewarm.rs); canonical execution below then
         # runs against warm caches
-        if len(block.transactions) >= self.prewarm_threshold:
+        if len(block.transactions) >= self.prewarm_threshold and not use_opt:
             from ..evm.executor import blob_base_fee
             from ..evm.interpreter import BlockEnv
             from .prewarm import PrewarmTask
@@ -372,7 +395,8 @@ class EngineTree:
                    and self.last_prewarm.record_accesses)
         try:
             with tracing.span("engine::execute", "execute",
-                              txs=len(block.transactions), bal=use_bal):
+                              txs=len(block.transactions), bal=use_bal,
+                              optimistic=use_opt):
                 if use_bal:
                     from .bal import BlockAccessList, execute_block_bal
 
@@ -383,6 +407,15 @@ class EngineTree:
                     out, self.last_bal_stats = execute_block_bal(
                         executor.source, block, senders, hint, self.config,
                         state_hook=state_hook, block_hashes=hashes)
+                    self._record_exec_metrics(bal=self.last_bal_stats)
+                elif use_opt:
+                    from .optimistic import execute_block_optimistic
+
+                    out, self.last_exec = execute_block_optimistic(
+                        executor.source, block, senders, self.config,
+                        max_workers=self.exec_workers,
+                        state_hook=state_hook, block_hashes=hashes)
+                    self._record_exec_metrics(optimistic=self.last_exec)
                 else:
                     out = executor.execute(block, senders, hashes,
                                            state_hook=state_hook)
@@ -440,6 +473,19 @@ class EngineTree:
             self.execution_cache.on_block_applied(out.changes)
             self._cache_anchor = block_hash
         return PayloadStatus(PayloadStatusKind.VALID, block_hash), senders, out.receipts
+
+    def _record_exec_metrics(self, bal=None, optimistic=None):
+        """Surface the parallel-execution stats (exec_bal_* / exec_parallel_*
+        counters + the events line's exec[...] segment)."""
+        try:
+            from ..metrics import exec_metrics
+
+            if bal is not None:
+                exec_metrics.record_bal(bal)
+            if optimistic is not None:
+                exec_metrics.record_optimistic(optimistic)
+        except Exception:  # noqa: BLE001 — metrics must never fail consensus
+            pass
 
     def _run_invalid_hooks(self, block, reason, out=None, computed_root=None):
         for hook in self.invalid_block_hooks:

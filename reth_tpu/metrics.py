@@ -320,6 +320,89 @@ class SparseCommitMetrics:
 sparse_commit_metrics = SparseCommitMetrics()
 
 
+class ExecMetrics:
+    """Parallel-execution observability: the optimistic scheduler
+    (engine/optimistic.py — exec_parallel_*) and the BAL wave executor
+    (engine/bal.py — exec_bal_*, previously computed but only stashed on
+    ``EngineTree.last_bal_stats``). One place to compare BAL-hinted vs
+    optimistic scheduling efficiency in production: how many ranks ran
+    native/parallel, how many invalidated and re-ran serially, how many
+    keys the async storage layer prefetched, and whether a block fell
+    all the way back to the serial executor."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._blocks = reg.counter(
+            "exec_parallel_blocks_total",
+            "blocks executed by the optimistic scheduler")
+        self._rounds = reg.counter(
+            "exec_parallel_rounds_total", "native speculation rounds run")
+        self._native = reg.counter(
+            "exec_parallel_native_txs_total",
+            "ranks committed from the native wave core")
+        self._python = reg.counter(
+            "exec_parallel_python_txs_total",
+            "ranks committed through the Python interpreter")
+        self._speculative = reg.counter(
+            "exec_parallel_speculative_commits_total",
+            "ranks whose validation-clean speculation committed directly")
+        self._serial_rerun = reg.counter(
+            "exec_parallel_serial_reruns_total",
+            "invalidated ranks re-executed against the merged view")
+        self._conflicts = reg.counter(
+            "exec_parallel_conflicts_total",
+            "native ranks demoted to an in-core serial re-run")
+        self._misses = reg.counter(
+            "exec_parallel_misses_total",
+            "native rounds stopped by a snapshot miss")
+        self._prefetched = reg.counter(
+            "exec_parallel_prefetched_keys_total",
+            "keys the async storage layer fetched in the background")
+        self._fallbacks = reg.counter(
+            "exec_parallel_fallbacks_total",
+            "blocks that fell back to the serial executor")
+        self._wall = reg.histogram(
+            "exec_parallel_wall_seconds",
+            "optimistic scheduler wall clock per block")
+        self._bal_waves = reg.counter("exec_bal_waves_total")
+        self._bal_parallel = reg.counter(
+            "exec_bal_parallel_txs_total",
+            "txs committed from conflict-free waves")
+        self._bal_serial = reg.counter(
+            "exec_bal_serial_txs_total",
+            "txs demoted to serial re-execution")
+        self._bal_native = reg.counter(
+            "exec_bal_native_txs_total", "txs executed by the native core")
+        self.last: dict | None = None      # optimistic, for the events line
+        self.last_bal: dict | None = None  # BAL, for the events line
+
+    def record_optimistic(self, stats: dict) -> None:
+        self._blocks.increment()
+        self._rounds.increment(stats.get("rounds", 0))
+        self._native.increment(stats.get("native", 0))
+        self._python.increment(stats.get("python", 0))
+        self._speculative.increment(stats.get("speculative", 0))
+        self._serial_rerun.increment(stats.get("serial_rerun", 0))
+        self._conflicts.increment(stats.get("conflicts", 0))
+        self._misses.increment(stats.get("misses", 0))
+        self._prefetched.increment(stats.get("prefetched", 0))
+        if stats.get("fallback"):
+            self._fallbacks.increment()
+        if "wall_s" in stats:
+            self._wall.record(stats["wall_s"])
+        self.last = dict(stats)
+
+    def record_bal(self, stats: dict) -> None:
+        self._bal_waves.increment(stats.get("waves", 0))
+        self._bal_parallel.increment(stats.get("parallel", 0))
+        self._bal_serial.increment(stats.get("serial", 0))
+        self._bal_native.increment(stats.get("native", 0))
+        self.last_bal = dict(stats)
+
+
+exec_metrics = ExecMetrics()
+
+
 class HashServiceMetrics:
     """Shared hash service observability (ops/hash_service.py): per-lane
     queue depth and request counts, coalesce factor (requests fused per

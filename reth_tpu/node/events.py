@@ -146,6 +146,33 @@ class NodeEventReporter:
             if "finish_s" in sc:
                 line += f" fin={sc['finish_s']}s"
             line += "]"
+        # parallel execution: the last block's scheduling efficiency —
+        # optimistic (engine/optimistic.py: native/python rank split,
+        # speculative commits vs serial re-runs, rounds, prefetched keys)
+        # or BAL wave stats (engine/bal.py) — so BAL-hinted vs optimistic
+        # scheduling is comparable on the one line operators read
+        from ..metrics import exec_metrics
+
+        ex = exec_metrics.last
+        if ex is not None:
+            line += (f" exec[opt r={ex.get('rounds', 0)}"
+                     f" nat={ex.get('native', 0)}"
+                     f" py={ex.get('python', 0)}"
+                     f" spec={ex.get('speculative', 0)}"
+                     f" conf={ex.get('conflicts', 0)}"
+                     f" pre={ex.get('prefetched', 0)}"
+                     f" w={ex.get('workers', 0)}")
+            if ex.get("fallback"):
+                line += " FALLBACK"
+            if "wall_s" in ex:
+                line += f" {ex['wall_s']}s"
+            line += "]"
+        eb = exec_metrics.last_bal
+        if eb is not None:
+            line += (f" exec[bal waves={eb.get('waves', 0)}"
+                     f" par={eb.get('parallel', 0)}"
+                     f" ser={eb.get('serial', 0)}"
+                     f" nat={eb.get('native', 0)}]")
         # --trace-blocks: the per-block wall budget — where the last
         # block's time actually went, split by phase and by hash-service
         # queue-wait vs device dispatch (tracing.py block summaries)

@@ -65,6 +65,10 @@ class NodeConfig:
     # --sparse-workers / [node] sparse_workers: parallel sparse-commit
     # pool width (None = env RETH_TPU_SPARSE_WORKERS or cpu-derived)
     sparse_workers: int | None = None
+    # --parallel-exec / [node] parallel_exec: optimistic parallel EVM
+    # execution on the no-BAL newPayload path (engine/optimistic.py);
+    # speculation width from RETH_TPU_EXEC_WORKERS
+    parallel_exec: bool = False
     # --rpc-gateway / [rpc] gateway: route every transport's dispatch
     # through the serving gateway (rpc/gateway.py): admission control
     # with priority classes, in-flight coalescing, and a head-invalidated
@@ -174,6 +178,7 @@ class Node:
             EvmConfig(chain_id=config.chain_id, chainspec=exec_spec),
             persistence_threshold=config.persistence_threshold,
             sparse_workers=config.sparse_workers,
+            parallel_exec=config.parallel_exec,
         )
         from ..pool.pool import PoolConfig
 

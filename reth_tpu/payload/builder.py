@@ -87,6 +87,19 @@ def build_payload(
     cumulative_gas = 0
     blob_gas_used = 0
     total_fees = 0
+    # --parallel-exec: execute the candidate list through the optimistic
+    # scheduler (engine/optimistic.py payload mode) — speculative parallel
+    # first attempts, in-order validation, builder-semantics skips for
+    # unexecutable candidates. Any scheduler failure falls back to the
+    # serial greedy loop below (same selection, just slower).
+    if pool is not None and getattr(tree, "parallel_exec", False):
+        built = _build_parallel(tree, pool, overlay, env, base_fee,
+                                cancun, blob_params, attrs)
+        if built is not None:
+            selected, out_mini, cumulative_gas, blob_gas_used, total_fees = built
+            return _seal(tree, overlay, parent_hash, attrs, env, extra_data,
+                         selected, out_mini, cumulative_gas, blob_gas_used,
+                         excess_blob, cancun, base_fee, total_fees)
     failed_senders: set[bytes] = set()
     txs_iter = pool.best_transactions(base_fee) if pool is not None else ()
     for tx in txs_iter:
@@ -128,9 +141,65 @@ def build_payload(
             state._capture_account_change(w.address)
             state.add_balance(w.address, w.amount * 10**9)
 
-    # state root over a scratch overlay (not retained; newPayload re-derives)
     post_accounts, post_storage = state.final_state()
     out = _MiniOutput(state.changes, post_accounts, post_storage, receipts)
+    return _seal(tree, overlay, parent_hash, attrs, env, extra_data,
+                 selected, out, cumulative_gas, blob_gas_used, excess_blob,
+                 cancun, base_fee, total_fees)
+
+
+def _build_parallel(tree, pool, overlay, env, base_fee, cancun, blob_params,
+                    attrs):
+    """Candidate selection through the optimistic scheduler; returns
+    ``(selected, mini_output, cumulative_gas, blob_gas_used, total_fees)``
+    or None (caller falls back to the serial greedy loop)."""
+    try:
+        from ..engine.optimistic import execute_candidates_optimistic
+        from ..primitives.types import recover_senders
+
+        candidates = list(pool.best_transactions(base_fee))
+        if len(candidates) < 4:
+            return None
+        rec = recover_senders(candidates)
+        txs, senders = [], []
+        for tx, s in zip(candidates, rec):
+            if s is None:
+                pool.remove_invalid(tx.hash)
+                continue
+            txs.append(tx)
+            senders.append(s)
+        out, committed, evicted, blob_gas_used, _stats = \
+            execute_candidates_optimistic(
+                ProviderStateSource(overlay), env, txs, senders,
+                tree.config, max_workers=getattr(tree, "exec_workers", None),
+                withdrawals=attrs.withdrawals,
+                blob_cap=blob_params.max_gas if cancun else None)
+        for i in evicted:
+            pool.remove_invalid(txs[i].hash)
+        selected = [txs[i] for i in committed]
+        total_fees = 0
+        prev = 0
+        for i, r in zip(committed, out.receipts):
+            gas_used = r.cumulative_gas_used - prev
+            prev = r.cumulative_gas_used
+            total_fees += gas_used * max(
+                0, txs[i].effective_gas_price(base_fee) - base_fee)
+        mini = _MiniOutput(out.changes, out.post_accounts, out.post_storage,
+                           out.receipts)
+        return selected, mini, out.gas_used, blob_gas_used, total_fees
+    except Exception:  # noqa: BLE001 — the serial loop is the fallback
+        return None
+
+
+def _seal(tree, overlay, parent_hash, attrs, env, extra_data, selected, out,
+          cumulative_gas, blob_gas_used, excess_blob, cancun, base_fee,
+          total_fees):
+    """State root + header assembly shared by the serial and parallel
+    selection paths (the sealed block is identical either way)."""
+    parent_num = overlay.block_number(parent_hash)
+    parent = overlay.header_by_number(parent_num)
+    receipts = out.receipts
+    # state root over a scratch overlay (not retained; newPayload re-derives)
     scratch = DatabaseProvider(OverlayTx(tree.factory.db.tx(),
                                          tree._chain_layers(parent_hash), {}))
     root = tree._state_root_job(scratch, out)

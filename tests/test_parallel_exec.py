@@ -1,0 +1,468 @@
+"""Optimistic parallel execution (engine/optimistic.py): randomized
+differential equivalence with the serial executor across conflict rates,
+worker counts, coinbase-sensitive ranks, and mid-block reverts; the
+RETH_TPU_FAULT_EXEC_* drills; a threaded stress run over the shared
+native core; and the conflict-check micro-benchmark (the O(wave^2) ->
+aggregate-isdisjoint satellite)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from reth_tpu.engine.bal import TxAccess
+from reth_tpu.engine.optimistic import (
+    AsyncStateReader,
+    execute_block_optimistic,
+)
+from reth_tpu.evm import BlockExecutor, EvmConfig
+from reth_tpu.evm.executor import (
+    BEACON_ROOTS_ADDRESS,
+    InMemoryStateSource,
+    InvalidTransaction,
+)
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256
+from reth_tpu.primitives.types import Block, Header, Transaction
+
+CFG = EvmConfig(chain_id=1)
+COINBASE = b"\xc0" * 20
+
+# PUSH0 CALLDATALOAD PUSH0 SSTORE STOP — slot0 = calldata word
+STORE_CODE = bytes.fromhex("5f355f5500")
+# PUSH0 PUSH0 REVERT
+REVERT_CODE = bytes.fromhex("5f5ffd")
+# PUSH20 <coinbase> BALANCE POP STOP — a genuine coinbase read
+READ_COINBASE = bytes([0x73]) + COINBASE + bytes.fromhex("315000")
+CODES = {keccak256(STORE_CODE): STORE_CODE,
+         keccak256(REVERT_CODE): REVERT_CODE,
+         keccak256(READ_COINBASE): READ_COINBASE}
+
+
+def _sender(i: int) -> bytes:
+    return bytes([0xA0]) + i.to_bytes(19, "big")
+
+
+def _tx(nonce, to, value=0, data=b"", gas_limit=200_000, **kw):
+    return Transaction(tx_type=2, chain_id=1, nonce=nonce,
+                       max_fee_per_gas=100 * 10**9,
+                       max_priority_fee_per_gas=10**9, gas_limit=gas_limit,
+                       to=to, value=value, data=data, **kw)
+
+
+def _block(txs, gas_limit=1_000_000_000, **hkw):
+    header = Header(number=1, gas_limit=gas_limit, base_fee_per_gas=7,
+                    beneficiary=COINBASE, **hkw)
+    return Block(header, tuple(txs), (), ())
+
+
+def _assert_equal(serial, out):
+    assert [r.encode_2718() for r in serial.receipts] == \
+           [r.encode_2718() for r in out.receipts]
+    assert serial.gas_used == out.gas_used
+    assert serial.post_accounts == out.post_accounts
+    assert serial.post_storage == out.post_storage
+    assert serial.changes.accounts == out.changes.accounts
+    assert serial.changes.storage == out.changes.storage
+    assert serial.changes.wiped_storage == out.changes.wiped_storage
+    assert serial.requests == out.requests
+
+
+def _run_both(accounts, txs, senders, workers=4, block=None, codes=None):
+    def mk():
+        return InMemoryStateSource(dict(accounts),
+                                   codes=dict(codes or CODES))
+
+    blk = block if block is not None else _block(txs)
+    serial = BlockExecutor(mk(), CFG).execute(blk, senders)
+    out, stats = execute_block_optimistic(mk(), blk, senders, CFG,
+                                          max_workers=workers)
+    _assert_equal(serial, out)
+    return serial, out, stats
+
+
+def test_disjoint_ranks_commit_native():
+    n = 24
+    senders = [_sender(i) for i in range(n)]
+    accounts = {s: Account(balance=10**20) for s in senders}
+    txs = []
+    for i in range(n):
+        if i % 2:
+            c = bytes([0x5C]) + i.to_bytes(19, "big")
+            accounts[c] = Account(code_hash=keccak256(STORE_CODE))
+            txs.append(_tx(0, c, data=(0xAB00 + i).to_bytes(32, "big")))
+        else:
+            txs.append(_tx(0, bytes([0xD0]) + i.to_bytes(19, "big"),
+                           value=1 + i, gas_limit=21_000))
+    _, _, stats = _run_both(accounts, txs, senders, workers=8)
+    assert stats["fallback"] is None
+    assert stats["native"] == n  # everything took the native core
+    assert stats["conflicts"] == 0
+    assert stats["rounds"] <= 3  # static keys + one read-feedback retry
+
+
+@pytest.mark.parametrize("conflict_rate", [0.0, 0.3, 0.7])
+@pytest.mark.parametrize("workers", [1, 4])
+def test_randomized_differential(conflict_rate, workers):
+    """Random mixes of transfers, shared-slot stores (conflicting ranks),
+    private stores, coinbase-sensitive reads, reverting calls, and
+    same-sender nonce chains — receipts/logs/gas/state bit-identical."""
+    rng = np.random.default_rng(int(conflict_rate * 10) * 7 + workers)
+    n = 28
+    senders, txs = [], []
+    accounts = {}
+    shared = b"\x5e" * 20
+    accounts[shared] = Account(code_hash=keccak256(STORE_CODE))
+    reader = b"\x5d" * 20
+    accounts[reader] = Account(code_hash=keccak256(READ_COINBASE))
+    reverter = b"\x5b" * 20
+    accounts[reverter] = Account(code_hash=keccak256(REVERT_CODE))
+    chain_sender = _sender(999)
+    accounts[chain_sender] = Account(balance=10**20)
+    chain_nonce = 0
+    for i in range(n):
+        roll = rng.random()
+        if roll < conflict_rate:
+            s = _sender(i)
+            accounts[s] = Account(balance=10**20)
+            senders.append(s)
+            txs.append(_tx(0, shared, data=int(
+                rng.integers(1, 1 << 60)).to_bytes(32, "big")))
+        elif roll < conflict_rate + 0.1:
+            senders.append(chain_sender)  # same-sender chain: serializes
+            txs.append(_tx(chain_nonce, bytes([0xD0]) * 20, value=1 + i,
+                           gas_limit=21_000))
+            chain_nonce += 1
+        elif roll < conflict_rate + 0.15:
+            s = _sender(i)
+            accounts[s] = Account(balance=10**20)
+            senders.append(s)
+            txs.append(_tx(0, reader))  # coinbase-sensitive
+        elif roll < conflict_rate + 0.2:
+            s = _sender(i)
+            accounts[s] = Account(balance=10**20)
+            senders.append(s)
+            txs.append(_tx(0, reverter))  # mid-block revert
+        else:
+            s = _sender(i)
+            accounts[s] = Account(balance=10**20)
+            c = bytes([0x5C]) + i.to_bytes(19, "big")
+            accounts[c] = Account(code_hash=keccak256(STORE_CODE))
+            senders.append(s)
+            txs.append(_tx(0, c, data=int(
+                rng.integers(1, 1 << 60)).to_bytes(32, "big")))
+    _, _, stats = _run_both(accounts, txs, senders, workers=workers)
+    assert stats["fallback"] is None
+    assert stats["native"] + stats["python"] == n
+
+
+def test_mid_block_revert_receipts_identical():
+    senders = [_sender(i) for i in range(3)]
+    accounts = {s: Account(balance=10**20) for s in senders}
+    reverter = b"\x5b" * 20
+    accounts[reverter] = Account(code_hash=keccak256(REVERT_CODE))
+    txs = [_tx(0, bytes([0xD1]) * 20, value=5, gas_limit=21_000),
+           _tx(0, reverter),
+           _tx(0, bytes([0xD2]) * 20, value=7, gas_limit=21_000)]
+    serial, out, _ = _run_both(accounts, txs, senders)
+    assert [r.success for r in out.receipts] == [True, False, True]
+
+
+def test_coinbase_sensitive_rank_goes_python():
+    senders = [_sender(i) for i in range(4)]
+    accounts = {s: Account(balance=10**20) for s in senders}
+    reader = b"\x5d" * 20
+    accounts[reader] = Account(code_hash=keccak256(READ_COINBASE))
+    txs = [_tx(0, bytes([0xD0 + i]) * 20, value=1 + i, gas_limit=21_000)
+           for i in range(3)] + [_tx(0, reader)]
+    _, _, stats = _run_both(accounts, txs, senders)
+    assert stats["python"] >= 1  # the coinbase reader left the native path
+
+
+def test_same_sender_nonce_chain():
+    s = _sender(7)
+    accounts = {s: Account(balance=10**20)}
+    txs = [_tx(k, bytes([0xD0 + k]) * 20, value=1 + k, gas_limit=21_000)
+           for k in range(3)]
+    _run_both(accounts, txs, [s, s, s])
+
+
+def test_invalid_block_raises_same_as_serial():
+    s = _sender(1)
+    accounts = {s: Account(balance=10**20)}
+    txs = [_tx(0, b"\xd1" * 20, value=1, gas_limit=21_000),
+           _tx(5, b"\xd2" * 20, value=2, gas_limit=21_000)]  # nonce gap
+    block = _block(txs)
+
+    def mk():
+        return InMemoryStateSource(dict(accounts), codes=dict(CODES))
+
+    with pytest.raises(InvalidTransaction):
+        BlockExecutor(mk(), CFG).execute(block, [s, s])
+    with pytest.raises(InvalidTransaction):
+        execute_block_optimistic(mk(), block, [s, s], CFG)
+
+
+def test_system_calls_and_requests_match_serial():
+    """A block with a parent beacon root and a present beacon-roots
+    contract: the pre-block system call's writes (and the Prague
+    requests collection) must fold identically to the serial path."""
+    senders = [_sender(i) for i in range(4)]
+    accounts = {s: Account(balance=10**20) for s in senders}
+    accounts[BEACON_ROOTS_ADDRESS] = Account(
+        code_hash=keccak256(STORE_CODE))
+    txs = [_tx(0, bytes([0xD0 + i]) * 20, value=1 + i, gas_limit=21_000)
+           for i in range(4)]
+    block = _block(txs, parent_beacon_block_root=b"\x42" * 32)
+    serial, out, stats = _run_both(accounts, txs, senders, block=block)
+    assert stats["fallback"] is None
+    # the system call's slot write is part of the compared post state
+    assert BEACON_ROOTS_ADDRESS in serial.post_storage
+
+
+def test_blob_tx_takes_python_path():
+    senders = [_sender(i) for i in range(3)]
+    accounts = {s: Account(balance=10**20) for s in senders}
+    blob = Transaction(
+        tx_type=3, chain_id=1, nonce=0, max_fee_per_gas=100 * 10**9,
+        max_priority_fee_per_gas=10**9, gas_limit=21_000,
+        to=b"\xd9" * 20, value=1, max_fee_per_blob_gas=10,
+        blob_versioned_hashes=(b"\x01" + b"\x00" * 31,))
+    txs = [_tx(0, b"\xd1" * 20, value=3, gas_limit=21_000), blob,
+           _tx(0, b"\xd2" * 20, value=4, gas_limit=21_000)]
+    _, _, stats = _run_both(accounts, txs, senders)
+    assert stats["python"] >= 1  # type-3 is statically native-ineligible
+
+
+def test_python_engine_without_native(monkeypatch):
+    """RETH_TPU_EXEC_NATIVE=0: the pure-Python Block-STM path — parallel
+    speculation, read-set validation, speculative commit of clean ranks
+    — still bit-identical."""
+    monkeypatch.setenv("RETH_TPU_EXEC_NATIVE", "0")
+    n = 10
+    senders = [_sender(i) for i in range(n)]
+    accounts = {s: Account(balance=10**20) for s in senders}
+    txs = [_tx(0, bytes([0xD0]) + i.to_bytes(19, "big"), value=1 + i,
+               gas_limit=21_000) for i in range(n)]
+    _, _, stats = _run_both(accounts, txs, senders)
+    assert stats["native"] == 0
+    assert stats["python"] == n
+    assert stats["speculative"] == n  # disjoint: every speculation commits
+
+
+def test_conflict_storm_drill(monkeypatch):
+    """RETH_TPU_FAULT_EXEC_CONFLICT_STORM: every rank is treated as
+    invalidated — the all-conflict worst case runs fully serial through
+    the re-execution ladder, output still bit-identical."""
+    monkeypatch.setenv("RETH_TPU_FAULT_EXEC_CONFLICT_STORM", "1")
+    n = 8
+    senders = [_sender(i) for i in range(n)]
+    accounts = {s: Account(balance=10**20) for s in senders}
+    txs = [_tx(0, bytes([0xD0]) + i.to_bytes(19, "big"), value=1 + i,
+               gas_limit=21_000) for i in range(n)]
+    _, _, stats = _run_both(accounts, txs, senders)
+    assert stats["native"] == 0
+    assert stats["serial_rerun"] == n
+    assert stats["speculative"] == 0
+
+
+def test_rank_wedge_drill_falls_back_serial(monkeypatch):
+    """RETH_TPU_FAULT_EXEC_RANK_WEDGE: a wedged speculative worker trips
+    the rank timeout; the scheduler abandons the attempt and the serial
+    fallback still produces the identical block."""
+    monkeypatch.setenv("RETH_TPU_FAULT_EXEC_RANK_WEDGE", "1")
+    monkeypatch.setenv("RETH_TPU_FAULT_EXEC_WEDGE_S", "1.5")
+    monkeypatch.setenv("RETH_TPU_EXEC_RANK_TIMEOUT", "0.1")
+    monkeypatch.setenv("RETH_TPU_EXEC_NATIVE", "0")  # force python ranks
+    n = 4
+    senders = [_sender(i) for i in range(n)]
+    accounts = {s: Account(balance=10**20) for s in senders}
+    txs = [_tx(0, bytes([0xD0]) + i.to_bytes(19, "big"), value=1 + i,
+               gas_limit=21_000) for i in range(n)]
+    serial, out, stats = _run_both(accounts, txs, senders)
+    assert stats["fallback"]  # the ladder's last rung ran
+    assert "wedged" in stats["fallback"]
+
+
+def test_threaded_stress_shared_native_core():
+    """Concurrent schedulers over the one shared libevmexec: each thread
+    executes its own block and must match its own serial run."""
+    errs: list = []
+
+    def worker(seed):
+        try:
+            n = 12
+            senders = [bytes([0xB0 + seed]) + i.to_bytes(19, "big")
+                       for i in range(n)]
+            accounts = {s: Account(balance=10**20) for s in senders}
+            txs = []
+            for i in range(n):
+                c = bytes([0x50 + seed]) + i.to_bytes(19, "big")
+                accounts[c] = Account(code_hash=keccak256(STORE_CODE))
+                txs.append(_tx(0, c, data=(seed * 1000 + i).to_bytes(32,
+                                                                     "big")))
+            _run_both(accounts, txs, senders, workers=2)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+
+
+def test_async_reader_prefetches_and_stops():
+    src = InMemoryStateSource(
+        {_sender(0): Account(balance=5)}, {_sender(0): {b"\x01" * 32: 9}})
+    reader = AsyncStateReader(src, workers=1)
+    reader.request([_sender(0), (_sender(0), b"\x01" * 32)])
+    deadline = time.time() + 5
+    while time.time() < deadline and reader.prefetched < 2:
+        time.sleep(0.01)
+    assert reader.accounts[_sender(0)].balance == 5
+    assert reader.slots[(_sender(0), b"\x01" * 32)] == 9
+    reader.stop()
+
+
+def test_conflict_check_microbench():
+    """Satellite: the aggregate-isdisjoint conflict predicate must beat a
+    per-pair scan by a wide margin on a big conflict-free wave (the
+    documented O(wave^2) hot cost)."""
+    n = 800
+    accs = []
+    for i in range(n):
+        a = TxAccess(index=i)
+        a.account_writes = {i.to_bytes(2, "big") + bytes(18)}
+        a.account_reads = set(a.account_writes)
+        a.slot_writes = {(b"\x5c" * 20, i.to_bytes(32, "big"))}
+        a.slot_reads = set(a.slot_writes)
+        accs.append(a)
+
+    t0 = time.perf_counter()
+    hits = 0
+    for i, a in enumerate(accs):  # the seed's shape: scan every pair
+        mine_a = a.account_reads | a.account_writes
+        mine_s = a.slot_reads | a.slot_writes
+        for b in accs[:i]:
+            if b.account_writes & mine_a or b.slot_writes & mine_s:
+                hits += 1
+    t_pair = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    accts: set = set()
+    slots: set = set()
+    agg_hits = 0
+    for a in accs:
+        if a.conflicts_with_write_sets(accts, slots):
+            agg_hits += 1
+        accts |= a.account_writes
+        slots |= a.slot_writes
+    t_agg = time.perf_counter() - t0
+
+    assert hits == 0 and agg_hits == 0  # the wave really is conflict-free
+    assert t_agg * 2 < t_pair, (t_agg, t_pair)
+
+
+def test_exec_metrics_recorded():
+    from reth_tpu.metrics import REGISTRY, exec_metrics
+
+    before = REGISTRY.counter("exec_parallel_blocks_total").value
+    exec_metrics.record_optimistic(
+        {"rounds": 2, "native": 10, "python": 1, "speculative": 1,
+         "serial_rerun": 0, "conflicts": 3, "misses": 1, "prefetched": 40,
+         "workers": 4, "wall_s": 0.01, "fallback": None})
+    assert REGISTRY.counter("exec_parallel_blocks_total").value == before + 1
+    assert exec_metrics.last["native"] == 10
+    exec_metrics.record_bal({"waves": 3, "parallel": 5, "serial": 2,
+                             "native": 6})
+    assert exec_metrics.last_bal["waves"] == 3
+    assert REGISTRY.counter("exec_bal_waves_total").value >= 3
+
+
+def test_engine_tree_parallel_exec_roots():
+    """An EngineTree with --parallel-exec validates real payloads with
+    roots identical to the builder's, recording per-block stats."""
+    from reth_tpu.consensus import EthBeaconConsensus
+    from reth_tpu.engine import EngineTree
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.storage import MemDb, ProviderFactory
+    from reth_tpu.storage.genesis import init_genesis
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    CPU = TrieCommitter(hasher=keccak256_batch_np)
+    wallets = [Wallet(0x7000 + i) for i in range(5)]
+    builder = ChainBuilder(
+        {w.address: Account(balance=10**20) for w in wallets},
+        committer=CPU)
+    builder.build_block([w.transfer(bytes([0xE0 + i]) * 20, 100 + i)
+                         for i, w in enumerate(wallets)])
+    builder.build_block([wallets[0].transfer(wallets[1].address, 10**19),
+                         wallets[1].transfer(wallets[2].address, 77),
+                         wallets[3].transfer(b"\xe9" * 20, 1),
+                         wallets[4].transfer(b"\xea" * 20, 2)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 committer=CPU)
+    tree = EngineTree(factory, CPU, EthBeaconConsensus(CPU),
+                      parallel_exec=True)
+    tree.prewarm_threshold = 2
+    for block in builder.blocks[1:]:
+        status = tree.on_new_payload(block)
+        assert status.status.name == "VALID", status.validation_error
+        tree.on_forkchoice_updated(block.header.hash)
+    assert tree.last_exec is not None
+    assert tree.last_exec["fallback"] is None
+    assert tree.last_exec["native"] + tree.last_exec["python"] == 4
+    assert tree.last_prewarm is None  # the prewarm pass was folded in
+
+
+def test_payload_builder_parallel_matches_serial():
+    """build_payload with --parallel-exec seals a bit-identical block."""
+    from reth_tpu.consensus import EthBeaconConsensus
+    from reth_tpu.engine import EngineTree
+    from reth_tpu.payload.builder import PayloadAttributes, build_payload
+    from reth_tpu.pool.pool import PoolConfig, TransactionPool
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.storage import MemDb, ProviderFactory
+    from reth_tpu.storage.genesis import init_genesis
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    CPU = TrieCommitter(hasher=keccak256_batch_np)
+    wallets = [Wallet(0x8000 + i) for i in range(8)]
+    builder = ChainBuilder(
+        {w.address: Account(balance=10**20) for w in wallets},
+        committer=CPU)
+
+    def mk_tree(par):
+        factory = ProviderFactory(MemDb())
+        init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                     committer=CPU)
+        return EngineTree(factory, CPU, EthBeaconConsensus(CPU),
+                          parallel_exec=par)
+
+    def mk_pool(tree):
+        pool = TransactionPool(lambda: tree.overlay_provider(),
+                               PoolConfig(chain_id=1))
+        for i, w in enumerate(wallets):
+            pool.add_transaction(
+                Wallet(w.priv).transfer(bytes([0xF0 + i]) * 20, 1000 + i))
+        return pool
+
+    attrs = PayloadAttributes(timestamp=builder.genesis.timestamp + 12,
+                              suggested_fee_recipient=COINBASE)
+    t_ser = mk_tree(False)
+    b_ser, f_ser = build_payload(t_ser, mk_pool(t_ser),
+                                 builder.genesis.hash, attrs)
+    t_par = mk_tree(True)
+    b_par, f_par = build_payload(t_par, mk_pool(t_par),
+                                 builder.genesis.hash, attrs)
+    assert b_ser.hash == b_par.hash
+    assert f_ser == f_par
+    assert len(b_par.transactions) == len(wallets)
