@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -69,6 +69,15 @@ test-gateway:
 test-obs:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_observability.py -q -p no:cacheprovider
+
+# device warm-up manager: shape-menu AOT compile lifecycle (watchdog +
+# backoff retry under the RETH_TPU_FAULT_COMPILE_WEDGE drill, degraded
+# CPU serving, promotion after recovery), persistent-cache validation /
+# corruption quarantine / subprocess cache probes, and the keccak/fused
+# tier clamps — CPU-only, no device required
+test-warmup:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_warmup.py -q -p no:cacheprovider
 
 # overlapped rebuild pipeline: parity vs the serial committer, packing,
 # arena residency, abort/failover drills, chunked-resume — fast, CPU-only

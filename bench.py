@@ -101,6 +101,15 @@ def _emit(value, vs_baseline, error=None, exit_code=None, **extra):
         "unit": _STATE.get("unit", "hashes/s"),
         "vs_baseline": vs_baseline,
         "backend": _STATE.get("backend", "unknown"),
+        # warm-up attribution rides on EVERY line (incl. watchdog/error
+        # lines): a wedged-tunnel zero without a warmup_state field is how
+        # five rounds of BENCH data became unreadable. Resolved LIVE from
+        # the manager so even a line emitted mid-warm-up (watchdog fired
+        # while a compile wedged) records which shape it died on.
+        "warmup_state": (_STATE["warmup_mgr"].snapshot()
+                         if _STATE.get("warmup_mgr") is not None
+                         else _STATE.get("warmup_state", "off")),
+        "compile_cache": _STATE.get("compile_cache", "off"),
     }
     line.update(_compile_split())
     if error:
@@ -638,12 +647,72 @@ def run_exec_mode() -> None:
           receipts_identical=True, exit_code=0)
 
 
+def _setup_compile_cache() -> None:
+    """RETH_TPU_COMPILE_CACHE_DIR: validate (quarantining corruption) and
+    enable the persistent XLA compilation cache, but ONLY after a
+    subprocess probe proves this jax build can run with it — the cache has
+    deadlocked the first jit over the axon tunnel before. The emitted
+    ``compile_cache`` field splits cold (empty cache, compiles pay full
+    wall) from warm (restart/rerun: compiles load from disk), so
+    compile_wall_s is attributable."""
+    cache_dir = os.environ.get("RETH_TPU_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        return
+    _STATE["phase"] = "compile-cache validation"
+    try:
+        from reth_tpu.ops.warmup import CompileCache
+
+        cc = CompileCache(cache_dir)
+        rep = cc.validate()
+        state = "warm" if rep["entries"] else "cold"
+        if cc.probe() and cc.enable():
+            _STATE["compile_cache"] = {
+                "dir": str(cc.dir), "state": state,
+                "entries": rep["entries"],
+                "quarantined": rep["quarantined"]}
+            _STATE["_cache_obj"] = cc  # hands per-shape hit tracking to warm-up
+        else:
+            _STATE["compile_cache"] = {
+                "dir": str(cc.dir), "state": "probe-failed-disabled"}
+    except Exception as e:  # noqa: BLE001 — cache is never fatal to a bench
+        _STATE["compile_cache"] = {"state": f"error: {e}"}
+
+
+def _maybe_warmup() -> None:
+    """RETH_TPU_WARMUP=background|block: run the real warm-up manager
+    (ops/warmup.py) over the default shape menu before measuring, so the
+    measured window is pure steady state and the line's ``warmup_state``
+    carries the per-shape compile walls + cache hit/miss split."""
+    mode = os.environ.get("RETH_TPU_WARMUP", "off")
+    if mode == "off":
+        return
+    _STATE["phase"] = "managed warm-up (shape menu)"
+    try:
+        from reth_tpu.ops.warmup import WarmupManager
+
+        # the cache (already validated + probe-enabled above) rides along
+        # so per-shape cache hits/misses land in warmup_state
+        mgr = WarmupManager(cache=_STATE.get("_cache_obj"),
+                            verify_cache=False,
+                            enable_cache=False)
+        _STATE["warmup_mgr"] = mgr  # _emit snapshots it live
+        if mode == "block":
+            mgr.run()
+        else:
+            mgr.start()
+            mgr.wait(timeout=_DEADLINE / 2)
+    except Exception as e:  # noqa: BLE001 — warm-up is never fatal to a bench
+        _STATE["warmup_state"] = {"state": f"error: {e}"}
+
+
 def main():
     # record spans/events from the start: the flight-recorder excerpt in
     # any error line needs the trail (probe attempts, first compiles)
     from reth_tpu import tracing
 
     tracing.set_trace_enabled(True)
+    _setup_compile_cache()
+    _maybe_warmup()
     mode = os.environ.get("RETH_TPU_BENCH_MODE", "exec")
     if mode == "service":
         run_service_mode()
@@ -695,6 +764,12 @@ def main():
     t_warm = time.time()
     run_rebuild(dev_committer, storage_jobs, account_jobs, pipelined=True)
     dt_warm = time.time() - t_warm
+    if (_STATE.get("warmup_mgr") is None
+            and _STATE.get("warmup_state", "off") == "off"):
+        # no managed warm-up ran: the untimed full pass IS the warm-up —
+        # still attributed, so this line can't masquerade as steady state
+        _STATE["warmup_state"] = {"state": "bench-warm-pass",
+                                  "wall_s": round(dt_warm, 3)}
 
     _STATE["phase"] = "device run"
     roots_dev, hashed_dev, dt_dev = run_rebuild(

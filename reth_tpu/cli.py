@@ -30,10 +30,32 @@ def _num(v, default=0) -> int:
     return int(s)
 
 
+def _resolve_warmup(args) -> tuple[str, str | None]:
+    """(mode, cache_dir) for the warm-up manager: flags beat env; the cache
+    dir defaults under the datadir once warm-up is on."""
+    import os
+
+    mode = (getattr(args, "warmup", None)
+            or os.environ.get("RETH_TPU_WARMUP") or "off")
+    cache_dir = (getattr(args, "compile_cache_dir", None)
+                 or os.environ.get("RETH_TPU_COMPILE_CACHE_DIR"))
+    if not cache_dir and mode != "off" and getattr(args, "datadir", None):
+        cache_dir = str(Path(args.datadir) / "compile-cache")
+    return mode, cache_dir
+
+
 def _make_committer(args):
     from .trie.committer import TrieCommitter
 
     mode = getattr(args, "hasher", "device")
+    warm_mode, cache_dir = _resolve_warmup(args)
+    warmup = None
+    if mode != "cpu" and warm_mode != "off":
+        # device warm-up manager (ops/warmup.py): the shape menu AOT-
+        # compiles under per-shape watchdog budgets while the node serves
+        # degraded on the CPU twin; the persistent compile cache (keyed
+        # under the datadir, probe-verified) makes restarts near-free
+        from .ops.warmup import build_warmup
     if mode == "cpu":
         from .primitives.keccak import keccak256_batch_np
 
@@ -47,15 +69,27 @@ def _make_committer(args):
 
         sup = DeviceSupervisor.shared()
         healthy = sup.startup()
-        committer = TrieCommitter(supervisor=sup)
+        if warm_mode != "off":
+            warmup = build_warmup(supervisor=sup, cache_dir=cache_dir)
+        committer = TrieCommitter(supervisor=sup, warmup=warmup)
         committer.turbo_backend = "auto"
         if not healthy:
             print(f"hasher auto: device unhealthy at startup "
                   f"({sup.last_probe.diag}); routing to cpu until a "
                   f"re-probe succeeds", file=sys.stderr)
     else:
-        committer = TrieCommitter()
+        if warm_mode != "off":
+            warmup = build_warmup(cache_dir=cache_dir)
+        committer = TrieCommitter(warmup=warmup)
         committer.turbo_backend = "device"
+    if warmup is not None:
+        committer.warmup = warmup
+        if warm_mode == "block":
+            # blocking warm-up: nothing dispatches before the menu is warm
+            # (offline commands — init/import — prefer determinism)
+            warmup.run()
+        else:
+            warmup.start()
     if getattr(args, "hash_service", False):
         # --hash-service: ONE background service owns the (supervised)
         # hashing backend and multiplexes every client over priority lanes
@@ -317,6 +351,7 @@ def cmd_node(args):
         from .rpc.jwt import load_or_create_secret
 
         jwt_secret = load_or_create_secret(args.authrpc_jwtsecret)
+    warm_mode, warm_cache = _resolve_warmup(args)
     cfg = NodeConfig(datadir=args.datadir, dev=args.dev,
                      http_port=args.http_port, authrpc_port=args.authrpc_port,
                      jwt_secret=jwt_secret, ws_port=args.ws_port,
@@ -332,6 +367,8 @@ def cmd_node(args):
                      sparse_workers=getattr(args, "sparse_workers", None),
                      parallel_exec=getattr(args, "parallel_exec", False),
                      rpc_gateway=getattr(args, "rpc_gateway", False),
+                     warmup=warm_mode,
+                     compile_cache_dir=warm_cache,
                      # --trace-blocks; unset falls back to RETH_TPU_TRACE
                      trace_blocks=(args.trace_blocks
                                    if getattr(args, "trace_blocks", None)
@@ -712,6 +749,8 @@ def cmd_config(args):
         f"persistence_threshold = {cfg.persistence_threshold}",
         f'hasher = "{cfg.hasher}"',
         f"hash_service = {'true' if cfg.hash_service else 'false'}",
+        f'warmup = "{cfg.warmup}"',
+        f'compile_cache_dir = "{cfg.compile_cache_dir}"',
         f"sparse_workers = {cfg.sparse_workers}",
         f"parallel_exec = {'true' if cfg.parallel_exec else 'false'}",
         f"trace_blocks = {'true' if cfg.trace_blocks else 'false'}",
@@ -925,6 +964,31 @@ def main(argv=None) -> int:
                             "composes with --hasher auto (breaker trips / "
                             "CPU failover apply to the shared service) — "
                             "see RETH_TPU_FAULT_SERVICE_* drill knobs")
+        p.add_argument("--warmup", choices=["off", "background", "block"],
+                       default=None,
+                       help="device warm-up manager (ops/warmup.py): AOT-"
+                            "compile the declared kernel shape menu one "
+                            "shape at a time under per-shape watchdog "
+                            "budgets with retry + backoff, sequenced "
+                            "behind the supervisor's health probe. "
+                            "'background' serves degraded on the CPU twin "
+                            "meanwhile, promoting each shape as it warms; "
+                            "'block' finishes warm-up before serving. "
+                            "Default: RETH_TPU_WARMUP or off. See "
+                            "RETH_TPU_FAULT_COMPILE_WEDGE for the drill, "
+                            "RETH_TPU_WARMUP_{BUDGET,ATTEMPTS,BACKOFF} "
+                            "for the knobs; also [node] warmup in "
+                            "reth.toml")
+        p.add_argument("--compile-cache-dir", dest="compile_cache_dir",
+                       default=None,
+                       help="persistent XLA compilation cache directory "
+                            "for --warmup (versioned by kernel-source "
+                            "digest; corrupt entries are quarantined and "
+                            "rebuilt; only enabled after a subprocess "
+                            "probe proves the cache loads). Default: "
+                            "<datadir>/compile-cache when --warmup is on; "
+                            "also RETH_TPU_COMPILE_CACHE_DIR or [node] "
+                            "compile_cache_dir in reth.toml")
 
     def add_db_arg(p):
         # paged (the COW B+tree / MDBX analogue) is the DEFAULT everywhere

@@ -112,6 +112,16 @@ class RethTpuConfig:
     # multiplex every keccak client over the shared background hash
     # service (ops/hash_service.py): priority lanes + continuous batching
     hash_service: bool = False
+    # device warm-up manager (--warmup CLI equivalent, ops/warmup.py):
+    # "off" | "background" (serve degraded on the CPU twin while the shape
+    # menu AOT-compiles, promoting shapes as they warm) | "block" (finish
+    # warm-up before serving)
+    warmup: str = "off"
+    # persistent XLA compilation cache directory for warm-up (versioned by
+    # kernel-source digest, probe-verified before enabling; corrupt entries
+    # quarantined + rebuilt). Empty = <datadir>/compile-cache when warm-up
+    # is on (--compile-cache-dir CLI equivalent)
+    compile_cache_dir: str = ""
     # parallel sparse commit: width of the live-tip finish path's RLP
     # encode pool AND the proof-worker pool (trie/sparse.py +
     # trie/proof.py). 0 = auto (env RETH_TPU_SPARSE_WORKERS or
@@ -156,6 +166,9 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.persistence_threshold = node.get("persistence_threshold", cfg.persistence_threshold)
     cfg.hasher = node.get("hasher", cfg.hasher)
     cfg.hash_service = bool(node.get("hash_service", cfg.hash_service))
+    cfg.warmup = str(node.get("warmup", cfg.warmup))
+    cfg.compile_cache_dir = str(node.get("compile_cache_dir",
+                                         cfg.compile_cache_dir))
     cfg.sparse_workers = int(node.get("sparse_workers", cfg.sparse_workers))
     cfg.parallel_exec = bool(node.get("parallel_exec", cfg.parallel_exec))
     cfg.trace_blocks = bool(node.get("trace_blocks", cfg.trace_blocks))

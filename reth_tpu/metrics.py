@@ -545,6 +545,91 @@ class SupervisorMetrics:
         self._probe_seconds.record(latency)
 
 
+class WarmupMetrics:
+    """Device warm-up manager observability (ops/warmup.py): menu progress
+    (shapes warm/failed out of declared), per-shape compile walls, watchdog
+    wedges and backoff retries, persistent-cache hits/misses/quarantines,
+    and how many dispatch buckets degraded-mode serving routed to the CPU
+    twin — what an operator needs to see that the node is (still) paying
+    compile cost, and whether restarts actually hit the on-disk cache."""
+
+    _STATES = {"off": 0.0, "pending": 1.0, "warming": 2.0, "warm": 3.0,
+               "degraded": 4.0}
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._state = reg.gauge(
+            "warmup_state",
+            "0 off, 1 pending, 2 warming, 3 warm, 4 degraded")
+        self._total = reg.gauge(
+            "warmup_shapes_total", "declared menu shapes")
+        self._warm = reg.gauge(
+            "warmup_shapes_warm", "menu shapes compiled and promoted")
+        self._failed = reg.gauge(
+            "warmup_shapes_failed",
+            "menu shapes that exhausted their compile retries")
+        self._compiles = reg.counter(
+            "warmup_compiles_total", "successful AOT shape compiles")
+        self._compile_s = reg.counter(
+            "warmup_compile_seconds_total",
+            "wall spent in successful warm-up compiles")
+        self._compile_hist = reg.histogram(
+            "warmup_compile_seconds", "per-shape AOT compile wall",
+            buckets=(0.05, 0.25, 1, 5, 15, 60, 240, 1200))
+        self._retries = reg.counter(
+            "warmup_retries_total", "compile retries after a wedge/failure")
+        self._wedges = reg.counter(
+            "warmup_wedges_total",
+            "compiles that exceeded the watchdog budget or raised")
+        self._cpu_routed = reg.counter(
+            "warmup_cpu_routed_total",
+            "dispatch buckets served on the CPU twin while un-warm")
+        self._cache_hits = reg.counter(
+            "warmup_cache_hits_total",
+            "shape compiles satisfied by the persistent cache")
+        self._cache_misses = reg.counter(
+            "warmup_cache_misses_total",
+            "shape compiles that wrote new persistent-cache entries")
+        self._cache_entries = reg.gauge(
+            "warmup_cache_entries",
+            "persistent-cache entries found at validation")
+        self._quarantines = reg.counter(
+            "warmup_cache_quarantines_total",
+            "corrupt cache directories quarantined and rebuilt")
+
+    def set_state(self, state: str) -> None:
+        self._state.set(self._STATES.get(state, 0.0))
+
+    def set_progress(self, *, total: int, warm: int, failed: int) -> None:
+        self._total.set(total)
+        self._warm.set(warm)
+        self._failed.set(failed)
+
+    def record_compile(self, wall_s: float, cache_hit: bool | None) -> None:
+        self._compiles.increment()
+        self._compile_s.increment(round(wall_s, 6))
+        self._compile_hist.record(wall_s)
+        if cache_hit is True:
+            self._cache_hits.increment()
+        elif cache_hit is False:
+            self._cache_misses.increment()
+
+    def record_retry(self) -> None:
+        self._retries.increment()
+
+    def record_wedge(self) -> None:
+        self._wedges.increment()
+
+    def record_cpu_routed(self, n: int = 1) -> None:
+        self._cpu_routed.increment(n)
+
+    def record_quarantine(self) -> None:
+        self._quarantines.increment()
+
+    def set_cache_entries(self, n: int) -> None:
+        self._cache_entries.set(n)
+
+
 class GatewayMetrics:
     """RPC serving gateway observability (rpc/gateway.py): per-class
     request counts, queue depth, running handlers, shed counts, and

@@ -91,6 +91,22 @@ class NodeEventReporter:
                      f" breaker={s['breaker']}")
             if s["trips"] or s["failovers"]:
                 line += f" trips={s['trips']} failovers={s['failovers']}"
+        # --warmup: the compile lifecycle's one-line health — menu
+        # progress, whether restarts hit the persistent cache, and how
+        # much serving is still degraded onto the CPU twin ("the node is
+        # slow right after start, why?" answer)
+        wu = getattr(self.node, "warmup", None)
+        if wu is not None:
+            w = wu.snapshot()
+            line += (f" warmup[{w['state']} {w['warm']}/{w['total']}"
+                     f" cache={w['cache']['mode']}")
+            if w["cache_hits"]:
+                line += f" hits={w['cache_hits']}"
+            if w["failed"]:
+                line += f" failed={w['failed']}"
+            if w["cpu_routed"]:
+                line += f" cpu_routed={w['cpu_routed']}"
+            line += f" wall={w['compile_wall_s']}s]"
         # --hash-service: the shared service's one-line health — queue
         # pressure, whether small batches actually fuse (cf = coalesce
         # factor), and the failure-path counters an operator pages on

@@ -79,6 +79,16 @@ class NodeConfig:
     # and flight-recorder dumps under the datadir (tracing.py)
     trace_blocks: bool = False
     trace_file: str | Path | None = None  # Chrome-trace path override
+    # --warmup / [node] warmup: device warm-up manager (ops/warmup.py) —
+    # AOT-compile the kernel shape menu behind the supervisor's health
+    # probe while serving degraded on the CPU twin ("background"), or
+    # finish warm-up before serving ("block"). "off" disables.
+    warmup: str = "off"
+    # --compile-cache-dir / [node] compile_cache_dir: persistent XLA
+    # compilation cache (kernel-source-versioned, probe-verified,
+    # quarantine-on-corruption). None = <datadir>/compile-cache when
+    # warm-up is on.
+    compile_cache_dir: str | Path | None = None
 
 
 class Node:
@@ -111,6 +121,24 @@ class Node:
         # client multiplexes over ops/hash_service.py — surfaced on the
         # events dashboard and hash_service_* /metrics
         self.hash_service = getattr(self.committer, "hash_service", None)
+        # device warm-up manager (--warmup): per-shape compile lifecycle +
+        # degraded-mode serving (ops/warmup.py). Usually built by the CLI
+        # alongside the committer; a directly-constructed Node with
+        # config.warmup set builds and starts one here.
+        self.warmup = getattr(self.committer, "warmup", None)
+        if self.warmup is None and config.warmup and config.warmup != "off":
+            from ..ops.warmup import build_warmup
+
+            cache_dir = config.compile_cache_dir
+            if not cache_dir and config.datadir:
+                cache_dir = Path(config.datadir) / "compile-cache"
+            self.warmup = build_warmup(
+                supervisor=self.hasher_supervisor, cache_dir=cache_dir)
+            self.committer.attach_warmup(self.warmup)
+            if config.warmup == "block":
+                self.warmup.run()
+            else:
+                self.warmup.start()
         # warm the native secp build now: a lazy first-use g++ compile
         # inside newPayload would stall a consensus response for seconds
         from ..primitives.secp256k1 import _native_lib

@@ -7,11 +7,12 @@ crates/trie/sparse/src/arena/mod.rs:2500-2548). Here those become batched,
 shape-stable XLA programs.
 """
 
-# NOTE: do NOT enable jax's persistent compilation cache here — setting
-# jax_compilation_cache_dir (or the jax_persistent_cache_min_* knobs)
-# deadlocks the first jit in this jax build (0.9.0/axon). Compile cost is
-# managed by minimising distinct program shapes instead (see KeccakDevice
-# block_tier / batch tiers).
+# NOTE: the persistent compilation cache is NEVER enabled at import time —
+# blindly setting jax_compilation_cache_dir has deadlocked the first jit in
+# this jax build (0.9.0/axon). The compile lifecycle is owned by the warm-up
+# manager (ops/warmup.py): a bounded shape menu AOT-compiled behind the
+# supervisor's health probe, and a cache directory that is only wired in
+# after a SUBPROCESS probe (probe_device(cache_dir=...)) proves it loads.
 
 from .keccak_jax import (
     keccak_f1600_jax,
@@ -35,8 +36,20 @@ from .hash_service import (
     LaneOverloaded,
     ServiceFaultInjector,
 )
+from .warmup import (
+    CompileCache,
+    MenuShape,
+    WarmupManager,
+    build_warmup,
+    default_menu,
+)
 
 __all__ = [
+    "CompileCache",
+    "MenuShape",
+    "WarmupManager",
+    "build_warmup",
+    "default_menu",
     "keccak_f1600_jax",
     "keccak256_jax_words",
     "keccak256_batch_jax",

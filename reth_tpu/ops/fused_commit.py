@@ -231,11 +231,29 @@ class FusedLevelEngine:
     # under 2^31 — scatter indices are int32 on the TPU, and a silent wrap
     # would drop splices and corrupt roots (2^21 rows * 544 B = 2^30.09)
     _MAX_ROWS = 1 << 21
+    # declared menu ceilings (ops/warmup.py, mirroring KeccakDevice): levels
+    # with more rows split across dispatches so one giant level can never
+    # mint a batch tier above the menu; block tiers past the ceiling raise
+    # (an MPT node tops out ~533 B = 4 rate blocks — 64 is generous slack,
+    # and there is no per-row CPU fallback mid-fused-commit to hide behind)
+    MAX_BATCH_ROWS = 1 << 16
+    MAX_BLOCK_TIER = 64
 
     def __init__(self, min_tier: int = 1024):
         self.min_tier = min_tier
         self._buf = None
         self._n_slots = 0
+
+    def _row_cap(self) -> int:
+        return min(self._MAX_ROWS, self.MAX_BATCH_ROWS)
+
+    def _check_block_tier(self, b_tier: int) -> int:
+        if b_tier > self.MAX_BLOCK_TIER:
+            raise ValueError(
+                f"node of {b_tier} rate blocks exceeds the declared "
+                f"block-tier ceiling {self.MAX_BLOCK_TIER} "
+                f"(ops/warmup.py shape menu)")
+        return b_tier
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -298,10 +316,10 @@ class FusedLevelEngine:
         n = len(bucket.templates)
         if n == 0:
             return
-        b_tier = _pow2(bucket.nb_max, floor=2)
+        b_tier = self._check_block_tier(_pow2(bucket.nb_max, floor=2))
         hole_budget = self._HOLE_FACTOR * _tier(n + 1, self.min_tier)
         over_holed = bucket.holes and len(bucket.holes) > hole_budget
-        if over_holed or n + 1 > self._MAX_ROWS:
+        if over_holed or n + 1 > self._row_cap():
             for part in self._split(bucket, hole_budget):
                 self._dispatch_one(part, b_tier)
             return
@@ -317,7 +335,7 @@ class FusedLevelEngine:
             row_holes = holes_by_row.get(row, [])
             if part.templates and (
                 len(part.holes) + len(row_holes) > hole_budget
-                or len(part.templates) + 2 > self._MAX_ROWS
+                or len(part.templates) + 2 > self._row_cap()
             ):
                 yield part
                 part = _Bucket()
@@ -382,6 +400,16 @@ class FusedLevelEngine:
             out.append(p)
         return n_tier, out
 
+    @staticmethod
+    def _filter_triples(triples, lo: int, hi: int):
+        """Select (row, coord, src) triples with lo <= row < hi, rebased."""
+        if triples is None:
+            return None
+        m = (triples[0] >= lo) & (triples[0] < hi)
+        if not m.any():
+            return None
+        return np.stack((triples[0][m] - lo, triples[1][m], triples[2][m]))
+
     def _pad_holes(self, holes, n: int, floor: int, growth_mult):
         """Pad (row, off/nib, src) triples; padding rows target row ``n``
         (always a padding row since n_tier >= n+1) and dummy slot 0."""
@@ -413,6 +441,19 @@ class FusedLevelEngine:
         n = len(row_off)
         if n == 0:
             return
+        self._check_block_tier(b_tier)
+        if n + 1 > self._row_cap():
+            # menu/row-cap clamp: split the level by row ranges (within-
+            # level order is free), rebasing the packed bytes and holes
+            cap = self._row_cap() - 1
+            for lo in range(0, n, cap):
+                hi = min(lo + cap, n)
+                base = int(row_off[lo])
+                end = int(row_off[hi - 1] + row_len[hi - 1])
+                self.dispatch_packed(
+                    flat[base:end], row_off[lo:hi] - base, row_len[lo:hi],
+                    slots[lo:hi], self._filter_triples(holes, lo, hi), b_tier)
+            return
         counts = (row_len // RATE + 1).astype(np.int32)
         n_tier, (row_off_p, row_len_p, counts_p, slots_p) = self._pad_rows(
             n, (row_off.astype(np.uint32), 0), (row_len.astype(np.uint32), 0),
@@ -439,6 +480,13 @@ class FusedLevelEngine:
         device (``_branch_level``)."""
         n = len(masks)
         if n == 0:
+            return
+        if n + 1 > self._row_cap():
+            cap = self._row_cap() - 1
+            for lo in range(0, n, cap):
+                hi = min(lo + cap, n)
+                self.dispatch_branch(masks[lo:hi], slots[lo:hi],
+                                     self._filter_triples(children, lo, hi))
             return
         n_tier, (masks_p, slots_p) = self._pad_rows(
             n, (masks.astype(np.int32), 0), (slots.astype(np.int32), 0)
@@ -613,25 +661,17 @@ class MegaFusedEngine(FusedLevelEngine):
             self._i32_off += a.size
         return off
 
-    @staticmethod
-    def _filter_triples(triples, lo: int, hi: int):
-        """Select (row, coord, src) triples with lo <= row < hi, rebased."""
-        if triples is None:
-            return None
-        m = (triples[0] >= lo) & (triples[0] < hi)
-        if not m.any():
-            return None
-        return np.stack((triples[0][m] - lo, triples[1][m], triples[2][m]))
-
     def dispatch_packed(self, flat, row_off, row_len, slots, holes, b_tier) -> None:
         n = len(row_off)
         if n == 0:
             return
+        self._check_block_tier(b_tier)
         L = b_tier * RATE
-        if n + 1 > self._MAX_ROWS:
-            # int32 scatter indices (row * L + byte) wrap past 2^31 — split
-            # the level by row ranges (within-level order is free)
-            cap = self._MAX_ROWS - 1
+        if n + 1 > self._row_cap():
+            # int32 scatter indices (row * L + byte) wrap past 2^31, and the
+            # warm-up menu caps the batch tier — split the level by row
+            # ranges (within-level order is free)
+            cap = self._row_cap() - 1
             for lo in range(0, n, cap):
                 hi = min(lo + cap, n)
                 base = int(row_off[lo])
@@ -666,8 +706,8 @@ class MegaFusedEngine(FusedLevelEngine):
         n = len(masks)
         if n == 0:
             return
-        if n + 1 > self._MAX_ROWS:
-            cap = self._MAX_ROWS - 1
+        if n + 1 > self._row_cap():
+            cap = self._row_cap() - 1
             for lo in range(0, n, cap):
                 hi = min(lo + cap, n)
                 self.dispatch_branch(masks[lo:hi], slots[lo:hi],

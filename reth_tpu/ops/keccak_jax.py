@@ -173,11 +173,21 @@ def keccak256_jax_words_masked(words, max_blocks: int, counts=None):
     return masked_absorb_words(words, max_blocks, counts)
 
 
-def _next_tier(n: int, min_tier: int = 8) -> int:
+def _next_tier(n: int, min_tier: int = 8, max_tier: int | None = None) -> int:
+    """Pow2 tier ladder from ``min_tier``; ``max_tier`` clamps growth to a
+    declared ceiling (the warm-up shape menu, ops/warmup.py) — callers must
+    chunk batches above it rather than minting an unbounded new tier."""
     t = min_tier
     while t < n:
         t *= 2
+    if max_tier is not None and t > max_tier:
+        return max_tier
     return t
+
+
+# one shared sentinel bucket for messages above the declared block-tier
+# ceiling: they hash on the CPU twin instead of minting a fresh program
+_CPU_BUCKET = 1 << 30
 
 
 def _to_u32(words: np.ndarray, batch_tier: int) -> np.ndarray:
@@ -203,21 +213,58 @@ class KeccakDevice:
     # messages (contract bytecode etc.) share masked programs at
     # power-of-two block tiers so compilation count stays bounded.
     MAX_EXACT_BLOCKS = 8
+    # Declared menu ceilings (ops/warmup.py default_menu): batches above
+    # MAX_BATCH_TIER are chunked; messages above MAX_BLOCK_TIER rate blocks
+    # hash on the CPU twin — either way no request can mint a program shape
+    # outside the warm-up menu (and trigger a fresh compile) mid-commit.
+    MAX_BATCH_TIER = 16384
+    MAX_BLOCK_TIER = 32
 
-    def __init__(self, min_tier: int = 8, block_tier: int | None = None):
+    def __init__(self, min_tier: int = 8, block_tier: int | None = None,
+                 warmup=None, max_batch_tier: int | None = None,
+                 max_block_tier: int | None = None):
         """``block_tier``: if set, ALL messages up to that many rate blocks
         share one masked program per batch tier (compile-count-minimal mode
         for workloads with a known size ceiling, e.g. trie nodes <= 4
         blocks); larger messages still fall back to pow2 tiers above it.
+        ``warmup``: an ``ops/warmup.py`` WarmupManager — buckets whose
+        (program, block_tier, batch_tier) shape is not warm yet hash on the
+        CPU twin instead of compiling inside a live dispatch.
         """
         self.min_tier = min_tier
         self.block_tier = block_tier
+        self.warmup = warmup
+        if max_block_tier is None:
+            max_block_tier = self.MAX_BLOCK_TIER
+        self.max_block_tier = max_block_tier
+        if max_batch_tier is None:
+            max_batch_tier = self.MAX_BATCH_TIER
+        # keep the ceiling ON the pow2 ladder from min_tier, so the chunk
+        # cap can never round up past it inside _hash_bucket
+        cap = min_tier
+        while cap * 2 <= max_batch_tier:
+            cap *= 2
+        self.max_batch_tier = cap
 
     def hash_batch(self, msgs: list[bytes]) -> list[bytes]:
+        cap = self.max_batch_tier
+        if len(msgs) > cap:
+            # one huge request never mints a tier above the menu ceiling:
+            # dispatch ceiling-sized chunks (order preserved)
+            out: list[bytes] = []
+            for lo in range(0, len(msgs), cap):
+                out.extend(bucketed_hash(msgs[lo:lo + cap],
+                                         self._hash_bucket,
+                                         bucket_key=self._bucket_key))
+            return out
         return bucketed_hash(msgs, self._hash_bucket, bucket_key=self._bucket_key)
 
     def _bucket_key(self, nb: int) -> int:
-        """Exact program for small block counts; shared pow2 tier above."""
+        """Exact program for small block counts; shared pow2 tier above —
+        clamped at the menu ceiling (over-ceiling messages share the CPU
+        bucket)."""
+        if nb > self.max_block_tier:
+            return _CPU_BUCKET
         if self.block_tier is not None:
             if nb <= self.block_tier:
                 return self.block_tier
@@ -225,6 +272,15 @@ class KeccakDevice:
         if nb <= self.MAX_EXACT_BLOCKS:
             return nb
         return _next_tier(nb, 2 * self.MAX_EXACT_BLOCKS)
+
+    @staticmethod
+    def _cpu_bucket(sub: list[bytes], counts: np.ndarray) -> np.ndarray:
+        """CPU-twin bucket: same row-viewable digest contract as the device
+        paths (rows ``.tobytes()`` == the 32-byte digest)."""
+        from ..primitives.keccak import keccak256_words_masked_np
+
+        words = pad_batch(sub, counts)
+        return keccak256_words_masked_np(words, int(counts.max()), counts)
 
     def _hash_bucket(self, sub: list[bytes], key: int, counts: np.ndarray) -> np.ndarray:
         """Hash one bucket; returns (n, 8) uint32 digests. Every dispatch
@@ -238,7 +294,19 @@ class KeccakDevice:
         from ..metrics import compile_tracker
 
         n = len(sub)
-        batch_tier = _next_tier(n, self.min_tier)
+        batch_tier = _next_tier(n, self.min_tier, self.max_batch_tier)
+        if key == _CPU_BUCKET:
+            # over the declared block-tier ceiling: CPU twin, no new program
+            return self._cpu_bucket(sub, counts)
+        if self.warmup is not None:
+            kind = ("keccak.exact"
+                    if self.block_tier is None and key <= self.MAX_EXACT_BLOCKS
+                    else "keccak.masked")
+            if not self.warmup.route_bucket(kind, key, batch_tier):
+                # shape not warm yet (degraded-mode serving): hash this
+                # bucket on the CPU twin; it promotes to the device the
+                # moment the warm-up manager marks the shape WARM
+                return self._cpu_bucket(sub, counts)
         if key == 1 and os.environ.get("RETH_TPU_PALLAS"):
             # hand-written fused kernel for the dominant single-block bucket;
             # any lowering failure falls back to the XLA path below

@@ -92,17 +92,31 @@ class ProbeResult:
 
 
 def probe_device(budget: float | None = None, *, code: str = PROBE_CODE,
-                 injector: "FaultInjector | None" = None) -> ProbeResult:
+                 injector: "FaultInjector | None" = None,
+                 cache_dir: str | None = None) -> ProbeResult:
     """One fail-fast health probe: run ``code`` in a subprocess under a hard
     wall-clock ``budget``. Returns a :class:`ProbeResult`; never raises and
     never blocks past the budget — a wedged tunnel wedges the CHILD.
 
-    NOTE: no ``jax_compilation_cache_dir`` in the child on purpose — the
-    persistent compile cache deadlocks the first jit over the axon tunnel
-    (measured round 2; see bench.py).
+    NOTE: the default probe deliberately runs WITHOUT a
+    ``jax_compilation_cache_dir`` — the persistent compile cache has
+    deadlocked the first jit over the axon tunnel (measured round 2; see
+    bench.py). ``cache_dir`` opts IN to cache validation: the child runs
+    with the persistent cache configured, so the warm-up manager
+    (``ops/warmup.py``) can prove a cache directory loads before wiring it
+    into the live process — a wedged cache wedges the child, never the
+    node.
     """
     if budget is None:
         budget = float(os.environ.get("RETH_TPU_PROBE_TIMEOUT", "120"))
+    if cache_dir is not None:
+        code = (
+            "import jax\n"
+            f"jax.config.update('jax_compilation_cache_dir', {cache_dir!r})\n"
+            "jax.config.update('jax_persistent_cache_min_compile_time_secs', 0)\n"
+            "jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)\n"
+            + code
+        )
     t0 = time.monotonic()
     if injector is not None and not injector.on_probe():
         tracing.fault_event("RETH_TPU_FAULT_PROBE_FAIL",
@@ -169,22 +183,29 @@ class FaultInjector:
     ``pipeline_abort``: the Nth rebuild-pipeline window raises
     :class:`InjectedPipelineAbort` — kills the chunk mid-queue so the
     chunked rebuild's resume-from-progress path is testable in-process.
+    ``compile_wedge``: the first N warm-up shape compiles wedge past their
+    watchdog budget (negative = every compile, until the field is cleared)
+    — the ``ops/warmup.py`` degraded-serving / backoff-retry drill.
 
     Env form (read by :meth:`from_env`, also settable via CLI):
     ``RETH_TPU_FAULT_WEDGE_EVERY`` / ``RETH_TPU_FAULT_DELAY`` /
-    ``RETH_TPU_FAULT_PROBE_FAIL`` / ``RETH_TPU_FAULT_PIPELINE_ABORT``.
+    ``RETH_TPU_FAULT_PROBE_FAIL`` / ``RETH_TPU_FAULT_PIPELINE_ABORT`` /
+    ``RETH_TPU_FAULT_COMPILE_WEDGE``.
     """
 
     def __init__(self, wedge_every: int = 0, delay: float = 0.0,
-                 probe_fail: int = 0, pipeline_abort: int = 0):
+                 probe_fail: int = 0, pipeline_abort: int = 0,
+                 compile_wedge: int = 0):
         self.wedge_every = wedge_every
         self.delay = delay
         self.probe_fail = probe_fail
         self.pipeline_abort = pipeline_abort
+        self.compile_wedge = compile_wedge
         self.dispatch_count = 0
         self.wedged = 0
         self.probes_failed = 0
         self.windows = 0
+        self.compiles_wedged = 0
         self._lock = threading.Lock()
 
     @classmethod
@@ -195,14 +216,30 @@ class FaultInjector:
         delay = float(env.get("RETH_TPU_FAULT_DELAY", "0") or 0)
         probe = int(env.get("RETH_TPU_FAULT_PROBE_FAIL", "0") or 0)
         pabort = int(env.get("RETH_TPU_FAULT_PIPELINE_ABORT", "0") or 0)
-        if not (wedge or delay or probe or pabort):
+        cwedge = int(env.get("RETH_TPU_FAULT_COMPILE_WEDGE", "0") or 0)
+        if not (wedge or delay or probe or pabort or cwedge):
             return None
         return cls(wedge_every=wedge, delay=delay, probe_fail=probe,
-                   pipeline_abort=pabort)
+                   pipeline_abort=pabort, compile_wedge=cwedge)
 
     def active(self) -> bool:
         return bool(self.wedge_every or self.delay or self.probe_fail
-                    or self.pipeline_abort)
+                    or self.pipeline_abort or self.compile_wedge)
+
+    def on_compile(self, budget: float) -> None:
+        """Called inside every warm-up compile worker. A wedged "compile"
+        sleeps well past the caller's watchdog ``budget`` in the (abandoned)
+        worker thread, so the REAL join-timeout path is exercised."""
+        with self._lock:
+            if self.compile_wedge == 0:
+                return
+            if self.compile_wedge > 0:
+                self.compile_wedge -= 1
+            self.compiles_wedged += 1
+        tracing.fault_event("RETH_TPU_FAULT_COMPILE_WEDGE",
+                            target="ops::warmup",
+                            compile=self.compiles_wedged)
+        time.sleep(min(budget * 3 + 1, budget + 60))
 
     def on_pipeline_window(self) -> None:
         """Called by the rebuild pipeline before dispatching each packed
@@ -367,6 +404,10 @@ class DeviceSupervisor:
         self.dispatch_errors = 0
         self.last_probe: ProbeResult | None = None
         self._probe_lock = threading.Lock()
+        # warm-up manager attachment (ops/warmup.py): per-shape readiness
+        # states ride here so committers/bench/events reach them through
+        # the supervisor they already hold
+        self.warmup = None
         self._publish()
 
     # -- shared instance (one supervisor per process, like REGISTRY) -------
@@ -423,6 +464,10 @@ class DeviceSupervisor:
                 if self.breaker.state == HALF_OPEN:
                     if self._probe().ok:
                         self.breaker.record_success()
+                        if self.warmup is not None:
+                            # the device just came back: promote any
+                            # compile-FAILED shapes in the background
+                            self.warmup.on_device_recovered()
                     else:
                         self.breaker.record_failure()
                         self.metrics.record_trip()
@@ -432,6 +477,13 @@ class DeviceSupervisor:
 
     def allows_device(self) -> bool:
         return self.route() == "device"
+
+    def warmup_allows_device(self) -> bool:
+        """Commit-level warm-up gate (fused path): a fused commit's
+        resident digest buffer can't hop backends at a shape boundary, so
+        the whole commit stays on the CPU twin until every menu shape is
+        warm. True when no warm-up manager is attached."""
+        return self.warmup is None or self.warmup.device_ready()
 
     # -- watchdog-bounded dispatch ----------------------------------------
 
@@ -503,6 +555,8 @@ class DeviceSupervisor:
             "probe_latency": None if lp is None else round(lp.latency, 3),
             "fault_injection": (self.injector.active()
                                 if self.injector is not None else False),
+            "warmup": (None if self.warmup is None
+                       else self.warmup.overall_state()),
         }
 
     def _publish(self) -> None:
@@ -572,7 +626,9 @@ class SupervisedBackend:
         self._journal = []
         self._device, self._cpu = None, None
         self.failed_over = False
-        if self.sup.route() == "device":
+        # warm-up gate first (cheap, no probe): a commit started during
+        # warm-up serves on the CPU twin — degraded mode, not a failover
+        if self.sup.warmup_allows_device() and self.sup.route() == "device":
             try:
                 self._device = self.sup.run_guarded(
                     self._factory, what="engine init")
@@ -624,10 +680,11 @@ class SupervisedHasher:
     """
 
     def __init__(self, supervisor: DeviceSupervisor, device_hasher=None,
-                 cpu_hasher=None, min_tier: int = 1024):
+                 cpu_hasher=None, min_tier: int = 1024, warmup=None):
         self.sup = supervisor
         self._device = device_hasher
         self._min_tier = min_tier
+        self._warmup = warmup
         if cpu_hasher is None:
             from ..primitives.keccak import keccak256_batch_np
 
@@ -638,8 +695,13 @@ class SupervisedHasher:
         if self._device is None:
             from .keccak_jax import KeccakDevice
 
+            # the warm-up manager (explicit, or attached to the supervisor
+            # after construction) gates each bucket: un-warm shapes hash on
+            # the CPU twin instead of compiling mid-commit
+            warmup = self._warmup if self._warmup is not None else self.sup.warmup
             self._device = KeccakDevice(
-                min_tier=self._min_tier, block_tier=4).hash_batch
+                min_tier=self._min_tier, block_tier=4,
+                warmup=warmup).hash_batch
         return self._device
 
     def __call__(self, msgs):

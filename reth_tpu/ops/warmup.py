@@ -1,0 +1,641 @@
+"""Device warm-up manager: supervised AOT compile lifecycle, persistent
+compilation cache, and degraded-mode serving.
+
+Every device bench round before this module reported ``value: 0`` — warm-up
+XLA compiles wedged the axon tunnel and the node had no bounded, recoverable
+path through kernel compilation (BENCH_r01–r05, ROADMAP item 1). Compilation
+is now a managed lifecycle instead of an ambush on the first live dispatch:
+
+- **Shape menu** (:func:`default_menu`): the bucketed
+  ``(program, block_tier, batch_tier)`` grid already implicit in
+  ``keccak_jax.py`` / ``fused_commit.py``, declared explicitly. At node
+  start the manager AOT-compiles each menu shape ONE AT A TIME, each
+  compile under a per-shape watchdog budget with retry + exponential
+  backoff (``RETH_TPU_WARMUP_BUDGET`` / ``_ATTEMPTS`` / ``_BACKOFF``), and
+  sequenced behind the supervisor's health probe — a wedged compile trips
+  the circuit breaker (``ops/supervisor.py``) instead of freezing startup.
+  ``RETH_TPU_FAULT_COMPILE_WEDGE`` drills the wedge path without hardware.
+- **Persistent compilation cache** (:class:`CompileCache`): JAX's
+  ``jax_compilation_cache_dir`` keyed under the datadir and VERSIONED by a
+  digest of the kernel sources (stale caches from older kernels land in a
+  different directory). Corrupt entries quarantine the directory and
+  rebuild rather than crashing. Because this jax build has deadlocked the
+  first jit with the cache enabled over the axon tunnel (measured round 2),
+  the cache is only enabled in-process after a SUBPROCESS probe
+  (:func:`supervisor.probe_device` with ``cache_dir=``) proves the cache
+  loads — a wedged cache wedges the probe child, never the node.
+- **Degraded-mode serving**: while warm-up is in progress the hash service
+  and the committers run on the CPU twin; individual shapes are promoted
+  to the device as each finishes compiling (per-shape
+  cold/compiling/warm/failed states, consulted by
+  ``KeccakDevice.route_bucket`` per dispatch and by ``SupervisedBackend``
+  per fused commit). An un-warmed shape encountered mid-commit routes that
+  bucket to the CPU — never a blocking fresh compile inside a commit.
+- **Observability**: ``warmup_*`` metrics (``metrics.WarmupMetrics``), a
+  ``warmup[...]`` events-dashboard fragment, per-shape ``ops::warmup``
+  trace events, and the bench's ``warmup_state`` field.
+
+Wiring: ``--warmup off|background|block`` + ``--compile-cache-dir`` on the
+CLI (``[node] warmup`` in reth.toml); :func:`build_warmup` is the shared
+constructor the CLI and ``node/node.py`` use.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+from .. import tracing
+
+COLD = "cold"
+COMPILING = "compiling"
+WARM = "warm"
+FAILED = "failed"
+
+# Declared ceilings shared with the dispatch front-ends: KeccakDevice chunks
+# batches above the batch ceiling and routes messages above the block
+# ceiling to the CPU twin, so no request can mint an off-menu program.
+DEFAULT_MIN_TIER = 1024
+DEFAULT_BLOCK_TIER = 4
+DEFAULT_MAX_BATCH_TIER = 16384
+DEFAULT_MAX_BLOCK_TIER = 32
+
+
+@dataclass(frozen=True)
+class MenuShape:
+    """One declared device program shape.
+
+    ``program``: "keccak.masked" | "keccak.exact" | "fused.plain" |
+    "fused.splice" — the same kind strings the dispatch sites report to the
+    compile tracker, so menu states and dispatch attribution line up.
+    """
+
+    program: str
+    block_tier: int
+    batch_tier: int
+
+    def key(self) -> tuple:
+        return (self.program, self.block_tier, self.batch_tier)
+
+    def __str__(self) -> str:  # events/log form
+        return f"{self.program}:{self.block_tier}x{self.batch_tier}"
+
+
+def default_menu(min_tier: int = DEFAULT_MIN_TIER,
+                 block_tier: int = DEFAULT_BLOCK_TIER,
+                 max_batch_tier: int = DEFAULT_MAX_BATCH_TIER,
+                 max_block_tier: int = DEFAULT_MAX_BLOCK_TIER,
+                 include_fused: bool = True) -> list[MenuShape]:
+    """The grid the runtime actually dispatches (see ``TrieCommitter``:
+    ``KeccakDevice(min_tier=1024, block_tier=4)``): one masked program per
+    pow2 batch tier for trie-node-sized messages (<= ``block_tier`` rate
+    blocks), plus the pow2 block-tier ladder at the base batch tier for
+    large messages (contract code), clamped at the declared ceilings —
+    everything beyond the menu is served by the CPU twin, never a fresh
+    mid-commit compile. ``include_fused`` adds the fused level-commit
+    programs at the base tier (the live-tip sparse/turbo commit shapes)."""
+    shapes: list[MenuShape] = []
+    t = min_tier
+    while t <= max_batch_tier:
+        shapes.append(MenuShape("keccak.masked", block_tier, t))
+        t *= 2
+    bt = 2 * block_tier
+    while bt <= max_block_tier:
+        shapes.append(MenuShape("keccak.masked", bt, min_tier))
+        bt *= 2
+    if include_fused:
+        shapes.append(MenuShape("fused.plain", block_tier, min_tier))
+        shapes.append(MenuShape("fused.splice", block_tier, min_tier))
+    return shapes
+
+
+def _build_shape(shape: MenuShape) -> None:
+    """Compile ``shape``'s program by dispatching a dummy batch of exactly
+    that shape through the SAME jitted callables the runtime uses — the
+    in-process jit cache (and, when enabled, the persistent cache) is keyed
+    by function + shapes, so the runtime's first real dispatch of the shape
+    is steady-state. The result sync (`np.asarray`) makes the wall honest."""
+    import numpy as np
+
+    if shape.program in ("keccak.masked", "keccak.exact"):
+        import jax.numpy as jnp
+
+        from .keccak_jax import keccak256_jax_words, keccak256_jax_words_masked
+
+        words = np.zeros((shape.batch_tier, shape.block_tier * 34),
+                         dtype=np.uint32)
+        if shape.program == "keccak.exact":
+            np.asarray(keccak256_jax_words(jnp.asarray(words),
+                                           shape.block_tier))
+        else:
+            counts = np.ones((shape.batch_tier,), dtype=np.int32)
+            np.asarray(keccak256_jax_words_masked(
+                jnp.asarray(words), shape.block_tier,
+                counts=jnp.asarray(counts)))
+        return
+    if shape.program in ("fused.plain", "fused.splice"):
+        import jax.numpy as jnp
+
+        from ..primitives.keccak import RATE
+        from .fused_commit import _jitted
+
+        n, b = shape.batch_tier, shape.block_tier
+        templates = jnp.zeros((n, b * RATE), dtype=jnp.uint8)
+        counts = jnp.ones((n,), dtype=jnp.int32)
+        slots = jnp.zeros((n,), dtype=jnp.int32)
+        buf = jnp.zeros((n, 32), dtype=jnp.uint8)
+        if shape.program == "fused.plain":
+            fn = _jitted("plain", b)
+            np.asarray(fn(templates, counts, slots, buf))
+        else:
+            # hole tier mirrors FusedLevelEngine: _HOLE_FACTOR * min batch
+            h = 4 * n
+            zeros_h = jnp.zeros((h,), dtype=jnp.int32)
+            fn = _jitted("splice", b)
+            np.asarray(fn(templates, counts, zeros_h, zeros_h, zeros_h,
+                          slots, buf))
+        return
+    raise ValueError(f"unknown menu program {shape.program!r}")
+
+
+def kernel_source_digest(paths: list[str | Path] | None = None) -> str:
+    """Digest versioning the persistent cache directory: the kernel sources
+    whose lowering feeds the cache, plus the jax version — a kernel edit or
+    a jax upgrade lands in a fresh cache dir instead of loading stale
+    executables."""
+    if paths is None:
+        here = Path(__file__).parent
+        paths = [here / "keccak_jax.py", here / "fused_commit.py",
+                 here / "keccak_pallas.py"]
+    h = hashlib.sha256()
+    for p in paths:
+        try:
+            h.update(Path(p).read_bytes())
+        except OSError:
+            h.update(str(p).encode())
+    try:
+        import jax
+
+        h.update(jax.__version__.encode())
+    except Exception:  # noqa: BLE001 — digest still deterministic sans jax
+        pass
+    return h.hexdigest()[:16]
+
+
+class CompileCache:
+    """Persistent on-disk XLA compilation cache under the datadir.
+
+    The directory is ``<base>/xla-<source digest>`` so restarts and bench
+    reruns against the same kernel sources pay compile cost once, while a
+    kernel change never loads a stale executable. ``validate()`` detects
+    corrupt entries (zero-length / unreadable files) and QUARANTINES the
+    whole directory (renamed aside, fresh dir created) rather than letting
+    a half-written entry crash or wedge the first jit. ``probe()`` verifies
+    in a SUBPROCESS that jax can actually run with this cache dir — the
+    deadlock this build has shown with the cache enabled stays in the
+    child. Only then does ``enable()`` point the in-process jax config at
+    the directory."""
+
+    def __init__(self, base_dir: str | Path, sources=None, *,
+                 probe_budget: float | None = None):
+        self.base = Path(base_dir)
+        self.digest = kernel_source_digest(sources)
+        self.dir = self.base / f"xla-{self.digest}"
+        self.probe_budget = probe_budget
+        self.enabled = False
+        self.quarantined = 0
+        self.last_report: dict | None = None
+
+    def entry_count(self) -> int:
+        try:
+            return sum(1 for p in self.dir.rglob("*") if p.is_file())
+        except OSError:
+            return 0
+
+    def validate(self) -> dict:
+        """Scan for corrupt entries; quarantine + rebuild on any. Returns
+        ``{"entries", "corrupt", "quarantined"}`` (post-quarantine entry
+        count is 0 — the next run repopulates the fresh directory)."""
+        corrupt: list[str] = []
+        entries = 0
+        if self.dir.is_dir():
+            for p in sorted(self.dir.rglob("*")):
+                if not p.is_file():
+                    continue
+                entries += 1
+                try:
+                    if p.stat().st_size == 0:
+                        corrupt.append(p.name)
+                        continue
+                    with open(p, "rb") as f:
+                        f.read(16)
+                except OSError:
+                    corrupt.append(p.name)
+        if corrupt:
+            k = self.quarantined
+            while True:
+                dest = self.dir.with_name(f"{self.dir.name}.quarantine-{k}")
+                if not dest.exists():
+                    break
+                k += 1
+            try:
+                self.dir.rename(dest)
+            except OSError:  # cross-device or racing writer: drop in place
+                import shutil
+
+                shutil.rmtree(self.dir, ignore_errors=True)
+                dest = None
+            self.quarantined += 1
+            entries = 0
+            tracing.event("ops::warmup", "cache_quarantine",
+                          dir=str(self.dir), corrupt=len(corrupt),
+                          moved_to=str(dest) if dest else "removed")
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.last_report = {"entries": entries, "corrupt": len(corrupt),
+                            "quarantined": bool(corrupt)}
+        return self.last_report
+
+    def probe(self, injector=None) -> bool:
+        """Subprocess check that a jit dispatch completes WITH this cache
+        dir configured (the opt-in cache-validation probe mode)."""
+        from .supervisor import probe_device
+
+        return probe_device(self.probe_budget, cache_dir=str(self.dir),
+                            injector=injector).ok
+
+    def enable(self) -> bool:
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", str(self.dir))
+            # persist every program: the tunnel's compile cost is exactly
+            # what restarts must not pay twice, size thresholds be damned
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+            self.enabled = True
+        except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+            self.enabled = False
+        return self.enabled
+
+    def disable(self) -> None:
+        if not self.enabled:
+            return
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:  # noqa: BLE001
+            pass
+        self.enabled = False
+
+    def summary(self) -> dict:
+        rep = self.last_report or {}
+        state = "off"
+        if self.enabled:
+            state = "warm" if rep.get("entries", 0) else "cold"
+        return {"mode": state, "dir": str(self.dir),
+                "entries": rep.get("entries", 0),
+                "quarantined": self.quarantined}
+
+
+class WarmupManager:
+    """Owns the compile lifecycle for the device keccak/fused kernels.
+
+    ``run()`` (or ``start()`` for a background thread) walks the menu one
+    shape at a time: each compile runs in a worker thread under ``budget``
+    seconds; a timeout abandons the wedged thread, counts a breaker failure
+    on the attached supervisor, and retries with exponential backoff.
+    Shapes settle in WARM or FAILED; the routing queries
+    (:meth:`route_bucket`, :meth:`device_ready`) implement degraded-mode
+    serving until everything is warm. ``on_device_recovered()`` (called by
+    the supervisor's half-open probe success) re-queues FAILED shapes, so
+    shapes promote once a fault clears."""
+
+    def __init__(self, menu: list[MenuShape] | None = None, *,
+                 supervisor=None, cache: CompileCache | None = None,
+                 budget: float | None = None, attempts: int | None = None,
+                 backoff: float | None = None, builder=None, injector=None,
+                 verify_cache: bool = True, enable_cache: bool = True,
+                 registry=None):
+        from ..metrics import WarmupMetrics
+
+        self.menu = list(menu if menu is not None else default_menu())
+        self.sup = supervisor
+        self.cache = cache
+        if budget is None:
+            budget = float(os.environ.get("RETH_TPU_WARMUP_BUDGET", "240"))
+        self.budget = budget
+        if attempts is None:
+            attempts = int(os.environ.get("RETH_TPU_WARMUP_ATTEMPTS", "3"))
+        self.attempts = max(1, attempts)
+        if backoff is None:
+            backoff = float(os.environ.get("RETH_TPU_WARMUP_BACKOFF", "2"))
+        self.backoff = backoff
+        self.verify_cache = verify_cache
+        # enable_cache=False: validate/quarantine only, never touch the
+        # process-global jax config (unit-test scope)
+        self.enable_cache = enable_cache
+        self._builder = builder or _build_shape
+        if injector is None and supervisor is not None:
+            injector = supervisor.injector
+        if injector is None:
+            from .supervisor import FaultInjector
+
+            injector = FaultInjector.from_env()
+        self.injector = injector
+        self.metrics = WarmupMetrics(registry)
+        self._lock = threading.Lock()
+        self.states: dict[tuple, str] = {s.key(): COLD for s in self.menu}
+        self.compile_walls: dict[tuple, float] = {}
+        self.retries = 0
+        self.wedges = 0
+        self.cpu_routed = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self._current: MenuShape | None = None
+        self._active = False      # gating applies from start() onward
+        self._retrying = False
+        self._done = threading.Event()
+        self._thread: threading.Thread | None = None
+        if supervisor is not None:
+            supervisor.warmup = self
+        self._publish()
+
+    # -- routing queries (hot path) -----------------------------------------
+
+    def device_ready(self) -> bool:
+        """May a whole fused commit claim the device? True before warm-up
+        ever starts (no gating), and once every menu shape is WARM. While
+        warming — or degraded with FAILED shapes — commits stay on the CPU
+        twin (a fused commit's digest buffer can't switch backends at a
+        shape boundary)."""
+        if not self._active:
+            return True
+        return self._done.is_set() and all(
+            s == WARM for s in self.states.values())
+
+    def route_bucket(self, program: str, block_tier: int,
+                     batch_tier: int) -> bool:
+        """Per-dispatch routing: True = device, False = CPU twin. A WARM
+        shape always gets the device; during warm-up (or degraded) an
+        un-warm or off-menu shape routes to the CPU — never a blocking
+        fresh compile inside a commit."""
+        if not self._active:
+            return True
+        if self.states.get((program, block_tier, batch_tier)) == WARM:
+            return True
+        if self.device_ready():
+            return True  # fully warm: off-menu stragglers ride the watchdog
+        with self._lock:
+            self.cpu_routed += 1
+        self.metrics.record_cpu_routed()
+        return False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Run warm-up on a background thread (the node serves degraded on
+        the CPU twin meanwhile; shapes promote as they finish)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(target=self.run, daemon=True,
+                                            name="device-warmup")
+        self._thread.start()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    def run(self) -> dict:
+        """Blocking warm-up pass: cache validation/enable, then the menu
+        one shape at a time. Returns the final snapshot."""
+        self._active = True
+        self._done.clear()
+        t0 = time.monotonic()
+        self._publish()
+        self._setup_cache()
+        for shape in self.menu:
+            if self.states.get(shape.key()) != WARM:
+                self._compile_shape(shape)
+        self._done.set()
+        self._publish()
+        snap = self.snapshot()
+        tracing.event("ops::warmup", "warmup_done", state=snap["state"],
+                      warm=snap["warm"], failed=snap["failed"],
+                      total=snap["total"],
+                      wall_s=round(time.monotonic() - t0, 3),
+                      compile_wall_s=snap["compile_wall_s"],
+                      cache=snap["cache"]["mode"])
+        return snap
+
+    def retry_failed(self) -> int:
+        """Re-run FAILED shapes (promotion path after a fault clears);
+        returns how many became WARM. Reentrancy-guarded: the supervisor's
+        half-open probe success fires mid-retry too."""
+        with self._lock:
+            if self._retrying:
+                return 0
+            self._retrying = True
+        try:
+            failed = [s for s in self.menu
+                      if self.states.get(s.key()) == FAILED]
+            if not failed:
+                return 0
+            self._done.clear()
+            self._publish()
+            promoted = 0
+            for shape in failed:
+                if self._compile_shape(shape):
+                    promoted += 1
+            self._done.set()
+            self._publish()
+            return promoted
+        finally:
+            with self._lock:
+                self._retrying = False
+
+    def on_device_recovered(self) -> None:
+        """Supervisor hook: a half-open probe just closed the breaker —
+        promote FAILED shapes in the background."""
+        if not self._active or self.device_ready():
+            return
+        if not any(s == FAILED for s in self.states.values()):
+            return
+        threading.Thread(target=self.retry_failed, daemon=True,
+                         name="device-warmup-retry").start()
+
+    # -- internals -----------------------------------------------------------
+
+    def _setup_cache(self) -> None:
+        if self.cache is None:
+            return
+        report = self.cache.validate()
+        self.metrics.set_cache_entries(report["entries"])
+        if report["quarantined"]:
+            self.metrics.record_quarantine()
+        if not self.enable_cache:
+            return
+        if self.verify_cache and not self.cache.probe(injector=self.injector):
+            # a cache dir this jax build can't even probe through must not
+            # be wired into the live process — warm-up proceeds uncached
+            tracing.event("ops::warmup", "cache_disabled",
+                          dir=str(self.cache.dir),
+                          reason="subprocess cache probe failed")
+            return
+        self.cache.enable()
+        tracing.event("ops::warmup", "cache_enabled",
+                      dir=str(self.cache.dir), entries=report["entries"],
+                      state="warm" if report["entries"] else "cold")
+
+    def _set_state(self, shape: MenuShape, state: str) -> None:
+        with self._lock:
+            self.states[shape.key()] = state
+            self._current = shape if state == COMPILING else None
+        self._publish()
+
+    def _compile_shape(self, shape: MenuShape) -> bool:
+        for attempt in range(1, self.attempts + 1):
+            if self.sup is not None and not self.sup.allows_device():
+                # breaker open: serving stays on the CPU twin; the shape
+                # parks FAILED until the supervisor's half-open probe
+                # succeeds and on_device_recovered() re-queues it
+                self._set_state(shape, FAILED)
+                tracing.event("ops::warmup", "shape_deferred",
+                              shape=str(shape), reason="breaker open")
+                return False
+            self._set_state(shape, COMPILING)
+            before = (self.cache.entry_count()
+                      if self.cache is not None and self.cache.enabled
+                      else None)
+            t0 = time.perf_counter()
+            ok, err = self._guarded_build(shape)
+            wall = time.perf_counter() - t0
+            if ok:
+                hit = None
+                if before is not None:
+                    hit = self.cache.entry_count() == before
+                    with self._lock:
+                        if hit:
+                            self.cache_hits += 1
+                        else:
+                            self.cache_misses += 1
+                with self._lock:
+                    self.compile_walls[shape.key()] = round(wall, 6)
+                self._set_state(shape, WARM)
+                self.metrics.record_compile(wall, cache_hit=hit)
+                if self.sup is not None:
+                    self.sup.breaker.record_success()
+                tracing.event("ops::warmup", "shape_warm", shape=str(shape),
+                              wall_s=round(wall, 4), attempt=attempt,
+                              cache_hit=hit)
+                return True
+            with self._lock:
+                self.wedges += 1
+            self.metrics.record_wedge()
+            if self.sup is not None:
+                # a wedged compile is a device failure like any other: it
+                # feeds the breaker so repeated wedges trip it and the node
+                # keeps serving degraded instead of freezing startup
+                if self.sup.breaker.record_failure():
+                    self.sup.metrics.record_trip()
+                self.sup._publish()
+            tracing.event("ops::warmup", "shape_wedged", shape=str(shape),
+                          attempt=attempt, budget_s=self.budget,
+                          error=str(err)[:200])
+            if attempt < self.attempts:
+                with self._lock:
+                    self.retries += 1
+                self.metrics.record_retry()
+                time.sleep(self.backoff * (2 ** (attempt - 1)))
+        self._set_state(shape, FAILED)
+        return False
+
+    def _guarded_build(self, shape: MenuShape) -> tuple[bool, object]:
+        """One compile attempt in a worker thread under the watchdog budget
+        (a wedged XLA compile cannot be cancelled — the thread is abandoned
+        and the shape retried/failed, exactly like a supervised dispatch)."""
+        box: list = [False, None]
+        injector = self.injector
+
+        def _call():
+            try:
+                if injector is not None:
+                    injector.on_compile(self.budget)
+                self._builder(shape)
+                box[0] = True
+            except BaseException as e:  # noqa: BLE001 — reported below
+                box[1] = e
+
+        t = threading.Thread(target=_call, daemon=True,
+                             name=f"warmup-{shape.program}")
+        t.start()
+        t.join(self.budget)
+        if t.is_alive():
+            tracing.fault_event("warmup_compile_timeout",
+                                target="ops::warmup", shape=str(shape),
+                                budget_s=self.budget)
+            return False, f"compile exceeded {self.budget}s watchdog budget"
+        if not box[0]:
+            return False, box[1]
+        return True, None
+
+    # -- observability -------------------------------------------------------
+
+    def _counts(self) -> tuple[int, int, int]:
+        vals = list(self.states.values())
+        return (sum(1 for s in vals if s == WARM),
+                sum(1 for s in vals if s == FAILED), len(vals))
+
+    def overall_state(self) -> str:
+        if not self._active:
+            return "off"
+        warm, failed, total = self._counts()
+        if not self._done.is_set():
+            return "warming"
+        if warm == total:
+            return "warm"
+        return "degraded"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            states = dict(self.states)
+            walls = dict(self.compile_walls)
+            current = self._current
+        warm = sum(1 for s in states.values() if s == WARM)
+        failed = sum(1 for s in states.values() if s == FAILED)
+        return {
+            "state": self.overall_state(),
+            "warm": warm,
+            "failed": failed,
+            "total": len(states),
+            "compiling": str(current) if current is not None else None,
+            "compile_wall_s": round(sum(walls.values()), 4),
+            "retries": self.retries,
+            "wedges": self.wedges,
+            "cpu_routed": self.cpu_routed,
+            "cache": (self.cache.summary() if self.cache is not None
+                      else {"mode": "off", "entries": 0, "quarantined": 0}),
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "shapes": {f"{k[0]}:{k[1]}x{k[2]}": v for k, v in states.items()},
+        }
+
+    def _publish(self) -> None:
+        warm, failed, total = self._counts()
+        self.metrics.set_progress(total=total, warm=warm, failed=failed)
+        self.metrics.set_state(self.overall_state())
+
+
+def build_warmup(supervisor=None, cache_dir: str | Path | None = None,
+                 menu: list[MenuShape] | None = None, registry=None,
+                 **kw) -> WarmupManager:
+    """Shared constructor for the CLI and ``node/node.py``: a manager over
+    the default menu, with the persistent cache keyed under ``cache_dir``
+    when one is given."""
+    cache = CompileCache(cache_dir) if cache_dir else None
+    return WarmupManager(menu=menu, supervisor=supervisor, cache=cache,
+                         registry=registry, **kw)

@@ -108,7 +108,7 @@ class TrieCommitter:
     """
 
     def __init__(self, hasher=None, fused: bool = False, min_tier: int = 1024,
-                 mesh=None, supervisor=None):
+                 mesh=None, supervisor=None, warmup=None):
         """``fused=True`` switches the hash phase to the fused multi-level
         device commit (``ops.fused_commit``): child digests stay resident in
         HBM between levels, eliminating the per-level D2H round trip; one
@@ -117,9 +117,13 @@ class TrieCommitter:
         devices. ``hasher`` is ignored when fused. ``supervisor`` (an
         ``ops/supervisor.py`` DeviceSupervisor) puts every device call
         behind the watchdog + circuit breaker with CPU failover — the
-        ``--hasher auto`` wiring."""
+        ``--hasher auto`` wiring. ``warmup`` (an ``ops/warmup.py``
+        WarmupManager) adds degraded-mode serving: un-warm shapes hash on
+        the CPU twin until their AOT compile finishes — the ``--warmup``
+        wiring."""
         self.fused = fused
         self.supervisor = supervisor
+        self.warmup = warmup
         self._engine = None
         if fused:
             from ..ops.fused_commit import FusedLevelEngine, FusedMeshEngine
@@ -138,7 +142,8 @@ class TrieCommitter:
             if supervisor is not None:
                 from ..ops.supervisor import SupervisedHasher
 
-                hasher = SupervisedHasher(supervisor, min_tier=min_tier)
+                hasher = SupervisedHasher(supervisor, min_tier=min_tier,
+                                          warmup=warmup)
             else:
                 from ..ops import KeccakDevice
 
@@ -147,13 +152,30 @@ class TrieCommitter:
                 # minimal, and min_tier=1024 collapses the small near-root
                 # levels into one shape (padding waste is far cheaper than
                 # a compile).
-                hasher = KeccakDevice(min_tier=min_tier, block_tier=4).hash_batch
+                hasher = KeccakDevice(min_tier=min_tier, block_tier=4,
+                                      warmup=warmup).hash_batch
         self.hasher = hasher
         # --hash-service wiring (cli.py): an ops/hash_service.py HashService
         # multiplexing every keccak client over one supervised backend.
         # When set, ``hasher`` is a lane-bound HashClient and ``for_lane``
         # hands call sites their own priority lane.
         self.hash_service = None
+
+    def attach_warmup(self, manager) -> None:
+        """Late-bind a warm-up manager (``ops/warmup.py``) to an already-
+        built committer: per-bucket device/CPU routing for the
+        KeccakDevice-backed hashers, plus commit-level gating on the
+        supervised fused path (the supervisor learns the manager when the
+        manager is constructed with ``supervisor=``)."""
+        self.warmup = manager
+        h = self.hasher
+        if hasattr(h, "_warmup"):       # SupervisedHasher
+            h._warmup = manager
+            h._device = None            # rebuild the gated device lazily
+        else:
+            owner = getattr(h, "__self__", None)  # KeccakDevice.hash_batch
+            if owner is not None and hasattr(owner, "warmup"):
+                owner.warmup = manager
 
     def for_lane(self, lane: str) -> "TrieCommitter":
         """Shallow clone whose ``hasher`` is bound to the hash service's
