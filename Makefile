@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -69,6 +69,20 @@ test-gateway:
 test-obs:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_observability.py -q -p no:cacheprovider
+
+# node health & SLO engine (part of the default `make test` flow —
+# tests/ is swept wholesale): metric ring-buffer retention + windowed
+# quantiles, the burn-rate evaluator (degraded within one window,
+# failing on sustained burn, hysteretic recovery), breach flight dumps +
+# the RETH_TPU_FAULT_SLO_BREACH drill, /health + debug_healthCheck /
+# debug_sloStatus / debug_metricsHistory end-to-end on a dev node with
+# a hash-service stall, the bench perf-regression sentinel (wedged
+# tunnel simulated -> rc=0 with a real CPU number + vs_prev), and the
+# sampler/evaluator overhead guard (<1% of the sparse-commit wall) —
+# CPU-only, no device required
+test-health:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_health.py -q -p no:cacheprovider
 
 # device warm-up manager: shape-menu AOT compile lifecycle (watchdog +
 # backoff retry under the RETH_TPU_FAULT_COMPILE_WEDGE drill, degraded

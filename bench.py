@@ -7,8 +7,14 @@ tunnel is wedged (five rounds of rc=2/value=0 taught us that lesson).
 rebuild benchmark described below; ``service``/``sparse``/``gateway``
 select the other subsystem benches.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "backend"}.
-``backend`` records which plane actually produced the number. A
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"backend", "vs_prev", "regression"}. ``backend`` records which plane
+actually produced the number; ``vs_prev`` compares against the trailing
+last-N-good-runs baseline for the same metric+mode+backend+warmup key
+(health.BenchBaselineStore, persisted at RETH_TPU_BENCH_BASELINE_STORE
+or <repo>/.bench_baselines.json) and ``regression`` flips true when the
+run drops under RETH_TPU_BENCH_REGRESSION_THRESHOLD (default 0.8x) of
+it — RETH_TPU_BENCH_STRICT=1 turns that into rc=3. A
 wedged/absent tunnel no longer yields rc=2 with value 0 — the rebuild
 mode records the OVERLAPPED rebuild pipeline's CPU rate
 (trie/turbo.RebuildPipeline: pooled native sweeps + cross-subtrie level
@@ -56,6 +62,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 
@@ -94,6 +101,48 @@ def _compile_split() -> dict:
         return {"compile_wall_s": 0.0, "compiled_shapes": 0}
 
 
+def _assess_vs_prev(line, error) -> None:
+    """Perf-regression sentinel (health.BenchBaselineStore): every line
+    gains ``vs_prev`` (value / median of the trailing last-N GOOD runs
+    for the same metric+mode+backend+warmup-state key) and a loud
+    ``regression`` flag — so a real throughput drop can't hide behind a
+    wedged tunnel's ``vs_baseline: 0``. Good runs append to the store;
+    error/zero lines only read it. Never fatal to the bench."""
+    try:
+        from reth_tpu.health import BenchBaselineStore
+
+        mode = os.environ.get("RETH_TPU_BENCH_MODE", "exec")
+        threshold = float(
+            os.environ.get("RETH_TPU_BENCH_REGRESSION_THRESHOLD", "0.8"))
+        store = BenchBaselineStore()
+        value = line["value"]
+        good = not error and isinstance(value, (int, float)) and value > 0
+        if good:
+            verdict = store.assess(line["metric"], mode, line["backend"],
+                                   line["warmup_state"], float(value),
+                                   threshold=threshold)
+            store.record(line["metric"], mode, line["backend"],
+                         line["warmup_state"], float(value),
+                         vs_baseline=line.get("vs_baseline"))
+        else:
+            verdict = {"vs_prev": None, "regression": False,
+                       "baseline_n": 0, "baseline": None}
+        line["vs_prev"] = verdict["vs_prev"]
+        line["regression"] = verdict["regression"]
+        line["baseline_n"] = verdict["baseline_n"]
+        if verdict["baseline"] is not None:
+            line["baseline_prev"] = verdict["baseline"]
+        if verdict["regression"]:
+            print(f"PERF REGRESSION: {line['metric']} = {value} "
+                  f"{line['unit']} is {verdict['vs_prev']}x the trailing "
+                  f"baseline ({verdict['baseline']} over "
+                  f"{verdict['baseline_n']} runs)", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — the sentinel never fails a bench
+        line.setdefault("vs_prev", None)
+        line.setdefault("regression", False)
+        line["baseline_error"] = f"{type(e).__name__}: {e}"
+
+
 def _emit(value, vs_baseline, error=None, exit_code=None, **extra):
     line = {
         "metric": _STATE.get("metric", "merkle_rebuild_keccak_per_sec"),
@@ -118,8 +167,16 @@ def _emit(value, vs_baseline, error=None, exit_code=None, **extra):
     elif extra.get("device_unavailable"):
         line["flight_recorder"] = _flight_excerpt()
     line.update(extra)
+    _assess_vs_prev(line, error)
     print(json.dumps(line), flush=True)
     if exit_code is not None:
+        if (line.get("regression")
+                and os.environ.get("RETH_TPU_BENCH_STRICT")
+                and exit_code == 0):
+            # strict mode: a regression vs the trailing baseline is a
+            # FAILURE, not a footnote (opt-in: the driver's rc contract
+            # treats nonzero as harness breakage, so default stays 0)
+            os._exit(3)
         os._exit(exit_code)
 
 

@@ -132,6 +132,11 @@ def run_bench(accounts: int, slots: int, tier: int, watchdog: int) -> dict | Non
     env.setdefault("RETH_TPU_COMPILE_CACHE_DIR",
                    os.path.join(REPO, ".compile-cache"))
     env.setdefault("RETH_TPU_WARMUP", "block")
+    # trailing-baseline store shared across captures/sessions: every
+    # bench line carries vs_prev/regression vs the last-N good runs of
+    # the same metric+mode+backend+warmup key (perf-regression sentinel)
+    env.setdefault("RETH_TPU_BENCH_BASELINE_STORE",
+                   os.path.join(REPO, ".bench_baselines.json"))
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -163,6 +168,11 @@ def update_artifact(captures: list[dict]) -> None:
         "value": best["result"]["value"] if best else 0,
         "unit": "hashes/s",
         "vs_baseline": best["result"].get("vs_baseline", 0) if best else 0,
+        # perf-regression sentinel fields: how this capture compares to
+        # the trailing last-N good runs of the same bench key
+        "vs_prev": best["result"].get("vs_prev") if best else None,
+        "regression": (best["result"].get("regression", False)
+                       if best else False),
         "accounts": best["accounts"] if best else 0,
         "warmup_state": (best["result"].get("warmup_state", "unknown")
                          if best else "unknown"),
@@ -195,6 +205,14 @@ def main() -> None:
             result = run_bench(accounts, slots, tier, watchdog)
             log_event({"event": "bench_done", "accounts": accounts,
                        "result": result})
+            if result and result.get("regression"):
+                # a regressed capture is still a capture, but the log
+                # must say so LOUDLY — the sentinel exists because five
+                # rounds of silent zeros erased the trajectory
+                log_event({"event": "bench_regression",
+                           "accounts": accounts,
+                           "value": result.get("value"),
+                           "vs_prev": result.get("vs_prev")})
             # a watchdog-truncated run (value>0 but "error" set, baseline
             # unmeasured) is not a clean capture — retry, don't escalate
             if result and result.get("value", 0) > 0 and "error" not in result:

@@ -369,6 +369,9 @@ def cmd_node(args):
                      rpc_gateway=getattr(args, "rpc_gateway", False),
                      warmup=warm_mode,
                      compile_cache_dir=warm_cache,
+                     health=getattr(args, "health", False),
+                     slo_interval=getattr(args, "slo_interval", 1.0),
+                     slo_window=getattr(args, "slo_window", 300),
                      # --trace-blocks; unset falls back to RETH_TPU_TRACE
                      trace_blocks=(args.trace_blocks
                                    if getattr(args, "trace_blocks", None)
@@ -754,6 +757,9 @@ def cmd_config(args):
         f"sparse_workers = {cfg.sparse_workers}",
         f"parallel_exec = {'true' if cfg.parallel_exec else 'false'}",
         f"trace_blocks = {'true' if cfg.trace_blocks else 'false'}",
+        f"health = {'true' if cfg.health else 'false'}",
+        f"slo_interval = {cfg.slo_interval}",
+        f"slo_window = {cfg.slo_window}",
         "",
         "[rpc]",
         f"gateway = {'true' if cfg.rpc.gateway else 'false'}",
@@ -1122,6 +1128,31 @@ def main(argv=None) -> int:
                    help="Chrome-trace output path override for "
                         "--trace-blocks (default <datadir>/traces/"
                         "blocks.trace.json)")
+    p.add_argument("--health", dest="health", action="store_true",
+                   default=False,
+                   help="node health & SLO engine (health.py): sample "
+                        "every metric into bounded ring buffers and "
+                        "evaluate the burn-rate SLO rule table (block "
+                        "import wall, hash-service per-lane p99 wait, "
+                        "gateway shed/cache rates, sparse finish wall, "
+                        "exec conflict/fallback rate, warm-up failures, "
+                        "breaker state); breaches flip the component to "
+                        "degraded/failing, dump the flight recorder, "
+                        "and surface at GET /health and the "
+                        "debug_healthCheck / debug_sloStatus / "
+                        "debug_metricsHistory RPCs. Also [node] health "
+                        "in reth.toml; RETH_TPU_FAULT_SLO_BREACH drills "
+                        "a forced breach")
+    p.add_argument("--slo-interval", dest="slo_interval", type=float,
+                   default=1.0,
+                   help="seconds between health sampler/evaluator "
+                        "passes (default 1.0; also RETH_TPU_SLO_INTERVAL "
+                        "/ [node] slo_interval)")
+    p.add_argument("--slo-window", dest="slo_window", type=int,
+                   default=300,
+                   help="retained ring-buffer samples per metric series "
+                        "(default 300 = 5 min at 1 Hz; also "
+                        "RETH_TPU_SLO_WINDOW / [node] slo_window)")
     p.set_defaults(fn=cmd_node)
 
     p = sub.add_parser("dump-genesis", help="print the dev genesis JSON")

@@ -137,6 +137,16 @@ class RethTpuConfig:
     # per-block span timelines, export Chrome-trace JSON under the
     # datadir, and point flight-recorder dumps there (tracing.py)
     trace_blocks: bool = False
+    # node health & SLO engine (--health CLI equivalent, health.py):
+    # metric time-series retention + burn-rate SLO evaluation over the
+    # default rule table, served at /health and the debug health RPCs
+    health: bool = False
+    # seconds between health sampler/evaluator passes (<= 0 disables the
+    # background thread; also RETH_TPU_SLO_INTERVAL)
+    slo_interval: float = 1.0
+    # ring-buffer samples retained per metric series (5 min at the
+    # default 1 Hz; also RETH_TPU_SLO_WINDOW)
+    slo_window: int = 300
 
 
 def _prune_mode(d: dict) -> PruneMode:
@@ -172,6 +182,9 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.sparse_workers = int(node.get("sparse_workers", cfg.sparse_workers))
     cfg.parallel_exec = bool(node.get("parallel_exec", cfg.parallel_exec))
     cfg.trace_blocks = bool(node.get("trace_blocks", cfg.trace_blocks))
+    cfg.health = bool(node.get("health", cfg.health))
+    cfg.slo_interval = float(node.get("slo_interval", cfg.slo_interval))
+    cfg.slo_window = int(node.get("slo_window", cfg.slo_window))
     rpc = raw.get("rpc", {})
     cfg.rpc.gateway = bool(rpc.get("gateway", cfg.rpc.gateway))
     cfg.rpc.gateway_cache = int(rpc.get("gateway_cache", cfg.rpc.gateway_cache))

@@ -40,6 +40,10 @@ class Gauge:
     name: str
     help: str = ""
     value: float = 0.0
+    # optional constant labels, rendered as name{k="v",...} — the
+    # Prometheus *_info convention (build_info et al: value pinned to 1,
+    # the identity lives in the labels)
+    labels: dict | None = None
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
 
@@ -75,6 +79,66 @@ class Histogram:
                     return
             self.counts[-1] += 1
 
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """Consistent (counts, total, n) copy — what render() and the
+        health sampler read under the per-metric lock."""
+        with self._lock:
+            return list(self.counts), self.total, self.n
+
+    def quantile(self, q: float) -> float | None:
+        """Estimate the q-quantile from the cumulative buckets (lifetime
+        counts; windowed estimates come from the health sampler's bucket
+        deltas)."""
+        counts, _, n = self.snapshot()
+        if not n:
+            return None
+        return histogram_quantile(self.buckets, counts, q)
+
+
+def histogram_quantile(buckets: tuple[float, ...], counts, q: float) -> float | None:
+    """Prometheus-style quantile estimate from fixed-bucket counts.
+
+    ``buckets`` are the upper bounds; ``counts`` are PER-BUCKET (not
+    cumulative) observation counts with the +Inf overflow bucket last,
+    so ``len(counts) == len(buckets) + 1``. Linear interpolation inside
+    the target bucket (lower bound = previous edge, 0 for the first);
+    a rank landing in the overflow bucket clamps to the highest finite
+    edge (the Prometheus convention — the bucket has no upper bound to
+    interpolate toward). Returns None when there are no observations.
+
+    Shared by the SLO evaluator (windowed p99s from sampler bucket
+    deltas), ``Histogram.quantile`` and bench/debug tooling — ad-hoc
+    percentile math grows subtle rank-vs-index bugs, so there is ONE
+    implementation.
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile {q} outside [0, 1]")
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    seen = 0.0
+    for i, b in enumerate(buckets):
+        prev_seen = seen
+        seen += counts[i]
+        if seen >= rank:
+            lo = buckets[i - 1] if i else 0.0
+            if counts[i] == 0:  # exact bucket-boundary rank
+                return lo
+            frac = (rank - prev_seen) / counts[i]
+            return lo + (b - lo) * frac
+    return buckets[-1]  # overflow bucket: clamp to the last finite edge
+
+
+def sample_percentile(sorted_samples, pct: int):
+    """Nearest-rank percentile over an already-sorted sample list (the
+    gas-oracle shape: small lists, integer percentile). One shared
+    implementation for every sorted-sample percentile in the repo."""
+    if not sorted_samples:
+        return None
+    idx = min(len(sorted_samples) - 1, len(sorted_samples) * pct // 100)
+    return sorted_samples[idx]
+
 
 class MetricsRegistry:
     def __init__(self):
@@ -95,14 +159,23 @@ class MetricsRegistry:
     def counter(self, name: str, help: str = "") -> Counter:
         return self._register(name, Counter, lambda: Counter(name, help))
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._register(name, Gauge, lambda: Gauge(name, help))
+    def gauge(self, name: str, help: str = "", labels: dict | None = None) -> Gauge:
+        g = self._register(name, Gauge, lambda: Gauge(name, help, labels=labels))
+        if labels is not None:
+            g.labels = labels
+        return g
 
     def histogram(self, name: str, help: str = "", **kw) -> Histogram:
         h = self._register(name, Histogram, lambda: Histogram(name, help, **kw))
         if kw.get("buckets") and h.buckets != kw["buckets"]:
             raise ValueError(f"metric {name!r} registered with different buckets")
         return h
+
+    def items(self) -> list[tuple[str, object]]:
+        """Stable (name, metric) snapshot — the health sampler's walk.
+        The metric objects are live; read histograms via snapshot()."""
+        with self._lock:
+            return sorted(self._metrics.items())
 
     def render(self) -> str:
         """Prometheus text exposition format."""
@@ -114,7 +187,12 @@ class MetricsRegistry:
                     lines.append(f"{name} {m.value}")
                 elif isinstance(m, Gauge):
                     lines.append(f"# TYPE {name} gauge")
-                    lines.append(f"{name} {m.value}")
+                    if m.labels:
+                        lbl = ",".join(f'{k}="{v}"'
+                                       for k, v in sorted(m.labels.items()))
+                        lines.append(f"{name}{{{lbl}}} {m.value}")
+                    else:
+                        lines.append(f"{name} {m.value}")
                 elif isinstance(m, Histogram):
                     lines.append(f"# TYPE {name} histogram")
                     with m._lock:  # consistent bucket/count/sum snapshot
@@ -133,6 +211,42 @@ class MetricsRegistry:
 REGISTRY = MetricsRegistry()
 
 _PROC_START = None
+_BUILD_INFO: dict | None = None
+
+
+def build_info() -> dict:
+    """Node-identity labels for the fleet: package version, git revision
+    (when the repo is available), jax version, and the configured device
+    backend. Computed once — subprocess + metadata probes must not tax
+    every /metrics scrape or health sample."""
+    global _BUILD_INFO
+    if _BUILD_INFO is not None:
+        return _BUILD_INFO
+    import os
+
+    from . import __version__
+
+    info = {"version": __version__}
+    try:
+        import subprocess
+
+        r = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5)
+        if r.returncode == 0 and r.stdout.strip():
+            info["git"] = r.stdout.strip()
+    except Exception:  # noqa: BLE001 — identity is best-effort
+        pass
+    try:
+        from importlib.metadata import version as _pkg_version
+
+        info["jax"] = _pkg_version("jax")
+    except Exception:  # noqa: BLE001
+        pass
+    info["backend"] = os.environ.get("JAX_PLATFORMS", "") or "device"
+    _BUILD_INFO = info
+    return info
 
 
 def update_process_metrics(registry: MetricsRegistry | None = None) -> None:
@@ -147,6 +261,11 @@ def update_process_metrics(registry: MetricsRegistry | None = None) -> None:
     if _PROC_START is None:
         _PROC_START = _t.time()
     reg.gauge("process_uptime_seconds").set(round(_t.time() - _PROC_START, 1))
+    # fleet identity: which build/toolchain/backend is this node? (the
+    # Prometheus *_info convention — value 1, identity in the labels)
+    reg.gauge("reth_tpu_build_info",
+              "node build identity: version/git/jax/backend",
+              labels=build_info()).set(1)
     try:
         with open("/proc/self/statm") as f:
             pages = int(f.read().split()[1])

@@ -189,6 +189,24 @@ class NodeEventReporter:
                      f" par={eb.get('parallel', 0)}"
                      f" ser={eb.get('serial', 0)}"
                      f" nat={eb.get('native', 0)}]")
+        # --health: the SLO engine's verdict — node status, any non-ok
+        # component, and the breach counter an operator pages on. The
+        # one line that says "the node itself thinks it is sick" instead
+        # of leaving the judgment to whoever reads the fragments above.
+        from .. import health as health_mod
+
+        eng = (getattr(self.node, "health", None)
+               or health_mod.get_engine())
+        if eng is not None:
+            comps = eng.components()
+            bad = [f"{c}:{s}" for c, s in sorted(comps.items())
+                   if s != "ok"]
+            line += f" slo[{eng.status()}"
+            if bad:
+                line += " " + ",".join(bad)
+            if eng.breaches_total:
+                line += f" breaches={eng.breaches_total}"
+            line += "]"
         # --trace-blocks: the per-block wall budget — where the last
         # block's time actually went, split by phase and by hash-service
         # queue-wait vs device dispatch (tracing.py block summaries)

@@ -89,6 +89,16 @@ class NodeConfig:
     # quarantine-on-corruption). None = <datadir>/compile-cache when
     # warm-up is on.
     compile_cache_dir: str | Path | None = None
+    # --health / [node] health: the node health & SLO engine (health.py)
+    # — metric time-series retention, burn-rate SLO evaluation over the
+    # default rule table, /health + debug_healthCheck/debug_sloStatus/
+    # debug_metricsHistory surfaces, and flight dumps on breach
+    health: bool = False
+    # [node] slo_interval: seconds between sampler/evaluator passes
+    # (<= 0 disables the thread — tests drive HealthEngine.tick())
+    slo_interval: float = 1.0
+    # [node] slo_window: ring-buffer samples retained per metric series
+    slo_window: int = 300
 
 
 class Node:
@@ -419,6 +429,19 @@ class Node:
                                           tip.hash)
 
             self.tree.canon_listeners.append(_track_head)
+        # node health & SLO engine (--health): samples every metric into
+        # bounded ring buffers and evaluates the burn-rate rule table;
+        # installed as the process default so /health (served by every
+        # RpcServer) and the debug health RPCs reach it (health.py)
+        self.health = None
+        if config.health:
+            from .. import health as health_mod
+
+            self.health = health_mod.HealthEngine(
+                interval=config.slo_interval, window=config.slo_window)
+            health_mod.install(self.health)
+            self.health.start()
+
         # human progress dashboard (reference crates/node/events)
         from .events import NodeEventReporter
 
@@ -506,6 +529,11 @@ class Node:
 
     def stop(self):
         self.tx_batcher.close()
+        if self.health is not None:
+            from .. import health as health_mod
+
+            self.health.stop()
+            health_mod.uninstall(self.health)
         self.event_reporter.stop()
         self.tasks.graceful_shutdown()
         self.rpc.stop()

@@ -78,6 +78,17 @@ from .. import tracing
 LANES = ("live", "payload", "rebuild", "proof")
 _LANE_INDEX = {name: i for i, name in enumerate(LANES)}
 
+# per-lane p99 queue-wait SLO budgets (seconds) — the live lane sits on
+# the block-import critical path, the background lanes tolerate queueing
+# by design. Kept here, next to the lane definitions, so a new lane must
+# declare its budget; consumed by health.py's default SLO rule table.
+DEFAULT_WAIT_BUDGETS = {"live": 0.25, "payload": 0.5,
+                        "rebuild": 2.0, "proof": 1.0}
+# p99 budget for one coalesced dispatch's wall (service time): a healthy
+# dispatch is sub-ms..tens of ms; sustained 150ms+ means a stalling
+# backend (wedge drill, compile storm, saturated tunnel)
+DEFAULT_DISPATCH_BUDGET_S = 0.15
+
 
 class HashServiceError(RuntimeError):
     """Base class for service-level failures."""

@@ -443,6 +443,43 @@ class DebugApi:
             "spans": timeline,
         }
 
+    # -- node health & SLOs (health.py) -------------------------------------
+
+    @staticmethod
+    def _health_engine():
+        from .. import health
+        from .server import RpcError
+
+        eng = health.get_engine()
+        if eng is None:
+            raise RpcError(-32000, "health engine disabled "
+                                   "(--health / [node] health)")
+        return eng
+
+    def debug_healthCheck(self):
+        """Node health roll-up (the /health body): component states,
+        breaching rules, recent breaches. Requires --health."""
+        return self._health_engine().health()
+
+    def debug_sloStatus(self):
+        """Every SLO rule's state, current value vs budget, fast/slow
+        burn, EWMA baseline, breach history, and the triggering value
+        series. Requires --health."""
+        return self._health_engine().slo_status()
+
+    def debug_metricsHistory(self, name=None, samples=None):
+        """Retained metric time-series (health.py sampler ring buffers):
+        no args lists the series; ``name`` returns its points (counters
+        delta-encoded, histograms with per-interval p50/p99), optionally
+        only the last ``samples``. Requires --health."""
+        from .server import RpcError
+
+        try:
+            return self._health_engine().metrics_history(
+                name, int(samples) if samples is not None else None)
+        except KeyError as e:
+            raise RpcError(-32000, str(e)) from None
+
     def debug_flightRecorder(self, action="snapshot", limit=256):
         """The in-memory flight recorder: ``action="snapshot"`` returns
         the most recent ``limit`` records; ``action="dump"`` snapshots

@@ -57,9 +57,10 @@ class GasPriceOracle:
         if not samples:
             tip = cfg.default_tip
         else:
+            from ..metrics import sample_percentile
+
             samples.sort()
-            tip = samples[min(len(samples) - 1,
-                              len(samples) * cfg.percentile // 100)]
+            tip = sample_percentile(samples, cfg.percentile)
         tip = min(tip, cfg.max_price)
         self._cache = (head.hash, tip)
         return tip

@@ -87,6 +87,15 @@ _TX_METHODS = frozenset({
     "eth_sendRawTransactionSync",
 })
 
+# monitoring probes (health.py surfaces): cheap snapshot reads a fleet
+# gateway polls to route around sick replicas — admitted as reads, never
+# queued behind a debug_traceBlock re-execution in the 2-slot debug class
+# (a health check that times out BECAUSE the node is busy reports the
+# node dead exactly when it matters that it is not)
+_MONITORING_METHODS = frozenset({
+    "debug_healthCheck", "debug_sloStatus", "debug_metricsHistory",
+})
+
 
 def classify(method: str) -> str:
     """Map a JSON-RPC method name onto its admission class."""
@@ -94,6 +103,8 @@ def classify(method: str) -> str:
         return "engine"
     if method in _TX_METHODS:
         return "tx"
+    if method in _MONITORING_METHODS:
+        return "read"
     if method.startswith(("debug_", "trace_", "ots_", "flashbots_")):
         return "debug"
     return "read"

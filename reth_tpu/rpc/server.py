@@ -167,6 +167,21 @@ class RpcServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/health":
+                    # machine-readable node health beside /metrics: the
+                    # SLO roll-up when --health is on (503 only when
+                    # failing), liveness + build identity otherwise —
+                    # what a fleet gateway probes to route around sick
+                    # replicas (health.py)
+                    from .. import health
+
+                    code, payload = health.health_response()
+                    body = json.dumps(payload).encode()
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self.send_response(404)
                     self.end_headers()

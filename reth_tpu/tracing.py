@@ -328,6 +328,9 @@ def _summarize(trace_id: str, records: list[dict]) -> dict | None:
     summary = {
         "trace": trace_id,
         "number": root["fields"].get("number"),
+        # closing wall-clock time: the health engine's block-wall SLO rule
+        # windows summaries by when the block finished
+        "ts": root["ts"] + total_ms / 1e3,
         "total_ms": total_ms,
         "prewarm_ms": round(dur_of("prewarm"), 3),
         "exec_ms": round(dur_of("execute"), 3),
@@ -374,6 +377,15 @@ def block_summary(trace_id: str) -> dict | None:
 def last_block_summary() -> dict | None:
     """The most recently closed block's wall budget (events dashboard)."""
     return _last_summary
+
+
+def recent_block_summaries(n: int | None = None) -> list[dict]:
+    """Closed-block wall budgets, oldest first (bounded by the timeline
+    ring) — the health engine's block-import SLO rule averages these over
+    its evaluation window."""
+    with _TL_LOCK:
+        out = list(_SUMMARIES.values())
+    return out[-n:] if n else out
 
 
 def format_wall_budget(s: dict) -> str:

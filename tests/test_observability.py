@@ -143,7 +143,10 @@ def test_global_metrics_exposition_valid():
                    if k.startswith(f'{name}_bucket{{le="') and "+Inf" not in k]
             assert les == sorted(les)  # cumulative
         else:
-            assert name in samples
+            # labeled gauges (the *_info convention, e.g. build_info)
+            # render as name{k="v"} value under a bare TYPE line
+            assert name in samples or any(
+                k.startswith(name + "{") for k in samples)
 
 
 # -- trace context ------------------------------------------------------------
@@ -575,10 +578,14 @@ def test_bench_device_unavailable_exits_zero_with_flight_excerpt(tmp_path):
 
     env = {**os.environ,
            "JAX_PLATFORMS": "cpu",
+           # the probe-timeout path lives in the rebuild mode (the
+           # default has been the tunnel-free exec bench since PR 7)
+           "RETH_TPU_BENCH_MODE": "rebuild",
            "RETH_TPU_FAULT_PROBE_FAIL": "-1",  # every probe fails
            "RETH_TPU_PROBE_ATTEMPTS": "1", "RETH_TPU_PROBE_GAP": "0",
            "RETH_TPU_BENCH_ACCOUNTS": "1500", "RETH_TPU_BENCH_SLOTS": "400",
            "RETH_TPU_BENCH_TIMEOUT": "300",
+           "RETH_TPU_BENCH_BASELINE_STORE": str(tmp_path / "baselines.json"),
            "RETH_TPU_FLIGHT_DIR": str(tmp_path)}
     env.pop("PALLAS_AXON_POOL_IPS", None)
     root = Path(__file__).resolve().parents[1]

@@ -589,7 +589,11 @@ def test_bench_emits_warmup_state_and_cache_fields(tmp_path):
                RETH_TPU_BENCH_GW_REQS="4",
                RETH_TPU_BENCH_GW_KEYS="2",
                RETH_TPU_BENCH_GW_WORK="4",
-               RETH_TPU_BENCH_TIMEOUT="300")
+               RETH_TPU_BENCH_TIMEOUT="300",
+               # keep the repo's trailing perf-baseline store out of
+               # test runs (tiny workloads would poison real vs_prev)
+               RETH_TPU_BENCH_BASELINE_STORE=str(
+                   tmp_path / "baselines.json"))
     env.pop("RETH_TPU_WARMUP", None)
     env.pop("RETH_TPU_COMPILE_CACHE_DIR", None)
     repo = Path(__file__).resolve().parent.parent
