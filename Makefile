@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -83,6 +83,21 @@ test-obs:
 test-health:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_health.py -q -p no:cacheprovider
+
+# mesh-sharded hash service: partition-rule routed sharded dispatch,
+# randomized mesh-vs-single-device differential parity (incl. non-pow2
+# meshes / uneven tiers), sub-mesh rebuild leases with live traffic
+# continuing, the per-device breaker shrink+replay ladder under
+# RETH_TPU_FAULT_DEVICE_WEDGE, mesh warm-up menu variants, and the
+# RETH_TPU_BENCH_MODE=mesh end-to-end drill — CPU-only (8 virtual
+# host devices via conftest)
+test-mesh:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_mesh_service.py tests/test_parallel.py \
+	  -q -p no:cacheprovider
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_fused_commit.py tests/test_turbo_commit.py \
+	  -q -p no:cacheprovider -m 'not slow'
 
 # device warm-up manager: shape-menu AOT compile lifecycle (watchdog +
 # backoff retry under the RETH_TPU_FAULT_COMPILE_WEDGE drill, degraded

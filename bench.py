@@ -5,7 +5,11 @@ the perf trajectory records a real measurement even while the device
 tunnel is wedged (five rounds of rc=2/value=0 taught us that lesson).
 ``RETH_TPU_BENCH_MODE=rebuild`` selects the original device state-root
 rebuild benchmark described below; ``service``/``sparse``/``gateway``
-select the other subsystem benches.
+select the other subsystem benches; ``mesh`` shards the production
+turbo/fused rebuild loop over 1/2/4/8 simulated host devices (one
+subprocess per mesh size, roots verified bit-identical vs the
+single-device committer before any number prints, per-mesh-size
+throughput + compile wall in ``per_mesh``).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "backend", "vs_prev", "regression"}. ``backend`` records which plane
@@ -704,6 +708,134 @@ def run_exec_mode() -> None:
           receipts_identical=True, exit_code=0)
 
 
+def _mesh_inner(n: int) -> None:
+    """Inner body of ``RETH_TPU_BENCH_MODE=mesh``: runs in a subprocess
+    whose XLA host-device count is forced to ``n``, commits the SAME
+    synthetic update stream through the single-device committer and the
+    mesh-sharded one (FusedMeshEngine over a ``parallel/mesh.py``
+    HashMesh — the production turbo level loop, not a demo reduction),
+    asserts the roots bit-identical, and prints ONE raw JSON line with
+    the mesh throughput + compile/steady wall split."""
+    from reth_tpu.metrics import compile_tracker
+    from reth_tpu.parallel.mesh import HashMesh
+    from reth_tpu.trie.turbo import TurboCommitter
+
+    accounts = int(os.environ.get("RETH_TPU_BENCH_MESH_ACCOUNTS", "20000"))
+    slots = int(os.environ.get("RETH_TPU_BENCH_MESH_SLOTS",
+                               str(max(accounts * 2 // 5, 100))))
+    tier = int(os.environ.get("RETH_TPU_BENCH_MESH_TIER", "4096"))
+    _STATE["phase"] = f"mesh inner ({n} devices): state build"
+    storage_jobs, account_jobs = build_state(accounts, slots)
+
+    single = TurboCommitter(backend="device", min_tier=tier)
+    _STATE["phase"] = f"mesh inner ({n} devices): single-device warm pass"
+    run_rebuild(single, storage_jobs, account_jobs, pipelined=True)
+    _STATE["phase"] = f"mesh inner ({n} devices): single-device run"
+    roots_single, _h, dt_single = run_rebuild(
+        single, storage_jobs, account_jobs, pipelined=True)
+
+    hash_mesh = HashMesh.build(n)
+    meshc = TurboCommitter(backend="device", min_tier=tier, mesh=hash_mesh)
+    compile_before = _compile_split()["compile_wall_s"]
+    _STATE["phase"] = f"mesh inner ({n} devices): mesh warm pass (compiles)"
+    run_rebuild(meshc, storage_jobs, account_jobs, pipelined=True)
+    compile_wall = round(
+        _compile_split()["compile_wall_s"] - compile_before, 4)
+    _STATE["phase"] = f"mesh inner ({n} devices): mesh measured pass"
+    roots_mesh, hashed, dt_mesh = run_rebuild(
+        meshc, storage_jobs, account_jobs, pipelined=True)
+
+    ok = roots_mesh == roots_single
+    print(json.dumps({
+        "n_devices": hash_mesh.n_devices,
+        "roots_identical": ok,
+        "hashes_per_sec": round(hashed / dt_mesh, 1),
+        "steady_wall_s": round(dt_mesh, 4),
+        "compile_wall_s": compile_wall,
+        "single_hashes_per_sec": round(hashed / dt_single, 1),
+        "hashed": hashed,
+        "mesh_degraded": hash_mesh.snapshot()["unhealthy"],
+        "compiled_shapes": compile_tracker.totals()["shapes"],
+    }), flush=True)
+    os._exit(0 if ok else 4)
+
+
+def run_mesh_mode() -> None:
+    """RETH_TPU_BENCH_MODE=mesh: the production turbo/fused rebuild loop
+    SPMD-sharded over 1/2/4/8 SIMULATED host devices — each mesh size in
+    its own subprocess (the XLA host-device count is fixed at backend
+    init), with ``JAX_PLATFORMS=cpu`` forced and the axon plugin scrubbed
+    so the mode is hermetic (it measures sharding overhead/scaling shape,
+    never the tunnel). Roots are verified bit-identical to the
+    single-device committer on the same update stream BEFORE any number
+    prints; the headline is the largest mesh's steady-state hashes/s with
+    per-mesh-size throughput + compile wall in ``per_mesh``. Env:
+    RETH_TPU_BENCH_MESH_DEVICES (default "1,2,4,8"),
+    RETH_TPU_BENCH_MESH_ACCOUNTS / _SLOTS / _TIER (workload)."""
+    import subprocess
+
+    sizes = sorted({int(x) for x in os.environ.get(
+        "RETH_TPU_BENCH_MESH_DEVICES", "1,2,4,8").split(",") if x.strip()})
+    _STATE["metric"] = "mesh_rebuild_hashes_per_sec"
+    # simulated host devices: honest labeling — this mode never touches
+    # the device tunnel, it measures the sharded data plane's scaling
+    _STATE["backend"] = "jax-cpu-mesh"
+    per: dict[str, dict] = {}
+    degraded = 0
+    budget = max(90, (_DEADLINE - 60) // max(len(sizes), 1))
+    for n in sizes:
+        _STATE["phase"] = f"mesh subprocess ({n} devices)"
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("PALLAS_AXON_POOL_IPS", "RETH_TPU_WARMUP")}
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                         if "host_platform_device_count" not in f)
+        env["XLA_FLAGS"] = \
+            (flags + f" --xla_force_host_platform_device_count={n}").strip()
+        env["RETH_TPU_BENCH_MESH_INNER"] = str(n)
+        env["RETH_TPU_BENCH_TIMEOUT"] = str(budget)
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, capture_output=True, text=True,
+                               timeout=budget + 60)
+        except subprocess.TimeoutExpired:
+            _emit(0, 0, error=f"mesh inner ({n} devices) exceeded "
+                              f"{budget + 60}s", exit_code=0)
+        line = None
+        for out_line in reversed(r.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(out_line)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict):
+                line = parsed
+                break
+        if not line or "n_devices" not in line or line.get("error"):
+            diag = ((line or {}).get("error")
+                    or (r.stderr or r.stdout or "no output")[-300:])
+            _emit(0, 0, error=f"mesh inner ({n} devices) failed "
+                              f"rc={r.returncode}: {diag}", exit_code=0)
+        if not line.get("roots_identical"):
+            # acceptance contract: a root divergence is a correctness
+            # failure — no throughput number may print over it
+            _emit(0, 0, error=f"mesh inner ({n} devices): roots diverged "
+                              f"from the single-device committer",
+                  exit_code=1)
+        degraded = max(degraded, int(line.get("mesh_degraded", 0)))
+        per[str(line["n_devices"])] = {
+            k: line[k] for k in ("hashes_per_sec", "compile_wall_s",
+                                 "steady_wall_s", "single_hashes_per_sec",
+                                 "hashed", "compiled_shapes")
+            if k in line}
+    top = per[str(max(sizes))]
+    base = per.get("1", {}).get("hashes_per_sec")
+    _STATE["device_result"] = top["hashes_per_sec"]
+    _emit(top["hashes_per_sec"],
+          round(top["hashes_per_sec"] / base, 3) if base else 0,
+          n_devices=max(sizes), per_mesh=per, mesh_degraded=degraded,
+          roots_identical=True, exit_code=0)
+
+
 def _setup_compile_cache() -> None:
     """RETH_TPU_COMPILE_CACHE_DIR: validate (quarantining corruption) and
     enable the persistent XLA compilation cache, but ONLY after a
@@ -768,9 +900,18 @@ def main():
     from reth_tpu import tracing
 
     tracing.set_trace_enabled(True)
+    inner = os.environ.get("RETH_TPU_BENCH_MESH_INNER")
+    if inner:
+        # mesh-mode subprocess: measure + verify, skip warm-up/cache setup
+        # (the inner run attributes its own compile wall explicitly)
+        _mesh_inner(int(inner))
+        return
     _setup_compile_cache()
     _maybe_warmup()
     mode = os.environ.get("RETH_TPU_BENCH_MODE", "exec")
+    if mode == "mesh":
+        run_mesh_mode()
+        return
     if mode == "service":
         run_service_mode()
         return

@@ -152,11 +152,51 @@ def run_bench(accounts: int, slots: int, tier: int, watchdog: int) -> dict | Non
             continue
         if isinstance(parsed, dict):
             # a zero must never land in the log without its warm-up
-            # attribution (five rounds of bare wedged-tunnel zeros)
+            # attribution (five rounds of bare wedged-tunnel zeros);
+            # every line also carries its mesh topology — single-device
+            # captures are honestly n_devices=1, mesh captures report
+            # their size + how many devices were shed by breakers
             parsed.setdefault("warmup_state", "unknown")
+            parsed.setdefault("n_devices", 1)
+            parsed.setdefault("mesh_degraded", 0)
             return parsed
-    return {"value": 0, "warmup_state": "unknown",
+    return {"value": 0, "warmup_state": "unknown", "n_devices": 1,
+            "mesh_degraded": 0,
             "error": f"no JSON line, rc={r.returncode}: "
+                     f"{(r.stderr or '')[-300:]}"}
+
+
+def run_mesh_bench(watchdog: int = 900) -> dict | None:
+    """RETH_TPU_BENCH_MODE=mesh capture: the production rebuild loop over
+    1/2/4/8 SIMULATED host devices. Hermetic — the mode forces
+    JAX_PLATFORMS=cpu in its per-size subprocesses and never touches the
+    tunnel — so it runs once at daemon start regardless of probe health
+    and every session records the sharded data plane's scaling curve."""
+    env = dict(os.environ,
+               RETH_TPU_BENCH_MODE="mesh",
+               RETH_TPU_BENCH_TIMEOUT=str(watchdog))
+    env.setdefault("RETH_TPU_BENCH_BASELINE_STORE",
+                   os.path.join(REPO, ".bench_baselines.json"))
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=watchdog + 120,
+            env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"value": 0, "n_devices": 0, "mesh_degraded": 0,
+                "error": f"mesh bench exceeded {watchdog + 120}s"}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict):
+            parsed.setdefault("n_devices", 0)
+            parsed.setdefault("mesh_degraded", 0)
+            return parsed
+    return {"value": 0, "n_devices": 0, "mesh_degraded": 0,
+            "error": f"mesh bench: no JSON line, rc={r.returncode}: "
                      f"{(r.stderr or '')[-300:]}"}
 
 
@@ -191,6 +231,14 @@ def update_artifact(captures: list[dict]) -> None:
 def main() -> None:
     log_event({"event": "daemon_start", "pid": os.getpid(),
                "probe_gap_s": PROBE_GAP_S, "sizes": SIZES})
+    # mesh scaling curve first: hermetic (simulated host devices), so it
+    # lands a number whether or not the tunnel ever probes healthy
+    log_event({"event": "mesh_bench_start"})
+    mesh_result = run_mesh_bench()
+    log_event({"event": "mesh_bench_done", "result": mesh_result})
+    git_commit([LOG], "bench: mesh-mode scaling capture "
+                      f"({mesh_result.get('n_devices', 0)} devices, "
+                      f"{mesh_result.get('value', 0)} hashes/s)")
     captures: list[dict] = []
     stage = 0
     probes = 0

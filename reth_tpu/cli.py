@@ -44,11 +44,34 @@ def _resolve_warmup(args) -> tuple[str, str | None]:
     return mode, cache_dir
 
 
+def _resolve_mesh(args) -> int:
+    """Device-mesh width: --mesh beats RETH_TPU_MESH beats [node]
+    mesh_devices (reth.toml); 0/1 = the mesh layer stays off."""
+    import os
+
+    n = getattr(args, "mesh", None)
+    if n is None:
+        n = os.environ.get("RETH_TPU_MESH") or 0
+    return int(n or 0)
+
+
 def _make_committer(args):
     from .trie.committer import TrieCommitter
 
     mode = getattr(args, "hasher", "device")
     warm_mode, cache_dir = _resolve_warmup(args)
+    mesh_n = _resolve_mesh(args) if mode != "cpu" else 0
+    hash_mesh = None
+    if mesh_n > 1:
+        # --mesh: the real device-mesh descriptor (parallel/mesh.py) —
+        # health mask + sub-mesh leases + the partition-rule table. Turbo
+        # committers shard fused level windows over it; with
+        # --hash-service the service routes every coalesced dispatch
+        # through it (per-device breakers, partial-mesh degradation).
+        from .parallel.mesh import HashMesh
+
+        hash_mesh = HashMesh.build(mesh_n)
+        mesh_n = hash_mesh.n_devices  # clamped to the available topology
     warmup = None
     if mode != "cpu" and warm_mode != "off":
         # device warm-up manager (ops/warmup.py): the shape menu AOT-
@@ -70,7 +93,8 @@ def _make_committer(args):
         sup = DeviceSupervisor.shared()
         healthy = sup.startup()
         if warm_mode != "off":
-            warmup = build_warmup(supervisor=sup, cache_dir=cache_dir)
+            warmup = build_warmup(supervisor=sup, cache_dir=cache_dir,
+                                  mesh_size=max(1, mesh_n))
         committer = TrieCommitter(supervisor=sup, warmup=warmup)
         committer.turbo_backend = "auto"
         if not healthy:
@@ -79,7 +103,8 @@ def _make_committer(args):
                   f"re-probe succeeds", file=sys.stderr)
     else:
         if warm_mode != "off":
-            warmup = build_warmup(cache_dir=cache_dir)
+            warmup = build_warmup(cache_dir=cache_dir,
+                                  mesh_size=max(1, mesh_n))
         committer = TrieCommitter(warmup=warmup)
         committer.turbo_backend = "device"
     if warmup is not None:
@@ -90,16 +115,24 @@ def _make_committer(args):
             warmup.run()
         else:
             warmup.start()
+    if hash_mesh is not None:
+        # mesh without a service still shards the turbo committers'
+        # fused level loops (stages/merkle, incremental full rebuild)
+        committer.hash_mesh = hash_mesh
     if getattr(args, "hash_service", False):
         # --hash-service: ONE background service owns the (supervised)
         # hashing backend and multiplexes every client over priority lanes
         # (ops/hash_service.py). The committer's own hasher becomes the
         # live-tip lane client; call sites pick other lanes via for_lane.
+        # With --mesh the service owns the MESH: coalesced dispatches
+        # route through the partition-rule table, rebuild commits take
+        # sub-mesh leases, per-device breakers degrade partially.
         from .ops.hash_service import HashService
 
         committer.hash_service = HashService(
             backend=committer.hasher,
-            supervisor=getattr(committer, "supervisor", None))
+            supervisor=getattr(committer, "supervisor", None),
+            mesh=hash_mesh, warmup=warmup)
         committer.hasher = committer.hash_service.client("live")
     return committer
 
@@ -752,6 +785,7 @@ def cmd_config(args):
         f"persistence_threshold = {cfg.persistence_threshold}",
         f'hasher = "{cfg.hasher}"',
         f"hash_service = {'true' if cfg.hash_service else 'false'}",
+        f"mesh_devices = {cfg.mesh_devices}",
         f'warmup = "{cfg.warmup}"',
         f'compile_cache_dir = "{cfg.compile_cache_dir}"',
         f"sparse_workers = {cfg.sparse_workers}",
@@ -970,6 +1004,21 @@ def main(argv=None) -> int:
                             "composes with --hasher auto (breaker trips / "
                             "CPU failover apply to the shared service) — "
                             "see RETH_TPU_FAULT_SERVICE_* drill knobs")
+        p.add_argument("--mesh", type=int, default=None,
+                       help="shard the hashing data plane over a device "
+                            "MESH of this many devices (parallel/mesh.py): "
+                            "fused per-depth level windows batch-shard "
+                            "across the mesh (digest arena replicated, XLA "
+                            "inserts the all-gather) while scalar requests "
+                            "stay on one device (partition-rule table); "
+                            "with --hash-service the rebuild takes a "
+                            "SUB-MESH lease (k of n devices, live lanes "
+                            "keep the rest; RETH_TPU_MESH_REBUILD_DEVICES) "
+                            "and per-device circuit breakers shrink the "
+                            "mesh around a wedged device before any CPU "
+                            "failover (RETH_TPU_FAULT_DEVICE_WEDGE drills "
+                            "it). Default: RETH_TPU_MESH or off; also "
+                            "[node] mesh_devices in reth.toml")
         p.add_argument("--warmup", choices=["off", "background", "block"],
                        default=None,
                        help="device warm-up manager (ops/warmup.py): AOT-"

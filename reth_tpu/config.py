@@ -112,6 +112,12 @@ class RethTpuConfig:
     # multiplex every keccak client over the shared background hash
     # service (ops/hash_service.py): priority lanes + continuous batching
     hash_service: bool = False
+    # device mesh width (--mesh CLI / RETH_TPU_MESH env equivalent): the
+    # hash service + turbo committers then shard coalesced dispatches and
+    # fused level windows over this many devices (parallel/mesh.py),
+    # with sub-mesh rebuild leases and per-device circuit breakers.
+    # 0/1 = single-device (the mesh layer stays off)
+    mesh_devices: int = 0
     # device warm-up manager (--warmup CLI equivalent, ops/warmup.py):
     # "off" | "background" (serve degraded on the CPU twin while the shape
     # menu AOT-compiles, promoting shapes as they warm) | "block" (finish
@@ -176,6 +182,7 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.persistence_threshold = node.get("persistence_threshold", cfg.persistence_threshold)
     cfg.hasher = node.get("hasher", cfg.hasher)
     cfg.hash_service = bool(node.get("hash_service", cfg.hash_service))
+    cfg.mesh_devices = int(node.get("mesh_devices", cfg.mesh_devices))
     cfg.warmup = str(node.get("warmup", cfg.warmup))
     cfg.compile_cache_dir = str(node.get("compile_cache_dir",
                                          cfg.compile_cache_dir))

@@ -359,6 +359,13 @@ def default_rules() -> list[SloRule]:
                 metric="warmup_shapes_failed", failing_factor=1e9,
                 help="menu shapes that exhausted compile retries "
                      "(serving degraded on the CPU twin)"),
+        # one shed device degrades within a window (budget 0.5 → burn 2);
+        # a full-mesh outage pages through hasher_breaker/CPU-rung rules,
+        # so this one never self-escalates to failing
+        SloRule("mesh_degraded_devices", "mesh", "gauge", 0.5,
+                metric="mesh_devices_unhealthy", failing_factor=1e9,
+                help="devices shed from the hashing mesh by per-device "
+                     "breakers (serving on a shrunken mesh)"),
         # breaker open (2) degrades within one window; sustained open
         # escalates to failing once the slow window burns too
         SloRule("hasher_breaker", "hasher_supervisor", "gauge", 1.5,

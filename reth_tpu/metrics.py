@@ -749,6 +749,71 @@ class WarmupMetrics:
         self._cache_entries.set(n)
 
 
+class MeshMetrics:
+    """Device-mesh observability (parallel/mesh.py + the mesh-sharded
+    hash service): mesh topology (total/healthy/leased devices), the
+    per-device breaker degradation counters (shrinks, shrunken-mesh
+    replays, recoveries), sub-mesh rebuild leases, and the partition-rule
+    routing split (sharded vs unpartitioned dispatches) — what an
+    operator needs to see that the mesh is serving degraded, and whether
+    coalesced batches actually scatter across devices."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._total = reg.gauge(
+            "mesh_devices_total", "devices in the hashing mesh roster")
+        self._healthy = reg.gauge(
+            "mesh_devices_healthy", "mesh devices passing their breaker")
+        self._unhealthy = reg.gauge(
+            "mesh_devices_unhealthy",
+            "mesh devices shed by per-device breakers (SLO input)")
+        self._leased = reg.gauge(
+            "mesh_devices_leased",
+            "devices currently claimed by a sub-mesh lease (rebuild)")
+        self._shrinks = reg.counter(
+            "mesh_shrinks_total",
+            "times a breaker trip removed a device from the live mesh")
+        self._recoveries = reg.counter(
+            "mesh_recoveries_total",
+            "devices re-admitted after their breaker cooldown")
+        self._submesh_leases = reg.counter(
+            "mesh_submesh_leases_total",
+            "sub-mesh leases granted (rebuild claims k of n devices)")
+        self._sharded = reg.counter(
+            "mesh_sharded_dispatches_total",
+            "coalesced dispatches batch-sharded across the mesh")
+        self._single = reg.counter(
+            "mesh_single_dispatches_total",
+            "scalar/sub-threshold dispatches kept on one device")
+        self._replays = reg.counter(
+            "mesh_replays_total",
+            "in-flight batches replayed on a shrunken mesh after a trip")
+
+    def set_topology(self, *, total: int, healthy: int, leased: int) -> None:
+        self._total.set(total)
+        self._healthy.set(healthy)
+        self._unhealthy.set(total - healthy)
+        self._leased.set(leased)
+
+    def record_shrink(self) -> None:
+        self._shrinks.increment()
+
+    def record_recovery(self) -> None:
+        self._recoveries.increment()
+
+    def record_submesh_lease(self) -> None:
+        self._submesh_leases.increment()
+
+    def record_sharded(self) -> None:
+        self._sharded.increment()
+
+    def record_single(self) -> None:
+        self._single.increment()
+
+    def record_replay(self) -> None:
+        self._replays.increment()
+
+
 class GatewayMetrics:
     """RPC serving gateway observability (rpc/gateway.py): per-class
     request counts, queue depth, running handlers, shed counts, and

@@ -122,6 +122,25 @@ class NodeEventReporter:
                          f" svc_bypass={s['lease_bypasses']}")
             if s["leased_by"]:
                 line += f" svc_leased={s['leased_by']}"
+        # --mesh: the device mesh's one-line health — live/total devices,
+        # whether a rebuild currently holds a sub-mesh lease, and the
+        # degradation counters (devices shed by per-device breakers,
+        # shrunken-mesh replays) an operator pages on
+        hm = getattr(self.node, "hash_mesh", None)
+        if hm is not None:
+            m = hm.snapshot()
+            line += f" mesh[{m['healthy']}/{m['total']}"
+            if m["leased"]:
+                line += f" leased={m['leased']}"
+            if m["unhealthy"]:
+                line += f" shed={m['unhealthy']}"
+            svc_m = (svc.snapshot().get("mesh") if svc is not None else None)
+            if svc_m is not None:
+                line += (f" sharded={svc_m['sharded_dispatches']}"
+                         f" single={svc_m['single_dispatches']}")
+                if svc_m["mesh_replays"]:
+                    line += f" replays={svc_m['mesh_replays']}"
+            line += "]"
         # --rpc-gateway: the serving gateway's one-line health — queue
         # pressure per admission domain, whether duplicate reads actually
         # share work (cf = coalesce factor), cache effectiveness, and the

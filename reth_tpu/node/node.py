@@ -131,6 +131,11 @@ class Node:
         # client multiplexes over ops/hash_service.py — surfaced on the
         # events dashboard and hash_service_* /metrics
         self.hash_service = getattr(self.committer, "hash_service", None)
+        # device mesh (--mesh): the parallel/mesh.py descriptor the turbo
+        # committers and the (meshed) hash service shard over — surfaced
+        # on the events dashboard and mesh_* /metrics
+        self.hash_mesh = (getattr(self.committer, "hash_mesh", None)
+                          or getattr(self.hash_service, "mesh", None))
         # device warm-up manager (--warmup): per-shape compile lifecycle +
         # degraded-mode serving (ops/warmup.py). Usually built by the CLI
         # alongside the committer; a directly-constructed Node with

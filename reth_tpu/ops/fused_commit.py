@@ -245,7 +245,35 @@ class FusedLevelEngine:
         self._n_slots = 0
 
     def _row_cap(self) -> int:
-        return min(self._MAX_ROWS, self.MAX_BATCH_ROWS)
+        """Row-range split threshold: the LARGEST tier on the batch ladder
+        (x4 growth from the device-count-rounded floor) that still fits
+        under the declared ceilings. Splitting at a raw ceiling minted a
+        tier ABOVE it whenever the mesh-rounded floor put the ladder off
+        the pow2 grid (e.g. 6 devices: 1026 → 4104 → 16416 → 65664 >
+        MAX_BATCH_ROWS) — a chunk split must never create a shape the
+        warm-up menu doesn't declare or the mesh can't divide."""
+        ceiling = min(self._MAX_ROWS, self.MAX_BATCH_ROWS)
+        mult = self._batch_multiple()
+        t = max(self.min_tier, mult)
+        while t * 4 <= ceiling:
+            t *= 4
+        return t
+
+    def _check_batch_tier(self, n_tier: int) -> int:
+        """Invariant guard on every minted batch tier: divisible by the
+        mesh device count AND inside the declared menu ceiling. A
+        violation here would silently shard unevenly or compile an
+        off-menu program mid-commit — fail loudly instead."""
+        mult = self._batch_multiple()
+        # the floor tier itself is always admissible (a min_tier configured
+        # above the ceiling has nothing smaller to fall back to)
+        ceiling = max(min(self._MAX_ROWS, self.MAX_BATCH_ROWS),
+                      max(self.min_tier, mult))
+        assert n_tier % mult == 0, (
+            f"batch tier {n_tier} not divisible by the {mult}-device mesh")
+        assert n_tier <= ceiling, (
+            f"batch tier {n_tier} exceeds the declared ceiling {ceiling}")
+        return n_tier
 
     def _check_block_tier(self, b_tier: int) -> int:
         if b_tier > self.MAX_BLOCK_TIER:
@@ -346,7 +374,8 @@ class FusedLevelEngine:
     def _dispatch_one(self, bucket: _Bucket, b_tier: int) -> None:
         n = len(bucket.templates)
         mult = self._batch_multiple()
-        n_tier = _tier(max(n + 1, mult), max(self.min_tier, mult), growth=4)
+        n_tier = self._check_batch_tier(
+            _tier(max(n + 1, mult), max(self.min_tier, mult), growth=4))
         L = b_tier * RATE
 
         templates = np.zeros((n_tier, L), dtype=np.uint8)
@@ -392,7 +421,8 @@ class FusedLevelEngine:
     def _pad_rows(self, n: int, *arrays):
         """Pad row-indexed arrays to the batch tier; returns (n_tier, padded)."""
         mult = self._batch_multiple()
-        n_tier = _tier(max(n + 1, mult), max(self.min_tier, mult), growth=4)
+        n_tier = self._check_batch_tier(
+            _tier(max(n + 1, mult), max(self.min_tier, mult), growth=4))
         out = []
         for arr, fill in arrays:
             p = np.full((n_tier,), fill, dtype=arr.dtype)
@@ -418,6 +448,8 @@ class FusedLevelEngine:
         h_tier = -(-floor // mult) * mult  # hole arrays shard over the mesh too
         while h_tier < h:
             h_tier *= growth_mult
+        assert h_tier % mult == 0, (
+            f"hole tier {h_tier} not divisible by the {mult}-device mesh")
         rows = np.full((h_tier,), n, dtype=np.int32)
         offs = np.zeros((h_tier,), dtype=np.int32)
         srcs = np.zeros((h_tier,), dtype=np.int32)
@@ -817,6 +849,15 @@ class FusedMeshEngine(FusedLevelEngine):
     def __init__(self, mesh, min_tier: int = 1024):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # ``mesh``: a jax.sharding.Mesh, or a parallel/mesh.py HashMesh
+        # descriptor — then the engine snapshots the LIVE sub-mesh at
+        # construction (one commit = one membership; a device lost
+        # mid-commit is the SupervisedBackend journal-replay's job)
+        live_snapshot = getattr(mesh, "live_snapshot", None)
+        if live_snapshot is not None:
+            mesh, _ = live_snapshot()
+            if mesh is None:
+                raise RuntimeError("HashMesh has no live devices")
         # every tier must stay divisible by the device count: tiers grow by
         # x4 (batch) / x2 (holes, slots) from their floors, so rounding the
         # floor up to a device-count multiple keeps all of them shardable

@@ -39,6 +39,22 @@ Shape:
   Requests that age past ``lease_bypass_s`` while a (long) lease is held
   are dispatched on the CPU twin, so a multi-second rebuild window never
   blocks the live tip.
+- **Device mesh** (``mesh=``, a ``parallel/mesh.py`` :class:`HashMesh`):
+  the service owns a device MESH instead of one backend. A
+  partition-rule table (``HashMesh.spec_for``) decides how each
+  coalesced dispatch shards: large batches scatter over the live mesh
+  (``P(axis)``, one keccak shard per device), scalar and sub-threshold
+  requests stay unpartitioned on one device (``P()``) — hash throughput
+  only scales with lanes when batching is explicit (arxiv 1608.00492,
+  2501.18780). The exclusive lease generalizes to a **sub-mesh lease**:
+  a rebuild claims k of n devices (``lease(devices=k)``) while the
+  live/payload/proof lanes keep dispatching on the rest — no pause, no
+  CPU bypass. Per-device circuit breakers
+  (``ops/supervisor.py DeviceBreakerBoard``) give partial-mesh
+  degradation: a wedged device SHRINKS the mesh (shardings re-form on
+  the survivors and the in-flight batch replays there, bit-identical —
+  hashing is stateless); the numpy-twin replay below remains the FINAL
+  rung, taken only once every device has tripped.
 - **Failover**: the backend is typically an ``ops/supervisor.py``
   :class:`~reth_tpu.ops.supervisor.SupervisedHasher` — circuit-breaker
   trips and watchdog timeouts apply to the shared service. Hashing is
@@ -254,9 +270,12 @@ class LeasedTurboBackend:
     service overhead — while coalesced lanes pause (aged requests bypass
     onto the CPU twin, see :meth:`HashService.lease`)."""
 
-    def __init__(self, service: "HashService", inner):
+    def __init__(self, service: "HashService", inner=None, factory=None):
+        if inner is None and factory is None:
+            raise ValueError("LeasedTurboBackend needs inner or factory")
         self._service = service
         self._inner = inner
+        self._factory = factory
         self._lease = None
 
     @property
@@ -271,6 +290,11 @@ class LeasedTurboBackend:
         if self._lease is None:
             self._lease = self._service.lease(what="rebuild")
             self._lease.__enter__()
+        if self._inner is None:
+            # deferred construction: on a meshed service the engine's
+            # shardings must form over the sub-mesh the lease carved out,
+            # which only exists once the lease is held
+            self._inner = self._factory()
         self._inner.begin(max_slots)
 
     def release(self) -> None:
@@ -328,6 +352,12 @@ class HashService:
     None, built from ``supervisor`` (a ``SupervisedHasher``) or, with no
     supervisor either, the plain device front-end.
     ``cpu_hasher``: the replay twin (default ``keccak256_batch_np``).
+    ``mesh``: a ``parallel/mesh.py`` HashMesh — coalesced dispatches then
+    route through the partition-rule table (sharded over the live mesh or
+    unpartitioned on one device) instead of ``backend``; per-device
+    breakers shrink the mesh before the CPU twin is ever considered.
+    ``rebuild_devices``: sub-mesh lease width (k of n devices for the
+    rebuild; default ``RETH_TPU_MESH_REBUILD_DEVICES`` or half the mesh).
     """
 
     def __init__(self, backend=None, supervisor=None, *,
@@ -340,6 +370,8 @@ class HashService:
                  lease_bypass_s: float | None = None,
                  min_tier: int = 1024,
                  injector: ServiceFaultInjector | None = None,
+                 mesh=None, breaker_board=None, device_injector=None,
+                 rebuild_devices: int | None = None, warmup=None,
                  registry=None):
         env = os.environ
         self.supervisor = supervisor
@@ -382,12 +414,37 @@ class HashService:
         from ..metrics import HashServiceMetrics
 
         self.metrics = HashServiceMetrics(registry)
+        # -- device mesh (tentpole): partition-rule routed sharded dispatch,
+        # per-device breakers, sub-mesh rebuild leases
+        self.mesh = mesh
+        self._mesh_hasher = None
+        self.breaker_board = breaker_board
+        self.device_injector = device_injector
+        self.rebuild_devices = rebuild_devices
+        if mesh is not None:
+            from ..parallel.mesh import MeshKeccak
+
+            self._mesh_hasher = MeshKeccak(mesh, min_tier=min_tier,
+                                           block_tier=4, warmup=warmup)
+            if breaker_board is None:
+                from .supervisor import DeviceBreakerBoard
+
+                self.breaker_board = DeviceBreakerBoard(mesh)
+            if device_injector is None:
+                from .supervisor import FaultInjector
+
+                self.device_injector = FaultInjector.from_env()
+            if rebuild_devices is None:
+                self.rebuild_devices = int(
+                    env.get("RETH_TPU_MESH_REBUILD_DEVICES", 0)
+                    or max(1, mesh.n_devices // 2))
         self._cond = threading.Condition()
         self._queues: dict[str, list[_Request]] = {l: [] for l in LANES}
         self._queued_msgs: dict[str, int] = {l: 0 for l in LANES}
         self._stopping = False
         self._leased = False
         self._lease_what: str | None = None
+        self._submesh = None  # active _SubMeshLease (rebuild holds k devices)
         self._dispatching = False
         # counters surfaced via snapshot() (metrics hold the full detail)
         self.dispatches = 0
@@ -397,6 +454,10 @@ class HashService:
         self.rejects = 0
         self.leases = 0
         self.lease_bypasses = 0
+        self.submesh_leases = 0
+        self.mesh_sharded = 0
+        self.mesh_single = 0
+        self.mesh_replays = 0
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="hash-service")
         self._thread.start()
@@ -475,14 +536,55 @@ class HashService:
     # -- exclusive lease ----------------------------------------------------
 
     @contextmanager
-    def lease(self, what: str = "rebuild"):
-        """Exclusive use of the underlying device: coalesced dispatching
-        pauses until release (in-flight dispatch first drains). Queued
-        requests that age past ``lease_bypass_s`` are hashed on the CPU
-        twin meanwhile, so a long-held lease cannot stall the live tip."""
+    def lease(self, what: str = "rebuild", devices: int | None = None):
+        """Device lease for a turbo commit.
+
+        **Exclusive** (no mesh, or ``devices`` covers the mesh): coalesced
+        dispatching pauses until release (in-flight dispatch first
+        drains); queued requests that age past ``lease_bypass_s`` are
+        hashed on the CPU twin meanwhile, so a long-held lease cannot
+        stall the live tip.
+
+        **Sub-mesh** (mesh present, ``devices=k`` leaves >= 1 live
+        device): the rebuild claims k devices (``rebuild_mesh()`` exposes
+        them to the engine factory) while coalesced dispatching CONTINUES
+        on the remaining live sub-mesh — shardings re-form over the
+        survivors, nothing pauses and nothing bypasses to the CPU.
+        """
+        if devices is None and self.mesh is not None:
+            devices = self.rebuild_devices
+        if self.mesh is not None and devices:
+            from ..parallel.mesh import MeshExhausted
+
+            t0 = time.monotonic()
+            sub = None
+            with self._cond:
+                while self._leased or self._submesh is not None:
+                    self._cond.wait()
+                try:
+                    sub = self.mesh.lease_submesh(devices, what=what)
+                except MeshExhausted:
+                    pass  # not enough live devices: exclusive lease below
+                else:
+                    self._submesh = sub
+                    self._lease_what = what
+                    self.leases += 1
+                    self.submesh_leases += 1
+            if sub is not None:
+                self.metrics.record_lease(time.monotonic() - t0)
+                try:
+                    yield self
+                finally:
+                    with self._cond:
+                        sub.release()
+                        self._submesh = None
+                        self._lease_what = None
+                        self._cond.notify_all()
+                return
         t0 = time.monotonic()
         with self._cond:
-            while self._leased or self._dispatching:
+            while self._leased or self._submesh is not None \
+                    or self._dispatching:
                 self._cond.wait()
             self._leased = True
             self._lease_what = what
@@ -496,10 +598,20 @@ class HashService:
                 self._lease_what = None
                 self._cond.notify_all()
 
-    def lease_backend(self, inner) -> LeasedTurboBackend:
+    def rebuild_mesh(self):
+        """The jax Mesh currently leased to the rebuild (``None`` outside
+        a sub-mesh lease) — what ``TurboCommitter``'s engine factory
+        builds its ``FusedMeshEngine`` over."""
+        sub = self._submesh
+        return sub.mesh if sub is not None else None
+
+    def lease_backend(self, inner=None, *, factory=None) -> LeasedTurboBackend:
         """Wrap an array-protocol turbo engine so one commit holds the
-        exclusive lease from ``begin()`` to its terminal fetch."""
-        return LeasedTurboBackend(self, inner)
+        lease from ``begin()`` to its terminal fetch. Pass ``factory``
+        instead of a built engine to defer construction until AFTER the
+        lease is acquired — the mesh path needs this so the engine forms
+        its shardings over the sub-mesh the lease just carved out."""
+        return LeasedTurboBackend(self, inner, factory=factory)
 
     # -- dispatcher ---------------------------------------------------------
 
@@ -590,6 +702,64 @@ class HashService:
                         self._dispatching = False
                         self._cond.notify_all()
 
+    def _mesh_dispatch(self, msgs: list[bytes], lane: str) -> list[bytes]:
+        """One coalesced batch over the device mesh, with partial-mesh
+        degradation. The partition-rule table decides sharded (``P(axis)``
+        over the live mesh) vs unpartitioned (``P()`` on one device); a
+        failed dispatch feeds the per-device breakers — attributed wedges
+        shed their device immediately — and the SAME batch replays on the
+        shrunken mesh, shardings re-formed over the survivors (hashing is
+        stateless, so the replay is bit-identical). Raises only when no
+        device is left: the caller's numpy-twin replay is the final rung.
+        """
+        from ..parallel.mesh import MeshExhausted
+
+        board = self.breaker_board
+        program = "keccak.scalar" if len(msgs) == 1 else "keccak.masked"
+        attempts = 0
+        while True:
+            if board is not None:
+                board.poll()  # cooled-down devices rejoin (trial by fire)
+            spec, mesh = self.mesh.spec_for(lane, program, len(msgs))
+            if mesh is None:
+                raise MeshExhausted(
+                    "no live mesh device (all breakers open or leased)")
+            indices = tuple(self.mesh.devices.index(d)
+                            for d in mesh.devices.flat)
+            try:
+                if self.device_injector is not None:
+                    self.device_injector.on_mesh_dispatch(indices)
+                out = self._mesh_hasher.hash_sharded(msgs, mesh)
+            except BaseException as e:  # noqa: BLE001 — degraded below
+                attempts += 1
+                idx = getattr(e, "device_index", None)
+                if board is None or attempts > self.mesh.n_devices + 1:
+                    raise
+                if idx is not None:
+                    board.record_failure(idx, attributed=True)
+                else:
+                    # a collective failure with no device attribution:
+                    # every participant is suspect (thresholded, so one
+                    # flaky dispatch does not shed the whole mesh)
+                    for i in indices:
+                        board.record_failure(i)
+                self.mesh_replays += 1
+                self.mesh.metrics.record_replay()
+                tracing.event("ops::hash_service", "mesh_replay",
+                              msgs=len(msgs), shed=idx,
+                              error=type(e).__name__,
+                              live=self.mesh.healthy_count)
+                continue  # replay the in-flight batch on the survivors
+            if board is not None:
+                board.record_success(indices)
+            if len(spec) and len(indices) > 1:
+                self.mesh_sharded += 1
+                self.mesh.metrics.record_sharded()
+            else:
+                self.mesh_single += 1
+                self.mesh.metrics.record_single()
+            return out
+
     def _dispatch(self, batch: list[_Request], bypass: bool) -> None:
         """ONE backend call for the whole coalesced batch; scatter digests
         back through the futures. Any backend failure (watchdog trip that
@@ -612,7 +782,10 @@ class HashService:
             else:
                 if self.injector is not None:
                     self.injector.on_dispatch()
-                digests = self._backend(msgs)
+                if self.mesh is not None:
+                    digests = self._mesh_dispatch(msgs, batch[0].lane)
+                else:
+                    digests = self._backend(msgs)
         except BaseException as first_error:  # noqa: BLE001 — replayed below
             replayed = True
             replay_err = type(first_error).__name__
@@ -692,7 +865,8 @@ class HashService:
         with self._cond:
             queued = dict(self._queued_msgs)
             leased = self._lease_what
-        return {
+            sub = self._submesh
+        out = {
             "queued": queued,
             "queued_total": sum(queued.values()),
             "dispatches": self.dispatches,
@@ -706,3 +880,17 @@ class HashService:
             "fault_injection": (self.injector.active()
                                 if self.injector is not None else False),
         }
+        if self.mesh is not None:
+            out["mesh"] = {
+                **self.mesh.snapshot(),
+                "sharded_dispatches": self.mesh_sharded,
+                "single_dispatches": self.mesh_single,
+                "mesh_replays": self.mesh_replays,
+                "submesh_leases": self.submesh_leases,
+                "submesh_held": (list(sub.indices)
+                                 if sub is not None else None),
+            }
+            if self.device_injector is not None:
+                out["fault_injection"] = (out["fault_injection"]
+                                          or self.device_injector.active())
+        return out

@@ -71,6 +71,17 @@ class InjectedWedge(DeviceDispatchError):
     """Fault injection wedged this dispatch (RETH_TPU_FAULT_WEDGE_EVERY)."""
 
 
+class InjectedDeviceWedge(DeviceDispatchError):
+    """Fault injection wedged ONE SPECIFIC mesh device
+    (RETH_TPU_FAULT_DEVICE_WEDGE) — carries the device index so the
+    per-device breaker can attribute the failure and shrink the mesh
+    around it instead of tripping the whole-device route."""
+
+    def __init__(self, device_index: int, msg: str):
+        super().__init__(msg)
+        self.device_index = device_index
+
+
 class InjectedPipelineAbort(RuntimeError):
     """Fault injection killed the rebuild pipeline at a window boundary
     (RETH_TPU_FAULT_PIPELINE_ABORT) — the in-process analogue of a crash
@@ -186,26 +197,34 @@ class FaultInjector:
     ``compile_wedge``: the first N warm-up shape compiles wedge past their
     watchdog budget (negative = every compile, until the field is cleared)
     — the ``ops/warmup.py`` degraded-serving / backoff-retry drill.
+    ``device_wedge``: a set of MESH DEVICE indices — any sharded dispatch
+    whose live mesh still contains one of them raises
+    :class:`InjectedDeviceWedge` (attributed), so the per-device breaker
+    + shrunken-mesh replay ladder is testable without hardware. Wedging
+    every index drills the final CPU rung.
 
     Env form (read by :meth:`from_env`, also settable via CLI):
     ``RETH_TPU_FAULT_WEDGE_EVERY`` / ``RETH_TPU_FAULT_DELAY`` /
     ``RETH_TPU_FAULT_PROBE_FAIL`` / ``RETH_TPU_FAULT_PIPELINE_ABORT`` /
-    ``RETH_TPU_FAULT_COMPILE_WEDGE``.
+    ``RETH_TPU_FAULT_COMPILE_WEDGE`` / ``RETH_TPU_FAULT_DEVICE_WEDGE``
+    (comma-separated device indices, e.g. ``"2"`` or ``"0,3,5"``).
     """
 
     def __init__(self, wedge_every: int = 0, delay: float = 0.0,
                  probe_fail: int = 0, pipeline_abort: int = 0,
-                 compile_wedge: int = 0):
+                 compile_wedge: int = 0, device_wedge=()):
         self.wedge_every = wedge_every
         self.delay = delay
         self.probe_fail = probe_fail
         self.pipeline_abort = pipeline_abort
         self.compile_wedge = compile_wedge
+        self.device_wedge = frozenset(int(i) for i in device_wedge)
         self.dispatch_count = 0
         self.wedged = 0
         self.probes_failed = 0
         self.windows = 0
         self.compiles_wedged = 0
+        self.devices_wedged = 0
         self._lock = threading.Lock()
 
     @classmethod
@@ -217,14 +236,37 @@ class FaultInjector:
         probe = int(env.get("RETH_TPU_FAULT_PROBE_FAIL", "0") or 0)
         pabort = int(env.get("RETH_TPU_FAULT_PIPELINE_ABORT", "0") or 0)
         cwedge = int(env.get("RETH_TPU_FAULT_COMPILE_WEDGE", "0") or 0)
-        if not (wedge or delay or probe or pabort or cwedge):
+        raw = env.get("RETH_TPU_FAULT_DEVICE_WEDGE", "") or ""
+        dwedge = tuple(int(x) for x in raw.split(",") if x.strip())
+        if not (wedge or delay or probe or pabort or cwedge or dwedge):
             return None
         return cls(wedge_every=wedge, delay=delay, probe_fail=probe,
-                   pipeline_abort=pabort, compile_wedge=cwedge)
+                   pipeline_abort=pabort, compile_wedge=cwedge,
+                   device_wedge=dwedge)
 
     def active(self) -> bool:
         return bool(self.wedge_every or self.delay or self.probe_fail
-                    or self.pipeline_abort or self.compile_wedge)
+                    or self.pipeline_abort or self.compile_wedge
+                    or self.device_wedge)
+
+    def on_mesh_dispatch(self, device_indices) -> None:
+        """Called before every mesh-sharded dispatch with the live device
+        indices. If a wedged device still participates, the dispatch
+        fails ATTRIBUTED to that device — exactly the failure shape a
+        per-device breaker needs to shrink the mesh around it."""
+        if not self.device_wedge:
+            return
+        hit = sorted(self.device_wedge.intersection(device_indices))
+        if not hit:
+            return
+        with self._lock:
+            self.devices_wedged += 1
+        tracing.fault_event("RETH_TPU_FAULT_DEVICE_WEDGE",
+                            target="parallel::mesh", device=hit[0],
+                            live=list(device_indices))
+        raise InjectedDeviceWedge(
+            hit[0], f"injected wedge on mesh device {hit[0]} "
+                    f"(live mesh {list(device_indices)})")
 
     def on_compile(self, budget: float) -> None:
         """Called inside every warm-up compile worker. A wedged "compile"
@@ -365,6 +407,93 @@ class CircuitBreaker:
                 self.trips += 1
                 self._open_until = self._clock() + self._timeout
                 self._set_state(OPEN)
+
+
+class DeviceBreakerBoard:
+    """Per-device circuit breakers over a ``parallel/mesh.py`` HashMesh —
+    the MIDDLE rung of the degradation ladder (device → sub-mesh → CPU
+    twin). One :class:`CircuitBreaker` per mesh device; a trip sheds that
+    device from the mesh's health mask (shardings re-form over the
+    survivors, the in-flight batch replays there) instead of routing the
+    whole node to the CPU twin. The full CPU failover — the supervisor's
+    existing all-or-nothing breaker — only fires once EVERY device has
+    tripped (:meth:`exhausted`).
+
+    Recovery is trial-by-fire: :meth:`poll` re-admits a device whose open
+    cooldown elapsed (the breaker's HALF_OPEN transition); the next
+    successful dispatch that includes it closes the breaker, the next
+    attributed failure re-opens it with doubled backoff. There is no
+    per-virtual-device subprocess probe — a mesh device's only meaningful
+    health signal is a dispatch that includes it.
+    """
+
+    def __init__(self, mesh, failure_threshold: int | None = None,
+                 reset_timeout: float | None = None, clock=time.monotonic):
+        if failure_threshold is None:
+            failure_threshold = int(
+                os.environ.get("RETH_TPU_DEVICE_BREAKER_TRIPS", "3"))
+        if reset_timeout is None:
+            reset_timeout = float(
+                os.environ.get("RETH_TPU_DEVICE_BREAKER_RESET", "30"))
+        self.mesh = mesh
+        self.breakers = [
+            CircuitBreaker(failure_threshold=failure_threshold,
+                           reset_timeout=reset_timeout, clock=clock)
+            for _ in range(mesh.n_devices)
+        ]
+        self.trips = 0
+
+    def record_failure(self, idx: int, attributed: bool = False) -> bool:
+        """Count one failure against device ``idx``; an ATTRIBUTED failure
+        (the error names the device — injected wedge, per-device XLA
+        diagnostic) opens immediately, an unattributed one counts toward
+        the threshold like any collective-participant suspicion. Returns
+        True when this call shed the device from the mesh."""
+        b = self.breakers[idx]
+        if attributed:
+            b.force_open()
+        else:
+            b.record_failure()
+        if b.state == OPEN and self.mesh.is_healthy(idx):
+            self.trips += 1
+            return self.mesh.mark_unhealthy(
+                idx, reason="attributed wedge" if attributed
+                else "unattributed dispatch failures")
+        return False
+
+    def record_success(self, indices) -> None:
+        """A dispatch over ``indices`` completed: clear their failure
+        counts (and close any HALF_OPEN breaker that just survived its
+        trial dispatch)."""
+        for i in indices:
+            self.breakers[i].record_success()
+
+    def poll(self) -> int:
+        """Re-admit devices whose open cooldown elapsed (``allow()`` moves
+        OPEN past its deadline to HALF_OPEN). Returns how many devices
+        rejoined the mesh; call before each mesh dispatch so recovery
+        needs no extra thread."""
+        rejoined = 0
+        for i, b in enumerate(self.breakers):
+            if not self.mesh.is_healthy(i) and b.allow():
+                if self.mesh.mark_healthy(i):
+                    rejoined += 1
+        return rejoined
+
+    def exhausted(self) -> bool:
+        """True when no device remains healthy — the caller must take the
+        final rung (CPU twin)."""
+        return self.mesh.healthy_count == 0
+
+    def snapshot(self) -> dict:
+        states = [b.state for b in self.breakers]
+        return {
+            "devices": len(states),
+            "open": sum(1 for s in states if s == OPEN),
+            "half_open": sum(1 for s in states if s == HALF_OPEN),
+            "trips": self.trips,
+            "states": states,
+        }
 
 
 class DeviceSupervisor:

@@ -72,24 +72,33 @@ class MenuShape:
     ``program``: "keccak.masked" | "keccak.exact" | "fused.plain" |
     "fused.splice" — the same kind strings the dispatch sites report to the
     compile tracker, so menu states and dispatch attribution line up.
+    ``mesh_size``: 1 = single-device; >1 = the SPMD variant sharded over
+    that many devices (a sharded dispatch compiles a DIFFERENT executable
+    than its single-device twin, so it needs its own menu slot — otherwise
+    the first mesh-sharded dispatch ambushes a live commit with a fresh
+    compile).
     """
 
     program: str
     block_tier: int
     batch_tier: int
+    mesh_size: int = 1
 
     def key(self) -> tuple:
-        return (self.program, self.block_tier, self.batch_tier)
+        return (self.program, self.block_tier, self.batch_tier,
+                self.mesh_size)
 
     def __str__(self) -> str:  # events/log form
-        return f"{self.program}:{self.block_tier}x{self.batch_tier}"
+        base = f"{self.program}:{self.block_tier}x{self.batch_tier}"
+        return base if self.mesh_size == 1 else f"{base}@m{self.mesh_size}"
 
 
 def default_menu(min_tier: int = DEFAULT_MIN_TIER,
                  block_tier: int = DEFAULT_BLOCK_TIER,
                  max_batch_tier: int = DEFAULT_MAX_BATCH_TIER,
                  max_block_tier: int = DEFAULT_MAX_BLOCK_TIER,
-                 include_fused: bool = True) -> list[MenuShape]:
+                 include_fused: bool = True,
+                 mesh_sizes: tuple[int, ...] = ()) -> list[MenuShape]:
     """The grid the runtime actually dispatches (see ``TrieCommitter``:
     ``KeccakDevice(min_tier=1024, block_tier=4)``): one masked program per
     pow2 batch tier for trie-node-sized messages (<= ``block_tier`` rate
@@ -97,7 +106,12 @@ def default_menu(min_tier: int = DEFAULT_MIN_TIER,
     large messages (contract code), clamped at the declared ceilings —
     everything beyond the menu is served by the CPU twin, never a fresh
     mid-commit compile. ``include_fused`` adds the fused level-commit
-    programs at the base tier (the live-tip sparse/turbo commit shapes)."""
+    programs at the base tier (the live-tip sparse/turbo commit shapes).
+    ``mesh_sizes`` adds the SPMD variants for each mesh size: the batch
+    ladder rounded up to device-count multiples (the tiers the mesh
+    front-ends actually mint — ``parallel/mesh.py mesh_tier`` /
+    ``FusedMeshEngine``'s rounded floor), so a mesh-sharded dispatch
+    never triggers a fresh compile mid-commit either."""
     shapes: list[MenuShape] = []
     t = min_tier
     while t <= max_batch_tier:
@@ -110,52 +124,97 @@ def default_menu(min_tier: int = DEFAULT_MIN_TIER,
     if include_fused:
         shapes.append(MenuShape("fused.plain", block_tier, min_tier))
         shapes.append(MenuShape("fused.splice", block_tier, min_tier))
+    for m in mesh_sizes:
+        if m <= 1:
+            continue
+        floor = -(-min_tier // m) * m  # device-count-multiple rounding
+        t = floor
+        while t <= max_batch_tier:
+            shapes.append(MenuShape("keccak.masked", block_tier, t, m))
+            t *= 2
+        if include_fused:
+            shapes.append(MenuShape("fused.plain", block_tier, floor, m))
+            shapes.append(MenuShape("fused.splice", block_tier, floor, m))
     return shapes
+
+
+def _mesh_for_shape(mesh_size: int):
+    """(Mesh, batch sharding, replicated sharding) for an SPMD menu shape.
+    jax interns ``Mesh`` per (devices, axes), so the warm-up's sharded
+    dummy dispatch hits the SAME jit cache entries the runtime's
+    ``MeshKeccak`` / ``FusedMeshEngine`` use."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < mesh_size:
+        raise ValueError(
+            f"menu shape needs {mesh_size} devices, found {len(devices)}")
+    mesh = Mesh(np.array(devices[:mesh_size]), ("data",))
+    return mesh, NamedSharding(mesh, P("data")), NamedSharding(mesh, P())
 
 
 def _build_shape(shape: MenuShape) -> None:
     """Compile ``shape``'s program by dispatching a dummy batch of exactly
     that shape through the SAME jitted callables the runtime uses — the
     in-process jit cache (and, when enabled, the persistent cache) is keyed
-    by function + shapes, so the runtime's first real dispatch of the shape
-    is steady-state. The result sync (`np.asarray`) makes the wall honest."""
+    by function + shapes + shardings, so the runtime's first real dispatch
+    of the shape is steady-state. The result sync (`np.asarray`) makes the
+    wall honest. ``mesh_size > 1`` dispatches the dummy batch SHARDED over
+    the first ``mesh_size`` devices — the mesh variant is a different
+    executable than its single-device twin."""
     import numpy as np
 
-    if shape.program in ("keccak.masked", "keccak.exact"):
+    import jax
+
+    put_batch = None
+    sharding_key = None
+    if shape.mesh_size > 1:
+        mesh, batch_sh, rep_sh = _mesh_for_shape(shape.mesh_size)
+        if shape.batch_tier % shape.mesh_size:
+            raise ValueError(
+                f"mesh menu tier {shape.batch_tier} not divisible by "
+                f"mesh size {shape.mesh_size}")
+        put_batch = lambda a: jax.device_put(a, batch_sh)  # noqa: E731
+        put_rep = lambda a: jax.device_put(a, rep_sh)      # noqa: E731
+        sharding_key = mesh
+    else:
         import jax.numpy as jnp
 
+        put_batch = put_rep = jnp.asarray
+    if shape.program in ("keccak.masked", "keccak.exact"):
         from .keccak_jax import keccak256_jax_words, keccak256_jax_words_masked
 
         words = np.zeros((shape.batch_tier, shape.block_tier * 34),
                          dtype=np.uint32)
         if shape.program == "keccak.exact":
-            np.asarray(keccak256_jax_words(jnp.asarray(words),
+            np.asarray(keccak256_jax_words(put_batch(words),
                                            shape.block_tier))
         else:
             counts = np.ones((shape.batch_tier,), dtype=np.int32)
             np.asarray(keccak256_jax_words_masked(
-                jnp.asarray(words), shape.block_tier,
-                counts=jnp.asarray(counts)))
+                put_batch(words), shape.block_tier,
+                counts=put_batch(counts)))
         return
     if shape.program in ("fused.plain", "fused.splice"):
-        import jax.numpy as jnp
-
         from ..primitives.keccak import RATE
         from .fused_commit import _jitted
 
         n, b = shape.batch_tier, shape.block_tier
-        templates = jnp.zeros((n, b * RATE), dtype=jnp.uint8)
-        counts = jnp.ones((n,), dtype=jnp.int32)
-        slots = jnp.zeros((n,), dtype=jnp.int32)
-        buf = jnp.zeros((n, 32), dtype=jnp.uint8)
+        templates = put_batch(np.zeros((n, b * RATE), dtype=np.uint8))
+        counts = put_batch(np.ones((n,), dtype=np.int32))
+        slots = put_batch(np.zeros((n,), dtype=np.int32))
+        buf = put_rep(np.zeros((n, 32), dtype=np.uint8))
         if shape.program == "fused.plain":
-            fn = _jitted("plain", b)
+            fn = _jitted("plain", b, sharding_key)
             np.asarray(fn(templates, counts, slots, buf))
         else:
             # hole tier mirrors FusedLevelEngine: _HOLE_FACTOR * min batch
             h = 4 * n
-            zeros_h = jnp.zeros((h,), dtype=jnp.int32)
-            fn = _jitted("splice", b)
+            zeros_h = put_batch(np.zeros((h,), dtype=np.int32))
+            fn = _jitted("splice", b, sharding_key)
             np.asarray(fn(templates, counts, zeros_h, zeros_h, zeros_h,
                           slots, buf))
         return
@@ -201,10 +260,15 @@ class CompileCache:
     the directory."""
 
     def __init__(self, base_dir: str | Path, sources=None, *,
-                 probe_budget: float | None = None):
+                 probe_budget: float | None = None, mesh_size: int = 1):
         self.base = Path(base_dir)
         self.digest = kernel_source_digest(sources)
-        self.dir = self.base / f"xla-{self.digest}"
+        self.mesh_size = mesh_size
+        # the cache key gains the mesh size: SPMD executables for an
+        # n-device topology must never be loaded into a differently-sized
+        # mesh (XLA would reject them at best, wedge the tunnel at worst)
+        suffix = f"-m{mesh_size}" if mesh_size != 1 else ""
+        self.dir = self.base / f"xla-{self.digest}{suffix}"
         self.probe_budget = probe_budget
         self.enabled = False
         self.quarantined = 0
@@ -379,14 +443,16 @@ class WarmupManager:
             s == WARM for s in self.states.values())
 
     def route_bucket(self, program: str, block_tier: int,
-                     batch_tier: int) -> bool:
+                     batch_tier: int, mesh_size: int = 1) -> bool:
         """Per-dispatch routing: True = device, False = CPU twin. A WARM
         shape always gets the device; during warm-up (or degraded) an
         un-warm or off-menu shape routes to the CPU — never a blocking
-        fresh compile inside a commit."""
+        fresh compile inside a commit. ``mesh_size`` selects the SPMD
+        variant's menu slot."""
         if not self._active:
             return True
-        if self.states.get((program, block_tier, batch_tier)) == WARM:
+        if self.states.get((program, block_tier, batch_tier,
+                            mesh_size)) == WARM:
             return True
         if self.device_ready():
             return True  # fully warm: off-menu stragglers ride the watchdog
@@ -621,7 +687,9 @@ class WarmupManager:
                       else {"mode": "off", "entries": 0, "quarantined": 0}),
             "cache_hits": self.cache_hits,
             "cache_misses": self.cache_misses,
-            "shapes": {f"{k[0]}:{k[1]}x{k[2]}": v for k, v in states.items()},
+            "shapes": {(f"{k[0]}:{k[1]}x{k[2]}"
+                        + (f"@m{k[3]}" if k[3] != 1 else "")): v
+                       for k, v in states.items()},
         }
 
     def _publish(self) -> None:
@@ -632,10 +700,14 @@ class WarmupManager:
 
 def build_warmup(supervisor=None, cache_dir: str | Path | None = None,
                  menu: list[MenuShape] | None = None, registry=None,
-                 **kw) -> WarmupManager:
+                 mesh_size: int = 1, **kw) -> WarmupManager:
     """Shared constructor for the CLI and ``node/node.py``: a manager over
     the default menu, with the persistent cache keyed under ``cache_dir``
-    when one is given."""
-    cache = CompileCache(cache_dir) if cache_dir else None
+    when one is given. ``mesh_size > 1`` (the ``--mesh`` wiring) adds the
+    SPMD menu variants and keys the cache by the mesh size."""
+    if menu is None and mesh_size > 1:
+        menu = default_menu(mesh_sizes=(mesh_size,))
+    cache = (CompileCache(cache_dir, mesh_size=mesh_size)
+             if cache_dir else None)
     return WarmupManager(menu=menu, supervisor=supervisor, cache=cache,
                          registry=registry, **kw)

@@ -9,6 +9,22 @@ reduction uses XLA collectives (all_gather) over ICI — no NCCL/MPI
 translation, the compiler inserts the transfers.
 """
 
-from .mesh import HashMesh, multichip_commit_step, sharded_keccak
+from .mesh import (
+    DEFAULT_PARTITION_RULES,
+    HashMesh,
+    MeshExhausted,
+    MeshKeccak,
+    match_partition_rule,
+    mesh_tier,
+    sharded_keccak,
+)
 
-__all__ = ["HashMesh", "multichip_commit_step", "sharded_keccak"]
+__all__ = [
+    "DEFAULT_PARTITION_RULES",
+    "HashMesh",
+    "MeshExhausted",
+    "MeshKeccak",
+    "match_partition_rule",
+    "mesh_tier",
+    "sharded_keccak",
+]

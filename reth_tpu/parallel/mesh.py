@@ -1,16 +1,43 @@
-"""Device-mesh sharded keccak + the multi-chip trie-commit step.
+"""Device-mesh descriptor for the sharded hashing data plane.
 
-Design (scaling-book recipe): pick a mesh, annotate shardings, let XLA
-insert collectives. The hash workload is batch-parallel, so the mesh has
-one ``data`` axis; a trie level of N nodes shards N/devices per chip.
-Parent levels need children's digests — a cross-device dependency —
-expressed as an ``all_gather`` of the level's digest shard (rides ICI on
-real hardware). This is the whole communication pattern of the
-state-commitment data plane: hash (sharded) → gather digests → hash the
-next level.
+Design (scaling-book recipe, SNIPPETS partition-rule idiom): pick a mesh,
+annotate shardings via a RULE TABLE, let XLA insert collectives. The hash
+workload is batch-parallel, so the mesh has one ``data`` axis; a trie
+level of N nodes shards N/devices per chip. Parent levels need children's
+digests — a cross-device dependency — expressed by scattering the level's
+sharded digests into the REPLICATED resident digest buffer (XLA inserts
+the all-gather, which rides ICI on real hardware). That is the whole
+communication pattern of the state-commitment data plane: hash (sharded)
+→ gather digests → hash the next level.
+
+:class:`HashMesh` is the real mesh descriptor, not a static wrapper:
+
+- **Device health mask**: per-device alive bits, flipped by the
+  per-device circuit breakers (``ops/supervisor.py DeviceBreakerBoard``).
+  A wedged device SHRINKS the mesh — shardings re-form over the
+  survivors and the in-flight batch replays there — instead of tripping
+  the all-or-nothing CPU failover (which remains the FINAL rung).
+- **Sub-mesh lease** (:meth:`lease_submesh`): the rebuild pipeline claims
+  k of n devices while the live/payload/proof lanes keep the rest — the
+  generalization of the hash service's exclusive lease.
+- **Partition-rule table** (:data:`DEFAULT_PARTITION_RULES`,
+  :meth:`spec_for`): ``(lane/program, shape) -> PartitionSpec`` decides
+  how each coalesced dispatch shards. Large fused per-depth windows
+  batch-shard (``P(axis)``); scalar and sub-threshold requests stay
+  unpartitioned on ONE device (``P()`` over a 1-device mesh) — the
+  Sakura/batched-hash lesson (arxiv 1608.00492, 2501.18780) that hash
+  throughput only scales with lanes when batching is explicit.
+
+Jax ``Mesh`` objects are cached per live-membership tuple, so jitted
+programs re-use compiled executables for a given topology and a shrink
+only pays one re-lowering per new membership.
 """
 
 from __future__ import annotations
+
+import os
+import re
+import threading
 
 import numpy as np
 
@@ -18,59 +45,375 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import tracing
 from ..ops.keccak_jax import absorb_single_block
 
+# -- partition-rule table (SNIPPETS match_partition_rules shape) --------------
 
-def _commit_step(w):
-    """Two-level trie commit: sharded leaf hash → gather → parent hash.
-
-    Level 0: hash N leaf messages (batch-sharded, pure data parallel).
-    Level 1: every device needs the whole level's digests to build parent
-    nodes → the replication constraint makes XLA insert an all_gather,
-    then the N/4 parent nodes (each the 128-byte concatenation of 4 child
-    digests, single rate block after padding) are hashed — a miniature
-    4-ary trie level reduce.
-    """
-    digests = absorb_single_block(w)  # (N, 8) sharded over batch
-    # reshaping groups of 4 children into parent rows crosses shard
-    # boundaries — XLA inserts the all_gather/collective from the sharding
-    # propagation (leaf level sharded, parent level replicated)
-    n = digests.shape[0]
-    groups = digests.reshape(n // 4, 32)  # 4 children of 8 words per parent
-    pad = jnp.zeros((n // 4, 2), dtype=jnp.uint32)
-    # keccak padding for a 128-byte message in the 136-byte rate block:
-    # byte 128 = 0x01 → word 32; byte 135 = 0x80 → word 33 high byte
-    pad = pad.at[:, 0].set(jnp.uint32(0x01)).at[:, 1].set(jnp.uint32(0x80000000))
-    parents = jnp.concatenate([groups, pad], axis=1)  # (n/4, 34)
-    return absorb_single_block(parents)
+# (regex over "lane/program", min rows PER DEVICE before sharding pays off;
+# None = never partition). First match wins. The thresholds encode the
+# scatter cost: a fused per-depth rebuild window is always worth spreading,
+# a scalar probe never is.
+DEFAULT_PARTITION_RULES: list[tuple[str, int | None]] = [
+    # fused level windows (rebuild pipeline / live sparse commit): the
+    # per-depth packing already built one full-rate batch — scatter it
+    (r"^(rebuild|live|payload)/fused\.", 1),
+    # explicit scalar programs (single-key probes): never pay the scatter
+    (r"/scalar$", None),
+    # coalesced keccak batches: shard once every device gets a real shard
+    (r"/keccak\.", 4),
+    # default: conservative — small batches stay on one device
+    (r".", 8),
+]
 
 
-class HashMesh:
-    """A 1-axis device mesh for batch-parallel hashing.
+def match_partition_rule(rules, name: str, rows: int,
+                         n_devices: int) -> str:
+    """``"batch"`` (shard over the mesh) or ``"single"`` (one device) for
+    one dispatch, by first-matching rule — the scalar-vs-sharded decision
+    of SNIPPETS' ``match_partition_rules``, specialized to the 1-axis
+    batch mesh."""
+    if n_devices <= 1:
+        return "single"
+    for pattern, min_rows in rules:
+        if re.search(pattern, name):
+            if min_rows is None:
+                return "single"
+            return "batch" if rows >= min_rows * n_devices else "single"
+    return "single"
 
-    Jitted programs are cached per mesh instance — callers reuse one
-    HashMesh for the life of the device topology.
-    """
 
-    def __init__(self, devices=None, axis: str = "data"):
-        devices = devices if devices is not None else jax.devices()
-        self.axis = axis
-        self.mesh = Mesh(np.array(devices), (axis,))
-        sharded = self.batch_sharding()
-        self._keccak = jax.jit(absorb_single_block, out_shardings=sharded)
-        # parent level reads ALL child digests → reshape over the full batch
-        # forces the all_gather; output is small, leave it replicated
-        self._commit = jax.jit(_commit_step, out_shardings=self.replicated())
+class MeshExhausted(RuntimeError):
+    """Every device in the mesh is unhealthy (or leased away): the caller
+    must take the next degradation rung (CPU twin)."""
+
+
+class _SubMeshLease:
+    """Handle for k devices carved out of the mesh (rebuild claims them;
+    live lanes keep the rest). ``mesh`` is the jax Mesh over the leased
+    devices; ``release()`` is idempotent."""
+
+    __slots__ = ("_owner", "indices", "mesh", "what", "_released")
+
+    def __init__(self, owner: "HashMesh", indices: tuple[int, ...],
+                 mesh: Mesh, what: str):
+        self._owner = owner
+        self.indices = indices
+        self.mesh = mesh
+        self.what = what
+        self._released = False
 
     @property
     def n_devices(self) -> int:
-        return self.mesh.devices.size
+        return len(self.indices)
 
-    def batch_sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P(self.axis))
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._owner._release_lease(self)
 
-    def replicated(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P())
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"_SubMeshLease({self.what!r}, devices={list(self.indices)})"
+
+
+class HashMesh:
+    """The 1-axis device mesh descriptor for batch-parallel hashing:
+    device roster + health mask + sub-mesh lease accounting + the
+    partition-rule table. See the module docstring."""
+
+    def __init__(self, devices=None, axis: str = "data", rules=None,
+                 registry=None):
+        devices = list(devices if devices is not None else jax.devices())
+        if not devices:
+            raise ValueError("HashMesh needs at least one device")
+        self.axis = axis
+        self.devices = devices
+        self.rules = list(rules if rules is not None
+                          else DEFAULT_PARTITION_RULES)
+        self._lock = threading.Lock()
+        self._healthy = [True] * len(devices)
+        self._leased: set[int] = set()
+        self._meshes: dict[tuple[int, ...], Mesh] = {}
+        from ..metrics import MeshMetrics
+
+        self.metrics = MeshMetrics(registry)
+        self.shrinks = 0
+        self.recoveries = 0
+        self.submesh_leases = 0
+        # legacy full-roster mesh + jitted single-block program (kept for
+        # sharded_keccak and anything that wants the raw kernel)
+        self.mesh = self._mesh_for(tuple(range(len(devices))))
+        self._keccak = jax.jit(absorb_single_block,
+                               out_shardings=self.batch_sharding())
+        self._publish_locked()
+
+    @classmethod
+    def build(cls, n_devices: int, **kw) -> "HashMesh":
+        """Mesh over the first ``n_devices`` host devices (clamped to the
+        roster — a --mesh larger than the topology degrades, not crashes)."""
+        devs = jax.devices()
+        n = max(1, min(int(n_devices), len(devs)))
+        if n < n_devices:
+            tracing.event("parallel::mesh", "mesh_clamped",
+                          requested=n_devices, available=len(devs))
+        return cls(devs[:n], **kw)
+
+    # -- topology ------------------------------------------------------------
+
+    @property
+    def n_devices(self) -> int:
+        """Total roster size (healthy or not)."""
+        return len(self.devices)
+
+    @property
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(self._healthy)
+
+    def is_healthy(self, idx: int) -> bool:
+        with self._lock:
+            return self._healthy[idx]
+
+    def _live_indices_locked(self) -> tuple[int, ...]:
+        return tuple(i for i in range(len(self.devices))
+                     if self._healthy[i] and i not in self._leased)
+
+    def _mesh_for(self, indices: tuple[int, ...]) -> Mesh:
+        """Cached jax Mesh per membership tuple: jitted programs are keyed
+        by the Mesh, so a stable object per topology keeps the compile
+        count at one per (shape, membership)."""
+        mesh = self._meshes.get(indices)
+        if mesh is None:
+            mesh = Mesh(np.array([self.devices[i] for i in indices]),
+                        (self.axis,))
+            self._meshes[indices] = mesh
+        return mesh
+
+    def live_snapshot(self) -> tuple[Mesh | None, tuple[int, ...]]:
+        """(jax Mesh over the healthy-and-unleased devices, their indices);
+        (None, ()) when everything is dead or leased away."""
+        with self._lock:
+            live = self._live_indices_locked()
+            if not live:
+                return None, ()
+            return self._mesh_for(live), live
+
+    # -- shardings -----------------------------------------------------------
+
+    def batch_sharding(self, mesh: Mesh | None = None) -> NamedSharding:
+        return NamedSharding(mesh if mesh is not None else self.mesh,
+                             P(self.axis))
+
+    def replicated(self, mesh: Mesh | None = None) -> NamedSharding:
+        return NamedSharding(mesh if mesh is not None else self.mesh, P())
+
+    def spec_for(self, lane: str, program: str,
+                 rows: int) -> tuple[P | None, Mesh | None]:
+        """Partition-rule decision for ONE dispatch: (PartitionSpec, Mesh).
+
+        ``P(axis)`` over the live mesh = batch-shard; ``P()`` over a
+        1-device mesh = unpartitioned on the first live device (scalar /
+        sub-threshold requests never pay the scatter). ``(None, None)``
+        when no device is live (caller takes the CPU rung)."""
+        with self._lock:
+            live = self._live_indices_locked()
+        if not live:
+            return None, None
+        kind = match_partition_rule(self.rules, f"{lane}/{program}",
+                                    rows, len(live))
+        if kind == "batch" and len(live) > 1:
+            return P(self.axis), self._mesh_for(live)
+        return P(), self._mesh_for(live[:1])
+
+    # -- health mask (per-device breakers flip these) ------------------------
+
+    def mark_unhealthy(self, idx: int, reason: str = "") -> bool:
+        """Shed one device from the mesh (breaker trip). Returns True when
+        this call shrank the live set. The moment the mesh loses a device
+        is postmortem-worthy: fault_event snapshots the flight recorder."""
+        with self._lock:
+            if not self._healthy[idx]:
+                return False
+            self._healthy[idx] = False
+            self.shrinks += 1
+            left = sum(self._healthy)
+            self._publish_locked()
+        self.metrics.record_shrink()
+        tracing.fault_event("mesh_device_shed", target="parallel::mesh",
+                            device=idx, healthy_left=left,
+                            reason=reason[:200])
+        return True
+
+    def mark_healthy(self, idx: int) -> bool:
+        """Re-admit a device (half-open re-trial / probe success)."""
+        with self._lock:
+            if self._healthy[idx]:
+                return False
+            self._healthy[idx] = True
+            self.recoveries += 1
+            self._publish_locked()
+        self.metrics.record_recovery()
+        tracing.event("parallel::mesh", "mesh_device_recovered", device=idx)
+        return True
+
+    # -- sub-mesh lease -------------------------------------------------------
+
+    def lease_submesh(self, k: int, what: str = "rebuild") -> _SubMeshLease:
+        """Carve ``k`` healthy, unleased devices out for an exclusive
+        claimant (the rebuild pipeline); live lanes keep the rest. Devices
+        are taken from the TAIL of the roster so the live lanes keep the
+        head — stable membership means stable compiled programs for the
+        latency-critical path. Raises MeshExhausted when fewer than
+        ``k + 1`` devices are available (the live side must keep >= 1;
+        callers fall back to the exclusive whole-device lease)."""
+        with self._lock:
+            avail = self._live_indices_locked()
+            if len(avail) < k + 1:
+                raise MeshExhausted(
+                    f"cannot lease {k} of {len(avail)} live devices "
+                    f"(live lanes must keep at least one)")
+            take = avail[-k:]
+            self._leased.update(take)
+            self.submesh_leases += 1
+            mesh = self._mesh_for(take)
+            self._publish_locked()
+        self.metrics.record_submesh_lease()
+        tracing.event("parallel::mesh", "submesh_lease", what=what,
+                      devices=list(take))
+        return _SubMeshLease(self, take, mesh, what)
+
+    def _release_lease(self, lease: _SubMeshLease) -> None:
+        with self._lock:
+            self._leased.difference_update(lease.indices)
+            self._publish_locked()
+        tracing.event("parallel::mesh", "submesh_release", what=lease.what,
+                      devices=list(lease.indices))
+
+    # -- observability --------------------------------------------------------
+
+    def _publish_locked(self) -> None:
+        healthy = sum(self._healthy)
+        self.metrics.set_topology(total=len(self.devices), healthy=healthy,
+                                  leased=len(self._leased))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            healthy = sum(self._healthy)
+            return {
+                "total": len(self.devices),
+                "healthy": healthy,
+                "unhealthy": len(self.devices) - healthy,
+                "leased": len(self._leased),
+                "live": len(self._live_indices_locked()),
+                "shrinks": self.shrinks,
+                "recoveries": self.recoveries,
+                "submesh_leases": self.submesh_leases,
+            }
+
+
+def mesh_tier(n: int, min_tier: int, mult: int,
+              ceiling: int | None = None) -> int:
+    """Batch tier for a mesh dispatch: the x2 ladder from ``min_tier``
+    rounded up to a device-count multiple, optionally clamped to the
+    largest LADDER tier <= ``ceiling`` (never to an off-ladder value — a
+    clamp that isn't itself on the ladder would mint a tier the warm-up
+    menu never declared, or one the mesh can't divide)."""
+    mult = max(1, mult)
+    t = -(-max(1, min_tier) // mult) * mult
+    cap = None
+    if ceiling is not None:
+        cap = t
+        while cap * 2 <= ceiling:
+            cap *= 2
+    while t < n and (cap is None or t < cap):
+        t *= 2
+    if cap is not None and t > cap:
+        t = cap
+    assert t % mult == 0, f"mesh tier {t} not divisible by {mult}"
+    return t
+
+
+class MeshKeccak:
+    """Sharded batch front-end over a :class:`HashMesh` — the mesh
+    analogue of ``ops/keccak_jax.KeccakDevice``. Buckets by block count,
+    pads the batch to a live-device-multiple tier, device_puts with the
+    batch ``NamedSharding``, and runs the SAME jitted masked-absorb
+    program the single-device path uses (XLA specializes per input
+    sharding). Over-ceiling messages share the CPU bucket; un-warm
+    (program, block, batch, mesh_size) shapes route to the CPU twin when
+    a warm-up manager is attached — never a fresh compile mid-commit."""
+
+    MAX_BATCH_TIER = 16384
+    MAX_BLOCK_TIER = 32
+
+    def __init__(self, hash_mesh: HashMesh, min_tier: int = 1024,
+                 block_tier: int = 4, warmup=None):
+        self.hash_mesh = hash_mesh
+        self.min_tier = min_tier
+        self.block_tier = block_tier
+        self.warmup = warmup
+
+    def _bucket_key(self, nb: int) -> int:
+        if nb > self.MAX_BLOCK_TIER:
+            from ..ops.keccak_jax import _CPU_BUCKET
+
+            return _CPU_BUCKET
+        if nb <= self.block_tier:
+            return self.block_tier
+        t = 2 * self.block_tier
+        while t < nb:
+            t *= 2
+        return t
+
+    def hash_sharded(self, msgs: list[bytes], mesh: Mesh) -> list[bytes]:
+        """Hash ``msgs`` with every bucket scattered over ``mesh`` (a live
+        snapshot from the descriptor — pass a 1-device mesh for the
+        unpartitioned route). Digest order matches input order."""
+        from ..primitives.keccak import bucketed_hash
+
+        cap = mesh_tier(1, self.min_tier, mesh.devices.size,
+                        self.MAX_BATCH_TIER)
+        while cap * 2 <= self.MAX_BATCH_TIER:
+            cap *= 2
+        out: list[bytes] = []
+        for lo in range(0, len(msgs), cap):
+            out.extend(bucketed_hash(
+                msgs[lo:lo + cap],
+                lambda sub, key, counts: self._hash_bucket(sub, key, counts,
+                                                           mesh),
+                bucket_key=self._bucket_key))
+        return out
+
+    def _hash_bucket(self, sub: list[bytes], key: int, counts: np.ndarray,
+                     mesh: Mesh) -> np.ndarray:
+        import time as _time
+
+        from ..metrics import compile_tracker
+        from ..ops.keccak_jax import _CPU_BUCKET, KeccakDevice, _to_u32
+        from ..ops.keccak_jax import keccak256_jax_words_masked
+        from ..primitives.keccak import pad_batch
+
+        n = len(sub)
+        ndev = mesh.devices.size
+        batch_tier = mesh_tier(n, self.min_tier, ndev, self.MAX_BATCH_TIER)
+        if key == _CPU_BUCKET:
+            return KeccakDevice._cpu_bucket(sub, counts)
+        if self.warmup is not None and not self.warmup.route_bucket(
+                "keccak.masked", key, batch_tier, ndev):
+            return KeccakDevice._cpu_bucket(sub, counts)
+        words = pad_batch(sub, counts, pad_to_blocks=key)
+        w32 = _to_u32(words, batch_tier)
+        cnt = np.zeros((batch_tier,), dtype=np.int32)
+        cnt[:n] = counts
+        sh = NamedSharding(mesh, P(self.hash_mesh.axis))
+        t0 = _time.perf_counter()
+        digests = keccak256_jax_words_masked(
+            jax.device_put(w32, sh), key,
+            counts=jax.device_put(cnt, sh))
+        out = np.asarray(digests)[:n]  # D2H sync point: wall is honest here
+        compile_tracker.record("keccak.mesh", (key, batch_tier, ndev),
+                               _time.perf_counter() - t0)
+        return out
 
 
 def sharded_keccak(hash_mesh: HashMesh, words: np.ndarray) -> jax.Array:
@@ -81,10 +424,3 @@ def sharded_keccak(hash_mesh: HashMesh, words: np.ndarray) -> jax.Array:
     """
     arr = jax.device_put(jnp.asarray(words), hash_mesh.batch_sharding())
     return hash_mesh._keccak(arr)
-
-
-def multichip_commit_step(hash_mesh: HashMesh, words: np.ndarray) -> jax.Array:
-    """One two-level 4-ary trie-commit step across the mesh (see
-    ``_commit_step``): N sharded leaves → all_gather → N/4 parent digests."""
-    arr = jax.device_put(jnp.asarray(words), hash_mesh.batch_sharding())
-    return hash_mesh._commit(arr)

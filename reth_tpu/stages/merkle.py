@@ -67,6 +67,7 @@ class MerkleStage(Stage):
                 backend=getattr(self.committer, "turbo_backend", "numpy"),
                 supervisor=getattr(self.committer, "supervisor", None),
                 hash_service=getattr(self.committer, "hash_service", None),
+                mesh=getattr(self.committer, "hash_mesh", None),
             )
         return self._turbo
 
@@ -106,7 +107,8 @@ class MerkleStage(Stage):
             return full_state_root_turbo(
                 provider, backend=backend,
                 supervisor=getattr(self.committer, "supervisor", None),
-                hash_service=getattr(self.committer, "hash_service", None))
+                hash_service=getattr(self.committer, "hash_service", None),
+                mesh=getattr(self.committer, "hash_mesh", None))
         except (ValueError, RuntimeError):
             return full_state_root(provider, self.committer)
 

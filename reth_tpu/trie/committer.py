@@ -160,6 +160,13 @@ class TrieCommitter:
         # When set, ``hasher`` is a lane-bound HashClient and ``for_lane``
         # hands call sites their own priority lane.
         self.hash_service = None
+        # --mesh wiring (cli.py): a parallel/mesh.py HashMesh descriptor.
+        # Turbo committers built FROM this committer (stages/merkle.py,
+        # trie/incremental.py) shard their fused level loops over it; a
+        # meshed hash service routes every lane's coalesced dispatches
+        # through its partition-rule table, so the for_lane clients are
+        # mesh-sharded transparently.
+        self.hash_mesh = None
 
     def attach_warmup(self, manager) -> None:
         """Late-bind a warm-up manager (``ops/warmup.py``) to an already-
@@ -176,6 +183,11 @@ class TrieCommitter:
             owner = getattr(h, "__self__", None)  # KeccakDevice.hash_batch
             if owner is not None and hasattr(owner, "warmup"):
                 owner.warmup = manager
+        svc = self.hash_service
+        if svc is not None and getattr(svc, "_mesh_hasher", None) is not None:
+            # meshed service: per-bucket degraded-mode routing applies to
+            # the sharded front-end too (mesh_size-keyed menu slots)
+            svc._mesh_hasher.warmup = manager
 
     def for_lane(self, lane: str) -> "TrieCommitter":
         """Shallow clone whose ``hasher`` is bound to the hash service's

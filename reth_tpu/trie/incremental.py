@@ -354,7 +354,8 @@ def full_state_root(
 
 
 def full_state_root_turbo(provider: DatabaseProvider, backend: str = "device",
-                          supervisor=None, hash_service=None) -> bytes:
+                          supervisor=None, hash_service=None,
+                          mesh=None) -> bytes:
     """Full rebuild on the turbo path: C++ structure sweep + packed/bitmap
     device levels (trie/turbo.py) — zero per-node Python. Same semantics as
     :func:`full_state_root`; raises ``ValueError`` for inputs outside the
@@ -367,7 +368,7 @@ def full_state_root_turbo(provider: DatabaseProvider, backend: str = "device",
     import numpy as np
 
     committer = TurboCommitter(backend=backend, supervisor=supervisor,
-                               hash_service=hash_service)
+                               hash_service=hash_service, mesh=mesh)
     p = provider
     p.clear_trie_tables()
 

@@ -792,15 +792,24 @@ class TurboCommitter:
 
     ``hash_service``: an ``ops/hash_service.py`` HashService — the
     device-touching backends ("device"/"auto") then hold the service's
-    EXCLUSIVE LEASE for each commit (begin → terminal fetch), so a rebuild
-    streams its pre-packed windows at full rate while the service's
-    coalesced lanes pause (aged live-tip requests bypass to the CPU twin).
-    The numpy backend never touches the device and takes no lease."""
+    LEASE for each commit (begin → terminal fetch). On a single-backend
+    service that lease is EXCLUSIVE (coalesced lanes pause; aged live-tip
+    requests bypass to the CPU twin); on a MESHED service it is a
+    SUB-MESH lease — the rebuild claims k of n devices and streams its
+    windows through a ``FusedMeshEngine`` sharded over them while the
+    live/payload/proof lanes keep dispatching on the rest. The numpy
+    backend never touches the device and takes no lease.
+
+    ``mesh``: a ``jax.sharding.Mesh`` or ``parallel/mesh.py`` HashMesh —
+    fused level dispatches then SPMD-shard over it; inherited from the
+    hash service's mesh when not given explicitly."""
 
     def __init__(self, backend: str = "device", min_tier: int = 1024, mesh=None,
                  supervisor=None, hash_service=None):
         self.backend_kind = backend
         self.min_tier = min_tier
+        if mesh is None and hash_service is not None:
+            mesh = getattr(hash_service, "mesh", None)
         self.mesh = mesh
         self.supervisor = supervisor
         self.hash_service = hash_service
@@ -810,6 +819,14 @@ class TurboCommitter:
     def _device_engine(self):
         from ..ops.fused_commit import MegaFusedEngine, FusedMeshEngine
 
+        svc = self.hash_service
+        if svc is not None and getattr(svc, "rebuild_mesh", None) is not None:
+            sub = svc.rebuild_mesh()
+            if sub is not None:
+                # sub-mesh lease held: this commit's shardings form over
+                # the k devices the lease carved out; live lanes keep the
+                # rest of the mesh
+                return FusedMeshEngine(sub, min_tier=self.min_tier)
         if self.mesh is not None:
             return FusedMeshEngine(self.mesh, min_tier=self.min_tier)
         # single-chip: whole-commit staging — one H2D, one program, one D2H
@@ -819,19 +836,24 @@ class TurboCommitter:
     def _make_backend(self):
         if self.backend_kind == "numpy":
             return _NumpyBackend(arena=self.arena)
-        if self.backend_kind == "auto":
-            from ..ops.supervisor import DeviceSupervisor, SupervisedBackend
 
-            sup = self.supervisor or DeviceSupervisor.shared()
-            backend = SupervisedBackend(sup, self._device_engine,
-                                        arena=self.arena)
-        else:
-            backend = self._device_engine()
+        def build():
+            if self.backend_kind == "auto":
+                from ..ops.supervisor import (DeviceSupervisor,
+                                              SupervisedBackend)
+
+                sup = self.supervisor or DeviceSupervisor.shared()
+                return SupervisedBackend(sup, self._device_engine,
+                                         arena=self.arena)
+            return self._device_engine()
+
         if self.hash_service is not None:
-            # shared-service discipline: this commit owns the device via
-            # the exclusive lease instead of grabbing it unilaterally
-            backend = self.hash_service.lease_backend(backend)
-        return backend
+            # shared-service discipline: this commit owns its devices via
+            # the (sub-mesh) lease instead of grabbing them unilaterally.
+            # Construction is DEFERRED so the engine's shardings form over
+            # the sub-mesh the lease carves out at begin().
+            return self.hash_service.lease_backend(factory=build)
+        return build()
 
     def commit_hashed_many(
         self,

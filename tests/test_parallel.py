@@ -1,6 +1,7 @@
 """Multi-chip sharding tests on the virtual 8-device CPU mesh."""
 
 import numpy as np
+import pytest
 
 from reth_tpu.primitives.keccak import keccak256, pad_batch
 
@@ -23,9 +24,18 @@ def test_graft_entry_single():
     assert out[0].tobytes() == keccak256(msg0)
 
 
-def test_dryrun_multichip_8():
+@pytest.mark.slow
+def test_dryrun_multichip_8(monkeypatch):
+    """(make test-mesh: two subprocess jax inits put this past the tier-1
+    budget; the driver runs the same path itself for MULTICHIP capture.)"""
     import __graft_entry__ as g
 
+    # test-sized workload: the dryrun's env defaults (4000 accounts) are
+    # the driver's MULTICHIP capture; here we only pin the plumbing — the
+    # bench mesh mode's own root-parity assertion still runs in full
+    monkeypatch.setenv("RETH_TPU_BENCH_MESH_ACCOUNTS", "400")
+    monkeypatch.setenv("RETH_TPU_BENCH_MESH_SLOTS", "150")
+    monkeypatch.setenv("RETH_TPU_BENCH_MESH_TIER", "128")
     g.dryrun_multichip(8)
 
 

@@ -94,17 +94,35 @@ def test_default_menu_grid():
     assert len(keys) == len(set(keys))
     # batch ladder for trie-node-sized messages
     for t in (1024, 2048, 4096, 8192, 16384):
-        assert ("keccak.masked", 4, t) in keys
+        assert ("keccak.masked", 4, t, 1) in keys
     # block ladder for large messages at the base tier
     for bt in (8, 16, 32):
-        assert ("keccak.masked", bt, 1024) in keys
+        assert ("keccak.masked", bt, 1024, 1) in keys
     # fused level-commit programs
-    assert ("fused.plain", 4, 1024) in keys
-    assert ("fused.splice", 4, 1024) in keys
+    assert ("fused.plain", 4, 1024, 1) in keys
+    assert ("fused.splice", 4, 1024, 1) in keys
     # ceilings respected
     assert all(s.batch_tier <= 16384 and s.block_tier <= 32 for s in menu)
     assert default_menu(include_fused=False) == [
         s for s in menu if not s.program.startswith("fused")]
+
+
+def test_default_menu_mesh_variants():
+    """mesh_sizes adds SPMD menu slots whose tiers sit on the
+    device-count-multiple ladder (what MeshKeccak/FusedMeshEngine mint)."""
+    menu = default_menu(min_tier=1024, mesh_sizes=(8,))
+    keys = [s.key() for s in menu]
+    assert len(keys) == len(set(keys))
+    for t in (1024, 2048, 4096, 8192, 16384):
+        assert ("keccak.masked", 4, t, 8) in keys
+    assert ("fused.plain", 4, 1024, 8) in keys
+    assert ("fused.splice", 4, 1024, 8) in keys
+    # a non-pow2 mesh rounds the floor up to a device-count multiple
+    menu6 = default_menu(min_tier=1024, mesh_sizes=(6,))
+    mesh6 = [s for s in menu6 if s.mesh_size == 6]
+    assert mesh6 and all(s.batch_tier % 6 == 0 for s in mesh6)
+    assert ("fused.plain", 4, 1026, 6) in [s.key() for s in mesh6]
+    assert str(mesh6[0]).endswith("@m6")
 
 
 def test_next_tier_clamps_to_menu_ceiling():
@@ -228,7 +246,7 @@ def test_degraded_routing_while_warming():
     assert mgr.cpu_routed == 1
     # per-shape promotion: ONE shape warming routes ITS buckets to the
     # device while the sibling still serves on the CPU twin
-    mgr.states[("keccak.masked", 4, 8)] = WARM
+    mgr.states[("keccak.masked", 4, 8, 1)] = WARM
     assert mgr.route_bucket("keccak.masked", 4, 8)
     assert not mgr.route_bucket("keccak.masked", 8, 8)
     assert mgr.cpu_routed == 2
@@ -278,7 +296,7 @@ def test_compile_wedge_forever_trips_breaker_and_degrades():
     assert sup.warmup is mgr  # attached at construction
     snap = mgr.run()
     assert snap["state"] == "degraded" and snap["failed"] == 1
-    assert mgr.states[("keccak.masked", 4, 8)] == FAILED
+    assert mgr.states[("keccak.masked", 4, 8, 1)] == FAILED
     assert breaker.state == OPEN  # wedges fed the breaker
     assert not mgr.device_ready()
     assert not sup.warmup_allows_device()
@@ -304,7 +322,7 @@ def test_promotion_after_fault_clears_via_half_open_probe():
             break
         time.sleep(0.01)
     assert mgr.device_ready()
-    assert mgr.states[("keccak.masked", 4, 8)] == WARM
+    assert mgr.states[("keccak.masked", 4, 8, 1)] == WARM
     assert breaker.state == CLOSED
     assert sup.warmup_allows_device()
 
@@ -320,7 +338,7 @@ def test_breaker_open_defers_without_burning_attempts():
                builder=builder)
     snap = mgr.run()
     assert snap["state"] == "degraded"
-    assert mgr.states[("keccak.masked", 4, 8)] == FAILED
+    assert mgr.states[("keccak.masked", 4, 8, 1)] == FAILED
     assert mgr.wedges == 0  # deferred, not wedged
 
 
@@ -329,7 +347,7 @@ def test_retry_failed_reentrancy_guard():
     mgr = _mgr(menu=[MenuShape("keccak.masked", 4, 8)],
                builder=calls.append, attempts=1)
     mgr._active = True
-    mgr.states[("keccak.masked", 4, 8)] = FAILED
+    mgr.states[("keccak.masked", 4, 8, 1)] = FAILED
     with mgr._lock:
         mgr._retrying = True
     assert mgr.retry_failed() == 0  # guarded
@@ -365,7 +383,7 @@ def test_keccak_device_degraded_buckets_bit_identical():
     assert dev.hash_batch(msgs) == expect
     assert mgr.cpu_routed >= 1
     routed = mgr.cpu_routed
-    mgr.states[("keccak.masked", 4, 8)] = WARM  # promoted mid-warm-up
+    mgr.states[("keccak.masked", 4, 8, 1)] = WARM  # promoted mid-warm-up
     assert dev.hash_batch(msgs) == expect
     assert mgr.cpu_routed == routed  # warm shape went to the device
 
