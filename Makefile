@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-chaos native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -107,6 +107,20 @@ test-mesh:
 test-warmup:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_warmup.py -q -p no:cacheprovider
+
+# crash-safe persistence + chaos drills: WAL format/replay/checkpoint
+# units, corrupt-image quarantine, reorg-across-restart, and the @slow
+# subprocess matrix — kill -9 at EVERY declared crash point
+# (RETH_TPU_FAULT_CRASH_AT), raw SIGKILL mid-mining, the 10-seed
+# composed-injector campaign (seeds printed on failure for exact replay
+# via `python -m reth_tpu.chaos scenario --seed N`), and the
+# deliberately-broken torn-record-accepted drill proving the invariant
+# suite can fail. Kill drills are `-m slow` so tier-1 keeps its budget;
+# this target runs everything — CPU-only, no device required
+test-chaos:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_wal_recovery.py tests/test_chaos.py \
+	  -q -p no:cacheprovider
 
 # overlapped rebuild pipeline: parity vs the serial committer, packing,
 # arena residency, abort/failover drills, chunked-resume — fast, CPU-only

@@ -1,0 +1,661 @@
+"""Chaos drill engine: crash points, composed fault scenarios, invariants.
+
+Reference analogue: reth proves its persistence thread + startup
+invariants with kill-and-restart integration drills; the Reddio paper's
+pipelined-execution failure modes (arxiv 2503.04595) arrive as
+*compositions* — a stalled service AND a shed storm AND a process kill
+— never one injector at a time. Ten PRs of this repo built fault
+injectors (``RETH_TPU_FAULT_*``) that had each only ever been drilled
+alone. This module is the harness that composes them and adds the one
+fault no injector could express: ungraceful death.
+
+Two layers:
+
+- **Crash points** (:func:`crash_point`): named ``os._exit`` sites in
+  the durability-critical windows — ``RETH_TPU_FAULT_CRASH_AT=
+  <point>[:nth]`` kills the process the *nth* time that point is
+  reached. Declared points (:data:`CRASH_POINTS`): after a WAL record
+  is fsync'd but before the in-memory publish (``wal-append``), between
+  the checkpoint's image swap and its manifest/truncation
+  (``checkpoint-swap``), between the persistence commit and the
+  in-memory bookkeeping (``advance-persistence``), mid-unwind between
+  the pipeline unwind and the canonical-header surgery (``unwind``),
+  and before a static-file jar's atomic rename (``jar-rename``).
+- **Scenario orchestrator**: seeded compositions of the existing
+  injectors + a kill (crash point or external ``SIGKILL``) against a
+  subprocess dev node, then a restart that must satisfy the declared
+  invariant suite: recovered head consistent and at most
+  ``persistence_threshold`` blocks behind the last mined block, the
+  recovered state root bit-identical both to recomputation through the
+  committer and to a fault-free twin replaying the same recorded
+  blocks, ``/health`` back to ``ok`` within the SLO window, and the
+  node live (mines again, no leaked hash-service lease). Every scenario
+  prints its seed; ``python -m reth_tpu.chaos scenario --seed N``
+  replays one exactly.
+
+The module stays import-light: storage (wal.py, kv.py, nippyjar.py) and
+the engine tree import :func:`crash_point` at module load; everything
+heavy is imported inside the child/orchestrator entry points.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+import zlib
+from pathlib import Path
+
+CRASH_POINTS = (
+    "wal-append",          # record fsync'd, publish pending (storage/wal.py)
+    "checkpoint-swap",     # image swapped, manifest/truncate pending
+    "advance-persistence", # persistence committed, tree bookkeeping pending
+    "unwind",              # pipeline unwound, canonical surgery pending
+    "jar-rename",          # jar bytes fsync'd, atomic rename pending
+)
+
+_hits: dict[str, int] = {}
+
+
+def reset_crash_counts() -> None:
+    """Test hook: forget per-point hit counters (they are process-wide)."""
+    _hits.clear()
+
+
+def crash_spec() -> tuple[str, int] | None:
+    """Parse ``RETH_TPU_FAULT_CRASH_AT=<point>[:nth]`` (nth default 1)."""
+    spec = os.environ.get("RETH_TPU_FAULT_CRASH_AT", "")
+    if not spec:
+        return None
+    name, _, nth = spec.partition(":")
+    try:
+        return name, max(1, int(nth or 1))
+    except ValueError:
+        return name, 1
+
+
+def crash_point(point: str) -> None:
+    """Die here (``os._exit(137)``) when the drill says so.
+
+    A real crash flushes nothing and runs no handlers — ``os._exit``
+    is the honest simulation of ``kill -9`` at an exact code location.
+    """
+    spec = crash_spec()
+    if spec is None or spec[0] != point:
+        return
+    _hits[point] = _hits.get(point, 0) + 1
+    if _hits[point] != spec[1]:
+        return
+    try:  # flight-record the drill like every other injector, best-effort
+        from . import tracing
+
+        tracing.fault_event("RETH_TPU_FAULT_CRASH_AT", target="chaos",
+                            point=point, nth=spec[1])
+    except Exception:  # noqa: BLE001 - dying is the point
+        pass
+    sys.stderr.write(f"chaos: crash point {point!r} firing (os._exit)\n")
+    sys.stderr.flush()
+    os._exit(137)
+
+
+# -- scenario vocabulary ------------------------------------------------------
+
+# injector menu: every env-driven fault the repo ships that is
+# meaningful on a CPU dev node (device/compile wedges need the device
+# supervisor path and are drilled by test_supervisor/test_warmup).
+# Values are deliberately mild — the node must LIMP, not halt, so the
+# kill lands on a degraded-but-serving process, which is how real
+# incidents arrive.
+FAULT_MENU: tuple[dict, ...] = (
+    {"RETH_TPU_FAULT_SPARSE_ABORT": "2"},        # sparse finish -> fallback
+    {"RETH_TPU_FAULT_SPARSE_PROOF_WEDGE": "1"},  # proof shard wedge
+    {"RETH_TPU_FAULT_GATEWAY_STALL": "0.02"},    # slow every admission
+    {"RETH_TPU_FAULT_GATEWAY_SHED": "5"},        # shed every 5th request
+    {"RETH_TPU_FAULT_EXEC_CONFLICT_STORM": "1"}, # all-conflict scheduling
+    {"RETH_TPU_FAULT_SERVICE_STALL": "0.02"},    # hash-service dispatch stall
+    {"RETH_TPU_FAULT_SLO_BREACH": "all"},        # force every SLO rule red
+)
+
+
+def make_scenario(seed: int) -> dict:
+    """Deterministic scenario from one seed: a fault composition plus a
+    kill (crash point or external SIGKILL mid-mining)."""
+    import random
+
+    rng = random.Random(seed)
+    faults: dict[str, str] = {}
+    for f in rng.sample(FAULT_MENU, k=rng.randint(1, 3)):
+        faults.update(f)
+    blocks = rng.randint(8, 13)
+    if rng.random() < 0.5:
+        point = rng.choice(CRASH_POINTS)
+        nth = {
+            # every commit appends: land the crash mid-chain, not at genesis
+            "wal-append": rng.randint(6, 3 * blocks),
+            "checkpoint-swap": rng.randint(1, 3),
+            "advance-persistence": rng.randint(2, blocks - 2),
+            "unwind": 1,
+            "jar-rename": rng.randint(1, 3),
+        }[point]
+        scn = {"mode": "point", "point": point, "nth": nth}
+    else:
+        scn = {"mode": "kill", "kill_after": rng.randint(4, blocks - 1)}
+    scn.update({
+        "seed": seed,
+        "faults": faults,
+        "blocks": blocks,
+        # the unwind point needs a deep reorg to reach _unwind_persisted_to
+        "reorg_at": (rng.randint(5, blocks - 1)
+                     if scn.get("point") == "unwind" or rng.random() < 0.25
+                     else 0),
+        "threshold": 2,
+        # hash service on for some scenarios so SERVICE_* faults bite
+        "hash_service": rng.random() < 0.5
+        or "RETH_TPU_FAULT_SERVICE_STALL" in faults,
+    })
+    return scn
+
+
+# -- child processes ----------------------------------------------------------
+
+
+def _cpu_committer():
+    from .primitives.keccak import keccak256_batch_np
+    from .trie.committer import TrieCommitter
+
+    committer = TrieCommitter(hasher=keccak256_batch_np)
+    committer.turbo_backend = "numpy"
+    return committer
+
+
+def _build_node(datadir: Path, seed: int, threshold: int,
+                hash_service: bool, fresh: bool):
+    """A dev node over memdb+WAL, deterministic genesis derived from the
+    seed — victim and recover children build the identical config."""
+    from .node import Node, NodeConfig
+    from .primitives.types import Account
+    from .testing import ChainBuilder, Wallet
+
+    committer = _cpu_committer()
+    if hash_service:
+        from .ops.hash_service import HashService
+
+        committer.hash_service = HashService(backend=committer.hasher)
+        committer.hasher = committer.hash_service.client("live")
+    wallet = Wallet(0xA11CE + seed)
+    builder = ChainBuilder({wallet.address: Account(balance=10**21)},
+                           committer=committer)
+    cfg = NodeConfig(
+        dev=True, datadir=datadir, db_backend="memdb",
+        genesis_header=builder.genesis if fresh else None,
+        genesis_alloc=builder.accounts_at_genesis if fresh else {},
+        persistence_threshold=threshold,
+        wal=True, wal_checkpoint_blocks=3,
+        static_file_distance=2,
+        rpc_gateway=True,
+        health=True, slo_interval=0.2, slo_window=120,
+        http_port=0, authrpc_port=0,
+    )
+    return Node(cfg, committer=committer), wallet, builder
+
+
+def _record_path(datadir: Path) -> Path:
+    return Path(datadir) / "chaos_blocks.jsonl"
+
+
+def child_victim(datadir: str, seed: int, blocks: int, threshold: int = 2,
+                 reorg_at: int = 0, hash_service: bool = False) -> int:
+    """Mine deterministic blocks until done (or until a crash point /
+    the parent's SIGKILL ends us), recording every sealed block's RLP so
+    the recover child can bound the loss and replay a fault-free twin."""
+    datadir = Path(datadir)
+    node, wallet, _ = _build_node(datadir, seed, threshold,
+                                  hash_service, fresh=True)
+    http_port, _ = node.start_rpc()
+    rec = open(_record_path(datadir), "a")
+    sink = b"\x0b" * 20
+    i = 0
+    while blocks <= 0 or i < blocks:
+        i += 1
+        if reorg_at and i == reorg_at:
+            # deep reorg: FCU to a persisted ancestor -> the persisted
+            # chain unwinds (crash point "unwind" lives in that window).
+            # Record the INTENT first — a crash mid-unwind legitimately
+            # recovers to the reorg target, and the invariant suite can
+            # only allow that if the record file says it was coming.
+            with node.factory.provider() as p:
+                target = max(0, node.tree.persisted_number - 1)
+                old = p.canonical_hash(target)
+            rec.write(json.dumps({"reorg_to": target}) + "\n")
+            rec.flush()
+            node.tree.on_forkchoice_updated(old)
+        node.pool.add_transaction(wallet.transfer(sink, 100 + i))
+        blk = node.miner.mine_block(timestamp=1_700_000_000 + i * 12)
+        rec.write(json.dumps({
+            "n": blk.header.number, "hash": blk.hash.hex(),
+            "root": blk.header.state_root.hex(), "rlp": blk.encode().hex(),
+        }) + "\n")
+        rec.flush()
+        # a little read traffic so gateway-class injectors actually fire
+        try:
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{http_port}/",
+                data=json.dumps({"jsonrpc": "2.0", "id": 1,
+                                 "method": "eth_blockNumber",
+                                 "params": []}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=5).read()
+        except Exception:  # noqa: BLE001 - shed drills reply -32005/queue full
+            pass
+    node.stop()
+    return 0
+
+
+def _read_record(datadir: Path) -> list[dict]:
+    path = _record_path(datadir)
+    if not path.exists():
+        return []
+    out = []
+    for line in path.read_text().splitlines():
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:  # torn tail of the record file itself
+            break
+    return out
+
+
+def _twin_root(recorded: list[dict], head_hash: bytes, seed: int):
+    """Replay the recorded chain (fault-free, ephemeral) up to exactly
+    ``head_hash``; returns (state_root, head_number) recomputed from the
+    twin's own persisted tables."""
+    from .engine import EngineTree
+    from .primitives.types import Account, Block
+    from .storage import MemDb, ProviderFactory
+    from .storage.genesis import init_genesis
+    from .testing import ChainBuilder, Wallet
+    from .trie.incremental import verify_state_root
+
+    committer = _cpu_committer()
+    wallet = Wallet(0xA11CE + seed)
+    builder = ChainBuilder({wallet.address: Account(balance=10**21)},
+                           committer=committer)
+    by_hash = {}
+    for line in recorded:
+        if "hash" in line:
+            by_hash[bytes.fromhex(line["hash"])] = \
+                Block.decode(bytes.fromhex(line["rlp"]))
+    chain = []
+    h = head_hash
+    while h != builder.genesis.hash:
+        blk = by_hash.get(h)
+        if blk is None:
+            return None, None  # recovered head not on the recorded chain
+        chain.append(blk)
+        h = blk.header.parent_hash
+    chain.reverse()
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 committer=committer)
+    tree = EngineTree(factory, committer=committer, persistence_threshold=0)
+    for blk in chain:
+        st = tree.on_new_payload(blk)
+        if st.status.value != "VALID":
+            return None, None
+        tree.on_forkchoice_updated(blk.hash)
+    root, problems = verify_state_root(factory.provider(), committer)
+    return (root if not problems else None), tree.persisted_number
+
+
+def child_recover(datadir: str, seed: int, threshold: int = 2,
+                  hash_service: bool = False,
+                  health_window_s: float = 15.0) -> int:
+    """Restart over the crashed datadir and check the invariant suite.
+
+    Prints one ``RESULT {...}`` JSON line; exit 0 iff every invariant
+    held.
+    """
+    import urllib.request
+
+    from .trie.incremental import verify_state_root
+
+    datadir = Path(datadir)
+    recorded = _read_record(datadir)
+    mined = [l for l in recorded if "hash" in l]
+    t0 = time.time()
+    inv: dict[str, object] = {}
+    result: dict[str, object] = {"seed": seed, "invariants": inv}
+    try:
+        node, wallet, _ = _build_node(datadir, seed, threshold,
+                                      hash_service, fresh=True)
+    except Exception as e:  # noqa: BLE001 - a refused startup fails the suite
+        result["ok"] = False
+        result["error"] = f"restart refused: {type(e).__name__}: {e}"
+        print("RESULT " + json.dumps(result))
+        return 1
+    try:
+        result["recovery_report"] = node.recovery
+        head_n = node.tree.persisted_number
+        head_h = node.tree.persisted_hash
+        result["recovered"] = {"number": head_n,
+                               "hash": head_h.hex() if head_h else None}
+        with node.factory.provider() as p:
+            head_header = p.header_by_number(head_n)
+
+        # 1. consistent head: startup recovery itself reported ok-or-
+        # degraded (degraded = it healed something), never failed
+        rep = node.recovery or {}
+        inv["head_consistent"] = (rep.get("status") in ("ok", "degraded")
+                                  and head_header is not None
+                                  and head_header.hash == head_h)
+
+        # 2. bounded loss: at most `threshold` blocks behind the last
+        # RECORDED block (each record line is written only after its FCU
+        # returned, so its persistence boundary had advanced; a recorded
+        # deep reorg legitimately lowers the floor), and the recovered
+        # head must BE a recorded block at that height
+        if mined:
+            by_height: dict[int, set] = {}
+            floor = 0
+            for l in recorded:
+                if "reorg_to" in l:
+                    floor = min(floor, l["reorg_to"])
+                elif "hash" in l:
+                    by_height.setdefault(l["n"], set()).add(l["hash"])
+                    floor = max(floor, l["n"] - threshold)
+            inv["loss_bound"] = (head_n >= floor
+                                 and (head_n == 0
+                                      or head_h.hex() in by_height.get(head_n, ())))
+        else:
+            inv["loss_bound"] = head_n == 0
+
+        # 3. recovered state root bit-identical to recomputation through
+        # the committer (READ-ONLY full verify over the hashed tables);
+        # a verifier CRASH on corrupt rows is a failed invariant, not a
+        # failed harness
+        try:
+            root, problems = verify_state_root(node.factory.provider(),
+                                               node.committer)
+            inv["root_recomputed"] = (head_header is not None
+                                      and root == head_header.state_root
+                                      and not problems)
+            if problems:
+                result["root_problems"] = problems[:5]
+        except Exception as e:  # noqa: BLE001
+            inv["root_recomputed"] = False
+            result["root_problems"] = [f"verifier crashed: {e}"]
+
+        # 4. bit-identical to a fault-free twin replaying the same blocks
+        try:
+            if head_n > 0:
+                twin_root, twin_n = _twin_root(recorded, head_h, seed)
+                inv["twin_root"] = (twin_root == head_header.state_root
+                                    and twin_n == head_n)
+            else:
+                inv["twin_root"] = True
+        except Exception as e:  # noqa: BLE001
+            inv["twin_root"] = False
+            result["twin_error"] = str(e)
+
+        # 5. /health returns to ok within the SLO window
+        http_port, _ = node.start_rpc()
+        deadline = time.time() + health_window_s
+        status = None
+        while time.time() < deadline:
+            try:
+                raw = urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/health", timeout=5).read()
+                status = json.loads(raw).get("status")
+                if status == "ok":
+                    break
+            except Exception:  # noqa: BLE001 - 503 while failing
+                pass
+            time.sleep(0.25)
+        inv["health_ok"] = status == "ok"
+        result["health_status"] = status
+
+        # 6. liveness: the node mines again on top of the recovered head
+        # (wallet nonce continues from recovered state), and no lease
+        # leaked across the crash
+        try:
+            with node.factory.provider() as p:
+                acct = p.account(wallet.address)
+            wallet.nonce = acct.nonce if acct is not None else 0
+            node.pool.add_transaction(wallet.transfer(b"\x0c" * 20, 7))
+            blk = node.miner.mine_block(timestamp=1_800_000_000)
+            inv["liveness"] = blk.header.number == head_n + 1
+        except Exception as e:  # noqa: BLE001 - a wedged node fails here
+            inv["liveness"] = False
+            result["liveness_error"] = str(e)
+        svc = getattr(node.committer, "hash_service", None)
+        inv["no_leaked_lease"] = (svc is None
+                                  or not svc.snapshot().get("leased_by"))
+    finally:
+        try:
+            node.stop()
+        except Exception:  # noqa: BLE001 - verdict beats a clean exit
+            pass
+    result["ok"] = all(v is True for v in inv.values())
+    result["wall_s"] = round(time.time() - t0, 2)
+    print("RESULT " + json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+# -- orchestrator -------------------------------------------------------------
+
+
+def _child_cmd(mode: str, datadir: Path, scn: dict) -> list[str]:
+    cmd = [sys.executable, "-m", "reth_tpu.chaos", mode,
+           "--datadir", str(datadir), "--seed", str(scn["seed"]),
+           "--threshold", str(scn["threshold"])]
+    if scn.get("hash_service"):
+        cmd.append("--hash-service")
+    if mode == "victim":
+        cmd += ["--blocks", str(scn["blocks"]),
+                "--reorg-at", str(scn.get("reorg_at", 0))]
+    return cmd
+
+
+def _child_env(extra: dict | None = None) -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("RETH_TPU_FAULT_")}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra or {})
+    return env
+
+
+def run_scenario(scn: dict, base_dir: str | Path,
+                 timeout: float = 240.0) -> dict:
+    """One drill: victim under composed faults + kill, then recover."""
+    datadir = Path(base_dir) / f"scn-{scn['seed']}"
+    datadir.mkdir(parents=True, exist_ok=True)
+    result = dict(scn)
+    env = _child_env(scn["faults"])
+    cmd = _child_cmd("victim", datadir, scn)
+    log_path = datadir / "victim.log"
+
+    def _log_tail() -> str:
+        try:
+            return log_path.read_text()[-400:]
+        except OSError:
+            return ""
+
+    log = open(log_path, "w")
+    try:
+        if scn["mode"] == "point":
+            env["RETH_TPU_FAULT_CRASH_AT"] = f"{scn['point']}:{scn['nth']}"
+            # mine until the point fires; cap so a mis-aimed nth still ends
+            cmd[cmd.index("--blocks") + 1] = str(scn["blocks"] + 20)
+            proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                result.update(ok=False, error="victim timeout")
+                return result
+            result["victim_rc"] = proc.returncode
+            if proc.returncode != 137:
+                result.update(ok=False,
+                              error=f"crash point never fired "
+                                    f"(rc={proc.returncode}): {_log_tail()}")
+                return result
+        else:
+            cmd[cmd.index("--blocks") + 1] = "0"  # mine until killed
+            proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+            rec = _record_path(datadir)
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    result.update(ok=False,
+                                  error=f"victim died early "
+                                        f"rc={proc.returncode}: {_log_tail()}")
+                    return result
+                lines = len(_read_record(datadir)) if rec.exists() else 0
+                if lines >= scn["kill_after"]:
+                    break
+                time.sleep(0.1)
+            else:
+                proc.kill()
+                proc.wait()
+                result.update(ok=False,
+                              error="victim never reached kill depth")
+                return result
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()
+            result["victim_rc"] = -9
+    finally:
+        log.close()
+    result["blocks_recorded"] = len([l for l in _read_record(datadir)
+                                     if "hash" in l])
+    rproc = subprocess.run(_child_cmd("recover", datadir, scn),
+                           env=_child_env(), capture_output=True, text=True,
+                           timeout=timeout)
+    verdict = None
+    for line in rproc.stdout.splitlines():
+        if line.startswith("RESULT "):
+            verdict = json.loads(line[len("RESULT "):])
+    if verdict is None:
+        result.update(ok=False,
+                      error=f"recover child emitted no verdict "
+                            f"(rc={rproc.returncode}): {rproc.stderr[-400:]}")
+        return result
+    result.update(verdict)
+    return result
+
+
+def run_campaign(seeds, base_dir: str | Path) -> list[dict]:
+    results = []
+    for seed in seeds:
+        scn = make_scenario(int(seed))
+        t0 = time.time()
+        res = run_scenario(scn, base_dir)
+        res["scenario_wall_s"] = round(time.time() - t0, 1)
+        tag = "ok" if res.get("ok") else "FAIL"
+        kill = (f"point={scn.get('point')}:{scn.get('nth')}"
+                if scn["mode"] == "point"
+                else f"kill_after={scn['kill_after']}")
+        print(f"chaos seed={seed} {tag} {kill} faults={sorted(scn['faults'])} "
+              f"blocks={res.get('blocks_recorded')} "
+              f"recovered={res.get('recovered', {}).get('number')} "
+              f"wall={res['scenario_wall_s']}s", flush=True)
+        if not res.get("ok"):
+            print(f"  replay: python -m reth_tpu.chaos scenario --seed {seed}"
+                  f"  ({res.get('error') or res.get('invariants')})",
+                  flush=True)
+        results.append(res)
+    return results
+
+
+# -- WAL corruption helper (negative drill + tests) ---------------------------
+
+
+def inject_bad_crc_record(wal_dir: str | Path, delta: dict) -> None:
+    """Append a record whose CRC is deliberately wrong to the newest WAL
+    segment — the bit-rot shape. A correct reader discards it as a torn
+    tail; the ``RETH_TPU_FAULT_WAL_ACCEPT_TORN`` broken reader applies
+    it, and the chaos invariant suite must then catch the corruption
+    (proving the harness can fail)."""
+    import pickle
+
+    segs = sorted(Path(wal_dir).glob("*.wal"))
+    if not segs:
+        raise FileNotFoundError(f"no WAL segments under {wal_dir}")
+    payload = pickle.dumps({"seq": 1 << 40, "tables": delta},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    bad_crc = (zlib.crc32(payload) ^ 0xDEADBEEF) & 0xFFFFFFFF
+    with open(segs[-1], "ab") as f:
+        f.write(struct.pack("<II", len(payload), bad_crc) + payload)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m reth_tpu.chaos",
+        description="chaos drill engine: crash points + composed fault "
+                    "scenarios over subprocess dev nodes")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    pv = sub.add_parser("victim", help="(child) mine under faults until "
+                                       "crashed or killed")
+    pv.add_argument("--datadir", required=True)
+    pv.add_argument("--seed", type=int, required=True)
+    pv.add_argument("--blocks", type=int, default=10,
+                    help="0 = mine until killed")
+    pv.add_argument("--threshold", type=int, default=2)
+    pv.add_argument("--reorg-at", dest="reorg_at", type=int, default=0)
+    pv.add_argument("--hash-service", dest="hash_service",
+                    action="store_true")
+
+    pr = sub.add_parser("recover", help="(child) restart + invariant suite")
+    pr.add_argument("--datadir", required=True)
+    pr.add_argument("--seed", type=int, required=True)
+    pr.add_argument("--threshold", type=int, default=2)
+    pr.add_argument("--hash-service", dest="hash_service",
+                    action="store_true")
+
+    ps = sub.add_parser("scenario", help="run one seeded scenario")
+    ps.add_argument("--seed", type=int, required=True)
+    ps.add_argument("--base", default=None)
+
+    pc = sub.add_parser("campaign", help="run a seeded scenario matrix")
+    pc.add_argument("--seeds", default="1,2,3,4,5,6,7,8,9,10",
+                    help="comma list, or N for range(1, N+1)")
+    pc.add_argument("--base", default=None)
+
+    args = parser.parse_args(argv)
+    if args.command == "victim":
+        return child_victim(args.datadir, args.seed, args.blocks,
+                            args.threshold, args.reorg_at, args.hash_service)
+    if args.command == "recover":
+        return child_recover(args.datadir, args.seed, args.threshold,
+                             args.hash_service)
+    import tempfile
+
+    base = args.base or tempfile.mkdtemp(prefix="reth-tpu-chaos-")
+    if args.command == "scenario":
+        res = run_scenario(make_scenario(args.seed), base)
+        print(json.dumps(res, indent=2, default=str))
+        return 0 if res.get("ok") else 1
+    seeds = ([int(s) for s in args.seeds.split(",")]
+             if "," in args.seeds else list(range(1, int(args.seeds) + 1)))
+    results = run_campaign(seeds, base)
+    bad = [r for r in results if not r.get("ok")]
+    print(f"chaos campaign: {len(results) - len(bad)}/{len(results)} passed")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,20 @@ def _resolve_warmup(args) -> tuple[str, str | None]:
     return mode, cache_dir
 
 
+def _resolve_wal(args) -> bool:
+    """memdb write-ahead log: --wal/--no-wal beats RETH_TPU_WAL beats
+    the on-by-default (storage/wal.py no-ops for non-memdb engines)."""
+    import os
+
+    flag = getattr(args, "wal", None)
+    if flag is not None:
+        return flag
+    env = os.environ.get("RETH_TPU_WAL")
+    if env is not None:
+        return env not in ("", "0")
+    return True
+
+
 def _resolve_mesh(args) -> int:
     """Device-mesh width: --mesh beats RETH_TPU_MESH beats [node]
     mesh_devices (reth.toml); 0/1 = the mesh layer stays off."""
@@ -405,6 +419,11 @@ def cmd_node(args):
                      health=getattr(args, "health", False),
                      slo_interval=getattr(args, "slo_interval", 1.0),
                      slo_window=getattr(args, "slo_window", 300),
+                     wal=_resolve_wal(args),
+                     wal_checkpoint_blocks=getattr(
+                         args, "wal_checkpoint_blocks", 8),
+                     recovery_verify_root=getattr(
+                         args, "recovery_verify_root", True),
                      # --trace-blocks; unset falls back to RETH_TPU_TRACE
                      trace_blocks=(args.trace_blocks
                                    if getattr(args, "trace_blocks", None)
@@ -1202,6 +1221,29 @@ def main(argv=None) -> int:
                    help="retained ring-buffer samples per metric series "
                         "(default 300 = 5 min at 1 Hz; also "
                         "RETH_TPU_SLO_WINDOW / [node] slo_window)")
+    p.add_argument("--wal", dest="wal", action="store_true", default=None,
+                   help="write-ahead log for the memdb store (default ON "
+                        "with a datadir): every commit fsync-appends its "
+                        "table delta to <datadir>/wal/<gen>.wal before "
+                        "publish, checkpoints (image + fsync'd manifest) "
+                        "truncate the log — a kill -9 loses at most "
+                        "persistence_threshold blocks. Also [node] wal / "
+                        "RETH_TPU_WAL; the native/paged engines carry "
+                        "their own durability")
+    p.add_argument("--no-wal", dest="wal", action="store_false",
+                   help="disable the memdb write-ahead log (durability "
+                        "falls back to image flushes at each persistence "
+                        "advance)")
+    p.add_argument("--wal-checkpoint-blocks", dest="wal_checkpoint_blocks",
+                   type=int, default=8,
+                   help="persisted blocks between WAL checkpoints "
+                        "(default 8; also [node] wal_checkpoint_blocks)")
+    p.add_argument("--no-recovery-verify", dest="recovery_verify_root",
+                   action="store_false", default=True,
+                   help="skip the startup recovery's full state-root "
+                        "recomputation through the committer (large "
+                        "datadirs trade the proof for boot time; also "
+                        "RETH_TPU_RECOVERY_VERIFY=0)")
     p.set_defaults(fn=cmd_node)
 
     p = sub.add_parser("dump-genesis", help="print the dev genesis JSON")

@@ -153,6 +153,16 @@ class RethTpuConfig:
     # ring-buffer samples retained per metric series (5 min at the
     # default 1 Hz; also RETH_TPU_SLO_WINDOW)
     slo_window: int = 300
+    # write-ahead log for the memdb-backed stores (--wal CLI equivalent,
+    # storage/wal.py): fsync'd per-commit records + checkpoint manifest,
+    # so a kill -9 loses at most persistence_threshold blocks
+    wal: bool = True
+    # persisted blocks between WAL checkpoints (image + manifest swap +
+    # log truncation; --wal-checkpoint-blocks CLI equivalent)
+    wal_checkpoint_blocks: int = 8
+    # verify the recovered head's state root by recomputation through
+    # the committer at startup (--no-recovery-verify opts out)
+    recovery_verify_root: bool = True
 
 
 def _prune_mode(d: dict) -> PruneMode:
@@ -192,6 +202,11 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.health = bool(node.get("health", cfg.health))
     cfg.slo_interval = float(node.get("slo_interval", cfg.slo_interval))
     cfg.slo_window = int(node.get("slo_window", cfg.slo_window))
+    cfg.wal = bool(node.get("wal", cfg.wal))
+    cfg.wal_checkpoint_blocks = int(node.get("wal_checkpoint_blocks",
+                                             cfg.wal_checkpoint_blocks))
+    cfg.recovery_verify_root = bool(node.get("recovery_verify_root",
+                                             cfg.recovery_verify_root))
     rpc = raw.get("rpc", {})
     cfg.rpc.gateway = bool(rpc.get("gateway", cfg.rpc.gateway))
     cfg.rpc.gateway_cache = int(rpc.get("gateway_cache", cfg.rpc.gateway_cache))

@@ -16,6 +16,7 @@ from enum import Enum
 
 from ..metrics import REGISTRY
 from .. import tracing
+from ..chaos import crash_point
 
 from ..consensus import ConsensusError, EthBeaconConsensus
 from ..evm import BlockExecutor, EvmConfig
@@ -137,6 +138,12 @@ class EngineTree:
 
                 Pipeline(fac, default_stages(committer=self.committer)).unwind(target)
         self.unwinder = unwinder
+        # durability boundary (storage/wal.py DurabilityManager): when the
+        # node attaches one, every persistence advance notifies it so WAL
+        # checkpoints track the persistence threshold; without one, a
+        # flush()-capable store is flushed at the same boundary — either
+        # way durability no longer waits for graceful shutdown
+        self.durability = None
         self.blocks: dict[bytes, ExecutedBlock] = {}
         self.invalid: dict[bytes, str] = {}
         # blocks whose parent is unknown yet (reference BlockBuffer,
@@ -719,7 +726,22 @@ class EngineTree:
     def _unwind_persisted_to(self, number: int) -> None:
         """Unwind the persisted chain to ``number`` (reference: engine →
         backfill pipeline unwind on deep reorgs, pipeline/mod.rs:303)."""
+        # durable unwind intent BEFORE the first stage commit: the
+        # pipeline unwinds with one commit per stage, so a crash anywhere
+        # inside leaves ragged checkpoints — the marker tells startup
+        # recovery the exact target to finish the job at (cleared
+        # atomically with the canonical surgery below)
+        from ..storage.recovery import UNWIND_MARKER_KEY
+
+        with self.factory.provider_rw() as p:
+            p.tx.put(Tables.Metadata.name, UNWIND_MARKER_KEY,
+                     number.to_bytes(8, "big"))
         self.unwinder(self.factory, number)
+        # crash window drilled by chaos.py: the pipeline unwind committed
+        # but the canonical-header surgery below did not — startup
+        # recovery heals it by completing the unwind to the marker target
+        # (storage/recovery.py)
+        crash_point("unwind")
         # drop unwound canonical blocks' header index
         with self.factory.provider_rw() as p:
             old_tip = p.last_block_number()
@@ -729,6 +751,7 @@ class EngineTree:
                     p.tx.delete(Tables.CanonicalHeaders.name, (n).to_bytes(8, "big"))
                     p.tx.delete(Tables.Headers.name, (n).to_bytes(8, "big"))
                     p.tx.delete(Tables.HeaderNumbers.name, bh)
+            p.tx.delete(Tables.Metadata.name, UNWIND_MARKER_KEY)
         with self.factory.provider() as p:
             self.persisted_number = number
             self.persisted_hash = p.canonical_hash(number)
@@ -736,6 +759,9 @@ class EngineTree:
         # in-memory tree entries built on the old chain are now stale
         self.blocks.clear()
         self.preserved_trie.invalidate()
+        # the unwound shape is a durability boundary too: a crash after a
+        # reorg must never resurrect the unwound chain
+        self._durability_boundary()
 
     def _notify_canon_change(self):
         chain = [self.blocks[h] for h in self.canonical_chain()]
@@ -779,6 +805,11 @@ class EngineTree:
                           "TransactionLookup", "IndexStorageHistory",
                           "IndexAccountHistory", "Finish"):
                 p.save_stage_checkpoint(stage, top)
+        # crash window drilled by chaos.py: the persistence transaction
+        # committed (and, with a WAL, is fsync-durable) but none of the
+        # in-memory bookkeeping below ran — restart must recover to the
+        # just-persisted head
+        crash_point("advance-persistence")
         last = self.blocks[to_persist[-1]]
         self.persisted_number = last.number
         self.persisted_hash = last.hash
@@ -787,3 +818,39 @@ class EngineTree:
             self.blocks.pop(h, None)
         for h in [h for h, eb in self.blocks.items() if eb.number <= self.persisted_number]:
             self.blocks.pop(h, None)
+        self._durability_boundary()
+
+    def _durability_boundary(self):
+        """Make everything persisted so far crash-durable.
+
+        With a WAL attached (``self.durability``) commits are already
+        fsync'd record-by-record; this notifies the manager so it can
+        truncate the log via a checkpoint. Without one, a store exposing
+        ``flush`` gets its image written here — durability then tracks
+        the persistence threshold instead of process lifetime (the old
+        behavior flushed only in ``Node.stop``).
+        """
+        if self.durability is not None:
+            try:
+                self.durability.on_persisted(self.persisted_number,
+                                             self.persisted_hash)
+                return
+            except Exception:  # noqa: BLE001 - a failed checkpoint must not
+                # fail consensus; per-commit WAL records still hold
+                import traceback
+
+                traceback.print_exc()
+                return
+        db = self.factory.db
+        # native/paged engines: sync() is the cheap power-loss durability
+        # point (fsync, no compaction); image-backed stores rewrite the
+        # image — either way, prefer the light call when one exists
+        op = getattr(db, "sync", None) or getattr(db, "flush", None)
+        if op is not None:
+            try:
+                op()
+            except Exception:  # noqa: BLE001 - durability best-effort here;
+                # consensus state is already committed
+                import traceback
+
+                traceback.print_exc()

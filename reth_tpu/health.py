@@ -372,6 +372,14 @@ def default_rules() -> list[SloRule]:
                 metric="hasher_supervisor_breaker_state",
                 failing_factor=1.3,
                 help="supervisor circuit breaker half-open/open"),
+        # crash-recovery verdict (storage/recovery.py): 0 ok, 1 degraded
+        # (healed a torn tail / quarantine — the node is consistent NOW,
+        # so no breach), 2 failed — the recovered state is provably wrong
+        # (root mismatch), which must page immediately and sustain
+        SloRule("recovery_failed", "durability", "gauge", 1.5,
+                metric="recovery_status", failing_factor=1.2,
+                help="startup recovery provably failed (recovered state "
+                     "root mismatch / unhealable chain)"),
     ]
     return rules
 

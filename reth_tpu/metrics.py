@@ -979,3 +979,83 @@ class DeviceCompileTracker:
 
 
 compile_tracker = DeviceCompileTracker()
+
+
+class WalMetrics:
+    """Write-ahead-log + startup-recovery observability (storage/wal.py,
+    storage/recovery.py): append/checkpoint cadence, segment size, torn
+    bytes discarded on replay, quarantined images/jars, and the
+    recovery_status gauge the health engine's durability rule watches —
+    the numbers that say whether a kill -9 right now would lose more
+    than the persistence threshold."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._appends = reg.counter(
+            "wal_appends_total", "fsync'd commit records appended")
+        self._bytes = reg.counter(
+            "wal_bytes_written_total", "record bytes appended (framed)")
+        self._checkpoints = reg.counter(
+            "wal_checkpoints_total", "image+manifest checkpoints taken")
+        self._segment_bytes = reg.gauge(
+            "wal_segment_bytes", "bytes in the live WAL segment")
+        self._gen = reg.gauge("wal_generation", "current WAL generation")
+        self._replayed = reg.counter(
+            "recovery_wal_records_replayed_total",
+            "commit records applied during startup replay")
+        self._torn = reg.counter(
+            "recovery_torn_bytes_total",
+            "torn WAL tail bytes discarded during startup replay")
+        self._quarantined = reg.counter(
+            "recovery_quarantined_total",
+            "corrupt images/jars quarantined aside at startup")
+        self._status = reg.gauge(
+            "recovery_status",
+            "last startup recovery: 0 ok, 1 degraded (healed), 2 failed")
+        self._problems = reg.gauge(
+            "recovery_problems", "problems reported by the last recovery")
+        self._mgr = None
+        self.last_recovery: dict | None = None  # events line fragment
+
+    def attach(self, manager) -> None:
+        """Bind the live DurabilityManager so the sampler-facing gauges
+        track it (called from storage/wal.py on attach)."""
+        self._mgr = manager
+        s = manager.snapshot()
+        self._gen.set(s["gen"])
+        self._segment_bytes.set(s["segment_bytes"])
+        self._replayed.increment(s["replayed"])
+        self._torn.increment(s["torn_bytes"])
+
+    def record_append(self, nbytes: int, segment_bytes: int) -> None:
+        self._appends.increment()
+        self._bytes.increment(nbytes)
+        self._segment_bytes.set(segment_bytes)
+
+    def record_checkpoint(self, manager) -> None:
+        self._checkpoints.increment()
+        s = manager.snapshot()
+        self._gen.set(s["gen"])
+        self._segment_bytes.set(s["segment_bytes"])
+
+    def record(self, report: dict) -> None:
+        """Push one startup-recovery report (storage/recovery.py)."""
+        level = {"ok": 0, "degraded": 1, "failed": 2}.get(
+            report.get("status", "ok"), 2)
+        self._status.set(level)
+        self._problems.set(len(report.get("problems", ())))
+        self._quarantined.increment(len(report.get("quarantined", ())))
+        self.last_recovery = {
+            "status": report.get("status"),
+            "head": report.get("head_number"),
+            "replayed": report.get("replayed_records", 0),
+            "torn_bytes": report.get("torn_bytes", 0),
+            "quarantined": len(report.get("quarantined", ())),
+            "healed": len(report.get("healed", ())),
+            "root_verified": report.get("root_verified"),
+            "wall_s": report.get("wall_s"),
+        }
+
+
+wal_metrics = WalMetrics()
+recovery_metrics = wal_metrics  # one surface: recovery_* lives beside wal_*

@@ -226,6 +226,31 @@ class NodeEventReporter:
             if eng.breaches_total:
                 line += f" breaches={eng.breaches_total}"
             line += "]"
+        # --wal: the durability boundary's one-line health — generation,
+        # fsync'd appends since start, checkpoints taken, live segment
+        # size — the numbers that say what a kill -9 right now would
+        # cost; plus the last startup recovery's verdict (replayed
+        # records, torn tail discarded, quarantines, root proof)
+        dur = getattr(self.node, "durability", None)
+        if dur is not None:
+            d = dur.snapshot()
+            line += (f" wal[gen={d['gen']} app={d['appends']}"
+                     f" ckpt={d['checkpoints']}"
+                     f" seg={d['segment_bytes']}B]")
+        rec = getattr(self.node, "recovery", None)
+        if rec is not None and (rec.get("replayed_records")
+                                or rec.get("status") != "ok"
+                                or rec.get("healed")):
+            line += (f" recovery[{rec['status']}"
+                     f" replayed={rec.get('replayed_records', 0)}")
+            if rec.get("torn_bytes"):
+                line += f" torn={rec['torn_bytes']}B"
+            if rec.get("quarantined"):
+                line += f" quarantined={len(rec['quarantined'])}"
+            if rec.get("root_verified") is not None:
+                line += (" root=ok" if rec["root_verified"]
+                         else " root=MISMATCH")
+            line += "]"
         # --trace-blocks: the per-block wall budget — where the last
         # block's time actually went, split by phase and by hash-service
         # queue-wait vs device dispatch (tracing.py block summaries)

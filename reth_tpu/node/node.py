@@ -99,6 +99,20 @@ class NodeConfig:
     slo_interval: float = 1.0
     # [node] slo_window: ring-buffer samples retained per metric series
     slo_window: int = 300
+    # --wal / [node] wal: write-ahead log beside the memdb image
+    # (storage/wal.py) — every commit fsync-appends its table delta
+    # before the in-memory publish, so a kill -9 loses at most
+    # persistence_threshold blocks instead of the whole session.
+    # Memdb-backed stores only: the native/paged engines carry their
+    # own WAL / shadow paging.
+    wal: bool = True
+    # [node] wal_checkpoint_blocks: persisted blocks between WAL
+    # checkpoints (image + fsync'd manifest swap + log truncation)
+    wal_checkpoint_blocks: int = 8
+    # --no-recovery-verify: skip the startup recovery's state-root
+    # recomputation through the committer (storage/recovery.py) —
+    # large datadirs can trade the proof for boot time
+    recovery_verify_root: bool = True
 
 
 class Node:
@@ -178,6 +192,21 @@ class Node:
         self.factory = ProviderFactory(
             open_database(config.db_backend, config.datadir,
                           storage_v2=config.storage_v2))
+        # crash-safe persistence (--wal, storage/wal.py): attach the
+        # write-ahead log BEFORE anything reads the store — attaching
+        # replays surviving commit records (discarding any torn tail)
+        # into the freshly-opened image, so genesis init, chain-spec
+        # rebuild, and the engine tree all see the recovered state
+        self.durability = None
+        if config.datadir and config.wal:
+            from ..storage.wal import attach_wal
+
+            static_dir = (Path(config.datadir) / "static_files"
+                          if config.static_file_distance is not None else None)
+            self.durability = attach_wal(
+                self.factory.db, Path(config.datadir) / "wal",
+                checkpoint_blocks=config.wal_checkpoint_blocks,
+                static_dir=static_dir)
         # storage-v2 startup invariants (reference rocksdb/invariants.rs):
         # reconcile the aux store against the stage checkpoints — prune
         # what's ahead, unwind what's behind
@@ -195,6 +224,26 @@ class Node:
                 self.factory, config.genesis_header, config.genesis_alloc,
                 config.genesis_storage, config.genesis_codes, self.committer,
             )
+        # startup recovery (storage/recovery.py): reconcile the recovered
+        # store against stage checkpoints and static-file jar digests,
+        # heal interrupted unwinds, and verify the recovered head's state
+        # root by recomputation through the committer BEFORE serving —
+        # the report lands on the events line, recovery_* metrics, and
+        # the PR 9 health engine's durability component
+        self.recovery = None
+        if config.datadir:
+            import os as _os
+
+            from ..storage.recovery import recover_on_startup
+
+            env = _os.environ.get("RETH_TPU_RECOVERY_VERIFY")
+            verify = (config.recovery_verify_root if env is None
+                      else env not in ("", "0"))
+            self.recovery = recover_on_startup(
+                self.factory, durability=self.durability,
+                committer=self.committer,
+                static_dir=Path(config.datadir) / "static_files",
+                verify_root=verify)
         # chain spec: persist on first launch, rebuild on restart (a node
         # relaunched from a datadir without --genesis must keep advertising
         # the right EIP-2124 fork id)
@@ -223,6 +272,9 @@ class Node:
             sparse_workers=config.sparse_workers,
             parallel_exec=config.parallel_exec,
         )
+        # the engine's persistence advance is the durability boundary:
+        # with a WAL it drives checkpoint cadence, without one it flushes
+        self.tree.durability = self.durability
         from ..pool.pool import PoolConfig
 
         self.pool = TransactionPool(lambda: self.tree.overlay_provider(),
@@ -553,7 +605,13 @@ class Node:
             self.discovery_v5.stop()
         if self.network is not None:
             self.network.stop()
-        if self.factory.db is not None and hasattr(self.factory.db, "flush"):
+        if self.durability is not None:
+            # graceful stop = one final checkpoint: image + manifest
+            # swapped, log truncated — the next boot replays nothing
+            self.durability.checkpoint(
+                head=(self.tree.persisted_number, self.tree.persisted_hash))
+            self.durability.close()
+        elif self.factory.db is not None and hasattr(self.factory.db, "flush"):
             self.factory.db.flush()
         if self.config.trace_blocks:
             # terminate the Chrome trace into a valid JSON array

@@ -197,6 +197,11 @@ class Tx:
             db._writer_thread = threading.get_ident()
         self._snap: dict[str, dict] = db._tables  # published map (immutable)
         self._own: dict[str, dict] = {}           # tx-private clones
+        # per-table touched-key sets for the WAL's commit delta (value
+        # None = whole-table replace via clear()); tracked only when a
+        # WAL is attached so the no-WAL hot path stays allocation-free
+        self._touched: dict[str, set | None] | None = \
+            {} if (write and getattr(db, "_wal", None) is not None) else None
         self._key_cache: dict[str, list[bytes]] = {}
         self._done = False
 
@@ -229,6 +234,45 @@ class Tx:
     def _invalidate_keys(self, table: str):
         self._key_cache.pop(table, None)
 
+    def _track(self, table: str, key: bytes):
+        t = self._touched
+        if t is None:
+            return
+        s = t.get(table)
+        if s is None:
+            if table in t:
+                return  # whole-table replace already recorded
+            s = t[table] = set()
+        s.add(key)
+
+    def _commit_delta(self) -> dict:
+        """The WAL record for this commit: per touched table, the final
+        absolute values of written keys + the deleted keys (or the whole
+        table for clear()) — exactly what the clone-on-touch write set
+        materialized, frozen for serialization."""
+
+        def freeze(v):
+            return list(v) if isinstance(v, list) else v
+
+        touched = self._touched or {}
+        delta: dict[str, dict] = {}
+        for table, own in self._own.items():
+            keys = touched.get(table, None)
+            if keys is None:
+                # clear()ed (or untracked, defensively): record the whole
+                # replacement table — replay-idempotent either way
+                delta[table] = {"replace": True,
+                                "rows": {k: freeze(v) for k, v in own.items()}}
+            else:
+                rows, dels = {}, []
+                for k in keys:
+                    if k in own:
+                        rows[k] = freeze(own[k])
+                    else:
+                        dels.append(k)
+                delta[table] = {"rows": rows, "del": dels}
+        return delta
+
     # -- reads --------------------------------------------------------------
 
     def get(self, table: str, key: bytes):
@@ -257,6 +301,7 @@ class Tx:
     def put(self, table: str, key: bytes, value: bytes, dupsort: bool = False):
         assert self._write, "read-only transaction"
         t = self._wtable(table)
+        self._track(table, key)
         if key not in t:
             self._invalidate_keys(table)
         if dupsort:
@@ -277,6 +322,7 @@ class Tx:
         """Delete a key (or one duplicate when ``value`` given)."""
         assert self._write, "read-only transaction"
         t = self._wtable(table)
+        self._track(table, key)
         if key not in t:
             return False
         if value is None or not isinstance(t.get(key), list):
@@ -296,6 +342,8 @@ class Tx:
     def clear(self, table: str):
         assert self._write
         self._own[table] = {}
+        if self._touched is not None:
+            self._touched[table] = None  # whole-table replace in the WAL
         self._invalidate_keys(table)
 
     # -- lifecycle ----------------------------------------------------------
@@ -304,10 +352,22 @@ class Tx:
         assert not self._done
         if self._write:
             if self._own:
-                new_map = dict(self._db._tables)
-                new_map.update(self._own)
-                self._db._tables = new_map  # atomic publish (GIL reference swap)
-                self._db._dirty = True
+                def _publish():
+                    new_map = dict(self._db._tables)
+                    new_map.update(self._own)
+                    # atomic publish (GIL reference swap)
+                    self._db._tables = new_map
+                    self._db._dirty = True
+
+                wal = getattr(self._db, "_wal", None)
+                if wal is not None and self._touched is not None:
+                    # durability boundary: the fsync'd WAL record lands
+                    # BEFORE the in-memory publish (and under the WAL
+                    # lock, so a concurrent checkpoint can never truncate
+                    # a record whose state it did not snapshot)
+                    wal.append(self._commit_delta(), publish=_publish)
+                else:
+                    _publish()
             self._db._writer_thread = None
             self._db._writer_lock.release()
         self._done = True
@@ -363,9 +423,42 @@ class MemDb(Database):
         self._writer_thread: int | None = None
         self._path = Path(path) if path else None
         self._dirty = False
+        self._wal = None          # WalStore once storage/wal.py attaches
+        self.quarantined: Path | None = None
         if self._path and self._path.exists():
-            with open(self._path, "rb") as f:
-                self._tables = pickle.load(f)
+            try:
+                with open(self._path, "rb") as f:
+                    self._tables = pickle.load(f)
+            except Exception as e:  # noqa: BLE001 - unreadable/truncated image
+                # quarantine the image aside and start empty instead of
+                # refusing to boot: startup recovery (storage/recovery.py)
+                # rebuilds what it can from the WAL and from genesis, and
+                # surfaces the quarantine as a recovery_* warning
+                self.quarantined = self._quarantine_image(e)
+
+    def _quarantine_image(self, err: Exception) -> Path:
+        k = 0
+        while True:
+            dest = self._path.with_name(f"{self._path.name}.corrupt-{k}")
+            if not dest.exists():
+                break
+            k += 1
+        self._path.replace(dest)
+        self._tables = {}
+        try:
+            from .. import tracing
+
+            tracing.event("storage::kv", "image_quarantined",
+                          path=str(self._path), quarantined=str(dest),
+                          error=f"{type(err).__name__}: {err}")
+        except Exception:  # noqa: BLE001 - telemetry never gates startup
+            pass
+        import sys
+
+        print(f"memdb: corrupt image {self._path} quarantined to {dest} "
+              f"({type(err).__name__}: {err}); recovering from WAL/genesis",
+              file=sys.stderr)
+        return dest
 
     def tx(self) -> Tx:
         return Tx(self, write=False)
@@ -375,8 +468,15 @@ class MemDb(Database):
 
     def flush(self):
         if self._path and self._dirty:
+            from .wal import fsync_dir, fsync_file
+
             tmp = self._path.with_suffix(".tmp")
             with open(tmp, "wb") as f:
                 pickle.dump(self._tables, f, protocol=pickle.HIGHEST_PROTOCOL)
+                # fsync the bytes BEFORE the rename and the directory
+                # AFTER it: without both, a crash shortly after replace()
+                # can still surface the old (or no) image
+                fsync_file(f)
             tmp.replace(self._path)
+            fsync_dir(self._path.parent)
             self._dirty = False

@@ -119,7 +119,23 @@ class NippyJar:
             f.write(struct.pack("<I", len(header)))
             f.write(header)
             f.write(bytes(data))
+            # durability before visibility: fsync the bytes, rename, then
+            # fsync the directory — a crash right after replace() must
+            # never surface a jar whose data did not reach the platter
+            f.flush()
+            try:
+                import os
+
+                os.fsync(f.fileno())
+            except OSError:  # pragma: no cover - platform-dependent
+                pass
+        from ..chaos import crash_point
+
+        crash_point("jar-rename")
         tmp.replace(path)  # jars appear atomically (immutable once named)
+        from .wal import fsync_dir
+
+        fsync_dir(path.parent)
 
     # -- reading --------------------------------------------------------------
 
