@@ -350,7 +350,10 @@ class Tx:
 
     def commit(self):
         assert not self._done
-        if self._write:
+        if not self._write:
+            self._done = True
+            return
+        try:
             if self._own:
                 def _publish():
                     new_map = dict(self._db._tables)
@@ -368,9 +371,13 @@ class Tx:
                     wal.append(self._commit_delta(), publish=_publish)
                 else:
                     _publish()
+        finally:
+            # a failed append (ENOSPC/EIO) must not leave the writer
+            # lock held until __del__: the commit raises, but the txn is
+            # over either way (the WAL already rewound its segment)
+            self._done = True
             self._db._writer_thread = None
             self._db._writer_lock.release()
-        self._done = True
 
     def abort(self):
         if self._write and not self._done:

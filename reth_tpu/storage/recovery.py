@@ -34,8 +34,9 @@ Steps (all idempotent, all surfaced in one report):
 
 Status: ``ok`` (nothing to do) | ``degraded`` (healed something —
 quarantine, torn tail, completed unwind) | ``failed`` (the recovered
-state is provably wrong: root mismatch / broken linkage that could not
-be healed). ``failed`` is surfaced through ``recovery_status`` so the
+state is provably wrong or the durability promise was broken: root
+mismatch, broken linkage that could not be healed, or mid-log WAL
+corruption that dropped durably committed records). ``failed`` is surfaced through ``recovery_status`` so the
 PR 9 health engine flips the node to failing instead of serving a
 corrupt chain silently.
 """
@@ -79,6 +80,17 @@ def recover_on_startup(factory, durability=None, committer=None,
             report["status"] = _worst(report["status"], "degraded")
             report["healed"].append(
                 f"discarded {rep['torn_bytes']} torn WAL tail bytes")
+        if rep.get("lost_segments"):
+            # mid-log corruption: the WAL quarantined whole segments of
+            # durably committed records it could not apply in order —
+            # this is a broken durability promise, not a healed crash
+            # tail, so it escalates past "degraded" even though the
+            # surviving prefix is self-consistent and its root verifies
+            report["status"] = "failed"
+            report["quarantined"].extend(rep["lost_segments"])
+            report["problems"].append(
+                f"mid-log WAL corruption: {len(rep['lost_segments'])} "
+                f"segment(s) quarantined, durably committed records lost")
         for store in durability.stores:
             q = getattr(store.db, "quarantined", None)
             if q is not None:

@@ -24,8 +24,14 @@ This module closes that hole without giving up the in-memory engine:
   crash window between the checkpoint steps recovers cleanly.
 - **Startup replay**: :meth:`WalStore.open` loads the manifest, replays
   every surviving segment in generation order into the freshly-opened
-  ``MemDb``, discards the torn tail (counted + surfaced), and attaches
-  itself so subsequent commits append.
+  ``MemDb``, discards the torn tail (counted + surfaced) and *truncates*
+  it off the live segment so post-recovery appends continue a
+  well-framed log, and attaches itself so subsequent commits append. A
+  torn NON-final segment is mid-log corruption, not a crash tail: the
+  corrupt segment and everything after it are quarantined aside
+  (``*.wal.corrupt``), the surviving prefix is checkpointed immediately,
+  and the loss is flagged (``lost_segments``) so startup recovery
+  escalates to ``failed`` — durably committed records were dropped.
 
 Record wire format (per segment, after the ``RTWL1\\n`` + u64-gen
 header)::
@@ -42,6 +48,7 @@ recovery that silently applies corrupt data.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import pickle
@@ -62,13 +69,29 @@ DEFAULT_SEGMENT_BYTES = 16 * 1024 * 1024
 # -- fsync plumbing (shared with kv.py / nippyjar.py) -------------------------
 
 
+# errno values that mean "fsync is not supported here" (pipes, some
+# special/virtual filesystems) — the only failures it is safe to ignore
+_FSYNC_UNSUPPORTED = frozenset(
+    e for e in (getattr(errno, name, None)
+                for name in ("EINVAL", "ENOSYS", "ENOTSUP", "EOPNOTSUPP"))
+    if e is not None)
+
+
 def fsync_file(f) -> None:
-    """flush + fsync an open file object (best-effort on exotic FS)."""
+    """flush + fsync an open file object.
+
+    Only "fsync unsupported on this file" errno values are swallowed; a
+    genuine EIO/ENOSPC must propagate to the committer — reporting a
+    commit durable when its bytes never reached the platter is the
+    classic fsync-gate failure mode.
+    """
     f.flush()
     try:
         os.fsync(f.fileno())
-    except OSError:  # pragma: no cover - platform-dependent
-        pass
+    except OSError as e:  # pragma: no cover - platform-dependent
+        if e.errno in _FSYNC_UNSUPPORTED:
+            return
+        raise
 
 
 def fsync_dir(path: Path) -> None:
@@ -120,8 +143,18 @@ def read_segment(path: Path):
     records: list[dict] = []
     accepted = 0
     data = path.read_bytes()
-    if not data.startswith(SEGMENT_MAGIC):
-        # unreadable header: the whole segment is torn
+    if (not data.startswith(SEGMENT_MAGIC)
+            or len(data) < len(SEGMENT_MAGIC) + 8):
+        # unreadable/truncated header: the whole segment is torn
+        return records, len(data), accepted
+    (hdr_gen,) = struct.unpack_from("<Q", data, len(SEGMENT_MAGIC))
+    try:
+        name_gen = _seg_gen(path)
+    except ValueError:
+        name_gen = None
+    if name_gen is not None and hdr_gen != name_gen:
+        # a mis-renamed / cross-copied segment would replay under the
+        # wrong generation order — treat the whole segment as torn
         return records, len(data), accepted
     pos = len(SEGMENT_MAGIC) + 8  # magic + u64 generation
     n = len(data)
@@ -214,6 +247,10 @@ class WalStore:
         self.replay_torn_bytes = 0
         self.replay_accepted_torn = 0
         self.replay_segments = 0
+        # mid-log corruption: segments quarantined aside because a torn
+        # NON-final segment broke framing before them — their records
+        # were durably committed and are now lost, so recovery escalates
+        self.lost_segments: list[str] = []
         self.last_checkpoint_head: tuple[int, str] | None = None
         self._ckpt_number: int | None = None
         self.max_segment_bytes = int(
@@ -237,6 +274,7 @@ class WalStore:
         segs = sorted(store.dir.glob("*.wal"), key=_seg_gen)
         tables = dict(db._tables)
         owned: set = set()
+        lost: list[Path] = []
         for i, seg in enumerate(segs):
             records, torn, accepted = read_segment(seg)
             for rec in records:
@@ -247,10 +285,27 @@ class WalStore:
             if torn:
                 store.replay_torn_bytes += torn
                 if i + 1 < len(segs):
-                    # mid-log corruption (not a crash tail): records after
-                    # it would apply out of order — stop, let the startup
-                    # reconcile + root verification judge what survived
-                    break
+                    # mid-log corruption (not a crash tail): framing is
+                    # broken in the MIDDLE of the durable history, so the
+                    # later segments' records — real fsync'd commits —
+                    # cannot be applied in order. Quarantine the corrupt
+                    # segment and everything after it (they are unusable
+                    # here anyway, but the bytes are kept for forensics)
+                    # and checkpoint immediately below so the surviving
+                    # prefix is durable; replay_report flags the loss so
+                    # startup recovery escalates beyond "degraded".
+                    lost = segs[i:]
+                else:
+                    # torn crash tail of the live segment: truncate the
+                    # garbage so subsequent appends continue a
+                    # well-framed log — without this, new records land
+                    # AFTER unreadable bytes and the next replay stops
+                    # at the tear, silently dropping every post-recovery
+                    # commit until a checkpoint rotates the segment.
+                    with open(seg, "rb+") as f:
+                        f.truncate(seg.stat().st_size - torn)
+                        fsync_file(f)
+                break
         if owned:
             db._tables = tables
             db._dirty = True
@@ -258,14 +313,30 @@ class WalStore:
         gen = manifest["gen"] if manifest else 1
         if segs:
             gen = max(gen, _seg_gen(segs[-1]))
-        store.gen = gen
         if manifest:
             head = manifest.get("head_number")
             store._ckpt_number = head
             if head is not None and manifest.get("head_hash"):
                 store.last_checkpoint_head = (head, manifest["head_hash"])
+        if lost:
+            for seg in lost:
+                dest = seg.with_suffix(seg.suffix + ".corrupt")
+                k = 0
+                while dest.exists():
+                    k += 1
+                    dest = seg.with_suffix(seg.suffix + f".corrupt-{k}")
+                seg.replace(dest)
+                store.lost_segments.append(dest.name)
+            fsync_dir(store.dir)
+            gen += 1  # never reuse a quarantined generation number
+        store.gen = gen
         store._open_segment()
         db._wal = store
+        if lost:
+            # make the surviving prefix durable NOW: a crash before the
+            # next cadence checkpoint must not lose the replayed records
+            # whose segments were just quarantined
+            store.checkpoint(head=store.last_checkpoint_head)
         return store
 
     def manifest(self) -> dict | None:
@@ -297,12 +368,33 @@ class WalStore:
         lock — a checkpoint can never snapshot state whose record it is
         about to truncate."""
         with self._lock:
-            self.seq += 1
-            payload = pickle.dumps({"seq": self.seq, "tables": delta},
+            payload = pickle.dumps({"seq": self.seq + 1, "tables": delta},
                                    protocol=pickle.HIGHEST_PROTOCOL)
             frame = struct.pack("<II", len(payload), zlib.crc32(payload))
-            self._fh.write(frame + payload)
-            fsync_file(self._fh)
+            path = self.dir / _seg_name(self.gen)
+            # every append fsyncs, so on-disk size == pre-append offset
+            start = path.stat().st_size
+            try:
+                self._fh.write(frame + payload)
+                fsync_file(self._fh)
+            except Exception:
+                # ENOSPC/EIO mid-append: a half-written frame at the
+                # tail would bury every later record behind a torn one —
+                # rewind the segment to the pre-append offset (through a
+                # fresh fd: the buffered writer may hold partial bytes)
+                # so the log stays well-framed, then let the committer
+                # see the failure
+                try:
+                    self._fh.close()
+                except Exception:  # noqa: BLE001 - already broken fd
+                    pass
+                try:
+                    os.truncate(path, start)
+                except OSError:  # pragma: no cover - fs itself is gone
+                    pass
+                self._fh = open(path, "ab")
+                raise
+            self.seq += 1
             self.appends += 1
             self.bytes_appended += len(frame) + len(payload)
             if self._metrics is not None:
@@ -389,6 +481,7 @@ class WalStore:
             "segment_bytes": self.segment_bytes(),
             "replayed": self.replayed_records,
             "torn_bytes": self.replay_torn_bytes,
+            "lost_segments": len(self.lost_segments),
         }
 
 
@@ -449,7 +542,8 @@ class DurabilityManager:
         s = self.main.snapshot()
         for extra in self.stores[1:]:
             e = extra.snapshot()
-            for k in ("appends", "bytes", "replayed", "torn_bytes"):
+            for k in ("appends", "bytes", "replayed", "torn_bytes",
+                      "lost_segments"):
                 s[k] += e[k]
         s["stores"] = len(self.stores)
         s["checkpoint_blocks"] = self.checkpoint_blocks
@@ -462,6 +556,9 @@ class DurabilityManager:
             "accepted_torn": sum(st.replay_accepted_torn
                                  for st in self.stores),
             "segments": sum(st.replay_segments for st in self.stores),
+            "lost_segments": [f"{Path(st.dir).name}/{name}"
+                              for st in self.stores
+                              for name in st.lost_segments],
             "manifest_head": self.main.last_checkpoint_head,
         }
 

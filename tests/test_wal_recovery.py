@@ -97,6 +97,169 @@ def test_wal_torn_tail_discarded(tmp_path):
         assert t.get("T", b"\x02") is None
 
 
+def test_torn_tail_truncated_so_post_recovery_commits_survive(tmp_path):
+    """Crash -> recover -> commit -> crash again. Recovery must TRUNCATE
+    the torn tail off the live segment: without that, post-recovery
+    appends land after unreadable garbage and the second recovery
+    silently drops every one of them."""
+    db, dur = reopen(tmp_path)
+    for i in range(3):
+        with db.tx_mut() as tx:
+            tx.put("T", bytes([i]), b"v%d" % i)
+    seg = dur.main.dir / "00000001.wal"
+    seg.write_bytes(seg.read_bytes()[:-7])  # kill -9 mid-append
+    # first recovery: two whole records survive, torn bytes gone from disk
+    db2, dur2 = reopen(tmp_path)
+    assert dur2.replay_report()["records"] == 2
+    sizes = seg.stat().st_size
+    with db2.tx_mut() as tx:
+        tx.put("T", b"new", b"post-recovery")
+    assert seg.stat().st_size > sizes
+    # second kill -9: the post-recovery commit MUST replay
+    db3, dur3 = reopen(tmp_path)
+    rep = dur3.replay_report()
+    assert rep["records"] == 3
+    assert rep["torn_bytes"] == 0
+    with db3.tx() as t:
+        assert t.get("T", b"new") == b"post-recovery"
+        assert t.get("T", b"\x00") == b"v0"
+        assert t.get("T", b"\x02") is None
+
+
+def test_midlog_corruption_quarantines_segments_and_escalates(tmp_path):
+    """A torn NON-final segment is mid-log corruption: the corrupt
+    segment and everything after it (durable commits we can no longer
+    apply in order) are quarantined aside, the surviving prefix is
+    checkpointed immediately, and recovery reports FAILED — the
+    durability promise was broken, not healed."""
+    import zlib
+
+    from reth_tpu.storage.recovery import recover_on_startup
+
+    db, dur = reopen(tmp_path)
+    with db.tx_mut() as tx:
+        tx.put("T", b"a", b"1")
+    with db.tx_mut() as tx:
+        tx.put("T", b"b", b"2")
+    dur.main.close()
+    # hand-roll a later segment holding another durably committed record
+    payload = pickle.dumps(
+        {"seq": 9, "tables": {"T": {"rows": {b"c": b"3"}, "del": []}}},
+        protocol=pickle.HIGHEST_PROTOCOL)
+    seg2 = tmp_path / "wal" / "00000002.wal"
+    seg2.write_bytes(SEGMENT_MAGIC + struct.pack("<Q", 2)
+                     + struct.pack("<II", len(payload), zlib.crc32(payload))
+                     + payload)
+    # bit-rot the SECOND record of segment 1 — now mid-log, not a tail
+    seg1 = tmp_path / "wal" / "00000001.wal"
+    data = bytearray(seg1.read_bytes())
+    data[-1] ^= 0xFF
+    seg1.write_bytes(bytes(data))
+
+    db2, dur2 = reopen(tmp_path)
+    rep = dur2.replay_report()
+    assert len(rep["lost_segments"]) == 2
+    assert not seg1.exists() and not seg2.exists()
+    assert (tmp_path / "wal" / "00000001.wal.corrupt").exists()
+    assert (tmp_path / "wal" / "00000002.wal.corrupt").exists()
+    with db2.tx() as t:
+        assert t.get("T", b"a") == b"1"   # surviving prefix applied
+        assert t.get("T", b"c") is None   # the lost segment is NOT
+    # recovery escalates beyond degraded: durable commits were dropped
+    report = recover_on_startup(ProviderFactory(db2), durability=dur2,
+                                committer=CPU, verify_root=False)
+    assert report["status"] == "failed"
+    assert any("mid-log" in p for p in report["problems"])
+    assert any(".wal.corrupt" in q for q in report["quarantined"])
+    # the open-time checkpoint made the prefix durable: the next boot
+    # replays clean instead of hitting the corrupt middle again
+    db3, dur3 = reopen(tmp_path)
+    rep3 = dur3.replay_report()
+    assert rep3["torn_bytes"] == 0 and not rep3["lost_segments"]
+    assert dur3.main.gen >= 3  # quarantined generations never reused
+    with db3.tx() as t:
+        assert t.get("T", b"a") == b"1"
+
+
+def test_append_failure_rewinds_log_and_releases_writer_lock(
+        tmp_path, monkeypatch):
+    """ENOSPC/EIO mid-append: commit raises, but the writer lock is
+    released immediately (not at __del__) and the half-written frame is
+    truncated away so later appends don't get buried behind it."""
+    import errno
+
+    db, dur = reopen(tmp_path)
+    with db.tx_mut() as tx:
+        tx.put("T", b"a", b"1")
+    seg = dur.main.dir / "00000001.wal"
+    good_size = seg.stat().st_size
+
+    fail = {"on": True}
+    real_fsync = os.fsync
+
+    def flaky(fd):
+        if fail["on"]:
+            raise OSError(errno.EIO, "injected EIO")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", flaky)
+    with pytest.raises(OSError):
+        with db.tx_mut() as tx:
+            tx.put("T", b"b", b"2")
+    fail["on"] = False
+    # failed record rewound: the segment holds exactly the good bytes
+    assert seg.stat().st_size == good_size
+    # writer lock released: the next write txn proceeds (no deadlock)
+    with db.tx_mut() as tx:
+        tx.put("T", b"c", b"3")
+    # the unpublished commit is absent, the log stays well-framed
+    with db.tx() as t:
+        assert t.get("T", b"b") is None
+    db2, dur2 = reopen(tmp_path)
+    rep = dur2.replay_report()
+    assert rep["records"] == 2 and rep["torn_bytes"] == 0
+    with db2.tx() as t:
+        assert t.get("T", b"a") == b"1"
+        assert t.get("T", b"b") is None
+        assert t.get("T", b"c") == b"3"
+
+
+def test_fsync_file_propagates_real_io_errors(tmp_path, monkeypatch):
+    import errno
+
+    from reth_tpu.storage.wal import fsync_file
+
+    with open(tmp_path / "x", "wb") as f:
+        monkeypatch.setattr(os, "fsync", lambda fd: (_ for _ in ()).throw(
+            OSError(errno.EIO, "injected EIO")))
+        with pytest.raises(OSError):
+            fsync_file(f)
+        # "fsync unsupported here" stays best-effort (pipes, special fs)
+        monkeypatch.setattr(os, "fsync", lambda fd: (_ for _ in ()).throw(
+            OSError(errno.EINVAL, "not supported")))
+        fsync_file(f)
+
+
+def test_segment_gen_mismatch_treated_as_torn(tmp_path):
+    """A mis-renamed / cross-copied segment must not replay under the
+    wrong generation order: the header gen is validated against the
+    filename."""
+    db, dur = reopen(tmp_path)
+    with db.tx_mut() as tx:
+        tx.put("T", b"a", b"1")
+    dur.main.close()
+    seg = tmp_path / "wal" / "00000001.wal"
+    renamed = tmp_path / "wal" / "00000005.wal"
+    seg.rename(renamed)
+    records, torn, accepted = read_segment(renamed)
+    assert records == [] and torn == renamed.stat().st_size
+    db2, dur2 = reopen(tmp_path)
+    assert dur2.replay_report()["records"] == 0
+    assert dur2.replay_report()["torn_bytes"] > 0
+    with db2.tx() as t:
+        assert t.get("T", b"a") is None
+
+
 def test_wal_crc_mismatch_discards_tail(tmp_path):
     db, dur = reopen(tmp_path)
     with db.tx_mut() as tx:
@@ -120,11 +283,14 @@ def test_wal_accept_torn_env_is_deliberately_broken(tmp_path, monkeypatch):
         tx.put("T", b"a", b"good")
     inject_bad_crc_record(tmp_path / "wal",
                           {"T": {"rows": {b"a": b"evil"}, "del": []}})
-    # correct reader: bad-CRC tail discarded
+    # correct reader: bad-CRC tail discarded (and truncated off disk)
     db2, _ = reopen(tmp_path)
     with db2.tx() as t:
         assert t.get("T", b"a") == b"good"
-    # broken reader: applied
+    # broken reader: applied (re-injected — the correct reader truncated
+    # the torn tail so post-recovery appends stay recoverable)
+    inject_bad_crc_record(tmp_path / "wal",
+                          {"T": {"rows": {b"a": b"evil"}, "del": []}})
     monkeypatch.setenv("RETH_TPU_FAULT_WAL_ACCEPT_TORN", "1")
     db3, dur3 = reopen(tmp_path)
     with db3.tx() as t:
@@ -501,6 +667,11 @@ def test_recovery_detects_corruption_injected_via_torn_acceptance(
     assert report["status"] in ("ok", "degraded")
     assert report["root_verified"] is True
     # broken reader: record applied -> the root proof must catch it
+    # (re-injected: the correct reader truncated the torn tail)
+    inject_bad_crc_record(tmp_path / "wal", {
+        Tables.HashedAccounts.name: {
+            "rows": {victim_key: b"\xde\xad" * 30}, "del": []},
+    })
     monkeypatch.setenv("RETH_TPU_FAULT_WAL_ACCEPT_TORN", "1")
     db3, dur3 = reopen(tmp_path)
     report3 = recover_on_startup(ProviderFactory(db3), durability=dur3,
