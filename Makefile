@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-chaos native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-chaos test-reorg native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -108,15 +108,31 @@ test-warmup:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_warmup.py -q -p no:cacheprovider
 
+# consensus robustness: orphan BlockBuffer bound/TTL + buffered-child
+# replay, invalid-cache LRU bound (incl. the @slow 10k-payload flood
+# acceptance drill), fcU cancellation of in-flight inserts with a
+# wedged proof worker held across the fcU, reorg-storm detection +
+# speculation backoff, deep-reorg depth accounting, and the
+# ForkBuilder/tamper machinery the chaos consensus domain drives —
+# CPU-only (tier-1 runs the same files minus the @slow flood)
+test-reorg:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_consensus_robustness.py \
+	  tests/test_engine_tree.py tests/test_sparse_root_engine.py \
+	  -q -p no:cacheprovider
+
 # crash-safe persistence + chaos drills: WAL format/replay/checkpoint
 # units, corrupt-image quarantine, reorg-across-restart, and the @slow
 # subprocess matrix — kill -9 at EVERY declared crash point
 # (RETH_TPU_FAULT_CRASH_AT), raw SIGKILL mid-mining, the 10-seed
-# composed-injector campaign (seeds printed on failure for exact replay
-# via `python -m reth_tpu.chaos scenario --seed N`), and the
-# deliberately-broken torn-record-accepted drill proving the invariant
-# suite can fail. Kill drills are `-m slow` so tier-1 keeps its budget;
-# this target runs everything — CPU-only, no device required
+# composed-injector storage campaign AND the 10-seed Engine-API
+# consensus campaign (seeded reorg storms vs a fault-free twin; seeds
+# printed on failure for exact replay via `python -m reth_tpu.chaos
+# scenario --domain storage|consensus --seed N`), the deep-reorg-
+# across-threshold SIGKILL drill, and the deliberately-broken
+# torn-record-accepted drill proving the invariant suite can fail.
+# Kill drills are `-m slow` so tier-1 keeps its budget; this target
+# runs everything — CPU-only, no device required
 test-chaos:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_wal_recovery.py tests/test_chaos.py \

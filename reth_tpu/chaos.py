@@ -32,6 +32,16 @@ Two layers:
   node live (mines again, no leaked hash-service lease). Every scenario
   prints its seed; ``python -m reth_tpu.chaos scenario --seed N``
   replays one exactly.
+- **Consensus domain** (``--domain consensus``): the same orchestrator
+  over an Engine-API adversarial victim
+  (:func:`child_consensus_victim`) — seeded reorg storms driven through
+  ``newPayload``/``forkchoiceUpdated`` by a
+  :class:`~reth_tpu.testing_actions.ForkBuilder` whose shadow tree is
+  the fault-free twin: side forks at random depths, deep reorgs across
+  the persistence threshold, orphan/duplicate/out-of-order payloads,
+  invalid payloads and floods, hostile forkchoice targets — under the
+  same composed injectors and crash points, with the same restart
+  invariant suite afterwards.
 
 The module stays import-light: storage (wal.py, kv.py, nippyjar.py) and
 the engine tree import :func:`crash_point` at module load; everything
@@ -160,6 +170,53 @@ def make_scenario(seed: int) -> dict:
     return scn
 
 
+def make_consensus_scenario(seed: int) -> dict:
+    """Deterministic Engine-API adversarial scenario: a seeded
+    reorg-storm schedule (side-chain forks, deep reorgs across the
+    persistence threshold, orphan/duplicate/out-of-order payloads,
+    invalid floods, hostile forkchoice targets) composed with a fault
+    sample and, for some seeds, a kill (crash point or SIGKILL) mid-
+    storm. Uses its own rng stream so storage-domain seeds stay stable."""
+    import random
+
+    rng = random.Random(0xC0DE0000 + seed)
+    faults: dict[str, str] = {}
+    for f in rng.sample(FAULT_MENU, k=rng.randint(1, 2)):
+        faults.update(f)
+    rounds = rng.randint(16, 26)
+    r = rng.random()
+    if r < 0.25:
+        scn: dict = {"mode": "kill", "kill_after": rng.randint(5, 10)}
+    elif r < 0.55:
+        point = rng.choice(("wal-append", "advance-persistence",
+                            "checkpoint-swap", "unwind"))
+        nth = {
+            "wal-append": rng.randint(6, 20),
+            "advance-persistence": rng.randint(2, 6),
+            "checkpoint-swap": rng.randint(1, 2),
+            "unwind": 1,
+        }[point]
+        scn = {"mode": "point", "point": point, "nth": nth}
+    else:
+        # run the whole storm: the victim's own fault-free-twin checks
+        # must hold live, and the restart invariants still run after
+        scn = {"mode": "complete"}
+    scn.update({
+        "domain": "consensus",
+        "seed": seed,
+        "faults": faults,
+        "rounds": rounds,
+        "threshold": 2,
+        # the unwind crash point only fires inside a persisted-chain
+        # unwind, so those seeds guarantee a deep reorg
+        "force_deep_reorg": (scn.get("point") == "unwind"
+                             or rng.random() < 0.3),
+        "hash_service": rng.random() < 0.4
+        or "RETH_TPU_FAULT_SERVICE_STALL" in faults,
+    })
+    return scn
+
+
 # -- child processes ----------------------------------------------------------
 
 
@@ -253,6 +310,248 @@ def child_victim(datadir: str, seed: int, blocks: int, threshold: int = 2,
             urllib.request.urlopen(req, timeout=5).read()
         except Exception:  # noqa: BLE001 - shed drills reply -32005/queue full
             pass
+    node.stop()
+    return 0
+
+
+def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
+                           threshold: int = 2, hash_service: bool = False,
+                           force_deep_reorg: bool = False) -> int:
+    """Drive the dev node's engine tree as a hostile CL: seeded
+    randomized interleavings of newPayload/forkchoiceUpdated — side
+    forks at random depths, deep reorgs across the persistence
+    threshold, orphan/out-of-order/duplicate payloads, invalid payloads
+    (bad root/gas/receipts + invalid-ancestor chains + floods), fcU to
+    stale/unknown/invalid heads — while the composed ``RETH_TPU_FAULT_*``
+    injectors (and any armed crash point) fire underneath.
+
+    Every block is minted by a :class:`~reth_tpu.testing_actions.ForkBuilder`
+    whose shadow tree executes it fault-free first, so each VALID the
+    node returns is already a bit-identical-root agreement with the
+    twin. Canonical commits are recorded in ``child_victim``'s format
+    (reorg intents included), so :func:`child_recover` applies the full
+    restart invariant suite unchanged. ``rounds <= 0`` storms forever
+    (the kill-mode orchestrator ends us)."""
+    import random
+
+    from .engine.tree import PayloadStatusKind
+    from .testing_actions import ForkBuilder, tampered_block
+
+    datadir = Path(datadir)
+    node, wallet, builder = _build_node(datadir, seed, threshold,
+                                        hash_service, fresh=True)
+    http_port, _ = node.start_rpc()
+    fb = ForkBuilder(builder.genesis, builder.accounts_at_genesis,
+                     wallet=wallet, committer=_cpu_committer())
+    rng = random.Random(0xAD0E0000 + seed)
+    rec = open(_record_path(datadir), "a")
+    recorded: set[bytes] = set()
+    head = builder.genesis.hash
+    VALID, SYNCING, INVALID = (PayloadStatusKind.VALID,
+                               PayloadStatusKind.SYNCING,
+                               PayloadStatusKind.INVALID)
+
+    def expect(st, *allowed, op=""):
+        if st.status not in allowed:
+            raise AssertionError(
+                f"consensus storm: {op} returned {st.status.name} "
+                f"({st.validation_error}), wanted "
+                f"{'/'.join(a.name for a in allowed)}")
+        return st
+
+    def record_canonical(new_head):
+        chain = []
+        h = new_head
+        while h != fb.genesis_hash and h not in recorded:
+            blk = fb.blocks[h]
+            chain.append(blk)
+            h = blk.header.parent_hash
+        for blk in reversed(chain):
+            rec.write(json.dumps({
+                "n": blk.header.number, "hash": blk.hash.hex(),
+                "root": blk.header.state_root.hex(),
+                "rlp": blk.encode().hex(),
+            }) + "\n")
+            recorded.add(blk.hash)
+        rec.flush()
+
+    def fcu(target, *allowed, op=""):
+        nonlocal head
+        # reorg-intent marker BEFORE a non-extending fcU: a crash inside
+        # the unwind legitimately recovers to the branch point, and the
+        # invariant suite only allows that if the record says it was
+        # coming
+        branch = fb.branch_point(head, target)
+        if branch is not None and branch[0] < fb.number_of(head):
+            rec.write(json.dumps({"reorg_to": branch[0]}) + "\n")
+            rec.flush()
+        st = expect(node.tree.on_forkchoice_updated(target), *allowed, op=op)
+        if st.status is VALID and target in fb.blocks:
+            head = target
+            record_canonical(target)
+        return st
+
+    def op_extend():
+        blk = fb.block_on(head, txs=rng.randint(0, 2),
+                          salt=rng.randint(0, 3))
+        expect(node.tree.on_new_payload(blk), VALID, op="extend.newPayload")
+        fcu(blk.hash, VALID, op="extend.fcu")
+
+    def op_side_fork():
+        hn = fb.number_of(head)
+        if hn < 2:
+            return op_extend()
+        depth = rng.randint(1, min(4, hn))
+        anc = fb.ancestor(head, depth)
+        tip = anc
+        for i in range(rng.randint(1, depth + 1)):
+            blk = fb.block_on(tip, txs=rng.randint(0, 1),
+                              salt=rng.randint(4, 9))
+            # VALID when the parent is in the tree, SYNCING (buffered)
+            # when it sits below the persisted tip — never INVALID
+            expect(node.tree.on_new_payload(blk), VALID, SYNCING,
+                   op="fork.newPayload")
+            tip = blk.hash
+        if rng.random() < 0.6:
+            fcu(tip, VALID, op="fork.fcu")
+
+    def op_deep_reorg():
+        # branch BELOW the node's persisted tip with a strictly longer
+        # fork: forces the pipeline unwind + buffered replay path (and
+        # the 'unwind' crash window)
+        pn = node.tree.persisted_number
+        hn = fb.number_of(head)
+        if pn < 1 or hn <= pn:
+            return op_extend()
+        anc = fb.ancestor(head, hn - max(0, pn - 1))
+        tip = anc
+        for _ in range(hn - fb.number_of(anc) + 1):
+            blk = fb.block_on(tip, txs=1, salt=rng.randint(10, 14))
+            expect(node.tree.on_new_payload(blk), VALID, SYNCING,
+                   op="deep.newPayload")
+            tip = blk.hash
+        fcu(tip, VALID, op="deep.fcu")
+
+    def op_rewind():
+        hn = fb.number_of(head)
+        if hn < 2:
+            return op_extend()
+        anc = fb.ancestor(head, rng.randint(1, min(3, hn)))
+        fcu(anc, VALID, op="rewind.fcu")
+
+    def op_orphan():
+        # child before parent: SYNCING + buffered, then the parent's
+        # arrival must replay the child (reference BlockBuffer shape)
+        a = fb.block_on(head, txs=1, salt=rng.randint(15, 17))
+        b = fb.block_on(a.hash, txs=0, salt=0)
+        expect(node.tree.on_new_payload(b), SYNCING, op="orphan.child")
+        expect(node.tree.on_new_payload(a), VALID, op="orphan.parent")
+        if b.hash not in node.tree.blocks:
+            raise AssertionError(
+                "consensus storm: buffered child not replayed when its "
+                "parent arrived")
+        fcu(b.hash, VALID, op="orphan.fcu")
+
+    def op_duplicate():
+        if fb.number_of(head) == 0:
+            return op_extend()
+        expect(node.tree.on_new_payload(fb.blocks[head]), VALID,
+               op="duplicate.newPayload")
+
+    def op_unknown_orphan():
+        salt = rng.getrandbits(64).to_bytes(8, "big")
+        blk = tampered_block(fb.blocks[head], "unknown_parent", salt=salt)
+        expect(node.tree.on_new_payload(blk), SYNCING, op="orphan.unknown")
+
+    def op_invalid():
+        kind = rng.choice(("state_root", "gas_used", "receipts_root",
+                           "gas_limit"))
+        base = fb.block_on(head, txs=1, salt=rng.randint(18, 21))
+        bad = tampered_block(base, kind)
+        expect(node.tree.on_new_payload(bad), INVALID,
+               op=f"invalid.{kind}")
+        # descendants of a known-invalid block: invalid ancestor, and an
+        # fcU to the invalid head is refused
+        child = tampered_block(base, "reparent", salt=bad.hash)
+        expect(node.tree.on_new_payload(child), INVALID,
+               op="invalid.ancestor")
+        expect(node.tree.on_forkchoice_updated(bad.hash), INVALID,
+               op="invalid.fcu")
+
+    def op_fcu_unknown():
+        fake = rng.getrandbits(256).to_bytes(32, "big")
+        expect(node.tree.on_forkchoice_updated(fake), SYNCING,
+               op="fcu.unknown")
+
+    def op_invalid_flood():
+        base = fb.block_on(head, txs=0, salt=22)
+        bad = tampered_block(base, "state_root")
+        expect(node.tree.on_new_payload(bad), INVALID, op="flood.seed")
+        for i in range(120):
+            child = tampered_block(base, "reparent",
+                                   salt=bad.hash + i.to_bytes(4, "big"))
+            expect(node.tree.on_new_payload(child), INVALID, op="flood")
+        cap = node.tree.invalid.capacity
+        if len(node.tree.invalid) > cap:
+            raise AssertionError(
+                f"invalid cache exceeded its bound: "
+                f"{len(node.tree.invalid)} > {cap}")
+
+    ops = [(op_extend, 4), (op_side_fork, 3), (op_deep_reorg, 1),
+           (op_rewind, 1), (op_orphan, 2), (op_duplicate, 1),
+           (op_unknown_orphan, 1), (op_invalid, 2), (op_fcu_unknown, 1),
+           (op_invalid_flood, 1)]
+    weights = [w for _, w in ops]
+    i = 0
+    while rounds <= 0 or i < rounds:
+        i += 1
+        if i <= 3:
+            op_extend()  # establish a chain before the storm proper
+        elif force_deep_reorg and i == 6:
+            op_deep_reorg()
+        else:
+            rng.choices([f for f, _ in ops], weights=weights, k=1)[0]()
+        if i % 3 == 0:
+            # a little read traffic so gateway-class injectors fire
+            try:
+                import urllib.request
+
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{http_port}/",
+                    data=json.dumps({"jsonrpc": "2.0", "id": 1,
+                                     "method": "eth_blockNumber",
+                                     "params": []}).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:  # noqa: BLE001 - shed drills reply -32005
+                pass
+
+    # storm over: in-process invariants against the fault-free twin.
+    # (Every VALID above already certified a bit-identical root — both
+    # trees checked the same header.state_root — so what is left is the
+    # head agreement, live state equivalence, and leak checks.)
+    if node.tree.head_hash != head:
+        raise AssertionError("node head diverged from the storm schedule")
+    if fb.number_of(head) > 0:
+        a_node = node.tree.overlay_provider(head).account(wallet.address)
+        a_twin = fb.tree.overlay_provider(head).account(wallet.address)
+        if (a_node is None) != (a_twin is None) or (
+                a_node is not None
+                and (a_node.nonce, a_node.balance)
+                != (a_twin.nonce, a_twin.balance)):
+            raise AssertionError("live state diverged from fault-free twin")
+    svc = getattr(node.committer, "hash_service", None)
+    if svc is not None and svc.snapshot().get("leased_by"):
+        raise AssertionError("leaked hash-service lease after the storm")
+    if getattr(node.factory.db, "_writer_thread", None) is not None:
+        raise AssertionError("leaked store writer lock after the storm")
+    if len(node.tree.invalid) > node.tree.invalid.capacity:
+        raise AssertionError("invalid cache over its bound after the storm")
+    print(f"STORM ok seed={seed} rounds={i} head={fb.number_of(head)} "
+          f"reorgs={node.tree.reorgs.reorgs} "
+          f"deep={node.tree.reorgs.max_depth} "
+          f"invalid_cached={len(node.tree.invalid)} "
+          f"orphans={len(node.tree.buffered)}", flush=True)
     node.stop()
     return 0
 
@@ -450,12 +749,18 @@ def child_recover(datadir: str, seed: int, threshold: int = 2,
 
 
 def _child_cmd(mode: str, datadir: Path, scn: dict) -> list[str]:
+    if mode == "victim" and scn.get("domain") == "consensus":
+        mode = "consensus"
     cmd = [sys.executable, "-m", "reth_tpu.chaos", mode,
            "--datadir", str(datadir), "--seed", str(scn["seed"]),
            "--threshold", str(scn["threshold"])]
     if scn.get("hash_service"):
         cmd.append("--hash-service")
-    if mode == "victim":
+    if mode == "consensus":
+        cmd += ["--rounds", str(scn["rounds"])]
+        if scn.get("force_deep_reorg"):
+            cmd.append("--force-deep-reorg")
+    elif mode == "victim":
         cmd += ["--blocks", str(scn["blocks"]),
                 "--reorg-at", str(scn.get("reorg_at", 0))]
     return cmd
@@ -486,12 +791,15 @@ def run_scenario(scn: dict, base_dir: str | Path,
         except OSError:
             return ""
 
+    # consensus-domain victims count storm rounds, storage victims blocks
+    count_flag = "--rounds" if scn.get("domain") == "consensus" else "--blocks"
+    count_key = "rounds" if scn.get("domain") == "consensus" else "blocks"
     log = open(log_path, "w")
     try:
         if scn["mode"] == "point":
             env["RETH_TPU_FAULT_CRASH_AT"] = f"{scn['point']}:{scn['nth']}"
-            # mine until the point fires; cap so a mis-aimed nth still ends
-            cmd[cmd.index("--blocks") + 1] = str(scn["blocks"] + 20)
+            # run until the point fires; cap so a mis-aimed nth still ends
+            cmd[cmd.index(count_flag) + 1] = str(scn[count_key] + 20)
             proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
             try:
                 proc.wait(timeout=timeout)
@@ -506,8 +814,26 @@ def run_scenario(scn: dict, base_dir: str | Path,
                               error=f"crash point never fired "
                                     f"(rc={proc.returncode}): {_log_tail()}")
                 return result
+        elif scn["mode"] == "complete":
+            # the full storm runs to the end: the victim's own in-process
+            # twin/leak invariants must hold (rc 0) before the restart
+            # invariant suite runs below
+            proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                result.update(ok=False, error="victim timeout")
+                return result
+            result["victim_rc"] = proc.returncode
+            if proc.returncode != 0:
+                result.update(ok=False,
+                              error=f"storm failed its live invariants "
+                                    f"(rc={proc.returncode}): {_log_tail()}")
+                return result
         else:
-            cmd[cmd.index("--blocks") + 1] = "0"  # mine until killed
+            cmd[cmd.index(count_flag) + 1] = "0"  # run until killed
             proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
             rec = _record_path(datadir)
             deadline = time.time() + timeout
@@ -550,23 +876,31 @@ def run_scenario(scn: dict, base_dir: str | Path,
     return result
 
 
-def run_campaign(seeds, base_dir: str | Path) -> list[dict]:
+def run_campaign(seeds, base_dir: str | Path,
+                 domain: str = "storage") -> list[dict]:
+    make = (make_consensus_scenario if domain == "consensus"
+            else make_scenario)
     results = []
     for seed in seeds:
-        scn = make_scenario(int(seed))
+        scn = make(int(seed))
         t0 = time.time()
         res = run_scenario(scn, base_dir)
         res["scenario_wall_s"] = round(time.time() - t0, 1)
         tag = "ok" if res.get("ok") else "FAIL"
-        kill = (f"point={scn.get('point')}:{scn.get('nth')}"
-                if scn["mode"] == "point"
-                else f"kill_after={scn['kill_after']}")
-        print(f"chaos seed={seed} {tag} {kill} faults={sorted(scn['faults'])} "
+        if scn["mode"] == "point":
+            kill = f"point={scn.get('point')}:{scn.get('nth')}"
+        elif scn["mode"] == "kill":
+            kill = f"kill_after={scn['kill_after']}"
+        else:
+            kill = "complete"
+        print(f"chaos[{domain}] seed={seed} {tag} {kill} "
+              f"faults={sorted(scn['faults'])} "
               f"blocks={res.get('blocks_recorded')} "
               f"recovered={res.get('recovered', {}).get('number')} "
               f"wall={res['scenario_wall_s']}s", flush=True)
         if not res.get("ok"):
-            print(f"  replay: python -m reth_tpu.chaos scenario --seed {seed}"
+            print(f"  replay: python -m reth_tpu.chaos scenario "
+                  f"--domain {domain} --seed {seed}"
                   f"  ({res.get('error') or res.get('invariants')})",
                   flush=True)
         results.append(res)
@@ -619,6 +953,19 @@ def main(argv=None) -> int:
     pv.add_argument("--hash-service", dest="hash_service",
                     action="store_true")
 
+    pk = sub.add_parser("consensus",
+                        help="(child) Engine-API adversarial storm until "
+                             "done, crashed, or killed")
+    pk.add_argument("--datadir", required=True)
+    pk.add_argument("--seed", type=int, required=True)
+    pk.add_argument("--rounds", type=int, default=20,
+                    help="0 = storm until killed")
+    pk.add_argument("--threshold", type=int, default=2)
+    pk.add_argument("--hash-service", dest="hash_service",
+                    action="store_true")
+    pk.add_argument("--force-deep-reorg", dest="force_deep_reorg",
+                    action="store_true")
+
     pr = sub.add_parser("recover", help="(child) restart + invariant suite")
     pr.add_argument("--datadir", required=True)
     pr.add_argument("--seed", type=int, required=True)
@@ -628,17 +975,25 @@ def main(argv=None) -> int:
 
     ps = sub.add_parser("scenario", help="run one seeded scenario")
     ps.add_argument("--seed", type=int, required=True)
+    ps.add_argument("--domain", choices=("storage", "consensus"),
+                    default="storage")
     ps.add_argument("--base", default=None)
 
     pc = sub.add_parser("campaign", help="run a seeded scenario matrix")
     pc.add_argument("--seeds", default="1,2,3,4,5,6,7,8,9,10",
                     help="comma list, or N for range(1, N+1)")
+    pc.add_argument("--domain", choices=("storage", "consensus"),
+                    default="storage")
     pc.add_argument("--base", default=None)
 
     args = parser.parse_args(argv)
     if args.command == "victim":
         return child_victim(args.datadir, args.seed, args.blocks,
                             args.threshold, args.reorg_at, args.hash_service)
+    if args.command == "consensus":
+        return child_consensus_victim(args.datadir, args.seed, args.rounds,
+                                      args.threshold, args.hash_service,
+                                      args.force_deep_reorg)
     if args.command == "recover":
         return child_recover(args.datadir, args.seed, args.threshold,
                              args.hash_service)
@@ -646,14 +1001,17 @@ def main(argv=None) -> int:
 
     base = args.base or tempfile.mkdtemp(prefix="reth-tpu-chaos-")
     if args.command == "scenario":
-        res = run_scenario(make_scenario(args.seed), base)
+        make = (make_consensus_scenario if args.domain == "consensus"
+                else make_scenario)
+        res = run_scenario(make(args.seed), base)
         print(json.dumps(res, indent=2, default=str))
         return 0 if res.get("ok") else 1
     seeds = ([int(s) for s in args.seeds.split(",")]
              if "," in args.seeds else list(range(1, int(args.seeds) + 1)))
-    results = run_campaign(seeds, base)
+    results = run_campaign(seeds, base, domain=args.domain)
     bad = [r for r in results if not r.get("ok")]
-    print(f"chaos campaign: {len(results) - len(bad)}/{len(results)} passed")
+    print(f"chaos campaign[{args.domain}]: "
+          f"{len(results) - len(bad)}/{len(results)} passed")
     return 1 if bad else 0
 
 

@@ -424,6 +424,8 @@ def cmd_node(args):
                          args, "wal_checkpoint_blocks", 8),
                      recovery_verify_root=getattr(
                          args, "recovery_verify_root", True),
+                     invalid_cache_size=getattr(
+                         args, "invalid_cache_size", None),
                      # --trace-blocks; unset falls back to RETH_TPU_TRACE
                      trace_blocks=(args.trace_blocks
                                    if getattr(args, "trace_blocks", None)
@@ -813,6 +815,7 @@ def cmd_config(args):
         f"health = {'true' if cfg.health else 'false'}",
         f"slo_interval = {cfg.slo_interval}",
         f"slo_window = {cfg.slo_window}",
+        f"invalid_cache_size = {cfg.invalid_cache_size}",
         "",
         "[rpc]",
         f"gateway = {'true' if cfg.rpc.gateway else 'false'}",
@@ -1244,6 +1247,12 @@ def main(argv=None) -> int:
                         "recomputation through the committer (large "
                         "datadirs trade the proof for boot time; also "
                         "RETH_TPU_RECOVERY_VERIFY=0)")
+    p.add_argument("--invalid-cache-size", dest="invalid_cache_size",
+                   type=int, default=None,
+                   help="bound of the engine tree's invalid-header LRU "
+                        "(default 512): an invalid-payload flood plateaus "
+                        "here instead of leaking memory. Also "
+                        "RETH_TPU_INVALID_CACHE / [node] invalid_cache_size")
     p.set_defaults(fn=cmd_node)
 
     p = sub.add_parser("dump-genesis", help="print the dev genesis JSON")

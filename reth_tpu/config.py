@@ -163,6 +163,10 @@ class RethTpuConfig:
     # verify the recovered head's state root by recomputation through
     # the committer at startup (--no-recovery-verify opts out)
     recovery_verify_root: bool = True
+    # bound of the engine tree's invalid-header LRU (--invalid-cache-size
+    # CLI / RETH_TPU_INVALID_CACHE env): an invalid-payload flood
+    # plateaus at this many cached rejections instead of leaking memory
+    invalid_cache_size: int = 512
 
 
 def _prune_mode(d: dict) -> PruneMode:
@@ -207,6 +211,8 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
                                              cfg.wal_checkpoint_blocks))
     cfg.recovery_verify_root = bool(node.get("recovery_verify_root",
                                              cfg.recovery_verify_root))
+    cfg.invalid_cache_size = int(node.get("invalid_cache_size",
+                                          cfg.invalid_cache_size))
     rpc = raw.get("rpc", {})
     cfg.rpc.gateway = bool(rpc.get("gateway", cfg.rpc.gateway))
     cfg.rpc.gateway_cache = int(rpc.get("gateway_cache", cfg.rpc.gateway_cache))

@@ -87,6 +87,13 @@ class ExecSchedulerError(Exception):
     """The optimistic scheduler could not finish; use the serial path."""
 
 
+class ExecCancelled(Exception):
+    """Cooperative cancellation (a forkchoiceUpdated reorged away from
+    the block mid-execution): NOT a scheduler failure — it must
+    propagate to the engine, never fall back to a serial re-run of a
+    dead head's block."""
+
+
 def default_exec_workers() -> int:
     """Speculation width: RETH_TPU_EXEC_WORKERS, else core-derived."""
     env = os.environ.get("RETH_TPU_EXEC_WORKERS")
@@ -221,7 +228,7 @@ class OptimisticScheduler:
                  config=None, max_workers: int | None = None,
                  state_hook=None, env=None, block=None, block_hashes=None,
                  mode: str = "block", withdrawals=None,
-                 blob_cap: int | None = None):
+                 blob_cap: int | None = None, cancel_event=None):
         self.txs = list(transactions)
         self.senders = senders
         self.config = config
@@ -231,6 +238,9 @@ class OptimisticScheduler:
         self.blob_cap = blob_cap
         self.blob_gas_used = 0
         self.state_hook = state_hook
+        # cooperative cancellation (engine tree in-flight insert event):
+        # checked at wave boundaries so a reorging fcU stops the rounds
+        self.cancel_event = cancel_event
         self.workers = max_workers or default_exec_workers()
         self.env = env if env is not None else _block_env(
             block, config, block_hashes)
@@ -584,6 +594,8 @@ class OptimisticScheduler:
 
         pos = 0
         while pos < n:
+            if self.cancel_event is not None and self.cancel_event.is_set():
+                raise ExecCancelled("forkchoice reorged away mid-wave")
             if not self.eligible[pos]:
                 self._commit_python_rank(pos)
                 pos += 1
@@ -630,23 +642,29 @@ class OptimisticScheduler:
 
 def execute_block_optimistic(source: StateSource, block, senders,
                              config=None, max_workers: int | None = None,
-                             state_hook=None, block_hashes=None):
+                             state_hook=None, block_hashes=None,
+                             cancel_event=None):
     """Execute ``block`` with the optimistic scheduler; output is
     bit-identical to ``BlockExecutor.execute`` (including system calls,
     EIP-7685 requests, and withdrawals). Returns ``(output, stats)``.
     Consensus-invalid transactions raise :class:`InvalidTransaction`
     exactly like the serial path; ANY other scheduler failure falls back
-    to a full serial re-run (``stats["fallback"]`` records why)."""
+    to a full serial re-run (``stats["fallback"]`` records why).
+    ``cancel_event`` set mid-run raises :class:`ExecCancelled` instead —
+    a reorged-away block must not be re-run at all."""
     sched = None
     try:
         sched = OptimisticScheduler(
             source, block.transactions, senders, config=config,
             max_workers=max_workers, state_hook=state_hook, block=block,
-            block_hashes=block_hashes, mode="block")
+            block_hashes=block_hashes, mode="block",
+            cancel_event=cancel_event)
         out = sched.run()
         return out, sched.stats
     except InvalidTransaction:
         raise  # genuinely invalid block — identical to serial behavior
+    except ExecCancelled:
+        raise  # cooperative abort — never serial-re-run a dead head
     except Exception as e:  # noqa: BLE001 — fallback ladder's last rung
         stats = dict(sched.stats) if sched is not None else {}
         stats["fallback"] = f"{type(e).__name__}: {e}"

@@ -96,6 +96,11 @@ class SparseRootTask:
         self._digests: dict[bytes, bytes] = {}
         self._sent: set = set()
         self._failed: Exception | None = None
+        # cooperative cancellation (engine/tree.py _cancel_inflight_for):
+        # a forkchoiceUpdated reorging away from this block sets it from
+        # ANOTHER thread; the worker stops at its next batch boundary and
+        # finish() refuses to produce a root for the dead head
+        self.cancelled = False
         self.proof_batches = 0
         self.commit_stats: dict | None = None
         # per-block wall breakdown (round-5 directive: measure the overlap
@@ -134,6 +139,8 @@ class SparseRootTask:
     def _run(self) -> None:
         while True:
             batch = self._queue.get()
+            if self.cancelled:
+                return  # no drain: in-flight proof shards die with pools
             if batch is None:
                 if self._failed is None:
                     try:
@@ -295,6 +302,8 @@ class SparseRootTask:
             self._shutdown_pools()
 
     def _finish_inner(self, out):
+        if self.cancelled:
+            raise SparseRootError("cancelled by forkchoice reorg")
         if self._failed is not None:
             raise SparseRootError(f"worker failed: {self._failed}") \
                 from self._failed
@@ -310,6 +319,8 @@ class SparseRootTask:
                 self._digests[k] = bytes(d)
         storage_roots: dict[bytes, bytes] = {}
         for _attempt in range(self.MAX_REVEAL_RETRIES):
+            if self.cancelled:
+                raise SparseRootError("cancelled by forkchoice reorg")
             try:
                 # parallel commit: cross-trie packed dispatches + encode
                 # pool; any failure inside it (including the injected
@@ -414,3 +425,11 @@ class SparseRootTask:
         self._queue.put(None)
         self._thread.join()
         self._shutdown_pools()
+
+    def cancel(self) -> None:
+        """Non-blocking abort from ANOTHER thread (a forkchoiceUpdated
+        reorging away from this block): flag the task, wake the worker.
+        The insert thread still owns the blocking cleanup — its abort /
+        finish path joins the worker and shuts the pools down."""
+        self.cancelled = True
+        self._queue.put(None)

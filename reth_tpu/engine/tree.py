@@ -1,20 +1,26 @@
 """The engine tree: newPayload / forkchoiceUpdated / persistence.
 
-Reference analogue: `EngineApiTreeHandler::on_new_payload` (insert +
-validate + state root, crates/engine/tree/src/tree/mod.rs:762),
-`on_forkchoice_updated` (:1175), `TreeState`, `advance_persistence`
-(:1449) + `PersistenceHandle`. The per-block state-root job — the
-reference's SparseTrieCacheTask pipeline — is the batched incremental
-committer over the block's overlay.
+Reference analogue: `EngineApiTreeHandler` (crates/engine/tree,
+tree module) — `on_new_payload` (insert + validate + state root),
+`on_forkchoice_updated`, `TreeState`, the orphan `BlockBuffer` and the
+bounded `InvalidHeaderCache` (both in engine/block_buffer.py here), and
+`advance_persistence` + `PersistenceHandle` (the persistence service).
+The per-block state-root job — the reference's SparseTrieCacheTask
+pipeline — is the batched incremental committer over the block's
+overlay. Consensus-robustness behavior (orphan buffering/replay,
+invalid-ancestor propagation, in-flight insert cancellation on
+forkchoice reorgs, reorg-storm backoff) is documented in ARCHITECTURE
+"Consensus robustness".
 """
 
 from __future__ import annotations
 
+import threading
 import time as _time
 from dataclasses import dataclass, field
 from enum import Enum
 
-from ..metrics import REGISTRY
+from ..metrics import REGISTRY, tree_metrics
 from .. import tracing
 from ..chaos import crash_point
 
@@ -41,6 +47,24 @@ class PayloadStatus:
     status: PayloadStatusKind
     latest_valid_hash: bytes | None = None
     validation_error: str | None = None
+
+
+class PayloadCancelled(Exception):
+    """An in-flight insert was cancelled by a competing
+    forkchoiceUpdated reorging away from it; the payload reports
+    SYNCING instead of finishing against a dead head."""
+
+
+@dataclass
+class _InFlightInsert:
+    """The one insert currently racing forkchoice (engine handlers may
+    run on different transport threads): its identity plus the hooks a
+    reorging fcU uses to abort the speculative machinery."""
+
+    block_hash: bytes
+    parent_hash: bytes
+    cancel: threading.Event = field(default_factory=threading.Event)
+    sparse_task: object = None
 
 
 @dataclass
@@ -79,6 +103,9 @@ class EngineTree:
         sparse_workers: int | None = None,
         parallel_exec: bool = False,
         exec_workers: int | None = None,
+        invalid_cache_size: int | None = None,
+        block_buffer_size: int | None = None,
+        block_buffer_ttl: float | None = None,
     ):
         self.factory = factory
         self.committer = committer or TrieCommitter()
@@ -122,7 +149,8 @@ class EngineTree:
         # (reference state_root_strategy/sparse_trie.rs); anything else
         # runs the prehash-only pipelined worker + incremental committer.
         # The sparse path falls back to the incremental committer on any
-        # SparseRootError (reference config.rs:140 state_root_fallback).
+        # SparseRootError (reference engine-primitives config,
+        # `state_root_fallback`).
         self.state_root_strategy = state_root_strategy
         # --sparse-workers: width of the sparse finish path's encode pool
         # AND the proof-worker pool (None = env/auto; 1 = pools off, the
@@ -145,10 +173,27 @@ class EngineTree:
         # way durability no longer waits for graceful shutdown
         self.durability = None
         self.blocks: dict[bytes, ExecutedBlock] = {}
-        self.invalid: dict[bytes, str] = {}
-        # blocks whose parent is unknown yet (reference BlockBuffer,
-        # crates/engine/tree/src/tree/block_buffer.rs)
-        self.buffered: dict[bytes, Block] = {}
+        from .block_buffer import BlockBuffer, InvalidHeaderCache, ReorgTracker
+
+        # bounded LRU of rejected payloads (--invalid-cache-size /
+        # RETH_TPU_INVALID_CACHE): an invalid-payload flood plateaus at
+        # the bound instead of leaking memory (reference
+        # InvalidHeaderCache); dict-compatible for existing callers
+        self.invalid = InvalidHeaderCache(invalid_cache_size)
+        # blocks whose parent is unknown yet (reference BlockBuffer):
+        # bounded + timeout-evicted, and buffered children replay the
+        # moment the missing parent validates
+        self.buffered = BlockBuffer(limit=block_buffer_size,
+                                    ttl=block_buffer_ttl)
+        # reorg-depth accounting: pathological forkchoice churn dumps
+        # the flight recorder once and engages a backoff window during
+        # which the speculative paths (sparse root, optimistic exec,
+        # prewarm) stand down — they are exactly what the churn thrashes
+        self.reorgs = ReorgTracker()
+        # the insert currently in flight (engine transports may race a
+        # forkchoiceUpdated against it); guarded by _inflight_lock
+        self._inflight: _InFlightInsert | None = None
+        self._inflight_lock = threading.Lock()
         with factory.provider() as p:
             n = p.last_block_number()
             h = p.canonical_hash(n)
@@ -220,10 +265,12 @@ class EngineTree:
         h = block.hash
         if h in self.blocks:
             return PayloadStatus(PayloadStatusKind.VALID, h)
-        if h in self.invalid:
-            return PayloadStatus(PayloadStatusKind.INVALID, None, self.invalid[h])
+        reason = self.invalid.get(h)
+        if reason is not None:
+            return PayloadStatus(PayloadStatusKind.INVALID, None, reason)
         if block.header.parent_hash in self.invalid:
             self.invalid[h] = "invalid ancestor"
+            self._invalidate_buffered_children(h)
             return PayloadStatus(PayloadStatusKind.INVALID, None, "invalid ancestor")
         # replay of an already-persisted canonical block → VALID
         with self.factory.provider() as p:
@@ -231,17 +278,45 @@ class EngineTree:
                 return PayloadStatus(PayloadStatusKind.VALID, h)
         parent_layers = self._chain_layers(block.header.parent_hash)
         if parent_layers is None:
-            # parent unknown or below the persisted tip: buffer; a later FCU
-            # to this branch unwinds and replays (reference BlockBuffer)
-            self.buffered[h] = block
+            # parent unknown or below the persisted tip: buffer; the
+            # parent arriving (below) or a later FCU to this branch
+            # replays the buffered subtree (reference BlockBuffer)
+            self.buffered.insert(block)
             return PayloadStatus(PayloadStatusKind.SYNCING)
-        return self._validate_and_insert(block, parent_layers)
+        st = self._validate_and_insert(block, parent_layers)
+        if st.status is PayloadStatusKind.VALID:
+            self._replay_buffered_children(h)
+        elif st.status is PayloadStatusKind.INVALID:
+            self._invalidate_buffered_children(h)
+        return st
+
+    def _replay_buffered_children(self, parent_hash: bytes) -> None:
+        """The missing parent just validated: replay its buffered
+        children (recursing through on_new_payload, so grandchildren
+        follow and an invalid child invalidates its own subtree)."""
+        for child in self.buffered.take_children_of(parent_hash):
+            tree_metrics.orphans_replayed()
+            st = self.on_new_payload(child)
+            if st.status is PayloadStatusKind.SYNCING:
+                # replay interrupted (e.g. insert cancelled by a racing
+                # fcU): keep the child for the next trigger
+                self.buffered.insert(child)
+
+    def _invalidate_buffered_children(self, parent_hash: bytes) -> None:
+        """Invalid-ancestor propagation into the orphan buffer: children
+        waiting on a block that just proved invalid are invalid too."""
+        for child in self.buffered.take_children_of(parent_hash):
+            self.invalid[child.hash] = "invalid ancestor"
+            self._invalidate_buffered_children(child.hash)
 
     def _validate_and_insert(self, block: Block, parent_layers: list[Layer]) -> PayloadStatus:
         h = block.hash
         base = self.factory.db.tx()
         layer: Layer = {}
         overlay = DatabaseProvider(OverlayTx(base, parent_layers, layer))
+        inflight = _InFlightInsert(h, block.header.parent_hash)
+        with self._inflight_lock:
+            self._inflight = inflight
         try:
             # block-lifecycle trace root: trace_id = block hash; every
             # phase span below (and every queue/pool handoff that carries
@@ -254,11 +329,23 @@ class EngineTree:
                         block.header, parent)
                     self.consensus.validate_block_pre_execution(block)
                 status, senders, receipts = self._execute_into_overlay(
-                    block, overlay, parent_layers)
+                    block, overlay, parent_layers, inflight=inflight)
         except (ConsensusError, InvalidTransaction) as e:
             self.invalid[h] = str(e)
             self._run_invalid_hooks(block, str(e))
             return PayloadStatus(PayloadStatusKind.INVALID, None, str(e))
+        except PayloadCancelled:
+            # a competing forkchoiceUpdated reorged away mid-insert: the
+            # speculative work was aborted through the journaled paths;
+            # the payload itself may be perfectly valid, so report
+            # SYNCING (the CL re-sends if it still cares), never INVALID
+            tracing.event("engine::tree", "payload_cancelled",
+                          block=h.hex()[:16])
+            return PayloadStatus(PayloadStatusKind.SYNCING)
+        finally:
+            with self._inflight_lock:
+                if self._inflight is inflight:
+                    self._inflight = None
         if status.status is PayloadStatusKind.VALID:
             self.blocks[h] = ExecutedBlock(
                 block=block, senders=senders, receipts=receipts,
@@ -279,6 +366,7 @@ class EngineTree:
     def _execute_into_overlay(
         self, block: Block, overlay: DatabaseProvider,
         parent_layers: list[Layer] | None = None,
+        inflight: _InFlightInsert | None = None,
     ) -> tuple[PayloadStatus, list[bytes], list]:
         """Execute + hash + root-check ``block``, writing into the overlay.
 
@@ -329,22 +417,29 @@ class EngineTree:
         # background state-root job overlapping execution: the sparse
         # strategy streams touched keys to a proof-fetch + reveal worker
         # so the whole trie job (hash, walk, reveal) overlaps the EVM
-        # (reference state_root_strategy/sparse_trie.rs:126-259 +
-        # state_root_task.rs:20-100); the pipelined strategy overlaps key
+        # (reference the sparse-trie state-root strategy + the parallel
+        # state-root task); the pipelined strategy overlaps key
         # prehash only (engine/pipelined_root.py). Created BEFORE prewarm
         # so the warming workers can seed its proof prefetch below.
         self.last_sparse = None
         sparse_task = None
         root_job = None
+        # reorg-storm backoff: while a hostile CL churns forkchoice, the
+        # speculative paths (preserved sparse trie, optimistic exec,
+        # prewarm) are what every reorg invalidates — stand them down and
+        # serve through the serial + pipelined/incremental paths instead
+        speculate = not self.reorgs.in_backoff()
         block_ctx = tracing.current_context()  # the block's root span
         with tracing.span("engine::tree", "root_task_start"):
-            if self.state_root_strategy == "sparse":
+            if self.state_root_strategy == "sparse" and speculate:
                 sparse_task = self._start_sparse_root(block, parent_layers,
                                                       trace_ctx=block_ctx)
             if sparse_task is None:
                 from .pipelined_root import PipelinedStateRoot
 
                 root_job = PipelinedStateRoot(self.committer.hasher)
+        if inflight is not None:
+            inflight.sparse_task = sparse_task
         state_hook = (sparse_task or root_job).on_state_update
         self.last_prewarm = None  # bind the pass to THIS block only
         self.last_exec = None
@@ -354,13 +449,14 @@ class EngineTree:
         # the prewarm run (reads warm the shared cache and stream to the
         # sparse task), and validation-clean speculation commits instead
         # of being discarded and re-executed.
-        use_opt = (self.parallel_exec and not self.bal_execution
+        use_opt = (self.parallel_exec and not self.bal_execution and speculate
                    and len(block.transactions) >= self.prewarm_threshold)
         # prewarm: execute txs in parallel against PARENT state first,
         # purely to populate the execution cache (reference
         # payload_processor/prewarm.rs); canonical execution below then
         # runs against warm caches
-        if len(block.transactions) >= self.prewarm_threshold and not use_opt:
+        if (len(block.transactions) >= self.prewarm_threshold and not use_opt
+                and speculate):
             from ..evm.executor import blob_base_fee
             from ..evm.interpreter import BlockEnv
             from .prewarm import PrewarmTask
@@ -398,6 +494,17 @@ class EngineTree:
             else:
                 root_job.finish([])
 
+        def _cancel_guard():
+            # cooperative cancellation boundary: a forkchoiceUpdated that
+            # reorged away from this block set the in-flight event (and
+            # non-blockingly cancelled the sparse task); abort the root
+            # job through the journaled path instead of letting it finish
+            # against a dead head
+            if inflight is not None and inflight.cancel.is_set():
+                _abort_root_job()
+                raise PayloadCancelled(
+                    "forkchoice reorged away from in-flight block")
+
         use_bal = (self.bal_execution and self.last_prewarm is not None
                    and self.last_prewarm.record_accesses)
         try:
@@ -416,12 +523,19 @@ class EngineTree:
                         state_hook=state_hook, block_hashes=hashes)
                     self._record_exec_metrics(bal=self.last_bal_stats)
                 elif use_opt:
-                    from .optimistic import execute_block_optimistic
+                    from .optimistic import ExecCancelled, execute_block_optimistic
 
-                    out, self.last_exec = execute_block_optimistic(
-                        executor.source, block, senders, self.config,
-                        max_workers=self.exec_workers,
-                        state_hook=state_hook, block_hashes=hashes)
+                    try:
+                        out, self.last_exec = execute_block_optimistic(
+                            executor.source, block, senders, self.config,
+                            max_workers=self.exec_workers,
+                            state_hook=state_hook, block_hashes=hashes,
+                            cancel_event=(inflight.cancel
+                                          if inflight is not None else None))
+                    except ExecCancelled as e:
+                        # the scheduler stopped its waves mid-round; the
+                        # BaseException handler below aborts the root job
+                        raise PayloadCancelled(str(e)) from e
                     self._record_exec_metrics(optimistic=self.last_exec)
                 else:
                     out = executor.execute(block, senders, hashes,
@@ -433,6 +547,7 @@ class EngineTree:
             raise
         if self.last_prewarm is not None:
             self.last_prewarm.join()
+        _cancel_guard()
         try:
             with tracing.span("engine::tree", "post_validate"):
                 self.consensus.validate_block_post_execution(
@@ -451,6 +566,7 @@ class EngineTree:
                 overlay.put_sender(idx.first_tx_num + i, s)
             write_execution_output(overlay, n, idx.first_tx_num, out)
         # hashed-state delta + state root (the state-root job)
+        _cancel_guard()
         t0 = _time.time()
         with tracing.span("engine::tree", "state_root",
                           strategy=("sparse" if sparse_task is not None
@@ -561,7 +677,7 @@ class EngineTree:
         gets its own transaction + overlay — never the in-progress layer).
 
         Reference analogue: spawning SparseTrieCacheTask per payload
-        (crates/engine/tree/src/tree/state_root_strategy/sparse_trie.rs:126).
+        (crates/engine/tree, sparse-trie state-root strategy).
         """
         from .sparse_root import SparseRootTask
 
@@ -590,7 +706,7 @@ class EngineTree:
                                  task) -> bytes:
         """Close the sparse root job; on any SparseRootError rerun the
         block's root with the incremental committer (reference
-        `state_root_fallback`, crates/engine/primitives/src/config.rs:140).
+        `state_root_fallback` in the engine-primitives config).
         All overlay writes happen only after the sparse path fully
         succeeded, so the fallback starts from a clean layer."""
         from .sparse_root import SparseRootError
@@ -599,6 +715,11 @@ class EngineTree:
             root, digest_map, storage_roots = task.finish(out)
             acct_updates, storage_updates = task.export_updates(out, digest_map)
         except SparseRootError as e:
+            if getattr(task, "cancelled", False):
+                # a forkchoice reorg cancelled the task mid-finish: do
+                # NOT fall back — the incremental committer would just
+                # finish the same dead head's root the slow way
+                raise PayloadCancelled(str(e)) from e
             self.last_sparse = {"strategy": "fallback", "error": str(e)}
             return self._state_root_job(overlay, out, None)
         self.last_sparse = {
@@ -669,8 +790,13 @@ class EngineTree:
     def on_forkchoice_updated(
         self, head: bytes, safe: bytes | None = None, finalized: bytes | None = None
     ) -> PayloadStatus:
-        if head in self.invalid:
-            return PayloadStatus(PayloadStatusKind.INVALID, None, self.invalid[head])
+        reason = self.invalid.get(head)
+        if reason is not None:
+            return PayloadStatus(PayloadStatusKind.INVALID, None, reason)
+        # a forkchoice that reorgs away from the insert currently in
+        # flight aborts its speculative machinery (sparse root task,
+        # proof-pool shards, optimistic waves) instead of racing it
+        self._cancel_inflight_for(head)
         if head == self.persisted_hash:
             return self._set_head(head)
         if head in self.blocks and self._chain_layers(head) is not None:
@@ -695,13 +821,106 @@ class EngineTree:
 
     def _set_head(self, head: bytes) -> PayloadStatus:
         old_head = self.head_hash
+        depth = self._reorg_depth(old_head, head)
         self.head_hash = head
+        if depth > 0:
+            self._record_reorg(depth)
         # persist first so listeners (pool maintenance, static-file
         # producer, pruner) observe the advanced persisted state
         self._advance_persistence()
         if old_head != head:
             self._notify_canon_change()
         return PayloadStatus(PayloadStatusKind.VALID, head)
+
+    # -- consensus robustness --------------------------------------------------
+
+    def _cancel_inflight_for(self, head: bytes) -> None:
+        """Cancel the in-flight insert when ``head`` reorgs away from it
+        (i.e. the new head neither IS the in-flight block nor extends its
+        parent chain). Non-blocking: sets the cooperative event and asks
+        the sparse task to stop at its next batch boundary; the insert
+        thread runs the journaled aborts and reports SYNCING."""
+        with self._inflight_lock:
+            inflight = self._inflight
+        if inflight is None or head == inflight.block_hash:
+            return
+        if self._extends(head, inflight.parent_hash):
+            return
+        if inflight.cancel.is_set():
+            return
+        inflight.cancel.set()
+        task = inflight.sparse_task
+        if task is not None:
+            task.cancel()
+        tree_metrics.payload_cancelled()
+        tracing.event("engine::tree", "inflight_cancelled",
+                      block=inflight.block_hash.hex()[:16],
+                      new_head=head.hex()[:16])
+
+    def _extends(self, head: bytes, target: bytes) -> bool:
+        """Is ``target`` on ``head``'s chain (head included)? Unknown
+        heads answer True — an fcU that only returns SYNCING performed
+        no reorg, so it must not cancel anything."""
+        if target == head:
+            return True
+        h = head
+        while h != self.persisted_hash:
+            eb = self.blocks.get(h)
+            if eb is None:
+                break
+            h = eb.parent_hash
+            if h == target:
+                return True
+        if h == self.persisted_hash:
+            # head roots in the persisted canonical chain: every
+            # persisted canonical block at or below the tip is an ancestor
+            if target == self.persisted_hash:
+                return True
+            with self.factory.provider() as p:
+                n = p.block_number(target)
+                return (n is not None and n <= self.persisted_number
+                        and p.canonical_hash(n) == target)
+        with self.factory.provider() as p:
+            hn = p.block_number(head)
+            if hn is None or p.canonical_hash(hn) != head:
+                return True  # unknown head: no reorg happens
+            tn = p.block_number(target)
+            return (tn is not None and tn <= hn
+                    and p.canonical_hash(tn) == target)
+
+    def _reorg_depth(self, old_head: bytes, new_head: bytes) -> int:
+        """Blocks abandoned off the old canonical chain by switching to
+        ``new_head`` (0 when the new head extends the old one)."""
+        if old_head == new_head:
+            return 0
+        on_new = {new_head, self.persisted_hash}
+        h = new_head
+        while h != self.persisted_hash:
+            eb = self.blocks.get(h)
+            if eb is None:
+                break
+            h = eb.parent_hash
+            on_new.add(h)
+        depth = 0
+        h = old_head
+        while h not in on_new:
+            eb = self.blocks.get(h)
+            if eb is None:
+                break
+            depth += 1
+            h = eb.parent_hash
+        return depth
+
+    def _record_reorg(self, depth: int, deep: bool = False) -> None:
+        tree_metrics.record_reorg(depth, deep=deep)
+        if self.reorgs.record(depth):
+            # pathological churn: dump the flight recorder once per
+            # storm (rate-limited) and engage the speculation backoff
+            tree_metrics.storm()
+            tracing.fault_event("TREE_REORG_STORM", target="engine::tree",
+                                depth=depth, reorgs=self.reorgs.reorgs,
+                                max_depth=self.reorgs.max_depth)
+        self.reorgs.in_backoff()  # refresh the gauge
 
     def _find_persisted_branch_point(self, head: bytes):
         """If ``head`` connects to a persisted canonical block below the tip
@@ -725,7 +944,7 @@ class EngineTree:
 
     def _unwind_persisted_to(self, number: int) -> None:
         """Unwind the persisted chain to ``number`` (reference: engine →
-        backfill pipeline unwind on deep reorgs, pipeline/mod.rs:303)."""
+        backfill pipeline unwind on deep reorgs, stages pipeline)."""
         # durable unwind intent BEFORE the first stage commit: the
         # pipeline unwinds with one commit per stage, so a crash anywhere
         # inside leaves ragged checkpoints — the marker tells startup
@@ -733,6 +952,10 @@ class EngineTree:
         # atomically with the canonical surgery below)
         from ..storage.recovery import UNWIND_MARKER_KEY
 
+        # reorg accounting BEFORE surgery: everything above the branch
+        # point on the current canonical chain is being abandoned
+        eb = self.blocks.get(self.head_hash)
+        head_number = eb.number if eb is not None else self.persisted_number
         with self.factory.provider_rw() as p:
             p.tx.put(Tables.Metadata.name, UNWIND_MARKER_KEY,
                      number.to_bytes(8, "big"))
@@ -759,6 +982,7 @@ class EngineTree:
         # in-memory tree entries built on the old chain are now stale
         self.blocks.clear()
         self.preserved_trie.invalidate()
+        self._record_reorg(max(0, head_number - number), deep=True)
         # the unwound shape is a durability boundary too: a crash after a
         # reorg must never resurrect the unwound chain
         self._durability_boundary()

@@ -380,6 +380,14 @@ def default_rules() -> list[SloRule]:
                 metric="recovery_status", failing_factor=1.2,
                 help="startup recovery provably failed (recovered state "
                      "root mismatch / unhealable chain)"),
+        # reorg-storm backoff engaged (engine/block_buffer.py
+        # ReorgTracker): the tree is absorbing pathological forkchoice
+        # churn with speculation disabled — degraded while it lasts,
+        # never self-escalating (the node still imports correctly)
+        SloRule("tree_reorg_backoff", "consensus", "gauge", 0.5,
+                metric="tree_reorg_backoff_active", failing_factor=1e9,
+                help="reorg-storm backoff active (speculative paths "
+                     "stood down while forkchoice churns)"),
     ]
     return rules
 

@@ -1059,3 +1059,93 @@ class WalMetrics:
 
 wal_metrics = WalMetrics()
 recovery_metrics = wal_metrics  # one surface: recovery_* lives beside wal_*
+
+
+class EngineTreeMetrics:
+    """Consensus-robustness observability for the engine tree
+    (engine/tree.py + engine/block_buffer.py): invalid-header cache
+    occupancy vs its bound (an invalid-payload flood must plateau, not
+    grow), orphan-buffer depth and evictions, reorg cadence/depth, storm
+    detections with their backoff state, and in-flight inserts cancelled
+    by a competing forkchoiceUpdated — the numbers that say whether a
+    hostile CL is actually hurting the node."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._invalid = reg.gauge(
+            "tree_invalid_cached",
+            "invalid-header cache entries (bounded LRU)")
+        self._invalid_evictions = reg.counter(
+            "tree_invalid_evictions_total",
+            "invalid-cache entries evicted at the bound")
+        self._orphans = reg.gauge(
+            "tree_orphans_buffered",
+            "blocks buffered awaiting an unknown parent")
+        self._orphan_evictions = reg.counter(
+            "tree_orphan_evictions_total",
+            "buffered orphans evicted (bound or TTL)")
+        self._orphan_replays = reg.counter(
+            "tree_orphan_replays_total",
+            "buffered children replayed when their parent arrived")
+        self._reorgs = reg.counter("tree_reorgs_total")
+        self._deep_reorgs = reg.counter(
+            "tree_deep_reorgs_total",
+            "reorgs that unwound the persisted chain")
+        self._depth = reg.histogram(
+            "tree_reorg_depth", "blocks abandoned per reorg",
+            buckets=(1, 2, 3, 5, 8, 13, 21, 34))
+        self._storms = reg.counter(
+            "tree_reorg_storms_total",
+            "reorg-storm detections (flight recorder dumped)")
+        self._backoff = reg.gauge(
+            "tree_reorg_backoff_active",
+            "1 while reorg-storm backoff disables speculation")
+        self._cancelled = reg.counter(
+            "tree_payloads_cancelled_total",
+            "in-flight inserts aborted by a forkchoice reorg")
+        # events-line fragment state (node/events.py tree[...])
+        self.last: dict = {}
+
+    def set_invalid(self, n: int, cap: int) -> None:
+        self._invalid.set(n)
+        self.last["invalid"] = n
+        self.last["invalid_cap"] = cap
+
+    def invalid_evicted(self) -> None:
+        self._invalid_evictions.increment()
+        self.last["invalid_evicted"] = self.last.get("invalid_evicted", 0) + 1
+
+    def set_orphans(self, n: int) -> None:
+        self._orphans.set(n)
+        self.last["orphans"] = n
+
+    def orphan_evicted(self) -> None:
+        self._orphan_evictions.increment()
+        self.last["orphans_evicted"] = self.last.get("orphans_evicted", 0) + 1
+
+    def orphans_replayed(self, n: int = 1) -> None:
+        self._orphan_replays.increment(n)
+        self.last["replayed"] = self.last.get("replayed", 0) + n
+
+    def record_reorg(self, depth: int, deep: bool = False) -> None:
+        self._reorgs.increment()
+        if deep:
+            self._deep_reorgs.increment()
+        self._depth.record(depth)
+        self.last["reorgs"] = self.last.get("reorgs", 0) + 1
+        self.last["max_depth"] = max(self.last.get("max_depth", 0), depth)
+
+    def storm(self) -> None:
+        self._storms.increment()
+        self.last["storms"] = self.last.get("storms", 0) + 1
+
+    def set_backoff(self, active: bool) -> None:
+        self._backoff.set(1 if active else 0)
+        self.last["backoff"] = bool(active)
+
+    def payload_cancelled(self) -> None:
+        self._cancelled.increment()
+        self.last["cancelled"] = self.last.get("cancelled", 0) + 1
+
+
+tree_metrics = EngineTreeMetrics()

@@ -208,6 +208,30 @@ class NodeEventReporter:
                      f" par={eb.get('parallel', 0)}"
                      f" ser={eb.get('serial', 0)}"
                      f" nat={eb.get('native', 0)}]")
+        # consensus robustness: the engine tree's one-line adversarial
+        # health — invalid-cache occupancy vs its bound (a flood must
+        # plateau), orphan-buffer depth, reorg cadence/depth, storm
+        # detections with their backoff, and inserts cancelled by a
+        # reorging forkchoice — the numbers that say a hostile CL is
+        # being absorbed instead of hurting the node
+        from ..metrics import tree_metrics
+
+        tm = tree_metrics.last
+        if tm and (tm.get("invalid") or tm.get("orphans")
+                   or tm.get("reorgs") or tm.get("cancelled")):
+            line += (f" tree[inv={tm.get('invalid', 0)}"
+                     f"/{tm.get('invalid_cap', 0)}"
+                     f" orph={tm.get('orphans', 0)}"
+                     f" reorgs={tm.get('reorgs', 0)}")
+            if tm.get("max_depth"):
+                line += f" depth^={tm['max_depth']}"
+            if tm.get("storms"):
+                line += f" storms={tm['storms']}"
+            if tm.get("cancelled"):
+                line += f" cancelled={tm['cancelled']}"
+            if tm.get("backoff"):
+                line += " BACKOFF"
+            line += "]"
         # --health: the SLO engine's verdict — node status, any non-ok
         # component, and the breach counter an operator pages on. The
         # one line that says "the node itself thinks it is sick" instead

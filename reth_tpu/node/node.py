@@ -113,6 +113,11 @@ class NodeConfig:
     # recomputation through the committer (storage/recovery.py) —
     # large datadirs can trade the proof for boot time
     recovery_verify_root: bool = True
+    # --invalid-cache-size / [node] invalid_cache_size: bound of the
+    # engine tree's invalid-header LRU (engine/block_buffer.py) — an
+    # invalid-payload flood plateaus here instead of leaking memory.
+    # None = RETH_TPU_INVALID_CACHE env or 512.
+    invalid_cache_size: int | None = None
 
 
 class Node:
@@ -271,6 +276,7 @@ class Node:
             persistence_threshold=config.persistence_threshold,
             sparse_workers=config.sparse_workers,
             parallel_exec=config.parallel_exec,
+            invalid_cache_size=config.invalid_cache_size,
         )
         # the engine's persistence advance is the durability boundary:
         # with a WAL it drives checkpoint cadence, without one it flushes

@@ -167,3 +167,202 @@ class AssertPoolSize:
     def __call__(self, node):
         if len(node.pool) != self.n:
             raise ActionError(f"pool has {len(node.pool)}, expected {self.n}")
+
+
+# -- fork builders (Engine-API adversarial scenarios) -------------------------
+#
+# Reference analogue: e2e-test-utils' testsuite fork helpers (CreateFork /
+# ReorgTo over produced payload chains). ForkBuilder plays the hostile CL:
+# a shadow, fault-free engine tree that can seal a consensus-valid block
+# on ANY parent it knows — side-chain forks at arbitrary depths, longer
+# competing branches, orphan subtrees. Because the shadow tree executes
+# and root-checks every block itself with a plain CPU committer and no
+# fault injectors, it doubles as the fault-free twin the chaos consensus
+# domain (reth_tpu/chaos.py) compares the drilled node against: any block
+# both trees accepted carries, by construction, bit-identical roots.
+
+
+class _TxFeed:
+    """Minimal pool view for ``build_payload``: a fixed candidate list."""
+
+    def __init__(self, txs):
+        self._txs = list(txs)
+
+    def best_transactions(self, base_fee):
+        return iter(self._txs)
+
+    def remove_invalid(self, tx_hash):
+        pass
+
+
+class ForkBuilder:
+    """CL-side block factory over a shadow fault-free engine tree."""
+
+    def __init__(self, genesis_header, genesis_alloc, wallet=None,
+                 committer=None, genesis_storage=None, genesis_codes=None,
+                 chain_id: int = 1):
+        from reth_tpu.engine import EngineTree
+        from reth_tpu.evm import EvmConfig
+        from reth_tpu.primitives.keccak import keccak256_batch_np
+        from reth_tpu.primitives.types import Block
+        from reth_tpu.storage import MemDb, ProviderFactory
+        from reth_tpu.storage.genesis import init_genesis
+        from reth_tpu.trie.committer import TrieCommitter
+
+        if committer is None:
+            committer = TrieCommitter(hasher=keccak256_batch_np)
+        self.chain_id = chain_id
+        self.wallet = wallet
+        self.factory = ProviderFactory(MemDb())
+        init_genesis(self.factory, genesis_header, genesis_alloc,
+                     genesis_storage, genesis_codes, committer=committer)
+        # a huge persistence threshold keeps every fork in memory, so any
+        # known block can parent a new one via the overlay provider
+        self.tree = EngineTree(self.factory, committer=committer,
+                               config=EvmConfig(chain_id=chain_id),
+                               persistence_threshold=1_000_000_000)
+        self.genesis_hash = genesis_header.hash
+        self.blocks: dict[bytes, Block] = {
+            self.genesis_hash: Block(genesis_header, (), (), ())}
+
+    def number_of(self, block_hash: bytes) -> int:
+        return self.blocks[block_hash].header.number
+
+    def ancestor(self, block_hash: bytes, depth: int) -> bytes:
+        """The hash ``depth`` parents above ``block_hash`` (clamped at
+        genesis)."""
+        h = block_hash
+        for _ in range(depth):
+            if h == self.genesis_hash:
+                break
+            h = self.blocks[h].header.parent_hash
+        return h
+
+    def branch_point(self, a: bytes, b: bytes):
+        """(number, hash) of the deepest common ancestor of two known
+        blocks, or None when either is unknown to the builder."""
+        if a not in self.blocks or b not in self.blocks:
+            return None
+        on_a = set()
+        h = a
+        while True:
+            on_a.add(h)
+            if h == self.genesis_hash:
+                break
+            h = self.blocks[h].header.parent_hash
+        h = b
+        while h not in on_a:
+            h = self.blocks[h].header.parent_hash
+        return (self.blocks[h].header.number, h)
+
+    def block_on(self, parent_hash: bytes, txs: int = 1, salt: int = 0):
+        """Seal (and shadow-import) a valid block on ``parent_hash``.
+        ``salt`` diversifies siblings (timestamp + transfer target), so
+        repeated calls on one parent mint distinct competing blocks."""
+        from reth_tpu.payload.builder import PayloadAttributes, build_payload
+        from reth_tpu.primitives.types import Transaction
+
+        overlay = self.tree.overlay_provider(parent_hash)
+        parent = overlay.header_by_number(
+            overlay.block_number(parent_hash))
+        feed = None
+        if txs and self.wallet is not None:
+            acct = overlay.account(self.wallet.address)
+            nonce = acct.nonce if acct is not None else 0
+            sink = bytes([0xD0 + (salt % 16)]) * 20
+            signed = []
+            for i in range(txs):
+                signed.append(self.wallet.sign_tx(Transaction(
+                    tx_type=2, chain_id=self.chain_id, nonce=nonce + i,
+                    max_fee_per_gas=100 * 10**9,
+                    max_priority_fee_per_gas=10**9, gas_limit=21_000,
+                    to=sink, value=1_000 + salt), bump_nonce=False))
+            feed = _TxFeed(signed)
+        block, _ = build_payload(
+            self.tree, feed, parent_hash,
+            PayloadAttributes(timestamp=parent.timestamp + 1 + salt))
+        st = self.tree.on_new_payload(block)
+        if st.status.value != "VALID":
+            raise ActionError(
+                f"fork builder sealed an invalid block: {st.validation_error}")
+        self.blocks[block.hash] = block
+        return block
+
+    def chain_on(self, parent_hash: bytes, length: int, txs: int = 1,
+                 salt: int = 0) -> list:
+        """A fork of ``length`` blocks rooted at ``parent_hash``."""
+        out = []
+        tip = parent_hash
+        for i in range(length):
+            blk = self.block_on(tip, txs=txs, salt=salt if i == 0 else 0)
+            out.append(blk)
+            tip = blk.hash
+        return out
+
+
+def tampered_block(block, kind: str, salt: bytes = b""):
+    """A consensus-invalid (or orphaned) variant of a valid block.
+
+    Kinds: ``state_root`` / ``receipts_root`` / ``gas_used`` (rejected
+    after execution), ``gas_limit`` (rejected by header validation),
+    ``unknown_parent`` (a fabricated parent — the orphan/SYNCING shape),
+    ``reparent`` (parent := ``salt`` — build invalid-ancestor chains on
+    a known-invalid block). ``salt`` also perturbs the timestamp so
+    repeated tampers of one block mint distinct hashes."""
+    from reth_tpu.primitives.types import Block, Header
+
+    h = dict(block.header.__dict__)
+    # uniqueness bump from the salt TAIL: ``reparent`` consumes the salt
+    # HEAD as the new parent hash, so flood callers append a counter
+    bump = int.from_bytes(salt[-4:], "big") % 1021 if salt else 0
+    if kind == "state_root":
+        h["state_root"] = bytes([0x13 + bump % 7]) * 32
+    elif kind == "receipts_root":
+        h["receipts_root"] = bytes([0x17 + bump % 7]) * 32
+    elif kind == "gas_used":
+        h["gas_used"] = block.header.gas_used + 1 + bump
+    elif kind == "gas_limit":
+        h["gas_limit"] = block.header.gas_limit * 2  # > 1/1024 step
+    elif kind == "unknown_parent":
+        h["parent_hash"] = (salt * 32)[:32] if salt else b"\x99" * 32
+        h["timestamp"] = block.header.timestamp + 1 + bump
+    elif kind == "reparent":
+        h["parent_hash"] = salt[:32]
+        h["timestamp"] = block.header.timestamp + 1 + bump
+    else:
+        raise ValueError(f"unknown tamper kind {kind!r}")
+    return Block(Header(**h), block.transactions, block.ommers,
+                 block.withdrawals)
+
+
+class ProduceSideChain:
+    """Build a ``length``-block fork off the canonical chain ``depth``
+    blocks below the tip (via a ForkBuilder) and feed it to the node;
+    with ``switch`` the forkchoice flips to the fork tip (a reorg)."""
+
+    def __init__(self, fork: ForkBuilder, depth: int, length: int,
+                 switch: bool = True, salt: int = 5):
+        self.fork = fork
+        self.depth = depth
+        self.length = length
+        self.switch = switch
+        self.salt = salt
+
+    def __call__(self, node):
+        from reth_tpu.engine.tree import PayloadStatusKind
+
+        head = node.tree.head_hash
+        if head not in self.fork.blocks:
+            raise ActionError("node head unknown to the fork builder — "
+                              "drive the node through the same builder")
+        anc = self.fork.ancestor(head, self.depth)
+        chain = self.fork.chain_on(anc, self.length, salt=self.salt)
+        for blk in chain:
+            st = node.tree.on_new_payload(blk)
+            if st.status is PayloadStatusKind.INVALID:
+                raise ActionError(
+                    f"fork block rejected: {st.validation_error}")
+        if self.switch:
+            st = node.tree.on_forkchoice_updated(chain[-1].hash)
+            if st.status is not PayloadStatusKind.VALID:
+                raise ActionError(f"fork fcU: {st.status.name}")

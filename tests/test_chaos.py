@@ -1,12 +1,15 @@
 """Chaos drill engine: crash points, composed fault scenarios, invariants.
 
 Fast tests cover the crash-point framework (spec parsing, nth counting,
-real ``os._exit`` in a throwaway subprocess) and the scenario
-generator's determinism. The ``@slow`` drills are the real thing:
+real ``os._exit`` in a throwaway subprocess) and both scenario
+generators' determinism. The ``@slow`` drills are the real thing:
 subprocess dev nodes killed at every declared crash point (plus raw
 SIGKILL) under composed ``RETH_TPU_FAULT_*`` injectors, restarted, and
-held to the invariant suite — ``make test-chaos`` runs them all; tier-1
-keeps its budget via ``-m 'not slow'``.
+held to the invariant suite — plus the Engine-API consensus domain:
+seeded reorg storms (``child_consensus_victim``) verified live against
+a fault-free ForkBuilder twin and then through the same restart suite.
+``make test-chaos`` runs them all; tier-1 keeps its budget via
+``-m 'not slow'``.
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ from reth_tpu.chaos import (
     CRASH_POINTS,
     FAULT_MENU,
     crash_spec,
+    make_consensus_scenario,
     make_scenario,
     run_scenario,
 )
@@ -112,6 +116,26 @@ def test_make_scenario_deterministic_and_diverse():
         assert s["blocks"] >= s.get("kill_after", 0)
 
 
+def test_make_consensus_scenario_deterministic_and_diverse():
+    a, b = make_consensus_scenario(7), make_consensus_scenario(7)
+    assert a == b
+    scns = [make_consensus_scenario(s) for s in range(1, 60)]
+    assert {s["mode"] for s in scns} == {"complete", "kill", "point"}
+    known = set().union(*[set(f) for f in FAULT_MENU])
+    for s in scns:
+        assert s["domain"] == "consensus"
+        assert s["faults"] and set(s["faults"]) <= known
+        assert s["rounds"] > 0
+    # unwind crash points must come with a forced deep reorg (the point
+    # only fires inside a persisted-chain unwind)
+    for s in scns:
+        if s.get("point") == "unwind":
+            assert s["force_deep_reorg"]
+    assert any(s["force_deep_reorg"] for s in scns)
+    # storage-domain seeds stay stable: separate rng streams
+    assert make_scenario(7) == make_scenario(7)
+
+
 def test_fault_menu_names_real_injectors():
     """Menu entries must reference env vars the codebase actually
     parses, or a composition drills nothing."""
@@ -193,6 +217,60 @@ def test_chaos_campaign_ten_seeds(tmp_path):
     bad = [r for r in results if not r.get("ok")]
     assert not bad, [
         (r["seed"], r.get("error") or r.get("invariants")) for r in bad]
+
+
+# -- Engine-API consensus domain (make test-chaos) ----------------------------
+
+
+@pytest.mark.slow
+def test_consensus_storm_scenario_completes(tmp_path):
+    """One full reorg-storm scenario run to completion: the victim's
+    live fault-free-twin invariants hold under the composed injectors,
+    and the restart invariant suite passes afterwards."""
+    scn = make_consensus_scenario(1)
+    assert scn["mode"] == "complete"  # pin: seed 1 runs the whole storm
+    res = run_scenario(scn, tmp_path)
+    assert res["ok"], (res.get("error"), res.get("invariants"))
+
+
+@pytest.mark.slow  # ~2 min: the full seeded matrix; `make test-chaos` runs it
+def test_consensus_campaign_ten_seeds(tmp_path):
+    """Acceptance: a 10-seed Engine-API adversarial campaign — reorg
+    storms (side forks, deep reorgs across the persistence threshold,
+    orphans, duplicates, invalid floods, hostile fcU targets) composed
+    with the PR 1-11 injectors and crash points/SIGKILLs — passes the
+    full invariant suite: canonical chain + roots bit-identical to the
+    fault-free twin, no leaked lease/lock, health back to ok within the
+    SLO window, node mines again. Failing seeds print a replay command."""
+    from reth_tpu.chaos import run_campaign
+
+    results = run_campaign(range(1, 11), tmp_path, domain="consensus")
+    bad = [r for r in results if not r.get("ok")]
+    assert not bad, [
+        (r["seed"], r.get("error") or r.get("invariants")) for r in bad]
+
+
+@pytest.mark.slow
+def test_deep_reorg_across_threshold_sigkill_restart(tmp_path):
+    """Satellite acceptance: a deep reorg across the persistence
+    threshold followed by SIGKILL + restart — recovered head, re-served
+    branch point, and root verification all consistent."""
+    scn = {"domain": "consensus", "seed": 33, "faults": {}, "mode": "kill",
+           "kill_after": 8, "rounds": 0, "threshold": 2,
+           "hash_service": False, "force_deep_reorg": True}
+    res = run_scenario(scn, tmp_path)
+    assert res["ok"], (res.get("error"), res.get("invariants"))
+    inv = res["invariants"]
+    assert inv["root_recomputed"] and inv["twin_root"] and inv["loss_bound"]
+    # the storm really reorged below the persistence threshold before the
+    # kill (marker written ahead of the unwinding fcU), and the recovered
+    # chain re-serves the branch point: the head sits at-or-above every
+    # reorg target with its ancestry twin-verified
+    rec = (tmp_path / "scn-33" / "chaos_blocks.jsonl").read_text()
+    markers = [json.loads(l)["reorg_to"] for l in rec.splitlines()
+               if "reorg_to" in l]
+    assert markers, "no deep-reorg intent recorded before the kill"
+    assert res["recovered"]["number"] >= min(markers)
 
 
 @pytest.mark.slow

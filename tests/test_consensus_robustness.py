@@ -1,0 +1,440 @@
+"""Consensus-robustness tests: the engine tree under adversarial CL
+behavior.
+
+Reference analogue: the BlockBuffer / InvalidHeaderCache unit tests
+(crates/engine/tree/src/tree/block_buffer.rs tests,
+invalid_headers.rs) and the engine-tree reorg tests (tree/tests.rs).
+Fast invariants only — the composed reorg-storm campaigns live in
+tests/test_chaos.py (`make test-chaos`); this file is `make test-reorg`.
+"""
+
+from __future__ import annotations
+
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from reth_tpu.engine import EngineTree
+from reth_tpu.engine.block_buffer import (
+    BlockBuffer,
+    InvalidHeaderCache,
+    ReorgTracker,
+)
+from reth_tpu.engine.tree import PayloadStatusKind
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256_batch_np
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.testing_actions import TestSuite as Suite
+from reth_tpu.testing_actions import ForkBuilder, tampered_block
+from reth_tpu.trie import TrieCommitter
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def make_env(n_blocks=5, threshold=2, extra_accounts=0):
+    alice = Wallet(0xA11CE)
+    bob = Wallet(0xB0B)
+    alloc = {alice.address: Account(balance=10**21),
+             bob.address: Account(balance=10**20)}
+    for i in range(1, extra_accounts + 1):
+        alloc[i.to_bytes(20, "big")] = Account(balance=i)
+    builder = ChainBuilder(alloc, committer=CPU)
+    for i in range(n_blocks):
+        builder.build_block([alice.transfer(bob.address, 10**15 + i)])
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 committer=CPU)
+    tree = EngineTree(factory, committer=CPU,
+                      persistence_threshold=threshold)
+    return builder, factory, tree, alice, bob
+
+
+# -- BlockBuffer / InvalidHeaderCache / ReorgTracker units --------------------
+
+
+def _b(h: bytes, parent: bytes, number: int = 1):
+    return SimpleNamespace(hash=h, header=SimpleNamespace(
+        parent_hash=parent, number=number))
+
+
+def test_block_buffer_bound_evicts_lru():
+    buf = BlockBuffer(limit=4, ttl=0)
+    blocks = [_b(bytes([i]) * 32, bytes([100 + i]) * 32) for i in range(6)]
+    for blk in blocks:
+        buf.insert(blk)
+    assert len(buf) == 4
+    assert buf.get(blocks[0].hash) is None  # oldest two evicted
+    assert buf.get(blocks[1].hash) is None
+    assert buf.get(blocks[5].hash) is blocks[5]
+    assert buf.evicted == 2
+    # re-inserting refreshes LRU position: touch #2, insert one more,
+    # #3 (now least recent) is the victim
+    buf.insert(blocks[2])
+    buf.insert(_b(b"\x77" * 32, b"\x78" * 32))
+    assert buf.get(blocks[2].hash) is not None
+    assert buf.get(blocks[3].hash) is None
+
+
+def test_block_buffer_ttl_eviction():
+    now = [0.0]
+    buf = BlockBuffer(limit=16, ttl=5.0, clock=lambda: now[0])
+    a = _b(b"\x01" * 32, b"\xaa" * 32)
+    buf.insert(a)
+    now[0] = 3.0
+    b = _b(b"\x02" * 32, b"\xaa" * 32)
+    buf.insert(b)
+    assert len(buf) == 2
+    now[0] = 6.0  # a expired, b not
+    buf.evict_expired()
+    assert buf.get(a.hash) is None
+    assert buf.get(b.hash) is b
+
+
+def test_block_buffer_take_children():
+    buf = BlockBuffer(limit=16, ttl=0)
+    parent = b"\xaa" * 32
+    kids = [_b(bytes([i]) * 32, parent) for i in range(3)]
+    other = _b(b"\x0f" * 32, b"\xbb" * 32)
+    for blk in kids + [other]:
+        buf.insert(blk)
+    taken = buf.take_children_of(parent)
+    assert {t.hash for t in taken} == {k.hash for k in kids}
+    assert len(buf) == 1  # only the unrelated orphan remains
+    assert buf.take_children_of(parent) == []
+
+
+def test_invalid_cache_lru_bound_and_touch():
+    cache = InvalidHeaderCache(capacity=3)
+    for i in range(5):
+        cache[bytes([i]) * 32] = f"bad {i}"
+    assert len(cache) == 3
+    assert bytes([0]) * 32 not in cache
+    assert cache[bytes([4]) * 32] == "bad 4"
+    assert cache.evicted == 2
+    # touching an entry protects it from the next eviction
+    assert bytes([2]) * 32 in cache
+    cache[b"\x50" * 32] = "bad new"
+    assert bytes([2]) * 32 in cache
+    assert cache.get(bytes([3]) * 32) is None
+
+
+def test_reorg_tracker_storm_and_backoff():
+    now = [0.0]
+    tr = ReorgTracker(window_s=30.0, storm_count=4, storm_depth=100,
+                      backoff_s=10.0, clock=lambda: now[0])
+    assert not tr.record(1) and not tr.record(1) and not tr.record(1)
+    assert not tr.in_backoff()
+    assert tr.record(1) is True  # 4th within the window: storm
+    assert tr.in_backoff()
+    assert tr.record(1) is False  # still the same storm: extend, not new
+    now[0] = 21.0  # base 10s doubled by the extension
+    assert not tr.in_backoff()
+    # quiet window: old events age out, no storm on the next reorg
+    now[0] = 60.0
+    assert tr.record(2) is False
+    assert tr.storms == 1
+
+
+# -- orphan buffering + replay (reference BlockBuffer behavior) ---------------
+
+
+def test_unknown_parent_buffers_and_replays_children():
+    builder, factory, tree, *_ = make_env(3)
+    b1, b2, b3 = builder.blocks[1:4]
+    # grandchild then child arrive first: SYNCING, buffered
+    assert tree.on_new_payload(b3).status is PayloadStatusKind.SYNCING
+    assert tree.on_new_payload(b2).status is PayloadStatusKind.SYNCING
+    assert len(tree.buffered) == 2
+    # the missing parent arrives: the whole buffered subtree replays
+    assert tree.on_new_payload(b1).status is PayloadStatusKind.VALID
+    assert b2.hash in tree.blocks and b3.hash in tree.blocks
+    assert len(tree.buffered) == 0
+    st = tree.on_forkchoice_updated(b3.hash)
+    assert st.status is PayloadStatusKind.VALID
+
+
+def test_invalid_parent_propagates_into_buffer():
+    builder, factory, tree, *_ = make_env(2)
+    b1, b2 = builder.blocks[1:3]
+    bad = tampered_block(b1, "state_root")
+    child = tampered_block(b2, "reparent", salt=bad.hash)
+    # the child arrives before its (soon-to-be-invalid) parent
+    assert tree.on_new_payload(child).status is PayloadStatusKind.SYNCING
+    assert tree.on_new_payload(bad).status is PayloadStatusKind.INVALID
+    # buffered child was invalidated with its ancestor, not replayed
+    assert child.hash in tree.invalid
+    st = tree.on_new_payload(child)
+    assert st.status is PayloadStatusKind.INVALID
+    assert "invalid ancestor" in st.validation_error
+
+
+# -- invalid-payload flood (acceptance drill) ---------------------------------
+
+
+@pytest.mark.slow  # ~1 min of pure-python header hashing; `make test-reorg`
+def test_invalid_flood_holds_cache_bound_and_node_keeps_importing():
+    """Acceptance drill: 10k distinct invalid payloads — tree_invalid_cached
+    plateaus at the configured bound and valid blocks still import
+    afterwards. (The fast bound test below covers tier-1.)"""
+    from reth_tpu.metrics import tree_metrics
+
+    builder, factory, tree, *_ = make_env(2)
+    b1, b2 = builder.blocks[1:3]
+    assert tree.on_new_payload(b1).status is PayloadStatusKind.VALID
+    bad = tampered_block(b2, "state_root")
+    assert tree.on_new_payload(bad).status is PayloadStatusKind.INVALID
+    for i in range(10_000):
+        child = tampered_block(b2, "reparent",
+                               salt=bad.hash + i.to_bytes(4, "big"))
+        st = tree.on_new_payload(child)
+        assert st.status is PayloadStatusKind.INVALID
+    assert len(tree.invalid) <= tree.invalid.capacity == 512
+    assert tree_metrics.last["invalid"] <= 512
+    assert tree.invalid.evicted > 9_000
+    # the flood changed nothing for honest traffic
+    assert tree.on_new_payload(b2).status is PayloadStatusKind.VALID
+    assert tree.on_forkchoice_updated(b2.hash).status is PayloadStatusKind.VALID
+
+
+def test_invalid_cache_size_is_configurable_and_flood_bounded():
+    """Fast flood-bound variant for tier-1: a 200-payload flood against a
+    7-entry cache plateaus at the bound and honest imports continue."""
+    builder, factory, *_ = make_env(1)
+    tree = EngineTree(factory, committer=CPU, invalid_cache_size=7)
+    b1 = builder.blocks[1]
+    bad = tampered_block(b1, "state_root")
+    assert tree.on_new_payload(bad).status is PayloadStatusKind.INVALID
+    for i in range(60):
+        child = tampered_block(b1, "reparent",
+                               salt=bad.hash + i.to_bytes(4, "big"))
+        assert tree.on_new_payload(child).status is PayloadStatusKind.INVALID
+    assert len(tree.invalid) <= 7
+    assert tree.invalid.evicted >= 50
+    assert tree.on_new_payload(b1).status is PayloadStatusKind.VALID
+
+
+# -- fcU cancellation of in-flight inserts (satellite regression) -------------
+
+
+def _sibling_forks(extra_accounts=8):
+    """Two competing height-1 blocks over one genesis, plus a child of
+    fork A — the minimal reorg-away shape."""
+    alice = Wallet(0xA11CE)
+    alloc = {alice.address: Account(balance=10**21)}
+    for i in range(1, extra_accounts + 1):
+        alloc[i.to_bytes(20, "big")] = Account(balance=i)
+    builder = ChainBuilder(alloc, committer=CPU)
+    fork_a = builder.build_block([alice.transfer(b"\xaa" * 20, 111)])
+    a_child = builder.build_block([alice.transfer(b"\xaa" * 20, 112)])
+
+    alice_b = Wallet(0xA11CE)
+    alloc_b = {alice_b.address: Account(balance=10**21)}
+    for i in range(1, extra_accounts + 1):
+        alloc_b[i.to_bytes(20, "big")] = Account(balance=i)
+    builder_b = ChainBuilder(alloc_b, committer=CPU)
+    fork_b = builder_b.build_block([alice_b.transfer(b"\xbb" * 20, 222)],
+                                   timestamp=24)
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 committer=CPU)
+    return factory, fork_a, a_child, fork_b
+
+
+def test_fcu_during_inflight_insert_aborts_sparse_root(monkeypatch):
+    """A forkchoiceUpdated that reorgs away from an in-flight
+    _validate_and_insert must abort the sparse root job via the
+    journaled abort path (not race it to a fallback root), with the
+    proof-worker wedge (RETH_TPU_FAULT_SPARSE_PROOF_WEDGE) held across
+    the fcU. The insert reports SYNCING and the payload stays
+    re-importable."""
+    from reth_tpu.consensus import EthBeaconConsensus
+    from reth_tpu.metrics import tree_metrics
+
+    factory, fork_a, a_child, fork_b = _sibling_forks()
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=10)
+    assert tree.on_new_payload(fork_a).status is PayloadStatusKind.VALID
+    assert tree.on_new_payload(fork_b).status is PayloadStatusKind.VALID
+    assert tree.on_forkchoice_updated(fork_a.hash).status \
+        is PayloadStatusKind.VALID
+
+    # wedge every sharded proof fetch for the rest of the test — the
+    # worker failure must not let the insert race into a fallback root
+    monkeypatch.setenv("RETH_TPU_FAULT_SPARSE_PROOF_WEDGE", "1")
+    started, release = threading.Event(), threading.Event()
+    real = EthBeaconConsensus.validate_block_post_execution
+
+    def paused(self, block, *a, **kw):
+        if block.hash == a_child.hash:
+            started.set()
+            release.wait(10)
+        return real(self, block, *a, **kw)
+
+    monkeypatch.setattr(EthBeaconConsensus,
+                        "validate_block_post_execution", paused)
+    cancelled_before = tree_metrics.last.get("cancelled", 0)
+    res: dict = {}
+    th = threading.Thread(
+        target=lambda: res.update(st=tree.on_new_payload(a_child)))
+    th.start()
+    assert started.wait(10), "insert never reached post_validate"
+    # reorg away: fork_b abandons a_child's parent chain entirely
+    assert tree.on_forkchoice_updated(fork_b.hash).status \
+        is PayloadStatusKind.VALID
+    with tree._inflight_lock:
+        inflight = tree._inflight
+    assert inflight is not None and inflight.cancel.is_set()
+    task = inflight.sparse_task
+    release.set()
+    th.join(30)
+    assert not th.is_alive()
+    assert res["st"].status is PayloadStatusKind.SYNCING
+    assert a_child.hash not in tree.blocks
+    assert a_child.hash not in tree.invalid
+    assert tree.last_sparse is None  # no fallback root was computed
+    if task is not None:
+        assert task.cancelled
+        assert not task._thread.is_alive()
+    assert tree_metrics.last.get("cancelled", 0) == cancelled_before + 1
+    # the cancelled payload is NOT poisoned: with the fcU settled it
+    # re-imports as a plain side-fork block (wedge still held: the
+    # legitimate fallback path covers the root)
+    monkeypatch.setattr(EthBeaconConsensus,
+                        "validate_block_post_execution", real)
+    assert tree.on_new_payload(a_child).status is PayloadStatusKind.VALID
+
+
+def test_fcu_to_extending_head_does_not_cancel(monkeypatch):
+    """An fcU that keeps the in-flight block's parent canonical (e.g. to
+    the parent itself, or an unknown hash) must NOT abort the insert."""
+    from reth_tpu.consensus import EthBeaconConsensus
+
+    factory, fork_a, a_child, fork_b = _sibling_forks()
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=10)
+    assert tree.on_new_payload(fork_a).status is PayloadStatusKind.VALID
+    tree.on_forkchoice_updated(fork_a.hash)
+    started, release = threading.Event(), threading.Event()
+    real = EthBeaconConsensus.validate_block_post_execution
+
+    def paused(self, block, *a, **kw):
+        if block.hash == a_child.hash:
+            started.set()
+            release.wait(10)
+        return real(self, block, *a, **kw)
+
+    monkeypatch.setattr(EthBeaconConsensus,
+                        "validate_block_post_execution", paused)
+    res: dict = {}
+    th = threading.Thread(
+        target=lambda: res.update(st=tree.on_new_payload(a_child)))
+    th.start()
+    assert started.wait(10)
+    # re-announcing the parent head and an unknown head: no reorg-away
+    assert tree.on_forkchoice_updated(fork_a.hash).status \
+        is PayloadStatusKind.VALID
+    assert tree.on_forkchoice_updated(b"\x5f" * 32).status \
+        is PayloadStatusKind.SYNCING
+    release.set()
+    th.join(30)
+    assert res["st"].status is PayloadStatusKind.VALID
+    assert a_child.hash in tree.blocks
+
+
+# -- reorg-storm tracking + backoff -------------------------------------------
+
+
+def test_reorg_storm_engages_backoff_and_disables_speculation():
+    factory, fork_a, a_child, fork_b = _sibling_forks()
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=10)
+    assert tree.on_new_payload(fork_a).status is PayloadStatusKind.VALID
+    assert tree.on_new_payload(fork_b).status is PayloadStatusKind.VALID
+    tree.on_forkchoice_updated(fork_a.hash)
+    # a hostile CL flip-flops forkchoice between the two forks
+    for _ in range(5):
+        tree.on_forkchoice_updated(fork_b.hash)
+        tree.on_forkchoice_updated(fork_a.hash)
+    assert tree.reorgs.reorgs >= 10
+    assert tree.reorgs.storms >= 1
+    assert tree.reorgs.in_backoff()
+    from reth_tpu.metrics import tree_metrics
+
+    assert tree_metrics.last["backoff"] is True
+    assert tree_metrics.last["storms"] >= 1
+    # during backoff the next insert serves through the non-speculative
+    # paths: no sparse task is started (last_sparse stays None), yet the
+    # block is still VALID with a verified root
+    st = tree.on_new_payload(a_child)
+    assert st.status is PayloadStatusKind.VALID, st.validation_error
+    assert tree.last_sparse is None
+
+
+def test_deep_reorg_depth_is_recorded():
+    builder, factory, tree, alice, bob = make_env(4, threshold=1)
+    for blk in builder.blocks[1:]:
+        assert tree.on_new_payload(blk).status is PayloadStatusKind.VALID
+        tree.on_forkchoice_updated(blk.hash)
+    assert tree.persisted_number == 3
+    before = tree.reorgs.reorgs
+    # competing fork branching at block 2 (below the persisted tip)
+    alice_b = Wallet(0xA11CE)
+    alloc = {alice_b.address: Account(balance=10**21),
+             Wallet(0xB0B).address: Account(balance=10**20)}
+    builder_b = ChainBuilder(alloc, committer=CPU)
+    for i in range(2):
+        builder_b.build_block([alice_b.transfer(Wallet(0xB0B).address,
+                                                10**15 + i)])
+    fork3 = builder_b.build_block([alice_b.transfer(b"\xbb" * 20, 999)],
+                                  timestamp=100)
+    assert tree.on_new_payload(fork3).status is PayloadStatusKind.SYNCING
+    assert tree.on_forkchoice_updated(fork3.hash).status \
+        is PayloadStatusKind.VALID
+    assert tree.reorgs.reorgs > before
+    assert tree.reorgs.max_depth >= 2  # blocks 3+4 abandoned
+
+
+# -- fork builders (testing_actions) ------------------------------------------
+
+
+def test_fork_builder_mints_valid_forks():
+    alice = Wallet(0xA11CE)
+    builder = ChainBuilder({alice.address: Account(balance=10**21)},
+                           committer=CPU)
+    fb = ForkBuilder(builder.genesis, builder.accounts_at_genesis,
+                     wallet=Wallet(0xA11CE), committer=CPU)
+    a = fb.block_on(fb.genesis_hash, txs=1)
+    b = fb.block_on(a.hash, txs=1)
+    c = fb.block_on(fb.genesis_hash, txs=1, salt=3)  # competing sibling
+    assert len({a.hash, b.hash, c.hash}) == 3
+    assert fb.number_of(b.hash) == 2
+    assert fb.ancestor(b.hash, 2) == fb.genesis_hash
+    assert fb.branch_point(b.hash, c.hash) == (0, fb.genesis_hash)
+    # every minted block imports VALID on an independent node tree, and
+    # the ProduceSideChain action reorgs that tree to a longer fork
+    factory = ProviderFactory(MemDb())
+    init_genesis(factory, builder.genesis, builder.accounts_at_genesis,
+                 committer=CPU)
+    tree = EngineTree(factory, committer=CPU, persistence_threshold=10)
+    for blk in (a, b, c):
+        assert tree.on_new_payload(blk).status is PayloadStatusKind.VALID
+        tree.on_forkchoice_updated(blk.hash)
+    tree.on_forkchoice_updated(b.hash)
+    from reth_tpu.testing_actions import ProduceSideChain
+
+    node = SimpleNamespace(tree=tree)
+    Suite(node).run(ProduceSideChain(fb, depth=1, length=2, salt=7))
+    assert tree.blocks[tree.head_hash].block.header.number == 3
+
+
+def test_tampered_blocks_are_rejected_by_kind():
+    builder, factory, tree, *_ = make_env(2)
+    b1, b2 = builder.blocks[1:3]
+    assert tree.on_new_payload(b1).status is PayloadStatusKind.VALID
+    for kind in ("state_root", "receipts_root", "gas_used", "gas_limit"):
+        st = tree.on_new_payload(tampered_block(b2, kind))
+        assert st.status is PayloadStatusKind.INVALID, kind
+    orphan = tampered_block(b2, "unknown_parent", salt=b"\x09")
+    assert tree.on_new_payload(orphan).status is PayloadStatusKind.SYNCING
+
+
