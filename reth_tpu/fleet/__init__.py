@@ -1,0 +1,43 @@
+"""Stateless read-replica fleet: witness-fed replica nodes behind a
+consistent-hash gateway ring.
+
+Reference analogue: reth's layer map splits serving from consensus —
+RPC reads should not compete with block import for the one full node's
+lock. This package assembles the substrate PRs 6–12 built
+(`engine/witness.py` closed witnesses, `engine/stateless.py`
+StatelessChain validation, the `rpc/gateway.py` admission/coalescing/
+caching front door, the health engine) into a genuinely new role:
+
+- :mod:`.feed` — the witness feed protocol: the full node streams
+  per-block ``ExecutionWitness`` + header announcements to subscribed
+  replicas over a length-prefixed CRC-framed socket protocol (the WAL's
+  record shape, storage/wal.py).
+- :mod:`.replica` — the stateless replica role: a process with NO
+  database that validates every fed block through ``StatelessChain``
+  (preserved sparse trie carried block-to-block) and serves
+  ``eth_call``/``eth_estimateGas``/``eth_getProof``/``eth_getLogs``/
+  ``eth_getBlockBy*`` from witness-backed state.
+- :mod:`.ring` — the fleet side of the gateway: a consistent-hash ring
+  over registered replicas keyed by the gateway's
+  ``(method, canonical params, head_hash)`` cache key, health-probed
+  per-replica draining, and failover replica → ring neighbor → the
+  local full node.
+
+``python -m reth_tpu.fleet replica --feed HOST:PORT`` runs a replica
+(the ``--role replica`` CLI entry delegates here).
+"""
+
+from .feed import FeedError, WitnessFeedClient, WitnessFeedServer
+from .replica import ReplicaFaultInjector, ReplicaNode
+from .ring import FleetRouter, HashRing, ReplicaHandle
+
+__all__ = [
+    "FeedError",
+    "FleetRouter",
+    "HashRing",
+    "ReplicaFaultInjector",
+    "ReplicaHandle",
+    "ReplicaNode",
+    "WitnessFeedClient",
+    "WitnessFeedServer",
+]

@@ -1,0 +1,87 @@
+"""``python -m reth_tpu.fleet`` — run fleet roles standalone.
+
+``replica``: the stateless read-replica process (`--role replica` on
+the main CLI delegates here). It holds no database: everything it
+serves comes over the witness feed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def run_replica(args) -> int:
+    from .replica import ReplicaNode
+
+    host, _, port = args.feed.rpartition(":")
+    if not host or not port.isdigit():
+        print(f"error: --feed must be HOST:PORT, got {args.feed!r}",
+              file=sys.stderr)
+        return 1
+    replica = ReplicaNode(host, int(port), http_port=args.http_port,
+                          retention=args.retention,
+                          replica_id=args.id)
+    http_port = replica.start()
+    print(f"replica RPC listening on 127.0.0.1:{http_port} "
+          f"(feed {args.feed})", flush=True)
+    if args.port_file:
+        # orchestrators (bench fleet mode, the chaos fleet domain, the
+        # README quick-start's registration step) read the bound port
+        # from here instead of scraping stdout
+        from pathlib import Path
+
+        Path(args.port_file).write_text(json.dumps(
+            {"http_port": http_port, "id": replica.replica_id}))
+    if args.register:
+        # self-registration with the full node's fleet gateway
+        import urllib.request
+
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": 1, "method": "fleet_register",
+            "params": [f"http://127.0.0.1:{http_port}"],
+        }).encode()
+        req = urllib.request.Request(
+            args.register, data=body,
+            headers={"Content-Type": "application/json"})
+        rid = json.loads(urllib.request.urlopen(
+            req, timeout=10).read()).get("result")
+        print(f"registered with {args.register} as {rid}", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    replica.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m reth_tpu.fleet",
+        description="stateless read-replica fleet roles")
+    sub = parser.add_subparsers(dest="command", required=True)
+    p = sub.add_parser("replica", help="run a witness-fed stateless "
+                                       "read replica (no database)")
+    p.add_argument("--feed", required=True,
+                   help="HOST:PORT of the full node's witness feed")
+    p.add_argument("--http-port", type=int, default=0,
+                   help="RPC port (0 = ephemeral)")
+    p.add_argument("--retention", type=int, default=128,
+                   help="validated blocks retained for serving")
+    p.add_argument("--id", default=None, help="replica id override")
+    p.add_argument("--port-file", default=None,
+                   help="write the bound RPC port here as JSON")
+    p.add_argument("--register", default=None,
+                   help="full-node RPC URL to self-register with "
+                        "(fleet_register)")
+    args = parser.parse_args(argv)
+    if args.command == "replica":
+        return run_replica(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,425 @@
+"""Witness feed: the full node streams per-block execution witnesses +
+head announcements to subscribed replicas.
+
+Reference analogue: reth's layer map serves `debug_executionWitness` on
+demand; a replica fleet needs the PUSH form — every canonical block's
+witness generated once at the source and fanned out, so N replicas cost
+one witness generation, not N RPC round-trips that each re-execute the
+block.
+
+Wire format: a TCP stream opening with the ``RTFD1\\n`` magic, then
+length-prefixed CRC-checked frames — the WAL's record shape
+(storage/wal.py)::
+
+    u32 payload_len | u32 crc32(payload) | payload (pickle)
+
+Frames:
+
+- ``{"type": "hello", "chain_id", "head": (number, hash), "spec": json}``
+  — first frame after the magic; anchors the subscriber.
+- ``{"type": "block", "number", "hash", "parent", "block_rlp",
+  "senders", "witness": {state, codes, keys, headers}}`` — one
+  self-contained stateless validation input per canonical block: the
+  witness is closed under the block's own trie edits
+  (engine/witness.py), so the replica can anchor on the parent header
+  it ships and replay with no state source.
+- ``{"type": "head", "number", "hash"}`` — head announcement (fanout
+  invalidation: replicas and the gateway ring key responses by head
+  hash, so a new head retires every cached read).
+
+The server generates witnesses on a dedicated worker thread fed by a
+bounded queue from the engine tree's canon listeners — witness
+generation re-executes the block, and that cost must never land on the
+consensus path. A full queue drops the oldest pending block (counted):
+every block record is self-contained, so a replica simply re-anchors on
+the next record's parent instead of desyncing.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import struct
+import threading
+import zlib
+from collections import OrderedDict
+
+from .. import tracing
+
+FEED_MAGIC = b"RTFD1\n"
+_HDR = struct.Struct("<II")
+MAX_FRAME = 256 * 1024 * 1024  # sanity bound: no witness comes close
+
+
+class FeedError(Exception):
+    """Broken framing (torn frame, CRC mismatch, oversized payload)."""
+
+
+def send_frame(sock: socket.socket, obj) -> int:
+    """Write one CRC-framed pickled frame; returns bytes sent."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+    sock.sendall(frame)
+    return len(frame)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("feed closed mid-frame"
+                                  if buf else "feed closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket):
+    """Read one frame; raises FeedError on torn/corrupt framing and
+    ConnectionError on a clean close."""
+    hdr = _recv_exact(sock, _HDR.size)
+    length, crc = _HDR.unpack(hdr)
+    if length > MAX_FRAME:
+        raise FeedError(f"frame length {length} exceeds bound")
+    payload = _recv_exact(sock, length)
+    if zlib.crc32(payload) != crc:
+        raise FeedError("frame CRC mismatch")
+    try:
+        return pickle.loads(payload)
+    except Exception as e:  # noqa: BLE001 — corrupt payload = torn frame
+        raise FeedError(f"undecodable frame: {type(e).__name__}: {e}") from e
+
+
+class _Subscriber:
+    __slots__ = ("sock", "lock", "addr")
+
+    def __init__(self, sock: socket.socket, addr):
+        self.sock = sock
+        self.lock = threading.Lock()  # one frame at a time per socket
+        self.addr = addr
+
+
+class WitnessFeedServer:
+    """Per-block witness generation + fanout for a full node.
+
+    ``on_canon_change`` installs as an engine-tree canon listener: it
+    only enqueues (bounded, drop-oldest) — generation and broadcast run
+    on this server's worker thread. ``tree`` supplies overlay views and
+    the committer; ``chain_spec`` rides the hello frame so replicas
+    execute under the same fork schedule.
+    """
+
+    def __init__(self, tree, *, chain_id: int = 1, chain_spec=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 backlog: int = 64, queue_cap: int = 32, registry=None):
+        self.tree = tree
+        self.chain_id = chain_id
+        self.chain_spec = chain_spec
+        self.host = host
+        self.port = port
+        self.backlog_cap = backlog
+        self._queue: queue.Queue = queue.Queue(maxsize=max(2, queue_cap))
+        self._backlog: list[dict] = []  # last N block records, for catch-up
+        self._subs: list[_Subscriber] = []
+        self._lock = threading.Lock()
+        self._srv: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self.head: tuple[int, bytes] | None = None
+        # canon notifications overlap (each carries the whole in-memory
+        # chain segment): dedupe by hash so every block feeds exactly once
+        self._seen: "OrderedDict[bytes, bool]" = OrderedDict()
+        # counters surfaced via snapshot() + fleet_* metrics
+        self.blocks_sent = 0
+        self.heads_sent = 0
+        self.witness_failures = 0
+        self.dropped_blocks = 0
+        self.last_witness_bytes = 0
+        self.total_witness_bytes = 0
+        from ..metrics import FleetMetrics
+
+        self.metrics = FleetMetrics(registry)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> int:
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((self.host, self.port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        for name, fn in (("feed-accept", self._accept_loop),
+                         ("feed-worker", self._worker)):
+            t = threading.Thread(target=fn, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        self._queue.put(None)  # wake the worker
+        with self._lock:
+            subs, self._subs = self._subs, []
+        for s in subs:
+            try:
+                s.sock.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # -- intake (engine canon listener) -------------------------------------
+
+    def on_canon_change(self, chain) -> None:
+        """Bounded enqueue of newly-canonical executed blocks; never
+        blocks the consensus path."""
+        if not chain:
+            return
+        tip = chain[-1]
+        self.head = (tip.number, tip.hash)
+        for eb in chain:
+            if eb.hash in self._seen:
+                continue
+            self._seen[eb.hash] = True
+            while len(self._seen) > 4 * self.backlog_cap:
+                self._seen.popitem(last=False)
+            try:
+                self._queue.put_nowait(eb)
+            except queue.Full:
+                # drop the OLDEST pending block: records are
+                # self-contained, replicas re-anchor on the next one
+                try:
+                    self._queue.get_nowait()
+                    self.dropped_blocks += 1
+                    self.metrics.record_feed_drop()
+                except queue.Empty:
+                    pass
+                try:
+                    self._queue.put_nowait(eb)
+                except queue.Full:
+                    self.dropped_blocks += 1
+                    self.metrics.record_feed_drop()
+
+    # -- worker -------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            eb = self._queue.get()
+            if eb is None or self._stop.is_set():
+                return
+            try:
+                record = self._build_record(eb)
+            except Exception as e:  # noqa: BLE001 — skip, surfaced below
+                self.witness_failures += 1
+                self.metrics.record_witness_failure()
+                tracing.event("fleet::feed", "witness_failed",
+                              number=eb.number, error=f"{type(e).__name__}: {e}")
+                record = None
+            if record is not None:
+                with self._lock:
+                    self._backlog.append(record)
+                    del self._backlog[:-self.backlog_cap]
+                self._broadcast(record)
+                self.blocks_sent += 1
+            # head announcement after the newest queued block drains:
+            # the fanout-invalidation signal even when a witness failed
+            if self._queue.empty() and self.head is not None:
+                self._broadcast({"type": "head", "number": self.head[0],
+                                 "hash": self.head[1]})
+                self.heads_sent += 1
+
+    def _build_record(self, eb) -> dict:
+        from ..engine.witness import generate_witness
+
+        header = eb.block.header
+        parent_hash = header.parent_hash
+        provider = self.tree.overlay_provider(parent_hash)
+        parent_header = provider.header_by_number(header.number - 1)
+        hashes = {}
+        for k in range(max(0, header.number - 256), header.number):
+            bh = provider.canonical_hash(k)
+            if bh:
+                hashes[k] = bh
+        with tracing.span("fleet::feed", "witness.generate",
+                          number=header.number):
+            w = generate_witness(
+                provider, eb.block, self.tree.committer, list(eb.senders),
+                parent_header, self.tree.config, block_hashes=hashes)
+        record = {
+            "type": "block",
+            "number": header.number,
+            "hash": header.hash,
+            "parent": parent_hash,
+            "block_rlp": eb.block.encode(),
+            "senders": list(eb.senders),
+            "witness": {"state": w.state, "codes": w.codes,
+                        "keys": w.keys, "headers": w.headers},
+        }
+        size = (sum(map(len, w.state)) + sum(map(len, w.codes))
+                + sum(map(len, w.headers)) + len(record["block_rlp"]))
+        self.last_witness_bytes = size
+        self.total_witness_bytes += size
+        self.metrics.record_witness(size)
+        return record
+
+    def _broadcast(self, record: dict) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for s in subs:
+            try:
+                with s.lock:
+                    send_frame(s.sock, record)
+            except OSError:
+                self._drop(s)
+
+    def _drop(self, sub: _Subscriber) -> None:
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+                self.metrics.set_subscribers(len(self._subs))
+        try:
+            sub.sock.close()
+        except OSError:
+            pass
+
+    # -- accept -------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handshake, args=(sock, addr),
+                             daemon=True, name="feed-handshake").start()
+
+    def _handshake(self, sock: socket.socket, addr) -> None:
+        sub = _Subscriber(sock, addr)
+        try:
+            sock.sendall(FEED_MAGIC)
+            hello = {"type": "hello", "chain_id": self.chain_id,
+                     "head": self.head,
+                     "spec": (self.chain_spec.to_json()
+                              if self.chain_spec is not None else None)}
+            with self._lock:
+                backlog = list(self._backlog)
+            with sub.lock:
+                send_frame(sock, hello)
+                # catch-up: every retained block record (each is
+                # self-contained, so the replica anchors on the first)
+                for record in backlog:
+                    send_frame(sock, record)
+        except OSError:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        with self._lock:
+            self._subs.append(sub)
+            self.metrics.set_subscribers(len(self._subs))
+
+    # -- observability ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            subs = len(self._subs)
+            backlog = len(self._backlog)
+        return {
+            "port": self.port,
+            "subscribers": subs,
+            "backlog": backlog,
+            "blocks_sent": self.blocks_sent,
+            "heads_sent": self.heads_sent,
+            "witness_failures": self.witness_failures,
+            "dropped_blocks": self.dropped_blocks,
+            "last_witness_bytes": self.last_witness_bytes,
+            "total_witness_bytes": self.total_witness_bytes,
+            "queue_depth": self._queue.qsize(),
+        }
+
+
+class WitnessFeedClient:
+    """Replica-side subscriber: connects, reads the hello, then streams
+    frames into ``on_record``; reconnects with backoff until stopped."""
+
+    def __init__(self, host: str, port: int, *, on_hello=None,
+                 on_record=None, reconnect: bool = True,
+                 backoff_s: float = 0.25, timeout_s: float = 10.0):
+        self.host = host
+        self.port = port
+        self.on_hello = on_hello
+        self.on_record = on_record
+        self.reconnect = reconnect
+        self.backoff_s = backoff_s
+        self.timeout_s = timeout_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._sock: socket.socket | None = None
+        self.connected = threading.Event()
+        self.connections = 0
+        self.frames = 0
+        self.frame_errors = 0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="feed-client")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._session()
+            except (OSError, ConnectionError):
+                pass
+            except FeedError:
+                self.frame_errors += 1
+            finally:
+                self.connected.clear()
+            if not self.reconnect or self._stop.is_set():
+                return
+            self._stop.wait(self.backoff_s)
+
+    def _session(self) -> None:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout_s)
+        self._sock = sock
+        try:
+            magic = _recv_exact(sock, len(FEED_MAGIC))
+            if magic != FEED_MAGIC:
+                raise FeedError(f"bad feed magic {magic!r}")
+            sock.settimeout(None)  # block on the stream once established
+            hello = recv_frame(sock)
+            if hello.get("type") != "hello":
+                raise FeedError("feed did not open with hello")
+            self.connections += 1
+            self.connected.set()
+            if self.on_hello is not None:
+                self.on_hello(hello)
+            while not self._stop.is_set():
+                frame = recv_frame(sock)
+                self.frames += 1
+                if self.on_record is not None:
+                    self.on_record(frame)
+        finally:
+            self._sock = None
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,591 @@
+"""The stateless read-replica role: no database, witness-fed serving.
+
+A replica subscribes to a full node's witness feed (:mod:`.feed`),
+validates every block through ``engine/stateless.py``'s
+:class:`~reth_tpu.engine.stateless.StatelessChain` (preserved sparse
+trie carried block-to-block, roots bit-identical to the full node by
+construction), and serves the read RPC surface from witness-backed
+state:
+
+- ``eth_call`` / ``eth_estimateGas`` — the interpreter runs against a
+  :class:`ReplicaStateSource` whose every read comes from the preserved
+  sparse trie's revealed nodes and the accumulated witness bytecodes.
+- ``eth_getProof`` — EIP-1186 proofs straight off the sparse trie's
+  spines (the trie IS the proof material).
+- ``eth_getLogs`` / ``eth_getBlockByNumber`` / ``eth_getBlockByHash`` —
+  from the retained window of validated blocks + their re-executed
+  receipts (stateless re-execution yields the same receipts the full
+  node committed; the root check proves it).
+
+A read that needs state the witness never revealed raises
+``BlindedNodeError`` inside the handler and maps to a clean JSON-RPC
+``-32001`` resource-not-found — the fleet gateway fails the request
+over to the next ring position or the local full node, so the client
+never sees it. A block outside the retained window answers ``-32001``
+the same way. The replica deliberately errs instead of approximating:
+every answer it does give is bit-identical to the full node's.
+
+Fault injection (:class:`ReplicaFaultInjector`):
+``RETH_TPU_FAULT_REPLICA_WEDGE=1`` stops feed processing (the replica
+keeps serving its stale head — the lag the gateway ring must shed);
+``RETH_TPU_FAULT_REPLICA_LAG=<seconds>`` delays each block record (a
+slow replica that falls progressively behind).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .. import tracing
+from ..engine.stateless import StatelessChain, StatelessValidationError, \
+    _decode_account_leaf
+from ..evm import EvmConfig
+from ..evm.executor import intrinsic_gas
+from ..evm.interpreter import BlockEnv, Interpreter, Revert, TxEnv
+from ..evm.state import EvmState, StateSource
+from ..primitives.keccak import keccak256, keccak256_batch_np
+from ..primitives.rlp import decode_int, rlp_decode
+from ..primitives.types import (
+    Block,
+    EMPTY_ROOT_HASH,
+    Header,
+    KECCAK_EMPTY,
+    Transaction,
+)
+from ..rpc.convert import block_to_rpc, data, parse_data, parse_qty, qty
+from ..rpc.server import RpcError, RpcServer
+from ..trie.sparse import BlindedNodeError
+from .feed import WitnessFeedClient
+
+# JSON-RPC resource-not-found: the replica's "I cannot answer this
+# bit-identically" code — the fleet router treats it as a failover
+# signal, never a client-visible failure
+NOT_IN_WITNESS = -32001
+
+DEFAULT_RETENTION = 128
+
+
+class ReplicaFaultInjector:
+    """Feed-processing fault policies, in the style of the gateway's
+    injector: ``wedge`` drops every block record (serving continues on
+    the stale head), ``lag_s`` sleeps before each one."""
+
+    def __init__(self, wedge: bool = False, lag_s: float = 0.0):
+        self.wedge = wedge
+        self.lag_s = lag_s
+        self.dropped = 0
+        self.lagged = 0
+
+    @classmethod
+    def from_env(cls, env=None) -> "ReplicaFaultInjector | None":
+        env = os.environ if env is None else env
+        wedge = env.get("RETH_TPU_FAULT_REPLICA_WEDGE", "") not in ("", "0")
+        lag = float(env.get("RETH_TPU_FAULT_REPLICA_LAG", "0") or 0)
+        if not (wedge or lag):
+            return None
+        return cls(wedge=wedge, lag_s=lag)
+
+    def active(self) -> bool:
+        return bool(self.wedge or self.lag_s)
+
+    def on_block(self, number: int) -> bool:
+        """Called per block record; True = drop it (wedge drill)."""
+        if self.lag_s:
+            self.lagged += 1
+            tracing.fault_event("RETH_TPU_FAULT_REPLICA_LAG",
+                                target="fleet::replica", number=number,
+                                lag_s=self.lag_s)
+            time.sleep(self.lag_s)
+        if self.wedge:
+            self.dropped += 1
+            tracing.fault_event("RETH_TPU_FAULT_REPLICA_WEDGE",
+                                target="fleet::replica", number=number)
+            return True
+        return False
+
+
+class ReplicaStateSource(StateSource):
+    """EVM state source over the preserved sparse trie + witness
+    bytecodes: every read comes from revealed nodes, an unrevealed path
+    raises ``BlindedNodeError`` (mapped to ``-32001`` by the API)."""
+
+    def __init__(self, trie, codes: dict[bytes, bytes]):
+        self.trie = trie
+        self.codes = codes
+
+    def account(self, address: bytes):
+        leaf = self.trie.account_trie.get(keccak256(address))
+        return _decode_account_leaf(leaf) if leaf is not None else None
+
+    def storage(self, address: bytes, slot: bytes) -> int:
+        acct = self.account(address)
+        if acct is None:
+            return 0
+        ha = keccak256(address)
+        stg = self.trie.storage_tries.get(ha)
+        if stg is None:
+            if acct.storage_root == EMPTY_ROOT_HASH:
+                return 0
+            raise BlindedNodeError(
+                b"", f"storage trie of {address.hex()} not in witness")
+        leaf = stg.get(keccak256(slot))
+        return decode_int(rlp_decode(leaf)) if leaf is not None else 0
+
+    def bytecode(self, code_hash: bytes) -> bytes:
+        if code_hash == KECCAK_EMPTY:
+            return b""
+        code = self.codes.get(code_hash)
+        if code is None:
+            raise BlindedNodeError(
+                b"", f"bytecode {code_hash.hex()} not in witness")
+        return code
+
+
+class ReplicaEthApi:
+    """The replica's read surface. Handlers mirror ``rpc/eth.py``'s
+    exactly (same env construction, same frame building, same response
+    shapes) so every answer is bit-identical to the full node's — the
+    only divergence allowed is ``-32001`` for state/blocks the replica
+    does not hold, which the fleet router converts into a failover."""
+
+    def __init__(self, replica: "ReplicaNode"):
+        self.r = replica
+
+    # -- helpers ------------------------------------------------------------
+
+    def _head(self) -> Header:
+        h = self.r.head_header
+        if h is None:
+            raise RpcError(NOT_IN_WITNESS, "replica has no validated head")
+        return h
+
+    def _resolve_number(self, tag) -> int:
+        head = self._head().number
+        if tag in (None, "latest", "pending", "safe", "finalized"):
+            return head
+        if tag == "earliest":
+            return 0
+        return parse_qty(tag)
+
+    def _record(self, n: int) -> dict:
+        rec = self.r.blocks.get(n)
+        if rec is None:
+            raise RpcError(NOT_IN_WITNESS,
+                           f"block {n} outside the replica window")
+        return rec
+
+    def _state_trie(self, tag):
+        """The witness-backed state trie — latest only: a replica holds
+        exactly one materialized state, the head's."""
+        head = self._head()
+        if self._resolve_number(tag) != head.number:
+            raise RpcError(NOT_IN_WITNESS,
+                           "replica serves latest state only")
+        trie = self.r.state_trie()
+        if trie is None:
+            raise RpcError(NOT_IN_WITNESS, "replica state not materialized")
+        return head, trie
+
+    def _blinded(self, e: BlindedNodeError) -> RpcError:
+        self.r.blinded_reads += 1
+        self.r.metrics.record_blinded()
+        return RpcError(NOT_IN_WITNESS,
+                        f"state not in witness: {e}")
+
+    # -- chain meta ---------------------------------------------------------
+
+    def eth_chainId(self):
+        return qty(self.r.chain_id)
+
+    def eth_blockNumber(self):
+        return qty(self._head().number)
+
+    def eth_syncing(self):
+        return False
+
+    # -- blocks -------------------------------------------------------------
+
+    def eth_getBlockByNumber(self, tag, full=False):
+        n = self._resolve_number(tag)
+        if n > self._head().number:
+            return None  # the full node answers None for future blocks
+        rec = self._record(n)
+        return block_to_rpc(rec["block"], full,
+                            rec["senders"] if full else None)
+
+    def eth_getBlockByHash(self, block_hash, full=False):
+        n = self.r.by_hash.get(parse_data(block_hash))
+        if n is None:
+            raise RpcError(NOT_IN_WITNESS,
+                           "block hash outside the replica window")
+        return self.eth_getBlockByNumber(qty(n), full)
+
+    # -- logs ---------------------------------------------------------------
+
+    def eth_getLogs(self, filt):
+        from ..rpc.eth import _topics_match
+
+        start = self._resolve_number(filt.get("fromBlock", "earliest"))
+        end = self._resolve_number(filt.get("toBlock", "latest"))
+        want_addr = None
+        if filt.get("address"):
+            a = filt["address"]
+            want_addr = {parse_data(x)
+                         for x in (a if isinstance(a, list) else [a])}
+        topics = filt.get("topics") or []
+        out = []
+        for n in range(start, end + 1):
+            rec = self._record(n)  # -32001 when outside the window
+            block: Block = rec["block"]
+            if not block.transactions:
+                continue
+            header = block.header
+            log_base = 0
+            for i, (tx, receipt) in enumerate(zip(block.transactions,
+                                                  rec["receipts"])):
+                for j, log in enumerate(receipt.logs):
+                    if want_addr and log.address not in want_addr:
+                        continue
+                    if not _topics_match(log.topics, topics):
+                        continue
+                    out.append({
+                        "address": data(log.address),
+                        "topics": [data(x) for x in log.topics],
+                        "data": data(log.data),
+                        "blockNumber": qty(n),
+                        "blockHash": data(header.hash),
+                        "transactionHash": data(tx.hash),
+                        "transactionIndex": qty(i),
+                        "logIndex": qty(log_base + j),
+                        "removed": False,
+                    })
+                log_base += len(receipt.logs)
+        return out
+
+    # -- proofs -------------------------------------------------------------
+
+    def eth_getProof(self, address, slots, tag="latest"):
+        _head, st = self._state_trie(tag)
+        addr = parse_data(address)
+        ha = keccak256(addr)
+        try:
+            # refs must be clean for spine(): a no-op when already clean
+            st.account_trie.root_hash_compute(self.r.hasher)
+            leaf = st.account_trie.get(ha)
+            acc = _decode_account_leaf(leaf) if leaf is not None else None
+            proof = st.account_trie.spine(ha)
+            storage_root = acc.storage_root if acc else EMPTY_ROOT_HASH
+            stg = st.storage_tries.get(ha)
+            storage_proofs = []
+            for s in slots:
+                key_b = parse_qty(s).to_bytes(32, "big")
+                if acc is None or storage_root == EMPTY_ROOT_HASH:
+                    storage_proofs.append((key_b, 0, []))
+                    continue
+                if stg is None:
+                    raise BlindedNodeError(
+                        b"", f"storage trie of {addr.hex()} not in witness")
+                stg.root_hash_compute(self.r.hasher)
+                hs = keccak256(key_b)
+                sleaf = stg.get(hs)
+                value = (decode_int(rlp_decode(sleaf))
+                         if sleaf is not None else 0)
+                storage_proofs.append((key_b, value, stg.spine(hs)))
+        except BlindedNodeError as e:
+            raise self._blinded(e) from None
+        return {
+            "address": address,
+            "accountProof": [data(n) for n in proof],
+            "balance": qty(acc.balance if acc else 0),
+            "nonce": qty(acc.nonce if acc else 0),
+            "codeHash": data(acc.code_hash if acc else KECCAK_EMPTY),
+            "storageHash": data(storage_root),
+            "storageProof": [
+                {"key": data(k), "value": qty(v),
+                 "proof": [data(n) for n in p]}
+                for k, v, p in storage_proofs
+            ],
+        }
+
+    # -- execution (read-only) ----------------------------------------------
+
+    def _call_env(self, header: Header) -> BlockEnv:
+        return BlockEnv(
+            number=header.number,
+            timestamp=header.timestamp,
+            coinbase=header.beneficiary,
+            gas_limit=header.gas_limit,
+            base_fee=header.base_fee_per_gas or 0,
+            prev_randao=header.mix_hash,
+            chain_id=self.r.chain_id,
+        )
+
+    def eth_call(self, call, tag="latest"):
+        from ..rpc.eth import EthApi
+
+        header, st = self._state_trie(tag)
+        env = self._call_env(header)
+        try:
+            state = EvmState(ReplicaStateSource(st, self.r.codes))
+            interp = Interpreter(state, env, TxEnv(
+                origin=parse_data(call.get("from", "0x" + "00" * 20))))
+            frame = EthApi._build_call_frame(call, state, env)
+            try:
+                ok, _gas_left, out = interp.call(frame)
+            except Revert as r:
+                raise RpcError(3, "execution reverted: 0x" + r.output.hex())
+            if not ok:
+                raise RpcError(-32000, "execution failed")
+            return data(out)
+        except BlindedNodeError as e:
+            raise self._blinded(e) from None
+
+    def eth_estimateGas(self, call, tag="latest"):
+        from ..rpc.eth import EthApi
+
+        header, st = self._state_trie(tag)
+        env = self._call_env(header)
+        sender = parse_data(call.get("from", "0x" + "00" * 20))
+        try:
+            state = EvmState(ReplicaStateSource(st, self.r.codes))
+            interp = Interpreter(state, env, TxEnv(origin=sender))
+            frame = EthApi._build_call_frame(call, state, env)
+            to, gas = frame.address if call.get("to") else None, frame.gas
+            try:
+                ok, gas_left, _ = interp.call(frame)
+            except Revert:
+                raise RpcError(3, "execution reverted")
+            if not ok:
+                raise RpcError(-32000, "execution failed")
+            used = gas - gas_left
+            fake_tx = Transaction(
+                to=to, data=parse_data(call.get("data",
+                                                call.get("input", "0x"))))
+            return qty(used + intrinsic_gas(fake_tx) + used // 16)
+        except BlindedNodeError as e:
+            raise self._blinded(e) from None
+
+    # -- fleet control ------------------------------------------------------
+
+    def fleet_status(self):
+        """The probe the gateway ring polls to drive draining: validated
+        head vs the feed's announced head (the lag), liveness, and the
+        counters a fleet operator reads."""
+        return self.r.status()
+
+
+class ReplicaNode:
+    """A witness-fed stateless replica: feed client + StatelessChain +
+    the read RPC surface, with no database anywhere."""
+
+    def __init__(self, feed_host: str, feed_port: int, *,
+                 http_port: int = 0, retention: int = DEFAULT_RETENTION,
+                 replica_id: str | None = None,
+                 injector: ReplicaFaultInjector | None = None,
+                 gateway: bool = True, registry=None):
+        from ..metrics import ReplicaMetrics
+
+        self.replica_id = replica_id or f"replica-{os.getpid()}"
+        self.retention = retention
+        self.lock = threading.RLock()
+        self.hasher = keccak256_batch_np
+        self.chain: StatelessChain | None = None
+        self.chain_id = 1
+        self.head_header: Header | None = None
+        self.announced: tuple[int, bytes] | None = None
+        self.blocks: dict[int, dict] = {}
+        self.by_hash: dict[bytes, int] = {}
+        self.codes: dict[bytes, bytes] = {}
+        self.started_at = time.time()
+        self.blocks_validated = 0
+        self.validation_failures = 0
+        self.blinded_reads = 0
+        self.injector = (injector if injector is not None
+                         else ReplicaFaultInjector.from_env())
+        self.metrics = ReplicaMetrics(registry)
+        self.client = WitnessFeedClient(
+            feed_host, feed_port,
+            on_hello=self._on_hello, on_record=self._on_record)
+        self.gateway = None
+        if gateway:
+            # the replica runs its OWN serving gateway: identical reads
+            # routed here by the ring coalesce and cache next to the
+            # state they read (keys embed the replica's validated head)
+            from ..rpc.gateway import RpcGateway
+
+            self.gateway = RpcGateway(
+                head_supplier=lambda: (self.head_header.hash
+                                       if self.head_header is not None
+                                       else b""),
+                registry=registry)
+        self.rpc = RpcServer(port=http_port, lock=self.lock,
+                             gateway=self.gateway)
+        self.rpc.register(ReplicaEthApi(self))
+        self.http_port: int | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> int:
+        self.http_port = self.rpc.start()
+        self.client.start()
+        return self.http_port
+
+    def stop(self) -> None:
+        self.client.stop()
+        self.rpc.stop()
+
+    def wait_synced(self, target: int, timeout: float = 15.0) -> bool:
+        """Test/CLI helper: wait until the validated head reaches
+        ``target``."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self.lock:
+                h = self.head_header
+            if h is not None and h.number >= target:
+                return True
+            time.sleep(0.02)
+        return False
+
+    # -- feed intake --------------------------------------------------------
+
+    def _on_hello(self, hello: dict) -> None:
+        with self.lock:
+            self.chain_id = hello.get("chain_id", 1)
+            spec = hello.get("spec")
+            exec_spec = None
+            if spec is not None:
+                from ..chainspec import ChainSpec
+
+                exec_spec = ChainSpec.from_json(spec).execution_spec
+            config = EvmConfig(chain_id=self.chain_id, chainspec=exec_spec)
+            if self.chain is None:
+                self.chain = StatelessChain(config=config,
+                                            hasher=self.hasher)
+            if hello.get("head") is not None:
+                self.announced = tuple(hello["head"])
+
+    def _on_record(self, record: dict) -> None:
+        kind = record.get("type")
+        if kind == "head":
+            with self.lock:
+                self.announced = (record["number"], record["hash"])
+                self._update_lag()
+            return
+        if kind != "block":
+            return
+        # the announcement is the block itself: lag accounting must see
+        # it even when the injector drops the record
+        with self.lock:
+            if (self.announced is None
+                    or record["number"] >= self.announced[0]):
+                self.announced = (record["number"], record["hash"])
+        if self.injector is not None and self.injector.on_block(
+                record["number"]):
+            with self.lock:
+                self._update_lag()
+            return
+        self._apply_block(record)
+
+    def _apply_block(self, record: dict) -> None:
+        from ..engine.witness import ExecutionWitness
+
+        block = Block.decode(record["block_rlp"])
+        with self.lock:
+            if block.hash in self.by_hash:
+                return  # duplicate record (reconnect catch-up overlap)
+        w = record["witness"]
+        witness = ExecutionWitness(state=list(w["state"]),
+                                   codes=list(w["codes"]),
+                                   keys=list(w["keys"]),
+                                   headers=list(w["headers"]))
+        with self.lock:
+            if self.chain is None:
+                self.chain = StatelessChain(config=EvmConfig(
+                    chain_id=self.chain_id), hasher=self.hasher)
+            if not witness.headers:
+                self.validation_failures += 1
+                self.metrics.record_validation_failure()
+                return
+            parent_header = Header.decode(witness.headers[0])
+            t0 = time.monotonic()
+            try:
+                with tracing.span("fleet::replica", "stateless.validate",
+                                  number=block.header.number):
+                    self.chain.validate(block, witness, parent_header)
+            except (StatelessValidationError, Exception) as e:  # noqa: BLE001
+                # a replica must never crash on a bad record: count it,
+                # keep serving the last good head, re-anchor on the next
+                self.validation_failures += 1
+                self.metrics.record_validation_failure()
+                tracing.event("fleet::replica", "validation_failed",
+                              number=block.header.number,
+                              error=f"{type(e).__name__}: {e}")
+                return
+            out = self.chain.last_output
+            n = block.header.number
+            # a reorg replaces the retained record at this height: drop
+            # the stale hash index entry before installing the new one
+            old = self.blocks.get(n)
+            if old is not None:
+                self.by_hash.pop(old["block"].hash, None)
+            self.blocks[n] = {
+                "block": block,
+                "senders": list(record["senders"]),
+                "receipts": list(out.receipts) if out is not None else [],
+            }
+            self.by_hash[block.hash] = n
+            for floor in [k for k in self.blocks
+                          if k <= n - self.retention]:
+                stale = self.blocks.pop(floor)
+                self.by_hash.pop(stale["block"].hash, None)
+            for c in witness.codes:
+                self.codes[keccak256(c)] = c
+            self.head_header = block.header
+            self.blocks_validated += 1
+            self.metrics.record_validated(time.monotonic() - t0)
+            self._update_lag()
+        # head changed: retire the replica-local response cache
+        if self.gateway is not None:
+            self.gateway.on_head_change()
+
+    def _update_lag(self) -> None:
+        self.metrics.set_lag(self.lag_heads())
+
+    # -- state access (under self.lock) -------------------------------------
+
+    def state_trie(self):
+        """The preserved sparse trie at the validated head (None before
+        the first block validates)."""
+        if self.chain is None or self.head_header is None:
+            return None
+        return self.chain.preserved.peek(self.head_header.hash)
+
+    def lag_heads(self) -> int:
+        if self.announced is None:
+            return 0
+        head = self.head_header.number if self.head_header is not None else 0
+        return max(0, self.announced[0] - head)
+
+    def status(self) -> dict:
+        with self.lock:
+            head = self.head_header
+            return {
+                "id": self.replica_id,
+                "head": ({"number": head.number, "hash": data(head.hash)}
+                         if head is not None else None),
+                "announced": ({"number": self.announced[0],
+                               "hash": data(self.announced[1])}
+                              if self.announced is not None else None),
+                "lag_heads": self.lag_heads(),
+                "connected": self.client.connected.is_set(),
+                "blocks_validated": self.blocks_validated,
+                "validation_failures": self.validation_failures,
+                "blinded_reads": self.blinded_reads,
+                "window": [min(self.blocks), max(self.blocks)]
+                          if self.blocks else None,
+                "wedged": bool(self.injector is not None
+                               and self.injector.wedge),
+                "uptime_s": round(time.time() - self.started_at, 1),
+            }

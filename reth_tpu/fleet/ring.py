@@ -1,0 +1,451 @@
+"""Consistent-hash gateway ring over registered read replicas.
+
+The fleet side of ``rpc/gateway.py``: pure reads admitted by the
+gateway route to a replica picked by consistent-hashing the gateway's
+own ``(method, canonical params, head_hash)`` cache key — identical
+reads land on the same replica and therefore in its response cache,
+and a fleet-size change only remaps ``1/n`` of the key space (the
+classic ring property, here keeping replica caches warm across
+membership churn).
+
+Failure ladder per request: chosen replica → next ring position → the
+local full node (``invoke_local``). A replica that answers with a
+JSON-RPC error (``-32001`` for state outside its witness window, or
+anything else) triggers the same failover — the client NEVER sees a
+replica-induced failure, and every served answer is bit-identical to
+the full node's by the replica's own construction.
+
+Draining: a background prober polls each replica's ``fleet_status``
+(classified into the gateway's ``engine`` admission class, so probes
+can never starve behind a ``debug_traceBlock``) and sheds a replica
+from the ring BEFORE users notice when it degrades — unreachable,
+reporting ``wedged``, lagging more than ``max_lag`` heads behind the
+full node's head, or failing its ``/health`` roll-up. A shed replica
+keeps being probed and rejoins on recovery (hysteresis: ``heal_n``
+consecutive good probes).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import threading
+import time
+import urllib.request
+
+from .. import tracing
+
+PROBE_INTERVAL_S = 0.5
+DEFAULT_MAX_LAG = 4
+DEFAULT_TIMEOUT_S = 5.0
+MAX_RING_TRIES = 2  # replicas tried before falling back to the full node
+
+
+def _hval(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha256(data).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes (stable key → node
+    mapping under membership churn)."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = vnodes
+        self._points: list[int] = []       # sorted vnode positions
+        self._owner: dict[int, str] = {}   # position -> node id
+        self._nodes: set[str] = set()
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, node_id: str) -> bool:
+        return node_id in self._nodes
+
+    def add(self, node_id: str) -> None:
+        if node_id in self._nodes:
+            return
+        self._nodes.add(node_id)
+        for i in range(self.vnodes):
+            pos = _hval(f"{node_id}#{i}".encode())
+            # vanishing collision chance; last writer wins deterministically
+            if pos not in self._owner:
+                bisect.insort(self._points, pos)
+            self._owner[pos] = node_id
+
+    def remove(self, node_id: str) -> None:
+        if node_id not in self._nodes:
+            return
+        self._nodes.discard(node_id)
+        for i in range(self.vnodes):
+            pos = _hval(f"{node_id}#{i}".encode())
+            if self._owner.get(pos) == node_id:
+                del self._owner[pos]
+                idx = bisect.bisect_left(self._points, pos)
+                if idx < len(self._points) and self._points[idx] == pos:
+                    self._points.pop(idx)
+
+    def nodes_for(self, key: bytes):
+        """Distinct node ids in ring order starting at ``key``'s
+        position — the failover order."""
+        if not self._points:
+            return
+        start = bisect.bisect(self._points, _hval(key))
+        seen = set()
+        n = len(self._points)
+        for off in range(n):
+            node = self._owner[self._points[(start + off) % n]]
+            if node not in seen:
+                seen.add(node)
+                yield node
+                if len(seen) == len(self._nodes):
+                    return
+
+
+class ReplicaHandle:
+    """One registered replica: address + probed health + route stats."""
+
+    __slots__ = ("id", "url", "state", "lag", "routed", "failovers",
+                 "errors", "probe_failures", "good_probes",
+                 "registered_at", "last_probe", "last_error")
+
+    def __init__(self, rid: str, url: str):
+        self.id = rid
+        self.url = url.rstrip("/")
+        self.state = "healthy"  # healthy | draining | unreachable
+        self.lag = 0
+        self.routed = 0
+        self.failovers = 0
+        self.errors = 0
+        self.probe_failures = 0
+        self.good_probes = 0
+        self.registered_at = time.time()
+        self.last_probe: float | None = None
+        self.last_error: str | None = None
+
+    def snapshot(self) -> dict:
+        return {"id": self.id, "url": self.url, "state": self.state,
+                "lag": self.lag, "routed": self.routed,
+                "failovers": self.failovers, "errors": self.errors,
+                "last_error": self.last_error}
+
+
+class ReplicaError(Exception):
+    """A replica answered with a JSON-RPC error (failover signal)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class FleetRouter:
+    """The gateway's fleet mode: ring routing + probed draining +
+    failover to the local full node."""
+
+    def __init__(self, *, max_lag: int = DEFAULT_MAX_LAG,
+                 probe_interval: float = PROBE_INTERVAL_S,
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 heal_n: int = 2, vnodes: int = 64, registry=None):
+        from ..metrics import FleetMetrics
+
+        self.max_lag = max_lag
+        self.probe_interval = probe_interval
+        self.timeout_s = timeout_s
+        self.heal_n = heal_n
+        self.ring = HashRing(vnodes=vnodes)
+        self.replicas: dict[str, ReplicaHandle] = {}
+        self.head: tuple[int, bytes] | None = None
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._seq = 0
+        self.metrics = FleetMetrics(registry)
+        # lifetime counters surfaced via snapshot()
+        self.routed = 0
+        self.failovers = 0
+        self.local_fallbacks = 0
+        self.sheds = 0
+        self.heals = 0
+
+    # -- membership ---------------------------------------------------------
+
+    def register(self, url: str, rid: str | None = None) -> str:
+        with self._lock:
+            for h in self.replicas.values():
+                if h.url == url.rstrip("/"):
+                    return h.id  # idempotent re-registration
+            if rid is None:
+                self._seq += 1
+                rid = f"replica-{self._seq}"
+            h = ReplicaHandle(rid, url)
+            self.replicas[rid] = h
+            self.ring.add(rid)
+            self._publish()
+        tracing.event("fleet::ring", "register", id=rid, url=url)
+        return rid
+
+    def deregister(self, rid: str) -> bool:
+        with self._lock:
+            h = self.replicas.pop(rid, None)
+            if h is None:
+                return False
+            self.ring.remove(rid)
+            self._publish()
+        tracing.event("fleet::ring", "deregister", id=rid)
+        return True
+
+    def drain(self, rid: str, why: str = "manual") -> bool:
+        """Shed a replica from the ring (kept registered + probed; a
+        recovered replica rejoins)."""
+        with self._lock:
+            h = self.replicas.get(rid)
+            if h is None:
+                return False
+            if h.state != "draining":
+                h.state = "draining"
+                h.good_probes = 0
+                self.ring.remove(rid)
+                self.sheds += 1
+                self.metrics.record_shed()
+                self._publish()
+        tracing.event("fleet::ring", "drain", id=rid, why=why)
+        return True
+
+    def _heal(self, h: ReplicaHandle) -> None:
+        # caller holds the lock
+        if h.state != "healthy":
+            h.state = "healthy"
+            self.ring.add(h.id)
+            self.heals += 1
+            self.metrics.record_heal()
+            self._publish()
+            tracing.event("fleet::ring", "heal", id=h.id)
+
+    def _publish(self) -> None:
+        # caller holds the lock
+        states = [h.state for h in self.replicas.values()]
+        self.metrics.set_replicas(
+            registered=len(states),
+            healthy=states.count("healthy"),
+            draining=states.count("draining"),
+            unreachable=states.count("unreachable"),
+            max_lag=max((h.lag for h in self.replicas.values()), default=0))
+
+    # -- head fanout --------------------------------------------------------
+
+    def on_head_change(self, chain=None) -> None:
+        """Engine canon listener: record the authoritative head for lag
+        accounting. (Response invalidation is structural — every routed
+        key embeds the head hash, and replicas retire their own caches
+        off the feed's head announcements.)"""
+        if chain:
+            tip = chain[-1]
+            self.head = (tip.number, tip.hash)
+
+    # -- routing ------------------------------------------------------------
+
+    def route(self, method: str, params, key, invoke_local):
+        """One read: ring replica → next ring position → local node."""
+        kb = repr(key).encode()
+        tried = 0
+        with self._lock:
+            order = list(self.ring.nodes_for(kb))
+        for rid in order:
+            if tried >= MAX_RING_TRIES:
+                break
+            with self._lock:
+                h = self.replicas.get(rid)
+                if h is None or h.state != "healthy":
+                    continue
+            tried += 1
+            try:
+                result = self._rpc(h.url, method, params)
+            except ReplicaError as e:
+                # the replica is healthy but cannot answer THIS read
+                # bit-identically (-32001 witness miss, or any error):
+                # fail over without shedding it
+                with self._lock:
+                    h.failovers += 1
+                    self.failovers += 1
+                self.metrics.record_failover()
+                tracing.event("fleet::ring", "failover", id=rid,
+                              method=method, code=e.code)
+                continue
+            except OSError as e:
+                # transport failure: shed NOW, the prober re-admits
+                with self._lock:
+                    h.errors += 1
+                    h.last_error = f"{type(e).__name__}: {e}"
+                    h.failovers += 1
+                    self.failovers += 1
+                self.metrics.record_failover()
+                self._mark_unreachable(rid)
+                continue
+            with self._lock:
+                h.routed += 1
+                self.routed += 1
+            self.metrics.record_routed()
+            return result
+        self.local_fallbacks += 1
+        self.metrics.record_local_fallback()
+        return invoke_local()
+
+    def _mark_unreachable(self, rid: str) -> None:
+        with self._lock:
+            h = self.replicas.get(rid)
+            if h is None:
+                return
+            if h.state != "unreachable":
+                h.state = "unreachable"
+                h.good_probes = 0
+                self.ring.remove(rid)
+                self.sheds += 1
+                self.metrics.record_shed()
+                self._publish()
+        tracing.event("fleet::ring", "shed", id=rid, why="unreachable")
+
+    def _rpc(self, url: str, method: str, params):
+        body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                           "params": params}).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            obj = json.loads(resp.read())
+        if "error" in obj:
+            err = obj["error"] or {}
+            raise ReplicaError(err.get("code", -32000),
+                               err.get("message", "replica error"))
+        return obj.get("result")
+
+    # -- probing / draining -------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None or self.probe_interval <= 0:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._probe_loop,
+                                        daemon=True, name="fleet-prober")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — probing must never die
+                pass
+
+    def probe_once(self) -> None:
+        """One probe pass over every registered replica (the thread
+        body; tests drive it directly for determinism)."""
+        with self._lock:
+            handles = list(self.replicas.values())
+        head_n = self.head[0] if self.head is not None else None
+        for h in handles:
+            verdict, why = self._probe(h, head_n)
+            with self._lock:
+                if h.id not in self.replicas:
+                    continue  # deregistered mid-probe
+                h.last_probe = time.time()
+                if verdict:
+                    h.probe_failures = 0
+                    h.good_probes += 1
+                    if (h.state in ("draining", "unreachable")
+                            and h.good_probes >= self.heal_n):
+                        self._heal(h)
+                else:
+                    h.good_probes = 0
+                    h.probe_failures += 1
+                    h.last_error = why
+                    if h.state == "healthy":
+                        state = ("unreachable" if why.startswith("probe ")
+                                 else "draining")
+                        h.state = state
+                        self.ring.remove(h.id)
+                        self.sheds += 1
+                        self.metrics.record_shed()
+                        self._publish()
+                        tracing.event("fleet::ring", "shed", id=h.id,
+                                      why=why)
+                self._publish()
+
+    def _probe(self, h: ReplicaHandle, head_n: int | None):
+        """(healthy?, reason) for one replica: fleet_status + lag +
+        /health roll-up."""
+        try:
+            status = self._rpc(h.url, "fleet_status", [])
+        except (ReplicaError, OSError) as e:
+            return False, f"probe {type(e).__name__}: {e}"
+        h.lag = int(status.get("lag_heads", 0) or 0)
+        if head_n is not None and status.get("head"):
+            h.lag = max(h.lag, head_n - int(status["head"]["number"]))
+        elif head_n is not None and not status.get("head"):
+            h.lag = max(h.lag, head_n)
+        if status.get("wedged"):
+            return False, "replica wedged"
+        if not status.get("connected", True):
+            return False, "feed disconnected"
+        if h.lag > self.max_lag:
+            return False, f"feed lag {h.lag} > {self.max_lag} heads"
+        # /health roll-up (liveness answered even without --health)
+        try:
+            with urllib.request.urlopen(f"{h.url}/health",
+                                        timeout=self.timeout_s) as resp:
+                health = json.loads(resp.read())
+            if health.get("status") == "failing":
+                return False, "health failing"
+        except OSError:
+            return False, "probe /health unreachable"
+        return True, ""
+
+    # -- observability ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            reps = [h.snapshot() for h in self.replicas.values()]
+        states = [r["state"] for r in reps]
+        return {
+            "replicas": reps,
+            "registered": len(reps),
+            "healthy": states.count("healthy"),
+            "draining": states.count("draining"),
+            "unreachable": states.count("unreachable"),
+            "ring_size": len(self.ring),
+            "routed": self.routed,
+            "failovers": self.failovers,
+            "local_fallbacks": self.local_fallbacks,
+            "sheds": self.sheds,
+            "heals": self.heals,
+            "max_lag": max((r["lag"] for r in reps), default=0),
+            "head": (self.head[0] if self.head is not None else None),
+        }
+
+
+class FleetAdminApi:
+    """fleet_* control surface registered on the full node's public
+    server (classified into the gateway's ``engine`` admission class —
+    registration and draining must never starve behind a debug trace)."""
+
+    def __init__(self, router: FleetRouter, feed_server=None):
+        self.router = router
+        self.feed = feed_server
+
+    def fleet_register(self, url):
+        return self.router.register(url)
+
+    def fleet_deregister(self, rid):
+        return self.router.deregister(rid)
+
+    def fleet_drain(self, rid):
+        return self.router.drain(rid)
+
+    def fleet_status(self):
+        out = self.router.snapshot()
+        if self.feed is not None:
+            out["feed"] = self.feed.snapshot()
+        return out

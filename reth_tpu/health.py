@@ -293,6 +293,17 @@ def _block_wall_ms(engine: "HealthEngine", rule: SloRule) -> float | None:
     return sum(walls) / len(walls) if walls else None
 
 
+def _fleet_unhealthy(engine: "HealthEngine", rule: SloRule) -> float | None:
+    """Replicas shed from the gateway ring: draining + unreachable.
+    None (rule idle) when the node never registered fleet gauges —
+    fleet mode off."""
+    draining = engine.sampler.latest("fleet_replicas_draining")
+    unreachable = engine.sampler.latest("fleet_replicas_unreachable")
+    if draining is None and unreachable is None:
+        return None
+    return (draining or 0) + (unreachable or 0)
+
+
 def default_rules() -> list[SloRule]:
     """The default rule table over the hot paths the repo instruments.
     Budgets are deliberately loose — SLOs page on pathology (a stall, a
@@ -388,6 +399,16 @@ def default_rules() -> list[SloRule]:
                 metric="tree_reorg_backoff_active", failing_factor=1e9,
                 help="reorg-storm backoff active (speculative paths "
                      "stood down while forkchoice churns)"),
+        # replica fleet (fleet/ring.py): one shed replica degrades the
+        # fleet component within a window (the ring already routed
+        # around it — reads fail over to neighbors / the local node, so
+        # this never self-escalates to failing); a whole-fleet outage
+        # just means every read serves locally, which is yesterday's
+        # single-node behavior, not an incident
+        SloRule("fleet_unhealthy_replicas", "fleet", "callable", 0.5,
+                source=_fleet_unhealthy, failing_factor=1e9,
+                help="replicas shed from the gateway ring (draining or "
+                     "unreachable; reads failing over)"),
     ]
     return rules
 

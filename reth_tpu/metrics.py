@@ -1149,3 +1149,125 @@ class EngineTreeMetrics:
 
 
 tree_metrics = EngineTreeMetrics()
+
+
+class FleetMetrics:
+    """Replica-fleet observability (fleet/ring.py + fleet/feed.py):
+    ring membership by state, per-request routing/failover counters,
+    feed fanout health (witness bytes per block, subscriber count,
+    generation failures), and the worst per-replica feed lag — the
+    numbers that say whether the fleet is actually absorbing read
+    traffic and which replica the ring shed."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._registered = reg.gauge(
+            "fleet_replicas_registered", "replicas known to the ring")
+        self._healthy = reg.gauge(
+            "fleet_replicas_healthy", "replicas currently in the ring")
+        self._draining = reg.gauge(
+            "fleet_replicas_draining",
+            "replicas shed from the ring (degraded, still probed)")
+        self._unreachable = reg.gauge(
+            "fleet_replicas_unreachable",
+            "replicas shed from the ring (transport-dead, still probed)")
+        self._max_lag = reg.gauge(
+            "fleet_feed_lag_heads",
+            "worst per-replica feed lag behind the full node's head")
+        self._routed = reg.counter(
+            "fleet_routed_total", "reads served by a ring replica")
+        self._failovers = reg.counter(
+            "fleet_failovers_total",
+            "reads that failed over to the next ring position")
+        self._local = reg.counter(
+            "fleet_local_fallbacks_total",
+            "reads answered by the local full node (ladder's last rung)")
+        self._shed = reg.counter(
+            "fleet_sheds_total", "replicas shed from the ring")
+        self._heals = reg.counter(
+            "fleet_heals_total", "shed replicas re-admitted on recovery")
+        self._subscribers = reg.gauge(
+            "fleet_feed_subscribers", "replicas subscribed to the feed")
+        self._witness_bytes = reg.histogram(
+            "fleet_witness_bytes", "witness feed record size per block",
+            buckets=(1024, 4096, 16384, 65536, 262144, 1048576, 4194304))
+        self._witness_failures = reg.counter(
+            "fleet_witness_failures_total",
+            "blocks whose witness generation failed (record skipped)")
+        self._feed_drops = reg.counter(
+            "fleet_feed_dropped_blocks_total",
+            "blocks dropped from a full feed queue (replicas re-anchor)")
+
+    def set_replicas(self, *, registered: int, healthy: int, draining: int,
+                     unreachable: int, max_lag: int) -> None:
+        self._registered.set(registered)
+        self._healthy.set(healthy)
+        self._draining.set(draining)
+        self._unreachable.set(unreachable)
+        self._max_lag.set(max_lag)
+
+    def record_routed(self) -> None:
+        self._routed.increment()
+
+    def record_failover(self) -> None:
+        self._failovers.increment()
+
+    def record_local_fallback(self) -> None:
+        self._local.increment()
+
+    def record_shed(self) -> None:
+        self._shed.increment()
+
+    def record_heal(self) -> None:
+        self._heals.increment()
+
+    def set_subscribers(self, n: int) -> None:
+        self._subscribers.set(n)
+
+    def record_witness(self, size: int) -> None:
+        self._witness_bytes.record(size)
+
+    def record_witness_failure(self) -> None:
+        self._witness_failures.increment()
+
+    def record_feed_drop(self) -> None:
+        self._feed_drops.increment()
+
+
+class ReplicaMetrics:
+    """Replica-process observability (fleet/replica.py): validated
+    blocks + stateless-validation wall, feed lag as the replica itself
+    sees it, validation failures, and reads refused because the witness
+    never revealed the path (-32001 → gateway failover)."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._validated = reg.counter(
+            "replica_blocks_validated_total",
+            "blocks validated through StatelessChain")
+        self._validate_wall = reg.histogram(
+            "replica_validate_seconds",
+            "stateless re-execution + root recompute wall per block",
+            buckets=SUB_MS_BUCKETS)
+        self._failures = reg.counter(
+            "replica_validation_failures_total",
+            "fed blocks that failed stateless validation (skipped)")
+        self._lag = reg.gauge(
+            "replica_feed_lag_heads",
+            "announced head minus validated head")
+        self._blinded = reg.counter(
+            "replica_blinded_reads_total",
+            "reads refused with -32001 (path not in the witness)")
+
+    def record_validated(self, wall_s: float) -> None:
+        self._validated.increment()
+        self._validate_wall.record(wall_s)
+
+    def record_validation_failure(self) -> None:
+        self._failures.increment()
+
+    def set_lag(self, lag: int) -> None:
+        self._lag.set(lag)
+
+    def record_blinded(self) -> None:
+        self._blinded.increment()

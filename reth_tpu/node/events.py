@@ -154,6 +154,34 @@ class NodeEventReporter:
                      f" hit={g['cache_hit_rate']}]")
             if g["sheds"]:
                 line += f" gw_sheds={g['sheds']}"
+        # --fleet: the replica fleet's one-line health — ring membership
+        # (healthy/registered), worst feed lag, how many reads actually
+        # landed on replicas vs failed over or fell back to this node,
+        # and the feed's fanout state (subscribers, witness bytes/block)
+        # — the numbers that say the fleet is absorbing read traffic
+        fr = getattr(self.node, "fleet_router", None)
+        if fr is not None:
+            f = fr.snapshot()
+            line += (f" fleet[{f['healthy']}/{f['registered']}"
+                     f" routed={f['routed']}")
+            if f["max_lag"]:
+                line += f" lag^={f['max_lag']}"
+            if f["failovers"]:
+                line += f" fo={f['failovers']}"
+            if f["local_fallbacks"]:
+                line += f" local={f['local_fallbacks']}"
+            if f["sheds"]:
+                line += f" sheds={f['sheds']}"
+            fs = getattr(self.node, "feed_server", None)
+            if fs is not None:
+                s = fs.snapshot()
+                line += (f" feed={s['subscribers']}sub"
+                         f"/{s['blocks_sent']}blk")
+                if s["last_witness_bytes"]:
+                    line += f" wit={s['last_witness_bytes']}B"
+                if s["witness_failures"]:
+                    line += f" witfail={s['witness_failures']}"
+            line += "]"
         # rebuild-pipeline stage walls: during a chunked Merkle rebuild this
         # is the line that says where the time goes (host sweep vs hashing)
         from ..metrics import pipeline_metrics

@@ -118,6 +118,18 @@ class NodeConfig:
     # invalid-payload flood plateaus here instead of leaking memory.
     # None = RETH_TPU_INVALID_CACHE env or 512.
     invalid_cache_size: int | None = None
+    # --fleet / [node] fleet: read-replica fleet mode (fleet/) — start
+    # the witness feed server (per-block ExecutionWitness fanout to
+    # subscribed stateless replicas), put the RPC gateway in fleet mode
+    # (consistent-hash ring routing of pure reads with per-replica
+    # draining and replica→ring-neighbor→local failover), and register
+    # the fleet_* admin methods. Implies rpc_gateway.
+    fleet: bool = False
+    # --feed-port: witness feed TCP port (0 = ephemeral)
+    feed_port: int = 0
+    # --fleet-max-lag: heads a replica may trail the node's head before
+    # the ring sheds it (fleet/ring.py prober)
+    fleet_max_lag: int = 4
 
 
 class Node:
@@ -375,12 +387,27 @@ class Node:
         # outranks public debug traffic) and by the WS/IPC transports
         # that wrap the public registry. Response-cache keys embed the
         # canonical head; the canon listener clears dead-head entries.
+        # --fleet: witness feed server + fleet router BEFORE the gateway
+        # so the gateway can route reads through the ring (fleet/)
+        self.feed_server = None
+        self.fleet_router = None
+        if config.fleet:
+            from ..fleet.feed import WitnessFeedServer
+            from ..fleet.ring import FleetRouter
+
+            self.feed_server = WitnessFeedServer(
+                self.tree, chain_id=config.chain_id,
+                chain_spec=config.chain_spec, port=config.feed_port)
+            self.tree.canon_listeners.append(self.feed_server.on_canon_change)
+            self.fleet_router = FleetRouter(max_lag=config.fleet_max_lag)
+            self.tree.canon_listeners.append(self.fleet_router.on_head_change)
         self.gateway = None
-        if config.rpc_gateway:
+        if config.rpc_gateway or config.fleet:
             from ..rpc.gateway import RpcGateway
 
             self.gateway = RpcGateway(
-                head_supplier=lambda: self.tree.head_hash)
+                head_supplier=lambda: self.tree.head_hash,
+                fleet=self.fleet_router)
             self.tree.canon_listeners.append(self.gateway.on_head_change)
         self.eth_api = EthApi(self.tree, self.pool, config.chain_id,
                               tx_batcher=self.tx_batcher)
@@ -401,6 +428,14 @@ class Node:
         self.rpc.register(BundleApi(self.eth_api))
         self.rpc.register(ValidationApi(self.eth_api))
         self.rpc.register(MinerApi(self.payload_service, self.pool))
+        if self.fleet_router is not None:
+            from ..fleet.ring import FleetAdminApi
+
+            # fleet_* classifies into the gateway's engine admission
+            # class: replica registration/draining never queues behind
+            # a debug_traceBlock re-execution
+            self.rpc.register(FleetAdminApi(self.fleet_router,
+                                            self.feed_server))
         self.engine_api = EngineApi(self.tree, self.payload_service, pool=self.pool)
         # JWT on the engine port (reference auth_layer.rs): explicit secret,
         # else auto-generated jwt.hex under the datadir; dev mode stays open
@@ -588,6 +623,10 @@ class Node:
             self.ws.start()
         if self.ipc is not None:
             self.ipc.start()
+        if self.feed_server is not None:
+            self.feed_server.start()
+        if self.fleet_router is not None:
+            self.fleet_router.start()
         return ports
 
     def stop(self):
@@ -598,6 +637,10 @@ class Node:
             self.health.stop()
             health_mod.uninstall(self.health)
         self.event_reporter.stop()
+        if self.fleet_router is not None:
+            self.fleet_router.stop()
+        if self.feed_server is not None:
+            self.feed_server.stop()
         self.tasks.graceful_shutdown()
         self.rpc.stop()
         self.authrpc.stop()

@@ -42,6 +42,16 @@ Shape:
   cleared wholesale so dead-head entries do not squat the LRU. Composes
   with (does not replace) ``rpc/state_cache.py``, which caches by
   immutable block hash underneath the handlers.
+- **Fleet mode** (``fleet=`` a :class:`~reth_tpu.fleet.ring
+  .FleetRouter`, wired by ``--fleet``): the coalescing leader of a pure
+  read routes through a consistent-hash ring of stateless read replicas
+  keyed by the SAME canonical ``(method, params, head)`` key — identical
+  reads land on the same replica and therefore in its response cache;
+  a replica that errs or cannot answer from its witness window fails
+  over to the next ring position and finally to the local handler, so
+  fleet membership is invisible to clients. ``fleet_*`` admin methods
+  classify into the ``engine`` class: registration and draining must
+  never starve behind a ``debug_traceBlock`` re-execution.
 - **Fault injection** (:class:`GatewayFaultInjector`):
   ``RETH_TPU_FAULT_GATEWAY_STALL`` (seconds added to every execution —
   the overload drill that backs requests up into the bounded queues)
@@ -100,6 +110,13 @@ _MONITORING_METHODS = frozenset({
 def classify(method: str) -> str:
     """Map a JSON-RPC method name onto its admission class."""
     if method.startswith("engine_"):
+        return "engine"
+    if method.startswith("fleet_"):
+        # fleet-admin / feed-control (fleet/ring.py FleetAdminApi +
+        # replica fleet_status probes): ring membership changes and
+        # draining are control-plane traffic — in the 2-slot debug class
+        # they would starve behind a debug_traceBlock re-execution
+        # exactly when a sick replica needs shedding
         return "engine"
     if method in _TX_METHODS:
         return "tx"
@@ -205,6 +222,7 @@ class RpcGateway:
                  coalesce_methods=None,
                  retry_after_s: float = 1.0,
                  injector: GatewayFaultInjector | None = None,
+                 fleet=None,
                  registry=None):
         env = os.environ
         self.head_supplier = head_supplier
@@ -230,6 +248,12 @@ class RpcGateway:
         self.retry_after_s = retry_after_s
         self.injector = (injector if injector is not None
                          else GatewayFaultInjector.from_env())
+        # fleet mode (fleet/ring.py FleetRouter): pure reads route to a
+        # consistent-hash ring of stateless replicas keyed by the SAME
+        # (method, params, head) cache key — identical reads land on the
+        # same replica and its response cache; failures ladder replica →
+        # ring neighbor → the local handler. None = serve locally.
+        self.fleet = fleet
 
         from ..metrics import GatewayMetrics
 
@@ -275,8 +299,12 @@ class RpcGateway:
                 if entry.error is not None:
                     raise entry.error
                 return entry.result
+            exec_fn = invoke
+            if self.fleet is not None:
+                exec_fn = (lambda m=method, p=params, k=key:
+                           self.fleet.route(m, p, k, invoke))
             try:
-                result = self._admit_and_run(cls, method, invoke)
+                result = self._admit_and_run(cls, method, exec_fn)
             except BaseException as e:
                 entry.error = e
                 raise
@@ -470,4 +498,6 @@ class RpcGateway:
             "invalidations": self.invalidations,
             "fault_injection": (self.injector.active()
                                 if self.injector is not None else False),
+            **({"fleet": self.fleet.snapshot()}
+               if self.fleet is not None else {}),
         }

@@ -1000,6 +1000,14 @@ class PreservedSparseTrie:
         self._anchor = block_hash
         self._trie = trie
 
+    def peek(self, block_hash: bytes) -> SparseStateTrie | None:
+        """Read the preserved trie WITHOUT claiming it (the replica
+        role serves reads from it between blocks; the next validate
+        still takes it normally)."""
+        if self._trie is not None and self._anchor == block_hash:
+            return self._trie
+        return None
+
     def invalidate(self) -> None:
         self._anchor = None
         self._trie = None

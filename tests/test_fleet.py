@@ -1,0 +1,589 @@
+"""Stateless read-replica fleet (reth_tpu/fleet/): witness feed framing,
+the consistent-hash ring + router draining ladder, replica serving
+bit-identical to the full node, and the kill-mid-load chaos drills."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from reth_tpu.fleet.feed import (
+    FEED_MAGIC,
+    FeedError,
+    recv_frame,
+    send_frame,
+)
+from reth_tpu.fleet.replica import ReplicaFaultInjector, ReplicaNode
+from reth_tpu.fleet.ring import FleetRouter, HashRing
+from reth_tpu.metrics import MetricsRegistry
+from reth_tpu.primitives.keccak import keccak256, keccak256_batch_np
+from reth_tpu.primitives.rlp import encode_int, rlp_encode
+from reth_tpu.primitives.types import Account
+from reth_tpu.rpc.server import RpcServer
+from reth_tpu.testing import Wallet
+from reth_tpu.trie.committer import TrieCommitter
+
+
+# -- consistent-hash ring -----------------------------------------------------
+
+
+def test_ring_deterministic_and_distinct_failover_order():
+    r = HashRing(vnodes=32)
+    for n in ("a", "b", "c"):
+        r.add(n)
+    key = b"gateway-cache-key"
+    order = list(r.nodes_for(key))
+    assert sorted(order) == ["a", "b", "c"]
+    assert order == list(r.nodes_for(key))  # stable
+    assert len(set(order)) == 3             # distinct failover order
+
+
+def test_ring_minimal_disruption_on_membership_change():
+    r = HashRing(vnodes=64)
+    for n in ("a", "b", "c", "d"):
+        r.add(n)
+    keys = [str(i).encode() for i in range(400)]
+    before = {k: next(r.nodes_for(k)) for k in keys}
+    r.remove("d")
+    after = {k: next(r.nodes_for(k)) for k in keys}
+    # only keys that lived on the removed node remap
+    assert all(after[k] == before[k] for k in keys if before[k] != "d")
+    assert any(before[k] == "d" for k in keys)
+    # re-adding restores the original mapping exactly
+    r.add("d")
+    assert all(next(r.nodes_for(k)) == before[k] for k in keys)
+
+
+def test_ring_empty_and_single():
+    r = HashRing()
+    assert list(r.nodes_for(b"x")) == []
+    r.add("only")
+    assert list(r.nodes_for(b"x")) == ["only"]
+    r.remove("only")
+    assert list(r.nodes_for(b"x")) == []
+
+
+# -- feed framing -------------------------------------------------------------
+
+
+def test_feed_frame_roundtrip_and_corruption():
+    a, b = socket.socketpair()
+    try:
+        payload = {"type": "block", "number": 7, "blob": b"\x00" * 1000}
+        send_frame(a, payload)
+        assert recv_frame(b) == payload
+        # CRC corruption: flip a payload byte behind a valid header
+        send_frame(a, {"x": 1})
+        raw = bytearray(b.recv(65536))
+        raw[-1] ^= 0xFF
+        c, d = socket.socketpair()
+        try:
+            c.sendall(bytes(raw))
+            with pytest.raises(FeedError, match="CRC"):
+                recv_frame(d)
+        finally:
+            c.close()
+            d.close()
+        # torn tail: a peer dying mid-frame is a clean ConnectionError
+        e, f = socket.socketpair()
+        try:
+            import pickle
+            import struct
+            import zlib
+
+            payload = pickle.dumps({"z": 3})
+            frame = struct.pack("<II", len(payload),
+                                zlib.crc32(payload)) + payload
+            e.sendall(frame[:len(frame) // 2])
+            e.close()
+            with pytest.raises(ConnectionError):
+                recv_frame(f)
+        finally:
+            f.close()
+    finally:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_replica_fault_injector_env():
+    assert ReplicaFaultInjector.from_env(env={}) is None
+    inj = ReplicaFaultInjector.from_env(
+        env={"RETH_TPU_FAULT_REPLICA_WEDGE": "1"})
+    assert inj is not None and inj.wedge and not inj.lag_s
+    assert inj.on_block(1) is True and inj.dropped == 1
+    inj = ReplicaFaultInjector.from_env(
+        env={"RETH_TPU_FAULT_REPLICA_LAG": "0.01"})
+    assert inj is not None and inj.lag_s == 0.01 and not inj.wedge
+    assert inj.on_block(1) is False and inj.lagged == 1
+
+
+# -- router draining / failover over fake replicas ----------------------------
+
+
+class _FakeReplica:
+    """A plain RpcServer masquerading as a replica: canned fleet_status
+    + an eth_call handler, enough for the router's probe and routing."""
+
+    def __init__(self, result="0xfake", lag=0, wedged=False):
+        self.result = result
+        self.status = {"head": {"number": 5, "hash": "0x00"},
+                       "lag_heads": lag, "wedged": wedged,
+                       "connected": True}
+        self.calls = 0
+        self.srv = RpcServer()
+        self.srv.register_method("fleet_status", lambda: self.status)
+        self.srv.register_method("eth_call", self._call)
+        self.port = self.srv.start()
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def _call(self, *params):
+        self.calls += 1
+        return self.result
+
+    def stop(self):
+        self.srv.stop()
+
+
+def test_router_routes_stably_and_fails_over_to_local():
+    router = FleetRouter(probe_interval=0, registry=MetricsRegistry())
+    reps = [_FakeReplica(result=f"0x{i}") for i in range(2)]
+    try:
+        for r in reps:
+            router.register(r.url)
+        key = ("eth_call", "[]", b"head")
+        local_calls = []
+        out1 = router.route("eth_call", [], key, lambda: local_calls.append(1))
+        out2 = router.route("eth_call", [], key, lambda: local_calls.append(1))
+        # same key -> same replica, and the local node was never touched
+        assert out1 == out2 and not local_calls
+        assert router.routed == 2
+        # a different key may land elsewhere but still on a replica
+        out3 = router.route("eth_call", [], ("eth_call", "[1]", b"head"),
+                            lambda: local_calls.append(1))
+        assert out3 in ("0x0", "0x1") and not local_calls
+    finally:
+        for r in reps:
+            r.stop()
+        router.stop()
+
+
+def test_router_sheds_dead_replica_and_falls_back_local():
+    router = FleetRouter(probe_interval=0, registry=MetricsRegistry())
+    rep = _FakeReplica()
+    rid = router.register(rep.url)
+    rep.stop()  # transport-dead
+    out = router.route("eth_call", [], ("eth_call", "[]", b"h"),
+                       lambda: "local-answer")
+    assert out == "local-answer"
+    assert router.local_fallbacks == 1 and router.failovers == 1
+    snap = router.snapshot()
+    assert snap["healthy"] == 0
+    assert snap["replicas"][0]["state"] == "unreachable"
+    # probe keeps it out of the ring while dead
+    router.probe_once()
+    assert router.snapshot()["healthy"] == 0
+    router.deregister(rid)
+
+
+def test_router_probe_drains_on_lag_and_wedge_then_heals():
+    router = FleetRouter(probe_interval=0, max_lag=2, heal_n=1,
+                         registry=MetricsRegistry())
+    rep = _FakeReplica(lag=5)
+    try:
+        router.register(rep.url)
+        router.probe_once()
+        snap = router.snapshot()
+        assert snap["healthy"] == 0
+        assert snap["replicas"][0]["state"] == "draining"
+        assert "lag" in snap["replicas"][0]["last_error"]
+        # recovery: lag drops -> heal_n good probes re-admit it
+        rep.status["lag_heads"] = 0
+        router.probe_once()
+        assert router.snapshot()["healthy"] == 1
+        assert router.heals == 1
+        # wedged flag sheds regardless of lag
+        rep.status["wedged"] = True
+        router.probe_once()
+        assert router.snapshot()["replicas"][0]["state"] == "draining"
+    finally:
+        rep.stop()
+        router.stop()
+
+
+def test_router_replica_error_fails_over_without_shedding():
+    router = FleetRouter(probe_interval=0, registry=MetricsRegistry())
+
+    class _Erroring(_FakeReplica):
+        def _call(self, *params):
+            self.calls += 1
+            from reth_tpu.rpc.server import RpcError
+
+            raise RpcError(-32001, "state not in witness")
+
+    rep = _Erroring()
+    try:
+        router.register(rep.url)
+        out = router.route("eth_call", [], ("eth_call", "[]", b"h"),
+                           lambda: "local")
+        assert out == "local"
+        assert rep.calls == 1
+        # a witness miss is a failover, not a shed: the replica stays in
+        snap = router.snapshot()
+        assert snap["healthy"] == 1 and router.failovers == 1
+    finally:
+        rep.stop()
+        router.stop()
+
+
+# -- end-to-end: fleet node + live replicas -----------------------------------
+
+# PUSH1 32 CALLDATALOAD (value) PUSH0 CALLDATALOAD (key) SSTORE STOP:
+# a kvstore writing storage[calldata[0:32]] = calldata[32:64]
+KV_CODE = bytes.fromhex("6020355f355500")
+# PUSH0 PUSH0 LOG0 STOP: emits one empty log
+LOG_CODE = bytes.fromhex("5f5fa000")
+
+
+def _initcode(runtime: bytes) -> bytes:
+    n = len(runtime)
+    return bytes([0x60, n, 0x60, 0x0B, 0x5F, 0x39, 0x60, n, 0x5F, 0xF3]) \
+        + b"\x00" + runtime
+
+
+def _create_address(sender: bytes, nonce: int) -> bytes:
+    return keccak256(rlp_encode([sender, encode_int(nonce)]))[12:]
+
+
+def _kv_set(wallet, kv, key: int, value: int):
+    data = key.to_bytes(32, "big") + value.to_bytes(32, "big")
+    return wallet.call(kv, data)
+
+
+def _rpc(port, method, params):
+    body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                       "params": params}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", data=body,
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=15).read())
+
+
+@pytest.fixture(scope="module")
+def fleet_env():
+    """A dev full node in fleet mode + one synced replica: blocks carry
+    transfers, kvstore storage writes, and a log-emitting call."""
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.testing import ChainBuilder
+
+    committer = TrieCommitter(hasher=keccak256_batch_np)
+    committer.turbo_backend = "numpy"
+    wallet = Wallet(0xF1EE7)
+    builder = ChainBuilder({wallet.address: Account(balance=10**21)},
+                           committer=committer)
+    node = Node(NodeConfig(dev=True, genesis_header=builder.genesis,
+                           genesis_alloc=builder.accounts_at_genesis,
+                           fleet=True, http_port=0, authrpc_port=0),
+                committer=committer)
+    node.fleet_router.probe_interval = 0  # probed explicitly
+    http, _ = node.start_rpc()
+    fport = node.feed_server.port
+    replica = ReplicaNode("127.0.0.1", fport, registry=MetricsRegistry(),
+                          replica_id="t-replica")
+    rport = replica.start()
+
+    kv = _create_address(wallet.address, 0)
+    logger = _create_address(wallet.address, 1)
+    sink = b"\x0b" * 20
+    blocks = [
+        [wallet.deploy(_initcode(KV_CODE)),
+         wallet.deploy(_initcode(LOG_CODE))],
+        [_kv_set(wallet, kv, 1, 0xA1), _kv_set(wallet, kv, 2, 0xB2),
+         _kv_set(wallet, kv, 3, 0xC3)],
+        [wallet.call(logger, b""), wallet.transfer(sink, 1000)],
+        # n+1 deletes a key that collapses into a sibling the previous
+        # block's witness never revealed — the closure path, live
+        [_kv_set(wallet, kv, 2, 0)],
+    ]
+    for i, txs in enumerate(blocks):
+        for tx in txs:
+            node.pool.add_transaction(tx)
+        node.miner.mine_block(timestamp=1_700_000_000 + i * 12)
+    assert replica.wait_synced(len(blocks), timeout=60), feed_diag(node)
+    node.fleet_router.register(f"http://127.0.0.1:{rport}")
+    node.fleet_router.probe_once()
+    env = {"node": node, "replica": replica, "wallet": wallet,
+           "http": http, "rport": rport, "kv": kv, "logger": logger,
+           "sink": sink, "tip": len(blocks), "fport": fport}
+    yield env
+    replica.stop()
+    node.stop()
+
+
+def feed_diag(node):
+    return f"feed: {node.feed_server.snapshot()}"
+
+
+def test_replica_validates_with_zero_failures(fleet_env):
+    r = fleet_env["replica"]
+    assert r.blocks_validated == fleet_env["tip"]
+    assert r.validation_failures == 0
+    assert r.lag_heads() == 0
+    st = r.status()
+    assert st["connected"] and not st["wedged"]
+    assert st["window"] == [1, fleet_env["tip"]]
+
+
+def test_replica_blocks_bit_identical(fleet_env):
+    http, rport, tip = (fleet_env[k] for k in ("http", "rport", "tip"))
+    for n in range(1, tip + 1):
+        for full in (False, True):
+            a = _rpc(http, "eth_getBlockByNumber", [hex(n), full])
+            b = _rpc(rport, "eth_getBlockByNumber", [hex(n), full])
+            assert a["result"] == b["result"]
+    h = _rpc(http, "eth_getBlockByNumber", [hex(tip), False])["result"]["hash"]
+    a = _rpc(http, "eth_getBlockByHash", [h, True])
+    b = _rpc(rport, "eth_getBlockByHash", [h, True])
+    assert a["result"] == b["result"]
+
+
+def test_replica_calls_bit_identical(fleet_env):
+    http, rport = fleet_env["http"], fleet_env["rport"]
+    wallet, sink = fleet_env["wallet"], fleet_env["sink"]
+    calls = [
+        {"from": "0x" + wallet.address.hex(), "to": "0x" + sink.hex(),
+         "value": "0x5"},
+        {"from": "0x" + wallet.address.hex(),
+         "to": "0x" + fleet_env["logger"].hex(), "data": "0x"},
+        {"from": "0x" + wallet.address.hex(),
+         "to": "0x" + fleet_env["kv"].hex(),
+         "data": "0x" + (7).to_bytes(32, "big").hex()
+                 + (9).to_bytes(32, "big").hex()},
+    ]
+    for call in calls:
+        a = _rpc(http, "eth_call", [call, "latest"])
+        b = _rpc(rport, "eth_call", [call, "latest"])
+        assert a["result"] == b["result"], call
+        a = _rpc(http, "eth_estimateGas", [call, "latest"])
+        b = _rpc(rport, "eth_estimateGas", [call, "latest"])
+        assert a["result"] == b["result"], call
+
+
+def test_replica_logs_bit_identical(fleet_env):
+    http, rport, tip = (fleet_env[k] for k in ("http", "rport", "tip"))
+    filt = {"fromBlock": "0x1", "toBlock": hex(tip)}
+    a = _rpc(http, "eth_getLogs", [filt])
+    b = _rpc(rport, "eth_getLogs", [filt])
+    assert a["result"] == b["result"]
+    assert a["result"], "the logger call must actually emit a log"
+    addr_filt = {**filt, "address": "0x" + fleet_env["logger"].hex()}
+    assert (_rpc(http, "eth_getLogs", [addr_filt])["result"]
+            == _rpc(rport, "eth_getLogs", [addr_filt])["result"])
+
+
+def test_replica_proofs_bit_identical(fleet_env):
+    http, rport = fleet_env["http"], fleet_env["rport"]
+    wallet, kv = fleet_env["wallet"], fleet_env["kv"]
+    for addr, slots in (("0x" + wallet.address.hex(), []),
+                        ("0x" + kv.hex(), ["0x1", "0x3"]),
+                        ("0x" + kv.hex(), ["0x2"])):  # deleted slot
+        a = _rpc(http, "eth_getProof", [addr, slots, "latest"])
+        b = _rpc(rport, "eth_getProof", [addr, slots, "latest"])
+        assert a["result"] == b["result"], (addr, slots)
+
+
+def test_replica_refuses_out_of_window_with_32001(fleet_env):
+    rport, tip = fleet_env["rport"], fleet_env["tip"]
+    # a hash the replica never saw
+    resp = _rpc(rport, "eth_getBlockByHash", ["0x" + "ab" * 32, False])
+    assert resp["error"]["code"] == -32001
+    # logs from "earliest" reach below the replica window (no genesis)
+    resp = _rpc(rport, "eth_getLogs", [{"fromBlock": "0x0",
+                                        "toBlock": hex(tip)}])
+    assert resp["error"]["code"] == -32001
+
+
+def test_gateway_routes_reads_and_serves_fleet_admin(fleet_env):
+    node, http = fleet_env["node"], fleet_env["http"]
+    router = node.fleet_router
+    node.gateway.on_head_change()  # drop cached entries: force routing
+    before = router.snapshot()["routed"]
+    wallet, sink = fleet_env["wallet"], fleet_env["sink"]
+    for i in range(4):
+        resp = _rpc(http, "eth_call",
+                    [{"from": "0x" + wallet.address.hex(),
+                      "to": "0x" + sink.hex(), "value": hex(0x40 + i)},
+                     "latest"])
+        assert "result" in resp, resp
+    assert router.snapshot()["routed"] >= before + 4
+    st = _rpc(http, "fleet_status", [])["result"]
+    assert st["registered"] >= 1 and st["feed"]["subscribers"] >= 1
+    # fleet admin rides the engine admission class (satellite contract)
+    from reth_tpu.rpc.gateway import classify
+
+    assert classify("fleet_status") == "engine"
+
+
+def test_late_joiner_blinded_read_fails_over_bit_identical(fleet_env):
+    """A replica joining after the feed backlog rotated holds only the
+    newest blocks: a read through an unrevealed path answers -32001,
+    and the SAME read through the fleet gateway still answers
+    bit-identically via the local-fallback rung."""
+    node, http = fleet_env["node"], fleet_env["http"]
+    wallet, kv = fleet_env["wallet"], fleet_env["kv"]
+    node.feed_server.backlog_cap = 1
+    with node.feed_server._lock:
+        del node.feed_server._backlog[:-1]
+    late = ReplicaNode("127.0.0.1", fleet_env["fport"],
+                       registry=MetricsRegistry(), replica_id="late")
+    lport = late.start()
+    router = node.fleet_router
+    try:
+        assert late.wait_synced(fleet_env["tip"], timeout=30)
+        assert late.blocks_validated == 1  # only the backlog tail
+        # slot 1 was written before the late joiner's window: its leaf
+        # sits behind an unrevealed sibling hash -> clean -32001
+        resp = _rpc(lport, "eth_getProof",
+                    ["0x" + kv.hex(), ["0x1"], "latest"])
+        assert resp["error"]["code"] == -32001
+        assert late.blinded_reads >= 1
+        # the same read through the gateway with ONLY the late replica
+        # registered: replica -32001 -> failover -> local full node
+        old = [h.id for h in router.replicas.values()]
+        for rid in old:
+            router.deregister(rid)
+        router.register(f"http://127.0.0.1:{lport}")
+        node.gateway.on_head_change()
+        via_fleet = _rpc(http, "eth_getProof",
+                         ["0x" + kv.hex(), ["0x1"], "latest"])
+        assert "result" in via_fleet
+        naked = RpcServer(lock=node.rpc.lock)
+        naked.methods = node.rpc.methods
+        expect = json.loads(naked.handle(json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": "eth_getProof",
+             "params": ["0x" + kv.hex(), ["0x1"], "latest"]}).encode()))
+        assert via_fleet["result"] == expect["result"]
+        assert router.snapshot()["failovers"] >= 1
+    finally:
+        for h in list(router.replicas.values()):
+            router.deregister(h.id)
+        router.register(f"http://127.0.0.1:{fleet_env['rport']}")
+        late.stop()
+
+
+def test_wedged_replica_reports_and_sheds(fleet_env):
+    node = fleet_env["node"]
+    wedged = ReplicaNode(
+        "127.0.0.1", fleet_env["fport"], registry=MetricsRegistry(),
+        replica_id="wedged",
+        injector=ReplicaFaultInjector(wedge=True))
+    wport = wedged.start()
+    router = node.fleet_router
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if wedged.client.connected.is_set():
+                break
+            time.sleep(0.05)
+        st = _rpc(wport, "fleet_status", [])["result"]
+        assert st["wedged"] is True
+        assert st["blocks_validated"] == 0  # every record dropped
+        rid = router.register(f"http://127.0.0.1:{wport}")
+        router.probe_once()
+        snap = router.snapshot()
+        mine = [r for r in snap["replicas"] if r["id"] == rid]
+        assert mine and mine[0]["state"] == "draining"
+    finally:
+        router.deregister("wedged")
+        for h in list(router.replicas.values()):
+            if h.url.endswith(str(wport)):
+                router.deregister(h.id)
+        wedged.stop()
+
+
+def test_events_line_carries_fleet_fragment(fleet_env):
+    node = fleet_env["node"]
+    node.event_reporter.on_canon_change([node.tree.blocks[h] for h in
+                                         [node.tree.head_hash]])
+    line = node.event_reporter.report_once()
+    assert line is not None and "fleet[" in line and "feed=" in line
+
+
+def test_health_rule_sees_fleet_component(fleet_env):
+    from reth_tpu.health import HealthEngine
+
+    eng = HealthEngine(interval=0)
+    eng.tick()
+    comps = eng.components()
+    assert "fleet" in comps
+    # a shed replica degrades the fleet component within one window
+    node = fleet_env["node"]
+    node.fleet_router.drain(next(iter(node.fleet_router.replicas)))
+    eng.tick()
+    assert eng.components()["fleet"] == "degraded"
+    # restore for other tests
+    for h in node.fleet_router.replicas.values():
+        h.good_probes = 99
+    node.fleet_router.probe_once()
+
+
+# -- chaos drills (multi-process) ---------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_chaos_sigkill_scenario(tmp_path):
+    """SIGKILL one replica mid-load: zero failed reads, bit-identical
+    responses, ring converges (chaos.py --domain fleet)."""
+    from reth_tpu.chaos import make_fleet_scenario, run_fleet_scenario
+
+    scn = make_fleet_scenario(3)
+    assert scn["mode"] == "sigkill"
+    res = run_fleet_scenario(scn, tmp_path, timeout=420)
+    assert res.get("ok"), res
+
+
+@pytest.mark.slow
+def test_fleet_chaos_campaign_ten_seeds(tmp_path):
+    """The acceptance matrix: 10 seeded fleet scenarios (sigkill/wedge/
+    lag mixes composed with full-node injectors) all pass."""
+    from reth_tpu.chaos import run_campaign
+
+    results = run_campaign(range(1, 11), tmp_path, domain="fleet")
+    bad = [r for r in results if not r.get("ok")]
+    assert not bad, bad
+
+
+@pytest.mark.slow
+def test_fleet_bench_mode_e2e(tmp_path):
+    """RETH_TPU_BENCH_MODE=fleet lands a verified number: responses
+    checked bit-identical before measuring, per_fleet carries the
+    1/2-replica curve."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("RETH_TPU_FAULT_")}
+    env.update(JAX_PLATFORMS="cpu", RETH_TPU_BENCH_MODE="fleet",
+               RETH_TPU_BENCH_FLEET_SIZES="1,2",
+               RETH_TPU_BENCH_FLEET_CLIENTS="3",
+               RETH_TPU_BENCH_FLEET_REQS="15",
+               RETH_TPU_BENCH_BASELINE_STORE=str(tmp_path / "bl.json"),
+               RETH_TPU_BENCH_TIMEOUT="420")
+    repo = Path(__file__).resolve().parent.parent
+    r = subprocess.run([sys.executable, str(repo / "bench.py")],
+                       capture_output=True, text=True, timeout=480,
+                       env=env, cwd=repo)
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "fleet_requests_per_sec"
+    assert line.get("error") is None
+    assert line["value"] > 0
+    assert set(line["per_fleet"]) == {"1", "2"}
+    assert line["single_node"]["tail_rps"] > 0
+    assert "bit-identical" in line["verified"]

@@ -70,6 +70,19 @@ def test_classification():
     assert "eth_sendRawTransaction" not in DEFAULT_COALESCE
 
 
+def test_classification_fleet_admin_rides_engine_class():
+    """fleet-admin / feed-control methods (replica registration,
+    draining, ring status probes) must classify as engine so they can
+    never starve in the 2-slot debug class behind a debug_traceBlock
+    re-execution — a sick replica needs shedding exactly when the node
+    is busiest."""
+    for method in ("fleet_register", "fleet_deregister", "fleet_drain",
+                   "fleet_status"):
+        assert classify(method) == "engine", method
+    # and they are control-plane: never coalesced or cached
+    assert not any(m.startswith("fleet_") for m in DEFAULT_COALESCE)
+
+
 # -- coalescing stress --------------------------------------------------------
 
 
