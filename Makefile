@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-chaos test-reorg test-fleet native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-chaos test-reorg test-fleet test-fleet-obs native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -68,7 +68,21 @@ test-gateway:
 # (span cost < 1% of the sparse-commit wall) — CPU-only
 test-obs:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-	  python -m pytest tests/test_observability.py -q -p no:cacheprovider
+	  python -m pytest tests/test_observability.py tests/test_fleet_obs.py \
+	  -q -p no:cacheprovider -m 'not slow'
+
+# fleet observability plane: trace wire-form encode/decode + adoption
+# (feed frames, routed-RPC traceparent), Chrome-trace stitching across
+# >=3 pids, metrics-federation delta protocol + bucket-exact histogram
+# merge (randomized property test) + stale degradation, correlated
+# flight dumps fanned over the feed under RETH_TPU_FAULT_REPLICA_WEDGE,
+# the fleet SLO rules, and the federation/wire-form overhead guards;
+# the @slow half runs the chaos --domain fleet wedge drill end-to-end
+# (3 processes, stitched trace + bucket-exact scope=fleet + one
+# correlation id across all three dumps) — CPU-only
+test-fleet-obs:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_fleet_obs.py -q -p no:cacheprovider
 
 # node health & SLO engine (part of the default `make test` flow —
 # tests/ is swept wholesale): metric ring-buffer retention + windowed
@@ -137,7 +151,7 @@ test-reorg:
 test-chaos:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_wal_recovery.py tests/test_chaos.py \
-	  tests/test_fleet.py -q -p no:cacheprovider
+	  tests/test_fleet.py tests/test_fleet_obs.py -q -p no:cacheprovider
 
 # stateless read-replica fleet: consistent-hash ring units (stability,
 # failover order), witness-feed CRC framing, router draining ladder

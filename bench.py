@@ -880,6 +880,21 @@ def run_fleet_mode() -> None:
     _STATE["backend"] = "cpu"
     _STATE["phase"] = "fleet node build"
 
+    # fleet observability coverage: the bench runs TRACED — the node and
+    # every replica export Chrome traces, and trace_stitched on the JSON
+    # line asserts cross-process parent-id resolution held during the
+    # bench. The exporter must install BEFORE the node mines: bench
+    # main() enables span recording at process start (error-trail
+    # contract), so witness spans generated during mining would
+    # otherwise record + propagate without ever exporting.
+    from reth_tpu import tracing as _tracing
+
+    base = Path(tempfile.mkdtemp(prefix="reth-tpu-bench-fleet-"))
+    _tracing.init_block_tracing(chrome_path=base / "node.trace.json")
+    trace_stitched = False
+    trace_pids = 0
+    trace_diag: dict = {}
+
     committer = TrieCommitter(hasher=keccak256_batch_np)
     committer.turbo_backend = "numpy"
     wallet = Wallet(0xA11CE)
@@ -950,7 +965,6 @@ def run_fleet_mode() -> None:
         return (round(len(lats) / wall, 1),
                 round(float(np.percentile(lats, 99)) * 1e3, 2))
 
-    base = Path(tempfile.mkdtemp(prefix="reth-tpu-bench-fleet-"))
     procs: list = []
     urls: list[str] = []
     per_fleet: dict = {}
@@ -966,7 +980,8 @@ def run_fleet_mode() -> None:
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "reth_tpu.fleet", "replica",
                  "--feed", f"127.0.0.1:{fport}",
-                 "--port-file", str(pf), "--id", f"bench-r{i}"],
+                 "--port-file", str(pf), "--id", f"bench-r{i}",
+                 "--trace-file", str(base / f"replica-{i}.trace.json")],
                 env=env, stdout=log, stderr=log))
             port_files.append(pf)
         deadline = time.time() + 90
@@ -1029,7 +1044,50 @@ def run_fleet_mode() -> None:
             entry["failovers"] = r1["failovers"] - r0["failovers"]
             entry["local"] = (r1["local_fallbacks"]
                               - r0["local_fallbacks"])
+            # per-replica breakdown: routed reads this run (router
+            # handles) + lifetime served/read-p99 pulled over the
+            # metrics federation — a hot or slow replica shows on the
+            # bench line, not just in its own process
+            before = {r["id"]: r["routed"] for r in r0["replicas"]}
+            node.fleet_federation.pull_once()
+            per_replica = {}
+            for r in r1["replicas"]:
+                rid = r["id"]
+                served = node.fleet_federation.replica_latest(
+                    rid, "gateway_requests_total_read")
+                p99 = node.fleet_federation.replica_quantile(
+                    rid, "gateway_service_seconds_read", 0.99)
+                per_replica[rid] = {
+                    "routed": r["routed"] - before.get(rid, 0),
+                    "served_reads": (served["v"] if served else None),
+                    "read_p99_ms": (round(p99 * 1e3, 3)
+                                    if p99 is not None else None),
+                }
+            entry["per_replica"] = per_replica
             per_fleet[n] = entry
+        # stitched-trace assertion: a few more routed reads, then merge
+        # the node's + every replica's Chrome trace — every
+        # cross-process parent id must resolve
+        _STATE["phase"] = "trace stitch check"
+        node.gateway.on_head_change()
+        for i in range(8):
+            node.rpc.handle(call_body(31000 + i))
+        stitch = _tracing.stitch_chrome_traces(
+            [base / "node.trace.json",
+             *sorted(base.glob("replica-*.trace.json"))])
+        trace_pids = len(stitch["pids"])
+        trace_stitched = bool(stitch["stitched"]
+                              and trace_pids >= min(max(sizes), 2) + 1)
+        trace_diag = {"cross_refs": stitch["cross_refs"],
+                      "unresolved_cross":
+                          len(set(stitch["unresolved_cross"]))}
+        if os.environ.get("RETH_TPU_BENCH_TRACE_DEBUG"):
+            # triage aid: print the events whose cross-process parent
+            # never resolved (which span, which pid, which parent)
+            bad = set(stitch["unresolved_cross"])
+            for e in stitch["events"]:
+                if (e.get("args") or {}).get("parent_id") in bad:
+                    sys.stderr.write(f"UNRESOLVED {json.dumps(e)}\n")
     finally:
         for p in procs:
             if p.poll() is None:
@@ -1037,6 +1095,7 @@ def run_fleet_mode() -> None:
                 p.wait()
         shutil.rmtree(base, ignore_errors=True)
         node.stop()
+        _tracing.shutdown_block_tracing()
 
     top = per_fleet[max(sizes)]
     value = top["tail_rps"]
@@ -1052,6 +1111,8 @@ def run_fleet_mode() -> None:
           # fan-out working (replicas are real processes)
           fleet_scaling=round(value / lo, 2) if lo else 0,
           requests_per_mix=clients * reqs, duplicate_pool=len(dup_pool),
+          trace_stitched=trace_stitched, trace_pids=trace_pids,
+          trace_diag=trace_diag,
           verified="bit-identical vs ungated dispatch before measuring",
           exit_code=0)
 

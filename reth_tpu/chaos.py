@@ -247,15 +247,21 @@ def make_fleet_scenario(seed: int) -> dict:
     faults: dict[str, str] = {}
     for f in rng.sample(fault_menu, k=rng.randint(0, 2)):
         faults.update(f)
+    blocks = rng.randint(3, 5)
     return {
         "domain": "fleet",
         "seed": seed,
         "faults": faults,
         "replicas": 2,
-        "blocks": rng.randint(3, 5),
+        "blocks": blocks,
         "requests": rng.randint(120, 200),
         # how the fleet loses a replica mid-load
         "mode": rng.choice(("sigkill", "wedge", "lag")),
+        # wedge replicas validate the initial chain and serve the first
+        # part of the load, then wedge MID-load (deferred injector) —
+        # so the stitched-trace invariant sees all three processes
+        # before the fleet degrades
+        "wedge_after": blocks + 1,
         "kill_frac": 0.4,
         "max_lag": 2,
     }
@@ -600,6 +606,63 @@ def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
     return 0
 
 
+def _parse_prom(text: str) -> dict:
+    """Exposition text -> {series_key: value} (comments skipped)."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _fleet_metrics_bucket_exact(fleet_text: str, own_text: str,
+                                rid: str, family: str) -> bool:
+    """The /metrics?scope=fleet acceptance check for one histogram
+    family: the replica-labeled series equal the replica's OWN
+    /metrics bucket-exactly, and the ``_fleet`` merge equals the
+    bucket-wise sum of every per-replica series in the same scrape."""
+    import re
+
+    fleet = _parse_prom(fleet_text)
+    own = _parse_prom(own_text)
+    own_buckets = {k: v for k, v in own.items()
+                   if k.startswith(family + '_bucket{')}
+    if not own_buckets:
+        return False
+    for k, v in own_buckets.items():
+        m = re.search(r'le="([^"]+)"', k)
+        if m is None:
+            return False
+        fk = f'{family}_bucket{{replica="{rid}",le="{m.group(1)}"}}'
+        if fleet.get(fk) != v:
+            return False
+    if (fleet.get(f'{family}_count{{replica="{rid}"}}')
+            != own.get(f"{family}_count")):
+        return False
+    # bucket-wise merge: _fleet == sum over per-replica series
+    sums: dict[str, float] = {}
+    pat = re.compile(
+        re.escape(family) + r'_bucket\{replica="([^"]+)",le="([^"]+)"\}')
+    for k, v in fleet.items():
+        m = pat.fullmatch(k)
+        if m is None:
+            continue
+        rep, le = m.group(1), m.group(2)
+        if rep == "_fleet":
+            continue
+        sums[le] = sums.get(le, 0.0) + v
+    for le, total in sums.items():
+        fk = f'{family}_bucket{{replica="_fleet",le="{le}"}}'
+        if fleet.get(fk) != total:
+            return False
+    return bool(sums)
+
+
 def child_fleet_victim(datadir: str, seed: int) -> int:
     """Replica-fleet drill (``--domain fleet``): a dev full node in
     fleet mode, two replica subprocesses fed over the witness socket,
@@ -614,10 +677,20 @@ def child_fleet_victim(datadir: str, seed: int) -> int:
     between the fleet path and a direct ungated dispatch, the ring
     converged (exactly one replica shed, requests still routing), and
     the surviving replica's validated head caught back up to the node.
+
+    Observability invariants (PR 14, the fleet-obs acceptance): the
+    merged Chrome traces from the node + both replicas form ONE
+    stitched trace (every cross-process parent id resolves, ≥3 pids);
+    ``/metrics?scope=fleet`` matches the survivor's own registry
+    bucket-exactly and its ``_fleet`` merge is the bucket-wise sum; and
+    a node-side fault event produces flight dumps from every reachable
+    process under ONE correlation id, merged time-ordered.
     """
     import random
     import threading
+    import urllib.request
 
+    from . import tracing
     from .node import Node, NodeConfig
     from .primitives.types import Account
     from .rpc.server import RpcServer
@@ -626,6 +699,14 @@ def child_fleet_victim(datadir: str, seed: int) -> int:
     scn = make_fleet_scenario(seed)
     datadir = Path(datadir)
     rng = random.Random(0xF1EE8000 + seed)
+    # fleet observability plane: one shared flight dir (correlated
+    # dumps from every process land together) + per-process Chrome
+    # traces (stitched-trace invariant)
+    obs_dir = datadir / "obs"
+    obs_dir.mkdir(parents=True, exist_ok=True)
+    os.environ["RETH_TPU_FLIGHT_DIR"] = str(obs_dir)
+    tracing.init_block_tracing(chrome_path=obs_dir / "node.trace.json",
+                               flight_dir=obs_dir)
     committer = _cpu_committer()
     wallet = Wallet(0xA11CE + seed)
     builder = ChainBuilder({wallet.address: Account(balance=10**21)},
@@ -654,8 +735,14 @@ def child_fleet_victim(datadir: str, seed: int) -> int:
         ports = []
         for i in range(scn["replicas"]):
             env = _child_env()
+            # the replicas share the node's flight dir (correlated
+            # dumps) and each writes its half of the stitched trace
+            env["RETH_TPU_FLIGHT_DIR"] = str(obs_dir)
             if i == 0 and scn["mode"] == "wedge":
-                env["RETH_TPU_FAULT_REPLICA_WEDGE"] = "1"
+                # deferred: validate the initial chain + serve the
+                # first part of the load, THEN wedge mid-load
+                env["RETH_TPU_FAULT_REPLICA_WEDGE"] = \
+                    str(scn.get("wedge_after", 1))
             elif i == 0 and scn["mode"] == "lag":
                 # heavy per-block delay: validation falls behind the
                 # mining cadence, so probed lag crosses max_lag
@@ -665,7 +752,9 @@ def child_fleet_victim(datadir: str, seed: int) -> int:
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "reth_tpu.fleet", "replica",
                  "--feed", f"127.0.0.1:{fport}",
-                 "--port-file", str(port_file), "--id", f"r{i}"],
+                 "--port-file", str(port_file), "--id", f"r{i}",
+                 "--trace-file",
+                 str(obs_dir / f"replica-{i}.trace.json")],
                 env=env, stdout=log, stderr=log))
             ports.append(port_file)
         deadline = time.time() + 60
@@ -695,8 +784,10 @@ def child_fleet_victim(datadir: str, seed: int) -> int:
             router.probe_once()
             snap = router.snapshot()
             healthy = snap["healthy"]
-            want = (scn["replicas"] if scn["mode"] == "sigkill"
-                    else scn["replicas"] - 1)
+            # a deferred wedge stays healthy until mid-load, so only
+            # the born-lagging replica is expected shed before the load
+            want = (scn["replicas"] - 1 if scn["mode"] == "lag"
+                    else scn["replicas"])
             if healthy >= want and snap["max_lag"] == 0:
                 break
             time.sleep(0.1)
@@ -812,6 +903,76 @@ def child_fleet_victim(datadir: str, seed: int) -> int:
             if not caught_up:
                 time.sleep(0.2)
         inv["survivor_caught_up"] = caught_up
+
+        # -- fleet observability invariants (PR 14) -------------------
+
+        # 6. ONE stitched trace across the fleet: a few more routed
+        # reads (tracing is on), then merge every process's Chrome
+        # trace — every cross-process parent id must resolve and ≥3
+        # pids must appear (node + both replicas; the dead replica's
+        # pre-kill spans still count, its torn file reads tolerantly)
+        for i in range(8):
+            node.rpc.handle(call_body(12000 + i))
+        trace_files = ([obs_dir / "node.trace.json"]
+                       + sorted(obs_dir.glob("replica-*.trace.json")))
+        stitched = tracing.stitch_chrome_traces(trace_files)
+        inv["trace_stitched"] = (stitched["stitched"]
+                                 and len(stitched["pids"]) >= 3)
+        result["trace"] = {
+            "pids": stitched["pids"],
+            "cross_refs": stitched["cross_refs"],
+            "unresolved_cross": stitched["unresolved_cross"][:5],
+            "events": len(stitched["events"]),
+        }
+
+        # 7. /metrics?scope=fleet matches the survivor's own registry
+        # bucket-exactly, and the _fleet merge is the bucket-wise sum
+        # of every per-replica series in the same scrape (the degraded
+        # replica's series ride stale-marked, never blocking the pull)
+        node.fleet_federation.pull_once()
+        fleet_text = urllib.request.urlopen(
+            f"http://127.0.0.1:{node.rpc.port}/metrics?scope=fleet",
+            timeout=10).read().decode()
+        survivor_text = urllib.request.urlopen(
+            f"http://127.0.0.1:{rports[1]}/metrics",
+            timeout=10).read().decode()
+        inv["fleet_metrics"] = _fleet_metrics_bucket_exact(
+            fleet_text, survivor_text, rids[1],
+            "replica_validate_seconds")
+        if scn["mode"] != "sigkill":
+            # the degraded replica is alive: the federation must keep
+            # pulling (wedge) or at least retain stale-marked data
+            inv["fleet_metrics_degraded_visible"] = (
+                f'replica="{rids[0]}"' in fleet_text)
+
+        # 8. correlated flight dumps: a node-side fault event fans the
+        # dump request over the feed; every reachable process dumps
+        # under ONE correlation id (the lagging replica's feed thread
+        # may be minutes behind its record queue, so lag mode only
+        # requires the node + survivor)
+        tracing.reset_fault_dump_limits()
+        tracing.fault_event("fleet_chaos_obs_drill", target="chaos",
+                            seed=seed, mode=scn["mode"])
+        cid = tracing.flight_recorder().last_correlation_id
+        want_pids = 3 if scn["mode"] == "wedge" else 2
+        merged = {}
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            merged = tracing.merge_correlated(cid, obs_dir)
+            if len(merged.get("pids", ())) >= want_pids:
+                break
+            time.sleep(0.25)
+        inv["correlated_dump"] = (len(merged.get("pids", ())) >= want_pids
+                                  and bool(merged.get("records")))
+        ts = [r.get("ts", 0.0) for r in merged.get("records", ())]
+        inv["correlated_time_ordered"] = ts == sorted(ts)
+        result["correlated"] = {
+            "correlation_id": cid,
+            "pids": merged.get("pids"),
+            "dumps": len(merged.get("dumps", ())),
+            "records": len(merged.get("records", ())),
+        }
+
         result["router"] = {k: snap[k] for k in
                             ("routed", "failovers", "local_fallbacks",
                              "sheds", "healthy", "registered")}

@@ -14,8 +14,20 @@ import time
 
 
 def run_replica(args) -> int:
+    from .. import tracing
     from .replica import ReplicaNode
 
+    # fleet-wide trace attribution: this process IS a replica — the
+    # role rides every exported span's resource attributes, the Chrome
+    # process metadata, and the wire form it stamps on outgoing context
+    tracing.set_process_role("replica")
+    if args.trace_file:
+        # cross-process stitching needs the replica's half of the trace
+        # on disk: enable span recording + the Chrome exporter (the
+        # full node's side comes from --trace-blocks); flight dumps go
+        # wherever RETH_TPU_FLIGHT_DIR points (a fleet shares one dir
+        # so correlated dumps land together)
+        tracing.init_block_tracing(chrome_path=args.trace_file)
     host, _, port = args.feed.rpartition(":")
     if not host or not port.isdigit():
         print(f"error: --feed must be HOST:PORT, got {args.feed!r}",
@@ -55,6 +67,9 @@ def run_replica(args) -> int:
     except KeyboardInterrupt:
         pass
     replica.stop()
+    if args.trace_file:
+        # terminate the Chrome trace into a valid JSON array
+        tracing.shutdown_block_tracing()
     return 0
 
 
@@ -77,6 +92,10 @@ def main(argv=None) -> int:
     p.add_argument("--register", default=None,
                    help="full-node RPC URL to self-register with "
                         "(fleet_register)")
+    p.add_argument("--trace-file", dest="trace_file", default=None,
+                   help="write this replica's spans as a Chrome trace "
+                        "here (the replica half of a stitched fleet "
+                        "trace)")
     args = parser.parse_args(argv)
     if args.command == "replica":
         return run_replica(args)

@@ -26,6 +26,20 @@ Frames:
 - ``{"type": "head", "number", "hash"}`` — head announcement (fanout
   invalidation: replicas and the gateway ring key responses by head
   hash, so a new head retires every cached read).
+- ``{"type": "flight_dump", "correlation_id", "reason", "window"}`` —
+  correlated flight-recorder fan-out: any process's fault event or SLO
+  breach stamps a correlation id + time window and this frame carries
+  the dump request across the fleet. The server broadcasts it to every
+  replica; replicas send it UPSTREAM on the same socket (the feed is
+  the fleet's one standing channel), and the server re-fans it to the
+  others — every process dumps under the SAME correlation id, deduped
+  by a bounded seen-set so fan-out cannot loop.
+
+Block records additionally carry a ``"tp"`` member — the wire form of
+the block's trace context (:func:`reth_tpu.tracing.context_to_wire`,
+trace id = block hash hex, parent = the ``witness.generate`` span) — so
+a replica's ``stateless.validate`` span stitches into the SAME trace as
+the full node's block lifecycle, cross-process.
 
 The server generates witnesses on a dedicated worker thread fed by a
 bounded queue from the engine tree's canon listeners — witness
@@ -37,6 +51,7 @@ the next record's parent instead of desyncing.
 
 from __future__ import annotations
 
+import os
 import pickle
 import queue
 import socket
@@ -130,6 +145,11 @@ class WitnessFeedServer:
         # canon notifications overlap (each carries the whole in-memory
         # chain segment): dedupe by hash so every block feeds exactly once
         self._seen: "OrderedDict[bytes, bool]" = OrderedDict()
+        # correlated flight dumps seen (bounded): fan-out dedupe so a
+        # replica-initiated dump re-fanned to the fleet cannot loop
+        self._corr_seen: "OrderedDict[str, bool]" = OrderedDict()
+        self.flight_requests = 0
+        self.flight_fanouts = 0
         # counters surfaced via snapshot() + fleet_* metrics
         self.blocks_sent = 0
         self.heads_sent = 0
@@ -246,11 +266,22 @@ class WitnessFeedServer:
             bh = provider.canonical_hash(k)
             if bh:
                 hashes[k] = bh
-        with tracing.span("fleet::feed", "witness.generate",
-                          number=header.number):
-            w = generate_witness(
-                provider, eb.block, self.tree.committer, list(eb.senders),
-                parent_header, self.tree.config, block_hashes=hashes)
+        # witness generation joins the block's lifecycle trace (trace id
+        # = block hash hex, the engine's trace_block convention) so the
+        # record's wire-form context stitches the replica's validation
+        # spans into the SAME trace cross-process
+        with tracing.use_context(
+                tracing.TraceContext(header.hash.hex(), None)):
+            with tracing.span("fleet::feed", "witness.generate",
+                              number=header.number) as wctx:
+                w = generate_witness(
+                    provider, eb.block, self.tree.committer,
+                    list(eb.senders), parent_header, self.tree.config,
+                    block_hashes=hashes)
+            # only when span recording is on: untraced feeds carry zero
+            # extra bytes per record
+            traceparent = (tracing.context_to_wire(wctx)
+                           if wctx is not None else None)
         record = {
             "type": "block",
             "number": header.number,
@@ -261,6 +292,8 @@ class WitnessFeedServer:
             "witness": {"state": w.state, "codes": w.codes,
                         "keys": w.keys, "headers": w.headers},
         }
+        if traceparent is not None:
+            record["tp"] = traceparent
         size = (sum(map(len, w.state)) + sum(map(len, w.codes))
                 + sum(map(len, w.headers)) + len(record["block_rlp"]))
         self.last_witness_bytes = size
@@ -268,15 +301,87 @@ class WitnessFeedServer:
         self.metrics.record_witness(size)
         return record
 
-    def _broadcast(self, record: dict) -> None:
+    def _broadcast(self, record: dict, exclude=None) -> None:
         with self._lock:
             subs = list(self._subs)
         for s in subs:
+            if s is exclude:
+                continue
             try:
                 with s.lock:
                     send_frame(s.sock, record)
             except OSError:
                 self._drop(s)
+
+    # -- correlated flight dumps --------------------------------------------
+
+    def _corr_mark(self, cid: str) -> bool:
+        """True when ``cid`` is new (mark it seen); bounded LRU."""
+        if not cid:
+            return False
+        with self._lock:
+            if cid in self._corr_seen:
+                return False
+            self._corr_seen[cid] = True
+            while len(self._corr_seen) > 256:
+                self._corr_seen.popitem(last=False)
+        return True
+
+    def request_flight_dump(self, reason: str, correlation_id: str,
+                            window=None) -> None:
+        """Initiator path (this node's own fault event / SLO breach just
+        dumped locally): fan the dump request to every replica so the
+        whole fleet snapshots the same incident under one id."""
+        if not self._corr_mark(correlation_id):
+            return
+        self.flight_fanouts += 1
+        self._broadcast({"type": "flight_dump", "reason": reason,
+                         "correlation_id": correlation_id,
+                         "window": list(window) if window else None,
+                         "origin": {"role": tracing.process_role(),
+                                    "pid": os.getpid()}})
+
+    def fault_observer(self):
+        """The ``tracing.add_fault_observer`` hook for a fleet-mode full
+        node: local dump written -> fan the request out."""
+        def _observer(reason: str, correlation_id: str, window) -> None:
+            self.request_flight_dump(reason, correlation_id, window)
+        return _observer
+
+    def _on_upstream(self, frame: dict, sub: _Subscriber) -> None:
+        """A frame a replica sent UPSTREAM on its feed socket: a
+        replica-side incident asks the fleet to dump. Dump locally and
+        re-fan to the other replicas (never back to the initiator)."""
+        if not isinstance(frame, dict) or frame.get("type") != "flight_dump":
+            return
+        cid = frame.get("correlation_id")
+        if not self._corr_mark(cid):
+            return
+        self.flight_requests += 1
+        tracing.event("fleet::feed", "flight_dump_request",
+                      correlation_id=cid, reason=frame.get("reason"),
+                      origin=str(frame.get("origin")))
+        tracing.flight_dump(str(frame.get("reason") or "fleet"),
+                            correlation_id=cid,
+                            window=frame.get("window"))
+        self.flight_fanouts += 1
+        self._broadcast(frame, exclude=sub)
+
+    def _sub_reader(self, sub: _Subscriber) -> None:
+        """Per-subscriber upstream reader (the feed socket is the
+        fleet's one standing bidirectional channel). A dead socket just
+        ends the reader — the next broadcast drops the subscriber."""
+        while not self._stop.is_set():
+            try:
+                frame = recv_frame(sub.sock)
+            except (ConnectionError, OSError, FeedError):
+                # dead or desynced upstream stream: end the reader; the
+                # next broadcast drops the subscriber if it is gone
+                return
+            try:
+                self._on_upstream(frame, sub)
+            except Exception:  # noqa: BLE001 — diagnostics only
+                pass
 
     def _drop(self, sub: _Subscriber) -> None:
         with self._lock:
@@ -306,7 +411,12 @@ class WitnessFeedServer:
             hello = {"type": "hello", "chain_id": self.chain_id,
                      "head": self.head,
                      "spec": (self.chain_spec.to_json()
-                              if self.chain_spec is not None else None)}
+                              if self.chain_spec is not None else None),
+                     # feed-side process identity (wire-form fields):
+                     # replicas stamp it on their own telemetry so a
+                     # merged fleet view knows which full node fed them
+                     "peer": {"role": tracing.process_role(),
+                              "pid": os.getpid()}}
             with self._lock:
                 backlog = list(self._backlog)
             with sub.lock:
@@ -324,6 +434,10 @@ class WitnessFeedServer:
         with self._lock:
             self._subs.append(sub)
             self.metrics.set_subscribers(len(self._subs))
+        # upstream reader: replicas send flight-dump requests back on
+        # this socket (the correlated-dump channel)
+        threading.Thread(target=self._sub_reader, args=(sub,),
+                         daemon=True, name="feed-upstream").start()
 
     # -- observability ------------------------------------------------------
 
@@ -342,6 +456,8 @@ class WitnessFeedServer:
             "last_witness_bytes": self.last_witness_bytes,
             "total_witness_bytes": self.total_witness_bytes,
             "queue_depth": self._queue.qsize(),
+            "flight_requests": self.flight_requests,
+            "flight_fanouts": self.flight_fanouts,
         }
 
 
@@ -362,10 +478,27 @@ class WitnessFeedClient:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._sock: socket.socket | None = None
+        self._send_lock = threading.Lock()
         self.connected = threading.Event()
         self.connections = 0
         self.frames = 0
         self.frame_errors = 0
+        self.sent_upstream = 0
+
+    def send(self, obj) -> bool:
+        """Send one frame UPSTREAM to the feed server (the replica →
+        full-node half of the correlated-dump channel). Best-effort:
+        False when not connected or the socket died mid-send."""
+        sock = self._sock
+        if sock is None or not self.connected.is_set():
+            return False
+        try:
+            with self._send_lock:
+                send_frame(sock, obj)
+            self.sent_upstream += 1
+            return True
+        except OSError:
+            return False
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True,

@@ -69,26 +69,39 @@ DEFAULT_RETENTION = 128
 
 class ReplicaFaultInjector:
     """Feed-processing fault policies, in the style of the gateway's
-    injector: ``wedge`` drops every block record (serving continues on
-    the stale head), ``lag_s`` sleeps before each one."""
+    injector: ``wedge`` drops every block record from the
+    ``wedge_after``-th onward (serving continues on the stale head —
+    ``RETH_TPU_FAULT_REPLICA_WEDGE=N`` wedges a replica MID-stream, N=1
+    from birth), ``lag_s`` sleeps before each one."""
 
-    def __init__(self, wedge: bool = False, lag_s: float = 0.0):
+    def __init__(self, wedge: bool = False, lag_s: float = 0.0,
+                 wedge_after: int = 1):
         self.wedge = wedge
+        self.wedge_after = max(1, wedge_after)
         self.lag_s = lag_s
+        self.seen = 0
         self.dropped = 0
         self.lagged = 0
 
     @classmethod
     def from_env(cls, env=None) -> "ReplicaFaultInjector | None":
         env = os.environ if env is None else env
-        wedge = env.get("RETH_TPU_FAULT_REPLICA_WEDGE", "") not in ("", "0")
+        wedge_raw = env.get("RETH_TPU_FAULT_REPLICA_WEDGE", "")
+        wedge = wedge_raw not in ("", "0")
+        wedge_after = int(wedge_raw) if wedge_raw.isdigit() and wedge else 1
         lag = float(env.get("RETH_TPU_FAULT_REPLICA_LAG", "0") or 0)
         if not (wedge or lag):
             return None
-        return cls(wedge=wedge, lag_s=lag)
+        return cls(wedge=wedge, lag_s=lag, wedge_after=wedge_after)
 
     def active(self) -> bool:
         return bool(self.wedge or self.lag_s)
+
+    @property
+    def wedging(self) -> bool:
+        """True while the wedge is live (the flag a probe reports) —
+        deferred wedges stay healthy until their Nth block record."""
+        return self.wedge and self.seen + 1 >= self.wedge_after
 
     def on_block(self, number: int) -> bool:
         """Called per block record; True = drop it (wedge drill)."""
@@ -98,7 +111,8 @@ class ReplicaFaultInjector:
                                 target="fleet::replica", number=number,
                                 lag_s=self.lag_s)
             time.sleep(self.lag_s)
-        if self.wedge:
+        self.seen += 1
+        if self.wedge and self.seen >= self.wedge_after:
             self.dropped += 1
             tracing.fault_event("RETH_TPU_FAULT_REPLICA_WEDGE",
                                 target="fleet::replica", number=number)
@@ -375,6 +389,15 @@ class ReplicaEthApi:
         counters a fleet operator reads."""
         return self.r.status()
 
+    def fleet_metricsSnapshot(self, cursor=None):
+        """Metrics federation pull (obs/federation.py): this replica's
+        registry as a delta-encoded snapshot against ``cursor`` (None or
+        a stale cursor returns the full absolute state). Classified into
+        the gateway's engine admission class with the other fleet_*
+        methods — federation pulls must never starve behind a debug
+        trace."""
+        return self.r.federation_source.snapshot(cursor)
+
 
 class ReplicaNode:
     """A witness-fed stateless replica: feed client + StatelessChain +
@@ -405,6 +428,14 @@ class ReplicaNode:
         self.injector = (injector if injector is not None
                          else ReplicaFaultInjector.from_env())
         self.metrics = ReplicaMetrics(registry)
+        # metrics federation source: the full node pulls this replica's
+        # registry (delta-encoded) via fleet_metricsSnapshot
+        from ..obs.federation import FederationSource
+
+        self.federation_source = FederationSource(registry)
+        # correlated flight dumps seen (fan-out dedupe: a dump this
+        # replica initiated comes back on the feed and must not re-dump)
+        self._corr_seen: dict[str, bool] = {}
         self.client = WitnessFeedClient(
             feed_host, feed_port,
             on_hello=self._on_hello, on_record=self._on_record)
@@ -429,12 +460,29 @@ class ReplicaNode:
 
     def start(self) -> int:
         self.http_port = self.rpc.start()
+        # correlated dumps: a replica-side fault event notifies the full
+        # node upstream over the feed socket so the WHOLE fleet dumps
+        # under the initiating incident's correlation id
+        tracing.add_fault_observer(self._on_local_fault)
         self.client.start()
         return self.http_port
 
     def stop(self) -> None:
+        tracing.remove_fault_observer(self._on_local_fault)
         self.client.stop()
         self.rpc.stop()
+
+    def _on_local_fault(self, reason: str, correlation_id: str,
+                        window) -> None:
+        self._corr_seen[correlation_id] = True
+        while len(self._corr_seen) > 256:
+            del self._corr_seen[next(iter(self._corr_seen))]
+        self.client.send({"type": "flight_dump", "reason": reason,
+                          "correlation_id": correlation_id,
+                          "window": list(window) if window else None,
+                          "origin": {"role": "replica",
+                                     "id": self.replica_id,
+                                     "pid": os.getpid()}})
 
     def wait_synced(self, target: int, timeout: float = 15.0) -> bool:
         """Test/CLI helper: wait until the validated head reaches
@@ -473,6 +521,19 @@ class ReplicaNode:
                 self.announced = (record["number"], record["hash"])
                 self._update_lag()
             return
+        if kind == "flight_dump":
+            # correlated dump request fanned out by the full node: dump
+            # this replica's ring under the SAME correlation id (skip if
+            # this replica initiated it — it already dumped)
+            cid = record.get("correlation_id")
+            if cid and cid not in self._corr_seen:
+                self._corr_seen[cid] = True
+                while len(self._corr_seen) > 256:
+                    del self._corr_seen[next(iter(self._corr_seen))]
+                tracing.flight_dump(str(record.get("reason") or "fleet"),
+                                    correlation_id=cid,
+                                    window=record.get("window"))
+            return
         if kind != "block":
             return
         # the announcement is the block itself: lag accounting must see
@@ -510,10 +571,18 @@ class ReplicaNode:
                 return
             parent_header = Header.decode(witness.headers[0])
             t0 = time.monotonic()
+            # cross-process trace adoption: the record's wire-form
+            # context (trace id = block hash, parent = the full node's
+            # witness.generate span) makes this validation part of the
+            # SAME block lifecycle trace the full node recorded
+            remote_ctx = tracing.context_from_wire(record.get("tp"))
             try:
-                with tracing.span("fleet::replica", "stateless.validate",
-                                  number=block.header.number):
-                    self.chain.validate(block, witness, parent_header)
+                with tracing.use_context(remote_ctx or
+                                         tracing.current_context()):
+                    with tracing.span("fleet::replica",
+                                      "stateless.validate",
+                                      number=block.header.number):
+                        self.chain.validate(block, witness, parent_header)
             except (StatelessValidationError, Exception) as e:  # noqa: BLE001
                 # a replica must never crash on a bad record: count it,
                 # keep serving the last good head, re-anchor on the next
@@ -573,6 +642,7 @@ class ReplicaNode:
             head = self.head_header
             return {
                 "id": self.replica_id,
+                "pid": os.getpid(),
                 "head": ({"number": head.number, "hash": data(head.hash)}
                          if head is not None else None),
                 "announced": ({"number": self.announced[0],
@@ -586,6 +656,6 @@ class ReplicaNode:
                 "window": [min(self.blocks), max(self.blocks)]
                           if self.blocks else None,
                 "wedged": bool(self.injector is not None
-                               and self.injector.wedge),
+                               and self.injector.wedging),
                 "uptime_s": round(time.time() - self.started_at, 1),
             }

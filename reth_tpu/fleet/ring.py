@@ -245,7 +245,13 @@ class FleetRouter:
     # -- routing ------------------------------------------------------------
 
     def route(self, method: str, params, key, invoke_local):
-        """One read: ring replica → next ring position → local node."""
+        """One read: ring replica → next ring position → local node.
+
+        Each replica attempt runs under a ``fleet.route`` span tagged
+        with the serving replica's id (a hot or flappy replica shows in
+        the trace, not just the logs), and the span's context rides the
+        request as its ``traceparent`` — the replica adopts it, so the
+        remote handler's spans stitch under this one cross-process."""
         kb = repr(key).encode()
         tried = 0
         with self._lock:
@@ -259,7 +265,10 @@ class FleetRouter:
                     continue
             tried += 1
             try:
-                result = self._rpc(h.url, method, params)
+                with tracing.span("fleet::ring", "fleet.route",
+                                  replica=rid, method=method) as sctx:
+                    result = self._rpc(h.url, method, params,
+                                       ctx=tracing.context_to_wire(sctx))
             except ReplicaError as e:
                 # the replica is healthy but cannot answer THIS read
                 # bit-identically (-32001 witness miss, or any error):
@@ -267,7 +276,7 @@ class FleetRouter:
                 with self._lock:
                     h.failovers += 1
                     self.failovers += 1
-                self.metrics.record_failover()
+                self.metrics.record_failover(rid)
                 tracing.event("fleet::ring", "failover", id=rid,
                               method=method, code=e.code)
                 continue
@@ -278,13 +287,13 @@ class FleetRouter:
                     h.last_error = f"{type(e).__name__}: {e}"
                     h.failovers += 1
                     self.failovers += 1
-                self.metrics.record_failover()
+                self.metrics.record_failover(rid)
                 self._mark_unreachable(rid)
                 continue
             with self._lock:
                 h.routed += 1
                 self.routed += 1
-            self.metrics.record_routed()
+            self.metrics.record_routed(rid)
             return result
         self.local_fallbacks += 1
         self.metrics.record_local_fallback()
@@ -304,9 +313,15 @@ class FleetRouter:
                 self._publish()
         tracing.event("fleet::ring", "shed", id=rid, why="unreachable")
 
-    def _rpc(self, url: str, method: str, params):
-        body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
-                           "params": params}).encode()
+    def _rpc(self, url: str, method: str, params, ctx: dict | None = None):
+        req_obj = {"jsonrpc": "2.0", "id": 1, "method": method,
+                   "params": params}
+        if ctx is not None:
+            # wire-form trace context (tracing.context_to_wire): the
+            # replica's RpcServer adopts it, stitching its handler spans
+            # under this gateway's fleet.route span
+            req_obj["traceparent"] = ctx
+        body = json.dumps(req_obj).encode()
         req = urllib.request.Request(
             url, data=body, headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:

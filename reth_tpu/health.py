@@ -304,6 +304,52 @@ def _fleet_unhealthy(engine: "HealthEngine", rule: SloRule) -> float | None:
     return (draining or 0) + (unreachable or 0)
 
 
+def _federation(with_replicas: bool = True):
+    """The installed metrics federation, or None (fleet obs off / no
+    replicas pulled yet — the rules stay idle rather than paging on an
+    empty fleet)."""
+    from .obs import federation as federation_mod
+
+    fed = federation_mod.get_federation()
+    if fed is None:
+        return None
+    if with_replicas and not fed.snapshot()["replicas"]:
+        return None
+    return fed
+
+
+def _fleet_read_p99(engine: "HealthEngine", rule: SloRule) -> float | None:
+    """Fleet-wide read-serving p99 across every replica's gateway
+    (obs/federation.py bucket-wise merge): the latency the fleet's
+    users actually see, windowed over the federation's pull rings."""
+    fed = _federation()
+    if fed is None:
+        return None
+    return fed.fleet_quantile("gateway_service_seconds_read", 0.99,
+                              samples=rule.window)
+
+
+def _fleet_lag_worst(engine: "HealthEngine", rule: SloRule) -> float | None:
+    """Worst replica feed lag AS THE REPLICAS REPORT IT (the federated
+    replica_feed_lag_heads gauge) — the distribution's max; the ring
+    prober sees the same number, but this one survives the prober being
+    wedged."""
+    fed = _federation()
+    if fed is None:
+        return None
+    return fed.replica_gauge_max("replica_feed_lag_heads")
+
+
+def _fleet_stale(engine: "HealthEngine", rule: SloRule) -> float | None:
+    """Replicas whose federated metrics are stale (pulls failing):
+    per-replica staleness is the federation's own degradation signal —
+    the fleet view is partially blind, even if serving is fine."""
+    fed = _federation()
+    if fed is None:
+        return None
+    return fed.snapshot()["stale"]
+
+
 def default_rules() -> list[SloRule]:
     """The default rule table over the hot paths the repo instruments.
     Budgets are deliberately loose — SLOs page on pathology (a stall, a
@@ -409,6 +455,30 @@ def default_rules() -> list[SloRule]:
                 source=_fleet_unhealthy, failing_factor=1e9,
                 help="replicas shed from the gateway ring (draining or "
                      "unreachable; reads failing over)"),
+        # fleet observability plane (obs/federation.py): fleet-wide
+        # read p99 over the bucket-wise federated histograms — the
+        # number single-process /metrics could never compute
+        SloRule("fleet_read_p99", "fleet", "callable", 0.5,
+                source=_fleet_read_p99, unit="s", failing_factor=4.0,
+                help="fleet-wide p99 read service wall across replica "
+                     "gateways (federated bucket-wise merge)"),
+        # replica-lag distribution: the worst federated
+        # replica_feed_lag_heads — degrades when any replica trails
+        # beyond the ring's shed bound; never self-escalates (the ring
+        # sheds it, reads fail over)
+        # budget mirrors fleet/ring.py DEFAULT_MAX_LAG
+        SloRule("fleet_replica_lag", "fleet", "callable", 4.0,
+                source=_fleet_lag_worst,
+                unit="heads", failing_factor=1e9,
+                help="worst federated replica feed lag (heads behind "
+                     "the announced head)"),
+        # per-replica staleness: the federation itself degrading — a
+        # replica whose metrics can't be pulled leaves the fleet view
+        # partially blind even while serving continues
+        SloRule("fleet_federation_stale", "fleet", "callable", 0.5,
+                source=_fleet_stale, failing_factor=1e9,
+                help="replicas whose federated metrics are stale "
+                     "(fleet_metricsSnapshot pulls failing)"),
     ]
     return rules
 

@@ -25,6 +25,11 @@ class Counter:
     name: str
     help: str = ""
     value: float = 0.0
+    # optional constant labels, rendered as name{k="v",...} — the
+    # per-replica attribution shape (fleet_routed_total{replica="r1"}):
+    # one Counter per label set, registered under the labeled key,
+    # sharing one TYPE line per family on /metrics
+    labels: dict | None = None
     # float += is a read-modify-write: unsynchronized concurrent
     # increments lose counts (every hot path here is multi-threaded)
     _lock: threading.Lock = field(default_factory=threading.Lock,
@@ -140,6 +145,10 @@ def sample_percentile(sorted_samples, pct: int):
     return sorted_samples[idx]
 
 
+def _label_str(labels: dict) -> str:
+    return ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -156,8 +165,14 @@ class MetricsRegistry:
                 )
             return existing
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._register(name, Counter, lambda: Counter(name, help))
+    def counter(self, name: str, help: str = "",
+                labels: dict | None = None) -> Counter:
+        # a labeled counter registers under its full labeled key so one
+        # family holds many series (per-replica attribution); the bare
+        # name stays available for the family's unlabeled aggregate
+        key = name if not labels else f"{name}{{{_label_str(labels)}}}"
+        return self._register(
+            key, Counter, lambda: Counter(name, help, labels=labels))
 
     def gauge(self, name: str, help: str = "", labels: dict | None = None) -> Gauge:
         g = self._register(name, Gauge, lambda: Gauge(name, help, labels=labels))
@@ -180,11 +195,18 @@ class MetricsRegistry:
     def render(self) -> str:
         """Prometheus text exposition format."""
         lines = []
+        typed: set[str] = set()  # one TYPE line per labeled family
         with self._lock:
             for name, m in sorted(self._metrics.items()):
                 if isinstance(m, Counter):
-                    lines.append(f"# TYPE {name} counter")
-                    lines.append(f"{name} {m.value}")
+                    if m.name not in typed:
+                        typed.add(m.name)
+                        lines.append(f"# TYPE {m.name} counter")
+                    if m.labels:
+                        lines.append(
+                            f"{m.name}{{{_label_str(m.labels)}}} {m.value}")
+                    else:
+                        lines.append(f"{name} {m.value}")
                 elif isinstance(m, Gauge):
                     lines.append(f"# TYPE {name} gauge")
                     if m.labels:
@@ -1161,6 +1183,12 @@ class FleetMetrics:
 
     def __init__(self, registry: MetricsRegistry | None = None):
         reg = registry or REGISTRY
+        self._reg = reg
+        # per-replica routing attribution: replica-id-labeled series
+        # beside the unlabeled aggregates, created lazily per replica —
+        # a hot or flappy replica is visible on /metrics without
+        # log-diving (satellite contract)
+        self._per_replica: dict[tuple, Counter] = {}
         self._registered = reg.gauge(
             "fleet_replicas_registered", "replicas known to the ring")
         self._healthy = reg.gauge(
@@ -1206,11 +1234,27 @@ class FleetMetrics:
         self._unreachable.set(unreachable)
         self._max_lag.set(max_lag)
 
-    def record_routed(self) -> None:
-        self._routed.increment()
+    def _replica_counter(self, family: str, help: str, rid: str) -> Counter:
+        key = (family, rid)
+        c = self._per_replica.get(key)
+        if c is None:
+            c = self._per_replica[key] = self._reg.counter(
+                family, help, labels={"replica": rid})
+        return c
 
-    def record_failover(self) -> None:
+    def record_routed(self, rid: str | None = None) -> None:
+        self._routed.increment()
+        if rid:
+            self._replica_counter(
+                "fleet_routed_total",
+                "reads served by a ring replica", rid).increment()
+
+    def record_failover(self, rid: str | None = None) -> None:
         self._failovers.increment()
+        if rid:
+            self._replica_counter(
+                "fleet_failovers_total",
+                "reads that failed over off this replica", rid).increment()
 
     def record_local_fallback(self) -> None:
         self._local.increment()

@@ -182,6 +182,24 @@ class NodeEventReporter:
                 if s["witness_failures"]:
                     line += f" witfail={s['witness_failures']}"
             line += "]"
+        # --fleet: the observability plane's one-line health — how many
+        # replicas the metrics federation is actually pulling (stale =
+        # the fleet view is partially blind), pull cadence/failures, and
+        # correlated flight-dump fan-outs — the numbers that say the
+        # fleet is OBSERVABLE, not just serving
+        fed = getattr(self.node, "fleet_federation", None)
+        if fed is not None:
+            fo = fed.snapshot()
+            line += (f" fleetobs[{fo['replicas'] - fo['stale']}"
+                     f"/{fo['replicas']} pulls={fo['pulls']}")
+            if fo["stale"]:
+                line += f" stale={fo['stale']}"
+            if fo["failures"]:
+                line += f" fail={fo['failures']}"
+            fs = getattr(self.node, "feed_server", None)
+            if fs is not None and fs.flight_fanouts:
+                line += f" dumps={fs.flight_fanouts}"
+            line += "]"
         # rebuild-pipeline stage walls: during a chunked Merkle rebuild this
         # is the line that says where the time goes (host sweep vs hashing)
         from ..metrics import pipeline_metrics

@@ -141,9 +141,14 @@ class Node:
         self.config = config
         # --trace-blocks: enable block-lifecycle tracing before any
         # component runs; traces + flight dumps live under the datadir
-        # (or the cwd for ephemeral nodes)
+        # (or the cwd for ephemeral nodes). An explicit
+        # RETH_TPU_FLIGHT_DIR wins for the dumps: a FLEET shares one
+        # flight directory so correlated dumps from every process land
+        # together — the datadir default must not override it.
         self.trace_path = None
         if config.trace_blocks:
+            import os as _os
+
             from .. import tracing
 
             base = Path(config.datadir) if config.datadir else Path(".")
@@ -151,8 +156,10 @@ class Node:
             trace_dir.mkdir(parents=True, exist_ok=True)
             self.trace_path = (Path(config.trace_file) if config.trace_file
                                else trace_dir / "blocks.trace.json")
-            tracing.init_block_tracing(chrome_path=self.trace_path,
-                                       flight_dir=trace_dir)
+            tracing.init_block_tracing(
+                chrome_path=self.trace_path,
+                flight_dir=(_os.environ.get("RETH_TPU_FLIGHT_DIR")
+                            or trace_dir))
         self.committer = committer or TrieCommitter()
         # device hasher supervisor (--hasher auto): present when the
         # committer routes through ops/supervisor.py — surfaced on the
@@ -391,16 +398,34 @@ class Node:
         # so the gateway can route reads through the ring (fleet/)
         self.feed_server = None
         self.fleet_router = None
+        self.fleet_federation = None
+        self._fleet_fault_observer = None
         if config.fleet:
+            from .. import tracing
             from ..fleet.feed import WitnessFeedServer
             from ..fleet.ring import FleetRouter
+            from ..obs import federation as federation_mod
 
+            # fleet role for cross-process trace attribution (exported
+            # span resource attrs + Chrome process metadata)
+            tracing.set_process_role("full")
             self.feed_server = WitnessFeedServer(
                 self.tree, chain_id=config.chain_id,
                 chain_spec=config.chain_spec, port=config.feed_port)
             self.tree.canon_listeners.append(self.feed_server.on_canon_change)
             self.fleet_router = FleetRouter(max_lag=config.fleet_max_lag)
             self.tree.canon_listeners.append(self.fleet_router.on_head_change)
+            # metrics federation: background pulls of every replica's
+            # registry via fleet_metricsSnapshot -> /metrics?scope=fleet,
+            # debug_fleetMetrics, the fleetobs[...] events fragment, and
+            # the fleet SLO rules (obs/federation.py)
+            self.fleet_federation = federation_mod.MetricsFederation(
+                self.fleet_router)
+            federation_mod.install(self.fleet_federation)
+            # correlated flight dumps: a local fault event / SLO breach
+            # fans its dump request to every replica over the feed
+            self._fleet_fault_observer = self.feed_server.fault_observer()
+            tracing.add_fault_observer(self._fleet_fault_observer)
         self.gateway = None
         if config.rpc_gateway or config.fleet:
             from ..rpc.gateway import RpcGateway
@@ -627,6 +652,8 @@ class Node:
             self.feed_server.start()
         if self.fleet_router is not None:
             self.fleet_router.start()
+        if self.fleet_federation is not None:
+            self.fleet_federation.start()
         return ports
 
     def stop(self):
@@ -637,6 +664,15 @@ class Node:
             self.health.stop()
             health_mod.uninstall(self.health)
         self.event_reporter.stop()
+        if self.fleet_federation is not None:
+            from ..obs import federation as federation_mod
+
+            self.fleet_federation.stop()
+            federation_mod.uninstall(self.fleet_federation)
+        if self._fleet_fault_observer is not None:
+            from .. import tracing
+
+            tracing.remove_fault_observer(self._fleet_fault_observer)
         if self.fleet_router is not None:
             self.fleet_router.stop()
         if self.feed_server is not None:

@@ -1,0 +1,11 @@
+"""Fleet-wide observability plane (PR 14): the cross-process layer over
+``tracing.py`` (trace propagation), ``metrics.py`` (federation), and the
+flight recorder (correlated dumps).
+
+- :mod:`.federation` — the full node pulls every registered replica's
+  metrics registry over the fleet admin channel, merges histograms
+  bucket-wise into a per-replica-labeled federated view, and exposes
+  fleet-wide windowed quantiles (``/metrics?scope=fleet``,
+  ``debug_fleetMetrics``, the ``fleetobs[...]`` events fragment, and the
+  fleet SLO rules in ``health.py``).
+"""

@@ -1,0 +1,592 @@
+"""Metrics federation: one fleet, one metrics surface.
+
+PR 13 made the node a distributed system; its `/metrics` registries
+stayed per-process — a fleet of N replicas was N unmergeable scrape
+targets, and "fleet read p99" was a number nobody could compute. This
+module is the pull half of the fix:
+
+- **Replica side** (:class:`FederationSource`): wraps a
+  :class:`~reth_tpu.metrics.MetricsRegistry` behind a cursor-based
+  delta protocol. A pull with the source's current cursor returns only
+  the metrics that CHANGED since the previous pull — counters and
+  histograms delta-encoded beside their absolute values, gauges by
+  value — bounded to ``max_metrics`` series per pull. A missing or
+  stale cursor (first pull, replica restart, federation restart)
+  returns the full absolute state and re-anchors. Served as the
+  ``fleet_metricsSnapshot`` RPC (engine admission class beside the
+  other ``fleet_*`` methods).
+- **Full-node side** (:class:`MetricsFederation`): a background puller
+  (its OWN thread — a slow or dead replica can never block the feed,
+  the gateway, or the prober) walks the
+  :class:`~reth_tpu.fleet.ring.FleetRouter`'s registered replicas each
+  interval, applies the deltas into per-replica series — the PR 9
+  sampler ring shape: counters ``(ts, cumulative, delta)``, gauges
+  ``(ts, value)``, histograms ``(ts, n_delta, sum_delta,
+  bucket_deltas)`` in bounded rings — and marks a replica **stale**
+  (data retained, age visible) when a pull fails. Merging is
+  bucket-wise: the fleet histogram's counts are the element-wise sums
+  of the per-replica counts, so a federated quantile
+  (:meth:`MetricsFederation.fleet_quantile`, via the shared
+  :func:`~reth_tpu.metrics.histogram_quantile`) is exactly the quantile
+  of the combined population — no quantile-of-quantiles averaging.
+
+Surfaces: ``GET /metrics?scope=fleet`` appends :meth:`render` (every
+pulled series per-replica-labeled + the ``replica="_fleet"`` bucket-wise
+merge) to the local exposition; the ``debug_fleetMetrics`` RPC returns
+:meth:`summary`; ``node/events.py`` prints the ``fleetobs[...]``
+fragment from :meth:`snapshot`; and ``health.py``'s fleet SLO rules
+(fleet read p99, replica-lag distribution, federation staleness) read
+the installed process default (:func:`install` / :func:`get_federation`,
+the ``health.py`` seam shape).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import tracing
+from ..metrics import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    histogram_quantile,
+)
+
+DEFAULT_INTERVAL_S = 1.0
+DEFAULT_WINDOW = 120          # retained pull deltas per series
+DEFAULT_MAX_METRICS = 1024    # series per pull (bounded payload)
+FLEET_LABEL = "_fleet"        # the bucket-wise merged pseudo-replica
+
+
+def snapshot_registry(registry: MetricsRegistry,
+                      max_metrics: int = DEFAULT_MAX_METRICS) -> dict:
+    """One registry as a JSON-able absolute snapshot:
+    ``{name: {"k": "c"|"g", "v": value} | {"k": "h", "b": buckets,
+    "c": counts, "s": sum, "n": count}}``."""
+    out: dict = {}
+    for name, m in registry.items():
+        if len(out) >= max_metrics:
+            break
+        if isinstance(m, Counter):
+            out[name] = {"k": "c", "v": m.value}
+        elif isinstance(m, Gauge):
+            out[name] = {"k": "g", "v": m.value}
+        elif isinstance(m, Histogram):
+            counts, total, n = m.snapshot()
+            out[name] = {"k": "h", "b": list(m.buckets), "c": counts,
+                         "s": total, "n": n}
+    return out
+
+
+class FederationSource:
+    """Replica-side pull endpoint: cursor-based delta encoding over a
+    registry, so steady-state federation traffic carries only what
+    changed since the last pull."""
+
+    def __init__(self, registry: MetricsRegistry | None = None, *,
+                 max_metrics: int = DEFAULT_MAX_METRICS):
+        import os
+
+        self.registry = registry or REGISTRY
+        self.max_metrics = max_metrics
+        # cursor nonce: a replica restart mints a new one, so a stale
+        # federation cursor forces a full re-anchor instead of applying
+        # deltas against state the restart threw away
+        self._nonce = f"{os.getpid():x}.{id(self) & 0xFFFF:x}"
+        self._seq = 0
+        self._last: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self.pulls = 0
+
+    def snapshot(self, cursor: str | None = None) -> dict:
+        """One pull. With the current cursor: only changed metrics,
+        delta-encoded (``d`` = counter delta, ``dn``/``ds``/``dc`` =
+        histogram count/sum/bucket deltas). Otherwise: the full
+        absolute state (``full: true``)."""
+        with self._lock:
+            full = cursor != f"{self._nonce}:{self._seq}" or not self._last
+            metrics: dict = {}
+            truncated = 0
+            for name, m in self.registry.items():
+                if len(metrics) >= self.max_metrics:
+                    truncated += 1
+                    continue
+                if isinstance(m, Counter):
+                    v = m.value
+                    prev = self._last.get(name)
+                    if full or prev != v:
+                        entry: dict = {"k": "c", "v": v}
+                        if not full and isinstance(prev, (int, float)):
+                            entry["d"] = v - prev if v >= prev else v
+                        metrics[name] = entry
+                    self._last[name] = v
+                elif isinstance(m, Gauge):
+                    v = m.value
+                    prev = self._last.get(name)
+                    if full or prev != v:
+                        metrics[name] = {"k": "g", "v": v}
+                    self._last[name] = v
+                elif isinstance(m, Histogram):
+                    counts, total, n = m.snapshot()
+                    prev = self._last.get(name)
+                    if full or prev is None or prev[2] != n \
+                            or prev[1] != total or prev[0] != counts:
+                        entry = {"k": "h", "c": counts, "s": total, "n": n}
+                        if full or prev is None:
+                            entry["b"] = list(m.buckets)
+                        elif n >= prev[2]:
+                            entry["dn"] = n - prev[2]
+                            entry["ds"] = total - prev[1]
+                            entry["dc"] = [c - p for c, p
+                                           in zip(counts, prev[0])]
+                        metrics[name] = entry
+                    self._last[name] = (counts, total, n)
+            self._seq += 1
+            self.pulls += 1
+            return {"cursor": f"{self._nonce}:{self._seq}", "full": full,
+                    "metrics": metrics, "truncated": truncated,
+                    "ts": time.time()}
+
+
+class _ReplicaSeries:
+    """One replica's federated state: latest absolute values plus the
+    bounded per-pull delta rings (the PR 9 sampler shape)."""
+
+    __slots__ = ("cursor", "latest", "rings", "buckets", "stale",
+                 "last_pull", "last_error", "pulls", "failures",
+                 "truncated")
+
+    def __init__(self):
+        self.cursor: str | None = None
+        self.latest: dict[str, dict] = {}
+        self.rings: dict[str, object] = {}
+        self.buckets: dict[str, tuple] = {}
+        self.stale = True          # until the first successful pull
+        self.last_pull: float | None = None
+        self.last_error: str | None = None
+        self.pulls = 0
+        self.failures = 0
+        self.truncated = 0
+
+
+class MetricsFederation:
+    """Full-node puller + merger over the fleet router's replicas."""
+
+    def __init__(self, router, *, interval: float | None = None,
+                 window: int = DEFAULT_WINDOW,
+                 registry: MetricsRegistry | None = None):
+        import os
+        from collections import deque
+
+        self._deque = deque
+        self.router = router
+        env_iv = os.environ.get("RETH_TPU_FLEET_METRICS_INTERVAL", "")
+        self.interval = float(interval if interval is not None
+                              else env_iv or DEFAULT_INTERVAL_S)
+        self.window = max(2, int(window))
+        self._series: dict[str, _ReplicaSeries] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.pulls = 0
+        self.failures = 0
+        reg = registry or REGISTRY
+        self._m_pulls = reg.counter(
+            "fleetobs_pulls_total", "replica metrics pulls attempted")
+        self._m_failures = reg.counter(
+            "fleetobs_pull_failures_total",
+            "replica metrics pulls that failed (replica marked stale)")
+        self._m_stale = reg.gauge(
+            "fleetobs_stale_replicas",
+            "replicas whose federated metrics are stale (pull failing)")
+        self._m_series = reg.gauge(
+            "fleetobs_federated_series", "federated metric series held")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Background puller (no-op when interval<=0: tests drive
+        :meth:`pull_once` directly)."""
+        if self.interval <= 0 or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-federation")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.pull_once()
+            except Exception:  # noqa: BLE001 — federation must never die
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    # -- pulling ------------------------------------------------------------
+
+    def pull_once(self, now: float | None = None) -> None:
+        """One pull pass over every registered replica (including shed
+        ones — a draining replica's metrics are exactly what the
+        operator is staring at). Failures mark the replica stale and
+        move on; the feed and gateway never feel this."""
+        with self.router._lock:
+            handles = [(h.id, h.url) for h in self.router.replicas.values()]
+        known = {rid for rid, _ in handles}
+        now = time.time() if now is None else now
+        for rid, url in handles:
+            with self._lock:
+                series = self._series.get(rid)
+                if series is None:
+                    series = self._series[rid] = _ReplicaSeries()
+            self.pulls += 1
+            self._m_pulls.increment()
+            try:
+                resp = self.router._rpc(url, "fleet_metricsSnapshot",
+                                        [series.cursor])
+                if not isinstance(resp, dict) or "metrics" not in resp:
+                    raise ValueError("malformed federation snapshot")
+            except Exception as e:  # noqa: BLE001 — stale-mark, never raise
+                self.failures += 1
+                self._m_failures.increment()
+                with self._lock:
+                    was_stale = series.stale
+                    series.failures += 1
+                    series.stale = True
+                    series.last_error = f"{type(e).__name__}: {e}"
+                if not was_stale:
+                    tracing.event("fleet::federation", "replica_stale",
+                                  id=rid, error=series.last_error)
+                continue
+            with self._lock:
+                self._apply(series, resp, now)
+        with self._lock:
+            # deregistered replicas fall out of the federated view
+            for rid in [r for r in self._series if r not in known]:
+                del self._series[rid]
+            self._publish_locked()
+
+    def _apply(self, series: _ReplicaSeries, resp: dict, now: float) -> None:
+        # caller holds the lock
+        if resp.get("full"):
+            # re-anchor: a replica restart (new cursor nonce) means its
+            # counters reset — drop the old rings so deltas stay honest
+            series.latest.clear()
+            series.rings.clear()
+        series.cursor = resp.get("cursor")
+        series.stale = False
+        series.last_pull = now
+        series.last_error = None
+        series.pulls += 1
+        series.truncated = int(resp.get("truncated") or 0)
+        for name, entry in resp.get("metrics", {}).items():
+            kind = entry.get("k")
+            ring = series.rings.get(name)
+            if ring is None:
+                ring = series.rings[name] = self._deque(maxlen=self.window)
+            if kind == "c":
+                v = float(entry.get("v", 0.0))
+                prev = series.latest.get(name, {}).get("v")
+                delta = entry.get("d")
+                if delta is None:
+                    if isinstance(prev, (int, float)):
+                        delta = v - prev if v >= prev else v
+                    else:
+                        # first sight is a BASELINE (sampler convention):
+                        # the lifetime value predates the window
+                        delta = 0.0
+                ring.append((now, v, float(delta)))
+                series.latest[name] = {"k": "c", "v": v}
+            elif kind == "g":
+                v = float(entry.get("v", 0.0))
+                ring.append((now, v))
+                series.latest[name] = {"k": "g", "v": v}
+            elif kind == "h":
+                counts = list(entry.get("c", ()))
+                total = float(entry.get("s", 0.0))
+                n = int(entry.get("n", 0))
+                if entry.get("b") is not None:
+                    series.buckets[name] = tuple(entry["b"])
+                prev = series.latest.get(name)
+                if "dc" in entry:
+                    deltas = (entry["dn"], entry["ds"], tuple(entry["dc"]))
+                elif prev is not None and n >= prev["n"]:
+                    deltas = (n - prev["n"], total - prev["s"],
+                              tuple(c - p for c, p
+                                    in zip(counts, prev["c"])))
+                else:
+                    # first sight is a BASELINE (the sampler convention):
+                    # lifetime counts predate the window
+                    deltas = (0, 0.0, tuple(0 for _ in counts))
+                ring.append((now,) + deltas)
+                series.latest[name] = {"k": "h", "c": counts, "s": total,
+                                       "n": n}
+
+    def _publish_locked(self) -> None:
+        self._m_stale.set(sum(1 for s in self._series.values() if s.stale))
+        self._m_series.set(sum(len(s.latest)
+                               for s in self._series.values()))
+
+    # -- queries ------------------------------------------------------------
+
+    def replica_latest(self, rid: str, name: str) -> dict | None:
+        with self._lock:
+            s = self._series.get(rid)
+            return dict(s.latest[name]) if s and name in s.latest else None
+
+    def replica_quantile(self, rid: str, name: str,
+                         q: float) -> float | None:
+        """One replica's lifetime quantile from its latest federated
+        histogram (bench's per-replica p99 breakdown)."""
+        with self._lock:
+            s = self._series.get(rid)
+            if s is None:
+                return None
+            e = s.latest.get(name)
+            b = s.buckets.get(name)
+        if e is None or b is None or e.get("k") != "h" or not e["n"]:
+            return None
+        return histogram_quantile(b, e["c"], q)
+
+    def replica_gauge_max(self, name: str) -> float | None:
+        """Max of one gauge across replicas (e.g. the worst
+        ``replica_feed_lag_heads`` as the replicas themselves report
+        it). None when no replica exposes it."""
+        vals = []
+        with self._lock:
+            for s in self._series.values():
+                e = s.latest.get(name)
+                if e is not None and e.get("k") in ("g", "c"):
+                    vals.append(float(e["v"]))
+        return max(vals) if vals else None
+
+    def fleet_counts(self, name: str) -> tuple | None:
+        """Bucket-wise merge of one histogram family across every
+        replica's LATEST absolute counts -> (buckets, counts, sum, n).
+        The merged counts are the element-wise sums, so a quantile over
+        them is the quantile of the combined population."""
+        with self._lock:
+            buckets = None
+            merged = None
+            total = 0.0
+            n = 0
+            for s in self._series.values():
+                e = s.latest.get(name)
+                if e is None or e.get("k") != "h":
+                    continue
+                b = s.buckets.get(name)
+                if b is None:
+                    continue
+                if buckets is None:
+                    buckets = b
+                    merged = [0] * len(e["c"])
+                if b != buckets or len(e["c"]) != len(merged):
+                    continue  # incompatible bucket layout: skip, never lie
+                merged = [m + c for m, c in zip(merged, e["c"])]
+                total += e["s"]
+                n += e["n"]
+        if buckets is None:
+            return None
+        return buckets, merged, total, n
+
+    def fleet_quantile(self, name: str, q: float,
+                       samples: int | None = None) -> float | None:
+        """Fleet-wide quantile of one histogram family. ``samples``
+        windows it over the last N pull intervals' merged bucket deltas
+        (a real windowed p99, the health-rule input); None uses the
+        merged lifetime counts."""
+        if samples is None:
+            merged = self.fleet_counts(name)
+            if merged is None or merged[3] == 0:
+                return None
+            return histogram_quantile(merged[0], merged[1], q)
+        with self._lock:
+            buckets = None
+            window: list | None = None
+            for s in self._series.values():
+                b = s.buckets.get(name)
+                ring = s.rings.get(name)
+                if b is None or ring is None:
+                    continue
+                if buckets is None:
+                    buckets = b
+                    window = [0] * (len(b) + 1)
+                if b != buckets:
+                    continue
+                for p in list(ring)[-samples:]:
+                    for i, d in enumerate(p[3]):
+                        if i < len(window):
+                            window[i] += d
+        if buckets is None or window is None or sum(window) <= 0:
+            return None
+        return histogram_quantile(buckets, window, q)
+
+    # -- surfaces -----------------------------------------------------------
+
+    def render(self) -> str:
+        """The ``scope=fleet`` exposition appendix: every federated
+        series re-labeled ``{replica="<id>"}`` plus the bucket-wise
+        ``{replica="_fleet"}`` merge for histograms, and a staleness
+        marker gauge per replica. One lock snapshot feeds both the
+        per-replica lines AND the merge, so a scrape is internally
+        bucket-exact even while the puller runs. Series names that
+        already carry labels get the replica label spliced in."""
+        with self._lock:
+            snap = [(rid, dict(s.latest), dict(s.buckets), s.stale)
+                    for rid, s in sorted(self._series.items())]
+        lines: list[str] = []
+        # family -> [buckets, merged_counts, sum, n]
+        hist: dict[str, list] = {}
+        for rid, latest, buckets, stale in snap:
+            lines.append(
+                f'fleetobs_replica_stale{{replica="{rid}"}} '
+                f'{1 if stale else 0}')
+            for name, e in sorted(latest.items()):
+                if e["k"] in ("c", "g"):
+                    lines.append(f"{self._label(name, rid)} {e['v']}")
+                    continue
+                b = buckets.get(name)
+                if b is None:
+                    continue
+                cum = 0
+                for edge, c in zip(b, e["c"]):
+                    cum += c
+                    lines.append(
+                        f'{self._label(name + "_bucket", rid, le=edge)}'
+                        f' {cum}')
+                lines.append(
+                    f'{self._label(name + "_bucket", rid, le="+Inf")}'
+                    f' {e["n"]}')
+                lines.append(f'{self._label(name + "_sum", rid)}'
+                             f' {e["s"]}')
+                lines.append(f'{self._label(name + "_count", rid)}'
+                             f' {e["n"]}')
+                m = hist.get(name)
+                if m is None:
+                    hist[name] = [b, list(e["c"]), e["s"], e["n"]]
+                elif m[0] == b and len(m[1]) == len(e["c"]):
+                    m[1] = [x + y for x, y in zip(m[1], e["c"])]
+                    m[2] += e["s"]
+                    m[3] += e["n"]
+        # the fleet merge: bucket-exact sums across replicas
+        for name in sorted(hist):
+            b, counts, total, n = hist[name]
+            cum = 0
+            for edge, c in zip(b, counts):
+                cum += c
+                lines.append(
+                    f'{self._label(name + "_bucket", FLEET_LABEL, le=edge)}'
+                    f' {cum}')
+            lines.append(
+                f'{self._label(name + "_bucket", FLEET_LABEL, le="+Inf")}'
+                f' {n}')
+            lines.append(f'{self._label(name + "_sum", FLEET_LABEL)}'
+                         f' {total}')
+            lines.append(f'{self._label(name + "_count", FLEET_LABEL)}'
+                         f' {n}')
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    @staticmethod
+    def _label(name: str, rid: str, le=None) -> str:
+        extra = f'replica="{rid}"' + (f',le="{le}"' if le is not None
+                                      else "")
+        if name.endswith("}"):  # already-labeled series: splice
+            return name[:-1] + "," + extra + "}"
+        return name + "{" + extra + "}"
+
+    def snapshot(self) -> dict:
+        """The ``fleetobs[...]`` events-fragment state."""
+        with self._lock:
+            stale = sum(1 for s in self._series.values() if s.stale)
+            ages = [time.time() - s.last_pull
+                    for s in self._series.values()
+                    if s.last_pull is not None]
+            return {
+                "replicas": len(self._series),
+                "stale": stale,
+                "pulls": self.pulls,
+                "failures": self.failures,
+                "series": sum(len(s.latest)
+                              for s in self._series.values()),
+                "max_pull_age_s": (round(max(ages), 2) if ages else None),
+            }
+
+    def summary(self) -> dict:
+        """The ``debug_fleetMetrics`` body: per-replica pull state plus
+        the fleet-wide quantiles an operator actually asks for."""
+        now = time.time()
+        with self._lock:
+            replicas = {
+                rid: {
+                    "stale": s.stale,
+                    "pulls": s.pulls,
+                    "failures": s.failures,
+                    "last_pull_age_s": (round(now - s.last_pull, 2)
+                                        if s.last_pull is not None
+                                        else None),
+                    "last_error": s.last_error,
+                    "series": len(s.latest),
+                    "truncated": s.truncated,
+                }
+                for rid, s in sorted(self._series.items())
+            }
+            hist_names = sorted({n for s in self._series.values()
+                                 for n, e in s.latest.items()
+                                 if e.get("k") == "h"})
+        quantiles = {}
+        for name in hist_names:
+            p99 = self.fleet_quantile(name, 0.99)
+            if p99 is not None:
+                merged = self.fleet_counts(name)
+                quantiles[name] = {
+                    "p50": round(self.fleet_quantile(name, 0.5) or 0, 6),
+                    "p99": round(p99, 6),
+                    "count": merged[3] if merged else 0,
+                }
+        return {
+            "interval_s": self.interval,
+            "window": self.window,
+            **self.snapshot(),
+            "per_replica": replicas,
+            "fleet_quantiles": quantiles,
+        }
+
+
+# -- process-default federation (the /metrics?scope=fleet seam) ---------------
+
+_FEDERATION: MetricsFederation | None = None
+
+
+def install(federation: MetricsFederation) -> None:
+    """Make ``federation`` the process default served by
+    ``/metrics?scope=fleet``, ``debug_fleetMetrics``, and the fleet SLO
+    rules (node/node.py; last installed wins, like health.install)."""
+    global _FEDERATION
+    _FEDERATION = federation
+
+
+def uninstall(federation: MetricsFederation | None = None) -> None:
+    global _FEDERATION
+    if federation is None or _FEDERATION is federation:
+        _FEDERATION = None
+
+
+def get_federation() -> MetricsFederation | None:
+    return _FEDERATION
+
+
+__all__ = [
+    "FederationSource",
+    "MetricsFederation",
+    "snapshot_registry",
+    "install",
+    "uninstall",
+    "get_federation",
+]

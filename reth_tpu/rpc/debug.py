@@ -480,11 +480,17 @@ class DebugApi:
         except KeyError as e:
             raise RpcError(-32000, str(e)) from None
 
-    def debug_flightRecorder(self, action="snapshot", limit=256):
+    def debug_flightRecorder(self, action="snapshot", limit=256,
+                             correlation_id=None):
         """The in-memory flight recorder: ``action="snapshot"`` returns
         the most recent ``limit`` records; ``action="dump"`` snapshots
         the ring to a JSONL file and returns its path plus every dump
-        written so far (breaker opens, watchdog timeouts, fault drills)."""
+        written so far (breaker opens, watchdog timeouts, fault drills);
+        ``action="correlated"`` returns the MERGED multi-process view of
+        one correlated incident — every dump in the shared flight
+        directory stamped with ``correlation_id`` (default: the most
+        recent id this process stamped), records annotated with their
+        originating pid/role and time-ordered."""
         from .. import tracing
         from .server import RpcError
 
@@ -492,11 +498,30 @@ class DebugApi:
         if action == "dump":
             path = tracing.flight_dump("rpc_request")
             return {"path": path, "dumps": list(rec.dumps)}
+        if action == "correlated":
+            merged = tracing.merge_correlated(correlation_id)
+            if limit:
+                merged["records"] = merged["records"][-int(limit):]
+            return merged
         if action != "snapshot":
             raise RpcError(-32602, f"unknown action {action!r} "
-                                   "(snapshot | dump)")
+                                   "(snapshot | dump | correlated)")
         return {
             "records": rec.snapshot(int(limit)),
             "recorded": rec.recorded,
             "dumps": list(rec.dumps),
         }
+
+    # -- fleet observability (obs/federation.py) ----------------------------
+
+    def debug_fleetMetrics(self):
+        """The metrics federation's summary: per-replica pull state
+        (stale flags, ages, errors) + fleet-wide quantiles over the
+        bucket-wise merged histograms. Requires --fleet."""
+        from ..obs import federation
+        from .server import RpcError
+
+        fed = federation.get_federation()
+        if fed is None:
+            raise RpcError(-32000, "metrics federation disabled (--fleet)")
+        return fed.summary()

@@ -104,6 +104,7 @@ _TX_METHODS = frozenset({
 # node dead exactly when it matters that it is not)
 _MONITORING_METHODS = frozenset({
     "debug_healthCheck", "debug_sloStatus", "debug_metricsHistory",
+    "debug_fleetMetrics",
 })
 
 

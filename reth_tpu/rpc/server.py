@@ -11,6 +11,8 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import tracing
+
 
 class RpcError(Exception):
     def __init__(self, code: int, message: str, data=None):
@@ -111,8 +113,22 @@ class RpcServer:
             with self.lock:
                 return fn(*params) if isinstance(params, list) else fn(**params)
 
+        # cross-process trace adoption: a fleet-routed request carries
+        # its originating gateway span as a wire-form "traceparent"
+        # member (fleet/ring.py) — adopt it so every span this dispatch
+        # records (the local gateway's admission span included) stitches
+        # under the remote caller's trace with a resolvable parent id
+        remote_ctx = tracing.context_from_wire(req.get("traceparent"))
         try:
-            if self.gateway is not None:
+            if remote_ctx is not None and tracing.trace_enabled():
+                with tracing.use_context(remote_ctx):
+                    with tracing.span("rpc::server", "rpc.serve",
+                                      method=method):
+                        if self.gateway is not None:
+                            result = self.gateway.call(method, params, invoke)
+                        else:
+                            result = invoke()
+            elif self.gateway is not None:
                 result = self.gateway.call(method, params, invoke)
             else:
                 result = invoke()
@@ -155,19 +171,31 @@ class RpcServer:
                 self.wfile.write(resp)
 
             def do_GET(self):
-                if self.path == "/metrics":
+                path, _, query = self.path.partition("?")
+                if path == "/metrics":
                     from ..metrics import REGISTRY
 
                     from ..metrics import update_process_metrics
 
                     update_process_metrics()
-                    body = REGISTRY.render().encode()
+                    text = REGISTRY.render()
+                    if "scope=fleet" in query:
+                        # fleet scope: append the federated view — every
+                        # replica's pulled registry per-replica-labeled
+                        # plus the bucket-wise fleet merge
+                        # (obs/federation.py; empty off-fleet)
+                        from ..obs import federation
+
+                        fed = federation.get_federation()
+                        if fed is not None:
+                            text += fed.render()
+                    body = text.encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
-                elif self.path == "/health":
+                elif path == "/health":
                     # machine-readable node health beside /metrics: the
                     # SLO roll-up when --health is on (503 only when
                     # failing), liveness + build identity otherwise —

@@ -40,6 +40,31 @@ when off, ``span()`` costs what it always did (one DEBUG log call).
 Events (:func:`event` / :func:`fault_event`) record into the flight
 recorder regardless — breaker trips and fault drills are rare and are
 exactly what a postmortem needs.
+
+Fleet layer (the cross-PROCESS half of the same machinery):
+
+- **Wire form** (:func:`context_to_wire` / :func:`context_from_wire`):
+  a compact dict ``{"t": trace_id, "s": span_id, "r": role, "p": pid}``
+  carried on witness-feed frames and as a ``traceparent`` member of
+  fleet-routed JSON-RPC requests. Span ids embed the originating pid in
+  their high bits (:func:`span_id_pid_bits`), so ids stay globally
+  unique across a fleet and a remote ``parent`` id resolves when traces
+  from several processes are merged.
+- **Process role** (:func:`set_process_role`): ``full`` / ``replica`` /
+  ``node`` — stamped as a resource attribute on every exported span and
+  as Chrome ``process_name`` metadata, so merged multi-process traces
+  stay attributable.
+- **Correlated dumps**: :func:`fault_event` stamps every dump with a
+  :func:`new_correlation_id` + time window and notifies registered
+  fault observers (:func:`add_fault_observer`) — the fleet coordinators
+  (feed server / replica) fan the dump request to their peers, every
+  process dumps under the SAME correlation id, and
+  :func:`merge_correlated` returns the time-aligned multi-process view
+  (``debug_flightRecorder`` ``action="correlated"``).
+- **Stitching** (:func:`stitch_chrome_traces`): merge exported Chrome
+  traces from several processes and report distinct pids + any
+  unresolved cross-process parent ids — the bench/chaos acceptance
+  check that one user read really is ONE trace.
 """
 
 from __future__ import annotations
@@ -108,6 +133,38 @@ _TRACE_ON = _env_enabled()
 _tls = threading.local()
 _span_ids = itertools.count(1)
 
+# span ids are globally unique across a FLEET: the low 40 bits count,
+# the high bits carry this process's pid — a remote parent id exported
+# from another process can never collide with a local span id, so
+# cross-process parent references resolve in merged Chrome/OTLP traces
+_SPAN_PID_SHIFT = 40
+_SPAN_PID_BITS = os.getpid() & 0x3FFFFF
+
+
+def _new_span_id() -> int:
+    return (_SPAN_PID_BITS << _SPAN_PID_SHIFT) | next(_span_ids)
+
+
+def span_id_pid_bits(span_id: int) -> int:
+    """The pid bits embedded in a span id (which process minted it) —
+    how stitch checks tell a cross-process parent from a local one."""
+    return span_id >> _SPAN_PID_SHIFT
+
+
+# process role for multi-process attribution (full | replica | node):
+# rides the wire form, OTLP resource attributes, and Chrome process
+# metadata so merged fleet traces stay tellable-apart after export
+_ROLE = os.environ.get("RETH_TPU_ROLE", "") or "node"
+
+
+def set_process_role(role: str) -> None:
+    global _ROLE
+    _ROLE = role
+
+
+def process_role() -> str:
+    return _ROLE
+
 
 class TraceContext:
     """A propagated trace position: ``trace_id`` (block hash hex for
@@ -121,6 +178,41 @@ class TraceContext:
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"TraceContext({self.trace_id!r}, span={self.span_id})"
+
+
+def context_to_wire(ctx: TraceContext | None = None) -> dict | None:
+    """Compact wire form of a trace position for cross-process handoffs
+    (witness-feed frames, fleet-routed JSON-RPC ``traceparent``):
+    ``{"t": trace_id, "s": span_id, "r": role, "p": pid}``. ``ctx``
+    defaults to the calling thread's current context; None (no trace)
+    encodes to None so untraced traffic carries zero extra bytes. A
+    span-only context (a routed READ has no block trace id) still
+    encodes — the remote spans stitch by parent span id even when no
+    named trace exists."""
+    if ctx is None:
+        ctx = current_context()
+    if ctx is None or (ctx.trace_id is None and ctx.span_id is None):
+        return None
+    return {"t": ctx.trace_id, "s": ctx.span_id, "r": _ROLE,
+            "p": os.getpid()}
+
+
+def context_from_wire(wire) -> TraceContext | None:
+    """Decode a wire-form dict back into an adoptable context (the
+    consumer half: ``use_context(context_from_wire(frame["tp"]))``).
+    Tolerates None/garbage — a malformed traceparent must never fail
+    the request it rode in on."""
+    if not isinstance(wire, dict):
+        return None
+    trace = wire.get("t")
+    if trace is not None and not (isinstance(trace, str) and trace):
+        return None
+    span = wire.get("s")
+    if span is not None and not isinstance(span, int):
+        return None
+    if trace is None and span is None:
+        return None
+    return TraceContext(trace, span)
 
 
 def set_trace_enabled(on: bool) -> None:
@@ -167,7 +259,7 @@ def span(target: str, name: str, level: int = logging.DEBUG, **fields):
     if _TRACE_ON:
         parent = getattr(_tls, "ctx", None)
         ctx = TraceContext(parent.trace_id if parent is not None else None,
-                           next(_span_ids))
+                           _new_span_id())
         _tls.ctx = ctx
     err = None
     try:
@@ -208,7 +300,7 @@ def record_span(target: str, name: str, start: float, duration: float, *,
         "kind": "span", "target": target, "name": name,
         "ts": start, "dur_ms": round(duration * 1e3, 3),
         "trace": ctx.trace_id if ctx is not None else None,
-        "span": next(_span_ids),
+        "span": _new_span_id(),
         "parent": ctx.span_id if ctx is not None else None,
         "thread": threading.current_thread().name,
         "fields": fields or {}, "error": error,
@@ -412,6 +504,7 @@ class FlightRecorder:
         self.directory = directory
         self.dumps: list[str] = []  # paths written, oldest first
         self.recorded = 0
+        self.last_correlation_id: str | None = None
 
     def record(self, rec: dict) -> None:
         with self._lock:
@@ -434,13 +527,24 @@ class FlightRecorder:
         d.mkdir(parents=True, exist_ok=True)
         return d
 
-    def dump(self, reason: str, path: str | Path | None = None) -> str | None:
+    def dump(self, reason: str, path: str | Path | None = None, *,
+             correlation_id: str | None = None,
+             window: tuple | list | None = None) -> str | None:
         """Write the ring (oldest first) as JSONL: one header line
-        ``{"kind": "flight_snapshot", "reason", "ts", "records"}`` then
-        one line per record. Returns the path, or None on an empty ring.
-        Never raises — a diagnostics failure must not fail the caller."""
+        ``{"kind": "flight_snapshot", "reason", "ts", "records", "pid",
+        "role", "correlation_id", "window"}`` then one line per record.
+        ``correlation_id`` ties this dump to the fleet-wide set written
+        for one incident; ``window`` (``[t0, t1]`` wall-clock seconds)
+        filters the ring to the incident's period so a peer's dump is
+        time-aligned with the initiator's. Returns the path, or None on
+        an empty ring. Never raises — a diagnostics failure must not
+        fail the caller."""
         try:
             records = self.snapshot()
+            if window:
+                t0, t1 = float(window[0]), float(window[1])
+                records = [r for r in records
+                           if t0 - 1.0 <= r.get("ts", 0.0) <= t1 + 1.0]
             if not records:
                 return None
             if path is None:
@@ -453,10 +557,15 @@ class FlightRecorder:
             with open(path, "w") as f:
                 f.write(json.dumps({
                     "kind": "flight_snapshot", "reason": reason,
-                    "ts": time.time(), "records": len(records)}) + "\n")
+                    "ts": time.time(), "records": len(records),
+                    "pid": os.getpid(), "role": _ROLE,
+                    "correlation_id": correlation_id,
+                    "window": list(window) if window else None}) + "\n")
                 for rec in records:
                     f.write(json.dumps(rec, default=str) + "\n")
             self.dumps.append(str(path))
+            if correlation_id:
+                self.last_correlation_id = correlation_id
             return str(path)
         except Exception:  # noqa: BLE001 — diagnostics only
             return None
@@ -473,17 +582,110 @@ def flight_snapshot(n: int | None = None) -> list[dict]:
     return _RECORDER.snapshot(n)
 
 
-def flight_dump(reason: str, path: str | Path | None = None) -> str | None:
+def flight_dump(reason: str, path: str | Path | None = None, *,
+                correlation_id: str | None = None,
+                window: tuple | list | None = None) -> str | None:
     """Snapshot the flight recorder to JSONL now (see the triggers in the
     module docstring)."""
-    return _RECORDER.dump(reason, path)
+    return _RECORDER.dump(reason, path, correlation_id=correlation_id,
+                          window=window)
 
 
 def load_flight_dump(path: str | Path) -> tuple[dict, list[dict]]:
-    """Parse a flight-recorder JSONL dump -> (header, records)."""
+    """Parse a flight-recorder JSONL dump -> (header, records). Torn
+    trailing lines (a killed process mid-write) are discarded."""
     lines = Path(path).read_text().splitlines()
     header = json.loads(lines[0])
-    return header, [json.loads(line) for line in lines[1:]]
+    records = []
+    for line in lines[1:]:
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:  # torn tail: the process died here
+            break
+    return header, records
+
+
+# -- correlated dumps ---------------------------------------------------------
+
+_corr_counter = itertools.count(1)
+# the incident window a correlated dump covers: the initiator stamps
+# [now - CORRELATION_WINDOW_S, now + slack] so every peer's dump is
+# filtered to the same period
+CORRELATION_WINDOW_S = 30.0
+
+
+def new_correlation_id() -> str:
+    """Fleet-unique incident id stamped on every dump of one correlated
+    set: wall-ms + pid + a per-process counter."""
+    return (f"{int(time.time() * 1e3):x}-{os.getpid():x}-"
+            f"{next(_corr_counter):x}")
+
+
+def correlated_dumps(correlation_id: str,
+                     directory: str | Path | None = None) -> list[tuple]:
+    """Every flight dump under ``directory`` (default: this process's
+    flight dir, which a fleet shares via RETH_TPU_FLIGHT_DIR) whose
+    header carries ``correlation_id`` -> [(header, records), ...]."""
+    d = Path(directory) if directory is not None else _RECORDER._dir()
+    out = []
+    for path in sorted(d.glob("flight-*.jsonl")):
+        try:
+            header, records = load_flight_dump(path)
+        except (OSError, json.JSONDecodeError, IndexError):
+            continue
+        if header.get("correlation_id") == correlation_id:
+            header = dict(header, path=str(path))
+            out.append((header, records))
+    return out
+
+
+def merge_correlated(correlation_id: str | None = None,
+                     directory: str | Path | None = None) -> dict:
+    """The merged multi-process view of one correlated incident: every
+    dump sharing the correlation id, records annotated with their
+    originating pid/role and time-ordered — what ``debug_flightRecorder``
+    ``action="correlated"`` returns. ``correlation_id`` defaults to the
+    most recent one this process stamped."""
+    cid = correlation_id or _RECORDER.last_correlation_id
+    if cid is None:
+        return {"correlation_id": None, "dumps": [], "pids": [],
+                "records": []}
+    dumps = correlated_dumps(cid, directory)
+    records = []
+    for header, recs in dumps:
+        pid, role = header.get("pid"), header.get("role")
+        for r in recs:
+            records.append(dict(r, pid=pid, role=role))
+    records.sort(key=lambda r: r.get("ts", 0.0))
+    return {
+        "correlation_id": cid,
+        "dumps": [h["path"] for h, _ in dumps],
+        "pids": sorted({h.get("pid") for h, _ in dumps
+                        if h.get("pid") is not None}),
+        "roles": sorted({str(h.get("role")) for h, _ in dumps}),
+        "records": records,
+    }
+
+
+# fault observers: the fleet coordinators hang here — the feed server
+# (full node) fans a dump request to every replica, a replica notifies
+# the full node upstream over its feed socket. Called AFTER the local
+# dump with (reason, correlation_id, window); observers must never
+# raise into the faulting path.
+_observer_lock = threading.Lock()
+_fault_observers: list = []
+
+
+def add_fault_observer(fn) -> None:
+    with _observer_lock:
+        if fn not in _fault_observers:
+            _fault_observers.append(fn)
+
+
+def remove_fault_observer(fn) -> None:
+    with _observer_lock:
+        if fn in _fault_observers:
+            _fault_observers.remove(fn)
 
 
 _fault_lock = threading.Lock()
@@ -500,8 +702,10 @@ def reset_fault_dump_limits() -> None:
 def fault_event(drill: str, target: str = "fault", **fields) -> str | None:
     """A RETH_TPU_FAULT_* drill (or real failure trigger) fired: record
     the event and snapshot the flight recorder, rate-limited per drill
-    name so wedge-every-dispatch drills don't spray the disk. Returns
-    the dump path when one was written."""
+    name so wedge-every-dispatch drills don't spray the disk. The dump
+    is stamped with a fresh correlation id + incident window and every
+    registered fault observer is notified so fleet peers dump under the
+    SAME id. Returns the dump path when one was written."""
     event(target, drill, **fields)
     now = time.monotonic()
     with _fault_lock:
@@ -509,7 +713,18 @@ def fault_event(drill: str, target: str = "fault", **fields) -> str | None:
         if now - last < FAULT_DUMP_INTERVAL_S:
             return None
         _fault_last_dump[drill] = now
-    return flight_dump(drill)
+    cid = new_correlation_id()
+    wall = time.time()
+    window = (wall - CORRELATION_WINDOW_S, wall + 5.0)
+    path = flight_dump(drill, correlation_id=cid, window=window)
+    with _observer_lock:
+        observers = list(_fault_observers)
+    for obs in observers:
+        try:
+            obs(drill, cid, window)
+        except Exception:  # noqa: BLE001 — diagnostics only
+            pass
+    return path
 
 
 # -- OTLP export (reference crates/tracing-otlp) ------------------------------
@@ -521,12 +736,42 @@ def fault_event(drill: str, target: str = "fault", **fields) -> str | None:
 _otlp = None
 
 
+def process_resource_attributes(replica_id: str | None = None) -> dict:
+    """Resource attributes identifying THIS process in a merged fleet
+    trace: role, pid, and the node's build identity
+    (``reth_tpu_build_info`` fields) — stamped on every exported span so
+    multi-process traces stay distinguishable after export."""
+    attrs = {"service.role": _ROLE, "process.pid": os.getpid()}
+    if replica_id:
+        attrs["service.replica_id"] = replica_id
+    try:
+        from .metrics import build_info
+
+        for k, v in build_info().items():
+            attrs[f"build.{k}"] = v
+    except Exception:  # noqa: BLE001 — identity is best-effort
+        pass
+    return attrs
+
+
 class OtlpFileExporter:
     def __init__(self, path: str | Path, service_name: str = "reth-tpu"):
         self._lock = threading.Lock()
         self._f = open(path, "a", buffering=1)
         self.service_name = service_name
         self.exported = 0
+        self._resource: list | None = None  # built lazily: role may be
+        # set after init but before the first span exports
+
+    def _resource_attrs(self) -> list:
+        if self._resource is None:
+            attrs = {"service.name": self.service_name}
+            attrs.update(process_resource_attributes())
+            self._resource = [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in attrs.items()
+            ]
+        return self._resource
 
     def export(self, target: str, name: str, start: float, duration: float,
                fields: dict, error: str | None,
@@ -550,9 +795,7 @@ class OtlpFileExporter:
             if parent is not None and parent.span_id is not None:
                 sp["parentSpanId"] = format(parent.span_id, "016x")
         span_rec = {
-            "resource": {"attributes": [
-                {"key": "service.name",
-                 "value": {"stringValue": self.service_name}}]},
+            "resource": {"attributes": self._resource_attrs()},
             "scopeSpans": [{
                 "scope": {"name": f"reth_tpu.{target}"},
                 "spans": [sp],
@@ -600,11 +843,24 @@ class ChromeTraceExporter:
         self._f.write("[\n")
         self._tids: dict[str, int] = {}
         self.exported = 0
+        self._named = False  # process metadata emitted?
 
     def _tid(self, thread_name: str) -> int:
+        # caller holds the lock. Distinct pid/tid metadata events per
+        # process so MERGED multi-process traces show named, separate
+        # process/thread tracks instead of anonymous numeric ids.
+        if not self._named:
+            self._named = True
+            self._f.write(json.dumps(
+                {"name": "process_name", "ph": "M", "pid": os.getpid(),
+                 "tid": 0, "args": {"name": f"{_ROLE}-{os.getpid()}"}})
+                + ",\n")
         tid = self._tids.get(thread_name)
         if tid is None:
             tid = self._tids[thread_name] = len(self._tids) + 1
+            self._f.write(json.dumps(
+                {"name": "thread_name", "ph": "M", "pid": os.getpid(),
+                 "tid": tid, "args": {"name": thread_name}}) + ",\n")
         return tid
 
     def export(self, rec: dict) -> None:
@@ -661,23 +917,80 @@ def shutdown_chrome_trace() -> None:
 
 def read_chrome_trace(path: str | Path) -> list[dict]:
     """Tolerant loader for a (possibly still-open) Chrome trace file:
-    each line holds one event object (JSON-lines view of the array)."""
+    each line holds one event object (JSON-lines view of the array).
+    Undecodable lines (a SIGKILLed process torn mid-write) are skipped —
+    postmortem tooling must read what the dead process DID flush."""
     out = []
     for line in Path(path).read_text().splitlines():
         line = line.strip().rstrip(",")
         if line in ("", "[", "]"):
             continue
-        out.append(json.loads(line))
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
     return out
+
+
+def stitch_chrome_traces(paths) -> dict:
+    """Merge Chrome trace files exported by SEVERAL processes and check
+    the cross-process stitching contract: every ``parent_id`` minted by
+    another process (its pid bits differ from the referencing event's)
+    must resolve to an exported span somewhere in the merged set.
+
+    Returns ``{"events", "pids", "span_ids", "unresolved",
+    "unresolved_cross", "stitched"}`` — ``stitched`` is True when at
+    least one cross-process parent reference exists AND all of them
+    resolve (a fleet whose traces never cross a process boundary is NOT
+    stitched, it is merely concatenated)."""
+    events: list[dict] = []
+    for p in paths:
+        try:
+            events.extend(read_chrome_trace(p))
+        except OSError:
+            continue
+    span_ids = set()
+    for e in events:
+        sid = (e.get("args") or {}).get("span_id")
+        if isinstance(sid, int):
+            span_ids.add(sid)
+    # pids that contributed SPANS — a process whose file holds only
+    # metadata events did not span the trace
+    pids = {e["pid"] for e in events
+            if "pid" in e and e.get("ph") == "X"}
+    unresolved, unresolved_cross, cross_refs = [], [], 0
+    for e in events:
+        parent = (e.get("args") or {}).get("parent_id")
+        if not isinstance(parent, int):
+            continue
+        cross = span_id_pid_bits(parent) != (e.get("pid", 0) & 0x3FFFFF)
+        if cross:
+            cross_refs += 1
+        if parent not in span_ids:
+            unresolved.append(parent)
+            if cross:
+                unresolved_cross.append(parent)
+    return {
+        "events": events,
+        "pids": sorted(pids),
+        "span_ids": span_ids,
+        "unresolved": unresolved,
+        "unresolved_cross": unresolved_cross,
+        "cross_refs": cross_refs,
+        "stitched": cross_refs > 0 and not unresolved_cross,
+    }
 
 
 def init_block_tracing(chrome_path: str | Path | None = None,
                        otlp_path: str | Path | None = None,
                        flight_dir: str | Path | None = None,
                        capacity: int | None = None) -> None:
-    """The ``--trace-blocks`` bundle: enable span recording, install the
-    requested exporters, and point flight-recorder dumps at a directory."""
-    set_trace_enabled(True)
+    """The ``--trace-blocks`` bundle: install the requested exporters,
+    point flight-recorder dumps at a directory, and THEN enable span
+    recording — exporters must exist before the first span can close,
+    or a busy worker thread (the feed's witness generator on a 1-core
+    host) slips whole spans into the gap: recorded in the ring and
+    adopted by replicas, but missing from the exported trace."""
     if chrome_path is not None:
         init_chrome_trace(chrome_path)
     if otlp_path is not None:
@@ -687,6 +1000,7 @@ def init_block_tracing(chrome_path: str | Path | None = None,
     if capacity is not None and capacity != _RECORDER._buf.maxlen:
         with _RECORDER._lock:
             _RECORDER._buf = deque(_RECORDER._buf, maxlen=capacity)
+    set_trace_enabled(True)
 
 
 def shutdown_block_tracing() -> None:
