@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-chaos test-reorg test-fleet test-fleet-obs native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-subtrie test-chaos test-reorg test-fleet test-fleet-obs native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -166,10 +166,24 @@ test-fleet:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_fleet.py -q -p no:cacheprovider
 
+# whole-subtrie fused tree-hash kernels: k-level engine parity vs the
+# per-level engines and the numpy twin (k x depth x mesh grid incl.
+# non-pow2 6/3-device meshes), the RETH_TPU_FAULT_SUBTRIE_{WEDGE,ABORT}
+# fused->per-level->CPU fault ladder, the hoisted ladder-cap regression
+# (64-level branch-heavy window stays on-menu), warm-up k-shape routing,
+# and hash-service multi-level window requests. The compile-heavy
+# k-sweeps are `-m slow` so tier-1 keeps its budget; this target runs
+# everything — CPU-only (8 virtual host devices via conftest)
+test-subtrie:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_subtrie_fused.py -q -p no:cacheprovider
+
 # overlapped rebuild pipeline: parity vs the serial committer, packing,
 # arena residency, abort/failover drills, chunked-resume — fast, CPU-only
-# (the sanitizer stress build is `-m slow`; run it via tsan-triebuild)
-test-pipeline:
+# (the sanitizer stress build is `-m slow`; run it via tsan-triebuild);
+# the whole-subtrie k-level backend rides along (it is a pipeline
+# backend: flush_window per packed window)
+test-pipeline: test-subtrie
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_turbo_pipeline.py tests/test_merkle_resume.py \
 	  -q -p no:cacheprovider -m 'not slow'

@@ -9,7 +9,12 @@ select the other subsystem benches; ``mesh`` shards the production
 turbo/fused rebuild loop over 1/2/4/8 simulated host devices (one
 subprocess per mesh size, roots verified bit-identical vs the
 single-device committer before any number prints, per-mesh-size
-throughput + compile wall in ``per_mesh``); ``fleet`` measures
+throughput + compile wall in ``per_mesh``); ``subtrie`` compares the
+whole-subtrie k-level fused committer (one dispatch per k levels) to
+the per-level committer at k ∈ {1,2,4,8} across 1/2/4/8 simulated
+devices — dispatches/block + wall per k, roots verified bit-identical
+before any number prints, and every mode's JSON line now carries
+``dispatches_per_block``; ``fleet`` measures
 sustained RPC throughput + p99 through the fleet gateway at 1/2/4/8
 witness-fed replica subprocesses vs the single-node gateway
 (duplicate-heavy + long-tail mixes, responses verified bit-identical
@@ -170,6 +175,16 @@ def _emit(value, vs_baseline, error=None, exit_code=None, **extra):
         "compile_cache": _STATE.get("compile_cache", "off"),
     }
     line.update(_compile_split())
+    # dispatch accounting rides on EVERY line (the BENCH trajectory was
+    # empty on this axis): the last fused commit's device-dispatch count,
+    # 0 when no fused commit ran this process
+    try:
+        from reth_tpu.metrics import fused_metrics
+
+        line.setdefault("dispatches_per_block",
+                        (fused_metrics.last or {}).get("dispatches", 0))
+    except Exception:  # noqa: BLE001 — diagnostics only
+        line.setdefault("dispatches_per_block", 0)
     if error:
         line["error"] = error
         line["flight_recorder"] = _flight_excerpt()
@@ -841,6 +856,144 @@ def run_mesh_mode() -> None:
           roots_identical=True, exit_code=0)
 
 
+def _subtrie_inner(n: int) -> None:
+    """Inner body of ``RETH_TPU_BENCH_MODE=subtrie``: runs in a subprocess
+    whose XLA host-device count is forced to ``n``, commits the SAME
+    window set through the per-level committer (Mega/FusedMesh — one
+    dispatch per staged level) and the whole-subtrie committer at
+    k ∈ {1,2,4,8}, asserts every k's roots bit-identical to the
+    per-level path BEFORE any number prints, and emits ONE raw JSON line
+    with wall + dispatches/block per k."""
+    from reth_tpu.metrics import fused_metrics
+    from reth_tpu.parallel.mesh import HashMesh
+    from reth_tpu.trie.turbo import TurboCommitter
+
+    accounts = int(os.environ.get("RETH_TPU_BENCH_SUBTRIE_ACCOUNTS", "8000"))
+    slots = int(os.environ.get("RETH_TPU_BENCH_SUBTRIE_SLOTS",
+                               str(max(accounts * 2 // 5, 100))))
+    tier = int(os.environ.get("RETH_TPU_BENCH_SUBTRIE_TIER", "1024"))
+    ks = [int(x) for x in os.environ.get(
+        "RETH_TPU_BENCH_SUBTRIE_KS", "1,2,4,8").split(",") if x.strip()]
+    _STATE["phase"] = f"subtrie inner ({n} devices): state build"
+    storage_jobs, account_jobs = build_state(accounts, slots)
+    mesh = HashMesh.build(n) if n > 1 else None
+
+    def measure(k: int):
+        c = TurboCommitter(backend="device", min_tier=tier, mesh=mesh,
+                           subtrie_levels=k)
+        _STATE["phase"] = f"subtrie inner ({n} dev, k={k}): warm pass"
+        run_rebuild(c, storage_jobs, account_jobs, pipelined=True)
+        d0 = fused_metrics.dispatches_cum
+        _STATE["phase"] = f"subtrie inner ({n} dev, k={k}): measured pass"
+        roots, hashed, dt = run_rebuild(c, storage_jobs, account_jobs,
+                                        pipelined=True)
+        # one rebuild pass = 2 committer runs (storage tries + account
+        # prefix subtries) — the "block" unit for dispatches/block
+        disp = fused_metrics.dispatches_cum - d0
+        return roots, hashed, dt, disp, round(disp / 2, 1)
+
+    roots_pl, hashed, dt_pl, disp_pl, dpb_pl = measure(0)
+    per_k: dict[str, dict] = {}
+    ok = True
+    for k in ks:
+        roots_k, _h, dt_k, disp_k, dpb_k = measure(k)
+        if roots_k != roots_pl:
+            ok = False
+        per_k[str(k)] = {
+            "wall_s": round(dt_k, 4),
+            "dispatches": disp_k,
+            "dispatches_per_block": dpb_k,
+            "dispatch_reduction": round(disp_pl / disp_k, 2) if disp_k else 0,
+            "hashes_per_sec": round(hashed / dt_k, 1),
+        }
+    print(json.dumps({
+        "n_devices": n,
+        "roots_identical": ok,
+        "hashed": hashed,
+        "perlevel": {"wall_s": round(dt_pl, 4), "dispatches": disp_pl,
+                     "dispatches_per_block": dpb_pl,
+                     "hashes_per_sec": round(hashed / dt_pl, 1)},
+        "per_k": per_k,
+    }), flush=True)
+    os._exit(0 if ok else 4)
+
+
+def run_subtrie_mode() -> None:
+    """RETH_TPU_BENCH_MODE=subtrie: whole-subtrie k-level fused commits
+    vs the per-level committer — dispatches/block + wall at
+    k ∈ {1,2,4,8}, on 1/2/4/8 SIMULATED host devices (one hermetic
+    subprocess per mesh size, JAX_PLATFORMS=cpu forced, axon plugin
+    scrubbed). Every k's roots are verified bit-identical to the
+    per-level committer on the same window set BEFORE any number prints;
+    the headline is the dispatch-count reduction at the largest k on the
+    largest mesh. Env: RETH_TPU_BENCH_SUBTRIE_DEVICES (default
+    "1,2,4,8"), RETH_TPU_BENCH_SUBTRIE_KS (default "1,2,4,8"),
+    RETH_TPU_BENCH_SUBTRIE_ACCOUNTS / _SLOTS / _TIER (workload)."""
+    import subprocess
+
+    sizes = sorted({int(x) for x in os.environ.get(
+        "RETH_TPU_BENCH_SUBTRIE_DEVICES", "1,2,4,8").split(",") if x.strip()})
+    _STATE["metric"] = "subtrie_dispatch_reduction"
+    _STATE["unit"] = "x"
+    _STATE["backend"] = "jax-cpu-mesh"
+    per: dict[str, dict] = {}
+    budget = max(120, (_DEADLINE - 60) // max(len(sizes), 1))
+    for n in sizes:
+        _STATE["phase"] = f"subtrie subprocess ({n} devices)"
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("PALLAS_AXON_POOL_IPS", "RETH_TPU_WARMUP")}
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                         if "host_platform_device_count" not in f)
+        env["XLA_FLAGS"] = \
+            (flags + f" --xla_force_host_platform_device_count={n}").strip()
+        env["RETH_TPU_BENCH_SUBTRIE_INNER"] = str(n)
+        env["RETH_TPU_BENCH_TIMEOUT"] = str(budget)
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, capture_output=True, text=True,
+                               timeout=budget + 60)
+        except subprocess.TimeoutExpired:
+            _emit(0, 0, error=f"subtrie inner ({n} devices) exceeded "
+                              f"{budget + 60}s", exit_code=0)
+        line = None
+        for out_line in reversed(r.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(out_line)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict):
+                line = parsed
+                break
+        if not line or "n_devices" not in line or line.get("error"):
+            diag = ((line or {}).get("error")
+                    or (r.stderr or r.stdout or "no output")[-300:])
+            _emit(0, 0, error=f"subtrie inner ({n} devices) failed "
+                              f"rc={r.returncode}: {diag}", exit_code=0)
+        if not line.get("roots_identical"):
+            # acceptance contract: a root divergence is a correctness
+            # failure — no dispatch number may print over it
+            _emit(0, 0, error=f"subtrie inner ({n} devices): k-level roots "
+                              f"diverged from the per-level committer",
+                  exit_code=1)
+        per[str(line["n_devices"])] = {
+            "perlevel": line["perlevel"], "per_k": line["per_k"],
+            "hashed": line["hashed"]}
+    top = per[str(max(sizes))]
+    best_k = max(top["per_k"], key=int)
+    headline = top["per_k"][best_k]["dispatch_reduction"]
+    _STATE["device_result"] = headline
+    _emit(headline, headline,
+          n_devices=max(sizes), k=int(best_k),
+          dispatches_per_block=top["per_k"][best_k]["dispatches_per_block"],
+          perlevel_dispatches_per_block=top["perlevel"][
+              "dispatches_per_block"],
+          per_mesh=per, roots_identical=True,
+          verified="k-level roots bit-identical to the per-level "
+                   "committer at every mesh size before measuring",
+          exit_code=0)
+
+
 def run_fleet_mode() -> None:
     """RETH_TPU_BENCH_MODE=fleet: sustained RPC throughput + p99 through
     the fleet gateway at 1/2/4/8 replicas vs the single-node gateway
@@ -1187,11 +1340,18 @@ def main():
         # (the inner run attributes its own compile wall explicitly)
         _mesh_inner(int(inner))
         return
+    inner = os.environ.get("RETH_TPU_BENCH_SUBTRIE_INNER")
+    if inner:
+        _subtrie_inner(int(inner))
+        return
     _setup_compile_cache()
     _maybe_warmup()
     mode = os.environ.get("RETH_TPU_BENCH_MODE", "exec")
     if mode == "mesh":
         run_mesh_mode()
+        return
+    if mode == "subtrie":
+        run_subtrie_mode()
         return
     if mode == "service":
         run_service_mode()

@@ -69,9 +69,27 @@ def _resolve_mesh(args) -> int:
     return int(n or 0)
 
 
+def _resolve_subtrie(args) -> int:
+    """Whole-subtrie k-level fused kernels: --subtrie-levels beats
+    RETH_TPU_SUBTRIE_LEVELS beats [node] subtrie_levels (reth.toml);
+    0/1 = per-level dispatching. The resolved k is exported back into
+    the env so EVERY consumer (TurboCommitter, ParallelSparseCommitter,
+    HashService window requests) picks it up without plumbing."""
+    import os
+
+    k = getattr(args, "subtrie_levels", None)
+    if k is None:
+        k = os.environ.get("RETH_TPU_SUBTRIE_LEVELS") or 0
+    k = int(k or 0)
+    if k > 1:
+        os.environ["RETH_TPU_SUBTRIE_LEVELS"] = str(k)
+    return k
+
+
 def _make_committer(args):
     from .trie.committer import TrieCommitter
 
+    _resolve_subtrie(args)
     mode = getattr(args, "hasher", "device")
     warm_mode, cache_dir = _resolve_warmup(args)
     mesh_n = _resolve_mesh(args) if mode != "cpu" else 0
@@ -834,6 +852,7 @@ def cmd_config(args):
         f'warmup = "{cfg.warmup}"',
         f'compile_cache_dir = "{cfg.compile_cache_dir}"',
         f"sparse_workers = {cfg.sparse_workers}",
+        f"subtrie_levels = {cfg.subtrie_levels}",
         f"parallel_exec = {'true' if cfg.parallel_exec else 'false'}",
         f"trace_blocks = {'true' if cfg.trace_blocks else 'false'}",
         f"health = {'true' if cfg.health else 'false'}",
@@ -1209,6 +1228,23 @@ def main(argv=None) -> int:
                         "or a cpu-derived value; 1 disables the pools "
                         "(the cross-trie packed hash dispatch stays on). "
                         "Also settable as [node] sparse_workers in "
+                        "reth.toml")
+    p.add_argument("--subtrie-levels", dest="subtrie_levels", type=int,
+                   default=None,
+                   help="whole-subtrie fused tree-hash kernels "
+                        "(ops/fused_commit.py SubtrieFusedEngine): commit "
+                        "k packed trie levels per device dispatch — the "
+                        "depth loop runs INSIDE the jitted program with "
+                        "the resident digest buffer as the carry, so "
+                        "dispatches per block drop from O(depth) to "
+                        "O(depth/k). Applies to the turbo rebuild, the "
+                        "parallel sparse finish, and hash-service window "
+                        "requests; un-warm k-shapes route to the "
+                        "per-level path, and failures replay per-level "
+                        "then on the CPU twin, roots bit-identical "
+                        "(RETH_TPU_FAULT_SUBTRIE_{WEDGE,ABORT} drills). "
+                        "Default: RETH_TPU_SUBTRIE_LEVELS or off (0/1 = "
+                        "per-level). Also [node] subtrie_levels in "
                         "reth.toml")
     p.add_argument("--parallel-exec", dest="parallel_exec",
                    action="store_true", default=False,

@@ -133,6 +133,12 @@ class RethTpuConfig:
     # trie/proof.py). 0 = auto (env RETH_TPU_SPARSE_WORKERS or
     # cpu-derived); 1 = pools off, cross-trie packed dispatch stays on
     sparse_workers: int = 0
+    # whole-subtrie fused kernels (--subtrie-levels CLI / env
+    # RETH_TPU_SUBTRIE_LEVELS): k > 1 collapses the committers' per-depth
+    # device dispatch loop into ONE dispatch per k packed levels
+    # (ops/fused_commit.SubtrieFusedEngine — the depth loop runs inside
+    # the jitted program, digest buffer as the carry). 0/1 = per-level
+    subtrie_levels: int = 0
     # optimistic parallel EVM execution on the no-BAL newPayload path
     # (--parallel-exec CLI equivalent): Block-STM-style speculation with
     # read/write-set validation, async storage prefetch, and serial
@@ -210,6 +216,7 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.compile_cache_dir = str(node.get("compile_cache_dir",
                                          cfg.compile_cache_dir))
     cfg.sparse_workers = int(node.get("sparse_workers", cfg.sparse_workers))
+    cfg.subtrie_levels = int(node.get("subtrie_levels", cfg.subtrie_levels))
     cfg.parallel_exec = bool(node.get("parallel_exec", cfg.parallel_exec))
     cfg.trace_blocks = bool(node.get("trace_blocks", cfg.trace_blocks))
     cfg.health = bool(node.get("health", cfg.health))

@@ -401,6 +401,17 @@ def default_rules() -> list[SloRule]:
         SloRule("sparse_finish_p99", "sparse_commit", "quantile", 0.5,
                 metric="sparse_commit_finish_seconds", q=0.99, unit="s",
                 help="p99 live-tip sparse finish() wall"),
+        # whole-subtrie fused commits: the histogram is recorded ONLY by
+        # the k-level engines, so a healthy k=8 commit sits at ~depth/8
+        # dispatches — a median above the budget means k-level commits
+        # are degrading back to per-level dispatch counts (un-warm
+        # k-shapes, chunk wedges, or a packing regression); degraded
+        # only, never failing (roots stay correct on every rung)
+        SloRule("fused_dispatches_per_block", "fused_commit", "quantile",
+                16.0, metric="fused_dispatches_per_block", q=0.5,
+                failing_factor=1e9,
+                help="median device dispatches per k-level fused commit "
+                     "above the k-level baseline (per-level regression)"),
         SloRule("exec_conflict_rate", "exec", "ratio", 0.5,
                 metrics_num=("exec_parallel_conflicts_total",
                              "exec_parallel_serial_reruns_total"),

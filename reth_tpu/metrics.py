@@ -461,6 +461,58 @@ class SparseCommitMetrics:
 sparse_commit_metrics = SparseCommitMetrics()
 
 
+class FusedCommitMetrics:
+    """Fused-committer dispatch accounting (ops/fused_commit.py): how many
+    device dispatches the commitment path actually issues, and how many
+    trie levels each one carried. The whole-subtrie engine
+    (``SubtrieFusedEngine``) exists to collapse O(depth) dispatches per
+    block into O(1) per chunk — these are the numbers that prove (or
+    disprove) it per commit, and the SLO rule in ``health.py`` pages when
+    a k-level commit regresses back to per-level dispatch counts."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._dispatches = reg.counter(
+            "fused_dispatches_total",
+            "fused committer device dispatches issued")
+        self._levels = reg.counter(
+            "fused_levels_total", "trie levels carried by fused dispatches")
+        self._levels_per = reg.histogram(
+            "fused_levels_per_dispatch",
+            "trie levels fused into one device dispatch",
+            buckets=(1, 2, 4, 8, 16, 32, 64))
+        self._per_block = reg.histogram(
+            "fused_dispatches_per_block",
+            "device dispatches one k-level fused commit issued",
+            buckets=(1, 2, 4, 8, 16, 24, 32, 64, 128))
+        self._fallbacks = reg.counter(
+            "fused_subtrie_fallbacks_total",
+            "k-level chunks degraded to the per-level or CPU path")
+        self.last: dict | None = None  # most recent commit, for events/bench
+        self.dispatches_cum = 0  # lifetime count (bench deltas)
+
+    def record_dispatch(self, levels: int) -> None:
+        self._dispatches.increment()
+        self._levels.increment(levels)
+        self._levels_per.record(levels)
+        self.dispatches_cum += 1
+
+    def record_fallback(self) -> None:
+        self._fallbacks.increment()
+
+    def record_commit(self, *, dispatches: int, levels: int, k: int,
+                      mode: str) -> None:
+        """One k-level commit finished: ``dispatches`` device calls carried
+        ``levels`` staged levels (``mode`` records which rung produced the
+        digests — fused / perlevel / cpu)."""
+        self._per_block.record(dispatches)
+        self.last = {"k": k, "dispatches": dispatches, "levels": levels,
+                     "mode": mode}
+
+
+fused_metrics = FusedCommitMetrics()
+
+
 class ExecMetrics:
     """Parallel-execution observability: the optimistic scheduler
     (engine/optimistic.py — exec_parallel_*) and the BAL wave executor

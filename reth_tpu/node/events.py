@@ -211,6 +211,21 @@ class NodeEventReporter:
                      f" disp={pm['dispatch_s']}s fetch={pm['fetch_s']}s]")
             if pm["drained_windows"]:
                 line += f" drained={pm['drained_windows']}"
+        # whole-subtrie fused commits: the k-level engine's one-line
+        # health — configured k, device dispatches the last commit
+        # actually issued for how many staged levels, and which rung
+        # produced the digests (fused / perlevel / cpu). A mode other
+        # than "fused" — or disp creeping toward lv — is the dispatch-
+        # count regression the fused SLO rule pages on.
+        from ..metrics import fused_metrics
+
+        fm = fused_metrics.last
+        if fm is not None:
+            line += (f" fused[k={fm['k']} disp={fm['dispatches']}"
+                     f" lv={fm['levels']}")
+            if fm["mode"] != "fused":
+                line += f" {fm['mode'].upper()}"
+            line += "]"
         # parallel sparse commit: the live-tip finish path's one-line
         # health — how many depth levels packed across tries, fused
         # dispatches per block, encode-chunk fan-out, and the finish wall

@@ -243,21 +243,49 @@ class FusedLevelEngine:
         self.min_tier = min_tier
         self._buf = None
         self._n_slots = 0
+        self.dispatches = 0  # device program calls since begin()
+        # ladder caps hoisted out of the dispatch path (PR 10 follow-up):
+        # the ladder walk used to rerun on EVERY dispatch_level/_split call;
+        # it is now computed once per (ceilings, min_tier, mesh) key — the
+        # key guard keeps tests that mutate MAX_BATCH_ROWS post-init exact
+        self._caps_key: tuple | None = None
+        self._caps()
+
+    def _caps(self) -> tuple[int, list[int]]:
+        """(row cap, batch-tier ladder) under the declared ceilings,
+        memoized by the inputs that define them. The row cap is the
+        LARGEST tier on the batch ladder (x4 growth from the
+        device-count-rounded floor) that still fits under the ceilings.
+        Splitting at a raw ceiling minted a tier ABOVE it whenever the
+        mesh-rounded floor put the ladder off the pow2 grid (e.g. 6
+        devices: 1026 → 4104 → 16416 → 65664 > MAX_BATCH_ROWS) — a chunk
+        split must never create a shape the warm-up menu doesn't declare
+        or the mesh can't divide."""
+        key = (self._MAX_ROWS, self.MAX_BATCH_ROWS, self.min_tier,
+               self._batch_multiple())
+        if self._caps_key != key:
+            ceiling = min(self._MAX_ROWS, self.MAX_BATCH_ROWS)
+            t = max(self.min_tier, key[3])
+            ladder = [t]
+            while t * 4 <= ceiling:
+                t *= 4
+                ladder.append(t)
+            self._caps_key = key
+            self._caps_value = (ladder[-1], ladder)
+        return self._caps_value
 
     def _row_cap(self) -> int:
-        """Row-range split threshold: the LARGEST tier on the batch ladder
-        (x4 growth from the device-count-rounded floor) that still fits
-        under the declared ceilings. Splitting at a raw ceiling minted a
-        tier ABOVE it whenever the mesh-rounded floor put the ladder off
-        the pow2 grid (e.g. 6 devices: 1026 → 4104 → 16416 → 65664 >
-        MAX_BATCH_ROWS) — a chunk split must never create a shape the
-        warm-up menu doesn't declare or the mesh can't divide."""
-        ceiling = min(self._MAX_ROWS, self.MAX_BATCH_ROWS)
-        mult = self._batch_multiple()
-        t = max(self.min_tier, mult)
-        while t * 4 <= ceiling:
-            t *= 4
-        return t
+        return self._caps()[0]
+
+    def _hole_budget(self, n: int) -> int:
+        """Hole budget for an ``n``-row level: _HOLE_FACTOR x the smallest
+        ladder tier holding ``n`` — looked up on the hoisted ladder
+        instead of re-walking it per dispatch/split call."""
+        cap, ladder = self._caps()
+        for t in ladder:
+            if n <= t:
+                return self._HOLE_FACTOR * t
+        return self._HOLE_FACTOR * cap  # over the cap: callers split by rows
 
     def _check_batch_tier(self, n_tier: int) -> int:
         """Invariant guard on every minted batch tier: divisible by the
@@ -289,6 +317,16 @@ class FusedLevelEngine:
         s_tier = _pow2(max_slots + 1, floor=max(self.min_tier, 2))
         self._buf = self._device_put(np.zeros((s_tier, 32), dtype=np.uint8))
         self._n_slots = 1  # slot 0 = dummy
+        self.dispatches = 0
+
+    def _count_dispatch(self, levels: int = 1) -> None:
+        """One device program actually ran, carrying ``levels`` staged
+        levels — the number the whole-subtrie kernel family exists to
+        shrink (fused_* metrics + the bench's dispatches/block)."""
+        from ..metrics import fused_metrics
+
+        self.dispatches += 1
+        fused_metrics.record_dispatch(levels)
 
     def alloc_slot(self) -> int:
         slot = self._n_slots
@@ -345,7 +383,7 @@ class FusedLevelEngine:
         if n == 0:
             return
         b_tier = self._check_block_tier(_pow2(bucket.nb_max, floor=2))
-        hole_budget = self._HOLE_FACTOR * _tier(n + 1, self.min_tier)
+        hole_budget = self._hole_budget(n + 1)
         over_holed = bucket.holes and len(bucket.holes) > hole_budget
         if over_holed or n + 1 > self._row_cap():
             for part in self._split(bucket, hole_budget):
@@ -399,6 +437,7 @@ class FusedLevelEngine:
                 self._put_batch(templates), self._put_batch(counts),
                 self._put_batch(slots), self._buf,
             )
+            self._count_dispatch()
             return
         h_tier = _pow2(len(bucket.holes), floor=self._HOLE_FACTOR * self.min_tier)
         hole_node = np.full((h_tier,), n, dtype=np.int32)  # padding row target
@@ -415,6 +454,7 @@ class FusedLevelEngine:
             self._put_batch(hole_node), self._put_batch(hole_byte),
             self._put_batch(hole_src), self._put_batch(slots), self._buf,
         )
+        self._count_dispatch()
 
     # -- raw turbo dispatch (arrays straight from native/triebuild.cpp) ----
 
@@ -503,6 +543,7 @@ class FusedLevelEngine:
             self._put_batch(hr), self._put_batch(ho), self._put_batch(hs),
             self._put_batch(slots_p), self._buf,
         )
+        self._count_dispatch()
 
     def dispatch_branch(
         self, masks: np.ndarray, slots: np.ndarray, children: np.ndarray
@@ -532,6 +573,7 @@ class FusedLevelEngine:
             self._put_batch(masks_p), self._put_batch(slots_p),
             self._put_batch(cr), self._put_batch(cn), self._put_batch(cs), self._buf,
         )
+        self._count_dispatch()
 
 
 @lru_cache(maxsize=64)
@@ -647,6 +689,7 @@ class MegaFusedEngine(FusedLevelEngine):
         self._plan, self._u8_parts, self._i32_parts = [], [], []
         self._u8_off = self._i32_off = 0
         self._buf = None
+        self.dispatches = 0
 
     def ensure(self, max_slots: int) -> None:
         """Staged variant: before ``_execute`` the buffer is only a planned
@@ -813,6 +856,7 @@ class MegaFusedEngine(FusedLevelEngine):
                     fn, u8d, i32d, buf, s32(flat_off), s32(len_o),
                     s32(slot_o), s32(hidx_o), s32(hsrc_o),
                     s32(n_valid), s32(h_valid))
+                self._count_dispatch()
             else:
                 (_, n_pow, ch_pow, mask_o, slot_o, chidx_o, chsrc_o,
                  n_valid, c_valid) = e
@@ -823,6 +867,7 @@ class MegaFusedEngine(FusedLevelEngine):
                     fn, u8d, i32d, buf, s32(mask_o), s32(slot_o),
                     s32(chidx_o), s32(chsrc_o), s32(n_valid),
                     s32(c_valid))
+                self._count_dispatch()
         self._buf = buf
         self._plan, self._u8_parts, self._i32_parts = [], [], []
 
@@ -860,13 +905,16 @@ class FusedMeshEngine(FusedLevelEngine):
                 raise RuntimeError("HashMesh has no live devices")
         # every tier must stay divisible by the device count: tiers grow by
         # x4 (batch) / x2 (holes, slots) from their floors, so rounding the
-        # floor up to a device-count multiple keeps all of them shardable
+        # floor up to a device-count multiple keeps all of them shardable.
+        # self.mesh must be set BEFORE super().__init__: the base class
+        # hoists the ladder caps at construction, which asks for
+        # _batch_multiple() — the mesh's device count here.
         mult = mesh.devices.size
-        super().__init__(min_tier=-(-min_tier // mult) * mult)
         self.mesh = mesh
         axis = mesh.axis_names[0]
         self._batch_sharding = NamedSharding(mesh, P(axis))
         self._replicated = NamedSharding(mesh, P())
+        super().__init__(min_tier=-(-min_tier // mult) * mult)
 
     def _device_put(self, arr: np.ndarray):
         return jax.device_put(arr, self._replicated)
@@ -878,4 +926,672 @@ class FusedMeshEngine(FusedLevelEngine):
         return self.mesh
 
     def _batch_multiple(self) -> int:
+        return self.mesh.devices.size
+
+
+# -- whole-subtrie fused kernels (ONE dispatch per k levels) ------------------
+
+
+class InjectedSubtrieWedge(RuntimeError):
+    """Fault injection wedged a k-level fused chunk dispatch
+    (RETH_TPU_FAULT_SUBTRIE_WEDGE) — the engine must replay the whole
+    staged journal bit-identically on the per-level path."""
+
+
+class InjectedSubtrieAbort(RuntimeError):
+    """Fault injection poisoned the WHOLE device path for this engine
+    (RETH_TPU_FAULT_SUBTRIE_ABORT): the fused chunk AND its per-level
+    replay both fail, so the commit must land on the CPU twin."""
+
+
+class SubtrieFaultInjector:
+    """Fault policies for the whole-subtrie engine, in the style of
+    ``ops/supervisor.py``'s FaultInjector.
+
+    ``wedge_at``: the Nth fused (multi-level) chunk dispatch of the
+    process raises :class:`InjectedSubtrieWedge` (one-shot) — the engine
+    replays its journal on the per-level path, roots bit-identical.
+    ``abort_at``: the Nth chunk dispatch raises AND every subsequent
+    per-level replay dispatch raises too — drills the final rung: the
+    journal replays on the CPU twin.
+
+    Env form (:meth:`from_env`): ``RETH_TPU_FAULT_SUBTRIE_WEDGE`` /
+    ``RETH_TPU_FAULT_SUBTRIE_ABORT``.
+    """
+
+    def __init__(self, wedge_at: int = 0, abort_at: int = 0):
+        import threading
+
+        self.wedge_at = wedge_at
+        self.abort_at = abort_at
+        self.chunks = 0
+        self.wedges = 0
+        self.aborts = 0
+        self._abort_armed = False
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, env=None) -> "SubtrieFaultInjector | None":
+        import os
+
+        env = os.environ if env is None else env
+        wedge = int(env.get("RETH_TPU_FAULT_SUBTRIE_WEDGE", "0") or 0)
+        abort = int(env.get("RETH_TPU_FAULT_SUBTRIE_ABORT", "0") or 0)
+        if not (wedge or abort):
+            return None
+        return cls(wedge_at=wedge, abort_at=abort)
+
+    def on_chunk(self, mode: str, levels: int) -> None:
+        """Called before every subtrie device dispatch. ``mode`` is
+        "fused" for k-level chunks and "perlevel" for the fallback
+        replay's single-level dispatches."""
+        from .. import tracing
+
+        if mode == "perlevel":
+            with self._lock:
+                armed = self._abort_armed
+            if armed:
+                tracing.fault_event("RETH_TPU_FAULT_SUBTRIE_ABORT",
+                                    target="ops::fused_commit",
+                                    rung="perlevel")
+                raise InjectedSubtrieAbort(
+                    "injected subtrie abort: per-level replay poisoned "
+                    f"(RETH_TPU_FAULT_SUBTRIE_ABORT={self.abort_at})")
+            return
+        with self._lock:
+            self.chunks += 1
+            n = self.chunks
+        if self.wedge_at and n == self.wedge_at:
+            with self._lock:
+                self.wedges += 1
+            tracing.fault_event("RETH_TPU_FAULT_SUBTRIE_WEDGE",
+                                target="ops::fused_commit", chunk=n,
+                                levels=levels)
+            raise InjectedSubtrieWedge(
+                f"injected subtrie wedge on chunk #{n} "
+                f"(RETH_TPU_FAULT_SUBTRIE_WEDGE={self.wedge_at})")
+        if self.abort_at and n == self.abort_at:
+            with self._lock:
+                self.aborts += 1
+                self._abort_armed = True
+            tracing.fault_event("RETH_TPU_FAULT_SUBTRIE_ABORT",
+                                target="ops::fused_commit", chunk=n,
+                                levels=levels)
+            raise InjectedSubtrieAbort(
+                f"injected subtrie abort on chunk #{n} "
+                f"(RETH_TPU_FAULT_SUBTRIE_ABORT={self.abort_at})")
+
+
+_PARAM_W = 10  # param-table row width (i32): kind + offsets + valid counts
+
+
+def _ladder_tier(n: int, floor: int, mult: int) -> int:
+    """x2 ladder from the ``mult``-rounded floor (stays divisible by the
+    mesh device count, mirroring ``FusedMeshEngine``'s tier discipline)."""
+    t = -(-max(1, floor) // max(1, mult)) * max(1, mult)
+    while t < n:
+        t *= 2
+    return t
+
+
+@lru_cache(maxsize=128)
+def _subtrie_program(b_tier: int, n_pow: int, h_pow: int, steps_pow: int,
+                     u8_len: int, i32_len: int, s_tier: int, mesh=None):
+    """ONE compiled program hashing up to ``steps_pow`` staged levels.
+
+    This is the Sakura shape (arxiv 1608.00492): the depth loop runs
+    INSIDE the jit — ``lax.fori_loop`` with the resident digest buffer as
+    the carry, each step splicing child digests written by earlier steps
+    — so a whole k-level chunk costs ONE dispatch instead of one per
+    depth. The loop body is traced ONCE (a ``lax.cond`` selecting the
+    packed or branch shape per step from the i32 param table), so trace
+    and compile size are constant in k — the round-2 mega postmortem
+    (every level unrolled → 19 s compile → wedged tunnel) does not recur.
+    Static shapes are the chunk-wide (rows, aux, steps) tiers plus the
+    staging-buffer lengths; live counts arrive via the param table and
+    junk rows/holes mask to the dummy slot, exactly like the per-level
+    staged programs — digests for real slots are bit-identical to the
+    per-level path by construction.
+
+    ``mesh``: a jax Mesh — the k-level SPMD variant. Staged buffers are
+    replicated; the per-step row block gets a sharding constraint over
+    the batch axis. The k-level packers keep each subtrie's rows
+    contiguous, so row-range shards ≈ subtrie shards: parent composition
+    goes through the REPLICATED digest buffer (XLA inserts the
+    all-gather), never through a neighbour's row shard.
+    """
+    L = b_tier * RATE
+    constraint = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        constraint = NamedSharding(mesh, P(mesh.axis_names[0], None))
+
+    def _shard(rows):
+        if constraint is not None:
+            return jax.lax.with_sharding_constraint(rows, constraint)
+        return rows
+
+    def packed_step(u8, i32, buf, p):
+        flat_off, len_o, slot_o = p[1], p[2], p[3]
+        hrow_o, hbyte_o, hsrc_o = p[4], p[5], p[6]
+        n_valid, h_valid = p[7], p[8]
+        raw = jax.lax.dynamic_slice(u8, (len_o,), (2 * n_pow,))
+        raw = raw.reshape(n_pow, 2).astype(jnp.uint32)
+        ridx = jnp.arange(n_pow, dtype=jnp.int32)
+        vrow = ridx < n_valid
+        row_len = jnp.where(vrow, raw[:, 0] | (raw[:, 1] << 8), 0)
+        row_off = (jnp.cumsum(row_len) - row_len).astype(jnp.int32)
+        counts = (row_len // RATE + 1).astype(jnp.int32)
+        slots = jnp.where(
+            vrow, jax.lax.dynamic_slice(i32, (slot_o,), (n_pow,)), 0)
+        col = jnp.arange(L, dtype=jnp.int32)[None, :]
+        idx = jnp.minimum(flat_off + row_off[:, None] + col, u8.shape[0] - 1)
+        rows = jnp.where(col < row_len[:, None].astype(jnp.int32), u8[idx], 0)
+        rl = row_len[:, None].astype(jnp.int32)
+        rows = rows ^ jnp.where(col == rl, 0x01, 0).astype(jnp.uint8)
+        last = (counts * RATE - 1)[:, None]
+        rows = rows ^ jnp.where(col == last, 0x80, 0).astype(jnp.uint8)
+        # splice child digests; junk hole entries retarget the level's
+        # always-padding row (row n_valid-1 has row_len 0, slot 0). Hole
+        # targets are staged as (row, byte) pairs — NOT row*L+byte — so
+        # one chunk-wide L can serve levels staged at different b_tiers.
+        hv = jnp.arange(h_pow, dtype=jnp.int32) < h_valid
+        hrow = jnp.where(
+            hv, jax.lax.dynamic_slice(i32, (hrow_o,), (h_pow,)), n_valid - 1)
+        hbyte = jnp.where(
+            hv, jax.lax.dynamic_slice(i32, (hbyte_o,), (h_pow,)), 0)
+        hsrc = jnp.where(
+            hv, jax.lax.dynamic_slice(i32, (hsrc_o,), (h_pow,)), 0)
+        dig = buf[hsrc]
+        fr = rows.reshape(-1)
+        sidx = (hrow * L + hbyte)[:, None] \
+            + jnp.arange(32, dtype=jnp.int32)[None, :]
+        rows = _shard(
+            fr.at[sidx.reshape(-1)].set(dig.reshape(-1)).reshape(n_pow, L))
+        d = masked_absorb_words(_bytes_to_words(rows), b_tier, counts)
+        return buf.at[slots].set(_digests_to_bytes(d))
+
+    def branch_step(u8, i32, buf, p):
+        mask_o, slot_o, chidx_o, chsrc_o = p[1], p[2], p[3], p[4]
+        n_valid, ch_valid = p[7], p[8]
+        raw = jax.lax.dynamic_slice(u8, (mask_o,), (2 * n_pow,))
+        raw = raw.reshape(n_pow, 2).astype(jnp.uint32)
+        vrow = jnp.arange(n_pow, dtype=jnp.int32) < n_valid
+        masks = jnp.where(vrow, raw[:, 0] | (raw[:, 1] << 8), 0)
+        slots = jnp.where(
+            vrow, jax.lax.dynamic_slice(i32, (slot_o,), (n_pow,)), 0)
+        cv = jnp.arange(h_pow, dtype=jnp.int32) < ch_valid
+        crn = jnp.where(
+            cv, jax.lax.dynamic_slice(i32, (chidx_o,), (h_pow,)),
+            (n_valid - 1) * 16)
+        cs = jnp.where(
+            cv, jax.lax.dynamic_slice(i32, (chsrc_o,), (h_pow,)), 0)
+        return _branch_level(masks.astype(jnp.int32), slots, crn // 16,
+                             crn % 16, cs, buf, b_tier=b_tier)
+
+    def run(u8, i32, params, buf, n_steps):
+        def body(s, carry):
+            p = jax.lax.dynamic_index_in_dim(params, s, axis=0,
+                                             keepdims=False)
+            return jax.lax.cond(
+                p[0] == 0,
+                lambda b: packed_step(u8, i32, b, p),
+                lambda b: branch_step(u8, i32, b, p),
+                carry)
+        return jax.lax.fori_loop(0, n_steps, body, buf)
+
+    return jax.jit(run, donate_argnums=3)
+
+
+class SubtrieFusedEngine(MegaFusedEngine):
+    """Whole-subtrie k-level fused engine: ONE device dispatch per chunk
+    of k staged levels, not one per depth (ROADMAP item 3).
+
+    Staging follows :class:`MegaFusedEngine` (two H2D uploads per flush,
+    tight bytes, zero mid-commit D2H), but execution goes one step
+    further: instead of one small program PER level, consecutive staged
+    levels group into chunks of ``k`` and each chunk runs as ONE
+    :func:`_subtrie_program` dispatch whose depth loop carries the
+    resident digest buffer — dispatches per commit drop from O(depth) to
+    O(depth / k). ``flush_window()`` lets the rebuild pipeline execute
+    each packed window eagerly (the digest buffer stays resident across
+    windows), preserving the sweep/hash overlap.
+
+    Degradation ladder (journal-replay based — staging arrays are host
+    numpy, retained until the terminal fetch, so replay is exact):
+
+      fused chunks → per-level (the same program at k=1) → CPU twin
+
+    A failed chunk dispatch (watchdog escape, injected
+    ``RETH_TPU_FAULT_SUBTRIE_WEDGE``) rebuilds the whole digest buffer by
+    replaying the journal per-level; if the device path is gone entirely
+    (``RETH_TPU_FAULT_SUBTRIE_ABORT``), the journal replays on the CPU
+    twin. Roots are bit-identical on every rung — hashing is
+    deterministic and the journal holds every staged byte. An attached
+    warm-up manager routes un-warm (fused.subtrie, k, tier, mesh) shapes
+    to the per-level path instead of compiling mid-commit.
+
+    Chunking discipline: steps sharing a chunk share ONE static
+    (b_tier, rows, aux) shape — the chunk b_tier is the max over its
+    steps (capped at ``_CHUNK_BTIER_CAP``; bigger-block levels dispatch
+    solo) and row/aux tiers are chunk-wide ladders, so program variety
+    stays O(log workload) and padded rows mask to the dummy slot.
+    """
+
+    effective_kind = "device"
+    _CHUNK_BTIER_CAP = 8
+
+    def __init__(self, min_tier: int = 1024, k: int | None = None,
+                 warmup=None, injector=None, row_floor: int | None = None,
+                 hole_floor: int | None = None):
+        import os as _os
+
+        super().__init__(min_tier=min_tier)
+        if k is None:
+            k = int(_os.environ.get("RETH_TPU_SUBTRIE_LEVELS", "0") or 8)
+        self.k = max(1, int(k))
+        self.warmup = warmup
+        self.injector = (injector if injector is not None
+                         else SubtrieFaultInjector.from_env())
+        if row_floor:
+            self._ROW_FLOOR = int(row_floor)
+        if hole_floor:
+            self._HOLE_FLOOR = int(hole_floor)
+        self._mode = "fused"
+        self._journal: list[tuple[np.ndarray, np.ndarray, list]] = []
+        self._buf_np: np.ndarray | None = None
+        self.levels_staged = 0
+
+    # -- mesh seam (overridden by SubtrieMeshEngine) -----------------------
+
+    def _mesh_arg(self):
+        return None
+
+    def _mesh_size(self) -> int:
+        return 1
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def begin(self, max_slots: int) -> None:
+        super().begin(max_slots)
+        self._mode = "fused"
+        self._journal = []
+        self._buf_np = None
+        self.levels_staged = 0
+
+    def ensure(self, max_slots: int) -> None:
+        if self._mode == "cpu":
+            need = max_slots + 1
+            if self._buf_np is not None and self._buf_np.shape[0] >= need:
+                return
+            tier = _pow2(need, floor=max(self.min_tier, 2, self._s_tier))
+            grown = np.zeros((tier, 32), dtype=np.uint8)
+            if self._buf_np is not None:
+                grown[: self._buf_np.shape[0]] = self._buf_np
+            self._buf_np = grown
+            self._s_tier = tier
+            return
+        super().ensure(max_slots)
+        if self._buf is not None:
+            self._s_tier = int(self._buf.shape[0])
+
+    # -- staging (k-level layout: hole targets as (row, byte) pairs) -------
+
+    def dispatch_packed(self, flat, row_off, row_len, slots, holes, b_tier):
+        n = len(row_off)
+        if n == 0:
+            return
+        self._check_block_tier(b_tier)
+        if n + 1 > self._row_cap():
+            cap = self._row_cap() - 1
+            for lo in range(0, n, cap):
+                hi = min(lo + cap, n)
+                base = int(row_off[lo])
+                end = int(row_off[hi - 1] + row_len[hi - 1])
+                self.dispatch_packed(
+                    flat[base:end], row_off[lo:hi] - base, row_len[lo:hi],
+                    slots[lo:hi], self._filter_triples(holes, lo, hi), b_tier)
+            return
+        row_len_p = np.zeros((n + 1,), dtype="<u2")
+        row_len_p[:n] = row_len
+        slots_p = np.zeros((n + 1,), dtype=np.int32)
+        slots_p[:n] = slots
+        h = holes.shape[1] if holes is not None else 0
+        hrow = np.full((h + 1,), n, dtype=np.int32)  # dump: the padding row
+        hbyte = np.zeros((h + 1,), dtype=np.int32)
+        hsrc = np.zeros((h + 1,), dtype=np.int32)
+        if h:
+            hrow[:h], hbyte[:h], hsrc[:h] = holes[0], holes[1], holes[2]
+        flat_off = self._stage_u8(np.asarray(flat, dtype=np.uint8))
+        len_o = self._stage_u8(row_len_p.view(np.uint8))
+        slot_o = self._stage_i32(slots_p)
+        hrow_o = self._stage_i32(hrow)
+        hbyte_o = self._stage_i32(hbyte)
+        hsrc_o = self._stage_i32(hsrc)
+        self._plan.append(("packed", b_tier, flat_off, len_o, slot_o,
+                           hrow_o, hbyte_o, hsrc_o, n + 1, h + 1))
+        self.levels_staged += 1
+
+    def dispatch_branch(self, masks, slots, children) -> None:
+        n = len(masks)
+        if n == 0:
+            return
+        if n + 1 > self._row_cap():
+            cap = self._row_cap() - 1
+            for lo in range(0, n, cap):
+                hi = min(lo + cap, n)
+                self.dispatch_branch(masks[lo:hi], slots[lo:hi],
+                                     self._filter_triples(children, lo, hi))
+            return
+        masks_p = np.zeros((n + 1,), dtype="<u2")
+        masks_p[:n] = masks
+        slots_p = np.zeros((n + 1,), dtype=np.int32)
+        slots_p[:n] = slots
+        c = children.shape[1] if children is not None else 0
+        chidx = np.full((c + 1,), n * 16, dtype=np.int32)
+        chsrc = np.zeros((c + 1,), dtype=np.int32)
+        if c:
+            chidx[:c] = children[0] * 16 + children[1]
+            chsrc[:c] = children[2]
+        mask_o = self._stage_u8(masks_p.view(np.uint8))
+        slot_o = self._stage_i32(slots_p)
+        chidx_o = self._stage_i32(chidx)
+        chsrc_o = self._stage_i32(chsrc)
+        self._plan.append(("branch", mask_o, slot_o, chidx_o, chsrc_o,
+                           n + 1, c + 1))
+        self.levels_staged += 1
+
+    # -- chunk planning ----------------------------------------------------
+
+    @staticmethod
+    def _step_btier(e) -> int:
+        return e[1] if e[0] == "packed" else 4
+
+    def _chunk_plan(self, plan: list, k: int) -> list[tuple]:
+        """[(entries, b_tier, n_pow, h_pow)] — consecutive steps grouped
+        up to ``k`` per chunk; within-a-commit order is the dependency
+        order (deeper levels staged first), so consecutive grouping
+        preserves parent composition exactly."""
+        mult = self._batch_multiple()
+        groups: list[list] = []
+        cur: list = []
+        cur_big = False
+        for e in plan:
+            big = self._step_btier(e) > self._CHUNK_BTIER_CAP
+            if cur and (len(cur) >= k or big or cur_big):
+                groups.append(cur)
+                cur = []
+            cur.append(e)
+            cur_big = big
+        if cur:
+            groups.append(cur)
+        chunks = []
+        for entries in groups:
+            b_tier = max(self._step_btier(e) for e in entries)
+            n_pow = _ladder_tier(max(e[-2] for e in entries),
+                                 self._ROW_FLOOR, mult)
+            h_pow = _ladder_tier(max(e[-1] for e in entries),
+                                 self._HOLE_FLOOR, mult)
+            chunks.append((entries, b_tier, n_pow, h_pow))
+        return chunks
+
+    def _chunk_buffer_lens(self, chunks: list[tuple]) -> tuple[int, int]:
+        """Final staged lengths covering every chunk-wide dynamic_slice
+        (a clamped slice start would silently misalign a level — the
+        chunk-wide row/aux tiers read PAST each level's own staging, so
+        the buffers must be long enough for the widest reader)."""
+        u8_need = self._u8_off
+        i32_need = self._i32_off
+        for entries, _b, n_pow, h_pow in chunks:
+            for e in entries:
+                if e[0] == "packed":
+                    (_t, _bt, _f, len_o, slot_o, hrow_o, hbyte_o, hsrc_o,
+                     _n, _h) = e
+                    u8_need = max(u8_need, len_o + 2 * n_pow)
+                    i32_need = max(i32_need, slot_o + n_pow,
+                                   hrow_o + h_pow, hbyte_o + h_pow,
+                                   hsrc_o + h_pow)
+                else:
+                    _t, mask_o, slot_o, chidx_o, chsrc_o, _n, _c = e
+                    u8_need = max(u8_need, mask_o + 2 * n_pow)
+                    i32_need = max(i32_need, slot_o + n_pow,
+                                   chidx_o + h_pow, chsrc_o + h_pow)
+        return (self._step(u8_need, 1 << 16), self._step(i32_need, 1 << 12))
+
+    # -- execution ---------------------------------------------------------
+
+    def flush_window(self) -> None:
+        """Execute everything staged so far (the rebuild pipeline calls
+        this per packed window, so device hashing overlaps the next
+        window's native sweep). The digest buffer stays resident."""
+        self._execute()
+
+    def _execute(self) -> None:
+        plan = self._plan
+        if not plan:
+            if (self._mode != "cpu" and self._buf is None
+                    and self._buf_np is None):
+                self._buf = self._device_put(
+                    np.zeros((self._s_tier, 32), dtype=np.uint8))
+            return
+        k_plan = 1 if self._mode == "perlevel" else self.k
+        chunks = self._chunk_plan(plan, k_plan)
+        u8_len, i32_len = self._chunk_buffer_lens(chunks)
+        u8 = np.zeros((u8_len,), dtype=np.uint8)
+        off = 0
+        for part in self._u8_parts:
+            u8[off:off + part.size] = part
+            off += part.size
+        i32 = np.zeros((i32_len,), dtype=np.int32)
+        off = 0
+        for part in self._i32_parts:
+            i32[off:off + part.size] = part
+            off += part.size
+        self._plan, self._u8_parts, self._i32_parts = [], [], []
+        self._u8_off = self._i32_off = 0
+        # the journal IS the failover: replay is exact because every
+        # staged byte is retained until the terminal fetch
+        self._journal.append((u8, i32, plan))
+        if self._mode == "cpu":
+            self._run_plan_numpy(u8, i32, plan)
+            return
+        if self._buf is None:
+            self._buf = self._device_put(
+                np.zeros((self._s_tier, 32), dtype=np.uint8))
+        mult = self._batch_multiple()
+        route_tier = -(-self._ROW_FLOOR // mult) * mult
+        if (self._mode == "fused" and self.k > 1 and self.warmup is not None
+                and not self.warmup.route_bucket(
+                    "fused.subtrie", self.k, route_tier,
+                    self._mesh_size())):
+            # degraded routing: the k-shape isn't warm — this flush runs
+            # per-level (same staged bytes, k=1 chunks); the engine stays
+            # on "fused" so later flushes promote once the shape warms
+            from ..metrics import fused_metrics
+
+            fused_metrics.record_fallback()
+            chunks = self._chunk_plan(plan, 1)
+        mode = "perlevel" if (self._mode == "perlevel"
+                              or len(chunks) >= len(plan)) else "fused"
+        try:
+            self._run_chunks(u8, i32, chunks, u8_len, i32_len, mode)
+        except BaseException as e:  # noqa: BLE001 — degraded below
+            self._degrade(e)
+
+    def _run_chunks(self, u8: np.ndarray, i32: np.ndarray, chunks: list,
+                    u8_len: int, i32_len: int, mode: str) -> None:
+        u8d = self._device_put(u8)
+        i32d = self._device_put(i32)
+        s_tier = int(self._buf.shape[0])
+        for entries, b_tier, n_pow, h_pow in chunks:
+            steps_pow = _pow2(len(entries), floor=8)
+            params = np.zeros((steps_pow, _PARAM_W), dtype=np.int32)
+            for i, e in enumerate(entries):
+                if e[0] == "packed":
+                    (_t, _bt, flat_off, len_o, slot_o, hrow_o, hbyte_o,
+                     hsrc_o, n_valid, h_valid) = e
+                    params[i] = (0, flat_off, len_o, slot_o, hrow_o,
+                                 hbyte_o, hsrc_o, n_valid, h_valid, 0)
+                else:
+                    _t, mask_o, slot_o, chidx_o, chsrc_o, n_valid, c_valid = e
+                    params[i] = (1, mask_o, slot_o, chidx_o, chsrc_o, 0, 0,
+                                 n_valid, c_valid, 0)
+            if self.injector is not None:
+                self.injector.on_chunk(mode, len(entries))
+            fn = _subtrie_program(b_tier, n_pow, h_pow, steps_pow,
+                                  u8_len, i32_len, s_tier, self._mesh_arg())
+            self._buf = _timed_call(
+                "fused.subtrie",
+                (b_tier, n_pow, h_pow, steps_pow, u8_len, i32_len,
+                 self._mesh_size()),
+                fn, u8d, i32d, self._device_put(params), self._buf,
+                np.int32(len(entries)))
+            self._count_dispatch(len(entries))
+
+    # -- degradation ladder ------------------------------------------------
+
+    def _degrade(self, err: BaseException) -> None:
+        from .. import tracing
+        from ..metrics import fused_metrics
+
+        fused_metrics.record_fallback()
+        if self._mode == "fused":
+            tracing.fault_event("subtrie_fallback",
+                                target="ops::fused_commit",
+                                rung="perlevel",
+                                error=f"{type(err).__name__}: {err}"[:200])
+            self._mode = "perlevel"
+            try:
+                self._replay_journal_device()
+                return
+            except BaseException as e2:  # noqa: BLE001 — final rung below
+                fused_metrics.record_fallback()
+                err = e2
+        tracing.fault_event("subtrie_fallback", target="ops::fused_commit",
+                            rung="cpu",
+                            error=f"{type(err).__name__}: {err}"[:200])
+        self._mode = "cpu"
+        self._buf = None
+        self._buf_np = np.zeros((self._s_tier, 32), dtype=np.uint8)
+        for u8, i32, plan in self._journal:
+            self._run_plan_numpy(u8, i32, plan)
+
+    def _replay_journal_device(self) -> None:
+        """Per-level rung: rebuild the digest buffer by replaying EVERY
+        journaled flush through the same program at k=1 (hashing is
+        deterministic, so the rebuilt buffer is bit-identical)."""
+        self._buf = self._device_put(
+            np.zeros((self._s_tier, 32), dtype=np.uint8))
+        for u8, i32, plan in self._journal:
+            chunks = self._chunk_plan(plan, 1)
+            self._run_chunks(u8, i32, chunks, u8.size, i32.size, "perlevel")
+
+    def _run_plan_numpy(self, u8: np.ndarray, i32: np.ndarray,
+                        plan: list) -> None:
+        """CPU-twin rung: interpret the staged plan with the numpy
+        backend's own level math (bit-identical to the device path)."""
+        from ..trie.turbo import _NumpyBackend
+
+        nb = _NumpyBackend()
+        nb._buf = self._buf_np
+        for e in plan:
+            if e[0] == "packed":
+                (_t, b_tier, flat_off, len_o, slot_o, hrow_o, hbyte_o,
+                 hsrc_o, n_valid, h_valid) = e
+                n = n_valid - 1
+                raw = u8[len_o:len_o + 2 * n].astype(np.uint32)
+                row_len = (raw[0::2] | (raw[1::2] << 8)).astype(np.uint32)
+                row_off = (np.cumsum(row_len) - row_len).astype(np.uint32)
+                slots = i32[slot_o:slot_o + n].astype(np.int64)
+                total = int(row_off[-1] + row_len[-1]) if n else 0
+                flat = u8[flat_off:flat_off + total]
+                h = h_valid - 1
+                holes = None
+                if h:
+                    holes = (i32[hrow_o:hrow_o + h],
+                             i32[hbyte_o:hbyte_o + h],
+                             i32[hsrc_o:hsrc_o + h])
+                nb.dispatch_packed(flat, row_off, row_len, slots, holes,
+                                   b_tier)
+            else:
+                _t, mask_o, slot_o, chidx_o, chsrc_o, n_valid, c_valid = e
+                n = n_valid - 1
+                raw = u8[mask_o:mask_o + 2 * n].astype(np.uint16)
+                masks = (raw[0::2] | (raw[1::2] << 8)).astype(np.uint16)
+                slots = i32[slot_o:slot_o + n].astype(np.int64)
+                c = c_valid - 1
+                crn = i32[chidx_o:chidx_o + c]
+                children = np.stack([crn // 16, crn % 16,
+                                     i32[chsrc_o:chsrc_o + c]])
+                nb.dispatch_branch(masks, slots, children)
+
+    # -- terminal fetches --------------------------------------------------
+
+    def _record_commit(self) -> None:
+        from ..metrics import fused_metrics
+
+        fused_metrics.record_commit(dispatches=self.dispatches,
+                                    levels=self.levels_staged, k=self.k,
+                                    mode=self._mode)
+
+    def finish(self) -> np.ndarray:
+        self._execute()
+        self._record_commit()
+        if self._mode == "cpu":
+            buf, self._buf_np = self._buf_np, None
+            self._journal = []
+            return buf
+        self._journal = []
+        return FusedLevelEngine.finish(self)
+
+    def fetch_slots(self, slots: np.ndarray) -> np.ndarray:
+        self._execute()
+        self._record_commit()
+        if self._mode == "cpu":
+            out = self._buf_np[np.asarray(slots, dtype=np.int64)]
+            self._buf_np = None
+            self._journal = []
+            return out
+        self._journal = []
+        return FusedLevelEngine.fetch_slots(self, slots)
+
+
+class SubtrieMeshEngine(SubtrieFusedEngine):
+    """k-level fused commit over a device mesh: the staged buffers and
+    the resident digest buffer are replicated, and each step's row block
+    carries a batch-axis sharding constraint. The k-level packers keep a
+    subtrie's rows contiguous (``_pack_window`` concatenates per sweep),
+    so row-range shards approximate shard-by-subtrie — and parent
+    composition always reads the REPLICATED digest buffer, so it never
+    crosses a row shard regardless of placement (the all-gather XLA
+    inserts after each step's scatter is the only communication)."""
+
+    def __init__(self, mesh, min_tier: int = 1024, k: int | None = None,
+                 warmup=None, injector=None, row_floor: int | None = None,
+                 hole_floor: int | None = None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        live_snapshot = getattr(mesh, "live_snapshot", None)
+        if live_snapshot is not None:
+            mesh, _ = live_snapshot()
+            if mesh is None:
+                raise RuntimeError("HashMesh has no live devices")
+        mult = mesh.devices.size
+        self.mesh = mesh
+        self._replicated = NamedSharding(mesh, P())
+        super().__init__(min_tier=-(-min_tier // mult) * mult, k=k,
+                         warmup=warmup, injector=injector,
+                         row_floor=row_floor, hole_floor=hole_floor)
+
+    def _device_put(self, arr: np.ndarray):
+        return jax.device_put(arr, self._replicated)
+
+    def _batch_multiple(self) -> int:
+        return self.mesh.devices.size
+
+    def _mesh_arg(self):
+        return self.mesh
+
+    def _mesh_size(self) -> int:
         return self.mesh.devices.size

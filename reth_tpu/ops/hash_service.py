@@ -215,6 +215,8 @@ class HashFuture:
 class _Request:
     __slots__ = ("lane", "msgs", "future", "enqueued_at", "ctx", "wall_at")
 
+    window = None  # plain hash request (multi-level requests override)
+
     def __init__(self, lane: str, msgs: list[bytes]):
         self.lane = lane
         self.msgs = msgs
@@ -225,6 +227,34 @@ class _Request:
         # its submitter's context and gets a per-request span on completion
         self.ctx = tracing.current_context()
         self.wall_at = time.time()
+
+
+class _WindowRequest:
+    """One multi-level request: a pre-packed k-level window (per-depth
+    packed/branch level arrays, the ``dispatch_packed``/``dispatch_branch``
+    wire shape) that the dispatcher runs as ONE whole-subtrie fused
+    dispatch instead of one hash call per depth. Completes with the
+    fetched digest rows (``fetch`` slots, or the whole buffer)."""
+
+    __slots__ = ("lane", "window", "max_slots", "fetch", "rows", "future",
+                 "enqueued_at", "ctx", "wall_at")
+
+    def __init__(self, lane: str, window: list[dict], max_slots: int,
+                 fetch=None):
+        self.lane = lane
+        self.window = window
+        self.max_slots = max_slots
+        self.fetch = fetch
+        self.rows = sum(len(lv["slots"]) for lv in window)
+        self.future = HashFuture()
+        self.enqueued_at = time.monotonic()
+        self.ctx = tracing.current_context()
+        self.wall_at = time.time()
+
+
+def _req_msgs(r) -> int:
+    """Queue-accounting size of one request (messages, or window rows)."""
+    return r.rows if r.window is not None else len(r.msgs)
 
 
 class HashClient:
@@ -245,6 +275,17 @@ class HashClient:
 
     def submit(self, msgs: list[bytes]) -> HashFuture:
         return self.service.submit(self.lane, list(msgs))
+
+    def commit_window(self, window: list[dict], max_slots: int,
+                      fetch=None):
+        """Multi-level request: hand the service a pre-packed k-level
+        window (one dict per level in deepest-first order — the
+        ``dispatch_packed``/``dispatch_branch`` array shape) and get the
+        digest buffer (or the ``fetch`` slots) back from ONE fused
+        dispatch. This is how the live sparse finish and the rebuild
+        lanes collapse their per-depth hash calls."""
+        return self.service.submit_window(self.lane, window,
+                                          max_slots, fetch=fetch).result()
 
     def map_chunks(self, chunks) -> list[bytes]:
         """Live-lane streaming: submit every chunk as its own request —
@@ -324,6 +365,11 @@ class LeasedTurboBackend:
     def dispatch_branch(self, masks, slots, children) -> None:
         self._inner.dispatch_branch(masks, slots, children)
 
+    def flush_window(self) -> None:
+        flush = getattr(self._inner, "flush_window", None)
+        if flush is not None:
+            flush()
+
     def fetch_slots(self, slots):
         try:
             return self._inner.fetch_slots(slots)
@@ -372,8 +418,17 @@ class HashService:
                  injector: ServiceFaultInjector | None = None,
                  mesh=None, breaker_board=None, device_injector=None,
                  rebuild_devices: int | None = None, warmup=None,
-                 registry=None):
+                 subtrie_levels: int | None = None, registry=None):
         env = os.environ
+        # multi-level window requests (submit_window): k levels per fused
+        # dispatch; RETH_TPU_SUBTRIE_LEVELS=0 keeps the default of 8 here
+        # because a window request is an EXPLICIT multi-level ask
+        if subtrie_levels is None:
+            subtrie_levels = int(
+                env.get("RETH_TPU_SUBTRIE_LEVELS", "0") or 8)
+        self.subtrie_levels = max(1, int(subtrie_levels))
+        self.warmup = warmup
+        self.window_dispatches = 0
         self.supervisor = supervisor
         if backend is None:
             if supervisor is not None:
@@ -533,6 +588,54 @@ class HashService:
         """Synchronous submit-and-wait — the ``hasher``-protocol path."""
         return self.submit(lane, msgs).result()
 
+    def submit_window(self, lane: str, window: list[dict], max_slots: int,
+                      *, fetch=None, block: bool = True,
+                      timeout: float | None = None) -> HashFuture:
+        """Enqueue one multi-level window request on ``lane``: a list of
+        level dicts in deepest-first order (``{"flat", "row_off",
+        "row_len", "slots", "holes", "b_tier"}`` or ``{"kind": "branch",
+        "masks", "slots", "children"}``). The dispatcher runs the whole
+        window through a whole-subtrie fused engine — ONE device dispatch
+        per k levels — and completes the future with the digest buffer
+        (or the requested ``fetch`` slots). Windows never coalesce with
+        plain hash requests; they occupy ``rows`` messages of the lane's
+        bounded capacity."""
+        if lane not in _LANE_INDEX:
+            raise ValueError(f"unknown lane {lane!r} (have {LANES})")
+        req = _WindowRequest(lane, window, max_slots, fetch=fetch)
+        if not window:
+            req.future._complete(result=[])
+            return req.future
+        n = req.rows
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._stopping:
+                    raise ServiceStopped("hash service is stopping")
+                room = self.lane_capacity - self._queued_msgs[lane]
+                if n <= room or not self._queues[lane]:
+                    break
+                if not block:
+                    self.rejects += 1
+                    self.metrics.record_reject(lane)
+                    raise LaneOverloaded(
+                        f"lane {lane!r} is full "
+                        f"({self._queued_msgs[lane]}/{self.lane_capacity} msgs)")
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    self.rejects += 1
+                    self.metrics.record_reject(lane)
+                    raise LaneOverloaded(
+                        f"lane {lane!r} still full after {timeout}s")
+                self._cond.wait(remaining)
+            self._queues[lane].append(req)
+            self._queued_msgs[lane] += n
+            self.metrics.record_submit(lane, n)
+            self.metrics.set_queue_depth(lane, self._queued_msgs[lane])
+            self._cond.notify_all()
+        return req.future
+
     # -- exclusive lease ----------------------------------------------------
 
     @contextmanager
@@ -631,16 +734,23 @@ class HashService:
                         if id(r) not in aged_ids]
         batch: list[_Request] = []
         total = 0
-        for r in order:
-            if batch and total + len(r.msgs) > self.max_batch:
-                break
-            batch.append(r)
-            total += len(r.msgs)
+        if order and order[0].window is not None:
+            # multi-level windows dispatch ALONE (one fused engine run,
+            # never concatenated with plain hash messages)
+            batch = [order[0]]
+        else:
+            for r in order:
+                if r.window is not None:
+                    continue  # next round leads with it
+                if batch and total + len(r.msgs) > self.max_batch:
+                    break
+                batch.append(r)
+                total += len(r.msgs)
         taken = {id(r) for r in batch}
         for lane in LANES:
             kept = [r for r in self._queues[lane] if id(r) not in taken]
             if len(kept) != len(self._queues[lane]):
-                removed = sum(len(r.msgs) for r in self._queues[lane]
+                removed = sum(_req_msgs(r) for r in self._queues[lane]
                               if id(r) in taken)
                 self._queues[lane] = kept
                 self._queued_msgs[lane] -= removed
@@ -760,12 +870,121 @@ class HashService:
                 self.mesh.metrics.record_single()
             return out
 
+    def _window_engine(self, lane: str, rows: int):
+        """Whole-subtrie engine for ONE multi-level window dispatch. With
+        a mesh, the partition-rule table routes ``fused.subtrie`` like
+        any other program — sharded over the live mesh when every device
+        gets a real row shard, a 1-device mesh otherwise; shard-by-
+        subtrie holds because the packers keep each subtrie's rows
+        contiguous and parent composition reads the replicated buffer."""
+        from .fused_commit import SubtrieFusedEngine, SubtrieMeshEngine
+
+        floors = dict(row_floor=max(64, 2 * self.min_tier),
+                      hole_floor=max(64, 2 * self.min_tier))
+        if self.mesh is not None:
+            from ..parallel.mesh import MeshExhausted
+
+            if self.breaker_board is not None:
+                self.breaker_board.poll()
+            _spec, mesh = self.mesh.spec_for(lane, "fused.subtrie", rows)
+            if mesh is None:
+                raise MeshExhausted(
+                    "no live mesh device (all breakers open or leased)")
+            return SubtrieMeshEngine(mesh, min_tier=self.min_tier,
+                                     k=self.subtrie_levels,
+                                     warmup=self.warmup, **floors)
+        return SubtrieFusedEngine(min_tier=self.min_tier,
+                                  k=self.subtrie_levels,
+                                  warmup=self.warmup, **floors)
+
+    @staticmethod
+    def _run_window_on(engine, req: _WindowRequest):
+        engine.begin(req.max_slots)
+        for lv in req.window:
+            if lv.get("kind") == "branch":
+                engine.dispatch_branch(lv["masks"], lv["slots"],
+                                       lv["children"])
+            else:
+                engine.dispatch_packed(lv["flat"], lv["row_off"],
+                                       lv["row_len"], lv["slots"],
+                                       lv.get("holes"), lv["b_tier"])
+        if req.fetch is not None:
+            import numpy as _np
+
+            return engine.fetch_slots(_np.asarray(req.fetch,
+                                                  dtype=_np.int64))
+        return engine.finish()
+
+    def _dispatch_window(self, req: _WindowRequest, bypass: bool) -> None:
+        """Run one multi-level window as a whole-subtrie fused dispatch.
+        Bypass (exclusive lease held) and any device failure land on the
+        numpy twin — level replay is exact, the future completes once."""
+        t0 = time.monotonic()
+        self.metrics.record_wait(req.lane, t0 - req.enqueued_at)
+        replayed = False
+        replay_err = None
+        digests = None
+        if not bypass:
+            try:
+                if self.injector is not None:
+                    self.injector.on_dispatch()
+                digests = self._run_window_on(
+                    self._window_engine(req.lane, req.rows), req)
+            except BaseException as e:  # noqa: BLE001 — replayed below
+                replayed = True
+                replay_err = type(e).__name__
+                self.replays += 1
+                self.metrics.record_replay()
+        else:
+            self.lease_bypasses += 1
+            self.metrics.record_lease_bypass()
+        if digests is None:
+            from ..trie.turbo import _NumpyBackend
+
+            try:
+                digests = self._run_window_on(_NumpyBackend(), req)
+            except BaseException as e:  # pragma: no cover - twin failure
+                req.future._complete(error=e)
+                raise
+        service_s = time.monotonic() - t0
+        req.future._complete(result=digests)
+        if replayed:
+            tracing.event("ops::hash_service", "window_replay",
+                          levels=len(req.window), rows=req.rows,
+                          error=replay_err)
+        self.dispatches += 1
+        self.window_dispatches += 1
+        self.coalesced_requests += 1
+        self.hashed_msgs += req.rows
+        now_wall = time.time()
+        if req.ctx is not None:
+            tracing.record_span(
+                "ops::hash_service", "hashsvc.window",
+                req.wall_at, now_wall - req.wall_at, ctx=req.ctx,
+                fields={"lane": req.lane, "levels": len(req.window),
+                        "rows": req.rows,
+                        "service_ms": round(service_s * 1e3, 3),
+                        "replayed": replayed, "bypass": bypass})
+        tracing.record_span(
+            "ops::hash_service",
+            "hashsvc.replay" if replayed
+            else ("hashsvc.bypass" if bypass else "hashsvc.dispatch"),
+            now_wall - service_s, service_s,
+            fields={"requests": 1, "msgs": req.rows,
+                    "levels": len(req.window)})
+        self.metrics.record_dispatch(
+            requests=1, msgs=req.rows, occupancy=1.0,
+            service_s=service_s, replayed=replayed)
+
     def _dispatch(self, batch: list[_Request], bypass: bool) -> None:
         """ONE backend call for the whole coalesced batch; scatter digests
         back through the futures. Any backend failure (watchdog trip that
         escaped the supervisor, injected service wedge, ...) replays the
         ENTIRE batch on the numpy twin — hashing is stateless, so replay
         is exact and every future completes exactly once."""
+        if len(batch) == 1 and batch[0].window is not None:
+            self._dispatch_window(batch[0], bypass)
+            return
         msgs: list[bytes] = []
         for r in batch:
             msgs.extend(r.msgs)
@@ -870,6 +1089,7 @@ class HashService:
             "queued": queued,
             "queued_total": sum(queued.values()),
             "dispatches": self.dispatches,
+            "window_dispatches": self.window_dispatches,
             "coalesce_factor": round(self.coalesce_factor(), 2),
             "hashed_msgs": self.hashed_msgs,
             "replays": self.replays,

@@ -792,6 +792,16 @@ class SupervisedBackend:
     def dispatch_branch(self, masks, slots, children):
         self._call("dispatch_branch", masks, slots, children)
 
+    def flush_window(self):
+        """Window-boundary hook: a whole-subtrie engine executes its
+        staged k-level chunks here (guarded + journaled like any device
+        call — a wedge mid-window replays the journal on the CPU twin);
+        per-level engines don't expose it and defer to finish."""
+        if self._device is not None and not hasattr(self._device,
+                                                    "flush_window"):
+            return
+        self._call("flush_window")
+
     def fetch_slots(self, slots):
         return self._call("fetch_slots", slots)
 

@@ -63,6 +63,12 @@ DEFAULT_MIN_TIER = 1024
 DEFAULT_BLOCK_TIER = 4
 DEFAULT_MAX_BATCH_TIER = 16384
 DEFAULT_MAX_BLOCK_TIER = 32
+# whole-subtrie k-level programs: the row tier the engines route against
+# (mirrors ops/fused_commit.MegaFusedEngine._ROW_FLOOR — kept literal here
+# so importing the menu never pulls jax in)
+DEFAULT_SUBTRIE_TIER = 2048
+# default k ladder declared for the k-level programs (--subtrie-levels)
+DEFAULT_SUBTRIE_KS: tuple[int, ...] = (8,)
 
 
 @dataclass(frozen=True)
@@ -98,7 +104,8 @@ def default_menu(min_tier: int = DEFAULT_MIN_TIER,
                  max_batch_tier: int = DEFAULT_MAX_BATCH_TIER,
                  max_block_tier: int = DEFAULT_MAX_BLOCK_TIER,
                  include_fused: bool = True,
-                 mesh_sizes: tuple[int, ...] = ()) -> list[MenuShape]:
+                 mesh_sizes: tuple[int, ...] = (),
+                 subtrie_ks: tuple[int, ...] = DEFAULT_SUBTRIE_KS) -> list[MenuShape]:
     """The grid the runtime actually dispatches (see ``TrieCommitter``:
     ``KeccakDevice(min_tier=1024, block_tier=4)``): one masked program per
     pow2 batch tier for trie-node-sized messages (<= ``block_tier`` rate
@@ -124,6 +131,14 @@ def default_menu(min_tier: int = DEFAULT_MIN_TIER,
     if include_fused:
         shapes.append(MenuShape("fused.plain", block_tier, min_tier))
         shapes.append(MenuShape("fused.splice", block_tier, min_tier))
+        # whole-subtrie k-level programs (fused.subtrie): block_tier slot
+        # carries k — the levels-per-dispatch the engine was built with;
+        # an un-warm (k, tier, mesh) shape routes the commit to the
+        # per-level path instead of compiling mid-commit
+        for k in subtrie_ks:
+            if k > 1:
+                shapes.append(
+                    MenuShape("fused.subtrie", k, DEFAULT_SUBTRIE_TIER))
     for m in mesh_sizes:
         if m <= 1:
             continue
@@ -135,6 +150,11 @@ def default_menu(min_tier: int = DEFAULT_MIN_TIER,
         if include_fused:
             shapes.append(MenuShape("fused.plain", block_tier, floor, m))
             shapes.append(MenuShape("fused.splice", block_tier, floor, m))
+            for k in subtrie_ks:
+                if k > 1:
+                    # device-count-multiple rounding, like every mesh tier
+                    sub_t = -(-DEFAULT_SUBTRIE_TIER // m) * m
+                    shapes.append(MenuShape("fused.subtrie", k, sub_t, m))
     return shapes
 
 
@@ -217,6 +237,35 @@ def _build_shape(shape: MenuShape) -> None:
             fn = _jitted("splice", b, sharding_key)
             np.asarray(fn(templates, counts, zeros_h, zeros_h, zeros_h,
                           slots, buf))
+        return
+    if shape.program == "fused.subtrie":
+        # k-level program: stage one packed + one branch level through the
+        # REAL engine (so chunk planning mints the exact (b_tier=4,
+        # row-floor, hole-floor) key the runtime's first chunk hits) and
+        # execute — the loop body compiles BOTH step kinds via its cond
+        from .fused_commit import SubtrieFusedEngine, SubtrieMeshEngine
+
+        k = shape.block_tier
+        if shape.mesh_size > 1:
+            mesh, _batch_sh, _rep_sh = _mesh_for_shape(shape.mesh_size)
+            eng = SubtrieMeshEngine(mesh, min_tier=64, k=k,
+                                    row_floor=shape.batch_tier,
+                                    hole_floor=shape.batch_tier)
+        else:
+            eng = SubtrieFusedEngine(min_tier=64, k=k,
+                                     row_floor=shape.batch_tier,
+                                     hole_floor=shape.batch_tier)
+        eng.begin(4)
+        s1, s2 = eng.alloc_slot(), eng.alloc_slot()
+        row = b"\x01" * 40
+        eng.dispatch_packed(np.frombuffer(row, dtype=np.uint8),
+                            np.zeros((1,), dtype=np.uint32),
+                            np.array([len(row)], dtype=np.uint32),
+                            np.array([s1], dtype=np.int32), None, 4)
+        eng.dispatch_branch(np.array([0x0001], dtype=np.uint16),
+                            np.array([s2], dtype=np.int32),
+                            np.array([[0], [0], [s1]], dtype=np.int32))
+        np.asarray(eng.finish())
         return
     raise ValueError(f"unknown menu program {shape.program!r}")
 

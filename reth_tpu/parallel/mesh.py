@@ -60,6 +60,11 @@ DEFAULT_PARTITION_RULES: list[tuple[str, int | None]] = [
     (r"^(rebuild|live|payload)/fused\.", 1),
     # explicit scalar programs (single-key probes): never pay the scatter
     (r"/scalar$", None),
+    # whole-subtrie k-level windows (any lane): rows are packed subtrie-
+    # contiguous, so row-range shards ≈ subtrie shards — shard as soon as
+    # every device gets a real row shard; parent composition reads the
+    # replicated digest buffer and never crosses devices
+    (r"/fused\.subtrie$", 1),
     # coalesced keccak batches: shard once every device gets a real shard
     (r"/keccak\.", 4),
     # default: conservative — small batches stay on one device

@@ -33,7 +33,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from .. import tracing
-from ..primitives.keccak import keccak256, keccak256_batch_np
+from ..primitives.keccak import RATE, keccak256, keccak256_batch_np
+from ..primitives.rlp import rlp_encode as _rlp_encode
 from ..primitives.nibbles import (
     Nibbles,
     common_prefix_len,
@@ -438,6 +439,58 @@ def _child_ref_of(child) -> bytes:
     return child._ref
 
 
+def _child_ref_template(child, slot_of: dict[int, int]) -> tuple[bytes, int]:
+    """Child reference as template bytes + digest source slot (0 = no
+    hole): clean/blinded/inline children contribute literal host-known
+    bytes, dirty hashed children a 33-byte placeholder whose digest the
+    device splices from the resident buffer. Dirty-inline children were
+    finalized when their own (deeper) level was walked, so their
+    ``_ref`` already holds complete hole-free bytes — the same invariant
+    as ``TrieCommitter._child_ref_template``."""
+    from .node import HASH_REF_HOLE
+
+    if isinstance(child, _Blind):
+        return encode_hash_ref(child.hash), 0
+    if child._ref is not None:
+        return child._ref, 0
+    return HASH_REF_HOLE, slot_of[id(child)]
+
+
+def _node_template_sparse(node, slot_of: dict[int, int]):
+    """(RLP template with zero-filled holes, [(byte_off, src_slot)]) for
+    one dirty sparse node — built with the SAME RLP builders the serial
+    encode uses (``HASH_REF_HOLE`` is a well-formed 33-byte ref), so the
+    spliced bytes are identical to ``_encode_rlp``'s output."""
+    if isinstance(node, _Leaf):
+        return leaf_node_rlp(node.path, node.value), []
+    if isinstance(node, _Ext):
+        ref, src = _child_ref_template(node.child, slot_of)
+        rlp = extension_node_rlp(node.path, ref)
+        # the child ref is the payload's tail; +1 skips its 0xa0 marker
+        return rlp, ([(len(rlp) - 32, src)] if src else [])
+    assert isinstance(node, _Branch)
+    refs: list[bytes] = []
+    srcs: list[int] = []
+    for c in node.children:
+        if c is None:
+            refs.append(EMPTY_STRING_RLP)
+            srcs.append(0)
+        else:
+            r, s = _child_ref_template(c, slot_of)
+            refs.append(r)
+            srcs.append(s)
+    rlp = branch_node_rlp(refs, node.value)
+    # refs sit back-to-back after the list header; the value is the tail
+    val_len = len(_rlp_encode(node.value))
+    off = len(rlp) - val_len - sum(len(r) for r in refs)
+    holes: list[tuple[int, int]] = []
+    for r, s in zip(refs, srcs):
+        if s:
+            holes.append((off + 1, s))
+        off += len(r)
+    return rlp, holes
+
+
 # -- parallel cross-trie commit ----------------------------------------------
 
 
@@ -561,13 +614,27 @@ class ParallelSparseCommitter:
     POOL_MIN_NODES = 128   # below this a level encodes serially
     MIN_CHUNK = 32
 
+    # whole-subtrie packing floors (k-level engine program tiers) — class
+    # attrs so tests can shrink them for fast CPU compiles
+    SUBTRIE_ROW_FLOOR = 512
+    SUBTRIE_HOLE_FLOOR = 512
+
     def __init__(self, workers: int | None = None, split_depth: int | None = None,
-                 injector: SparseFaultInjector | None = None):
+                 injector: SparseFaultInjector | None = None,
+                 subtrie_levels: int | None = None):
         env = os.environ
         self.workers = sparse_worker_count(workers)
         self.split_depth = int(
             split_depth if split_depth is not None
             else env.get("RETH_TPU_SPARSE_SPLIT_DEPTH", "2"))
+        # whole-subtrie fused finish (--subtrie-levels): k > 1 packs the
+        # global per-depth schedule into hole-spliced level templates and
+        # commits the WHOLE dirty set in one multi-level dispatch per k
+        # levels (ops/fused_commit.SubtrieFusedEngine, or the hash
+        # service's window lane when the hasher is a HashClient)
+        self.subtrie_levels = int(
+            subtrie_levels if subtrie_levels is not None
+            else env.get("RETH_TPU_SUBTRIE_LEVELS", "0") or 0)
         self.injector = (injector if injector is not None
                          else SparseFaultInjector.from_env())
         self._pool: ThreadPoolExecutor | None = None
@@ -671,6 +738,11 @@ class ParallelSparseCommitter:
             self.last = {**stats, "wall_s": 0.0}
             return roots
 
+        if self.subtrie_levels > 1:
+            fused = self._commit_fused(live, roots, hasher, stats, t_wall)
+            if fused is not None:
+                return fused
+
         levels = self._collect([t for _, t in live])
         use_streaming = hasattr(hasher, "submit")
         encode_wall = [0.0]  # summed per-chunk encode time (pool-side)
@@ -755,6 +827,125 @@ class ParallelSparseCommitter:
                                 ctx=tracing.current_context(),
                                 fields={"chunks": stats["encode_chunks"]})
         stats["wall_s"] = round(time.perf_counter() - t_wall, 6)
+        self.last = stats
+        sparse_commit_metrics.record_commit(stats)
+        return roots
+
+    # -- whole-subtrie fused finish (k levels per device dispatch) ----------
+
+    def _commit_fused(self, live, roots, hasher, stats, t_wall):
+        """Pack the global per-depth schedule into hole-spliced level
+        templates — the inline-vs-hashed split needs only RLP *lengths*,
+        never digest values (the fused-committer invariant) — and commit
+        the whole dirty set through a whole-subtrie engine: ONE device
+        dispatch per ``subtrie_levels`` depths instead of one hash call
+        per depth. With a service-bound ``HashClient`` the window rides
+        the live lane (``commit_window``); otherwise a local
+        ``SubtrieFusedEngine`` runs it. Roots are bit-identical to the
+        serial path: templates come from the SAME RLP builders, with
+        zero-filled holes where the device splices child digests.
+        Returns None when the engine stack is unavailable (no jax) — the
+        caller falls through to the classic per-depth path."""
+        import numpy as np
+
+        from ..metrics import sparse_commit_metrics
+
+        commit_window = getattr(hasher, "commit_window", None)
+        eng = None
+        if commit_window is None:
+            try:
+                from ..ops.fused_commit import SubtrieFusedEngine
+
+                eng = SubtrieFusedEngine(
+                    min_tier=64, k=self.subtrie_levels,
+                    row_floor=self.SUBTRIE_ROW_FLOOR,
+                    hole_floor=self.SUBTRIE_HOLE_FLOOR)
+            except Exception:  # noqa: BLE001 — no device stack: classic path
+                return None
+
+        levels = self._collect([t for _, t in live])
+        slot_of: dict[int, int] = {}
+        next_slot = [1]  # slot 0 = dummy (engine convention)
+        schedule: list[tuple[list, list, list]] = []
+        for depth in sorted(levels, reverse=True):
+            if self.injector is not None:
+                self.injector.on_dispatch()
+            stats["levels"] += 1
+            lv_nodes, lv_templates, lv_holes = [], [], []
+            for _g, node in levels[depth]:
+                t, holes = _node_template_sparse(node, slot_of)
+                if len(t) < 32:
+                    node._ref = t  # inline: complete and hole-free
+                    continue
+                slot = next_slot[0]
+                next_slot[0] += 1
+                slot_of[id(node)] = slot
+                lv_nodes.append(node)
+                lv_templates.append(t)
+                lv_holes.append(holes)
+            if lv_nodes:
+                schedule.append((lv_nodes, lv_templates, lv_holes))
+
+        window: list[dict] = []
+        for _nodes, templates, holess in schedule:
+            row_len = np.array([len(t) for t in templates], dtype=np.uint32)
+            row_off = (np.cumsum(row_len) - row_len).astype(np.uint32)
+            flat = np.frombuffer(b"".join(templates), dtype=np.uint8)
+            slots = np.array([slot_of[id(n)] for n in _nodes],
+                             dtype=np.int32)
+            hr: list[int] = []
+            hb: list[int] = []
+            hs: list[int] = []
+            for i, hl in enumerate(holess):
+                for off, src in hl:
+                    hr.append(i)
+                    hb.append(off)
+                    hs.append(src)
+            holes = (np.array([hr, hb, hs], dtype=np.int32) if hr else None)
+            bt = 1
+            maxlen = int(row_len.max())
+            while bt * RATE <= maxlen:
+                bt *= 2
+            window.append({"flat": flat, "row_off": row_off,
+                           "row_len": row_len, "slots": slots,
+                           "holes": holes, "b_tier": bt})
+
+        buf = None
+        if window:
+            max_slots = next_slot[0] - 1
+            if commit_window is not None:
+                # live-lane window request: the service runs it as one
+                # fused dispatch per k levels (numpy replay on failure)
+                buf = commit_window(window, max_slots)
+                stats["streamed"] += len(window)
+                stats["dispatches"] += max(
+                    1, -(-len(window) // self.subtrie_levels))
+            else:
+                eng.begin(max_slots)
+                for w in window:
+                    eng.dispatch_packed(w["flat"], w["row_off"],
+                                        w["row_len"], w["slots"],
+                                        w["holes"], w["b_tier"])
+                buf = eng.finish()
+                stats["dispatches"] += eng.dispatches
+            for _nodes, _templates, _holess in schedule:
+                for node in _nodes:
+                    node._ref = encode_hash_ref(
+                        bytes(buf[slot_of[id(node)]]))
+                    stats["hashed"] += 1
+
+        for i, t in live:
+            root_slot = slot_of.get(id(t.root))
+            if root_slot is not None:
+                t.root_hash = bytes(buf[root_slot])
+            else:
+                # inline or clean root: the root hash is keccak of the
+                # full root RLP whatever its size (serial-path rule)
+                t.root_hash = keccak256(_encode_rlp(t.root))
+            t.updates = 0
+            roots[i] = t.root_hash
+        stats["wall_s"] = round(time.perf_counter() - t_wall, 6)
+        stats["subtrie_k"] = self.subtrie_levels
         self.last = stats
         sparse_commit_metrics.record_commit(stats)
         return roots

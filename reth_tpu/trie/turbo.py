@@ -329,6 +329,10 @@ class _NumpyBackend:
         buf, self._buf = self._buf, None
         return buf
 
+    def flush_window(self) -> None:
+        """Window-boundary hook (whole-subtrie engines execute their
+        staged chunk here); the CPU twin hashes eagerly, so no-op."""
+
 
 def _marshal_and_build(lib, jobs, collect_branches: bool, start_depth: int):
     """Sort each job's keys, flatten values, and run the native structure
@@ -666,6 +670,13 @@ class RebuildPipeline:
                     backend.dispatch_packed(m.flat, m.row_off, m.row_len,
                                             m.row_slot, m.holes, m.b_tier)
                     backend.dispatch_branch(m.masks, m.bmp_slot, m.children)
+                # k-level window boundary: a whole-subtrie engine STAGES
+                # the per-depth calls above and executes the window here
+                # as O(levels/k) fused dispatches — so device hashing of
+                # this window still overlaps the next window's sweep
+                flush = getattr(backend, "flush_window", None)
+                if flush is not None:
+                    flush()
                 dt = time.perf_counter() - t1
                 stages["dispatch"] += dt
                 # window dispatch may run on the hash pool: attribute it to
@@ -805,7 +816,8 @@ class TurboCommitter:
     hash service's mesh when not given explicitly."""
 
     def __init__(self, backend: str = "device", min_tier: int = 1024, mesh=None,
-                 supervisor=None, hash_service=None):
+                 supervisor=None, hash_service=None,
+                 subtrie_levels: int | None = None):
         self.backend_kind = backend
         self.min_tier = min_tier
         if mesh is None and hash_service is not None:
@@ -813,24 +825,46 @@ class TurboCommitter:
         self.mesh = mesh
         self.supervisor = supervisor
         self.hash_service = hash_service
+        # whole-subtrie fused kernels (--subtrie-levels / [node]
+        # subtrie_levels / RETH_TPU_SUBTRIE_LEVELS): k > 1 collapses the
+        # per-depth dispatch loop into ONE device dispatch per k levels;
+        # 0/1 keeps the per-level engines
+        if subtrie_levels is None:
+            subtrie_levels = int(
+                os.environ.get("RETH_TPU_SUBTRIE_LEVELS", "0") or 0)
+        self.subtrie_levels = max(0, int(subtrie_levels))
         self.arena = DigestArena()  # resident across this committer's commits
         self._lib = load_library()
 
     def _device_engine(self):
-        from ..ops.fused_commit import MegaFusedEngine, FusedMeshEngine
+        from ..ops.fused_commit import (
+            FusedMeshEngine,
+            MegaFusedEngine,
+            SubtrieFusedEngine,
+            SubtrieMeshEngine,
+        )
 
+        k = self.subtrie_levels
+        warmup = getattr(self.supervisor, "warmup", None)
         svc = self.hash_service
+        sub = None
         if svc is not None and getattr(svc, "rebuild_mesh", None) is not None:
             sub = svc.rebuild_mesh()
-            if sub is not None:
-                # sub-mesh lease held: this commit's shardings form over
-                # the k devices the lease carved out; live lanes keep the
-                # rest of the mesh
-                return FusedMeshEngine(sub, min_tier=self.min_tier)
-        if self.mesh is not None:
-            return FusedMeshEngine(self.mesh, min_tier=self.min_tier)
-        # single-chip: whole-commit staging — one H2D, one program, one D2H
-        # (the axon tunnel charges ~40-70 ms latency PER transfer)
+        mesh = sub if sub is not None else self.mesh
+        if mesh is not None:
+            # sub-mesh lease held (sub): this commit's shardings form over
+            # the k devices the lease carved out; live lanes keep the rest
+            if k > 1:
+                return SubtrieMeshEngine(mesh, min_tier=self.min_tier, k=k,
+                                         warmup=warmup)
+            return FusedMeshEngine(mesh, min_tier=self.min_tier)
+        if k > 1:
+            # whole-subtrie kernels: staging like the mega engine, but the
+            # depth loop runs INSIDE the jit — one dispatch per k levels
+            return SubtrieFusedEngine(min_tier=self.min_tier, k=k,
+                                      warmup=warmup)
+        # single-chip: whole-commit staging — one H2D, one program PER
+        # LEVEL, one D2H (the axon tunnel charges ~40-70 ms per transfer)
         return MegaFusedEngine(min_tier=self.min_tier)
 
     def _make_backend(self):
