@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-subtrie test-chaos test-reorg test-fleet test-fleet-obs native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-subtrie test-chaos test-reorg test-fleet test-fleet-obs test-ha native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -151,7 +151,22 @@ test-reorg:
 test-chaos:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_wal_recovery.py tests/test_chaos.py \
-	  tests/test_fleet.py tests/test_fleet_obs.py -q -p no:cacheprovider
+	  tests/test_fleet.py tests/test_fleet_obs.py tests/test_ha.py \
+	  -q -p no:cacheprovider
+
+# leader/standby high availability: promotion state machine + heartbeat
+# monitor units, wire-framing corruption vetting (torn/CRC/stale-epoch/
+# out-of-order-generation rejected exactly like on-disk replay),
+# flapping-feed client backoff + resubscribe-from-last-seen-head, the
+# fleet_promote/fleet_standbyStatus ENGINE admission pinning, live
+# leader->standby WAL shipping + in-process promotion, plus the @slow
+# multi-process drills: the SIGKILL-the-leader chaos domain (10 seeds,
+# `python -m reth_tpu.chaos campaign --domain ha`), the no-fence
+# negative drill proving the suite can fail, and the
+# RETH_TPU_BENCH_MODE=ha end-to-end capture — CPU-only
+test-ha:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_ha.py -q -p no:cacheprovider
 
 # stateless read-replica fleet: consistent-hash ring units (stability,
 # failover order), witness-feed CRC framing, router draining ladder

@@ -1270,6 +1270,212 @@ def run_fleet_mode() -> None:
           exit_code=0)
 
 
+def run_ha_mode() -> None:
+    """RETH_TPU_BENCH_MODE=ha: leader-kill failover wall through the HA
+    pair (fleet/standby.py). A leader subprocess (fleet+WAL dev node,
+    mining continuously) ships its durable stream to a hot-standby
+    subprocess; two replica subprocesses serve reads with the standby's
+    takeover feed as their failover endpoint. A continuous read load
+    runs against the replicas while the leader is SIGKILLed mid-stream;
+    the headline is ``promote_ms`` (the standby's catching-up → leading
+    wall) with ``failover_wall_s`` (kill → promoted gateway serving)
+    and ``reads_failed`` (read-load failures across the whole failover
+    window — the HA promise is zero). Env:
+    RETH_TPU_BENCH_HA_HEARTBEAT (detection timeout, default 1.0s),
+    RETH_TPU_BENCH_HA_BLOCKS (blocks mined before the kill, default 6)."""
+    import shutil
+    import signal as signal_mod
+    import socket as socket_mod
+    import subprocess
+    import tempfile
+    import urllib.request
+    from pathlib import Path
+
+    from reth_tpu.chaos import _child_env, _read_record
+
+    heartbeat = float(os.environ.get("RETH_TPU_BENCH_HA_HEARTBEAT", "1.0"))
+    pre_blocks = int(os.environ.get("RETH_TPU_BENCH_HA_BLOCKS", "6"))
+    _STATE["metric"] = "ha_promote_ms"
+    _STATE["unit"] = "ms"
+    _STATE["backend"] = "cpu"
+    _STATE["phase"] = "ha pair spawn"
+    base = Path(tempfile.mkdtemp(prefix="reth-tpu-bench-ha-"))
+    procs: list = []
+
+    def rpc(port, method, params=None, timeout=10.0):
+        body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                           "params": params or []}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/", data=body,
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+    def spawn(cmd, env, log_name):
+        log = open(base / log_name, "w")
+        p = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+        procs.append(p)
+        return p
+
+    def wait_port_file(pf, what, deadline_s=90):
+        deadline = time.time() + deadline_s
+        while not pf.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        if not pf.exists():
+            _emit(0, 0, error=f"{what} never bound its port", exit_code=1)
+        return json.loads(pf.read_text())
+
+    try:
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            tport = s.getsockname()[1]
+        leader_dir = base / "leader"
+        lpf = base / "leader.port"
+        leader = spawn(
+            [sys.executable, "-m", "reth_tpu.chaos", "ha-leader",
+             "--datadir", str(leader_dir), "--seed", "1",
+             "--port-file", str(lpf)],
+            _child_env(), "leader.log")
+        lports = wait_port_file(lpf, "leader")
+        lhttp, lfeed = lports["http_port"], lports["feed_port"]
+
+        spf = base / "standby.port"
+        spawn(
+            [sys.executable, "-m", "reth_tpu.fleet", "standby",
+             "--feed", f"127.0.0.1:{lfeed}",
+             "--datadir", str(base / "standby"),
+             "--takeover-feed-port", str(tport),
+             "--heartbeat-timeout", str(heartbeat),
+             "--id", "bench-sb", "--port-file", str(spf)],
+            _child_env(), "standby.log")
+        shttp = wait_port_file(spf, "standby")["http_port"]
+
+        rports = []
+        for i in range(2):
+            rpf = base / f"replica-{i}.port"
+            spawn(
+                [sys.executable, "-m", "reth_tpu.fleet", "replica",
+                 "--feed", f"127.0.0.1:{lfeed}",
+                 "--failover-feed", f"127.0.0.1:{tport}",
+                 "--auto-register",
+                 "--register", f"http://127.0.0.1:{lhttp}",
+                 "--id", f"bench-r{i}", "--port-file", str(rpf)],
+                _child_env(), f"replica-{i}.log")
+            rports.append(wait_port_file(rpf, f"replica {i}")["http_port"])
+
+        # gate: a recorded chain + a caught-up standby + serving replicas
+        _STATE["phase"] = "ha pair sync"
+        deadline = time.time() + 120
+        status: dict = {}
+        while time.time() < deadline:
+            mined = [l for l in _read_record(leader_dir) if "hash" in l]
+            try:
+                status = rpc(shttp, "fleet_standbyStatus")["result"]
+            except Exception:  # noqa: BLE001 — standby still booting
+                status = {}
+            if (len(mined) >= pre_blocks
+                    and status.get("records_applied", 0) > 0
+                    and not status.get("awaiting_resync", True)
+                    and status.get("lag_heads", 99) <= 2):
+                break
+            time.sleep(0.1)
+        else:
+            _emit(0, 0, error=f"standby never caught up: "
+                              f"{json.dumps(status)[:300]}", exit_code=1)
+
+        # continuous read load against the replicas across the failover
+        _STATE["phase"] = "leader kill + failover"
+        stop = threading.Event()
+        reads = {"total": 0, "failed": 0}
+        rlock = threading.Lock()
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                port = rports[i % len(rports)]
+                i += 1
+                try:
+                    resp = rpc(port, "eth_getBlockByNumber",
+                               ["latest", False], timeout=5)
+                    bad = "error" in resp
+                except Exception:  # noqa: BLE001 — transport loss counts
+                    bad = True
+                with rlock:
+                    reads["total"] += 1
+                    reads["failed"] += 1 if bad else 0
+                time.sleep(0.01)
+
+        loaders = [threading.Thread(target=load, daemon=True)
+                   for _ in range(2)]
+        for t in loaders:
+            t.start()
+        time.sleep(0.5)
+        os.kill(leader.pid, signal_mod.SIGKILL)
+        leader.wait()
+        killed_at = time.time()
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                status = rpc(shttp, "fleet_standbyStatus")["result"]
+            except Exception:  # noqa: BLE001 — admin RPC mid-promotion
+                status = {}
+            if status.get("state") in ("leading", "failed"):
+                break
+            time.sleep(0.05)
+        if status.get("state") != "leading":
+            _emit(0, 0, error=f"standby never promoted: "
+                              f"{json.dumps(status, default=str)[:300]}",
+                  exit_code=1)
+        pnode = status["node"]
+        failover_wall_s = time.time() - killed_at
+
+        # the promoted gateway serves, and the replicas re-anchor on it
+        _STATE["phase"] = "post-promotion re-anchor"
+        promoted_reads_failed = 0
+        for i in range(8):
+            try:
+                resp = rpc(pnode["http_port"], "eth_blockNumber")
+                promoted_reads_failed += 1 if "error" in resp else 0
+            except Exception:  # noqa: BLE001
+                promoted_reads_failed += 1
+        deadline = time.time() + 90
+        reanchored = False
+        while time.time() < deadline and not reanchored:
+            try:
+                fs = rpc(pnode["http_port"], "fleet_status")["result"]
+                reanchored = fs.get("registered", 0) >= 2
+            except Exception:  # noqa: BLE001
+                pass
+            if not reanchored:
+                time.sleep(0.2)
+        stop.set()
+        for t in loaders:
+            t.join(timeout=5)
+
+        value = float(status.get("promote_ms") or 0.0)
+        _STATE["device_result"] = value
+        _emit(value, 1.0,
+              reads_failed=reads["failed"], reads_total=reads["total"],
+              promoted_reads_failed=promoted_reads_failed,
+              failover_wall_s=round(failover_wall_s, 2),
+              detection_timeout_s=heartbeat,
+              replicas_reanchored=reanchored,
+              leader_epoch=status.get("leader_epoch"),
+              standby_resyncs=status.get("resyncs_applied"),
+              records_applied=status.get("records_applied"),
+              verified="promoted head root recomputed at takeover "
+                       "(recovery_verify_root)",
+              exit_code=0 if (reads["failed"] == 0
+                              and promoted_reads_failed == 0
+                              and reanchored) else 1)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _setup_compile_cache() -> None:
     """RETH_TPU_COMPILE_CACHE_DIR: validate (quarantining corruption) and
     enable the persistent XLA compilation cache, but ONLY after a
@@ -1364,6 +1570,9 @@ def main():
         return
     if mode == "fleet":
         run_fleet_mode()
+        return
+    if mode == "ha":
+        run_ha_mode()
         return
     if mode == "exec":
         # the DEFAULT: CPU-measurable optimistic parallel execution — the

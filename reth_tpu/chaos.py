@@ -267,6 +267,55 @@ def make_fleet_scenario(seed: int) -> dict:
     }
 
 
+def make_ha_scenario(seed: int) -> dict:
+    """Deterministic leader-kill HA scenario: a dev full node in
+    fleet+WAL mode (the leader) shipping its durable stream to a hot
+    standby subprocess, two replicas anchored on the leader's feed with
+    the standby's takeover feed as failover — then SIGKILL the leader
+    mid-load. Invariant suite runs in the orchestrator child: the
+    standby promotes, its recovered head is within the persistence
+    threshold of the recorded chain with a root bit-identical to a
+    fault-free twin replay, the replicas re-register with the new
+    leader's ring and reads keep succeeding, and the restarted OLD
+    leader fences on the standby's higher epoch. Own rng stream so
+    other domains' seeds stay stable."""
+    import random
+
+    rng = random.Random(0xF1EEB000 + seed)
+    # leader-side injectors: only ones the stream must absorb without
+    # an invariant lawfully failing — a stalled gateway slows reads, a
+    # bounded feed partition forces the standby through the
+    # gap-detect → resync ladder before the kill even happens
+    leader_menu = (
+        {"RETH_TPU_FAULT_GATEWAY_STALL": "0.01"},
+        {"RETH_TPU_FAULT_LEADER_PARTITION": "0.4:1.5"},
+    )
+    faults: dict[str, str] = {}
+    for f in rng.sample(leader_menu, k=rng.randint(0, 2)):
+        faults.update(f)
+    # standby-side: a per-record replay delay small enough to catch
+    # back up before the kill gate (which requires lag <= 2)
+    standby_faults: dict[str, str] = {}
+    if rng.random() < 0.5:
+        standby_faults["RETH_TPU_FAULT_STANDBY_LAG"] = "0.002"
+    return {
+        "domain": "ha",
+        "seed": seed,
+        "faults": faults,
+        "standby_faults": standby_faults,
+        "replicas": 2,
+        "threshold": 2,
+        # blocks the leader must have recorded before the SIGKILL
+        "kill_after": rng.randint(6, 10),
+        # > the partition window, so a mid-partition silence never
+        # triggers a premature promotion
+        "heartbeat_timeout": 2.0,
+        # the negative drill flips this: fencing disabled, the
+        # old-leader invariant MUST fail (proves the suite can)
+        "no_fence": False,
+    }
+
+
 # -- child processes ----------------------------------------------------------
 
 
@@ -280,7 +329,8 @@ def _cpu_committer():
 
 
 def _build_node(datadir: Path, seed: int, threshold: int,
-                hash_service: bool, fresh: bool):
+                hash_service: bool, fresh: bool, fleet: bool = False,
+                ha_peer_feeds: tuple = ()):
     """A dev node over memdb+WAL, deterministic genesis derived from the
     seed — victim and recover children build the identical config."""
     from .node import Node, NodeConfig
@@ -304,6 +354,8 @@ def _build_node(datadir: Path, seed: int, threshold: int,
         wal=True, wal_checkpoint_blocks=3,
         static_file_distance=2,
         rpc_gateway=True,
+        fleet=fleet, feed_port=0,
+        ha_peer_feeds=tuple(ha_peer_feeds),
         health=True, slo_interval=0.2, slo_window=120,
         http_port=0, authrpc_port=0,
     )
@@ -1028,6 +1080,365 @@ def run_fleet_scenario(scn: dict, base_dir: str | Path,
     return result
 
 
+def _ha_rpc(port: int, method: str, params=None, timeout: float = 10.0):
+    """One JSON-RPC call against a drill child; raises on transport
+    errors (the caller's deadline loop absorbs them)."""
+    import urllib.request
+
+    body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                       "params": params or []}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", data=body,
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+def child_ha_leader(datadir: str, seed: int, threshold: int = 2,
+                    port_file: str | None = None) -> int:
+    """(child) the HA leader: a dev full node in fleet+WAL mode mining
+    continuously under light read load until SIGKILLed, recording every
+    sealed block — the durable-loss ledger the promoted standby is
+    audited against."""
+    datadir = Path(datadir)
+    node, wallet, _ = _build_node(datadir, seed, threshold,
+                                  hash_service=False, fresh=True,
+                                  fleet=True)
+    ports = node.start_rpc()
+    if port_file:
+        Path(port_file).write_text(json.dumps({
+            "http_port": ports[0], "feed_port": node.feed_server.port,
+            "pid": os.getpid()}))
+    rec = open(_record_path(datadir), "a")
+    sink = b"\x0b" * 20
+    i = 0
+    while True:  # until the orchestrator's SIGKILL
+        i += 1
+        node.pool.add_transaction(wallet.transfer(sink, 100 + i))
+        blk = node.miner.mine_block(timestamp=1_700_000_000 + i * 12)
+        rec.write(json.dumps({
+            "n": blk.header.number, "hash": blk.hash.hex(),
+            "root": blk.header.state_root.hex(), "rlp": blk.encode().hex(),
+        }) + "\n")
+        rec.flush()
+        try:
+            _ha_rpc(ports[0], "eth_blockNumber", timeout=5)
+        except Exception:  # noqa: BLE001 - stall injectors slow, not gate
+            pass
+        time.sleep(0.05)
+
+
+def child_ha_fence_probe(datadir: str, seed: int, threshold: int = 2,
+                         peer: str = "") -> int:
+    """(child) restart the SIGKILLed old leader's datadir with the
+    standby's takeover feed as an HA peer: startup must fence — report
+    a superseding epoch and refuse engine writes. Prints one
+    ``RESULT {...}`` line; the ORCHESTRATOR judges fenced/unfenced (the
+    no-fence negative drill needs the unfenced report, not a crash)."""
+    from .engine.tree import PayloadStatusKind
+
+    datadir = Path(datadir)
+    try:
+        node, _, _ = _build_node(datadir, seed, threshold,
+                                 hash_service=False, fresh=True,
+                                 fleet=True,
+                                 ha_peer_feeds=(peer,) if peer else ())
+    except Exception as e:  # noqa: BLE001 - a refused restart is a verdict
+        print("RESULT " + json.dumps(
+            {"error": f"restart refused: {type(e).__name__}: {e}"}))
+        return 1
+    try:
+        fenced = bool(node.tree.fenced)
+        write_refused = None
+        if fenced:
+            # a fenced tree must refuse engine writes outright
+            st = node.tree.on_forkchoice_updated(b"\x00" * 32)
+            write_refused = st.status is PayloadStatusKind.INVALID
+        result = {
+            "fenced": fenced, "write_refused": write_refused,
+            "fence_report": node.fence_report,
+            "own_epoch": (node.durability.epoch
+                          if node.durability is not None else None),
+            "recovered": node.tree.persisted_number,
+        }
+    finally:
+        node.stop()
+    print("RESULT " + json.dumps(result, default=str))
+    return 0
+
+
+def child_ha_victim(datadir: str, seed: int, no_fence: bool = False) -> int:
+    """Leader-kill HA drill (``--domain ha``): leader + hot standby +
+    two replicas as subprocesses, SIGKILL the leader mid-load, then
+    audit the failover end to end.
+
+    Invariant suite (prints one ``RESULT {...}`` line; exit 0 iff all
+    hold): the standby promotes to ``leading`` with its recovered head
+    root verified by recomputation; zero durable-commit loss — the
+    promoted head is within the persistence threshold of the recorded
+    chain and its state root is bit-identical to a fault-free twin
+    replay of the recorded blocks; both replicas re-register with the
+    promoted leader's ring and reads through the new gateway keep
+    succeeding; and the restarted OLD leader fences on the standby's
+    higher epoch (with ``no_fence`` the fencing check is disabled and
+    this invariant MUST fail — the negative drill)."""
+    import socket as socket_mod
+
+    scn = make_ha_scenario(seed)
+    if no_fence:
+        scn["no_fence"] = True
+    datadir = Path(datadir)
+    leader_dir = datadir / "leader"
+    standby_dir = datadir / "standby"
+    leader_dir.mkdir(parents=True, exist_ok=True)
+    standby_dir.mkdir(parents=True, exist_ok=True)
+    inv: dict[str, object] = {}
+    result: dict[str, object] = {"seed": seed, "scenario": scn,
+                                 "invariants": inv}
+    t0 = time.time()
+    procs: list = []
+    logs: list = []
+
+    def _spawn(cmd, env, log_name):
+        log = open(datadir / log_name, "w")
+        logs.append(log)
+        p = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+        procs.append(p)
+        return p
+
+    def _wait_port_file(pf, what, deadline_s=60):
+        deadline = time.time() + deadline_s
+        while not pf.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        if not pf.exists():
+            raise RuntimeError(f"{what} port file {pf} never appeared")
+        return json.loads(pf.read_text())
+
+    try:
+        # the takeover feed port is pinned up front so the replicas can
+        # carry it as a failover endpoint from birth
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            tport = s.getsockname()[1]
+
+        lpf = datadir / "leader.port"
+        leader = _spawn(
+            [sys.executable, "-m", "reth_tpu.chaos", "ha-leader",
+             "--datadir", str(leader_dir), "--seed", str(seed),
+             "--threshold", str(scn["threshold"]),
+             "--port-file", str(lpf)],
+            _child_env(scn["faults"]), "leader.log")
+        lports = _wait_port_file(lpf, "leader")
+        lhttp, lfeed = lports["http_port"], lports["feed_port"]
+
+        spf = datadir / "standby.port"
+        _spawn(
+            [sys.executable, "-m", "reth_tpu.fleet", "standby",
+             "--feed", f"127.0.0.1:{lfeed}",
+             "--datadir", str(standby_dir),
+             "--takeover-feed-port", str(tport),
+             "--heartbeat-timeout", str(scn["heartbeat_timeout"]),
+             "--id", f"sb{seed}", "--port-file", str(spf)],
+            _child_env(scn["standby_faults"]), "standby.log")
+        shttp = _wait_port_file(spf, "standby")["http_port"]
+
+        for i in range(scn["replicas"]):
+            rpf = datadir / f"replica-{i}.port"
+            _spawn(
+                [sys.executable, "-m", "reth_tpu.fleet", "replica",
+                 "--feed", f"127.0.0.1:{lfeed}",
+                 "--failover-feed", f"127.0.0.1:{tport}",
+                 "--auto-register",
+                 "--register", f"http://127.0.0.1:{lhttp}",
+                 "--id", f"r{i}", "--port-file", str(rpf)],
+                _child_env(), f"replica-{i}.log")
+            _wait_port_file(rpf, f"replica {i}")
+
+        # load gate: enough recorded blocks AND a caught-up standby —
+        # killing a leader whose stream never anchored proves nothing
+        deadline = time.time() + 120
+        status: dict = {}
+        while time.time() < deadline:
+            recorded = [l for l in _read_record(leader_dir) if "hash" in l]
+            try:
+                status = _ha_rpc(shttp, "fleet_standbyStatus")["result"]
+            except Exception:  # noqa: BLE001 - standby still booting
+                status = {}
+            if (len(recorded) >= scn["kill_after"]
+                    and status.get("records_applied", 0) > 0
+                    and not status.get("awaiting_resync", True)
+                    and status.get("lag_heads", 99) <= 2):
+                break
+            if leader.poll() is not None:
+                raise RuntimeError(
+                    f"leader died early rc={leader.returncode}")
+            time.sleep(0.1)
+        else:
+            raise RuntimeError(
+                f"standby never caught up: {json.dumps(status)[:300]}")
+        result["pre_kill"] = {
+            "blocks_recorded": len(recorded),
+            "standby_applied": status.get("records_applied"),
+            "resyncs": status.get("resyncs_applied"),
+        }
+
+        # the actual fault: SIGKILL the leader mid-load
+        os.kill(leader.pid, signal.SIGKILL)
+        leader.wait()
+        killed_at = time.time()
+        recorded = _read_record(leader_dir)
+        mined = [l for l in recorded if "hash" in l]
+        max_n = max(l["n"] for l in mined)
+        by_height: dict[int, set] = {}
+        for l in mined:
+            by_height.setdefault(l["n"], set()).add(l["hash"])
+
+        # 1. the standby promotes itself (heartbeat loss) and its
+        # recovered head root verifies by recomputation
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                status = _ha_rpc(shttp, "fleet_standbyStatus")["result"]
+            except Exception:  # noqa: BLE001 - admin RPC mid-promotion
+                status = {}
+            if status.get("state") in ("leading", "failed"):
+                break
+            time.sleep(0.1)
+        inv["promoted"] = status.get("state") == "leading"
+        result["standby"] = {k: status.get(k) for k in
+                             ("state", "leader_epoch", "promote_ms",
+                              "promote_error", "records_applied",
+                              "resyncs_applied", "gap_detected",
+                              "history")}
+        result["failover_wall_s"] = round(time.time() - killed_at, 2)
+        if not inv["promoted"]:
+            raise RuntimeError(
+                f"standby never reached leading: "
+                f"{json.dumps(status, default=str)[:400]}")
+        pnode = status["node"] or {}
+        phttp, pfeed = pnode["http_port"], pnode["feed_port"]
+        inv["root_verified"] = (
+            pnode.get("recovery", {}).get("root_verified") is True)
+
+        # 2. zero durable-commit loss: the promoted head is within the
+        # persistence threshold of the recorded chain, IS a recorded
+        # block, and its state root is bit-identical to a fault-free
+        # twin replay of the record
+        head_n = int(_ha_rpc(phttp, "eth_blockNumber")["result"], 16)
+        blk = _ha_rpc(phttp, "eth_getBlockByNumber",
+                      [hex(head_n), False])["result"]
+        head_hash = blk["hash"][2:]
+        floor = max_n - scn["threshold"]
+        inv["loss_bound"] = (head_n >= floor
+                             and head_hash in by_height.get(head_n, ()))
+        twin_root, _ = _twin_root(recorded, bytes.fromhex(head_hash), seed)
+        inv["root_twin_identical"] = (
+            twin_root is not None
+            and "0x" + twin_root.hex() == blk["stateRoot"])
+        result["recovered"] = {"number": head_n, "hash": head_hash,
+                               "recorded_max": max_n}
+
+        # 3. the fleet re-anchors: both replicas rotate to the takeover
+        # feed, see the bumped epoch in its hello, and re-register with
+        # the promoted leader's ring
+        deadline = time.time() + 90
+        fs: dict = {}
+        while time.time() < deadline:
+            try:
+                fs = _ha_rpc(phttp, "fleet_status")["result"]
+            except Exception:  # noqa: BLE001
+                fs = {}
+            if fs.get("registered", 0) >= scn["replicas"]:
+                break
+            time.sleep(0.2)
+        inv["replicas_reanchored"] = (
+            fs.get("registered", 0) >= scn["replicas"])
+        result["ring"] = {k: fs.get(k) for k in
+                          ("registered", "healthy", "routed")}
+
+        # 4. zero failed reads through the promoted leader's gateway
+        failures = []
+        for i in range(16):
+            for method, params in (
+                    ("eth_blockNumber", []),
+                    ("eth_getBlockByNumber", [hex(head_n), False])):
+                resp = _ha_rpc(phttp, method, params)
+                if "error" in resp:
+                    failures.append(resp["error"])
+        inv["no_failed_reads"] = not failures
+        if failures:
+            result["failures"] = failures[:5]
+
+        # 5. the restarted old leader fences on the standby's higher
+        # epoch and refuses engine writes (the no-fence negative drill
+        # disables the check — this invariant is HOW it fails)
+        probe_env = _child_env(
+            {"RETH_TPU_FAULT_HA_NO_FENCE": "1"} if scn["no_fence"]
+            else None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "reth_tpu.chaos", "ha-fence-probe",
+             "--datadir", str(leader_dir), "--seed", str(seed),
+             "--threshold", str(scn["threshold"]),
+             "--peer", f"127.0.0.1:{pfeed}"],
+            env=probe_env, capture_output=True, text=True, timeout=120)
+        probe = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("RESULT "):
+                probe = json.loads(line[len("RESULT "):])
+        inv["old_leader_fenced"] = (
+            probe is not None and probe.get("fenced") is True
+            and probe.get("write_refused") is True)
+        result["fence_probe"] = probe if probe is not None else {
+            "error": f"no verdict rc={proc.returncode}: "
+                     f"{proc.stderr[-300:]}"}
+    except Exception as e:  # noqa: BLE001 - a crashed drill fails the suite
+        result["ok"] = False
+        result["error"] = f"{type(e).__name__}: {e}"
+        print("RESULT " + json.dumps(result, default=str))
+        return 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for log in logs:
+            log.close()
+    result["ok"] = all(v is True for v in inv.values())
+    result["wall_s"] = round(time.time() - t0, 2)
+    print("RESULT " + json.dumps(result, default=str))
+    return 0 if result["ok"] else 1
+
+
+def run_ha_scenario(scn: dict, base_dir: str | Path,
+                    timeout: float = 360.0) -> dict:
+    """One HA drill: the orchestrator child owns the leader/standby/
+    replica subprocesses and runs the invariant suite in-process;
+    injector env lands per-process inside (the scenario carries it)."""
+    datadir = Path(base_dir) / f"ha-{scn['seed']}"
+    datadir.mkdir(parents=True, exist_ok=True)
+    result = dict(scn)
+    cmd = [sys.executable, "-m", "reth_tpu.chaos", "ha-victim",
+           "--datadir", str(datadir), "--seed", str(scn["seed"])]
+    if scn.get("no_fence"):
+        cmd.append("--no-fence")
+    try:
+        proc = subprocess.run(cmd, env=_child_env(), capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        result.update(ok=False, error="ha victim timeout")
+        return result
+    verdict = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT "):
+            verdict = json.loads(line[len("RESULT "):])
+    if verdict is None:
+        result.update(ok=False,
+                      error=f"ha victim emitted no verdict "
+                            f"(rc={proc.returncode}): {proc.stderr[-400:]}")
+        return result
+    result.update(verdict)
+    return result
+
+
 def _read_record(datadir: Path) -> list[dict]:
     path = _record_path(datadir)
     if not path.exists():
@@ -1352,6 +1763,7 @@ _DOMAIN_MAKERS = {
     "storage": (make_scenario, run_scenario),
     "consensus": (make_consensus_scenario, run_scenario),
     "fleet": (make_fleet_scenario, run_fleet_scenario),
+    "ha": (make_ha_scenario, run_ha_scenario),
 }
 
 
@@ -1365,12 +1777,13 @@ def run_campaign(seeds, base_dir: str | Path,
         res = run(scn, base_dir)
         res["scenario_wall_s"] = round(time.time() - t0, 1)
         tag = "ok" if res.get("ok") else "FAIL"
-        if scn["mode"] == "point":
+        mode = scn.get("mode", "sigkill-leader")
+        if mode == "point":
             kill = f"point={scn.get('point')}:{scn.get('nth')}"
-        elif scn["mode"] == "kill":
+        elif mode == "kill" or domain == "ha":
             kill = f"kill_after={scn['kill_after']}"
         else:
-            kill = scn["mode"]
+            kill = mode
         print(f"chaos[{domain}] seed={seed} {tag} {kill} "
               f"faults={sorted(scn['faults'])} "
               f"blocks={res.get('blocks_recorded')} "
@@ -1457,16 +1870,44 @@ def main(argv=None) -> int:
     pf.add_argument("--datadir", required=True)
     pf.add_argument("--seed", type=int, required=True)
 
+    ph = sub.add_parser("ha-victim",
+                        help="(child) leader-kill HA drill: SIGKILL the "
+                             "leader mid-load, audit the standby failover")
+    ph.add_argument("--datadir", required=True)
+    ph.add_argument("--seed", type=int, required=True)
+    ph.add_argument("--no-fence", dest="no_fence", action="store_true",
+                    help="negative drill: disable epoch fencing — the "
+                         "old-leader invariant must fail")
+
+    pl = sub.add_parser("ha-leader",
+                        help="(child) HA leader: fleet+WAL dev node "
+                             "mining until killed")
+    pl.add_argument("--datadir", required=True)
+    pl.add_argument("--seed", type=int, required=True)
+    pl.add_argument("--threshold", type=int, default=2)
+    pl.add_argument("--port-file", dest="port_file", default=None)
+
+    pp = sub.add_parser("ha-fence-probe",
+                        help="(child) restart the old leader against a "
+                             "takeover feed peer; report fenced/unfenced")
+    pp.add_argument("--datadir", required=True)
+    pp.add_argument("--seed", type=int, required=True)
+    pp.add_argument("--threshold", type=int, default=2)
+    pp.add_argument("--peer", default="",
+                    help="HOST:PORT of the promoted standby's feed")
+
     ps = sub.add_parser("scenario", help="run one seeded scenario")
     ps.add_argument("--seed", type=int, required=True)
-    ps.add_argument("--domain", choices=("storage", "consensus", "fleet"),
+    ps.add_argument("--domain",
+                    choices=("storage", "consensus", "fleet", "ha"),
                     default="storage")
     ps.add_argument("--base", default=None)
 
     pc = sub.add_parser("campaign", help="run a seeded scenario matrix")
     pc.add_argument("--seeds", default="1,2,3,4,5,6,7,8,9,10",
                     help="comma list, or N for range(1, N+1)")
-    pc.add_argument("--domain", choices=("storage", "consensus", "fleet"),
+    pc.add_argument("--domain",
+                    choices=("storage", "consensus", "fleet", "ha"),
                     default="storage")
     pc.add_argument("--base", default=None)
 
@@ -1483,6 +1924,14 @@ def main(argv=None) -> int:
                              args.hash_service)
     if args.command == "fleet-victim":
         return child_fleet_victim(args.datadir, args.seed)
+    if args.command == "ha-victim":
+        return child_ha_victim(args.datadir, args.seed, args.no_fence)
+    if args.command == "ha-leader":
+        return child_ha_leader(args.datadir, args.seed, args.threshold,
+                               args.port_file)
+    if args.command == "ha-fence-probe":
+        return child_ha_fence_probe(args.datadir, args.seed,
+                                    args.threshold, args.peer)
     import tempfile
 
     base = args.base or tempfile.mkdtemp(prefix="reth-tpu-chaos-")

@@ -390,6 +390,27 @@ def cmd_node(args):
         if getattr(args, "register", None):
             argv += ["--register", args.register]
         return fleet_main(argv)
+    if getattr(args, "role", "full") == "standby":
+        # the hot-standby role replays the leader's WAL stream into its
+        # own datadir and only becomes a full node at promotion time
+        if not getattr(args, "feed", None):
+            print("error: --role standby needs --feed HOST:PORT",
+                  file=sys.stderr)
+            return 1
+        if not args.datadir:
+            print("error: --role standby needs --datadir",
+                  file=sys.stderr)
+            return 1
+        from .fleet.__main__ import main as fleet_main
+
+        argv = ["standby", "--feed", args.feed,
+                "--datadir", args.datadir,
+                "--http-port", str(args.http_port),
+                "--takeover-feed-port", str(args.takeover_feed_port),
+                "--heartbeat-timeout", str(args.heartbeat_timeout)]
+        if getattr(args, "no_auto_promote", False):
+            argv += ["--no-auto-promote"]
+        return fleet_main(argv)
     committer = _make_committer(args)
     backend = _resolve_backend(args)
     if args.db_backend in ("paged", "native") and not args.datadir:
@@ -460,6 +481,8 @@ def cmd_node(args):
                      invalid_cache_size=getattr(
                          args, "invalid_cache_size", None),
                      fleet=bool(getattr(args, "fleet", None)),
+                     ha_peer_feeds=tuple(
+                         getattr(args, "ha_peer_feeds", None) or ()),
                      feed_port=getattr(args, "feed_port", 0) or 0,
                      fleet_max_lag=(getattr(args, "fleet_max_lag", None)
                                     if getattr(args, "fleet_max_lag", None)
@@ -1162,21 +1185,43 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_export_era)
 
     p = sub.add_parser("node", help="run the node (RPC + engine API)")
-    p.add_argument("--role", choices=["full", "replica"], default="full",
+    p.add_argument("--role", choices=["full", "replica", "standby"],
+                   default="full",
                    help="full: the usual node. replica: a stateless "
                         "witness-fed read replica (no database) — needs "
                         "--feed HOST:PORT; serves eth_call/eth_estimateGas/"
                         "eth_getProof/eth_getLogs/eth_getBlockBy* from "
-                        "witness-backed state (fleet/replica.py)")
+                        "witness-backed state (fleet/replica.py). standby: "
+                        "a WAL-shipped hot standby — needs --feed and "
+                        "--datadir; replays the leader's durable stream and "
+                        "promotes itself on heartbeat loss or fleet_promote "
+                        "(fleet/standby.py)")
     p.add_argument("--feed", default=None,
-                   help="(replica role) HOST:PORT of the full node's "
-                        "witness feed")
+                   help="(replica/standby role) HOST:PORT of the full "
+                        "node's witness feed")
     p.add_argument("--replica-retention", dest="replica_retention",
                    type=int, default=128,
                    help="(replica role) validated blocks retained")
     p.add_argument("--register", default=None,
                    help="(replica role) full-node RPC URL to self-register "
                         "with (fleet_register)")
+    p.add_argument("--takeover-feed-port", dest="takeover_feed_port",
+                   type=int, default=0,
+                   help="(standby role) feed port the promoted node binds "
+                        "(0 = ephemeral)")
+    p.add_argument("--no-auto-promote", dest="no_auto_promote",
+                   action="store_true",
+                   help="(standby role) only promote on explicit "
+                        "fleet_promote (no heartbeat-loss trigger)")
+    p.add_argument("--heartbeat-timeout", dest="heartbeat_timeout",
+                   type=float, default=2.0,
+                   help="(standby role) seconds without a leader heartbeat "
+                        "before auto-promotion fires")
+    p.add_argument("--ha-peer-feed", dest="ha_peer_feeds",
+                   action="append", default=None,
+                   help="(full role) HOST:PORT of a peer feed to probe for "
+                        "a higher leader epoch at startup — if one is "
+                        "serving, this node starts fenced (repeatable)")
     p.add_argument("--fleet", dest="fleet", action="store_true",
                    default=None,
                    help="read-replica fleet mode: start the witness feed "

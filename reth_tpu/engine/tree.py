@@ -172,6 +172,12 @@ class EngineTree:
         # flush()-capable store is flushed at the same boundary — either
         # way durability no longer waits for graceful shutdown
         self.durability = None
+        # HA fencing (fleet/election.py): a restarted old leader that
+        # detects a higher leader epoch on a live peer feed sets this —
+        # every write entry point (newPayload, forkchoiceUpdated)
+        # refuses with the fencing reason instead of splitting the brain
+        self.fenced = False
+        self.fence_reason = ""
         self.blocks: dict[bytes, ExecutedBlock] = {}
         from .block_buffer import BlockBuffer, InvalidHeaderCache, ReorgTracker
 
@@ -201,6 +207,11 @@ class EngineTree:
         self.persisted_hash = h
         self.head_hash: bytes = h  # canonical in-memory head
         self.canon_listeners: list = []  # CanonStateNotification sinks
+        # fork-choice forwarding sinks (fleet HA: the witness feed ships
+        # every head advance to the standby as an st_fcu record); called
+        # with (number, head_hash) AFTER persistence advanced, so the
+        # shipped WAL records for the head's durable prefix precede it
+        self.fcu_listeners: list = []
         self._root_histogram = REGISTRY.histogram(
             "engine_state_root_duration_seconds",
             "per-block incremental state-root wall clock",
@@ -261,7 +272,17 @@ class EngineTree:
 
     # -- newPayload ------------------------------------------------------------
 
+    def fence(self, reason: str) -> None:
+        """Refuse all subsequent writes (HA epoch fencing): this node
+        was superseded by a higher leader epoch while it was down."""
+        self.fenced = True
+        self.fence_reason = reason
+        tracing.event("engine::tree", "fenced", reason=reason)
+
     def on_new_payload(self, block: Block) -> PayloadStatus:
+        if self.fenced:
+            return PayloadStatus(PayloadStatusKind.INVALID, None,
+                                 f"fenced: {self.fence_reason}")
         h = block.hash
         if h in self.blocks:
             return PayloadStatus(PayloadStatusKind.VALID, h)
@@ -790,6 +811,9 @@ class EngineTree:
     def on_forkchoice_updated(
         self, head: bytes, safe: bytes | None = None, finalized: bytes | None = None
     ) -> PayloadStatus:
+        if self.fenced:
+            return PayloadStatus(PayloadStatusKind.INVALID, None,
+                                 f"fenced: {self.fence_reason}")
         reason = self.invalid.get(head)
         if reason is not None:
             return PayloadStatus(PayloadStatusKind.INVALID, None, reason)
@@ -830,6 +854,15 @@ class EngineTree:
         self._advance_persistence()
         if old_head != head:
             self._notify_canon_change()
+            if self.fcu_listeners:
+                eb = self.blocks.get(head)
+                number = (eb.number if eb is not None
+                          else self.persisted_number)
+                for listener in list(self.fcu_listeners):
+                    try:
+                        listener(number, head)
+                    except Exception:  # noqa: BLE001 - sinks never gate
+                        pass
         return PayloadStatus(PayloadStatusKind.VALID, head)
 
     # -- consensus robustness --------------------------------------------------

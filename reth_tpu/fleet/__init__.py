@@ -22,22 +22,39 @@ caching front door, the health engine) into a genuinely new role:
   ``(method, canonical params, head_hash)`` cache key, health-probed
   per-replica draining, and failover replica → ring neighbor → the
   local full node.
+- :mod:`.standby` — the WAL-shipped hot standby role: a full node's
+  durable stream (``RTST1`` records over the same feed framing) replayed
+  continuously into a second datadir, with heartbeat-loss / RPC-driven
+  promotion to leader (:mod:`.election` holds the state machine and the
+  epoch fencing probe).
 
-``python -m reth_tpu.fleet replica --feed HOST:PORT`` runs a replica
-(the ``--role replica`` CLI entry delegates here).
+``python -m reth_tpu.fleet replica --feed HOST:PORT`` runs a replica and
+``python -m reth_tpu.fleet standby --feed HOST:PORT --datadir DIR`` a hot
+standby (the ``--role replica`` / ``--role standby`` CLI entries delegate
+here).
 """
 
+from .election import (HeartbeatMonitor, PromotionStateMachine, fence_check,
+                       probe_feed_hello)
 from .feed import FeedError, WitnessFeedClient, WitnessFeedServer
 from .replica import ReplicaFaultInjector, ReplicaNode
 from .ring import FleetRouter, HashRing, ReplicaHandle
+from .standby import StandbyAdminApi, StandbyFaultInjector, StandbyNode
 
 __all__ = [
     "FeedError",
     "FleetRouter",
     "HashRing",
+    "HeartbeatMonitor",
+    "PromotionStateMachine",
     "ReplicaFaultInjector",
     "ReplicaHandle",
     "ReplicaNode",
+    "StandbyAdminApi",
+    "StandbyFaultInjector",
+    "StandbyNode",
     "WitnessFeedClient",
     "WitnessFeedServer",
+    "fence_check",
+    "probe_feed_hello",
 ]

@@ -3,6 +3,10 @@
 ``replica``: the stateless read-replica process (`--role replica` on
 the main CLI delegates here). It holds no database: everything it
 serves comes over the witness feed.
+
+``standby``: the WAL-shipped hot standby (`--role standby` delegates
+here). It replays the leader's durable stream into its own datadir and
+promotes itself to leader on heartbeat loss or ``fleet_promote``.
 """
 
 from __future__ import annotations
@@ -11,6 +15,15 @@ import argparse
 import json
 import sys
 import time
+
+
+def _parse_hostport(spec: str, flag: str) -> tuple[str, int] | None:
+    host, _, port = spec.rpartition(":")
+    if not host or not port.isdigit():
+        print(f"error: {flag} must be HOST:PORT, got {spec!r}",
+              file=sys.stderr)
+        return None
+    return host, int(port)
 
 
 def run_replica(args) -> int:
@@ -28,14 +41,20 @@ def run_replica(args) -> int:
         # wherever RETH_TPU_FLIGHT_DIR points (a fleet shares one dir
         # so correlated dumps land together)
         tracing.init_block_tracing(chrome_path=args.trace_file)
-    host, _, port = args.feed.rpartition(":")
-    if not host or not port.isdigit():
-        print(f"error: --feed must be HOST:PORT, got {args.feed!r}",
-              file=sys.stderr)
+    ep = _parse_hostport(args.feed, "--feed")
+    if ep is None:
         return 1
-    replica = ReplicaNode(host, int(port), http_port=args.http_port,
+    failover = []
+    for spec in (args.failover_feed or ()):
+        fep = _parse_hostport(spec, "--failover-feed")
+        if fep is None:
+            return 1
+        failover.append(fep)
+    replica = ReplicaNode(ep[0], ep[1], http_port=args.http_port,
                           retention=args.retention,
-                          replica_id=args.id)
+                          replica_id=args.id,
+                          failover_feeds=failover or None,
+                          auto_register=args.auto_register)
     http_port = replica.start()
     print(f"replica RPC listening on 127.0.0.1:{http_port} "
           f"(feed {args.feed})", flush=True)
@@ -73,6 +92,37 @@ def run_replica(args) -> int:
     return 0
 
 
+def run_standby(args) -> int:
+    from .standby import StandbyFaultInjector, StandbyNode
+
+    ep = _parse_hostport(args.feed, "--feed")
+    if ep is None:
+        return 1
+    standby = StandbyNode(
+        ep[0], ep[1], datadir=args.datadir, standby_id=args.id,
+        http_port=args.http_port,
+        takeover_feed_port=args.takeover_feed_port,
+        auto_promote=not args.no_auto_promote,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        injector=StandbyFaultInjector.from_env())
+    http_port = standby.start()
+    print(f"standby admin RPC listening on 127.0.0.1:{http_port} "
+          f"(feed {args.feed}, datadir {args.datadir})", flush=True)
+    if args.port_file:
+        from pathlib import Path
+
+        Path(args.port_file).write_text(json.dumps(
+            {"http_port": http_port, "id": standby.standby_id,
+             "pid": standby.status()["pid"]}))
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    standby.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m reth_tpu.fleet",
@@ -92,13 +142,46 @@ def main(argv=None) -> int:
     p.add_argument("--register", default=None,
                    help="full-node RPC URL to self-register with "
                         "(fleet_register)")
+    p.add_argument("--failover-feed", action="append", default=None,
+                   help="additional HOST:PORT feed endpoint to rotate "
+                        "to when the primary dies (a standby's "
+                        "takeover feed); repeatable")
+    p.add_argument("--auto-register", action="store_true",
+                   help="re-register with the serving leader's gateway "
+                        "whenever the feed's leader epoch changes "
+                        "(failover re-anchor)")
     p.add_argument("--trace-file", dest="trace_file", default=None,
                    help="write this replica's spans as a Chrome trace "
                         "here (the replica half of a stitched fleet "
                         "trace)")
+    s = sub.add_parser("standby", help="run a WAL-shipped hot standby "
+                                       "(promotes to leader on "
+                                       "heartbeat loss / fleet_promote)")
+    s.add_argument("--feed", required=True,
+                   help="HOST:PORT of the leader's witness feed")
+    s.add_argument("--datadir", required=True,
+                   help="standby datadir (becomes the leader datadir "
+                        "on promotion)")
+    s.add_argument("--http-port", type=int, default=0,
+                   help="standby admin RPC port (fleet_standbyStatus / "
+                        "fleet_promote; 0 = ephemeral)")
+    s.add_argument("--takeover-feed-port", type=int, default=0,
+                   help="feed port the promoted node binds "
+                        "(0 = ephemeral)")
+    s.add_argument("--id", default=None, help="standby id override")
+    s.add_argument("--no-auto-promote", action="store_true",
+                   help="only promote on explicit fleet_promote (no "
+                        "heartbeat-loss trigger)")
+    s.add_argument("--heartbeat-timeout", type=float, default=2.0,
+                   help="seconds without a leader heartbeat before "
+                        "auto-promotion fires")
+    s.add_argument("--port-file", default=None,
+                   help="write the bound admin RPC port here as JSON")
     args = parser.parse_args(argv)
     if args.command == "replica":
         return run_replica(args)
+    if args.command == "standby":
+        return run_standby(args)
     return 1
 
 

@@ -1,0 +1,197 @@
+"""Leader election plumbing for the HA pair: the promotion state
+machine, the heartbeat monitor, and the epoch fencing probe.
+
+There is no quorum here — the fleet runs exactly one leader and one
+hot standby (ROADMAP item 2a), so "election" reduces to a deterministic
+promotion ladder plus epoch fencing:
+
+- **Promotion state machine** (:class:`PromotionStateMachine`):
+  ``following → catching-up → promoting → leading`` (terminal
+  ``failed`` when the recovered head root does not verify). Transitions
+  are monotonic — a standby never demotes itself; a fenced OLD leader
+  restarts into ``fenced`` instead.
+- **Heartbeat monitor** (:class:`HeartbeatMonitor`): the leader stamps
+  ``st_heartbeat`` frames onto the feed socket; the standby arms a
+  deadline per beat. Missing the deadline (socket alive but silent —
+  the partition case) or losing the socket entirely both funnel into
+  one ``on_loss`` callback, fired once per connection epoch.
+- **Fencing probe** (:func:`probe_feed_hello` / :func:`fence_check`):
+  every feed hello carries the sender's monotonic ``leader_epoch``
+  (persisted in the WAL manifest, storage/wal.py). A restarted old
+  leader probes the standby's takeover feed before serving writes: a
+  live peer advertising a HIGHER epoch means this node was superseded
+  while it was dead — it must fence (refuse stale writes) rather than
+  split-brain the fleet. ``RETH_TPU_FAULT_HA_NO_FENCE=1`` disables the
+  check — the deliberately broken mode the chaos negative drill uses to
+  prove the invariant suite notices a split brain.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+from .feed import FEED_MAGIC, _recv_exact, recv_frame
+
+# promotion ladder, in order; "failed" and "fenced" are terminal
+STATES = ("following", "catching-up", "promoting", "leading")
+
+
+class PromotionStateMachine:
+    """The standby's promotion ladder. Thread-safe; transitions are
+    monotonic along :data:`STATES` (plus the terminal ``failed``), and
+    every transition lands in ``history`` with a wall-clock stamp and
+    the reason — the forensic trail a failover post-mortem reads."""
+
+    def __init__(self, on_transition=None):
+        self._lock = threading.Lock()
+        self._state = "following"
+        self.on_transition = on_transition
+        self.history: list[dict] = [
+            {"state": "following", "at": time.time(), "why": "start"}]
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def is_leading(self) -> bool:
+        return self._state == "leading"
+
+    def advance(self, to: str, why: str = "") -> bool:
+        """Move to ``to``; False when the transition would go backwards
+        (or away from a terminal state) — promotion never regresses."""
+        with self._lock:
+            cur = self._state
+            if cur in ("failed", "fenced"):
+                return False
+            if to == "failed":
+                pass  # any live state may fail
+            elif to not in STATES or cur not in STATES \
+                    or STATES.index(to) <= STATES.index(cur):
+                return False
+            self._state = to
+            self.history.append(
+                {"state": to, "at": time.time(), "why": why})
+        if self.on_transition is not None:
+            try:
+                self.on_transition(to, why)
+            except Exception:  # noqa: BLE001 - observers never gate
+                pass
+        return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self._state,
+                    "history": [dict(h) for h in self.history]}
+
+
+class HeartbeatMonitor:
+    """Deadline watchdog over the leader's ``st_heartbeat`` cadence.
+
+    ``note()`` on every received beat re-arms the deadline; a checker
+    thread fires ``on_loss(age_s)`` once when the deadline lapses.
+    ``reset()`` re-arms after a reconnect (a fresh session gets a fresh
+    grace period). The monitor deliberately measures LOCAL receipt time
+    only — no cross-host clock comparison."""
+
+    def __init__(self, timeout_s: float = 2.0, on_loss=None,
+                 interval_s: float | None = None):
+        self.timeout_s = max(0.1, float(timeout_s))
+        self.on_loss = on_loss
+        self._interval = interval_s or min(0.25, self.timeout_s / 4)
+        self._last = time.monotonic()
+        self._fired = False
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.beats = 0
+        self.losses = 0
+
+    def note(self) -> None:
+        with self._lock:
+            self._last = time.monotonic()
+            self._fired = False
+            self.beats += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._last = time.monotonic()
+            self._fired = False
+
+    def age_s(self) -> float:
+        with self._lock:
+            return time.monotonic() - self._last
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ha-heartbeat")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            with self._lock:
+                age = time.monotonic() - self._last
+                lapsed = age > self.timeout_s and not self._fired
+                if lapsed:
+                    self._fired = True
+                    self.losses += 1
+            if lapsed and self.on_loss is not None:
+                try:
+                    self.on_loss(age)
+                except Exception:  # noqa: BLE001 - callback never kills
+                    pass
+
+
+def probe_feed_hello(host: str, port: int,
+                     timeout_s: float = 2.0) -> dict | None:
+    """Connect to a witness feed just long enough to read its hello
+    frame (which carries the sender's ``epoch``); None when the peer is
+    unreachable or does not speak the feed protocol."""
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout_s) as sock:
+            sock.settimeout(timeout_s)
+            if _recv_exact(sock, len(FEED_MAGIC)) != FEED_MAGIC:
+                return None
+            hello = recv_frame(sock)
+            if isinstance(hello, dict) and hello.get("type") == "hello":
+                return hello
+    except Exception:  # noqa: BLE001 - unreachable peer = no hello
+        return None
+    return None
+
+
+def fencing_disabled(env=None) -> bool:
+    env = os.environ if env is None else env
+    return env.get("RETH_TPU_FAULT_HA_NO_FENCE", "") not in ("", "0")
+
+
+def fence_check(own_epoch: int, peers, timeout_s: float = 2.0) -> dict:
+    """Probe each ``(host, port)`` feed in ``peers``; fenced when any
+    live peer advertises ``epoch > own_epoch``. Returns a report dict —
+    the caller (node startup) decides what fencing means (refusing
+    stale writes), this only establishes the fact."""
+    report = {"fenced": False, "own_epoch": int(own_epoch),
+              "peer_epoch": None, "peer": None, "probed": 0,
+              "disabled": fencing_disabled()}
+    for host, port in peers or ():
+        hello = probe_feed_hello(host, port, timeout_s=timeout_s)
+        if hello is None:
+            continue
+        report["probed"] += 1
+        peer_epoch = int(hello.get("epoch") or 0)
+        if peer_epoch > report["own_epoch"] and \
+                (report["peer_epoch"] is None
+                 or peer_epoch > report["peer_epoch"]):
+            report["peer_epoch"] = peer_epoch
+            report["peer"] = f"{host}:{port}"
+            if not report["disabled"]:
+                report["fenced"] = True
+    return report

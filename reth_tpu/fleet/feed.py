@@ -35,6 +35,37 @@ Frames:
   others — every process dumps under the SAME correlation id, deduped
   by a bounded seen-set so fan-out cannot loop.
 
+``RTST1`` record family (leader → standby WAL shipping, fleet/standby.py):
+rides the same framing, delivered only to subscribers that sent
+``{"type": "subscribe_wal", "from": {store: [gen, seq]} | None}``
+upstream:
+
+- ``{"type": "st_wal", "st": "RTST1", "epoch", "store", "gen", "seq",
+  "payload", "crc"}`` — one durable WAL record, shipped post-fsync with
+  the RAW on-disk payload bytes + their crc32, so the standby applies
+  the exact torn/CRC discipline of ``storage/wal.py`` replay to the
+  wire stream.
+- ``{"type": "st_manifest", "st": "RTST1", "epoch", "store",
+  "manifest"}`` — the leader checkpointed; the standby checkpoints its
+  own WAL at the same boundary.
+- ``{"type": "st_fcu", "st": "RTST1", "epoch", "number", "hash"}`` —
+  fork-choice forwarding: the leader's canonical head, the standby's
+  lag anchor and recovered-head target.
+- ``{"type": "st_heartbeat", "st": "RTST1", "epoch", "head"}`` —
+  leader liveness at a fixed cadence; the standby's promotion trigger
+  is this beat going silent (election.HeartbeatMonitor).
+- ``{"type": "st_resync", "st": "RTST1", "epoch", "store", "tables",
+  "gen", "seq", "head"}`` — a full consistent table image (records
+  carry absolute values, so replacing the standby's state with the
+  image and continuing from ``(gen, seq)`` converges exactly); sent
+  when a subscriber's ``from`` position cannot be continued, or on an
+  upstream ``{"type": "resync_request"}``.
+
+Every hello additionally carries ``epoch`` (the sender's monotonic
+leader epoch, persisted in the WAL manifest) and ``rpc_port`` — the
+fencing handshake: a restarted old leader probing a live peer whose
+epoch is higher knows it was superseded and must not serve writes.
+
 Block records additionally carry a ``"tp"`` member — the wire form of
 the block's trace context (:func:`reth_tpu.tracing.context_to_wire`,
 trace id = block hash hex, parent = the ``witness.generate`` span) — so
@@ -54,15 +85,18 @@ from __future__ import annotations
 import os
 import pickle
 import queue
+import random
 import socket
 import struct
 import threading
+import time
 import zlib
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 from .. import tracing
 
 FEED_MAGIC = b"RTFD1\n"
+ST_MAGIC = "RTST1"  # the WAL-shipping record family tag
 _HDR = struct.Struct("<II")
 MAX_FRAME = 256 * 1024 * 1024  # sanity bound: no witness comes close
 
@@ -107,12 +141,13 @@ def recv_frame(sock: socket.socket):
 
 
 class _Subscriber:
-    __slots__ = ("sock", "lock", "addr")
+    __slots__ = ("sock", "lock", "addr", "wal")
 
     def __init__(self, sock: socket.socket, addr):
         self.sock = sock
         self.lock = threading.Lock()  # one frame at a time per socket
         self.addr = addr
+        self.wal = False  # True once the peer sent subscribe_wal
 
 
 class WitnessFeedServer:
@@ -157,6 +192,43 @@ class WitnessFeedServer:
         self.dropped_blocks = 0
         self.last_witness_bytes = 0
         self.total_witness_bytes = 0
+        # -- HA / WAL shipping (RTST1 family, fleet/standby.py) -----------
+        # monotonic leader epoch: set from the WAL manifest by
+        # attach_durability; rides every hello (the fencing handshake)
+        self.epoch = 1
+        # this node's public RPC port (hello field): a re-anchoring
+        # replica registers with the promoted leader's gateway here
+        self.rpc_port: int | None = None
+        self._durability = None
+        # shipped records queue, drained by the feed-ship thread so a
+        # slow/wedged standby socket can never stall the append path;
+        # items: ("rec", frame) | ("resync", subscriber)
+        self._st_queue: deque = deque()
+        self._st_cond = threading.Condition()
+        self._st_cap = int(os.environ.get("RETH_TPU_HA_SHIP_QUEUE", 4096))
+        self.heartbeat_s = float(
+            os.environ.get("RETH_TPU_HA_HEARTBEAT_S", "0.25"))
+        self.st_records_sent = 0
+        self.st_manifests_sent = 0
+        self.st_fcu_sent = 0
+        self.st_dropped = 0
+        self.heartbeats_sent = 0
+        self.resyncs_sent = 0
+        # RETH_TPU_FAULT_LEADER_PARTITION=<dur_s>[:<start_s>] — suppress
+        # every RTST1 frame (records AND heartbeats) for dur_s starting
+        # start_s (default 1.0) after the server starts: the network
+        # partition the standby must survive via gap-detect + resync
+        self._partition: tuple[float, float] | None = None
+        self.partition_suppressed = 0
+        raw = os.environ.get("RETH_TPU_FAULT_LEADER_PARTITION", "")
+        if raw not in ("", "0"):
+            dur, _, start = raw.partition(":")
+            try:
+                self._partition = (float(start or 1.0),
+                                   float(start or 1.0) + float(dur))
+            except ValueError:
+                self._partition = None
+        self._started_at = time.monotonic()
         from ..metrics import FleetMetrics
 
         self.metrics = FleetMetrics(registry)
@@ -169,8 +241,10 @@ class WitnessFeedServer:
         self._srv.bind((self.host, self.port))
         self._srv.listen(16)
         self.port = self._srv.getsockname()[1]
+        self._started_at = time.monotonic()
         for name, fn in (("feed-accept", self._accept_loop),
-                         ("feed-worker", self._worker)):
+                         ("feed-worker", self._worker),
+                         ("feed-ship", self._ship_loop)):
             t = threading.Thread(target=fn, daemon=True, name=name)
             t.start()
             self._threads.append(t)
@@ -184,6 +258,8 @@ class WitnessFeedServer:
             except OSError:
                 pass
         self._queue.put(None)  # wake the worker
+        with self._st_cond:
+            self._st_cond.notify_all()  # wake the ship loop
         with self._lock:
             subs, self._subs = self._subs, []
         for s in subs:
@@ -302,16 +378,145 @@ class WitnessFeedServer:
         return record
 
     def _broadcast(self, record: dict, exclude=None) -> None:
+        # witness traffic (block/head) skips WAL subscribers: the
+        # standby replicates state from RTST1 records, not witnesses —
+        # shipping both would double its ingest for nothing
+        skip_wal = record.get("type") in ("block", "head")
         with self._lock:
             subs = list(self._subs)
         for s in subs:
-            if s is exclude:
+            if s is exclude or (skip_wal and s.wal):
                 continue
             try:
                 with s.lock:
                     send_frame(s.sock, record)
             except OSError:
                 self._drop(s)
+
+    # -- WAL shipping (RTST1, the HA standby's replication stream) ----------
+
+    def attach_durability(self, durability) -> None:
+        """Hook the node's DurabilityManager: every post-fsync append
+        and checkpoint manifest lands on the ship queue; the manifest's
+        persisted leader epoch becomes this feed's advertised epoch."""
+        self._durability = durability
+        self.epoch = durability.epoch
+        durability.attach_shipper(self._ship_record, self._ship_manifest)
+
+    def _partition_active(self) -> bool:
+        if self._partition is None:
+            return False
+        now = time.monotonic() - self._started_at
+        active = self._partition[0] <= now < self._partition[1]
+        if active:
+            tracing.fault_event("RETH_TPU_FAULT_LEADER_PARTITION",
+                                target="fleet::feed")
+        return active
+
+    def _st_enqueue(self, item) -> None:
+        with self._st_cond:
+            while len(self._st_queue) >= self._st_cap:
+                # drop the OLDEST shipped record: the standby detects
+                # the seq gap and re-anchors via resync
+                self._st_queue.popleft()
+                self.st_dropped += 1
+            self._st_queue.append(item)
+            self._st_cond.notify()
+
+    def _ship_record(self, store: int, gen: int, seq: int,
+                     payload: bytes) -> None:
+        """DurabilityManager append observer: runs under the WAL append
+        lock, so it only enqueues — the ship thread does the socket
+        work."""
+        self._st_enqueue(("rec", {
+            "type": "st_wal", "st": ST_MAGIC, "epoch": self.epoch,
+            "store": store, "gen": gen, "seq": seq,
+            "payload": payload, "crc": zlib.crc32(payload)}))
+
+    def _ship_manifest(self, store: int, manifest: dict) -> None:
+        self._st_enqueue(("rec", {
+            "type": "st_manifest", "st": ST_MAGIC, "epoch": self.epoch,
+            "store": store, "manifest": manifest}))
+
+    def ship_fcu(self, number: int, head_hash: bytes) -> None:
+        """Fork-choice forwarding (engine canon listener): the leader's
+        canonical head, the standby's lag anchor."""
+        self._st_enqueue(("rec", {
+            "type": "st_fcu", "st": ST_MAGIC, "epoch": self.epoch,
+            "number": number, "hash": head_hash}))
+
+    def _ship_loop(self) -> None:
+        """Drain the ship queue to WAL subscribers; a silent queue still
+        beats ``st_heartbeat`` at the configured cadence — the standby's
+        liveness signal."""
+        next_beat = time.monotonic() + self.heartbeat_s
+        while not self._stop.is_set():
+            with self._st_cond:
+                if not self._st_queue:
+                    self._st_cond.wait(
+                        max(0.01, next_beat - time.monotonic()))
+                batch = []
+                while self._st_queue:
+                    batch.append(self._st_queue.popleft())
+            if self._stop.is_set():
+                return
+            partitioned = self._partition_active()
+            for kind, item in batch:
+                if kind == "resync":
+                    self._send_resync(item)
+                    continue
+                if partitioned:
+                    self.partition_suppressed += 1
+                    continue
+                self._broadcast_wal(item)
+                if item["type"] == "st_wal":
+                    self.st_records_sent += 1
+                elif item["type"] == "st_manifest":
+                    self.st_manifests_sent += 1
+                elif item["type"] == "st_fcu":
+                    self.st_fcu_sent += 1
+            if time.monotonic() >= next_beat:
+                next_beat = time.monotonic() + self.heartbeat_s
+                if not partitioned and not self._partition_active():
+                    self._broadcast_wal(
+                        {"type": "st_heartbeat", "st": ST_MAGIC,
+                         "epoch": self.epoch, "head": self.head})
+                    self.heartbeats_sent += 1
+                else:
+                    self.partition_suppressed += 1
+
+    def _broadcast_wal(self, record: dict) -> None:
+        with self._lock:
+            subs = [s for s in self._subs if s.wal]
+        for s in subs:
+            try:
+                with s.lock:
+                    send_frame(s.sock, record)
+            except OSError:
+                self._drop(s)
+
+    def _send_resync(self, sub: _Subscriber) -> None:
+        """Full consistent table image(s) for one subscriber — sent
+        from the ship thread so it lands IN ORDER with the st_wal
+        stream (every queued record before it carries seq <= the
+        image's, every one after continues from it)."""
+        if self._durability is None:
+            return
+        try:
+            images = self._durability.snapshot_tables()
+        except Exception:  # noqa: BLE001 - resync is best-effort
+            return
+        for i, (tables, gen, seq) in enumerate(images):
+            rec = {"type": "st_resync", "st": ST_MAGIC,
+                   "epoch": self.epoch, "store": i, "tables": tables,
+                   "gen": gen, "seq": seq, "head": self.head}
+            try:
+                with sub.lock:
+                    send_frame(sub.sock, rec)
+            except OSError:
+                self._drop(sub)
+                return
+        self.resyncs_sent += 1
 
     # -- correlated flight dumps --------------------------------------------
 
@@ -350,9 +555,41 @@ class WitnessFeedServer:
 
     def _on_upstream(self, frame: dict, sub: _Subscriber) -> None:
         """A frame a replica sent UPSTREAM on its feed socket: a
-        replica-side incident asks the fleet to dump. Dump locally and
-        re-fan to the other replicas (never back to the initiator)."""
-        if not isinstance(frame, dict) or frame.get("type") != "flight_dump":
+        replica-side incident asks the fleet to dump, a standby
+        subscribes to the WAL stream, a reconnecting replica asks for
+        the backlog since its last seen head."""
+        if not isinstance(frame, dict):
+            return
+        kind = frame.get("type")
+        if kind == "subscribe_wal":
+            # mark BEFORE queuing the resync so every record shipped
+            # from now on reaches this subscriber; the image then lands
+            # in-stream and seq-anchors the tail. A tail-exact ``from``
+            # (nothing missed across the reconnect) skips the image.
+            sub.wal = True
+            if not self._wal_tail_matches(frame.get("from")):
+                self._st_enqueue(("resync", sub))
+            return
+        if kind == "resync_request":
+            if sub.wal:
+                self._st_enqueue(("resync", sub))
+            return
+        if kind == "resubscribe":
+            # reconnect catch-up: re-send retained block records above
+            # the subscriber's last seen head (records are
+            # self-contained; the replica dedupes by hash)
+            since = frame.get("number")
+            with self._lock:
+                backlog = [r for r in self._backlog
+                           if since is None or r["number"] > since]
+            try:
+                with sub.lock:
+                    for record in backlog:
+                        send_frame(sub.sock, record)
+            except OSError:
+                self._drop(sub)
+            return
+        if kind != "flight_dump":
             return
         cid = frame.get("correlation_id")
         if not self._corr_mark(cid):
@@ -366,6 +603,21 @@ class WitnessFeedServer:
                             window=frame.get("window"))
         self.flight_fanouts += 1
         self._broadcast(frame, exclude=sub)
+
+    def _wal_tail_matches(self, frm) -> bool:
+        """True when ``frm`` (``{store: [gen, seq]}``) equals every
+        store's live tail — the reconnecting standby missed nothing, so
+        no image is needed."""
+        if self._durability is None or not isinstance(frm, dict):
+            return False
+        stores = self._durability.stores
+        if len(frm) != len(stores):
+            return False
+        for i, store in enumerate(stores):
+            pos = frm.get(i) or frm.get(str(i))
+            if not pos or tuple(pos) != (store.gen, store.seq):
+                return False
+        return True
 
     def _sub_reader(self, sub: _Subscriber) -> None:
         """Per-subscriber upstream reader (the feed socket is the
@@ -410,6 +662,11 @@ class WitnessFeedServer:
             sock.sendall(FEED_MAGIC)
             hello = {"type": "hello", "chain_id": self.chain_id,
                      "head": self.head,
+                     # HA fencing handshake: the sender's monotonic
+                     # leader epoch + its public RPC port (where a
+                     # re-anchoring replica registers with the ring)
+                     "epoch": self.epoch,
+                     "rpc_port": self.rpc_port,
                      "spec": (self.chain_spec.to_json()
                               if self.chain_spec is not None else None),
                      # feed-side process identity (wire-form fields):
@@ -444,10 +701,20 @@ class WitnessFeedServer:
     def snapshot(self) -> dict:
         with self._lock:
             subs = len(self._subs)
+            wal_subs = sum(1 for s in self._subs if s.wal)
             backlog = len(self._backlog)
         return {
             "port": self.port,
             "subscribers": subs,
+            "wal_subscribers": wal_subs,
+            "epoch": self.epoch,
+            "st_records_sent": self.st_records_sent,
+            "st_manifests_sent": self.st_manifests_sent,
+            "st_fcu_sent": self.st_fcu_sent,
+            "st_dropped": self.st_dropped,
+            "heartbeats_sent": self.heartbeats_sent,
+            "resyncs_sent": self.resyncs_sent,
+            "partition_suppressed": self.partition_suppressed,
             "backlog": backlog,
             "blocks_sent": self.blocks_sent,
             "heads_sent": self.heads_sent,
@@ -463,18 +730,36 @@ class WitnessFeedServer:
 
 class WitnessFeedClient:
     """Replica-side subscriber: connects, reads the hello, then streams
-    frames into ``on_record``; reconnects with backoff until stopped."""
+    frames into ``on_record``; reconnects with exponential backoff +
+    jitter until stopped.
+
+    Reconnect hardening: transport death resets nothing — the client
+    remembers ``last_seen_head`` across sessions and resubscribes from
+    it after the next hello (an upstream ``resubscribe`` frame the
+    server answers with the retained block records above that head), so
+    a late joiner mid-gap catches up instead of dying on the gap.
+    ``endpoints`` holds failover feed addresses (the HA standby's
+    takeover port): each failed attempt rotates to the next one."""
 
     def __init__(self, host: str, port: int, *, on_hello=None,
                  on_record=None, reconnect: bool = True,
-                 backoff_s: float = 0.25, timeout_s: float = 10.0):
+                 backoff_s: float = 0.25, backoff_max_s: float = 5.0,
+                 timeout_s: float = 10.0, endpoints=None):
         self.host = host
         self.port = port
         self.on_hello = on_hello
         self.on_record = on_record
         self.reconnect = reconnect
         self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
         self.timeout_s = timeout_s
+        # connection targets, primary first; set_endpoints() may extend
+        # at runtime (a replica told about the standby's takeover feed)
+        self._endpoints: list[tuple[str, int]] = [(host, int(port))]
+        for ep in endpoints or ():
+            self.add_endpoint(ep[0], int(ep[1]))
+        self._ep_index = 0
+        self._rng = random.Random()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._sock: socket.socket | None = None
@@ -484,6 +769,16 @@ class WitnessFeedClient:
         self.frames = 0
         self.frame_errors = 0
         self.sent_upstream = 0
+        self.resubscribes = 0
+        self._session_established = False
+        self.last_seen_head: tuple[int, bytes] | None = None
+        # (host, port) of the live session — which endpoint is serving
+        self.endpoint: tuple[str, int] | None = None
+
+    def add_endpoint(self, host: str, port: int) -> None:
+        ep = (host, int(port))
+        if ep not in self._endpoints:
+            self._endpoints.append(ep)
 
     def send(self, obj) -> bool:
         """Send one frame UPSTREAM to the feed server (the replica →
@@ -516,7 +811,9 @@ class WitnessFeedClient:
             self._thread.join(timeout=2)
 
     def _run(self) -> None:
+        failures = 0
         while not self._stop.is_set():
+            self._session_established = False
             try:
                 self._session()
             except (OSError, ConnectionError):
@@ -524,13 +821,28 @@ class WitnessFeedClient:
             except FeedError:
                 self.frame_errors += 1
             finally:
+                established = self._session_established
                 self.connected.clear()
             if not self.reconnect or self._stop.is_set():
                 return
-            self._stop.wait(self.backoff_s)
+            if established:
+                failures = 0  # a real session resets the backoff
+            else:
+                failures += 1
+                # a dead endpoint rotates to the next candidate (the
+                # failover ladder: primary feed -> standby takeover)
+                self._ep_index = (self._ep_index + 1) % len(self._endpoints)
+            # exponential backoff with full jitter: a flapping server
+            # (or a whole fleet reconnecting at once after a leader
+            # kill) must not see a synchronized retry stampede
+            ceiling = min(self.backoff_max_s,
+                          self.backoff_s * (2 ** min(failures, 10)))
+            self._stop.wait(self.backoff_s / 4
+                            + self._rng.random() * ceiling)
 
     def _session(self) -> None:
-        sock = socket.create_connection((self.host, self.port),
+        host, port = self._endpoints[self._ep_index]
+        sock = socket.create_connection((host, port),
                                         timeout=self.timeout_s)
         self._sock = sock
         try:
@@ -542,16 +854,33 @@ class WitnessFeedClient:
             if hello.get("type") != "hello":
                 raise FeedError("feed did not open with hello")
             self.connections += 1
+            self._session_established = True
+            self.endpoint = (host, port)
             self.connected.set()
             if self.on_hello is not None:
                 self.on_hello(hello)
+            if self.last_seen_head is not None:
+                # resubscribe-from-last-seen-head: ask for the retained
+                # records this client missed while disconnected
+                with self._send_lock:
+                    send_frame(sock, {"type": "resubscribe",
+                                      "number": self.last_seen_head[0]})
+                self.resubscribes += 1
             while not self._stop.is_set():
                 frame = recv_frame(sock)
                 self.frames += 1
+                if isinstance(frame, dict) and \
+                        frame.get("type") in ("block", "head"):
+                    n, h = frame.get("number"), frame.get("hash")
+                    if isinstance(n, int) and (
+                            self.last_seen_head is None
+                            or n >= self.last_seen_head[0]):
+                        self.last_seen_head = (n, h)
                 if self.on_record is not None:
                     self.on_record(frame)
         finally:
             self._sock = None
+            self.endpoint = None
             try:
                 sock.close()
             except OSError:

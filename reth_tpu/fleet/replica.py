@@ -407,7 +407,8 @@ class ReplicaNode:
                  http_port: int = 0, retention: int = DEFAULT_RETENTION,
                  replica_id: str | None = None,
                  injector: ReplicaFaultInjector | None = None,
-                 gateway: bool = True, registry=None):
+                 gateway: bool = True, registry=None,
+                 failover_feeds=None, auto_register: bool = False):
         from ..metrics import ReplicaMetrics
 
         self.replica_id = replica_id or f"replica-{os.getpid()}"
@@ -436,9 +437,17 @@ class ReplicaNode:
         # correlated flight dumps seen (fan-out dedupe: a dump this
         # replica initiated comes back on the feed and must not re-dump)
         self._corr_seen: dict[str, bool] = {}
+        # HA failover: extra feed endpoints (the standby's takeover
+        # feed) the client rotates to when the leader dies; on hello
+        # from a NEW leader epoch, auto_register re-anchors this
+        # replica into the promoted leader's gateway ring
+        self.auto_register = auto_register
+        self.leader_epoch = 0
+        self.reregistrations = 0
         self.client = WitnessFeedClient(
             feed_host, feed_port,
-            on_hello=self._on_hello, on_record=self._on_record)
+            on_hello=self._on_hello, on_record=self._on_record,
+            endpoints=failover_feeds)
         self.gateway = None
         if gateway:
             # the replica runs its OWN serving gateway: identical reads
@@ -499,7 +508,19 @@ class ReplicaNode:
     # -- feed intake --------------------------------------------------------
 
     def _on_hello(self, hello: dict) -> None:
+        epoch = int(hello.get("epoch") or 0)
+        rpc_port = hello.get("rpc_port")
+        register_target = None
         with self.lock:
+            if epoch and epoch != self.leader_epoch:
+                # a new leader lineage (first connect, or a promoted
+                # standby after failover): re-anchor this replica into
+                # the leader's gateway ring so reads keep routing here
+                if self.auto_register and rpc_port and self.http_port:
+                    ep = self.client.endpoint
+                    if ep is not None:
+                        register_target = f"http://{ep[0]}:{rpc_port}"
+                self.leader_epoch = epoch
             self.chain_id = hello.get("chain_id", 1)
             spec = hello.get("spec")
             exec_spec = None
@@ -513,6 +534,29 @@ class ReplicaNode:
                                             hasher=self.hasher)
             if hello.get("head") is not None:
                 self.announced = tuple(hello["head"])
+        if register_target is not None:
+            threading.Thread(target=self._register_with,
+                             args=(register_target,), daemon=True,
+                             name="replica-reanchor").start()
+
+    def _register_with(self, url: str) -> None:
+        """Best-effort ``fleet_register`` against the (new) leader's
+        gateway — the ring re-anchor half of a failover."""
+        import json
+        import urllib.request
+
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": 1, "method": "fleet_register",
+            "params": [f"http://127.0.0.1:{self.http_port}"],
+        }).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10).read()
+            self.reregistrations += 1
+            tracing.event("fleet::replica", "reanchored", leader=url)
+        except Exception:  # noqa: BLE001 - the prober will retry reads
+            pass
 
     def _on_record(self, record: dict) -> None:
         kind = record.get("type")

@@ -1,0 +1,567 @@
+"""The hot-standby role: WAL-shipped replication + failover promotion.
+
+A standby is a full node in waiting. It subscribes to the leader's
+witness feed socket with ``subscribe_wal`` and continuously replays the
+``RTST1`` record stream (fleet/feed.py) into its OWN MemDb + WAL
+(storage/wal.py) — every shipped record is re-appended locally with the
+same fsync + torn-tail discipline the leader used, so the standby's
+datadir is at all times a valid crash-recoverable datadir. Wire records
+are vetted exactly like on-disk replay: the raw payload bytes must
+match their shipped crc32 (torn/corrupt → rejected), the epoch must not
+be stale, and the ``(gen, seq)`` position must continue the stream —
+a gap or an out-of-order generation re-anchors via an upstream
+``resync_request`` (the leader answers with a full consistent table
+image, ordered in-stream).
+
+Promotion (``following → catching-up → promoting → leading``,
+fleet/election.py) triggers on leader heartbeat loss over the feed
+socket or an explicit ``fleet_promote`` admin RPC:
+
+1. **catching-up** — the feed client stops; the durable tail is already
+   applied (application is synchronous with receipt).
+2. **promoting** — the leader epoch is bumped (``old + 1``), stamped
+   into every store, and checkpointed into the WAL manifest (the
+   fencing token a restarted old leader will find itself behind). Then
+   a full :class:`~reth_tpu.node.node.Node` is constructed over the
+   standby's datadir — the standard crash-recovery startup
+   (storage/recovery.py) replays the tail and **verifies the recovered
+   head state root by recomputation** before anything serves.
+3. **leading** — the node's RPC + witness feed start on the takeover
+   ports; replicas reconnect via their failover endpoint, see the
+   bumped epoch + the new leader's ``rpc_port`` in the hello, and
+   re-register with the promoted node's gateway ring.
+
+Fault injection (:class:`StandbyFaultInjector`):
+``RETH_TPU_FAULT_STANDBY_LAG=<seconds>`` delays each shipped record (a
+standby that falls progressively behind — the replay-lag SLO's drill);
+``RETH_TPU_FAULT_STANDBY_WEDGE[=N]`` freezes replication from the Nth
+record (heartbeats still count — a live but stuck standby).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+import zlib
+from dataclasses import replace
+from pathlib import Path
+
+from .. import tracing
+from ..rpc.server import RpcServer
+from ..storage.kv import MemDb
+from ..storage.wal import WalStore, _apply_delta
+from .election import HeartbeatMonitor, PromotionStateMachine
+from .feed import WitnessFeedClient
+
+
+class StandbyFaultInjector:
+    """Replication fault policies beside the replica's: ``wedge`` drops
+    every shipped record from the ``wedge_after``-th onward (the
+    standby keeps heartbeating but its replay lag grows unbounded),
+    ``lag_s`` sleeps before each one."""
+
+    def __init__(self, wedge: bool = False, lag_s: float = 0.0,
+                 wedge_after: int = 1):
+        self.wedge = wedge
+        self.wedge_after = max(1, wedge_after)
+        self.lag_s = lag_s
+        self.seen = 0
+        self.dropped = 0
+        self.lagged = 0
+
+    @classmethod
+    def from_env(cls, env=None) -> "StandbyFaultInjector | None":
+        env = os.environ if env is None else env
+        wedge_raw = env.get("RETH_TPU_FAULT_STANDBY_WEDGE", "")
+        wedge = wedge_raw not in ("", "0")
+        wedge_after = int(wedge_raw) if wedge_raw.isdigit() and wedge else 1
+        lag = float(env.get("RETH_TPU_FAULT_STANDBY_LAG", "0") or 0)
+        if not (wedge or lag):
+            return None
+        return cls(wedge=wedge, lag_s=lag, wedge_after=wedge_after)
+
+    @property
+    def wedging(self) -> bool:
+        return self.wedge and self.seen + 1 >= self.wedge_after
+
+    def on_record(self, kind: str) -> bool:
+        """Called per RTST1 record; True = drop it (wedge drill)."""
+        if self.lag_s:
+            self.lagged += 1
+            tracing.fault_event("RETH_TPU_FAULT_STANDBY_LAG",
+                                target="fleet::standby", kind=kind,
+                                lag_s=self.lag_s)
+            time.sleep(self.lag_s)
+        self.seen += 1
+        if self.wedge and self.seen >= self.wedge_after:
+            self.dropped += 1
+            tracing.fault_event("RETH_TPU_FAULT_STANDBY_WEDGE",
+                                target="fleet::standby", kind=kind)
+            return True
+        return False
+
+
+class StandbyAdminApi:
+    """The standby's admin surface: ``fleet_standbyStatus`` (the probe
+    the chaos drills and the HA bench poll) and ``fleet_promote`` (the
+    explicit failover trigger). Both ride the gateway's ENGINE
+    admission class when routed through a leader gateway — promotion
+    must never queue behind a debug trace."""
+
+    def __init__(self, standby: "StandbyNode"):
+        self.s = standby
+
+    def fleet_standbyStatus(self):
+        return self.s.status()
+
+    def fleet_promote(self):
+        self.s.promote("fleet_promote rpc")
+        return self.s.status()
+
+
+class _StandbyStore:
+    """One replicated store: the standby's own MemDb + WalStore pair
+    (index 0 = main, 1 = the storage-v2 aux), plus the LEADER-side
+    stream position used for continuity checks."""
+
+    def __init__(self, db: MemDb, wal: WalStore):
+        self.db = db
+        self.wal = wal
+        self.pos: tuple[int, int] | None = None  # leader (gen, seq)
+        self.owned: set = set()  # tables cloned since the last image
+        self.awaiting_resync = True
+
+
+class StandbyNode:
+    """A WAL-fed hot standby with a promotion state machine."""
+
+    def __init__(self, feed_host: str, feed_port: int, *,
+                 datadir: str | Path, standby_id: str | None = None,
+                 http_port: int = 0, takeover_feed_port: int = 0,
+                 auto_promote: bool = True,
+                 heartbeat_timeout_s: float = 2.0,
+                 injector: StandbyFaultInjector | None = None,
+                 promote_config=None, registry=None):
+        from ..metrics import StandbyMetrics
+
+        self.standby_id = standby_id or f"standby-{os.getpid()}"
+        self.datadir = Path(datadir)
+        self.datadir.mkdir(parents=True, exist_ok=True)
+        self.takeover_feed_port = takeover_feed_port
+        self.auto_promote = auto_promote
+        self.promote_config = promote_config
+        self.lock = threading.RLock()
+        self.started_at = time.time()
+        self.injector = (injector if injector is not None
+                         else StandbyFaultInjector.from_env())
+        self.metrics = StandbyMetrics(registry)
+        # store 0 opens eagerly (replays any prior standby session —
+        # the standby's datadir is always crash-recoverable); the aux
+        # store materializes on the first store=1 record
+        self.stores: dict[int, _StandbyStore] = {0: self._open_store(0)}
+        self.leader_epoch = self.stores[0].wal.epoch
+        self.leader_head: tuple[int, bytes] | None = None   # heartbeat
+        self.applied_head: tuple[int, bytes] | None = None  # last st_fcu
+        self.persisted_head: tuple[int, str] | None = None  # st_manifest
+        # counters — the wire-vetting ledger (satellite: wire corruption
+        # handled exactly like on-disk replay)
+        self.records_applied = 0
+        self.records_duplicate = 0
+        self.crc_rejected = 0
+        self.stale_epoch_rejected = 0
+        self.gen_rejected = 0
+        self.gap_detected = 0
+        self.resyncs_requested = 0
+        self.resyncs_applied = 0
+        self.manifests_applied = 0
+        self.promote_ms: float | None = None
+        self.promote_error: str | None = None
+        self.node = None  # the promoted full Node, once leading
+        self.node_ports: tuple[int, int] | None = None
+        self.promotion = PromotionStateMachine(
+            on_transition=self._on_transition)
+        self.monitor = HeartbeatMonitor(
+            timeout_s=heartbeat_timeout_s, on_loss=self._on_heartbeat_loss)
+        self.client = WitnessFeedClient(
+            feed_host, feed_port,
+            on_hello=self._on_hello, on_record=self._on_record)
+        self.rpc = RpcServer(port=http_port, lock=self.lock)
+        self.rpc.register(StandbyAdminApi(self))
+        self.http_port: int | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> int:
+        tracing.set_process_role("standby")
+        self.http_port = self.rpc.start()
+        self.monitor.start()
+        self.client.start()
+        return self.http_port
+
+    def stop(self) -> None:
+        self.monitor.stop()
+        self.client.stop()
+        self.rpc.stop()
+        if self.node is not None:
+            self.node.stop()
+            self.node = None
+        else:
+            for st in self.stores.values():
+                st.wal.close()
+
+    def _open_store(self, idx: int) -> _StandbyStore:
+        # layout mirrors the full node's (storage/__init__.py +
+        # storage/wal.attach_wal): the promoted Node opens the SAME
+        # files this standby wrote
+        name = "db.bin" if idx == 0 else "db-aux.bin"
+        wal_dir = self.datadir / ("wal" if idx == 0 else "wal-aux")
+        db = MemDb(self.datadir / name)
+        return _StandbyStore(db, WalStore.open(db, wal_dir))
+
+    def _store(self, idx: int) -> _StandbyStore:
+        st = self.stores.get(idx)
+        if st is None:
+            st = self.stores[idx] = self._open_store(idx)
+        return st
+
+    def _on_transition(self, state: str, why: str) -> None:
+        tracing.event("fleet::standby", "promotion", state=state, why=why)
+        self.metrics.set_state(state)
+
+    # -- feed intake --------------------------------------------------------
+
+    def _on_hello(self, hello: dict) -> None:
+        ep = int(hello.get("epoch") or 0)
+        with self.lock:
+            if ep > self.leader_epoch:
+                self.leader_epoch = ep
+                self.metrics.set_epoch(ep)
+        self.monitor.reset()
+        # subscribe to the WAL stream; a tail-exact position skips the
+        # image, anything else (first connect, restart, gap) resyncs
+        frm = None
+        with self.lock:
+            if all(not st.awaiting_resync and st.pos is not None
+                   for st in self.stores.values()):
+                frm = {i: list(st.pos) for i, st in self.stores.items()}
+            else:
+                for st in self.stores.values():
+                    st.awaiting_resync = True
+        self.client.send({"type": "subscribe_wal", "from": frm})
+
+    def _check_epoch(self, frame: dict) -> bool:
+        """False = frame rejected. A STALE epoch is a fenced old leader
+        still talking — refused like an on-disk stale-generation
+        segment. A HIGHER epoch is a new leader lineage: adopt it and
+        re-anchor from a fresh image."""
+        ep = int(frame.get("epoch") or 0)
+        with self.lock:
+            if ep < self.leader_epoch:
+                self.stale_epoch_rejected += 1
+                self.metrics.record_rejected("stale_epoch")
+                return False
+            if ep > self.leader_epoch:
+                self.leader_epoch = ep
+                self.metrics.set_epoch(ep)
+                self._request_resync()
+                return False
+        return True
+
+    def _request_resync(self) -> None:
+        for st in self.stores.values():
+            st.awaiting_resync = True
+        self.resyncs_requested += 1
+        self.metrics.record_resync_request()
+        self.client.send({"type": "resync_request"})
+
+    def _on_record(self, frame: dict) -> None:
+        if not isinstance(frame, dict):
+            return
+        kind = frame.get("type")
+        if kind == "st_heartbeat":
+            self.monitor.note()
+            head = frame.get("head")
+            if head is not None:
+                with self.lock:
+                    self.leader_head = (head[0], head[1])
+                    self._update_lag()
+            self._check_epoch(frame)
+            return
+        if kind not in ("st_wal", "st_manifest", "st_fcu", "st_resync"):
+            return  # witness traffic / flight dumps: not ours
+        if self.promotion.state != "following":
+            return  # promotion in flight: the stream is closed
+        if self.injector is not None and self.injector.on_record(kind):
+            return  # wedged: frozen replication, lag grows
+        if not self._check_epoch(frame):
+            return
+        if kind == "st_wal":
+            self._on_wal(frame)
+        elif kind == "st_manifest":
+            self._on_manifest(frame)
+        elif kind == "st_fcu":
+            with self.lock:
+                self.applied_head = (frame["number"], frame["hash"])
+                self._update_lag()
+        elif kind == "st_resync":
+            self._on_resync(frame)
+
+    def _on_wal(self, frame: dict) -> None:
+        st = self._store(int(frame.get("store", 0)))
+        payload = frame.get("payload")
+        # the on-disk discipline, applied to the wire: a record is
+        # usable iff its raw bytes verify against their crc32 — a torn
+        # or bit-rotted payload is rejected, never applied
+        if not isinstance(payload, (bytes, bytearray)) \
+                or zlib.crc32(payload) != frame.get("crc"):
+            self.crc_rejected += 1
+            self.metrics.record_rejected("crc")
+            if not st.awaiting_resync:
+                self._request_resync()
+            return
+        gen, seq = int(frame.get("gen", 0)), int(frame.get("seq", 0))
+        with self.lock:
+            if st.awaiting_resync:
+                return  # the in-stream image will anchor us
+            pgen, pseq = st.pos
+            if gen < pgen:
+                # out-of-order generation: a record from BEFORE a
+                # checkpoint the stream already crossed — the wire
+                # analogue of a mis-renamed segment, refused the same way
+                self.gen_rejected += 1
+                self.metrics.record_rejected("generation")
+                self._request_resync()
+                return
+            if seq <= pseq:
+                self.records_duplicate += 1
+                return
+            if seq != pseq + 1:
+                self.gap_detected += 1
+                self.metrics.record_rejected("gap")
+                self._request_resync()
+                return
+            try:
+                rec = pickle.loads(bytes(payload))
+            except Exception:  # noqa: BLE001 - undecodable = torn
+                self.crc_rejected += 1
+                self.metrics.record_rejected("crc")
+                self._request_resync()
+                return
+            delta = rec.get("tables", {})
+
+            def _publish():
+                _apply_delta(st.db._tables, delta, st.owned)
+                st.db._dirty = True
+
+            # durable-tail discipline: the shipped delta is re-appended
+            # to the standby's OWN WAL (fsync'd, same framing) before
+            # the in-memory publish — a standby killed at any byte
+            # boundary recovers to its last complete shipped commit
+            st.wal.append(delta, publish=_publish)
+            st.pos = (gen, seq)
+            self.records_applied += 1
+            self.metrics.record_applied()
+
+    def _on_manifest(self, frame: dict) -> None:
+        st = self._store(int(frame.get("store", 0)))
+        manifest = frame.get("manifest") or {}
+        with self.lock:
+            if st.awaiting_resync:
+                return
+            head = None
+            if manifest.get("head_number") is not None \
+                    and manifest.get("head_hash"):
+                head = (manifest["head_number"], manifest["head_hash"])
+                if int(frame.get("store", 0)) == 0:
+                    self.persisted_head = head
+            # checkpoint the standby's own WAL at the leader's boundary
+            # (image + manifest swap + log truncation), then track the
+            # leader's new generation for continuity
+            st.wal.checkpoint(head=head)
+            if st.pos is not None:
+                st.pos = (max(st.pos[0], int(manifest.get("gen", 0))),
+                          st.pos[1])
+            self.manifests_applied += 1
+
+    def _on_resync(self, frame: dict) -> None:
+        st = self._store(int(frame.get("store", 0)))
+        tables = frame.get("tables")
+        if not isinstance(tables, dict):
+            return
+        with self.lock:
+            # absolute-image re-anchor: replace the whole table map,
+            # then checkpoint so the image is durable immediately —
+            # exactly the quarantine-then-checkpoint shape of on-disk
+            # replay after mid-log corruption
+            st.db._tables = {k: dict(v) for k, v in tables.items()}
+            st.db._dirty = True
+            st.owned = set(st.db._tables)
+            st.pos = (int(frame.get("gen", 1)), int(frame.get("seq", 0)))
+            st.awaiting_resync = False
+            head = frame.get("head")
+            if head is not None and int(frame.get("store", 0)) == 0:
+                self.applied_head = (head[0], head[1])
+            st.wal.checkpoint(head=tuple(head) if head else None)
+            self.resyncs_applied += 1
+            self.metrics.record_resync_applied()
+            self._update_lag()
+
+    def _update_lag(self) -> None:
+        self.metrics.set_lag(self.lag_heads())
+
+    def lag_heads(self) -> int:
+        if self.leader_head is None:
+            return 0
+        applied = self.applied_head[0] if self.applied_head else 0
+        return max(0, self.leader_head[0] - applied)
+
+    # -- promotion ----------------------------------------------------------
+
+    def _on_heartbeat_loss(self, age_s: float) -> None:
+        if self.monitor.beats == 0 and self.resyncs_applied == 0:
+            # never saw a leader at all (started first / leader still
+            # booting): nothing to promote over — keep waiting
+            self.monitor.reset()
+            return
+        tracing.event("fleet::standby", "heartbeat_loss", age_s=age_s)
+        if self.auto_promote:
+            threading.Thread(
+                target=self.promote,
+                args=(f"heartbeat loss ({age_s:.2f}s)",),
+                daemon=True, name="ha-promote").start()
+
+    def promote(self, why: str = "manual") -> bool:
+        """Run the promotion ladder to ``leading``; idempotent — a
+        second trigger (heartbeat loss racing fleet_promote) returns
+        once the first finishes. False when promotion failed (root
+        verification) or was never applicable."""
+        if not self.promotion.advance("catching-up", why):
+            # already past following: wait for the in-flight promotion
+            deadline = time.time() + 60
+            while time.time() < deadline and self.promotion.state in (
+                    "catching-up", "promoting"):
+                time.sleep(0.05)
+            return self.promotion.is_leading()
+        t0 = time.monotonic()
+        # catching-up: stop the stream — application is synchronous
+        # with receipt, so once the client thread exits, the durable
+        # tail IS fully applied
+        self.monitor.stop()
+        self.client.stop()
+        self.promotion.advance("promoting", "durable tail applied")
+        with self.lock:
+            new_epoch = self.leader_epoch + 1
+            head = self.applied_head
+            for st in self.stores.values():
+                # the fencing token: the bumped epoch lands in every
+                # store's manifest BEFORE anything serves
+                st.wal.epoch = new_epoch
+                st.wal.checkpoint(
+                    head=head if st is self.stores[0] else None)
+                st.wal.close()
+            for st in self.stores.values():
+                st.db._wal = None
+        try:
+            node, ports = self._launch_node()
+        except Exception as e:  # noqa: BLE001 - surfaced, state = failed
+            self.promote_error = f"{type(e).__name__}: {e}"
+            self.promotion.advance("failed", self.promote_error)
+            self.metrics.record_promotion(failed=True)
+            return False
+        recovery = node.recovery or {}
+        if recovery.get("status") == "failed" or \
+                (recovery.get("root_verified") is False):
+            self.promote_error = (
+                f"recovered head root failed verification: "
+                f"{recovery.get('problems')}")
+            node.stop()
+            self.promotion.advance("failed", self.promote_error)
+            self.metrics.record_promotion(failed=True)
+            return False
+        self.node = node
+        self.node_ports = ports
+        self.leader_epoch = new_epoch
+        self.metrics.set_epoch(new_epoch)
+        self.promote_ms = (time.monotonic() - t0) * 1000.0
+        self.metrics.record_promotion(wall_s=self.promote_ms / 1000.0)
+        self.promotion.advance(
+            "leading", f"feed serving on :{node.feed_server.port}")
+        return True
+
+    def _launch_node(self):
+        """Construct the full Node over the standby's datadir: the
+        standard crash-recovery startup replays the durable tail and
+        verifies the recovered head root by recomputation — promotion
+        reuses the read-only verify path wholesale."""
+        from ..node.node import Node, NodeConfig
+
+        cfg = self.promote_config or NodeConfig()
+        cfg = replace(
+            cfg, datadir=str(self.datadir), db_backend="memdb",
+            dev=True, wal=True, fleet=True, rpc_gateway=True,
+            recovery_verify_root=True, feed_port=self.takeover_feed_port,
+            http_port=0, authrpc_port=0, genesis_header=None,
+            genesis_alloc={}, genesis_storage=None, genesis_codes=None)
+        node = Node(cfg)
+        ports = node.start_rpc()
+        return node, ports
+
+    # -- observability ------------------------------------------------------
+
+    def wait_state(self, state: str, timeout: float = 30.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.promotion.state == state:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def status(self) -> dict:
+        with self.lock:
+            node = self.node
+            return {
+                "id": self.standby_id,
+                "pid": os.getpid(),
+                "state": self.promotion.state,
+                "leader_epoch": self.leader_epoch,
+                "connected": self.client.connected.is_set(),
+                "applied_head": ({"number": self.applied_head[0],
+                                  "hash": self.applied_head[1].hex()
+                                  if isinstance(self.applied_head[1], bytes)
+                                  else self.applied_head[1]}
+                                 if self.applied_head else None),
+                "leader_head": ({"number": self.leader_head[0]}
+                                if self.leader_head else None),
+                "lag_heads": self.lag_heads(),
+                "records_applied": self.records_applied,
+                "records_duplicate": self.records_duplicate,
+                "crc_rejected": self.crc_rejected,
+                "stale_epoch_rejected": self.stale_epoch_rejected,
+                "gen_rejected": self.gen_rejected,
+                "gap_detected": self.gap_detected,
+                "resyncs_requested": self.resyncs_requested,
+                "resyncs_applied": self.resyncs_applied,
+                "manifests_applied": self.manifests_applied,
+                "awaiting_resync": any(st.awaiting_resync
+                                       for st in self.stores.values()),
+                "stores": len(self.stores),
+                "wedged": bool(self.injector is not None
+                               and self.injector.wedging),
+                "promote_ms": self.promote_ms,
+                "promote_error": self.promote_error,
+                "history": self.promotion.snapshot()["history"],
+                "node": ({"http_port": self.node_ports[0],
+                          "authrpc_port": self.node_ports[1],
+                          "feed_port": node.feed_server.port,
+                          "epoch": node.feed_server.epoch,
+                          "recovery": {
+                              "status": (node.recovery or {}).get("status"),
+                              "root_verified": (node.recovery or {}).get(
+                                  "root_verified"),
+                              "head_number": (node.recovery or {}).get(
+                                  "head_number")}}
+                         if node is not None else None),
+                "uptime_s": round(time.time() - self.started_at, 1),
+            }

@@ -490,6 +490,17 @@ def default_rules() -> list[SloRule]:
                 source=_fleet_stale, failing_factor=1e9,
                 help="replicas whose federated metrics are stale "
                      "(fleet_metricsSnapshot pulls failing)"),
+        # HA hot standby (fleet/standby.py): replay lag in heads behind
+        # the leader's heartbeat head. A trailing standby still promotes
+        # correctly (it finishes the durable tail first) but widens the
+        # failover's data-loss window toward the persistence threshold —
+        # degraded while it trails, failing when it has effectively
+        # stopped replaying (wedged feed thread / resync loop)
+        SloRule("standby_replay_lag", "ha", "gauge", 4.0,
+                metric="standby_replay_lag_heads", unit="heads",
+                failing_factor=8.0,
+                help="hot-standby replay lag (heads behind the leader "
+                     "heartbeat; bounds the failover loss window)"),
     ]
     return rules
 

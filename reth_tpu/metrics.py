@@ -1367,3 +1367,78 @@ class ReplicaMetrics:
 
     def record_blinded(self) -> None:
         self._blinded.increment()
+
+
+class StandbyMetrics:
+    """Hot-standby observability (fleet/standby.py): replay lag behind
+    the leader's heartbeat head (the HA SLO input), applied vs rejected
+    shipped records by rejection class, resync churn, the promotion
+    ladder position, and time-to-promote."""
+
+    _STATES = ("following", "catching-up", "promoting", "leading",
+               "failed")
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._reg = reg
+        self._lag = reg.gauge(
+            "standby_replay_lag_heads",
+            "leader heartbeat head minus the standby's applied head")
+        self._epoch = reg.gauge(
+            "standby_leader_epoch", "leader epoch the standby tracks")
+        self._state = reg.gauge(
+            "standby_promotion_state",
+            "promotion ladder position (0=following .. 3=leading, "
+            "-1=failed)")
+        self._applied = reg.counter(
+            "standby_records_applied_total",
+            "shipped WAL records applied to the standby's store")
+        self._rejected: dict[str, Counter] = {}
+        self._resync_requests = reg.counter(
+            "standby_resync_requests_total",
+            "gap/corruption re-anchors requested from the leader")
+        self._resync_applied = reg.counter(
+            "standby_resyncs_applied_total",
+            "full table images applied (stream re-anchored)")
+        self._promote_wall = reg.histogram(
+            "standby_promote_seconds",
+            "heartbeat-loss/fleet_promote to feed-serving wall",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+        self._promote_failures = reg.counter(
+            "standby_promote_failures_total",
+            "promotions aborted (root verification / node launch)")
+
+    def set_lag(self, lag: int) -> None:
+        self._lag.set(lag)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch.set(epoch)
+
+    def set_state(self, state: str) -> None:
+        self._state.set(self._STATES.index(state)
+                        if state in self._STATES[:-1] else -1)
+
+    def record_applied(self) -> None:
+        self._applied.increment()
+
+    def record_rejected(self, kind: str) -> None:
+        c = self._rejected.get(kind)
+        if c is None:
+            c = self._rejected[kind] = self._reg.counter(
+                "standby_records_rejected_total",
+                "shipped records refused (crc / stale_epoch / "
+                "generation / gap)", labels={"reason": kind})
+        c.increment()
+
+    def record_resync_request(self) -> None:
+        self._resync_requests.increment()
+
+    def record_resync_applied(self) -> None:
+        self._resync_applied.increment()
+
+    def record_promotion(self, wall_s: float | None = None,
+                         failed: bool = False) -> None:
+        if failed:
+            self._promote_failures.increment()
+        elif wall_s is not None:
+            self._promote_wall.record(wall_s)

@@ -200,6 +200,27 @@ class NodeEventReporter:
             if fs is not None and fs.flight_fanouts:
                 line += f" dumps={fs.flight_fanouts}"
             line += "]"
+        # HA: this leader's durable-stream shipping + fencing state —
+        # epoch lineage, how many standbys ride the WAL stream, records
+        # shipped vs dropped (a standby too slow for the ship queue),
+        # and whether this node is fenced (superseded by a promotion)
+        fs = getattr(self.node, "feed_server", None)
+        if fs is not None:
+            s = fs.snapshot()
+            if s.get("wal_subscribers") or s.get("st_records_sent") \
+                    or getattr(self.node.tree, "fenced", False):
+                line += (f" ha[epoch={s['epoch']}"
+                         f" standbys={s['wal_subscribers']}"
+                         f" shipped={s['st_records_sent']}")
+                if s.get("st_dropped"):
+                    line += f" dropped={s['st_dropped']}"
+                if s.get("resyncs_sent"):
+                    line += f" resyncs={s['resyncs_sent']}"
+                if s.get("partition_suppressed"):
+                    line += f" part={s['partition_suppressed']}"
+                if getattr(self.node.tree, "fenced", False):
+                    line += " FENCED"
+                line += "]"
         # rebuild-pipeline stage walls: during a chunked Merkle rebuild this
         # is the line that says where the time goes (host sweep vs hashing)
         from ..metrics import pipeline_metrics

@@ -130,6 +130,13 @@ class NodeConfig:
     # --fleet-max-lag: heads a replica may trail the node's head before
     # the ring sheds it (fleet/ring.py prober)
     fleet_max_lag: int = 4
+    # --ha-peer-feed: HOST:PORT witness feeds of HA peers (the standby's
+    # takeover feed). Probed at startup for epoch fencing: a live peer
+    # advertising a HIGHER leader epoch means this node was superseded
+    # while it was down — the engine tree fences (refuses stale writes)
+    # instead of splitting the brain. The leader also ships its WAL
+    # stream to any standby that subscribes on the feed (fleet/standby.py)
+    ha_peer_feeds: tuple[str, ...] = ()
 
 
 class Node:
@@ -300,6 +307,25 @@ class Node:
         # the engine's persistence advance is the durability boundary:
         # with a WAL it drives checkpoint cadence, without one it flushes
         self.tree.durability = self.durability
+        # HA epoch fencing (fleet/election.py): probe the configured
+        # peer feeds BEFORE any write path opens — a live peer with a
+        # higher persisted leader epoch supersedes this node
+        self.fence_report = None
+        if config.ha_peer_feeds and self.durability is not None:
+            from ..fleet.election import fence_check
+
+            peers = []
+            for spec in config.ha_peer_feeds:
+                host, _, port = str(spec).rpartition(":")
+                if host and port.isdigit():
+                    peers.append((host, int(port)))
+            self.fence_report = fence_check(self.durability.epoch, peers)
+            if self.fence_report["fenced"]:
+                self.tree.fence(
+                    f"superseded by leader epoch "
+                    f"{self.fence_report['peer_epoch']} at "
+                    f"{self.fence_report['peer']} (own epoch "
+                    f"{self.fence_report['own_epoch']})")
         from ..pool.pool import PoolConfig
 
         self.pool = TransactionPool(lambda: self.tree.overlay_provider(),
@@ -413,6 +439,13 @@ class Node:
                 self.tree, chain_id=config.chain_id,
                 chain_spec=config.chain_spec, port=config.feed_port)
             self.tree.canon_listeners.append(self.feed_server.on_canon_change)
+            # HA WAL shipping: every post-fsync commit record, checkpoint
+            # manifest, and fork-choice advance rides the feed to any
+            # subscribed standby (RTST1 records, fleet/standby.py); the
+            # feed's advertised epoch comes from the WAL manifest
+            if self.durability is not None:
+                self.feed_server.attach_durability(self.durability)
+                self.tree.fcu_listeners.append(self.feed_server.ship_fcu)
             self.fleet_router = FleetRouter(max_lag=config.fleet_max_lag)
             self.tree.canon_listeners.append(self.fleet_router.on_head_change)
             # metrics federation: background pulls of every replica's
@@ -644,6 +677,10 @@ class Node:
         The WS port (when enabled) is at ``self.ws.port`` after this."""
         self.event_reporter.start()
         ports = self.rpc.start(), self.authrpc.start()
+        if self.feed_server is not None:
+            # hello field: a re-anchoring replica registers with this
+            # node's fleet gateway at the advertised RPC port
+            self.feed_server.rpc_port = ports[0]
         if self.ws is not None:
             self.ws.start()
         if self.ipc is not None:

@@ -251,6 +251,16 @@ class WalStore:
         # NON-final segment broke framing before them — their records
         # were durably committed and are now lost, so recovery escalates
         self.lost_segments: list[str] = []
+        # fleet HA: monotonic leader epoch persisted in the manifest (a
+        # promoted standby bumps it; a restarted old leader compares it
+        # against the live feed's hello and fences itself if stale)
+        self.epoch = 1
+        # post-fsync shipping hooks (fleet/standby WAL replication):
+        # observer(gen, seq, payload) runs under the append lock AFTER
+        # the record is durable; manifest_observer(manifest) after each
+        # checkpoint swap. Failures must never gate local durability.
+        self.observer = None
+        self.manifest_observer = None
         self.last_checkpoint_head: tuple[int, str] | None = None
         self._ckpt_number: int | None = None
         self.max_segment_bytes = int(
@@ -316,6 +326,10 @@ class WalStore:
         if manifest:
             head = manifest.get("head_number")
             store._ckpt_number = head
+            try:
+                store.epoch = max(1, int(manifest.get("leader_epoch", 1)))
+            except (TypeError, ValueError):
+                store.epoch = 1
             if head is not None and manifest.get("head_hash"):
                 store.last_checkpoint_head = (head, manifest["head_hash"])
         if lost:
@@ -401,6 +415,11 @@ class WalStore:
                 self._metrics.record_append(len(frame) + len(payload),
                                             self._fh.tell())
             crash_point("wal-append")
+            if self.observer is not None:
+                try:
+                    self.observer(self.gen, self.seq, payload)
+                except Exception:  # noqa: BLE001 - shipping never gates
+                    pass
             if publish is not None:
                 publish()
 
@@ -441,7 +460,8 @@ class WalStore:
             if static_dir is not None and Path(static_dir).is_dir():
                 for p in sorted(Path(static_dir).glob("*.sf")):
                     jars[p.name] = jar_digest(p)
-            manifest = {"gen": new_gen, "written_at": time.time()}
+            manifest = {"gen": new_gen, "written_at": time.time(),
+                        "leader_epoch": self.epoch}
             if head is not None:
                 manifest["head_number"] = head[0]
                 manifest["head_hash"] = (head[1].hex()
@@ -459,6 +479,19 @@ class WalStore:
             fsync_dir(self.dir)
             self.checkpoints += 1
             self.last_checkpoint_s = time.time() - t0
+            if self.manifest_observer is not None:
+                try:
+                    self.manifest_observer(dict(manifest))
+                except Exception:  # noqa: BLE001 - shipping never gates
+                    pass
+
+    def snapshot_tables(self) -> tuple[dict, int, int]:
+        """Consistent ``(tables, gen, seq)`` image under the append lock
+        — the resync source for a fleet standby that detected a gap in
+        the shipped record stream."""
+        with self._lock:
+            return ({k: dict(v) for k, v in self.db._tables.items()},
+                    self.gen, self.seq)
 
     def segment_bytes(self) -> int:
         try:
@@ -512,6 +545,38 @@ class DurabilityManager:
     @property
     def main(self) -> WalStore:
         return self.stores[0]
+
+    # -- fleet HA shipping ----------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self.main.epoch
+
+    def set_epoch(self, epoch: int) -> None:
+        e = max(1, int(epoch))
+        for store in self.stores:
+            store.epoch = e
+
+    def attach_shipper(self, on_record, on_manifest=None) -> None:
+        """Route every durable append (and checkpoint manifest) to the
+        fleet shipping hooks as ``(store_index, gen, seq, payload)`` /
+        ``(store_index, manifest)`` — store_index disambiguates the
+        split-layout aux WAL."""
+        for i, store in enumerate(self.stores):
+            store.observer = (lambda gen, seq, payload, _i=i:
+                              on_record(_i, gen, seq, payload))
+            if on_manifest is not None:
+                store.manifest_observer = (lambda manifest, _i=i:
+                                           on_manifest(_i, manifest))
+
+    def detach_shipper(self) -> None:
+        for store in self.stores:
+            store.observer = None
+            store.manifest_observer = None
+
+    def snapshot_tables(self) -> list[tuple[dict, int, int]]:
+        """Per-store consistent table images (resync payloads)."""
+        return [store.snapshot_tables() for store in self.stores]
 
     def on_persisted(self, number: int, head_hash: bytes | None) -> None:
         """Called after every persistence advance (the durability
