@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-subtrie test-chaos test-reorg test-fleet test-fleet-obs test-ha native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-subtrie test-chaos test-reorg test-fleet test-fleet-obs test-ha test-import-pipeline native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -152,7 +152,20 @@ test-chaos:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_wal_recovery.py tests/test_chaos.py \
 	  tests/test_fleet.py tests/test_fleet_obs.py tests/test_ha.py \
+	  tests/test_block_pipeline.py \
 	  -q -p no:cacheprovider
+
+# cross-block import pipeline (engine/block_pipeline.py): randomized
+# serial-vs-pipelined differential imports (roots/receipts/senders
+# bit-identical), deterministic mid-commit speculation via a gated
+# commit leg, the abort ladder (tampered-root parent, fcU reorg
+# mid-speculation), lease hygiene, and depth plumbing — CPU-only.
+# The consensus chaos domain storms depth-2 trees on half its seeds
+# (see test-chaos / `python -m reth_tpu.chaos campaign --domain
+# consensus`); RETH_TPU_BENCH_MODE=import is the perf capture.
+test-import-pipeline:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_block_pipeline.py -q -p no:cacheprovider
 
 # leader/standby high availability: promotion state machine + heartbeat
 # monitor units, wire-framing corruption vetting (torn/CRC/stale-epoch/

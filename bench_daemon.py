@@ -144,7 +144,7 @@ def run_bench(accounts: int, slots: int, tier: int, watchdog: int) -> dict | Non
         )
     except subprocess.TimeoutExpired:
         return {"value": 0, "warmup_state": "unknown",
-                "dispatches_per_block": 0,
+                "dispatches_per_block": 0, "pipeline_depth": 1, "overlap_fraction": 0,
                 "error": f"bench subprocess exceeded {watchdog + 90}s"}
     for line in reversed(r.stdout.strip().splitlines()):
         try:
@@ -161,9 +161,11 @@ def run_bench(accounts: int, slots: int, tier: int, watchdog: int) -> dict | Non
             parsed.setdefault("n_devices", 1)
             parsed.setdefault("mesh_degraded", 0)
             parsed.setdefault("dispatches_per_block", 0)
+            parsed.setdefault("pipeline_depth", 1)
+            parsed.setdefault("overlap_fraction", 0)
             return parsed
     return {"value": 0, "warmup_state": "unknown", "n_devices": 1,
-            "mesh_degraded": 0, "dispatches_per_block": 0,
+            "mesh_degraded": 0, "dispatches_per_block": 0, "pipeline_depth": 1, "overlap_fraction": 0,
             "error": f"no JSON line, rc={r.returncode}: "
                      f"{(r.stderr or '')[-300:]}"}
 
@@ -197,9 +199,11 @@ def run_mesh_bench(watchdog: int = 900) -> dict | None:
             parsed.setdefault("n_devices", 0)
             parsed.setdefault("mesh_degraded", 0)
             parsed.setdefault("dispatches_per_block", 0)
+            parsed.setdefault("pipeline_depth", 1)
+            parsed.setdefault("overlap_fraction", 0)
             return parsed
     return {"value": 0, "n_devices": 0, "mesh_degraded": 0,
-            "dispatches_per_block": 0,
+            "dispatches_per_block": 0, "pipeline_depth": 1, "overlap_fraction": 0,
             "error": f"mesh bench: no JSON line, rc={r.returncode}: "
                      f"{(r.stderr or '')[-300:]}"}
 
@@ -236,9 +240,11 @@ def run_fleet_bench(watchdog: int = 900) -> dict | None:
             parsed.setdefault("single_node", {})
             parsed.setdefault("fleet_scaling", 0)
             parsed.setdefault("dispatches_per_block", 0)
+            parsed.setdefault("pipeline_depth", 1)
+            parsed.setdefault("overlap_fraction", 0)
             return parsed
     return {"value": 0, "per_fleet": {}, "fleet_scaling": 0,
-            "dispatches_per_block": 0,
+            "dispatches_per_block": 0, "pipeline_depth": 1, "overlap_fraction": 0,
             "error": f"fleet bench: no JSON line, rc={r.returncode}: "
                      f"{(r.stderr or '')[-300:]}"}
 

@@ -221,6 +221,12 @@ def make_consensus_scenario(seed: int) -> dict:
                              or rng.random() < 0.3),
         "hash_service": rng.random() < 0.4
         or "RETH_TPU_FAULT_SERVICE_STALL" in faults,
+        # cross-block import pipeline (engine/block_pipeline.py): half
+        # the seeds storm a depth-2 tree — two-deep payload bursts, fcU
+        # reorgs landing mid-speculation, tampered-root parents whose
+        # speculating children must abort cleanly. Drawn LAST so
+        # existing seeds' schedules stay bit-stable.
+        "pipeline": rng.random() < 0.5,
     })
     return scn
 
@@ -418,7 +424,8 @@ def child_victim(datadir: str, seed: int, blocks: int, threshold: int = 2,
 
 def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
                            threshold: int = 2, hash_service: bool = False,
-                           force_deep_reorg: bool = False) -> int:
+                           force_deep_reorg: bool = False,
+                           pipeline: bool = False) -> int:
     """Drive the dev node's engine tree as a hostile CL: seeded
     randomized interleavings of newPayload/forkchoiceUpdated — side
     forks at random depths, deep reorgs across the persistence
@@ -440,8 +447,26 @@ def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
     from .testing_actions import ForkBuilder, tampered_block
 
     datadir = Path(datadir)
+    if pipeline:
+        # EngineTree resolves the pipeline depth from the env at
+        # construction; set it before the node is built
+        os.environ["RETH_TPU_PIPELINE_DEPTH"] = "2"
     node, wallet, builder = _build_node(datadir, seed, threshold,
                                         hash_service, fresh=True)
+    if pipeline and node.tree.pipeline is None:
+        raise AssertionError("pipeline storm requested but tree has none")
+    if pipeline:
+        # slow-device injector: stretch the commit leg so the storm's
+        # two-deep bursts reliably land INSIDE the parent's commit
+        # window (CPU roots on 1-2 tx blocks close in ~ms, faster than
+        # a payload round-trip — a real device dispatch does not)
+        _orig_root = node.tree._sparse_root_or_fallback
+
+        def _slow_root(*a, **kw):
+            time.sleep(0.08)
+            return _orig_root(*a, **kw)
+
+        node.tree._sparse_root_or_fallback = _slow_root
     http_port, _ = node.start_rpc()
     fb = ForkBuilder(builder.genesis, builder.accounts_at_genesis,
                      wallet=wallet, committer=_cpu_committer())
@@ -599,10 +624,104 @@ def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
                 f"invalid cache exceeded its bound: "
                 f"{len(node.tree.invalid)} > {cap}")
 
+    # -- cross-block pipeline ops (depth-2 trees only): two payloads in
+    # flight at once, so block N+1 speculates over N's open commit
+    # window while the storm's faults fire underneath. Every outcome the
+    # pipeline can produce is legal here EXCEPT an unclean one: a leaked
+    # lease, a stuck speculation slot, or a root the fault-free twin
+    # disagrees with (the expect() on VALID already certifies roots).
+    import threading as _threading
+
+    def _two_deep(a, b):
+        """Submit ``a`` then ``b`` with ``b`` landing while ``a`` is
+        (likely) mid-commit; returns (status_a, status_b)."""
+        res = {}
+        ta = _threading.Thread(
+            target=lambda: res.setdefault("a", node.tree.on_new_payload(a)))
+        ta.start()
+        node.tree.pipeline.wait_commit_open(a.hash, timeout=30)
+        res.setdefault("b", node.tree.on_new_payload(b))
+        ta.join(timeout=120)
+        if ta.is_alive():
+            raise AssertionError("pipeline storm: parent insert hung")
+        return res["a"], res["b"]
+
+    def op_pipe_extend():
+        a = fb.block_on(head, txs=rng.randint(1, 2), salt=rng.randint(23, 25))
+        b = fb.block_on(a.hash, txs=rng.randint(0, 2), salt=0)
+        st_a, st_b = _two_deep(a, b)
+        expect(st_a, VALID, op="pipe.parent")
+        expect(st_b, VALID, SYNCING, op="pipe.child")
+        if st_b.status is not VALID:
+            expect(node.tree.on_new_payload(b), VALID, op="pipe.child.retry")
+        fcu(b.hash, VALID, op="pipe.fcu")
+
+    def op_pipe_reorg():
+        # a known side fork, then an fcU to it lands mid-speculation:
+        # the speculative child must abort (or already have adopted) and
+        # the chain must remain importable either way
+        fork = fb.block_on(head, txs=0, salt=26)
+        expect(node.tree.on_new_payload(fork), VALID, SYNCING,
+               op="pipe.fork")
+        a = fb.block_on(head, txs=1, salt=27)
+        b = fb.block_on(a.hash, txs=1, salt=0)
+        res = {}
+        ta = _threading.Thread(
+            target=lambda: res.setdefault("a", node.tree.on_new_payload(a)))
+        ta.start()
+        node.tree.pipeline.wait_commit_open(a.hash, timeout=30)
+        tb = _threading.Thread(
+            target=lambda: res.setdefault("b", node.tree.on_new_payload(b)))
+        tb.start()
+        fcu(fork.hash, VALID, SYNCING, op="pipe.reorg.fcu")
+        ta.join(timeout=120)
+        tb.join(timeout=120)
+        if ta.is_alive() or tb.is_alive():
+            raise AssertionError("pipeline storm: reorged insert hung")
+        # the racing fcU may have cancelled either insert (SYNCING, the
+        # CL re-sends) — never INVALID, the payloads are valid
+        expect(res["a"], VALID, SYNCING, op="pipe.reorg.parent")
+        expect(res["b"], VALID, SYNCING, op="pipe.reorg.child")
+        if res["a"].status is not VALID or a.hash not in node.tree.blocks:
+            expect(node.tree.on_new_payload(a), VALID, op="pipe.reorg.a2")
+        if res["b"].status is not VALID or b.hash not in node.tree.blocks:
+            expect(node.tree.on_new_payload(b), VALID, op="pipe.reorg.b2")
+        fcu(b.hash, VALID, op="pipe.reorg.back")
+
+    def op_pipe_invalid():
+        # a tampered-root parent with its child speculating over the
+        # doomed commit window: the abort ladder must fire, the child
+        # must never be adopted, and both must end INVALID
+        base = fb.block_on(head, txs=1, salt=28)
+        bad = tampered_block(base, "state_root")
+        child = tampered_block(base, "reparent", salt=bad.hash)
+        res = {}
+        ta = _threading.Thread(
+            target=lambda: res.setdefault("a", node.tree.on_new_payload(bad)))
+        ta.start()
+        node.tree.pipeline.wait_commit_open(bad.hash, timeout=30)
+        res.setdefault("b", node.tree.on_new_payload(child))
+        ta.join(timeout=120)
+        if ta.is_alive():
+            raise AssertionError("pipeline storm: invalid insert hung")
+        expect(res["a"], INVALID, op="pipe.invalid.parent")
+        # mid-flight the child may only buffer (SYNCING); once the
+        # parent is known-invalid a re-send must say INVALID
+        expect(res["b"], INVALID, SYNCING, op="pipe.invalid.child")
+        if res["b"].status is SYNCING:
+            expect(node.tree.on_new_payload(child), INVALID,
+                   op="pipe.invalid.child2")
+        if child.hash in node.tree.blocks:
+            raise AssertionError(
+                "pipeline storm: child adopted off an invalid parent")
+
     ops = [(op_extend, 4), (op_side_fork, 3), (op_deep_reorg, 1),
            (op_rewind, 1), (op_orphan, 2), (op_duplicate, 1),
            (op_unknown_orphan, 1), (op_invalid, 2), (op_fcu_unknown, 1),
            (op_invalid_flood, 1)]
+    if node.tree.pipeline is not None:
+        ops += [(op_pipe_extend, 3), (op_pipe_reorg, 2),
+                (op_pipe_invalid, 2)]
     weights = [w for _, w in ops]
     i = 0
     while rounds <= 0 or i < rounds:
@@ -649,11 +768,25 @@ def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
         raise AssertionError("leaked store writer lock after the storm")
     if len(node.tree.invalid) > node.tree.invalid.capacity:
         raise AssertionError("invalid cache over its bound after the storm")
+    pipe_stats = {}
+    if node.tree.pipeline is not None:
+        pipe_stats = node.tree.pipeline.stats_snapshot()
+        if pipe_stats["leases_active"]:
+            raise AssertionError(
+                f"leaked pipeline sub-mesh lease after the storm: "
+                f"{pipe_stats}")
+        if node.tree.pipeline._spec is not None:
+            raise AssertionError(
+                "stuck speculation slot after the storm")
     print(f"STORM ok seed={seed} rounds={i} head={fb.number_of(head)} "
           f"reorgs={node.tree.reorgs.reorgs} "
           f"deep={node.tree.reorgs.max_depth} "
           f"invalid_cached={len(node.tree.invalid)} "
-          f"orphans={len(node.tree.buffered)}", flush=True)
+          f"orphans={len(node.tree.buffered)}"
+          + (f" pipe_spec={pipe_stats['speculations']}"
+             f" pipe_adopt={pipe_stats['adopted']}"
+             f" pipe_abort={pipe_stats['aborted']}"
+             if pipe_stats else ""), flush=True)
     node.stop()
     return 0
 
@@ -1643,6 +1776,8 @@ def _child_cmd(mode: str, datadir: Path, scn: dict) -> list[str]:
         cmd += ["--rounds", str(scn["rounds"])]
         if scn.get("force_deep_reorg"):
             cmd.append("--force-deep-reorg")
+        if scn.get("pipeline"):
+            cmd.append("--pipeline")
     elif mode == "victim":
         cmd += ["--blocks", str(scn["blocks"]),
                 "--reorg-at", str(scn.get("reorg_at", 0))]
@@ -1856,6 +1991,8 @@ def main(argv=None) -> int:
                     action="store_true")
     pk.add_argument("--force-deep-reorg", dest="force_deep_reorg",
                     action="store_true")
+    pk.add_argument("--pipeline", action="store_true",
+                    help="storm a depth-2 cross-block import pipeline")
 
     pr = sub.add_parser("recover", help="(child) restart + invariant suite")
     pr.add_argument("--datadir", required=True)
@@ -1918,7 +2055,7 @@ def main(argv=None) -> int:
     if args.command == "consensus":
         return child_consensus_victim(args.datadir, args.seed, args.rounds,
                                       args.threshold, args.hash_service,
-                                      args.force_deep_reorg)
+                                      args.force_deep_reorg, args.pipeline)
     if args.command == "recover":
         return child_recover(args.datadir, args.seed, args.threshold,
                              args.hash_service)

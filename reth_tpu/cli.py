@@ -467,6 +467,7 @@ def cmd_node(args):
                      storage_v2=getattr(args, "storage_v2", None),
                      sparse_workers=getattr(args, "sparse_workers", None),
                      parallel_exec=getattr(args, "parallel_exec", False),
+                     pipeline_depth=getattr(args, "pipeline_depth", None),
                      rpc_gateway=getattr(args, "rpc_gateway", False),
                      warmup=warm_mode,
                      compile_cache_dir=warm_cache,
@@ -877,6 +878,7 @@ def cmd_config(args):
         f"sparse_workers = {cfg.sparse_workers}",
         f"subtrie_levels = {cfg.subtrie_levels}",
         f"parallel_exec = {'true' if cfg.parallel_exec else 'false'}",
+        f"pipeline_depth = {cfg.pipeline_depth}",
         f"trace_blocks = {'true' if cfg.trace_blocks else 'false'}",
         f"health = {'true' if cfg.health else 'false'}",
         f"slo_interval = {cfg.slo_interval}",
@@ -1304,6 +1306,22 @@ def main(argv=None) -> int:
                         "width: RETH_TPU_EXEC_WORKERS (default "
                         "cpu-derived). Also settable as [node] "
                         "parallel_exec in reth.toml")
+    p.add_argument("--pipeline-depth", dest="pipeline_depth", type=int,
+                   default=None, metavar="N",
+                   help="cross-block import pipeline depth "
+                        "(engine/block_pipeline.py): 2 = start optimistic "
+                        "execution of payload N+1 over block N's frozen "
+                        "commit window while N's fused state-root "
+                        "dispatches run, with speculative prewarm + "
+                        "multiproof prefetch on a double-buffered hash "
+                        "sub-mesh lease; adoption re-runs every consensus "
+                        "and root check, so results stay bit-identical to "
+                        "serial imports, and fcU reorgs / invalid parents "
+                        "abort the speculation through the cooperative "
+                        "cancellation ladder. 1 = strictly serial "
+                        "(default). Env fallback: RETH_TPU_PIPELINE_DEPTH. "
+                        "Also settable as [node] pipeline_depth in "
+                        "reth.toml")
     p.add_argument("--rpc-gateway", dest="rpc_gateway", action="store_true",
                    default=False,
                    help="route every RPC transport (HTTP/WS/IPC + the "

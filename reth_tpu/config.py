@@ -145,6 +145,12 @@ class RethTpuConfig:
     # fallback (engine/optimistic.py). Speculation width comes from
     # RETH_TPU_EXEC_WORKERS (default cpu-derived).
     parallel_exec: bool = False
+    # cross-block import pipeline depth (--pipeline-depth CLI
+    # equivalent, engine/block_pipeline.py): 2 = execute block N+1 over
+    # N's frozen commit window while N's fused root dispatches run;
+    # 1 = strictly serial imports. Env RETH_TPU_PIPELINE_DEPTH is the
+    # fallback when unset.
+    pipeline_depth: int = 1
     # block-lifecycle tracing (--trace-blocks CLI equivalent): record
     # per-block span timelines, export Chrome-trace JSON under the
     # datadir, and point flight-recorder dumps there (tracing.py)
@@ -218,6 +224,7 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.sparse_workers = int(node.get("sparse_workers", cfg.sparse_workers))
     cfg.subtrie_levels = int(node.get("subtrie_levels", cfg.subtrie_levels))
     cfg.parallel_exec = bool(node.get("parallel_exec", cfg.parallel_exec))
+    cfg.pipeline_depth = int(node.get("pipeline_depth", cfg.pipeline_depth))
     cfg.trace_blocks = bool(node.get("trace_blocks", cfg.trace_blocks))
     cfg.health = bool(node.get("health", cfg.health))
     cfg.slo_interval = float(node.get("slo_interval", cfg.slo_interval))

@@ -672,6 +672,61 @@ def generate_suite(seeds_per_scenario: int = 10) -> dict[str, dict]:
     return suite
 
 
+def _suite_cache_path(seeds_per_scenario: int) -> str | None:
+    import hashlib
+    import os
+
+    if os.environ.get("RETH_TPU_CONFORMANCE_CACHE", "1") == "0":
+        return None
+    try:
+        with open(__file__, "rb") as f:
+            key = hashlib.sha256(f.read()).hexdigest()[:12]
+    except OSError:
+        return None
+    cache_dir = os.environ.get("RETH_TPU_CONFORMANCE_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "tests", ".conformance_cache")
+    return os.path.join(cache_dir, f"suite-{seeds_per_scenario}x-{key}.json")
+
+
+def load_or_generate_suite(seeds_per_scenario: int = 10) -> dict[str, dict]:
+    """``generate_suite`` behind a content-addressed disk cache.
+
+    Generating the corpus executes every chain through the real EVM and
+    seals real roots — minutes of CPU for hundreds of cases — but the
+    output is pure deterministic data in the ef-tests JSON shape the
+    runner consumes from disk anyway (``run_fixture_file`` is
+    json.load → run_blockchain_test). The cache key is the sha256 of
+    THIS file, so editing any scenario regenerates; the replay itself
+    (the actual conformance check) always runs in full against the
+    current pipeline. ``RETH_TPU_CONFORMANCE_CACHE=0`` disables, or
+    delete tests/.conformance_cache/ to force regeneration.
+    """
+    import os
+
+    path = _suite_cache_path(seeds_per_scenario)
+    if path:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            pass
+    suite = generate_suite(seeds_per_scenario)
+    if path:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(suite, f, separators=(",", ":"))
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return suite
+
+
 def write_suite(path: str, seeds_per_scenario: int = 10) -> int:
     suite = generate_suite(seeds_per_scenario)
     with open(path, "w") as f:

@@ -56,7 +56,7 @@ class SparseRootTask:
     def __init__(self, parent_provider, parent_root: bytes, preserved,
                  committer, parent_hash: bytes | None = None,
                  provider_factory=None, workers: int | None = None,
-                 trace_ctx=None):
+                 trace_ctx=None, seed_digests=None):
         # live tip is the highest-priority hash-service lane: with
         # --hash-service the task's batches coalesce with every other
         # client's but dispatch first; without one this is committer.hasher
@@ -94,6 +94,12 @@ class SparseRootTask:
             self.trie = SparseStateTrie.anchored(parent_root)
         self._queue: queue.Queue = queue.Queue()
         self._digests: dict[bytes, bytes] = {}
+        if seed_digests:
+            # cross-block pipeline adoption: the speculative stage
+            # pre-hashed the touched keys on the double-buffered sub-mesh
+            # while the parent committed — seed them so _process skips
+            # re-hashing (proof fetch + reveal still run normally)
+            self._digests.update(seed_digests)
         self._sent: set = set()
         self._failed: Exception | None = None
         # cooperative cancellation (engine/tree.py _cancel_inflight_for):

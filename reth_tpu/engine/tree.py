@@ -65,6 +65,9 @@ class _InFlightInsert:
     parent_hash: bytes
     cancel: threading.Event = field(default_factory=threading.Event)
     sparse_task: object = None
+    # commit window published to the cross-block pipeline once this
+    # insert enters its state-root phase (engine/block_pipeline.py)
+    commit_win: object = None
 
 
 @dataclass
@@ -106,6 +109,7 @@ class EngineTree:
         invalid_cache_size: int | None = None,
         block_buffer_size: int | None = None,
         block_buffer_ttl: float | None = None,
+        pipeline_depth: int | None = None,
     ):
         self.factory = factory
         self.committer = committer or TrieCommitter()
@@ -196,6 +200,23 @@ class EngineTree:
         # which the speculative paths (sparse root, optimistic exec,
         # prewarm) stand down — they are exactly what the churn thrashes
         self.reorgs = ReorgTracker()
+        # cross-block import pipeline (engine/block_pipeline.py): depth
+        # >= 2 speculatively executes payload N+1 over N's uncommitted
+        # overlay while N's state-root job runs on the device
+        # (--pipeline-depth / RETH_TPU_PIPELINE_DEPTH; 1 = serial import)
+        if pipeline_depth is None:
+            import os
+
+            try:
+                pipeline_depth = int(
+                    os.environ.get("RETH_TPU_PIPELINE_DEPTH", "1"))
+            except ValueError:
+                pipeline_depth = 1
+        self.pipeline = None
+        if pipeline_depth >= 2:
+            from .block_pipeline import BlockPipeline
+
+            self.pipeline = BlockPipeline(self, depth=pipeline_depth)
         # the insert currently in flight (engine transports may race a
         # forkchoiceUpdated against it); guarded by _inflight_lock
         self._inflight: _InFlightInsert | None = None
@@ -298,6 +319,28 @@ class EngineTree:
             if p.canonical_hash(block.header.number) == h:
                 return PayloadStatus(PayloadStatusKind.VALID, h)
         parent_layers = self._chain_layers(block.header.parent_hash)
+        if parent_layers is None and self.pipeline is not None:
+            # parent may be the block currently committing: speculate —
+            # execute this payload over the parent's uncommitted overlay
+            # while its state-root dispatches run, adopt on VALID
+            # (engine/block_pipeline.py); None means the pipeline didn't
+            # handle it and the normal buffer/SYNCING path decides below
+            st = self.pipeline.try_speculate(block)
+            if st is not None:
+                if st.status is PayloadStatusKind.VALID:
+                    self._replay_buffered_children(h)
+                elif st.status is PayloadStatusKind.INVALID:
+                    self._invalidate_buffered_children(h)
+                return st
+            if block.header.parent_hash in self.invalid:
+                # the parent was judged INVALID while we speculated
+                self.invalid[h] = "invalid ancestor"
+                self._invalidate_buffered_children(h)
+                return PayloadStatus(PayloadStatusKind.INVALID, None,
+                                     "invalid ancestor")
+            if h in self.blocks:  # a buffered replay raced us in
+                return PayloadStatus(PayloadStatusKind.VALID, h)
+            parent_layers = self._chain_layers(block.header.parent_hash)
         if parent_layers is None:
             # parent unknown or below the persisted tip: buffer; the
             # parent arriving (below) or a later FCU to this branch
@@ -330,7 +373,8 @@ class EngineTree:
             self.invalid[child.hash] = "invalid ancestor"
             self._invalidate_buffered_children(child.hash)
 
-    def _validate_and_insert(self, block: Block, parent_layers: list[Layer]) -> PayloadStatus:
+    def _validate_and_insert(self, block: Block, parent_layers: list[Layer],
+                             pre_executed=None) -> PayloadStatus:
         h = block.hash
         base = self.factory.db.tx()
         layer: Layer = {}
@@ -338,6 +382,7 @@ class EngineTree:
         inflight = _InFlightInsert(h, block.header.parent_hash)
         with self._inflight_lock:
             self._inflight = inflight
+        status = None
         try:
             # block-lifecycle trace root: trace_id = block hash; every
             # phase span below (and every queue/pool handoff that carries
@@ -350,7 +395,8 @@ class EngineTree:
                         block.header, parent)
                     self.consensus.validate_block_pre_execution(block)
                 status, senders, receipts = self._execute_into_overlay(
-                    block, overlay, parent_layers, inflight=inflight)
+                    block, overlay, parent_layers, inflight=inflight,
+                    pre_executed=pre_executed)
         except (ConsensusError, InvalidTransaction) as e:
             self.invalid[h] = str(e)
             self._run_invalid_hooks(block, str(e))
@@ -367,12 +413,22 @@ class EngineTree:
             with self._inflight_lock:
                 if self._inflight is inflight:
                     self._inflight = None
+            # non-VALID exit (exception, INVALID, cancel): close this
+            # insert's commit window NOW so a speculating child aborts;
+            # the VALID path closes below, AFTER the block is visible in
+            # the tree (adoption needs it in ``blocks``)
+            if (inflight.commit_win is not None and self.pipeline is not None
+                    and (status is None
+                         or status.status is not PayloadStatusKind.VALID)):
+                self.pipeline.close_commit(inflight.commit_win, ok=False)
         if status.status is PayloadStatusKind.VALID:
             self.blocks[h] = ExecutedBlock(
                 block=block, senders=senders, receipts=receipts,
                 layer=layer, parent_hash=block.header.parent_hash,
             )
             self.buffered.pop(h, None)
+            if inflight.commit_win is not None and self.pipeline is not None:
+                self.pipeline.close_commit(inflight.commit_win, ok=True)
         return status
 
     def _header_of(self, block_hash: bytes, overlay: DatabaseProvider):
@@ -388,11 +444,15 @@ class EngineTree:
         self, block: Block, overlay: DatabaseProvider,
         parent_layers: list[Layer] | None = None,
         inflight: _InFlightInsert | None = None,
+        pre_executed=None,
     ) -> tuple[PayloadStatus, list[bytes], list]:
         """Execute + hash + root-check ``block``, writing into the overlay.
 
         Returns (status, senders, receipts); senders/receipts are empty on
-        invalid payloads.
+        invalid payloads. With ``pre_executed`` (a SpeculationResult from
+        the cross-block pipeline) execution is already done: its output
+        feeds the SAME post-validation, overlay writes, and root checks a
+        fresh execution would — adoption never skips a consensus check.
         """
         header = block.header
         n = header.number
@@ -405,25 +465,35 @@ class EngineTree:
             # the block timeline made the three redundant recomputations
             # on this path visible
             block_hash = block.hash
-            if self._cache_anchor != header.parent_hash:
-                self.execution_cache = type(self.execution_cache)()  # reset
-                # the fresh cache is warmed with THIS parent's state: anchor
-                # it now, or a failed sibling would leave cache/anchor
-                # divergent
+            if pre_executed is not None:
+                # adopt the speculation's warmed cache as the tree's
+                # cross-block cache (it was warmed on exactly this
+                # parent's state); finalize below advances its anchor
+                self.execution_cache = pre_executed.cache
                 self._cache_anchor = header.parent_hash
-            source = CachedStateSource(ProviderStateSource(overlay),
-                                       self.execution_cache)
-            executor = BlockExecutor(source, self.config)
-            hashes = {}
-            for k in range(max(0, n - 256), n):
-                bh = overlay.canonical_hash(k)
-                if bh:
-                    hashes[k] = bh
+                source = executor = None
+                hashes = {}
+            else:
+                if self._cache_anchor != header.parent_hash:
+                    self.execution_cache = type(self.execution_cache)()  # reset
+                    # the fresh cache is warmed with THIS parent's state:
+                    # anchor it now, or a failed sibling would leave
+                    # cache/anchor divergent
+                    self._cache_anchor = header.parent_hash
+                source = CachedStateSource(ProviderStateSource(overlay),
+                                           self.execution_cache)
+                executor = BlockExecutor(source, self.config)
+                hashes = {}
+                for k in range(max(0, n - 256), n):
+                    bh = overlay.canonical_hash(k)
+                    if bh:
+                        hashes[k] = bh
         from ..primitives.types import recover_senders
 
         with tracing.span("engine::tree", "recover_senders",
                           txs=len(block.transactions)):
-            senders = recover_senders(block.transactions)
+            senders = (pre_executed.senders if pre_executed is not None
+                       else recover_senders(block.transactions))
         if any(s is None for s in senders):
             bad = next(i for i, s in enumerate(senders) if s is None)
             try:
@@ -453,8 +523,13 @@ class EngineTree:
         block_ctx = tracing.current_context()  # the block's root span
         with tracing.span("engine::tree", "root_task_start"):
             if self.state_root_strategy == "sparse" and speculate:
-                sparse_task = self._start_sparse_root(block, parent_layers,
-                                                      trace_ctx=block_ctx)
+                sparse_task = self._start_sparse_root(
+                    block, parent_layers, trace_ctx=block_ctx,
+                    # adoption seeds the key digests the speculative
+                    # prehash already computed on the double-buffered
+                    # sub-mesh — the task skips re-hashing them
+                    seed_digests=(pre_executed.digests
+                                  if pre_executed is not None else None))
             if sparse_task is None:
                 from .pipelined_root import PipelinedStateRoot
 
@@ -471,13 +546,14 @@ class EngineTree:
         # sparse task), and validation-clean speculation commits instead
         # of being discarded and re-executed.
         use_opt = (self.parallel_exec and not self.bal_execution and speculate
+                   and pre_executed is None
                    and len(block.transactions) >= self.prewarm_threshold)
         # prewarm: execute txs in parallel against PARENT state first,
         # purely to populate the execution cache (reference
         # payload_processor/prewarm.rs); canonical execution below then
         # runs against warm caches
         if (len(block.transactions) >= self.prewarm_threshold and not use_opt
-                and speculate):
+                and speculate and pre_executed is None):
             from ..evm.executor import blob_base_fee
             from ..evm.interpreter import BlockEnv
             from .prewarm import PrewarmTask
@@ -528,39 +604,55 @@ class EngineTree:
 
         use_bal = (self.bal_execution and self.last_prewarm is not None
                    and self.last_prewarm.record_accesses)
+        t_exec0 = _time.monotonic()
         try:
-            with tracing.span("engine::execute", "execute",
-                              txs=len(block.transactions), bal=use_bal,
-                              optimistic=use_opt):
-                if use_bal:
-                    from .bal import BlockAccessList, execute_block_bal
+            if pre_executed is not None:
+                # cross-block pipeline adoption: execution already ran
+                # over this parent's uncommitted overlay while it was
+                # committing; feed the root task its touched keys in one
+                # burst (digests were seeded above) and reuse the output
+                with tracing.span("engine::tree", "adopt_speculation",
+                                  txs=len(block.transactions),
+                                  keys=len(pre_executed.keys)):
+                    state_hook(pre_executed.keys)
+                    out = pre_executed.out
+                    self.last_exec = pre_executed.stats
+                    if pre_executed.stats is not None:
+                        self._record_exec_metrics(
+                            optimistic=pre_executed.stats)
+            else:
+                with tracing.span("engine::execute", "execute",
+                                  txs=len(block.transactions), bal=use_bal,
+                                  optimistic=use_opt):
+                    if use_bal:
+                        from .bal import BlockAccessList, execute_block_bal
 
-                    self.last_prewarm.join()
-                    hint = BlockAccessList(entries=[
-                        self.last_prewarm.accesses[i]
-                        for i in sorted(self.last_prewarm.accesses)])
-                    out, self.last_bal_stats = execute_block_bal(
-                        executor.source, block, senders, hint, self.config,
-                        state_hook=state_hook, block_hashes=hashes)
-                    self._record_exec_metrics(bal=self.last_bal_stats)
-                elif use_opt:
-                    from .optimistic import ExecCancelled, execute_block_optimistic
+                        self.last_prewarm.join()
+                        hint = BlockAccessList(entries=[
+                            self.last_prewarm.accesses[i]
+                            for i in sorted(self.last_prewarm.accesses)])
+                        out, self.last_bal_stats = execute_block_bal(
+                            executor.source, block, senders, hint, self.config,
+                            state_hook=state_hook, block_hashes=hashes)
+                        self._record_exec_metrics(bal=self.last_bal_stats)
+                    elif use_opt:
+                        from .optimistic import ExecCancelled, execute_block_optimistic
 
-                    try:
-                        out, self.last_exec = execute_block_optimistic(
-                            executor.source, block, senders, self.config,
-                            max_workers=self.exec_workers,
-                            state_hook=state_hook, block_hashes=hashes,
-                            cancel_event=(inflight.cancel
-                                          if inflight is not None else None))
-                    except ExecCancelled as e:
-                        # the scheduler stopped its waves mid-round; the
-                        # BaseException handler below aborts the root job
-                        raise PayloadCancelled(str(e)) from e
-                    self._record_exec_metrics(optimistic=self.last_exec)
-                else:
-                    out = executor.execute(block, senders, hashes,
-                                           state_hook=state_hook)
+                        try:
+                            out, self.last_exec = execute_block_optimistic(
+                                executor.source, block, senders, self.config,
+                                max_workers=self.exec_workers,
+                                state_hook=state_hook, block_hashes=hashes,
+                                cancel_event=(inflight.cancel
+                                              if inflight is not None else None))
+                        except ExecCancelled as e:
+                            # the scheduler stopped its waves mid-round; the
+                            # BaseException handler below aborts the root job
+                            raise PayloadCancelled(str(e)) from e
+                        self._record_exec_metrics(optimistic=self.last_exec)
+                    else:
+                        out = executor.execute(block, senders, hashes,
+                                               state_hook=state_hook)
         except BaseException:
             _abort_root_job()  # never leak the worker thread
             if self.last_prewarm is not None:
@@ -568,6 +660,11 @@ class EngineTree:
             raise
         if self.last_prewarm is not None:
             self.last_prewarm.join()
+        if self.pipeline is not None:
+            self.pipeline.note_exec_wall(
+                pre_executed.exec_end - pre_executed.exec_start
+                if pre_executed is not None
+                else _time.monotonic() - t_exec0)
         _cancel_guard()
         try:
             with tracing.span("engine::tree", "post_validate"):
@@ -588,6 +685,14 @@ class EngineTree:
             write_execution_output(overlay, n, idx.first_tx_num, out)
         # hashed-state delta + state root (the state-root job)
         _cancel_guard()
+        if self.pipeline is not None and inflight is not None:
+            # publish the commit window: from here to the root verdict
+            # only hashed/trie tables are written, so the frozen layer
+            # snapshot is this block's complete plain-state effect — a
+            # child payload arriving now speculates over it
+            # (engine/block_pipeline.py; closed in _validate_and_insert)
+            inflight.commit_win = self.pipeline.open_commit(
+                block, block_hash, parent_layers or [], overlay.tx.layer)
         t0 = _time.time()
         with tracing.span("engine::tree", "state_root",
                           strategy=("sparse" if sparse_task is not None
@@ -692,7 +797,7 @@ class EngineTree:
         return changed_hashed_accounts, changed_hashed_storages, wiped_hashed
 
     def _start_sparse_root(self, block: Block, parent_layers,
-                           trace_ctx=None):
+                           trace_ctx=None, seed_digests=None):
         """Launch the background sparse-trie root task over the PARENT
         view (its proof worker reads concurrently with execution, so it
         gets its own transaction + overlay — never the in-progress layer).
@@ -718,7 +823,7 @@ class EngineTree:
                 parent_provider, parent.state_root, self.preserved_trie,
                 self.committer, parent_hash=block.header.parent_hash,
                 provider_factory=parent_view, workers=self.sparse_workers,
-                trace_ctx=trace_ctx)
+                trace_ctx=trace_ctx, seed_digests=seed_digests)
         except Exception:  # noqa: BLE001 — strategy startup must never
             # fail the payload; the pipelined+incremental path covers it
             return None
@@ -821,6 +926,10 @@ class EngineTree:
         # flight aborts its speculative machinery (sparse root task,
         # proof-pool shards, optimistic waves) instead of racing it
         self._cancel_inflight_for(head)
+        if self.pipeline is not None:
+            # same ladder for the cross-block speculation: an fcU that
+            # reorgs past the speculated block's parent aborts it
+            self.pipeline.on_forkchoice(head)
         if head == self.persisted_hash:
             return self._set_head(head)
         if head in self.blocks and self._chain_layers(head) is not None:

@@ -1225,6 +1225,85 @@ class EngineTreeMetrics:
 tree_metrics = EngineTreeMetrics()
 
 
+class BlockPipelineMetrics:
+    """Cross-block import pipeline observability
+    (engine/block_pipeline.py): speculations started/adopted/aborted
+    (aborts labeled by ladder rung), commit-window cadence, the measured
+    exec-inside-commit overlap fraction, and double-buffer sub-mesh
+    leases — the numbers that say whether back-to-back import is
+    actually overlapping exec with commit and why speculations die."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry or REGISTRY
+        self._reg = reg
+        self._depth = reg.gauge(
+            "block_pipeline_depth", "configured import pipeline depth")
+        self._started = reg.counter(
+            "block_pipeline_speculations_total",
+            "speculative next-block executions started")
+        self._adopted = reg.counter(
+            "block_pipeline_committed_total",
+            "speculations adopted after the parent committed VALID")
+        self._aborted = reg.counter(
+            "block_pipeline_aborted_total",
+            "speculations discarded (any abort-ladder rung)")
+        self._abort_reason: dict[str, Counter] = {}
+        self._windows = reg.counter(
+            "block_pipeline_commit_windows_total",
+            "commit windows published by the insert path")
+        self._window_wall = reg.histogram(
+            "block_pipeline_commit_window_seconds",
+            "commit-window wall clock (open to close)")
+        self._overlap = reg.histogram(
+            "block_pipeline_overlap_fraction",
+            "speculative exec wall inside the parent's commit window")
+        self._leases = reg.counter(
+            "block_pipeline_submesh_leases_total",
+            "double-buffer sub-mesh leases taken for speculation")
+        # events-line fragment state (node/events.py pipe[...])
+        self.last: dict = {}
+
+    def set_depth(self, depth: int) -> None:
+        self._depth.set(depth)
+        self.last["depth"] = depth
+
+    def window_opened(self) -> None:
+        self._windows.increment()
+
+    def window_closed(self, ok: bool, wall: float) -> None:
+        self._window_wall.record(wall)
+
+    def speculation_started(self) -> None:
+        self._started.increment()
+        self.last["spec"] = self.last.get("spec", 0) + 1
+
+    def speculation_adopted(self, overlap_fraction: float) -> None:
+        self._adopted.increment()
+        self._overlap.record(overlap_fraction)
+        self.last["adopted"] = self.last.get("adopted", 0) + 1
+        self.last["overlap"] = overlap_fraction
+
+    def speculation_aborted(self, reason: str) -> None:
+        self._aborted.increment()
+        c = self._abort_reason.get(reason)
+        if c is None:
+            c = self._reg.counter(
+                "block_pipeline_aborted_reason_total",
+                "speculations discarded, by abort-ladder rung",
+                labels={"reason": reason})
+            self._abort_reason[reason] = c
+        c.increment()
+        self.last["aborted"] = self.last.get("aborted", 0) + 1
+        self.last["last_abort"] = reason
+
+    def lease_taken(self, devices: int) -> None:
+        self._leases.increment()
+        self.last["lease_devices"] = devices
+
+
+block_pipeline_metrics = BlockPipelineMetrics()
+
+
 class FleetMetrics:
     """Replica-fleet observability (fleet/ring.py + fleet/feed.py):
     ring membership by state, per-request routing/failover counters,

@@ -314,6 +314,25 @@ class NodeEventReporter:
             if tm.get("backoff"):
                 line += " BACKOFF"
             line += "]"
+        # cross-block import pipeline: speculations started/adopted/
+        # aborted, the measured exec-inside-commit overlap fraction, and
+        # the last abort-ladder rung — the one-line answer to "is
+        # back-to-back import actually overlapping exec with commit"
+        from ..metrics import block_pipeline_metrics
+
+        bp = block_pipeline_metrics.last
+        if bp and bp.get("spec"):
+            line += (f" pipe[d={bp.get('depth', 2)}"
+                     f" spec={bp.get('spec', 0)}"
+                     f" adopt={bp.get('adopted', 0)}"
+                     f" abort={bp.get('aborted', 0)}")
+            if "overlap" in bp:
+                line += f" ovl={bp['overlap']:.2f}"
+            if bp.get("last_abort"):
+                line += f" last={bp['last_abort']}"
+            if bp.get("lease_devices"):
+                line += f" lease={bp['lease_devices']}d"
+            line += "]"
         # --health: the SLO engine's verdict — node status, any non-ok
         # component, and the breach counter an operator pages on. The
         # one line that says "the node itself thinks it is sick" instead

@@ -69,6 +69,10 @@ class NodeConfig:
     # execution on the no-BAL newPayload path (engine/optimistic.py);
     # speculation width from RETH_TPU_EXEC_WORKERS
     parallel_exec: bool = False
+    # --pipeline-depth / [node] pipeline_depth: cross-block import
+    # pipeline (engine/block_pipeline.py); 2 = speculate block N+1
+    # while N commits, None = env RETH_TPU_PIPELINE_DEPTH (default 1)
+    pipeline_depth: int | None = None
     # --rpc-gateway / [rpc] gateway: route every transport's dispatch
     # through the serving gateway (rpc/gateway.py): admission control
     # with priority classes, in-flight coalescing, and a head-invalidated
@@ -302,6 +306,7 @@ class Node:
             persistence_threshold=config.persistence_threshold,
             sparse_workers=config.sparse_workers,
             parallel_exec=config.parallel_exec,
+            pipeline_depth=config.pipeline_depth,
             invalid_cache_size=config.invalid_cache_size,
         )
         # the engine's persistence advance is the durability boundary:

@@ -303,6 +303,32 @@ class HashClient:
         return f"HashClient(lane={self.lane!r})"
 
 
+class PipelineLease:
+    """A double-buffered sub-mesh held by the cross-block import
+    pipeline: the speculative block's key-prehash batches dispatch on
+    the leased devices (via the service's sharded hasher) while the
+    committing block's lanes re-form over the rest. Release is
+    idempotent — the pipeline's abort ladder releases on every exit
+    path, and the chaos drills assert zero leaked leases."""
+
+    def __init__(self, service: "HashService", sub):
+        self._service = service
+        self._sub = sub
+        self.devices = len(sub.indices)
+        self.released = False
+
+    def hash(self, msgs: list[bytes]) -> list[bytes]:
+        if self.released:  # late straggler batch: CPU twin, never racy
+            return self._service._cpu(msgs)
+        return self._service._mesh_hasher.hash_sharded(msgs, self._sub.mesh)
+
+    def release(self) -> None:
+        if self.released:
+            return
+        self.released = True
+        self._sub.release()
+
+
 class LeasedTurboBackend:
     """Array-protocol backend proxy that holds the service's exclusive
     lease for the duration of one turbo commit (``begin`` → terminal
@@ -510,6 +536,7 @@ class HashService:
         self.leases = 0
         self.lease_bypasses = 0
         self.submesh_leases = 0
+        self.pipeline_leases = 0
         self.mesh_sharded = 0
         self.mesh_single = 0
         self.mesh_replays = 0
@@ -715,6 +742,29 @@ class HashService:
         lease is acquired — the mesh path needs this so the engine forms
         its shardings over the sub-mesh the lease just carved out."""
         return LeasedTurboBackend(self, inner, factory=factory)
+
+    def pipeline_lease(self, devices: int | None = None):
+        """Double-buffer sub-mesh for the cross-block import pipeline
+        (engine/block_pipeline.py): carve ``devices`` (default half the
+        mesh) for the speculative block's key prehash while the
+        in-commit block's lane dispatches re-form over the remainder —
+        the PR 10 rebuild lease generalized to two concurrent users.
+
+        Unlike :meth:`lease` this never pauses coalesced dispatching and
+        never waits: the speculation either gets its own devices
+        immediately or runs without (``None`` — no mesh, or not enough
+        live devices to leave the commit side at least one)."""
+        if self.mesh is None or self._mesh_hasher is None:
+            return None
+        from ..parallel.mesh import MeshExhausted
+
+        k = int(devices) if devices else max(1, self.mesh.n_devices // 2)
+        try:
+            sub = self.mesh.lease_submesh(k, what="pipeline")
+        except MeshExhausted:
+            return None
+        self.pipeline_leases += 1
+        return PipelineLease(self, sub)
 
     # -- dispatcher ---------------------------------------------------------
 
@@ -1109,6 +1159,7 @@ class HashService:
                 "submesh_leases": self.submesh_leases,
                 "submesh_held": (list(sub.indices)
                                  if sub is not None else None),
+                "pipeline_leases": self.pipeline_leases,
             }
             if self.device_injector is not None:
                 out["fault_injection"] = (out["fault_injection"]

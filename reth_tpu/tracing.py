@@ -374,6 +374,20 @@ def _record(rec: dict, always: bool = False) -> None:
             tl.append(rec)
 
 
+def ensure_timeline(trace_id: str) -> None:
+    """Pre-register a trace timeline so spans recorded BEFORE the block's
+    root ``trace_block`` opens still land in it — cross-block speculation
+    executes N+1 while N commits, ahead of N+1's own lifecycle."""
+    if not _TRACE_ON:
+        return
+    with _TL_LOCK:
+        _TIMELINES.setdefault(trace_id, [])
+        _TIMELINES.move_to_end(trace_id)
+        while len(_TIMELINES) > _MAX_TRACES:
+            dead, _ = _TIMELINES.popitem(last=False)
+            _SUMMARIES.pop(dead, None)
+
+
 def block_timeline(trace_id: str) -> list[dict] | None:
     """All records of one trace (block), oldest first; None if unknown."""
     with _TL_LOCK:
@@ -425,7 +439,9 @@ def _summarize(trace_id: str, records: list[dict]) -> dict | None:
         "ts": root["ts"] + total_ms / 1e3,
         "total_ms": total_ms,
         "prewarm_ms": round(dur_of("prewarm"), 3),
-        "exec_ms": round(dur_of("execute"), 3),
+        # an adopted speculation ran its execute leg as speculate.exec
+        # inside the parent's commit window; count it as the exec wall
+        "exec_ms": round(dur_of("execute") or dur_of("speculate.exec"), 3),
         "root_ms": round(dur_of("state_root"), 3),
         # hash-service attribution: queue-wait vs device dispatch (with no
         # service the direct hash.dispatch spans carry the dispatch wall)

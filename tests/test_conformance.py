@@ -15,7 +15,7 @@ import json
 import pytest
 
 from reth_tpu.conformance import ConformanceFailure, run_blockchain_test
-from reth_tpu.conformance.generate import SCENARIOS, builder_to_fixture, generate_suite
+from reth_tpu.conformance.generate import SCENARIOS, load_or_generate_suite
 from reth_tpu.conformance.runner import run_fixture_file
 
 _PER_SCENARIO = 20
@@ -23,7 +23,10 @@ _PER_SCENARIO = 20
 
 @pytest.fixture(scope="module")
 def suite():
-    return generate_suite(_PER_SCENARIO)
+    # cached on disk keyed by the generator's source hash — regeneration
+    # costs minutes of EVM execution for what is deterministic input
+    # data; the replay below is the actual conformance check
+    return load_or_generate_suite(_PER_SCENARIO)
 
 
 def test_suite_size(suite):
