@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-subtrie test-chaos test-reorg test-fleet test-fleet-obs test-ha test-import-pipeline native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-subtrie test-chaos test-reorg test-fleet test-fleet-obs test-ha test-txflow test-import-pipeline native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -152,8 +152,22 @@ test-chaos:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_wal_recovery.py tests/test_chaos.py \
 	  tests/test_fleet.py tests/test_fleet_obs.py tests/test_ha.py \
-	  tests/test_block_pipeline.py \
+	  tests/test_block_pipeline.py tests/test_txflow.py \
 	  -q -p no:cacheprovider
+
+# production write path: txpool firehose -> continuous block production.
+# Randomized differential producer-vs-serial-greedy parity (clone-pool
+# bit-identity at pool-sequence parity), nonce-gap promotion mid-build,
+# blob-tx fee gating, replacement-racing-inclusion slot accounting,
+# TxBatcher backpressure (-32005 + retry_after + shed metrics), pt_*
+# feed framing + replica pending-view reads, classify() pinning for
+# producer_/txpool_, scenario determinism, plus the @slow multi-process
+# drills: the SIGKILL-mid-build pool chaos domain (10 seeds, `python -m
+# reth_tpu.chaos campaign --domain pool`) and the
+# RETH_TPU_BENCH_MODE=txflow end-to-end capture — CPU-only
+test-txflow:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_txflow.py -q -p no:cacheprovider
 
 # cross-block import pipeline (engine/block_pipeline.py): randomized
 # serial-vs-pipelined differential imports (roots/receipts/senders

@@ -19,7 +19,12 @@ sustained RPC throughput + p99 through the fleet gateway at 1/2/4/8
 witness-fed replica subprocesses vs the single-node gateway
 (duplicate-heavy + long-tail mixes, responses verified bit-identical
 to an ungated dispatch before any number prints, per-size results in
-``per_fleet``).
+``per_fleet``); ``txflow`` floods the insertion batcher with adversarial
+submission mixes at 1k-50k offered tx/s and measures tx->inclusion p99 +
+txs/block through the continuous block producer vs the serial
+build-on-demand miner, with the hot candidate's inclusion set verified
+bit-identical against a serial greedy build over a cloned pool at every
+load point before any number prints (per-rate results in ``per_rate``).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "backend", "vs_prev", "regression"}. ``backend`` records which plane
@@ -1597,6 +1602,338 @@ def run_ha_mode() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def _txflow_schedule(wallets, under_wallet, txs_per_wallet: int, rng,
+                     value_tag: int):
+    """One adversarial submission schedule: per-wallet nonce chains with
+    duplicates, valid replacements (2x fees, >= the 10% bump), underpriced
+    replacements (+5%, below the bump), and one dedicated underpriced tx
+    (fee cap below the base fee — admitted, never executable). Returns
+    ``(schedule, slots)`` where schedule entries are ``(kind, tx, track)``
+    in submission order (per-sender order preserved by a round-robin
+    interleave) and ``slots`` is the number of (sender, nonce) slots the
+    chain-valid stream should eventually mine exactly once each."""
+    from itertools import zip_longest
+
+    from reth_tpu.primitives.types import Transaction
+
+    sink = b"\x0f" * 20
+    per_wallet = []
+    for wi, w in enumerate(wallets):
+        seq = []
+        bases = []
+        for k in range(txs_per_wallet):
+            tx = w.transfer(sink, 10**9 + value_tag + wi * 1000 + k)
+            bases.append(tx)
+            seq.append(("base", tx, True))
+        # duplicate: the same raw tx again — rejected "already known"
+        seq.append(("dup", bases[int(rng.integers(0, len(bases)))], False))
+        if wi % 3 == 0:
+            # valid replacement: same nonce at 2x fees — the winner; the
+            # base it replaces must NEVER be mined (asserted via slots)
+            tgt = bases[int(rng.integers(0, len(bases)))]
+            seq.append(("repl", w.sign_tx(Transaction(
+                tx_type=2, chain_id=1, nonce=tgt.nonce,
+                max_fee_per_gas=tgt.max_fee_per_gas * 2,
+                max_priority_fee_per_gas=tgt.max_priority_fee_per_gas * 2,
+                gas_limit=21_000, to=sink, value=tgt.value + 1,
+            ), bump_nonce=False), True))
+        elif wi % 3 == 1:
+            # underpriced replacement: +5% < the 10% min bump — rejected
+            # ("replacement underpriced", or "nonce too low" when the base
+            # won the race to a block first; both are correct outcomes)
+            tgt = bases[int(rng.integers(0, len(bases)))]
+            seq.append(("repl_under", w.sign_tx(Transaction(
+                tx_type=2, chain_id=1, nonce=tgt.nonce,
+                max_fee_per_gas=tgt.max_fee_per_gas * 105 // 100,
+                max_priority_fee_per_gas=tgt.max_priority_fee_per_gas,
+                gas_limit=21_000, to=sink, value=tgt.value + 1,
+            ), bump_nonce=False), False))
+        per_wallet.append(seq)
+    sched = [e for rnd in zip_longest(*per_wallet) for e in rnd
+             if e is not None]
+    # fee cap below any base fee: admitted (balance/nonce are fine) but
+    # effective tip < 0 — sits in the basefee bucket, never selected
+    sched.insert(int(rng.integers(0, len(sched) + 1)),
+                 ("under", under_wallet.transfer(
+                     sink, 1, max_fee_per_gas=1,
+                     max_priority_fee_per_gas=0), False))
+    return sched, len(wallets) * txs_per_wallet
+
+
+def _txflow_verify(node) -> str | None:
+    """The txflow acceptance contract: wait for the hot candidate to reach
+    pool parity, then compare its inclusion set bit-identically against ONE
+    serial greedy ``build_payload`` pass over a CLONED pool (same txs,
+    submission order preserved so heap ties break identically; the clone
+    absorbs the serial pass's evictions instead of the live pool). Returns
+    None on bit-identity, else a diagnostic string. Mining must be paused
+    by the caller — the comparison needs a quiescent head."""
+    from reth_tpu.payload.builder import build_payload
+    from reth_tpu.pool.pool import TransactionPool
+
+    prod = node.producer
+    got = parent = attrs = None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        with prod._lock:
+            cand = prod.candidate
+            with node.pool._lock:
+                if (cand is not None and cand.window is None
+                        and cand.parent_hash == node.tree.head_hash
+                        and cand.pool_seq == node.pool.event_seq):
+                    got = [t.hash for t in cand.selected]
+                    parent, attrs = cand.parent_hash, cand.attrs
+                    break
+        time.sleep(0.01)
+    if got is None:
+        return "producer never reached pool parity"
+    clone = TransactionPool(node.pool.state_reader, config=node.pool.config)
+    clone.base_fee = node.pool.base_fee
+    clone.blob_base_fee = node.pool.blob_base_fee
+    with node.pool._lock:
+        ptxs = sorted(node.pool.by_hash.values(),
+                      key=lambda p: p.submission_id)
+    for p in ptxs:
+        clone.add_transaction(p.tx, sender=p.sender)
+    block, _fees = build_payload(node.tree, clone, parent, attrs)
+    want = [t.hash for t in block.transactions]
+    if got != want:
+        return (f"candidate/serial inclusion set mismatch: candidate "
+                f"{len(got)} txs, serial {len(want)} txs, first divergence "
+                f"at rank {next((i for i, (a, b) in enumerate(zip(got, want)) if a != b), min(len(got), len(want)))}")
+    return None
+
+
+def run_txflow_mode() -> None:
+    """RETH_TPU_BENCH_MODE=txflow: the production write path end-to-end —
+    txpool firehose -> continuous block production (payload/producer.py)
+    vs the same flood through the serial build-on-demand miner. At each
+    offered load point an adversarial submission mix (nonce chains +
+    duplicates + replacements + underpriced) floods the insertion batcher
+    while the dev miner seals on an interval; the headline is the
+    tx->inclusion p99 at the top rate with txs/block, shed counts, and the
+    producer's incremental economy (fresh vs replayed ranks, hot-hit rate)
+    in ``per_rate``. ACCEPTANCE CONTRACT: at every load point the hot
+    candidate's inclusion set is verified bit-identical against one serial
+    greedy build over a cloned pool BEFORE any number prints (divergence
+    = rc 1). ``vs_baseline`` = serial-miner p99 / continuous p99 at the
+    top rate. Hermetic (CPU dev node, numpy committer — never touches the
+    tunnel). Env: RETH_TPU_BENCH_TXFLOW_RATES (default "1000,10000,50000"
+    offered tx/s), RETH_TPU_BENCH_TXFLOW_WALLETS (default 10),
+    RETH_TPU_BENCH_TXFLOW_TXS (chain length per wallet, default 6),
+    RETH_TPU_BENCH_TXFLOW_INTERVAL (mining interval s, default 0.25)."""
+    from reth_tpu.node import Node, NodeConfig
+    from reth_tpu.pool.batcher import PoolOverloaded
+    from reth_tpu.pool.pool import PoolError
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.primitives.types import Account
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie.committer import TrieCommitter
+
+    rates = [int(r) for r in os.environ.get(
+        "RETH_TPU_BENCH_TXFLOW_RATES", "1000,10000,50000").split(",") if r]
+    n_wallets = int(os.environ.get("RETH_TPU_BENCH_TXFLOW_WALLETS", "10"))
+    txs_per_wallet = int(os.environ.get("RETH_TPU_BENCH_TXFLOW_TXS", "6"))
+    interval = float(os.environ.get("RETH_TPU_BENCH_TXFLOW_INTERVAL", "0.25"))
+    _STATE["metric"] = "txflow_inclusion_p99_ms"
+    _STATE["unit"] = "ms"
+    _STATE["backend"] = "cpu"
+
+    def make_node(continuous: bool):
+        committer = TrieCommitter(hasher=keccak256_batch_np)
+        committer.turbo_backend = "numpy"
+        wallets = [Wallet(0xB100 + i) for i in range(n_wallets)]
+        under_wallet = Wallet(0xBEEF)
+        genesis = {w.address: Account(balance=10**21)
+                   for w in wallets + [under_wallet]}
+        builder = ChainBuilder(genesis, committer=committer)
+        node = Node(NodeConfig(dev=True, genesis_header=builder.genesis,
+                               genesis_alloc=builder.accounts_at_genesis,
+                               continuous_build=continuous,
+                               http_port=0, authrpc_port=0),
+                    committer=committer)
+        node.start_rpc()
+        return node, wallets, under_wallet
+
+    def run_point(continuous: bool, rate: int, seed: int) -> dict:
+        rng = np.random.default_rng(seed)
+        node, wallets, under_wallet = make_node(continuous)
+        try:
+            sched, _slots = _txflow_schedule(wallets, under_wallet,
+                                             txs_per_wallet, rng, rate)
+            sub_times: dict[bytes, tuple[float, bool]] = {}
+            lats: list[float] = []
+            counts = {"accepted": 0, "dup_rejected": 0,
+                      "repl_rejected": 0, "sheds": 0}
+            blocks = {"total": 0, "nonempty": 0, "mined": 0}
+            mined_hashes: set[bytes] = set()
+            pause = threading.Event()
+            stop = threading.Event()
+            miner_err: list = []
+
+            def miner_loop():
+                while not stop.is_set():
+                    if stop.wait(interval):
+                        return
+                    if pause.is_set():
+                        continue
+                    try:
+                        blk = node.miner.mine_block()
+                    except Exception as e:  # noqa: BLE001 — surfaced below
+                        miner_err.append(e)
+                        return
+                    now = time.monotonic()
+                    blocks["total"] += 1
+                    if blk.transactions:
+                        blocks["nonempty"] += 1
+                    for t in blk.transactions:
+                        rec = sub_times.get(t.hash)
+                        if rec is not None:
+                            mined_hashes.add(t.hash)
+                            blocks["mined"] += 1
+                            if rec[1]:
+                                lats.append(now - rec[0])
+
+            mt = threading.Thread(target=miner_loop, daemon=True)
+            mt.start()
+            _STATE["phase"] = (f"txflow {rate}/s "
+                               f"({'continuous' if continuous else 'serial'})"
+                               f": flood")
+            futs = []
+            t0 = time.monotonic()
+            for i, (kind, tx, track) in enumerate(sched):
+                lag = t0 + i / rate - time.monotonic()
+                if lag > 0:
+                    time.sleep(lag)
+                sub_times[tx.hash] = (time.monotonic(), track)
+                futs.append((kind, tx, node.tx_batcher.submit(tx)))
+            accepted: set[bytes] = set()
+            for kind, tx, fut in futs:
+                try:
+                    fut.result(timeout=30)
+                    counts["accepted"] += 1
+                    accepted.add(tx.hash)
+                except PoolOverloaded:
+                    counts["sheds"] += 1
+                except PoolError as e:
+                    if kind == "dup":
+                        counts["dup_rejected"] += 1
+                    elif kind in ("repl", "repl_under"):
+                        # "replacement underpriced", or "nonce too low"
+                        # when the base won the race into a block first
+                        counts["repl_rejected"] += 1
+                    else:
+                        raise RuntimeError(
+                            f"txflow: unexpected rejection of a {kind} "
+                            f"tx: {e}")
+            # drain: every accepted slot mined, only the underpriced
+            # straggler left pooled (it can never execute at this fee)
+            _STATE["phase"] = (f"txflow {rate}/s: drain "
+                               f"({'continuous' if continuous else 'serial'})")
+            stragglers = sum(1 for k, t, _ in futs
+                             if k == "under" and t.hash in accepted)
+            deadline = time.time() + 90
+            while time.time() < deadline and not miner_err:
+                with node.pool._lock:
+                    left = len(node.pool.by_hash)
+                if left <= stragglers:
+                    break
+                time.sleep(0.02)
+            else:
+                if not miner_err:
+                    raise RuntimeError(
+                        f"txflow: pool never drained at {rate}/s "
+                        f"({left} txs left, {stragglers} expected)")
+            if miner_err:
+                raise RuntimeError(f"txflow: miner failed: {miner_err[0]}")
+            if continuous:
+                # acceptance contract: pause mining, push one more
+                # adversarial burst, and verify the refreshed candidate
+                # bit-identical against a serial greedy build over a
+                # cloned pool BEFORE this point's numbers count
+                _STATE["phase"] = f"txflow {rate}/s: verify vs serial greedy"
+                pause.set()
+                burst, _ = _txflow_schedule(wallets, under_wallet,
+                                            2, rng, rate + 1)
+                bfuts = [(k, t, node.tx_batcher.submit(t))
+                         for k, t, _tr in burst]
+                for k, t, f in bfuts:
+                    try:
+                        f.result(timeout=30)
+                        sub_times[t.hash] = (time.monotonic(), False)
+                        accepted.add(t.hash)
+                    except PoolError:
+                        pass
+                diag = _txflow_verify(node)
+                if diag is not None:
+                    _emit(0, 0, error=f"txflow at {rate}/s: {diag}",
+                          exit_code=1)
+                pause.clear()
+                deadline = time.time() + 90
+                while time.time() < deadline and not miner_err:
+                    with node.pool._lock:
+                        left = len(node.pool.by_hash)
+                    if left <= stragglers + 1:  # + the burst's underpriced
+                        break
+                    time.sleep(0.02)
+            stop.set()
+            mt.join(timeout=10)
+            if miner_err:
+                raise RuntimeError(f"txflow: miner failed: {miner_err[0]}")
+            if not lats:
+                raise RuntimeError(f"txflow: no inclusion latencies at "
+                                   f"{rate}/s")
+            entry = {
+                "p99_inclusion_ms": round(
+                    float(np.percentile(lats, 99)) * 1e3, 2),
+                "mean_inclusion_ms": round(
+                    float(np.mean(lats)) * 1e3, 2),
+                "txs_per_block": round(
+                    blocks["mined"] / max(1, blocks["nonempty"]), 2),
+                "blocks": blocks["total"],
+                "nonempty_blocks": blocks["nonempty"],
+                "mined": blocks["mined"],
+                **counts,
+                "batcher_sheds": node.tx_batcher.sheds,
+            }
+            if continuous and node.producer is not None:
+                s = node.producer.snapshot()
+                entry["producer"] = {
+                    k: s[k] for k in ("refreshes", "full_rebuilds",
+                                      "exec_ranks", "reexec_ranks",
+                                      "invalidated", "hits", "misses",
+                                      "sealed", "errors")}
+                entry["miner_producer_seals"] = node.miner.producer_seals
+                entry["miner_serial_builds"] = node.miner.serial_builds
+            return entry
+        finally:
+            stop.set()
+            node.stop()
+
+    per_rate: dict[str, dict] = {}
+    for rate in rates:
+        entry = run_point(True, rate, seed=rate)
+        entry["serial_miner"] = {
+            k: v for k, v in run_point(False, rate, seed=rate).items()
+            if k in ("p99_inclusion_ms", "mean_inclusion_ms",
+                     "txs_per_block", "blocks", "mined")}
+        per_rate[str(rate)] = entry
+    top = per_rate[str(max(rates))]
+    value = top["p99_inclusion_ms"]
+    serial_p99 = top["serial_miner"]["p99_inclusion_ms"]
+    _STATE["device_result"] = value
+    _emit(value, round(serial_p99 / value, 3) if value else 0,
+          per_rate=per_rate, rates=rates,
+          txs_per_block=top["txs_per_block"],
+          sheds=sum(per_rate[str(r)]["sheds"] for r in rates),
+          wallets=n_wallets, chain_len=txs_per_wallet,
+          mining_interval_s=interval,
+          verified="candidate inclusion set bit-identical to a serial "
+                   "greedy build over a cloned pool at every load point "
+                   "before measuring",
+          exit_code=0)
+
+
 def _setup_compile_cache() -> None:
     """RETH_TPU_COMPILE_CACHE_DIR: validate (quarantining corruption) and
     enable the persistent XLA compilation cache, but ONLY after a
@@ -1694,6 +2031,9 @@ def main():
         return
     if mode == "ha":
         run_ha_mode()
+        return
+    if mode == "txflow":
+        run_txflow_mode()
         return
     if mode == "import":
         run_import_mode()

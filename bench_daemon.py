@@ -249,6 +249,51 @@ def run_fleet_bench(watchdog: int = 900) -> dict | None:
                      f"{(r.stderr or '')[-300:]}"}
 
 
+def run_txflow_bench(watchdog: int = 900) -> dict | None:
+    """RETH_TPU_BENCH_MODE=txflow capture: the production write path —
+    adversarial submission floods through the insertion batcher into the
+    continuous block producer vs the serial build-on-demand miner, with
+    tx->inclusion p99 + txs/block per offered load point and the
+    candidate inclusion set verified bit-identical against a serial
+    greedy build before any number prints. Hermetic (CPU dev node, numpy
+    committer, never touches the tunnel), so it runs at daemon start and
+    every session records the write path's latency curve (``per_rate``/
+    ``txs_per_block``/``sheds``)."""
+    env = dict(os.environ,
+               RETH_TPU_BENCH_MODE="txflow",
+               JAX_PLATFORMS="cpu",
+               RETH_TPU_BENCH_TIMEOUT=str(watchdog))
+    env.setdefault("RETH_TPU_BENCH_BASELINE_STORE",
+                   os.path.join(REPO, ".bench_baselines.json"))
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=watchdog + 120,
+            env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"value": 0, "per_rate": {}, "txs_per_block": 0, "sheds": 0,
+                "error": f"txflow bench exceeded {watchdog + 120}s"}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict):
+            parsed.setdefault("per_rate", {})
+            parsed.setdefault("txs_per_block", 0)
+            parsed.setdefault("sheds", 0)
+            parsed.setdefault("dispatches_per_block", 0)
+            parsed.setdefault("pipeline_depth", 1)
+            parsed.setdefault("overlap_fraction", 0)
+            return parsed
+    return {"value": 0, "per_rate": {}, "txs_per_block": 0, "sheds": 0,
+            "dispatches_per_block": 0, "pipeline_depth": 1,
+            "overlap_fraction": 0,
+            "error": f"txflow bench: no JSON line, rc={r.returncode}: "
+                     f"{(r.stderr or '')[-300:]}"}
+
+
 def update_artifact(captures: list[dict]) -> None:
     best = max((c for c in captures if c["result"].get("value", 0) > 0),
                key=lambda c: c["accounts"], default=None)
@@ -296,6 +341,14 @@ def main() -> None:
     git_commit([LOG], "bench: fleet-mode serving capture "
                       f"({fleet_result.get('fleet_scaling', 0)}x scaling, "
                       f"{fleet_result.get('value', 0)} requests/s)")
+    # write-path latency curve: hermetic too (CPU dev node + the
+    # continuous producer), so every session records tx->inclusion p99
+    log_event({"event": "txflow_bench_start"})
+    txflow_result = run_txflow_bench()
+    log_event({"event": "txflow_bench_done", "result": txflow_result})
+    git_commit([LOG], "bench: txflow-mode write-path capture "
+                      f"({txflow_result.get('value', 0)} ms inclusion p99, "
+                      f"{txflow_result.get('txs_per_block', 0)} txs/block)")
     captures: list[dict] = []
     stage = 0
     probes = 0

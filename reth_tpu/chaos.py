@@ -322,6 +322,46 @@ def make_ha_scenario(seed: int) -> dict:
     }
 
 
+def make_pool_scenario(seed: int) -> dict:
+    """Deterministic write-path scenario (``--domain pool``): a dev full
+    node in fleet mode with the continuous producer on, flooded with a
+    seeded adversarial submission mix (per-sender nonce chains plus
+    duplicates, valid 2x replacements, underpriced +5% replacements, and
+    a fee-capped-below-base-fee straggler) while blocks keep mining off
+    the hot candidate — some seeds throw a mid-storm reorg — then
+    SIGKILLed mid-build. The recover child restarts the datadir and
+    audits the write path: no stuck candidate slot, replacement
+    semantics intact, a replica converging on the leader's exact pending
+    view, and zero leaked leases. Own rng stream so other domains'
+    seeds stay stable."""
+    import random
+
+    rng = random.Random(0xF001ED00 + seed)
+    # slow-only injectors: the write-path invariants assert semantics,
+    # not latency, so nothing here may legitimately fail a submission
+    fault_menu = (
+        {"RETH_TPU_FAULT_GATEWAY_STALL": "0.01"},
+        {"RETH_TPU_FAULT_SLO_BREACH": "all"},
+    )
+    faults: dict[str, str] = {}
+    for f in rng.sample(fault_menu, k=rng.randint(0, 1)):
+        faults.update(f)
+    return {
+        "domain": "pool",
+        "seed": seed,
+        "faults": faults,
+        "mode": "kill",
+        "threshold": 2,
+        "wallets": rng.randint(4, 6),
+        "txs_per_wallet": rng.randint(3, 5),
+        # recorded blocks before the SIGKILL lands (mid-flood, so the
+        # kill interleaves arbitrarily with refresh/seal/commit legs)
+        "kill_after": rng.randint(4, 7),
+        "reorg_storm": rng.random() < 0.4,
+        "reorg_at": rng.randint(3, 4),
+    }
+
+
 # -- child processes ----------------------------------------------------------
 
 
@@ -336,7 +376,7 @@ def _cpu_committer():
 
 def _build_node(datadir: Path, seed: int, threshold: int,
                 hash_service: bool, fresh: bool, fleet: bool = False,
-                ha_peer_feeds: tuple = ()):
+                ha_peer_feeds: tuple = (), continuous: bool = False):
     """A dev node over memdb+WAL, deterministic genesis derived from the
     seed — victim and recover children build the identical config."""
     from .node import Node, NodeConfig
@@ -361,6 +401,7 @@ def _build_node(datadir: Path, seed: int, threshold: int,
         static_file_distance=2,
         rpc_gateway=True,
         fleet=fleet, feed_port=0,
+        continuous_build=continuous,
         ha_peer_feeds=tuple(ha_peer_feeds),
         health=True, slo_interval=0.2, slo_window=120,
         http_port=0, authrpc_port=0,
@@ -1761,6 +1802,328 @@ def child_recover(datadir: str, seed: int, threshold: int = 2,
     return 0 if result["ok"] else 1
 
 
+def _pool_burst(wallets, under_wallet, txs_per_wallet: int, rng, tag: int):
+    """One adversarial submission round, per-sender order preserved by a
+    round-robin interleave: fresh nonce-chain bases, one duplicate per
+    wallet, alternating valid (2x, >= the 10% bump) and underpriced
+    (+5%, below it) same-nonce replacements, plus one fee-capped-below-
+    base-fee straggler. Yields ``(tx, must_admit)`` pairs."""
+    from itertools import zip_longest
+
+    from .primitives.types import Transaction
+
+    sink = b"\x0f" * 20
+    per_wallet = []
+    for wi, w in enumerate(wallets):
+        bases = [w.transfer(sink, 10**6 + tag * 10_000 + wi * 100 + k)
+                 for k in range(txs_per_wallet)]
+        seq = [(tx, True) for tx in bases]
+        seq.append((bases[rng.randrange(len(bases))], False))  # duplicate
+        tgt = bases[rng.randrange(len(bases))]
+        if wi % 2 == 0:
+            seq.append((w.sign_tx(Transaction(
+                tx_type=2, chain_id=1, nonce=tgt.nonce,
+                max_fee_per_gas=tgt.max_fee_per_gas * 2,
+                max_priority_fee_per_gas=tgt.max_priority_fee_per_gas * 2,
+                gas_limit=21_000, to=sink, value=tgt.value + 1,
+            ), bump_nonce=False), True))
+        else:
+            seq.append((w.sign_tx(Transaction(
+                tx_type=2, chain_id=1, nonce=tgt.nonce,
+                max_fee_per_gas=tgt.max_fee_per_gas * 105 // 100,
+                max_priority_fee_per_gas=tgt.max_priority_fee_per_gas,
+                gas_limit=21_000, to=sink, value=tgt.value + 1,
+            ), bump_nonce=False), False))
+        per_wallet.append(seq)
+    out = [e for rnd in zip_longest(*per_wallet) for e in rnd
+           if e is not None]
+    # admitted (funded, gapless) but effective tip < 0: a permanent
+    # basefee-bucket straggler the producer must keep skipping
+    out.insert(rng.randrange(len(out) + 1),
+               (under_wallet.transfer(sink, 1, max_fee_per_gas=1,
+                                      max_priority_fee_per_gas=0), True))
+    return out
+
+
+def child_pool_victim(datadir: str, seed: int) -> int:
+    """(child) write-path drill victim: continuous-build fleet node
+    mining off the hot candidate under a seeded adversarial pool flood
+    (duplicates / replacements / underpriced), optionally rewound by a
+    mid-storm reorg, recording every sealed block until the
+    orchestrator's SIGKILL lands mid-build."""
+    import random
+
+    from .pool.pool import PoolError
+    from .testing import Wallet
+
+    scn = make_pool_scenario(seed)
+    datadir = Path(datadir)
+    node, wallet, _ = _build_node(datadir, seed, scn["threshold"],
+                                  hash_service=False, fresh=True,
+                                  fleet=True, continuous=True)
+    node.start_rpc()
+    rec = open(_record_path(datadir), "a")
+
+    def record(blk):
+        rec.write(json.dumps({
+            "n": blk.header.number, "hash": blk.hash.hex(),
+            "root": blk.header.state_root.hex(), "rlp": blk.encode().hex(),
+        }) + "\n")
+        rec.flush()
+
+    # funding block: the flood wallets (and the underpriced straggler's)
+    # get their balances on-chain first, so admission sees them funded
+    wallets = [Wallet(0xF001E000 + seed * 64 + i)
+               for i in range(scn["wallets"])]
+    under_wallet = Wallet(0xF001E000 + seed * 64 + 63)
+    for w in wallets + [under_wallet]:
+        node.pool.add_transaction(wallet.transfer(w.address, 10**18))
+    record(node.miner.mine_block())
+    rng = random.Random(0xF001EE00 + seed)
+    i = 1
+    while True:  # until the orchestrator's SIGKILL
+        i += 1
+        for tx, must_admit in _pool_burst(wallets, under_wallet,
+                                          scn["txs_per_wallet"], rng, i):
+            try:
+                node.pool.add_transaction(tx)
+            except PoolError:
+                if must_admit:
+                    raise
+        if scn["reorg_storm"] and i == scn["reorg_at"]:
+            # rewind to a persisted ancestor ABOVE the funding block;
+            # record the INTENT first (a crash mid-unwind legitimately
+            # recovers to the reorg target). Unwound senders' local
+            # nonces now lead the chain — their tail gaps and queues,
+            # which is exactly the post-reorg pool shape to survive
+            with node.factory.provider() as p:
+                target = max(1, node.tree.persisted_number - 1)
+                old = p.canonical_hash(target)
+            rec.write(json.dumps({"reorg_to": target}) + "\n")
+            rec.flush()
+            node.tree.on_forkchoice_updated(old)
+        record(node.miner.mine_block())
+
+
+def child_pool_recover(datadir: str, seed: int) -> int:
+    """Restart over the killed write-path victim's datadir and audit the
+    producer/pool invariant suite. Prints one ``RESULT {...}`` line;
+    exit 0 iff every invariant held:
+
+    - consistent recovered head with bounded durable loss (as the
+      storage suite defines them);
+    - **no stuck candidate slot**: fresh load lands in a hot candidate
+      that reaches pool-sequence parity on the recovered head, seals
+      through the producer, and advances the chain;
+    - **replacement semantics hold after restart**: a 2x same-nonce
+      replacement wins the slot, a +5% one is refused, and the winner
+      (never the base) is mined;
+    - **replicas converge on the pending view**: a replica subscribed to
+      the restarted feed serves ``txpool_content`` bit-identical to the
+      leader's (``pt_*`` snapshot + live records);
+    - **zero leaked leases**: no hash-service lease held and the
+      candidate's commit-window lease released at rest."""
+    import urllib.request  # noqa: F401 - _ha_rpc pulls it lazily
+
+    from .pool.pool import PoolError
+    from .primitives.types import Transaction
+    from .testing import Wallet
+
+    scn = make_pool_scenario(seed)
+    datadir = Path(datadir)
+    recorded = _read_record(datadir)
+    mined = [l for l in recorded if "hash" in l]
+    t0 = time.time()
+    inv: dict[str, object] = {}
+    result: dict[str, object] = {"seed": seed, "invariants": inv}
+    try:
+        node, wallet, _ = _build_node(datadir, seed, scn["threshold"],
+                                      hash_service=False, fresh=True,
+                                      fleet=True, continuous=True)
+    except Exception as e:  # noqa: BLE001 - a refused startup fails the suite
+        result["ok"] = False
+        result["error"] = f"restart refused: {type(e).__name__}: {e}"
+        print("RESULT " + json.dumps(result))
+        return 1
+    rproc = None
+    try:
+        result["recovery_report"] = node.recovery
+        head_n = node.tree.persisted_number
+        head_h = node.tree.persisted_hash
+        result["recovered"] = {"number": head_n,
+                               "hash": head_h.hex() if head_h else None}
+        with node.factory.provider() as p:
+            head_header = p.header_by_number(head_n)
+        rep = node.recovery or {}
+        inv["head_consistent"] = (rep.get("status") in ("ok", "degraded")
+                                  and head_header is not None
+                                  and head_header.hash == head_h)
+
+        # bounded durable loss, exactly as the storage suite bounds it
+        if mined:
+            by_height: dict[int, set] = {}
+            floor = 0
+            for l in recorded:
+                if "reorg_to" in l:
+                    floor = min(floor, l["reorg_to"])
+                elif "hash" in l:
+                    by_height.setdefault(l["n"], set()).add(l["hash"])
+                    floor = max(floor, l["n"] - scn["threshold"])
+            inv["loss_bound"] = (head_n >= floor
+                                 and (head_n == 0
+                                      or head_h.hex() in by_height.get(head_n, ())))
+        else:
+            inv["loss_bound"] = head_n == 0
+
+        http_port, _ = node.start_rpc()
+        prod = node.producer
+
+        # -- no stuck candidate slot: fresh load -> hot candidate at
+        # pool parity on the recovered head, sealed by the producer
+        with node.factory.provider() as p:
+            acct = p.account(wallet.address)
+        wallet.nonce = acct.nonce if acct is not None else 0
+        fresh_w = Wallet(0xF001F000 + seed)
+        node.pool.add_transaction(wallet.transfer(fresh_w.address, 10**18))
+        for k in range(3):
+            node.pool.add_transaction(wallet.transfer(b"\x0d" * 20, 50 + k))
+        deadline = time.time() + 20
+        parity = False
+        while time.time() < deadline and not parity:
+            with prod._lock:
+                cand = prod.candidate
+                with node.pool._lock:
+                    parity = (cand is not None and cand.window is None
+                              and cand.parent_hash == node.tree.head_hash
+                              and cand.pool_seq == node.pool.event_seq
+                              and len(cand.selected) == 4)
+            if not parity:
+                time.sleep(0.05)
+        snap = prod.snapshot()
+        inv["no_stuck_candidate"] = parity and snap["errors"] == 0
+        result["producer"] = {k: snap[k] for k in
+                              ("refreshes", "full_rebuilds", "hits",
+                               "misses", "sealed", "errors")}
+        blk = node.miner.mine_block()
+        inv["liveness"] = (blk.header.number == head_n + 1
+                           and len(blk.transactions) == 4
+                           and node.miner.producer_seals >= 1)
+
+        # -- replacement semantics after restart: 2x wins the slot, +5%
+        # against the NEW occupant is refused, the winner gets mined
+        sink = b"\x0e" * 20
+        base = fresh_w.transfer(sink, 77)
+        node.pool.add_transaction(base)
+        repl = fresh_w.sign_tx(Transaction(
+            tx_type=2, chain_id=1, nonce=base.nonce,
+            max_fee_per_gas=base.max_fee_per_gas * 2,
+            max_priority_fee_per_gas=base.max_priority_fee_per_gas * 2,
+            gas_limit=21_000, to=sink, value=78), bump_nonce=False)
+        node.pool.add_transaction(repl)
+        under = fresh_w.sign_tx(Transaction(
+            tx_type=2, chain_id=1, nonce=base.nonce,
+            max_fee_per_gas=base.max_fee_per_gas * 105 // 100,
+            max_priority_fee_per_gas=base.max_priority_fee_per_gas,
+            gas_limit=21_000, to=sink, value=79), bump_nonce=False)
+        under_refused = False
+        try:
+            node.pool.add_transaction(under)
+        except PoolError:
+            under_refused = True
+        inv["replacement_semantics"] = (under_refused
+                                        and repl.hash in node.pool.by_hash
+                                        and base.hash not in node.pool.by_hash)
+        blk2 = node.miner.mine_block()
+        hashes = {t.hash for t in blk2.transactions}
+        inv["replacement_mined"] = (repl.hash in hashes
+                                    and base.hash not in hashes)
+
+        # -- replica pending-view convergence: subscribe a replica to
+        # the restarted feed (pt_snapshot anchors it), then push live
+        # pending load incl. a replacement; its txpool_content must go
+        # bit-identical to the leader's
+        port_file = datadir / "replica.port"
+        rlog = open(datadir / "replica.log", "w")
+        rproc = subprocess.Popen(
+            [sys.executable, "-m", "reth_tpu.fleet", "replica",
+             "--feed", f"127.0.0.1:{node.feed_server.port}",
+             "--port-file", str(port_file), "--id", "r0"],
+            env=_child_env(), stdout=rlog, stderr=rlog)
+        deadline = time.time() + 60
+        while not port_file.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        if not port_file.exists():
+            raise RuntimeError("replica port file never appeared")
+        rport = json.loads(port_file.read_text())["http_port"]
+        pend = [fresh_w.transfer(b"\x0d" * 20, 200 + k) for k in range(3)]
+        for tx in pend:
+            node.pool.add_transaction(tx)
+        repl2 = fresh_w.sign_tx(Transaction(
+            tx_type=2, chain_id=1, nonce=pend[-1].nonce,
+            max_fee_per_gas=pend[-1].max_fee_per_gas * 2,
+            max_priority_fee_per_gas=pend[-1].max_priority_fee_per_gas * 2,
+            gas_limit=21_000, to=b"\x0d" * 20, value=299), bump_nonce=False)
+        node.pool.add_transaction(repl2)
+
+        def buckets(content):
+            return {b: {h["hash"] for by_nonce in content.get(b, {}).values()
+                        for h in by_nonce.values()}
+                    for b in ("pending", "queued")}
+
+        deadline = time.time() + 30
+        converged = False
+        own = rep_view = None
+        while time.time() < deadline and not converged:
+            own = _ha_rpc(http_port, "txpool_content").get("result")
+            try:
+                rep_view = _ha_rpc(rport, "txpool_content").get("result")
+            except Exception:  # noqa: BLE001 - replica still syncing
+                rep_view = None
+            converged = (own is not None and rep_view is not None
+                         and buckets(own) == buckets(rep_view))
+            if not converged:
+                time.sleep(0.2)
+        inv["replica_pending_view"] = converged
+        if not converged and own is not None:
+            result["pending_diff"] = {
+                "leader": sorted(h for s in buckets(own).values() for h in s),
+                "replica": (sorted(h for s in buckets(rep_view).values()
+                                   for h in s)
+                            if rep_view is not None else None)}
+
+        # -- zero leaked leases: no hash-service lease held, and the
+        # candidate's commit-window lease released once at rest
+        deadline = time.time() + 10
+        window_free = False
+        while time.time() < deadline and not window_free:
+            with prod._lock:
+                cand = prod.candidate
+                window_free = cand is None or cand.window is None
+            if not window_free:
+                time.sleep(0.05)
+        svc = getattr(node.committer, "hash_service", None)
+        inv["no_leaked_lease"] = (window_free
+                                  and (svc is None
+                                       or not svc.snapshot().get("leased_by")))
+    except Exception as e:  # noqa: BLE001 — a crashed suite fails the drill
+        result["ok"] = False
+        result["error"] = f"{type(e).__name__}: {e}"
+        print("RESULT " + json.dumps(result, default=str))
+        return 1
+    finally:
+        if rproc is not None and rproc.poll() is None:
+            rproc.kill()
+            rproc.wait()
+        try:
+            node.stop()
+        except Exception:  # noqa: BLE001 - verdict beats a clean exit
+            pass
+    result["ok"] = all(v is True for v in inv.values())
+    result["wall_s"] = round(time.time() - t0, 2)
+    print("RESULT " + json.dumps(result, default=str))
+    return 0 if result["ok"] else 1
+
+
 # -- orchestrator -------------------------------------------------------------
 
 
@@ -1894,11 +2257,76 @@ def run_scenario(scn: dict, base_dir: str | Path,
     return result
 
 
+def run_pool_scenario(scn: dict, base_dir: str | Path,
+                      timeout: float = 240.0) -> dict:
+    """One write-path drill: continuous-build victim under the seeded
+    flood until it has recorded ``kill_after`` blocks, SIGKILL mid-build,
+    then the pool recover child's invariant suite over the datadir."""
+    datadir = Path(base_dir) / f"pool-{scn['seed']}"
+    datadir.mkdir(parents=True, exist_ok=True)
+    result = dict(scn)
+    cmd = [sys.executable, "-m", "reth_tpu.chaos", "pool-victim",
+           "--datadir", str(datadir), "--seed", str(scn["seed"])]
+    log_path = datadir / "victim.log"
+
+    def _log_tail() -> str:
+        try:
+            return log_path.read_text()[-400:]
+        except OSError:
+            return ""
+
+    log = open(log_path, "w")
+    try:
+        proc = subprocess.Popen(cmd, env=_child_env(scn["faults"]),
+                                stdout=log, stderr=log)
+        rec = _record_path(datadir)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                result.update(ok=False,
+                              error=f"victim died early "
+                                    f"rc={proc.returncode}: {_log_tail()}")
+                return result
+            lines = (len([l for l in _read_record(datadir) if "hash" in l])
+                     if rec.exists() else 0)
+            if lines >= scn["kill_after"]:
+                break
+            time.sleep(0.1)
+        else:
+            proc.kill()
+            proc.wait()
+            result.update(ok=False, error="victim never reached kill depth")
+            return result
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        result["victim_rc"] = -9
+    finally:
+        log.close()
+    result["blocks_recorded"] = len([l for l in _read_record(datadir)
+                                     if "hash" in l])
+    rproc = subprocess.run(
+        [sys.executable, "-m", "reth_tpu.chaos", "pool-recover",
+         "--datadir", str(datadir), "--seed", str(scn["seed"])],
+        env=_child_env(), capture_output=True, text=True, timeout=timeout)
+    verdict = None
+    for line in rproc.stdout.splitlines():
+        if line.startswith("RESULT "):
+            verdict = json.loads(line[len("RESULT "):])
+    if verdict is None:
+        result.update(ok=False,
+                      error=f"pool recover emitted no verdict "
+                            f"(rc={rproc.returncode}): {rproc.stderr[-400:]}")
+        return result
+    result.update(verdict)
+    return result
+
+
 _DOMAIN_MAKERS = {
     "storage": (make_scenario, run_scenario),
     "consensus": (make_consensus_scenario, run_scenario),
     "fleet": (make_fleet_scenario, run_fleet_scenario),
     "ha": (make_ha_scenario, run_ha_scenario),
+    "pool": (make_pool_scenario, run_pool_scenario),
 }
 
 
@@ -2033,10 +2461,23 @@ def main(argv=None) -> int:
     pp.add_argument("--peer", default="",
                     help="HOST:PORT of the promoted standby's feed")
 
+    pw = sub.add_parser("pool-victim",
+                        help="(child) write-path drill: continuous-build "
+                             "node under adversarial pool flood until "
+                             "SIGKILLed mid-build")
+    pw.add_argument("--datadir", required=True)
+    pw.add_argument("--seed", type=int, required=True)
+
+    pq = sub.add_parser("pool-recover",
+                        help="(child) restart the killed write-path "
+                             "victim + producer/pool invariant suite")
+    pq.add_argument("--datadir", required=True)
+    pq.add_argument("--seed", type=int, required=True)
+
     ps = sub.add_parser("scenario", help="run one seeded scenario")
     ps.add_argument("--seed", type=int, required=True)
     ps.add_argument("--domain",
-                    choices=("storage", "consensus", "fleet", "ha"),
+                    choices=("storage", "consensus", "fleet", "ha", "pool"),
                     default="storage")
     ps.add_argument("--base", default=None)
 
@@ -2044,7 +2485,7 @@ def main(argv=None) -> int:
     pc.add_argument("--seeds", default="1,2,3,4,5,6,7,8,9,10",
                     help="comma list, or N for range(1, N+1)")
     pc.add_argument("--domain",
-                    choices=("storage", "consensus", "fleet", "ha"),
+                    choices=("storage", "consensus", "fleet", "ha", "pool"),
                     default="storage")
     pc.add_argument("--base", default=None)
 
@@ -2069,6 +2510,10 @@ def main(argv=None) -> int:
     if args.command == "ha-fence-probe":
         return child_ha_fence_probe(args.datadir, args.seed,
                                     args.threshold, args.peer)
+    if args.command == "pool-victim":
+        return child_pool_victim(args.datadir, args.seed)
+    if args.command == "pool-recover":
+        return child_pool_recover(args.datadir, args.seed)
     import tempfile
 
     base = args.base or tempfile.mkdtemp(prefix="reth-tpu-chaos-")

@@ -468,6 +468,8 @@ def cmd_node(args):
                      sparse_workers=getattr(args, "sparse_workers", None),
                      parallel_exec=getattr(args, "parallel_exec", False),
                      pipeline_depth=getattr(args, "pipeline_depth", None),
+                     continuous_build=getattr(args, "continuous_build",
+                                              False),
                      rpc_gateway=getattr(args, "rpc_gateway", False),
                      warmup=warm_mode,
                      compile_cache_dir=warm_cache,
@@ -879,6 +881,7 @@ def cmd_config(args):
         f"subtrie_levels = {cfg.subtrie_levels}",
         f"parallel_exec = {'true' if cfg.parallel_exec else 'false'}",
         f"pipeline_depth = {cfg.pipeline_depth}",
+        f"continuous_build = {'true' if cfg.continuous_build else 'false'}",
         f"trace_blocks = {'true' if cfg.trace_blocks else 'false'}",
         f"health = {'true' if cfg.health else 'false'}",
         f"slo_interval = {cfg.slo_interval}",
@@ -1321,6 +1324,21 @@ def main(argv=None) -> int:
                         "cancellation ladder. 1 = strictly serial "
                         "(default). Env fallback: RETH_TPU_PIPELINE_DEPTH. "
                         "Also settable as [node] pipeline_depth in "
+                        "reth.toml")
+    p.add_argument("--continuous-build", dest="continuous_build",
+                   action="store_true", default=False,
+                   help="standing block producer (payload/producer.py): "
+                        "stream the pool's best transactions into a hot "
+                        "candidate payload refreshed incrementally on pool "
+                        "events and head changes — only ranks a pool delta "
+                        "or new head invalidates re-execute, and with "
+                        "--pipeline-depth 2 the N+1 candidate builds over "
+                        "block N's commit window while N's root dispatches "
+                        "run. getPayload / dev mining seal the candidate "
+                        "(inclusion set bit-identical to the one-shot "
+                        "serial greedy builder) instead of building from "
+                        "scratch. producer_status reports the candidate. "
+                        "Also settable as [node] continuous_build in "
                         "reth.toml")
     p.add_argument("--rpc-gateway", dest="rpc_gateway", action="store_true",
                    default=False,

@@ -151,6 +151,11 @@ class RethTpuConfig:
     # 1 = strictly serial imports. Env RETH_TPU_PIPELINE_DEPTH is the
     # fallback when unset.
     pipeline_depth: int = 1
+    # standing block producer (--continuous-build CLI equivalent,
+    # payload/producer.py): hot candidate payload incrementally
+    # refreshed on pool events and head changes; getPayload / dev
+    # mining seal it instead of building from scratch
+    continuous_build: bool = False
     # block-lifecycle tracing (--trace-blocks CLI equivalent): record
     # per-block span timelines, export Chrome-trace JSON under the
     # datadir, and point flight-recorder dumps there (tracing.py)
@@ -225,6 +230,8 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.subtrie_levels = int(node.get("subtrie_levels", cfg.subtrie_levels))
     cfg.parallel_exec = bool(node.get("parallel_exec", cfg.parallel_exec))
     cfg.pipeline_depth = int(node.get("pipeline_depth", cfg.pipeline_depth))
+    cfg.continuous_build = bool(node.get("continuous_build",
+                                         cfg.continuous_build))
     cfg.trace_blocks = bool(node.get("trace_blocks", cfg.trace_blocks))
     cfg.health = bool(node.get("health", cfg.health))
     cfg.slo_interval = float(node.get("slo_interval", cfg.slo_interval))

@@ -188,6 +188,10 @@ class BlockPipeline:
         self.overlap_wall_s = 0.0    # speculative exec inside a window
         self.last_overlap_fraction = 0.0
         self.leases_active = 0
+        # commit-window observers beyond the tree itself: the continuous
+        # block producer subscribes so N+1's candidate starts building the
+        # moment N's window opens (called OUTSIDE the pipeline lock)
+        self.open_listeners: list = []
         block_pipeline_metrics.set_depth(self.depth)
 
     # -- commit window (called from the insert thread) ----------------------
@@ -208,7 +212,17 @@ class BlockPipeline:
             self._window = win
             self._cond.notify_all()
         block_pipeline_metrics.window_opened()
+        for fn in list(self.open_listeners):
+            try:
+                fn(win)
+            except Exception:  # noqa: BLE001 — an observer must never
+                pass           # stall the insert thread
         return win
+
+    def current_window(self):
+        """The commit window currently open, or None."""
+        with self._lock:
+            return self._window
 
     def close_commit(self, win: CommitWindow, ok: bool) -> None:
         """Close N's window (idempotent; called on EVERY insert exit

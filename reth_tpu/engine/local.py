@@ -13,10 +13,16 @@ from .tree import EngineTree, PayloadStatusKind
 
 
 class LocalMiner:
-    def __init__(self, tree: EngineTree, pool, block_time: int = 12):
+    def __init__(self, tree: EngineTree, pool, block_time: int = 12,
+                 producer=None):
         self.tree = tree
         self.pool = pool
         self.block_time = block_time
+        # continuous-build mode: seal the producer's hot candidate instead
+        # of running a fresh greedy build per block
+        self.producer = producer
+        self.producer_seals = 0
+        self.serial_builds = 0
 
     def mine_block(self, timestamp: int | None = None):
         """Build one block from the pool, submit it, make it canonical."""
@@ -28,7 +34,16 @@ class LocalMiner:
         # consensus requires strictly increasing timestamps (geth dev mode
         # applies the same clamp)
         attrs = PayloadAttributes(timestamp=max(ts, parent.timestamp + 1))
-        block, _fees = build_payload(self.tree, self.pool, head, attrs)
+        block = None
+        if self.producer is not None:
+            try:
+                block, _fees = self.producer.take(head, attrs)
+                self.producer_seals += 1
+            except Exception:  # noqa: BLE001 — the serial build is always
+                block = None   # the fallback; mining must not fail
+        if block is None:
+            block, _fees = build_payload(self.tree, self.pool, head, attrs)
+            self.serial_builds += 1
         st = self.tree.on_new_payload(block)
         if st.status is not PayloadStatusKind.VALID:
             raise RuntimeError(f"self-mined block invalid: {st.validation_error}")

@@ -61,6 +61,28 @@ upstream:
   when a subscriber's ``from`` position cannot be continued, or on an
   upstream ``{"type": "resync_request"}``.
 
+``RTPT1`` record family (pool propagation, pool/pool.py listeners):
+rides the same framing, delivered only to subscribers that sent
+``{"type": "subscribe_pool"}`` upstream. Every record carries the
+pool's monotonic ``seq``; a subscriber that observes a gap (ship-queue
+drop-oldest fired) re-subscribes and gets a fresh snapshot:
+
+- ``{"type": "pt_snapshot", "pt": "RTPT1", "seq", "base_fee",
+  "blob_base_fee", "txs": [(tx_rlp, sender), ...]}`` — the full
+  pending set at subscribe time; anchors the replica's pending view.
+- ``{"type": "pt_add", "pt": "RTPT1", "seq", "tx": tx_rlp, "hash",
+  "sender", "nonce"}`` — one admission.
+- ``{"type": "pt_replace", ... , "old_hash"}`` — a same-nonce
+  replacement that out-bid the incumbent.
+- ``{"type": "pt_drop", "pt": "RTPT1", "seq", "hash", "sender",
+  "reason": mined|invalid|evicted|underfunded}`` — one eviction.
+- ``{"type": "pt_canon", "pt": "RTPT1", "seq", "base_fee",
+  "blob_base_fee"}`` — fee markets moved with the head.
+
+This is what lets replicas answer ``eth_getTransactionByHash``,
+pending-tag nonces, and ``txpool_*`` for UNMINED txs instead of
+``-32001``: the write population's reads stay on the fleet.
+
 Every hello additionally carries ``epoch`` (the sender's monotonic
 leader epoch, persisted in the WAL manifest) and ``rpc_port`` — the
 fencing handshake: a restarted old leader probing a live peer whose
@@ -97,6 +119,7 @@ from .. import tracing
 
 FEED_MAGIC = b"RTFD1\n"
 ST_MAGIC = "RTST1"  # the WAL-shipping record family tag
+PT_MAGIC = "RTPT1"  # the pool-propagation record family tag
 _HDR = struct.Struct("<II")
 MAX_FRAME = 256 * 1024 * 1024  # sanity bound: no witness comes close
 
@@ -141,13 +164,14 @@ def recv_frame(sock: socket.socket):
 
 
 class _Subscriber:
-    __slots__ = ("sock", "lock", "addr", "wal")
+    __slots__ = ("sock", "lock", "addr", "wal", "pool")
 
     def __init__(self, sock: socket.socket, addr):
         self.sock = sock
         self.lock = threading.Lock()  # one frame at a time per socket
         self.addr = addr
-        self.wal = False  # True once the peer sent subscribe_wal
+        self.wal = False   # True once the peer sent subscribe_wal
+        self.pool = False  # True once the peer sent subscribe_pool
 
 
 class WitnessFeedServer:
@@ -214,6 +238,11 @@ class WitnessFeedServer:
         self.st_dropped = 0
         self.heartbeats_sent = 0
         self.resyncs_sent = 0
+        # -- pool propagation (RTPT1 family, pool/pool.py listeners) ------
+        self._pool = None
+        self.pt_records_sent = 0
+        self.pt_dropped = 0
+        self.pt_snapshots_sent = 0
         # RETH_TPU_FAULT_LEADER_PARTITION=<dur_s>[:<start_s>] — suppress
         # every RTST1 frame (records AND heartbeats) for dur_s starting
         # start_s (default 1.0) after the server starts: the network
@@ -416,10 +445,20 @@ class WitnessFeedServer:
     def _st_enqueue(self, item) -> None:
         with self._st_cond:
             while len(self._st_queue) >= self._st_cap:
-                # drop the OLDEST shipped record: the standby detects
-                # the seq gap and re-anchors via resync
-                self._st_queue.popleft()
-                self.st_dropped += 1
+                # drop the OLDEST shipped record: a standby detects the
+                # seq gap and re-anchors via resync; a pool subscriber
+                # detects its pt seq gap and re-subscribes for a snapshot
+                dropped = self._st_queue.popleft()
+                if dropped[0] in ("pool", "pool_snapshot"):
+                    self.pt_dropped += 1
+                    try:
+                        from ..metrics import pool_metrics
+
+                        pool_metrics.record_feed_drop()
+                    except Exception:  # noqa: BLE001
+                        pass
+                else:
+                    self.st_dropped += 1
             self._st_queue.append(item)
             self._st_cond.notify()
 
@@ -445,6 +484,78 @@ class WitnessFeedServer:
             "type": "st_fcu", "st": ST_MAGIC, "epoch": self.epoch,
             "number": number, "hash": head_hash}))
 
+    # -- pool propagation (RTPT1, the replicas' pending view) ---------------
+
+    def attach_pool(self, pool) -> None:
+        """Hook the node's TransactionPool: every pool event (admission /
+        replacement / drop / canon) ships as a ``pt_*`` record to pool
+        subscribers. The listener runs under the pool lock, so it only
+        encodes and enqueues — the ship thread does the socket work."""
+        self._pool = pool
+        pool.add_listener(self._pool_event)
+
+    def _pool_event(self, ev: dict) -> None:
+        kind = ev.get("kind")
+        rec = {"pt": PT_MAGIC, "seq": ev["seq"]}
+        if kind in ("add", "replace"):
+            tx = ev["tx"]
+            rec.update(type=f"pt_{kind}", tx=tx.encode(), hash=tx.hash,
+                       sender=ev.get("sender"), nonce=tx.nonce)
+            if kind == "replace":
+                rec["old_hash"] = ev.get("old_hash")
+        elif kind == "drop":
+            rec.update(type="pt_drop", hash=ev.get("hash"),
+                       sender=ev.get("sender"), reason=ev.get("reason"))
+        elif kind == "canon":
+            rec.update(type="pt_canon", base_fee=ev.get("base_fee"),
+                       blob_base_fee=ev.get("blob_base_fee"))
+        else:
+            return
+        self._st_enqueue(("pool", rec))
+
+    def _broadcast_pool(self, record: dict) -> None:
+        with self._lock:
+            subs = [s for s in self._subs if s.pool]
+        if not subs:
+            return
+        for s in subs:
+            try:
+                with s.lock:
+                    send_frame(s.sock, record)
+            except OSError:
+                self._drop(s)
+        try:
+            from ..metrics import pool_metrics
+
+            pool_metrics.record_shipped(len(subs))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _send_pool_snapshot(self, sub: _Subscriber) -> None:
+        """Full pending set for one subscriber, sent from the ship
+        thread so it lands IN ORDER with the pt_* stream: every queued
+        record before it carries seq <= the snapshot's, every one after
+        continues from it (same discipline as st_resync)."""
+        pool = self._pool
+        if pool is None:
+            return
+        with pool._lock:
+            seq = pool.event_seq
+            txs = [(p.tx.encode(), p.sender)
+                   for p in sorted(pool.by_hash.values(),
+                                   key=lambda p: p.submission_id)]
+            base_fee, blob_fee = pool.base_fee, pool.blob_base_fee
+        rec = {"type": "pt_snapshot", "pt": PT_MAGIC, "seq": seq,
+               "base_fee": base_fee, "blob_base_fee": blob_fee,
+               "txs": txs}
+        try:
+            with sub.lock:
+                send_frame(sub.sock, rec)
+        except OSError:
+            self._drop(sub)
+            return
+        self.pt_snapshots_sent += 1
+
     def _ship_loop(self) -> None:
         """Drain the ship queue to WAL subscribers; a silent queue still
         beats ``st_heartbeat`` at the configured cadence — the standby's
@@ -465,8 +576,15 @@ class WitnessFeedServer:
                 if kind == "resync":
                     self._send_resync(item)
                     continue
+                if kind == "pool_snapshot":
+                    self._send_pool_snapshot(item)
+                    continue
                 if partitioned:
                     self.partition_suppressed += 1
+                    continue
+                if kind == "pool":
+                    self._broadcast_pool(item)
+                    self.pt_records_sent += 1
                     continue
                 self._broadcast_wal(item)
                 if item["type"] == "st_wal":
@@ -573,6 +691,14 @@ class WitnessFeedServer:
         if kind == "resync_request":
             if sub.wal:
                 self._st_enqueue(("resync", sub))
+            return
+        if kind == "subscribe_pool":
+            # mark BEFORE queuing the snapshot so every pt record shipped
+            # from now on reaches this subscriber; the snapshot lands
+            # in-stream and seq-anchors the tail (a re-subscribe after a
+            # detected gap follows the same path)
+            sub.pool = True
+            self._st_enqueue(("pool_snapshot", sub))
             return
         if kind == "resubscribe":
             # reconnect catch-up: re-send retained block records above
@@ -702,11 +828,16 @@ class WitnessFeedServer:
         with self._lock:
             subs = len(self._subs)
             wal_subs = sum(1 for s in self._subs if s.wal)
+            pool_subs = sum(1 for s in self._subs if s.pool)
             backlog = len(self._backlog)
         return {
             "port": self.port,
             "subscribers": subs,
             "wal_subscribers": wal_subs,
+            "pool_subscribers": pool_subs,
+            "pt_records_sent": self.pt_records_sent,
+            "pt_snapshots_sent": self.pt_snapshots_sent,
+            "pt_dropped": self.pt_dropped,
             "epoch": self.epoch,
             "st_records_sent": self.st_records_sent,
             "st_manifests_sent": self.st_manifests_sent,

@@ -25,6 +25,18 @@ never sees it. A block outside the retained window answers ``-32001``
 the same way. The replica deliberately errs instead of approximating:
 every answer it does give is bit-identical to the full node's.
 
+The pending view (:class:`ReplicaPoolView`): the replica also
+subscribes to the leader pool's ``pt_*`` record family (feed.py) —
+snapshot + incremental admissions/replacements/drops keyed by a
+monotonic pool ``seq`` — and serves ``eth_getTransactionByHash`` for
+unmined txs, pending-tag ``eth_getTransactionCount``, and the
+``txpool_*`` namespace from it instead of answering ``-32001``. The
+same doctrine applies: a seq gap (records shed upstream under
+backpressure, or lost across a reconnect) makes the view unsynced and
+the replica re-subscribes for a fresh snapshot rather than serving a
+silently-divergent pending set; while unsynced, pool reads answer
+``-32001`` and fail over.
+
 Fault injection (:class:`ReplicaFaultInjector`):
 ``RETH_TPU_FAULT_REPLICA_WEDGE=1`` stops feed processing (the replica
 keeps serving its stale head — the lag the gateway ring must shed);
@@ -54,7 +66,8 @@ from ..primitives.types import (
     KECCAK_EMPTY,
     Transaction,
 )
-from ..rpc.convert import block_to_rpc, data, parse_data, parse_qty, qty
+from ..rpc.convert import block_to_rpc, data, parse_data, parse_qty, qty, \
+    tx_to_rpc
 from ..rpc.server import RpcError, RpcServer
 from ..trie.sparse import BlindedNodeError
 from .feed import WitnessFeedClient
@@ -157,6 +170,112 @@ class ReplicaStateSource(StateSource):
         return code
 
 
+class ReplicaPoolView:
+    """The fleet-propagated pending-tx set, rebuilt from ``pt_*``
+    records: hash → ``(tx, sender)`` plus a per-sender nonce map, bounded
+    by ``limit`` (oldest admission evicted first — same pressure
+    direction as the leader pool's own eviction). ``seq`` tracks the
+    leader pool's event sequence; -1 means "no snapshot yet" and every
+    incremental record is ignored until one lands (the snapshot
+    supersedes whatever those records would have said). Mutated only
+    under the owning replica's lock."""
+
+    def __init__(self, limit: int = 8192):
+        self.limit = limit
+        self.seq = -1
+        self.base_fee = 0
+        self.blob_base_fee = 0
+        # hash -> (tx, sender); insertion-ordered = admission-ordered
+        self.txs: dict[bytes, tuple[Transaction, bytes]] = {}
+        self.by_sender: dict[bytes, dict[int, bytes]] = {}
+        self.records = 0
+        self.snapshots = 0
+        self.evicted = 0
+        self.decode_errors = 0
+
+    def _insert(self, tx: Transaction, sender: bytes) -> None:
+        nonces = self.by_sender.setdefault(sender, {})
+        old = nonces.get(tx.nonce)
+        if old is not None and old != tx.hash:
+            self.txs.pop(old, None)
+        self.txs[tx.hash] = (tx, sender)
+        nonces[tx.nonce] = tx.hash
+        while len(self.txs) > self.limit:
+            h, (otx, osender) = next(iter(self.txs.items()))
+            self._remove(h, otx, osender)
+            self.evicted += 1
+
+    def _remove(self, h: bytes, tx=None, sender=None) -> None:
+        entry = self.txs.pop(h, None)
+        if entry is not None:
+            tx, sender = entry
+        if tx is None or sender is None:
+            return
+        nonces = self.by_sender.get(sender)
+        if nonces is not None and nonces.get(tx.nonce) == h:
+            del nonces[tx.nonce]
+            if not nonces:
+                del self.by_sender[sender]
+
+    def apply(self, record: dict) -> str:
+        """Apply one ``pt_*`` record; returns ``"ok"`` or ``"gap"``.
+        After a gap the view resets to unsynced (seq -1) so the caller's
+        re-subscribe races no further gap reports."""
+        kind = record.get("type")
+        seq = int(record.get("seq") or 0)
+        if kind == "pt_snapshot":
+            self.txs.clear()
+            self.by_sender.clear()
+            self.base_fee = record.get("base_fee") or 0
+            self.blob_base_fee = record.get("blob_base_fee") or 0
+            for raw, sender in record.get("txs") or ():
+                try:
+                    self._insert(Transaction.decode(raw), sender)
+                except Exception:  # noqa: BLE001 - skip the bad entry
+                    self.decode_errors += 1
+            self.seq = seq
+            self.snapshots += 1
+            return "ok"
+        if self.seq < 0 or seq <= self.seq:
+            # not yet snapshotted, or a record the snapshot already
+            # folded in (the subscribe/broadcast enqueue race)
+            return "ok"
+        if seq != self.seq + 1:
+            self.seq = -1
+            return "gap"
+        self.records += 1
+        self.seq = seq
+        if kind in ("pt_add", "pt_replace"):
+            try:
+                tx = Transaction.decode(record["tx"])
+            except Exception:  # noqa: BLE001
+                self.decode_errors += 1
+                return "ok"
+            if kind == "pt_replace":
+                old = record.get("old_hash")
+                if old:
+                    self._remove(old)
+            self._insert(tx, record.get("sender"))
+        elif kind == "pt_drop":
+            self._remove(record.get("hash"))
+        elif kind == "pt_canon":
+            self.base_fee = record.get("base_fee") or 0
+            self.blob_base_fee = record.get("blob_base_fee") or 0
+        return "ok"
+
+
+class _PoolViewContent:
+    """Duck-typed ``pool`` for :class:`~reth_tpu.rpc.net.TxpoolApi`:
+    ``content()`` computed from the replica's pending view so the
+    txpool_* response shapes come from the one canonical formatter."""
+
+    def __init__(self, api: "ReplicaEthApi"):
+        self.api = api
+
+    def content(self):
+        return self.api._pool_content()
+
+
 class ReplicaEthApi:
     """The replica's read surface. Handlers mirror ``rpc/eth.py``'s
     exactly (same env construction, same frame building, same response
@@ -165,7 +284,10 @@ class ReplicaEthApi:
     does not hold, which the fleet router converts into a failover."""
 
     def __init__(self, replica: "ReplicaNode"):
+        from ..rpc.net import TxpoolApi
+
         self.r = replica
+        self._txpool = TxpoolApi(_PoolViewContent(self))
 
     # -- helpers ------------------------------------------------------------
 
@@ -381,6 +503,100 @@ class ReplicaEthApi:
         except BlindedNodeError as e:
             raise self._blinded(e) from None
 
+    # -- pending txs (fleet pool view) --------------------------------------
+
+    def _view(self) -> ReplicaPoolView:
+        v = self.r.pool_view
+        if v is None or v.seq < 0:
+            raise RpcError(NOT_IN_WITNESS, "replica pool view not synced")
+        return v
+
+    def eth_getTransactionByHash(self, tx_hash):
+        h = parse_data(tx_hash)
+        v = self.r.pool_view
+        if v is not None and v.seq >= 0:
+            entry = v.txs.get(h)
+            if entry is not None:
+                tx, sender = entry
+                return tx_to_rpc(tx, sender=sender)  # pending: null block
+        # mined within the retained window: the records hold everything
+        for n, rec in self.r.blocks.items():
+            block: Block = rec["block"]
+            for i, tx in enumerate(block.transactions):
+                if tx.hash == h:
+                    return tx_to_rpc(tx, block.header, i,
+                                     rec["senders"][i])
+        # outside both views: fail over rather than answer None — the
+        # full node may know it (older block, or a pool gap here)
+        raise RpcError(NOT_IN_WITNESS,
+                       "tx not in the replica's pending view or window")
+
+    def eth_getTransactionCount(self, address, tag="latest"):
+        addr = parse_data(address)
+        pending = tag == "pending"
+        _head, st = self._state_trie("latest" if pending else tag)
+        try:
+            acc = ReplicaStateSource(st, self.r.codes).account(addr)
+        except BlindedNodeError as e:
+            raise self._blinded(e) from None
+        nonce = acc.nonce if acc else 0
+        if pending:
+            # mirror pool.pooled_nonce: highest contiguous pooled
+            # nonce + 1; an unsynced view must fail over, not undercount
+            nonces = self._view().by_sender.get(addr, {})
+            while nonce in nonces:
+                nonce += 1
+        return qty(nonce)
+
+    def _pool_content(self):
+        """``pool.content()``-shaped view over the propagated pending
+        set, mirroring the leader's bucketing: nonce-gapped or
+        under-base-fee txs are "queued", the executable rest "pending".
+        A sender whose account the witness never revealed buckets from
+        its lowest propagated nonce — admission-level records carry no
+        on-chain nonce, and guessing lower would fabricate a gap."""
+        v = self._view()
+        st = self.r.state_trie()
+        src = (ReplicaStateSource(st, self.r.codes)
+               if st is not None else None)
+        out: dict = {"pending": {}, "queued": {}}
+        for sender, nonces in v.by_sender.items():
+            next_nonce = None
+            if src is not None:
+                try:
+                    acc = src.account(sender)
+                    next_nonce = acc.nonce if acc else 0
+                except BlindedNodeError:
+                    next_nonce = None
+            if next_nonce is None:
+                next_nonce = min(nonces)
+            for nonce in sorted(nonces):
+                tx, _sender = v.txs[nonces[nonce]]
+                gap = nonce > next_nonce
+                if tx.tx_type >= 2:
+                    tip = (-1 if tx.max_fee_per_gas < v.base_fee
+                           else min(tx.max_priority_fee_per_gas,
+                                    tx.max_fee_per_gas - v.base_fee))
+                else:
+                    tip = tx.gas_price - v.base_fee
+                key = "pending" if not gap and tip >= 0 else "queued"
+                out[key].setdefault(sender, {})[nonce] = tx
+                if not gap:
+                    next_nonce = nonce + 1
+        return out
+
+    def txpool_status(self):
+        return self._txpool.txpool_status()
+
+    def txpool_content(self):
+        return self._txpool.txpool_content()
+
+    def txpool_contentFrom(self, address):
+        return self._txpool.txpool_contentFrom(address)
+
+    def txpool_inspect(self):
+        return self._txpool.txpool_inspect()
+
     # -- fleet control ------------------------------------------------------
 
     def fleet_status(self):
@@ -426,6 +642,10 @@ class ReplicaNode:
         self.blocks_validated = 0
         self.validation_failures = 0
         self.blinded_reads = 0
+        # pending view fed by the leader pool's pt_* records; unsynced
+        # (seq -1) until the first pt_snapshot lands post-subscribe
+        self.pool_view: ReplicaPoolView | None = ReplicaPoolView()
+        self.pool_resubscribes = 0
         self.injector = (injector if injector is not None
                          else ReplicaFaultInjector.from_env())
         self.metrics = ReplicaMetrics(registry)
@@ -534,6 +754,13 @@ class ReplicaNode:
                                             hasher=self.hasher)
             if hello.get("head") is not None:
                 self.announced = tuple(hello["head"])
+            if self.pool_view is not None:
+                # a new session starts unsynced: the server-side pool
+                # flag died with the old socket, and the fresh snapshot
+                # the re-subscribe earns resets the view wholesale
+                self.pool_view.seq = -1
+        if self.pool_view is not None:
+            self.client.send({"type": "subscribe_pool"})
         if register_target is not None:
             threading.Thread(target=self._register_with,
                              args=(register_target,), daemon=True,
@@ -578,6 +805,9 @@ class ReplicaNode:
                                     correlation_id=cid,
                                     window=record.get("window"))
             return
+        if kind and kind.startswith("pt_"):
+            self._apply_pool_record(record)
+            return
         if kind != "block":
             return
         # the announcement is the block itself: lag accounting must see
@@ -592,6 +822,23 @@ class ReplicaNode:
                 self._update_lag()
             return
         self._apply_block(record)
+
+    def _apply_pool_record(self, record: dict) -> None:
+        view = self.pool_view
+        if view is None:
+            return
+        with self.lock:
+            outcome = view.apply(record)
+        if outcome == "gap":
+            # records were shed upstream (drop-oldest backpressure) or
+            # lost in a partition drill: re-subscribe for a fresh
+            # snapshot instead of serving a silently-divergent view
+            # (apply() already reset the view to unsynced, so reads
+            # answer -32001 and fail over until the snapshot lands)
+            self.pool_resubscribes += 1
+            tracing.event("fleet::replica", "pool_view_gap",
+                          seq=record.get("seq"))
+            self.client.send({"type": "subscribe_pool"})
 
     def _apply_block(self, record: dict) -> None:
         from ..engine.witness import ExecutionWitness
@@ -701,5 +948,13 @@ class ReplicaNode:
                           if self.blocks else None,
                 "wedged": bool(self.injector is not None
                                and self.injector.wedging),
+                "pool_view": ({
+                    "synced": self.pool_view.seq >= 0,
+                    "seq": self.pool_view.seq,
+                    "txs": len(self.pool_view.txs),
+                    "records": self.pool_view.records,
+                    "snapshots": self.pool_view.snapshots,
+                    "resubscribes": self.pool_resubscribes,
+                } if self.pool_view is not None else None),
                 "uptime_s": round(time.time() - self.started_at, 1),
             }

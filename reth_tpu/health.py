@@ -501,6 +501,26 @@ def default_rules() -> list[SloRule]:
                 failing_factor=8.0,
                 help="hot-standby replay lag (heads behind the leader "
                      "heartbeat; bounds the failover loss window)"),
+        # write-path firehose (pool/batcher.py): sustained -32005
+        # admission shedding means the insert worker has fallen behind
+        # the submit rate for a whole window — clients are being told to
+        # back off faster than the pool absorbs. Bursty sheds within a
+        # window are the backpressure ladder WORKING, so the budget is a
+        # sustained rate, not a single-burst count
+        SloRule("pool_shed_rate", "pool", "rate", 10.0,
+                metric="pool_admission_sheds_total", unit="/s",
+                help="sustained tx-admission shed rate (-32005 "
+                     "backpressure saturating for whole windows)"),
+        # continuous producer (payload/producer.py): staleness is how
+        # long the hot candidate has lagged the pool. A stale candidate
+        # silently degrades continuous build back to build-on-demand;
+        # sustained staleness means the refresh loop is wedged or
+        # drowning — failing once it exceeds a block interval
+        SloRule("producer_staleness", "producer", "gauge", 1.0,
+                metric="producer_staleness_seconds", unit="s",
+                failing_factor=12.0,
+                help="hot-candidate staleness behind the pool (refresh "
+                     "loop wedged or outpaced)"),
     ]
     return rules
 

@@ -333,6 +333,32 @@ class NodeEventReporter:
             if bp.get("lease_devices"):
                 line += f" lease={bp['lease_devices']}d"
             line += "]"
+        # write-path firehose: pool admissions/replacements/drops since
+        # start, -32005 sheds, and pt_* records shipped to the fleet —
+        # the one-line answer to "is the firehose being absorbed"
+        from ..metrics import pool_metrics, producer_metrics
+
+        pl = pool_metrics.last
+        if pl:
+            line += (f" pool[add={pl.get('add', 0)}"
+                     f" repl={pl.get('replace', 0)}"
+                     f" drop={pl.get('drop', 0)}")
+            if pl.get("sheds"):
+                line += f" shed={pl['sheds']}"
+            if pl.get("shipped"):
+                line += f" ship={pl['shipped']}"
+            line += "]"
+        # continuous producer: candidate size, incremental economy
+        # (fresh-executed vs replayed ranks), refresh cadence, staleness
+        pr = producer_metrics.last
+        if pr and pr.get("refreshes"):
+            line += (f" build[ranks={pr.get('ranks', 0)}"
+                     f" fresh={pr.get('fresh', 0)}"
+                     f" re={pr.get('reexec', 0)}"
+                     f" refr={pr.get('refreshes', 0)}")
+            if pr.get("staleness_s", 0) > 0.5:
+                line += f" stale={pr['staleness_s']:.1f}s"
+            line += "]"
         # --health: the SLO engine's verdict — node status, any non-ok
         # component, and the breach counter an operator pages on. The
         # one line that says "the node itself thinks it is sick" instead

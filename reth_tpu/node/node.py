@@ -73,6 +73,12 @@ class NodeConfig:
     # pipeline (engine/block_pipeline.py); 2 = speculate block N+1
     # while N commits, None = env RETH_TPU_PIPELINE_DEPTH (default 1)
     pipeline_depth: int | None = None
+    # --continuous-build / [node] continuous_build: standing block
+    # producer (payload/producer.py) — keeps a hot candidate payload
+    # incrementally refreshed on pool events and head changes, so
+    # getPayload / dev mining seal instead of building from scratch;
+    # rides the commit window when the import pipeline is on
+    continuous_build: bool = False
     # --rpc-gateway / [rpc] gateway: route every transport's dispatch
     # through the serving gateway (rpc/gateway.py): admission control
     # with priority classes, in-flight coalescing, and a head-invalidated
@@ -420,6 +426,17 @@ class Node:
         shared_lock = threading.RLock()
         # payload improvement loops must serialise with engine/RPC handlers
         self.payload_service.lock = shared_lock
+        # --continuous-build: the standing producer shares the engine
+        # lock, feeds payload jobs AND the dev miner its hot candidate
+        self.producer = None
+        if config.continuous_build:
+            from ..payload import BlockProducer
+
+            self.producer = BlockProducer(self.tree, self.pool,
+                                          lock=shared_lock)
+            self.payload_service.producer = self.producer
+            if self.miner is not None:
+                self.miner.producer = self.producer
         # serving gateway (--rpc-gateway): ONE gateway shared by the
         # public and auth servers (one admission domain — engine traffic
         # outranks public debug traffic) and by the WS/IPC transports
@@ -451,6 +468,10 @@ class Node:
             if self.durability is not None:
                 self.feed_server.attach_durability(self.durability)
                 self.tree.fcu_listeners.append(self.feed_server.ship_fcu)
+            # pending-tx propagation: every pool admission/replacement/
+            # drop ships as a pt_* record to subscribed replicas, so the
+            # fleet answers pending reads instead of failing them over
+            self.feed_server.attach_pool(self.pool)
             self.fleet_router = FleetRouter(max_lag=config.fleet_max_lag)
             self.tree.canon_listeners.append(self.fleet_router.on_head_change)
             # metrics federation: background pulls of every replica's
@@ -491,6 +512,10 @@ class Node:
         self.rpc.register(BundleApi(self.eth_api))
         self.rpc.register(ValidationApi(self.eth_api))
         self.rpc.register(MinerApi(self.payload_service, self.pool))
+        if self.producer is not None:
+            from ..rpc.net import ProducerApi
+
+            self.rpc.register(ProducerApi(self.producer))
         if self.fleet_router is not None:
             from ..fleet.ring import FleetAdminApi
 
@@ -681,6 +706,8 @@ class Node:
         """Start the RPC transports; returns (http_port, authrpc_port).
         The WS port (when enabled) is at ``self.ws.port`` after this."""
         self.event_reporter.start()
+        if self.producer is not None:
+            self.producer.start()
         ports = self.rpc.start(), self.authrpc.start()
         if self.feed_server is not None:
             # hello field: a re-anchoring replica registers with this
@@ -699,6 +726,8 @@ class Node:
         return ports
 
     def stop(self):
+        if self.producer is not None:
+            self.producer.stop()
         self.tx_batcher.close()
         if self.health is not None:
             from .. import health as health_mod

@@ -6,5 +6,7 @@ Reference analogue: crates/payload — `PayloadBuilderService`/`PayloadJob`
 """
 
 from .builder import PayloadAttributes, PayloadBuilderService, build_payload
+from .producer import BlockProducer
 
-__all__ = ["PayloadAttributes", "PayloadBuilderService", "build_payload"]
+__all__ = ["BlockProducer", "PayloadAttributes", "PayloadBuilderService",
+           "build_payload"]

@@ -38,22 +38,15 @@ class PayloadAttributes:
     parent_beacon_block_root: bytes | None = None
 
 
-def build_payload(
-    tree: EngineTree,
-    pool,
-    parent_hash: bytes,
-    attrs: PayloadAttributes,
-    extra_data: bytes = b"",
-    gas_ceiling: int | None = None,
-) -> Block:
-    """Assemble a sealed block on top of ``parent_hash``; returns
-    (block, total priority fees). ``pool=None`` builds the empty-payload
-    fallback (reference BasicPayloadJob's pre-built empty payload)."""
+def payload_env(tree: EngineTree, parent: Header, attrs: PayloadAttributes,
+                gas_ceiling: int | None = None):
+    """Fee-market + block-env context for a child of ``parent``; returns
+    ``(env, base_fee, cancun, excess_blob, blob_params)``. Shared by the
+    one-shot builder below and the continuous producer (producer.py),
+    which must price candidates identically or its incremental candidate
+    diverges from the serial greedy build."""
     from ..evm.executor import blob_base_fee, next_excess_blob_gas
 
-    overlay = tree.overlay_provider(parent_hash)
-    parent_num = overlay.block_number(parent_hash)
-    parent = overlay.header_by_number(parent_num)
     base_fee = calc_next_base_fee(parent)
     blob_params = tree.config.blob_params_for(parent.number + 1, attrs.timestamp)
     # EIP-4844: blob fields continue once the parent carries them
@@ -80,6 +73,25 @@ def build_payload(
         chain_id=tree.config.chain_id,
         blob_base_fee=blob_base_fee(excess_blob, blob_params.update_fraction),
     )
+    return env, base_fee, cancun, excess_blob, blob_params
+
+
+def build_payload(
+    tree: EngineTree,
+    pool,
+    parent_hash: bytes,
+    attrs: PayloadAttributes,
+    extra_data: bytes = b"",
+    gas_ceiling: int | None = None,
+) -> Block:
+    """Assemble a sealed block on top of ``parent_hash``; returns
+    (block, total priority fees). ``pool=None`` builds the empty-payload
+    fallback (reference BasicPayloadJob's pre-built empty payload)."""
+    overlay = tree.overlay_provider(parent_hash)
+    parent_num = overlay.block_number(parent_hash)
+    parent = overlay.header_by_number(parent_num)
+    env, base_fee, cancun, excess_blob, blob_params = payload_env(
+        tree, parent, attrs, gas_ceiling)
     executor = BlockExecutor(ProviderStateSource(overlay), tree.config)
     state = EvmState(executor.source)
     selected: list[Transaction] = []
@@ -253,7 +265,7 @@ class PayloadJob:
 
     def __init__(self, tree, pool, parent_hash, attrs, lock, deadline: float,
                  interval: float, extra_data: bytes = b"",
-                 gas_ceiling: int | None = None):
+                 gas_ceiling: int | None = None, producer=None):
         self.tree = tree
         self.pool = pool
         self.parent_hash = parent_hash
@@ -263,16 +275,14 @@ class PayloadJob:
         self.interval = interval
         self.extra_data = extra_data
         self.gas_ceiling = gas_ceiling
+        self.producer = producer
         self.best: Block | None = None
         self.best_fees: int = -1
         self.rebuilds = 0
         self._resolved = threading.Event()
         with self.lock:
             try:
-                self.best, self.best_fees = build_payload(
-                    tree, pool, parent_hash, attrs,
-                    extra_data=extra_data, gas_ceiling=gas_ceiling,
-                )
+                self.best, self.best_fees = self._build_once()
             except Exception:  # noqa: BLE001 — fall back to an empty payload
                 self.best, self.best_fees = build_payload(
                     tree, None, parent_hash, attrs,
@@ -281,6 +291,21 @@ class PayloadJob:
         self._thread = threading.Thread(target=self._improve_loop, daemon=True)
         self._thread.start()
 
+    def _build_once(self):
+        """One full build: seal the continuous producer's hot candidate
+        when one is attached (incremental refresh, no re-execution on a
+        hot hit), else the one-shot serial/parallel builder."""
+        if self.producer is not None:
+            try:
+                return self.producer.take(
+                    self.parent_hash, self.attrs, extra_data=self.extra_data,
+                    gas_ceiling=self.gas_ceiling)
+            except Exception:  # noqa: BLE001 — the one-shot builder is
+                pass           # always the fallback
+        return build_payload(self.tree, self.pool, self.parent_hash,
+                             self.attrs, extra_data=self.extra_data,
+                             gas_ceiling=self.gas_ceiling)
+
     def rebuild(self) -> bool:
         """One re-build; swaps only a strictly better payload. Returns
         whether the swap happened."""
@@ -288,10 +313,7 @@ class PayloadJob:
             if self._resolved.is_set():
                 return False
             try:
-                block, fees = build_payload(self.tree, self.pool,
-                                            self.parent_hash, self.attrs,
-                                            extra_data=self.extra_data,
-                                            gas_ceiling=self.gas_ceiling)
+                block, fees = self._build_once()
             except Exception:  # noqa: BLE001 — keep the current best
                 return False
             self.rebuilds += 1
@@ -320,12 +342,14 @@ class PayloadBuilderService:
     MAX_JOBS = 16
 
     def __init__(self, tree: EngineTree, pool, lock=None,
-                 deadline: float = 2.0, interval: float = 0.25):
+                 deadline: float = 2.0, interval: float = 0.25,
+                 producer=None):
         self.tree = tree
         self.pool = pool
         self.lock = lock or threading.RLock()
         self.deadline = deadline
         self.interval = interval
+        self.producer = producer
         # miner_ knobs (rpc/miner.py): stamped into every subsequent job
         self.extra_data: bytes = b""
         self.gas_ceiling: int | None = None
@@ -337,6 +361,7 @@ class PayloadBuilderService:
             self.tree, self.pool, parent_hash, attrs, self.lock,
             self.deadline, self.interval,
             extra_data=self.extra_data, gas_ceiling=self.gas_ceiling,
+            producer=self.producer,
         )
         while len(self.jobs) > self.MAX_JOBS:
             self.jobs.pop(next(iter(self.jobs))).resolve()

@@ -8,6 +8,7 @@ Reference analogue: crates/transaction-pool — the `TransactionPool` trait
 """
 
 from .pool import PoolConfig, PoolError, TransactionPool
-from .batcher import TxBatcher
+from .batcher import PoolOverloaded, TxBatcher
 
-__all__ = ["PoolConfig", "PoolError", "TransactionPool", "TxBatcher"]
+__all__ = ["PoolConfig", "PoolError", "PoolOverloaded", "TransactionPool",
+           "TxBatcher"]

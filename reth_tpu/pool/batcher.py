@@ -24,16 +24,37 @@ from ..primitives.types import Transaction, recover_senders
 from .pool import PoolError
 
 
+class PoolOverloaded(PoolError):
+    """Admission queue is full — the firehose outran the insert worker.
+
+    Carries ``retry_after_s`` so the RPC layer can map this onto the
+    gateway's shed convention (``-32005`` + retry_after) instead of the
+    generic ``-32000`` pool error. Bounding the queue here is what keeps a
+    tx flood from growing memory without limit and from starving the
+    gateway's engine lanes: the submit call fails fast instead of
+    parking work forever.
+    """
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(
+            f"transaction pool overloaded ({depth} admissions queued)")
+        self.retry_after_s = retry_after_s
+
+
 class TxBatcher:
     """Worker-thread insertion batcher over a :class:`TransactionPool`."""
 
-    def __init__(self, pool, max_batch: int = 128):
+    def __init__(self, pool, max_batch: int = 128, max_queue: int = 8192,
+                 retry_after_s: float = 0.5):
         self.pool = pool
         self.max_batch = max_batch
+        self.max_queue = max_queue
+        self.retry_after_s = retry_after_s
         self._q: queue.Queue = queue.Queue()
         self._closed = False
         self.batches = 0
         self.processed = 0
+        self.sheds = 0
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="tx-batcher")
         self._thread.start()
@@ -42,10 +63,21 @@ class TxBatcher:
 
     def submit(self, tx: Transaction) -> Future:
         """Enqueue a tx; the Future resolves to its hash or raises
-        PoolError."""
+        PoolError (PoolOverloaded when the admission queue is saturated)."""
         fut: Future = Future()
         if self._closed:
             fut.set_exception(PoolError("batcher closed"))
+            return fut
+        depth = self._q.qsize()
+        if self.max_queue and depth >= self.max_queue:
+            self.sheds += 1
+            try:
+                from ..metrics import pool_metrics
+
+                pool_metrics.record_shed()
+            except Exception:  # noqa: BLE001
+                pass
+            fut.set_exception(PoolOverloaded(depth, self.retry_after_s))
             return fut
         self._q.put((tx, fut))
         return fut

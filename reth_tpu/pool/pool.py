@@ -91,6 +91,47 @@ class TransactionPool:
         # worker, and canonical-update maintenance all touch the indexes
         # (reference: the pool lives behind a RwLock)
         self._lock = threading.RLock()
+        # pool-event plane (reference: TransactionPool's event listeners,
+        # src/pool/events.rs): every admission/replacement/drop/canon
+        # update is published to registered sinks under the pool lock with
+        # a monotonic sequence number. The continuous block producer keys
+        # its incremental refreshes off ``event_seq``; the fleet feed
+        # ships the same events as ``pt_*`` records so replicas hold a
+        # pending view. Listeners must be fast and non-blocking.
+        self.listeners: list = []
+        self.event_seq: int = 0
+
+    # -- events ----------------------------------------------------------------
+
+    def add_listener(self, fn) -> None:
+        """Register a pool-event sink: ``fn(event_dict)`` called under the
+        pool lock for add/replace/drop/canon events."""
+        with self._lock:
+            self.listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self.listeners:
+                self.listeners.remove(fn)
+
+    def _emit(self, kind: str, **fields) -> None:
+        """Publish one pool event (lock held by every caller)."""
+        self.event_seq += 1
+        try:
+            from ..metrics import pool_metrics
+
+            pool_metrics.on_event(kind, fields.get("reason"))
+        except Exception:  # noqa: BLE001 — metrics never block admission
+            pass
+        if not self.listeners:
+            return
+        ev = {"seq": self.event_seq, "kind": kind}
+        ev.update(fields)
+        for fn in list(self.listeners):
+            try:
+                fn(ev)
+            except Exception:  # noqa: BLE001 — a broken sink must not
+                pass           # poison admission for everyone
 
     # -- submission -----------------------------------------------------------
 
@@ -166,10 +207,12 @@ class TransactionPool:
             raise PoolError("insufficient funds")
         sender_txs = self.by_sender.setdefault(sender, {})
         existing = sender_txs.get(tx.nonce)
+        replaced_hash: bytes | None = None
         if existing is not None:
             bump = existing.max_fee() * (100 + MIN_PRICE_BUMP_PERCENT) // 100
             if self._fee_of(tx) < bump:
                 raise PoolError("replacement underpriced")
+            replaced_hash = existing.tx.hash
             self._drop(existing.tx.hash)
         if len(sender_txs) >= self.config.max_account_slots and existing is None:
             raise PoolError("sender slot limit")
@@ -190,6 +233,10 @@ class TransactionPool:
         ptx = PooledTx(tx, sender, next(self._submission_counter), cost)
         sender_txs[tx.nonce] = ptx
         self.by_hash[h] = ptx
+        if replaced_hash is not None:
+            self._emit("replace", tx=tx, sender=sender, old_hash=replaced_hash)
+        else:
+            self._emit("add", tx=tx, sender=sender)
         self.updated.set()
         return h
 
@@ -204,8 +251,11 @@ class TransactionPool:
             raise PoolError("pool full: transaction underpriced")
         txs = self.by_sender.get(worst.sender, {})
         for n in sorted(n for n in txs if n >= worst.nonce):
-            self._drop(txs[n].tx.hash)
+            dropped = txs[n].tx.hash
+            self._drop(dropped)
             del txs[n]
+            self._emit("drop", hash=dropped, sender=worst.sender,
+                       reason="evicted")
         if not txs:
             self.by_sender.pop(worst.sender, None)
 
@@ -240,6 +290,7 @@ class TransactionPool:
             txs.pop(ptx.nonce, None)
             if not txs:
                 del self.by_sender[ptx.sender]
+        self._emit("drop", hash=tx_hash, sender=ptx.sender, reason="invalid")
 
     def get_blob_sidecar(self, tx_hash: bytes):
         return self.blob_store.get(tx_hash)
@@ -355,12 +406,22 @@ class TransactionPool:
             balance = acct.balance if acct else 0
             txs = self.by_sender[sender]
             for n in [n for n in txs if n < nonce]:
-                self._drop(txs[n].tx.hash, mined=True)
+                mined_hash = txs[n].tx.hash
+                self._drop(mined_hash, mined=True)
                 del txs[n]
+                self._emit("drop", hash=mined_hash, sender=sender,
+                           reason="mined")
             for n in [n for n in txs if txs[n].cost > balance]:
-                self._drop(txs[n].tx.hash)
+                poor_hash = txs[n].tx.hash
+                self._drop(poor_hash)
                 del txs[n]
+                self._emit("drop", hash=poor_hash, sender=sender,
+                           reason="underfunded")
             if not txs:
                 del self.by_sender[sender]
+        # one canon marker even when nothing dropped: the fee market moved,
+        # so the producer's candidate ordering may be stale
+        self._emit("canon", base_fee=base_fee,
+                   blob_base_fee=self.blob_base_fee)
         if self.by_hash:
             self.updated.set()  # remaining txs may have become executable

@@ -346,7 +346,7 @@ class EthApi:
         if self.pool is None:
             raise RpcError(-32000, "no transaction pool")
         tx = Transaction.decode(parse_data(raw))
-        from ..pool import PoolError
+        from ..pool import PoolError, PoolOverloaded
 
         try:
             # through the insertion batcher when the node wired one:
@@ -355,6 +355,14 @@ class EthApi:
                 h = self.tx_batcher.add_sync(tx)
             else:
                 h = self.pool.add_transaction(tx)
+        except PoolOverloaded as e:
+            # firehose backpressure rides the gateway's shed convention
+            # (-32005 + retry_after) so clients back off instead of
+            # retrying hot — and the bounded admission queue never grows
+            # into engine-lane starvation
+            raise RpcError(-32005, "transaction pool overloaded",
+                           data={"class": "tx",
+                                 "retry_after": e.retry_after_s})
         except PoolError as e:
             raise RpcError(-32000, str(e))
         except TimeoutError as e:

@@ -123,6 +123,16 @@ def classify(method: str) -> str:
         return "tx"
     if method in _MONITORING_METHODS:
         return "read"
+    if method.startswith("producer_"):
+        # continuous-build control/introspection (payload/producer.py):
+        # operator plane like fleet_, must not queue behind debug work
+        return "engine"
+    if method.startswith("txpool_"):
+        # pool INSPECTION is a read (pending view, nonces, content) —
+        # only the submit methods above ride the shed-first tx class;
+        # pinned explicitly so the write-path PR cannot accidentally
+        # reclassify reads as sheddable
+        return "read"
     if method.startswith(("debug_", "trace_", "ots_", "flashbots_")):
         return "debug"
     return "read"

@@ -1,4 +1,6 @@
-"""net_* / web3_* / txpool_* namespaces (reference crates/rpc/rpc)."""
+"""net_* / web3_* / txpool_* / producer_* namespaces (reference
+crates/rpc/rpc; producer_* is this repo's continuous-build operator
+plane)."""
 
 from __future__ import annotations
 
@@ -89,3 +91,15 @@ class TxpoolApi:
             }
             for bucket, senders in content.items()
         }
+
+
+class ProducerApi:
+    """Operator introspection for the continuous block producer
+    (payload/producer.py) — admitted in the engine class, mirroring
+    fleet_* control-plane methods."""
+
+    def __init__(self, producer):
+        self.producer = producer
+
+    def producer_status(self):
+        return self.producer.snapshot()

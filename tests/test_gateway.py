@@ -63,6 +63,11 @@ def test_classification():
     assert classify("eth_call") == "read"
     assert classify("eth_getLogs") == "read"
     assert classify("net_version") == "read"
+    # producer introspection shares the leader-only engine lane; pending-tx
+    # reads are replica-servable via the pt_* feed view
+    assert classify("producer_status") == "engine"
+    assert classify("txpool_content") == "read"
+    assert classify("txpool_status") == "read"
     assert CLASSES.index("engine") < CLASSES.index("read") < \
         CLASSES.index("tx") < CLASSES.index("debug")
     # the cacheable set is exactly the pure head-scoped reads
