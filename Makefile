@@ -6,7 +6,7 @@
 # tunnel. Override workers with TEST_WORKERS=n.
 TEST_WORKERS ?= 6
 
-.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-subtrie test-chaos test-reorg test-fleet test-fleet-obs test-ha test-txflow test-import-pipeline native tsan-triebuild
+.PHONY: test test-serial test-faults test-pipeline test-service test-sparse test-parallel test-gateway test-obs test-warmup test-health test-mesh test-subtrie test-chaos test-reorg test-fleet test-fleet-obs test-ha test-txflow test-import-pipeline test-hotstate native tsan-triebuild
 
 test:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -38,7 +38,21 @@ test-service:
 test-sparse:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_sparse_parallel.py tests/test_sparse.py \
-	  tests/test_sparse_root_engine.py -q -p no:cacheprovider
+	  tests/test_sparse_root_engine.py tests/test_hotstate.py \
+	  -q -p no:cacheprovider
+
+# hot-state plane (ISSUE 19): cross-block trie-node cache
+# (trie/hot_cache.py) + device-resident digest arena (DigestArena in
+# ops/fused_commit.py). Hash-keyed cache versioning, keccak validation
+# (RETH_TPU_FAULT_HOTSTATE_POISON must be CAUGHT), the 10-seed
+# cached-vs-uncached randomized differential (roots bit-identical over
+# interleaved update/delete/wipe streams + fork switches), arena epoch
+# eviction / fault-fallback / EVICT_STORM drills, sibling-fork engine
+# integration, and the hotstate_* metrics + degrade-only SLO rule —
+# CPU-only
+test-hotstate:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_hotstate.py -q -p no:cacheprovider
 
 # optimistic parallel execution (part of the default `make test` sweep):
 # randomized differential parity vs the serial executor across conflict
@@ -147,13 +161,16 @@ test-reorg:
 # torn-record-accepted drill proving the invariant suite can fail.
 # Kill drills are `-m slow` so tier-1 keeps its budget; this target
 # runs everything — including the fleet domain's replica-kill-mid-load
-# drills (tests/test_fleet.py) — CPU-only, no device required
+# drills (tests/test_fleet.py) and the hot-state cache dimension
+# (half the consensus seeds storm a --hot-state node against an
+# uncached twin; POISON/EVICT_STORM injectors; zero leaked arena
+# rows post-storm) — CPU-only, no device required
 test-chaos:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	  python -m pytest tests/test_wal_recovery.py tests/test_chaos.py \
 	  tests/test_fleet.py tests/test_fleet_obs.py tests/test_ha.py \
 	  tests/test_block_pipeline.py tests/test_txflow.py \
-	  -q -p no:cacheprovider
+	  tests/test_hotstate.py -q -p no:cacheprovider
 
 # production write path: txpool firehose -> continuous block production.
 # Randomized differential producer-vs-serial-greedy parity (clone-pool

@@ -24,7 +24,13 @@ submission mixes at 1k-50k offered tx/s and measures tx->inclusion p99 +
 txs/block through the continuous block producer vs the serial
 build-on-demand miner, with the hot candidate's inclusion set verified
 bit-identical against a serial greedy build over a cloned pool at every
-load point before any number prints (per-rate results in ``per_rate``).
+load point before any number prints (per-rate results in ``per_rate``);
+``hotstate`` imports an interleaved sibling-fork stream with the
+hot-state plane (cross-block trie-node cache + device digest arena) on
+vs off — proof-target reduction factor as the headline, proof walls,
+hit rate, H2D bytes/block and the delta-upload fraction as extras,
+every payload VALID (root-checked) in both runs before any number
+prints.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "backend", "vs_prev", "regression"}. ``backend`` records which plane
@@ -851,6 +857,153 @@ def run_import_mode():
           max_leg_per_block_s=round(max_leg_pb, 4),
           wall_lt_max_leg=bool(sustained_pb < max_leg_pb),
           host_cores=os.cpu_count(),
+          roots_identical=True, exit_code=0)
+
+
+def run_hotstate_mode():
+    """RETH_TPU_BENCH_MODE=hotstate: sustained overlapping import with
+    the hot-state plane (trie/hot_cache.py + the digest arena) ON vs
+    OFF over the SAME block stream. The stream interleaves two sibling
+    forks over one wallet set (A1 B1 A2 B2 ...), so the single-claimant
+    preserved trie misses on every import and the sparse task must
+    reveal its anchors each block — the exact shape the cross-block
+    cache exists for. Every payload status from BOTH runs must be VALID
+    (each VALID is already a computed-root == header-root check against
+    the CPU truth chain) BEFORE any number prints. Headline =
+    proof-target reduction factor (uncached targets/block over cached
+    targets/block; the issue's bar is >= 2x). Extras carry the
+    proof-fetch walls, cache hit rate, per-block H2D bytes both ways,
+    the delta-upload fraction (staged rows over staged+stamped; bar
+    < 0.5 on this steady overlap), and the arena epoch counters.
+    Env: RETH_TPU_BENCH_HOTSTATE_BLOCKS (default 8, per fork),
+    RETH_TPU_BENCH_HOTSTATE_TXS (default 24),
+    RETH_TPU_BENCH_HOTSTATE_WALLETS (default 48)."""
+    from reth_tpu.engine import EngineTree
+    from reth_tpu.engine.tree import PayloadStatusKind
+    from reth_tpu.primitives import Account
+    from reth_tpu.primitives.keccak import keccak256_batch_np
+    from reth_tpu.storage import MemDb, ProviderFactory
+    from reth_tpu.storage.genesis import init_genesis
+    from reth_tpu.testing import ChainBuilder, Wallet
+    from reth_tpu.trie import TrieCommitter
+
+    n_blocks = int(os.environ.get("RETH_TPU_BENCH_HOTSTATE_BLOCKS", "8"))
+    n_txs = int(os.environ.get("RETH_TPU_BENCH_HOTSTATE_TXS", "24"))
+    n_wallets = int(os.environ.get("RETH_TPU_BENCH_HOTSTATE_WALLETS", "48"))
+    _STATE["metric"] = "hotstate_proof_target_reduction"
+    _STATE["unit"] = "x"
+
+    cpu = TrieCommitter(hasher=keccak256_batch_np)
+    committer = TrieCommitter()  # device/jitted keccak where available
+    _STATE["backend"] = getattr(committer, "backend", None) or "device"
+
+    def make_stream():
+        genesis = {Wallet(0x2000 + i).address: Account(balance=10**21)
+                   for i in range(n_wallets)}
+        half = n_wallets // 2
+        chains = []
+        for fork in range(2):
+            # both forks root at the SAME genesis and churn the SAME
+            # wallet set; fresh Wallet objects per fork so each chain's
+            # nonce tracking starts from genesis — distinct values keep
+            # the sibling headers apart
+            ws = [Wallet(0x2000 + i) for i in range(n_wallets)]
+            b = ChainBuilder(genesis, committer=cpu)
+            for i in range(n_blocks):
+                send, recv = (ws[:half], ws[half:]) if i % 2 == 0 else \
+                             (ws[half:], ws[:half])
+                b.build_block([send[j % half].transfer(
+                    recv[j % half].address,
+                    10**13 + fork * 7 + i * n_txs + j)
+                    for j in range(n_txs)])
+            chains.append(b)
+        order = []
+        for i in range(1, n_blocks + 1):
+            order.append(chains[0].blocks[i])
+            order.append(chains[1].blocks[i])
+        return chains[0], order
+
+    def run(hot: bool):
+        b, order = make_stream()
+        f = ProviderFactory(MemDb())
+        init_genesis(f, b.genesis, b.accounts_at_genesis, committer=cpu)
+        tree = EngineTree(f, committer=committer,
+                          persistence_threshold=10**9, hot_state=hot)
+        agg = {"proof_wall_s": 0.0, "proof_targets": 0,
+               "cache_unblinds": 0, "h2d_bytes": 0,
+               "delta_fractions": [], "sparse_blocks": 0}
+        t0 = time.time()
+        sts = []
+        for blk in order:
+            sts.append(tree.on_new_payload(blk))
+            m = tree.last_sparse or {}
+            if m.get("strategy") == "sparse":
+                agg["sparse_blocks"] += 1
+                agg["proof_wall_s"] += m.get("proof", 0.0)
+                agg["proof_targets"] += m.get("proof_targets", 0)
+                agg["cache_unblinds"] += m.get("cache_unblinds", 0)
+                cs = m.get("commit") or {}
+                agg["h2d_bytes"] += int(cs.get("h2d_bytes", 0) or 0)
+                if "delta_fraction" in cs:
+                    agg["delta_fractions"].append(cs["delta_fraction"])
+        agg["wall_s"] = time.time() - t0
+        return tree, order, sts, agg
+
+    _STATE["phase"] = "hotstate bench: warm-up run"
+    run(False)  # jit compiles + first-call allocations off the walls
+    _STATE["phase"] = "hotstate bench: uncached import"
+    t_cold, order, st_cold, cold = run(False)
+    _STATE["phase"] = "hotstate bench: cached import"
+    t_hot, _, st_hot, hot = run(True)
+
+    _STATE["phase"] = "hotstate bench: verify roots bit-identical"
+    if not all(s.status is PayloadStatusKind.VALID
+               for s in st_cold + st_hot):
+        _emit(0, 0, error="hotstate bench: non-VALID payload status",
+              exit_code=1)
+    for blk in order:
+        ec = t_cold.blocks.get(blk.hash)
+        eh = t_hot.blocks.get(blk.hash)
+        if ec is None or eh is None or \
+                ec.block.header.state_root != eh.block.header.state_root:
+            _emit(0, 0, error=f"hotstate bench: cached/uncached "
+                              f"divergence at block "
+                              f"{blk.header.number}", exit_code=1)
+
+    n_imported = len(order)
+    cold_pb = cold["proof_targets"] / n_imported
+    hot_pb = hot["proof_targets"] / n_imported
+    reduction = cold_pb / hot_pb if hot_pb else float(cold_pb or 1.0)
+    cache_stats = t_hot.hot_cache.stats() if t_hot.hot_cache else {}
+    lookups = cache_stats.get("hits", 0) + cache_stats.get("misses", 0)
+    hit_rate = cache_stats.get("hits", 0) / lookups if lookups else 0.0
+    arena = t_hot.hot_arena.snapshot() if t_hot.hot_arena else {}
+    dfs = hot["delta_fractions"]
+    _STATE["device_result"] = round(reduction, 3)
+    _emit(round(reduction, 3), round(reduction, 3),
+          blocks=n_imported, txs_per_block=n_txs,
+          uncached_wall_s=round(cold["wall_s"], 4),
+          cached_wall_s=round(hot["wall_s"], 4),
+          uncached_proof_wall_s=round(cold["proof_wall_s"], 4),
+          cached_proof_wall_s=round(hot["proof_wall_s"], 4),
+          uncached_proof_targets_per_block=round(cold_pb, 2),
+          cached_proof_targets_per_block=round(hot_pb, 2),
+          cache_unblinds=hot["cache_unblinds"],
+          cache_hit_rate=round(hit_rate, 4),
+          cache_entries=cache_stats.get("entries", 0),
+          cache_stale_drops=cache_stats.get("stale_drops", 0),
+          uncached_h2d_bytes_per_block=round(
+              cold["h2d_bytes"] / n_imported),
+          cached_h2d_bytes_per_block=round(
+              hot["h2d_bytes"] / n_imported),
+          delta_upload_fraction=round(sum(dfs) / len(dfs), 4)
+          if dfs else None,
+          arena_delta_epochs=arena.get("delta_epochs", 0),
+          arena_full_epochs=arena.get("full_epochs", 0),
+          arena_resident_rows=arena.get("resident_rows", 0),
+          arena_evictions=arena.get("evictions", 0),
+          arena_faults=arena.get("faults", 0),
+          sparse_blocks=hot["sparse_blocks"],
           roots_identical=True, exit_code=0)
 
 
@@ -2037,6 +2190,9 @@ def main():
         return
     if mode == "import":
         run_import_mode()
+        return
+    if mode == "hotstate":
+        run_hotstate_mode()
         return
     if mode == "exec":
         # the DEFAULT: CPU-measurable optimistic parallel execution — the

@@ -294,6 +294,54 @@ def run_txflow_bench(watchdog: int = 900) -> dict | None:
                      f"{(r.stderr or '')[-300:]}"}
 
 
+def run_hotstate_bench(watchdog: int = 900) -> dict | None:
+    """RETH_TPU_BENCH_MODE=hotstate capture: sustained sibling-fork
+    import with the hot-state plane (cross-block trie-node cache +
+    device digest arena) on vs off — proof-target reduction factor as
+    the headline, cache hit rate, proof walls, per-block H2D bytes and
+    the delta-upload fraction on the line, every payload VALID
+    (root-checked) in both runs before any number prints. Hermetic (CPU
+    jax backend, in-memory trees), so every session records the cache's
+    effect on the steady-import read wall."""
+    env = dict(os.environ,
+               RETH_TPU_BENCH_MODE="hotstate",
+               JAX_PLATFORMS="cpu",
+               RETH_TPU_BENCH_TIMEOUT=str(watchdog))
+    env.setdefault("RETH_TPU_BENCH_BASELINE_STORE",
+                   os.path.join(REPO, ".bench_baselines.json"))
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=watchdog + 120,
+            env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"value": 0, "cache_hit_rate": 0,
+                "delta_upload_fraction": None,
+                "error": f"hotstate bench exceeded {watchdog + 120}s"}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict):
+            parsed.setdefault("cache_hit_rate", 0)
+            parsed.setdefault("cache_unblinds", 0)
+            parsed.setdefault("delta_upload_fraction", None)
+            parsed.setdefault("uncached_proof_targets_per_block", 0)
+            parsed.setdefault("cached_proof_targets_per_block", 0)
+            parsed.setdefault("uncached_h2d_bytes_per_block", 0)
+            parsed.setdefault("cached_h2d_bytes_per_block", 0)
+            parsed.setdefault("arena_delta_epochs", 0)
+            parsed.setdefault("arena_faults", 0)
+            return parsed
+    return {"value": 0, "cache_hit_rate": 0, "delta_upload_fraction": None,
+            "uncached_proof_targets_per_block": 0,
+            "cached_proof_targets_per_block": 0,
+            "error": f"hotstate bench: no JSON line, rc={r.returncode}: "
+                     f"{(r.stderr or '')[-300:]}"}
+
+
 def update_artifact(captures: list[dict]) -> None:
     best = max((c for c in captures if c["result"].get("value", 0) > 0),
                key=lambda c: c["accounts"], default=None)
@@ -349,6 +397,16 @@ def main() -> None:
     git_commit([LOG], "bench: txflow-mode write-path capture "
                       f"({txflow_result.get('value', 0)} ms inclusion p99, "
                       f"{txflow_result.get('txs_per_block', 0)} txs/block)")
+    # hot-state plane curve: hermetic as well (CPU jax backend,
+    # in-memory trees), so every session records the cross-block
+    # cache's proof-target reduction + delta-upload fraction
+    log_event({"event": "hotstate_bench_start"})
+    hotstate_result = run_hotstate_bench()
+    log_event({"event": "hotstate_bench_done", "result": hotstate_result})
+    git_commit([LOG], "bench: hotstate-mode cache capture "
+                      f"({hotstate_result.get('value', 0)}x fewer proof "
+                      "targets, hit rate "
+                      f"{hotstate_result.get('cache_hit_rate', 0)})")
     captures: list[dict] = []
     stage = 0
     probes = 0

@@ -41,7 +41,11 @@ Two layers:
   the persistence threshold, orphan/duplicate/out-of-order payloads,
   invalid payloads and floods, hostile forkchoice targets — under the
   same composed injectors and crash points, with the same restart
-  invariant suite afterwards.
+  invariant suite afterwards. Half the seeds storm a hot-state-cached
+  tree (trie/hot_cache.py) against the uncached twin — some with the
+  ``HOTSTATE_POISON``/``HOTSTATE_EVICT_STORM`` injectors underneath —
+  so every VALID is a bit-identical-root agreement across cache state,
+  and the arena must end the storm with zero leaked rows.
 - **Fleet domain** (``--domain fleet``): a dev full node in replica-
   fleet mode (fleet/) with replica subprocesses fed over the witness
   socket, read load through the consistent-hash gateway ring while
@@ -138,6 +142,15 @@ FAULT_MENU: tuple[dict, ...] = (
     {"RETH_TPU_FAULT_SLO_BREACH": "all"},        # force every SLO rule red
 )
 
+# hot-state injectors ride only on cached consensus seeds (drawn after
+# the hot_state coin in make_consensus_scenario), never sampled from
+# FAULT_MENU — keeping them out preserves every pre-existing seed's
+# fault schedule bit-for-bit.
+HOTSTATE_FAULTS: tuple[str, ...] = (
+    "RETH_TPU_FAULT_HOTSTATE_POISON",
+    "RETH_TPU_FAULT_HOTSTATE_EVICT_STORM",
+)
+
 
 def make_scenario(seed: int) -> dict:
     """Deterministic scenario from one seed: a fault composition plus a
@@ -224,10 +237,27 @@ def make_consensus_scenario(seed: int) -> dict:
         # cross-block import pipeline (engine/block_pipeline.py): half
         # the seeds storm a depth-2 tree — two-deep payload bursts, fcU
         # reorgs landing mid-speculation, tampered-root parents whose
-        # speculating children must abort cleanly. Drawn LAST so
-        # existing seeds' schedules stay bit-stable.
+        # speculating children must abort cleanly. Drawn after the base
+        # schedule so existing seeds' schedules stay bit-stable.
         "pipeline": rng.random() < 0.5,
+        # hot-state plane (trie/hot_cache.py): half the seeds storm a
+        # cache-enabled tree while the twin stays cache-disabled, so
+        # every VALID the storm already demands is a bit-identical-root
+        # agreement with the uncached twin across every reorg/unwind.
+        # Drawn LAST (after "pipeline") so existing seeds stay stable.
+        "hot_state": rng.random() < 0.5,
     })
+    if scn["hot_state"]:
+        # hot-state injectors ride along on some cached seeds: poison
+        # must be CAUGHT by node-hash validation (a served poison flips
+        # a root and the twin checks fail), an evict storm may only
+        # cost performance — never a wrong status. Drawn after the
+        # hot_state coin so every earlier seed schedule stays put.
+        if rng.random() < 0.5:
+            faults["RETH_TPU_FAULT_HOTSTATE_POISON"] = str(
+                rng.randint(3, 9))
+        if rng.random() < 0.3:
+            faults["RETH_TPU_FAULT_HOTSTATE_EVICT_STORM"] = "1"
     return scn
 
 
@@ -466,7 +496,8 @@ def child_victim(datadir: str, seed: int, blocks: int, threshold: int = 2,
 def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
                            threshold: int = 2, hash_service: bool = False,
                            force_deep_reorg: bool = False,
-                           pipeline: bool = False) -> int:
+                           pipeline: bool = False,
+                           hot_state: bool = False) -> int:
     """Drive the dev node's engine tree as a hostile CL: seeded
     randomized interleavings of newPayload/forkchoiceUpdated — side
     forks at random depths, deep reorgs across the persistence
@@ -492,8 +523,20 @@ def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
         # EngineTree resolves the pipeline depth from the env at
         # construction; set it before the node is built
         os.environ["RETH_TPU_PIPELINE_DEPTH"] = "2"
+    if hot_state:
+        # same construction-time env resolution as the pipeline; popped
+        # again below so the fault-free ForkBuilder twin is built
+        # CACHE-DISABLED — every VALID the storm demands is then a
+        # bit-identical-root agreement between the cached node and an
+        # uncached twin, across every fork switch, unwind, and storm
+        os.environ["RETH_TPU_HOT_STATE"] = "1"
     node, wallet, builder = _build_node(datadir, seed, threshold,
                                         hash_service, fresh=True)
+    if hot_state:
+        os.environ.pop("RETH_TPU_HOT_STATE", None)
+        if node.tree.hot_cache is None:
+            raise AssertionError("hot-state storm requested but tree "
+                                 "has no cache")
     if pipeline and node.tree.pipeline is None:
         raise AssertionError("pipeline storm requested but tree has none")
     if pipeline:
@@ -819,6 +862,20 @@ def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
         if node.tree.pipeline._spec is not None:
             raise AssertionError(
                 "stuck speculation slot after the storm")
+    hot_stats = {}
+    if hot_state:
+        # stale-node leaks already fail above (a stale cache entry
+        # surviving an unwind would flip a root and the VALID/twin
+        # checks catch it); what is left is resource reclamation
+        hot_stats = node.tree.hot_cache.stats()
+        arena = node.tree.hot_arena
+        if arena is not None:
+            leaked = arena.leaked_rows()
+            if leaked:
+                raise AssertionError(
+                    f"hot-state arena leaked {leaked} rows after the "
+                    f"storm: {arena.snapshot()}")
+            hot_stats.update(arena.snapshot())
     print(f"STORM ok seed={seed} rounds={i} head={fb.number_of(head)} "
           f"reorgs={node.tree.reorgs.reorgs} "
           f"deep={node.tree.reorgs.max_depth} "
@@ -827,7 +884,12 @@ def child_consensus_victim(datadir: str, seed: int, rounds: int = 20,
           + (f" pipe_spec={pipe_stats['speculations']}"
              f" pipe_adopt={pipe_stats['adopted']}"
              f" pipe_abort={pipe_stats['aborted']}"
-             if pipe_stats else ""), flush=True)
+             if pipe_stats else "")
+          + (f" hot_hits={hot_stats.get('hits', 0)}"
+             f" hot_clears={hot_stats.get('clears', 0)}"
+             f" arena_delta={hot_stats.get('delta_epochs', 0)}"
+             f" arena_evict={hot_stats.get('evictions', 0)}"
+             if hot_state else ""), flush=True)
     node.stop()
     return 0
 
@@ -2141,6 +2203,8 @@ def _child_cmd(mode: str, datadir: Path, scn: dict) -> list[str]:
             cmd.append("--force-deep-reorg")
         if scn.get("pipeline"):
             cmd.append("--pipeline")
+        if scn.get("hot_state"):
+            cmd.append("--hot-state")
     elif mode == "victim":
         cmd += ["--blocks", str(scn["blocks"]),
                 "--reorg-at", str(scn.get("reorg_at", 0))]
@@ -2421,6 +2485,9 @@ def main(argv=None) -> int:
                     action="store_true")
     pk.add_argument("--pipeline", action="store_true",
                     help="storm a depth-2 cross-block import pipeline")
+    pk.add_argument("--hot-state", dest="hot_state", action="store_true",
+                    help="storm a hot-state-cached tree against an "
+                         "uncached fault-free twin")
 
     pr = sub.add_parser("recover", help="(child) restart + invariant suite")
     pr.add_argument("--datadir", required=True)
@@ -2496,7 +2563,8 @@ def main(argv=None) -> int:
     if args.command == "consensus":
         return child_consensus_victim(args.datadir, args.seed, args.rounds,
                                       args.threshold, args.hash_service,
-                                      args.force_deep_reorg, args.pipeline)
+                                      args.force_deep_reorg, args.pipeline,
+                                      args.hot_state)
     if args.command == "recover":
         return child_recover(args.datadir, args.seed, args.threshold,
                              args.hash_service)

@@ -470,6 +470,7 @@ def cmd_node(args):
                      pipeline_depth=getattr(args, "pipeline_depth", None),
                      continuous_build=getattr(args, "continuous_build",
                                               False),
+                     hot_state=getattr(args, "hot_state", False),
                      rpc_gateway=getattr(args, "rpc_gateway", False),
                      warmup=warm_mode,
                      compile_cache_dir=warm_cache,
@@ -882,6 +883,7 @@ def cmd_config(args):
         f"parallel_exec = {'true' if cfg.parallel_exec else 'false'}",
         f"pipeline_depth = {cfg.pipeline_depth}",
         f"continuous_build = {'true' if cfg.continuous_build else 'false'}",
+        f"hot_state = {'true' if cfg.hot_state else 'false'}",
         f"trace_blocks = {'true' if cfg.trace_blocks else 'false'}",
         f"health = {'true' if cfg.health else 'false'}",
         f"slo_interval = {cfg.slo_interval}",
@@ -1340,6 +1342,21 @@ def main(argv=None) -> int:
                         "scratch. producer_status reports the candidate. "
                         "Also settable as [node] continuous_build in "
                         "reth.toml")
+    p.add_argument("--hot-state", dest="hot_state", action="store_true",
+                   default=False,
+                   help="hot-state plane (trie/hot_cache.py): cross-block "
+                        "trie-node cache shared across forks — sparse "
+                        "root tasks reveal from it before fetching "
+                        "proofs, every entry is keccak-validated at "
+                        "lookup — plus a device-resident digest arena "
+                        "(ops/fused_commit.py) that keeps subtree digest "
+                        "rows on the accelerator across blocks so sparse "
+                        "finishes upload only dirty rows; roots stay "
+                        "bit-identical, any arena fault evicts and "
+                        "reruns the full-upload path. Invalidated on "
+                        "deep reorgs/storms. Env fallback: "
+                        "RETH_TPU_HOT_STATE. Also settable as [node] "
+                        "hot_state in reth.toml")
     p.add_argument("--rpc-gateway", dest="rpc_gateway", action="store_true",
                    default=False,
                    help="route every RPC transport (HTTP/WS/IPC + the "

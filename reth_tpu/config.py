@@ -156,6 +156,11 @@ class RethTpuConfig:
     # refreshed on pool events and head changes; getPayload / dev
     # mining seal it instead of building from scratch
     continuous_build: bool = False
+    # hot-state plane (--hot-state CLI equivalent, trie/hot_cache.py):
+    # cross-block trie-node cache feeding sparse reveals without proof
+    # fetches + device-resident digest arena with delta uploads
+    # (ops/fused_commit.py); env RETH_TPU_HOT_STATE is the fallback
+    hot_state: bool = False
     # block-lifecycle tracing (--trace-blocks CLI equivalent): record
     # per-block span timelines, export Chrome-trace JSON under the
     # datadir, and point flight-recorder dumps there (tracing.py)
@@ -232,6 +237,7 @@ def load_config(path: str | Path | None) -> RethTpuConfig:
     cfg.pipeline_depth = int(node.get("pipeline_depth", cfg.pipeline_depth))
     cfg.continuous_build = bool(node.get("continuous_build",
                                          cfg.continuous_build))
+    cfg.hot_state = bool(node.get("hot_state", cfg.hot_state))
     cfg.trace_blocks = bool(node.get("trace_blocks", cfg.trace_blocks))
     cfg.health = bool(node.get("health", cfg.health))
     cfg.slo_interval = float(node.get("slo_interval", cfg.slo_interval))

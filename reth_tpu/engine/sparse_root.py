@@ -56,7 +56,8 @@ class SparseRootTask:
     def __init__(self, parent_provider, parent_root: bytes, preserved,
                  committer, parent_hash: bytes | None = None,
                  provider_factory=None, workers: int | None = None,
-                 trace_ctx=None, seed_digests=None):
+                 trace_ctx=None, seed_digests=None, hot_cache=None,
+                 arena=None):
         # live tip is the highest-priority hash-service lane: with
         # --hash-service the task's batches coalesce with every other
         # client's but dispatch first; without one this is committer.hasher
@@ -68,9 +69,18 @@ class SparseRootTask:
         # hanging the worker thread mid-block; kept for observability
         self.supervisor = getattr(committer, "supervisor", None)
         self.calc = ProofCalculator(parent_provider, committer)
+        # hot-state plane (ISSUE 19): the shared cross-block node cache
+        # serves blinded paths before they become proof targets, and the
+        # shared digest arena turns the fused finish into a delta upload
+        self.hot_cache = hot_cache
+        self.cache_unblinds = 0   # proof targets the cache absorbed
+        self.proof_targets = 0    # targets that DID go to proof fetch
+        self._touched_accounts: set[bytes] = set()
+        self._touched_storage: dict[bytes, set[bytes]] = {}
         # parallel finish: cross-trie packed hashing + encode pool
         # (--sparse-workers; trie/sparse.py ParallelSparseCommitter)
-        self.sparse_committer = ParallelSparseCommitter(workers=workers)
+        self.sparse_committer = ParallelSparseCommitter(workers=workers,
+                                                        arena=arena)
         # proof-worker pool (reth proof_task.rs analogue): shards
         # multiproof targets by storage trie across N workers, each on a
         # FRESH parent view from ``provider_factory`` (cursor state is
@@ -92,6 +102,11 @@ class SparseRootTask:
             self.reused = True
         else:
             self.trie = SparseStateTrie.anchored(parent_root)
+        if hot_cache is not None:
+            # reveal-ref stamping: revealed-but-unmutated nodes keep a
+            # clean ref, so the delta finish never re-stages them (and
+            # trie.stamped is the delta-fraction denominator)
+            self.trie.set_stamping(True)
         self._queue: queue.Queue = queue.Queue()
         self._digests: dict[bytes, bytes] = {}
         if seed_digests:
@@ -205,22 +220,34 @@ class SparseRootTask:
         targets: dict[bytes, list[bytes]] = {}
         for a in addrs:
             ha = self._digests[a]
+            self._touched_accounts.add(ha)
             if ha in self._fetching:
                 continue
             if self._needs_account_reveal(ha):
+                if self._cache_reveal_account(ha):
+                    self.cache_unblinds += 1
+                    continue
                 targets.setdefault(a, [])
                 self._fetching.add(ha)
         for a, s in pairs:
             ha = self._digests[a]
-            key = (ha, self._digests[s])
+            hs = self._digests[s]
+            self._touched_accounts.add(ha)
+            self._touched_storage.setdefault(ha, set()).add(hs)
+            key = (ha, hs)
             if key in self._fetching:
                 continue
             if self._needs_storage_reveal(*key):
+                if self._cache_reveal_storage(ha, hs):
+                    self.cache_unblinds += 1
+                    continue
                 targets.setdefault(a, []).append(s)
                 self._fetching.add(key)
         if not targets:
             return
         self.proof_batches += 1
+        self.proof_targets += len(targets) + sum(
+            len(v) for v in targets.values())
         if self.proof_pool is not None:
             # sharded async fetch: workers walk independent storage tries
             # on their own parent views; reveals land when shards complete
@@ -285,6 +312,42 @@ class SparseRootTask:
         except BlindedNodeError:
             return True
 
+    # -- hot-state cache reveals (proof fetches the cache absorbs) -----------
+
+    def _cache_reveal_account(self, hashed_addr: bytes) -> bool:
+        """Unblind the account path purely from the cross-block node
+        cache; True = no proof target needed for this key."""
+        if self.hot_cache is None:
+            return False
+        from ..trie.hot_cache import ACCOUNT_OWNER
+
+        return self.hot_cache.reveal_through(self.trie.account_trie,
+                                             ACCOUNT_OWNER, hashed_addr)
+
+    def _cache_reveal_storage(self, hashed_addr: bytes,
+                              hashed_slot: bytes) -> bool:
+        """Storage analogue — when the storage trie itself is unknown but
+        the account leaf is readable (possibly just cache-revealed), its
+        storage root anchors a fresh trie that the cache then unblinds."""
+        if self.hot_cache is None:
+            return False
+        st = self.trie.storage_tries.get(hashed_addr)
+        if st is None:
+            try:
+                acct_rlp = self.trie.account_trie.get(hashed_addr)
+            except BlindedNodeError:
+                return False
+            if acct_rlp is None:
+                return False  # absent account: the proof path handles it
+            from ..primitives.types import Account
+
+            try:
+                root = Account.decode(acct_rlp).storage_root
+            except Exception:  # noqa: BLE001 — malformed: proof path
+                return False
+            st = self.trie.storage_trie(hashed_addr, root)
+        return self.hot_cache.reveal_through(st, hashed_addr, hashed_slot)
+
     # -- finalization --------------------------------------------------------
 
     def finish(self, out):
@@ -340,6 +403,9 @@ class SparseRootTask:
                         committer=self.sparse_committer)
                 break
             except BlindedNodeError as e:
+                if self._cache_unblind(e):
+                    self.cache_unblinds += 1
+                    continue  # retry the commit without a spine fetch
                 extra = (self.calc.storage_spine_for_path(e.owner, e.path)
                          if e.owner is not None
                          else self.calc.spine_for_path(e.path))
@@ -357,6 +423,49 @@ class SparseRootTask:
         self.commit_stats = self.sparse_committer.last
         self.walls["finish"] = time.monotonic() - self.finish_called_at
         return root, self._digests, storage_roots
+
+    def _cache_unblind(self, e: BlindedNodeError) -> bool:
+        """Serve a finish-side blind from the node cache (one validated
+        node at the reported path); False = pay the spine fetch."""
+        if self.hot_cache is None:
+            return False
+        if e.owner is not None:
+            trie = self.trie.storage_tries.get(e.owner)
+            owner = e.owner
+        else:
+            from ..trie.hot_cache import ACCOUNT_OWNER
+
+            trie = self.trie.account_trie
+            owner = ACCOUNT_OWNER
+        if trie is None:
+            return False
+        path = bytes(e.path)
+        h = trie.blind_hash_at(path)
+        if h is None:
+            return False
+        rlp = self.hot_cache.lookup(owner, path, h)
+        return rlp is not None and trie.reveal_at(path, rlp)
+
+    def absorb_into_cache(self, out, digest_map=None) -> None:
+        """Post-root-match population pass: push this block's freshly
+        committed spines (changed keys) and revealed read paths (touched
+        keys) into the shared node cache. Call next to :meth:`preserve`
+        — absorbing a trie mutated by an INVALID block would poison
+        sibling forks' reveals."""
+        if self.hot_cache is None:
+            return
+        if digest_map is None:
+            digest_map = self._digests
+        changed = sorted(set(out.changes.accounts) | set(out.changes.storage)
+                         | set(out.changes.wiped_storage))
+        account_keys = [digest_map[a] for a in changed]
+        storage_keys = {digest_map[a]: [digest_map[s] for s in slots]
+                        for a, slots in out.post_storage.items()}
+        wiped = [digest_map[a] for a in out.changes.wiped_storage]
+        self.hot_cache.absorb_block(
+            self.trie, account_keys, storage_keys, wiped_owners=wiped,
+            touched_accounts=self._touched_accounts,
+            touched_storage=self._touched_storage)
 
     def _shutdown_pools(self) -> None:
         self.sparse_committer.shutdown()
@@ -383,6 +492,8 @@ class SparseRootTask:
             "proof_shards": (self.proof_pool.shards_total
                              if self.proof_pool is not None else 0),
             "sparse_workers": self.sparse_committer.workers,
+            "proof_targets": self.proof_targets,
+            "cache_unblinds": self.cache_unblinds,
         }
         if self.commit_stats is not None:
             out["commit"] = dict(self.commit_stats)

@@ -110,6 +110,7 @@ class EngineTree:
         block_buffer_size: int | None = None,
         block_buffer_ttl: float | None = None,
         pipeline_depth: int | None = None,
+        hot_state: bool | None = None,
     ):
         self.factory = factory
         self.committer = committer or TrieCommitter()
@@ -164,6 +165,28 @@ class EngineTree:
 
         self.preserved_trie = PreservedSparseTrie()
         self.last_sparse = None  # per-block strategy stats (tests/metrics)
+        # hot-state plane (--hot-state / RETH_TPU_HOT_STATE, ISSUE 19):
+        # a cross-block node/multiproof cache shared by every fork's
+        # sparse task + the persistent device digest arena the fused
+        # finish delta-uploads against. Both ride the same reorg
+        # stand-downs as the preserved trie (deep unwind / reorg storm
+        # -> wholesale invalidation).
+        if hot_state is None:
+            from ..trie.hot_cache import hot_state_enabled
+
+            hot_state = hot_state_enabled()
+        self.hot_cache = None
+        self.hot_arena = None
+        if hot_state:
+            from ..trie.hot_cache import TrieNodeCache
+
+            self.hot_cache = TrieNodeCache.from_env()
+            try:
+                from ..ops.fused_commit import DigestArena
+
+                self.hot_arena = DigestArena.from_env()
+            except Exception:  # noqa: BLE001 — no jax stack: cache-only
+                self.hot_arena = None
         if unwinder is None:
             def unwinder(fac, target):
                 from ..stages import Pipeline, default_stages
@@ -717,6 +740,12 @@ class EngineTree:
                 # preserve only AFTER the root matched: a trie mutated by
                 # an invalid block would poison the next payload's anchor
                 sparse_task.preserve(block_hash)
+                # same rule for the shared node cache: absorb the block's
+                # committed spines + revealed read paths only once valid
+                try:
+                    sparse_task.absorb_into_cache(out)
+                except Exception:  # noqa: BLE001 — cache population must
+                    pass           # never fail a validated payload
             # advance the execution cache: invalidate this block's writes
             # and anchor the warm cache on the new tip
             self.execution_cache.on_block_applied(out.changes)
@@ -823,7 +852,8 @@ class EngineTree:
                 parent_provider, parent.state_root, self.preserved_trie,
                 self.committer, parent_hash=block.header.parent_hash,
                 provider_factory=parent_view, workers=self.sparse_workers,
-                trace_ctx=trace_ctx, seed_digests=seed_digests)
+                trace_ctx=trace_ctx, seed_digests=seed_digests,
+                hot_cache=self.hot_cache, arena=self.hot_arena)
         except Exception:  # noqa: BLE001 — strategy startup must never
             # fail the payload; the pipelined+incremental path covers it
             return None
@@ -1062,7 +1092,20 @@ class EngineTree:
             tracing.fault_event("TREE_REORG_STORM", target="engine::tree",
                                 depth=depth, reorgs=self.reorgs.reorgs,
                                 max_depth=self.reorgs.max_depth)
+            # the hot-state plane is speculative state too: churn is
+            # exactly what thrashes it, so it stands down with the rest
+            self._invalidate_hot_state("reorg_storm")
         self.reorgs.in_backoff()  # refresh the gauge
+
+    def _invalidate_hot_state(self, reason: str) -> None:
+        """Wholesale hot-state invalidation (deep reorg / reorg storm):
+        validation-at-lookup already guarantees no stale node can serve,
+        so this is about not paying churn-thrashed miss storms — and
+        about the arena's leak invariant across unwinds."""
+        if self.hot_cache is not None:
+            self.hot_cache.clear(reason)
+        if self.hot_arena is not None:
+            self.hot_arena.invalidate(reason)
 
     def _find_persisted_branch_point(self, head: bytes):
         """If ``head`` connects to a persisted canonical block below the tip
@@ -1124,6 +1167,7 @@ class EngineTree:
         # in-memory tree entries built on the old chain are now stale
         self.blocks.clear()
         self.preserved_trie.invalidate()
+        self._invalidate_hot_state("deep_reorg")
         self._record_reorg(max(0, head_number - number), deep=True)
         # the unwound shape is a durability boundary too: a crash after a
         # reorg must never resurrect the unwound chain

@@ -412,6 +412,19 @@ def default_rules() -> list[SloRule]:
                 failing_factor=1e9,
                 help="median device dispatches per k-level fused commit "
                      "above the k-level baseline (per-level regression)"),
+        # hot-state node cache: a SUSTAINED hit-rate collapse under
+        # steady import traffic means the invalidation rules are eating
+        # the cache (an invalidation bug), not a consensus risk —
+        # validation-at-lookup turns staleness into misses. Floor rule,
+        # gated on real lookup volume; degrade only, never page.
+        SloRule("hotstate_hit_rate", "hot_state", "ratio", 0.10,
+                metrics_num=("hotstate_cache_hits_total",),
+                metrics_den=("hotstate_cache_hits_total",
+                             "hotstate_cache_misses_total"),
+                op="<", min_den=50.0, failing_factor=1e9,
+                help="cross-block node-cache hit rate collapsing under "
+                     "steady import (invalidation bug — degrade, don't "
+                     "page)"),
         SloRule("exec_conflict_rate", "exec", "ratio", 0.5,
                 metrics_num=("exec_parallel_conflicts_total",
                              "exec_parallel_serial_reruns_total"),

@@ -359,6 +359,27 @@ class NodeEventReporter:
             if pr.get("staleness_s", 0) > 0.5:
                 line += f" stale={pr['staleness_s']:.1f}s"
             line += "]"
+        # hot-state plane (--hot-state): node-cache hit rate, resident
+        # arena rows, last delta-upload fraction, and the validation
+        # catches (stale/poison) — the one-line answer to "is the
+        # cross-block cache actually absorbing proof fetches"
+        from ..metrics import hotstate_metrics
+
+        hs = hotstate_metrics.last
+        if hs:
+            line += f" hot[hit={hs.get('hit_rate', 0.0):.2f}"
+            ar = hs.get("arena")
+            if ar:
+                line += f" rows={ar.get('resident_rows', 0)}"
+            if "delta_fraction" in hs:
+                line += f" dfrac={hs['delta_fraction']:.2f}"
+            c = hs.get("cache") or {}
+            caught = c.get("stale_drops", 0) + c.get("poison_caught", 0)
+            if caught:
+                line += f" caught={caught}"
+            if ar and ar.get("faults"):
+                line += f" faults={ar['faults']}"
+            line += "]"
         # --health: the SLO engine's verdict — node status, any non-ok
         # component, and the breach counter an operator pages on. The
         # one line that says "the node itself thinks it is sick" instead

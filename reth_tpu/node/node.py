@@ -79,6 +79,12 @@ class NodeConfig:
     # getPayload / dev mining seal instead of building from scratch;
     # rides the commit window when the import pipeline is on
     continuous_build: bool = False
+    # --hot-state / [node] hot_state: hot-state plane — cross-block
+    # trie-node cache (trie/hot_cache.py) feeding sparse reveals
+    # without proof fetches, plus a device-resident digest arena
+    # (ops/fused_commit.py DigestArena) so sparse finishes upload only
+    # dirty rows; False defers to RETH_TPU_HOT_STATE
+    hot_state: bool = False
     # --rpc-gateway / [rpc] gateway: route every transport's dispatch
     # through the serving gateway (rpc/gateway.py): admission control
     # with priority classes, in-flight coalescing, and a head-invalidated
@@ -313,6 +319,8 @@ class Node:
             sparse_workers=config.sparse_workers,
             parallel_exec=config.parallel_exec,
             pipeline_depth=config.pipeline_depth,
+            # True forces on; False stays None so RETH_TPU_HOT_STATE decides
+            hot_state=config.hot_state or None,
             invalid_cache_size=config.invalid_cache_size,
         )
         # the engine's persistence advance is the durability boundary:

@@ -33,6 +33,8 @@ seam this replaces (crates/stages/stages/src/stages/hashing_account.rs:29-32).
 
 from __future__ import annotations
 
+import os
+import threading
 import time as _time
 from functools import lru_cache, partial
 
@@ -682,6 +684,9 @@ class MegaFusedEngine(FusedLevelEngine):
         self._i32_parts: list[np.ndarray] = []
         self._u8_off = 0
         self._i32_off = 0
+        # per-commit H2D accounting (bench hotstate's bytes/block signal)
+        self.staged_u8_bytes = 0
+        self.staged_i32_bytes = 0
 
     def begin(self, max_slots: int) -> None:
         self._s_tier = _pow2(max_slots + 1, floor=max(self.min_tier, 2))
@@ -690,6 +695,8 @@ class MegaFusedEngine(FusedLevelEngine):
         self._u8_off = self._i32_off = 0
         self._buf = None
         self.dispatches = 0
+        self.staged_u8_bytes = 0
+        self.staged_i32_bytes = 0
 
     def ensure(self, max_slots: int) -> None:
         """Staged variant: before ``_execute`` the buffer is only a planned
@@ -726,6 +733,7 @@ class MegaFusedEngine(FusedLevelEngine):
         off = self._u8_off
         self._u8_parts.append(arr)
         self._u8_off += arr.size
+        self.staged_u8_bytes += int(arr.size)
         return off
 
     def _stage_i32(self, *arrays: np.ndarray) -> int:
@@ -734,6 +742,7 @@ class MegaFusedEngine(FusedLevelEngine):
             a = np.ascontiguousarray(a).astype(np.int32, copy=False).ravel()
             self._i32_parts.append(a)
             self._i32_off += a.size
+            self.staged_i32_bytes += int(a.size) * 4
         return off
 
     def dispatch_packed(self, flat, row_off, row_len, slots, holes, b_tier) -> None:
@@ -1202,6 +1211,12 @@ class SubtrieFusedEngine(MegaFusedEngine):
         self._journal: list[tuple[np.ndarray, np.ndarray, list]] = []
         self._buf_np: np.ndarray | None = None
         self.levels_staged = 0
+        # delta commits (hot-state arena): the journal only covers THIS
+        # epoch, so the internal replay-from-zeros ladder would silently
+        # lose prior epochs' resident rows — in delta mode any device
+        # fault re-raises and the OWNER (DigestArena) takes the full-
+        # upload rung instead (ISSUE 19's external ladder).
+        self._delta = False
 
     # -- mesh seam (overridden by SubtrieMeshEngine) -----------------------
 
@@ -1219,6 +1234,28 @@ class SubtrieFusedEngine(MegaFusedEngine):
         self._journal = []
         self._buf_np = None
         self.levels_staged = 0
+        self._delta = False
+
+    def begin_delta(self, max_slots: int) -> None:
+        """Open a DELTA commit: keep the resident digest buffer from the
+        previous epoch and stage only this epoch's dirty rows (holes may
+        splice prior-epoch slots). Preconditions — the engine must still
+        be on the fused rung with a materialized buffer; anything else is
+        an :class:`ArenaFault` the owner answers with a full upload."""
+        if self._mode != "fused" or self._buf is None:
+            raise ArenaFault(
+                f"delta precondition lost (mode={self._mode}, "
+                f"resident={self._buf is not None})")
+        self._plan, self._u8_parts, self._i32_parts = [], [], []
+        self._u8_off = self._i32_off = 0
+        self.dispatches = 0
+        self.staged_u8_bytes = 0
+        self.staged_i32_bytes = 0
+        self._journal = []
+        self._buf_np = None
+        self.levels_staged = 0
+        self._delta = True
+        self.ensure(max_slots)
 
     def ensure(self, max_slots: int) -> None:
         if self._mode == "cpu":
@@ -1417,6 +1454,8 @@ class SubtrieFusedEngine(MegaFusedEngine):
         try:
             self._run_chunks(u8, i32, chunks, u8_len, i32_len, mode)
         except BaseException as e:  # noqa: BLE001 — degraded below
+            if self._delta:
+                raise  # external ladder: the arena owner full-uploads
             self._degrade(e)
 
     def _run_chunks(self, u8: np.ndarray, i32: np.ndarray, chunks: list,
@@ -1556,6 +1595,20 @@ class SubtrieFusedEngine(MegaFusedEngine):
         self._journal = []
         return FusedLevelEngine.fetch_slots(self, slots)
 
+    def peek_slots(self, slots: np.ndarray) -> np.ndarray:
+        """Small D2H like :meth:`fetch_slots`, but the digest buffer stays
+        RESIDENT — the terminal fetch of a delta epoch (the rows live on
+        so later epochs can hole-splice them)."""
+        self._execute()
+        self._record_commit()
+        self._journal = []
+        if self._mode == "cpu":  # defensive: delta never degrades to cpu
+            return self._buf_np[np.asarray(slots, dtype=np.int64)].copy()
+        ids = np.zeros((_pow2(max(len(slots), 1), floor=8),), dtype=np.int32)
+        ids[: len(slots)] = slots
+        out = np.asarray(jnp.take(self._buf, self._device_put(ids), axis=0))
+        return out[: len(slots)]
+
 
 class SubtrieMeshEngine(SubtrieFusedEngine):
     """k-level fused commit over a device mesh: the staged buffers and
@@ -1595,3 +1648,193 @@ class SubtrieMeshEngine(SubtrieFusedEngine):
 
     def _mesh_size(self) -> int:
         return self.mesh.devices.size
+
+
+# -- hot-state plane, device half: the persistent digest arena ----------------
+
+
+class ArenaFault(RuntimeError):
+    """A delta-commit precondition or device fault under the hot-state
+    arena — NEVER handled inside the engine (the journal only covers the
+    current epoch, so the internal replay ladder cannot rebuild resident
+    rows). The arena owner catches it, evicts, and re-runs the commit on
+    the classic full-upload path (then per-level, then the CPU twin —
+    the same ladder as before, entered one rung higher)."""
+
+
+class DigestArena:
+    """Epoch-tagged registry of digest rows resident in ONE persistent
+    :class:`SubtrieFusedEngine` across blocks — the hot-state plane's
+    device half (ISSUE 19; SonicDB S6's commitment-structure residency).
+
+    The classic sparse finish builds a throwaway engine per commit: every
+    block re-stages and re-uploads its whole dirty set and the buffer
+    dies with ``finish()``. Under the arena the engine (and its device
+    buffer) survives: slots are allocated monotonically across epochs,
+    ``_slot_of`` maps node digest -> (slot, last_live_epoch), and a new
+    epoch's templates hole-splice resident slots for unchanged sibling
+    digests instead of treating the buffer as empty. The terminal fetch
+    is :meth:`SubtrieFusedEngine.peek_slots` (this epoch's rows only),
+    which keeps the buffer resident.
+
+    Safety ladder (roots bit-identical on every rung):
+
+    - ``begin_delta`` refuses unless the engine is still on the fused
+      rung with a materialized buffer (:class:`ArenaFault`);
+    - any device fault during a delta epoch re-raises out of the engine
+      (``_delta`` external ladder) — :meth:`on_fault` evicts wholesale
+      and the commit re-runs on the full-upload path;
+    - rows idle for ``max_epoch_age`` epochs are retired at lookup, and
+      the whole arena evicts when ``next_slot`` outgrows ``max_rows`` —
+      so the buffer is bounded and the leak invariant
+      ``leaked_rows() == 0`` (every allocated row is registered or
+      retired) is checkable after every epoch (the chaos cache dimension
+      asserts it post-storm).
+
+    Single-writer: concurrent sparse finishes (speculation leg, the
+    continuous producer) contend via :meth:`try_acquire`; the loser just
+    takes the classic path for that block.
+    """
+
+    def __init__(self, max_rows: int = 1 << 20, max_epoch_age: int = 64):
+        self.max_rows = max(1024, int(max_rows))
+        self.max_epoch_age = max(1, int(max_epoch_age))
+        self.engine: SubtrieFusedEngine | None = None
+        self.epoch = 0
+        self.next_slot = 1  # slot 0 = the engines' dummy slot
+        self._slot_of: dict[bytes, tuple[int, int]] = {}
+        self.retired = 0
+        self._commit_lock = threading.Lock()
+        # counters (mirrored into hotstate_* metrics by the committer)
+        self.resident_hits = 0
+        self.lookup_misses = 0
+        self.evictions = 0
+        self.faults = 0
+        self.delta_epochs = 0
+        self.full_epochs = 0
+        self.contended = 0
+
+    @classmethod
+    def from_env(cls, env=None) -> "DigestArena":
+        env = os.environ if env is None else env
+        return cls(
+            max_rows=int(env.get("RETH_TPU_HOT_ARENA_ROWS", "0")
+                         or (1 << 20)),
+            max_epoch_age=int(env.get("RETH_TPU_HOT_ARENA_EPOCHS", "0")
+                              or 64))
+
+    # -- single-writer seam ------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        if self._commit_lock.acquire(blocking=False):
+            return True
+        self.contended += 1
+        return False
+
+    def release(self) -> None:
+        self._commit_lock.release()
+
+    # -- epoch lifecycle ---------------------------------------------------
+
+    def begin_epoch(self, evict_storm: bool = False) -> bool:
+        """Open a commit epoch; True = the arena is empty and this epoch
+        must be a FULL upload (``engine.begin``), False = delta."""
+        self.epoch += 1
+        if evict_storm:
+            self.evict("evict_storm")
+        elif self.next_slot >= self.max_rows:
+            self.evict("max_rows")
+        fresh = self.next_slot == 1 or self.engine is None
+        if fresh:
+            self.full_epochs += 1
+        else:
+            self.delta_epochs += 1
+        return fresh
+
+    def evict(self, reason: str = "") -> None:
+        """Wholesale eviction: drop the engine (and its device buffer)
+        and forget every registered row — the next epoch full-uploads."""
+        self.engine = None
+        self._slot_of.clear()
+        self.next_slot = 1
+        self.retired = 0
+        self.evictions += 1
+        if reason:
+            from .. import tracing
+
+            tracing.fault_event("hotstate_arena_evict",
+                                target="ops::fused_commit", reason=reason,
+                                epoch=self.epoch)
+
+    def invalidate(self, reason: str = "") -> None:
+        """Tree-side wholesale invalidation (deep reorg / reorg storm):
+        waits out any in-flight commit, then evicts — the same stand-down
+        that parks the preserved trie and clears the node cache."""
+        with self._commit_lock:
+            self.evict(reason)
+
+    def on_fault(self, err: BaseException) -> None:
+        """A delta epoch died mid-flight (device fault, ArenaFault, any
+        exception out of the committer's arena path): count it, evict —
+        the caller re-runs the SAME commit on the full-upload path."""
+        self.faults += 1
+        from .. import tracing
+        from ..metrics import fused_metrics
+
+        fused_metrics.record_fallback()
+        tracing.fault_event("hotstate_arena_fault",
+                            target="ops::fused_commit",
+                            error=f"{type(err).__name__}: {err}"[:200],
+                            epoch=self.epoch)
+        self.evict("fault")
+
+    # -- row registry ------------------------------------------------------
+
+    def alloc(self) -> int:
+        slot = self.next_slot
+        self.next_slot += 1
+        return slot
+
+    def lookup(self, digest: bytes) -> int:
+        """Resident slot for ``digest`` (0 = not resident). Rows idle for
+        ``max_epoch_age`` epochs retire here; hits refresh the tag."""
+        ent = self._slot_of.get(digest)
+        if ent is None:
+            self.lookup_misses += 1
+            return 0
+        slot, last = ent
+        if self.epoch - last > self.max_epoch_age:
+            del self._slot_of[digest]
+            self.retired += 1
+            self.lookup_misses += 1
+            return 0
+        self._slot_of[digest] = (slot, self.epoch)
+        self.resident_hits += 1
+        return slot
+
+    def note(self, digest: bytes, slot: int) -> None:
+        """Register this epoch's freshly hashed row; a duplicate digest
+        retires the superseded slot (the leak invariant's other half)."""
+        old = self._slot_of.get(digest)
+        if old is not None and old[0] != slot:
+            self.retired += 1
+        self._slot_of[digest] = (slot, self.epoch)
+
+    def leaked_rows(self) -> int:
+        """Allocated-but-unaccounted rows; 0 is an invariant the chaos
+        cache dimension asserts after every storm."""
+        return self.next_slot - 1 - len(self._slot_of) - self.retired
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "epoch": self.epoch, "resident_rows": len(self._slot_of),
+            "next_slot": self.next_slot, "retired": self.retired,
+            "leaked_rows": self.leaked_rows(),
+            "resident_hits": self.resident_hits,
+            "lookup_misses": self.lookup_misses,
+            "evictions": self.evictions, "faults": self.faults,
+            "delta_epochs": self.delta_epochs,
+            "full_epochs": self.full_epochs, "contended": self.contended,
+        }

@@ -102,9 +102,17 @@ class _Branch:
         self._ref = None
 
 
-def _decode_node(rlp: bytes, by_hash: dict[bytes, bytes]):
+def _decode_node(rlp: bytes, by_hash: dict[bytes, bytes],
+                 stamp: bool = False):
     """Materialize one RLP node, descending into children found in
-    ``by_hash`` (proof set); absent hashed children stay blinded."""
+    ``by_hash`` (proof set); absent hashed children stay blinded.
+
+    ``stamp`` (the hot-state plane, trie/hot_cache.py): revealed nodes'
+    hashes are already known — the proof addressed them BY hash — so
+    their ``_ref`` can be stamped at decode time. A revealed-but-never-
+    mutated node then stays clean through the next commit instead of
+    being re-encoded, re-staged, and re-hashed; mutation clears refs
+    along its path exactly as before, so roots are bit-identical."""
     items = rlp_decode(rlp)
     if len(items) == 2:
         prefix, payload = items
@@ -112,27 +120,34 @@ def _decode_node(rlp: bytes, by_hash: dict[bytes, bytes]):
         if is_leaf:
             return _Leaf(nib, payload)
         # extension: payload is a child ref (raw RLP list when inline)
-        return _Ext(nib, _decode_ref(payload, by_hash))
+        return _Ext(nib, _decode_ref(payload, by_hash, stamp))
     assert len(items) == 17, "malformed MPT node"
     br = _Branch(value=items[16])
     for i in range(16):
         if items[i] != b"":
-            br.children[i] = _decode_ref(items[i], by_hash)
+            br.children[i] = _decode_ref(items[i], by_hash, stamp)
     return br
 
 
-def _decode_ref(ref, by_hash: dict[bytes, bytes]):
+def _decode_ref(ref, by_hash: dict[bytes, bytes], stamp: bool = False):
     """A child as it appears inside a parent's decoded RLP: a 32-byte hash
     string, or an inline (already decoded) list for <32-byte nodes."""
     if isinstance(ref, list):  # inline child: re-encode to reuse _decode_node
         from ..primitives.rlp import rlp_encode
 
-        return _decode_node(rlp_encode(ref), by_hash)
+        inline = rlp_encode(ref)
+        node = _decode_node(inline, by_hash, stamp)
+        if stamp:
+            node._ref = inline  # inline ref IS the node's RLP
+        return node
     assert isinstance(ref, bytes)
     if len(ref) == 32:
         sub = by_hash.get(ref)
         if sub is not None:
-            return _decode_node(sub, by_hash)
+            node = _decode_node(sub, by_hash, stamp)
+            if stamp:
+                node._ref = encode_hash_ref(ref)
+            return node
         return _Blind(ref)
     # short raw value used as a ref (shouldn't occur in secure tries)
     raise ValueError("unexpected short child reference")
@@ -145,6 +160,12 @@ class SparseTrie:
         self.root_hash = root_hash
         self.root = None if root_hash == EMPTY_ROOT_HASH else _Blind(root_hash)
         self.updates = 0  # mutations since last root()
+        # hot-state plane (trie/hot_cache.py): when set, reveals stamp
+        # the (known) node hashes as clean refs so unmutated revealed
+        # nodes never re-stage; ``stamped`` counts them since the last
+        # commit (the delta-upload-fraction denominator)
+        self.stamp_reveals = False
+        self.stamped = 0
 
     # -- reveal ---------------------------------------------------------------
 
@@ -153,26 +174,138 @@ class SparseTrie:
         given proof nodes (spine nodes of one or more proofs)."""
         if not proof_nodes:
             return
+        stamp = self.stamp_reveals
         by_hash = {keccak256(n): n for n in proof_nodes}
         if self.root is None or isinstance(self.root, _Blind):
             top = by_hash.get(self.root_hash)
             if top is None:
                 return  # proof for a different root
-            self.root = _decode_node(top, by_hash)
+            self.root = _decode_node(top, by_hash, stamp)
+            if stamp:
+                self.root._ref = encode_hash_ref(self.root_hash)
+                self.stamped += len(by_hash)
             return
-        self.root = self._merge(self.root, by_hash)
+        self.root = self._merge(self.root, by_hash, stamp)
+        if stamp:
+            self.stamped += len(by_hash)
 
-    def _merge(self, node, by_hash):
+    def _merge(self, node, by_hash, stamp: bool = False):
         if isinstance(node, _Blind):
             rlp = by_hash.get(node.hash)
-            return _decode_node(rlp, by_hash) if rlp is not None else node
+            if rlp is None:
+                return node
+            revealed = _decode_node(rlp, by_hash, stamp)
+            if stamp:
+                revealed._ref = encode_hash_ref(node.hash)
+            return revealed
         if isinstance(node, _Ext):
-            node.child = self._merge(node.child, by_hash)
+            node.child = self._merge(node.child, by_hash, stamp)
         elif isinstance(node, _Branch):
             for i, c in enumerate(node.children):
                 if c is not None:
-                    node.children[i] = self._merge(c, by_hash)
+                    node.children[i] = self._merge(c, by_hash, stamp)
         return node
+
+    # -- hot-state plane hooks (trie/hot_cache.py) ----------------------------
+
+    def node_at(self, path: bytes):
+        """The node sitting after consuming exactly ``path``'s nibbles
+        (the key-nibble positions ``BlindedNodeError.path`` uses); None
+        when the walk diverges, ends early, or an earlier blind blocks
+        it."""
+        node, depth = self.root, 0
+        while node is not None:
+            if depth == len(path):
+                return node
+            if isinstance(node, (_Blind, _Leaf)):
+                return None
+            if isinstance(node, _Ext):
+                np_ = node.path
+                if (depth + len(np_) > len(path)
+                        or path[depth:depth + len(np_)] != np_):
+                    return None
+                depth += len(np_)
+                node = node.child
+                continue
+            node = node.children[path[depth]]
+            depth += 1
+        return None
+
+    def blind_hash_at(self, path: bytes) -> bytes | None:
+        """Hash of the blinded node at ``path`` (key-nibble position), or
+        None when the position isn't a blind — the hot cache's lookup key
+        validator."""
+        node = self.node_at(path)
+        return node.hash if isinstance(node, _Blind) else None
+
+    def reveal_at(self, path: bytes, rlp: bytes) -> bool:
+        """Reveal ONE blinded node in place from a cached RLP (hot-state
+        cache hit). Validates ``keccak(rlp)`` against the blind's hash —
+        a poisoned/stale entry can never splice in — and stamps the
+        revealed node's ref (its hash is known by construction).
+        Children decode to blinds; deeper cache hits reveal them in
+        turn. Returns False when the position isn't a matching blind."""
+        node, depth, parent, link = self.root, 0, None, None
+        while node is not None:
+            if depth == len(path):
+                break
+            if isinstance(node, (_Blind, _Leaf)):
+                return False
+            if isinstance(node, _Ext):
+                np_ = node.path
+                if (depth + len(np_) > len(path)
+                        or path[depth:depth + len(np_)] != np_):
+                    return False
+                depth += len(np_)
+                parent, link = node, None
+                node = node.child
+                continue
+            parent, link = node, path[depth]
+            node = node.children[path[depth]]
+            depth += 1
+        if not isinstance(node, _Blind) or keccak256(rlp) != node.hash:
+            return False
+        revealed = _decode_node(rlp, {}, stamp=True)
+        revealed._ref = encode_hash_ref(node.hash)
+        self.stamped += 1
+        if parent is None:
+            self.root = revealed
+        elif isinstance(parent, _Ext):
+            parent.child = revealed
+        else:
+            parent.children[link] = revealed
+        return True
+
+    def harvest_spine(self, key: bytes, out: list, seen: set) -> None:
+        """Collect ``(path, rlp)`` for every >=32 B node along ``key``'s
+        path into ``out`` (hot-cache population). Paths are key-nibble
+        positions (the same coordinates ``BlindedNodeError`` reports).
+        Child refs must be clean where visited — the walk stops at the
+        first node whose children aren't (a freshly revealed subtree
+        under a clean parent before any commit), which is safe: harvest
+        runs post-commit or post-reveal-with-stamping, where that never
+        happens on the key path."""
+        nib = unpack_nibbles(key) if len(key) == 32 else key
+        node, depth = self.root, 0
+        while node is not None and not isinstance(node, _Blind):
+            path = bytes(nib[:depth])
+            if path not in seen:
+                if not _children_ready(node):
+                    return
+                rlp = _encode_rlp(node)
+                if len(rlp) >= 32:
+                    seen.add(path)
+                    out.append((path, rlp))
+            if isinstance(node, _Leaf):
+                return
+            if isinstance(node, _Ext):
+                if nib[depth:depth + len(node.path)] != node.path:
+                    return
+                depth += len(node.path)
+                node = node.child
+            else:
+                node = node.children[nib[depth]]
+                depth += 1
 
     # -- read -----------------------------------------------------------------
 
@@ -439,24 +572,52 @@ def _child_ref_of(child) -> bytes:
     return child._ref
 
 
-def _child_ref_template(child, slot_of: dict[int, int]) -> tuple[bytes, int]:
+def _children_ready(node) -> bool:
+    """True when every child carries a usable ref (blind or cached) — the
+    precondition for ``_encode_rlp`` outside a commit walk."""
+    if isinstance(node, _Leaf):
+        return True
+    if isinstance(node, _Ext):
+        c = node.child
+        return isinstance(c, _Blind) or c._ref is not None
+    return all(c is None or isinstance(c, _Blind) or c._ref is not None
+               for c in node.children)
+
+
+def _child_ref_template(child, slot_of: dict[int, int],
+                        resident=None) -> tuple[bytes, int]:
     """Child reference as template bytes + digest source slot (0 = no
     hole): clean/blinded/inline children contribute literal host-known
     bytes, dirty hashed children a 33-byte placeholder whose digest the
     device splices from the resident buffer. Dirty-inline children were
     finalized when their own (deeper) level was walked, so their
     ``_ref`` already holds complete hole-free bytes — the same invariant
-    as ``TrieCommitter._child_ref_template``."""
+    as ``TrieCommitter._child_ref_template``.
+
+    ``resident`` (hot-state arena): maps a known child HASH to a digest
+    slot still resident from a PRIOR epoch (0 = not resident). A hit
+    turns the literal ref into a hole spliced from the persistent buffer
+    — the spliced bytes are that slot's digest, which IS the hash, so
+    the composed RLP is bit-identical either way."""
     from .node import HASH_REF_HOLE
 
     if isinstance(child, _Blind):
+        if resident is not None:
+            s = resident(child.hash)
+            if s:
+                return HASH_REF_HOLE, s
         return encode_hash_ref(child.hash), 0
     if child._ref is not None:
-        return child._ref, 0
+        r = child._ref
+        if resident is not None and len(r) == 33 and r[0] == 0xA0:
+            s = resident(r[1:])
+            if s:
+                return HASH_REF_HOLE, s
+        return r, 0
     return HASH_REF_HOLE, slot_of[id(child)]
 
 
-def _node_template_sparse(node, slot_of: dict[int, int]):
+def _node_template_sparse(node, slot_of: dict[int, int], resident=None):
     """(RLP template with zero-filled holes, [(byte_off, src_slot)]) for
     one dirty sparse node — built with the SAME RLP builders the serial
     encode uses (``HASH_REF_HOLE`` is a well-formed 33-byte ref), so the
@@ -464,7 +625,7 @@ def _node_template_sparse(node, slot_of: dict[int, int]):
     if isinstance(node, _Leaf):
         return leaf_node_rlp(node.path, node.value), []
     if isinstance(node, _Ext):
-        ref, src = _child_ref_template(node.child, slot_of)
+        ref, src = _child_ref_template(node.child, slot_of, resident)
         rlp = extension_node_rlp(node.path, ref)
         # the child ref is the payload's tail; +1 skips its 0xa0 marker
         return rlp, ([(len(rlp) - 32, src)] if src else [])
@@ -476,7 +637,7 @@ def _node_template_sparse(node, slot_of: dict[int, int]):
             refs.append(EMPTY_STRING_RLP)
             srcs.append(0)
         else:
-            r, s = _child_ref_template(c, slot_of)
+            r, s = _child_ref_template(c, slot_of, resident)
             refs.append(r)
             srcs.append(s)
     rlp = branch_node_rlp(refs, node.value)
@@ -621,7 +782,7 @@ class ParallelSparseCommitter:
 
     def __init__(self, workers: int | None = None, split_depth: int | None = None,
                  injector: SparseFaultInjector | None = None,
-                 subtrie_levels: int | None = None):
+                 subtrie_levels: int | None = None, arena=None):
         env = os.environ
         self.workers = sparse_worker_count(workers)
         self.split_depth = int(
@@ -637,6 +798,18 @@ class ParallelSparseCommitter:
             else env.get("RETH_TPU_SUBTRIE_LEVELS", "0") or 0)
         self.injector = (injector if injector is not None
                          else SparseFaultInjector.from_env())
+        # hot-state plane (--hot-state): a shared DigestArena makes each
+        # commit a DELTA against the persistent cross-block engine —
+        # only this block's dirty rows stage; unchanged sibling digests
+        # splice from rows still resident from prior epochs. Implies the
+        # whole-subtrie layout even when --subtrie-levels is unset.
+        self.arena = arena
+        self._arena_k = self.subtrie_levels if self.subtrie_levels > 1 else 8
+        self.hot_injector = None
+        if arena is not None:
+            from .hot_cache import HotStateFaultInjector
+
+            self.hot_injector = HotStateFaultInjector.from_env()
         self._pool: ThreadPoolExecutor | None = None
         self._pool_lock = threading.Lock()
         self.last: dict | None = None  # most recent commit's stats
@@ -738,6 +911,15 @@ class ParallelSparseCommitter:
             self.last = {**stats, "wall_s": 0.0}
             return roots
 
+        if (self.arena is not None
+                and getattr(hasher, "commit_window", None) is None):
+            # hot-state delta commit; any fault inside evicts the arena
+            # and falls through to the classic full-upload rungs below
+            delta = self._commit_fused_arena(live, roots, hasher, stats,
+                                             t_wall)
+            if delta is not None:
+                return delta
+
         if self.subtrie_levels > 1:
             fused = self._commit_fused(live, roots, hasher, stats, t_wall)
             if fused is not None:
@@ -789,6 +971,9 @@ class ParallelSparseCommitter:
                                 n._ref = r
                         if to_hash:
                             stats["streamed"] += 1
+                            stats["h2d_bytes"] = (
+                                stats.get("h2d_bytes", 0)
+                                + sum(len(r) for _, r in to_hash))
                             pending.append(
                                 (to_hash,
                                  hasher.submit([r for _, r in to_hash])))
@@ -812,6 +997,8 @@ class ParallelSparseCommitter:
             self.injector.on_dispatch()
         tops = [_encode_rlp(t.root) for _, t in live]
         stats["dispatches"] += 1
+        stats["h2d_bytes"] = (stats.get("h2d_bytes", 0)
+                              + sum(len(r) for r in tops))
         with tracing.span("trie::sparse", "hash.dispatch", msgs=len(tops),
                           what="trie_tops"):
             digests = hasher(tops)
@@ -886,29 +1073,7 @@ class ParallelSparseCommitter:
             if lv_nodes:
                 schedule.append((lv_nodes, lv_templates, lv_holes))
 
-        window: list[dict] = []
-        for _nodes, templates, holess in schedule:
-            row_len = np.array([len(t) for t in templates], dtype=np.uint32)
-            row_off = (np.cumsum(row_len) - row_len).astype(np.uint32)
-            flat = np.frombuffer(b"".join(templates), dtype=np.uint8)
-            slots = np.array([slot_of[id(n)] for n in _nodes],
-                             dtype=np.int32)
-            hr: list[int] = []
-            hb: list[int] = []
-            hs: list[int] = []
-            for i, hl in enumerate(holess):
-                for off, src in hl:
-                    hr.append(i)
-                    hb.append(off)
-                    hs.append(src)
-            holes = (np.array([hr, hb, hs], dtype=np.int32) if hr else None)
-            bt = 1
-            maxlen = int(row_len.max())
-            while bt * RATE <= maxlen:
-                bt *= 2
-            window.append({"flat": flat, "row_off": row_off,
-                           "row_len": row_len, "slots": slots,
-                           "holes": holes, "b_tier": bt})
+        window = self._pack_schedule(schedule, slot_of)
 
         buf = None
         if window:
@@ -928,6 +1093,8 @@ class ParallelSparseCommitter:
                                         w["holes"], w["b_tier"])
                 buf = eng.finish()
                 stats["dispatches"] += eng.dispatches
+                stats["h2d_bytes"] = (eng.staged_u8_bytes
+                                      + eng.staged_i32_bytes)
             for _nodes, _templates, _holess in schedule:
                 for node in _nodes:
                     node._ref = encode_hash_ref(
@@ -951,6 +1118,177 @@ class ParallelSparseCommitter:
         return roots
 
     @staticmethod
+    def _pack_schedule(schedule, slot_of: dict[int, int]) -> list[dict]:
+        """Level template lists -> engine window dicts (flat bytes,
+        row offsets/lengths, digest slots, hole triples, block tier) —
+        shared by the classic fused finish and the arena delta finish."""
+        import numpy as np
+
+        window: list[dict] = []
+        for _nodes, templates, holess in schedule:
+            row_len = np.array([len(t) for t in templates], dtype=np.uint32)
+            row_off = (np.cumsum(row_len) - row_len).astype(np.uint32)
+            flat = np.frombuffer(b"".join(templates), dtype=np.uint8)
+            slots = np.array([slot_of[id(n)] for n in _nodes],
+                             dtype=np.int32)
+            hr: list[int] = []
+            hb: list[int] = []
+            hs: list[int] = []
+            for i, hl in enumerate(holess):
+                for off, src in hl:
+                    hr.append(i)
+                    hb.append(off)
+                    hs.append(src)
+            holes = (np.array([hr, hb, hs], dtype=np.int32) if hr else None)
+            bt = 1
+            maxlen = int(row_len.max())
+            while bt * RATE <= maxlen:
+                bt *= 2
+            window.append({"flat": flat, "row_off": row_off,
+                           "row_len": row_len, "slots": slots,
+                           "holes": holes, "b_tier": bt})
+        return window
+
+    # -- hot-state arena delta finish (ISSUE 19 device half) ----------------
+
+    def _commit_fused_arena(self, live, roots, hasher, stats, t_wall):
+        """Delta-commit the dirty set against the persistent cross-block
+        :class:`~reth_tpu.ops.fused_commit.DigestArena`: only THIS
+        block's dirty rows stage onto the device; unchanged sibling
+        digests (clean refs, blinds, reveal-stamped subtrees) either
+        inline as literal bytes or hole-splice rows still resident from
+        prior epochs. The terminal fetch is ``peek_slots`` (this epoch's
+        rows only), keeping the buffer resident for the next block.
+
+        Returns None — and the caller reruns the SAME commit on the
+        classic full-upload rungs — when the arena is contended, the
+        device stack is absent, or ANY fault fires mid-epoch (the arena
+        evicts first, so no partial epoch is ever referenced). Roots are
+        bit-identical on every rung: templates come from the same RLP
+        builders and a resident splice writes the exact digest bytes the
+        literal ref would have inlined."""
+        import numpy as np
+
+        from ..metrics import sparse_commit_metrics
+
+        arena = self.arena
+        if not arena.try_acquire():
+            return None  # a sibling finish holds the arena: classic path
+        try:
+            evict_storm = (self.hot_injector is not None
+                           and self.hot_injector.evict_storm)
+            fresh = arena.begin_epoch(evict_storm=evict_storm)
+            eng = arena.engine
+            if eng is None:
+                try:
+                    from ..ops.fused_commit import SubtrieFusedEngine
+
+                    eng = SubtrieFusedEngine(
+                        min_tier=64, k=self._arena_k,
+                        row_floor=self.SUBTRIE_ROW_FLOOR,
+                        hole_floor=self.SUBTRIE_HOLE_FLOOR)
+                except Exception:  # noqa: BLE001 — no device stack
+                    return None
+                arena.engine = eng
+                fresh = True
+
+            levels = self._collect([t for _, t in live])
+            resident = None if fresh else arena.lookup
+            slot_of: dict[int, int] = {}
+            epoch_nodes: list = []
+            epoch_slots: list[int] = []
+            schedule: list[tuple[list, list, list]] = []
+            for depth in sorted(levels, reverse=True):
+                if self.injector is not None:
+                    self.injector.on_dispatch()
+                stats["levels"] += 1
+                lv_nodes, lv_templates, lv_holes = [], [], []
+                for _g, node in levels[depth]:
+                    t, holes = _node_template_sparse(node, slot_of,
+                                                     resident)
+                    if len(t) < 32:
+                        node._ref = t  # inline: complete and hole-free
+                        continue
+                    slot = arena.alloc()
+                    slot_of[id(node)] = slot
+                    lv_nodes.append(node)
+                    lv_templates.append(t)
+                    lv_holes.append(holes)
+                    epoch_nodes.append(node)
+                    epoch_slots.append(slot)
+                if lv_nodes:
+                    schedule.append((lv_nodes, lv_templates, lv_holes))
+
+            window = self._pack_schedule(schedule, slot_of)
+            h2d_bytes = 0
+            if window:
+                max_slots = arena.next_slot - 1
+                if fresh:
+                    eng.begin(max_slots)
+                else:
+                    eng.begin_delta(max_slots)
+                for w in window:
+                    eng.dispatch_packed(w["flat"], w["row_off"],
+                                        w["row_len"], w["slots"],
+                                        w["holes"], w["b_tier"])
+                rows = eng.peek_slots(
+                    np.asarray(epoch_slots, dtype=np.int64))
+                for node, slot, d in zip(epoch_nodes, epoch_slots, rows):
+                    dig = bytes(d)
+                    node._ref = encode_hash_ref(dig)
+                    arena.note(dig, slot)
+                    stats["hashed"] += 1
+                stats["dispatches"] += eng.dispatches
+                h2d_bytes = eng.staged_u8_bytes + eng.staged_i32_bytes
+
+            for i, t in live:
+                if id(t.root) in slot_of:
+                    t.root_hash = bytes(t.root._ref[1:])
+                else:
+                    # inline or clean root: keccak of the full root RLP
+                    # whatever its size (serial-path rule)
+                    t.root_hash = keccak256(_encode_rlp(t.root))
+                t.updates = 0
+                roots[i] = t.root_hash
+
+            # delta-upload accounting: staged rows vs reveal-stamped
+            # rows that a cold path would have re-staged (trie.stamped)
+            stamped = 0
+            for _i, t in live:
+                stamped += t.stamped
+                t.stamped = 0
+            staged_rows = len(epoch_nodes)
+            denom = staged_rows + stamped
+            delta_fraction = (staged_rows / denom) if denom else 0.0
+            stats["wall_s"] = round(time.perf_counter() - t_wall, 6)
+            stats["subtrie_k"] = self._arena_k
+            stats["staged_rows"] = staged_rows
+            stats["stamped_rows"] = stamped
+            stats["delta_fraction"] = round(delta_fraction, 4)
+            stats["h2d_bytes"] = h2d_bytes
+            stats["arena_fresh"] = fresh
+            self.last = stats
+            sparse_commit_metrics.record_commit(stats)
+            try:
+                from ..metrics import hotstate_metrics
+
+                hotstate_metrics.record_arena(
+                    arena.snapshot(), delta_fraction=delta_fraction,
+                    staged_rows=staged_rows, stamped_rows=stamped,
+                    h2d_bytes=h2d_bytes, fresh=fresh)
+            except Exception:  # noqa: BLE001 — metrics never gate commits
+                pass
+            return roots
+        except BaseException as e:  # noqa: BLE001 — external ladder
+            arena.on_fault(e)
+            if not isinstance(e, Exception) or isinstance(
+                    e, InjectedSparseAbort):
+                raise  # injected aborts / interrupts keep their semantics
+            return None
+        finally:
+            arena.release()
+
+    @staticmethod
     def _apply_level(nodes, rlps, hasher, stats) -> None:
         to_hash = [(n, r) for n, r in zip(nodes, rlps) if len(r) >= 32]
         for n, r in zip(nodes, rlps):
@@ -958,6 +1296,8 @@ class ParallelSparseCommitter:
                 n._ref = r  # inline ref
         if to_hash:
             stats["dispatches"] += 1
+            stats["h2d_bytes"] = (stats.get("h2d_bytes", 0)
+                                  + sum(len(r) for _, r in to_hash))
             with tracing.span("trie::sparse", "hash.dispatch",
                               msgs=len(to_hash), what="level"):
                 digests = hasher([r for _, r in to_hash])
@@ -979,10 +1319,20 @@ class SparseStateTrie:
 
     account_trie: SparseTrie = field(default_factory=SparseTrie)
     storage_tries: dict[bytes, SparseTrie] = field(default_factory=dict)
+    # hot-state plane: propagate reveal-ref stamping to every trie
+    stamp_reveals: bool = False
 
     @classmethod
     def anchored(cls, state_root: bytes) -> "SparseStateTrie":
         return cls(account_trie=SparseTrie(state_root))
+
+    def set_stamping(self, on: bool) -> None:
+        """Turn reveal-ref stamping on for every current and future trie
+        (the hot-state plane's delta-staging precondition)."""
+        self.stamp_reveals = on
+        self.account_trie.stamp_reveals = on
+        for t in self.storage_tries.values():
+            t.stamp_reveals = on
 
     def reveal_account(self, proof_nodes: list[bytes]) -> None:
         self.account_trie.reveal(proof_nodes)
@@ -992,6 +1342,7 @@ class SparseStateTrie:
         st = self.storage_tries.get(hashed_addr)
         if st is None:
             st = SparseTrie(storage_root)
+            st.stamp_reveals = self.stamp_reveals
             self.storage_tries[hashed_addr] = st
         return st
 
@@ -1000,6 +1351,7 @@ class SparseStateTrie:
         st = self.storage_tries.get(hashed_addr)
         if st is None or (st.root is None and st.root_hash != storage_root):
             st = SparseTrie(storage_root)
+            st.stamp_reveals = self.stamp_reveals
             self.storage_tries[hashed_addr] = st
         st.reveal(proof_nodes)
 

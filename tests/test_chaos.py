@@ -24,6 +24,7 @@ import pytest
 from reth_tpu.chaos import (
     CRASH_POINTS,
     FAULT_MENU,
+    HOTSTATE_FAULTS,
     crash_spec,
     make_consensus_scenario,
     make_scenario,
@@ -121,11 +122,16 @@ def test_make_consensus_scenario_deterministic_and_diverse():
     assert a == b
     scns = [make_consensus_scenario(s) for s in range(1, 60)]
     assert {s["mode"] for s in scns} == {"complete", "kill", "point"}
-    known = set().union(*[set(f) for f in FAULT_MENU])
+    known = set().union(*[set(f) for f in FAULT_MENU], HOTSTATE_FAULTS)
     for s in scns:
         assert s["domain"] == "consensus"
         assert s["faults"] and set(s["faults"]) <= known
         assert s["rounds"] > 0
+        # hot-state injectors only land on cached seeds
+        if not s.get("hot_state"):
+            assert not (set(s["faults"]) & set(HOTSTATE_FAULTS))
+    assert any(s.get("hot_state") for s in scns)
+    assert any(set(s["faults"]) & set(HOTSTATE_FAULTS) for s in scns)
     # unwind crash points must come with a forced deep reorg (the point
     # only fires inside a persisted-chain unwind)
     for s in scns:

@@ -1,0 +1,493 @@
+"""Hot-state plane tests (ISSUE 19): the cross-block trie-node cache
+(trie/hot_cache.py), the device-resident digest arena with delta uploads
+(ops/fused_commit.py DigestArena + the arena finish in trie/sparse.py),
+and the engine wiring (engine/sparse_root.py + engine/tree.py).
+
+The acceptance drills:
+
+- hash-keyed cache semantics: sibling forks' versions coexist at one
+  (owner, path); canonical-write trims keep the fork-live versions; a
+  wrong-hash lookup can never serve (staleness is structural);
+- ``RETH_TPU_FAULT_HOTSTATE_POISON`` is CAUGHT by node-hash validation —
+  a poisoned serve is a counted miss, never a reveal;
+- randomized differential suite (10 seeds): cached reveals + arena delta
+  finishes vs uncached proof-fed classic finishes over interleaved
+  update/delete/wipe streams with sibling-collapse deletes and fork
+  switches — roots bit-identical every round, verified against a
+  from-scratch rebuild each round;
+- arena drills: epoch eviction under a row budget, the fault ladder
+  (mid-epoch engine fault -> evict -> SAME commit reruns on the classic
+  full-upload rung), the evict-storm injector forcing every epoch onto
+  the full-upload rung, and the no-leaked-rows invariant throughout;
+- engine wiring: sibling-fork import through EngineTree(hot_state=True)
+  serves reveals from the cache (fewer proof targets than the uncached
+  twin on the same stream), the proof-pool dedupe does not double-fetch
+  what the cache already unblinded, and deep-reorg stand-down clears
+  both planes.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from reth_tpu.engine import EngineTree
+from reth_tpu.engine.tree import PayloadStatusKind
+from reth_tpu.primitives import Account
+from reth_tpu.primitives.keccak import keccak256, keccak256_batch_np
+from reth_tpu.primitives.rlp import rlp_encode
+from reth_tpu.storage import MemDb, ProviderFactory
+from reth_tpu.storage.genesis import init_genesis
+from reth_tpu.testing import ChainBuilder, Wallet
+from reth_tpu.trie import TrieCommitter
+from reth_tpu.trie.hot_cache import (
+    ACCOUNT_OWNER,
+    HotStateFaultInjector,
+    TrieNodeCache,
+)
+from reth_tpu.trie.sparse import (
+    BlindedNodeError,
+    ParallelSparseCommitter,
+    SparseTrie,
+    _encode_rlp,
+)
+
+CPU = TrieCommitter(hasher=keccak256_batch_np)
+
+
+def _arena(**kw):
+    from reth_tpu.ops.fused_commit import DigestArena
+
+    return DigestArena(**kw)
+
+
+def _small_committer(arena=None) -> ParallelSparseCommitter:
+    c = ParallelSparseCommitter(workers=1, arena=arena)
+    # shrink the device batch floors so the tiny test tries still take
+    # the fused/arena windows instead of padding to production tiers
+    c.SUBTRIE_ROW_FLOOR = 8
+    c.SUBTRIE_HOLE_FLOOR = 8
+    return c
+
+
+def _keys(n: int, salt: int = 0):
+    return [keccak256(salt.to_bytes(4, "big") + i.to_bytes(8, "big"))
+            for i in range(n)]
+
+
+# -- TrieNodeCache unit behavior ---------------------------------------------
+
+
+def test_cache_hash_keyed_versions_coexist():
+    """Two forks' nodes at the SAME (owner, path) both serve — the node
+    hash is part of the key, so absorbing one fork never evicts the
+    sibling's live spine (the thrash a path-keyed map would have)."""
+    cache = TrieNodeCache(injector=None)
+    a, b = b"\xaa" * 40, b"\xbb" * 41
+    cache.put(ACCOUNT_OWNER, b"\x01", a)
+    cache.put(ACCOUNT_OWNER, b"\x01", b)
+    assert cache.lookup(ACCOUNT_OWNER, b"\x01", keccak256(a)) == a
+    assert cache.lookup(ACCOUNT_OWNER, b"\x01", keccak256(b)) == b
+    assert cache.hits == 2
+    # a hash no version carries is a miss, never a wrong serve
+    assert cache.lookup(ACCOUNT_OWNER, b"\x01", b"\x00" * 32) is None
+    assert cache.misses == 1
+
+
+def test_cache_version_cap_and_invalidate_trim():
+    cache = TrieNodeCache(injector=None)
+    rlps = [bytes([i]) * 40 for i in range(6)]
+    for r in rlps:
+        cache.put(ACCOUNT_OWNER, b"", r)
+    # per-path fan-out is bounded: the oldest versions aged out
+    assert len(cache) == cache.VERSIONS_PER_PATH
+    assert cache.lookup(ACCOUNT_OWNER, b"", keccak256(rlps[0])) is None
+    assert cache.lookup(ACCOUNT_OWNER, b"", keccak256(rlps[-1])) == rlps[-1]
+    # canonical-write trim: prefixes of the changed key keep only the
+    # newest INVALIDATE_KEEP versions (the fork siblings' live spines)
+    cache.invalidate_key(ACCOUNT_OWNER, b"\x07" * 32)
+    assert len(cache) == cache.INVALIDATE_KEEP
+    assert cache.lookup(ACCOUNT_OWNER, b"", keccak256(rlps[-1])) == rlps[-1]
+    cache.drop_owner(ACCOUNT_OWNER)
+    assert len(cache) == 0
+
+
+def test_cache_clear_and_owner_isolation():
+    cache = TrieNodeCache(injector=None)
+    cache.put(ACCOUNT_OWNER, b"\x01", b"\xaa" * 40)
+    cache.put(b"\x99" * 32, b"\x01", b"\xbb" * 40)
+    cache.drop_owner(b"\x99" * 32)
+    assert cache.lookup(ACCOUNT_OWNER, b"\x01",
+                        keccak256(b"\xaa" * 40)) is not None
+    cache.clear("test")
+    assert len(cache) == 0 and cache.clears == 1
+
+
+def test_poison_injector_is_caught():
+    """Every poisoned serve MUST be caught by node-hash validation: the
+    lookup misses (pays a proof fetch), poison_caught counts it, and the
+    intact entry still serves on the next (unpoisoned) lookup."""
+    inj = HotStateFaultInjector(poison_every=2)
+    cache = TrieNodeCache(injector=inj)
+    rlp = b"\xcd" * 40
+    cache.put(ACCOUNT_OWNER, b"\x02", rlp)
+    h = keccak256(rlp)
+    assert cache.lookup(ACCOUNT_OWNER, b"\x02", h) == rlp   # 1st: clean
+    assert cache.lookup(ACCOUNT_OWNER, b"\x02", h) is None  # 2nd: poisoned
+    assert cache.poison_caught == 1
+    assert cache.lookup(ACCOUNT_OWNER, b"\x02", h) == rlp   # entry intact
+
+
+def test_reveal_through_unblinds_from_cache_alone():
+    """A trie anchored at a blind root becomes readable purely from
+    cached spine nodes — the zero-proof-fetch reveal path."""
+    keys = _keys(50)
+    truth = SparseTrie()
+    for i, k in enumerate(keys):
+        truth.update(k, rlp_encode((i + 1).to_bytes(4, "big")))
+    _small_committer().commit([truth])
+    cache = TrieNodeCache(injector=None)
+    assert cache.harvest(truth, ACCOUNT_OWNER, keys) > 0
+
+    blind = SparseTrie(root_hash=truth.root_hash)
+    for i, k in enumerate(keys):
+        assert cache.reveal_through(blind, ACCOUNT_OWNER, k)
+        assert blind.get(k) == rlp_encode((i + 1).to_bytes(4, "big"))
+    assert cache.hits > 0 and cache.stale_drops == 0
+    # with the cache gone, the same anchor cannot unblind
+    cache.clear("test")
+    blind2 = SparseTrie(root_hash=truth.root_hash)
+    assert not cache.reveal_through(blind2, ACCOUNT_OWNER, keys[0])
+
+
+# -- randomized differential: cached vs uncached finishes --------------------
+
+
+def _apply_with_reveals(blind, twin, cache, owner, fn, counters):
+    """Run one mutation, unblinding on demand: cache first (validated),
+    the twin's node RLP as the simulated proof fetch on a miss."""
+    for _ in range(400):
+        try:
+            return fn()
+        except BlindedNodeError as e:
+            path = bytes(e.path)
+            h = blind.blind_hash_at(path)
+            rlp = cache.lookup(owner, path, h) if h is not None else None
+            if rlp is not None and blind.reveal_at(path, rlp):
+                counters["cache"] += 1
+                continue
+            node = twin.node_at(path)
+            assert node is not None, "twin missing a node the blind needs"
+            assert blind.reveal_at(path, _encode_rlp(node))
+            counters["fetch"] += 1
+    raise AssertionError("reveal loop did not converge")
+
+
+@pytest.mark.parametrize("seed", range(1, 11))
+def test_randomized_differential_cached_vs_uncached(seed):
+    """10-seed differential: interleaved update/delete/wipe streams over
+    two alternating sibling forks. The cached lineage reveals from the
+    shared TrieNodeCache (falling back to simulated proof fetches) and
+    delta-commits through a persistent DigestArena on half the seeds;
+    the uncached twin re-stages everything through the classic path.
+    Every round's root must be bit-identical to the twin's AND to a
+    from-scratch rebuild of the reference state."""
+    rng = random.Random(0x407E + seed)
+    keys = _keys(36, salt=seed)
+    cache = TrieNodeCache(injector=None)
+    arena = _arena(max_rows=1 << 12) if seed % 2 else None
+    hot_committer = _small_committer(arena=arena)
+    cold_committer = _small_committer()
+    counters = {"cache": 0, "fetch": 0}
+
+    forks = {f: {"state": {}, "root": None, "twin": SparseTrie()}
+             for f in ("A", "B")}
+    for rnd in range(14):
+        fork = forks["AB"[rnd % 2] if rng.random() < 0.8
+                     else rng.choice("AB")]
+        blind = (SparseTrie() if fork["root"] is None
+                 else SparseTrie(root_hash=fork["root"]))
+        blind.stamp_reveals = True
+
+        ops = []
+        if fork["state"] and rng.random() < 0.08:
+            ops.append(("wipe", None, None))
+        else:
+            present = list(fork["state"])
+            for k in rng.sample(keys, rng.randint(3, 9)):
+                if k in fork["state"] and rng.random() < 0.35:
+                    ops.append(("del", k, None))  # sibling-collapse deletes
+                else:
+                    v = rlp_encode(rng.randbytes(rng.randint(1, 48)))
+                    ops.append(("set", k, v))
+            # target a guaranteed-present key sometimes so deletions hit
+            # two-child branches that collapse into extensions
+            if present and rng.random() < 0.5:
+                ops.append(("del", rng.choice(present), None))
+
+        for op, k, v in ops:
+            if op == "wipe":
+                blind = SparseTrie()
+                blind.stamp_reveals = True
+                fork["twin"] = SparseTrie()
+                fork["state"] = {}
+                cache.drop_owner(ACCOUNT_OWNER)
+                continue
+            if op == "set":
+                _apply_with_reveals(blind, fork["twin"], cache,
+                                    ACCOUNT_OWNER,
+                                    lambda k=k, v=v: blind.update(k, v),
+                                    counters)
+            else:
+                _apply_with_reveals(blind, fork["twin"], cache,
+                                    ACCOUNT_OWNER,
+                                    lambda k=k: blind.delete(k),
+                                    counters)
+        # twin applies the same ops, then both commit on their own path
+        for op, k, v in ops:
+            if op == "wipe":
+                continue
+            if op == "set":
+                fork["twin"].update(k, v)
+                fork["state"][k] = v
+            else:
+                fork["twin"].delete(k)
+                fork["state"].pop(k, None)
+
+        (hot_root,) = hot_committer.commit([blind])
+        (cold_root,) = cold_committer.commit([fork["twin"]])
+        assert hot_root == cold_root, f"round {rnd}: cached diverged"
+        scratch = SparseTrie()
+        for k, v in fork["state"].items():
+            scratch.update(k, v)
+        assert scratch.root_hash_compute() == cold_root, \
+            f"round {rnd}: twin diverged from rebuild"
+
+        # absorb: canonical-write trims + fresh spine harvest
+        changed = [k for op, k, _ in ops if op != "wipe"]
+        for k in changed:
+            cache.invalidate_key(ACCOUNT_OWNER, k)
+        cache.harvest(blind, ACCOUNT_OWNER, changed)
+        fork["root"] = hot_root
+
+    assert counters["cache"] > 0, "cache never served a reveal"
+    if arena is not None and arena.engine is not None:
+        assert arena.leaked_rows() == 0, arena.snapshot()
+        assert arena.snapshot()["delta_epochs"] > 0, arena.snapshot()
+
+
+# -- arena drills ------------------------------------------------------------
+
+
+def _arena_rounds(committer, trie, keys, rng, rounds=6):
+    """Steady incremental commits of one trie through ``committer``;
+    returns the per-round roots (for a twin comparison)."""
+    roots = []
+    for rnd in range(rounds):
+        for k in rng.sample(keys, 6):
+            trie.update(k, rlp_encode(rng.randbytes(20)))
+        (r,) = committer.commit([trie])
+        roots.append(r)
+    return roots
+
+
+def test_arena_epoch_eviction_reclaims_rows():
+    """A row budget forces begin_epoch to evict: the epoch after the
+    eviction runs the full-upload rung (arena_fresh), roots stay
+    bit-identical to a classic twin, and no row leaks."""
+    rng = random.Random(11)
+    keys = _keys(48, salt=77)
+    arena = _arena()
+    arena.max_rows = 24  # the ctor floors at 1024; shrink for the drill
+    hot = _small_committer(arena=arena)
+    cold = _small_committer()
+    t_hot, t_cold = SparseTrie(), SparseTrie()
+    rng2 = random.Random(11)
+    hot_roots = _arena_rounds(hot, t_hot, keys, rng, rounds=8)
+    cold_roots = _arena_rounds(cold, t_cold, keys, rng2, rounds=8)
+    assert hot_roots == cold_roots
+    snap = arena.snapshot()
+    assert snap["evictions"] >= 1, snap
+    assert arena.leaked_rows() == 0, snap
+
+
+def test_arena_fault_falls_back_to_full_upload():
+    """A mid-epoch device fault must evict the arena and let the SAME
+    commit rerun on the classic full-upload rungs — root unchanged, the
+    fault counted, nothing leaked."""
+    rng = random.Random(5)
+    keys = _keys(40, salt=5)
+    arena = _arena()
+    hot = _small_committer(arena=arena)
+    trie = SparseTrie()
+    for k in keys[:12]:
+        trie.update(k, rlp_encode(b"\x01" + k[:8]))
+    (first,) = hot.commit([trie])
+    if arena.engine is None:
+        pytest.skip("no device stack: arena path unavailable")
+
+    boom = RuntimeError("injected mid-epoch device fault")
+
+    def explode(*a, **kw):
+        raise boom
+
+    arena.engine.dispatch_packed = explode  # next epoch faults mid-flight
+    for k in keys[12:24]:
+        trie.update(k, rlp_encode(b"\x02" + k[:8]))
+    twin = SparseTrie()
+    for k in keys[:12]:
+        twin.update(k, rlp_encode(b"\x01" + k[:8]))
+    for k in keys[12:24]:
+        twin.update(k, rlp_encode(b"\x02" + k[:8]))
+    (faulted,) = hot.commit([trie])
+    assert faulted == _small_committer().commit([twin])[0]
+    snap = arena.snapshot()
+    assert snap["faults"] == 1 and snap["evictions"] >= 1, snap
+    assert arena.leaked_rows() == 0
+    # the arena recovers: the next commit re-enters the delta protocol
+    for k in keys[24:30]:
+        trie.update(k, rlp_encode(b"\x03" + k[:8]))
+    hot.commit([trie])
+    assert arena.engine is not None and arena.snapshot()["faults"] == 1
+
+
+def test_evict_storm_injector_forces_full_uploads(monkeypatch):
+    """RETH_TPU_FAULT_HOTSTATE_EVICT_STORM=1: every epoch starts from an
+    evicted arena, so every commit runs the full-upload rung — purely a
+    performance fault, roots stay bit-identical."""
+    monkeypatch.setenv("RETH_TPU_FAULT_HOTSTATE_EVICT_STORM", "1")
+    rng, rng2 = random.Random(3), random.Random(3)
+    keys = _keys(32, salt=9)
+    arena = _arena()
+    hot = _small_committer(arena=arena)   # injector read from env here
+    cold = _small_committer()
+    assert hot.hot_injector is not None and hot.hot_injector.evict_storm
+    hot_roots = _arena_rounds(hot, SparseTrie(), keys, rng, rounds=5)
+    cold_roots = _arena_rounds(cold, SparseTrie(), keys, rng2, rounds=5)
+    assert hot_roots == cold_roots
+    snap = arena.snapshot()
+    if arena.engine is not None:
+        assert snap["delta_epochs"] == 0, snap
+        assert snap["full_epochs"] >= 1, snap
+    assert arena.leaked_rows() == 0
+
+
+# -- engine wiring -----------------------------------------------------------
+
+
+def _sibling_fork_env(n_blocks=3, n_wallets=12, n_txs=6):
+    """Two sibling chains over the SAME genesis + wallet set (the
+    preserved trie misses every interleaved import, so each block needs
+    reveals) and a factory to feed them into."""
+    genesis = {Wallet(0x5000 + i).address: Account(balance=10**21)
+               for i in range(n_wallets)}
+    half = n_wallets // 2
+    chains = []
+    for fork in range(2):
+        ws = [Wallet(0x5000 + i) for i in range(n_wallets)]
+        b = ChainBuilder(genesis, committer=CPU)
+        for i in range(n_blocks):
+            send, recv = (ws[:half], ws[half:]) if i % 2 == 0 else \
+                         (ws[half:], ws[:half])
+            b.build_block([send[j % half].transfer(
+                recv[j % half].address, 10**13 + fork * 3 + i * 17 + j)
+                for j in range(n_txs)])
+        chains.append(b)
+    order = []
+    for i in range(1, n_blocks + 1):
+        order.append(chains[0].blocks[i])
+        order.append(chains[1].blocks[i])
+
+    def fresh_factory():
+        f = ProviderFactory(MemDb())
+        init_genesis(f, chains[0].genesis, chains[0].accounts_at_genesis,
+                     committer=CPU)
+        return f
+
+    return order, fresh_factory
+
+
+def _import_forks(tree, order):
+    agg = {"proof_targets": 0, "cache_unblinds": 0}
+    for blk in order:
+        st = tree.on_new_payload(blk)
+        assert st.status is PayloadStatusKind.VALID, st.validation_error
+        m = tree.last_sparse or {}
+        assert m.get("strategy") == "sparse", m
+        agg["proof_targets"] += m.get("proof_targets", 0)
+        agg["cache_unblinds"] += m.get("cache_unblinds", 0)
+    return agg
+
+
+def test_engine_sibling_forks_served_from_cache():
+    """EngineTree(hot_state=True) vs the uncached twin on the SAME
+    interleaved sibling-fork stream: every payload VALID on both (roots
+    bit-identical by the header check), the cached tree unblinds from
+    the cache, and it fetches strictly fewer proof targets — the
+    dedupe/cache interaction (a cache unblind never lands on the proof
+    pool, an in-flight fetch is never re-consulted) shows up as that
+    strict reduction."""
+    order, fresh_factory = _sibling_fork_env()
+    hot_tree = EngineTree(fresh_factory(), committer=CPU,
+                          persistence_threshold=10**9, hot_state=True)
+    assert hot_tree.hot_cache is not None
+    cold_tree = EngineTree(fresh_factory(), committer=CPU,
+                           persistence_threshold=10**9, hot_state=False)
+    assert cold_tree.hot_cache is None
+    hot = _import_forks(hot_tree, order)
+    cold = _import_forks(cold_tree, order)
+    assert hot["cache_unblinds"] > 0
+    assert cold["cache_unblinds"] == 0
+    assert hot["proof_targets"] < cold["proof_targets"], (hot, cold)
+    assert len(hot_tree.hot_cache) > 0
+
+
+def test_engine_poison_storm_stays_valid(monkeypatch):
+    """With every other cache serve poisoned, imports stay VALID (the
+    validator eats the poison as a miss and the proof path supplies the
+    real node) and the catches are counted."""
+    monkeypatch.setenv("RETH_TPU_FAULT_HOTSTATE_POISON", "2")
+    order, fresh_factory = _sibling_fork_env()
+    tree = EngineTree(fresh_factory(), committer=CPU,
+                      persistence_threshold=10**9, hot_state=True)
+    _import_forks(tree, order)
+    assert tree.hot_cache.poison_caught > 0
+
+
+def test_engine_invalidate_hot_state_clears_both_planes():
+    order, fresh_factory = _sibling_fork_env(n_blocks=2)
+    tree = EngineTree(fresh_factory(), committer=CPU,
+                      persistence_threshold=10**9, hot_state=True)
+    _import_forks(tree, order)
+    assert len(tree.hot_cache) > 0
+    tree._invalidate_hot_state("test_stand_down")
+    assert len(tree.hot_cache) == 0
+    if tree.hot_arena is not None:
+        assert tree.hot_arena.engine is None
+        assert tree.hot_arena.leaked_rows() == 0
+
+
+# -- observability -----------------------------------------------------------
+
+
+def test_hotstate_metrics_and_health_rule():
+    """hotstate_* counters convert lifetime totals to increments, the
+    events fragment renders from ``last``, and the health table carries
+    the hit-rate-collapse floor as a degrade-only rule."""
+    from reth_tpu.health import default_rules
+    from reth_tpu.metrics import HotStateMetrics
+
+    m = HotStateMetrics()
+    m.record_cache({"entries": 4, "hits": 10, "misses": 2,
+                    "stale_drops": 1, "poison_caught": 0, "evictions": 0,
+                    "puts": 9, "clears": 0})
+    m.record_cache({"entries": 5, "hits": 14, "misses": 3,
+                    "stale_drops": 1, "poison_caught": 0, "evictions": 0,
+                    "puts": 12, "clears": 0})
+    assert m.last["hit_rate"] == pytest.approx(14 / 17, abs=1e-3)
+
+    rules = {r.name: r for r in default_rules()}
+    rule = rules["hotstate_hit_rate"]
+    assert rule.op == "<" and rule.kind == "ratio"
+    assert rule.failing_factor >= 1e6  # degrade-only: never pages
